@@ -1,0 +1,11 @@
+"""kueue_tpu: a TPU-native job-queueing and quota-admission framework.
+
+Capabilities of kubernetes-sigs/kueue — Workload/ClusterQueue/LocalQueue/
+Cohort quota semantics, hierarchical borrowing, StrictFIFO/BestEffortFIFO,
+flavor fungibility, classical + fair-sharing (DRF) preemption, two-phase
+admission checks, multi-cluster dispatch, topology-aware gang placement —
+with the admission hot loop reformulated as a batched tensor program solved
+with JAX/XLA on TPU.
+"""
+
+__version__ = "0.1.0"

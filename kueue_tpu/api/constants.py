@@ -1,0 +1,117 @@
+"""API enums and condition constants.
+
+Mirrors the behavioral surface of the reference API types
+(reference: apis/kueue/v1beta2/*_types.go). String values follow the
+reference so that serialized state is recognizable to users migrating over.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class QueueingStrategy(str, enum.Enum):
+    """reference clusterqueue_types.go:190."""
+
+    STRICT_FIFO = "StrictFIFO"
+    BEST_EFFORT_FIFO = "BestEffortFIFO"
+
+
+class PreemptionPolicy(str, enum.Enum):
+    """withinClusterQueue / reclaimWithinCohort policies
+    (reference clusterqueue_types.go:517)."""
+
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    LOWER_OR_NEWER_EQUAL_PRIORITY = "LowerOrNewerEqualPriority"
+    ANY = "Any"
+
+
+class BorrowWithinCohortPolicy(str, enum.Enum):
+    """reference clusterqueue_types.go:573."""
+
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+
+
+class FlavorFungibilityPolicy(str, enum.Enum):
+    """whenCanBorrow / whenCanPreempt (reference clusterqueue_types.go:456)."""
+
+    BORROW = "Borrow"
+    PREEMPT = "Preempt"
+    TRY_NEXT_FLAVOR = "TryNextFlavor"
+
+
+class FlavorFungibilityPreference(str, enum.Enum):
+    """reference clusterqueue_types.go:446."""
+
+    BORROWING_OVER_PREEMPTION = "BorrowingOverPreemption"
+    PREEMPTION_OVER_BORROWING = "PreemptionOverBorrowing"
+
+
+class StopPolicy(str, enum.Enum):
+    NONE = "None"
+    HOLD = "Hold"
+    HOLD_AND_DRAIN = "HoldAndDrain"
+
+
+class AdmissionScope(str, enum.Enum):
+    """reference fairsharing_types.go:55."""
+
+    USAGE_BASED_FAIR_SHARING = "UsageBasedAdmissionFairSharing"
+    NO_FAIR_SHARING = "NoAdmissionFairSharing"
+
+
+# ---- Workload condition types (reference workload_types.go:929-1069) ----
+
+COND_QUOTA_RESERVED = "QuotaReserved"
+COND_ADMITTED = "Admitted"
+COND_PODS_READY = "PodsReady"
+COND_EVICTED = "Evicted"
+COND_PREEMPTED = "Preempted"
+COND_REQUEUED = "Requeued"
+COND_FINISHED = "Finished"
+COND_DEACTIVATION_TARGET = "DeactivationTarget"
+
+# ---- Eviction / preemption reasons ----
+
+EVICTED_BY_PREEMPTION = "Preempted"
+EVICTED_BY_PODS_READY_TIMEOUT = "PodsReadyTimeout"
+EVICTED_BY_ADMISSION_CHECK = "AdmissionCheck"
+EVICTED_BY_CLUSTER_QUEUE_STOPPED = "ClusterQueueStopped"
+EVICTED_BY_LOCAL_QUEUE_STOPPED = "LocalQueueStopped"
+EVICTED_BY_DEACTIVATION = "Deactivated"
+EVICTED_BY_NODE_FAILURE = "NodeFailures"
+
+IN_CLUSTER_QUEUE_REASON = "InClusterQueue"
+IN_COHORT_RECLAMATION_REASON = "InCohortReclamation"
+IN_COHORT_FAIR_SHARING_REASON = "InCohortFairSharing"
+IN_COHORT_RECLAIM_WHILE_BORROWING_REASON = "InCohortReclaimWhileBorrowing"
+
+# ---- QuotaReserved "pending" reasons (subset used by the scheduler) ----
+
+REASON_WAITING_FOR_QUOTA = "WaitingForQuota"
+REASON_EXCEEDS_MAX_QUOTA = "ExceedsMaxQuota"
+REASON_NO_MATCHING_FLAVOR = "NoMatchingFlavor"
+REASON_WAITING_FOR_PREEMPTED = "WaitingForPreemptedWorkloads"
+REASON_PENDING = "Pending"
+
+# ---- AdmissionCheck states (reference workload_types.go:796) ----
+
+
+class CheckState(str, enum.Enum):
+    PENDING = "Pending"
+    READY = "Ready"
+    RETRY = "Retry"
+    REJECTED = "Rejected"
+
+
+class RequeueReason(str, enum.Enum):
+    """Why a workload went back to the queues
+    (reference pkg/cache/queue requeue reasons)."""
+
+    GENERIC = "Generic"
+    FAILED_AFTER_NOMINATION = "FailedAfterNomination"
+    NO_FIT = "NoFit"
+    PREEMPTION_NO_CANDIDATES = "PreemptionNoCandidates"
+    NAMESPACE_MISMATCH = "NamespaceMismatch"

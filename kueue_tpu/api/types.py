@@ -1,0 +1,432 @@
+"""Core API object model: Workload, ClusterQueue, Cohort, LocalQueue,
+ResourceFlavor, AdmissionCheck, Topology, WorkloadPriorityClass.
+
+These are idiomatic Python dataclasses carrying the behaviorally relevant
+fields of the reference CRDs (reference: apis/kueue/v1beta2/). They are the
+host-side object model; the scheduler hot loop operates on dense tensor
+encodings derived from them (kueue_tpu/ops, kueue_tpu/models).
+
+Resource quantities are canonical integers: milliCPU for "cpu", bytes for
+"memory", plain counts otherwise — matching the reference's int64
+canonicalization (reference pkg/resources/amount.go AmountFromQuantity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.constants import (
+    AdmissionScope,
+    BorrowWithinCohortPolicy,
+    CheckState,
+    FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
+    PreemptionPolicy,
+    QueueingStrategy,
+    StopPolicy,
+)
+from kueue_tpu.core.resources import UNLIMITED
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+# --------------------------------------------------------------------------
+# Shared scheduling primitives
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """Subset of corev1.Toleration the admission path evaluates."""
+
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | NoExecute | PreferNoSchedule
+
+
+@dataclass(frozen=True)
+class MatchExpression:
+    """Node-affinity requirement (corev1.NodeSelectorRequirement subset)."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        present = self.key in labels
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        if self.operator == "In":
+            return present and labels[self.key] in self.values
+        if self.operator == "NotIn":
+            return not present or labels[self.key] not in self.values
+        raise ValueError(f"unknown operator {self.operator}")
+
+
+# --------------------------------------------------------------------------
+# ResourceFlavor / Topology
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceFlavor:
+    """Hardware variant (reference resourceflavor_types.go:31-121)."""
+
+    name: str
+    node_labels: Dict[str, str] = field(default_factory=dict)
+    node_taints: List[Taint] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_name: Optional[str] = None
+
+
+@dataclass
+class Topology:
+    """Ordered node-label levels defining the datacenter tree
+    (reference topology_types.go:108-162). For TPU fleets the levels map onto
+    ICI domains: e.g. ("pod", "superpod", "host")."""
+
+    name: str
+    levels: List[str] = field(default_factory=list)  # ordered, top first
+
+
+# --------------------------------------------------------------------------
+# ClusterQueue / Cohort / LocalQueue
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceQuota:
+    """Per (flavor, resource) quota cell (reference clusterqueue_types.go:300).
+
+    ``borrowing_limit``/``lending_limit`` of None mean unlimited borrowing /
+    everything lendable, as in the reference (nil pointers)."""
+
+    nominal: int = 0
+    borrowing_limit: Optional[int] = None
+    lending_limit: Optional[int] = None
+
+
+@dataclass
+class FlavorQuotas:
+    name: str  # ResourceFlavor reference
+    resources: Dict[str, ResourceQuota] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceGroup:
+    """Flavors ordered by preference covering a set of resources
+    (reference clusterqueue_types.go:255)."""
+
+    covered_resources: List[str] = field(default_factory=list)
+    flavors: List[FlavorQuotas] = field(default_factory=list)
+
+
+@dataclass
+class FlavorFungibility:
+    """reference clusterqueue_types.go:456."""
+
+    when_can_borrow: FlavorFungibilityPolicy = FlavorFungibilityPolicy.BORROW
+    when_can_preempt: FlavorFungibilityPolicy = (
+        FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    )
+    preference: Optional[FlavorFungibilityPreference] = None
+
+
+@dataclass
+class BorrowWithinCohort:
+    policy: BorrowWithinCohortPolicy = BorrowWithinCohortPolicy.NEVER
+    max_priority_threshold: Optional[int] = None
+
+
+@dataclass
+class ClusterQueuePreemption:
+    """reference clusterqueue_types.go:517."""
+
+    within_cluster_queue: PreemptionPolicy = PreemptionPolicy.NEVER
+    reclaim_within_cohort: PreemptionPolicy = PreemptionPolicy.NEVER
+    borrow_within_cohort: BorrowWithinCohort = field(
+        default_factory=BorrowWithinCohort
+    )
+
+
+@dataclass
+class FairSharing:
+    """Weight used by DRF ordering (reference fairsharing_types.go:25-39).
+
+    Weight is a non-negative float; 0 means "borrow last, preempt first"."""
+
+    weight: float = 1.0
+
+
+@dataclass
+class ClusterQueue:
+    """Quota pool + admission policies (reference clusterqueue_types.go:67)."""
+
+    name: str
+    cohort: Optional[str] = None
+    resource_groups: List[ResourceGroup] = field(default_factory=list)
+    queueing_strategy: QueueingStrategy = QueueingStrategy.BEST_EFFORT_FIFO
+    preemption: ClusterQueuePreemption = field(
+        default_factory=ClusterQueuePreemption
+    )
+    flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
+    namespace_selector: Optional[Dict[str, str]] = None  # None selects all
+    stop_policy: StopPolicy = StopPolicy.NONE
+    fair_sharing: Optional[FairSharing] = None
+    admission_checks: List[str] = field(default_factory=list)
+    admission_scope: Optional[AdmissionScope] = None
+
+    def flavors_for(self, resource: str) -> List[str]:
+        for rg in self.resource_groups:
+            if resource in rg.covered_resources:
+                return [f.name for f in rg.flavors]
+        return []
+
+
+@dataclass
+class Cohort:
+    """Node in the borrowing hierarchy (reference cohort_types.go:24-72)."""
+
+    name: str
+    parent: Optional[str] = None
+    quotas: List[FlavorQuotas] = field(default_factory=list)
+    fair_sharing: Optional[FairSharing] = None
+
+
+@dataclass
+class LocalQueue:
+    """Namespaced tenant queue -> ClusterQueue
+    (reference localqueue_types.go:33)."""
+
+    name: str
+    namespace: str = "default"
+    cluster_queue: str = ""
+    stop_policy: StopPolicy = StopPolicy.NONE
+    fair_sharing: Optional[FairSharing] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# --------------------------------------------------------------------------
+# Workload
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class TopologyRequest:
+    """Per-podset topology constraint (reference workload_types.go podset
+    topology request): admit only if the gang fits under one domain at
+    ``level`` (required) or prefer to (preferred)."""
+
+    required_level: Optional[str] = None
+    preferred_level: Optional[str] = None
+    unconstrained: bool = False
+    podset_group_name: Optional[str] = None
+    # Gang subdivided into slices pinned under a topology level
+    # (reference workload_types.go:252 PodsetSliceRequiredTopologyConstraint).
+    slice_required_level: Optional[str] = None
+    slice_size: Optional[int] = None
+
+
+@dataclass
+class PodSet:
+    """Homogeneous group of pods (reference workload_types.go:556)."""
+
+    name: str
+    count: int
+    requests: Dict[str, int] = field(default_factory=dict)  # per-pod
+    min_count: Optional[int] = None  # enables partial admission
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    required_affinity: List[MatchExpression] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_request: Optional[TopologyRequest] = None
+
+
+@dataclass
+class PodSetAssignment:
+    """Result of admission for one podset (reference workload_types.go:289)."""
+
+    name: str
+    flavors: Dict[str, str] = field(default_factory=dict)  # resource -> flavor
+    resource_usage: Dict[str, int] = field(default_factory=dict)  # totals
+    count: int = 0
+    topology_assignment: Optional["TopologyAssignment"] = None
+
+
+@dataclass
+class TopologyAssignment:
+    """Domains assigned to a podset (reference workload_types.go:457)."""
+
+    levels: List[str] = field(default_factory=list)
+    # list of (level-values tuple, pod count)
+    domains: List[Tuple[Tuple[str, ...], int]] = field(default_factory=list)
+
+
+@dataclass
+class Admission:
+    """reference workload_types.go:267."""
+
+    cluster_queue: str = ""
+    pod_set_assignments: List[PodSetAssignment] = field(default_factory=list)
+
+
+@dataclass
+class Condition:
+    type: str
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class AdmissionCheckState:
+    name: str
+    state: CheckState = CheckState.PENDING
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class RequeueState:
+    """Eviction backoff bookkeeping (reference workload_types.go:774)."""
+
+    count: int = 0
+    requeue_at: Optional[float] = None
+
+
+@dataclass
+class WorkloadStatus:
+    conditions: List[Condition] = field(default_factory=list)
+    admission: Optional[Admission] = None
+    admission_checks: List[AdmissionCheckState] = field(default_factory=list)
+    requeue_state: Optional[RequeueState] = None
+    reclaimable_pods: Dict[str, int] = field(default_factory=dict)
+    cluster_name: Optional[str] = None  # MultiKueue placement
+    unhealthy_nodes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Workload:
+    """The unit of admission (reference workload_types.go:28)."""
+
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""  # LocalQueue name
+    pod_sets: List[PodSet] = field(default_factory=list)
+    priority: int = 0
+    priority_class: Optional[str] = None
+    active: bool = True
+    creation_time: float = 0.0
+    uid: str = field(default_factory=_new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    maximum_execution_time_seconds: Optional[int] = None
+    status: WorkloadStatus = field(default_factory=WorkloadStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "Workload":
+        return dataclasses.replace(
+            self,
+            pod_sets=[dataclasses.replace(ps) for ps in self.pod_sets],
+            status=dataclasses.replace(
+                self.status,
+                conditions=list(self.status.conditions),
+                admission_checks=list(self.status.admission_checks),
+            ),
+        )
+
+
+# --------------------------------------------------------------------------
+# AdmissionCheck / WorkloadPriorityClass
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class AdmissionCheck:
+    """Two-phase admission plugin registration
+    (reference admissioncheck_types.go:23-134)."""
+
+    name: str
+    controller_name: str = ""
+    parameters: Optional[Dict[str, str]] = None
+    active: bool = True
+
+
+@dataclass
+class WorkloadPriorityClass:
+    """Priority decoupled from pod priority
+    (reference workloadpriorityclass_types.go)."""
+
+    name: str
+    value: int = 0
+
+
+def quota(
+    nominal: int,
+    borrowing_limit: Optional[int] = None,
+    lending_limit: Optional[int] = None,
+) -> ResourceQuota:
+    """Convenience constructor used heavily by tests."""
+    return ResourceQuota(nominal, borrowing_limit, lending_limit)
+
+
+__all__ = [
+    "Admission",
+    "AdmissionCheck",
+    "AdmissionCheckState",
+    "BorrowWithinCohort",
+    "ClusterQueue",
+    "ClusterQueuePreemption",
+    "Cohort",
+    "Condition",
+    "FairSharing",
+    "FlavorFungibility",
+    "FlavorQuotas",
+    "LocalQueue",
+    "MatchExpression",
+    "PodSet",
+    "PodSetAssignment",
+    "RequeueState",
+    "ResourceFlavor",
+    "ResourceGroup",
+    "ResourceQuota",
+    "Taint",
+    "Toleration",
+    "Topology",
+    "TopologyAssignment",
+    "TopologyRequest",
+    "Workload",
+    "WorkloadPriorityClass",
+    "WorkloadStatus",
+    "quota",
+    "UNLIMITED",
+]

@@ -1,0 +1,312 @@
+"""Hierarchical quota engine — host-exact semantics.
+
+This is the behavioral re-derivation of the reference's resourceNode math
+(reference: pkg/cache/scheduler/resource_node.go). Every function here has a
+vectorized twin in ``kueue_tpu/ops/quota_ops.py`` operating on padded
+[node, flavor, resource] int64 tensors; the property tests in
+``tests/test_quota_oracle.py`` pin the two implementations to each other.
+
+Semantics (per FlavorResource cell, all saturating int arithmetic):
+
+- ``subtree_quota`` = own nominal + Σ_children (child.subtree_quota −
+  child.local_quota)                      (resource_node.go:190-227)
+- ``local_quota``   = max(0, subtree_quota − lending_limit) when a lending
+  limit is set, else 0                    (resource_node.go:67)
+- ``usage`` at a cohort = Σ_children max(0, child.usage − child.local_quota)
+- ``available``     = recursive up-tree with borrowing-limit clamp
+                                          (resource_node.go:106-122)
+- ``potential_available`` = max capacity assuming zero usage
+                                          (resource_node.go:129-140)
+- ``add_usage`` / ``remove_usage`` bubble the part of the delta exceeding
+  local quota to the parent               (resource_node.go:144-165)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from kueue_tpu.core.resources import (
+    FlavorResource,
+    FlavorResourceQuantities,
+    UNLIMITED,
+    sat_add,
+    sat_sub,
+)
+
+
+@dataclass
+class QuotaCell:
+    """Quota of one node for one FlavorResource."""
+
+    nominal: int = 0
+    borrowing_limit: Optional[int] = None  # None = unlimited borrowing
+    lending_limit: Optional[int] = None  # None = lend everything
+
+
+class QuotaNode:
+    """One node of the quota tree (a ClusterQueue leaf or a Cohort)."""
+
+    def __init__(self, name: str, is_cq: bool = False) -> None:
+        self.name = name
+        self.is_cq = is_cq
+        self.parent: Optional["QuotaNode"] = None
+        self.children: List["QuotaNode"] = []
+        self.quotas: Dict[FlavorResource, QuotaCell] = {}
+        self.subtree_quota: FlavorResourceQuantities = {}
+        self.usage: FlavorResourceQuantities = {}
+        self.fair_weight: float = 1.0
+
+    # -- navigation ---------------------------------------------------------
+
+    def has_parent(self) -> bool:
+        return self.parent is not None
+
+    def root(self) -> "QuotaNode":
+        node = self
+        while node.parent is not None:
+            node = node.parent
+        return node
+
+    def path_self_to_root(self) -> Iterator["QuotaNode"]:
+        node: Optional[QuotaNode] = self
+        while node is not None:
+            yield node
+            node = node.parent
+
+    # -- cell accessors -----------------------------------------------------
+
+    def local_quota(self, fr: FlavorResource) -> int:
+        cell = self.quotas.get(fr)
+        if cell is None or cell.lending_limit is None:
+            return 0
+        return max(0, sat_sub(self.subtree_quota.get(fr, 0), cell.lending_limit))
+
+    def local_available(self, fr: FlavorResource) -> int:
+        return max(0, sat_sub(self.local_quota(fr), self.usage.get(fr, 0)))
+
+    def available(self, fr: FlavorResource) -> int:
+        """Remaining capacity for this node, honoring borrowing limits.
+        May be negative under overadmission (resource_node.go:106)."""
+        if self.parent is None:
+            return sat_sub(self.subtree_quota.get(fr, 0), self.usage.get(fr, 0))
+        parent_available = self.parent.available(fr)
+        cell = self.quotas.get(fr)
+        if cell is not None and cell.borrowing_limit is not None:
+            lq = self.local_quota(fr)
+            stored_in_parent = sat_sub(self.subtree_quota.get(fr, 0), lq)
+            used_in_parent = max(0, sat_sub(self.usage.get(fr, 0), lq))
+            with_max = sat_add(
+                sat_sub(stored_in_parent, used_in_parent), cell.borrowing_limit
+            )
+            parent_available = min(with_max, parent_available)
+        return sat_add(self.local_available(fr), parent_available)
+
+    def potential_available(self, fr: FlavorResource) -> int:
+        """Max capacity available assuming no usage
+        (resource_node.go:129)."""
+        if self.parent is None:
+            return self.subtree_quota.get(fr, 0)
+        avail = sat_add(self.local_quota(fr), self.parent.potential_available(fr))
+        cell = self.quotas.get(fr)
+        if cell is not None and cell.borrowing_limit is not None:
+            max_with_borrowing = sat_add(
+                self.subtree_quota.get(fr, 0), cell.borrowing_limit
+            )
+            avail = min(max_with_borrowing, avail)
+        return avail
+
+    # -- usage mutation -----------------------------------------------------
+
+    def add_usage(self, fr: FlavorResource, val: int) -> None:
+        """resource_node.go:144. Negative val is not allowed here; use
+        remove_usage (their bubbling rules differ)."""
+        local_avail = self.local_available(fr)
+        self.usage[fr] = sat_add(self.usage.get(fr, 0), val)
+        if self.parent is not None and val > local_avail:
+            self.parent.add_usage(fr, sat_sub(val, local_avail))
+
+    def remove_usage(self, fr: FlavorResource, val: int) -> None:
+        """resource_node.go:156."""
+        stored_in_parent = sat_sub(self.usage.get(fr, 0), self.local_quota(fr))
+        self.usage[fr] = sat_sub(self.usage.get(fr, 0), val)
+        if stored_in_parent <= 0 or self.parent is None:
+            return
+        self.parent.remove_usage(fr, min(val, stored_in_parent))
+
+    # -- fit predicates -----------------------------------------------------
+
+    def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
+        """Would usage+val exceed this node's subtree quota?"""
+        return sat_add(self.usage.get(fr, 0), val) > self.subtree_quota.get(fr, 0)
+
+    def quantities_fit_in_quota(
+        self, requests: FlavorResourceQuantities
+    ) -> Tuple[bool, FlavorResourceQuantities]:
+        """resource_node.go:233: fit at this node + requests remaining past
+        the node's local quota (to be retried on the parent)."""
+        fits = True
+        remaining: FlavorResourceQuantities = {}
+        for fr, v in requests.items():
+            if self.subtree_quota.get(fr, 0) < sat_add(self.usage.get(fr, 0), v):
+                fits = False
+            remaining[fr] = max(0, sat_sub(v, self.local_available(fr)))
+        return fits, remaining
+
+    def is_within_nominal_in(self, frs) -> bool:
+        """resource_node.go:247."""
+        return all(
+            self.subtree_quota.get(fr, 0) >= self.usage.get(fr, 0) for fr in frs
+        )
+
+    def height(self) -> int:
+        """Distance to the furthest leaf; a childless node has height 0
+        (reference hierarchical_preemption.go getNodeHeight)."""
+        h = min(len(self.children), 1)
+        for child in self.children:
+            if not child.is_cq:
+                h = max(h, child.height() + 1)
+        return h
+
+
+def update_tree(root: QuotaNode) -> None:
+    """Recompute subtree_quota bottom-up and cohort usage roll-ups
+    (resource_node.go:190-227). CQ usage is preserved; cohort usage is
+    re-derived from children."""
+    _update_node(root)
+
+
+def _update_node(node: QuotaNode) -> None:
+    node.subtree_quota = {fr: cell.nominal for fr, cell in node.quotas.items()}
+    if not node.is_cq:
+        node.usage = {}
+    for child in node.children:
+        _update_node(child)
+        # accumulateFromChild (resource_node.go:217)
+        for fr, child_quota in child.subtree_quota.items():
+            delta = sat_sub(child_quota, child.local_quota(fr))
+            node.subtree_quota[fr] = sat_add(node.subtree_quota.get(fr, 0), delta)
+        for fr, child_usage in child.usage.items():
+            delta = max(0, sat_sub(child_usage, child.local_quota(fr)))
+            node.usage[fr] = sat_add(node.usage.get(fr, 0), delta)
+
+
+def find_height_of_lowest_subtree_that_fits(
+    cq: QuotaNode, fr: FlavorResource, val: int
+) -> Tuple[int, bool]:
+    """Borrow "distance": height of the lowest cohort subtree that can absorb
+    val of fr (reference hierarchical_preemption.go:221). Returns
+    (height, subtree_is_proper) where the second value reports whether the
+    found subtree is smaller than the whole hierarchy — i.e. reclaim may be
+    possible higher up."""
+    if not cq.borrowing_with(fr, val) or not cq.has_parent():
+        return 0, cq.has_parent()
+    remaining = sat_sub(val, cq.local_available(fr))
+    node = cq.parent
+    while node is not None:
+        if not node.borrowing_with(fr, remaining):
+            return node.height(), node.has_parent()
+        remaining = sat_sub(remaining, node.local_available(fr))
+        node = node.parent
+    assert cq.parent is not None
+    return cq.parent.root().height(), False
+
+
+def calculate_lendable(node: QuotaNode) -> Dict[str, int]:
+    """Aggregate potential capacity per resource name across all flavors,
+    evaluated at ``node`` (reference fair_sharing.go:186)."""
+    root = node.root()
+    lendable: Dict[str, int] = {}
+    for fr in root.subtree_quota:
+        lendable[fr.resource] = sat_add(
+            lendable.get(fr.resource, 0), node.potential_available(fr)
+        )
+    return lendable
+
+
+@dataclass
+class DRS:
+    """Dominant resource share (reference fair_sharing.go:43)."""
+
+    fair_weight: float = 1.0
+    unweighted_ratio: float = 0.0
+    dominant_resource: str = ""
+    borrowing: bool = False
+    borrowed_frs: List[FlavorResource] = field(default_factory=list)
+
+    def is_zero(self) -> bool:
+        return self.unweighted_ratio == 0
+
+    def precise_weighted_share(self) -> float:
+        if self.is_zero():
+            return 0.0
+        if self.fair_weight == 0:
+            return float("inf")
+        return self.unweighted_ratio / self.fair_weight
+
+    def zero_weight_borrows(self) -> bool:
+        return self.fair_weight == 0 and not self.is_zero()
+
+    def is_borrowing_on(self, requested: FlavorResourceQuantities) -> bool:
+        return any(requested.get(fr, 0) > 0 for fr in self.borrowed_frs)
+
+
+def negative_drs() -> DRS:
+    return DRS(unweighted_ratio=-1.0)
+
+
+def compare_drs(a: DRS, b: DRS) -> int:
+    """Lower wins for scheduling, higher wins for preemption
+    (fair_sharing.go:112)."""
+    a_zwb, b_zwb = a.zero_weight_borrows(), b.zero_weight_borrows()
+    if a_zwb and b_zwb:
+        return _cmp(a.unweighted_ratio, b.unweighted_ratio)
+    if a_zwb:
+        return 1
+    if b_zwb:
+        return -1
+    return _cmp(a.precise_weighted_share(), b.precise_weighted_share())
+
+
+def _cmp(a: float, b: float) -> int:
+    return (a > b) - (a < b)
+
+
+def dominant_resource_share(
+    node: QuotaNode, wl_req: FlavorResourceQuantities
+) -> DRS:
+    """share = max over resources of (borrowed-above-subtree-quota × 1000 /
+    lendable-at-parent), ÷ weight (reference fair_sharing.go:149)."""
+    drs = DRS(fair_weight=node.fair_weight)
+    if not node.has_parent():
+        return drs
+
+    borrowing: Dict[str, int] = {}
+    borrowed_frs: List[FlavorResource] = []
+    for fr, quota in node.subtree_quota.items():
+        amount_borrowed = sat_sub(
+            sat_add(wl_req.get(fr, 0), node.usage.get(fr, 0)), quota
+        )
+        if amount_borrowed > 0:
+            borrowing[fr.resource] = sat_add(
+                borrowing.get(fr.resource, 0), amount_borrowed
+            )
+            borrowed_frs.append(fr)
+    if not borrowing:
+        return drs
+    drs.borrowing = True
+    drs.borrowed_frs = borrowed_frs
+
+    assert node.parent is not None
+    lendable = calculate_lendable(node.parent)
+    for r_name, borrowed in borrowing.items():
+        lr = lendable.get(r_name, 0)
+        if lr > 0:
+            ratio = float(borrowed) * 1000.0 / float(lr)
+            if ratio > drs.unweighted_ratio or (
+                ratio == drs.unweighted_ratio
+                and r_name < drs.dominant_resource
+            ):
+                drs.unweighted_ratio = ratio
+                drs.dominant_resource = r_name
+    return drs

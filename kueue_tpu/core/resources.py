@@ -1,0 +1,89 @@
+"""Saturating quota arithmetic and flavor-resource keys.
+
+TPU-native rebuild of the reference's quota math (reference:
+pkg/resources/amount.go, pkg/resources/resources.go). The reference wraps
+int64 in an `Amount` struct whose arithmetic saturates instead of wrapping,
+with math.MaxInt64 as the "Unlimited" sentinel.
+
+Design deviation (deliberate): we use ``UNLIMITED = 2**62`` as the sentinel
+and clamp all quota arithmetic to ``[-UNLIMITED, UNLIMITED]``. This keeps the
+same observable semantics for any realistic quota (real quotas are far below
+2**62) while guaranteeing that the *device* solver — which carries quota as
+int64 JAX arrays — can add any two in-range values without int64 overflow
+(2 * 2**62 < 2**63). Host oracle and TPU kernels therefore share one exact
+integer semantics, which the differential tests rely on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, NamedTuple, Tuple
+
+# "Effectively infinite" quota sentinel. See module docstring.
+UNLIMITED: int = 1 << 62
+_MIN: int = -UNLIMITED
+
+
+def clamp(v: int) -> int:
+    """Clamp an arbitrary int into the representable quota range."""
+    if v >= UNLIMITED:
+        return UNLIMITED
+    if v <= _MIN:
+        return _MIN
+    return v
+
+
+def is_unlimited(v: int) -> bool:
+    return v >= UNLIMITED
+
+
+def sat_add(a: int, b: int) -> int:
+    """Saturating a + b; Unlimited propagates (reference amount.go Add)."""
+    return clamp(a + b)
+
+
+def sat_sub(a: int, b: int) -> int:
+    """Saturating a - b; Unlimited minuend stays Unlimited
+    (reference amount.go Sub)."""
+    if is_unlimited(a):
+        return UNLIMITED
+    return clamp(a - b)
+
+
+class FlavorResource(NamedTuple):
+    """Key of a (ResourceFlavor, resource-name) cell
+    (reference pkg/resources/resource.go FlavorResource)."""
+
+    flavor: str
+    resource: str
+
+
+# FlavorResourceQuantities in the reference: map[FlavorResource]Amount.
+FlavorResourceQuantities = Dict[FlavorResource, int]
+
+
+def frq_add(dst: FlavorResourceQuantities, src: Mapping[FlavorResource, int]) -> None:
+    for fr, v in src.items():
+        dst[fr] = sat_add(dst.get(fr, 0), v)
+
+
+def frq_sub(dst: FlavorResourceQuantities, src: Mapping[FlavorResource, int]) -> None:
+    for fr, v in src.items():
+        dst[fr] = sat_sub(dst.get(fr, 0), v)
+
+
+def frq_clone(src: Mapping[FlavorResource, int]) -> FlavorResourceQuantities:
+    return dict(src)
+
+
+# Canonical resource names (subset of corev1 the scheduler treats specially).
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+
+def resource_requests_total(
+    per_pod: Mapping[str, int], count: int
+) -> Dict[str, int]:
+    """Total requests of a podset: per-pod requests scaled by pod count
+    (reference pkg/workload TotalRequests semantics)."""
+    return {name: clamp(v * count) for name, v in per_pod.items()}

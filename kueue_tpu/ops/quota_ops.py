@@ -1,0 +1,295 @@
+"""Vectorized hierarchical quota math on padded device tensors.
+
+These are the TPU twins of ``kueue_tpu/cache/resource_node.py`` (which
+re-derives reference pkg/cache/scheduler/resource_node.go). The cohort tree
+is encoded as parent-pointer arrays; every per-FlavorResource scalar function
+becomes an elementwise op over an ``[N, F, R]`` int64 tensor, and the
+up/down-tree recursions become depth-bounded loops (depth <= MAX_DEPTH,
+unrolled at trace time) of gathers/scatter-adds — XLA-friendly: static
+shapes, no data-dependent control flow.
+
+Int64 discipline: quota arithmetic must be exact, so everything here is i64
+(``jax_enable_x64`` is flipped on at import). Saturation clamps to
+±UNLIMITED = ±2**62, so any two in-range values add without int64 overflow.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from kueue_tpu.core.resources import UNLIMITED
+
+# Maximum supported cohort-tree depth (root=0). The reference supports
+# arbitrary depth; 8 levels is far beyond any practical hierarchy and keeps
+# the unrolled tree walks cheap.
+MAX_DEPTH = 8
+
+I64 = jnp.int64
+CAP = jnp.int64(UNLIMITED)
+
+
+def sat(v: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(v, -CAP, CAP)
+
+
+def sat_add(a, b):
+    return sat(a + b)
+
+
+def sat_sub(a, b):
+    """a - b with Unlimited minuend staying Unlimited."""
+    return jnp.where(a >= CAP, CAP, sat(a - b))
+
+
+_CAP_F = float(UNLIMITED)
+
+
+def sat_scatter_add(base: jnp.ndarray, idx: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """base.at[idx].add(deltas) with saturation at ±UNLIMITED.
+
+    A plain int64 scatter-add wraps when several near-UNLIMITED values land on
+    one row (2 * 2**62 >= 2**63). A float64 shadow accumulation detects any
+    row whose true sum leaves the representable range — float64 is only used
+    as an overflow detector, the returned values stay exact int64 below the
+    cap.
+    """
+    int_sum = base.at[idx].add(deltas, mode="drop")
+    f_sum = base.astype(jnp.float64).at[idx].add(
+        deltas.astype(jnp.float64), mode="drop"
+    )
+    return jnp.where(
+        f_sum >= _CAP_F, CAP, jnp.where(f_sum <= -_CAP_F, -CAP, sat(int_sum))
+    )
+
+
+class QuotaTreeArrays(NamedTuple):
+    """Dense encoding of the CQ/Cohort quota tree.
+
+    N = padded node count (ClusterQueues are leaves, Cohorts internal; node 0
+    conventionally unused padding is allowed). F/R = padded flavor/resource
+    axes. Quantities are canonical integers (milliCPU, bytes, counts).
+    """
+
+    parent: jnp.ndarray  # i32[N], -1 for roots and padding
+    active: jnp.ndarray  # bool[N]
+    depth: jnp.ndarray  # i32[N], root=0; padding=0
+    height: jnp.ndarray  # i32[N], distance to furthest leaf cohort-wise
+    nominal: jnp.ndarray  # i64[N,F,R]
+    borrow_limit: jnp.ndarray  # i64[N,F,R]; CAP where unset (= unlimited)
+    has_borrow_limit: jnp.ndarray  # bool[N,F,R]
+    lend_limit: jnp.ndarray  # i64[N,F,R]; CAP where unset
+    has_lend_limit: jnp.ndarray  # bool[N,F,R]
+    subtree_quota: jnp.ndarray  # i64[N,F,R] (computed; see compute_subtree)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.parent.shape[0]
+
+
+def _parent_or_self(tree: QuotaTreeArrays) -> jnp.ndarray:
+    """Parent indices with roots/padding redirected to themselves, so gathers
+    stay in-bounds."""
+    return jnp.where(tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent)
+
+
+def local_quota(tree: QuotaTreeArrays) -> jnp.ndarray:
+    """max(0, subtree_quota - lending_limit) where a lending limit is set
+    (resource_node.go:67)."""
+    lq = jnp.maximum(0, sat_sub(tree.subtree_quota, tree.lend_limit))
+    return jnp.where(tree.has_lend_limit, lq, 0)
+
+
+def local_available(tree: QuotaTreeArrays, usage: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(0, sat_sub(local_quota(tree), usage))
+
+
+def compute_subtree(
+    tree: QuotaTreeArrays, cq_usage: jnp.ndarray, is_cq: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Bottom-up fill of subtree_quota and cohort usage roll-up
+    (resource_node.go:190-227).
+
+    Args:
+      cq_usage: i64[N,F,R], meaningful on CQ rows (cohort rows are derived).
+      is_cq: bool[N].
+
+    Returns (subtree_quota, usage) for all nodes.
+    """
+    n = tree.n_nodes
+    parent = _parent_or_self(tree)
+    subtree = tree.nominal
+    usage = jnp.where(is_cq[:, None, None], cq_usage, 0)
+
+    # Process levels deepest-first. A node's subtree_quota is final once all
+    # deeper levels have contributed, because contributions only flow one
+    # level up per iteration.
+    for d in range(MAX_DEPTH, 0, -1):
+        at_level = (tree.depth == d) & tree.active & (tree.parent >= 0)
+        mask = at_level[:, None, None]
+        # local_quota depends on the node's *final* subtree quota, available
+        # at this iteration since the node's children were already folded in.
+        lq = jnp.where(
+            tree.has_lend_limit,
+            jnp.maximum(0, sat_sub(subtree, tree.lend_limit)),
+            0,
+        )
+        q_delta = jnp.where(mask, sat_sub(subtree, lq), 0)
+        u_delta = jnp.where(mask, jnp.maximum(0, sat_sub(usage, lq)), 0)
+        subtree = sat_scatter_add(subtree, parent, q_delta)
+        usage = sat_scatter_add(usage, parent, u_delta)
+    return subtree, usage
+
+
+def available_all(tree: QuotaTreeArrays, usage: jnp.ndarray) -> jnp.ndarray:
+    """available() for every node at once (resource_node.go:106-122), by a
+    top-down sweep: roots first, then each level consumes its parent's
+    finished value."""
+    parent = _parent_or_self(tree)
+    lq = local_quota(tree)
+    l_avail = jnp.maximum(0, sat_sub(lq, usage))
+    stored_in_parent = sat_sub(tree.subtree_quota, lq)
+    used_in_parent = jnp.maximum(0, sat_sub(usage, lq))
+    with_max_from_parent = sat_add(
+        sat_sub(stored_in_parent, used_in_parent), tree.borrow_limit
+    )
+
+    root_avail = sat_sub(tree.subtree_quota, usage)
+    avail = root_avail  # correct for roots; refined level by level
+    for d in range(1, MAX_DEPTH + 1):
+        at_level = ((tree.depth == d) & (tree.parent >= 0))[:, None, None]
+        parent_avail = avail[parent]
+        clamped = jnp.where(
+            tree.has_borrow_limit,
+            jnp.minimum(with_max_from_parent, parent_avail),
+            parent_avail,
+        )
+        avail = jnp.where(at_level, sat_add(l_avail, clamped), avail)
+    return avail
+
+
+def potential_available_all(tree: QuotaTreeArrays) -> jnp.ndarray:
+    """potentialAvailable() for every node (resource_node.go:129-140)."""
+    parent = _parent_or_self(tree)
+    lq = local_quota(tree)
+    max_with_borrowing = sat_add(tree.subtree_quota, tree.borrow_limit)
+
+    pot = tree.subtree_quota  # correct for roots
+    for d in range(1, MAX_DEPTH + 1):
+        at_level = ((tree.depth == d) & (tree.parent >= 0))[:, None, None]
+        val = sat_add(lq, pot[parent])
+        val = jnp.where(
+            tree.has_borrow_limit, jnp.minimum(max_with_borrowing, val), val
+        )
+        pot = jnp.where(at_level, val, pot)
+    return pot
+
+
+def ancestor_chain(tree: QuotaTreeArrays, node: jnp.ndarray) -> jnp.ndarray:
+    """Indices of node, parent, grandparent, ... padded by repeating the
+    root. Returns i32[MAX_DEPTH+1]."""
+    parent = _parent_or_self(tree)
+    chain = [node]
+    for _ in range(MAX_DEPTH):
+        chain.append(parent[chain[-1]])
+    return jnp.stack(chain)
+
+
+def add_usage(
+    tree: QuotaTreeArrays, usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray
+) -> jnp.ndarray:
+    """Add delta i64[F,R] of usage at ``node``, bubbling the part exceeding
+    local availability up the ancestor chain (resource_node.go:144-152).
+
+    Returns the updated usage tensor. Works under jit/scan: the chain walk is
+    a fixed MAX_DEPTH-step unrolled loop of gathers + one scatter-add.
+    """
+    chain = ancestor_chain(tree, node)
+    lq = local_quota(tree)
+    deltas = jnp.zeros((MAX_DEPTH + 1,) + delta.shape, dtype=I64)
+    cur = delta
+    for i in range(MAX_DEPTH + 1):
+        idx = chain[i]
+        local_avail = jnp.maximum(0, sat_sub(lq[idx], usage[idx]))
+        deltas = deltas.at[i].set(cur)
+        has_parent = tree.parent[idx] >= 0
+        # bubble only the excess over (pre-update) local availability
+        cur = jnp.where(has_parent, jnp.maximum(0, sat_sub(cur, local_avail)), 0)
+        # NOTE: reference bubbles (val - localAvailable) which may go negative
+        # only when val < localAvailable, in which case it doesn't recurse at
+        # all; max(0, ...) with the has_parent gate reproduces both branches
+        # for non-negative val.
+    return sat_scatter_add(usage, chain, deltas)
+
+
+def remove_usage(
+    tree: QuotaTreeArrays, usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray
+) -> jnp.ndarray:
+    """Inverse of add_usage (resource_node.go:156-165)."""
+    chain = ancestor_chain(tree, node)
+    lq = local_quota(tree)
+    deltas = jnp.zeros((MAX_DEPTH + 1,) + delta.shape, dtype=I64)
+    cur = delta
+    for i in range(MAX_DEPTH + 1):
+        idx = chain[i]
+        stored_in_parent = sat_sub(usage[idx], lq[idx])
+        deltas = deltas.at[i].set(cur)
+        has_parent = tree.parent[idx] >= 0
+        cont = has_parent & (stored_in_parent > 0)
+        cur = jnp.where(cont, jnp.minimum(cur, stored_in_parent), 0)
+    return sat_scatter_add(usage, chain, -deltas)
+
+
+def borrow_height(
+    tree: QuotaTreeArrays,
+    usage: jnp.ndarray,
+    cq: jnp.ndarray,
+    fr_val: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """FindHeightOfLowestSubtreeThatFits, batched over [F, R]
+    (reference hierarchical_preemption.go:221).
+
+    Args:
+      cq: scalar node index.
+      fr_val: i64[F,R] additional amount per flavor-resource cell.
+
+    Returns (height i32[F,R], proper_subtree bool[F,R]) where proper_subtree
+    reports the found subtree being smaller than the whole hierarchy.
+    """
+    chain = ancestor_chain(tree, cq)
+    lq = local_quota(tree)
+    l_avail = jnp.maximum(0, sat_sub(lq, usage))
+
+    fshape = fr_val.shape
+    height = jnp.zeros(fshape, dtype=jnp.int32)
+    proper = jnp.zeros(fshape, dtype=bool)
+    done = jnp.zeros(fshape, dtype=bool)
+
+    # Level 0: the CQ itself.
+    borrowing0 = sat_add(usage[cq], fr_val) > tree.subtree_quota[cq]
+    has_parent0 = tree.parent[cq] >= 0
+    fits_here = (~borrowing0) | (~has_parent0)
+    height = jnp.where(fits_here, 0, height)
+    proper = jnp.where(fits_here, has_parent0, proper)
+    done = done | fits_here
+
+    remaining = sat_sub(fr_val, l_avail[cq])
+    root_height = tree.height[chain[MAX_DEPTH]]
+    for i in range(1, MAX_DEPTH + 1):
+        idx = chain[i]
+        is_real = idx != chain[i - 1]  # chain pads by repeating the root
+        borrowing = sat_add(usage[idx], remaining) > tree.subtree_quota[idx]
+        fits = (~borrowing) & is_real & ~done
+        height = jnp.where(fits, tree.height[idx], height)
+        proper = jnp.where(fits, tree.parent[idx] >= 0, proper)
+        done = done | fits
+        remaining = jnp.where(done, remaining, sat_sub(remaining, l_avail[idx]))
+    # Nothing fit: whole-hierarchy height, not a proper subtree.
+    height = jnp.where(done, height, root_height)
+    proper = jnp.where(done, proper, False)
+    return height, proper

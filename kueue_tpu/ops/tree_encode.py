@@ -1,0 +1,123 @@
+"""Encode a host QuotaNode tree into padded QuotaTreeArrays.
+
+The encoder is host-side (runs once per snapshot); everything downstream is
+jittable. Flavors and resources get dense indices; nodes are laid out in an
+arbitrary stable order with parent pointers, depth and cohort height
+precomputed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from kueue_tpu.cache.resource_node import QuotaNode
+from kueue_tpu.core.resources import FlavorResource, UNLIMITED
+from kueue_tpu.ops.quota_ops import MAX_DEPTH, QuotaTreeArrays
+
+import jax.numpy as jnp
+
+
+@dataclass
+class TreeIndex:
+    """Host-side mapping between names and dense indices."""
+
+    node_of: Dict[str, int] = field(default_factory=dict)
+    nodes: List[QuotaNode] = field(default_factory=list)
+    flavor_of: Dict[str, int] = field(default_factory=dict)
+    flavors: List[str] = field(default_factory=list)
+    resource_of: Dict[str, int] = field(default_factory=dict)
+    resources: List[str] = field(default_factory=list)
+
+    def fr_index(self, fr: FlavorResource) -> Tuple[int, int]:
+        return self.flavor_of[fr.flavor], self.resource_of[fr.resource]
+
+
+def _collect(root: QuotaNode, out: List[QuotaNode]) -> None:
+    out.append(root)
+    for child in root.children:
+        _collect(child, out)
+
+
+def encode_tree(
+    roots: List[QuotaNode],
+    n_pad: int = 0,
+    f_pad: int = 0,
+    r_pad: int = 0,
+) -> Tuple[QuotaTreeArrays, "TreeIndex", jnp.ndarray, jnp.ndarray]:
+    """Returns (tree_arrays, index, cq_usage[N,F,R], is_cq[N]).
+
+    subtree_quota in the returned arrays is zero; callers run
+    ``quota_ops.compute_subtree`` (or copy host-computed values) to fill it.
+    """
+    idx = TreeIndex()
+    order: List[QuotaNode] = []
+    for root in roots:
+        _collect(root, order)
+    for node in order:
+        idx.node_of[node.name] = len(idx.nodes)
+        idx.nodes.append(node)
+        for fr in node.quotas:
+            if fr.flavor not in idx.flavor_of:
+                idx.flavor_of[fr.flavor] = len(idx.flavors)
+                idx.flavors.append(fr.flavor)
+            if fr.resource not in idx.resource_of:
+                idx.resource_of[fr.resource] = len(idx.resources)
+                idx.resources.append(fr.resource)
+
+    n = max(len(idx.nodes), n_pad, 1)
+    f = max(len(idx.flavors), f_pad, 1)
+    r = max(len(idx.resources), r_pad, 1)
+
+    parent = np.full(n, -1, dtype=np.int32)
+    active = np.zeros(n, dtype=bool)
+    depth = np.zeros(n, dtype=np.int32)
+    height = np.zeros(n, dtype=np.int32)
+    is_cq = np.zeros(n, dtype=bool)
+    nominal = np.zeros((n, f, r), dtype=np.int64)
+    borrow_limit = np.full((n, f, r), UNLIMITED, dtype=np.int64)
+    has_borrow = np.zeros((n, f, r), dtype=bool)
+    lend_limit = np.full((n, f, r), UNLIMITED, dtype=np.int64)
+    has_lend = np.zeros((n, f, r), dtype=bool)
+    usage = np.zeros((n, f, r), dtype=np.int64)
+
+    for i, node in enumerate(idx.nodes):
+        active[i] = True
+        is_cq[i] = node.is_cq
+        if node.parent is not None:
+            parent[i] = idx.node_of[node.parent.name]
+        d = sum(1 for _ in node.path_self_to_root()) - 1
+        if d > MAX_DEPTH:
+            raise ValueError(
+                f"cohort tree depth {d} exceeds MAX_DEPTH={MAX_DEPTH}"
+            )
+        depth[i] = d
+        height[i] = node.height()
+        for fr, cell in node.quotas.items():
+            fi, ri = idx.fr_index(fr)
+            nominal[i, fi, ri] = cell.nominal
+            if cell.borrowing_limit is not None:
+                borrow_limit[i, fi, ri] = cell.borrowing_limit
+                has_borrow[i, fi, ri] = True
+            if cell.lending_limit is not None:
+                lend_limit[i, fi, ri] = cell.lending_limit
+                has_lend[i, fi, ri] = True
+        for fr, v in node.usage.items():
+            fi, ri = idx.fr_index(fr)
+            usage[i, fi, ri] = v
+
+    tree = QuotaTreeArrays(
+        parent=jnp.asarray(parent),
+        active=jnp.asarray(active),
+        depth=jnp.asarray(depth),
+        height=jnp.asarray(height),
+        nominal=jnp.asarray(nominal),
+        borrow_limit=jnp.asarray(borrow_limit),
+        has_borrow_limit=jnp.asarray(has_borrow),
+        lend_limit=jnp.asarray(lend_limit),
+        has_lend_limit=jnp.asarray(has_lend),
+        subtree_quota=jnp.zeros((n, f, r), dtype=jnp.int64),
+    )
+    return tree, idx, jnp.asarray(usage), jnp.asarray(is_cq)

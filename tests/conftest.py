@@ -1,0 +1,22 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Real multi-chip TPU hardware is not available in CI; sharding correctness is
+validated on XLA's host-platform device emulation (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment pre-imports JAX with the remote-TPU platform before
+pytest starts (sitecustomize), so we must switch the platform via
+jax.config, not environment variables.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+if os.environ.get("KUEUE_TPU_TEST_ON_TPU", "") != "1":
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

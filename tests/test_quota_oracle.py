@@ -1,0 +1,215 @@
+"""Property tests: host quota oracle vs vectorized JAX quota kernels.
+
+Random cohort forests with random quotas/limits/usages; every per-node
+per-FlavorResource quantity computed by the host oracle
+(kueue_tpu/cache/resource_node.py, exact reference semantics) must match the
+dense device kernels (kueue_tpu/ops/quota_ops.py) bit for bit.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.cache.resource_node import (
+    QuotaCell,
+    QuotaNode,
+    find_height_of_lowest_subtree_that_fits,
+    update_tree,
+)
+from kueue_tpu.core.resources import FlavorResource, UNLIMITED
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.tree_encode import encode_tree
+
+FLAVORS = ["on-demand", "spot", "tpu-v5e"]
+RESOURCES = ["cpu", "memory", "tpu"]
+
+
+def random_forest(rng: random.Random, n_cohorts=6, n_cqs=8, depth_bias=0.5):
+    """Build a random cohort forest with CQ leaves and random quota cells."""
+    cohorts = []
+    for i in range(n_cohorts):
+        node = QuotaNode(f"cohort-{i}")
+        if cohorts and rng.random() < depth_bias:
+            parent = rng.choice(cohorts)
+            node.parent = parent
+            parent.children.append(node)
+        cohorts.append(node)
+    cqs = []
+    for i in range(n_cqs):
+        cq = QuotaNode(f"cq-{i}", is_cq=True)
+        if cohorts and rng.random() < 0.9:
+            parent = rng.choice(cohorts)
+            cq.parent = parent
+            parent.children.append(cq)
+        cqs.append(cq)
+
+    def random_cells(node, p_cell=0.8):
+        for f in FLAVORS:
+            for r in RESOURCES:
+                if rng.random() > p_cell:
+                    continue
+                fr = FlavorResource(f, r)
+                cell = QuotaCell(nominal=rng.randrange(0, 100))
+                if rng.random() < 0.4:
+                    cell.borrowing_limit = rng.randrange(0, 50)
+                if rng.random() < 0.4:
+                    cell.lending_limit = rng.randrange(0, 50)
+                node.quotas[fr] = cell
+
+    for node in cohorts + cqs:
+        random_cells(node)
+    for cq in cqs:
+        for fr in list(cq.quotas):
+            if rng.random() < 0.7:
+                cq.usage[fr] = rng.randrange(0, 120)
+
+    roots = [n for n in cohorts + cqs if n.parent is None]
+    for root in roots:
+        update_tree(root)
+    return roots, cqs
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_subtree_available_potential_match_oracle(seed):
+    rng = random.Random(seed)
+    roots, cqs = random_forest(rng)
+    tree, idx, cq_usage, is_cq = encode_tree(roots)
+
+    subtree, usage = quota_ops.compute_subtree(tree, cq_usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+    avail = np.asarray(quota_ops.available_all(tree, usage))
+    pot = np.asarray(quota_ops.potential_available_all(tree))
+    subtree_np = np.asarray(subtree)
+    usage_np = np.asarray(usage)
+
+    for node in idx.nodes:
+        i = idx.node_of[node.name]
+        for f in FLAVORS:
+            for r in RESOURCES:
+                fr = FlavorResource(f, r)
+                fi, ri = idx.flavor_of[f], idx.resource_of[r]
+                assert subtree_np[i, fi, ri] == node.subtree_quota.get(fr, 0), (
+                    node.name, fr)
+                assert usage_np[i, fi, ri] == node.usage.get(fr, 0), (
+                    node.name, fr)
+                assert avail[i, fi, ri] == node.available(fr), (node.name, fr)
+                assert pot[i, fi, ri] == node.potential_available(fr), (
+                    node.name, fr)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_add_remove_usage_match_oracle(seed):
+    rng = random.Random(1000 + seed)
+    roots, cqs = random_forest(rng)
+    tree, idx, cq_usage, is_cq = encode_tree(roots)
+    subtree, usage = quota_ops.compute_subtree(tree, cq_usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+
+    f_n, r_n = len(FLAVORS), len(RESOURCES)
+    for _ in range(10):
+        cq = rng.choice(cqs)
+        i = idx.node_of[cq.name]
+        delta_np = np.zeros((tree.nominal.shape[1], tree.nominal.shape[2]),
+                            dtype=np.int64)
+        host_deltas = {}
+        for _ in range(rng.randrange(1, 4)):
+            fr = FlavorResource(rng.choice(FLAVORS), rng.choice(RESOURCES))
+            v = rng.randrange(0, 60)
+            host_deltas[fr] = host_deltas.get(fr, 0) + v
+        for fr, v in host_deltas.items():
+            delta_np[idx.flavor_of[fr.flavor], idx.resource_of[fr.resource]] = v
+
+        if rng.random() < 0.6:
+            usage = quota_ops.add_usage(tree, usage, i, delta_np)
+            for fr, v in host_deltas.items():
+                cq.add_usage(fr, v)
+        else:
+            usage = quota_ops.remove_usage(tree, usage, i, delta_np)
+            for fr, v in host_deltas.items():
+                cq.remove_usage(fr, v)
+
+        usage_np = np.asarray(usage)
+        for node in idx.nodes:
+            j = idx.node_of[node.name]
+            for f in FLAVORS:
+                for r in RESOURCES:
+                    fr = FlavorResource(f, r)
+                    fi, ri = idx.flavor_of[f], idx.resource_of[r]
+                    assert usage_np[j, fi, ri] == node.usage.get(fr, 0), (
+                        node.name, fr, host_deltas)
+
+
+def test_add_usage_multiple_frs_single_call():
+    """add_usage with several (flavor, resource) cells in one delta tensor
+    must bubble each cell independently, like per-fr host calls."""
+    rng = random.Random(7)
+    roots, cqs = random_forest(rng, n_cohorts=3, n_cqs=4)
+    tree, idx, cq_usage, is_cq = encode_tree(roots)
+    subtree, usage = quota_ops.compute_subtree(tree, cq_usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+
+    cq = next(c for c in cqs if c.parent is not None)
+    i = idx.node_of[cq.name]
+    delta = np.zeros(tree.nominal.shape[1:], dtype=np.int64)
+    for f in FLAVORS:
+        for r in RESOURCES:
+            delta[idx.flavor_of[f], idx.resource_of[r]] = 37
+            cq.add_usage(FlavorResource(f, r), 37)
+    usage = np.asarray(quota_ops.add_usage(tree, usage, i, delta))
+    for node in idx.nodes:
+        j = idx.node_of[node.name]
+        for f in FLAVORS:
+            for r in RESOURCES:
+                fr = FlavorResource(f, r)
+                assert usage[j, idx.flavor_of[f], idx.resource_of[r]] == \
+                    node.usage.get(fr, 0)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_borrow_height_matches_oracle(seed):
+    rng = random.Random(2000 + seed)
+    roots, cqs = random_forest(rng)
+    tree, idx, cq_usage, is_cq = encode_tree(roots)
+    subtree, usage = quota_ops.compute_subtree(tree, cq_usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+
+    for cq in cqs:
+        i = idx.node_of[cq.name]
+        vals = np.zeros(tree.nominal.shape[1:], dtype=np.int64)
+        expected = {}
+        for f in FLAVORS:
+            for r in RESOURCES:
+                fr = FlavorResource(f, r)
+                v = rng.randrange(0, 150)
+                vals[idx.flavor_of[f], idx.resource_of[r]] = v
+                expected[fr] = find_height_of_lowest_subtree_that_fits(
+                    cq, fr, v)
+        height, proper = quota_ops.borrow_height(tree, usage, i, vals)
+        height, proper = np.asarray(height), np.asarray(proper)
+        for fr, (eh, ep) in expected.items():
+            fi, ri = idx.flavor_of[fr.flavor], idx.resource_of[fr.resource]
+            assert height[fi, ri] == eh, (cq.name, fr)
+            assert bool(proper[fi, ri]) == ep, (cq.name, fr)
+
+
+def test_unlimited_saturation():
+    root = QuotaNode("root")
+    cq = QuotaNode("cq", is_cq=True)
+    cq.parent = root
+    root.children.append(cq)
+    fr = FlavorResource("f", "cpu")
+    cq.quotas[fr] = QuotaCell(nominal=UNLIMITED)
+    root.quotas[fr] = QuotaCell(nominal=UNLIMITED)
+    update_tree(root)
+    assert root.subtree_quota[fr] == UNLIMITED  # saturated, not 2*UNLIMITED
+    cq.add_usage(fr, 10**15)
+    assert cq.available(fr) == UNLIMITED  # unlimited minuend stays unlimited
+
+    tree, idx, cq_usage, is_cq = encode_tree([root])
+    subtree, usage = quota_ops.compute_subtree(tree, cq_usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+    avail = np.asarray(quota_ops.available_all(tree, usage))
+    i = idx.node_of["cq"]
+    fi, ri = idx.flavor_of["f"], idx.resource_of["cpu"]
+    assert avail[i, fi, ri] == UNLIMITED
