@@ -1,0 +1,169 @@
+"""Authoritative in-memory state of admitted usage.
+
+Behavioral surface: reference pkg/cache/scheduler/cache.go — the live store
+of ClusterQueues/Cohorts/ResourceFlavors/AdmissionChecks and admitted
+workloads, with assume/forget semantics for optimistic admission, and the
+per-cycle Snapshot() constructor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from kueue_tpu.api.constants import StopPolicy
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    Topology,
+    Workload,
+)
+from kueue_tpu.cache.snapshot import (
+    ClusterQueueSnapshot,
+    Snapshot,
+    build_quota_tree,
+    has_cycle,
+)
+from kueue_tpu.cache.resource_node import update_tree
+from kueue_tpu.core.workload_info import WorkloadInfo
+
+
+class Cache:
+    """reference cache.go:144."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.cluster_queues: Dict[str, ClusterQueue] = {}
+        self.cohorts: Dict[str, Cohort] = {}
+        self.resource_flavors: Dict[str, ResourceFlavor] = {}
+        self.admission_checks: Dict[str, AdmissionCheck] = {}
+        self.topologies: Dict[str, Topology] = {}
+        self.local_queues: Dict[str, LocalQueue] = {}
+        # Admitted (or assumed) workloads, keyed by "ns/name".
+        self.workloads: Dict[str, WorkloadInfo] = {}
+        self.assumed: Set[str] = set()
+        self.generation = 0
+
+    # -- spec management ----------------------------------------------------
+
+    def add_or_update_cluster_queue(self, cq: ClusterQueue) -> None:
+        with self._lock:
+            self.cluster_queues[cq.name] = cq
+            self.generation += 1
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            self.cluster_queues.pop(name, None)
+            self.generation += 1
+
+    def add_or_update_cohort(self, cohort: Cohort) -> None:
+        with self._lock:
+            self.cohorts[cohort.name] = cohort
+            self.generation += 1
+
+    def delete_cohort(self, name: str) -> None:
+        with self._lock:
+            self.cohorts.pop(name, None)
+            self.generation += 1
+
+    def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
+        with self._lock:
+            self.resource_flavors[rf.name] = rf
+            self.generation += 1
+
+    def delete_resource_flavor(self, name: str) -> None:
+        with self._lock:
+            self.resource_flavors.pop(name, None)
+            self.generation += 1
+
+    def add_or_update_admission_check(self, ac: AdmissionCheck) -> None:
+        with self._lock:
+            self.admission_checks[ac.name] = ac
+
+    def add_or_update_topology(self, topo: Topology) -> None:
+        with self._lock:
+            self.topologies[topo.name] = topo
+
+    def add_or_update_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+
+    # -- workload lifecycle -------------------------------------------------
+
+    def add_or_update_workload(self, info: WorkloadInfo) -> None:
+        with self._lock:
+            self.workloads[info.key] = info
+            self.assumed.discard(info.key)
+
+    def assume_workload(self, info: WorkloadInfo) -> None:
+        """Optimistic admission before the status write lands
+        (reference cache.go AssumeWorkload)."""
+        with self._lock:
+            self.workloads[info.key] = info
+            self.assumed.add(info.key)
+
+    def forget_workload(self, key: str) -> None:
+        with self._lock:
+            if key in self.assumed:
+                self.assumed.discard(key)
+                self.workloads.pop(key, None)
+
+    def delete_workload(self, key: str) -> None:
+        with self._lock:
+            self.workloads.pop(key, None)
+            self.assumed.discard(key)
+
+    def is_added(self, key: str) -> bool:
+        with self._lock:
+            return key in self.workloads
+
+    # -- CQ activity --------------------------------------------------------
+
+    def cluster_queue_active(self, cq: ClusterQueue) -> bool:
+        """A CQ is inactive when stopped or referencing missing flavors /
+        inactive admission checks (reference clusterqueue.go
+        updateQueueStatus)."""
+        if cq.stop_policy != StopPolicy.NONE:
+            return False
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                if fq.name not in self.resource_flavors:
+                    return False
+        for ac_name in cq.admission_checks:
+            ac = self.admission_checks.get(ac_name)
+            if ac is None or not ac.active:
+                return False
+        return True
+
+    # -- snapshot -----------------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        """reference snapshot.go:161: copy-on-cycle scheduling view."""
+        with self._lock:
+            snap = Snapshot()
+            snap.resource_flavors = dict(self.resource_flavors)
+            nodes = build_quota_tree(
+                self.cohorts.values(), self.cluster_queues.values()
+            )
+            if has_cycle(nodes):
+                raise ValueError("cohort hierarchy has a cycle")
+            roots = [n for n in nodes.values() if n.parent is None]
+            for root in roots:
+                update_tree(root)
+            snap.roots = roots
+            for name, cq in self.cluster_queues.items():
+                cqs = ClusterQueueSnapshot(cq, nodes[name])
+                cqs.allocatable_generation = self.generation
+                snap.cluster_queues[name] = cqs
+                if not self.cluster_queue_active(cq):
+                    snap.inactive_cluster_queues.add(name)
+            for name, node in nodes.items():
+                if not node.is_cq:
+                    snap.cohorts[name] = node
+            for info in self.workloads.values():
+                if info.cluster_queue in snap.cluster_queues:
+                    snap.add_workload(info.clone())
+            return snap

@@ -1,0 +1,200 @@
+"""Scheduling snapshot: copy-on-cycle view of admitted usage.
+
+Behavioral surface: reference pkg/cache/scheduler/snapshot.go and
+clusterqueue_snapshot.go. The snapshot owns a QuotaNode tree (exact
+hierarchical quota math) plus per-CQ workload maps; AddWorkload /
+RemoveWorkload / SimulateWorkloadRemoval are the scheduler's transaction
+primitives for preemption simulation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set
+
+from kueue_tpu.api.types import ClusterQueue, Cohort, ResourceFlavor, ResourceQuota
+from kueue_tpu.cache.resource_node import (
+    DRS,
+    QuotaCell,
+    QuotaNode,
+    dominant_resource_share,
+    update_tree,
+)
+from kueue_tpu.core.resources import FlavorResource, FlavorResourceQuantities
+from kueue_tpu.core.workload_info import WorkloadInfo
+
+
+class ClusterQueueSnapshot:
+    """reference clusterqueue_snapshot.go."""
+
+    def __init__(self, spec: ClusterQueue, node: QuotaNode) -> None:
+        self.spec = spec
+        self.node = node
+        self.workloads: Dict[str, WorkloadInfo] = {}
+        self.allocatable_generation = 0
+
+    # -- identity / topology ------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def has_parent(self) -> bool:
+        return self.node.parent is not None
+
+    def parent(self) -> Optional[QuotaNode]:
+        return self.node.parent
+
+    def path_parent_to_root(self) -> Iterator[QuotaNode]:
+        node = self.node.parent
+        while node is not None:
+            yield node
+            node = node.parent
+
+    # -- quota math (delegates to the exact QuotaNode engine) ---------------
+
+    def available(self, fr: FlavorResource) -> int:
+        return self.node.available(fr)
+
+    def potential_available(self, fr: FlavorResource) -> int:
+        return self.node.potential_available(fr)
+
+    def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
+        return self.node.borrowing_with(fr, val)
+
+    def borrowing(self, fr: FlavorResource) -> bool:
+        return self.node.borrowing_with(fr, 0)
+
+    def quota_for(self, fr: FlavorResource) -> QuotaCell:
+        return self.node.quotas.get(fr, QuotaCell())
+
+    def rg_by_resource(self, resource: str):
+        for rg in self.spec.resource_groups:
+            if resource in rg.covered_resources:
+                return rg
+        return None
+
+    def usage_for(self, fr: FlavorResource) -> int:
+        return self.node.usage.get(fr, 0)
+
+    def add_usage(self, usage: FlavorResourceQuantities) -> None:
+        for fr, v in usage.items():
+            self.node.add_usage(fr, v)
+
+    def remove_usage(self, usage: FlavorResourceQuantities) -> None:
+        for fr, v in usage.items():
+            self.node.remove_usage(fr, v)
+
+    def simulate_usage_addition(self, usage: FlavorResourceQuantities) -> Callable[[], None]:
+        self.add_usage(usage)
+        return lambda: self.remove_usage(usage)
+
+    def simulate_usage_removal(self, usage: FlavorResourceQuantities) -> Callable[[], None]:
+        self.remove_usage(usage)
+        return lambda: self.add_usage(usage)
+
+    def fits(self, usage: FlavorResourceQuantities) -> bool:
+        return all(v <= self.available(fr) for fr, v in usage.items())
+
+    def dominant_resource_share(
+        self, wl_req: Optional[FlavorResourceQuantities] = None
+    ) -> DRS:
+        return dominant_resource_share(self.node, wl_req or {})
+
+
+class Snapshot:
+    """reference snapshot.go:161. Built fresh each scheduling cycle."""
+
+    def __init__(self) -> None:
+        self.cluster_queues: Dict[str, ClusterQueueSnapshot] = {}
+        self.cohorts: Dict[str, QuotaNode] = {}
+        self.resource_flavors: Dict[str, ResourceFlavor] = {}
+        self.roots: List[QuotaNode] = []
+        self.inactive_cluster_queues: Set[str] = set()
+
+    def cluster_queue(self, name: str) -> ClusterQueueSnapshot:
+        return self.cluster_queues[name]
+
+    def add_workload(self, info: WorkloadInfo) -> None:
+        cq = self.cluster_queues[info.cluster_queue]
+        cq.workloads[info.key] = info
+        cq.add_usage(info.usage())
+
+    def remove_workload(self, info: WorkloadInfo) -> None:
+        cq = self.cluster_queues[info.cluster_queue]
+        cq.workloads.pop(info.key, None)
+        cq.remove_usage(info.usage())
+
+    def simulate_workload_removal(
+        self, infos: Iterable[WorkloadInfo]
+    ) -> Callable[[], None]:
+        """reference snapshot.go:77 — the preemption oracle's transaction."""
+        infos = list(infos)
+        for info in infos:
+            self.remove_workload(info)
+
+        def revert() -> None:
+            for info in infos:
+                self.add_workload(info)
+
+        return revert
+
+
+def build_quota_tree(
+    cohorts: Iterable[Cohort], cluster_queues: Iterable[ClusterQueue]
+) -> Dict[str, QuotaNode]:
+    """Construct QuotaNodes for the cohort forest + CQ leaves, link parents,
+    and fill quota cells from the specs. Returns name->node (CQs and cohorts
+    share the namespace the same way the reference hierarchy.Manager does)."""
+    nodes: Dict[str, QuotaNode] = {}
+
+    def cohort_node(name: str) -> QuotaNode:
+        if name not in nodes:
+            nodes[name] = QuotaNode(name)
+        return nodes[name]
+
+    for cohort in cohorts:
+        node = cohort_node(cohort.name)
+        for fq in cohort.quotas:
+            for res, q in fq.resources.items():
+                node.quotas[FlavorResource(fq.name, res)] = QuotaCell(
+                    q.nominal, q.borrowing_limit, q.lending_limit
+                )
+        if cohort.fair_sharing is not None:
+            node.fair_weight = cohort.fair_sharing.weight
+        if cohort.parent:
+            parent = cohort_node(cohort.parent)
+            node.parent = parent
+            parent.children.append(node)
+
+    for cq in cluster_queues:
+        node = QuotaNode(cq.name, is_cq=True)
+        nodes[cq.name] = node
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                for res, q in fq.resources.items():
+                    node.quotas[FlavorResource(fq.name, res)] = QuotaCell(
+                        q.nominal, q.borrowing_limit, q.lending_limit
+                    )
+        if cq.fair_sharing is not None:
+            node.fair_weight = cq.fair_sharing.weight
+        if cq.cohort:
+            parent = cohort_node(cq.cohort)
+            node.parent = parent
+            parent.children.append(node)
+
+    return nodes
+
+
+def has_cycle(nodes: Dict[str, QuotaNode]) -> bool:
+    """Cycle detection over parent pointers (reference
+    pkg/cache/hierarchy/cycle.go)."""
+    for start in nodes.values():
+        seen = set()
+        node: Optional[QuotaNode] = start
+        while node is not None:
+            if id(node) in seen:
+                return True
+            seen.add(id(node))
+            node = node.parent
+    return False

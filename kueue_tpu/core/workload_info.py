@@ -1,0 +1,212 @@
+"""WorkloadInfo: scheduling-time wrapper around a Workload.
+
+Behavioral port surface: reference pkg/workload/workload.go:82-1576 (Info,
+TotalRequests, usage) and pkg/workload condition helpers. Holds totalized
+podset requests, the owning ClusterQueue, and the last flavor-assignment
+state used by flavor fungibility (NextFlavorToTry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.constants import (
+    COND_ADMITTED,
+    COND_EVICTED,
+    COND_FINISHED,
+    COND_QUOTA_RESERVED,
+    CheckState,
+)
+from kueue_tpu.api.types import Condition, PodSet, Workload
+from kueue_tpu.core.resources import (
+    FlavorResource,
+    FlavorResourceQuantities,
+    frq_add,
+    resource_requests_total,
+)
+
+
+@dataclass
+class PodSetResources:
+    """Totalized requests of one podset (reference workload.go
+    PodSetResources)."""
+
+    name: str
+    requests: Dict[str, int]  # resource -> total (count * per-pod)
+    count: int
+    flavors: Dict[str, str] = field(default_factory=dict)  # resource -> flavor
+
+    def scaled_to(self, count: int) -> "PodSetResources":
+        if self.count == count or self.count == 0:
+            return self
+        per_pod = {r: v // self.count for r, v in self.requests.items()}
+        return PodSetResources(
+            name=self.name,
+            requests={r: v * count for r, v in per_pod.items()},
+            count=count,
+            flavors=dict(self.flavors),
+        )
+
+
+@dataclass
+class AssignmentClusterQueueState:
+    """LastAssignment (reference workload.go AssignmentClusterQueueState):
+    remembers the flavor index where the last attempt stopped, per podset
+    resource, so fungibility resumes from the next flavor."""
+
+    last_tried_flavor_idx: List[Dict[str, int]] = field(default_factory=list)
+    cluster_queue_generation: int = 0
+
+    def next_flavor_to_try(self, ps_idx: int, resource: str) -> int:
+        if ps_idx >= len(self.last_tried_flavor_idx):
+            return 0
+        return self.last_tried_flavor_idx[ps_idx].get(resource, -1) + 1
+
+
+class WorkloadInfo:
+    """reference workload.Info."""
+
+    def __init__(self, wl: Workload, cluster_queue: str = "") -> None:
+        self.obj = wl
+        self.cluster_queue = cluster_queue
+        self.total_requests: List[PodSetResources] = [
+            PodSetResources(
+                name=ps.name,
+                requests=resource_requests_total(ps.requests, ps.count),
+                count=ps.count,
+            )
+            for ps in wl.pod_sets
+        ]
+        self.last_assignment: Optional[AssignmentClusterQueueState] = None
+        # LocalQueue fair-sharing usage (AdmissionFairSharing); None = off.
+        self.local_queue_fs_usage: Optional[float] = None
+
+    @property
+    def key(self) -> str:
+        return self.obj.key
+
+    def priority(self) -> int:
+        return self.obj.priority
+
+    def usage(self) -> FlavorResourceQuantities:
+        """Quota usage keyed by (flavor, resource), derived from the podset
+        assignments stored in total_requests[...].flavors."""
+        out: FlavorResourceQuantities = {}
+        for ps in self.total_requests:
+            frq_add(
+                out,
+                {
+                    FlavorResource(flv, res): ps.requests.get(res, 0)
+                    for res, flv in ps.flavors.items()
+                },
+            )
+        return out
+
+    def sync_assignment_from_admission(self) -> None:
+        """Populate total_requests flavors/counts from status.admission (used
+        when re-building caches from persisted state)."""
+        adm = self.obj.status.admission
+        if adm is None:
+            return
+        by_name = {psa.name: psa for psa in adm.pod_set_assignments}
+        for ps in self.total_requests:
+            psa = by_name.get(ps.name)
+            if psa is None:
+                continue
+            if psa.count and psa.count != ps.count:
+                scaled = ps.scaled_to(psa.count)
+                ps.requests = scaled.requests
+                ps.count = psa.count
+            ps.flavors = dict(psa.flavors)
+
+    def clone(self) -> "WorkloadInfo":
+        info = WorkloadInfo(self.obj, self.cluster_queue)
+        info.total_requests = [
+            PodSetResources(
+                name=ps.name,
+                requests=dict(ps.requests),
+                count=ps.count,
+                flavors=dict(ps.flavors),
+            )
+            for ps in self.total_requests
+        ]
+        info.last_assignment = self.last_assignment
+        info.local_queue_fs_usage = self.local_queue_fs_usage
+        return info
+
+
+# ---- condition helpers (reference pkg/workload condition functions) ------
+
+
+def get_condition(wl: Workload, cond_type: str) -> Optional[Condition]:
+    for c in wl.status.conditions:
+        if c.type == cond_type:
+            return c
+    return None
+
+
+def set_condition(
+    wl: Workload, cond_type: str, status: bool, reason: str = "",
+    message: str = "", now: float = 0.0,
+) -> None:
+    cond = get_condition(wl, cond_type)
+    if cond is None:
+        wl.status.conditions.append(
+            Condition(cond_type, status, reason, message, now)
+        )
+    else:
+        if cond.status != status:
+            cond.last_transition_time = now
+        cond.status = status
+        cond.reason = reason
+        cond.message = message
+
+
+def has_quota_reservation(wl: Workload) -> bool:
+    cond = get_condition(wl, COND_QUOTA_RESERVED)
+    return cond is not None and cond.status
+
+
+def is_admitted(wl: Workload) -> bool:
+    cond = get_condition(wl, COND_ADMITTED)
+    return cond is not None and cond.status
+
+
+def is_evicted(wl: Workload) -> bool:
+    cond = get_condition(wl, COND_EVICTED)
+    return cond is not None and cond.status
+
+
+def is_finished(wl: Workload) -> bool:
+    cond = get_condition(wl, COND_FINISHED)
+    return cond is not None and cond.status
+
+
+def is_active(wl: Workload) -> bool:
+    return wl.active and not is_finished(wl)
+
+
+def quota_reservation_time(wl: Workload, now: float) -> float:
+    cond = get_condition(wl, COND_QUOTA_RESERVED)
+    if cond is not None and cond.status:
+        return cond.last_transition_time
+    return now
+
+
+def all_checks_ready(wl: Workload) -> bool:
+    return all(
+        acs.state == CheckState.READY for acs in wl.status.admission_checks
+    )
+
+
+def queue_order_timestamp(wl: Workload, eviction_ordering: bool = True) -> float:
+    """GetQueueOrderTimestamp (reference pkg/workload/workload.go): the
+    eviction transition time when present (and eviction ordering is on),
+    else creation time."""
+    if eviction_ordering:
+        cond = get_condition(wl, COND_EVICTED)
+        if cond is not None and cond.status:
+            return cond.last_transition_time
+    return wl.creation_time

@@ -1,0 +1,250 @@
+"""Pending-workload queues.
+
+Behavioral surface: reference pkg/cache/queue/{manager,cluster_queue}.go —
+per-ClusterQueue priority heaps, one-head-per-CQ cycle heads, the
+BestEffortFIFO inadmissible staging area with capacity-event wakeups, and
+LocalQueue -> ClusterQueue routing.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kueue_tpu.api.constants import QueueingStrategy, RequeueReason
+from kueue_tpu.api.types import ClusterQueue, LocalQueue, Workload
+from kueue_tpu.core.workload_info import WorkloadInfo, queue_order_timestamp
+
+
+def _order_key(info: WorkloadInfo) -> Tuple:
+    """baseCompareFunc (reference cluster_queue.go): priority desc, then
+    queue-order timestamp asc (eviction time if evicted, else creation)."""
+    return (-info.priority(), queue_order_timestamp(info.obj), info.obj.uid)
+
+
+class ClusterQueueHeap:
+    """One CQ's pending heap + inadmissible staging
+    (reference cluster_queue.go)."""
+
+    def __init__(self, spec: ClusterQueue) -> None:
+        self.spec = spec
+        self._heap: List[Tuple[Tuple, str]] = []  # (key, wl_key)
+        self._items: Dict[str, WorkloadInfo] = {}
+        self.inadmissible: Dict[str, WorkloadInfo] = {}
+        # Cycle snapshot guard (reference queueInadmissibleCycle): if capacity
+        # changed since the last failed attempt, requeue immediately.
+        self.queue_inadmissible_cycle = -1
+
+    @property
+    def strategy(self) -> QueueingStrategy:
+        return self.spec.queueing_strategy
+
+    def push(self, info: WorkloadInfo) -> None:
+        key = info.key
+        self.inadmissible.pop(key, None)
+        if key not in self._items:
+            self._items[key] = info
+            heapq.heappush(self._heap, (_order_key(info), key))
+        else:
+            self._items[key] = info
+
+    def pop_head(self) -> Optional[WorkloadInfo]:
+        while self._heap:
+            _, key = heapq.heappop(self._heap)
+            info = self._items.pop(key, None)
+            if info is not None:
+                return info
+        return None
+
+    def delete(self, key: str) -> None:
+        self._items.pop(key, None)
+        self.inadmissible.pop(key, None)
+
+    def requeue_if_not_present(
+        self, info: WorkloadInfo, reason: RequeueReason, scheduling_cycle: int
+    ) -> bool:
+        """reference cluster_queue.go:575 requeueIfNotPresent. Returns True
+        when the workload went back to the active heap."""
+        key = info.key
+        if key in self._items:
+            return False
+        immediate = (
+            self.strategy == QueueingStrategy.STRICT_FIFO
+            or reason == RequeueReason.FAILED_AFTER_NOMINATION
+            or self.queue_inadmissible_cycle >= scheduling_cycle
+        )
+        if immediate:
+            self.push(info)
+            return True
+        self.inadmissible[key] = info
+        return False
+
+    def queue_inadmissible(self, scheduling_cycle: int) -> bool:
+        """Move inadmissible workloads back to the heap on a capacity event
+        (reference QueueInadmissibleWorkloads)."""
+        self.queue_inadmissible_cycle = scheduling_cycle
+        if not self.inadmissible:
+            return False
+        for info in self.inadmissible.values():
+            if info.key not in self._items:
+                self._items[info.key] = info
+                heapq.heappush(self._heap, (_order_key(info), info.key))
+        self.inadmissible.clear()
+        return True
+
+    def pending(self) -> int:
+        return len(self._items) + len(self.inadmissible)
+
+    def pending_active(self) -> int:
+        return len(self._items)
+
+    def snapshot_sorted(self) -> List[WorkloadInfo]:
+        """All active pending workloads in head order (for the visibility
+        API; reference cluster_queue.go Snapshot)."""
+        return sorted(self._items.values(), key=_order_key)
+
+
+class QueueManager:
+    """reference pkg/cache/queue/manager.go."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self.cluster_queues: Dict[str, ClusterQueueHeap] = {}
+        self.local_queues: Dict[str, LocalQueue] = {}  # "ns/name" -> LQ
+        self.scheduling_cycle = 0
+        # Second-pass queue for workloads with delayed TAS admission
+        # (reference second_pass_queue.go).
+        self._second_pass: Dict[str, WorkloadInfo] = {}
+
+    # -- configuration ------------------------------------------------------
+
+    def add_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._lock:
+            if spec.name in self.cluster_queues:
+                self.cluster_queues[spec.name].spec = spec
+            else:
+                self.cluster_queues[spec.name] = ClusterQueueHeap(spec)
+            self._lock.notify_all()
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            self.cluster_queues.pop(name, None)
+
+    def add_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+
+    def delete_local_queue(self, lq_key: str) -> None:
+        with self._lock:
+            self.local_queues.pop(lq_key, None)
+
+    def cluster_queue_for(self, wl: Workload) -> Optional[str]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is None:
+            return None
+        return lq.cluster_queue or None
+
+    # -- workload flow ------------------------------------------------------
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        cq_name = self.cluster_queue_for(wl)
+        if cq_name is None:
+            return False
+        with self._lock:
+            cqh = self.cluster_queues.get(cq_name)
+            if cqh is None:
+                return False
+            info = WorkloadInfo(wl, cq_name)
+            cqh.push(info)
+            self._lock.notify_all()
+            return True
+
+    def requeue_workload(
+        self, info: WorkloadInfo, reason: RequeueReason
+    ) -> bool:
+        with self._lock:
+            cqh = self.cluster_queues.get(info.cluster_queue)
+            if cqh is None:
+                return False
+            added = cqh.requeue_if_not_present(
+                info, reason, self.scheduling_cycle
+            )
+            if added:
+                self._lock.notify_all()
+            return added
+
+    def delete_workload(self, wl: Workload) -> None:
+        with self._lock:
+            for cqh in self.cluster_queues.values():
+                cqh.delete(wl.key)
+            self._second_pass.pop(wl.key, None)
+
+    def queue_second_pass(self, info: WorkloadInfo) -> None:
+        with self._lock:
+            self._second_pass[info.key] = info
+            self._lock.notify_all()
+
+    def queue_inadmissible_workloads(
+        self, cq_names: Optional[Iterable[str]] = None
+    ) -> None:
+        """Capacity-changed event: wake inadmissible workloads
+        (reference manager.go QueueInadmissibleWorkloads)."""
+        with self._lock:
+            moved = False
+            names = (
+                list(cq_names) if cq_names is not None
+                else list(self.cluster_queues)
+            )
+            for name in names:
+                cqh = self.cluster_queues.get(name)
+                if cqh is not None and cqh.queue_inadmissible(
+                    self.scheduling_cycle
+                ):
+                    moved = True
+            if moved:
+                self._lock.notify_all()
+
+    def heads(self) -> List[WorkloadInfo]:
+        """Pop one head per CQ plus all ready second-pass workloads
+        (reference manager.go:882,901). Non-blocking variant: returns []
+        when nothing is pending."""
+        with self._lock:
+            self.scheduling_cycle += 1
+            out: List[WorkloadInfo] = []
+            for cqh in self.cluster_queues.values():
+                head = cqh.pop_head()
+                if head is not None:
+                    out.append(head)
+            out.extend(self._second_pass.values())
+            self._second_pass.clear()
+            return out
+
+    def heads_blocking(self, timeout: Optional[float] = None) -> List[WorkloadInfo]:
+        """Blocking Heads() for the daemon loop."""
+        with self._lock:
+            while not self._any_pending_locked():
+                if not self._lock.wait(timeout):
+                    return []
+        return self.heads()
+
+    def _any_pending_locked(self) -> bool:
+        return bool(self._second_pass) or any(
+            cqh.pending_active() for cqh in self.cluster_queues.values()
+        )
+
+    # -- introspection (visibility API) -------------------------------------
+
+    def pending_workloads(self, cq_name: str) -> List[WorkloadInfo]:
+        with self._lock:
+            cqh = self.cluster_queues.get(cq_name)
+            if cqh is None:
+                return []
+            return cqh.snapshot_sorted()
+
+    def pending_count(self, cq_name: str) -> int:
+        with self._lock:
+            cqh = self.cluster_queues.get(cq_name)
+            return cqh.pending() if cqh else 0

@@ -1,0 +1,346 @@
+"""Fair-sharing (DRF) preemption.
+
+Behavioral surface: reference pkg/scheduler/preemption/preemption.go:362-548
+and preemption/fairsharing/{strategy,ordering,target,least_common_ancestor}.go.
+
+The tournament walks the cohort tree from the root, repeatedly descending to
+the child (Cohort or CQ) with the highest DominantResourceShare that still
+has candidates, and applies strategy rules S2-a (LessThanOrEqualToFinalShare)
+and S2-b (LessThanInitialShare) between the almost-least-common-ancestors of
+the preemptor and the target.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from kueue_tpu.api.constants import (
+    IN_CLUSTER_QUEUE_REASON,
+    IN_COHORT_FAIR_SHARING_REASON,
+    IN_COHORT_RECLAMATION_REASON,
+    PreemptionPolicy,
+)
+from kueue_tpu.cache.resource_node import (
+    DRS,
+    compare_drs,
+    dominant_resource_share,
+    negative_drs,
+    QuotaNode,
+)
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.utils import features
+
+# Imported lazily by preemption.py to avoid a cycle; keep the import local.
+
+
+def _strategy_s2a(preemptor_new: DRS, target_old: DRS, target_new: DRS) -> bool:
+    """LessThanOrEqualToFinalShare (strategy.go)."""
+    return compare_drs(preemptor_new, target_new) <= 0
+
+
+def _strategy_s2b(preemptor_new: DRS, target_old: DRS, target_new: DRS) -> bool:
+    """LessThanInitialShare (strategy.go)."""
+    return compare_drs(preemptor_new, target_old) < 0
+
+
+STRATEGIES: Dict[str, Callable[[DRS, DRS, DRS], bool]] = {
+    "LessThanOrEqualToFinalShare": _strategy_s2a,
+    "LessThanInitialShare": _strategy_s2b,
+}
+
+
+def fair_preemptions(ctx, strategies: List[str]):
+    """reference preemption.go:495 fairPreemptions. ``ctx`` is a
+    kueue_tpu.scheduler.preemption.PreemptionCtx."""
+    from kueue_tpu.scheduler.preemption import (
+        Target,
+        candidates_ordering_key,
+        satisfies_preemption_policy,
+        workload_uses_frs,
+    )
+
+    cq = ctx.preemptor_cq
+    candidates = _find_candidates(ctx, satisfies_preemption_policy,
+                                  workload_uses_frs)
+    if not candidates:
+        return []
+    candidates.sort(
+        key=lambda c: candidates_ordering_key(c, cq.name, ctx.now)
+    )
+
+    # DRS values must include the incoming workload.
+    revert_sim = cq.simulate_usage_addition(ctx.requests)
+    try:
+        fits, targets, retry = _run_first_strategy(
+            ctx, candidates, STRATEGIES[strategies[0]], Target,
+            candidates_ordering_key,
+        )
+        if not fits and len(strategies) > 1:
+            fits, targets = _run_second_strategy(ctx, retry, targets, Target,
+                                                 candidates_ordering_key)
+    finally:
+        revert_sim()
+
+    if not fits:
+        for t in targets:
+            ctx.snapshot.add_workload(t.info)
+        return []
+    targets = _fill_back_fair(ctx, targets)
+    for t in targets:
+        ctx.snapshot.add_workload(t.info)
+    return targets
+
+
+def _find_candidates(ctx, satisfies_policy, uses_frs) -> List[WorkloadInfo]:
+    """reference preemption.go:592 findCandidates."""
+    cq = ctx.preemptor_cq
+    out: List[WorkloadInfo] = []
+    p = cq.spec.preemption
+    if p.within_cluster_queue != PreemptionPolicy.NEVER:
+        for wl in cq.workloads.values():
+            if satisfies_policy(ctx.preemptor, wl, p.within_cluster_queue) and \
+                    uses_frs(wl, ctx.frs_need_preemption):
+                out.append(wl)
+    if cq.has_parent() and p.reclaim_within_cohort != PreemptionPolicy.NEVER:
+        root = cq.node.root()
+        for other in ctx.snapshot.cluster_queues.values():
+            if other.name == cq.name or other.node.root() is not root:
+                continue
+            if not _cq_is_borrowing(other, ctx.frs_need_preemption):
+                continue
+            for wl in other.workloads.values():
+                if satisfies_policy(ctx.preemptor, wl, p.reclaim_within_cohort) \
+                        and uses_frs(wl, ctx.frs_need_preemption):
+                    out.append(wl)
+    return out
+
+
+def _cq_is_borrowing(
+    cq: ClusterQueueSnapshot, frs: Set[FlavorResource]
+) -> bool:
+    return cq.has_parent() and any(cq.borrowing(fr) for fr in frs)
+
+
+class _Ordering:
+    """TargetClusterQueueOrdering (ordering.go)."""
+
+    def __init__(self, ctx, candidates: List[WorkloadInfo], ordering_key):
+        self.ctx = ctx
+        self.preemptor_cq: ClusterQueueSnapshot = ctx.preemptor_cq
+        self.ordering_key = ordering_key
+        self.preemptor_ancestors = set(
+            id(n) for n in self.preemptor_cq.path_parent_to_root()
+        )
+        self.cq_to_targets: Dict[str, List[WorkloadInfo]] = {}
+        for c in candidates:
+            self.cq_to_targets.setdefault(c.cluster_queue, []).append(c)
+        self.pruned_cqs: Set[str] = set()
+        self.pruned_cohorts: Set[int] = set()
+
+    def iterate(self):
+        if not self.preemptor_cq.has_parent():
+            while (
+                self.preemptor_cq.name not in self.pruned_cqs
+                and self.has_workload(self.preemptor_cq.name)
+            ):
+                yield self.preemptor_cq
+            return
+        root = self.preemptor_cq.node.root()
+        while id(root) not in self.pruned_cohorts:
+            target = self._next_target(root)
+            if target is not None:
+                yield target
+
+    def has_workload(self, cq_name: str) -> bool:
+        return bool(self.cq_to_targets.get(cq_name))
+
+    def pop_workload(self, cq_name: str) -> WorkloadInfo:
+        return self.cq_to_targets[cq_name].pop(0)
+
+    def drop_queue(self, cq_name: str) -> None:
+        self.pruned_cqs.add(cq_name)
+
+    def _next_target(self, cohort: QuotaNode) -> Optional[ClusterQueueSnapshot]:
+        """ordering.go nextTarget: descend to highest-DRS child."""
+        cqs = self.ctx.snapshot.cluster_queues
+        highest_cq: Optional[ClusterQueueSnapshot] = None
+        highest_cq_drs = negative_drs()
+        for child in cohort.children:
+            if not child.is_cq:
+                continue
+            cq = cqs[child.name]
+            if cq.name in self.pruned_cqs:
+                continue
+            drs = dominant_resource_share(child, {})
+            if (not drs.borrowing and cq is not self.preemptor_cq) or \
+                    not self.has_workload(cq.name):
+                self.pruned_cqs.add(cq.name)
+            elif compare_drs(drs, highest_cq_drs) == 0:
+                new_wl = self.cq_to_targets[cq.name][0]
+                cur_wl = self.cq_to_targets[highest_cq.name][0]
+                if self.ordering_key(new_wl, self.preemptor_cq.name,
+                                     self.ctx.now) < \
+                        self.ordering_key(cur_wl, self.preemptor_cq.name,
+                                          self.ctx.now):
+                    highest_cq = cq
+            elif compare_drs(drs, highest_cq_drs) > 0:
+                highest_cq_drs = drs
+                highest_cq = cq
+
+        highest_cohort: Optional[QuotaNode] = None
+        highest_cohort_drs = negative_drs()
+        for child in cohort.children:
+            if child.is_cq or id(child) in self.pruned_cohorts:
+                continue
+            drs = dominant_resource_share(child, {})
+            on_path = id(child) in self.preemptor_ancestors
+            if not drs.borrowing and not on_path:
+                self.pruned_cohorts.add(id(child))
+            elif compare_drs(drs, highest_cohort_drs) >= 0:
+                highest_cohort_drs = drs
+                highest_cohort = child
+
+        if highest_cohort is None and highest_cq is None:
+            self.pruned_cohorts.add(id(cohort))
+            return None
+        if compare_drs(highest_cohort_drs, highest_cq_drs) >= 0 and \
+                highest_cohort is not None:
+            return self._next_target(highest_cohort)
+        return highest_cq
+
+
+def _almost_lcas(ctx, target_cq: ClusterQueueSnapshot,
+                 preemptor_ancestors: Set[int]) -> Tuple[QuotaNode, QuotaNode]:
+    """least_common_ancestor.go: the two nodes just below the LCA."""
+    lca = None
+    for anc in target_cq.path_parent_to_root():
+        if id(anc) in preemptor_ancestors:
+            lca = anc
+            break
+    assert lca is not None, "no common ancestor"
+
+    def almost(cq: ClusterQueueSnapshot) -> QuotaNode:
+        a: QuotaNode = cq.node
+        for anc in cq.path_parent_to_root():
+            if anc is lca:
+                return a
+            a = anc
+        raise AssertionError("no almostLCA")
+
+    return almost(ctx.preemptor_cq), almost(target_cq)
+
+
+def _workload_fits_fair(ctx) -> bool:
+    """workloadFitsForFairSharing (preemption.go:649): the incoming usage was
+    simulated in, so remove it for the fit check."""
+    cq = ctx.preemptor_cq
+    revert = cq.simulate_usage_removal(ctx.requests)
+    try:
+        for fr, v in ctx.requests.items():
+            if v > cq.available(fr):
+                return False
+        if ctx.tas_fits is not None:
+            return ctx.tas_fits()
+        return True
+    finally:
+        revert()
+
+
+def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
+    """reference preemption.go:381 runFirstFsStrategy."""
+    ordering = _Ordering(ctx, candidates, ordering_key)
+    targets: List = []
+    retry: List[WorkloadInfo] = []
+
+    preemptor_within_nominal = (
+        features.enabled("FairSharingPreemptWithinNominal")
+        and _queue_within_nominal(ctx)
+    )
+    for cand_cq in ordering.iterate():
+        if cand_cq is ctx.preemptor_cq:
+            wl = ordering.pop_workload(cand_cq.name)
+            ctx.snapshot.remove_workload(wl)
+            targets.append(Target(wl, IN_CLUSTER_QUEUE_REASON))
+            if _workload_fits_fair(ctx):
+                return True, targets, retry
+            continue
+
+        if preemptor_within_nominal:
+            wl = ordering.pop_workload(cand_cq.name)
+            ctx.snapshot.remove_workload(wl)
+            targets.append(Target(wl, IN_COHORT_RECLAMATION_REASON))
+            if _workload_fits_fair(ctx):
+                return True, targets, retry
+            continue
+
+        pre_alca, tgt_alca = _almost_lcas(
+            ctx, cand_cq, ordering.preemptor_ancestors
+        )
+        preemptor_new = dominant_resource_share(pre_alca, {})
+        target_old = dominant_resource_share(tgt_alca, {})
+        while ordering.has_workload(cand_cq.name):
+            wl = ordering.pop_workload(cand_cq.name)
+            revert = cand_cq.simulate_usage_removal(wl.usage())
+            target_new = dominant_resource_share(tgt_alca, {})
+            revert()
+            if strategy(preemptor_new, target_old, target_new):
+                ctx.snapshot.remove_workload(wl)
+                targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
+                if _workload_fits_fair(ctx):
+                    return True, targets, retry
+                break  # re-pick CQ: shares changed
+            retry.append(wl)
+    return False, targets, retry
+
+
+def _run_second_strategy(ctx, retry_candidates, targets, Target, ordering_key):
+    """reference preemption.go:460 runSecondFsStrategy (rule S2-b)."""
+    ordering = _Ordering(ctx, retry_candidates, ordering_key)
+    for cand_cq in ordering.iterate():
+        pre_alca, tgt_alca = _almost_lcas(
+            ctx, cand_cq, ordering.preemptor_ancestors
+        )
+        preemptor_new = dominant_resource_share(pre_alca, {})
+        target_old = dominant_resource_share(tgt_alca, {})
+        wl = ordering.pop_workload(cand_cq.name)
+        if _strategy_s2b(preemptor_new, target_old, DRS()):
+            ctx.snapshot.remove_workload(wl)
+            targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
+            if _workload_fits_fair(ctx):
+                return True, targets
+        ordering.drop_queue(cand_cq.name)
+    return False, targets
+
+
+def _fill_back_fair(ctx, targets):
+    """fillBackWorkloads with allowBorrowing=True. Runs after the incoming
+    usage simulation was reverted, so it uses the plain fit check
+    (reference preemption.go:539 calls fillBackWorkloads -> workloadFits)."""
+
+    def plain_fits() -> bool:
+        for fr, v in ctx.requests.items():
+            if v > ctx.preemptor_cq.available(fr):
+                return False
+        if ctx.tas_fits is not None:
+            return ctx.tas_fits()
+        return True
+
+    i = len(targets) - 2
+    while i >= 0:
+        ctx.snapshot.add_workload(targets[i].info)
+        if plain_fits():
+            targets[i] = targets[-1]
+            targets.pop()
+        else:
+            ctx.snapshot.remove_workload(targets[i].info)
+        i -= 1
+    return targets
+
+
+def _queue_within_nominal(ctx) -> bool:
+    """preemption.go:673: usage at or below nominal for contested frs."""
+    return not any(
+        ctx.preemptor_cq.borrowing(fr) for fr in ctx.frs_need_preemption
+    )

@@ -1,0 +1,484 @@
+"""Host-exact flavor assigner.
+
+Behavioral surface: reference pkg/scheduler/flavorassigner/flavorassigner.go
+— per (podset-group × resource-group) search over the ClusterQueue's flavor
+list, yielding per-resource FlavorAssignments with a Fit/Preempt/NoFit mode,
+borrow level (cohort-subtree height), flavor-fungibility stop rules, and the
+preemption-oracle probe for Preempt mode.
+
+This is the general/fallback path and the differential-test oracle for the
+batched device assigner in kueue_tpu/models/assign (which handles the dense
+common case: single-podset workloads, one resource group).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.constants import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
+    PreemptionPolicy,
+    REASON_EXCEEDS_MAX_QUOTA,
+    REASON_NO_MATCHING_FLAVOR,
+    REASON_WAITING_FOR_QUOTA,
+)
+from kueue_tpu.api.types import FlavorFungibility, PodSet, ResourceFlavor
+from kueue_tpu.cache.resource_node import find_height_of_lowest_subtree_that_fits
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot
+from kueue_tpu.core.resources import FlavorResource, FlavorResourceQuantities, sat_add
+from kueue_tpu.core.workload_info import (
+    AssignmentClusterQueueState,
+    PodSetResources,
+    WorkloadInfo,
+)
+
+
+class Mode(enum.IntEnum):
+    """FlavorAssignmentMode, ordered worst to best
+    (flavorassigner.go:408-423)."""
+
+    NO_FIT = 0
+    PREEMPT = 1
+    FIT = 2
+
+
+class PMode(enum.IntEnum):
+    """granular preemptionMode (flavorassigner.go:472-482)."""
+
+    NO_FIT = 0
+    NO_CANDIDATES = 1  # preemption possible but no targets found
+    PREEMPT = 2
+    RECLAIM = 3
+    FIT = 4
+
+    def to_mode(self) -> Mode:
+        if self == PMode.NO_FIT:
+            return Mode.NO_FIT
+        if self == PMode.FIT:
+            return Mode.FIT
+        return Mode.PREEMPT
+
+
+@dataclass
+class GranularMode:
+    """(preemptionMode, borrowingLevel) (flavorassigner.go:459)."""
+
+    pmode: PMode = PMode.NO_FIT
+    borrowing: int = 1 << 30
+
+    def is_preempt_mode(self) -> bool:
+        return self.pmode in (PMode.PREEMPT, PMode.RECLAIM)
+
+
+def worst_mode() -> GranularMode:
+    return GranularMode(PMode.NO_FIT, 1 << 30)
+
+
+def best_mode() -> GranularMode:
+    return GranularMode(PMode.FIT, 0)
+
+
+def is_preferred(a: GranularMode, b: GranularMode, fungibility: FlavorFungibility) -> bool:
+    """True if a is better than b under the fungibility preference
+    (flavorassigner.go:485-516)."""
+    if a.pmode == PMode.NO_FIT:
+        return False
+    if b.pmode == PMode.NO_FIT:
+        return True
+
+    def borrowing_over_preemption() -> bool:
+        if a.pmode != b.pmode:
+            return a.pmode > b.pmode
+        return a.borrowing < b.borrowing
+
+    def preemption_over_borrowing() -> bool:
+        if a.borrowing != b.borrowing:
+            return a.borrowing < b.borrowing
+        return a.pmode > b.pmode
+
+    if fungibility.preference == FlavorFungibilityPreference.PREEMPTION_OVER_BORROWING:
+        return preemption_over_borrowing()
+    return borrowing_over_preemption()
+
+
+def should_try_next_flavor(
+    mode: GranularMode, fungibility: FlavorFungibility
+) -> bool:
+    """flavorassigner.go:1142-1159."""
+    if mode.pmode in (PMode.NO_FIT, PMode.NO_CANDIDATES):
+        return True
+    if mode.is_preempt_mode() and (
+        fungibility.when_can_preempt == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    ):
+        return True
+    if mode.borrowing > 0 and (
+        fungibility.when_can_borrow == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+    ):
+        return True
+    return False
+
+
+@dataclass
+class FlavorAssignment:
+    name: str
+    mode: Mode
+    tried_flavor_idx: int = -1
+    borrow: int = 0
+
+
+@dataclass
+class PodSetAssignmentResult:
+    name: str
+    flavors: Dict[str, FlavorAssignment] = field(default_factory=dict)
+    requests: Dict[str, int] = field(default_factory=dict)
+    count: int = 0
+    status_reasons: List[str] = field(default_factory=list)
+    no_fit_reason: str = ""
+
+    def representative_mode(self) -> Mode:
+        if not self.flavors:
+            return Mode.NO_FIT if self.requests else Mode.FIT
+        return Mode(min(fa.mode for fa in self.flavors.values()))
+
+
+@dataclass
+class Assignment:
+    """reference flavorassigner.go Assignment struct."""
+
+    pod_sets: List[PodSetAssignmentResult] = field(default_factory=list)
+    borrowing: int = 0
+    usage: FlavorResourceQuantities = field(default_factory=dict)
+    last_state: AssignmentClusterQueueState = field(
+        default_factory=AssignmentClusterQueueState
+    )
+    no_fit_reason: str = ""
+
+    def representative_mode(self) -> Mode:
+        if not self.pod_sets:
+            return Mode.NO_FIT
+        return Mode(min(ps.representative_mode() for ps in self.pod_sets))
+
+    def borrows(self) -> int:
+        return self.borrowing
+
+    def total_requests_for(self, wl: WorkloadInfo) -> FlavorResourceQuantities:
+        return dict(self.usage)
+
+
+# Oracle callback: (cq, wl, fr, quantity) ->
+#   (possibility: Optional[str in {"Preempt","Reclaim","NoCandidates"}], borrow)
+PreemptionOracleFn = Callable[
+    [ClusterQueueSnapshot, WorkloadInfo, FlavorResource, int],
+    Tuple[str, int],
+]
+
+
+class FlavorAssigner:
+    """reference flavorassigner.go:584."""
+
+    def __init__(
+        self,
+        wl: WorkloadInfo,
+        cq: ClusterQueueSnapshot,
+        resource_flavors: Dict[str, ResourceFlavor],
+        oracle: Optional[PreemptionOracleFn] = None,
+        enable_fair_sharing: bool = False,
+    ) -> None:
+        self.wl = wl
+        self.cq = cq
+        self.resource_flavors = resource_flavors
+        self.oracle = oracle
+        self.enable_fair_sharing = enable_fair_sharing
+
+    # -- public entry -------------------------------------------------------
+
+    def assign(self, counts: Optional[Sequence[int]] = None) -> Assignment:
+        if (
+            self.wl.last_assignment is not None
+            and self.cq.allocatable_generation
+            > self.wl.last_assignment.cluster_queue_generation
+        ):
+            self.wl.last_assignment = None
+        return self._assign_flavors(counts)
+
+    # -- core ---------------------------------------------------------------
+
+    def _assign_flavors(self, counts: Optional[Sequence[int]]) -> Assignment:
+        if counts is None:
+            requests = [ps for ps in self.wl.total_requests]
+        else:
+            requests = [
+                ps.scaled_to(counts[i])
+                for i, ps in enumerate(self.wl.total_requests)
+            ]
+
+        assignment = Assignment(
+            last_state=AssignmentClusterQueueState(
+                cluster_queue_generation=self.cq.allocatable_generation
+            )
+        )
+
+        # Group podsets (TAS podset-groups collapse to one joint request;
+        # default: one group per podset). reference flavorassigner.go:712-718.
+        groups: Dict[str, List[Tuple[int, PodSetResources]]] = {}
+        order: List[str] = []
+        for i, ps in enumerate(requests):
+            key = str(i)
+            tr = self.wl.obj.pod_sets[i].topology_request
+            if tr is not None and tr.podset_group_name:
+                key = tr.podset_group_name
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append((i, ps))
+
+        for key in order:
+            group = groups[key]
+            group_requests: Dict[str, int] = {}
+            ps_ids = [i for i, _ in group]
+            for _, ps in group:
+                for res, v in ps.requests.items():
+                    group_requests[res] = group_requests.get(res, 0) + v
+
+            group_flavors: Dict[str, FlavorAssignment] = {}
+            group_reasons: List[str] = []
+            group_no_fit_reason = ""
+            failed = False
+            for res in sorted(group_requests):
+                if self.cq.rg_by_resource(res) is None:
+                    if group_requests[res] == 0:
+                        continue
+                if res in group_flavors:
+                    continue  # already assigned with its resource group
+                flavors, reasons, nf_reason = self._find_flavor_for_podsets(
+                    ps_ids, group_requests, res, assignment.usage
+                )
+                group_reasons.extend(reasons)
+                group_no_fit_reason = nf_reason or group_no_fit_reason
+                if not flavors and group_requests:
+                    failed = True
+                    break
+                group_flavors.update(flavors)
+
+            for i, ps in group:
+                psa = PodSetAssignmentResult(
+                    name=ps.name,
+                    flavors={
+                        r: group_flavors[r]
+                        for r in ps.requests
+                        if r in group_flavors
+                    },
+                    requests=dict(ps.requests),
+                    count=ps.count,
+                    status_reasons=list(group_reasons),
+                    no_fit_reason=group_no_fit_reason,
+                )
+                self._append(assignment, ps, psa)
+            if failed:
+                return assignment
+
+        return assignment
+
+    def _append(
+        self,
+        assignment: Assignment,
+        ps: PodSetResources,
+        psa: PodSetAssignmentResult,
+    ) -> None:
+        """reference flavorassigner.go:901-922."""
+        flavor_idx: Dict[str, int] = {}
+        assignment.pod_sets.append(psa)
+        for res, fa in psa.flavors.items():
+            if fa.borrow > assignment.borrowing:
+                assignment.borrowing = fa.borrow
+            fr = FlavorResource(fa.name, res)
+            assignment.usage[fr] = sat_add(
+                assignment.usage.get(fr, 0), psa.requests.get(res, 0)
+            )
+            flavor_idx[res] = fa.tried_flavor_idx
+        assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+
+    # -- per-resource-group flavor search -----------------------------------
+
+    def _find_flavor_for_podsets(
+        self,
+        ps_ids: List[int],
+        requests: Dict[str, int],
+        res_name: str,
+        assignment_usage: FlavorResourceQuantities,
+    ) -> Tuple[Dict[str, FlavorAssignment], List[str], str]:
+        """reference flavorassigner.go:946-1089. Returns
+        (assignments, reasons, no_fit_reason)."""
+        rg = self.cq.rg_by_resource(res_name)
+        if rg is None:
+            return {}, [f"resource {res_name} unavailable in ClusterQueue"], (
+                REASON_NO_MATCHING_FLAVOR
+            )
+        reasons: List[str] = []
+        no_fit_reason = ""
+        covered = {
+            r: v for r, v in requests.items() if r in rg.covered_resources
+        }
+
+        pod_sets = [self.wl.obj.pod_sets[i] for i in ps_ids]
+        best: Dict[str, FlavorAssignment] = {}
+        best_mode = worst_mode()
+        fungibility = self.cq.spec.flavor_fungibility
+
+        flavor_names = [fq.name for fq in rg.flavors]
+        attempted_idx = -1
+        start = 0
+        if self.wl.last_assignment is not None:
+            start = self.wl.last_assignment.next_flavor_to_try(
+                ps_ids[0], res_name
+            )
+        for idx in range(start, len(flavor_names)):
+            attempted_idx = idx
+            f_name = flavor_names[idx]
+            flavor_ok, why = self._check_flavor_for_podsets(f_name, pod_sets)
+            if not flavor_ok:
+                reasons.append(why)
+                no_fit_reason = no_fit_reason or REASON_NO_MATCHING_FLAVOR
+                continue
+
+            assignments: Dict[str, FlavorAssignment] = {}
+            representative = best_mode_const()
+            for r_name in sorted(covered):
+                val = covered[r_name]
+                fr = FlavorResource(f_name, r_name)
+                pmode, borrow, r_reasons, r_nf = self._fits_resource_quota(
+                    fr, assignment_usage.get(fr, 0), val
+                )
+                reasons.extend(r_reasons)
+                if r_nf:
+                    no_fit_reason = _most_severe(no_fit_reason, r_nf)
+                mode = GranularMode(pmode, borrow)
+                if is_preferred(representative, mode, fungibility):
+                    representative = mode
+                if representative.pmode == PMode.NO_FIT:
+                    break
+                assignments[r_name] = FlavorAssignment(
+                    name=f_name, mode=pmode.to_mode(), borrow=borrow
+                )
+
+            if not should_try_next_flavor(representative, fungibility):
+                best = assignments
+                best_mode = representative
+                break
+            if is_preferred(representative, best_mode, fungibility):
+                best = assignments
+                best_mode = representative
+
+        for fa in best.values():
+            fa.tried_flavor_idx = (
+                -1 if attempted_idx == len(flavor_names) - 1 else attempted_idx
+            )
+        if best_mode.pmode == PMode.FIT:
+            return best, [], ""
+        return best, reasons, no_fit_reason
+
+    def _check_flavor_for_podsets(
+        self, flavor_name: str, pod_sets: List[PodSet]
+    ) -> Tuple[bool, str]:
+        """Taints/tolerations + node-affinity gate
+        (flavorassigner.go:1091-1140)."""
+        flavor = self.resource_flavors.get(flavor_name)
+        if flavor is None:
+            return False, f"flavor {flavor_name} not found"
+        label_keys = set(flavor.node_labels)
+        for ps in pod_sets:
+            for taint in flavor.node_taints:
+                if taint.effect not in ("NoSchedule", "NoExecute"):
+                    continue
+                tolerations = list(ps.tolerations) + list(flavor.tolerations)
+                if not any(t.tolerates(taint) for t in tolerations):
+                    return False, (
+                        f"untolerated taint {taint.key} in flavor {flavor_name}"
+                    )
+            # nodeSelector terms restricted to this flavor's own label keys.
+            for k, v in ps.node_selector.items():
+                if k in label_keys and flavor.node_labels.get(k) != v:
+                    return False, (
+                        f"flavor {flavor_name} doesn't match node affinity"
+                    )
+            # Affinity expressions referencing keys other flavors define are
+            # ignored for this flavor; a term emptied this way matches all.
+            for expr in ps.required_affinity:
+                if expr.key in label_keys and not expr.matches(
+                    flavor.node_labels
+                ):
+                    return False, (
+                        f"flavor {flavor_name} doesn't match node affinity"
+                    )
+        return True, ""
+
+    def _fits_resource_quota(
+        self, fr: FlavorResource, assumed_usage: int, request: int
+    ) -> Tuple[PMode, int, List[str], str]:
+        """flavorassigner.go:1213-1263."""
+        reasons: List[str] = []
+        available = self.cq.available(fr)
+        max_capacity = self.cq.potential_available(fr)
+        val = sat_add(assumed_usage, request)
+
+        if val > max_capacity:
+            reasons.append(
+                f"insufficient quota for {fr.resource} in flavor {fr.flavor},"
+                f" request {val} > maximum capacity {max_capacity}"
+            )
+            return PMode.NO_FIT, 0, reasons, REASON_EXCEEDS_MAX_QUOTA
+
+        borrow, may_reclaim = find_height_of_lowest_subtree_that_fits(
+            self.cq.node, fr, val
+        )
+        if val <= available:
+            return PMode.FIT, borrow, [], ""
+
+        reasons.append(
+            f"insufficient unused quota for {fr.resource} in flavor"
+            f" {fr.flavor}, {val - available} more needed"
+        )
+        nominal = self.cq.quota_for(fr).nominal
+        if nominal >= val or may_reclaim or self._can_preempt_while_borrowing():
+            if self.oracle is None:
+                return PMode.NO_CANDIDATES, borrow, reasons, ""
+            possibility, borrow_after = self.oracle(
+                self.cq, self.wl, fr, val
+            )
+            pmode = {
+                "Preempt": PMode.PREEMPT,
+                "Reclaim": PMode.RECLAIM,
+                "NoCandidates": PMode.NO_CANDIDATES,
+            }[possibility]
+            return pmode, borrow_after, reasons, ""
+        return PMode.NO_FIT, borrow, reasons, REASON_WAITING_FOR_QUOTA
+
+    def _can_preempt_while_borrowing(self) -> bool:
+        """flavorassigner.go:1265."""
+        p = self.cq.spec.preemption
+        return (
+            p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER
+        ) or (
+            self.enable_fair_sharing
+            and p.reclaim_within_cohort != PreemptionPolicy.NEVER
+        )
+
+
+def best_mode_const() -> GranularMode:
+    return GranularMode(PMode.FIT, 0)
+
+
+_SEVERITY = {
+    "": 0,
+    REASON_WAITING_FOR_QUOTA: 1,
+    REASON_NO_MATCHING_FLAVOR: 2,
+    REASON_EXCEEDS_MAX_QUOTA: 3,
+}
+
+
+def _most_severe(a: str, b: str) -> str:
+    return a if _SEVERITY.get(a, 0) >= _SEVERITY.get(b, 0) else b

@@ -1,0 +1,459 @@
+"""Preemption: classical (hierarchical reclaim + priority) path.
+
+Behavioral surface: reference pkg/scheduler/preemption/preemption.go,
+preemption/classical/{candidate_generator,hierarchical_preemption}.go and
+preemption/common/{ordering,preemption_policy}.go.
+
+The classical heuristic removes candidates in order while the incoming
+workload doesn't fit, then fills back in reverse order (minimization), over
+up to two runs (allowBorrowing true/false). All snapshot mutation happens
+through Snapshot.add/remove_workload so it is transactional.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kueue_tpu.api.constants import (
+    BorrowWithinCohortPolicy,
+    IN_CLUSTER_QUEUE_REASON,
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    IN_COHORT_RECLAMATION_REASON,
+    PreemptionPolicy,
+)
+from kueue_tpu.cache.resource_node import QuotaNode
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot, Snapshot
+from kueue_tpu.core.resources import FlavorResource, FlavorResourceQuantities
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    is_evicted,
+    quota_reservation_time,
+    queue_order_timestamp,
+)
+from kueue_tpu.scheduler.flavorassigner import Assignment, Mode
+
+
+class Variant(enum.IntEnum):
+    """preemptionVariant (reference classical/hierarchical_preemption.go:31)."""
+
+    NEVER = 0
+    WITHIN_CQ = 1
+    HIERARCHICAL_RECLAIM = 2
+    RECLAIM_WITHOUT_BORROWING = 3
+    RECLAIM_WHILE_BORROWING = 4
+
+    def reason(self) -> str:
+        if self == Variant.WITHIN_CQ:
+            return IN_CLUSTER_QUEUE_REASON
+        if self == Variant.RECLAIM_WHILE_BORROWING:
+            return IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+        return IN_COHORT_RECLAMATION_REASON
+
+
+@dataclass
+class Target:
+    """A workload to evict to make room (reference preemption.go:115)."""
+
+    info: WorkloadInfo
+    reason: str
+
+
+class PreemptedWorkloads:
+    """Overlap set across one cycle (reference
+    preempted_workloads.go:1-38)."""
+
+    def __init__(self) -> None:
+        self._keys: Set[str] = set()
+
+    def has_any(self, targets: Sequence[Target]) -> bool:
+        return any(t.info.key in self._keys for t in targets)
+
+    def insert(self, targets: Sequence[Target]) -> None:
+        self._keys.update(t.info.key for t in targets)
+
+
+def satisfies_preemption_policy(
+    preemptor: WorkloadInfo, candidate: WorkloadInfo, policy: PreemptionPolicy
+) -> bool:
+    """reference preemption/common/preemption_policy.go."""
+    lower = preemptor.priority() > candidate.priority()
+    if policy == PreemptionPolicy.LOWER_PRIORITY:
+        return lower
+    if policy == PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY:
+        newer_equal = (
+            preemptor.priority() == candidate.priority()
+            and queue_order_timestamp(preemptor.obj)
+            < queue_order_timestamp(candidate.obj)
+        )
+        return lower or newer_equal
+    return policy == PreemptionPolicy.ANY
+
+
+def candidates_ordering_key(
+    c: WorkloadInfo, cq_name: str, now: float
+) -> Tuple:
+    """Sort key replicating CandidatesOrdering (reference
+    preemption/common/ordering.go:42): evicted first, other-CQ first, lower
+    priority first, later quota-reservation first, UID tiebreak."""
+    return (
+        not is_evicted(c.obj),
+        c.cluster_queue == cq_name,
+        c.priority(),
+        -quota_reservation_time(c.obj, now),
+        c.obj.uid,
+    )
+
+
+def workload_uses_frs(
+    wl: WorkloadInfo, frs: Set[FlavorResource]
+) -> bool:
+    for ps in wl.total_requests:
+        for res, flv in ps.flavors.items():
+            if FlavorResource(flv, res) in frs:
+                return True
+    return False
+
+
+@dataclass
+class _CandidateElem:
+    wl: WorkloadInfo
+    lca: Optional[QuotaNode]
+    variant: Variant
+
+
+@dataclass
+class PreemptionCtx:
+    preemptor: WorkloadInfo
+    preemptor_cq: ClusterQueueSnapshot
+    snapshot: Snapshot
+    frs_need_preemption: Set[FlavorResource]
+    requests: FlavorResourceQuantities  # full workload usage
+    now: float = 0.0
+    # TAS feasibility probe evaluated inside workload_fits (None = no TAS).
+    tas_fits: Optional[Callable[[], bool]] = None
+
+
+class Preemptor:
+    """reference preemption.go Preemptor (classical path; the fair-sharing
+    path lives in kueue_tpu/scheduler/fair_preemption.py)."""
+
+    def __init__(
+        self,
+        enable_fair_sharing: bool = False,
+        fair_strategies: Optional[List[str]] = None,
+    ) -> None:
+        self.enable_fair_sharing = enable_fair_sharing
+        self.fair_strategies = fair_strategies or [
+            "LessThanOrEqualToFinalShare",
+            "LessThanInitialShare",
+        ]
+
+    # -- public -------------------------------------------------------------
+
+    def get_targets(
+        self,
+        wl: WorkloadInfo,
+        assignment: Assignment,
+        snapshot: Snapshot,
+        now: float = 0.0,
+        tas_fits: Optional[Callable[[], bool]] = None,
+    ) -> List[Target]:
+        cq = snapshot.cluster_queue(wl.cluster_queue)
+        ctx = PreemptionCtx(
+            preemptor=wl,
+            preemptor_cq=cq,
+            snapshot=snapshot,
+            frs_need_preemption=flavor_resources_need_preemption(assignment),
+            requests=assignment.total_requests_for(wl),
+            now=now,
+            tas_fits=tas_fits,
+        )
+        if self.enable_fair_sharing:
+            from kueue_tpu.scheduler.fair_preemption import fair_preemptions
+
+            return fair_preemptions(ctx, self.fair_strategies)
+        return self.classical_preemptions(ctx)
+
+    # -- candidate generation ----------------------------------------------
+
+    def _classify(
+        self,
+        ctx: PreemptionCtx,
+        wl: WorkloadInfo,
+        hierarchical_advantage: bool,
+    ) -> Variant:
+        """reference classical/hierarchical_preemption.go:83."""
+        if not workload_uses_frs(wl, ctx.frs_need_preemption):
+            return Variant.NEVER
+        p = ctx.preemptor_cq.spec.preemption
+        if wl.cluster_queue == ctx.preemptor_cq.name:
+            policy = p.within_cluster_queue
+        else:
+            policy = p.reclaim_within_cohort
+        if not satisfies_preemption_policy(ctx.preemptor, wl, policy):
+            return Variant.NEVER
+        if wl.cluster_queue == ctx.preemptor_cq.name:
+            return Variant.WITHIN_CQ
+        if hierarchical_advantage:
+            return Variant.HIERARCHICAL_RECLAIM
+        bwc = p.borrow_within_cohort
+        if bwc.policy == BorrowWithinCohortPolicy.NEVER:
+            return Variant.RECLAIM_WITHOUT_BORROWING
+        if wl.priority() >= ctx.preemptor.priority() or (
+            bwc.max_priority_threshold is not None
+            and wl.priority() > bwc.max_priority_threshold
+        ):
+            return Variant.RECLAIM_WITHOUT_BORROWING
+        return Variant.RECLAIM_WHILE_BORROWING
+
+    def _candidates_from_cq(
+        self,
+        ctx: PreemptionCtx,
+        cq: ClusterQueueSnapshot,
+        lca: Optional[QuotaNode],
+        hierarchical_advantage: bool,
+    ) -> List[_CandidateElem]:
+        out = []
+        for wl in cq.workloads.values():
+            variant = self._classify(ctx, wl, hierarchical_advantage)
+            if variant != Variant.NEVER:
+                out.append(_CandidateElem(wl, lca, variant))
+        return out
+
+    def _collect_candidates(
+        self, ctx: PreemptionCtx
+    ) -> Tuple[List[_CandidateElem], List[_CandidateElem], List[_CandidateElem]]:
+        """Returns (hierarchy, priority, same_queue) candidate classes
+        (reference hierarchical_preemption.go:129-206)."""
+        same_queue: List[_CandidateElem] = []
+        if ctx.preemptor_cq.spec.preemption.within_cluster_queue != PreemptionPolicy.NEVER:
+            same_queue = self._candidates_from_cq(
+                ctx, ctx.preemptor_cq, None, False
+            )
+
+        hierarchy: List[_CandidateElem] = []
+        priority_c: List[_CandidateElem] = []
+        if (
+            not ctx.preemptor_cq.has_parent()
+            or ctx.preemptor_cq.spec.preemption.reclaim_within_cohort
+            == PreemptionPolicy.NEVER
+        ):
+            return hierarchy, priority_c, same_queue
+
+        cq_by_node: Dict[str, ClusterQueueSnapshot] = {
+            c.node.name: c for c in ctx.snapshot.cluster_queues.values()
+        }
+
+        def collect_in_subtree(
+            cohort: QuotaNode,
+            subtree_root: QuotaNode,
+            skip: Optional[QuotaNode],
+            advantage: bool,
+            out: List[_CandidateElem],
+        ) -> None:
+            for child in cohort.children:
+                if child is skip:
+                    continue
+                if child.is_cq:
+                    if child.name == ctx.preemptor_cq.name:
+                        continue
+                    if not child.is_within_nominal_in(ctx.frs_need_preemption):
+                        out.extend(
+                            self._candidates_from_cq(
+                                ctx, cq_by_node[child.name], subtree_root,
+                                advantage,
+                            )
+                        )
+                else:
+                    if not child.is_within_nominal_in(ctx.frs_need_preemption):
+                        collect_in_subtree(
+                            child, subtree_root, skip, advantage, out
+                        )
+
+        advantage, remaining = ctx.preemptor_cq.node.quantities_fit_in_quota(
+            ctx.requests
+        )
+        previous: Optional[QuotaNode] = ctx.preemptor_cq.node
+        for subtree_root in ctx.preemptor_cq.path_parent_to_root():
+            out = hierarchy if advantage else priority_c
+            collect_in_subtree(subtree_root, subtree_root, previous, advantage, out)
+            fits, remaining = subtree_root.quantities_fit_in_quota(remaining)
+            # Once a subtree fits the requests, the preemptor has hierarchical
+            # advantage over everything above it.
+            advantage = advantage or fits
+            previous = subtree_root
+        return hierarchy, priority_c, same_queue
+
+    # -- classical algorithm -------------------------------------------------
+
+    def classical_preemptions(self, ctx: PreemptionCtx) -> List[Target]:
+        """reference preemption.go:281-336."""
+        hierarchy, priority_c, same_queue = self._collect_candidates(ctx)
+
+        def sort(lst: List[_CandidateElem]) -> List[_CandidateElem]:
+            return sorted(
+                lst,
+                key=lambda c: candidates_ordering_key(
+                    c.wl, ctx.preemptor_cq.name, ctx.now
+                ),
+            )
+
+        hierarchy, priority_c, same_queue = (
+            sort(hierarchy), sort(priority_c), sort(same_queue),
+        )
+
+        def split_evicted(lst):
+            ev = [c for c in lst if is_evicted(c.wl.obj)]
+            nev = [c for c in lst if not is_evicted(c.wl.obj)]
+            return ev, nev
+
+        ev_h, nev_h = split_evicted(hierarchy)
+        ev_p, nev_p = split_evicted(priority_c)
+        ev_s, nev_s = split_evicted(same_queue)
+        all_candidates = ev_h + ev_p + ev_s + nev_h + nev_p + nev_s
+
+        no_other_queue_candidates = not hierarchy and not priority_c
+        no_hierarchy_candidates = not hierarchy
+        borrow_forbidden = (
+            ctx.preemptor_cq.spec.preemption.borrow_within_cohort.policy
+            == BorrowWithinCohortPolicy.NEVER
+        )
+
+        if no_other_queue_candidates or (
+            borrow_forbidden and not self._queue_under_nominal(ctx)
+        ):
+            attempts = [True]
+        elif borrow_forbidden and no_hierarchy_candidates:
+            attempts = [False, True]
+        else:
+            attempts = [True, False]
+
+        for allow_borrowing in attempts:
+            targets: List[Target] = []
+            for cand in all_candidates:
+                if not self._candidate_is_valid(ctx, cand, allow_borrowing):
+                    continue
+                ctx.snapshot.remove_workload(cand.wl)
+                targets.append(Target(cand.wl, cand.variant.reason()))
+                if self._workload_fits(ctx, allow_borrowing):
+                    targets = self._fill_back(ctx, targets, allow_borrowing)
+                    self._restore(ctx, targets)
+                    return targets
+            self._restore(ctx, targets)
+        return []
+
+    def _candidate_is_valid(
+        self, ctx: PreemptionCtx, cand: _CandidateElem, borrow: bool
+    ) -> bool:
+        """reference candidate_generator.go:137-158."""
+        if ctx.preemptor_cq.name == cand.wl.cluster_queue:
+            return True
+        if borrow and cand.variant == Variant.RECLAIM_WITHOUT_BORROWING:
+            return False
+        cq = ctx.snapshot.cluster_queue(cand.wl.cluster_queue)
+        if cq.node.is_within_nominal_in(ctx.frs_need_preemption):
+            return False
+        node = cq.node.parent
+        while node is not None and node is not cand.lca:
+            if node.is_within_nominal_in(ctx.frs_need_preemption):
+                return False
+            node = node.parent
+        return True
+
+    def _workload_fits(self, ctx: PreemptionCtx, allow_borrowing: bool) -> bool:
+        """reference preemption.go:628."""
+        for fr, v in ctx.requests.items():
+            if not allow_borrowing and ctx.preemptor_cq.borrowing_with(fr, v):
+                return False
+            if v > ctx.preemptor_cq.available(fr):
+                return False
+        if ctx.tas_fits is not None:
+            return ctx.tas_fits()
+        return True
+
+    def _fill_back(
+        self, ctx: PreemptionCtx, targets: List[Target], allow_borrowing: bool
+    ) -> List[Target]:
+        """reference preemption.go:338-351."""
+        i = len(targets) - 2
+        while i >= 0:
+            ctx.snapshot.add_workload(targets[i].info)
+            if self._workload_fits(ctx, allow_borrowing):
+                targets[i] = targets[-1]
+                targets.pop()
+            else:
+                ctx.snapshot.remove_workload(targets[i].info)
+            i -= 1
+        return targets
+
+    def _restore(self, ctx: PreemptionCtx, targets: List[Target]) -> None:
+        for t in targets:
+            ctx.snapshot.add_workload(t.info)
+
+    def _queue_under_nominal(self, ctx: PreemptionCtx) -> bool:
+        """usage strictly below nominal for all contested frs
+        (preemption.go:659)."""
+        node = ctx.preemptor_cq.node
+        return all(
+            ctx.preemptor_cq.quota_for(fr).nominal > node.usage.get(fr, 0)
+            for fr in ctx.frs_need_preemption
+        )
+
+
+def flavor_resources_need_preemption(
+    assignment: Assignment,
+) -> Set[FlavorResource]:
+    """reference preemption.go:550."""
+    out: Set[FlavorResource] = set()
+    for ps in assignment.pod_sets:
+        for res, fa in ps.flavors.items():
+            if fa.mode == Mode.PREEMPT:
+                out.add(FlavorResource(fa.name, res))
+    return out
+
+
+def make_oracle(
+    preemptor: Preemptor, snapshot: Snapshot, now: float = 0.0
+):
+    """SimulatePreemption (reference preemption_oracle.go): run the
+    preemption search for a single contested FlavorResource and report
+    whether targets exist and the borrow height after preemptions."""
+
+    def simulate(
+        cq: ClusterQueueSnapshot, wl: WorkloadInfo, fr: FlavorResource, val: int
+    ) -> Tuple[str, int]:
+        from kueue_tpu.cache.resource_node import (
+            find_height_of_lowest_subtree_that_fits,
+        )
+
+        ctx = PreemptionCtx(
+            preemptor=wl,
+            preemptor_cq=snapshot.cluster_queue(wl.cluster_queue),
+            snapshot=snapshot,
+            frs_need_preemption={fr},
+            requests={fr: val},
+            now=now,
+        )
+        if preemptor.enable_fair_sharing:
+            from kueue_tpu.scheduler.fair_preemption import fair_preemptions
+
+            candidates = fair_preemptions(ctx, preemptor.fair_strategies)
+        else:
+            candidates = preemptor.classical_preemptions(ctx)
+        if not candidates:
+            borrow, _ = find_height_of_lowest_subtree_that_fits(cq.node, fr, val)
+            return "NoCandidates", borrow
+        revert = snapshot.simulate_workload_removal(
+            [t.info for t in candidates]
+        )
+        borrow_after, _ = find_height_of_lowest_subtree_that_fits(
+            cq.node, fr, val
+        )
+        revert()
+        if any(t.info.cluster_queue == cq.name for t in candidates):
+            return "Preempt", borrow_after
+        return "Reclaim", borrow_after
+
+    return simulate

@@ -1,0 +1,639 @@
+"""The scheduling cycle.
+
+Behavioral surface: reference pkg/scheduler/scheduler.go — one cycle =
+Heads -> Snapshot -> nominate (flavor assignment + preemption targets) ->
+ordered iteration (classical sort or fair-sharing tournament) ->
+admit / preempt / skip -> requeue.
+
+This host driver is exact and fully general. The batched TPU cycle
+(kueue_tpu/models/batch_scheduler.py) executes the same decision procedure
+for the dense common case and is differential-tested against this one.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.constants import (
+    COND_ADMITTED,
+    COND_EVICTED,
+    COND_PREEMPTED,
+    COND_QUOTA_RESERVED,
+    EVICTED_BY_PREEMPTION,
+    CheckState,
+    REASON_PENDING,
+    REASON_WAITING_FOR_QUOTA,
+    RequeueReason,
+)
+from kueue_tpu.api.types import Admission, AdmissionCheckState, PodSetAssignment
+from kueue_tpu.cache.cache import Cache
+from kueue_tpu.cache.resource_node import compare_drs, dominant_resource_share
+from kueue_tpu.cache.snapshot import ClusterQueueSnapshot, Snapshot
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    has_quota_reservation,
+    queue_order_timestamp,
+    set_condition,
+)
+from kueue_tpu.queue.manager import QueueManager
+from kueue_tpu.scheduler.flavorassigner import (
+    Assignment,
+    FlavorAssigner,
+    Mode,
+)
+from kueue_tpu.scheduler.preemption import (
+    PreemptedWorkloads,
+    Preemptor,
+    Target,
+    make_oracle,
+)
+from kueue_tpu.utils import features
+
+
+class EntryStatus(str, enum.Enum):
+    NOT_NOMINATED = "notNominated"
+    NOMINATED = "nominated"
+    SKIPPED = "skipped"
+    ASSUMED = "assumed"
+    EVICTED = "evicted"
+    PREEMPTING = "preempting"
+
+
+@dataclass
+class Entry:
+    """reference scheduler.go entry."""
+
+    info: WorkloadInfo
+    cq_snapshot: Optional[ClusterQueueSnapshot] = None
+    assignment: Optional[Assignment] = None
+    preemption_targets: List[Target] = field(default_factory=list)
+    status: EntryStatus = EntryStatus.NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: RequeueReason = RequeueReason.GENERIC
+    quota_reserved_reason: str = ""
+
+
+@dataclass
+class CycleResult:
+    admitted: List[str] = field(default_factory=list)
+    preempting: List[str] = field(default_factory=list)
+    preempted: List[str] = field(default_factory=list)
+    skipped: List[str] = field(default_factory=list)
+    inadmissible: List[str] = field(default_factory=list)
+    head_keys: frozenset = frozenset()
+    duration_s: float = 0.0
+
+    @property
+    def success(self) -> bool:
+        return bool(self.admitted)
+
+
+class Scheduler:
+    """reference scheduler.go:180."""
+
+    def __init__(
+        self,
+        cache: Cache,
+        queues: QueueManager,
+        fair_sharing: bool = False,
+        fair_strategies: Optional[List[str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        # Called for each preemption victim; controllers use this to drive
+        # the eviction lifecycle. Default applies it inline.
+        evict_fn: Optional[Callable[[WorkloadInfo, str, str], None]] = None,
+    ) -> None:
+        self.cache = cache
+        self.queues = queues
+        self.fair_sharing = fair_sharing
+        self.preemptor = Preemptor(
+            enable_fair_sharing=fair_sharing, fair_strategies=fair_strategies
+        )
+        self.clock = clock
+        self.evict_fn = evict_fn or self._default_evict
+        self.scheduling_cycle = 0
+
+    # ------------------------------------------------------------------
+    # cycle
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> CycleResult:
+        """One scheduling cycle (reference scheduler.go:300)."""
+        self.scheduling_cycle += 1
+        start = self.clock()
+        result = CycleResult()
+
+        heads = self.queues.heads()
+        result.head_keys = frozenset(h.key for h in heads)
+        if not heads:
+            result.duration_s = self.clock() - start
+            return result
+
+        snapshot = self.cache.snapshot()
+        entries, inadmissible = self._nominate(heads, snapshot)
+
+        iterator = self._make_iterator(entries, snapshot)
+
+        preempted_workloads = PreemptedWorkloads()
+        skipped_preemptions: Dict[str, int] = {}
+        for e in iterator:
+            self._process_entry(
+                e, snapshot, preempted_workloads, skipped_preemptions, result
+            )
+
+        # Requeue everything not assumed/evicted.
+        for e in entries:
+            if e.status == EntryStatus.ASSUMED:
+                result.admitted.append(e.info.key)
+            elif e.status == EntryStatus.PREEMPTING:
+                result.preempting.append(e.info.key)
+                self._requeue_and_update(e)
+            elif e.status != EntryStatus.EVICTED:
+                result.skipped.append(e.info.key)
+                self._requeue_and_update(e)
+        for e in inadmissible:
+            result.inadmissible.append(e.info.key)
+            self._requeue_and_update(e)
+
+        result.duration_s = self.clock() - start
+        return result
+
+    def schedule_all(self, max_cycles: int = 100000) -> int:
+        """Run cycles until no progress is possible. Returns cycle count."""
+        cycles = 0
+        prev_no_progress_heads: Optional[frozenset] = None
+        while cycles < max_cycles:
+            result = self.schedule()
+            cycles += 1
+            if result.admitted or result.preempted:
+                prev_no_progress_heads = None
+                continue
+            # No admission and no eviction: no capacity event happened. Stop
+            # once the head set repeats (e.g. a StrictFIFO head that will
+            # never fit) — the system is stable.
+            if not result.head_keys or result.head_keys == prev_no_progress_heads:
+                break
+            prev_no_progress_heads = result.head_keys
+        return cycles
+
+    # ------------------------------------------------------------------
+    # nomination
+    # ------------------------------------------------------------------
+
+    def _nominate(
+        self, heads: Sequence[WorkloadInfo], snapshot: Snapshot
+    ) -> Tuple[List[Entry], List[Entry]]:
+        """reference scheduler.go:629."""
+        entries: List[Entry] = []
+        inadmissible: List[Entry] = []
+        for info in heads:
+            e = Entry(info=info)
+            cqs = snapshot.cluster_queues.get(info.cluster_queue)
+            e.cq_snapshot = cqs
+            if self.cache.is_added(info.key) and not has_second_pass(info):
+                continue
+            if any(
+                acs.state in (CheckState.RETRY, CheckState.REJECTED)
+                for acs in info.obj.status.admission_checks
+            ):
+                e.inadmissible_msg = "The workload has failed admission checks"
+                inadmissible.append(e)
+            elif info.cluster_queue in snapshot.inactive_cluster_queues:
+                e.inadmissible_msg = (
+                    f"ClusterQueue {info.cluster_queue} is inactive"
+                )
+                inadmissible.append(e)
+            elif cqs is None:
+                e.inadmissible_msg = (
+                    f"ClusterQueue {info.cluster_queue} not found"
+                )
+                inadmissible.append(e)
+            elif not self._namespace_allowed(cqs, info):
+                e.inadmissible_msg = "Workload namespace doesn't match ClusterQueue selector"
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+                inadmissible.append(e)
+            else:
+                assignment, targets = self._get_assignments(info, snapshot)
+                e.assignment = assignment
+                e.preemption_targets = targets
+                entries.append(e)
+        return entries, inadmissible
+
+    def _namespace_allowed(
+        self, cqs: ClusterQueueSnapshot, info: WorkloadInfo
+    ) -> bool:
+        sel = cqs.spec.namespace_selector
+        if sel is None:
+            return True
+        # Simplified label selector: exact-match dict against a synthetic
+        # namespace label set {"kubernetes.io/metadata.name": namespace}.
+        labels = {"kubernetes.io/metadata.name": info.obj.namespace}
+        return all(labels.get(k) == v for k, v in sel.items())
+
+    def _get_assignments(
+        self, info: WorkloadInfo, snapshot: Snapshot
+    ) -> Tuple[Assignment, List[Target]]:
+        """reference scheduler.go:750,779."""
+        cq = snapshot.cluster_queue(info.cluster_queue)
+        oracle = make_oracle(self.preemptor, snapshot)
+        assigner = FlavorAssigner(
+            info, cq, snapshot.resource_flavors, oracle=oracle,
+            enable_fair_sharing=self.fair_sharing,
+        )
+        full = assigner.assign()
+        mode = full.representative_mode()
+        if mode == Mode.FIT:
+            return full, []
+        if mode == Mode.PREEMPT:
+            targets = self.preemptor.get_targets(info, full, snapshot)
+            if targets:
+                return full, targets
+
+        if features.enabled("PartialAdmission") and can_be_partially_admitted(info):
+            found = self._search_partial(info, snapshot, assigner)
+            if found is not None:
+                return found
+        return full, []
+
+    def _search_partial(
+        self, info: WorkloadInfo, snapshot: Snapshot, assigner: FlavorAssigner
+    ) -> Optional[Tuple[Assignment, List[Target]]]:
+        """PodSetReducer.Search (reference
+        flavorassigner/podset_reducer.go:67): binary search over a single
+        scale axis shrinking every reducible podset proportionally."""
+        pod_sets = info.obj.pod_sets
+        full_counts = [ps.count for ps in pod_sets]
+        deltas = [
+            ps.count - (ps.min_count if ps.min_count is not None else ps.count)
+            for ps in pod_sets
+        ]
+        total_delta = sum(deltas)
+        if total_delta == 0:
+            return None
+
+        def counts_at(i: int) -> List[int]:
+            return [
+                full_counts[j] - (deltas[j] * i // total_delta)
+                for j in range(len(pod_sets))
+            ]
+
+        def fits(counts: List[int]) -> Optional[Tuple[Assignment, List[Target]]]:
+            assignment = assigner.assign(counts)
+            mode = assignment.representative_mode()
+            if mode == Mode.FIT:
+                return assignment, []
+            if mode == Mode.PREEMPT:
+                targets = self.preemptor.get_targets(
+                    info, assignment, snapshot
+                )
+                if targets:
+                    return assignment, targets
+            return None
+
+        # sort.Search semantics: find smallest i in [0, total_delta] passing.
+        lo, hi = 0, total_delta
+        best: Optional[Tuple[Assignment, List[Target]]] = None
+        while lo < hi:
+            mid = (lo + hi) // 2
+            r = fits(counts_at(mid))
+            if r is not None:
+                best = r
+                hi = mid
+            else:
+                lo = mid + 1
+        if best is None and lo <= total_delta:
+            best = fits(counts_at(lo))
+        return best
+
+    # ------------------------------------------------------------------
+    # iteration order
+    # ------------------------------------------------------------------
+
+    def _make_iterator(self, entries: List[Entry], snapshot: Snapshot):
+        if self.fair_sharing:
+            return self._fair_iterator(entries, snapshot)
+        return self._classical_iterator(entries)
+
+    def _classical_iterator(self, entries: List[Entry]):
+        """reference scheduler.go:1005: quota-reserved first, fewest borrows,
+        priority desc, FIFO."""
+
+        def key(e: Entry):
+            return (
+                not has_quota_reservation(e.info.obj),
+                e.assignment.borrows() if e.assignment else 0,
+                -e.info.priority()
+                if features.enabled("PrioritySortingWithinCohort")
+                else 0,
+                queue_order_timestamp(e.info.obj),
+            )
+
+        return iter(sorted(entries, key=key))
+
+    def _fair_iterator(self, entries: List[Entry], snapshot: Snapshot):
+        """Fair-sharing tournament (reference fair_sharing_iterator.go)."""
+        cq_to_entry: Dict[str, Entry] = {
+            e.info.cluster_queue: e for e in entries
+        }
+
+        def assignment_usage(e: Entry):
+            return e.assignment.usage if e.assignment else {}
+
+        def pop_one() -> Entry:
+            # Any CQ without a cohort goes directly.
+            for cq_name, e in cq_to_entry.items():
+                cqs = snapshot.cluster_queues[cq_name]
+                if not cqs.has_parent():
+                    del cq_to_entry[cq_name]
+                    return e
+            some_cq = next(iter(cq_to_entry))
+            root = snapshot.cluster_queues[some_cq].node.root()
+
+            # computeDRS: per (ancestor-cohort, workload) DRS with the
+            # workload's usage simulated in.
+            drs_values: Dict[Tuple[int, str], object] = {}
+            for cq_name, e in cq_to_entry.items():
+                cqs = snapshot.cluster_queues[cq_name]
+                if cqs.node.root() is not root:
+                    continue
+                revert = cqs.simulate_usage_addition(assignment_usage(e))
+                drs = dominant_resource_share(cqs.node, {})
+                for anc in cqs.path_parent_to_root():
+                    drs_values[(id(anc), e.info.key)] = drs
+                    drs = dominant_resource_share(anc, {})
+                revert()
+
+            def less(a: Entry, b: Entry, parent_id: int) -> bool:
+                a_drs = drs_values[(parent_id, a.info.key)]
+                b_drs = drs_values[(parent_id, b.info.key)]
+                c = compare_drs(a_drs, b_drs)
+                if c != 0:
+                    return c < 0
+                if features.enabled("PrioritySortingWithinCohort"):
+                    if a.info.priority() != b.info.priority():
+                        return a.info.priority() > b.info.priority()
+                return queue_order_timestamp(a.info.obj) < queue_order_timestamp(
+                    b.info.obj
+                )
+
+            def tournament(cohort) -> Optional[Entry]:
+                candidates: List[Entry] = []
+                for child in cohort.children:
+                    if child.is_cq:
+                        e = cq_to_entry.get(child.name)
+                        if e is not None:
+                            candidates.append(e)
+                    else:
+                        c = tournament(child)
+                        if c is not None:
+                            candidates.append(c)
+                if not candidates:
+                    return None
+                best = candidates[0]
+                for cur in candidates[1:]:
+                    if less(cur, best, id(cohort)):
+                        best = cur
+                return best
+
+            winner = tournament(root)
+            assert winner is not None
+            del cq_to_entry[winner.info.cluster_queue]
+            return winner
+
+        def gen():
+            while cq_to_entry:
+                yield pop_one()
+
+        return gen()
+
+    # ------------------------------------------------------------------
+    # per-entry processing
+    # ------------------------------------------------------------------
+
+    def _process_entry(
+        self,
+        e: Entry,
+        snapshot: Snapshot,
+        preempted_workloads: PreemptedWorkloads,
+        skipped_preemptions: Dict[str, int],
+        result: CycleResult,
+    ) -> None:
+        """reference scheduler.go:385."""
+        cq = snapshot.cluster_queue(e.info.cluster_queue)
+        assert e.assignment is not None
+        usage = dict(e.assignment.usage)
+        fits = self._fits(snapshot, cq, usage, preempted_workloads,
+                          e.preemption_targets)
+        mode = e.assignment.representative_mode()
+
+        if mode == Mode.NO_FIT:
+            e.requeue_reason = RequeueReason.NO_FIT
+            e.quota_reserved_reason = e.assignment.no_fit_reason or REASON_WAITING_FOR_QUOTA
+            e.inadmissible_msg = "; ".join(
+                r for ps in e.assignment.pod_sets for r in ps.status_reasons
+            ) or "Workload didn't fit"
+            return
+
+        if mode == Mode.PREEMPT and not e.preemption_targets:
+            e.requeue_reason = RequeueReason.PREEMPTION_NO_CANDIDATES
+            e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA
+            e.inadmissible_msg = (
+                "Workload requires preemption but no candidate targets found"
+            )
+            # reserveCapacityForUnreclaimablePreempt (scheduler.go:513).
+            if not can_always_reclaim(cq):
+                cq.add_usage(self._quota_resources_to_reserve(e, cq))
+            return
+
+        if preempted_workloads.has_any(e.preemption_targets):
+            e.status = EntryStatus.SKIPPED
+            e.inadmissible_msg = (
+                "Workload has overlapping preemption targets with another workload"
+            )
+            e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA
+            skipped_preemptions[cq.name] = skipped_preemptions.get(cq.name, 0) + 1
+            return
+
+        if not fits:
+            e.status = EntryStatus.SKIPPED
+            e.inadmissible_msg = (
+                "Workload no longer fits after processing another workload"
+            )
+            e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA
+            if mode == Mode.PREEMPT:
+                skipped_preemptions[cq.name] = (
+                    skipped_preemptions.get(cq.name, 0) + 1
+                )
+            return
+
+        preempted_workloads.insert(e.preemption_targets)
+        cq.add_usage(usage)
+
+        if mode == Mode.PREEMPT:
+            e.status = EntryStatus.PREEMPTING
+            e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA
+            e.inadmissible_msg = (
+                f"Waiting for {len(e.preemption_targets)} preempted workloads"
+            )
+            self._issue_preemptions(e, result)
+            return
+
+        e.status = EntryStatus.NOMINATED
+        self._admit(e, cq)
+        result_status = e.status  # ASSUMED on success
+
+    def _fits(
+        self,
+        snapshot: Snapshot,
+        cq: ClusterQueueSnapshot,
+        usage,
+        preempted_workloads: PreemptedWorkloads,
+        targets: List[Target],
+    ) -> bool:
+        """reference scheduler.go fits(): simulate removal of all preemption
+        victims so far + this entry's targets, then check quota."""
+        infos = [t.info for t in targets]
+        revert = snapshot.simulate_workload_removal(infos)
+        try:
+            return cq.fits(usage)
+        finally:
+            revert()
+
+    def _quota_resources_to_reserve(self, e: Entry, cq: ClusterQueueSnapshot):
+        """reference scheduler.go:738 quotaResourcesToReserve."""
+        assert e.assignment is not None
+        if e.assignment.representative_mode() != Mode.PREEMPT:
+            return e.assignment.usage
+        reserved = {}
+        for fr, usage in e.assignment.usage.items():
+            cell = cq.quota_for(fr)
+            node_usage = cq.node.usage.get(fr, 0)
+            if e.assignment.borrowing > 0:
+                if cell.borrowing_limit is None:
+                    reserved[fr] = usage
+                else:
+                    reserved[fr] = min(
+                        usage,
+                        cell.nominal + cell.borrowing_limit - node_usage,
+                    )
+            else:
+                reserved[fr] = max(0, min(usage, cell.nominal - node_usage))
+        return reserved
+
+    # ------------------------------------------------------------------
+    # admission / preemption application
+    # ------------------------------------------------------------------
+
+    def _admit(self, e: Entry, cq: ClusterQueueSnapshot) -> None:
+        """reference scheduler.go:890 admit + :954 assumeWorkload."""
+        assert e.assignment is not None
+        now = self.clock()
+        admission = Admission(
+            cluster_queue=e.info.cluster_queue,
+            pod_set_assignments=[
+                PodSetAssignment(
+                    name=psa.name,
+                    flavors={r: fa.name for r, fa in psa.flavors.items()},
+                    resource_usage=dict(psa.requests),
+                    count=psa.count,
+                )
+                for psa in e.assignment.pod_sets
+            ],
+        )
+        wl = e.info.obj
+        wl.status.admission = admission
+        set_condition(
+            wl, COND_QUOTA_RESERVED, True, "QuotaReserved",
+            f"Quota reserved in ClusterQueue {cq.name}", now,
+        )
+        # Apply assignment into the info's podset flavors for usage tracking.
+        for ps, psa in zip(e.info.total_requests, e.assignment.pod_sets):
+            if psa.count != ps.count:
+                scaled = ps.scaled_to(psa.count)
+                ps.requests = scaled.requests
+                ps.count = psa.count
+            ps.flavors = {r: fa.name for r, fa in psa.flavors.items()}
+        e.info.last_assignment = e.assignment.last_state
+
+        checks = cq.spec.admission_checks
+        if checks:
+            wl.status.admission_checks = [
+                AdmissionCheckState(name=c, state=CheckState.PENDING)
+                for c in checks
+            ]
+        else:
+            set_condition(
+                wl, COND_ADMITTED, True, "Admitted",
+                "The workload is admitted", now,
+            )
+        self.cache.assume_workload(e.info)
+        e.status = EntryStatus.ASSUMED
+
+    def _issue_preemptions(self, e: Entry, result: CycleResult) -> None:
+        """reference preemption.go:198 IssuePreemptions."""
+        for t in e.preemption_targets:
+            self.evict_fn(t.info, EVICTED_BY_PREEMPTION, t.reason)
+            result.preempted.append(t.info.key)
+
+    def _default_evict(
+        self, victim: WorkloadInfo, eviction_reason: str, preemption_reason: str
+    ) -> None:
+        """Inline eviction: conditions + cache removal + requeue (the
+        controllers module performs this asynchronously in the full stack;
+        reference pkg/workload/evict)."""
+        now = self.clock()
+        wl = victim.obj
+        set_condition(wl, COND_EVICTED, True, eviction_reason,
+                      "Preempted to accommodate a workload", now)
+        set_condition(wl, COND_PREEMPTED, True, preemption_reason,
+                      "Preempted", now)
+        set_condition(wl, COND_QUOTA_RESERVED, False, "Pending",
+                      "Evicted by preemption", now)
+        set_condition(wl, COND_ADMITTED, False, "NoReservation",
+                      "The workload has no reservation", now)
+        wl.status.admission = None
+        wl.status.admission_checks = []
+        self.cache.delete_workload(victim.key)
+        # Re-enter the queues with eviction-time ordering.
+        fresh = WorkloadInfo(wl, victim.cluster_queue)
+        self.queues.requeue_workload(fresh, RequeueReason.GENERIC)
+        self.queues.queue_inadmissible_workloads()
+
+    def _requeue_and_update(self, e: Entry) -> None:
+        """reference scheduler.go:1050."""
+        if (
+            e.status != EntryStatus.NOT_NOMINATED
+            and e.requeue_reason == RequeueReason.GENERIC
+        ):
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.info, e.requeue_reason)
+        if e.status in (EntryStatus.NOT_NOMINATED, EntryStatus.SKIPPED):
+            now = self.clock()
+            wl = e.info.obj
+            set_condition(
+                wl, COND_QUOTA_RESERVED, False,
+                e.quota_reserved_reason or REASON_PENDING,
+                e.inadmissible_msg, now,
+            )
+
+
+def can_be_partially_admitted(info: WorkloadInfo) -> bool:
+    return any(
+        ps.min_count is not None and ps.min_count < ps.count
+        for ps in info.obj.pod_sets
+    )
+
+
+def can_always_reclaim(cq: ClusterQueueSnapshot) -> bool:
+    """reference preemption CanAlwaysReclaim: with ReclaimWithinCohort=Any
+    the CQ can always take back its nominal quota."""
+    from kueue_tpu.api.constants import PreemptionPolicy
+
+    return cq.spec.preemption.reclaim_within_cohort == PreemptionPolicy.ANY
+
+
+def has_second_pass(info: WorkloadInfo) -> bool:
+    return False  # TAS delayed-admission second pass: wired in kueue_tpu/tas.

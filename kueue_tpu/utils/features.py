@@ -1,0 +1,64 @@
+"""Feature gates (reference pkg/features/kube_features.go).
+
+A small mutable registry with the reference's defaults. Gates not yet wired
+into behavior are still registered so user configs carry over unchanged;
+they're marked below as they become load-bearing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_DEFAULTS: Dict[str, bool] = {
+    # -- load-bearing in kueue_tpu --
+    "FlavorFungibility": True,
+    "PrioritySortingWithinCohort": True,
+    "FairSharingPreemptWithinNominal": True,
+    "TopologyAwareScheduling": True,
+    "PartialAdmission": True,
+    "WaitForPodsReady": True,
+    "LocalQueueMetrics": False,
+    "ElasticJobsViaWorkloadSlices": False,
+    "ConcurrentAdmission": False,
+    "AdmissionFairSharing": False,
+    "MultiKueue": True,
+    "MultiKueueBatchJobWithManagedBy": False,
+    "HierarchicalCohorts": True,
+    "TASFailedNodeReplacement": True,
+    "TASFailedNodeReplacementFailFast": True,
+    "TASReplaceNodeOnPodTermination": True,
+    "WorkloadRequestUseMergePatch": False,
+    "ObjectRetentionPolicies": True,
+    "SchedulerTimestampPreemptionBuffer": False,
+    "DynamicResourceAllocation": False,
+    "ProvisioningACC": True,
+    "VisibilityOnDemand": True,
+    "QueueVisibility": False,
+    "PodIntegrationAutoEnable": True,
+    "ConfigurableResourceTransformations": True,
+    "ManagedJobsNamespaceSelectorAlwaysRespected": True,
+    "PrioritizedAccessToFlavors": False,
+    "FairSharingPrioritizeNonBorrowing": False,
+}
+
+_overrides: Dict[str, bool] = {}
+
+
+def enabled(name: str) -> bool:
+    if name in _overrides:
+        return _overrides[name]
+    return _DEFAULTS.get(name, False)
+
+
+def set_enabled(name: str, value: bool) -> None:
+    _overrides[name] = value
+
+
+def reset() -> None:
+    _overrides.clear()
+
+
+def all_gates() -> Dict[str, bool]:
+    out = dict(_DEFAULTS)
+    out.update(_overrides)
+    return out

@@ -1,0 +1,146 @@
+"""Test builders, modeled on the reference's pkg/util/testing wrappers."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.constants import PreemptionPolicy, QueueingStrategy
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FairSharing,
+    FlavorFungibility,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.cache.cache import Cache
+from kueue_tpu.queue.manager import QueueManager
+from kueue_tpu.scheduler.scheduler import Scheduler
+
+_counter = itertools.count(1)
+
+
+def make_cq(
+    name: str,
+    cohort: Optional[str] = None,
+    flavors: Optional[Dict[str, Dict[str, ResourceQuota]]] = None,
+    resources: Sequence[str] = ("cpu",),
+    strategy: QueueingStrategy = QueueingStrategy.BEST_EFFORT_FIFO,
+    preemption: Optional[ClusterQueuePreemption] = None,
+    fungibility: Optional[FlavorFungibility] = None,
+    fair_weight: Optional[float] = None,
+    admission_checks: Sequence[str] = (),
+) -> ClusterQueue:
+    """flavors: ordered {flavor_name: {resource: ResourceQuota}}."""
+    flavors = flavors or {"default": {"cpu": ResourceQuota(nominal=10_000)}}
+    rg = ResourceGroup(
+        covered_resources=list(resources),
+        flavors=[
+            FlavorQuotas(name=f, resources=dict(qs))
+            for f, qs in flavors.items()
+        ],
+    )
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        resource_groups=[rg],
+        queueing_strategy=strategy,
+        preemption=preemption or ClusterQueuePreemption(),
+        flavor_fungibility=fungibility or FlavorFungibility(),
+        fair_sharing=FairSharing(weight=fair_weight)
+        if fair_weight is not None
+        else None,
+        admission_checks=list(admission_checks),
+    )
+
+
+def make_wl(
+    name: str,
+    queue: str = "lq",
+    cpu_m: int = 1000,
+    count: int = 1,
+    priority: int = 0,
+    creation_time: float = 0.0,
+    min_count: Optional[int] = None,
+    requests: Optional[Dict[str, int]] = None,
+    namespace: str = "default",
+) -> Workload:
+    ps = PodSet(
+        name="main",
+        count=count,
+        requests=requests or {"cpu": cpu_m},
+        min_count=min_count,
+    )
+    return Workload(
+        name=name,
+        namespace=namespace,
+        queue_name=queue,
+        pod_sets=[ps],
+        priority=priority,
+        creation_time=creation_time or float(next(_counter)),
+    )
+
+
+def build_env(
+    cqs: Sequence[ClusterQueue],
+    cohorts: Sequence[Cohort] = (),
+    flavors: Sequence[ResourceFlavor] = (),
+    local_queues: Optional[Sequence[LocalQueue]] = None,
+    fair_sharing: bool = False,
+) -> Tuple[Cache, QueueManager, Scheduler]:
+    cache = Cache()
+    queues = QueueManager()
+    flavor_names = {f.name for f in flavors}
+    needed = {
+        fq.name
+        for cq in cqs
+        for rg in cq.resource_groups
+        for fq in rg.flavors
+    }
+    for f in flavors:
+        cache.add_or_update_resource_flavor(f)
+    for name in needed - flavor_names:
+        cache.add_or_update_resource_flavor(ResourceFlavor(name=name))
+    for c in cohorts:
+        cache.add_or_update_cohort(c)
+    for cq in cqs:
+        cache.add_or_update_cluster_queue(cq)
+        queues.add_cluster_queue(cq)
+    if local_queues is None:
+        # One LocalQueue "lq" per CQ is unambiguous only with one CQ; make
+        # one LQ per CQ named lq-<cq> plus "lq" -> first CQ.
+        local_queues = [LocalQueue(name="lq", cluster_queue=cqs[0].name)]
+        local_queues += [
+            LocalQueue(name=f"lq-{cq.name}", cluster_queue=cq.name)
+            for cq in cqs
+        ]
+    for lq in local_queues:
+        cache.add_or_update_local_queue(lq)
+        queues.add_local_queue(lq)
+    sched = Scheduler(cache, queues, fair_sharing=fair_sharing)
+    return cache, queues, sched
+
+
+def submit(queues: QueueManager, *wls: Workload) -> None:
+    for wl in wls:
+        assert queues.add_or_update_workload(wl), f"no queue route for {wl.name}"
+
+
+def admitted_names(cache: Cache) -> List[str]:
+    return sorted(
+        info.obj.name
+        for info in cache.workloads.values()
+    )
+
+
+def admission_of(cache: Cache, name: str, namespace: str = "default"):
+    info = cache.workloads.get(f"{namespace}/{name}")
+    return None if info is None else info.obj.status.admission

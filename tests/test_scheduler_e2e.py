@@ -1,0 +1,436 @@
+"""End-to-end scheduler scenarios, mirroring the reference's
+pkg/scheduler/scheduler_test.go table tests at small scale."""
+
+import pytest
+
+from kueue_tpu.api.constants import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+    PreemptionPolicy,
+    QueueingStrategy,
+)
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorFungibility,
+    LocalQueue,
+    MatchExpression,
+    PodSet,
+    ResourceFlavor,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Workload,
+    quota,
+)
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    is_admitted,
+    is_evicted,
+)
+
+from .helpers import (
+    admission_of,
+    admitted_names,
+    build_env,
+    make_cq,
+    make_wl,
+    submit,
+)
+
+
+def test_simple_admission():
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}})]
+    )
+    wl = make_wl("job-1", cpu_m=2000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["job-1"]
+    assert is_admitted(wl)
+    adm = admission_of(cache, "job-1")
+    assert adm.cluster_queue == "cq-a"
+    assert adm.pod_set_assignments[0].flavors["cpu"] == "default"
+
+
+def test_no_fit_stays_pending():
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"default": {"cpu": quota(1_000)}})]
+    )
+    wl = make_wl("big", cpu_m=5_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == []
+    assert not has_quota_reservation(wl)
+    assert queues.pending_count("cq-a") == 1
+
+
+def test_priority_order_within_cq():
+    """Higher priority admitted first when quota fits only one."""
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}})]
+    )
+    lo = make_wl("lo", cpu_m=3_000, priority=1, creation_time=1.0)
+    hi = make_wl("hi", cpu_m=3_000, priority=10, creation_time=2.0)
+    submit(queues, lo, hi)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["hi"]
+
+
+def test_multiple_small_fit_together():
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}})]
+    )
+    wls = [make_wl(f"w{i}", cpu_m=2_000) for i in range(5)]
+    submit(queues, *wls)
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 5
+
+
+def test_cohort_borrowing():
+    """cq-a borrows sibling cq-b's unused nominal quota."""
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(6_000)}}),
+        ],
+    )
+    wl = make_wl("borrower", queue="lq-cq-a", cpu_m=8_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["borrower"]
+
+
+def test_borrowing_limit_respected():
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000, borrowing_limit=1_000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(6_000)}}),
+        ],
+    )
+    wl = make_wl("borrower", queue="lq-cq-a", cpu_m=6_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == []  # needs 2000 borrowed > limit 1000
+
+
+def test_lending_limit_respected():
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(6_000, lending_limit=1_000)}}),
+        ],
+    )
+    wl = make_wl("borrower", queue="lq-cq-a", cpu_m=6_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    # cq-b only lends 1000; 4000 + 1000 < 6000.
+    assert admitted_names(cache) == []
+
+
+def test_flavor_fungibility_spills_to_next():
+    """With default whenCanBorrow=Borrow but no cohort, a full first flavor
+    spills to the second flavor."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={
+                    "on-demand": {"cpu": quota(2_000)},
+                    "spot": {"cpu": quota(10_000)},
+                },
+            )
+        ],
+    )
+    w1 = make_wl("w1", cpu_m=2_000)
+    w2 = make_wl("w2", cpu_m=2_000)
+    submit(queues, w1, w2)
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 2
+    flavors = {
+        admission_of(cache, n).pod_set_assignments[0].flavors["cpu"]
+        for n in ("w1", "w2")
+    }
+    assert flavors == {"on-demand", "spot"}
+
+
+def test_fungibility_borrow_before_next_flavor():
+    """whenCanBorrow=Borrow (default): prefer borrowing on the first flavor
+    over spilling to the next flavor."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={
+                    "on-demand": {"cpu": quota(2_000)},
+                    "spot": {"cpu": quota(10_000)},
+                },
+            ),
+            make_cq(
+                "cq-b", cohort="co",
+                flavors={"on-demand": {"cpu": quota(10_000)}},
+            ),
+        ],
+    )
+    wl = make_wl("w1", queue="lq-cq-a", cpu_m=4_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["w1"]
+    assert admission_of(cache, "w1").pod_set_assignments[0].flavors["cpu"] == \
+        "on-demand"
+
+
+def test_fungibility_try_next_flavor_before_borrow():
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={
+                    "on-demand": {"cpu": quota(2_000)},
+                    "spot": {"cpu": quota(10_000)},
+                },
+                fungibility=FlavorFungibility(
+                    when_can_borrow=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+                ),
+            ),
+            make_cq(
+                "cq-b", cohort="co",
+                flavors={"on-demand": {"cpu": quota(10_000)}},
+            ),
+        ],
+    )
+    wl = make_wl("w1", queue="lq-cq-a", cpu_m=4_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["w1"]
+    assert admission_of(cache, "w1").pod_set_assignments[0].flavors["cpu"] == \
+        "spot"
+
+
+def test_preemption_within_cq_lower_priority():
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    lo = make_wl("lo", cpu_m=3_000, priority=1, creation_time=1.0)
+    submit(queues, lo)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["lo"]
+
+    hi = make_wl("hi", cpu_m=3_000, priority=10, creation_time=2.0)
+    submit(queues, hi)
+    sched.schedule_all()
+    # lo evicted, hi admitted; lo cannot come back (would preempt hi? no:
+    # lo priority < hi, policy LowerPriority) so lo stays pending.
+    assert is_evicted(lo.  __getattribute__("__class__") and lo) or True
+    assert "hi" in admitted_names(cache)
+    assert "lo" not in admitted_names(cache)
+    assert is_evicted(lo)
+
+
+def test_reclaim_within_cohort():
+    """cq-b workload borrows cq-a's quota; cq-a reclaims by preemption."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={"default": {"cpu": quota(5_000)}},
+                preemption=ClusterQueuePreemption(
+                    reclaim_within_cohort=PreemptionPolicy.ANY
+                ),
+            ),
+            make_cq(
+                "cq-b", cohort="co",
+                flavors={"default": {"cpu": quota(5_000)}},
+            ),
+        ],
+    )
+    big_b = make_wl("big-b", queue="lq-cq-b", cpu_m=8_000)
+    submit(queues, big_b)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["big-b"]
+
+    a1 = make_wl("a1", queue="lq-cq-a", cpu_m=4_000)
+    submit(queues, a1)
+    sched.schedule_all()
+    assert "a1" in admitted_names(cache)
+    assert is_evicted(big_b)
+    # big-b requeued pending (cannot fit while a1 holds quota: 8000 > 6000
+    # available). It stays pending.
+    assert "big-b" not in admitted_names(cache)
+
+
+def test_no_preemption_when_policy_never():
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}})
+        ],
+    )
+    lo = make_wl("lo", cpu_m=3_000, priority=1)
+    submit(queues, lo)
+    sched.schedule_all()
+    hi = make_wl("hi", cpu_m=3_000, priority=10)
+    submit(queues, hi)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["lo"]
+    assert not is_evicted(lo)
+
+
+def test_partial_admission():
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}})]
+    )
+    wl = make_wl("elastic", cpu_m=1_000, count=10, min_count=2)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["elastic"]
+    adm = admission_of(cache, "elastic")
+    assert adm.pod_set_assignments[0].count == 4  # 4 * 1000m fits in 4000m
+
+
+def test_taints_and_affinity_flavor_selection():
+    spot = ResourceFlavor(
+        name="spot",
+        node_labels={"tier": "spot"},
+        node_taints=[Taint(key="spot", value="true", effect="NoSchedule")],
+    )
+    ondemand = ResourceFlavor(name="on-demand", node_labels={"tier": "od"})
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={
+                    "spot": {"cpu": quota(10_000)},
+                    "on-demand": {"cpu": quota(10_000)},
+                },
+            )
+        ],
+        flavors=[spot, ondemand],
+    )
+    # Workload without toleration skips the tainted spot flavor.
+    wl = make_wl("no-tol", cpu_m=1_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admission_of(cache, "no-tol").pod_set_assignments[0].flavors[
+        "cpu"
+    ] == "on-demand"
+
+    # Workload with toleration takes spot (first flavor).
+    wl2 = make_wl("tol", cpu_m=1_000)
+    wl2.pod_sets[0].tolerations.append(
+        Toleration(key="spot", operator="Equal", value="true",
+                   effect="NoSchedule")
+    )
+    submit(queues, wl2)
+    sched.schedule_all()
+    assert admission_of(cache, "tol").pod_set_assignments[0].flavors["cpu"] \
+        == "spot"
+
+    # Workload with affinity selecting tier=od.
+    wl3 = make_wl("affinity", cpu_m=1_000)
+    wl3.pod_sets[0].required_affinity.append(
+        MatchExpression(key="tier", operator="In", values=("od",))
+    )
+    submit(queues, wl3)
+    sched.schedule_all()
+    assert admission_of(cache, "affinity").pod_set_assignments[0].flavors[
+        "cpu"
+    ] == "on-demand"
+
+
+def test_strict_fifo_head_blocks():
+    """StrictFIFO: a blocked head keeps later workloads waiting."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                strategy=QueueingStrategy.STRICT_FIFO,
+            )
+        ],
+    )
+    big = make_wl("big", cpu_m=5_000, creation_time=1.0)  # never fits
+    small = make_wl("small", cpu_m=1_000, creation_time=2.0)
+    submit(queues, big, small)
+    sched.schedule_all()
+    # big blocks the queue; small must NOT be admitted.
+    assert admitted_names(cache) == []
+
+
+def test_best_effort_fifo_skips_blocked_head():
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+            )
+        ],
+    )
+    big = make_wl("big", cpu_m=5_000, creation_time=1.0)
+    small = make_wl("small", cpu_m=1_000, creation_time=2.0)
+    submit(queues, big, small)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["small"]
+
+
+def test_admission_checks_gate_admitted_condition():
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                admission_checks=["prov-check"],
+            )
+        ],
+    )
+    from kueue_tpu.api.types import AdmissionCheck
+
+    cache.add_or_update_admission_check(
+        AdmissionCheck(name="prov-check", controller_name="test")
+    )
+    wl = make_wl("gated", cpu_m=1_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert has_quota_reservation(wl)
+    assert not is_admitted(wl)
+    assert wl.status.admission_checks[0].name == "prov-check"
+
+
+def test_fair_sharing_orders_by_drs():
+    """Two CQs compete; the one with lower usage share goes first."""
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000)}}),
+        ],
+        fair_sharing=True,
+    )
+    # cq-a already borrowing heavily.
+    seed = make_wl("seed-a", queue="lq-cq-a", cpu_m=6_000, creation_time=1.0)
+    submit(queues, seed)
+    sched.schedule_all()
+    assert "seed-a" in admitted_names(cache)
+
+    # Both submit; only 2000m left. cq-b (share 0) should win the tournament.
+    wa = make_wl("wa", queue="lq-cq-a", cpu_m=2_000, creation_time=2.0)
+    wb = make_wl("wb", queue="lq-cq-b", cpu_m=2_000, creation_time=3.0)
+    submit(queues, wa, wb)
+    sched.schedule()
+    assert "wb" in admitted_names(cache)
+    assert "wa" not in admitted_names(cache)
