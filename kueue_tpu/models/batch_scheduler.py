@@ -1,0 +1,353 @@
+"""The batched scheduling-cycle kernel — the TPU hot loop.
+
+This reformulates the reference's per-workload scheduling cycle
+(pkg/scheduler/scheduler.go:300 + flavorassigner.go findFlavorForPodSets) as
+one compiled XLA program over dense (workload x flavor x resource) tensors:
+
+  1. ``nominate``: flavor assignment for ALL workloads at once — per-cell
+     Fit/Preempt/NoFit modes, borrow heights (cohort-subtree walk), flavor
+     fungibility stop rules and preference scores, fully vectorized.
+  2. ``admission order``: the classical iterator's sort (fewest borrows,
+     priority, FIFO) as a lexsort.
+  3. ``admit scan``: the order-dependent part — earlier entries consume
+     capacity — as a lax.scan whose body does a MAX_DEPTH-bounded
+     ancestor-chain walk (gathers + one scatter-add) instead of the
+     reference's pointer-chasing tree mutation.
+
+Exactness: decisions are bit-identical to the host-exact scheduler for all
+device-compatible workloads on CQs that cannot preempt (the oracle outcome
+is then deterministic). Workloads needing a preemption oracle are flagged
+``needs_host`` and handled by the host path. Integer quota math is exact
+int64 end to end.
+
+Outcome codes returned per workload:
+  0 = NOFIT (requeue), 1 = NO_CANDIDATES (requeue, capacity reserved),
+  2 = NEEDS_HOST (preemption path), 3 = FIT_SKIPPED (lost the race in-cycle),
+  4 = ADMITTED.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.quota_ops import (
+    CAP,
+    MAX_DEPTH,
+    QuotaTreeArrays,
+    ancestor_chain,
+    sat_add,
+    sat_sub,
+)
+
+# preemptionMode codes (match kueue_tpu.scheduler.flavorassigner.PMode).
+P_NOFIT = 0
+P_NO_CANDIDATES = 1
+P_PREEMPT_RAW = 2  # preemption possible; oracle outcome unknown on device
+P_FIT = 4
+
+OUT_NOFIT = 0
+OUT_NO_CANDIDATES = 1
+OUT_NEEDS_HOST = 2
+OUT_FIT_SKIPPED = 3
+OUT_ADMITTED = 4
+
+_BIG = jnp.int64(1) << 40
+_NEG_INF = -(jnp.int64(1) << 60)
+
+
+class NominateResult(NamedTuple):
+    chosen_flavor: jnp.ndarray  # i32[W] global flavor id (-1 none)
+    best_pmode: jnp.ndarray  # i32[W]
+    best_borrow: jnp.ndarray  # i32[W]
+    needs_host: jnp.ndarray  # bool[W]
+    tried_flavor_idx: jnp.ndarray  # i32[W] (-1 = wrapped)
+
+
+class CycleOutputs(NamedTuple):
+    outcome: jnp.ndarray  # i32[W]
+    chosen_flavor: jnp.ndarray  # i32[W]
+    borrow: jnp.ndarray  # i32[W]
+    tried_flavor_idx: jnp.ndarray  # i32[W]
+    usage: jnp.ndarray  # i64[N,F,R] post-cycle
+    order: jnp.ndarray  # i32[W] processing order (diagnostics)
+
+
+def _pref_score(pmode, borrow, pref_preempt_over_borrow):
+    """Granular-mode preference as a single i64 score; higher = preferred
+    (flavorassigner.go isPreferred). NOFIT is absolute bottom."""
+    bob = pmode * _BIG - borrow
+    pob = -borrow * _BIG + pmode
+    score = jnp.where(pref_preempt_over_borrow, pob, bob)
+    return jnp.where(pmode == P_NOFIT, _NEG_INF, score)
+
+
+def _avail_at_node(
+    tree: QuotaTreeArrays, usage: jnp.ndarray, node: jnp.ndarray
+) -> jnp.ndarray:
+    """available() for one node as i64[F,R], via its ancestor chain
+    (resource_node.go:106). Root-first evaluation down the chain."""
+    chain = ancestor_chain(tree, node)
+    lq = quota_ops.local_quota(tree)
+    l_avail = jnp.maximum(0, sat_sub(lq, usage))
+    stored = sat_sub(tree.subtree_quota, lq)
+    used_in_parent = jnp.maximum(0, sat_sub(usage, lq))
+    with_max = sat_add(sat_sub(stored, used_in_parent), tree.borrow_limit)
+
+    top = chain[MAX_DEPTH]
+    avail = sat_sub(tree.subtree_quota[top], usage[top])
+    for i in range(MAX_DEPTH - 1, -1, -1):
+        n = chain[i]
+        is_repeat = n == chain[i + 1]
+        clamped = jnp.where(
+            tree.has_borrow_limit[n], jnp.minimum(with_max[n], avail), avail
+        )
+        stepped = sat_add(l_avail[n], clamped)
+        avail = jnp.where(is_repeat, avail, stepped)
+    return avail
+
+
+def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
+    """Vectorized flavor assignment for every workload against the
+    cycle-start usage (reference scheduler.go:629 nominate +
+    flavorassigner.go:946 findFlavorForPodSets)."""
+    tree = arrays.tree
+    avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
+    pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
+
+    def per_workload(c, req, elig, start_k, active):
+        # req: i64[R]; elig: bool[F].
+        f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+        req_cell = jnp.broadcast_to(req[None, :], (f_n, r_n))
+        cell_active = (req[None, :] > 0) & arrays.covered[c][None, :]
+
+        avail_c = avail_all[c]
+        pot_c = pot_all[c]
+        height, proper = quota_ops.borrow_height(tree, usage, c, req_cell)
+
+        no_fit = req_cell > pot_c
+        fit = req_cell <= avail_c
+        preempt_gate = (
+            (arrays.nominal_cq[c] >= req_cell)
+            | proper
+            | arrays.can_preempt_while_borrowing[c]
+        )
+        pmode_cell = jnp.where(
+            fit,
+            P_FIT,
+            jnp.where(
+                no_fit, P_NOFIT,
+                jnp.where(preempt_gate, P_PREEMPT_RAW, P_NOFIT),
+            ),
+        ).astype(jnp.int32)
+        # CQs that can never find preemption targets resolve the oracle on
+        # device: NoCandidates, borrow from the no-preemption fit search.
+        pmode_cell = jnp.where(
+            (pmode_cell == P_PREEMPT_RAW) & arrays.never_preempts[c],
+            P_NO_CANDIDATES,
+            pmode_cell,
+        )
+        borrow_cell = height.astype(jnp.int32)
+
+        # Representative (worst) mode over active cells per flavor.
+        score_cell = _pref_score(
+            pmode_cell.astype(jnp.int64),
+            borrow_cell.astype(jnp.int64),
+            arrays.pref_preempt_over_borrow[c],
+        )
+        best_score_inactive = _pref_score(
+            jnp.int64(P_FIT), jnp.int64(0),
+            arrays.pref_preempt_over_borrow[c],
+        )
+        score_cell = jnp.where(cell_active, score_cell, best_score_inactive)
+        rep_idx = jnp.argmin(score_cell, axis=1)  # worst resource per flavor
+        f_iota = jnp.arange(f_n)
+        rep_pmode = pmode_cell[f_iota, rep_idx]
+        rep_borrow = borrow_cell[f_iota, rep_idx]
+        # A flavor failing taints/affinity is NOFIT outright
+        # (checkFlavorForPodSets precedes the quota loop).
+        rep_pmode = jnp.where(elig, rep_pmode, P_NOFIT)
+        rep_borrow = jnp.where(elig, rep_borrow, 0)
+        rep_score = _pref_score(
+            rep_pmode.astype(jnp.int64),
+            rep_borrow.astype(jnp.int64),
+            arrays.pref_preempt_over_borrow[c],
+        )
+
+        # Fungibility scan over the CQ's flavor preference order.
+        k_n = arrays.flavor_at.shape[1]
+
+        def body(carry, k):
+            best_score, best_f, best_pm, best_bw, stopped, seen_praw, att = carry
+            k = k.astype(jnp.int32)
+            f = arrays.flavor_at[c, k]
+            pos_valid = (k < arrays.n_flavors[c]) & (k >= start_k)
+            pm = rep_pmode[f]
+            bw = rep_borrow[f]
+            sc = rep_score[f]
+            consider = pos_valid & ~stopped
+            att = jnp.where(consider, k, att)
+            seen_praw = seen_praw | (consider & (pm == P_PREEMPT_RAW))
+
+            should_try_next = (
+                (pm == P_NOFIT)
+                | (pm == P_NO_CANDIDATES)
+                | ((pm == P_PREEMPT_RAW) & arrays.when_can_preempt_try_next[c])
+                | ((bw > 0) & arrays.when_can_borrow_try_next[c])
+            )
+            stop_here = consider & ~should_try_next
+            preferred = consider & (sc > best_score)
+            take = stop_here | (preferred & ~stop_here)
+            best_score = jnp.where(take, sc, best_score)
+            best_f = jnp.where(take, f, best_f)
+            best_pm = jnp.where(take, pm, best_pm)
+            best_bw = jnp.where(take, bw, best_bw)
+            stopped = stopped | stop_here
+            return (best_score, best_f, best_pm, best_bw, stopped, seen_praw,
+                    att), None
+
+        init = (
+            _NEG_INF, jnp.int32(-1), jnp.int32(P_NOFIT), jnp.int32(0),
+            jnp.bool_(False), jnp.bool_(False), jnp.int32(-1),
+        )
+        (b_score, b_f, b_pm, b_bw, _stopped, seen_praw, att), _ = jax.lax.scan(
+            body, init, jnp.arange(k_n)
+        )
+        needs_host = (seen_praw | (b_pm == P_PREEMPT_RAW)) & active
+        tried = jnp.where(att == arrays.n_flavors[c] - 1, -1, att)
+        b_pm = jnp.where(active, b_pm, P_NOFIT)
+        return b_f, b_pm, b_bw, needs_host, tried
+
+    chosen, pmode, borrow, needs_host, tried = jax.vmap(per_workload)(
+        arrays.w_cq, arrays.w_req, arrays.w_elig, arrays.w_start_flavor,
+        arrays.w_active,
+    )
+    return NominateResult(chosen, pmode, borrow, needs_host, tried)
+
+
+def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
+    """Classical iterator sort (scheduler.go:1005): quota-reserved first,
+    fewest borrows, highest priority, FIFO timestamp. Inactive entries sink
+    to the end."""
+    w = arrays.w_cq.shape[0]
+    borrows = jnp.where(nom.best_pmode > P_NOFIT, nom.best_borrow, 0)
+    keys = (
+        jnp.arange(w, dtype=jnp.int32),  # final tiebreak: submission index
+        arrays.w_timestamp,
+        -arrays.w_priority,
+        borrows.astype(jnp.int64),
+        (~arrays.w_quota_reserved).astype(jnp.int32),
+        (~arrays.w_active).astype(jnp.int32),
+    )
+    return jnp.lexsort(keys).astype(jnp.int32)
+
+
+def admit_scan(
+    arrays: CycleArrays, nom: NominateResult, usage: jnp.ndarray,
+    order: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sequential admission in sorted order (the order-dependent core of
+    processEntry, scheduler.go:385): each FIT entry re-checks the fit
+    against running usage, then consumes capacity; NO_CANDIDATES entries
+    reserve clipped capacity (scheduler.go:513)."""
+    tree = arrays.tree
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    f_onehot = jnp.arange(f_n)
+
+    def body(usage, w):
+        c = arrays.w_cq[w]
+        f = nom.chosen_flavor[w]
+        pm = nom.best_pmode[w]
+        active = arrays.w_active[w]
+        cell_mask = (
+            (f_onehot[:, None] == f)
+            & (arrays.w_req[w][None, :] > 0)
+            & arrays.covered[c][None, :]
+        )
+        delta = jnp.where(cell_mask, arrays.w_req[w][None, :], 0).astype(
+            jnp.int64
+        )
+
+        avail = _avail_at_node(tree, usage, c)
+        fits = jnp.all((delta <= avail) | ~cell_mask)
+        deferred = nom.needs_host[w]  # host path decides; don't touch usage
+        admit = active & (pm == P_FIT) & fits & ~deferred
+        usage_admit = quota_ops.add_usage(tree, usage, c, delta)
+
+        # reserveCapacityForUnreclaimablePreempt for NO_CANDIDATES entries.
+        nominal = tree.nominal[c]
+        node_usage = usage[c]
+        bl = tree.borrow_limit[c]
+        has_bl = tree.has_borrow_limit[c]
+        borrowing = nom.best_borrow[w] > 0
+        reserve_borrowing = jnp.where(
+            has_bl,
+            jnp.minimum(delta, sat_sub(sat_add(nominal, bl), node_usage)),
+            delta,
+        )
+        reserve_plain = jnp.maximum(
+            0, jnp.minimum(delta, sat_sub(nominal, node_usage))
+        )
+        reserve = jnp.where(borrowing, reserve_borrowing, reserve_plain)
+        reserve = jnp.where(cell_mask, reserve, 0)
+        do_reserve = (
+            active
+            & (pm == P_NO_CANDIDATES)
+            & ~arrays.can_always_reclaim[c]
+            & ~deferred
+        )
+        usage_reserve = quota_ops.add_usage(tree, usage, c, reserve)
+
+        new_usage = jnp.where(
+            admit, usage_admit, jnp.where(do_reserve, usage_reserve, usage)
+        )
+        return new_usage, admit
+
+    final_usage, admitted_in_order = jax.lax.scan(body, usage, order)
+    admitted = jnp.zeros(arrays.w_cq.shape[0], dtype=bool)
+    admitted = admitted.at[order].set(admitted_in_order)
+    return final_usage, admitted
+
+
+@functools.partial(jax.jit, static_argnames=())
+def cycle(arrays: CycleArrays) -> CycleOutputs:
+    """One full batched scheduling cycle, jitted end to end."""
+    usage = arrays.usage
+    nom = nominate(arrays, usage)
+    order = admission_order(arrays, nom)
+    final_usage, admitted = admit_scan(arrays, nom, usage, order)
+
+    outcome = jnp.where(
+        ~arrays.w_active,
+        OUT_NOFIT,
+        jnp.where(
+            nom.needs_host,
+            OUT_NEEDS_HOST,
+            jnp.where(
+                admitted,
+                OUT_ADMITTED,
+                jnp.where(
+                    nom.best_pmode == P_FIT,
+                    OUT_FIT_SKIPPED,
+                    jnp.where(
+                        nom.best_pmode == P_NO_CANDIDATES,
+                        OUT_NO_CANDIDATES,
+                        OUT_NOFIT,
+                    ),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+    return CycleOutputs(
+        outcome=outcome,
+        chosen_flavor=nom.chosen_flavor,
+        borrow=nom.best_borrow,
+        tried_flavor_idx=nom.tried_flavor_idx,
+        usage=final_usage,
+        order=order,
+    )

@@ -1,0 +1,213 @@
+"""DeviceScheduler: the scheduling loop with the batched TPU cycle.
+
+Drives the same control plane as kueue_tpu.scheduler.Scheduler (same cache,
+queues, eviction lifecycle) but executes each cycle's nomination + admission
+with the compiled batched kernel (kueue_tpu/models/batch_scheduler.py).
+Workloads outside the dense fast path — or needing the preemption oracle —
+fall back to the host-exact path within the same loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from kueue_tpu.api.constants import (
+    COND_ADMITTED,
+    COND_QUOTA_RESERVED,
+    CheckState,
+    RequeueReason,
+)
+from kueue_tpu.api.types import Admission, AdmissionCheckState, PodSetAssignment
+from kueue_tpu.cache.cache import Cache
+from kueue_tpu.core.workload_info import (
+    AssignmentClusterQueueState,
+    WorkloadInfo,
+    set_condition,
+)
+from kueue_tpu.models import batch_scheduler
+from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.queue.manager import QueueManager
+from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
+
+
+class DeviceScheduler:
+    """Hybrid device/host scheduler."""
+
+    def __init__(
+        self,
+        cache: Cache,
+        queues: QueueManager,
+        fair_sharing: bool = False,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.cache = cache
+        self.queues = queues
+        self.fair_sharing = fair_sharing
+        self.clock = clock
+        # Host-exact scheduler reused for fallback entries and for the
+        # eviction lifecycle.
+        self.host = Scheduler(cache, queues, fair_sharing=fair_sharing,
+                              clock=clock)
+        self.device_time_s = 0.0
+        self.cycles = 0
+
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> CycleResult:
+        self.cycles += 1
+        start = self.clock()
+        result = CycleResult()
+        heads = self.queues.heads()
+        result.head_keys = frozenset(h.key for h in heads)
+        if not heads:
+            result.duration_s = self.clock() - start
+            return result
+
+        snapshot = self.cache.snapshot()
+        arrays, idx = encode_cycle(
+            snapshot, heads, snapshot.resource_flavors,
+            fair_sharing=self.fair_sharing,
+        )
+
+        host_entries: List[WorkloadInfo] = list(idx.host_fallback)
+
+        if idx.workloads:
+            t0 = self.clock()
+            out = batch_scheduler.cycle(arrays)
+            outcome = np.asarray(out.outcome)
+            chosen = np.asarray(out.chosen_flavor)
+            tried = np.asarray(out.tried_flavor_idx)
+            self.device_time_s += self.clock() - t0
+
+            for i, info in enumerate(idx.workloads):
+                oc = outcome[i]
+                if oc == batch_scheduler.OUT_ADMITTED:
+                    self._apply_admission(
+                        info, idx.flavors[chosen[i]], int(tried[i]),
+                        snapshot,
+                    )
+                    result.admitted.append(info.key)
+                elif oc == batch_scheduler.OUT_NEEDS_HOST:
+                    host_entries.append(info)
+                else:
+                    self._apply_requeue(info, int(oc), int(tried[i]),
+                                        snapshot)
+                    result.skipped.append(info.key)
+
+        # Host-exact path for fallback + preemption entries, in one go.
+        if host_entries:
+            host_result = self._host_process(host_entries)
+            result.admitted.extend(host_result.admitted)
+            result.preempted.extend(host_result.preempted)
+            result.preempting.extend(host_result.preempting)
+            result.skipped.extend(host_result.skipped)
+            result.inadmissible.extend(host_result.inadmissible)
+
+        result.duration_s = self.clock() - start
+        return result
+
+    def schedule_all(self, max_cycles: int = 100000) -> int:
+        cycles = 0
+        prev_heads = None
+        while cycles < max_cycles:
+            result = self.schedule()
+            cycles += 1
+            if result.admitted or result.preempted:
+                prev_heads = None
+                continue
+            if not result.head_keys or result.head_keys == prev_heads:
+                break
+            prev_heads = result.head_keys
+        return cycles
+
+    # ------------------------------------------------------------------
+
+    def _host_process(self, infos: List[WorkloadInfo]) -> CycleResult:
+        """Run the host-exact pipeline on specific workloads by temporarily
+        feeding them as the only heads."""
+        result = CycleResult()
+        snapshot = self.cache.snapshot()
+        entries, inadmissible = self.host._nominate(infos, snapshot)
+        iterator = self.host._make_iterator(entries, snapshot)
+        from kueue_tpu.scheduler.preemption import PreemptedWorkloads
+        from kueue_tpu.scheduler.scheduler import EntryStatus
+
+        preempted = PreemptedWorkloads()
+        skipped: Dict[str, int] = {}
+        for e in iterator:
+            self.host._process_entry(e, snapshot, preempted, skipped, result)
+        for e in entries:
+            if e.status == EntryStatus.ASSUMED:
+                result.admitted.append(e.info.key)
+            elif e.status == EntryStatus.PREEMPTING:
+                result.preempting.append(e.info.key)
+                self.host._requeue_and_update(e)
+            elif e.status != EntryStatus.EVICTED:
+                result.skipped.append(e.info.key)
+                self.host._requeue_and_update(e)
+        for e in inadmissible:
+            result.inadmissible.append(e.info.key)
+            self.host._requeue_and_update(e)
+        return result
+
+    def _apply_admission(
+        self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot
+    ) -> None:
+        now = self.clock()
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        ps = info.total_requests[0]
+        flavors = {res: flavor for res, v in ps.requests.items()}
+        admission = Admission(
+            cluster_queue=info.cluster_queue,
+            pod_set_assignments=[
+                PodSetAssignment(
+                    name=ps.name,
+                    flavors=dict(flavors),
+                    resource_usage=dict(ps.requests),
+                    count=ps.count,
+                )
+            ],
+        )
+        wl = info.obj
+        wl.status.admission = admission
+        set_condition(wl, COND_QUOTA_RESERVED, True, "QuotaReserved",
+                      f"Quota reserved in ClusterQueue {cqs.name}", now)
+        ps.flavors = dict(flavors)
+        info.last_assignment = AssignmentClusterQueueState(
+            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+            cluster_queue_generation=cqs.allocatable_generation,
+        )
+        checks = cqs.spec.admission_checks
+        if checks:
+            wl.status.admission_checks = [
+                AdmissionCheckState(name=c, state=CheckState.PENDING)
+                for c in checks
+            ]
+        else:
+            set_condition(wl, COND_ADMITTED, True, "Admitted",
+                          "The workload is admitted", now)
+        self.cache.assume_workload(info)
+
+    def _apply_requeue(
+        self, info: WorkloadInfo, outcome: int, tried_idx: int, snapshot
+    ) -> None:
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        ps = info.total_requests[0]
+        info.last_assignment = AssignmentClusterQueueState(
+            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+            cluster_queue_generation=cqs.allocatable_generation,
+        )
+        reason = {
+            batch_scheduler.OUT_NOFIT: RequeueReason.NO_FIT,
+            batch_scheduler.OUT_NO_CANDIDATES:
+                RequeueReason.PREEMPTION_NO_CANDIDATES,
+            batch_scheduler.OUT_FIT_SKIPPED:
+                RequeueReason.FAILED_AFTER_NOMINATION,
+        }.get(outcome, RequeueReason.GENERIC)
+        self.queues.requeue_workload(info, reason)
+        now = self.clock()
+        set_condition(info.obj, COND_QUOTA_RESERVED, False, "Pending",
+                      "Workload didn't fit", now)

@@ -1,0 +1,250 @@
+"""Encode a scheduling cycle into dense device tensors.
+
+Host-side, runs once per cycle: takes the Snapshot's quota tree plus a batch
+of pending workloads and produces the padded arrays consumed by the batched
+cycle kernel (kueue_tpu/models/batch_scheduler.py).
+
+Device-compatible workloads are the dense common case the TPU path handles:
+single podset, all requested resources covered by one resource group of the
+CQ. Anything else (multi-podset with heterogeneous flavors, multiple
+resource groups, TAS, partial admission) goes through the host-exact path —
+the encoder reports them in ``host_fallback``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from kueue_tpu.api.constants import (
+    FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
+)
+from kueue_tpu.cache.snapshot import Snapshot
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core.workload_info import WorkloadInfo, has_quota_reservation
+from kueue_tpu.ops.quota_ops import QuotaTreeArrays
+from kueue_tpu.ops.tree_encode import TreeIndex, encode_tree
+from kueue_tpu.core.workload_info import queue_order_timestamp
+
+
+class CycleArrays(NamedTuple):
+    """Inputs of one batched scheduling cycle. W/F/R/N are padded axes."""
+
+    # -- tree/topology (static between spec changes) --
+    tree: QuotaTreeArrays
+    usage: jnp.ndarray  # i64[N,F,R] cycle-start usage
+    # -- per-CQ policy --
+    flavor_at: jnp.ndarray  # i32[N,K] global flavor id per preference slot
+    n_flavors: jnp.ndarray  # i32[N]
+    covered: jnp.ndarray  # bool[N,R] resource covered by the CQ's group
+    when_can_borrow_try_next: jnp.ndarray  # bool[N]
+    when_can_preempt_try_next: jnp.ndarray  # bool[N]
+    pref_preempt_over_borrow: jnp.ndarray  # bool[N]
+    can_preempt_while_borrowing: jnp.ndarray  # bool[N]
+    never_preempts: jnp.ndarray  # bool[N] oracle deterministically NoCandidates
+    can_always_reclaim: jnp.ndarray  # bool[N] reclaimWithinCohort == Any
+    nominal_cq: jnp.ndarray  # i64[N,F,R] (= tree.nominal; alias for clarity)
+    # -- per-workload --
+    w_cq: jnp.ndarray  # i32[W] CQ node index
+    w_req: jnp.ndarray  # i64[W,R]
+    w_elig: jnp.ndarray  # bool[W,F] flavor passes taints/affinity
+    w_active: jnp.ndarray  # bool[W] (padding = False)
+    w_priority: jnp.ndarray  # i64[W]
+    w_timestamp: jnp.ndarray  # f64[W]
+    w_quota_reserved: jnp.ndarray  # bool[W] second-pass entries first
+    w_start_flavor: jnp.ndarray  # i32[W] NextFlavorToTry resume index
+
+
+@dataclass
+class CycleIndex:
+    """Host bookkeeping to decode device results."""
+
+    tree_index: TreeIndex
+    workloads: List[WorkloadInfo] = field(default_factory=list)
+    host_fallback: List[WorkloadInfo] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    flavors: List[str] = field(default_factory=list)
+
+
+def _round_up(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+def encode_cycle(
+    snapshot: Snapshot,
+    heads: Sequence[WorkloadInfo],
+    resource_flavors: Dict[str, object],
+    w_pad: int = 0,
+    fair_sharing: bool = False,
+) -> Tuple[CycleArrays, CycleIndex]:
+    """Build CycleArrays from the host snapshot + pending heads."""
+    tree, tidx, usage, is_cq = encode_tree(snapshot.roots)
+    n = tree.n_nodes
+    f = tree.nominal.shape[1]
+    r = tree.nominal.shape[2]
+
+    from kueue_tpu.ops import quota_ops
+
+    subtree, usage_full = quota_ops.compute_subtree(tree, usage, is_cq)
+    tree = tree._replace(subtree_quota=subtree)
+
+    idx = CycleIndex(
+        tree_index=tidx,
+        resources=list(tidx.resources),
+        flavors=list(tidx.flavors),
+    )
+
+    # Per-CQ policy arrays.
+    flavor_at = np.zeros((n, max(f, 1)), dtype=np.int32)
+    n_flavors = np.zeros(n, dtype=np.int32)
+    covered = np.zeros((n, r), dtype=bool)
+    borrow_try_next = np.zeros(n, dtype=bool)
+    preempt_try_next = np.zeros(n, dtype=bool)
+    pref_pob = np.zeros(n, dtype=bool)
+    cpwb = np.zeros(n, dtype=bool)
+    never_preempts = np.zeros(n, dtype=bool)
+    can_always_reclaim = np.zeros(n, dtype=bool)
+
+    single_rg_cq: Dict[str, bool] = {}
+    for name, cqs in snapshot.cluster_queues.items():
+        ni = tidx.node_of[name]
+        spec = cqs.spec
+        single_rg_cq[name] = len(spec.resource_groups) == 1
+        if not spec.resource_groups:
+            continue
+        rg = spec.resource_groups[0]
+        flist = [fq.name for fq in rg.flavors if fq.name in tidx.flavor_of]
+        n_flavors[ni] = len(flist)
+        for k, fname in enumerate(flist):
+            flavor_at[ni, k] = tidx.flavor_of[fname]
+        for res in rg.covered_resources:
+            if res in tidx.resource_of:
+                covered[ni, tidx.resource_of[res]] = True
+        fung = spec.flavor_fungibility
+        borrow_try_next[ni] = (
+            fung.when_can_borrow == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+        )
+        preempt_try_next[ni] = (
+            fung.when_can_preempt == FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+        )
+        pref_pob[ni] = (
+            fung.preference
+            == FlavorFungibilityPreference.PREEMPTION_OVER_BORROWING
+        )
+        from kueue_tpu.api.constants import (
+            BorrowWithinCohortPolicy,
+            PreemptionPolicy,
+        )
+
+        p = spec.preemption
+        cpwb[ni] = (
+            p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER
+        ) or (
+            fair_sharing
+            and p.reclaim_within_cohort != PreemptionPolicy.NEVER
+        )
+        never_preempts[ni] = (
+            p.within_cluster_queue == PreemptionPolicy.NEVER
+            and p.reclaim_within_cohort == PreemptionPolicy.NEVER
+        )
+        can_always_reclaim[ni] = (
+            p.reclaim_within_cohort == PreemptionPolicy.ANY
+        )
+
+    # Workload arrays.
+    device_wls: List[WorkloadInfo] = []
+    for info in heads:
+        if _device_compatible(info, snapshot, single_rg_cq):
+            device_wls.append(info)
+        else:
+            idx.host_fallback.append(info)
+
+    w = _round_up(len(device_wls), 8) if w_pad == 0 else w_pad
+    w_cq = np.zeros(w, dtype=np.int32)
+    w_req = np.zeros((w, r), dtype=np.int64)
+    w_elig = np.zeros((w, f), dtype=bool)
+    w_active = np.zeros(w, dtype=bool)
+    w_priority = np.zeros(w, dtype=np.int64)
+    w_timestamp = np.zeros(w, dtype=np.float64)
+    w_qr = np.zeros(w, dtype=bool)
+    w_start = np.zeros(w, dtype=np.int32)
+
+    from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
+
+    for i, info in enumerate(device_wls):
+        idx.workloads.append(info)
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        ni = tidx.node_of[info.cluster_queue]
+        w_cq[i] = ni
+        w_active[i] = True
+        w_priority[i] = info.priority()
+        w_timestamp[i] = queue_order_timestamp(info.obj)
+        w_qr[i] = has_quota_reservation(info.obj)
+        ps = info.total_requests[0]
+        for res, v in ps.requests.items():
+            if res in tidx.resource_of:
+                w_req[i, tidx.resource_of[res]] = v
+        # Taints/affinity eligibility per flavor (host-side; reuses the exact
+        # assigner's check).
+        assigner = FlavorAssigner(info, cqs, resource_flavors)
+        pod_sets = [info.obj.pod_sets[0]]
+        for fname, fi in tidx.flavor_of.items():
+            ok, _ = assigner._check_flavor_for_podsets(fname, pod_sets)
+            w_elig[i, fi] = ok
+        if info.last_assignment is not None and (
+            cqs.allocatable_generation
+            <= info.last_assignment.cluster_queue_generation
+        ):
+            res0 = idx.resources[0] if idx.resources else ""
+            w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
+
+    arrays = CycleArrays(
+        tree=tree,
+        usage=usage_full,
+        flavor_at=jnp.asarray(flavor_at),
+        n_flavors=jnp.asarray(n_flavors),
+        covered=jnp.asarray(covered),
+        when_can_borrow_try_next=jnp.asarray(borrow_try_next),
+        when_can_preempt_try_next=jnp.asarray(preempt_try_next),
+        pref_preempt_over_borrow=jnp.asarray(pref_pob),
+        can_preempt_while_borrowing=jnp.asarray(cpwb),
+        never_preempts=jnp.asarray(never_preempts),
+        can_always_reclaim=jnp.asarray(can_always_reclaim),
+        nominal_cq=tree.nominal,
+        w_cq=jnp.asarray(w_cq),
+        w_req=jnp.asarray(w_req),
+        w_elig=jnp.asarray(w_elig),
+        w_active=jnp.asarray(w_active),
+        w_priority=jnp.asarray(w_priority),
+        w_timestamp=jnp.asarray(w_timestamp),
+        w_quota_reserved=jnp.asarray(w_qr),
+        w_start_flavor=jnp.asarray(w_start),
+    )
+    return arrays, idx
+
+
+def _device_compatible(
+    info: WorkloadInfo, snapshot: Snapshot, single_rg_cq: Dict[str, bool]
+) -> bool:
+    if info.cluster_queue not in snapshot.cluster_queues:
+        return False
+    if not single_rg_cq.get(info.cluster_queue, False):
+        return False
+    if len(info.total_requests) != 1:
+        return False
+    ps = info.obj.pod_sets[0]
+    if ps.min_count is not None and ps.min_count < ps.count:
+        return False  # partial admission -> host path
+    if ps.topology_request is not None:
+        return False  # TAS -> host path (device TAS kernel comes separately)
+    cqs = snapshot.cluster_queues[info.cluster_queue]
+    rg = cqs.spec.resource_groups[0]
+    return all(
+        res in rg.covered_resources
+        for res, v in info.total_requests[0].requests.items()
+        if v > 0
+    )

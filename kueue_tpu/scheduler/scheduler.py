@@ -219,6 +219,10 @@ class Scheduler:
                 assignment, targets = self._get_assignments(info, snapshot)
                 e.assignment = assignment
                 e.preemption_targets = targets
+                # Carry fungibility resume state on the Info so a requeued
+                # workload retries from NextFlavorToTry (reference
+                # recordAssignment).
+                info.last_assignment = assignment.last_state
                 entries.append(e)
         return entries, inadmissible
 

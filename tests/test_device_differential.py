@@ -1,0 +1,163 @@
+"""Differential tests: batched device cycle vs host-exact scheduler.
+
+Random no-preemption scenarios (cohort forests, borrow/lend limits, flavor
+fungibility configs, taints/affinity, priorities); the DeviceScheduler must
+produce the same admitted set and identical flavor assignments as the
+host-exact Scheduler."""
+
+import random
+from typing import Dict, List, Tuple
+
+import pytest
+
+from kueue_tpu.api.constants import (
+    FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
+    QueueingStrategy,
+)
+from kueue_tpu.api.types import (
+    Cohort,
+    FlavorFungibility,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    quota,
+)
+from kueue_tpu.models.driver import DeviceScheduler
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+RESOURCES = ["cpu", "memory"]
+
+
+def random_scenario(seed: int):
+    rng = random.Random(seed)
+    n_flavors = rng.randint(1, 3)
+    flavor_specs = []
+    for i in range(n_flavors):
+        tainted = rng.random() < 0.3
+        flavor_specs.append(
+            ResourceFlavor(
+                name=f"f{i}",
+                node_labels={"tier": f"t{i}"},
+                node_taints=[Taint(key=f"taint{i}", value="true")]
+                if tainted
+                else [],
+            )
+        )
+
+    n_cohorts = rng.randint(0, 2)
+    cohorts = [Cohort(name=f"co{i}") for i in range(n_cohorts)]
+    if n_cohorts == 2 and rng.random() < 0.5:
+        cohorts[1].parent = "co0"
+
+    cqs = []
+    n_cqs = rng.randint(1, 4)
+    for i in range(n_cqs):
+        flavors: Dict[str, Dict[str, ResourceQuota]] = {}
+        for fs in rng.sample(flavor_specs, rng.randint(1, n_flavors)):
+            cells = {}
+            for res in RESOURCES:
+                nominal = rng.randrange(0, 8) * 1000
+                bl = rng.choice([None, rng.randrange(0, 5) * 1000])
+                ll = rng.choice([None, rng.randrange(0, 5) * 1000])
+                cells[res] = ResourceQuota(nominal, bl, ll)
+            flavors[fs.name] = cells
+        fung = FlavorFungibility(
+            when_can_borrow=rng.choice(
+                [FlavorFungibilityPolicy.BORROW,
+                 FlavorFungibilityPolicy.TRY_NEXT_FLAVOR]
+            ),
+            when_can_preempt=rng.choice(
+                [FlavorFungibilityPolicy.PREEMPT,
+                 FlavorFungibilityPolicy.TRY_NEXT_FLAVOR]
+            ),
+            preference=rng.choice(
+                [None,
+                 FlavorFungibilityPreference.BORROWING_OVER_PREEMPTION,
+                 FlavorFungibilityPreference.PREEMPTION_OVER_BORROWING]
+            ),
+        )
+        cohort = rng.choice([None] + [c.name for c in cohorts]) if cohorts \
+            else None
+        cqs.append(
+            make_cq(
+                f"cq{i}",
+                cohort=cohort,
+                flavors=flavors,
+                resources=RESOURCES,
+                strategy=rng.choice(
+                    [QueueingStrategy.BEST_EFFORT_FIFO,
+                     QueueingStrategy.STRICT_FIFO]
+                ),
+                fungibility=fung,
+            )
+        )
+
+    workloads = []
+    for i in range(rng.randint(3, 14)):
+        cq = rng.choice(cqs)
+        reqs = {}
+        for res in rng.sample(RESOURCES, rng.randint(1, 2)):
+            reqs[res] = rng.randrange(1, 6) * 500
+        wl = make_wl(
+            f"wl{i}",
+            queue=f"lq-{cq.name}",
+            requests=reqs,
+            priority=rng.randrange(0, 3) * 100,
+            creation_time=float(i + 1),
+        )
+        if rng.random() < 0.3:
+            # Tolerate every taint so tainted flavors become eligible.
+            wl.pod_sets[0].tolerations = [
+                Toleration(key=f"taint{j}", operator="Exists")
+                for j in range(n_flavors)
+            ]
+        workloads.append(wl)
+    return flavor_specs, cohorts, cqs, workloads
+
+
+def run_host(seed: int) -> Tuple[Dict[str, str], List[str]]:
+    flavor_specs, cohorts, cqs, workloads = random_scenario(seed)
+    cache, queues, sched = build_env(cqs, cohorts=cohorts, flavors=flavor_specs)
+    submit(queues, *workloads)
+    sched.schedule_all()
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    return admissions, sorted(admissions)
+
+
+def run_device(seed: int) -> Tuple[Dict[str, str], List[str]]:
+    flavor_specs, cohorts, cqs, workloads = random_scenario(seed)
+    cache, queues, _ = build_env(cqs, cohorts=cohorts, flavors=flavor_specs)
+    dsched = DeviceScheduler(cache, queues)
+    submit(queues, *workloads)
+    dsched.schedule_all()
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    return admissions, sorted(admissions)
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_device_matches_host(seed):
+    host_adm, host_names = run_host(seed)
+    dev_adm, dev_names = run_device(seed)
+    assert dev_names == host_names, (
+        f"admitted sets differ: host={host_names} device={dev_names}"
+    )
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name], (
+            f"flavor assignment differs for {name}: "
+            f"host={host_adm[name]} device={dev_adm[name]}"
+        )
