@@ -1,0 +1,304 @@
+"""Benchmark: admission throughput on the reference's baseline scenario.
+
+Mirrors test/performance/scheduler/configs/baseline/generator.yaml from the
+reference (kubernetes-sigs/kueue): 5 cohorts x 6 ClusterQueues, nominal 20
+cpu + borrowingLimit 100 per CQ, reclaimWithinCohort=Any +
+withinClusterQueue=LowerPriority, and per CQ 350 small (req 1, prio 50),
+100 medium (req 5, prio 100), 50 large (req 20, prio 200) workloads with
+200/500/1000 ms runtimes.
+
+Differences from the reference harness, by design: all workloads are
+submitted upfront and execution is simulated on a virtual clock (completion
+is instantaneous when the scheduler is otherwise stuck), so the measured
+wall time is pure scheduling compute — the framework's sustainable
+admission throughput. The reference's derived number on this config is
+~42.7 admissions/s (BASELINE.md); vs_baseline = ours / 42.7.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_scenario(scale: float):
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.cache.cache import Cache
+    from kueue_tpu.queue.manager import QueueManager
+
+    cache = Cache()
+    queues = QueueManager()
+    cache.add_or_update_resource_flavor(ResourceFlavor(name="default"))
+
+    classes = [
+        ("small", int(350 * scale), 1000, 50, 0.2),
+        ("medium", int(100 * scale), 5000, 100, 0.5),
+        ("large", int(50 * scale), 20000, 200, 1.0),
+    ]
+
+    workloads = []
+    t = 0.0
+    for ci in range(5):
+        cache.add_or_update_cohort(Cohort(name=f"cohort-{ci}"))
+        for qi in range(6):
+            cq_name = f"cq-{ci}-{qi}"
+            cq = ClusterQueue(
+                name=cq_name,
+                cohort=f"cohort-{ci}",
+                resource_groups=[
+                    ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[
+                            FlavorQuotas(
+                                name="default",
+                                resources={
+                                    "cpu": ResourceQuota(
+                                        nominal=20_000,
+                                        borrowing_limit=100_000,
+                                    )
+                                },
+                            )
+                        ],
+                    )
+                ],
+                preemption=ClusterQueuePreemption(
+                    reclaim_within_cohort=PreemptionPolicy.ANY,
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                ),
+            )
+            cache.add_or_update_cluster_queue(cq)
+            queues.add_cluster_queue(cq)
+            lq = LocalQueue(name=f"lq-{cq_name}", cluster_queue=cq_name)
+            cache.add_or_update_local_queue(lq)
+            queues.add_local_queue(lq)
+            for cls_name, count, req, prio, runtime_s in classes:
+                for i in range(count):
+                    t += 1.0
+                    workloads.append(
+                        (
+                            Workload(
+                                name=f"{cq_name}-{cls_name}-{i}",
+                                queue_name=f"lq-{cq_name}",
+                                pod_sets=[
+                                    PodSet(
+                                        name="main", count=1,
+                                        requests={"cpu": req},
+                                    )
+                                ],
+                                priority=prio,
+                                creation_time=t,
+                            ),
+                            runtime_s,
+                        )
+                    )
+    return cache, queues, workloads
+
+
+def run(kind: str, scale: float) -> dict:
+    from kueue_tpu.core.workload_info import is_evicted
+
+    cache, queues, workloads = build_scenario(scale)
+    if kind == "device":
+        from kueue_tpu.models.driver import DeviceScheduler
+
+        sched = DeviceScheduler(cache, queues)
+    else:
+        from kueue_tpu.scheduler.scheduler import Scheduler
+
+        sched = Scheduler(cache, queues)
+
+    runtime_of = {}
+    for wl, runtime_s in workloads:
+        assert queues.add_or_update_workload(wl)
+        runtime_of[wl.key] = runtime_s
+
+    n_total = len(workloads)
+    vclock = 0.0
+    completions = []  # (completes_at, key)
+    running = {}
+    finished = 0
+    cycles = 0
+    t_start = time.monotonic()
+
+    while finished < n_total:
+        result = sched.schedule()
+        cycles += 1
+        for key in result.admitted:
+            heapq.heappush(completions, (vclock + runtime_of[key], key))
+            running[key] = True
+        for key in result.preempted:
+            running.pop(key, None)
+
+        if not result.admitted and not result.preempted:
+            # Scheduler stuck: advance virtual time to the next completion.
+            while completions and completions[0][1] not in running:
+                heapq.heappop(completions)  # evicted; stale entry
+            if not completions:
+                if not result.head_keys:
+                    log(f"DEADLOCK: finished={finished}/{n_total}")
+                    break
+                # heads exist but nothing runs/admits: keep cycling guard
+                log(f"stall: finished={finished}/{n_total}")
+                break
+            vclock, key = heapq.heappop(completions)
+            batch = [key]
+            while completions and completions[0][0] <= vclock:
+                _, k2 = heapq.heappop(completions)
+                if k2 in running:
+                    batch.append(k2)
+            for k in batch:
+                if k in running:
+                    del running[k]
+                    info = cache.workloads.get(k)
+                    cache.delete_workload(k)
+                    finished += 1
+            queues.queue_inadmissible_workloads()
+        else:
+            # Opportunistically complete anything already due.
+            while completions and completions[0][0] <= vclock:
+                _, k = heapq.heappop(completions)
+                if k in running:
+                    del running[k]
+                    cache.delete_workload(k)
+                    finished += 1
+                    queues.queue_inadmissible_workloads()
+
+    wall = time.monotonic() - t_start
+    return {
+        "n": n_total,
+        "finished": finished,
+        "wall_s": wall,
+        "cycles": cycles,
+        "throughput": finished / wall if wall > 0 else 0.0,
+        "device_time_s": getattr(sched, "device_time_s", 0.0),
+    }
+
+
+def device_mega_cycle_probe():
+    """Secondary metric (stderr): one batched scheduling cycle at the
+    north-star scale — 50k pending workloads x 2000 CQs (50 cohorts) x 32
+    flavors — as a single compiled program on the attached accelerator."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.models.encode import CycleArrays
+    from kueue_tpu.ops.quota_ops import QuotaTreeArrays, compute_subtree
+    from kueue_tpu.ops.tree_encode import GroupLayout
+
+    W, C, F, R, CO = 50_000, 2000, 32, 2, 50
+    rng = np.random.default_rng(0)
+    N = C + CO
+    parent = np.full(N, -1, np.int32)
+    depth = np.zeros(N, np.int32)
+    height = np.zeros(N, np.int32)
+    for i in range(CO, N):
+        parent[i] = rng.integers(0, CO)
+        depth[i] = 1
+    height[:CO] = 1
+    is_cq = np.zeros(N, bool)
+    is_cq[CO:] = True
+    nominal = np.zeros((N, F, R), np.int64)
+    nominal[CO:] = rng.integers(0, 50, (C, F, R)) * 1000
+    CAPV = 1 << 62
+    tree = QuotaTreeArrays(
+        parent=jnp.asarray(parent), active=jnp.ones(N, bool),
+        depth=jnp.asarray(depth), height=jnp.asarray(height),
+        nominal=jnp.asarray(nominal),
+        borrow_limit=jnp.full((N, F, R), CAPV, jnp.int64),
+        has_borrow_limit=jnp.zeros((N, F, R), bool),
+        lend_limit=jnp.full((N, F, R), CAPV, jnp.int64),
+        has_lend_limit=jnp.zeros((N, F, R), bool),
+        subtree_quota=jnp.zeros((N, F, R), jnp.int64),
+    )
+    usage0 = jnp.zeros((N, F, R), jnp.int64)
+    subtree, usage = compute_subtree(tree, usage0, jnp.asarray(is_cq))
+    tree = tree._replace(subtree_quota=subtree)
+    arrays = CycleArrays(
+        tree=tree, usage=usage,
+        flavor_at=jnp.asarray(np.tile(np.arange(F, dtype=np.int32), (N, 1))),
+        n_flavors=jnp.full(N, F, jnp.int32),
+        covered=jnp.ones((N, R), bool),
+        when_can_borrow_try_next=jnp.zeros(N, bool),
+        when_can_preempt_try_next=jnp.ones(N, bool),
+        pref_preempt_over_borrow=jnp.zeros(N, bool),
+        can_preempt_while_borrowing=jnp.zeros(N, bool),
+        never_preempts=jnp.ones(N, bool),
+        can_always_reclaim=jnp.zeros(N, bool),
+        nominal_cq=tree.nominal,
+        w_cq=jnp.asarray(rng.integers(CO, N, W).astype(np.int32)),
+        w_req=jnp.asarray(rng.integers(1, 20, (W, R)) * 500),
+        w_elig=jnp.asarray(rng.random((W, F)) < 0.9),
+        w_active=jnp.ones(W, bool),
+        w_priority=jnp.asarray(rng.integers(0, 3, W) * 100),
+        w_timestamp=jnp.asarray(np.arange(W, dtype=np.float64)),
+        w_quota_reserved=jnp.zeros(W, bool),
+        w_start_flavor=jnp.zeros(W, np.int32),
+    )
+    layout = GroupLayout(parent, np.ones(N, bool))
+    ga = bs.GroupArrays(*layout.as_jax())
+    fn = jax.jit(bs.make_grouped_cycle(2 * W // layout.n_groups))
+    out = fn(arrays, ga)
+    out.outcome.block_until_ready()  # compile
+    t0 = time.monotonic()
+    out = fn(arrays, ga)
+    out.outcome.block_until_ready()
+    dt = time.monotonic() - t0
+    admitted = int((np.asarray(out.outcome) == 4).sum())
+    log(
+        f"device mega-cycle (50k wl x 2000 CQ x 32 flavors, "
+        f"{jax.devices()[0].platform}): {dt*1000:.0f} ms, "
+        f"{admitted} admitted, equivalent {admitted/dt:.0f} admissions/s"
+    )
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kind", default="host", choices=["device", "host"])
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="fraction of the 15k baseline workload count")
+    ap.add_argument("--skip-mega", action="store_true")
+    args = ap.parse_args()
+
+    stats = run(args.kind, args.scale)
+    log(f"stats: {stats}")
+    if not args.skip_mega:
+        try:
+            device_mega_cycle_probe()
+        except Exception as exc:  # pragma: no cover
+            log(f"device mega-cycle probe failed: {exc}")
+    baseline_throughput = 42.7  # BASELINE.md derived admissions/s
+    value = round(stats["throughput"], 2)
+    print(json.dumps({
+        "metric": "baseline_admission_throughput",
+        "value": value,
+        "unit": "workloads/s",
+        "vs_baseline": round(value / baseline_throughput, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

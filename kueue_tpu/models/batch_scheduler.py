@@ -236,15 +236,20 @@ def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
     to the end."""
     w = arrays.w_cq.shape[0]
     borrows = jnp.where(nom.best_pmode > P_NOFIT, nom.best_borrow, 0)
-    keys = (
-        jnp.arange(w, dtype=jnp.int32),  # final tiebreak: submission index
+    # Least-significant key first; each pass is a stable argsort applied on
+    # top of the previous permutation (equivalent to lexsort, but compiles
+    # to simple single-key sorts). Submission-index tiebreak is implicit in
+    # stability.
+    perm = jnp.arange(w, dtype=jnp.int32)
+    for key in (
         arrays.w_timestamp,
         -arrays.w_priority,
         borrows.astype(jnp.int64),
         (~arrays.w_quota_reserved).astype(jnp.int32),
         (~arrays.w_active).astype(jnp.int32),
-    )
-    return jnp.lexsort(keys).astype(jnp.int32)
+    ):
+        perm = perm[jnp.argsort(key[perm], stable=True)]
+    return perm.astype(jnp.int32)
 
 
 def admit_scan(
@@ -254,10 +259,24 @@ def admit_scan(
     """Sequential admission in sorted order (the order-dependent core of
     processEntry, scheduler.go:385): each FIT entry re-checks the fit
     against running usage, then consumes capacity; NO_CANDIDATES entries
-    reserve clipped capacity (scheduler.go:513)."""
+    reserve clipped capacity (scheduler.go:513).
+
+    Per-step work is restricted to the entry's MAX_DEPTH ancestor chain —
+    gather [D+1,F,R] rows, walk, one scatter back — so a step touches
+    ~D*F*R elements, not the whole [N,F,R] state. All usage-independent
+    quantities (local quota, subtree quota, limits, chains) are hoisted out
+    of the scan."""
     tree = arrays.tree
     f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
     f_onehot = jnp.arange(f_n)
+
+    # Hoisted invariants (usage-independent).
+    lq_all = quota_ops.local_quota(tree)  # [N,F,R]
+    parent = jnp.where(tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent)
+    chain_cols = [jnp.arange(tree.n_nodes)]
+    for _ in range(MAX_DEPTH):
+        chain_cols.append(parent[chain_cols[-1]])
+    chain_table = jnp.stack(chain_cols, axis=1)  # [N, D+1]
 
     def body(usage, w):
         c = arrays.w_cq[w]
@@ -273,25 +292,43 @@ def admit_scan(
             jnp.int64
         )
 
-        avail = _avail_at_node(tree, usage, c)
+        chain = chain_table[c]  # [D+1]
+        u = usage[chain]  # [D+1,F,R]
+        lq = lq_all[chain]
+        subtree = tree.subtree_quota[chain]
+        bl = tree.borrow_limit[chain]
+        has_bl = tree.has_borrow_limit[chain]
+        # chain[i] == chain[i+1] marks padding repeats past the root.
+        nxt = jnp.concatenate([chain[1:], chain[-1:]])
+        is_repeat = chain == nxt
+
+        l_avail = jnp.maximum(0, sat_sub(lq, u))
+        stored = sat_sub(subtree, lq)
+        used_in_parent = jnp.maximum(0, sat_sub(u, lq))
+        with_max = sat_add(sat_sub(stored, used_in_parent), bl)
+
+        # available() down the chain, root first (resource_node.go:106).
+        avail = sat_sub(subtree[MAX_DEPTH], u[MAX_DEPTH])
+        for i in range(MAX_DEPTH - 1, -1, -1):
+            clamped = jnp.where(has_bl[i], jnp.minimum(with_max[i], avail),
+                                avail)
+            stepped = sat_add(l_avail[i], clamped)
+            avail = jnp.where(is_repeat[i], avail, stepped)
+
         fits = jnp.all((delta <= avail) | ~cell_mask)
         deferred = nom.needs_host[w]  # host path decides; don't touch usage
         admit = active & (pm == P_FIT) & fits & ~deferred
-        usage_admit = quota_ops.add_usage(tree, usage, c, delta)
 
         # reserveCapacityForUnreclaimablePreempt for NO_CANDIDATES entries.
-        nominal = tree.nominal[c]
-        node_usage = usage[c]
-        bl = tree.borrow_limit[c]
-        has_bl = tree.has_borrow_limit[c]
         borrowing = nom.best_borrow[w] > 0
+        nominal_c = tree.nominal[c]
         reserve_borrowing = jnp.where(
-            has_bl,
-            jnp.minimum(delta, sat_sub(sat_add(nominal, bl), node_usage)),
+            has_bl[0],
+            jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl[0]), u[0])),
             delta,
         )
         reserve_plain = jnp.maximum(
-            0, jnp.minimum(delta, sat_sub(nominal, node_usage))
+            0, jnp.minimum(delta, sat_sub(nominal_c, u[0]))
         )
         reserve = jnp.where(borrowing, reserve_borrowing, reserve_plain)
         reserve = jnp.where(cell_mask, reserve, 0)
@@ -301,22 +338,29 @@ def admit_scan(
             & ~arrays.can_always_reclaim[c]
             & ~deferred
         )
-        usage_reserve = quota_ops.add_usage(tree, usage, c, reserve)
 
-        new_usage = jnp.where(
-            admit, usage_admit, jnp.where(do_reserve, usage_reserve, usage)
-        )
+        applied = jnp.where(admit, delta, jnp.where(do_reserve, reserve, 0))
+        # addUsage bubbling along the chain (resource_node.go:144): each
+        # level receives the part of the previous level's delta exceeding
+        # its (pre-update) local availability; repeats past root get zero.
+        deltas = jnp.zeros((MAX_DEPTH + 1, f_n, r_n), dtype=jnp.int64)
+        cur = applied
+        for i in range(MAX_DEPTH + 1):
+            deltas = deltas.at[i].set(cur)
+            cont = ~is_repeat[i] if i < MAX_DEPTH else jnp.bool_(False)
+            cur = jnp.where(cont, jnp.maximum(0, sat_sub(cur, l_avail[i])), 0)
+        new_usage = quota_ops.sat(usage.at[chain].add(deltas, mode="drop"))
         return new_usage, admit
 
-    final_usage, admitted_in_order = jax.lax.scan(body, usage, order)
+    final_usage, admitted_in_order = jax.lax.scan(body, usage, order,
+                                                  unroll=4)
     admitted = jnp.zeros(arrays.w_cq.shape[0], dtype=bool)
     admitted = admitted.at[order].set(admitted_in_order)
     return final_usage, admitted
 
 
-@functools.partial(jax.jit, static_argnames=())
-def cycle(arrays: CycleArrays) -> CycleOutputs:
-    """One full batched scheduling cycle, jitted end to end."""
+def cycle_impl(arrays: CycleArrays) -> CycleOutputs:
+    """One full batched scheduling cycle (unjitted; see ``cycle``)."""
     usage = arrays.usage
     nom = nominate(arrays, usage)
     order = admission_order(arrays, nom)
@@ -351,3 +395,218 @@ def cycle(arrays: CycleArrays) -> CycleOutputs:
         usage=final_usage,
         order=order,
     )
+
+
+# Jitted entry point: one compiled XLA program per (W, N, F, R) shape bucket.
+cycle = jax.jit(cycle_impl)
+
+
+class GroupArrays(NamedTuple):
+    """Device-side forest layout (see ops.tree_encode.GroupLayout)."""
+
+    flat_to_group: jnp.ndarray  # i32[N]
+    flat_to_local: jnp.ndarray  # i32[N]
+    node_sel: jnp.ndarray  # i32[G,Nm] flat node per slot
+    local_valid: jnp.ndarray  # bool[G,Nm]
+    chain_local: jnp.ndarray  # i32[G,Nm,D+1] local-id ancestor chains
+
+
+def admit_scan_grouped(
+    arrays: CycleArrays,
+    ga: GroupArrays,
+    nom: NominateResult,
+    usage: jnp.ndarray,
+    order: jnp.ndarray,
+    s_max: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forest-parallel admission scan.
+
+    Cohort trees share no quota cells, so sequential consistency is only
+    required *within* a tree. Entries are bucketed per tree (group) in
+    global admission order; the scan runs over per-group slots with the body
+    vectorized across all G groups — scan length max-entries-per-group
+    instead of W. Entries beyond ``s_max`` slots in one group are left
+    undecided this cycle (requeued; exactness needs s_max >= max bucket).
+    """
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    g_n, nm = ga.node_sel.shape
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    f_onehot = jnp.arange(f_n)
+    g_iota = jnp.arange(g_n)
+
+    # Grouped static tensors [G,Nm,F,R] (usage-independent, hoisted).
+    def to_g(x, pad):
+        y = x[ga.node_sel]
+        return jnp.where(ga.local_valid[..., None, None], y, pad)
+
+    lq_g = to_g(quota_ops.local_quota(tree), 0)
+    subtree_g = to_g(tree.subtree_quota, 0)
+    bl_g = to_g(tree.borrow_limit, CAP)
+    has_bl_g = to_g(tree.has_borrow_limit, False)
+    nominal_g = to_g(tree.nominal, 0)
+    usage_g = to_g(usage, 0)
+
+    # Entries bucketed by (group, admission rank) with one stable argsort.
+    rank = jnp.zeros(w_n, dtype=jnp.int64).at[order].set(
+        jnp.arange(w_n, dtype=jnp.int64)
+    )
+    g_w = ga.flat_to_group[arrays.w_cq].astype(jnp.int64)
+    sort_key = jnp.where(
+        arrays.w_active, g_w * w_n + rank, jnp.int64(w_n) * w_n + w_n
+    )
+    grouped_order = jnp.argsort(sort_key).astype(jnp.int32)
+    counts = jnp.zeros(g_n, dtype=jnp.int32).at[
+        ga.flat_to_group[arrays.w_cq]
+    ].add(arrays.w_active.astype(jnp.int32), mode="drop")
+    starts = jnp.cumsum(counts) - counts  # exclusive
+
+    # chain repeats mark root padding (local chain mirrors flat semantics).
+    chain_next = jnp.concatenate(
+        [ga.chain_local[..., 1:], ga.chain_local[..., -1:]], axis=-1
+    )
+    chain_is_repeat = ga.chain_local == chain_next  # [G,Nm,D+1]
+
+    def body(usage_g, s):
+        pos = starts + s
+        in_range = s < counts
+        w = grouped_order[jnp.clip(pos, 0, w_n - 1)]  # [G]
+        c = arrays.w_cq[w]
+        valid = in_range & arrays.w_active[w]
+        f = nom.chosen_flavor[w]
+        pm = nom.best_pmode[w]
+        c_local = ga.flat_to_local[c]
+        chain = ga.chain_local[g_iota, c_local]  # [G,D+1]
+        is_repeat = chain_is_repeat[g_iota, c_local]  # [G,D+1]
+
+        req = arrays.w_req[w]  # [G,R]
+        cell_mask = (
+            (f_onehot[None, :, None] == f[:, None, None])
+            & (req[:, None, :] > 0)
+            & arrays.covered[c][:, None, :]
+        )  # [G,F,R]
+        delta = jnp.where(cell_mask, req[:, None, :], 0).astype(jnp.int64)
+
+        gi = g_iota[:, None]
+        u = usage_g[gi, chain]  # [G,D+1,F,R]
+        lq = lq_g[gi, chain]
+        subtree = subtree_g[gi, chain]
+        bl = bl_g[gi, chain]
+        has_bl = has_bl_g[gi, chain]
+
+        l_avail = jnp.maximum(0, sat_sub(lq, u))
+        stored = sat_sub(subtree, lq)
+        used_in_parent = jnp.maximum(0, sat_sub(u, lq))
+        with_max = sat_add(sat_sub(stored, used_in_parent), bl)
+
+        avail = sat_sub(subtree[:, MAX_DEPTH], u[:, MAX_DEPTH])  # [G,F,R]
+        for i in range(MAX_DEPTH - 1, -1, -1):
+            clamped = jnp.where(
+                has_bl[:, i], jnp.minimum(with_max[:, i], avail), avail
+            )
+            stepped = sat_add(l_avail[:, i], clamped)
+            avail = jnp.where(is_repeat[:, i, None, None], avail, stepped)
+
+        fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))  # [G]
+        deferred = nom.needs_host[w]
+        admit = valid & (pm == P_FIT) & fits & ~deferred
+
+        borrowing = nom.best_borrow[w] > 0
+        nom_c = nominal_g[gi, c_local[:, None]][:, 0]  # [G,F,R]
+        reserve_borrowing = jnp.where(
+            has_bl[:, 0],
+            jnp.minimum(delta, sat_sub(sat_add(nom_c, bl[:, 0]), u[:, 0])),
+            delta,
+        )
+        reserve_plain = jnp.maximum(
+            0, jnp.minimum(delta, sat_sub(nom_c, u[:, 0]))
+        )
+        reserve = jnp.where(
+            borrowing[:, None, None], reserve_borrowing, reserve_plain
+        )
+        reserve = jnp.where(cell_mask, reserve, 0)
+        do_reserve = (
+            valid
+            & (pm == P_NO_CANDIDATES)
+            & ~arrays.can_always_reclaim[c]
+            & ~deferred
+        )
+
+        applied = jnp.where(
+            admit[:, None, None],
+            delta,
+            jnp.where(do_reserve[:, None, None], reserve, 0),
+        )
+        deltas = jnp.zeros((g_n, MAX_DEPTH + 1, f_n, r_n), dtype=jnp.int64)
+        cur = applied
+        for i in range(MAX_DEPTH + 1):
+            deltas = deltas.at[:, i].set(cur)
+            cont = (~is_repeat[:, i, None, None]) if i < MAX_DEPTH else False
+            cur = jnp.where(
+                cont, jnp.maximum(0, sat_sub(cur, l_avail[:, i])), 0
+            )
+        new_usage_g = quota_ops.sat(
+            usage_g.at[gi, chain].add(deltas, mode="drop")
+        )
+        w_out = jnp.where(admit, w, w_n)  # w_n = dropped by scatter
+        return new_usage_g, (w_out, admit)
+
+    final_usage_g, (w_mat, admit_mat) = jax.lax.scan(
+        body, usage_g, jnp.arange(s_max), unroll=2
+    )
+    admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
+        admit_mat.ravel(), mode="drop"
+    )[:w_n]
+    # Back to flat node layout.
+    final_usage = final_usage_g[ga.flat_to_group, ga.flat_to_local]
+    final_usage = jnp.where(
+        tree.active[:, None, None], final_usage, usage
+    )
+    return final_usage, admitted
+
+
+def make_grouped_cycle(s_max: int = 0):
+    """Build a jittable grouped cycle; s_max=0 means exact (W slots)."""
+
+    def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage)
+        order = admission_order(arrays, nom)
+        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+        final_usage, admitted = admit_scan_grouped(
+            arrays, ga, nom, usage, order, s
+        )
+        outcome = jnp.where(
+            ~arrays.w_active,
+            OUT_NOFIT,
+            jnp.where(
+                nom.needs_host,
+                OUT_NEEDS_HOST,
+                jnp.where(
+                    admitted,
+                    OUT_ADMITTED,
+                    jnp.where(
+                        nom.best_pmode == P_FIT,
+                        OUT_FIT_SKIPPED,
+                        jnp.where(
+                            nom.best_pmode == P_NO_CANDIDATES,
+                            OUT_NO_CANDIDATES,
+                            OUT_NOFIT,
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        return CycleOutputs(
+            outcome=outcome,
+            chosen_flavor=nom.chosen_flavor,
+            borrow=nom.best_borrow,
+            tried_flavor_idx=nom.tried_flavor_idx,
+            usage=final_usage,
+            order=order,
+        )
+
+    return impl
+
+
+cycle_grouped = jax.jit(make_grouped_cycle())

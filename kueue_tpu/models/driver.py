@@ -67,8 +67,13 @@ class DeviceScheduler:
             return result
 
         snapshot = self.cache.snapshot()
+        # Pad the workload axis to a power-of-two bucket so every cycle hits
+        # the same compiled program (avoids per-shape recompilation).
+        bucket = 16
+        while bucket < len(heads):
+            bucket *= 2
         arrays, idx = encode_cycle(
-            snapshot, heads, snapshot.resource_flavors,
+            snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
             fair_sharing=self.fair_sharing,
         )
 
@@ -76,7 +81,7 @@ class DeviceScheduler:
 
         if idx.workloads:
             t0 = self.clock()
-            out = batch_scheduler.cycle(arrays)
+            out = batch_scheduler.cycle_grouped(arrays, idx.group_arrays)
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)

@@ -27,7 +27,7 @@ from kueue_tpu.cache.snapshot import Snapshot
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.core.workload_info import WorkloadInfo, has_quota_reservation
 from kueue_tpu.ops.quota_ops import QuotaTreeArrays
-from kueue_tpu.ops.tree_encode import TreeIndex, encode_tree
+from kueue_tpu.ops.tree_encode import GroupLayout, TreeIndex, encode_tree
 from kueue_tpu.core.workload_info import queue_order_timestamp
 
 
@@ -68,6 +68,7 @@ class CycleIndex:
     host_fallback: List[WorkloadInfo] = field(default_factory=list)
     resources: List[str] = field(default_factory=list)
     flavors: List[str] = field(default_factory=list)
+    group_arrays: object = None  # batch_scheduler.GroupArrays
 
 
 def _round_up(n: int, m: int) -> int:
@@ -201,6 +202,11 @@ def encode_cycle(
         ):
             res0 = idx.resources[0] if idx.resources else ""
             w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
+
+    layout = GroupLayout(np.asarray(tree.parent), np.asarray(tree.active))
+    from kueue_tpu.models.batch_scheduler import GroupArrays
+
+    idx.group_arrays = GroupArrays(*layout.as_jax())
 
     arrays = CycleArrays(
         tree=tree,

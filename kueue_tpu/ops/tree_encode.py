@@ -121,3 +121,64 @@ def encode_tree(
         subtree_quota=jnp.zeros((n, f, r), dtype=jnp.int64),
     )
     return tree, idx, jnp.asarray(usage), jnp.asarray(is_cq)
+
+
+class GroupLayout:
+    """Forest grouping: nodes re-indexed as [group, local] where a group is
+    one root's tree. Cohort trees share no quota, so the admission scan can
+    process one entry per group simultaneously — scan length drops from W to
+    max-entries-per-group. Built host-side from the flat arrays (static per
+    spec change)."""
+
+    def __init__(self, parent: np.ndarray, active: np.ndarray) -> None:
+        n = parent.shape[0]
+        root_of = np.arange(n)
+        # Resolve roots by pointer-jumping (depth bounded by MAX_DEPTH).
+        for _ in range(MAX_DEPTH + 1):
+            has_parent = parent[root_of] >= 0
+            root_of = np.where(has_parent, parent[root_of], root_of)
+        roots = sorted(set(root_of[active].tolist())) if active.any() else [0]
+        g_of_root = {r: g for g, r in enumerate(roots)}
+        self.n_groups = max(len(roots), 1)
+        self.flat_to_group = np.zeros(n, dtype=np.int32)
+        self.flat_to_local = np.zeros(n, dtype=np.int32)
+        counts = np.zeros(self.n_groups, dtype=np.int64)
+        for i in range(n):
+            if not active[i]:
+                continue
+            g = g_of_root[root_of[i]]
+            self.flat_to_group[i] = g
+            self.flat_to_local[i] = counts[g]
+            counts[g] += 1
+        self.n_local = max(int(counts.max()) if len(counts) else 1, 1)
+        # node_sel[g, l] = flat node index (or 0, masked by local_valid).
+        self.node_sel = np.zeros((self.n_groups, self.n_local), dtype=np.int32)
+        self.local_valid = np.zeros((self.n_groups, self.n_local), dtype=bool)
+        for i in range(n):
+            if active[i]:
+                g, l = self.flat_to_group[i], self.flat_to_local[i]
+                self.node_sel[g, l] = i
+                self.local_valid[g, l] = True
+        # Local-id ancestor chains [G, Nm, D+1], padded by repeating the
+        # local root (mirrors ops.quota_ops.ancestor_chain semantics).
+        self.chain_local = np.zeros(
+            (self.n_groups, self.n_local, MAX_DEPTH + 1), dtype=np.int32
+        )
+        for i in range(n):
+            if not active[i]:
+                continue
+            g, l = self.flat_to_group[i], self.flat_to_local[i]
+            cur = i
+            for d in range(MAX_DEPTH + 1):
+                self.chain_local[g, l, d] = self.flat_to_local[cur]
+                if parent[cur] >= 0:
+                    cur = parent[cur]
+
+    def as_jax(self):
+        return (
+            jnp.asarray(self.flat_to_group),
+            jnp.asarray(self.flat_to_local),
+            jnp.asarray(self.node_sel),
+            jnp.asarray(self.local_valid),
+            jnp.asarray(self.chain_local),
+        )

@@ -1,0 +1,81 @@
+"""Multi-chip execution of the batched scheduling cycle.
+
+The solver is data-parallel over the workload axis: per-workload nomination
+(the FLOP-heavy part — W x F x R fit/borrow tensors) shards across devices
+over a 1-D ``('w',)`` mesh, while the quota tree and policy arrays are
+replicated. XLA inserts the collectives (an all-gather before the global
+admission sort/scan, which is sequential by semantics and tiny by volume).
+
+On multi-host TPU fleets the same program spans hosts via jax.distributed;
+the mesh axis simply grows. No NCCL-analog hand-plumbing: ICI/DCN routing is
+XLA's job (the reference's MultiKueue-style cross-cluster dispatch remains a
+control-plane concern, kueue_tpu/controllers/multikueue.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kueue_tpu.models import batch_scheduler
+from kueue_tpu.models.encode import CycleArrays
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("w",))
+
+
+def cycle_shardings(mesh: Mesh):
+    """(in_shardings, out_shardings) for batch_scheduler.cycle_impl: workload
+    axis sharded, tree/policy replicated, outputs replicated."""
+    rep = NamedSharding(mesh, P())
+    wsh = NamedSharding(mesh, P("w"))
+    tree_sh = jax.tree_util.tree_map(lambda _: rep, _tree_proto())
+    in_sh = CycleArrays(
+        tree=tree_sh,
+        usage=rep,
+        flavor_at=rep,
+        n_flavors=rep,
+        covered=rep,
+        when_can_borrow_try_next=rep,
+        when_can_preempt_try_next=rep,
+        pref_preempt_over_borrow=rep,
+        can_preempt_while_borrowing=rep,
+        never_preempts=rep,
+        can_always_reclaim=rep,
+        nominal_cq=rep,
+        w_cq=wsh,
+        w_req=wsh,
+        w_elig=wsh,
+        w_active=wsh,
+        w_priority=wsh,
+        w_timestamp=wsh,
+        w_quota_reserved=wsh,
+        w_start_flavor=wsh,
+    )
+    out_sh = batch_scheduler.CycleOutputs(
+        outcome=rep, chosen_flavor=rep, borrow=rep, tried_flavor_idx=rep,
+        usage=rep, order=rep,
+    )
+    return in_sh, out_sh
+
+
+def _tree_proto():
+    from kueue_tpu.ops.quota_ops import QuotaTreeArrays
+
+    return QuotaTreeArrays(*([0] * len(QuotaTreeArrays._fields)))
+
+
+def sharded_cycle(mesh: Mesh):
+    """Compile the cycle for the mesh. Workload axis length must divide the
+    mesh size (the encoder pads to a multiple of 8)."""
+    in_sh, out_sh = cycle_shardings(mesh)
+    return jax.jit(
+        batch_scheduler.cycle_impl, in_shardings=(in_sh,), out_shardings=out_sh
+    )
