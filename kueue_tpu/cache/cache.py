@@ -29,6 +29,7 @@ from kueue_tpu.cache.snapshot import (
 )
 from kueue_tpu.cache.resource_node import update_tree
 from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.tas.snapshot import Node, TASFlavorSnapshot
 
 
 class Cache:
@@ -42,6 +43,10 @@ class Cache:
         self.admission_checks: Dict[str, AdmissionCheck] = {}
         self.topologies: Dict[str, Topology] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
+        self.nodes: Dict[str, Node] = {}
+        # Usage by pods outside kueue's management, per (flavor, leaf
+        # domain) (reference tas_non_tas_pod_cache.go).
+        self.non_tas_usage: Dict[str, Dict[str, Dict[str, int]]] = {}
         # Admitted (or assumed) workloads, keyed by "ns/name".
         self.workloads: Dict[str, WorkloadInfo] = {}
         self.assumed: Set[str] = set()
@@ -90,6 +95,16 @@ class Cache:
     def add_or_update_local_queue(self, lq: LocalQueue) -> None:
         with self._lock:
             self.local_queues[lq.key] = lq
+
+    def add_or_update_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+            self.generation += 1
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+            self.generation += 1
 
     # -- workload lifecycle -------------------------------------------------
 
@@ -163,6 +178,21 @@ class Cache:
             for name, node in nodes.items():
                 if not node.is_cq:
                     snap.cohorts[name] = node
+            # Per-flavor topology snapshots (reference tas_flavor.go).
+            for name, rf in self.resource_flavors.items():
+                if rf.topology_name and rf.topology_name in self.topologies:
+                    snap.tas_flavors[name] = TASFlavorSnapshot(
+                        self.topologies[rf.topology_name],
+                        self.nodes.values(),
+                        usage={
+                            k: dict(v)
+                            for k, v in self.non_tas_usage.get(
+                                name, {}
+                            ).items()
+                        },
+                        flavor_taints=rf.node_taints,
+                        flavor_tolerations=rf.tolerations,
+                    )
             for info in self.workloads.values():
                 if info.cluster_queue in snap.cluster_queues:
                     snap.add_workload(info.clone())

@@ -111,6 +111,8 @@ class Snapshot:
         self.resource_flavors: Dict[str, ResourceFlavor] = {}
         self.roots: List[QuotaNode] = []
         self.inactive_cluster_queues: Set[str] = set()
+        # flavor name -> TASFlavorSnapshot (reference tas_flavor_snapshot.go)
+        self.tas_flavors: Dict[str, object] = {}
 
     def cluster_queue(self, name: str) -> ClusterQueueSnapshot:
         return self.cluster_queues[name]
@@ -119,11 +121,21 @@ class Snapshot:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads[info.key] = info
         cq.add_usage(info.usage())
+        for flavor, leaf_usage in info.tas_usage().items():
+            tas = self.tas_flavors.get(flavor)
+            if tas is not None:
+                for leaf_id, reqs in leaf_usage.items():
+                    tas.add_usage(leaf_id, reqs)
 
     def remove_workload(self, info: WorkloadInfo) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads.pop(info.key, None)
         cq.remove_usage(info.usage())
+        for flavor, leaf_usage in info.tas_usage().items():
+            tas = self.tas_flavors.get(flavor)
+            if tas is not None:
+                for leaf_id, reqs in leaf_usage.items():
+                    tas.remove_usage(leaf_id, reqs)
 
     def simulate_workload_removal(
         self, infos: Iterable[WorkloadInfo]

@@ -104,6 +104,31 @@ class WorkloadInfo:
             )
         return out
 
+    def tas_usage(self):
+        """Topology usage: flavor -> leaf domain id -> per-resource totals,
+        derived from the admission's TopologyAssignments (reference
+        workload usage.go TAS part)."""
+        out: Dict[str, Dict[str, Dict[str, int]]] = {}
+        adm = self.obj.status.admission
+        if adm is None:
+            return out
+        for i, psa in enumerate(adm.pod_set_assignments):
+            ta = psa.topology_assignment
+            if ta is None or i >= len(self.obj.pod_sets):
+                continue
+            per_pod = self.obj.pod_sets[i].requests
+            # The TAS flavor for this podset: any assigned flavor works
+            # since one flavor serves the whole podset on the TAS path.
+            flavors = set(psa.flavors.values())
+            for flavor in flavors:
+                dst_f = out.setdefault(flavor, {})
+                for values, count in ta.domains:
+                    leaf_id = "/".join(values)
+                    dst = dst_f.setdefault(leaf_id, {})
+                    for res, v in per_pod.items():
+                        dst[res] = dst.get(res, 0) + v * count
+        return out
+
     def sync_assignment_from_admission(self) -> None:
         """Populate total_requests flavors/counts from status.admission (used
         when re-building caches from persisted state)."""

@@ -138,6 +138,8 @@ class PodSetAssignmentResult:
     count: int = 0
     status_reasons: List[str] = field(default_factory=list)
     no_fit_reason: str = ""
+    topology_assignment: object = None  # api.types.TopologyAssignment
+    delayed_topology_request: bool = False
 
     def representative_mode(self) -> Mode:
         if not self.flavors:
@@ -187,12 +189,14 @@ class FlavorAssigner:
         resource_flavors: Dict[str, ResourceFlavor],
         oracle: Optional[PreemptionOracleFn] = None,
         enable_fair_sharing: bool = False,
+        tas_flavors: Optional[Dict[str, object]] = None,
     ) -> None:
         self.wl = wl
         self.cq = cq
         self.resource_flavors = resource_flavors
         self.oracle = oracle
         self.enable_fair_sharing = enable_fair_sharing
+        self.tas_flavors = tas_flavors or {}
 
     # -- public entry -------------------------------------------------------
 
@@ -281,7 +285,75 @@ class FlavorAssigner:
             if failed:
                 return assignment
 
+        # TAS hook (reference flavorassigner.go:796-835): try the topology
+        # placement for Fit assignments; downgrade to Preempt on failure;
+        # for Preempt assignments verify feasibility on an empty cluster,
+        # else NoFit.
+        if self.tas_flavors and assignment.representative_mode() == Mode.FIT:
+            if not self.update_for_tas(assignment, simulate_empty=False,
+                                       attach=True):
+                for psa in assignment.pod_sets:
+                    for fa in psa.flavors.values():
+                        if fa.mode == Mode.FIT:
+                            fa.mode = Mode.PREEMPT
+        if self.tas_flavors and assignment.representative_mode() == Mode.PREEMPT:
+            if not self.update_for_tas(assignment, simulate_empty=True,
+                                       attach=False):
+                for psa in assignment.pod_sets:
+                    for fa in psa.flavors.values():
+                        fa.mode = Mode.NO_FIT
         return assignment
+
+    def update_for_tas(
+        self, assignment: "Assignment", simulate_empty: bool,
+        attach: bool,
+    ) -> bool:
+        """Find topology placements for every TAS podset of the
+        assignment. Accumulates assumed usage across podsets so sibling
+        podsets of one workload don't double-book domains. Returns False if
+        any TAS podset has no placement."""
+        from kueue_tpu.tas.snapshot import PlacementRequest
+
+        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for i, psa in enumerate(assignment.pod_sets):
+            if i >= len(self.wl.obj.pod_sets):
+                continue
+            ps = self.wl.obj.pod_sets[i]
+            tr = ps.topology_request
+            if tr is None or not psa.flavors:
+                continue
+            flavor_name = next(iter(psa.flavors.values())).name
+            tas = self.tas_flavors.get(flavor_name)
+            if tas is None:
+                return False
+            req = PlacementRequest(
+                count=psa.count,
+                single_pod_requests=dict(ps.requests),
+                required_level=tr.required_level,
+                preferred_level=tr.preferred_level,
+                unconstrained=tr.unconstrained,
+                slice_size=tr.slice_size or 1,
+                slice_required_level=tr.slice_required_level,
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            )
+            ta, _leader_ta, reason = tas.find_topology_assignment(
+                req, simulate_empty=simulate_empty,
+                assumed_usage=assumed.get(flavor_name),
+            )
+            if reason:
+                psa.status_reasons.append(reason)
+                return False
+            if attach:
+                psa.topology_assignment = ta
+            # Track assumed usage for subsequent podsets.
+            dst_f = assumed.setdefault(flavor_name, {})
+            for values, count in ta.domains:
+                leaf_id = "/".join(values)
+                dst = dst_f.setdefault(leaf_id, {})
+                for res, v in ps.requests.items():
+                    dst[res] = dst.get(res, 0) + v * count
+        return True
 
     def _append(
         self,
@@ -391,6 +463,14 @@ class FlavorAssigner:
             return False, f"flavor {flavor_name} not found"
         label_keys = set(flavor.node_labels)
         for ps in pod_sets:
+            # checkPodSetAndFlavorMatchForTAS (reference
+            # tas_flavorassigner.go): a podset explicitly requesting TAS
+            # needs a flavor with a topology.
+            if ps.topology_request is not None and not flavor.topology_name:
+                return False, (
+                    f"flavor {flavor_name} does not support "
+                    "TopologyAwareScheduling"
+                )
             for taint in flavor.node_taints:
                 if taint.effect not in ("NoSchedule", "NoExecute"):
                     continue

@@ -246,13 +246,28 @@ class Scheduler:
         assigner = FlavorAssigner(
             info, cq, snapshot.resource_flavors, oracle=oracle,
             enable_fair_sharing=self.fair_sharing,
+            tas_flavors=snapshot.tas_flavors,
         )
         full = assigner.assign()
         mode = full.representative_mode()
+
+        def tas_fits() -> bool:
+            # TAS feasibility probe used by the preemptor's workloadFits
+            # (reference preemption.go:637): placements must exist under the
+            # snapshot's current (simulated) topology usage.
+            return assigner.update_for_tas(full, simulate_empty=False,
+                                           attach=False)
+
+        has_tas = any(
+            ps.topology_request is not None for ps in info.obj.pod_sets
+        )
         if mode == Mode.FIT:
             return full, []
         if mode == Mode.PREEMPT:
-            targets = self.preemptor.get_targets(info, full, snapshot)
+            targets = self.preemptor.get_targets(
+                info, full, snapshot,
+                tas_fits=tas_fits if has_tas else None,
+            )
             if targets:
                 return full, targets
 
@@ -473,8 +488,28 @@ class Scheduler:
                 )
             return
 
+        # TAS recompute: placements were chosen against cycle-start usage;
+        # earlier entries may have taken the domains
+        # (reference scheduler.go:409-414 updateAssignmentIfNeeded).
+        if mode == Mode.FIT and self._has_tas_podsets(e):
+            assigner = FlavorAssigner(
+                e.info, cq, snapshot.resource_flavors,
+                tas_flavors=snapshot.tas_flavors,
+            )
+            if not assigner.update_for_tas(
+                e.assignment, simulate_empty=False, attach=True
+            ):
+                e.status = EntryStatus.SKIPPED
+                e.inadmissible_msg = (
+                    "Topology placement no longer feasible after processing"
+                    " another workload"
+                )
+                e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA
+                return
+
         preempted_workloads.insert(e.preemption_targets)
         cq.add_usage(usage)
+        self._add_tas_usage(e, snapshot)
 
         if mode == Mode.PREEMPT:
             e.status = EntryStatus.PREEMPTING
@@ -488,6 +523,32 @@ class Scheduler:
         e.status = EntryStatus.NOMINATED
         self._admit(e, cq)
         result_status = e.status  # ASSUMED on success
+
+    def _has_tas_podsets(self, e: Entry) -> bool:
+        return any(
+            ps.topology_request is not None for ps in e.info.obj.pod_sets
+        )
+
+    def _add_tas_usage(self, e: Entry, snapshot: Snapshot) -> None:
+        """Reserve the chosen topology domains in the snapshot so later
+        entries in this cycle see them taken."""
+        assert e.assignment is not None
+        for i, psa in enumerate(e.assignment.pod_sets):
+            ta = psa.topology_assignment
+            if ta is None or i >= len(e.info.obj.pod_sets):
+                continue
+            ps_spec = e.info.obj.pod_sets[i]
+            flavor = next(iter(psa.flavors.values())).name if psa.flavors \
+                else None
+            tas = snapshot.tas_flavors.get(flavor)
+            if tas is None:
+                continue
+            for values, count in ta.domains:
+                leaf_id = "/".join(values)
+                tas.add_usage(
+                    leaf_id,
+                    {r: v * count for r, v in ps_spec.requests.items()},
+                )
 
     def _fits(
         self,
@@ -543,6 +604,7 @@ class Scheduler:
                     flavors={r: fa.name for r, fa in psa.flavors.items()},
                     resource_usage=dict(psa.requests),
                     count=psa.count,
+                    topology_assignment=psa.topology_assignment,
                 )
                 for psa in e.assignment.pod_sets
             ],

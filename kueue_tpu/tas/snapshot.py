@@ -1,0 +1,613 @@
+"""Topology-Aware Scheduling: the gang-placement engine.
+
+Behavioral surface: reference pkg/cache/scheduler/tas_flavor_snapshot.go —
+the per-flavor topology tree (datacenter levels -> domains -> leaf nodes),
+phase-1 capacity fill (per-leaf free capacity -> per-domain pod/slice
+counts, bottom-up), phase-2a best-fit level search, phase-2b greedy descent
+minimizing domains per level, and phase-3 assignment building.
+
+For a TPU fleet the topology levels map onto interconnect domains (e.g.
+("pod", "superpod", "host")): a required "superpod" constraint keeps a
+model-parallel gang inside one ICI domain; slice constraints pin
+sequence/tensor-parallel subgroups under a level.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kueue_tpu.api.types import (
+    PodSet,
+    Taint,
+    Toleration,
+    Topology,
+    TopologyAssignment,
+    TopologyRequest,
+)
+
+INF = 1 << 30
+
+
+@dataclass
+class Node:
+    """A schedulable host (for TPU fleets: one TPU VM / host)."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    capacity: Dict[str, int] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = True
+
+
+class Domain:
+    """reference tas_flavor_snapshot.go:54."""
+
+    __slots__ = (
+        "id", "level_values", "parent", "children",
+        "state", "state_with_leader", "slice_state",
+        "slice_state_with_leader", "leader_state",
+        "free_capacity",
+    )
+
+    def __init__(self, level_values: Tuple[str, ...]):
+        self.id = "/".join(level_values)
+        self.level_values = level_values
+        self.parent: Optional["Domain"] = None
+        self.children: List["Domain"] = []
+        self.state = 0
+        self.state_with_leader = 0
+        self.slice_state = 0
+        self.slice_state_with_leader = 0
+        self.leader_state = 0
+        self.free_capacity: Dict[str, int] = {}
+
+
+def count_fits(requests: Dict[str, int], capacity: Dict[str, int]) -> int:
+    """How many pods with ``requests`` fit in ``capacity``
+    (reference resources.Requests.CountIn). A "pods" capacity on the node
+    bounds the count even when not requested (the reference's OnePodRequest
+    per pod)."""
+    fits = INF
+    for res, v in requests.items():
+        if v <= 0:
+            continue
+        fits = min(fits, capacity.get(res, 0) // v)
+    if "pods" in capacity and "pods" not in requests:
+        fits = min(fits, capacity["pods"])
+    return 0 if fits >= INF else max(0, fits)
+
+
+@dataclass
+class PlacementRequest:
+    """One podset's topology placement request."""
+
+    count: int
+    single_pod_requests: Dict[str, int]
+    required_level: Optional[str] = None
+    preferred_level: Optional[str] = None
+    unconstrained: bool = False
+    slice_size: int = 1
+    slice_required_level: Optional[str] = None
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    leader_requests: Optional[Dict[str, int]] = None  # LWS leader pod
+
+
+class TASFlavorSnapshot:
+    """Per-flavor topology tree with free capacities
+    (reference tas_flavor_snapshot.go)."""
+
+    def __init__(
+        self,
+        topology: Topology,
+        nodes: Iterable[Node],
+        usage: Optional[Dict[str, Dict[str, int]]] = None,
+        flavor_taints: Sequence[Taint] = (),
+        flavor_tolerations: Sequence[Toleration] = (),
+    ) -> None:
+        self.topology = topology
+        self.level_keys = list(topology.levels)
+        self.lowest_is_node = (
+            bool(self.level_keys)
+            and self.level_keys[-1] == "kubernetes.io/hostname"
+        )
+        self.flavor_taints = list(flavor_taints)
+        self.flavor_tolerations = list(flavor_tolerations)
+        # usage: leaf domain id -> resource -> used amount (from admitted
+        # TAS workloads + non-TAS pods; reference tas_cache.go).
+        self.usage = usage or {}
+
+        self.domains: Dict[str, Domain] = {}
+        self.leaves: List[Domain] = []
+        self.roots: List[Domain] = []
+        self._leaf_alias: Dict[str, str] = {}  # hostname -> full leaf id
+        self.domains_per_level: List[List[Domain]] = [
+            [] for _ in self.level_keys
+        ]
+        self.nodes_by_leaf: Dict[str, List[Node]] = {}
+        for node in nodes:
+            if not node.ready:
+                continue
+            values = []
+            ok = True
+            for key in self.level_keys:
+                if key == "kubernetes.io/hostname":
+                    values.append(node.name)
+                elif key in node.labels:
+                    values.append(node.labels[key])
+                else:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            leaf = self._ensure_domain(tuple(values))
+            self.nodes_by_leaf.setdefault(leaf.id, []).append(node)
+            if self.lowest_is_node:
+                self._leaf_alias[values[-1]] = leaf.id
+
+    def _ensure_domain(self, values: Tuple[str, ...]) -> Domain:
+        did = "/".join(values)
+        if did in self.domains:
+            return self.domains[did]
+        dom = Domain(values)
+        self.domains[did] = dom
+        level_idx = len(values) - 1
+        self.domains_per_level[level_idx].append(dom)
+        if level_idx == len(self.level_keys) - 1:
+            self.leaves.append(dom)
+        if level_idx == 0:
+            self.roots.append(dom)
+        else:
+            parent = self._ensure_domain(values[:-1])
+            dom.parent = parent
+            parent.children.append(dom)
+        return dom
+
+    # -- usage bookkeeping (reference tas_cache.go) -------------------------
+
+    def _canonical_leaf_id(self, leaf_id: str) -> str:
+        """TopologyAssignments emitted with hostname-only levels (lowest
+        level is the node) reference leaves by hostname; map those back to
+        the full domain path."""
+        if leaf_id in self.domains:
+            return leaf_id
+        return self._leaf_alias.get(leaf_id, leaf_id)
+
+    def add_usage(self, leaf_id: str, requests: Dict[str, int]) -> None:
+        leaf_id = self._canonical_leaf_id(leaf_id)
+        dst = self.usage.setdefault(leaf_id, {})
+        for res, v in requests.items():
+            dst[res] = dst.get(res, 0) + v
+
+    def remove_usage(self, leaf_id: str, requests: Dict[str, int]) -> None:
+        leaf_id = self._canonical_leaf_id(leaf_id)
+        dst = self.usage.setdefault(leaf_id, {})
+        for res, v in requests.items():
+            dst[res] = dst.get(res, 0) - v
+
+    def clone_usage(self) -> Dict[str, Dict[str, int]]:
+        return {k: dict(v) for k, v in self.usage.items()}
+
+    # -- phase 1: capacity fill ---------------------------------------------
+
+    def _leaf_free_capacity(
+        self, leaf: Domain, simulate_empty: bool
+    ) -> Dict[str, int]:
+        cap: Dict[str, int] = {}
+        for node in self.nodes_by_leaf.get(leaf.id, []):
+            for res, v in node.capacity.items():
+                cap[res] = cap.get(res, 0) + v
+        if not simulate_empty:
+            for res, used in self.usage.get(leaf.id, {}).items():
+                cap[res] = cap.get(res, 0) - used
+        return cap
+
+    def _node_matches(self, node: Node, req: PlacementRequest) -> bool:
+        for k, v in req.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+        tolerations = list(req.tolerations) + self.flavor_tolerations
+        for taint in list(node.taints) + self.flavor_taints:
+            if taint.effect not in ("NoSchedule", "NoExecute"):
+                continue
+            if not any(t.tolerates(taint) for t in tolerations):
+                return False
+        return True
+
+    def _fill_in_counts(
+        self,
+        req: PlacementRequest,
+        slice_size: int,
+        slice_level_idx: int,
+        simulate_empty: bool,
+        assumed_usage: Optional[Dict[str, Dict[str, int]]],
+        required_replacement_domain: Optional[str] = None,
+    ) -> None:
+        """reference fillInCounts :1760 + fillLeafCounts :1863."""
+        for dom in self.domains.values():
+            dom.state = dom.state_with_leader = 0
+            dom.slice_state = dom.slice_state_with_leader = 0
+            dom.leader_state = 0
+        # Account for one pod slot per pod (OnePodRequest analog): model the
+        # "pods" resource only when nodes declare it.
+        requests = dict(req.single_pod_requests)
+        for leaf in self.leaves:
+            if required_replacement_domain and not leaf.id.startswith(
+                required_replacement_domain
+            ):
+                continue
+            if self.lowest_is_node:
+                nodes = [
+                    n for n in self.nodes_by_leaf.get(leaf.id, [])
+                    if self._node_matches(n, req)
+                ]
+                cap: Dict[str, int] = {}
+                for node in nodes:
+                    for res, v in node.capacity.items():
+                        cap[res] = cap.get(res, 0) + v
+                if not simulate_empty:
+                    for res, used in self.usage.get(leaf.id, {}).items():
+                        cap[res] = cap.get(res, 0) - used
+            else:
+                cap = self._leaf_free_capacity(leaf, simulate_empty)
+            if assumed_usage and leaf.id in assumed_usage:
+                for res, used in assumed_usage[leaf.id].items():
+                    cap[res] = cap.get(res, 0) - used
+            leaf.free_capacity = cap
+            leaf.state = count_fits(requests, cap)
+            leaf.leader_state = 0
+            if req.leader_requests is not None:
+                if count_fits(req.leader_requests, cap) > 0:
+                    leaf.leader_state = 1
+                    cap = {
+                        res: cap.get(res, 0) - req.leader_requests.get(res, 0)
+                        for res in set(cap) | set(req.leader_requests)
+                    }
+            leaf.state_with_leader = count_fits(requests, cap)
+
+        leader_required = req.leader_requests is not None
+        for root in self.roots:
+            self._fill_counts_helper(
+                root, slice_size, slice_level_idx, 0, leader_required
+            )
+
+    def _fill_counts_helper(
+        self, dom: Domain, slice_size: int, slice_level_idx: int, level: int,
+        leader_required: bool,
+    ) -> None:
+        """reference fillInCountsHelper :1902."""
+        if not dom.children:
+            if level == slice_level_idx:
+                dom.slice_state = dom.state // slice_size
+                dom.slice_state_with_leader = (
+                    dom.state_with_leader // slice_size
+                )
+            return
+        children_capacity = 0
+        slice_capacity = 0
+        has_leader_contributor = False
+        min_swl_diff = INF
+        min_slice_swl_diff = INF
+        leader_state = 0
+        for child in dom.children:
+            self._fill_counts_helper(
+                child, slice_size, slice_level_idx, level + 1, leader_required
+            )
+            children_capacity += child.state
+            slice_capacity += child.slice_state
+            if not leader_required or child.leader_state > 0:
+                has_leader_contributor = True
+                min_swl_diff = min(
+                    child.state - child.state_with_leader, min_swl_diff
+                )
+                min_slice_swl_diff = min(
+                    child.slice_state - child.slice_state_with_leader,
+                    min_slice_swl_diff,
+                )
+            leader_state = max(child.leader_state, leader_state)
+        dom.state = children_capacity
+        if has_leader_contributor:
+            dom.state_with_leader = children_capacity - min_swl_diff
+            slice_swl = slice_capacity - min_slice_swl_diff
+        else:
+            dom.state_with_leader = 0
+            slice_swl = 0
+        dom.leader_state = leader_state
+        if level == slice_level_idx:
+            dom.slice_state = dom.state // slice_size
+            dom.slice_state_with_leader = dom.state_with_leader // slice_size
+        elif level < slice_level_idx:
+            dom.slice_state = slice_capacity
+            dom.slice_state_with_leader = slice_swl
+
+    # -- sorting / best fit --------------------------------------------------
+
+    def _sorted_domains(self, domains: List[Domain]) -> List[Domain]:
+        """BestFit order: slice_state desc, state asc, levelValues asc
+        (reference sortedDomains :1731)."""
+        return sorted(
+            domains,
+            key=lambda d: (-d.slice_state, d.state, d.level_values),
+        )
+
+    def _sorted_domains_with_leader(self, domains: List[Domain]) -> List[Domain]:
+        return sorted(
+            domains,
+            key=lambda d: (
+                -d.leader_state, -d.slice_state_with_leader,
+                d.state_with_leader, d.level_values,
+            ),
+        )
+
+    @staticmethod
+    def _best_fit_for_slices(
+        domains: List[Domain], slice_count: int, leader_count: int
+    ) -> Domain:
+        """First domain with the lowest sufficient capacity
+        (reference findBestFitDomainBy)."""
+        get = (
+            (lambda d: d.slice_state_with_leader)
+            if leader_count > 0
+            else (lambda d: d.slice_state)
+        )
+        best = domains[0]
+        for d in domains:
+            if get(d) >= slice_count and (
+                get(d) < get(best) or get(best) < slice_count
+            ):
+                best = d
+        return best
+
+    # -- phase 2a: level search ----------------------------------------------
+
+    def _find_level_with_fit(
+        self, search_level_idx: int, req: PlacementRequest, slice_size: int,
+        required: bool, unconstrained: bool, leader_count: int,
+    ) -> Tuple[int, List[Domain], str]:
+        """reference findLevelWithFitDomains :1380 (BestFit profile)."""
+        domains = self.domains_per_level[search_level_idx]
+        if not domains:
+            return 0, [], (
+                f"no topology domains at level: "
+                f"{self.level_keys[search_level_idx]}"
+            )
+        sorted_domains = self._sorted_domains_with_leader(list(domains))
+        top = sorted_domains[0]
+        slice_count = req.count // slice_size
+        if (
+            top.slice_state_with_leader >= slice_count
+            and top.leader_state >= leader_count
+        ):
+            top = self._best_fit_for_slices(
+                sorted_domains, slice_count, leader_count
+            )
+            return search_level_idx, [top], ""
+
+        if required:
+            return 0, [], (
+                f"topology {self.level_keys[search_level_idx]} doesn't fit:"
+                f" requested {slice_count} slice(s), fits {top.slice_state}"
+            )
+        if search_level_idx > 0 and not unconstrained:
+            return self._find_level_with_fit(
+                search_level_idx - 1, req, slice_size, required,
+                unconstrained, leader_count,
+            )
+        # Top level (or unconstrained): gather multiple domains greedily.
+        results: List[Domain] = []
+        remaining = slice_count
+        remaining_leaders = leader_count
+        idx = 0
+        while (
+            remaining_leaders > 0
+            and idx < len(sorted_domains)
+            and sorted_domains[idx].leader_state > 0
+        ):
+            dom = sorted_domains[idx]
+            if sorted_domains[idx].slice_state_with_leader >= remaining:
+                dom = self._best_fit_for_slices(
+                    sorted_domains[idx:], remaining, remaining_leaders
+                )
+            results.append(dom)
+            remaining_leaders -= dom.leader_state
+            remaining -= dom.slice_state_with_leader
+            idx += 1
+        if remaining_leaders > 0:
+            return 0, [], "not enough leader capacity"
+        rest = self._sorted_domains(
+            [d for d in sorted_domains[idx:] if d not in results]
+        )
+        j = 0
+        while remaining > 0 and j < len(rest):
+            dom = rest[j]
+            if dom.slice_state >= remaining:
+                dom = self._best_fit_for_slices(rest[j:], remaining, 0)
+            results.append(dom)
+            remaining -= dom.slice_state
+            j += 1
+        if remaining > 0:
+            return 0, [], (
+                f"topology doesn't fit: requested {slice_count} slice(s),"
+                f" fits {slice_count - remaining}"
+            )
+        return search_level_idx, results, ""
+
+    # -- phase 2b: minimize counts -------------------------------------------
+
+    def _update_counts_to_minimum(
+        self, domains: List[Domain], count: int, leader_count: int,
+        slice_size: int, slices: bool,
+    ) -> List[Domain]:
+        """reference updateCountsToMinimumGeneric :1578 (BestFit)."""
+        result: List[Domain] = []
+        remaining = count // slice_size if slices else count
+        remaining_leaders = leader_count
+
+        i = 0
+        while i < len(domains):
+            dom = domains[i]
+            if remaining_leaders > 0 and dom.leader_state > 0:
+                # Consume a leader-hosting domain.
+                if slices:
+                    take = min(dom.slice_state_with_leader, remaining)
+                    dom.state = take * slice_size
+                    dom.slice_state = take
+                else:
+                    take = min(dom.state_with_leader, remaining)
+                    dom.state = take
+                dom.leader_state = min(dom.leader_state, remaining_leaders)
+                remaining_leaders -= dom.leader_state
+                remaining -= take
+                result.append(dom)
+                if remaining <= 0 and remaining_leaders <= 0:
+                    return result
+                i += 1
+                continue
+            if slices:
+                if dom.slice_state >= remaining:
+                    dom = self._best_fit_for_slices(
+                        domains[i:], remaining, 0
+                    )
+                    dom.leader_state = 0
+                    dom.state = remaining * slice_size
+                    dom.slice_state = remaining
+                    result.append(dom)
+                    return result
+                dom.leader_state = 0
+                dom.state = dom.slice_state * slice_size
+                remaining -= dom.slice_state
+                result.append(dom)
+            else:
+                if dom.state >= remaining:
+                    get = lambda d: d.state
+                    best = dom
+                    for d in domains[i:]:
+                        if get(d) >= remaining and (
+                            get(d) < get(best) or get(best) < remaining
+                        ):
+                            best = d
+                    dom = best
+                    dom.leader_state = 0
+                    dom.state = remaining
+                    result.append(dom)
+                    return result
+                dom.leader_state = 0
+                remaining -= dom.state
+                result.append(dom)
+            i += 1
+        return result if remaining <= 0 else []
+
+    # -- main entry ------------------------------------------------------------
+
+    def find_topology_assignment(
+        self,
+        req: PlacementRequest,
+        simulate_empty: bool = False,
+        assumed_usage: Optional[Dict[str, Dict[str, int]]] = None,
+        required_replacement_domain: Optional[str] = None,
+    ) -> Tuple[Optional[TopologyAssignment], Optional[TopologyAssignment], str]:
+        """Returns (worker_assignment, leader_assignment, failure_reason).
+        reference findTopologyAssignment :943."""
+        required = req.required_level is not None
+        unconstrained = req.unconstrained or (
+            req.required_level is None and req.preferred_level is None
+        )
+        level_key = req.required_level or req.preferred_level
+        if unconstrained and level_key is None:
+            level_key = self.level_keys[-1] if self.level_keys else None
+        if level_key is None or level_key not in self.level_keys:
+            return None, None, f"no requested topology level: {level_key}"
+        requested_level_idx = self.level_keys.index(level_key)
+
+        slice_size = req.slice_size or 1
+        if req.slice_required_level is not None:
+            if req.slice_required_level not in self.level_keys:
+                return None, None, (
+                    f"no requested topology level for slices:"
+                    f" {req.slice_required_level}"
+                )
+            slice_level_idx = self.level_keys.index(req.slice_required_level)
+        else:
+            slice_level_idx = len(self.level_keys) - 1
+            slice_size = 1
+        if requested_level_idx > slice_level_idx:
+            return None, None, (
+                "podset slice topology is above the podset topology"
+            )
+        if slice_size > 0 and req.count % slice_size != 0:
+            return None, None, (
+                f"pod count {req.count} not divisible by slice size"
+                f" {slice_size}"
+            )
+
+        leader_count = 1 if req.leader_requests is not None else 0
+
+        # phase 1
+        self._fill_in_counts(
+            req, slice_size, slice_level_idx, simulate_empty, assumed_usage,
+            required_replacement_domain,
+        )
+
+        # phase 2a
+        fit_level_idx, curr, reason = self._find_level_with_fit(
+            requested_level_idx, req, slice_size, required, unconstrained,
+            leader_count,
+        )
+        if reason:
+            return None, None, reason
+
+        # phase 2b: descend, minimizing domains per level.
+        curr = self._update_counts_to_minimum(
+            curr, req.count, leader_count, slice_size, True
+        )
+        level_idx = fit_level_idx
+        while level_idx < min(len(self.level_keys) - 1, slice_level_idx):
+            # Above the slice level: slices may be re-distributed freely
+            # across all lower domains (reference :1092-1099).
+            lower = self._sorted_domains(
+                [c for d in curr for c in d.children]
+            )
+            curr = self._update_counts_to_minimum(
+                lower, req.count, leader_count, slice_size, True
+            )
+            level_idx += 1
+        while level_idx < len(self.level_keys) - 1:
+            # At/below the slice level: per-parent assignment of pods.
+            new_curr: List[Domain] = []
+            for dom in curr:
+                lower = self._sorted_domains(list(dom.children))
+                taken = self._update_counts_to_minimum(
+                    lower, dom.state, dom.leader_state, 1, False
+                )
+                new_curr.extend(taken)
+            curr = new_curr
+            level_idx += 1
+
+        # phase 3
+        leader_assignment: Optional[TopologyAssignment] = None
+        if leader_count:
+            leader_domains = []
+            worker_domains = []
+            for dom in curr:
+                if dom.leader_state > 0:
+                    ld = Domain(dom.level_values)
+                    ld.state = dom.leader_state
+                    leader_domains.append(ld)
+                if dom.state > 0:
+                    worker_domains.append(dom)
+            leader_assignment = self._build_assignment(leader_domains)
+            curr = worker_domains
+        return self._build_assignment(curr), leader_assignment, ""
+
+    def _build_assignment(self, domains: List[Domain]) -> TopologyAssignment:
+        """reference buildAssignment :1663."""
+        domains = sorted(domains, key=lambda d: d.level_values)
+        level_idx = len(self.level_keys) - 1 if self.lowest_is_node else 0
+        ta = TopologyAssignment(levels=self.level_keys[level_idx:])
+        for dom in domains:
+            if dom.state == 0:
+                continue
+            ta.domains.append((dom.level_values[level_idx:], dom.state))
+        return ta

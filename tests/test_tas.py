@@ -1,0 +1,256 @@
+"""Topology-Aware Scheduling tests, mirroring the reference's
+tas_flavor_snapshot_test.go scenarios at small scale.
+
+Topology used throughout: block > rack > hostname, 2 blocks x 2 racks x
+2 nodes, each node 4 tpu chips.
+"""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    PodSet,
+    ResourceFlavor,
+    Topology,
+    TopologyRequest,
+    Workload,
+    quota,
+)
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.tas.snapshot import Node, PlacementRequest, TASFlavorSnapshot
+
+from .helpers import admission_of, admitted_names, build_env, make_cq, submit
+
+LEVELS = ["cloud.google.com/topology-block", "cloud.google.com/topology-rack",
+          "kubernetes.io/hostname"]
+
+
+def make_topology():
+    return Topology(name="tpu-topo", levels=list(LEVELS))
+
+
+def make_nodes(blocks=2, racks=2, nodes=2, tpu=4):
+    out = []
+    for b in range(blocks):
+        for r in range(racks):
+            for n in range(nodes):
+                out.append(
+                    Node(
+                        name=f"node-{b}-{r}-{n}",
+                        labels={
+                            LEVELS[0]: f"b{b}",
+                            LEVELS[1]: f"b{b}-r{r}",
+                        },
+                        capacity={"tpu": tpu},
+                    )
+                )
+    return out
+
+
+def snapshot():
+    return TASFlavorSnapshot(make_topology(), make_nodes())
+
+
+def test_required_rack_fits_single_rack():
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=2, single_pod_requests={"tpu": 4},
+                         required_level=LEVELS[1])
+    )
+    assert reason == ""
+    # 2 pods x 4 tpu = full rack (2 nodes x 4).
+    assert sum(c for _, c in ta.domains) == 2
+    racks = {v[:2] for v, _ in ta.domains}  # hostname-level values
+    assert len(ta.domains) == 2  # two nodes
+    # both nodes in same rack
+    names = [v[-1] for v, _ in ta.domains]
+    assert {n.rsplit("-", 1)[0].split("-", 1)[1][:3] for n in names} or True
+    prefixes = {n.rsplit("-", 1)[0] for n in names}
+    assert len(prefixes) == 1
+
+
+def test_required_rack_too_big_fails():
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=3, single_pod_requests={"tpu": 4},
+                         required_level=LEVELS[1])
+    )
+    assert ta is None
+    assert "doesn't fit" in reason
+
+
+def test_required_block_spans_racks():
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=4, single_pod_requests={"tpu": 4},
+                         required_level=LEVELS[0])
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 4
+    blocks = {v[0].split("-")[1][:2] for v, _ in ta.domains} or True
+    names = [v[-1] for v, _ in ta.domains]
+    assert len({n.split("-")[1] for n in names}) == 1  # one block
+
+
+def test_preferred_falls_back_up_levels():
+    """Preferred rack with a gang bigger than a rack places at block scope."""
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=3, single_pod_requests={"tpu": 4},
+                         preferred_level=LEVELS[1])
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 3
+
+
+def test_best_fit_prefers_tightest_domain():
+    """A 1-pod request on a partially used topology picks the domain with
+    least leftover capacity (BestFit)."""
+    snap = snapshot()
+    snap.add_usage("b0/b0-r0/node-0-0-0", {"tpu": 3})  # 1 tpu free
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=1, single_pod_requests={"tpu": 1},
+                         required_level=LEVELS[1])
+    )
+    assert reason == ""
+    assert ta.domains[0][0][-1] == "node-0-0-0"  # tightest node
+
+
+def test_usage_blocks_capacity():
+    snap = snapshot()
+    for b in (0, 1):
+        for r in (0, 1):
+            for n in (0, 1):
+                snap.add_usage(f"b{b}/b{b}-r{r}/node-{b}-{r}-{n}", {"tpu": 4})
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=1, single_pod_requests={"tpu": 1},
+                         required_level=LEVELS[0])
+    )
+    assert ta is None and reason
+
+
+def test_slice_constraint_packs_slices_in_racks():
+    """8 pods in slices of 2, slices pinned to racks: every slice's pods in
+    one rack."""
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(
+            count=8, single_pod_requests={"tpu": 2},
+            required_level=LEVELS[0],
+            slice_size=2, slice_required_level=LEVELS[1],
+        )
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 8
+
+
+def test_unconstrained_spreads_anywhere():
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=16, single_pod_requests={"tpu": 1},
+                         unconstrained=True)
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 16
+
+
+def test_node_selector_restricts_leaves():
+    nodes = make_nodes()
+    for n in nodes:
+        if n.name.startswith("node-1"):
+            n.labels["pool"] = "premium"
+    snap = TASFlavorSnapshot(make_topology(), nodes)
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=2, single_pod_requests={"tpu": 4},
+                         required_level=LEVELS[1],
+                         node_selector={"pool": "premium"})
+    )
+    assert reason == ""
+    assert all(v[-1].startswith("node-1") for v, _ in ta.domains)
+
+
+# ---- end-to-end through the scheduler -------------------------------------
+
+
+def tas_env():
+    flavor = ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo")
+    cache, queues, sched = build_env(
+        [make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                 resources=["tpu"])],
+        flavors=[flavor],
+    )
+    cache.add_or_update_topology(make_topology())
+    for node in make_nodes():
+        cache.add_or_update_node(node)
+    return cache, queues, sched
+
+
+def tas_wl(name, count, tpu=4, level=LEVELS[1], creation=0.0):
+    return Workload(
+        name=name,
+        queue_name="lq",
+        pod_sets=[
+            PodSet(
+                name="main", count=count, requests={"tpu": tpu},
+                topology_request=TopologyRequest(required_level=level),
+            )
+        ],
+        creation_time=creation or 1.0,
+    )
+
+
+def test_e2e_tas_admission_attaches_assignment():
+    cache, queues, sched = tas_env()
+    wl = tas_wl("gang", count=2)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["gang"]
+    adm = admission_of(cache, "gang")
+    ta = adm.pod_set_assignments[0].topology_assignment
+    assert ta is not None
+    assert sum(c for _, c in ta.domains) == 2
+    assert is_admitted(wl)
+
+
+def test_e2e_tas_gang_too_big_stays_pending():
+    cache, queues, sched = tas_env()
+    wl = tas_wl("too-big", count=3, tpu=4, level=LEVELS[1])
+    submit(queues, wl)
+    sched.schedule_all()
+    # Quota (32 tpu) fits, but no rack has 12 tpu -> pending.
+    assert admitted_names(cache) == []
+
+
+def test_e2e_tas_two_gangs_get_disjoint_racks():
+    cache, queues, sched = tas_env()
+    w1 = tas_wl("g1", count=2, creation=1.0)
+    w2 = tas_wl("g2", count=2, creation=2.0)
+    submit(queues, w1, w2)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["g1", "g2"]
+    d1 = {
+        v for v, _ in admission_of(cache, "g1")
+        .pod_set_assignments[0].topology_assignment.domains
+    }
+    d2 = {
+        v for v, _ in admission_of(cache, "g2")
+        .pod_set_assignments[0].topology_assignment.domains
+    }
+    assert not (d1 & d2), f"overlapping node assignment: {d1 & d2}"
+
+
+def test_e2e_tas_usage_released_on_delete():
+    cache, queues, sched = tas_env()
+    for i in range(4):
+        submit(queues, tas_wl(f"g{i}", count=2, creation=float(i + 1)))
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 4  # 4 gangs x 8 tpu = full fleet
+
+    late = tas_wl("late", count=2, creation=9.0)
+    submit(queues, late)
+    sched.schedule_all()
+    assert "late" not in admitted_names(cache)
+
+    cache.delete_workload("default/g0")
+    queues.queue_inadmissible_workloads()
+    sched.schedule_all()
+    assert "late" in admitted_names(cache)
