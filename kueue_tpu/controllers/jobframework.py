@@ -1,0 +1,235 @@
+"""Job integration SDK.
+
+Behavioral surface: reference pkg/controller/jobframework — the GenericJob
+interface (interface.go:37-71), the JobReconciler lifecycle
+(reconciler.go:296: ensure-one-workload, construct workload from podsets,
+start/stop with podset info injection) and the integration registry
+(integrationmanager.go).
+
+kueue_tpu is standalone (no kube-apiserver), so "reconcile" is call-driven:
+the manager invokes reconcile_job on job events (submit, finish, suspend)
+and on workload events (admitted, evicted). Job adapters translate between
+a framework's job object and the Workload admission currency.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from kueue_tpu.api.constants import (
+    COND_ADMITTED,
+    COND_FINISHED,
+)
+from kueue_tpu.api.types import (
+    Admission,
+    PodSet,
+    PodSetAssignment,
+    Workload,
+)
+from kueue_tpu.core.workload_info import (
+    get_condition,
+    is_admitted,
+    set_condition,
+)
+
+
+@dataclass
+class PodSetInfo:
+    """Scheduling attributes injected into a started job's podset
+    (reference pkg/podset PodSetInfo)."""
+
+    name: str
+    count: int
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: list = field(default_factory=list)
+    topology_domains: List[Tuple[Tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+
+
+class GenericJob(abc.ABC):
+    """reference jobframework/interface.go:37 GenericJob."""
+
+    # -- identity --
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @property
+    def namespace(self) -> str:
+        return "default"
+
+    @property
+    @abc.abstractmethod
+    def queue_name(self) -> str:
+        """Target LocalQueue."""
+
+    # -- suspension --
+    @abc.abstractmethod
+    def is_suspended(self) -> bool: ...
+
+    @abc.abstractmethod
+    def suspend(self) -> None: ...
+
+    @abc.abstractmethod
+    def run_with_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        """Unsuspend, injecting node selectors / topology domains."""
+
+    def restore_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        """Undo run_with_podsets_info customizations on stop."""
+
+    # -- shape --
+    @abc.abstractmethod
+    def pod_sets(self) -> List[PodSet]: ...
+
+    # -- completion --
+    @abc.abstractmethod
+    def finished(self) -> Tuple[bool, bool, str]:
+        """(finished, success, message)."""
+
+    def pods_ready(self) -> bool:
+        """All expected pods are running (WaitForPodsReady input)."""
+        return True
+
+    # -- optional capabilities (reference interface.go:76-228) --
+    def priority(self) -> int:
+        return 0
+
+    def priority_class(self) -> Optional[str]:
+        return None
+
+    def active(self) -> bool:
+        return True
+
+    def max_execution_time_seconds(self) -> Optional[int]:
+        return None
+
+    def reclaimable_pods(self) -> Dict[str, int]:
+        """podset name -> pods whose resources can be reclaimed early."""
+        return {}
+
+
+class IntegrationRegistry:
+    """reference integrationmanager.go: frameworks register adapters."""
+
+    def __init__(self) -> None:
+        self._integrations: Dict[str, Callable[..., GenericJob]] = {}
+        self._enabled: Dict[str, bool] = {}
+
+    def register(
+        self, framework_name: str, factory: Callable[..., GenericJob],
+        enabled: bool = True,
+    ) -> None:
+        self._integrations[framework_name] = factory
+        self._enabled[framework_name] = enabled
+
+    def enabled(self, framework_name: str) -> bool:
+        return self._enabled.get(framework_name, False)
+
+    def set_enabled(self, framework_name: str, value: bool) -> None:
+        if framework_name in self._integrations:
+            self._enabled[framework_name] = value
+
+    def factory(self, framework_name: str):
+        return self._integrations.get(framework_name)
+
+    def names(self) -> List[str]:
+        return sorted(self._integrations)
+
+
+registry = IntegrationRegistry()
+
+
+def workload_name_for(job: GenericJob) -> str:
+    return f"{type(job).__name__.lower()}-{job.name}"
+
+
+def construct_workload(job: GenericJob, now: float) -> Workload:
+    """reference reconciler.go:1424 constructWorkload."""
+    return Workload(
+        name=workload_name_for(job),
+        namespace=job.namespace,
+        queue_name=job.queue_name,
+        pod_sets=[ps for ps in job.pod_sets()],
+        priority=job.priority(),
+        priority_class=job.priority_class(),
+        active=job.active(),
+        creation_time=now,
+        maximum_execution_time_seconds=job.max_execution_time_seconds(),
+    )
+
+
+def podset_infos_from_admission(
+    wl: Workload, admission: Admission
+) -> List[PodSetInfo]:
+    """Build start-time podset infos from the admission: flavors' node
+    labels become node selectors; topology domains pin the gang
+    (reference reconciler.go startJob + podset.go Merge)."""
+    infos: List[PodSetInfo] = []
+    for i, psa in enumerate(admission.pod_set_assignments):
+        info = PodSetInfo(name=psa.name, count=psa.count)
+        if psa.topology_assignment is not None:
+            info.topology_domains = list(psa.topology_assignment.domains)
+        infos.append(info)
+    return infos
+
+
+class JobReconciler:
+    """reference reconciler.go:296 ReconcileGenericJob, call-driven.
+
+    The manager owns one instance; it keeps the job <-> workload link and
+    drives suspend/unsuspend according to workload admission state.
+    """
+
+    def __init__(self, manager) -> None:
+        self.manager = manager
+        self.job_of_workload: Dict[str, GenericJob] = {}
+        self.workload_of_job: Dict[str, str] = {}
+
+    def _job_key(self, job: GenericJob) -> str:
+        return f"{job.namespace}/{job.name}"
+
+    def reconcile(self, job: GenericJob) -> Optional[Workload]:
+        """ensureOneWorkload + lifecycle step for one job. Returns the
+        workload (created if needed)."""
+        now = self.manager.clock()
+        jkey = self._job_key(job)
+        wl_key = self.workload_of_job.get(jkey)
+        wl = self.manager.workloads.get(wl_key) if wl_key else None
+
+        if wl is None:
+            # Webhook-equivalent: jobs are created suspended
+            # (reference base_webhook.go Default).
+            if not job.is_suspended():
+                job.suspend()
+            wl = construct_workload(job, now)
+            self.manager.create_workload(wl)
+            self.workload_of_job[jkey] = wl.key
+            self.job_of_workload[wl.key] = job
+            return wl
+
+        finished, success, msg = job.finished()
+        if finished and get_condition(wl, COND_FINISHED) is None:
+            set_condition(wl, COND_FINISHED, True,
+                          "Succeeded" if success else "Failed", msg, now)
+            self.manager.finish_workload(wl)
+            return wl
+
+        if is_admitted(wl) and job.is_suspended():
+            # startJob (reference reconciler.go:1326).
+            infos = podset_infos_from_admission(wl, wl.status.admission)
+            # Flavor node labels -> node selectors.
+            for i, psa in enumerate(wl.status.admission.pod_set_assignments):
+                for flavor_name in psa.flavors.values():
+                    rf = self.manager.cache.resource_flavors.get(flavor_name)
+                    if rf is not None:
+                        infos[i].node_selector.update(rf.node_labels)
+                        infos[i].tolerations.extend(rf.tolerations)
+            job.run_with_podsets_info(infos)
+        elif not is_admitted(wl) and not job.is_suspended():
+            # stopJob (reference reconciler.go:1368): evicted/not admitted.
+            job.suspend()
+            job.restore_podsets_info([])
+        return wl

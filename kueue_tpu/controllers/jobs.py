@@ -1,0 +1,258 @@
+"""Built-in job integrations.
+
+Behavioral surface: reference pkg/controller/jobs/* — adapters implementing
+GenericJob for each workload framework. kueue_tpu ships TPU-native
+equivalents of the reference families:
+
+  BatchJob          <- batch/job            (single podset, completions)
+  TrainJob          <- kubeflow TFJob/PyTorchJob/JAXJob/TrainJob (role
+                       replicas, e.g. one podset per jax process group)
+  LeaderWorkerSet   <- leaderworkerset      (leader + workers gang)
+  PodGroup          <- pod-group integration (plain pods admitted together)
+  ServingGroup      <- Deployment/StatefulSet (long-running replicas)
+  MPIJob            <- mpijob               (launcher + workers)
+  RayCluster        <- raycluster           (head + worker groups)
+
+Adapters are plain Python state machines — "suspended" means the framework
+must not run processes; run_with_podsets_info delivers node selectors and
+topology domains (for TPU fleets: which hosts of which ICI domain to use).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.api.types import PodSet, Toleration, TopologyRequest
+from kueue_tpu.controllers.jobframework import (
+    GenericJob,
+    PodSetInfo,
+    registry,
+)
+
+
+class _BaseJob(GenericJob):
+    def __init__(
+        self,
+        name: str,
+        queue: str,
+        namespace: str = "default",
+        priority: int = 0,
+        priority_class: Optional[str] = None,
+    ) -> None:
+        self._name = name
+        self._queue = queue
+        self._namespace = namespace
+        self._priority = priority
+        self._priority_class = priority_class
+        self._suspended = True
+        self._finished = False
+        self._success = True
+        self._message = ""
+        self._pods_ready = False
+        self.started_with: List[PodSetInfo] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def namespace(self) -> str:
+        return self._namespace
+
+    @property
+    def queue_name(self) -> str:
+        return self._queue
+
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        self._suspended = True
+        self._pods_ready = False
+
+    def run_with_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        self._suspended = False
+        self.started_with = infos
+        self._pods_ready = True
+
+    def restore_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        self.started_with = []
+
+    def finished(self) -> Tuple[bool, bool, str]:
+        return self._finished, self._success, self._message
+
+    def pods_ready(self) -> bool:
+        return not self._suspended and self._pods_ready
+
+    def priority(self) -> int:
+        return self._priority
+
+    def priority_class(self) -> Optional[str]:
+        return self._priority_class
+
+    # test/ops helpers
+    def mark_finished(self, success: bool = True, message: str = "") -> None:
+        self._finished = True
+        self._success = success
+        self._message = message
+
+    def set_pods_ready(self, ready: bool) -> None:
+        self._pods_ready = ready
+
+
+class BatchJob(_BaseJob):
+    """reference pkg/controller/jobs/job."""
+
+    def __init__(self, name: str, queue: str, parallelism: int = 1,
+                 requests: Optional[Dict[str, int]] = None,
+                 min_parallelism: Optional[int] = None,
+                 topology: Optional[TopologyRequest] = None,
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.parallelism = parallelism
+        self.min_parallelism = min_parallelism
+        self.requests = requests or {"cpu": 1000}
+        self.topology = topology
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(
+                name="main",
+                count=self.parallelism,
+                requests=dict(self.requests),
+                min_count=self.min_parallelism,
+                topology_request=self.topology,
+            )
+        ]
+
+
+class TrainJob(_BaseJob):
+    """Multi-role training job (reference kubeflow jobs / trainjob): each
+    role (e.g. "trainer" process group) is one podset. For TPU training a
+    role maps onto a set of hosts driving one slice."""
+
+    def __init__(self, name: str, queue: str,
+                 roles: Dict[str, Tuple[int, Dict[str, int]]],
+                 topology: Optional[TopologyRequest] = None,
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.roles = roles
+        self.topology = topology
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(
+                name=role,
+                count=count,
+                requests=dict(reqs),
+                topology_request=self.topology,
+            )
+            for role, (count, reqs) in self.roles.items()
+        ]
+
+
+class LeaderWorkerSet(_BaseJob):
+    """reference pkg/controller/jobs/leaderworkerset: a leader podset and a
+    workers podset admitted as one gang."""
+
+    def __init__(self, name: str, queue: str, workers: int,
+                 worker_requests: Dict[str, int],
+                 leader_requests: Optional[Dict[str, int]] = None,
+                 topology: Optional[TopologyRequest] = None, **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.workers = workers
+        self.worker_requests = worker_requests
+        self.leader_requests = leader_requests or {"cpu": 100}
+        self.topology = topology
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(name="leader", count=1,
+                   requests=dict(self.leader_requests)),
+            PodSet(name="workers", count=self.workers,
+                   requests=dict(self.worker_requests),
+                   topology_request=self.topology),
+        ]
+
+
+class MPIJob(_BaseJob):
+    """reference pkg/controller/jobs/mpijob: launcher + workers."""
+
+    def __init__(self, name: str, queue: str, workers: int,
+                 worker_requests: Dict[str, int],
+                 launcher_requests: Optional[Dict[str, int]] = None,
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.workers = workers
+        self.worker_requests = worker_requests
+        self.launcher_requests = launcher_requests or {"cpu": 500}
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(name="launcher", count=1,
+                   requests=dict(self.launcher_requests)),
+            PodSet(name="worker", count=self.workers,
+                   requests=dict(self.worker_requests)),
+        ]
+
+
+class RayCluster(_BaseJob):
+    """reference pkg/controller/jobs/raycluster: head + worker groups."""
+
+    def __init__(self, name: str, queue: str,
+                 head_requests: Dict[str, int],
+                 worker_groups: Dict[str, Tuple[int, Dict[str, int]]],
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.head_requests = head_requests
+        self.worker_groups = worker_groups
+
+    def pod_sets(self) -> List[PodSet]:
+        out = [PodSet(name="head", count=1, requests=dict(self.head_requests))]
+        for g, (count, reqs) in self.worker_groups.items():
+            out.append(PodSet(name=g, count=count, requests=dict(reqs)))
+        return out
+
+
+class PodGroup(_BaseJob):
+    """reference pkg/controller/jobs/pod (pod groups): N identical pods
+    admitted all-or-nothing via scheduling gates."""
+
+    def __init__(self, name: str, queue: str, count: int,
+                 requests: Dict[str, int], **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.count = count
+        self.requests = requests
+
+    def pod_sets(self) -> List[PodSet]:
+        return [PodSet(name="pods", count=self.count,
+                       requests=dict(self.requests))]
+
+
+class ServingGroup(_BaseJob):
+    """reference pkg/controller/jobs/{deployment,statefulset}: long-running
+    replicas; scale via replace-and-resubmit (elastic slices in a later
+    phase)."""
+
+    def __init__(self, name: str, queue: str, replicas: int,
+                 requests: Dict[str, int], **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.replicas = replicas
+        self.requests = requests
+
+    def pod_sets(self) -> List[PodSet]:
+        return [PodSet(name="replicas", count=self.replicas,
+                       requests=dict(self.requests))]
+
+
+for _name, _cls in [
+    ("batch/job", BatchJob),
+    ("trainjob", TrainJob),
+    ("leaderworkerset", LeaderWorkerSet),
+    ("mpijob", MPIJob),
+    ("raycluster", RayCluster),
+    ("pod", PodGroup),
+    ("serving", ServingGroup),
+]:
+    registry.register(_name, _cls)

@@ -1,0 +1,182 @@
+"""MultiKueue: multi-cluster dispatch.
+
+Behavioral surface: reference pkg/controller/admissionchecks/multikueue +
+pkg/controller/workloaddispatcher — the manager cluster reserves quota
+locally, then mirrors the workload to nominated worker clusters (the
+incremental dispatcher nominates up to 3 new workers per round); the first
+worker to reserve quota wins, the copies on other workers are deleted, and
+the check flips Ready with the winning cluster recorded.
+
+In kueue_tpu a "worker cluster" is another Manager instance (in-process or
+remote behind the same interface) — for TPU fleets these are independent
+slices/pools, the DCN tier of the placement hierarchy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    is_evicted,
+    is_finished,
+)
+from kueue_tpu.manager import AdmissionCheckController, Manager
+
+INCREMENTAL_DISPATCHER_ROUND_SIZE = 3  # reference incrementaldispatcher.go:56
+
+
+@dataclass
+class MultiKueueConfig:
+    """reference multikueue_types.go MultiKueueConfig."""
+
+    name: str
+    clusters: List[str] = field(default_factory=list)
+    # "AllAtOnce" | "Incremental" (reference config multiKueue dispatcher).
+    dispatcher: str = "AllAtOnce"
+
+
+@dataclass
+class _GroupState:
+    nominated: List[str] = field(default_factory=list)
+    round_started_at: float = 0.0
+    winner: Optional[str] = None
+
+
+class MultiKueueController(AdmissionCheckController):
+    """reference multikueue admissioncheck.go + workload.go wlReconciler."""
+
+    controller_name = "kueue.x-k8s.io/multikueue"
+
+    def __init__(
+        self,
+        workers: Optional[Dict[str, Manager]] = None,
+        config: Optional[MultiKueueConfig] = None,
+        nomination_round_seconds: float = 300.0,
+    ) -> None:
+        self.workers: Dict[str, Manager] = workers or {}
+        self.config = config or MultiKueueConfig(name="default")
+        self.nomination_round_seconds = nomination_round_seconds
+        self.state: Dict[str, _GroupState] = {}
+
+    def add_worker(self, name: str, manager: Manager) -> None:
+        self.workers[name] = manager
+        if name not in self.config.clusters:
+            self.config.clusters.append(name)
+
+    # ------------------------------------------------------------------
+
+    def sync(self, manager: Manager, wl: Workload, check_name: str) -> None:
+        """reference workload.go:185 Reconcile / :364 reconcileGroup."""
+        now = manager.clock()
+        st = self.state.setdefault(wl.key, _GroupState())
+        acs = next(
+            (a for a in wl.status.admission_checks if a.name == check_name),
+            None,
+        )
+        if acs is None:
+            return
+
+        clusters = [c for c in self.config.clusters if c in self.workers]
+        if not clusters:
+            return
+
+        # Nominate workers (incremental: rounds of 3; reference
+        # incrementaldispatcher.go:92).
+        if self.config.dispatcher == "Incremental":
+            if not st.nominated or (
+                now - st.round_started_at > self.nomination_round_seconds
+                and st.winner is None
+            ):
+                remaining = [c for c in clusters if c not in st.nominated]
+                st.nominated.extend(
+                    remaining[:INCREMENTAL_DISPATCHER_ROUND_SIZE]
+                )
+                st.round_started_at = now
+        else:
+            st.nominated = list(clusters)
+
+        # Mirror the workload to nominated workers (readGroup/createRemote).
+        for cluster in st.nominated:
+            worker = self.workers[cluster]
+            if wl.key not in worker.workloads:
+                copy = wl.clone()
+                copy.status = type(copy.status)()  # fresh status on remote
+                try:
+                    worker.create_workload(copy)
+                except ValueError:
+                    continue
+
+        # Let the remote schedulers make progress, then look for a winner.
+        for cluster in st.nominated:
+            worker = self.workers[cluster]
+            worker.schedule()
+
+        winner = st.winner
+        if winner is None:
+            for cluster in st.nominated:
+                remote = self.workers[cluster].workloads.get(wl.key)
+                if remote is not None and has_quota_reservation(remote):
+                    winner = cluster
+                    break
+        if winner is None:
+            acs.message = (
+                f"No worker cluster reserved quota yet "
+                f"(nominated: {st.nominated})"
+            )
+            return
+
+        # First worker with QuotaReserved wins; delete the other copies
+        # (reference workload.go:364).
+        st.winner = winner
+        for cluster in st.nominated:
+            if cluster == winner:
+                continue
+            worker = self.workers[cluster]
+            remote = worker.workloads.get(wl.key)
+            if remote is not None:
+                worker.delete_workload(remote)
+        wl.status.cluster_name = winner
+        acs.state = CheckState.READY
+        acs.message = f'The workload got reservation on "{winner}"'
+        acs.last_transition_time = now
+        manager.metrics.inc(
+            "multikueue_dispatches_total", {"cluster": winner}
+        )
+
+    # ------------------------------------------------------------------
+
+    def sync_remote_status(self, manager: Manager, wl: Workload) -> None:
+        """Mirror remote completion/eviction back (reference workload.go
+        remote status sync + failurerecovery redispatch)."""
+        st = self.state.get(wl.key)
+        if st is None or st.winner is None:
+            return
+        worker = self.workers.get(st.winner)
+        if worker is None:
+            self._redispatch(manager, wl)
+            return
+        remote = worker.workloads.get(wl.key)
+        if remote is None:
+            self._redispatch(manager, wl)
+            return
+        if is_finished(remote):
+            manager.finish_workload(wl)
+        elif is_evicted(remote) and not has_quota_reservation(remote):
+            self._redispatch(manager, wl)
+
+    def _redispatch(self, manager: Manager, wl: Workload) -> None:
+        """Worker lost the workload (eviction / cluster gone): reset the
+        check and dispatch again (reference failurerecovery/)."""
+        st = self.state.setdefault(wl.key, _GroupState())
+        st.winner = None
+        st.nominated = []
+        wl.status.cluster_name = None
+        for acs in wl.status.admission_checks:
+            ac = manager.cache.admission_checks.get(acs.name)
+            if ac is not None and ac.controller_name == self.controller_name:
+                acs.state = CheckState.PENDING
+                acs.message = "Redispatching after worker loss"

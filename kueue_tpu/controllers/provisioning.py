@@ -1,0 +1,135 @@
+"""Provisioning admission check: capacity provisioning before admission.
+
+Behavioral surface: reference pkg/controller/admissionchecks/provisioning —
+per admitted-pending workload, create a ProvisioningRequest from the
+check's ProvisioningRequestConfig, mirror its Provisioned/Failed state into
+the AdmissionCheckState, and retry with backoff per the retry strategy.
+
+The cluster-autoscaler seam becomes a pluggable CapacityProvider — for TPU
+fleets: a reservation system, a GKE/TPU provisioner, or the test fake.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Protocol
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import Workload
+from kueue_tpu.manager import AdmissionCheckController, Manager
+
+
+class ProvisioningState(str, enum.Enum):
+    PENDING = "Pending"
+    PROVISIONED = "Provisioned"
+    FAILED = "Failed"
+
+
+@dataclass
+class ProvisioningRequestConfig:
+    """reference apis provisioningrequestconfig_types.go."""
+
+    name: str
+    provisioning_class: str = "queued-provisioning.gke.io"
+    parameters: Dict[str, str] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_backoff_seconds: float = 60.0
+
+
+@dataclass
+class ProvisioningRequest:
+    """The capacity request handed to the provider."""
+
+    name: str
+    workload_key: str
+    provisioning_class: str
+    parameters: Dict[str, str]
+    pod_sets: list
+    attempt: int = 1
+    state: ProvisioningState = ProvisioningState.PENDING
+    message: str = ""
+    retry_at: Optional[float] = None
+
+
+class CapacityProvider(Protocol):
+    def poll(self, request: ProvisioningRequest) -> ProvisioningState: ...
+
+
+class AlwaysProvisioned:
+    def poll(self, request: ProvisioningRequest) -> ProvisioningState:
+        return ProvisioningState.PROVISIONED
+
+
+class ProvisioningController(AdmissionCheckController):
+    """reference provisioning/controller.go:83."""
+
+    controller_name = "kueue.x-k8s.io/provisioning-request"
+
+    def __init__(
+        self,
+        provider: Optional[CapacityProvider] = None,
+        configs: Optional[Dict[str, ProvisioningRequestConfig]] = None,
+    ) -> None:
+        self.provider = provider or AlwaysProvisioned()
+        # admission-check name -> config
+        self.configs = configs or {}
+        self.requests: Dict[str, ProvisioningRequest] = {}
+
+    def config_for(self, check_name: str) -> ProvisioningRequestConfig:
+        return self.configs.get(
+            check_name, ProvisioningRequestConfig(name="default")
+        )
+
+    def sync(self, manager: Manager, wl: Workload, check_name: str) -> None:
+        now = manager.clock()
+        cfg = self.config_for(check_name)
+        key = f"{wl.key}/{check_name}"
+        req = self.requests.get(key)
+        if req is None:
+            req = ProvisioningRequest(
+                name=f"{wl.name}-{check_name}-1",
+                workload_key=wl.key,
+                provisioning_class=cfg.provisioning_class,
+                parameters=dict(cfg.parameters),
+                pod_sets=list(wl.pod_sets),
+            )
+            self.requests[key] = req
+        if req.retry_at is not None:
+            if now < req.retry_at:
+                return
+            req.retry_at = None
+            req.state = ProvisioningState.PENDING
+            req.attempt += 1
+            req.name = f"{wl.name}-{check_name}-{req.attempt}"
+
+        if req.state == ProvisioningState.PENDING:
+            req.state = self.provider.poll(req)
+
+        acs = next(
+            (a for a in wl.status.admission_checks if a.name == check_name),
+            None,
+        )
+        if acs is None:
+            return
+        if req.state == ProvisioningState.PROVISIONED:
+            acs.state = CheckState.READY
+            acs.message = f"Provisioned by request {req.name}"
+            acs.last_transition_time = now
+            manager.metrics.inc("provisioning_requests_provisioned_total")
+        elif req.state == ProvisioningState.FAILED:
+            if req.attempt >= cfg.max_retries + 1:
+                acs.state = CheckState.REJECTED
+                acs.message = (
+                    f"Provisioning failed after {req.attempt} attempts"
+                )
+                acs.last_transition_time = now
+                self.requests.pop(key, None)
+            else:
+                # Backoff then re-create the request (reference
+                # admissioncheck_reconciler.go retry path).
+                req.retry_at = now + cfg.retry_backoff_seconds * (
+                    2 ** (req.attempt - 1)
+                )
+                acs.message = f"Provisioning attempt {req.attempt} failed"
+            manager.metrics.inc("provisioning_requests_failed_total")

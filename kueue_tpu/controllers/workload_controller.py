@@ -1,0 +1,217 @@
+"""Workload lifecycle controller.
+
+Behavioral surface: reference pkg/controller/core/workload_controller.go —
+eviction on deactivation / maximumExecutionTime / PodsReady timeout with
+requeue backoff, admission-check retry/rejection handling, Admitted-state
+sync, finished-workload retention GC, and requeue into the queue manager.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.constants import (
+    COND_ADMITTED,
+    COND_EVICTED,
+    COND_PODS_READY,
+    COND_QUOTA_RESERVED,
+    COND_REQUEUED,
+    EVICTED_BY_ADMISSION_CHECK,
+    EVICTED_BY_DEACTIVATION,
+    EVICTED_BY_PODS_READY_TIMEOUT,
+    CheckState,
+    RequeueReason,
+)
+from kueue_tpu.api.types import RequeueState, Workload
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    all_checks_ready,
+    get_condition,
+    has_quota_reservation,
+    is_admitted,
+    is_evicted,
+    is_finished,
+    set_condition,
+)
+
+
+@dataclass
+class WaitForPodsReadyConfig:
+    """reference config v1beta2 configuration_types.go:304."""
+
+    enable: bool = False
+    timeout_seconds: float = 300.0
+    block_admission: bool = False
+    requeuing_backoff_base_seconds: float = 60.0
+    requeuing_backoff_limit_count: Optional[int] = None
+    requeuing_backoff_max_seconds: float = 3600.0
+
+
+@dataclass
+class RetentionConfig:
+    """reference objectRetentionPolicies (configuration_types.go:774)."""
+
+    retain_finished_seconds: Optional[float] = None  # None = keep forever
+
+
+class WorkloadController:
+    """One reconcile pass = reconcile(workload). The manager calls it on
+    events and periodically (clock-driven timeouts)."""
+
+    def __init__(
+        self,
+        manager,
+        pods_ready: Optional[WaitForPodsReadyConfig] = None,
+        retention: Optional[RetentionConfig] = None,
+    ) -> None:
+        self.manager = manager
+        self.pods_ready = pods_ready or WaitForPodsReadyConfig()
+        self.retention = retention or RetentionConfig()
+        # workload key -> admission time (for PodsReady/maxExecutionTime).
+        self.admitted_at: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, wl: Workload) -> None:
+        now = self.manager.clock()
+        key = wl.key
+
+        if is_finished(wl):
+            self._maybe_gc(wl, now)
+            return
+
+        # Deactivation (spec.active=False) evicts and deactivates
+        # (reference workload_controller.go DeactivationTarget path).
+        if not wl.active and (is_admitted(wl) or has_quota_reservation(wl)):
+            self.evict(wl, EVICTED_BY_DEACTIVATION,
+                       "The workload is deactivated", now)
+            return
+
+        # Admission-check state machine (reference :322 area):
+        if has_quota_reservation(wl) and wl.status.admission_checks:
+            states = {acs.state for acs in wl.status.admission_checks}
+            if CheckState.REJECTED in states:
+                wl.active = False
+                self.evict(
+                    wl, EVICTED_BY_ADMISSION_CHECK,
+                    "At least one admission check was rejected", now,
+                )
+                return
+            if CheckState.RETRY in states:
+                self.evict(
+                    wl, EVICTED_BY_ADMISSION_CHECK,
+                    "At least one admission check requests retry", now,
+                )
+                # Reset check states for the next attempt.
+                for acs in wl.status.admission_checks:
+                    acs.state = CheckState.PENDING
+                return
+            if all_checks_ready(wl) and not is_admitted(wl):
+                set_condition(wl, COND_ADMITTED, True, "Admitted",
+                              "The workload is admitted", now)
+
+        if is_admitted(wl):
+            self.admitted_at.setdefault(key, now)
+            # maximumExecutionTime (reference evictions by
+            # MaximumExecutionTimeExceeded).
+            met = wl.maximum_execution_time_seconds
+            if met is not None and now - self.admitted_at[key] > met:
+                wl.active = False
+                self.evict(wl, EVICTED_BY_DEACTIVATION,
+                           "Exceeded the maximum execution time", now)
+                return
+            # WaitForPodsReady timeout.
+            if self.pods_ready.enable:
+                job = self.manager.job_reconciler.job_of_workload.get(key)
+                ready = job.pods_ready() if job is not None else True
+                if ready:
+                    set_condition(wl, COND_PODS_READY, True, "PodsReady",
+                                  "All pods are ready", now)
+                elif now - self.admitted_at[key] > self.pods_ready.timeout_seconds:
+                    self._requeue_with_backoff(wl, now)
+                    self.evict(
+                        wl, EVICTED_BY_PODS_READY_TIMEOUT,
+                        f"Exceeded the PodsReady timeout {key}", now,
+                    )
+                    return
+        else:
+            self.admitted_at.pop(key, None)
+
+    # ------------------------------------------------------------------
+
+    def evict(self, wl: Workload, reason: str, message: str, now: float) -> None:
+        """pkg/workload/evict.Evict equivalent: conditions + quota release +
+        requeue."""
+        set_condition(wl, COND_EVICTED, True, reason, message, now)
+        set_condition(wl, COND_QUOTA_RESERVED, False, "Pending", message, now)
+        set_condition(wl, COND_ADMITTED, False, "NoReservation", message, now)
+        wl.status.admission = None
+        wl.status.admission_checks = []
+        self.manager.cache.delete_workload(wl.key)
+        self.admitted_at.pop(wl.key, None)
+        if wl.active:
+            info = WorkloadInfo(wl, self.manager.queues.cluster_queue_for(wl))
+            rs = wl.status.requeue_state
+            if rs is None or rs.requeue_at is None or rs.requeue_at <= now:
+                set_condition(wl, COND_REQUEUED, True, reason, message, now)
+                self.manager.queues.requeue_workload(
+                    info, RequeueReason.GENERIC
+                )
+        self.manager.queues.queue_inadmissible_workloads()
+        # The job must stop (suspend) — handled by job reconciliation.
+        job = self.manager.job_reconciler.job_of_workload.get(wl.key)
+        if job is not None:
+            self.manager.job_reconciler.reconcile(job)
+
+    def _requeue_with_backoff(self, wl: Workload, now: float) -> None:
+        """reference workload_controller.go requeuing backoff: exponential
+        per eviction count, capped; deactivate past the limit."""
+        rs = wl.status.requeue_state or RequeueState()
+        rs.count += 1
+        limit = self.pods_ready.requeuing_backoff_limit_count
+        if limit is not None and rs.count > limit:
+            wl.active = False
+            rs.requeue_at = None
+        else:
+            delay = min(
+                self.pods_ready.requeuing_backoff_base_seconds
+                * (2 ** (rs.count - 1)),
+                self.pods_ready.requeuing_backoff_max_seconds,
+            )
+            rs.requeue_at = now + delay
+        wl.status.requeue_state = rs
+
+    def requeue_ready_backoffs(self) -> int:
+        """Move workloads whose backoff expired back into the queues.
+        Returns how many were requeued."""
+        now = self.manager.clock()
+        n = 0
+        for wl in list(self.manager.workloads.values()):
+            rs = wl.status.requeue_state
+            if (
+                rs is not None
+                and rs.requeue_at is not None
+                and rs.requeue_at <= now
+                and wl.active
+                and not is_finished(wl)
+                and not has_quota_reservation(wl)
+            ):
+                rs.requeue_at = None
+                set_condition(wl, COND_REQUEUED, True, "BackoffFinished",
+                              "Requeued after backoff", now)
+                # Straight into the active heap — the backoff already served
+                # as the penalty (reference workload_controller.go requeues
+                # via an immediate queue add once RequeueAt passes).
+                if self.manager.queues.add_or_update_workload(wl):
+                    n += 1
+        return n
+
+    def _maybe_gc(self, wl: Workload, now: float) -> None:
+        keep = self.retention.retain_finished_seconds
+        if keep is None:
+            return
+        cond = get_condition(wl, "Finished")
+        if cond is not None and now - cond.last_transition_time > keep:
+            self.manager.delete_workload(wl)

@@ -1,0 +1,265 @@
+"""The controller manager: one object wiring the whole control plane.
+
+Behavioral surface: reference cmd/kueue/main.go — cache + queue wiring,
+core controllers, scheduler, admission-check controllers — reshaped as a
+call-driven facade (kueue_tpu is standalone; there is no kube-apiserver to
+watch, so "events" are method calls and `tick()` drives clock-based
+reconciliation).
+
+Typical use:
+
+    mgr = Manager()
+    mgr.apply(flavor, topology, cohort, cq, lq)
+    mgr.submit_job(my_train_job)          # or mgr.create_workload(wl)
+    mgr.schedule()                        # one scheduling cycle
+    mgr.tick()                            # timeouts, checks, backoffs
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from kueue_tpu.api.constants import COND_FINISHED, CheckState
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    Topology,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.cache.cache import Cache
+from kueue_tpu.controllers.jobframework import GenericJob, JobReconciler
+from kueue_tpu.controllers.workload_controller import (
+    RetentionConfig,
+    WaitForPodsReadyConfig,
+    WorkloadController,
+)
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    is_finished,
+    set_condition,
+)
+from kueue_tpu.queue.manager import QueueManager
+from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
+from kueue_tpu.tas.snapshot import Node
+from kueue_tpu.metrics.registry import Metrics
+
+ApplyObject = Union[
+    ClusterQueue, Cohort, LocalQueue, ResourceFlavor, Topology,
+    AdmissionCheck, Node, WorkloadPriorityClass,
+]
+
+
+class AdmissionCheckController:
+    """Plugin seam for two-phase admission (reference
+    pkg/controller/admissionchecks): the manager calls ``sync`` for every
+    workload with a pending check owned by this controller."""
+
+    controller_name = "base"
+
+    def sync(self, manager: "Manager", wl: Workload, check_name: str) -> None:
+        raise NotImplementedError
+
+
+class Manager:
+    def __init__(
+        self,
+        fair_sharing: bool = False,
+        pods_ready: Optional[WaitForPodsReadyConfig] = None,
+        retention: Optional[RetentionConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        use_device_scheduler: bool = False,
+    ) -> None:
+        self.clock = clock
+        self.cache = Cache()
+        self.queues = QueueManager()
+        self.metrics = Metrics()
+        if use_device_scheduler:
+            from kueue_tpu.models.driver import DeviceScheduler
+
+            self.scheduler = DeviceScheduler(
+                self.cache, self.queues, fair_sharing=fair_sharing
+            )
+        else:
+            self.scheduler = Scheduler(
+                self.cache, self.queues, fair_sharing=fair_sharing,
+                clock=clock,
+            )
+        self.workloads: Dict[str, Workload] = {}
+        self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
+        self.job_reconciler = JobReconciler(self)
+        self.workload_controller = WorkloadController(
+            self, pods_ready=pods_ready, retention=retention
+        )
+        self.check_controllers: Dict[str, AdmissionCheckController] = {}
+
+    # ------------------------------------------------------------------
+    # configuration objects
+    # ------------------------------------------------------------------
+
+    def apply(self, *objects: ApplyObject) -> None:
+        for obj in objects:
+            if isinstance(obj, ClusterQueue):
+                self.cache.add_or_update_cluster_queue(obj)
+                self.queues.add_cluster_queue(obj)
+            elif isinstance(obj, Cohort):
+                self.cache.add_or_update_cohort(obj)
+            elif isinstance(obj, LocalQueue):
+                self.cache.add_or_update_local_queue(obj)
+                self.queues.add_local_queue(obj)
+            elif isinstance(obj, ResourceFlavor):
+                self.cache.add_or_update_resource_flavor(obj)
+            elif isinstance(obj, Topology):
+                self.cache.add_or_update_topology(obj)
+            elif isinstance(obj, AdmissionCheck):
+                self.cache.add_or_update_admission_check(obj)
+            elif isinstance(obj, Node):
+                self.cache.add_or_update_node(obj)
+            elif isinstance(obj, WorkloadPriorityClass):
+                self.priority_classes[obj.name] = obj
+            else:
+                raise TypeError(f"unsupported object {type(obj)!r}")
+        self.queues.queue_inadmissible_workloads()
+
+    def delete(self, obj: ApplyObject) -> None:
+        if isinstance(obj, ClusterQueue):
+            self.cache.delete_cluster_queue(obj.name)
+            self.queues.delete_cluster_queue(obj.name)
+        elif isinstance(obj, Cohort):
+            self.cache.delete_cohort(obj.name)
+        elif isinstance(obj, LocalQueue):
+            self.queues.delete_local_queue(obj.key)
+        elif isinstance(obj, ResourceFlavor):
+            self.cache.delete_resource_flavor(obj.name)
+        elif isinstance(obj, Node):
+            self.cache.delete_node(obj.name)
+        self.queues.queue_inadmissible_workloads()
+
+    def register_check_controller(
+        self, ctrl: AdmissionCheckController
+    ) -> None:
+        self.check_controllers[ctrl.controller_name] = ctrl
+
+    # ------------------------------------------------------------------
+    # workload / job lifecycle
+    # ------------------------------------------------------------------
+
+    def create_workload(self, wl: Workload) -> None:
+        """Validating-webhook equivalent + queue entry
+        (reference pkg/webhooks/workload_webhook.go)."""
+        if wl.key in self.workloads:
+            raise ValueError(f"workload {wl.key} already exists")
+        if not wl.pod_sets:
+            raise ValueError("workload needs at least one podset")
+        if len(wl.pod_sets) > 18:
+            raise ValueError("workload supports at most 18 podsets")
+        if wl.creation_time == 0.0:
+            wl.creation_time = self.clock()
+        if wl.priority_class and wl.priority_class in self.priority_classes:
+            wl.priority = self.priority_classes[wl.priority_class].value
+        self.workloads[wl.key] = wl
+        self.metrics.inc("workloads_created_total")
+        self.queues.add_or_update_workload(wl)
+
+    def submit_job(self, job: GenericJob) -> Workload:
+        wl = self.job_reconciler.reconcile(job)
+        assert wl is not None
+        return wl
+
+    def reconcile_job(self, job: GenericJob) -> None:
+        self.job_reconciler.reconcile(job)
+
+    def finish_workload(self, wl: Workload, success: bool = True) -> None:
+        now = self.clock()
+        if not is_finished(wl):
+            set_condition(wl, COND_FINISHED, True,
+                          "Succeeded" if success else "Failed", "", now)
+        self.cache.delete_workload(wl.key)
+        self.queues.delete_workload(wl)
+        self.metrics.inc("workloads_finished_total")
+        self.queues.queue_inadmissible_workloads()
+
+    def delete_workload(self, wl: Workload) -> None:
+        self.cache.delete_workload(wl.key)
+        self.queues.delete_workload(wl)
+        self.workloads.pop(wl.key, None)
+        self.job_reconciler.job_of_workload.pop(wl.key, None)
+        self.queues.queue_inadmissible_workloads()
+
+    # ------------------------------------------------------------------
+    # control loops
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> CycleResult:
+        result = self.scheduler.schedule()
+        self.metrics.observe(
+            "admission_attempt_duration_seconds", result.duration_s
+        )
+        self.metrics.inc("admission_attempts_total")
+        for key in result.admitted:
+            self.metrics.inc("quota_reserved_workloads_total")
+        for key in result.preempted:
+            self.metrics.inc("preempted_workloads_total")
+        # Sync jobs whose workload state changed.
+        self._reconcile_touched_jobs(result)
+        return result
+
+    def schedule_all(self, max_cycles: int = 100000) -> int:
+        cycles = 0
+        prev_no_progress_heads = None
+        while cycles < max_cycles:
+            result = self.schedule()
+            cycles += 1
+            if result.admitted or result.preempted:
+                prev_no_progress_heads = None
+                continue
+            if not result.head_keys or result.head_keys == prev_no_progress_heads:
+                break
+            prev_no_progress_heads = result.head_keys
+        for key, job in list(self.job_reconciler.job_of_workload.items()):
+            self.job_reconciler.reconcile(job)
+        self.tick()
+        return cycles
+
+    def tick(self) -> None:
+        """Clock-driven reconciliation: admission checks, timeouts,
+        backoffs, retention, job sync."""
+        for wl in list(self.workloads.values()):
+            self._sync_admission_checks(wl)
+            self.workload_controller.reconcile(wl)
+        self.workload_controller.requeue_ready_backoffs()
+
+    def run_until_settled(self, max_rounds: int = 1000) -> None:
+        """Drive schedule + tick until no more progress."""
+        for _ in range(max_rounds):
+            result = self.schedule()
+            self.tick()
+            if not result.admitted and not result.preempted:
+                if not result.head_keys:
+                    break
+
+    # ------------------------------------------------------------------
+
+    def _sync_admission_checks(self, wl: Workload) -> None:
+        for acs in wl.status.admission_checks:
+            if acs.state != CheckState.PENDING:
+                continue
+            ac = self.cache.admission_checks.get(acs.name)
+            if ac is None:
+                continue
+            ctrl = self.check_controllers.get(ac.controller_name)
+            if ctrl is not None:
+                ctrl.sync(self, wl, acs.name)
+
+    def _reconcile_touched_jobs(self, result: CycleResult) -> None:
+        touched = set(result.admitted) | set(result.preempted) | set(
+            result.preempting
+        )
+        for key in touched:
+            job = self.job_reconciler.job_of_workload.get(key)
+            if job is not None:
+                self.job_reconciler.reconcile(job)

@@ -1,0 +1,129 @@
+"""Metrics registry.
+
+Behavioral surface: reference pkg/metrics/metrics.go — the ~50 Prometheus
+series become counters/gauges/histograms in a dependency-free registry with
+a Prometheus text exposition dump (so operators can scrape or log it).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 300.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _lk(labels: Optional[Dict[str, str]]) -> LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Histogram:
+    def __init__(self, buckets=_DEFAULT_BUCKETS) -> None:
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += v
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return self.buckets[i] if i < len(self.buckets) else float(
+                    "inf"
+                )
+        return float("inf")
+
+
+class Metrics:
+    """Counters, gauges and histograms with labels. Series names follow the
+    reference (pkg/metrics/metrics.go:354-966) so dashboards carry over."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, Dict[LabelKey, float]] = defaultdict(
+            lambda: defaultdict(float)
+        )
+        self.gauges: Dict[str, Dict[LabelKey, float]] = defaultdict(
+            lambda: defaultdict(float)
+        )
+        self.histograms: Dict[str, Dict[LabelKey, Histogram]] = defaultdict(
+            dict
+        )
+
+    def inc(self, name: str, labels: Optional[Dict[str, str]] = None,
+            value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name][_lk(labels)] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self.gauges[name][_lk(labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            h = self.histograms[name].get(_lk(labels))
+            if h is None:
+                h = self.histograms[name][_lk(labels)] = Histogram()
+            h.observe(value)
+
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            if name in self.counters:
+                return self.counters[name].get(_lk(labels), 0.0)
+            return self.gauges.get(name, {}).get(_lk(labels), 0.0)
+
+    def expose(self) -> str:
+        """Prometheus text format."""
+        out: List[str] = []
+        with self._lock:
+            for name, series in sorted(self.counters.items()):
+                out.append(f"# TYPE kueue_{name} counter")
+                for lk, v in sorted(series.items()):
+                    out.append(f"kueue_{name}{_fmt(lk)} {v}")
+            for name, series in sorted(self.gauges.items()):
+                out.append(f"# TYPE kueue_{name} gauge")
+                for lk, v in sorted(series.items()):
+                    out.append(f"kueue_{name}{_fmt(lk)} {v}")
+            for name, series in sorted(self.histograms.items()):
+                out.append(f"# TYPE kueue_{name} histogram")
+                for lk, h in sorted(series.items()):
+                    acc = 0
+                    for b, c in zip(h.buckets, h.counts):
+                        acc += c
+                        out.append(
+                            f'kueue_{name}_bucket{_fmt(lk, ("le", str(b)))}'
+                            f" {acc}"
+                        )
+                    out.append(
+                        f'kueue_{name}_bucket{_fmt(lk, ("le", "+Inf"))} {h.n}'
+                    )
+                    out.append(f"kueue_{name}_sum{_fmt(lk)} {h.total}")
+                    out.append(f"kueue_{name}_count{_fmt(lk)} {h.n}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(lk: LabelKey, extra: Optional[Tuple[str, str]] = None) -> str:
+    items = list(lk)
+    if extra:
+        items.append(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"

@@ -1,0 +1,305 @@
+"""Controller-layer tests: jobframework lifecycle, workload controller
+(PodsReady timeout, backoff, max execution time, retention), provisioning
+and MultiKueue admission checks — mirroring the reference's
+test/integration/singlecluster/{controller,scheduler} scenarios in-process.
+"""
+
+import pytest
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    WorkloadPriorityClass,
+    quota,
+)
+from kueue_tpu.controllers.jobs import BatchJob, LeaderWorkerSet, TrainJob
+from kueue_tpu.controllers.multikueue import MultiKueueConfig, MultiKueueController
+from kueue_tpu.controllers.provisioning import (
+    ProvisioningController,
+    ProvisioningRequest,
+    ProvisioningState,
+)
+from kueue_tpu.controllers.workload_controller import WaitForPodsReadyConfig
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    is_admitted,
+    is_evicted,
+    is_finished,
+)
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def basic_manager(clock=None, **kw) -> Manager:
+    mgr = Manager(clock=clock or FakeClock(), **kw)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def test_job_lifecycle_admit_run_finish():
+    mgr = basic_manager()
+    job = BatchJob("train-1", queue="lq", parallelism=2,
+                   requests={"cpu": 2000})
+    wl = mgr.submit_job(job)
+    assert job.is_suspended()
+
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    assert not job.is_suspended()
+    assert job.started_with[0].count == 2
+
+    job.mark_finished(success=True)
+    mgr.reconcile_job(job)
+    assert is_finished(wl)
+    # Quota released.
+    assert not mgr.cache.is_added(wl.key)
+
+
+def test_job_suspended_on_eviction():
+    clock = FakeClock()
+    mgr = basic_manager(
+        clock,
+        pods_ready=WaitForPodsReadyConfig(
+            enable=True, timeout_seconds=10.0,
+            requeuing_backoff_base_seconds=30.0,
+        ),
+    )
+    job = BatchJob("stuck", queue="lq", parallelism=1,
+                   requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    job.set_pods_ready(False)
+
+    clock.advance(11.0)
+    mgr.tick()
+    assert is_evicted(wl)
+    assert job.is_suspended()
+    assert wl.status.requeue_state.count == 1
+    # Backoff holds it out of the queues.
+    mgr.schedule_all()
+    assert not is_admitted(wl)
+    # After the backoff it is readmitted.
+    clock.advance(31.0)
+    mgr.tick()
+    mgr.schedule_all()
+    assert is_admitted(wl)
+
+
+def test_max_execution_time_deactivates():
+    clock = FakeClock()
+    mgr = basic_manager(clock)
+    job = BatchJob("bounded", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    wl.maximum_execution_time_seconds = 60
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    clock.advance(61.0)
+    mgr.tick()
+    assert is_evicted(wl)
+    assert not wl.active
+
+
+def test_priority_class_resolution():
+    mgr = basic_manager()
+    mgr.apply(WorkloadPriorityClass(name="high", value=1000))
+    wl = Workload(name="w", queue_name="lq", priority_class="high",
+                  pod_sets=[__import__("kueue_tpu.api.types",
+                                       fromlist=["PodSet"]).PodSet(
+                      name="m", count=1, requests={"cpu": 100})])
+    mgr.create_workload(wl)
+    assert wl.priority == 1000
+
+
+def test_train_job_multi_role():
+    mgr = basic_manager()
+    job = TrainJob(
+        "llm", queue="lq",
+        roles={"trainer": (2, {"cpu": 2000}), "evaluator": (1, {"cpu": 1000})},
+    )
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    assert {ps.name for ps in wl.pod_sets} == {"trainer", "evaluator"}
+    adm = wl.status.admission
+    assert len(adm.pod_set_assignments) == 2
+
+
+def test_provisioning_check_gates_and_provisions():
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+    )
+
+    class SlowProvider:
+        def __init__(self):
+            self.polls = 0
+
+        def poll(self, request):
+            self.polls += 1
+            return (ProvisioningState.PROVISIONED if self.polls >= 2
+                    else ProvisioningState.PENDING)
+
+    prov = ProvisioningController(provider=SlowProvider())
+    mgr.register_check_controller(prov)
+
+    job = BatchJob("gated", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert has_quota_reservation(wl)
+    assert not is_admitted(wl)  # gated on the check
+
+    mgr.tick()  # second poll -> provisioned -> Ready -> Admitted
+    assert wl.status.admission_checks[0].state == CheckState.READY
+    assert is_admitted(wl)
+    mgr.reconcile_job(job)
+    assert not job.is_suspended()
+
+
+def test_provisioning_retry_then_reject():
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+    )
+
+    class FailingProvider:
+        def poll(self, request):
+            return ProvisioningState.FAILED
+
+    from kueue_tpu.controllers.provisioning import ProvisioningRequestConfig
+
+    prov = ProvisioningController(
+        provider=FailingProvider(),
+        configs={"prov": ProvisioningRequestConfig(
+            name="cfg", max_retries=1, retry_backoff_seconds=10.0)},
+    )
+    mgr.register_check_controller(prov)
+
+    job = BatchJob("doomed", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert has_quota_reservation(wl)
+
+    mgr.tick()  # attempt 1 fails -> backoff
+    assert wl.status.admission_checks[0].state == CheckState.PENDING
+    clock.advance(11.0)
+    mgr.tick()  # attempt 2 fails -> attempts exhausted -> Rejected
+    mgr.tick()  # workload controller deactivates + evicts
+    assert not wl.active
+    assert is_evicted(wl)
+
+
+def worker_manager() -> Manager:
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def test_multikueue_dispatch_first_winner():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    w1, w2 = worker_manager(), worker_manager()
+    # Saturate worker1 so worker2 must win.
+    filler = BatchJob("filler", queue="lq", requests={"cpu": 4000})
+    w1.submit_job(filler)
+    w1.schedule_all()
+
+    mk = MultiKueueController()
+    mk.add_worker("cluster-1", w1)
+    mk.add_worker("cluster-2", w2)
+    mgr.register_check_controller(mk)
+
+    job = BatchJob("dispatched", queue="lq", requests={"cpu": 2000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert has_quota_reservation(wl)
+    mgr.tick()
+    assert wl.status.admission_checks[0].state == CheckState.READY
+    assert wl.status.cluster_name == "cluster-2"
+    assert is_admitted(wl)
+    # Loser copy deleted.
+    assert wl.key not in w1.workloads
+    assert wl.key in w2.workloads
+
+
+def test_multikueue_remote_finish_propagates():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    worker = worker_manager()
+    mk = MultiKueueController()
+    mk.add_worker("cluster-1", worker)
+    mgr.register_check_controller(mk)
+
+    job = BatchJob("remote", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "cluster-1"
+
+    remote = worker.workloads[wl.key]
+    worker.finish_workload(remote)
+    mk.sync_remote_status(mgr, wl)
+    assert is_finished(wl)
+
+
+def test_metrics_exposition():
+    mgr = basic_manager()
+    job = BatchJob("m", queue="lq", requests={"cpu": 1000})
+    mgr.submit_job(job)
+    mgr.schedule_all()
+    text = mgr.metrics.expose()
+    assert "kueue_admission_attempts_total" in text
+    assert "kueue_quota_reserved_workloads_total" in text
