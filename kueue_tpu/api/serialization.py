@@ -1,0 +1,279 @@
+"""YAML/dict (de)serialization for the API objects.
+
+Manifest kinds mirror the reference CRDs (kind: ClusterQueue, LocalQueue,
+ResourceFlavor, Cohort, Topology, AdmissionCheck, WorkloadPriorityClass,
+Workload, Node) so users migrating from the reference can carry their specs
+over with a mechanical field mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+import yaml
+
+from kueue_tpu.api.constants import (
+    BorrowWithinCohortPolicy,
+    FlavorFungibilityPolicy,
+    FlavorFungibilityPreference,
+    PreemptionPolicy,
+    QueueingStrategy,
+    StopPolicy,
+)
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    BorrowWithinCohort,
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FairSharing,
+    FlavorFungibility,
+    FlavorQuotas,
+    LocalQueue,
+    MatchExpression,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Taint,
+    Toleration,
+    Topology,
+    TopologyRequest,
+    Workload,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.tas.snapshot import Node
+
+
+def parse_quantity(v: Union[int, float, str], resource: str = "") -> int:
+    """Canonical integers matching the reference's int64 canonicalization
+    (pkg/resources/amount.go AmountFromQuantity): cpu in milli-units,
+    memory/storage in bytes, everything else in plain counts."""
+    if isinstance(v, bool):
+        raise ValueError("quantity cannot be bool")
+    is_cpu = resource == "cpu"
+    if isinstance(v, (int, float)):
+        return int(v * 1000) if is_cpu else int(v)
+    s = str(v).strip()
+    suffixes = {
+        "Ki": 1024, "Mi": 1024 ** 2, "Gi": 1024 ** 3, "Ti": 1024 ** 4,
+        "k": 1000, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+    }
+    if s.endswith("m"):
+        return int(float(s[:-1]))
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return int(float(s[: -len(suf)]) * mult)
+    return int(float(s) * 1000) if is_cpu else int(float(s))
+
+
+def _quota(d: Dict[str, Any]) -> ResourceQuota:
+    res = d.get("name", "")
+    return ResourceQuota(
+        nominal=parse_quantity(
+            d.get("nominalQuota", d.get("nominal", 0)), res
+        ),
+        borrowing_limit=(
+            parse_quantity(d["borrowingLimit"], res)
+            if d.get("borrowingLimit") is not None else None
+        ),
+        lending_limit=(
+            parse_quantity(d["lendingLimit"], res)
+            if d.get("lendingLimit") is not None else None
+        ),
+    )
+
+
+def _toleration(d) -> Toleration:
+    return Toleration(
+        key=d.get("key", ""), operator=d.get("operator", "Equal"),
+        value=d.get("value", ""), effect=d.get("effect", ""),
+    )
+
+
+def _taint(d) -> Taint:
+    return Taint(key=d["key"], value=d.get("value", ""),
+                 effect=d.get("effect", "NoSchedule"))
+
+
+def decode(doc: Dict[str, Any]):
+    """Decode one manifest document by `kind`."""
+    kind = doc.get("kind")
+    meta = doc.get("metadata", {})
+    spec = doc.get("spec", {})
+    name = meta.get("name", doc.get("name"))
+    if kind == "ResourceFlavor":
+        return ResourceFlavor(
+            name=name,
+            node_labels=spec.get("nodeLabels", {}),
+            node_taints=[_taint(t) for t in spec.get("nodeTaints", [])],
+            tolerations=[_toleration(t) for t in spec.get("tolerations", [])],
+            topology_name=spec.get("topologyName"),
+        )
+    if kind == "Topology":
+        levels = spec.get("levels", [])
+        keys = [
+            lv["nodeLabel"] if isinstance(lv, dict) else lv for lv in levels
+        ]
+        return Topology(name=name, levels=keys)
+    if kind == "Cohort":
+        return Cohort(
+            name=name,
+            parent=spec.get("parentName", spec.get("parent")),
+            quotas=[
+                FlavorQuotas(
+                    name=fq["name"],
+                    resources={
+                        r["name"]: _quota(r) for r in fq.get("resources", [])
+                    },
+                )
+                for rg in spec.get("resourceGroups", [])
+                for fq in rg.get("flavors", [])
+            ],
+            fair_sharing=_fair_sharing(spec),
+        )
+    if kind == "ClusterQueue":
+        preemption = spec.get("preemption", {})
+        bwc = preemption.get("borrowWithinCohort", {}) or {}
+        fung = spec.get("flavorFungibility", {}) or {}
+        return ClusterQueue(
+            name=name,
+            cohort=spec.get("cohortName", spec.get("cohort")),
+            resource_groups=[
+                ResourceGroup(
+                    covered_resources=rg.get("coveredResources", []),
+                    flavors=[
+                        FlavorQuotas(
+                            name=fq["name"],
+                            resources={
+                                r["name"]: _quota(r)
+                                for r in fq.get("resources", [])
+                            },
+                        )
+                        for fq in rg.get("flavors", [])
+                    ],
+                )
+                for rg in spec.get("resourceGroups", [])
+            ],
+            queueing_strategy=QueueingStrategy(
+                spec.get("queueingStrategy", "BestEffortFIFO")
+            ),
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy(
+                    preemption.get("withinClusterQueue", "Never")
+                ),
+                reclaim_within_cohort=PreemptionPolicy(
+                    preemption.get("reclaimWithinCohort", "Never")
+                ),
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy(
+                        bwc.get("policy", "Never")
+                    ),
+                    max_priority_threshold=bwc.get("maxPriorityThreshold"),
+                ),
+            ),
+            flavor_fungibility=FlavorFungibility(
+                when_can_borrow=FlavorFungibilityPolicy(
+                    fung.get("whenCanBorrow", "Borrow")
+                ),
+                when_can_preempt=FlavorFungibilityPolicy(
+                    fung.get("whenCanPreempt", "TryNextFlavor")
+                ),
+                preference=(
+                    FlavorFungibilityPreference(fung["preference"])
+                    if fung.get("preference") else None
+                ),
+            ),
+            namespace_selector=spec.get("namespaceSelector"),
+            stop_policy=StopPolicy(spec.get("stopPolicy", "None")),
+            fair_sharing=_fair_sharing(spec),
+            admission_checks=spec.get("admissionChecks", []),
+        )
+    if kind == "LocalQueue":
+        return LocalQueue(
+            name=name,
+            namespace=meta.get("namespace", "default"),
+            cluster_queue=spec.get("clusterQueue", ""),
+            stop_policy=StopPolicy(spec.get("stopPolicy", "None")),
+        )
+    if kind == "AdmissionCheck":
+        return AdmissionCheck(
+            name=name,
+            controller_name=spec.get("controllerName", ""),
+            parameters=spec.get("parameters"),
+        )
+    if kind == "WorkloadPriorityClass":
+        return WorkloadPriorityClass(name=name, value=doc.get("value", 0))
+    if kind == "Node":
+        return Node(
+            name=name,
+            labels=meta.get("labels", {}),
+            capacity={
+                r: parse_quantity(v, r)
+                for r, v in (doc.get("status", {}).get("capacity")
+                             or doc.get("capacity", {})).items()
+            },
+            taints=[_taint(t) for t in spec.get("taints", [])],
+            ready=doc.get("ready", True),
+        )
+    if kind == "Workload":
+        return Workload(
+            name=name,
+            namespace=meta.get("namespace", "default"),
+            queue_name=spec.get("queueName", ""),
+            priority=spec.get("priority", 0),
+            priority_class=spec.get("priorityClassName"),
+            active=spec.get("active", True),
+            pod_sets=[_podset(ps) for ps in spec.get("podSets", [])],
+        )
+    raise ValueError(f"unknown kind: {kind}")
+
+
+def _podset(d: Dict[str, Any]) -> PodSet:
+    template = d.get("template", {}).get("spec", {})
+    containers = template.get("containers", [])
+    requests: Dict[str, int] = {}
+    for c in containers:
+        for r, v in (c.get("resources", {}).get("requests") or {}).items():
+            requests[r] = requests.get(r, 0) + parse_quantity(v, r)
+    requests.update({
+        r: parse_quantity(v, r) for r, v in d.get("requests", {}).items()
+    })
+    tr = d.get("topologyRequest")
+    topology_request = None
+    if tr:
+        topology_request = TopologyRequest(
+            required_level=tr.get("required"),
+            preferred_level=tr.get("preferred"),
+            unconstrained=tr.get("unconstrained", False),
+            podset_group_name=tr.get("podSetGroupName"),
+            slice_required_level=tr.get("podSetSliceRequiredTopology"),
+            slice_size=tr.get("podSetSliceSize"),
+        )
+    return PodSet(
+        name=d.get("name", "main"),
+        count=d.get("count", 1),
+        requests=requests,
+        min_count=d.get("minCount"),
+        node_selector=template.get("nodeSelector", {}),
+        tolerations=[_toleration(t) for t in template.get("tolerations", [])],
+        topology_request=topology_request,
+    )
+
+
+def _fair_sharing(spec):
+    fs = spec.get("fairSharing")
+    if not fs:
+        return None
+    return FairSharing(weight=float(fs.get("weight", 1)))
+
+
+def load_manifests(text_or_path: str) -> List[Any]:
+    text = text_or_path
+    if "\n" not in text_or_path:
+        try:
+            with open(text_or_path) as f:
+                text = f.read()
+        except OSError:
+            pass
+    return [decode(doc) for doc in yaml.safe_load_all(text) if doc]

@@ -1,0 +1,199 @@
+"""kueuectl-equivalent CLI.
+
+Behavioral surface: reference cmd/kueuectl — create/list/delete/stop/resume
+for ClusterQueues, LocalQueues and Workloads, pending-workload listing via
+the visibility server, plus `schedule` (run cycles) and `import` (bulk
+import). Operates on a manifest-defined in-process control plane:
+
+    python -m kueue_tpu.cli --manifests cluster.yaml list clusterqueue
+    python -m kueue_tpu.cli --manifests cluster.yaml schedule
+    python -m kueue_tpu.cli --manifests cluster.yaml \
+        list pendingworkloads --cluster-queue cq-a
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from kueue_tpu.api.constants import StopPolicy
+from kueue_tpu.api.serialization import load_manifests
+from kueue_tpu.api.types import ClusterQueue, LocalQueue, Workload
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+from kueue_tpu.visibility.server import VisibilityServer
+
+
+def build_manager(manifest_paths: List[str]) -> Manager:
+    mgr = Manager()
+    for path in manifest_paths:
+        for obj in load_manifests(path):
+            if isinstance(obj, Workload):
+                mgr.create_workload(obj)
+            else:
+                mgr.apply(obj)
+    return mgr
+
+
+def _print_table(rows: List[List[str]], headers: List[str]) -> None:
+    widths = [
+        max(len(str(r[i])) for r in [headers] + rows)
+        for i in range(len(headers))
+    ]
+    for r in [headers] + rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+
+
+def cmd_list(mgr: Manager, args) -> int:
+    kind = args.resource.lower()
+    if kind in ("clusterqueue", "cq", "clusterqueues"):
+        rows = []
+        for name, cq in sorted(mgr.cache.cluster_queues.items()):
+            pending = mgr.queues.pending_count(name)
+            admitted = sum(
+                1 for info in mgr.cache.workloads.values()
+                if info.cluster_queue == name
+            )
+            rows.append([name, cq.cohort or "", cq.queueing_strategy.value,
+                         pending, admitted, cq.stop_policy.value])
+        _print_table(rows, ["NAME", "COHORT", "STRATEGY", "PENDING",
+                            "ADMITTED", "STOP"])
+    elif kind in ("localqueue", "lq", "localqueues"):
+        rows = [
+            [lq.namespace, lq.name, lq.cluster_queue]
+            for lq in sorted(mgr.cache.local_queues.values(),
+                             key=lambda q: q.key)
+        ]
+        _print_table(rows, ["NAMESPACE", "NAME", "CLUSTERQUEUE"])
+    elif kind in ("workload", "workloads", "wl"):
+        rows = []
+        for key, wl in sorted(mgr.workloads.items()):
+            status = "Admitted" if is_admitted(wl) else "Pending"
+            cq = mgr.queues.cluster_queue_for(wl) or ""
+            rows.append([wl.namespace, wl.name, wl.queue_name, cq,
+                         wl.priority, status])
+        _print_table(rows, ["NAMESPACE", "NAME", "QUEUE", "CLUSTERQUEUE",
+                            "PRIORITY", "STATUS"])
+    elif kind in ("pendingworkloads", "pending"):
+        vis = VisibilityServer(mgr.queues)
+        summary = vis.pending_workloads_cq(args.cluster_queue)
+        rows = [
+            [w.name, w.local_queue, w.priority,
+             w.position_in_cluster_queue, w.position_in_local_queue]
+            for w in summary.items
+        ]
+        _print_table(rows, ["NAME", "LOCALQUEUE", "PRIORITY", "POS(CQ)",
+                            "POS(LQ)"])
+        print(f"inadmissible: {summary.inadmissible}")
+    elif kind in ("resourceflavor", "resourceflavors", "rf"):
+        rows = [
+            [rf.name, json.dumps(rf.node_labels), rf.topology_name or ""]
+            for rf in sorted(mgr.cache.resource_flavors.values(),
+                             key=lambda r: r.name)
+        ]
+        _print_table(rows, ["NAME", "NODELABELS", "TOPOLOGY"])
+    else:
+        print(f"unknown resource {args.resource}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _set_stop_policy(mgr: Manager, args, policy: StopPolicy) -> int:
+    kind = args.resource.lower()
+    if kind in ("clusterqueue", "cq"):
+        cq = mgr.cache.cluster_queues.get(args.name)
+        if cq is None:
+            print(f"ClusterQueue {args.name} not found", file=sys.stderr)
+            return 1
+        cq.stop_policy = policy
+        mgr.apply(cq)
+    elif kind in ("localqueue", "lq"):
+        lq = mgr.cache.local_queues.get(f"default/{args.name}")
+        if lq is None:
+            print(f"LocalQueue {args.name} not found", file=sys.stderr)
+            return 1
+        lq.stop_policy = policy
+    elif kind in ("workload", "wl"):
+        wl = mgr.workloads.get(f"default/{args.name}")
+        if wl is None:
+            print(f"Workload {args.name} not found", file=sys.stderr)
+            return 1
+        wl.active = policy == StopPolicy.NONE
+        mgr.tick()
+    else:
+        print(f"unknown resource {args.resource}", file=sys.stderr)
+        return 1
+    print(f"{args.resource}/{args.name} -> {policy.value}")
+    return 0
+
+
+def cmd_schedule(mgr: Manager, args) -> int:
+    cycles = mgr.schedule_all(max_cycles=args.cycles)
+    admitted = sum(
+        1 for wl in mgr.workloads.values() if is_admitted(wl)
+    )
+    print(f"cycles={cycles} admitted={admitted} "
+          f"total={len(mgr.workloads)}")
+    return 0
+
+
+def cmd_import(mgr: Manager, args) -> int:
+    from kueue_tpu.importer import import_workloads
+
+    report = import_workloads(mgr, args.file, check_only=args.check)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kueuectl-tpu")
+    ap.add_argument("--manifests", action="append", default=[],
+                    help="YAML manifest file(s) defining the control plane")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_list = sub.add_parser("list")
+    p_list.add_argument("resource")
+    p_list.add_argument("--cluster-queue", default="")
+
+    p_stop = sub.add_parser("stop")
+    p_stop.add_argument("resource")
+    p_stop.add_argument("name")
+
+    p_resume = sub.add_parser("resume")
+    p_resume.add_argument("resource")
+    p_resume.add_argument("name")
+
+    p_sched = sub.add_parser("schedule")
+    p_sched.add_argument("--cycles", type=int, default=100000)
+
+    p_imp = sub.add_parser("import")
+    p_imp.add_argument("file")
+    p_imp.add_argument("--check", action="store_true")
+
+    sub.add_parser("dump")
+
+    args = ap.parse_args(argv)
+    mgr = build_manager(args.manifests)
+
+    if args.cmd == "list":
+        return cmd_list(mgr, args)
+    if args.cmd == "stop":
+        return _set_stop_policy(mgr, args, StopPolicy.HOLD)
+    if args.cmd == "resume":
+        return _set_stop_policy(mgr, args, StopPolicy.NONE)
+    if args.cmd == "schedule":
+        return cmd_schedule(mgr, args)
+    if args.cmd == "import":
+        return cmd_import(mgr, args)
+    if args.cmd == "dump":
+        from kueue_tpu.utils.debugger import dump
+
+        dump(mgr, sys.stdout)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,250 @@
+"""Component configuration.
+
+Behavioral surface: reference apis/config/v1beta2/configuration_types.go +
+pkg/config/{config,validation}.go — the single Configuration object with
+defaulting, validation, and feature-gate overrides, loadable from YAML.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+from kueue_tpu.controllers.workload_controller import (
+    RetentionConfig,
+    WaitForPodsReadyConfig,
+)
+from kueue_tpu.utils import features
+
+
+@dataclass
+class FairSharingConfig:
+    """reference configuration_types.go:739."""
+
+    enable: bool = False
+    preemption_strategies: List[str] = field(
+        default_factory=lambda: [
+            "LessThanOrEqualToFinalShare", "LessThanInitialShare",
+        ]
+    )
+
+
+@dataclass
+class MultiKueueSettings:
+    """reference configuration_types.go:331."""
+
+    gc_interval_seconds: float = 60.0
+    origin: str = "multikueue"
+    worker_lost_timeout_seconds: float = 900.0
+    dispatcher_name: str = "AllAtOnce"  # or "Incremental"
+
+
+@dataclass
+class ResourceTransformation:
+    """reference configuration_types.go:612: map an input resource into
+    scheduling resources (e.g. tpu-v5e-pod -> tpu chips)."""
+
+    input: str
+    strategy: str = "Retain"  # Retain | Replace
+    outputs: Dict[str, int] = field(default_factory=dict)  # per input unit
+
+
+@dataclass
+class ResourcesConfig:
+    """reference configuration_types.go:589."""
+
+    exclude_resource_prefixes: List[str] = field(default_factory=list)
+    transformations: List[ResourceTransformation] = field(
+        default_factory=list
+    )
+
+
+@dataclass
+class Configuration:
+    """reference configuration_types.go:35."""
+
+    namespace: str = "kueue-system"
+    manage_jobs_without_queue_name: bool = False
+    wait_for_pods_ready: WaitForPodsReadyConfig = field(
+        default_factory=WaitForPodsReadyConfig
+    )
+    integrations: List[str] = field(
+        default_factory=lambda: ["batch/job", "trainjob", "leaderworkerset",
+                                 "mpijob", "raycluster", "pod", "serving"]
+    )
+    fair_sharing: FairSharingConfig = field(default_factory=FairSharingConfig)
+    multi_kueue: MultiKueueSettings = field(default_factory=MultiKueueSettings)
+    resources: ResourcesConfig = field(default_factory=ResourcesConfig)
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+    object_retention_after_finished_seconds: Optional[float] = None
+    visibility_enabled: bool = True
+    use_device_scheduler: bool = False
+
+
+def _pick(d: dict, *names, default=None):
+    for n in names:
+        if n in d:
+            return d[n]
+    return default
+
+
+def load(source) -> Configuration:
+    """Load + default + validate a Configuration from a YAML string, file
+    path, or dict (reference pkg/config/config.go:219)."""
+    if isinstance(source, dict):
+        raw = source
+    else:
+        text = source
+        if "\n" not in str(source):
+            try:
+                with open(source) as f:
+                    text = f.read()
+            except (OSError, TypeError):
+                pass
+        raw = yaml.safe_load(text) or {}
+
+    cfg = Configuration()
+    cfg.namespace = _pick(raw, "namespace", default=cfg.namespace)
+    cfg.manage_jobs_without_queue_name = _pick(
+        raw, "manageJobsWithoutQueueName", "manage_jobs_without_queue_name",
+        default=False,
+    )
+    wfpr = _pick(raw, "waitForPodsReady", "wait_for_pods_ready", default={})
+    if wfpr:
+        rq = _pick(wfpr, "requeuingStrategy", "requeuing_strategy",
+                   default={}) or {}
+        cfg.wait_for_pods_ready = WaitForPodsReadyConfig(
+            enable=wfpr.get("enable", False),
+            timeout_seconds=_duration(_pick(wfpr, "timeout", default="5m")),
+            block_admission=_pick(wfpr, "blockAdmission", "block_admission",
+                                  default=False),
+            requeuing_backoff_base_seconds=float(
+                _pick(rq, "backoffBaseSeconds", default=60)
+            ),
+            requeuing_backoff_limit_count=_pick(
+                rq, "backoffLimitCount", default=None
+            ),
+            requeuing_backoff_max_seconds=float(
+                _pick(rq, "backoffMaxSeconds", default=3600)
+            ),
+        )
+    if "integrations" in raw:
+        frameworks = _pick(raw["integrations"] or {}, "frameworks",
+                           default=None)
+        if frameworks is not None:
+            cfg.integrations = list(frameworks)
+    fs = _pick(raw, "fairSharing", "fair_sharing", default={}) or {}
+    cfg.fair_sharing = FairSharingConfig(
+        enable=fs.get("enable", False),
+        preemption_strategies=fs.get(
+            "preemptionStrategies",
+            ["LessThanOrEqualToFinalShare", "LessThanInitialShare"],
+        ),
+    )
+    mk = _pick(raw, "multiKueue", "multi_kueue", default={}) or {}
+    cfg.multi_kueue = MultiKueueSettings(
+        gc_interval_seconds=_duration(_pick(mk, "gcInterval", default="1m")),
+        origin=mk.get("origin", "multikueue"),
+        worker_lost_timeout_seconds=_duration(
+            _pick(mk, "workerLostTimeout", default="15m")
+        ),
+        dispatcher_name=mk.get("dispatcherName", "AllAtOnce"),
+    )
+    res = _pick(raw, "resources", default={}) or {}
+    cfg.resources = ResourcesConfig(
+        exclude_resource_prefixes=res.get("excludeResourcePrefixes", []),
+        transformations=[
+            ResourceTransformation(
+                input=t["input"],
+                strategy=t.get("strategy", "Retain"),
+                outputs=t.get("outputs", {}),
+            )
+            for t in res.get("transformations", [])
+        ],
+    )
+    cfg.feature_gates = dict(_pick(raw, "featureGates", "feature_gates",
+                                   default={}) or {})
+    orp = _pick(raw, "objectRetentionPolicies", default={}) or {}
+    wl_ret = (orp.get("workloads") or {})
+    if wl_ret.get("afterFinished") is not None:
+        cfg.object_retention_after_finished_seconds = _duration(
+            wl_ret["afterFinished"]
+        )
+    cfg.use_device_scheduler = bool(
+        _pick(raw, "useDeviceScheduler", "use_device_scheduler",
+              default=False)
+    )
+
+    validate(cfg)
+    return cfg
+
+
+def validate(cfg: Configuration) -> None:
+    """reference pkg/config/validation.go (subset)."""
+    if cfg.wait_for_pods_ready.enable:
+        if cfg.wait_for_pods_ready.timeout_seconds <= 0:
+            raise ValueError("waitForPodsReady.timeout must be positive")
+        if cfg.wait_for_pods_ready.requeuing_backoff_base_seconds < 0:
+            raise ValueError("backoffBaseSeconds must be >= 0")
+    for strategy in cfg.fair_sharing.preemption_strategies:
+        if strategy not in (
+            "LessThanOrEqualToFinalShare", "LessThanInitialShare",
+        ):
+            raise ValueError(f"unknown preemption strategy {strategy}")
+    if cfg.multi_kueue.dispatcher_name not in ("AllAtOnce", "Incremental"):
+        raise ValueError(
+            f"unknown dispatcher {cfg.multi_kueue.dispatcher_name}"
+        )
+    for gate in cfg.feature_gates:
+        if gate not in features.all_gates():
+            raise ValueError(f"unknown feature gate {gate}")
+
+
+def apply_feature_gates(cfg: Configuration) -> None:
+    for gate, value in cfg.feature_gates.items():
+        features.set_enabled(gate, value)
+
+
+def build_manager(cfg: Configuration, **kw):
+    """cmd/kueue main.go equivalent: construct a Manager from config."""
+    from kueue_tpu.manager import Manager
+
+    apply_feature_gates(cfg)
+    retention = None
+    if cfg.object_retention_after_finished_seconds is not None:
+        retention = RetentionConfig(
+            retain_finished_seconds=(
+                cfg.object_retention_after_finished_seconds
+            )
+        )
+    mgr = Manager(
+        fair_sharing=cfg.fair_sharing.enable,
+        pods_ready=cfg.wait_for_pods_ready,
+        retention=retention,
+        use_device_scheduler=cfg.use_device_scheduler,
+        **kw,
+    )
+    from kueue_tpu.controllers.jobframework import registry
+
+    for name in registry.names():
+        registry.set_enabled(name, name in cfg.integrations)
+    return mgr
+
+
+def _duration(v) -> float:
+    """Parse '5m', '30s', '1h', numbers, into seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    mult = 1.0
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("h"):
+        mult, s = 3600.0, s[:-1]
+    elif s.endswith("m"):
+        mult, s = 60.0, s[:-1]
+    elif s.endswith("s"):
+        s = s[:-1]
+    return float(s) * mult

@@ -1,0 +1,37 @@
+"""State dumper (reference pkg/debugger: SIGUSR2 -> dump caches/queues).
+
+register_signal_dump(manager) installs the same SIGUSR2 behavior; dump()
+returns the text for programmatic use.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import TextIO
+
+
+def dump(manager, out: TextIO = sys.stderr) -> None:
+    cache = manager.cache
+    queues = manager.queues
+    print("=== kueue_tpu cache dump ===", file=out)
+    print(f"ClusterQueues: {sorted(cache.cluster_queues)}", file=out)
+    print(f"Cohorts: {sorted(cache.cohorts)}", file=out)
+    print(f"Flavors: {sorted(cache.resource_flavors)}", file=out)
+    print(f"Nodes: {len(cache.nodes)}", file=out)
+    print("--- admitted workloads ---", file=out)
+    for key, info in sorted(cache.workloads.items()):
+        flag = " (assumed)" if key in cache.assumed else ""
+        print(f"  {key} cq={info.cluster_queue}{flag} "
+              f"usage={dict(info.usage())}", file=out)
+    print("--- pending queues ---", file=out)
+    for name, cqh in sorted(queues.cluster_queues.items()):
+        heads = [i.obj.name for i in cqh.snapshot_sorted()]
+        print(f"  {name}: active={heads} "
+              f"inadmissible={sorted(cqh.inadmissible)}", file=out)
+    print("=== end dump ===", file=out)
+
+
+def register_signal_dump(manager) -> None:
+    """SIGUSR2 -> dump, like the reference's pkg/debugger/debugger.go:31."""
+    signal.signal(signal.SIGUSR2, lambda *_: dump(manager))

@@ -1,0 +1,124 @@
+"""Visibility API: on-demand pending-workload introspection.
+
+Behavioral surface: reference pkg/visibility (extension API server) —
+live pending-workloads summaries with queue positions from the heap order
+(storage/pending_workloads_cq.go:63). Exposed as plain Python calls plus an
+optional JSON/HTTP server for remote operators.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from kueue_tpu.queue.manager import QueueManager
+
+
+@dataclass
+class PendingWorkload:
+    """reference apis/visibility/v1beta2/types.go:66."""
+
+    name: str
+    namespace: str
+    local_queue: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    """reference apis/visibility types.go:87."""
+
+    cluster_queue: str
+    items: List[PendingWorkload] = field(default_factory=list)
+    inadmissible: int = 0
+
+
+class VisibilityServer:
+    """reference pkg/visibility/server.go:82."""
+
+    def __init__(self, queues: QueueManager) -> None:
+        self.queues = queues
+
+    def pending_workloads_cq(
+        self, cq_name: str, offset: int = 0, limit: int = 1000
+    ) -> PendingWorkloadsSummary:
+        summary = PendingWorkloadsSummary(cluster_queue=cq_name)
+        lq_pos: Dict[str, int] = {}
+        infos = self.queues.pending_workloads(cq_name)
+        for pos, info in enumerate(infos):
+            lq = info.obj.queue_name
+            lq_idx = lq_pos.get(lq, 0)
+            lq_pos[lq] = lq_idx + 1
+            if pos < offset or pos >= offset + limit:
+                continue
+            summary.items.append(
+                PendingWorkload(
+                    name=info.obj.name,
+                    namespace=info.obj.namespace,
+                    local_queue=lq,
+                    priority=info.priority(),
+                    position_in_cluster_queue=pos,
+                    position_in_local_queue=lq_idx,
+                )
+            )
+        cqh = self.queues.cluster_queues.get(cq_name)
+        if cqh is not None:
+            summary.inadmissible = len(cqh.inadmissible)
+        return summary
+
+    def pending_workloads_lq(
+        self, lq_key: str, offset: int = 0, limit: int = 1000
+    ) -> List[PendingWorkload]:
+        lq = self.queues.local_queues.get(lq_key)
+        if lq is None:
+            return []
+        summary = self.pending_workloads_cq(lq.cluster_queue)
+        items = [
+            w for w in summary.items
+            if f"{w.namespace}/{w.local_queue}" == lq_key
+        ]
+        return items[offset:offset + limit]
+
+    def to_json(self, cq_name: str) -> str:
+        return json.dumps(asdict(self.pending_workloads_cq(cq_name)))
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8082):
+        """Optional HTTP endpoint:
+        GET /visibility/clusterqueues/<name>/pendingworkloads."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if (
+                    len(parts) == 3
+                    and parts[0] == "visibility"
+                    and parts[1] == "clusterqueues"
+                ) or (
+                    len(parts) == 4
+                    and parts[0] == "visibility"
+                    and parts[1] == "clusterqueues"
+                    and parts[3] == "pendingworkloads"
+                ):
+                    body = server_self.to_json(parts[2]).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd

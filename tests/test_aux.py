@@ -1,0 +1,204 @@
+"""Aux subsystem tests: visibility, config, serialization, CLI, importer,
+debugger."""
+
+import io
+import json
+
+import pytest
+
+from kueue_tpu.api.serialization import load_manifests, parse_quantity
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.config.configuration import build_manager, load
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+from kueue_tpu.visibility.server import VisibilityServer
+
+from .helpers import make_cq, make_wl, submit
+
+
+MANIFESTS = """
+kind: ResourceFlavor
+metadata: {name: default}
+spec: {}
+---
+kind: ClusterQueue
+metadata: {name: cq-a}
+spec:
+  cohortName: pool
+  queueingStrategy: BestEffortFIFO
+  resourceGroups:
+  - coveredResources: [cpu, memory]
+    flavors:
+    - name: default
+      resources:
+      - {name: cpu, nominalQuota: 10}
+      - {name: memory, nominalQuota: 10Gi}
+  preemption:
+    withinClusterQueue: LowerPriority
+    reclaimWithinCohort: Any
+---
+kind: LocalQueue
+metadata: {name: lq, namespace: default}
+spec: {clusterQueue: cq-a}
+---
+kind: Workload
+metadata: {name: wl-1, namespace: default}
+spec:
+  queueName: lq
+  priority: 100
+  podSets:
+  - name: main
+    count: 2
+    requests: {cpu: 500m, memory: 1Gi}
+"""
+
+
+def test_quantity_parsing():
+    assert parse_quantity("500m", "cpu") == 500
+    assert parse_quantity(10, "cpu") == 10_000
+    assert parse_quantity("1.5", "cpu") == 1500
+    assert parse_quantity("1Gi", "memory") == 1024 ** 3
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity(7, "tpu") == 7
+
+
+def test_manifest_roundtrip_and_schedule():
+    objs = load_manifests(MANIFESTS)
+    kinds = [type(o).__name__ for o in objs]
+    assert kinds == ["ResourceFlavor", "ClusterQueue", "LocalQueue",
+                     "Workload"]
+    cq = objs[1]
+    assert cq.resource_groups[0].flavors[0].resources["memory"].nominal == \
+        10 * 1024 ** 3
+    from kueue_tpu.cli import build_manager as cli_build
+
+    mgr = Manager()
+    for obj in objs[:-1]:
+        mgr.apply(obj)
+    mgr.create_workload(objs[-1])
+    mgr.schedule_all()
+    assert is_admitted(mgr.workloads["default/wl-1"])
+
+
+def test_visibility_positions():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(1_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        LocalQueue(name="lq2", cluster_queue="cq-a"),
+    )
+    # Fill the CQ so later workloads stay pending.
+    mgr.create_workload(make_wl("run", cpu_m=1000, creation_time=1.0))
+    mgr.schedule_all()
+    for i in range(3):
+        mgr.create_workload(
+            make_wl(f"p{i}", queue="lq" if i < 2 else "lq2",
+                    cpu_m=500, priority=10 - i, creation_time=float(i + 2))
+        )
+    vis = VisibilityServer(mgr.queues)
+    summary = vis.pending_workloads_cq("cq-a")
+    names = [w.name for w in summary.items]
+    assert names == ["p0", "p1", "p2"]  # priority order
+    assert [w.position_in_cluster_queue for w in summary.items] == [0, 1, 2]
+    assert summary.items[2].position_in_local_queue == 0  # first in lq2
+    data = json.loads(vis.to_json("cq-a"))
+    assert data["cluster_queue"] == "cq-a"
+
+
+def test_config_load_and_build():
+    cfg = load("""
+namespace: kueue-system
+waitForPodsReady:
+  enable: true
+  timeout: 2m
+  requeuingStrategy:
+    backoffBaseSeconds: 10
+fairSharing:
+  enable: true
+featureGates:
+  PartialAdmission: false
+objectRetentionPolicies:
+  workloads:
+    afterFinished: 1h
+""")
+    assert cfg.wait_for_pods_ready.enable
+    assert cfg.wait_for_pods_ready.timeout_seconds == 120.0
+    assert cfg.fair_sharing.enable
+    assert cfg.object_retention_after_finished_seconds == 3600.0
+    mgr = build_manager(cfg)
+    assert mgr.scheduler.fair_sharing
+    from kueue_tpu.utils import features
+
+    assert not features.enabled("PartialAdmission")
+    features.reset()
+
+
+def test_config_validation_rejects_bad_strategy():
+    with pytest.raises(ValueError):
+        load({"fairSharing": {"enable": True,
+                              "preemptionStrategies": ["Nope"]}})
+
+
+def test_cli_list_and_schedule(tmp_path, capsys):
+    mpath = tmp_path / "m.yaml"
+    mpath.write_text(MANIFESTS)
+    from kueue_tpu.cli import main
+
+    assert main(["--manifests", str(mpath), "schedule"]) == 0
+    out = capsys.readouterr().out
+    assert "admitted=1" in out
+
+    assert main(["--manifests", str(mpath), "list", "clusterqueue"]) == 0
+    out = capsys.readouterr().out
+    assert "cq-a" in out
+
+
+def test_importer(tmp_path):
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl_yaml = """
+kind: Workload
+metadata: {name: preexisting, namespace: default}
+spec:
+  queueName: lq
+  podSets:
+  - name: main
+    count: 1
+    requests: {cpu: 2}
+"""  # cpu: 2 cores = 2000m
+    p = tmp_path / "wl.yaml"
+    p.write_text(wl_yaml)
+    from kueue_tpu.importer import import_workloads
+
+    report = import_workloads(mgr, str(p))
+    assert report == {"checked": 1, "imported": 1, "failed": []}
+    wl = mgr.workloads["default/preexisting"]
+    assert is_admitted(wl)
+    # Imported usage counts against quota.
+    big = make_wl("big", cpu_m=9_000)
+    mgr.create_workload(big)
+    mgr.schedule_all()
+    assert not is_admitted(big)
+
+
+def test_debugger_dump():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.submit_job(BatchJob("d", queue="lq", requests={"cpu": 1000}))
+    mgr.schedule_all()
+    from kueue_tpu.utils.debugger import dump
+
+    buf = io.StringIO()
+    dump(mgr, buf)
+    text = buf.getvalue()
+    assert "cq-a" in text and "batchjob-d" in text
