@@ -174,6 +174,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sub.add_parser("dump")
 
+    p_perf = sub.add_parser("perf")
+    p_perf.add_argument("generator")
+    p_perf.add_argument("--rangespec", default=None)
+
     args = ap.parse_args(argv)
     mgr = build_manager(args.manifests)
 
@@ -192,6 +196,27 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         dump(mgr, sys.stdout)
         return 0
+    if args.cmd == "perf":
+        from kueue_tpu.perf.harness import run_config_files
+
+        result, violations = run_config_files(args.generator, args.rangespec)
+        print(json.dumps({
+            "virtual_wall_s": round(result.virtual_wall_s, 2),
+            "scheduling_wall_s": round(result.scheduling_wall_s, 2),
+            "admitted": result.admitted,
+            "total": result.total_workloads,
+            "cycles": result.cycles,
+            "avg_time_to_admission_s": {
+                k: round(v, 2)
+                for k, v in result.avg_time_to_admission_s.items()
+            },
+            "cq_class_min_usage_pct": {
+                k: round(v, 1)
+                for k, v in result.cq_class_min_usage_pct.items()
+            },
+            "violations": violations,
+        }, indent=2))
+        return 0 if not violations else 1
     return 1
 
 

@@ -1,0 +1,308 @@
+"""Performance/scalability harness.
+
+Behavioral surface: reference test/performance/scheduler — the generator
+(configs/*/generator.yaml: cohorts x queuesSets x workloadsSets with
+creation intervals, runtimes, priorities), the runner (mimics workload
+execution by completing after runtimeMs — no real pods), and the checker
+(rangespec.yaml expectation bands: maxWallMs, per-CQ-class min utilization,
+per-workload-class max avg time-to-admission).
+
+Time model: a virtual clock drives creation intervals and runtimes, so the
+recorded per-class admission latencies are directly comparable with the
+reference's calibrated rangespecs; the wall-clock spent scheduling is
+reported separately (the TPU-native speed metric).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.core.workload_info import get_condition
+from kueue_tpu.manager import Manager
+
+CREATE, COMPLETE = 0, 1
+
+
+@dataclass
+class GeneratedWorkload:
+    wl: Workload
+    klass: str
+    cq_name: str
+    cq_class: str
+    create_at: float
+    runtime_s: float
+    admitted_at: Optional[float] = None
+    completed_at: Optional[float] = None
+
+
+@dataclass
+class RunResult:
+    total_workloads: int = 0
+    admitted: int = 0
+    virtual_wall_s: float = 0.0
+    scheduling_wall_s: float = 0.0
+    cycles: int = 0
+    # workload class -> average time-to-admission (virtual seconds)
+    avg_time_to_admission_s: Dict[str, float] = field(default_factory=dict)
+    # CQ class -> minimum average utilization %
+    cq_class_min_usage_pct: Dict[str, float] = field(default_factory=dict)
+
+    def throughput(self) -> float:
+        if self.scheduling_wall_s <= 0:
+            return 0.0
+        return self.admitted / self.scheduling_wall_s
+
+
+def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
+    """Build the control plane + workload stream from a generator config
+    (reference test/performance/scheduler generator.yaml schema)."""
+    mgr = Manager()
+    mgr.apply(ResourceFlavor(name="default"))
+    out: List[GeneratedWorkload] = []
+
+    for cohort_set in config.get("cohorts", []):
+        cohort_class = cohort_set.get("className", "cohort")
+        for ci in range(cohort_set.get("count", 1)):
+            cohort_name = f"{cohort_class}-{ci}"
+            mgr.apply(Cohort(name=cohort_name))
+            for queue_set in cohort_set.get("queuesSets", []):
+                cq_class = queue_set.get("className", "cq")
+                nominal = queue_set.get("nominalQuota", 10) * 1000
+                borrowing = queue_set.get("borrowingLimit")
+                for qi in range(queue_set.get("count", 1)):
+                    cq_name = f"{cohort_name}-{cq_class}-{qi}"
+                    cq = ClusterQueue(
+                        name=cq_name,
+                        cohort=cohort_name,
+                        resource_groups=[
+                            ResourceGroup(
+                                covered_resources=["cpu"],
+                                flavors=[FlavorQuotas(
+                                    name="default",
+                                    resources={"cpu": ResourceQuota(
+                                        nominal=nominal,
+                                        borrowing_limit=(
+                                            borrowing * 1000
+                                            if borrowing is not None
+                                            else None
+                                        ),
+                                    )},
+                                )],
+                            )
+                        ],
+                        preemption=ClusterQueuePreemption(
+                            reclaim_within_cohort=PreemptionPolicy(
+                                queue_set.get("reclaimWithinCohort", "Never")
+                            ),
+                            within_cluster_queue=PreemptionPolicy(
+                                queue_set.get("withinClusterQueue", "Never")
+                            ),
+                        ),
+                    )
+                    mgr.apply(cq)
+                    lq = LocalQueue(name=f"lq-{cq_name}",
+                                    cluster_queue=cq_name)
+                    mgr.apply(lq)
+                    for ws in queue_set.get("workloadsSets", []):
+                        interval_s = ws.get("creationIntervalMs", 0) / 1000.0
+                        t = 0.0
+                        n = ws.get("count", 0)
+                        specs = ws.get("workloads", [])
+                        for i in range(n):
+                            spec = specs[i % len(specs)]
+                            t += interval_s
+                            wl = Workload(
+                                name=(
+                                    f"{cq_name}-{spec.get('className', 'wl')}"
+                                    f"-{i}"
+                                ),
+                                queue_name=lq.name,
+                                priority=spec.get("priority", 0),
+                                pod_sets=[PodSet(
+                                    name="main", count=1,
+                                    requests={
+                                        "cpu": spec.get("request", 1) * 1000
+                                    },
+                                )],
+                            )
+                            out.append(GeneratedWorkload(
+                                wl=wl,
+                                klass=spec.get("className", "wl"),
+                                cq_name=cq_name,
+                                cq_class=cq_class,
+                                create_at=t,
+                                runtime_s=(
+                                    spec.get("runtimeMs", 100) / 1000.0
+                                ),
+                            ))
+    return mgr, out
+
+
+def run(config: dict) -> RunResult:
+    """Event-driven virtual-time simulation (reference runner/main.go:118
+    'mimic workload execution')."""
+    mgr, gens = generate(config)
+    by_key = {g.wl.key: g for g in gens}
+    nominal_of: Dict[str, int] = {}
+    class_of_cq: Dict[str, str] = {}
+    for g in gens:
+        class_of_cq[g.cq_name] = g.cq_class
+    for name, cq in mgr.cache.cluster_queues.items():
+        nominal_of[name] = sum(
+            q.nominal
+            for rg in cq.resource_groups
+            for fq in rg.flavors
+            for q in fq.resources.values()
+        )
+
+    events: List[Tuple[float, int, int, str]] = []  # (t, kind, seq, key)
+    for i, g in enumerate(gens):
+        heapq.heappush(events, (g.create_at, CREATE, i, g.wl.key))
+
+    vclock = 0.0
+    # Time-weighted CQ usage integral for utilization.
+    usage_now: Dict[str, int] = {name: 0 for name in nominal_of}
+    usage_integral: Dict[str, float] = {name: 0.0 for name in nominal_of}
+    last_sample = 0.0
+    sched_wall = 0.0
+    cycles = 0
+    result = RunResult(total_workloads=len(gens))
+    seq = len(gens)
+
+    def advance_to(t: float) -> None:
+        nonlocal last_sample, vclock
+        dt = t - last_sample
+        if dt > 0:
+            for name, u in usage_now.items():
+                usage_integral[name] += u * dt
+        last_sample = t
+        vclock = t
+
+    while events:
+        t, kind, _seq, key = heapq.heappop(events)
+        advance_to(t)
+        g = by_key[key]
+        if kind == CREATE:
+            mgr.create_workload(g.wl)
+        else:
+            if g.completed_at is None:
+                g.completed_at = vclock
+                usage_now[g.cq_name] -= g.wl.pod_sets[0].requests["cpu"]
+                mgr.finish_workload(g.wl)
+
+        # Batch all events at the same instant before scheduling.
+        while events and events[0][0] <= vclock + 1e-9:
+            t2, kind2, _s2, key2 = heapq.heappop(events)
+            g2 = by_key[key2]
+            if kind2 == CREATE:
+                mgr.create_workload(g2.wl)
+            elif g2.completed_at is None:
+                g2.completed_at = vclock
+                usage_now[g2.cq_name] -= g2.wl.pod_sets[0].requests["cpu"]
+                mgr.finish_workload(g2.wl)
+
+        t0 = time.monotonic()
+        while True:
+            r = mgr.schedule()
+            cycles += 1
+            for akey in r.admitted:
+                ag = by_key.get(akey)
+                if ag is not None and ag.admitted_at is None:
+                    ag.admitted_at = vclock
+                    usage_now[ag.cq_name] += ag.wl.pod_sets[0].requests["cpu"]
+                    seq += 1
+                    heapq.heappush(
+                        events,
+                        (vclock + ag.runtime_s, COMPLETE, seq, akey),
+                    )
+            if not r.admitted and not r.preempted:
+                break
+        sched_wall += time.monotonic() - t0
+
+    advance_to(vclock)
+    result.virtual_wall_s = vclock
+    result.scheduling_wall_s = sched_wall
+    result.cycles = cycles
+    result.admitted = sum(1 for g in gens if g.admitted_at is not None)
+
+    sums: Dict[str, List[float]] = {}
+    for g in gens:
+        if g.admitted_at is not None:
+            sums.setdefault(g.klass, []).append(g.admitted_at - g.create_at)
+    result.avg_time_to_admission_s = {
+        k: sum(v) / len(v) for k, v in sums.items()
+    }
+
+    per_class_util: Dict[str, List[float]] = {}
+    for name, integral in usage_integral.items():
+        if vclock <= 0 or nominal_of.get(name, 0) <= 0:
+            continue
+        util = 100.0 * integral / (vclock * nominal_of[name])
+        per_class_util.setdefault(class_of_cq.get(name, "cq"), []).append(util)
+    result.cq_class_min_usage_pct = {
+        k: min(v) for k, v in per_class_util.items()
+    }
+    return result
+
+
+def check(result: RunResult, rangespec: dict) -> List[str]:
+    """Compare against a rangespec (reference checker). Returns violations;
+    empty list = pass."""
+    violations: List[str] = []
+    cmd = rangespec.get("cmd", {})
+    max_wall_ms = cmd.get("maxWallMs")
+    if max_wall_ms is not None and result.virtual_wall_s * 1000 > max_wall_ms:
+        violations.append(
+            f"virtual wall {result.virtual_wall_s*1000:.0f}ms > "
+            f"maxWallMs {max_wall_ms}"
+        )
+    for cq_class, floor in (
+        rangespec.get("clusterQueueClassesMinUsage") or {}
+    ).items():
+        got = result.cq_class_min_usage_pct.get(cq_class, 0.0)
+        if got < floor:
+            violations.append(
+                f"cq class {cq_class} min usage {got:.1f}% < floor {floor}%"
+            )
+    for klass, limit_ms in (
+        rangespec.get("wlClassesMaxAvgTimeToAdmissionMs") or {}
+    ).items():
+        got = result.avg_time_to_admission_s.get(klass)
+        if got is None:
+            violations.append(f"no admissions for class {klass}")
+        elif got * 1000 > limit_ms:
+            violations.append(
+                f"class {klass} avg time-to-admission {got*1000:.0f}ms > "
+                f"{limit_ms}ms"
+            )
+    return violations
+
+
+def run_config_files(generator_path: str, rangespec_path: Optional[str] = None):
+    with open(generator_path) as f:
+        config = yaml.safe_load(f)
+    result = run(config)
+    violations = []
+    if rangespec_path:
+        with open(rangespec_path) as f:
+            rangespec = yaml.safe_load(f)
+        violations = check(result, rangespec)
+    return result, violations

@@ -1,0 +1,68 @@
+"""Performance harness tests: a scaled-down reference baseline config run
+through the generator/runner/checker."""
+
+from kueue_tpu.perf.harness import check, generate, run
+
+SMALL_BASELINE = {
+    # 1/10-scale version of the reference baseline generator.yaml.
+    "cohorts": [{
+        "className": "cohort",
+        "count": 2,
+        "queuesSets": [{
+            "className": "cq",
+            "count": 3,
+            "nominalQuota": 20,
+            "borrowingLimit": 100,
+            "reclaimWithinCohort": "Any",
+            "withinClusterQueue": "LowerPriority",
+            "workloadsSets": [
+                {"count": 35, "creationIntervalMs": 100,
+                 "workloads": [{"className": "small", "runtimeMs": 200,
+                                "priority": 50, "request": 1}]},
+                {"count": 10, "creationIntervalMs": 500,
+                 "workloads": [{"className": "medium", "runtimeMs": 500,
+                                "priority": 100, "request": 5}]},
+                {"count": 5, "creationIntervalMs": 1200,
+                 "workloads": [{"className": "large", "runtimeMs": 1000,
+                                "priority": 200, "request": 20}]},
+            ],
+        }],
+    }],
+}
+
+
+def test_generate_shapes():
+    mgr, gens = generate(SMALL_BASELINE)
+    assert len(mgr.cache.cluster_queues) == 6
+    assert len(gens) == 6 * 50
+    classes = {g.klass for g in gens}
+    assert classes == {"small", "medium", "large"}
+
+
+def test_run_admits_everything():
+    result = run(SMALL_BASELINE)
+    assert result.admitted == result.total_workloads
+    assert result.virtual_wall_s > 0
+    assert set(result.avg_time_to_admission_s) == {"small", "medium",
+                                                   "large"}
+    # Large jobs are high priority; their admission latency must not be
+    # pathological relative to the run.
+    assert result.cq_class_min_usage_pct["cq"] > 0
+
+
+def test_checker_flags_violations():
+    result = run(SMALL_BASELINE)
+    ok = check(result, {
+        "cmd": {"maxWallMs": result.virtual_wall_s * 1000 + 1000},
+        "clusterQueueClassesMinUsage": {"cq": 0},
+        "wlClassesMaxAvgTimeToAdmissionMs": {
+            "small": 10_000_000, "medium": 10_000_000, "large": 10_000_000,
+        },
+    })
+    assert ok == []
+    bad = check(result, {
+        "cmd": {"maxWallMs": 1},
+        "clusterQueueClassesMinUsage": {"cq": 101},
+        "wlClassesMaxAvgTimeToAdmissionMs": {"small": 0},
+    })
+    assert len(bad) == 3
