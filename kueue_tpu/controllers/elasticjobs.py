@@ -1,0 +1,141 @@
+"""Elastic jobs: scale running workloads via workload slices.
+
+Behavioral surface: reference pkg/workloadslicing + pkg/controller/
+elasticjobs — a scale-up admits a *replacement slice*: a new workload
+carrying the new counts that treats the old slice as a preemptible
+replacement target, so the job keeps its current allocation until the
+larger one is granted atomically. Scale-down releases the delta
+immediately.
+
+The admission transaction here: simulate removal of the old slice, run the
+scheduler's assignment for the new slice, and only commit the swap when the
+new slice fits (otherwise the old allocation is untouched and the request
+stays pending for retry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.api.constants import COND_ADMITTED, COND_QUOTA_RESERVED
+from kueue_tpu.api.types import Admission, PodSetAssignment, Workload
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    is_admitted,
+    set_condition,
+)
+from kueue_tpu.scheduler.flavorassigner import FlavorAssigner, Mode
+
+REPLACED_SLICE_LABEL = "kueue.x-k8s.io/replaced-workload-slice"
+
+
+def scale(manager, wl: Workload, new_counts: Dict[str, int]) -> Tuple[bool, str]:
+    """Scale an admitted workload's podsets to ``new_counts`` (podset name
+    -> count). Returns (applied, message).
+
+    Scale-down applies immediately (usage delta released). Scale-up runs
+    the replacement-slice admission: old usage is treated as reclaimable
+    during the fit check (reference workloadslicing.go:165
+    EnsureWorkloadSlices / :344 ReplacedWorkloadSlice)."""
+    if not is_admitted(wl):
+        return False, "workload is not admitted; edit the spec and resubmit"
+    info = manager.cache.workloads.get(wl.key)
+    if info is None:
+        return False, "workload not found in cache"
+
+    old_counts = {ps.name: ps.count for ps in wl.pod_sets}
+    if new_counts == old_counts:
+        return True, "no change"
+    scale_up = any(
+        new_counts.get(name, c) > c for name, c in old_counts.items()
+    )
+
+    # Build the new slice (same spec, new counts).
+    new_pod_sets = []
+    for ps in wl.pod_sets:
+        count = new_counts.get(ps.name, ps.count)
+        if count < 0:
+            return False, f"invalid count {count} for podset {ps.name}"
+        new_pod_sets.append(dataclasses.replace(ps, count=count))
+
+    if not scale_up:
+        _apply_counts(manager, wl, info, new_pod_sets)
+        return True, "scaled down"
+
+    # Scale-up: fit the new slice with the old slice's usage removed
+    # (the old slice is the replacement target).
+    snapshot = manager.cache.snapshot()
+    old_info = snapshot.cluster_queues[info.cluster_queue].workloads.get(
+        wl.key
+    )
+    if old_info is not None:
+        revert = snapshot.simulate_workload_removal([old_info])
+    else:
+        revert = lambda: None
+    try:
+        candidate = wl.clone()
+        candidate.pod_sets = new_pod_sets
+        cand_info = WorkloadInfo(candidate, info.cluster_queue)
+        assigner = FlavorAssigner(
+            cand_info,
+            snapshot.cluster_queues[info.cluster_queue],
+            snapshot.resource_flavors,
+            tas_flavors=snapshot.tas_flavors,
+        )
+        assignment = assigner.assign()
+        if assignment.representative_mode() != Mode.FIT:
+            return False, (
+                "insufficient quota for the scaled slice; keeping current "
+                "allocation"
+            )
+    finally:
+        revert()
+
+    # Commit the swap atomically: new admission replaces the old slice.
+    now = manager.clock()
+    wl.pod_sets = new_pod_sets
+    wl.status.admission = Admission(
+        cluster_queue=info.cluster_queue,
+        pod_set_assignments=[
+            PodSetAssignment(
+                name=psa.name,
+                flavors={r: fa.name for r, fa in psa.flavors.items()},
+                resource_usage=dict(psa.requests),
+                count=psa.count,
+                topology_assignment=psa.topology_assignment,
+            )
+            for psa in assignment.pod_sets
+        ],
+    )
+    set_condition(wl, COND_QUOTA_RESERVED, True, "SliceReplaced",
+                  "Quota reserved for the scaled slice", now)
+    set_condition(wl, COND_ADMITTED, True, "SliceReplaced",
+                  "Scaled slice admitted", now)
+    fresh = WorkloadInfo(wl, info.cluster_queue)
+    fresh.sync_assignment_from_admission()
+    manager.cache.add_or_update_workload(fresh)
+    manager.queues.queue_inadmissible_workloads()
+    return True, "scaled up via replacement slice"
+
+
+def _apply_counts(manager, wl: Workload, info: WorkloadInfo, new_pod_sets) -> None:
+    now = manager.clock()
+    wl.pod_sets = new_pod_sets
+    adm = wl.status.admission
+    per_pod = {ps.name: ps.requests for ps in new_pod_sets}
+    for psa in adm.pod_set_assignments:
+        count = next(
+            (ps.count for ps in new_pod_sets if ps.name == psa.name),
+            psa.count,
+        )
+        psa.count = count
+        psa.resource_usage = {
+            r: v * count for r, v in per_pod.get(psa.name, {}).items()
+        }
+    fresh = WorkloadInfo(wl, info.cluster_queue)
+    fresh.sync_assignment_from_admission()
+    manager.cache.add_or_update_workload(fresh)
+    manager.queues.queue_inadmissible_workloads()
+    set_condition(wl, COND_ADMITTED, True, "SliceScaledDown",
+                  "Scaled down in place", now)

@@ -1,0 +1,72 @@
+"""Elastic jobs (workload slices) tests."""
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.controllers.elasticjobs import scale
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq, make_wl
+
+
+def env(quota_m=10_000):
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(quota_m)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def test_scale_up_within_quota():
+    mgr = env()
+    wl = make_wl("elastic", cpu_m=1000, count=2)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+
+    ok, msg = scale(mgr, wl, {"main": 6})
+    assert ok, msg
+    assert wl.status.admission.pod_set_assignments[0].count == 6
+    info = mgr.cache.workloads[wl.key]
+    from kueue_tpu.core.resources import FlavorResource
+
+    assert info.usage()[FlavorResource("default", "cpu")] == 6000
+
+
+def test_scale_up_beyond_quota_keeps_old_allocation():
+    mgr = env(quota_m=4_000)
+    wl = make_wl("elastic", cpu_m=1000, count=3)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+
+    ok, msg = scale(mgr, wl, {"main": 10})
+    assert not ok
+    assert wl.status.admission.pod_set_assignments[0].count == 3
+    assert is_admitted(wl)
+
+
+def test_scale_up_uses_own_old_allocation():
+    """The new slice may reuse the old slice's quota: 3->4 works even when
+    only 1 unit is otherwise free."""
+    mgr = env(quota_m=4_000)
+    wl = make_wl("elastic", cpu_m=1000, count=3)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    ok, msg = scale(mgr, wl, {"main": 4})
+    assert ok, msg
+    assert wl.status.admission.pod_set_assignments[0].count == 4
+
+
+def test_scale_down_releases_quota():
+    mgr = env(quota_m=4_000)
+    wl = make_wl("elastic", cpu_m=1000, count=4)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    ok, _ = scale(mgr, wl, {"main": 1})
+    assert ok
+    other = make_wl("other", cpu_m=3000)
+    mgr.create_workload(other)
+    mgr.schedule_all()
+    assert is_admitted(other)
