@@ -1,0 +1,135 @@
+"""TAS node-failure detection and recovery.
+
+Behavioral surface: reference pkg/controller/tas/node_controller.go
+(unhealthy-node detection -> Workload.Status.UnhealthyNodes) +
+tas_flavor_snapshot.go:743 findReplacementAssignment (replace only the
+failed node's share of the gang, keeping the rest in place) +
+scheduler.go:417 fail-fast eviction when no replacement exists
+(TASFailedNodeReplacement / TASFailedNodeReplacementFailFast gates).
+
+For TPU fleets this is the host-failure path: a dead host inside an ICI
+domain gets its pods re-placed onto a healthy host — same rack first —
+without restarting the rest of the gang when possible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.api.constants import EVICTED_BY_NODE_FAILURE
+from kueue_tpu.api.types import TopologyAssignment, Workload
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.tas.snapshot import PlacementRequest
+from kueue_tpu.utils import features
+
+
+class TASNodeFailureController:
+    """Drives detection + recovery; the manager calls ``node_unhealthy`` on
+    node events and ``reconcile`` from tick()."""
+
+    def __init__(self, manager) -> None:
+        self.manager = manager
+
+    # -- detection ----------------------------------------------------------
+
+    def node_unhealthy(self, node_name: str) -> List[str]:
+        """Mark the node unhealthy and flag every admitted workload whose
+        topology assignment uses it. Returns affected workload keys."""
+        node = self.manager.cache.nodes.get(node_name)
+        if node is not None:
+            node.ready = False
+            self.manager.cache.generation += 1
+        affected = []
+        for key, wl in self.manager.workloads.items():
+            if not is_admitted(wl) or wl.status.admission is None:
+                continue
+            for psa in wl.status.admission.pod_set_assignments:
+                ta = psa.topology_assignment
+                if ta is None:
+                    continue
+                if any(values[-1] == node_name for values, _ in ta.domains):
+                    if node_name not in wl.status.unhealthy_nodes:
+                        wl.status.unhealthy_nodes.append(node_name)
+                    affected.append(key)
+                    break
+        return affected
+
+    def node_recovered(self, node_name: str) -> None:
+        node = self.manager.cache.nodes.get(node_name)
+        if node is not None:
+            node.ready = True
+            self.manager.cache.generation += 1
+
+    # -- recovery -----------------------------------------------------------
+
+    def reconcile(self) -> None:
+        if not features.enabled("TASFailedNodeReplacement"):
+            return
+        for wl in list(self.manager.workloads.values()):
+            if wl.status.unhealthy_nodes and is_admitted(wl):
+                self._recover(wl)
+
+    def _recover(self, wl: Workload) -> None:
+        """Find replacement nodes for the failed share of each affected
+        podset; evict fail-fast when impossible."""
+        mgr = self.manager
+        snapshot = mgr.cache.snapshot()  # unhealthy nodes already excluded
+        failed = set(wl.status.unhealthy_nodes)
+        ok = True
+        info = mgr.cache.workloads.get(wl.key)
+        for i, psa in enumerate(wl.status.admission.pod_set_assignments):
+            ta = psa.topology_assignment
+            if ta is None or i >= len(wl.pod_sets):
+                continue
+            lost = [(v, c) for v, c in ta.domains if v[-1] in failed]
+            if not lost:
+                continue
+            keep = [(v, c) for v, c in ta.domains if v[-1] not in failed]
+            lost_count = sum(c for _, c in lost)
+            ps = wl.pod_sets[i]
+            flavor = next(iter(psa.flavors.values()), None)
+            tas = snapshot.tas_flavors.get(flavor)
+            if tas is None:
+                ok = False
+                break
+            tr = ps.topology_request
+            req = PlacementRequest(
+                count=lost_count,
+                single_pod_requests=dict(ps.requests),
+                # The replacement must stay within the original constraint
+                # level; reference keeps the existing domain when possible.
+                required_level=tr.required_level if tr else None,
+                preferred_level=tr.preferred_level if tr else None,
+                unconstrained=tr.unconstrained if tr else True,
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            )
+            # The workload's own surviving usage stays; its lost usage was
+            # on the dead node whose capacity is excluded, so plain
+            # placement against current usage is correct.
+            replacement, _, reason = tas.find_topology_assignment(req)
+            if reason:
+                ok = False
+                break
+            merged: Dict[Tuple[str, ...], int] = {}
+            for v, c in keep + list(replacement.domains):
+                merged[v] = merged.get(v, 0) + c
+            psa.topology_assignment = TopologyAssignment(
+                levels=replacement.levels or ta.levels,
+                domains=sorted(merged.items()),
+            )
+        if ok:
+            wl.status.unhealthy_nodes = []
+            if info is not None:
+                info.sync_assignment_from_admission()
+                mgr.cache.add_or_update_workload(info)
+            mgr.metrics.inc("tas_node_replacements_total")
+        elif features.enabled("TASFailedNodeReplacementFailFast"):
+            mgr.workload_controller.evict(
+                wl, EVICTED_BY_NODE_FAILURE,
+                "No replacement for unhealthy node(s): "
+                + ",".join(sorted(failed)),
+                mgr.clock(),
+            )
+            wl.status.unhealthy_nodes = []
+            mgr.metrics.inc("tas_node_replacement_failures_total")

@@ -96,6 +96,9 @@ class Manager:
             self, pods_ready=pods_ready, retention=retention
         )
         self.check_controllers: Dict[str, AdmissionCheckController] = {}
+        from kueue_tpu.controllers.tas_failure import TASNodeFailureController
+
+        self.tas_failure = TASNodeFailureController(self)
 
     # ------------------------------------------------------------------
     # configuration objects
@@ -228,6 +231,7 @@ class Manager:
     def tick(self) -> None:
         """Clock-driven reconciliation: admission checks, timeouts,
         backoffs, retention, job sync."""
+        self.tas_failure.reconcile()
         for wl in list(self.workloads.values()):
             self._sync_admission_checks(wl)
             self.workload_controller.reconcile(wl)

@@ -90,7 +90,7 @@ def encode_cycle(
 
     from kueue_tpu.ops import quota_ops
 
-    subtree, usage_full = quota_ops.compute_subtree(tree, usage, is_cq)
+    subtree, usage_full = quota_ops.compute_subtree_jit(tree, usage, is_cq)
     tree = tree._replace(subtree_quota=subtree)
 
     idx = CycleIndex(

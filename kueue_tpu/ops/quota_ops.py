@@ -190,6 +190,12 @@ def potential_available_all(tree: QuotaTreeArrays) -> jnp.ndarray:
     return pot
 
 
+# Jitted alias: encoders call compute_subtree once per cycle; eager
+# execution would issue ~50 small dispatches (very costly over a remote
+# device transport).
+compute_subtree_jit = jax.jit(compute_subtree)
+
+
 def ancestor_chain(tree: QuotaTreeArrays, node: jnp.ndarray) -> jnp.ndarray:
     """Indices of node, parent, grandparent, ... padded by repeating the
     root. Returns i32[MAX_DEPTH+1]."""

@@ -1,0 +1,81 @@
+"""TAS node-failure recovery tests (reference tas/node_controller +
+findReplacementAssignment + fail-fast eviction)."""
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.core.workload_info import is_admitted, is_evicted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq
+from .test_tas import LEVELS, make_nodes, make_topology, tas_wl
+
+
+def tas_manager(nodes=None):
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        make_topology(),
+    )
+    for node in nodes or make_nodes():
+        mgr.apply(node)
+    return mgr
+
+
+def assigned_nodes(wl):
+    ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    return {v[-1] for v, _ in ta.domains}
+
+
+def test_replacement_found_on_healthy_node():
+    mgr = tas_manager()
+    wl = tas_wl("gang", count=2)  # 2 pods x 4 tpu = one rack
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    before = assigned_nodes(wl)
+    dead = sorted(before)[0]
+
+    affected = mgr.tas_failure.node_unhealthy(dead)
+    assert affected == [wl.key]
+    assert wl.status.unhealthy_nodes == [dead]
+
+    mgr.tick()
+    assert is_admitted(wl)
+    after = assigned_nodes(wl)
+    assert dead not in after
+    assert wl.status.unhealthy_nodes == []
+    # The surviving node keeps its pods.
+    assert (before - {dead}) <= after
+
+
+def test_no_replacement_evicts_fail_fast():
+    # Tiny fleet: 1 block x 1 rack x 2 nodes; gang uses both; kill one.
+    nodes = make_nodes(blocks=1, racks=1, nodes=2)
+    mgr = tas_manager(nodes)
+    wl = tas_wl("gang", count=2)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    dead = sorted(assigned_nodes(wl))[0]
+    mgr.tas_failure.node_unhealthy(dead)
+    mgr.tick()
+    assert is_evicted(wl)
+    assert not is_admitted(wl)
+
+
+def test_recovered_node_serves_again():
+    nodes = make_nodes(blocks=1, racks=1, nodes=2)
+    mgr = tas_manager(nodes)
+    wl = tas_wl("gang", count=2)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    dead = sorted(assigned_nodes(wl))[0]
+    mgr.tas_failure.node_unhealthy(dead)
+    mgr.tick()
+    assert is_evicted(wl)
+    mgr.tas_failure.node_recovered(dead)
+    mgr.queues.queue_inadmissible_workloads()
+    mgr.schedule_all()
+    assert is_admitted(wl)
