@@ -1,0 +1,233 @@
+"""Preemption scenario tests mirroring reference
+pkg/scheduler/preemption/preemption_test.go patterns: hierarchical reclaim,
+borrowWithinCohort thresholds, minimization (fill-back), and fair-sharing
+(DRF) preemption."""
+
+import pytest
+
+from kueue_tpu.api.constants import (
+    BorrowWithinCohortPolicy,
+    PreemptionPolicy,
+)
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorQuotas,
+    quota,
+)
+from kueue_tpu.core.workload_info import is_admitted, is_evicted
+
+from .helpers import admitted_names, build_env, make_cq, make_wl, submit
+
+
+def test_preemption_minimizes_victims():
+    """Fill-back: only as many victims as needed are evicted."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    smalls = [
+        make_wl(f"s{i}", cpu_m=1_000, priority=1, creation_time=float(i + 1))
+        for i in range(4)
+    ]
+    submit(queues, *smalls)
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 4
+
+    hi = make_wl("hi", cpu_m=2_000, priority=10, creation_time=10.0)
+    submit(queues, hi)
+    sched.schedule_all()
+    assert "hi" in admitted_names(cache)
+    evicted = [w.obj.name if hasattr(w, "obj") else w.name
+               for w in smalls if is_evicted(w)]
+    assert len(evicted) == 2, f"expected exactly 2 victims, got {evicted}"
+
+
+def test_hierarchical_reclaim_nested_cohorts():
+    """Nested cohorts: team cohort under org cohort; the entitled CQ
+    reclaims from a borrower in a sibling subtree."""
+    cohorts = [
+        Cohort(name="org"),
+        Cohort(name="team-x", parent="org"),
+        Cohort(name="team-y", parent="org"),
+    ]
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-x", cohort="team-x",
+                flavors={"default": {"cpu": quota(4_000)}},
+                preemption=ClusterQueuePreemption(
+                    reclaim_within_cohort=PreemptionPolicy.ANY
+                ),
+            ),
+            make_cq(
+                "cq-y", cohort="team-y",
+                flavors={"default": {"cpu": quota(4_000)}},
+            ),
+        ],
+        cohorts=cohorts,
+    )
+    borrower = make_wl("borrower", queue="lq-cq-y", cpu_m=8_000,
+                       creation_time=1.0)
+    submit(queues, borrower)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["borrower"]
+
+    entitled = make_wl("entitled", queue="lq-cq-x", cpu_m=4_000,
+                       creation_time=2.0)
+    submit(queues, entitled)
+    sched.schedule_all()
+    assert "entitled" in admitted_names(cache)
+    assert is_evicted(borrower)
+
+
+def test_borrow_within_cohort_threshold():
+    """borrowWithinCohort LowerPriority with maxPriorityThreshold: victims
+    above the threshold cannot be preempted when the preemptor would
+    borrow."""
+    preemption = ClusterQueuePreemption(
+        reclaim_within_cohort=PreemptionPolicy.ANY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+            max_priority_threshold=100,
+        ),
+    )
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}},
+                    preemption=preemption),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}}),
+        ],
+    )
+    # Low-priority victim in cq-b borrowing 2000 beyond nominal (below the
+    # threshold): preemptable even while cq-a itself borrows.
+    victim = make_wl("victim", queue="lq-cq-b", cpu_m=4_000, priority=50,
+                     creation_time=1.0)
+    submit(queues, victim)
+    sched.schedule_all()
+    assert "victim" in admitted_names(cache)
+
+    # Preemptor needs 4000 (borrowing 2000 above nominal).
+    preemptor = make_wl("preemptor", queue="lq-cq-a", cpu_m=4_000,
+                        priority=200, creation_time=2.0)
+    submit(queues, preemptor)
+    sched.schedule_all()
+    assert "preemptor" in admitted_names(cache)
+    assert is_evicted(victim)
+
+
+def test_borrow_within_cohort_protects_high_priority():
+    preemption = ClusterQueuePreemption(
+        reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+            max_priority_threshold=100,
+        ),
+    )
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}},
+                    preemption=preemption),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}}),
+        ],
+    )
+    # Victim borrowing, above the threshold (150 > 100) though below the
+    # preemptor's priority.
+    victim = make_wl("protected", queue="lq-cq-b", cpu_m=4_000, priority=150,
+                     creation_time=1.0)
+    submit(queues, victim)
+    sched.schedule_all()
+    assert "protected" in admitted_names(cache)
+
+    preemptor = make_wl("preemptor", queue="lq-cq-a", cpu_m=4_000,
+                        priority=200, creation_time=2.0)
+    submit(queues, preemptor)
+    sched.schedule_all()
+    # Preemptor would borrow, victim is above threshold -> no preemption.
+    assert "protected" in admitted_names(cache)
+    assert not is_evicted(victim)
+    assert "preemptor" not in admitted_names(cache)
+
+
+def test_fair_sharing_preemption_balances_shares():
+    """DRF preemption: the CQ with the highest dominant share loses."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={"default": {"cpu": quota(3_000)}},
+                preemption=ClusterQueuePreemption(
+                    reclaim_within_cohort=PreemptionPolicy.ANY
+                ),
+            ),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(3_000)}}),
+            make_cq("cq-c", cohort="co",
+                    flavors={"default": {"cpu": quota(3_000)}}),
+        ],
+        fair_sharing=True,
+    )
+    # cq-b borrows heavily (3 workloads of 2000 = 6000, share over nominal
+    # 3000); cq-c modestly (one 4000).
+    for i in range(3):
+        submit(queues, make_wl(f"b{i}", queue="lq-cq-b", cpu_m=2_000,
+                               creation_time=float(i + 1)))
+    submit(queues, make_wl("c0", queue="lq-cq-c", cpu_m=3_000,
+                           creation_time=4.0))
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 4
+
+    # cq-a wants its nominal back.
+    submit(queues, make_wl("a0", queue="lq-cq-a", cpu_m=3_000,
+                           creation_time=5.0))
+    sched.schedule_all()
+    assert "a0" in admitted_names(cache)
+    # The victim must come from cq-b (highest share), not cq-c.
+    evicted_b = [f"b{i}" for i in range(3)
+                 if f"b{i}" not in admitted_names(cache)]
+    assert evicted_b, "expected a victim from the highest-share CQ (cq-b)"
+    assert "c0" in admitted_names(cache)
+
+
+def test_preemption_overlap_skipped_within_cycle():
+    """Two preemptors sharing a victim: only one preempts per cycle
+    (PreemptedWorkloads overlap set)."""
+    preemption = ClusterQueuePreemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+    )
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", flavors={"default": {"cpu": quota(2_000)}},
+                    preemption=preemption),
+            make_cq("cq-b", flavors={"default": {"cpu": quota(2_000)}},
+                    preemption=preemption),
+        ],
+    )
+    v1 = make_wl("v1", queue="lq-cq-a", cpu_m=2_000, priority=1,
+                 creation_time=1.0)
+    v2 = make_wl("v2", queue="lq-cq-b", cpu_m=2_000, priority=1,
+                 creation_time=1.5)
+    submit(queues, v1, v2)
+    sched.schedule_all()
+
+    h1 = make_wl("h1", queue="lq-cq-a", cpu_m=2_000, priority=10,
+                 creation_time=2.0)
+    h2 = make_wl("h2", queue="lq-cq-b", cpu_m=2_000, priority=10,
+                 creation_time=3.0)
+    submit(queues, h1, h2)
+    sched.schedule_all()
+    assert "h1" in admitted_names(cache)
+    assert "h2" in admitted_names(cache)
+    assert is_evicted(v1) and is_evicted(v2)
