@@ -51,6 +51,8 @@ class Cache:
         self.workloads: Dict[str, WorkloadInfo] = {}
         self.assumed: Set[str] = set()
         self.generation = 0
+        # Structure cache for TAS snapshots: (generation, template).
+        self._tas_templates: Dict[str, tuple] = {}
 
     # -- spec management ----------------------------------------------------
 
@@ -178,21 +180,28 @@ class Cache:
             for name, node in nodes.items():
                 if not node.is_cq:
                     snap.cohorts[name] = node
-            # Per-flavor topology snapshots (reference tas_flavor.go).
+            # Per-flavor topology snapshots (reference tas_flavor.go). The
+            # domain tree + capacity arrays are immutable between node or
+            # topology changes, so they're cached and shared per cycle.
             for name, rf in self.resource_flavors.items():
                 if rf.topology_name and rf.topology_name in self.topologies:
-                    snap.tas_flavors[name] = TASFlavorSnapshot(
-                        self.topologies[rf.topology_name],
-                        self.nodes.values(),
-                        usage={
-                            k: dict(v)
-                            for k, v in self.non_tas_usage.get(
-                                name, {}
-                            ).items()
-                        },
-                        flavor_taints=rf.node_taints,
-                        flavor_tolerations=rf.tolerations,
-                    )
+                    cached = self._tas_templates.get(name)
+                    if cached is None or cached[0] != self.generation:
+                        template = TASFlavorSnapshot(
+                            self.topologies[rf.topology_name],
+                            self.nodes.values(),
+                            flavor_taints=rf.node_taints,
+                            flavor_tolerations=rf.tolerations,
+                        )
+                        self._tas_templates[name] = (self.generation, template)
+                    else:
+                        template = cached[1]
+                    tas = template.share_structure()
+                    tas.usage = {
+                        k: dict(v)
+                        for k, v in self.non_tas_usage.get(name, {}).items()
+                    }
+                    snap.tas_flavors[name] = tas
             for info in self.workloads.values():
                 if info.cluster_queue in snap.cluster_queues:
                     snap.add_workload(info.clone())

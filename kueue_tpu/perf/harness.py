@@ -33,12 +33,25 @@ from kueue_tpu.api.types import (
     ResourceFlavor,
     ResourceGroup,
     ResourceQuota,
+    Topology,
+    TopologyRequest,
     Workload,
 )
+from kueue_tpu.tas.snapshot import Node
 from kueue_tpu.core.workload_info import get_condition
 from kueue_tpu.manager import Manager
 
 CREATE, COMPLETE = 0, 1
+
+
+def _parse_q(v, resource: str) -> int:
+    from kueue_tpu.api.serialization import parse_quantity
+
+    return parse_quantity(v, resource)
+
+
+def _wl_cpu(wl) -> int:
+    return sum(ps.requests.get("cpu", 0) * ps.count for ps in wl.pod_sets)
 
 
 @dataclass
@@ -75,7 +88,44 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
     """Build the control plane + workload stream from a generator config
     (reference test/performance/scheduler generator.yaml schema)."""
     mgr = Manager()
-    mgr.apply(ResourceFlavor(name="default"))
+    flavor_name = "default"
+    # Optional topology section (reference configs/tas/generator.yaml).
+    topo_cfg = config.get("topology")
+    if topo_cfg:
+        levels = [lv["nodeLabel"] for lv in topo_cfg.get("levels", [])]
+        mgr.apply(Topology(name=topo_cfg.get("name", "topo"), levels=levels))
+        rf_cfg = config.get("resourceFlavor", {})
+        flavor_name = rf_cfg.get("name", "tas-flavor")
+        mgr.apply(ResourceFlavor(
+            name=flavor_name,
+            topology_name=topo_cfg.get("name", "topo"),
+        ))
+        # Materialize the node fleet from the per-level counts.
+        counts = [lv.get("count", 1) for lv in topo_cfg.get("levels", [])]
+        leaf_cfg = topo_cfg.get("levels", [])[-1] if topo_cfg.get("levels") \
+            else {}
+        cap = {
+            r: _parse_q(v, r)
+            for r, v in (leaf_cfg.get("capacity") or {"cpu": "96"}).items()
+        }
+
+        def emit(prefix, values, level):
+            if level == len(counts) - 1:
+                for i in range(counts[level]):
+                    name = "-".join(values + [str(i)]) or f"n{i}"
+                    labels = {
+                        levels[d]: "-".join(values[: d + 1])
+                        for d in range(len(values))
+                    }
+                    mgr.apply(Node(name=f"node-{name}", labels=labels,
+                                   capacity=dict(cap)))
+                return
+            for i in range(counts[level]):
+                emit(prefix, values + [f"{prefix}{level}x{i}"], level + 1)
+
+        emit("l", [], 0)
+    else:
+        mgr.apply(ResourceFlavor(name="default"))
     out: List[GeneratedWorkload] = []
 
     for cohort_set in config.get("cohorts", []):
@@ -85,7 +135,7 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
             mgr.apply(Cohort(name=cohort_name))
             for queue_set in cohort_set.get("queuesSets", []):
                 cq_class = queue_set.get("className", "cq")
-                nominal = queue_set.get("nominalQuota", 10) * 1000
+                nominal = _parse_q(queue_set.get("nominalQuota", 10), "cpu")
                 borrowing = queue_set.get("borrowingLimit")
                 for qi in range(queue_set.get("count", 1)):
                     cq_name = f"{cohort_name}-{cq_class}-{qi}"
@@ -96,11 +146,11 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
                             ResourceGroup(
                                 covered_resources=["cpu"],
                                 flavors=[FlavorQuotas(
-                                    name="default",
+                                    name=flavor_name,
                                     resources={"cpu": ResourceQuota(
                                         nominal=nominal,
                                         borrowing_limit=(
-                                            borrowing * 1000
+                                            _parse_q(borrowing, "cpu")
                                             if borrowing is not None
                                             else None
                                         ),
@@ -129,6 +179,20 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
                         for i in range(n):
                             spec = specs[i % len(specs)]
                             t += interval_s
+                            tr = None
+                            constraint = spec.get("tasConstraint")
+                            if constraint:
+                                level = spec.get("tasLevel")
+                                tr = TopologyRequest(
+                                    required_level=(
+                                        level if constraint == "required"
+                                        else None
+                                    ),
+                                    preferred_level=(
+                                        level if constraint in
+                                        ("preferred", "balanced") else None
+                                    ),
+                                )
                             wl = Workload(
                                 name=(
                                     f"{cq_name}-{spec.get('className', 'wl')}"
@@ -137,10 +201,14 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
                                 queue_name=lq.name,
                                 priority=spec.get("priority", 0),
                                 pod_sets=[PodSet(
-                                    name="main", count=1,
+                                    name="main",
+                                    count=spec.get("podCount", 1),
                                     requests={
-                                        "cpu": spec.get("request", 1) * 1000
+                                        "cpu": _parse_q(
+                                            spec.get("request", 1), "cpu"
+                                        )
                                     },
+                                    topology_request=tr,
                                 )],
                             )
                             out.append(GeneratedWorkload(
@@ -216,7 +284,7 @@ def run(config: dict) -> RunResult:
                 mgr.create_workload(g2.wl)
             elif g2.completed_at is None:
                 g2.completed_at = vclock
-                usage_now[g2.cq_name] -= g2.wl.pod_sets[0].requests["cpu"]
+                usage_now[g2.cq_name] -= _wl_cpu(g2.wl)
                 mgr.finish_workload(g2.wl)
 
         t0 = time.monotonic()
@@ -227,7 +295,7 @@ def run(config: dict) -> RunResult:
                 ag = by_key.get(akey)
                 if ag is not None and ag.admitted_at is None:
                     ag.admitted_at = vclock
-                    usage_now[ag.cq_name] += ag.wl.pod_sets[0].requests["cpu"]
+                    usage_now[ag.cq_name] += _wl_cpu(ag.wl)
                     seq += 1
                     heapq.heappush(
                         events,

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
+
+import numpy as np
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from kueue_tpu.api.types import (
@@ -123,6 +125,7 @@ class TASFlavorSnapshot:
         self.leaves: List[Domain] = []
         self.roots: List[Domain] = []
         self._leaf_alias: Dict[str, str] = {}  # hostname -> full leaf id
+        self._match_cache: Dict = {}
         self.domains_per_level: List[List[Domain]] = [
             [] for _ in self.level_keys
         ]
@@ -146,6 +149,47 @@ class TASFlavorSnapshot:
             self.nodes_by_leaf.setdefault(leaf.id, []).append(node)
             if self.lowest_is_node:
                 self._leaf_alias[values[-1]] = leaf.id
+        self._build_static_arrays()
+
+    def _build_static_arrays(self) -> None:
+        """Dense per-leaf capacity arrays for the vectorized phase-1 fill
+        (the Python per-leaf loop dominates at fleet scale otherwise)."""
+        res: set = set()
+        for nodes in self.nodes_by_leaf.values():
+            for node in nodes:
+                res.update(node.capacity)
+        self._res_names = sorted(res)
+        self._res_index = {r: i for i, r in enumerate(self._res_names)}
+        ln = len(self.leaves)
+        rn = max(len(self._res_names), 1)
+        self._leaf_cap = np.zeros((ln, rn), dtype=np.int64)
+        self._leaf_index = {leaf.id: i for i, leaf in enumerate(self.leaves)}
+        for i, leaf in enumerate(self.leaves):
+            for node in self.nodes_by_leaf.get(leaf.id, []):
+                for r, v in node.capacity.items():
+                    self._leaf_cap[i, self._res_index[r]] += v
+        # Per-level parent index vectors for the vectorized roll-up: for
+        # each domain at level l, the position of its parent at level l-1.
+        self._level_parent_idx: List[Optional[np.ndarray]] = [None]
+        for l in range(1, len(self.level_keys)):
+            pos = {id(d): i for i, d in enumerate(self.domains_per_level[l - 1])}
+            self._level_parent_idx.append(
+                np.asarray(
+                    [pos[id(d.parent)] for d in self.domains_per_level[l]],
+                    dtype=np.int64,
+                )
+            )
+
+    def share_structure(self) -> "TASFlavorSnapshot":
+        """Cheap per-cycle snapshot: shares the immutable domain tree and
+        capacity arrays, with fresh usage (reference rebuilds the whole
+        snapshot per cycle; structure only changes on node/topology
+        events)."""
+        clone = object.__new__(TASFlavorSnapshot)
+        clone.__dict__.update(self.__dict__)
+        clone.usage = {}
+        clone._match_cache = self._match_cache
+        return clone
 
     def _ensure_domain(self, values: Tuple[str, ...]) -> Domain:
         did = "/".join(values)
@@ -204,6 +248,33 @@ class TASFlavorSnapshot:
                 cap[res] = cap.get(res, 0) - used
         return cap
 
+    def _matching_capacity(self, req: PlacementRequest) -> np.ndarray:
+        """Per-leaf capacity restricted to nodes passing the request's
+        selector/tolerations; memoized per distinct (selector, tolerations)
+        — workload specs repeat heavily in practice."""
+        key = (
+            tuple(sorted(req.node_selector.items())),
+            tuple(req.tolerations),
+        )
+        cached = self._match_cache.get(key)
+        if cached is not None:
+            return cached
+        if not req.node_selector and not any(
+            n.taints
+            for nodes in self.nodes_by_leaf.values()
+            for n in nodes
+        ):
+            cap = self._leaf_cap
+        else:
+            cap = np.zeros_like(self._leaf_cap)
+            for i, leaf in enumerate(self.leaves):
+                for node in self.nodes_by_leaf.get(leaf.id, []):
+                    if self._node_matches(node, req):
+                        for r, v in node.capacity.items():
+                            cap[i, self._res_index[r]] += v
+        self._match_cache[key] = cap
+        return cap
+
     def _node_matches(self, node: Node, req: PlacementRequest) -> bool:
         for k, v in req.node_selector.items():
             if node.labels.get(k) != v:
@@ -230,47 +301,140 @@ class TASFlavorSnapshot:
             dom.state = dom.state_with_leader = 0
             dom.slice_state = dom.slice_state_with_leader = 0
             dom.leader_state = 0
-        # Account for one pod slot per pod (OnePodRequest analog): model the
-        # "pods" resource only when nodes declare it.
+        # Vectorized leaf fill: free = static capacity - usage - assumed,
+        # restricted to selector/taint-matching nodes; per-pod fit counts by
+        # integer division over the resource axis.
         requests = dict(req.single_pod_requests)
-        for leaf in self.leaves:
-            if required_replacement_domain and not leaf.id.startswith(
-                required_replacement_domain
-            ):
+        ln = len(self.leaves)
+        rn = self._leaf_cap.shape[1]
+        cap_arr = self._matching_capacity(req)
+        if simulate_empty:
+            free = cap_arr.copy()
+        else:
+            free = cap_arr.copy()
+            for leaf_id, used in self.usage.items():
+                i = self._leaf_index.get(leaf_id)
+                if i is None:
+                    continue
+                for r, v in used.items():
+                    ri = self._res_index.get(r)
+                    if ri is not None:
+                        free[i, ri] -= v
+        if assumed_usage:
+            for leaf_id, used in assumed_usage.items():
+                i = self._leaf_index.get(self._canonical_leaf_id(leaf_id))
+                if i is None:
+                    continue
+                for r, v in used.items():
+                    ri = self._res_index.get(r)
+                    if ri is not None:
+                        free[i, ri] -= v
+
+        fits = np.full(ln, INF, dtype=np.int64)
+        for r, v in requests.items():
+            if v <= 0:
                 continue
-            if self.lowest_is_node:
-                nodes = [
-                    n for n in self.nodes_by_leaf.get(leaf.id, [])
-                    if self._node_matches(n, req)
-                ]
-                cap: Dict[str, int] = {}
-                for node in nodes:
-                    for res, v in node.capacity.items():
-                        cap[res] = cap.get(res, 0) + v
-                if not simulate_empty:
-                    for res, used in self.usage.get(leaf.id, {}).items():
-                        cap[res] = cap.get(res, 0) - used
-            else:
-                cap = self._leaf_free_capacity(leaf, simulate_empty)
-            if assumed_usage and leaf.id in assumed_usage:
-                for res, used in assumed_usage[leaf.id].items():
-                    cap[res] = cap.get(res, 0) - used
-            leaf.free_capacity = cap
-            leaf.state = count_fits(requests, cap)
+            ri = self._res_index.get(r)
+            col = free[:, ri] if ri is not None else np.zeros(ln, np.int64)
+            fits = np.minimum(fits, np.maximum(col, 0) // v)
+        if "pods" in self._res_index and "pods" not in requests:
+            fits = np.minimum(
+                fits, np.maximum(free[:, self._res_index["pods"]], 0)
+            )
+        fits = np.where(fits >= INF, 0, fits)
+        if required_replacement_domain:
+            for i, leaf in enumerate(self.leaves):
+                if not leaf.id.startswith(required_replacement_domain):
+                    fits[i] = 0
+                    free[i] = 0
+
+        for i, leaf in enumerate(self.leaves):
+            leaf.state = int(fits[i])
             leaf.leader_state = 0
-            if req.leader_requests is not None:
+            leaf.state_with_leader = leaf.state
+        if req.leader_requests is not None:
+            for i, leaf in enumerate(self.leaves):
+                cap = {
+                    r: int(free[i, self._res_index[r]])
+                    for r in self._res_names
+                }
+                leaf.free_capacity = cap
                 if count_fits(req.leader_requests, cap) > 0:
                     leaf.leader_state = 1
-                    cap = {
-                        res: cap.get(res, 0) - req.leader_requests.get(res, 0)
-                        for res in set(cap) | set(req.leader_requests)
+                    cap2 = {
+                        r: cap.get(r, 0) - req.leader_requests.get(r, 0)
+                        for r in set(cap) | set(req.leader_requests)
                     }
-            leaf.state_with_leader = count_fits(requests, cap)
+                    leaf.state_with_leader = count_fits(requests, cap2)
+                else:
+                    leaf.state_with_leader = count_fits(requests, cap)
 
         leader_required = req.leader_requests is not None
-        for root in self.roots:
-            self._fill_counts_helper(
-                root, slice_size, slice_level_idx, 0, leader_required
+        self._roll_up_counts(slice_size, slice_level_idx, leader_required)
+
+    def _roll_up_counts(
+        self, slice_size: int, slice_level_idx: int, leader_required: bool
+    ) -> None:
+        """Vectorized bottom-up accumulation (fillInCountsHelper :1902) as
+        per-level segment reductions over parent-index vectors."""
+        n_levels = len(self.level_keys)
+        last = n_levels - 1
+        doms = self.domains_per_level[last]
+        state = np.asarray([d.state for d in doms], dtype=np.int64)
+        swl = np.asarray([d.state_with_leader for d in doms], dtype=np.int64)
+        leader = np.asarray([d.leader_state for d in doms], dtype=np.int64)
+        if last == slice_level_idx:
+            sl = state // slice_size
+            sl_wl = swl // slice_size
+        else:
+            sl = np.zeros_like(state)
+            sl_wl = np.zeros_like(state)
+        for i, d in enumerate(doms):
+            d.slice_state = int(sl[i])
+            d.slice_state_with_leader = int(sl_wl[i])
+
+        for l in range(last - 1, -1, -1):
+            pidx = self._level_parent_idx[l + 1]
+            n_parent = len(self.domains_per_level[l])
+            p_state = np.zeros(n_parent, dtype=np.int64)
+            np.add.at(p_state, pidx, state)
+            p_slice = np.zeros(n_parent, dtype=np.int64)
+            np.add.at(p_slice, pidx, sl)
+            p_leader = np.zeros(n_parent, dtype=np.int64)
+            np.maximum.at(p_leader, pidx, leader)
+
+            contributes = (
+                np.ones_like(leader, dtype=bool)
+                if not leader_required else (leader > 0)
+            )
+            diff = np.where(contributes, state - swl, INF)
+            sdiff = np.where(contributes, sl - sl_wl, INF)
+            min_diff = np.full(n_parent, INF, dtype=np.int64)
+            np.minimum.at(min_diff, pidx, diff)
+            min_sdiff = np.full(n_parent, INF, dtype=np.int64)
+            np.minimum.at(min_sdiff, pidx, sdiff)
+            has_contrib = np.zeros(n_parent, dtype=bool)
+            np.logical_or.at(has_contrib, pidx, contributes)
+
+            p_swl = np.where(has_contrib, p_state - min_diff, 0)
+            p_slice_wl = np.where(has_contrib, p_slice - min_sdiff, 0)
+
+            if l == slice_level_idx:
+                p_slice = p_state // slice_size
+                p_slice_wl = p_swl // slice_size
+            elif l > slice_level_idx:
+                p_slice = np.zeros_like(p_state)
+                p_slice_wl = np.zeros_like(p_state)
+
+            pdoms = self.domains_per_level[l]
+            for i, d in enumerate(pdoms):
+                d.state = int(p_state[i])
+                d.state_with_leader = int(p_swl[i])
+                d.leader_state = int(p_leader[i])
+                d.slice_state = int(p_slice[i])
+                d.slice_state_with_leader = int(p_slice_wl[i])
+            state, swl, leader, sl, sl_wl = (
+                p_state, p_swl, p_leader, p_slice, p_slice_wl
             )
 
     def _fill_counts_helper(
