@@ -88,10 +88,9 @@ def encode_cycle(
     f = tree.nominal.shape[1]
     r = tree.nominal.shape[2]
 
-    from kueue_tpu.ops import quota_ops
-
-    subtree, usage_full = quota_ops.compute_subtree_jit(tree, usage, is_cq)
-    tree = tree._replace(subtree_quota=subtree)
+    # subtree_quota and cohort usage roll-ups arrive pre-computed from the
+    # host tree (exact); no device round-trip during encoding.
+    usage_full = usage
 
     idx = CycleIndex(
         tree_index=tidx,

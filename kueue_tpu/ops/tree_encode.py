@@ -47,10 +47,13 @@ def encode_tree(
     f_pad: int = 0,
     r_pad: int = 0,
 ) -> Tuple[QuotaTreeArrays, "TreeIndex", jnp.ndarray, jnp.ndarray]:
-    """Returns (tree_arrays, index, cq_usage[N,F,R], is_cq[N]).
+    """Returns (tree_arrays, index, usage[N,F,R], is_cq[N]).
 
-    subtree_quota in the returned arrays is zero; callers run
-    ``quota_ops.compute_subtree`` (or copy host-computed values) to fill it.
+    subtree_quota is filled from the host tree (QuotaNode.subtree_quota,
+    already exact after update_tree), and usage includes the cohort
+    roll-ups — no device computation is needed to finish the encoding.
+    ``quota_ops.compute_subtree`` recomputes both on device when arrays are
+    built synthetically.
     """
     idx = TreeIndex()
     order: List[QuotaNode] = []
@@ -82,6 +85,7 @@ def encode_tree(
     lend_limit = np.full((n, f, r), UNLIMITED, dtype=np.int64)
     has_lend = np.zeros((n, f, r), dtype=bool)
     usage = np.zeros((n, f, r), dtype=np.int64)
+    subtree = np.zeros((n, f, r), dtype=np.int64)
 
     for i, node in enumerate(idx.nodes):
         active[i] = True
@@ -107,6 +111,9 @@ def encode_tree(
         for fr, v in node.usage.items():
             fi, ri = idx.fr_index(fr)
             usage[i, fi, ri] = v
+        for fr, v in node.subtree_quota.items():
+            fi, ri = idx.fr_index(fr)
+            subtree[i, fi, ri] = v
 
     tree = QuotaTreeArrays(
         parent=jnp.asarray(parent),
@@ -118,7 +125,7 @@ def encode_tree(
         has_borrow_limit=jnp.asarray(has_borrow),
         lend_limit=jnp.asarray(lend_limit),
         has_lend_limit=jnp.asarray(has_lend),
-        subtree_quota=jnp.zeros((n, f, r), dtype=jnp.int64),
+        subtree_quota=jnp.asarray(subtree),
     )
     return tree, idx, jnp.asarray(usage), jnp.asarray(is_cq)
 
