@@ -226,6 +226,10 @@ def build_manager(cfg: Configuration, **kw):
         use_device_scheduler=cfg.use_device_scheduler,
         **kw,
     )
+    mgr.exclude_resource_prefixes = list(
+        cfg.resources.exclude_resource_prefixes
+    )
+    mgr.resource_transformations = list(cfg.resources.transformations)
     from kueue_tpu.controllers.jobframework import registry
 
     for name in registry.names():

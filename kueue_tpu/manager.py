@@ -91,6 +91,9 @@ class Manager:
             )
         self.workloads: Dict[str, Workload] = {}
         self.priority_classes: Dict[str, WorkloadPriorityClass] = {}
+        # Resource preprocessing (reference config resources section).
+        self.exclude_resource_prefixes: list = []
+        self.resource_transformations: list = []
         self.job_reconciler = JobReconciler(self)
         self.workload_controller = WorkloadController(
             self, pods_ready=pods_ready, retention=retention
@@ -164,6 +167,15 @@ class Manager:
             wl.creation_time = self.clock()
         if wl.priority_class and wl.priority_class in self.priority_classes:
             wl.priority = self.priority_classes[wl.priority_class].value
+        if self.exclude_resource_prefixes or self.resource_transformations:
+            from kueue_tpu.utils.resource_transform import transform_requests
+
+            for ps in wl.pod_sets:
+                ps.requests = transform_requests(
+                    ps.requests,
+                    self.exclude_resource_prefixes,
+                    self.resource_transformations,
+                )
         self.workloads[wl.key] = wl
         self.metrics.inc("workloads_created_total")
         self.queues.add_or_update_workload(wl)

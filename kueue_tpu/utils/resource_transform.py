@@ -1,0 +1,34 @@
+"""Resource request preprocessing.
+
+Behavioral surface: reference pkg/config resources section
+(configuration_types.go:589-731): excludeResourcePrefixes strips matching
+resources from scheduling; transformations map an input resource into
+output scheduling resources (Retain keeps the input alongside, Replace
+swaps it) — the DRA/device-class seam: e.g. one "tpu-v5e-slice" request
+becomes 4 "tpu" chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+
+def transform_requests(
+    requests: Dict[str, int],
+    exclude_prefixes: Iterable[str] = (),
+    transformations: Iterable = (),
+) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    tf_by_input = {t.input: t for t in transformations}
+    for res, v in requests.items():
+        if any(res.startswith(p) for p in exclude_prefixes):
+            continue
+        t = tf_by_input.get(res)
+        if t is None:
+            out[res] = out.get(res, 0) + v
+            continue
+        if t.strategy == "Retain":
+            out[res] = out.get(res, 0) + v
+        for o_res, per_unit in t.outputs.items():
+            out[o_res] = out.get(o_res, 0) + per_unit * v
+    return out

@@ -202,3 +202,33 @@ def test_debugger_dump():
     dump(mgr, buf)
     text = buf.getvalue()
     assert "cq-a" in text and "batchjob-d" in text
+
+
+def test_resource_transformations():
+    from kueue_tpu.config.configuration import (
+        ResourceTransformation,
+        build_manager,
+        load,
+    )
+
+    cfg = load({
+        "resources": {
+            "excludeResourcePrefixes": ["ephemeral-"],
+            "transformations": [
+                {"input": "tpu-v5e-slice", "strategy": "Replace",
+                 "outputs": {"tpu": 4}},
+            ],
+        },
+    })
+    mgr = build_manager(cfg)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"tpu": quota(8)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = make_wl("t", requests={"tpu-v5e-slice": 2, "ephemeral-storage": 5})
+    mgr.create_workload(wl)
+    assert wl.pod_sets[0].requests == {"tpu": 8}
+    mgr.schedule_all()
+    assert is_admitted(wl)
