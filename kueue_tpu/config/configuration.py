@@ -77,6 +77,7 @@ class Configuration:
     fair_sharing: FairSharingConfig = field(default_factory=FairSharingConfig)
     multi_kueue: MultiKueueSettings = field(default_factory=MultiKueueSettings)
     resources: ResourcesConfig = field(default_factory=ResourcesConfig)
+    admission_fair_sharing: Optional[object] = None  # AdmissionFairSharingConfig
     feature_gates: Dict[str, bool] = field(default_factory=dict)
     object_retention_after_finished_seconds: Optional[float] = None
     visibility_enabled: bool = True
@@ -164,6 +165,22 @@ def load(source) -> Configuration:
             for t in res.get("transformations", [])
         ],
     )
+    afs = _pick(raw, "admissionFairSharing", default=None)
+    if afs:
+        from kueue_tpu.queue.afs import AdmissionFairSharingConfig
+
+        cfg.admission_fair_sharing = AdmissionFairSharingConfig(
+            usage_half_life_s=_duration(
+                afs.get("usageHalfLifeTime", "10m")
+            ),
+            usage_sampling_interval_s=_duration(
+                afs.get("usageSamplingInterval", "5m")
+            ),
+            resource_weights={
+                k: float(v)
+                for k, v in (afs.get("resourceWeights") or {}).items()
+            },
+        )
     cfg.feature_gates = dict(_pick(raw, "featureGates", "feature_gates",
                                    default={}) or {})
     orp = _pick(raw, "objectRetentionPolicies", default={}) or {}
@@ -224,6 +241,7 @@ def build_manager(cfg: Configuration, **kw):
         pods_ready=cfg.wait_for_pods_ready,
         retention=retention,
         use_device_scheduler=cfg.use_device_scheduler,
+        admission_fair_sharing=cfg.admission_fair_sharing,
         **kw,
     )
     mgr.exclude_resource_prefixes = list(

@@ -73,6 +73,7 @@ class Manager:
         retention: Optional[RetentionConfig] = None,
         clock: Callable[[], float] = time.monotonic,
         use_device_scheduler: bool = False,
+        admission_fair_sharing=None,
     ) -> None:
         self.clock = clock
         self.cache = Cache()
@@ -99,6 +100,10 @@ class Manager:
             self, pods_ready=pods_ready, retention=retention
         )
         self.check_controllers: Dict[str, AdmissionCheckController] = {}
+        if admission_fair_sharing is not None:
+            from kueue_tpu.queue.afs import AfsTracker
+
+            self.queues.afs_tracker = AfsTracker(admission_fair_sharing)
         from kueue_tpu.controllers.tas_failure import TASNodeFailureController
 
         self.tas_failure = TASNodeFailureController(self)
@@ -215,8 +220,20 @@ class Manager:
             "admission_attempt_duration_seconds", result.duration_s
         )
         self.metrics.inc("admission_attempts_total")
+        tracker = self.queues.afs_tracker
         for key in result.admitted:
             self.metrics.inc("quota_reserved_workloads_total")
+            if tracker is not None:
+                wl = self.workloads.get(key)
+                if wl is not None:
+                    tracker.add_entry_penalty(
+                        f"{wl.namespace}/{wl.queue_name}",
+                        {
+                            r: v * ps.count
+                            for ps in wl.pod_sets
+                            for r, v in ps.requests.items()
+                        },
+                    )
         for key in result.preempted:
             self.metrics.inc("preempted_workloads_total")
         # Sync jobs whose workload state changed.
@@ -243,6 +260,26 @@ class Manager:
     def tick(self) -> None:
         """Clock-driven reconciliation: admission checks, timeouts,
         backoffs, retention, job sync."""
+        tracker = self.queues.afs_tracker
+        if tracker is not None:
+            from kueue_tpu.core.workload_info import is_admitted as _adm
+
+            now = self.clock()
+            running: Dict[str, Dict[str, int]] = {}
+            for wl in self.workloads.values():
+                lq_key = f"{wl.namespace}/{wl.queue_name}"
+                running.setdefault(lq_key, {})
+                if _adm(wl):
+                    for ps in wl.pod_sets:
+                        for r, v in ps.requests.items():
+                            running[lq_key][r] = (
+                                running[lq_key].get(r, 0) + v * ps.count
+                            )
+            for lq_key, usage in running.items():
+                lq = self.cache.local_queues.get(lq_key)
+                if lq is not None and lq.fair_sharing is not None:
+                    tracker.set_lq_weight(lq_key, lq.fair_sharing.weight)
+                tracker.sample(lq_key, usage, now)
         self.tas_failure.reconcile()
         for wl in list(self.workloads.values()):
             self._sync_admission_checks(wl)

@@ -1,0 +1,92 @@
+"""Admission Fair Sharing (AFS): usage-based LocalQueue ordering.
+
+Behavioral surface: reference pkg/util/admissionfairsharing +
+pkg/cache/queue/afs — per-LocalQueue consumed resources tracked as an
+exponential moving average with a configured half-life, entry penalties
+added at admission (alpha x totalRequests), and fair-sharing usage
+  usage = sum_r weight_r * (consumed_r + penalty_r) / lqWeight
+ordering workloads of CQs whose admissionScope is
+UsageBasedAdmissionFairSharing (lowest usage first).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class AdmissionFairSharingConfig:
+    """reference config admissionFairSharing (configuration_types.go:758)."""
+
+    usage_half_life_s: float = 600.0
+    usage_sampling_interval_s: float = 300.0
+    resource_weights: Dict[str, float] = field(default_factory=dict)
+
+
+def _alpha(sampling: float, half_life: float) -> float:
+    """calculateAlphaRate (admission_fair_sharing.go:41)."""
+    if half_life == 0:
+        return 0.0
+    return 1.0 - math.pow(0.5, sampling / half_life)
+
+
+@dataclass
+class _Entry:
+    consumed: Dict[str, float] = field(default_factory=dict)
+    penalty: Dict[str, float] = field(default_factory=dict)
+    last_update: float = 0.0
+
+
+class AfsTracker:
+    """Consumed-resources EMA + entry penalties per LocalQueue
+    (reference afs/consumed_resources.go + entry_penalties.go)."""
+
+    def __init__(self, config: Optional[AdmissionFairSharingConfig] = None):
+        self.config = config or AdmissionFairSharingConfig()
+        self._entries: Dict[str, _Entry] = {}
+        self._lq_weight: Dict[str, float] = {}
+
+    def set_lq_weight(self, lq_key: str, weight: float) -> None:
+        self._lq_weight[lq_key] = weight
+
+    def add_entry_penalty(self, lq_key: str, total_requests: Dict[str, int],
+                          ) -> None:
+        """CalculateEntryPenalty: alpha x totalRequests on admission."""
+        a = _alpha(self.config.usage_sampling_interval_s,
+                   self.config.usage_half_life_s)
+        e = self._entries.setdefault(lq_key, _Entry())
+        for r, v in total_requests.items():
+            e.penalty[r] = e.penalty.get(r, 0.0) + a * v
+
+    def sample(self, lq_key: str, running_usage: Dict[str, int],
+               now: float) -> None:
+        """CalculateDecayedConsumed: EMA of running usage; folds pending
+        penalties into consumed (the reference pops penalties on sample)."""
+        e = self._entries.setdefault(lq_key, _Entry())
+        elapsed = max(0.0, now - e.last_update) if e.last_update else \
+            self.config.usage_sampling_interval_s
+        a = _alpha(elapsed, self.config.usage_half_life_s)
+        merged: Dict[str, float] = {}
+        for r in set(e.consumed) | set(running_usage):
+            merged[r] = (
+                e.consumed.get(r, 0.0) * (1 - a)
+                + running_usage.get(r, 0) * a
+            )
+        for r, v in e.penalty.items():
+            merged[r] = merged.get(r, 0.0) + v
+        e.consumed = merged
+        e.penalty = {}
+        e.last_update = now
+
+    def usage(self, lq_key: str) -> float:
+        """CalculateUsage (admission_fair_sharing.go:67)."""
+        e = self._entries.get(lq_key)
+        if e is None:
+            return 0.0
+        total = 0.0
+        for r in sorted(set(e.consumed) | set(e.penalty)):
+            v = e.consumed.get(r, 0.0) + e.penalty.get(r, 0.0)
+            total += self.config.resource_weights.get(r, 1.0) * v
+        return total / self._lq_weight.get(lq_key, 1.0)

@@ -51,7 +51,20 @@ class ClusterQueueHeap:
         else:
             self._items[key] = info
 
-    def pop_head(self) -> Optional[WorkloadInfo]:
+    def pop_head(self, afs_usage_fn=None) -> Optional[WorkloadInfo]:
+        if afs_usage_fn is not None and self._items:
+            # Usage-based admission fair sharing: lowest LocalQueue usage
+            # first, base order as tiebreak (reference cluster_queue.go
+            # queueOrderingFunc with enableAdmissionFs).
+            best_key = min(
+                self._items,
+                key=lambda k: (
+                    afs_usage_fn(self._items[k]),
+                    _order_key(self._items[k]),
+                ),
+            )
+            info = self._items.pop(best_key)
+            return info
         while self._heap:
             _, key = heapq.heappop(self._heap)
             info = self._items.pop(key, None)
@@ -115,6 +128,8 @@ class QueueManager:
         self.cluster_queues: Dict[str, ClusterQueueHeap] = {}
         self.local_queues: Dict[str, LocalQueue] = {}  # "ns/name" -> LQ
         self.scheduling_cycle = 0
+        # AdmissionFairSharing tracker (None = AFS off).
+        self.afs_tracker = None
         # Second-pass queue for workloads with delayed TAS admission
         # (reference second_pass_queue.go).
         self._second_pass: Dict[str, WorkloadInfo] = {}
@@ -214,8 +229,25 @@ class QueueManager:
         with self._lock:
             self.scheduling_cycle += 1
             out: List[WorkloadInfo] = []
+            from kueue_tpu.api.constants import AdmissionScope
+
             for cqh in self.cluster_queues.values():
-                head = cqh.pop_head()
+                afs_fn = None
+                if (
+                    self.afs_tracker is not None
+                    and cqh.spec.admission_scope
+                    == AdmissionScope.USAGE_BASED_FAIR_SHARING
+                ):
+                    tracker = self.afs_tracker
+
+                    def afs_fn(info, _t=tracker):
+                        u = _t.usage(
+                            f"{info.obj.namespace}/{info.obj.queue_name}"
+                        )
+                        info.local_queue_fs_usage = u
+                        return u
+
+                head = cqh.pop_head(afs_fn)
                 if head is not None:
                     out.append(head)
             out.extend(self._second_pass.values())

@@ -1,0 +1,56 @@
+"""Admission fair sharing tests (reference scheduler_afs_test.go shape)."""
+
+from kueue_tpu.api.constants import AdmissionScope
+from kueue_tpu.api.types import FairSharing, LocalQueue, ResourceFlavor, quota
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+from kueue_tpu.queue.afs import AdmissionFairSharingConfig, AfsTracker
+
+from .helpers import make_cq, make_wl
+
+
+def test_tracker_half_life_decay():
+    t = AfsTracker(AdmissionFairSharingConfig(
+        usage_half_life_s=10.0, usage_sampling_interval_s=10.0))
+    t.sample("default/lq", {"cpu": 1000}, now=10.0)
+    u1 = t.usage("default/lq")
+    assert u1 > 0
+    # No running usage anymore: decays by half every 10s.
+    t.sample("default/lq", {}, now=20.0)
+    assert abs(t.usage("default/lq") - u1 / 2) < 1e-6
+
+
+def test_usage_based_ordering_prefers_low_usage_lq():
+    clockbox = [0.0]
+    mgr = Manager(
+        clock=lambda: clockbox[0],
+        admission_fair_sharing=AdmissionFairSharingConfig(
+            usage_half_life_s=600, usage_sampling_interval_s=60,
+        ),
+    )
+    cq = make_cq("cq-a", flavors={"default": {"cpu": quota(2_000)}})
+    cq.admission_scope = AdmissionScope.USAGE_BASED_FAIR_SHARING
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        cq,
+        LocalQueue(name="heavy", cluster_queue="cq-a"),
+        LocalQueue(name="light", cluster_queue="cq-a"),
+    )
+    # heavy-lq builds up usage.
+    w0 = make_wl("h0", queue="heavy", cpu_m=2_000, creation_time=1.0)
+    mgr.create_workload(w0)
+    mgr.schedule_all()
+    assert is_admitted(w0)
+    clockbox[0] = 60.0
+    mgr.tick()  # sample running usage into the tracker
+    mgr.finish_workload(w0)
+
+    # Both queues submit; heavy submitted EARLIER (would win FIFO), but
+    # light has lower fair-sharing usage and must go first.
+    h1 = make_wl("h1", queue="heavy", cpu_m=2_000, creation_time=61.0)
+    l1 = make_wl("l1", queue="light", cpu_m=2_000, creation_time=62.0)
+    mgr.create_workload(h1)
+    mgr.create_workload(l1)
+    mgr.schedule()
+    assert is_admitted(l1)
+    assert not is_admitted(h1)
