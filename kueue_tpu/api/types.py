@@ -199,6 +199,9 @@ class ClusterQueue:
     fair_sharing: Optional[FairSharing] = None
     admission_checks: List[str] = field(default_factory=list)
     admission_scope: Optional[AdmissionScope] = None
+    # ConcurrentAdmission (reference clusterqueue_types.go:204): when
+    # "Enabled", workloads race one variant per candidate flavor.
+    concurrent_admission_policy: Optional[str] = None
 
     def flavors_for(self, resource: str) -> List[str]:
         for rg in self.resource_groups:

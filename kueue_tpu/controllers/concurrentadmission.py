@@ -1,0 +1,168 @@
+"""Concurrent admission: per-flavor workload variants racing for admission.
+
+Behavioral surface: reference pkg/controller/concurrentadmission — for a
+ClusterQueue with ConcurrentAdmission enabled, a workload is expanded into
+one variant per candidate flavor; each variant may only use its own flavor
+(reference flavorassigner.go:981 IsFlavorAllowedForVariant). The first
+variant admitted wins; less-preferred admitted variants are migrated to a
+more-preferred flavor when it becomes available (controller.go:307); the
+losing variants are deactivated once the winner runs.
+
+For TPU fleets: the same training job races for "reserved v5e" and "spot
+v5e" capacity simultaneously, and migrates back to reserved when it frees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import is_admitted, is_evicted
+from kueue_tpu.scheduler.flavorassigner import FlavorAssigner, Mode
+
+VARIANT_OF_LABEL = "kueue.x-k8s.io/variant-of"
+ALLOWED_FLAVOR_LABEL = "kueue.x-k8s.io/allowed-resource-flavor"
+
+
+def is_variant(wl: Workload) -> bool:
+    return VARIANT_OF_LABEL in wl.labels
+
+
+def allowed_flavor(wl: Workload) -> Optional[str]:
+    return wl.labels.get(ALLOWED_FLAVOR_LABEL)
+
+
+class ConcurrentAdmissionController:
+    """reference concurrentadmission/controller.go:70."""
+
+    def __init__(self, manager) -> None:
+        self.manager = manager
+        # group key (original wl key) -> ordered variant keys (flavor pref)
+        self.groups: Dict[str, List[str]] = {}
+
+    # ------------------------------------------------------------------
+
+    def ensure_variants(self, wl: Workload) -> List[Workload]:
+        """Expand a workload into per-flavor variants (controller.go:188).
+        Returns the variants (creating them on first call). The original
+        workload is withdrawn from the queues and acts as the group
+        anchor."""
+        mgr = self.manager
+        cq_name = mgr.queues.cluster_queue_for(wl)
+        cq = mgr.cache.cluster_queues.get(cq_name) if cq_name else None
+        if cq is None or cq.concurrent_admission_policy != "Enabled":
+            return []
+        if wl.key in self.groups:
+            return [
+                mgr.workloads[k] for k in self.groups[wl.key]
+                if k in mgr.workloads
+            ]
+        flavors: List[str] = []
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                if fq.name not in flavors:
+                    flavors.append(fq.name)
+        if len(flavors) < 2:
+            return []
+        mgr.queues.delete_workload(wl)  # anchor no longer queued itself
+        variants = []
+        for flavor in flavors:
+            v = wl.clone()
+            v.name = f"{wl.name}-fl-{flavor}"
+            v.labels = dict(wl.labels)
+            v.labels[VARIANT_OF_LABEL] = wl.key
+            v.labels[ALLOWED_FLAVOR_LABEL] = flavor
+            v.status = type(v.status)()
+            mgr.create_workload(v)
+            variants.append(v)
+        self.groups[wl.key] = [v.key for v in variants]
+        return variants
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self) -> None:
+        """Winner selection + loser deactivation + migration
+        (controller.go:70,307)."""
+        mgr = self.manager
+        for anchor_key, variant_keys in list(self.groups.items()):
+            variants = [
+                mgr.workloads[k] for k in variant_keys if k in mgr.workloads
+            ]
+            admitted = [v for v in variants if is_admitted(v)]
+            if not admitted:
+                continue
+            anchor = mgr.workloads.get(anchor_key)
+            # Preference order = flavor order; keep the most preferred
+            # admitted variant, deactivate the rest.
+            order = {k: i for i, k in enumerate(variant_keys)}
+            admitted.sort(key=lambda v: order[v.key])
+            winner = admitted[0]
+            for v in variants:
+                if v is winner:
+                    continue
+                if is_admitted(v):
+                    # Less favorable sibling admitted: migration — evict it
+                    # in favor of the winner (scheduler issueMigration).
+                    mgr.workload_controller.evict(
+                        v, "FlavorMigration",
+                        f"Migrated to more favorable variant {winner.name}",
+                        mgr.clock(),
+                    )
+                v.active = False
+                mgr.queues.delete_workload(v)
+            # Mirror the winning admission onto the anchor for observers.
+            if anchor is not None:
+                anchor.status = winner.status
+
+    def try_migration(self) -> None:
+        """Periodic: if a more-preferred variant would now Fit, evict the
+        currently admitted less-preferred one and re-race
+        (controller.go:307 migration-to-preferred-flavor)."""
+        mgr = self.manager
+        snapshot = mgr.cache.snapshot()
+        for anchor_key, variant_keys in list(self.groups.items()):
+            admitted = [
+                mgr.workloads[k] for k in variant_keys
+                if k in mgr.workloads and is_admitted(mgr.workloads[k])
+            ]
+            if not admitted:
+                continue
+            order = {k: i for i, k in enumerate(variant_keys)}
+            current = min(admitted, key=lambda v: order[v.key])
+            cur_rank = order[current.key]
+            if cur_rank == 0:
+                continue
+            for k in variant_keys[:cur_rank]:
+                preferred = mgr.workloads.get(k)
+                if preferred is None:
+                    continue
+                from kueue_tpu.core.workload_info import WorkloadInfo
+
+                cq_name = current.status.admission.cluster_queue
+                cqs = snapshot.cluster_queues.get(cq_name)
+                if cqs is None:
+                    continue
+                info = WorkloadInfo(preferred, cq_name)
+                assigner = FlavorAssigner(
+                    info, cqs, snapshot.resource_flavors,
+                    tas_flavors=snapshot.tas_flavors,
+                )
+                assignment = assigner.assign()
+                fits_preferred = (
+                    assignment.representative_mode() == Mode.FIT
+                    and all(
+                        next(iter(psa.flavors.values())).name
+                        == allowed_flavor(preferred)
+                        for psa in assignment.pod_sets if psa.flavors
+                    )
+                )
+                if fits_preferred:
+                    preferred.active = True
+                    mgr.workload_controller.evict(
+                        current, "FlavorMigration",
+                        f"Migrating to preferred flavor variant "
+                        f"{preferred.name}",
+                        mgr.clock(),
+                    )
+                    mgr.queues.add_or_update_workload(preferred)
+                    break

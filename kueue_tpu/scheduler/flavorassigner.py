@@ -408,9 +408,20 @@ class FlavorAssigner:
             start = self.wl.last_assignment.next_flavor_to_try(
                 ps_ids[0], res_name
             )
+        allowed = self.wl.obj.labels.get(
+            "kueue.x-k8s.io/allowed-resource-flavor"
+        )
         for idx in range(start, len(flavor_names)):
             attempted_idx = idx
             f_name = flavor_names[idx]
+            # ConcurrentAdmission variants race one flavor each
+            # (reference flavorassigner.go:981).
+            if allowed is not None and f_name != allowed:
+                reasons.append(
+                    f"skipping flavor {f_name}: variant restricted to"
+                    f" {allowed}"
+                )
+                continue
             flavor_ok, why = self._check_flavor_for_podsets(f_name, pod_sets)
             if not flavor_ok:
                 reasons.append(why)

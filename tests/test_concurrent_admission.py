@@ -1,0 +1,92 @@
+"""Concurrent admission tests (reference pkg/controller/concurrentadmission
+behavior at small scale)."""
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.controllers.concurrentadmission import (
+    ConcurrentAdmissionController,
+)
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq, make_wl
+
+
+def env(reserved_quota=4000, spot_quota=8000):
+    mgr = Manager()
+    cq = make_cq(
+        "cq-a",
+        flavors={
+            "reserved": {"cpu": quota(reserved_quota)},
+            "spot": {"cpu": quota(spot_quota)},
+        },
+    )
+    cq.concurrent_admission_policy = "Enabled"
+    mgr.apply(
+        ResourceFlavor(name="reserved"),
+        ResourceFlavor(name="spot"),
+        cq,
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    ctrl = ConcurrentAdmissionController(mgr)
+    return mgr, ctrl
+
+
+def test_variants_race_preferred_flavor_wins():
+    mgr, ctrl = env()
+    wl = make_wl("job", cpu_m=2000)
+    mgr.create_workload(wl)
+    variants = ctrl.ensure_variants(wl)
+    assert len(variants) == 2
+    mgr.schedule_all()
+    ctrl.reconcile()
+    # Both could fit; the reserved (first-flavor) variant wins.
+    winner = mgr.workloads["default/job-fl-reserved"]
+    assert is_admitted(winner)
+    loser = mgr.workloads.get("default/job-fl-spot")
+    assert loser is None or not loser.active
+    # Flavor restriction honored.
+    flavors = winner.status.admission.pod_set_assignments[0].flavors
+    assert set(flavors.values()) == {"reserved"}
+
+
+def test_variant_falls_to_spot_when_reserved_full():
+    mgr, ctrl = env()
+    filler = make_wl("filler", cpu_m=4000)
+    filler.labels["kueue.x-k8s.io/allowed-resource-flavor"] = "reserved"
+    mgr.create_workload(filler)
+    mgr.schedule_all()
+    assert is_admitted(filler)
+
+    wl = make_wl("job", cpu_m=3000)
+    mgr.create_workload(wl)
+    ctrl.ensure_variants(wl)
+    mgr.schedule_all()
+    ctrl.reconcile()
+    spot_v = mgr.workloads["default/job-fl-spot"]
+    assert is_admitted(spot_v)
+    assert set(
+        spot_v.status.admission.pod_set_assignments[0].flavors.values()
+    ) == {"spot"}
+
+
+def test_migration_back_to_preferred():
+    mgr, ctrl = env()
+    filler = make_wl("filler", cpu_m=4000)
+    filler.labels["kueue.x-k8s.io/allowed-resource-flavor"] = "reserved"
+    mgr.create_workload(filler)
+    mgr.schedule_all()
+
+    wl = make_wl("job", cpu_m=3000)
+    mgr.create_workload(wl)
+    ctrl.ensure_variants(wl)
+    mgr.schedule_all()
+    ctrl.reconcile()
+    assert is_admitted(mgr.workloads["default/job-fl-spot"])
+
+    # Reserved frees up; periodic migration moves the job back.
+    mgr.finish_workload(filler)
+    ctrl.try_migration()
+    mgr.schedule_all()
+    ctrl.reconcile()
+    reserved_v = mgr.workloads["default/job-fl-reserved"]
+    assert is_admitted(reserved_v)
