@@ -256,3 +256,85 @@ for _name, _cls in [
     ("serving", ServingGroup),
 ]:
     registry.register(_name, _cls)
+
+
+class JobSet(_BaseJob):
+    """reference pkg/controller/jobs/jobset: a set of replicated jobs, each
+    replicated job -> one podset (count = replicas x parallelism)."""
+
+    def __init__(self, name: str, queue: str,
+                 replicated_jobs: Dict[str, Tuple[int, int, Dict[str, int]]],
+                 topology: Optional[TopologyRequest] = None, **kw) -> None:
+        """replicated_jobs: name -> (replicas, parallelism, per-pod requests)."""
+        super().__init__(name, queue, **kw)
+        self.replicated_jobs = replicated_jobs
+        self.topology = topology
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(
+                name=rj_name,
+                count=replicas * parallelism,
+                requests=dict(reqs),
+                topology_request=self.topology,
+            )
+            for rj_name, (replicas, parallelism, reqs)
+            in self.replicated_jobs.items()
+        ]
+
+
+class AppWrapper(_BaseJob):
+    """reference pkg/controller/jobs/appwrapper: an arbitrary bundle of
+    components, each contributing podsets."""
+
+    def __init__(self, name: str, queue: str,
+                 components: List[Tuple[str, int, Dict[str, int]]],
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.components = components
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(name=cname, count=count, requests=dict(reqs))
+            for cname, count, reqs in self.components
+        ]
+
+
+class SparkApplication(_BaseJob):
+    """reference pkg/controller/jobs/sparkapplication: driver + executors."""
+
+    def __init__(self, name: str, queue: str, executors: int,
+                 executor_requests: Dict[str, int],
+                 driver_requests: Optional[Dict[str, int]] = None,
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.executors = executors
+        self.executor_requests = executor_requests
+        self.driver_requests = driver_requests or {"cpu": 1000}
+
+    def pod_sets(self) -> List[PodSet]:
+        return [
+            PodSet(name="driver", count=1, requests=dict(self.driver_requests)),
+            PodSet(name="executor", count=self.executors,
+                   requests=dict(self.executor_requests)),
+        ]
+
+
+# Aliases covering the kubeflow job family shapes (TFJob/PyTorchJob/
+# XGBoostJob/PaddleJob/JAXJob all reduce to role -> (count, requests)).
+TFJob = PyTorchJob = XGBoostJob = PaddleJob = JAXJob = TrainJob
+Deployment = StatefulSet = ServingGroup
+
+for _name, _cls in [
+    ("jobset", JobSet),
+    ("appwrapper", AppWrapper),
+    ("sparkapplication", SparkApplication),
+    ("kubeflow/tfjob", TFJob),
+    ("kubeflow/pytorchjob", PyTorchJob),
+    ("kubeflow/xgboostjob", XGBoostJob),
+    ("kubeflow/paddlejob", PaddleJob),
+    ("kubeflow/jaxjob", JAXJob),
+    ("deployment", Deployment),
+    ("statefulset", StatefulSet),
+]:
+    registry.register(_name, _cls)

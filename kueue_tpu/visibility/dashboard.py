@@ -1,0 +1,123 @@
+"""Web dashboard (kueueviz equivalent).
+
+Behavioral surface: reference cmd/kueueviz — a live view of ClusterQueues,
+pending/admitted workloads and quota usage. Single self-contained HTML page
+polling the JSON API; serve with ``serve_dashboard(manager)`` or mount into
+the visibility server.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict
+
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core.workload_info import is_admitted
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>kueue_tpu</title><style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+table{border-collapse:collapse;margin:1em 0}
+td,th{border:1px solid #444;padding:4px 10px;text-align:left}
+th{background:#222}.bar{background:#333;width:160px;height:12px}
+.fill{background:#4a8;height:12px}h2{color:#8cf}
+</style></head><body>
+<h1>kueue_tpu dashboard</h1>
+<div id="content">loading...</div>
+<script>
+async function refresh(){
+  const r = await fetch('/api/state'); const s = await r.json();
+  let h = '<h2>ClusterQueues</h2><table><tr><th>name</th><th>cohort</th>'+
+    '<th>pending</th><th>admitted</th><th>usage</th></tr>';
+  for (const cq of s.cluster_queues){
+    h += `<tr><td>${cq.name}</td><td>${cq.cohort||''}</td>`+
+      `<td>${cq.pending}</td><td>${cq.admitted}</td><td>`;
+    for (const [res, u] of Object.entries(cq.usage)){
+      const pct = Math.min(100, u.pct);
+      h += `${res}: ${u.used}/${u.nominal} `+
+        `<div class=bar><div class=fill style="width:${pct*1.6}px"></div></div>`;
+    }
+    h += '</td></tr>';
+  }
+  h += '</table><h2>Workloads</h2><table><tr><th>key</th><th>queue</th>'+
+    '<th>priority</th><th>status</th></tr>';
+  for (const w of s.workloads){
+    h += `<tr><td>${w.key}</td><td>${w.queue}</td><td>${w.priority}</td>`+
+      `<td>${w.status}</td></tr>`;
+  }
+  h += '</table>';
+  document.getElementById('content').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def state_json(manager) -> Dict:
+    cqs = []
+    for name, cq in sorted(manager.cache.cluster_queues.items()):
+        usage: Dict[str, Dict] = {}
+        nominal: Dict[str, int] = {}
+        for rg in cq.resource_groups:
+            for fq in rg.flavors:
+                for res, q in fq.resources.items():
+                    nominal[res] = nominal.get(res, 0) + q.nominal
+        used: Dict[str, int] = {}
+        for info in manager.cache.workloads.values():
+            if info.cluster_queue != name:
+                continue
+            for fr, v in info.usage().items():
+                used[fr.resource] = used.get(fr.resource, 0) + v
+        for res, nom in nominal.items():
+            u = used.get(res, 0)
+            usage[res] = {
+                "used": u, "nominal": nom,
+                "pct": round(100.0 * u / nom, 1) if nom else 0.0,
+            }
+        cqs.append({
+            "name": name,
+            "cohort": cq.cohort,
+            "pending": manager.queues.pending_count(name),
+            "admitted": sum(
+                1 for i in manager.cache.workloads.values()
+                if i.cluster_queue == name
+            ),
+            "usage": usage,
+        })
+    wls = []
+    for key, wl in sorted(manager.workloads.items()):
+        wls.append({
+            "key": key,
+            "queue": wl.queue_name,
+            "priority": wl.priority,
+            "status": "Admitted" if is_admitted(wl) else "Pending",
+        })
+    return {"cluster_queues": cqs, "workloads": wls}
+
+
+def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path == "/api/state":
+                body = json.dumps(state_json(manager)).encode()
+                ctype = "application/json"
+            elif self.path in ("/", "/index.html"):
+                body = _PAGE.encode()
+                ctype = "text/html"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

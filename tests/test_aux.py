@@ -232,3 +232,33 @@ def test_resource_transformations():
     assert wl.pod_sets[0].requests == {"tpu": 8}
     mgr.schedule_all()
     assert is_admitted(wl)
+
+
+def test_dashboard_state_and_http():
+    import urllib.request
+
+    from kueue_tpu.visibility.dashboard import serve_dashboard, state_json
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.create_workload(make_wl("d1", cpu_m=1000))
+    mgr.schedule_all()
+    state = state_json(mgr)
+    assert state["cluster_queues"][0]["usage"]["cpu"]["used"] == 1000
+    httpd = serve_dashboard(mgr, port=0)
+    port = httpd.server_address[1]
+    try:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5
+        ).read().decode()
+        assert "kueue_tpu dashboard" in page
+        api = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/state", timeout=5
+        ).read().decode()
+        assert "cq-a" in api
+    finally:
+        httpd.shutdown()

@@ -303,3 +303,34 @@ def test_metrics_exposition():
     text = mgr.metrics.expose()
     assert "kueue_admission_attempts_total" in text
     assert "kueue_quota_reserved_workloads_total" in text
+
+
+def test_jobset_appwrapper_spark_adapters():
+    mgr = basic_manager()
+    from kueue_tpu.controllers.jobs import AppWrapper, JobSet, SparkApplication
+
+    js = JobSet("js", queue="lq",
+                replicated_jobs={"workers": (2, 2, {"cpu": 500})})
+    aw = AppWrapper("aw", queue="lq",
+                    components=[("a", 1, {"cpu": 500}),
+                                ("b", 2, {"cpu": 250})])
+    sp = SparkApplication("sp", queue="lq", executors=3,
+                          executor_requests={"cpu": 500})
+    for job in (js, aw, sp):
+        mgr.submit_job(job)
+    mgr.schedule_all()
+    for job in (js, aw, sp):
+        assert not job.is_suspended(), job.name
+    wl = mgr.workloads["default/jobset-js"]
+    assert wl.pod_sets[0].count == 4  # 2 replicas x 2 parallelism
+
+
+def test_registry_has_all_frameworks():
+    from kueue_tpu.controllers.jobframework import registry
+
+    names = registry.names()
+    for expected in ["batch/job", "jobset", "appwrapper",
+                     "sparkapplication", "kubeflow/tfjob", "mpijob",
+                     "raycluster", "leaderworkerset", "pod", "deployment",
+                     "statefulset", "trainjob"]:
+        assert expected in names, expected
