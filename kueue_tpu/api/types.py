@@ -250,6 +250,9 @@ class TopologyRequest:
     required_level: Optional[str] = None
     preferred_level: Optional[str] = None
     unconstrained: bool = False
+    # Balanced placement (reference TASBalancedPlacement): spread slices
+    # evenly over the minimal domain set instead of packing best-fit.
+    balanced: bool = False
     podset_group_name: Optional[str] = None
     # Gang subdivided into slices pinned under a topology level
     # (reference workload_types.go:252 PodsetSliceRequiredTopologyConstraint).

@@ -192,6 +192,7 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
                                         level if constraint in
                                         ("preferred", "balanced") else None
                                     ),
+                                    balanced=(constraint == "balanced"),
                                 )
                             wl = Workload(
                                 name=(

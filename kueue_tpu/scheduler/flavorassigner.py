@@ -336,6 +336,7 @@ class FlavorAssigner:
                 slice_required_level=tr.slice_required_level,
                 node_selector=dict(ps.node_selector),
                 tolerations=list(ps.tolerations),
+                balanced=getattr(tr, "balanced", False),
             )
             ta, _leader_ta, reason = tas.find_topology_assignment(
                 req, simulate_empty=simulate_empty,

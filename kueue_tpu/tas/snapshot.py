@@ -95,6 +95,7 @@ class PlacementRequest:
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     leader_requests: Optional[Dict[str, int]] = None  # LWS leader pod
+    balanced: bool = False
 
 
 class TASFlavorSnapshot:
@@ -663,6 +664,51 @@ class TASFlavorSnapshot:
             i += 1
         return result if remaining <= 0 else []
 
+    def _balance_counts(
+        self, domains: List[Domain], count: int, slice_size: int
+    ) -> List[Domain]:
+        """Balanced placement (reference tas_balanced_placement.go,
+        simplified): use the greedy-minimal number of domains, then spread
+        slices as evenly as capacity allows — maximizing the minimum
+        per-domain slice count instead of best-fit packing."""
+        slice_count = count // slice_size
+        ordered = self._sorted_domains(list(domains))
+        chosen: List[Domain] = []
+        remaining = slice_count
+        for dom in ordered:
+            if remaining <= 0:
+                break
+            if dom.slice_state <= 0:
+                continue
+            chosen.append(dom)
+            remaining -= dom.slice_state
+        if remaining > 0 or not chosen:
+            return self._update_counts_to_minimum(
+                domains, count, 0, slice_size, True
+            )
+        # Even spread with capacity-aware waterfill.
+        alloc = {id(d): 0 for d in chosen}
+        left = slice_count
+        while left > 0:
+            # Give one slice to the chosen domain with the lowest allocation
+            # that still has room (maximizes the minimum).
+            candidates = [
+                d for d in chosen if alloc[id(d)] < d.slice_state
+            ]
+            d = min(candidates, key=lambda x: (alloc[id(x)],
+                                               x.level_values))
+            alloc[id(d)] += 1
+            left -= 1
+        out = []
+        for d in chosen:
+            if alloc[id(d)] == 0:
+                continue
+            d.slice_state = alloc[id(d)]
+            d.state = alloc[id(d)] * slice_size
+            d.leader_state = 0
+            out.append(d)
+        return out
+
     # -- main entry ------------------------------------------------------------
 
     def find_topology_assignment(
@@ -723,16 +769,34 @@ class TASFlavorSnapshot:
             return None, None, reason
 
         # phase 2b: descend, minimizing domains per level.
-        curr = self._update_counts_to_minimum(
-            curr, req.count, leader_count, slice_size, True
-        )
+        use_balanced = req.balanced and not required and not unconstrained
+        balance_level = requested_level_idx if use_balanced else -1
+        if fit_level_idx == balance_level:
+            # Fit found at the balance level: spread evenly right here,
+            # using the pristine phase-1 counts of the whole level.
+            curr = self._balance_counts(
+                self._sorted_domains(
+                    list(self.domains_per_level[balance_level])
+                ),
+                req.count, slice_size,
+            )
+        else:
+            curr = self._update_counts_to_minimum(
+                curr, req.count, leader_count, slice_size, True
+            )
         level_idx = fit_level_idx
         while level_idx < min(len(self.level_keys) - 1, slice_level_idx):
             # Above the slice level: slices may be re-distributed freely
-            # across all lower domains (reference :1092-1099).
+            # across all lower domains (reference :1092-1099). Under
+            # balanced placement, stop the free loop at the requested
+            # level and spread evenly there (tas_balanced_placement.go).
             lower = self._sorted_domains(
                 [c for d in curr for c in d.children]
             )
+            if level_idx + 1 == balance_level:
+                curr = self._balance_counts(lower, req.count, slice_size)
+                level_idx += 1
+                break
             curr = self._update_counts_to_minimum(
                 lower, req.count, leader_count, slice_size, True
             )

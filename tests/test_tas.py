@@ -254,3 +254,23 @@ def test_e2e_tas_usage_released_on_delete():
     queues.queue_inadmissible_workloads()
     sched.schedule_all()
     assert "late" in admitted_names(cache)
+
+
+def test_balanced_placement_spreads_evenly():
+    """Balanced preferred placement: 4 slices over 2 racks -> 2+2, not
+    best-fit packing into one domain chain."""
+    snap = snapshot()
+    # rack capacity: 2 nodes x 4 tpu = 8 tpu = 4 pods of 2 tpu.
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=6, single_pod_requests={"tpu": 2},
+                         preferred_level=LEVELS[1], balanced=True)
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 6
+    # Count pods per rack (nodes are named node-<b>-<r>-<n>).
+    per_rack = {}
+    for v, c in ta.domains:
+        rack = v[-1].rsplit("-", 1)[0]
+        per_rack[rack] = per_rack.get(rack, 0) + c
+    # 6 pods over 2 racks balanced -> 3 + 3 (not 4 + 2).
+    assert sorted(per_rack.values()) == [3, 3], per_rack
