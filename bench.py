@@ -259,19 +259,23 @@ def device_mega_cycle_probe():
     )
     layout = GroupLayout(parent, np.ones(N, bool))
     ga = bs.GroupArrays(*layout.as_jax())
-    fn = jax.jit(bs.make_grouped_cycle(2 * W // layout.n_groups))
-    out = fn(arrays, ga)
-    out.outcome.block_until_ready()  # compile
-    t0 = time.monotonic()
-    out = fn(arrays, ga)
-    out.outcome.block_until_ready()
-    dt = time.monotonic() - t0
-    admitted = int((np.asarray(out.outcome) == 4).sum())
-    log(
-        f"device mega-cycle (50k wl x 2000 CQ x 32 flavors, "
-        f"{jax.devices()[0].platform}): {dt*1000:.0f} ms, "
-        f"{admitted} admitted, equivalent {admitted/dt:.0f} admissions/s"
-    )
+    for name, fn in (
+        ("fixed-point", jax.jit(bs.make_fixedpoint_cycle())),
+        ("grouped-scan", jax.jit(
+            bs.make_grouped_cycle(2 * W // layout.n_groups))),
+    ):
+        out = fn(arrays, ga)
+        out.outcome.block_until_ready()  # compile
+        t0 = time.monotonic()
+        out = fn(arrays, ga)
+        out.outcome.block_until_ready()
+        dt = time.monotonic() - t0
+        admitted = int((np.asarray(out.outcome) == 4).sum())
+        log(
+            f"device mega-cycle[{name}] (50k wl x 2000 CQ x 32 flavors, "
+            f"{jax.devices()[0].platform}): {dt*1000:.0f} ms, "
+            f"{admitted} admitted, equivalent {admitted/dt:.0f} admissions/s"
+        )
     return dt
 
 

@@ -610,3 +610,262 @@ def make_grouped_cycle(s_max: int = 0):
 
 
 cycle_grouped = jax.jit(make_grouped_cycle())
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point admission (no-lending-limit fast path)
+# ---------------------------------------------------------------------------
+#
+# With no lending limits anywhere (localQuota == 0 for every node —
+# resource_node.go:67), usage bubbles fully to every ancestor and
+#   available(cq) = min over chain nodes b of  T_b - usage_b, where
+#   T_root = subtree_quota[root];  T_b = subtree_quota[b] + borrow_limit[b]
+#   when a borrowing limit is set;  T_b = +inf otherwise.
+# Usage at b before entry i is base + the admission-order prefix sum of
+# admitted deltas inside b's subtree — so greedy admission becomes a
+# monotone-bounds fixed point instead of a sequential scan:
+#   * an entry that fits even when ALL undecided earlier entries are
+#     counted (over-estimate) is definitely admitted;
+#   * an entry that cannot fit even when NO undecided earlier entry is
+#     counted (under-estimate) is definitely rejected;
+#   * the first undecided entry of each cohort tree always has an exact
+#     prefix, so every round decides at least one entry per tree.
+# Expected rounds: a handful; worst case max-entries-per-tree.
+
+_INF64 = (jnp.int64(1) << 61)
+
+
+def _seg_excl_prefix(sorted_vals, head):
+    """Exclusive prefix sums within segments. sorted_vals: [W,F,R] in sorted
+    order; head: bool[W] marking segment starts. Returns [W,F,R]."""
+    c = jnp.cumsum(sorted_vals, axis=0)
+    excl = c - sorted_vals  # global exclusive prefix
+    w = head.shape[0]
+    head_idx = jnp.where(head, jnp.arange(w), -1)
+    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
+    return excl - excl[seg_head]
+
+
+def admit_fixedpoint(
+    arrays: CycleArrays,
+    ga: GroupArrays,
+    nom: NominateResult,
+    usage: jnp.ndarray,
+    order: jnp.ndarray,
+    max_rounds: int = 64,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Order-exact admission equivalent to admit_scan_grouped, computed in
+    O(rounds) fully-vectorized passes. Requires no lending limits (caller
+    checks has_lend_limit is all-False)."""
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    f_onehot = jnp.arange(f_n)
+
+    # Static per-cycle quantities -------------------------------------------
+    rank = jnp.zeros(w_n, dtype=jnp.int64).at[order].set(
+        jnp.arange(w_n, dtype=jnp.int64)
+    )
+    parent = jnp.where(tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent)
+    chain_cols = [arrays.w_cq.astype(jnp.int32)]
+    for _ in range(MAX_DEPTH):
+        chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
+    chains = jnp.stack(chain_cols, axis=1)  # [W, D+1] flat node ids
+    is_root = tree.parent[chains] < 0  # [W, D+1]
+
+    # Constraint term per chain node: T_b - base_usage_b (or +inf).
+    t_node = jnp.where(
+        (tree.parent < 0)[:, None, None],
+        tree.subtree_quota,
+        jnp.where(
+            tree.has_borrow_limit,
+            sat_add(tree.subtree_quota, tree.borrow_limit),
+            _INF64,
+        ),
+    )
+    slack0 = jnp.where(
+        t_node >= _INF64, _INF64, sat_sub(t_node, usage)
+    )  # [N,F,R] capacity left before this cycle's admissions
+
+    cell_mask = (
+        (f_onehot[None, :, None] == nom.chosen_flavor[:, None, None])
+        & (arrays.w_req[:, None, :] > 0)
+        & arrays.covered[arrays.w_cq][:, None, :]
+    )  # [W,F,R]
+    delta = jnp.where(cell_mask, arrays.w_req[:, None, :], 0).astype(jnp.int64)
+
+    deferred = nom.needs_host
+    is_fit = arrays.w_active & (nom.best_pmode == P_FIT) & ~deferred
+    is_nc = (
+        arrays.w_active
+        & (nom.best_pmode == P_NO_CANDIDATES)
+        & ~arrays.can_always_reclaim[arrays.w_cq]
+        & ~deferred
+    )
+    borrowing = nom.best_borrow > 0
+    nominal_c = tree.nominal[arrays.w_cq]  # [W,F,R]
+    has_bl_c = tree.has_borrow_limit[arrays.w_cq]
+    bl_c = tree.borrow_limit[arrays.w_cq]
+
+    # Per-level sorted orders (static): entries sorted by (chain node, rank).
+    perms = []
+    heads = []
+    inv_perms = []
+    for d in range(MAX_DEPTH + 1):
+        key = chains[:, d].astype(jnp.int64) * (w_n + 1) + rank
+        perm = jnp.argsort(key)
+        node_sorted = chains[:, d][perm]
+        head = jnp.concatenate([
+            jnp.ones(1, bool), node_sorted[1:] != node_sorted[:-1]
+        ])
+        inv = jnp.zeros(w_n, dtype=jnp.int32).at[perm].set(
+            jnp.arange(w_n, dtype=jnp.int32)
+        )
+        perms.append(perm)
+        heads.append(head)
+        inv_perms.append(inv)
+
+    def chain_slack(contrib):
+        """min over chain levels of (slack0[b] - prefix_b(i)) for every
+        entry, given per-entry finalized/assumed contributions [W,F,R]."""
+        avail = jnp.full((w_n, f_n, r_n), _INF64, dtype=jnp.int64)
+        for d in range(MAX_DEPTH + 1):
+            perm, head, inv = perms[d], heads[d], inv_perms[d]
+            pre = _seg_excl_prefix(contrib[perm], head)[inv]
+            term = sat_sub(slack0[chains[:, d]], pre)
+            term = jnp.where(slack0[chains[:, d]] >= _INF64, _INF64, term)
+            # Repeated root levels recompute the same term: harmless.
+            avail = jnp.minimum(avail, term)
+        return avail  # [W,F,R]
+
+    def body(state):
+        admitted, rejected, reserved, decided, changed, rounds = state
+        undecided = ~decided
+
+        contrib_lo = jnp.where(admitted[:, None, None], delta, 0) + reserved
+        maybe = undecided & (is_fit | is_nc)
+        contrib_hi = contrib_lo + jnp.where(maybe[:, None, None], delta, 0)
+
+        avail_lo = chain_slack(contrib_hi)  # worst case (most usage)
+        avail_hi = chain_slack(contrib_lo)  # best case (least usage)
+        exact = jnp.all(avail_lo == avail_hi, axis=(1, 2))
+
+        fits_worst = jnp.all((delta <= avail_lo) | ~cell_mask, axis=(1, 2))
+        fits_best = jnp.all((delta <= avail_hi) | ~cell_mask, axis=(1, 2))
+
+        new_admit = undecided & is_fit & fits_worst
+        new_reject = undecided & is_fit & ~fits_best
+        # Exact prefixes decide anything (covers first-undecided-per-tree).
+        new_admit = new_admit | (undecided & is_fit & exact & fits_best)
+        new_reject = new_reject | (undecided & is_fit & exact & ~fits_best)
+
+        # NO_CANDIDATES reserves finalize once the prefix AT THE CQ NODE is
+        # exact (the clipped amount needs the true usage there —
+        # scheduler.go:738 quotaResourcesToReserve). avail equality is not
+        # enough: the min can coincide while the level-0 prefix differs.
+        pre0 = _seg_excl_prefix(contrib_lo[perms[0]], heads[0])[inv_perms[0]]
+        pre0_hi = _seg_excl_prefix(
+            contrib_hi[perms[0]], heads[0]
+        )[inv_perms[0]]
+        exact0 = jnp.all(pre0 == pre0_hi, axis=(1, 2))
+        nc_final = undecided & is_nc & exact0
+        u_c = usage[arrays.w_cq] + pre0
+        reserve_borrowing = jnp.where(
+            has_bl_c,
+            jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl_c), u_c)),
+            delta,
+        )
+        reserve_plain = jnp.maximum(
+            0, jnp.minimum(delta, sat_sub(nominal_c, u_c))
+        )
+        res_amt = jnp.where(
+            borrowing[:, None, None], reserve_borrowing, reserve_plain
+        )
+        res_amt = jnp.where(cell_mask, res_amt, 0)
+        reserved = jnp.where(nc_final[:, None, None], res_amt, reserved)
+
+        newly = new_admit | new_reject | nc_final
+        admitted = admitted | new_admit
+        rejected = rejected | new_reject
+        decided = decided | newly | (undecided & ~is_fit & ~is_nc)
+        return (admitted, rejected, reserved, decided, jnp.any(newly),
+                rounds + 1)
+
+    def cond(state):
+        _adm, _rej, _res, decided, changed, rounds = state
+        return changed & (rounds < max_rounds) & ~jnp.all(decided)
+
+    init = (
+        jnp.zeros(w_n, bool),
+        jnp.zeros(w_n, bool),
+        jnp.zeros((w_n, f_n, r_n), jnp.int64),
+        ~(is_fit | is_nc),  # everything else is decided from the start
+        jnp.bool_(True),
+        jnp.int32(0),
+    )
+    admitted, _rej, reserved, decided, _chg, rounds = jax.lax.while_loop(
+        cond, body, init
+    )
+
+    # Final usage: base + all finalized contributions bubbled to ancestors.
+    contrib = jnp.where(admitted[:, None, None], delta, 0) + reserved
+    final_usage = usage
+    for d in range(MAX_DEPTH + 1):
+        add_d = jnp.zeros_like(usage)
+        # Scatter each entry's contribution at its chain-d node; repeated
+        # roots would double-count, so mask repeats.
+        is_repeat = (chains[:, d] == chains[:, d - 1]) if d > 0 else \
+            jnp.zeros(w_n, bool)
+        vals = jnp.where(is_repeat[:, None, None], 0, contrib)
+        add_d = add_d.at[chains[:, d]].add(vals, mode="drop")
+        final_usage = quota_ops.sat(final_usage + add_d)
+    return final_usage, admitted
+
+
+def make_fixedpoint_cycle(max_rounds: int = 64):
+    """Grouped-cycle equivalent using the fixed-point admission pass.
+    Exact iff the tree has no lending limits AND max_rounds suffices (the
+    driver checks the former; rounds cap is a safety net far above any
+    practical depth of contention cascades)."""
+
+    def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage)
+        order = admission_order(arrays, nom)
+        final_usage, admitted = admit_fixedpoint(
+            arrays, ga, nom, usage, order, max_rounds
+        )
+        outcome = jnp.where(
+            ~arrays.w_active,
+            OUT_NOFIT,
+            jnp.where(
+                nom.needs_host,
+                OUT_NEEDS_HOST,
+                jnp.where(
+                    admitted,
+                    OUT_ADMITTED,
+                    jnp.where(
+                        nom.best_pmode == P_FIT,
+                        OUT_FIT_SKIPPED,
+                        jnp.where(
+                            nom.best_pmode == P_NO_CANDIDATES,
+                            OUT_NO_CANDIDATES,
+                            OUT_NOFIT,
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        return CycleOutputs(
+            outcome=outcome,
+            chosen_flavor=nom.chosen_flavor,
+            borrow=nom.best_borrow,
+            tried_flavor_idx=nom.tried_flavor_idx,
+            usage=final_usage,
+            order=order,
+        )
+
+    return impl
+
+
+cycle_fixedpoint = jax.jit(make_fixedpoint_cycle())

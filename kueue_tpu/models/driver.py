@@ -81,7 +81,14 @@ class DeviceScheduler:
 
         if idx.workloads:
             t0 = self.clock()
-            out = batch_scheduler.cycle_grouped(arrays, idx.group_arrays)
+            # No lending limits -> the O(rounds) fixed-point kernel is
+            # exact; otherwise the forest-grouped sequential scan.
+            if not bool(np.asarray(arrays.tree.has_lend_limit).any()):
+                out = batch_scheduler.cycle_fixedpoint(
+                    arrays, idx.group_arrays
+                )
+            else:
+                out = batch_scheduler.cycle_grouped(arrays, idx.group_arrays)
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)

@@ -1,0 +1,106 @@
+"""Differential tests: fixed-point admission vs the grouped sequential scan
+on random no-lending-limit problems — outcomes and final usage must be
+identical (both are order-exact greedy admission)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from kueue_tpu.models import batch_scheduler as bs
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.ops.quota_ops import QuotaTreeArrays, compute_subtree
+from kueue_tpu.ops.tree_encode import GroupLayout
+from kueue_tpu.core.resources import UNLIMITED
+
+
+def synth(seed, W=64, C=10, F=3, R=2, COHORTS=3, with_bl=True,
+          never_preempts=True):
+    rng = np.random.default_rng(seed)
+    N = C + COHORTS
+    parent = np.full(N, -1, np.int32)
+    depth = np.zeros(N, np.int32)
+    height = np.zeros(N, np.int32)
+    for i in range(COHORTS, N):
+        parent[i] = rng.integers(0, COHORTS)
+        depth[i] = 1
+    height[:COHORTS] = 1
+    is_cq = np.zeros(N, bool)
+    is_cq[COHORTS:] = True
+    nominal = np.zeros((N, F, R), np.int64)
+    nominal[COHORTS:] = rng.integers(0, 10, (C, F, R)) * 1000
+    has_bl = np.zeros((N, F, R), bool)
+    bl = np.full((N, F, R), UNLIMITED, np.int64)
+    if with_bl:
+        mask = rng.random((C, F, R)) < 0.5
+        has_bl[COHORTS:] = mask
+        bl[COHORTS:][mask] = (
+            rng.integers(0, 8, (C, F, R)) * 1000
+        )[mask]
+    tree = QuotaTreeArrays(
+        parent=jnp.asarray(parent), active=jnp.ones(N, bool),
+        depth=jnp.asarray(depth), height=jnp.asarray(height),
+        nominal=jnp.asarray(nominal),
+        borrow_limit=jnp.asarray(bl),
+        has_borrow_limit=jnp.asarray(has_bl),
+        lend_limit=jnp.full((N, F, R), UNLIMITED, jnp.int64),
+        has_lend_limit=jnp.zeros((N, F, R), bool),
+        subtree_quota=jnp.zeros((N, F, R), jnp.int64),
+    )
+    usage0 = jnp.asarray(
+        np.where(is_cq[:, None, None],
+                 rng.integers(0, 4, (N, F, R)) * 1000, 0)
+    )
+    subtree, usage = compute_subtree(tree, usage0, jnp.asarray(is_cq))
+    tree = tree._replace(subtree_quota=subtree)
+    arrays = CycleArrays(
+        tree=tree, usage=usage,
+        flavor_at=jnp.asarray(
+            np.tile(np.arange(F, dtype=np.int32), (N, 1))),
+        n_flavors=jnp.full(N, F, jnp.int32),
+        covered=jnp.ones((N, R), bool),
+        when_can_borrow_try_next=jnp.asarray(rng.random(N) < 0.5),
+        when_can_preempt_try_next=jnp.ones(N, bool),
+        pref_preempt_over_borrow=jnp.zeros(N, bool),
+        can_preempt_while_borrowing=jnp.zeros(N, bool),
+        never_preempts=jnp.full(N, never_preempts),
+        can_always_reclaim=jnp.asarray(rng.random(N) < 0.3),
+        nominal_cq=tree.nominal,
+        w_cq=jnp.asarray(rng.integers(COHORTS, N, W).astype(np.int32)),
+        w_req=jnp.asarray(rng.integers(0, 6, (W, R)) * 500),
+        w_elig=jnp.asarray(rng.random((W, F)) < 0.85),
+        w_active=jnp.asarray(rng.random(W) < 0.95),
+        w_priority=jnp.asarray(rng.integers(0, 3, W) * 100),
+        w_timestamp=jnp.asarray(np.arange(W, dtype=np.float64)),
+        w_quota_reserved=jnp.zeros(W, bool),
+        w_start_flavor=jnp.zeros(W, np.int32),
+    )
+    layout = GroupLayout(parent, np.ones(N, bool))
+    ga = bs.GroupArrays(*layout.as_jax())
+    return arrays, ga
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fixedpoint_matches_grouped_scan(seed):
+    arrays, ga = synth(seed)
+    out_scan = bs.cycle_grouped(arrays, ga)
+    out_fp = bs.cycle_fixedpoint(arrays, ga)
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.outcome), np.asarray(out_fp.outcome),
+        err_msg=f"outcomes differ (seed {seed})",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.usage), np.asarray(out_fp.usage),
+        err_msg=f"final usage differs (seed {seed})",
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fixedpoint_matches_with_preempt_capable_cqs(seed):
+    # needs_host entries contribute nothing in both kernels.
+    arrays, ga = synth(100 + seed, never_preempts=False)
+    out_scan = bs.cycle_grouped(arrays, ga)
+    out_fp = bs.cycle_fixedpoint(arrays, ga)
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.outcome), np.asarray(out_fp.outcome))
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.usage), np.asarray(out_fp.usage))
