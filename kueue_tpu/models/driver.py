@@ -53,6 +53,7 @@ class DeviceScheduler:
                               clock=clock)
         self.device_time_s = 0.0
         self.cycles = 0
+        self.use_fixedpoint = False
 
     # ------------------------------------------------------------------
 
@@ -81,9 +82,12 @@ class DeviceScheduler:
 
         if idx.workloads:
             t0 = self.clock()
-            # No lending limits -> the O(rounds) fixed-point kernel is
-            # exact; otherwise the forest-grouped sequential scan.
-            if not bool(np.asarray(arrays.tree.has_lend_limit).any()):
+            # Default kernel: forest-grouped scan. The fixed-point kernel
+            # (exact for no-lending-limit trees) is opt-in until TPU
+            # measurements establish the crossover; bench.py probes both.
+            if self.use_fixedpoint and not bool(
+                np.asarray(arrays.tree.has_lend_limit).any()
+            ):
                 out = batch_scheduler.cycle_fixedpoint(
                     arrays, idx.group_arrays
                 )
