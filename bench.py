@@ -247,6 +247,11 @@ def device_mega_cycle_probe():
         can_preempt_while_borrowing=jnp.zeros(N, bool),
         never_preempts=jnp.ones(N, bool),
         can_always_reclaim=jnp.zeros(N, bool),
+        usage_by_prio=jnp.zeros((N, F, R, 8), jnp.int64),
+        prio_cuts=jnp.full(8, (1 << 62), jnp.int64),
+        prefilter_valid=jnp.asarray(False),
+        policy_within=jnp.zeros(N, jnp.int32),
+        policy_reclaim=jnp.zeros(N, jnp.int32),
         nominal_cq=tree.nominal,
         w_cq=jnp.asarray(rng.integers(CO, N, W).astype(np.int32)),
         w_req=jnp.asarray(rng.integers(1, 20, (W, R)) * 500),

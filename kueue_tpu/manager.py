@@ -113,10 +113,24 @@ class Manager:
     # ------------------------------------------------------------------
 
     def apply(self, *objects: ApplyObject) -> None:
+        from kueue_tpu.api.constants import StopPolicy
+
         for obj in objects:
             if isinstance(obj, ClusterQueue):
                 self.cache.add_or_update_cluster_queue(obj)
                 self.queues.add_cluster_queue(obj)
+                if obj.stop_policy == StopPolicy.HOLD_AND_DRAIN:
+                    # Drain: evict every admitted workload of this CQ
+                    # (reference stopPolicy semantics).
+                    for info in list(self.cache.workloads.values()):
+                        if info.cluster_queue == obj.name:
+                            wl = self.workloads.get(info.key)
+                            if wl is not None:
+                                self.workload_controller.evict(
+                                    wl, "ClusterQueueStopped",
+                                    "The ClusterQueue is stopped and "
+                                    "draining", self.clock(),
+                                )
             elif isinstance(obj, Cohort):
                 self.cache.add_or_update_cohort(obj)
             elif isinstance(obj, LocalQueue):

@@ -120,7 +120,26 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
     avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
     pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
 
-    def per_workload(c, req, elig, start_k, active):
+    # Preemption-candidate prefilter: tree-level aggregates of "borrowing
+    # CQ with eligible admitted usage" per priority bucket, so the oracle's
+    # NoCandidates outcome resolves on device whenever zero candidates can
+    # exist (a sound subset of reference preemption_oracle.go outcomes; any
+    # possible candidate still routes to the host path).
+    parent_or_self = jnp.where(
+        tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent
+    )
+    root_of = jnp.arange(tree.n_nodes)
+    for _ in range(MAX_DEPTH):
+        root_of = parent_or_self[root_of]
+    cq_borrowing = usage > tree.subtree_quota  # [N,F,R] not-within-nominal
+    contrib = (
+        cq_borrowing[..., None] & (arrays.usage_by_prio > 0)
+    )  # [N,F,R,B]
+    tree_count = jnp.zeros_like(contrib, dtype=jnp.int32).at[root_of].add(
+        contrib.astype(jnp.int32), mode="drop"
+    )  # indexed by root node id
+
+    def per_workload(c, req, elig, start_k, active, prio):
         # req: i64[R]; elig: bool[F].
         f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
         req_cell = jnp.broadcast_to(req[None, :], (f_n, r_n))
@@ -149,6 +168,40 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
         # device: NoCandidates, borrow from the no-preemption fit search.
         pmode_cell = jnp.where(
             (pmode_cell == P_PREEMPT_RAW) & arrays.never_preempts[c],
+            P_NO_CANDIDATES,
+            pmode_cell,
+        )
+        # Prefilter: zero possible candidates -> exact NoCandidates.
+        cuts = arrays.prio_cuts
+        mask_lower = cuts < prio
+        mask_loweq = cuts <= prio
+
+        def bucket_elig(pol):
+            return jnp.where(
+                pol == 3,
+                jnp.ones_like(cuts, dtype=bool),
+                jnp.where(
+                    pol == 2, mask_loweq,
+                    jnp.where(pol == 1, mask_lower,
+                              jnp.zeros_like(cuts, dtype=bool)),
+                ),
+            )
+
+        same_elig = bucket_elig(arrays.policy_within[c])  # [B]
+        same_exists = jnp.any(
+            (arrays.usage_by_prio[c] > 0) & same_elig[None, None, :],
+            axis=-1,
+        )  # [F,R]
+        reclaim_elig = bucket_elig(arrays.policy_reclaim[c])
+        others = (
+            tree_count[root_of[c]] - contrib[c].astype(jnp.int32)
+        ) > 0  # [F,R,B]
+        cross_exists = jnp.any(others & reclaim_elig[None, None, :], axis=-1)
+        no_candidates = (
+            arrays.prefilter_valid & ~(same_exists | cross_exists)
+        )
+        pmode_cell = jnp.where(
+            (pmode_cell == P_PREEMPT_RAW) & no_candidates,
             P_NO_CANDIDATES,
             pmode_cell,
         )
@@ -225,7 +278,7 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
 
     chosen, pmode, borrow, needs_host, tried = jax.vmap(per_workload)(
         arrays.w_cq, arrays.w_req, arrays.w_elig, arrays.w_start_flavor,
-        arrays.w_active,
+        arrays.w_active, arrays.w_priority,
     )
     return NominateResult(chosen, pmode, borrow, needs_host, tried)
 

@@ -47,6 +47,14 @@ class CycleArrays(NamedTuple):
     can_preempt_while_borrowing: jnp.ndarray  # bool[N]
     never_preempts: jnp.ndarray  # bool[N] oracle deterministically NoCandidates
     can_always_reclaim: jnp.ndarray  # bool[N] reclaimWithinCohort == Any
+    # Preemption-candidate prefilter (resolves NoCandidates on device):
+    # admitted usage bucketed by workload priority rank, and policy codes
+    # (0=Never, 1=LowerPriority, 2=LowerOrNewerEqual superset, 3=Any).
+    usage_by_prio: jnp.ndarray  # i64[N,F,R,B] per-CQ admitted usage
+    prio_cuts: jnp.ndarray  # i64[B] bucket upper bounds (sorted distinct)
+    prefilter_valid: jnp.ndarray  # bool[] whether buckets cover all prios
+    policy_within: jnp.ndarray  # i32[N]
+    policy_reclaim: jnp.ndarray  # i32[N]
     nominal_cq: jnp.ndarray  # i64[N,F,R] (= tree.nominal; alias for clarity)
     # -- per-workload --
     w_cq: jnp.ndarray  # i32[W] CQ node index
@@ -108,6 +116,8 @@ def encode_cycle(
     cpwb = np.zeros(n, dtype=bool)
     never_preempts = np.zeros(n, dtype=bool)
     can_always_reclaim = np.zeros(n, dtype=bool)
+    policy_within = np.zeros(n, dtype=np.int32)
+    policy_reclaim = np.zeros(n, dtype=np.int32)
 
     single_rg_cq: Dict[str, bool] = {}
     for name, cqs in snapshot.cluster_queues.items():
@@ -154,6 +164,40 @@ def encode_cycle(
         can_always_reclaim[ni] = (
             p.reclaim_within_cohort == PreemptionPolicy.ANY
         )
+        _pol = {
+            PreemptionPolicy.NEVER: 0,
+            PreemptionPolicy.LOWER_PRIORITY: 1,
+            PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY: 2,
+            PreemptionPolicy.ANY: 3,
+        }
+        policy_within[ni] = _pol[p.within_cluster_queue]
+        policy_reclaim[ni] = _pol[p.reclaim_within_cohort]
+
+    # Admitted usage bucketed by priority rank (preemption prefilter).
+    B = 8
+    admitted_prios = sorted({
+        info.priority()
+        for cqs in snapshot.cluster_queues.values()
+        for info in cqs.workloads.values()
+    })
+    prefilter_valid = np.asarray(len(admitted_prios) <= B)
+    prio_cuts = np.full(B, np.iinfo(np.int64).max // 2, dtype=np.int64)
+    prio_rank = {}
+    if prefilter_valid:
+        for rank_i, pv in enumerate(admitted_prios):
+            prio_cuts[rank_i] = pv
+            prio_rank[pv] = rank_i
+    usage_by_prio = np.zeros((n, f, r, B), dtype=np.int64)
+    if prefilter_valid:
+        for cq_name2, cqs2 in snapshot.cluster_queues.items():
+            ni2 = tidx.node_of[cq_name2]
+            for info in cqs2.workloads.values():
+                b = prio_rank.get(info.priority(), B - 1)
+                for fr2, v2 in info.usage().items():
+                    fi2 = tidx.flavor_of.get(fr2.flavor)
+                    ri2 = tidx.resource_of.get(fr2.resource)
+                    if fi2 is not None and ri2 is not None:
+                        usage_by_prio[ni2, fi2, ri2, b] += v2
 
     # Workload arrays.
     device_wls: List[WorkloadInfo] = []
@@ -219,6 +263,11 @@ def encode_cycle(
         can_preempt_while_borrowing=jnp.asarray(cpwb),
         never_preempts=jnp.asarray(never_preempts),
         can_always_reclaim=jnp.asarray(can_always_reclaim),
+        usage_by_prio=jnp.asarray(usage_by_prio),
+        prio_cuts=jnp.asarray(prio_cuts),
+        prefilter_valid=jnp.asarray(prefilter_valid),
+        policy_within=jnp.asarray(policy_within),
+        policy_reclaim=jnp.asarray(policy_reclaim),
         nominal_cq=tree.nominal,
         w_cq=jnp.asarray(w_cq),
         w_req=jnp.asarray(w_req),

@@ -49,6 +49,11 @@ def cycle_shardings(mesh: Mesh):
         can_preempt_while_borrowing=rep,
         never_preempts=rep,
         can_always_reclaim=rep,
+        usage_by_prio=rep,
+        prio_cuts=rep,
+        prefilter_valid=rep,
+        policy_within=rep,
+        policy_reclaim=rep,
         nominal_cq=rep,
         w_cq=wsh,
         w_req=wsh,

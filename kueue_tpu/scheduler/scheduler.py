@@ -206,6 +206,11 @@ class Scheduler:
                     f"ClusterQueue {info.cluster_queue} is inactive"
                 )
                 inadmissible.append(e)
+            elif self._local_queue_stopped(info):
+                e.inadmissible_msg = (
+                    f"LocalQueue {info.obj.queue_name} is stopped"
+                )
+                inadmissible.append(e)
             elif cqs is None:
                 e.inadmissible_msg = (
                     f"ClusterQueue {info.cluster_queue} not found"
@@ -225,6 +230,14 @@ class Scheduler:
                 info.last_assignment = assignment.last_state
                 entries.append(e)
         return entries, inadmissible
+
+    def _local_queue_stopped(self, info: WorkloadInfo) -> bool:
+        from kueue_tpu.api.constants import StopPolicy
+
+        lq = self.cache.local_queues.get(
+            f"{info.obj.namespace}/{info.obj.queue_name}"
+        )
+        return lq is not None and lq.stop_policy != StopPolicy.NONE
 
     def _namespace_allowed(
         self, cqs: ClusterQueueSnapshot, info: WorkloadInfo

@@ -334,3 +334,51 @@ def test_registry_has_all_frameworks():
                      "raycluster", "leaderworkerset", "pod", "deployment",
                      "statefulset", "trainjob"]:
         assert expected in names, expected
+
+
+def test_cq_stop_policies():
+    from kueue_tpu.api.constants import StopPolicy
+
+    mgr = basic_manager()
+    job1 = BatchJob("running", queue="lq", requests={"cpu": 1000})
+    wl1 = mgr.submit_job(job1)
+    mgr.schedule_all()
+    assert is_admitted(wl1)
+
+    cq = mgr.cache.cluster_queues["cq-a"]
+    # Hold: admitted keeps running, new workloads blocked.
+    cq.stop_policy = StopPolicy.HOLD
+    mgr.apply(cq)
+    job2 = BatchJob("blocked", queue="lq", requests={"cpu": 1000})
+    wl2 = mgr.submit_job(job2)
+    mgr.schedule_all()
+    assert is_admitted(wl1) and not is_admitted(wl2)
+
+    # HoldAndDrain: admitted evicted too.
+    cq.stop_policy = StopPolicy.HOLD_AND_DRAIN
+    mgr.apply(cq)
+    assert is_evicted(wl1)
+    assert job1.is_suspended()
+
+    # Resume: both admit again.
+    cq.stop_policy = StopPolicy.NONE
+    mgr.apply(cq)
+    mgr.schedule_all()
+    assert is_admitted(wl1) and is_admitted(wl2)
+
+
+def test_lq_stop_policy_blocks_queue():
+    from kueue_tpu.api.constants import StopPolicy
+    from kueue_tpu.api.types import LocalQueue
+
+    mgr = basic_manager()
+    lq = mgr.cache.local_queues["default/lq"]
+    lq.stop_policy = StopPolicy.HOLD
+    job = BatchJob("held", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert not is_admitted(wl)
+    lq.stop_policy = StopPolicy.NONE
+    mgr.queues.queue_inadmissible_workloads()
+    mgr.schedule_all()
+    assert is_admitted(wl)

@@ -64,6 +64,11 @@ def synth(seed, W=64, C=10, F=3, R=2, COHORTS=3, with_bl=True,
         can_preempt_while_borrowing=jnp.zeros(N, bool),
         never_preempts=jnp.full(N, never_preempts),
         can_always_reclaim=jnp.asarray(rng.random(N) < 0.3),
+        usage_by_prio=jnp.zeros((N, F, R, 8), jnp.int64),
+        prio_cuts=jnp.full(8, (1 << 62), jnp.int64),
+        prefilter_valid=jnp.asarray(False),
+        policy_within=jnp.zeros(N, jnp.int32),
+        policy_reclaim=jnp.zeros(N, jnp.int32),
         nominal_cq=tree.nominal,
         w_cq=jnp.asarray(rng.integers(COHORTS, N, W).astype(np.int32)),
         w_req=jnp.asarray(rng.integers(0, 6, (W, R)) * 500),

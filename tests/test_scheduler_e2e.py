@@ -434,3 +434,34 @@ def test_fair_sharing_orders_by_drs():
     sched.schedule()
     assert "wb" in admitted_names(cache)
     assert "wa" not in admitted_names(cache)
+
+
+def test_cohort_level_quotas():
+    """Cohorts can hold their own quotas (reference cohort_types.go:24):
+    CQs in the cohort can use them beyond their nominal."""
+    from kueue_tpu.api.types import Cohort, FlavorQuotas, ResourceQuota
+
+    cohort = Cohort(
+        name="co",
+        quotas=[FlavorQuotas(
+            name="default",
+            resources={"cpu": ResourceQuota(nominal=5_000)},
+        )],
+    )
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}}),
+        ],
+        cohorts=[cohort],
+    )
+    # 2000 own + 5000 cohort-level = 7000 available.
+    wl = make_wl("big", cpu_m=7_000)
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["big"]
+
+    wl2 = make_wl("too-big", cpu_m=1_000)
+    submit(queues, wl2)
+    sched.schedule_all()
+    assert "too-big" not in admitted_names(cache)
