@@ -299,6 +299,56 @@ class Manager:
             self._sync_admission_checks(wl)
             self.workload_controller.reconcile(wl)
         self.workload_controller.requeue_ready_backoffs()
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        """Gauge series (reference pkg/metrics/metrics.go:414,831,896):
+        pending_workloads, cluster_queue_resource_usage,
+        cluster_queue_weighted_share / cohort_weighted_share."""
+        from kueue_tpu.core.resources import FlavorResource
+
+        snapshot = None
+        for name in self.cache.cluster_queues:
+            self.metrics.set_gauge(
+                "pending_workloads", self.queues.pending_count(name),
+                {"cluster_queue": name, "status": "active"},
+            )
+        usage_by_cq: Dict[str, Dict] = {}
+        for info in self.cache.workloads.values():
+            dst = usage_by_cq.setdefault(info.cluster_queue, {})
+            for fr, v in info.usage().items():
+                dst[fr] = dst.get(fr, 0) + v
+        for cq_name, frs in usage_by_cq.items():
+            for fr, v in frs.items():
+                self.metrics.set_gauge(
+                    "cluster_queue_resource_usage", v,
+                    {"cluster_queue": cq_name, "flavor": fr.flavor,
+                     "resource": fr.resource},
+                )
+        # Weighted shares need the snapshot's quota tree.
+        try:
+            snapshot = self.cache.snapshot()
+        except ValueError:
+            return
+        for name, cqs in snapshot.cluster_queues.items():
+            drs = cqs.dominant_resource_share()
+            share = drs.precise_weighted_share()
+            if share != float("inf"):
+                self.metrics.set_gauge(
+                    "cluster_queue_weighted_share", share,
+                    {"cluster_queue": name},
+                )
+        for name, node in snapshot.cohorts.items():
+            from kueue_tpu.cache.resource_node import (
+                dominant_resource_share,
+            )
+
+            drs = dominant_resource_share(node, {})
+            share = drs.precise_weighted_share()
+            if share != float("inf"):
+                self.metrics.set_gauge(
+                    "cohort_weighted_share", share, {"cohort": name},
+                )
 
     def run_until_settled(self, max_rounds: int = 1000) -> None:
         """Drive schedule + tick until no more progress."""

@@ -382,3 +382,17 @@ def test_lq_stop_policy_blocks_queue():
     mgr.queues.queue_inadmissible_workloads()
     mgr.schedule_all()
     assert is_admitted(wl)
+
+
+def test_gauge_metrics_updated():
+    mgr = basic_manager()
+    job = BatchJob("g", queue="lq", requests={"cpu": 2000})
+    mgr.submit_job(job)
+    mgr.schedule_all()
+    assert mgr.metrics.get(
+        "cluster_queue_resource_usage",
+        {"cluster_queue": "cq-a", "flavor": "default", "resource": "cpu"},
+    ) == 2000.0
+    assert mgr.metrics.get(
+        "pending_workloads", {"cluster_queue": "cq-a", "status": "active"}
+    ) == 0.0

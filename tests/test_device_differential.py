@@ -161,3 +161,80 @@ def test_device_matches_host(seed):
             f"flavor assignment differs for {name}: "
             f"host={host_adm[name]} device={dev_adm[name]}"
         )
+
+
+def test_prefilter_resolves_no_candidates_on_device():
+    """Preemption-capable CQ with nothing preemptable: the device resolves
+    NoCandidates exactly (no host fallback), matching host semantics."""
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption
+
+    preemption = ClusterQueuePreemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+    )
+    for run_device in (False, True):
+        cache, queues, _ = build_env(
+            [
+                make_cq("cq-a", cohort="co",
+                        flavors={"f0": {"cpu": ResourceQuota(4000)}},
+                        preemption=preemption),
+                make_cq("cq-b", cohort="co",
+                        flavors={"f0": {"cpu": ResourceQuota(4000)}}),
+            ],
+        )
+        # w1 saturates cq-a; w2 needs 5000 (> the 4000 still borrowable),
+        # so only preemption could help — but every admitted workload has
+        # EQUAL priority -> zero candidates -> requeue, no eviction.
+        w1 = make_wl("w1", queue="lq-cq-a", cpu_m=4000, priority=100,
+                     creation_time=1.0)
+        w2 = make_wl("w2", queue="lq-cq-a", cpu_m=5000, priority=100,
+                     creation_time=2.0)
+        if run_device:
+            sched = DeviceScheduler(cache, queues)
+        else:
+            from kueue_tpu.scheduler.scheduler import Scheduler
+
+            sched = Scheduler(cache, queues)
+        submit(queues, w1, w2)
+        sched.schedule_all()
+        admitted = sorted(
+            i.obj.name for i in cache.workloads.values()
+        )
+        assert admitted == ["w1"], (run_device, admitted)
+        from kueue_tpu.core.workload_info import is_evicted
+
+        assert not is_evicted(w1)
+
+
+def test_device_preemption_falls_back_to_host_and_evicts():
+    """Real candidates exist: the device defers, the host path preempts —
+    end state matches the pure-host scheduler."""
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption
+
+    preemption = ClusterQueuePreemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+    )
+    results = {}
+    for run_device in (False, True):
+        cache, queues, _ = build_env(
+            [make_cq("cq-a", flavors={"f0": {"cpu": ResourceQuota(4000)}},
+                     preemption=preemption)],
+        )
+        lo = make_wl("lo", cpu_m=4000, priority=1, creation_time=1.0)
+        hi = make_wl("hi", cpu_m=4000, priority=10, creation_time=2.0)
+        if run_device:
+            sched = DeviceScheduler(cache, queues)
+        else:
+            from kueue_tpu.scheduler.scheduler import Scheduler
+
+            sched = Scheduler(cache, queues)
+        submit(queues, lo)
+        sched.schedule_all()
+        submit(queues, hi)
+        sched.schedule_all()
+        results[run_device] = sorted(
+            i.obj.name for i in cache.workloads.values()
+        )
+    assert results[False] == results[True] == ["hi"]

@@ -274,3 +274,24 @@ def test_balanced_placement_spreads_evenly():
         per_rack[rack] = per_rack.get(rack, 0) + c
     # 6 pods over 2 racks balanced -> 3 + 3 (not 4 + 2).
     assert sorted(per_rack.values()) == [3, 3], per_rack
+
+
+def test_leader_worker_placement():
+    """LWS leader + workers: the leader pod lands on a node that also has
+    worker capacity (reference leader/worker split :725)."""
+    snap = snapshot()
+    ta, leader_ta, reason = snap.find_topology_assignment(
+        PlacementRequest(
+            count=2, single_pod_requests={"tpu": 3},
+            required_level=LEVELS[1],
+            leader_requests={"tpu": 1},
+        )
+    )
+    assert reason == ""
+    assert leader_ta is not None
+    assert sum(c for _, c in leader_ta.domains) == 1
+    assert sum(c for _, c in ta.domains) == 2
+    # Leader + its co-located worker share a node: 3+1 <= 4 on one node.
+    leader_node = leader_ta.domains[0][0][-1]
+    worker_nodes = {v[-1] for v, _ in ta.domains}
+    assert leader_node in worker_nodes or len(worker_nodes) == 2
