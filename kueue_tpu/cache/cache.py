@@ -53,6 +53,11 @@ class Cache:
         self.generation = 0
         # Structure cache for TAS snapshots: (generation, template).
         self._tas_templates: Dict[str, tuple] = {}
+        # Live quota tree with incrementally maintained usage (reference
+        # cache.go keeps usage live; Snapshot() only clones usage maps).
+        self._live_nodes: Optional[Dict[str, object]] = None
+        self._live_generation = -1
+        self._cq_workloads: Dict[str, Dict[str, WorkloadInfo]] = {}
 
     # -- spec management ----------------------------------------------------
 
@@ -110,26 +115,50 @@ class Cache:
 
     # -- workload lifecycle -------------------------------------------------
 
+    def _live_add(self, info: WorkloadInfo) -> None:
+        self._ensure_live()
+        node = self._live_nodes.get(info.cluster_queue)
+        if node is not None:
+            for fr, v in info.usage().items():
+                node.add_usage(fr, v)
+        self._cq_workloads.setdefault(info.cluster_queue, {})[info.key] = info
+
+    def _live_remove(self, key: str) -> None:
+        old = self.workloads.get(key)
+        if old is None or self._live_nodes is None:
+            return
+        node = self._live_nodes.get(old.cluster_queue)
+        if node is not None:
+            for fr, v in old.usage().items():
+                node.remove_usage(fr, v)
+        self._cq_workloads.get(old.cluster_queue, {}).pop(key, None)
+
     def add_or_update_workload(self, info: WorkloadInfo) -> None:
         with self._lock:
+            self._live_remove(info.key)
             self.workloads[info.key] = info
             self.assumed.discard(info.key)
+            self._live_add(info)
 
     def assume_workload(self, info: WorkloadInfo) -> None:
         """Optimistic admission before the status write lands
         (reference cache.go AssumeWorkload)."""
         with self._lock:
+            self._live_remove(info.key)
             self.workloads[info.key] = info
             self.assumed.add(info.key)
+            self._live_add(info)
 
     def forget_workload(self, key: str) -> None:
         with self._lock:
             if key in self.assumed:
+                self._live_remove(key)
                 self.assumed.discard(key)
                 self.workloads.pop(key, None)
 
     def delete_workload(self, key: str) -> None:
         with self._lock:
+            self._live_remove(key)
             self.workloads.pop(key, None)
             self.assumed.discard(key)
 
@@ -157,23 +186,69 @@ class Cache:
 
     # -- snapshot -----------------------------------------------------------
 
+    def _ensure_live(self) -> None:
+        """(Re)build the live quota tree when specs changed, replaying
+        admitted usage once; all later workload events update it
+        incrementally."""
+        if self._live_nodes is not None and \
+                self._live_generation == self.generation:
+            return
+        nodes = build_quota_tree(
+            self.cohorts.values(), self.cluster_queues.values()
+        )
+        if has_cycle(nodes):
+            raise ValueError("cohort hierarchy has a cycle")
+        for node in nodes.values():
+            if node.parent is None:
+                update_tree(node)
+        self._live_nodes = nodes
+        self._live_generation = self.generation
+        self._cq_workloads = {}
+        for info in self.workloads.values():
+            node = nodes.get(info.cluster_queue)
+            if node is not None:
+                for fr, v in info.usage().items():
+                    node.add_usage(fr, v)
+                self._cq_workloads.setdefault(
+                    info.cluster_queue, {}
+                )[info.key] = info
+
+    def _clone_live_tree(self) -> Dict[str, object]:
+        """Copy-on-cycle clone: structure, quotas and subtree quotas are
+        shared; usage dicts are copied (the scheduler's transaction state).
+        reference resource_node.go Clone()."""
+        from kueue_tpu.cache.resource_node import QuotaNode
+
+        clones: Dict[str, QuotaNode] = {}
+        for name, node in self._live_nodes.items():
+            c = QuotaNode.__new__(QuotaNode)
+            c.name = node.name
+            c.is_cq = node.is_cq
+            c.parent = None
+            c.children = []
+            c.quotas = node.quotas  # shared (immutable between gens)
+            c.subtree_quota = node.subtree_quota  # shared
+            c.usage = dict(node.usage)  # the mutable transaction state
+            c.fair_weight = node.fair_weight
+            clones[name] = c
+        for name, node in self._live_nodes.items():
+            if node.parent is not None:
+                clones[name].parent = clones[node.parent.name]
+                clones[node.parent.name].children.append(clones[name])
+        return clones
+
     def snapshot(self) -> Snapshot:
         """reference snapshot.go:161: copy-on-cycle scheduling view."""
         with self._lock:
+            self._ensure_live()
             snap = Snapshot()
             snap.resource_flavors = dict(self.resource_flavors)
-            nodes = build_quota_tree(
-                self.cohorts.values(), self.cluster_queues.values()
-            )
-            if has_cycle(nodes):
-                raise ValueError("cohort hierarchy has a cycle")
-            roots = [n for n in nodes.values() if n.parent is None]
-            for root in roots:
-                update_tree(root)
-            snap.roots = roots
+            nodes = self._clone_live_tree()
+            snap.roots = [n for n in nodes.values() if n.parent is None]
             for name, cq in self.cluster_queues.items():
                 cqs = ClusterQueueSnapshot(cq, nodes[name])
                 cqs.allocatable_generation = self.generation
+                cqs.workloads = dict(self._cq_workloads.get(name, {}))
                 snap.cluster_queues[name] = cqs
                 if not self.cluster_queue_active(cq):
                     snap.inactive_cluster_queues.add(name)
@@ -202,7 +277,13 @@ class Cache:
                         for k, v in self.non_tas_usage.get(name, {}).items()
                     }
                     snap.tas_flavors[name] = tas
-            for info in self.workloads.values():
-                if info.cluster_queue in snap.cluster_queues:
-                    snap.add_workload(info.clone())
+            # Usage is already in the cloned tree; only TAS usage needs a
+            # replay into the per-cycle TAS snapshots.
+            if snap.tas_flavors:
+                for info in self.workloads.values():
+                    for flavor, leaf_usage in info.tas_usage().items():
+                        tas = snap.tas_flavors.get(flavor)
+                        if tas is not None:
+                            for leaf_id, reqs in leaf_usage.items():
+                                tas.add_usage(leaf_id, reqs)
             return snap
