@@ -115,8 +115,14 @@ class Manager:
     def apply(self, *objects: ApplyObject) -> None:
         from kueue_tpu.api.constants import StopPolicy
 
+        from kueue_tpu.utils.validation import (
+            validate_cluster_queue,
+            validate_cohort,
+        )
+
         for obj in objects:
             if isinstance(obj, ClusterQueue):
+                validate_cluster_queue(obj)
                 self.cache.add_or_update_cluster_queue(obj)
                 self.queues.add_cluster_queue(obj)
                 if obj.stop_policy == StopPolicy.HOLD_AND_DRAIN:
@@ -132,6 +138,7 @@ class Manager:
                                     "draining", self.clock(),
                                 )
             elif isinstance(obj, Cohort):
+                validate_cohort(obj)
                 self.cache.add_or_update_cohort(obj)
             elif isinstance(obj, LocalQueue):
                 self.cache.add_or_update_local_queue(obj)
@@ -176,12 +183,11 @@ class Manager:
     def create_workload(self, wl: Workload) -> None:
         """Validating-webhook equivalent + queue entry
         (reference pkg/webhooks/workload_webhook.go)."""
+        from kueue_tpu.utils.validation import validate_workload
+
         if wl.key in self.workloads:
             raise ValueError(f"workload {wl.key} already exists")
-        if not wl.pod_sets:
-            raise ValueError("workload needs at least one podset")
-        if len(wl.pod_sets) > 18:
-            raise ValueError("workload supports at most 18 podsets")
+        validate_workload(wl)
         if wl.creation_time == 0.0:
             wl.creation_time = self.clock()
         if wl.priority_class and wl.priority_class in self.priority_classes:

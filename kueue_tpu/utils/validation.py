@@ -1,0 +1,86 @@
+"""Object validation (webhook equivalents).
+
+Behavioral surface: reference pkg/webhooks/{clusterqueue,cohort,
+resourceflavor,workload}_webhook.go — structural invariants enforced at
+apply/create time.
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.api.constants import BorrowWithinCohortPolicy, PreemptionPolicy
+from kueue_tpu.api.types import ClusterQueue, Cohort, Workload
+
+
+def validate_cluster_queue(cq: ClusterQueue) -> None:
+    """reference clusterqueue_webhook.go:62-96."""
+    if len(cq.resource_groups) > 16:
+        raise ValueError("a ClusterQueue supports at most 16 resourceGroups")
+    total_flavors = sum(len(rg.flavors) for rg in cq.resource_groups)
+    if total_flavors > 256:
+        raise ValueError("a ClusterQueue supports at most 256 flavors")
+    seen_resources = set()
+    for rg in cq.resource_groups:
+        if not rg.covered_resources:
+            raise ValueError("resourceGroup needs coveredResources")
+        for res in rg.covered_resources:
+            if res in seen_resources:
+                raise ValueError(
+                    f"resource {res} appears in multiple resourceGroups"
+                )
+            seen_resources.add(res)
+        for fq in rg.flavors:
+            for res, q in fq.resources.items():
+                if res not in rg.covered_resources:
+                    raise ValueError(
+                        f"flavor {fq.name} defines quota for uncovered"
+                        f" resource {res}"
+                    )
+                if q.nominal < 0:
+                    raise ValueError("nominalQuota must be >= 0")
+                if q.borrowing_limit is not None and q.borrowing_limit < 0:
+                    raise ValueError("borrowingLimit must be >= 0")
+                if q.lending_limit is not None and q.lending_limit < 0:
+                    raise ValueError("lendingLimit must be >= 0")
+                if q.lending_limit is not None and not cq.cohort:
+                    raise ValueError(
+                        "lendingLimit requires the ClusterQueue to be in a"
+                        " cohort"
+                    )
+    bwc = cq.preemption.borrow_within_cohort
+    if (
+        bwc.policy == BorrowWithinCohortPolicy.NEVER
+        and bwc.max_priority_threshold is not None
+    ):
+        raise ValueError(
+            "maxPriorityThreshold requires borrowWithinCohort policy"
+            " != Never"
+        )
+
+
+def validate_cohort(cohort: Cohort) -> None:
+    if cohort.parent == cohort.name:
+        raise ValueError("a Cohort cannot be its own parent")
+
+
+def validate_workload(wl: Workload) -> None:
+    """reference workload_webhook.go."""
+    if not wl.pod_sets:
+        raise ValueError("workload needs at least one podset")
+    if len(wl.pod_sets) > 18:
+        raise ValueError("workload supports at most 18 podsets")
+    names = set()
+    for ps in wl.pod_sets:
+        if ps.name in names:
+            raise ValueError(f"duplicate podset name {ps.name}")
+        names.add(ps.name)
+        if ps.count < 0:
+            raise ValueError("podset count must be >= 0")
+        if ps.min_count is not None and not (
+            0 < ps.min_count <= ps.count
+        ):
+            raise ValueError("minCount must be in (0, count]")
+        tr = ps.topology_request
+        if tr is not None and tr.required_level and tr.preferred_level:
+            raise ValueError(
+                "topologyRequest cannot set both required and preferred"
+            )

@@ -262,3 +262,58 @@ def test_dashboard_state_and_http():
         assert "cq-a" in api
     finally:
         httpd.shutdown()
+
+
+def test_webhook_validation():
+    import pytest as _pytest
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        Cohort,
+        FlavorQuotas,
+        PodSet,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.utils.validation import (
+        validate_cluster_queue,
+        validate_cohort,
+        validate_workload,
+    )
+
+    with _pytest.raises(ValueError, match="16 resourceGroups"):
+        validate_cluster_queue(ClusterQueue(
+            name="x",
+            resource_groups=[
+                ResourceGroup(covered_resources=[f"r{i}"])
+                for i in range(17)
+            ],
+        ))
+    with _pytest.raises(ValueError, match="lendingLimit requires"):
+        validate_cluster_queue(ClusterQueue(
+            name="x",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="f",
+                    resources={"cpu": ResourceQuota(1, lending_limit=1)},
+                )],
+            )],
+        ))
+    with _pytest.raises(ValueError, match="own parent"):
+        validate_cohort(Cohort(name="c", parent="c"))
+    with _pytest.raises(ValueError, match="minCount"):
+        validate_workload(Workload(
+            name="w", queue_name="q",
+            pod_sets=[PodSet(name="m", count=2, requests={"cpu": 1},
+                             min_count=5)],
+        ))
+    with _pytest.raises(ValueError, match="duplicate podset"):
+        validate_workload(Workload(
+            name="w", queue_name="q",
+            pod_sets=[
+                PodSet(name="m", count=1, requests={"cpu": 1}),
+                PodSet(name="m", count=1, requests={"cpu": 1}),
+            ],
+        ))
