@@ -289,12 +289,12 @@ def main():
     ap.add_argument("--kind", default="host", choices=["device", "host"])
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
-    ap.add_argument("--skip-mega", action="store_true")
+    ap.add_argument("--with-mega", action="store_true")
     args = ap.parse_args()
 
     stats = run(args.kind, args.scale)
     log(f"stats: {stats}")
-    if not args.skip_mega:
+    if args.with_mega:
         try:
             device_mega_cycle_probe()
         except Exception as exc:  # pragma: no cover

@@ -235,6 +235,11 @@ class Manager:
     # ------------------------------------------------------------------
 
     def schedule(self) -> CycleResult:
+        if self._admission_blocked():
+            # waitForPodsReady.blockAdmission (reference
+            # scheduler.go:545 waitForPodsReadyIfBlocked): hold new
+            # admissions until every admitted workload has PodsReady.
+            return CycleResult()
         result = self.scheduler.schedule()
         self.metrics.observe(
             "admission_attempt_duration_seconds", result.duration_s
@@ -366,6 +371,21 @@ class Manager:
                     break
 
     # ------------------------------------------------------------------
+
+    def _admission_blocked(self) -> bool:
+        cfg = self.workload_controller.pods_ready
+        if not (cfg.enable and cfg.block_admission):
+            return False
+        from kueue_tpu.core.workload_info import is_admitted as _adm
+
+        for key in self.cache.workloads:
+            wl = self.workloads.get(key)
+            if wl is None or not _adm(wl):
+                continue
+            job = self.job_reconciler.job_of_workload.get(key)
+            if job is not None and not job.pods_ready():
+                return True
+        return False
 
     def _sync_admission_checks(self, wl: Workload) -> None:
         for acs in wl.status.admission_checks:

@@ -396,3 +396,27 @@ def test_gauge_metrics_updated():
     assert mgr.metrics.get(
         "pending_workloads", {"cluster_queue": "cq-a", "status": "active"}
     ) == 0.0
+
+
+def test_block_admission_until_pods_ready():
+    clock = FakeClock()
+    mgr = basic_manager(
+        clock,
+        pods_ready=WaitForPodsReadyConfig(
+            enable=True, timeout_seconds=300.0, block_admission=True,
+        ),
+    )
+    j1 = BatchJob("first", queue="lq", requests={"cpu": 1000})
+    wl1 = mgr.submit_job(j1)
+    mgr.schedule_all()
+    assert is_admitted(wl1)
+    j1.set_pods_ready(False)  # pods not up yet
+
+    j2 = BatchJob("second", queue="lq", requests={"cpu": 1000})
+    wl2 = mgr.submit_job(j2)
+    mgr.schedule_all()
+    assert not is_admitted(wl2)  # blocked
+
+    j1.set_pods_ready(True)
+    mgr.schedule_all()
+    assert is_admitted(wl2)
