@@ -87,31 +87,6 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
     return jnp.where(pmode == P_NOFIT, _NEG_INF, score)
 
 
-def _avail_at_node(
-    tree: QuotaTreeArrays, usage: jnp.ndarray, node: jnp.ndarray
-) -> jnp.ndarray:
-    """available() for one node as i64[F,R], via its ancestor chain
-    (resource_node.go:106). Root-first evaluation down the chain."""
-    chain = ancestor_chain(tree, node)
-    lq = quota_ops.local_quota(tree)
-    l_avail = jnp.maximum(0, sat_sub(lq, usage))
-    stored = sat_sub(tree.subtree_quota, lq)
-    used_in_parent = jnp.maximum(0, sat_sub(usage, lq))
-    with_max = sat_add(sat_sub(stored, used_in_parent), tree.borrow_limit)
-
-    top = chain[MAX_DEPTH]
-    avail = sat_sub(tree.subtree_quota[top], usage[top])
-    for i in range(MAX_DEPTH - 1, -1, -1):
-        n = chain[i]
-        is_repeat = n == chain[i + 1]
-        clamped = jnp.where(
-            tree.has_borrow_limit[n], jnp.minimum(with_max[n], avail), avail
-        )
-        stepped = sat_add(l_avail[n], clamped)
-        avail = jnp.where(is_repeat, avail, stepped)
-    return avail
-
-
 def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
     """Vectorized flavor assignment for every workload against the
     cycle-start usage (reference scheduler.go:629 nominate +

@@ -9,7 +9,6 @@ LocalQueue -> ClusterQueue routing.
 from __future__ import annotations
 
 import heapq
-import itertools
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
