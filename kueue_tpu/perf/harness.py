@@ -64,6 +64,8 @@ class GeneratedWorkload:
     runtime_s: float
     admitted_at: Optional[float] = None
     completed_at: Optional[float] = None
+    running: bool = False
+    expected_completion: Optional[float] = None
 
 
 @dataclass
@@ -87,7 +89,9 @@ class RunResult:
 def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
     """Build the control plane + workload stream from a generator config
     (reference test/performance/scheduler generator.yaml schema)."""
-    mgr = Manager()
+    mgr = Manager(fair_sharing=bool(
+        (config.get("fairSharing") or {}).get("enable")
+    ))
     flavor_name = "default"
     # Optional topology section (reference configs/tas/generator.yaml).
     topo_cfg = config.get("topology")
@@ -247,7 +251,6 @@ def run(config: dict) -> RunResult:
         heapq.heappush(events, (g.create_at, CREATE, i, g.wl.key))
 
     vclock = 0.0
-    # Time-weighted CQ usage integral for utilization.
     usage_now: Dict[str, int] = {name: 0 for name in nominal_of}
     usage_integral: Dict[str, float] = {name: 0.0 for name in nominal_of}
     last_sample = 0.0
@@ -255,6 +258,7 @@ def run(config: dict) -> RunResult:
     cycles = 0
     result = RunResult(total_workloads=len(gens))
     seq = len(gens)
+    finished = 0
 
     def advance_to(t: float) -> None:
         nonlocal last_sample, vclock
@@ -265,46 +269,64 @@ def run(config: dict) -> RunResult:
         last_sample = t
         vclock = t
 
-    while events:
-        t, kind, _seq, key = heapq.heappop(events)
-        advance_to(t)
+    def handle_event(kind: int, key: str) -> None:
+        nonlocal finished
         g = by_key[key]
         if kind == CREATE:
             mgr.create_workload(g.wl)
-        else:
-            if g.completed_at is None:
-                g.completed_at = vclock
-                usage_now[g.cq_name] -= g.wl.pod_sets[0].requests["cpu"]
-                mgr.finish_workload(g.wl)
+            return
+        # COMPLETE: valid only if still running and this is the live
+        # completion (preemption reschedules a fresh one on re-admission).
+        if g.running and g.completed_at is None and \
+                g.expected_completion is not None and \
+                abs(g.expected_completion - vclock) < 1e-9:
+            g.completed_at = vclock
+            g.running = False
+            usage_now[g.cq_name] -= _wl_cpu(g.wl)
+            finished += 1
+            mgr.finish_workload(g.wl)
 
-        # Batch all events at the same instant before scheduling.
-        while events and events[0][0] <= vclock + 1e-9:
-            t2, kind2, _s2, key2 = heapq.heappop(events)
-            g2 = by_key[key2]
-            if kind2 == CREATE:
-                mgr.create_workload(g2.wl)
-            elif g2.completed_at is None:
-                g2.completed_at = vclock
-                usage_now[g2.cq_name] -= _wl_cpu(g2.wl)
-                mgr.finish_workload(g2.wl)
-
+    def drain_scheduler() -> None:
+        """Run cycles until quiescent: on every admission schedule the run
+        (possibly a re-run after preemption); on every preemption release
+        the victim's simulated usage."""
+        nonlocal cycles, seq, sched_wall
         t0 = time.monotonic()
-        while True:
+        for _ in range(1000):  # safety cap per event batch
             r = mgr.schedule()
             cycles += 1
+            for pkey in r.preempted:
+                pg = by_key.get(pkey)
+                if pg is not None and pg.running:
+                    pg.running = False
+                    pg.expected_completion = None
+                    usage_now[pg.cq_name] -= _wl_cpu(pg.wl)
             for akey in r.admitted:
                 ag = by_key.get(akey)
-                if ag is not None and ag.admitted_at is None:
+                if ag is None or ag.running:
+                    continue
+                if ag.admitted_at is None:
                     ag.admitted_at = vclock
-                    usage_now[ag.cq_name] += _wl_cpu(ag.wl)
-                    seq += 1
-                    heapq.heappush(
-                        events,
-                        (vclock + ag.runtime_s, COMPLETE, seq, akey),
-                    )
+                ag.running = True
+                ag.expected_completion = vclock + ag.runtime_s
+                usage_now[ag.cq_name] += _wl_cpu(ag.wl)
+                seq += 1
+                heapq.heappush(
+                    events,
+                    (ag.expected_completion, COMPLETE, seq, akey),
+                )
             if not r.admitted and not r.preempted:
                 break
         sched_wall += time.monotonic() - t0
+
+    while events:
+        t, kind, _seq, key = heapq.heappop(events)
+        advance_to(t)
+        handle_event(kind, key)
+        while events and events[0][0] <= vclock + 1e-9:
+            _t2, kind2, _s2, key2 = heapq.heappop(events)
+            handle_event(kind2, key2)
+        drain_scheduler()
 
     advance_to(vclock)
     result.virtual_wall_s = vclock

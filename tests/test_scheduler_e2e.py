@@ -465,3 +465,86 @@ def test_cohort_level_quotas():
     submit(queues, wl2)
     sched.schedule_all()
     assert "too-big" not in admitted_names(cache)
+
+
+def test_fungibility_preference_preemption_over_borrowing():
+    """preference=PreemptionOverBorrowing: a flavor where preemption would
+    avoid borrowing wins over a flavor that fits by borrowing
+    (reference flavorassigner.go:499 preemptionOverBorrowing)."""
+    from kueue_tpu.api.constants import FlavorFungibilityPreference
+
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={
+                    "reserved": {"cpu": quota(4_000)},
+                    "spot": {"cpu": quota(0)},
+                },
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+                fungibility=FlavorFungibility(
+                    when_can_borrow=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR,
+                    when_can_preempt=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR,
+                    preference=(
+                        FlavorFungibilityPreference.PREEMPTION_OVER_BORROWING
+                    ),
+                ),
+            ),
+            make_cq("cq-b", cohort="co",
+                    flavors={"spot": {"cpu": quota(8_000)}}),
+        ],
+    )
+    # Fill reserved with a low-priority victim.
+    victim = make_wl("victim", queue="lq-cq-a", cpu_m=4_000, priority=1,
+                     creation_time=1.0)
+    submit(queues, victim)
+    sched.schedule_all()
+    assert "victim" in admitted_names(cache)
+
+    # High-priority: reserved=preempt(borrow 0) vs spot=borrow(level 1).
+    # PreemptionOverBorrowing prefers the lower borrowing level -> preempt
+    # on reserved.
+    hi = make_wl("hi", queue="lq-cq-a", cpu_m=4_000, priority=100,
+                 creation_time=2.0)
+    submit(queues, hi)
+    sched.schedule_all()
+    assert "hi" in admitted_names(cache)
+    assert is_evicted(victim)
+    assert admission_of(cache, "hi").pod_set_assignments[0].flavors["cpu"] \
+        == "reserved"
+
+
+def test_evicted_candidates_preferred_as_victims():
+    """CandidatesOrdering: already-evicted workloads are chosen as victims
+    first (reference preemption/common/ordering.go:45)."""
+    from kueue_tpu.core.workload_info import set_condition
+    from kueue_tpu.api.constants import COND_EVICTED
+
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    w_a = make_wl("wa", cpu_m=2_000, priority=1, creation_time=1.0)
+    w_b = make_wl("wb", cpu_m=2_000, priority=1, creation_time=2.0)
+    submit(queues, w_a, w_b)
+    sched.schedule_all()
+    assert len(admitted_names(cache)) == 2
+    # Mark wa as already being evicted (e.g. by another controller).
+    set_condition(w_a, COND_EVICTED, True, "SomeReason", "", 3.0)
+
+    hi = make_wl("hi", cpu_m=2_000, priority=50, creation_time=4.0)
+    submit(queues, hi)
+    sched.schedule_all()
+    assert "hi" in admitted_names(cache)
+    # wa (already evicted) was taken; wb survives.
+    assert "wb" in admitted_names(cache)
+    assert "wa" not in admitted_names(cache)
