@@ -214,13 +214,21 @@ def find_height_of_lowest_subtree_that_fits(
 
 def calculate_lendable(node: QuotaNode) -> Dict[str, int]:
     """Aggregate potential capacity per resource name across all flavors,
-    evaluated at ``node`` (reference fair_sharing.go:186)."""
+    evaluated at ``node`` (reference fair_sharing.go:186).
+
+    potentialAvailable is usage-independent, so the result is constant for
+    a given quota configuration; it is memoized on the node (snapshot
+    clones are rebuilt whenever quotas change)."""
+    cached = getattr(node, "_lendable_cache", None)
+    if cached is not None:
+        return cached
     root = node.root()
     lendable: Dict[str, int] = {}
     for fr in root.subtree_quota:
         lendable[fr.resource] = sat_add(
             lendable.get(fr.resource, 0), node.potential_available(fr)
         )
+    node._lendable_cache = lendable
     return lendable
 
 

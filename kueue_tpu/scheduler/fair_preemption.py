@@ -123,13 +123,34 @@ def _cq_is_borrowing(
     return cq.has_parent() and any(cq.borrowing(fr) for fr in frs)
 
 
+class _DRSCache:
+    """Memoizes dominant_resource_share per node between usage mutations:
+    the tournament re-reads shares of untouched subtrees on every descent
+    (ordering.go nextTarget), which dominates the fair path's cost."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[int, DRS] = {}
+
+    def get(self, node) -> DRS:
+        hit = self._cache.get(id(node))
+        if hit is None:
+            hit = dominant_resource_share(node, {})
+            self._cache[id(node)] = hit
+        return hit
+
+    def invalidate(self) -> None:
+        self._cache.clear()
+
+
 class _Ordering:
     """TargetClusterQueueOrdering (ordering.go)."""
 
-    def __init__(self, ctx, candidates: List[WorkloadInfo], ordering_key):
+    def __init__(self, ctx, candidates: List[WorkloadInfo], ordering_key,
+                 drs_cache: Optional[_DRSCache] = None):
         self.ctx = ctx
         self.preemptor_cq: ClusterQueueSnapshot = ctx.preemptor_cq
         self.ordering_key = ordering_key
+        self.drs = drs_cache or _DRSCache()
         self.preemptor_ancestors = set(
             id(n) for n in self.preemptor_cq.path_parent_to_root()
         )
@@ -173,7 +194,7 @@ class _Ordering:
             cq = cqs[child.name]
             if cq.name in self.pruned_cqs:
                 continue
-            drs = dominant_resource_share(child, {})
+            drs = self.drs.get(child)
             if (not drs.borrowing and cq is not self.preemptor_cq) or \
                     not self.has_workload(cq.name):
                 self.pruned_cqs.add(cq.name)
@@ -194,7 +215,7 @@ class _Ordering:
         for child in cohort.children:
             if child.is_cq or id(child) in self.pruned_cohorts:
                 continue
-            drs = dominant_resource_share(child, {})
+            drs = self.drs.get(child)
             on_path = id(child) in self.preemptor_ancestors
             if not drs.borrowing and not on_path:
                 self.pruned_cohorts.add(id(child))
@@ -253,6 +274,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
     ordering = _Ordering(ctx, candidates, ordering_key)
     targets: List = []
     retry: List[WorkloadInfo] = []
+    drs = ordering.drs
 
     preemptor_within_nominal = (
         features.enabled("FairSharingPreemptWithinNominal")
@@ -262,6 +284,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
         if cand_cq is ctx.preemptor_cq:
             wl = ordering.pop_workload(cand_cq.name)
             ctx.snapshot.remove_workload(wl)
+            drs.invalidate()
             targets.append(Target(wl, IN_CLUSTER_QUEUE_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets, retry
@@ -270,6 +293,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
         if preemptor_within_nominal:
             wl = ordering.pop_workload(cand_cq.name)
             ctx.snapshot.remove_workload(wl)
+            drs.invalidate()
             targets.append(Target(wl, IN_COHORT_RECLAMATION_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets, retry
@@ -278,15 +302,23 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
         pre_alca, tgt_alca = _almost_lcas(
             ctx, cand_cq, ordering.preemptor_ancestors
         )
-        preemptor_new = dominant_resource_share(pre_alca, {})
-        target_old = dominant_resource_share(tgt_alca, {})
+        preemptor_new = drs.get(pre_alca)
+        target_old = drs.get(tgt_alca)
+        removal_memo: Dict = {}
         while ordering.has_workload(cand_cq.name):
             wl = ordering.pop_workload(cand_cq.name)
-            revert = cand_cq.simulate_usage_removal(wl.usage())
-            target_new = dominant_resource_share(tgt_alca, {})
-            revert()
+            # Same-profile candidates (identical usage) yield the same
+            # share-after-removal; memoize within this CQ visit.
+            mkey = (id(tgt_alca), tuple(sorted(wl.usage().items())))
+            target_new = removal_memo.get(mkey)
+            if target_new is None:
+                revert = cand_cq.simulate_usage_removal(wl.usage())
+                target_new = dominant_resource_share(tgt_alca, {})
+                revert()
+                removal_memo[mkey] = target_new
             if strategy(preemptor_new, target_old, target_new):
                 ctx.snapshot.remove_workload(wl)
+                drs.invalidate()
                 targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
                 if _workload_fits_fair(ctx):
                     return True, targets, retry
@@ -307,6 +339,7 @@ def _run_second_strategy(ctx, retry_candidates, targets, Target, ordering_key):
         wl = ordering.pop_workload(cand_cq.name)
         if _strategy_s2b(preemptor_new, target_old, DRS()):
             ctx.snapshot.remove_workload(wl)
+            ordering.drs.invalidate()
             targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets

@@ -419,15 +419,82 @@ def make_oracle(
 ):
     """SimulatePreemption (reference preemption_oracle.go): run the
     preemption search for a single contested FlavorResource and report
-    whether targets exist and the borrow height after preemptions."""
+    whether targets exist and the borrow height after preemptions.
+
+    Memoized per cycle: all nomination-phase probes see the same snapshot
+    state, and the outcome depends only on (cq, fr, amount, preemptor
+    priority, preemptor order timestamp)."""
+    memo: dict = {}
 
     def simulate(
+        cq: ClusterQueueSnapshot, wl: WorkloadInfo, fr: FlavorResource, val: int
+    ) -> Tuple[str, int]:
+        # Timestamps only influence candidate sets under
+        # LowerOrNewerEqualPriority; otherwise identical (cq, fr, amount,
+        # priority) probes share one result.
+        p = cq.spec.preemption
+        ts_sensitive = PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY in (
+            p.within_cluster_queue, p.reclaim_within_cohort
+        )
+        key = (
+            cq.name, fr, val, wl.priority(),
+            queue_order_timestamp(wl.obj) if ts_sensitive else None,
+        )
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        out = _simulate_uncached(cq, wl, fr, val)
+        memo[key] = out
+        return out
+
+    def _candidates_possible(
+        cq: ClusterQueueSnapshot, wl: WorkloadInfo, fr: FlavorResource
+    ) -> bool:
+        """Sound existence prefilter: when no admitted workload can
+        satisfy any preemption policy on fr, the full search is guaranteed
+        to return no targets (candidates are a subset of this check)."""
+        p = cq.spec.preemption
+
+        def policy_matches(policy, cand: WorkloadInfo) -> bool:
+            if policy == PreemptionPolicy.NEVER:
+                return False
+            if policy == PreemptionPolicy.ANY:
+                return True
+            if policy == PreemptionPolicy.LOWER_PRIORITY:
+                return cand.priority() < wl.priority()
+            return cand.priority() <= wl.priority()  # LowerOrNewer superset
+
+        if p.within_cluster_queue != PreemptionPolicy.NEVER:
+            for cand in cq.workloads.values():
+                if policy_matches(p.within_cluster_queue, cand) and \
+                        workload_uses_frs(cand, {fr}):
+                    return True
+        if cq.has_parent() and \
+                p.reclaim_within_cohort != PreemptionPolicy.NEVER:
+            root = cq.node.root()
+            for other in snapshot.cluster_queues.values():
+                if other.name == cq.name or other.node.root() is not root:
+                    continue
+                if other.node.is_within_nominal_in({fr}):
+                    continue
+                for cand in other.workloads.values():
+                    if policy_matches(p.reclaim_within_cohort, cand) and \
+                            workload_uses_frs(cand, {fr}):
+                        return True
+        return False
+
+    def _simulate_uncached(
         cq: ClusterQueueSnapshot, wl: WorkloadInfo, fr: FlavorResource, val: int
     ) -> Tuple[str, int]:
         from kueue_tpu.cache.resource_node import (
             find_height_of_lowest_subtree_that_fits,
         )
 
+        if not _candidates_possible(cq, wl, fr):
+            borrow, _ = find_height_of_lowest_subtree_that_fits(
+                cq.node, fr, val
+            )
+            return "NoCandidates", borrow
         ctx = PreemptionCtx(
             preemptor=wl,
             preemptor_cq=snapshot.cluster_queue(wl.cluster_queue),

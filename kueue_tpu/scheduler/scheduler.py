@@ -133,6 +133,7 @@ class Scheduler:
             return result
 
         snapshot = self.cache.snapshot()
+        self._cycle_oracle = make_oracle(self.preemptor, snapshot)
         entries, inadmissible = self._nominate(heads, snapshot)
 
         iterator = self._make_iterator(entries, snapshot)
@@ -255,7 +256,9 @@ class Scheduler:
     ) -> Tuple[Assignment, List[Target]]:
         """reference scheduler.go:750,779."""
         cq = snapshot.cluster_queue(info.cluster_queue)
-        oracle = make_oracle(self.preemptor, snapshot)
+        oracle = getattr(self, "_cycle_oracle", None) or make_oracle(
+            self.preemptor, snapshot
+        )
         assigner = FlavorAssigner(
             info, cq, snapshot.resource_flavors, oracle=oracle,
             enable_fair_sharing=self.fair_sharing,
