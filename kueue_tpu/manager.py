@@ -20,7 +20,12 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Union
 
-from kueue_tpu.api.constants import COND_FINISHED, CheckState
+from kueue_tpu.api.constants import COND_FINISHED, CheckState, StopPolicy
+from kueue_tpu.utils.validation import (
+    validate_cluster_queue,
+    validate_cohort,
+    validate_workload,
+)
 from kueue_tpu.api.types import (
     AdmissionCheck,
     ClusterQueue,
@@ -113,12 +118,6 @@ class Manager:
     # ------------------------------------------------------------------
 
     def apply(self, *objects: ApplyObject) -> None:
-        from kueue_tpu.api.constants import StopPolicy
-
-        from kueue_tpu.utils.validation import (
-            validate_cluster_queue,
-            validate_cohort,
-        )
 
         for obj in objects:
             if isinstance(obj, ClusterQueue):
@@ -183,8 +182,6 @@ class Manager:
     def create_workload(self, wl: Workload) -> None:
         """Validating-webhook equivalent + queue entry
         (reference pkg/webhooks/workload_webhook.go)."""
-        from kueue_tpu.utils.validation import validate_workload
-
         if wl.key in self.workloads:
             raise ValueError(f"workload {wl.key} already exists")
         validate_workload(wl)

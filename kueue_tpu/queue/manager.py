@@ -13,7 +13,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from kueue_tpu.api.constants import QueueingStrategy, RequeueReason
+from kueue_tpu.api.constants import (
+    AdmissionScope,
+    QueueingStrategy,
+    RequeueReason,
+)
 from kueue_tpu.api.types import ClusterQueue, LocalQueue, Workload
 from kueue_tpu.core.workload_info import WorkloadInfo, queue_order_timestamp
 
@@ -228,8 +232,6 @@ class QueueManager:
         with self._lock:
             self.scheduling_cycle += 1
             out: List[WorkloadInfo] = []
-            from kueue_tpu.api.constants import AdmissionScope
-
             for cqh in self.cluster_queues.values():
                 afs_fn = None
                 if (

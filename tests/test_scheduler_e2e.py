@@ -548,3 +548,68 @@ def test_evicted_candidates_preferred_as_victims():
     # wa (already evicted) was taken; wb survives.
     assert "wb" in admitted_names(cache)
     assert "wa" not in admitted_names(cache)
+
+
+def test_eviction_timestamp_reorders_queue():
+    """A preempted workload re-queues with its eviction timestamp, so a
+    newer never-evicted workload of equal priority goes first
+    (reference workload.go GetQueueOrderTimestamp)."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(2_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    lo = make_wl("lo", cpu_m=2_000, priority=1, creation_time=1.0)
+    submit(queues, lo)
+    sched.schedule_all()
+    hi = make_wl("hi", cpu_m=2_000, priority=10, creation_time=2.0)
+    submit(queues, hi)
+    sched.schedule_all()
+    assert is_evicted(lo)
+
+    # Now hi finishes; lo (evicted at t>2) competes with mid (created 3.0,
+    # same priority as lo). lo's queue timestamp is its eviction time,
+    # which is later than mid's creation -> mid goes first.
+    mid = make_wl("mid", cpu_m=2_000, priority=1, creation_time=3.0)
+    submit(queues, mid)
+    cache.delete_workload("default/hi")
+    queues.queue_inadmissible_workloads()
+    sched.schedule()
+    assert "mid" in admitted_names(cache)
+    assert "lo" not in admitted_names(cache)
+
+
+def test_partial_admission_with_preemption():
+    """Partial admission search also considers preemption-backed counts
+    (reference getInitialAssignments:802)."""
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(6_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    filler = make_wl("filler", cpu_m=4_000, priority=1, creation_time=1.0)
+    submit(queues, filler)
+    sched.schedule_all()
+
+    # Elastic high-priority workload: full count 8 (8000m) can't fit even
+    # with preemption (6000 total); preempting filler frees 4000 ->
+    # 6 pods fit. Partial admission + preemption should land count 6.
+    elastic = make_wl("elastic", cpu_m=1_000, count=8, min_count=2,
+                      priority=10, creation_time=2.0)
+    submit(queues, elastic)
+    sched.schedule_all()
+    assert "elastic" in admitted_names(cache)
+    assert admission_of(cache, "elastic").pod_set_assignments[0].count == 6
+    assert is_evicted(filler)
