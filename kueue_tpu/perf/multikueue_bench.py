@@ -1,0 +1,112 @@
+"""MultiKueue dispatch benchmark (BASELINE.json config #5 shape).
+
+N workloads dispatched from a manager cluster across K worker clusters
+(each its own Manager — the in-process analog of the reference's envtest
+multi-cluster suite, test/integration/multikueue/suite_test.go:100, scaled
+up). Measures end-to-end dispatch throughput: local quota reservation ->
+mirror to workers -> first QuotaReserved wins -> losers cleaned up.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.controllers.multikueue import MultiKueueController
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+
+
+def _cluster(cpu_quota_m: int) -> Manager:
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        ClusterQueue(
+            name="cq",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="default",
+                    resources={"cpu": ResourceQuota(nominal=cpu_quota_m)},
+                )],
+            )],
+        ),
+        LocalQueue(name="lq", cluster_queue="cq"),
+    )
+    return mgr
+
+
+def run(
+    n_workloads: int = 2000,
+    n_workers: int = 8,
+    dispatcher: str = "AllAtOnce",
+) -> Dict:
+    # Manager cluster holds ample local quota; workers bound the real
+    # placement capacity.
+    mgr = _cluster(cpu_quota_m=n_workloads * 1000)
+    mgr.cache.cluster_queues["cq"].admission_checks = ["mk"]
+    mgr.apply(AdmissionCheck(
+        name="mk", controller_name="kueue.x-k8s.io/multikueue",
+    ))
+    mk = MultiKueueController()
+    mk.config.dispatcher = dispatcher
+    per_worker = (n_workloads * 1000) // n_workers + 1000
+    for i in range(n_workers):
+        mk.add_worker(f"worker-{i}", _cluster(per_worker))
+    mgr.register_check_controller(mk)
+
+    jobs: List[BatchJob] = []
+    for i in range(n_workloads):
+        job = BatchJob(f"job-{i}", queue="lq", requests={"cpu": 1000})
+        mgr.submit_job(job)
+        jobs.append(job)
+
+    t0 = time.monotonic()
+    rounds = 0
+    while rounds < 200:
+        mgr.schedule_all()
+        dispatched = sum(
+            1 for wl in mgr.workloads.values()
+            if wl.status.cluster_name is not None
+        )
+        if dispatched >= n_workloads:
+            break
+        rounds += 1
+    wall = time.monotonic() - t0
+
+    placed: Dict[str, int] = {}
+    for wl in mgr.workloads.values():
+        if wl.status.cluster_name:
+            placed[wl.status.cluster_name] = (
+                placed.get(wl.status.cluster_name, 0) + 1
+            )
+    admitted = sum(1 for wl in mgr.workloads.values() if is_admitted(wl))
+    return {
+        "n": n_workloads,
+        "workers": n_workers,
+        "dispatched": sum(placed.values()),
+        "admitted": admitted,
+        "wall_s": wall,
+        "throughput": sum(placed.values()) / wall if wall else 0.0,
+        "placement": placed,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    stats = run(
+        n_workloads=int(sys.argv[1]) if len(sys.argv) > 1 else 2000,
+    )
+    print(json.dumps(stats, indent=2))

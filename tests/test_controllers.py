@@ -420,3 +420,14 @@ def test_block_admission_until_pods_ready():
     j1.set_pods_ready(True)
     mgr.schedule_all()
     assert is_admitted(wl2)
+
+
+def test_multikueue_dispatch_at_scale_even_placement():
+    from kueue_tpu.perf.multikueue_bench import run as mk_run
+
+    stats = mk_run(n_workloads=200, n_workers=4)
+    assert stats["dispatched"] == 200
+    assert stats["admitted"] == 200
+    # Even spread across workers (capacity-driven).
+    assert max(stats["placement"].values()) - \
+        min(stats["placement"].values()) <= 10
