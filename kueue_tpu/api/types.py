@@ -283,6 +283,9 @@ class PodSetAssignment:
     resource_usage: Dict[str, int] = field(default_factory=dict)  # totals
     count: int = 0
     topology_assignment: Optional["TopologyAssignment"] = None
+    # Placement deferred to the target cluster (reference
+    # workload_types.go delayedTopologyRequest; the MultiKueue+TAS path).
+    delayed_topology_request: bool = False
 
 
 @dataclass

@@ -190,6 +190,7 @@ class FlavorAssigner:
         oracle: Optional[PreemptionOracleFn] = None,
         enable_fair_sharing: bool = False,
         tas_flavors: Optional[Dict[str, object]] = None,
+        allow_delayed_tas: bool = False,
     ) -> None:
         self.wl = wl
         self.cq = cq
@@ -197,6 +198,9 @@ class FlavorAssigner:
         self.oracle = oracle
         self.enable_fair_sharing = enable_fair_sharing
         self.tas_flavors = tas_flavors or {}
+        # MultiKueue: topology placement happens on the target cluster
+        # (reference delayedTopologyRequest).
+        self.allow_delayed_tas = allow_delayed_tas
 
     # -- public entry -------------------------------------------------------
 
@@ -325,6 +329,9 @@ class FlavorAssigner:
             flavor_name = next(iter(psa.flavors.values())).name
             tas = self.tas_flavors.get(flavor_name)
             if tas is None:
+                if self.allow_delayed_tas:
+                    psa.delayed_topology_request = True
+                    continue
                 return False
             req = PlacementRequest(
                 count=psa.count,
@@ -479,6 +486,8 @@ class FlavorAssigner:
             # tas_flavorassigner.go): a podset explicitly requesting TAS
             # needs a flavor with a topology.
             if ps.topology_request is not None and not flavor.topology_name:
+                if self.allow_delayed_tas:
+                    continue  # placement deferred to the target cluster
                 return False, (
                     f"flavor {flavor_name} does not support "
                     "TopologyAwareScheduling"

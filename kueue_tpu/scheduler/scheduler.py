@@ -263,6 +263,7 @@ class Scheduler:
             info, cq, snapshot.resource_flavors, oracle=oracle,
             enable_fair_sharing=self.fair_sharing,
             tas_flavors=snapshot.tas_flavors,
+            allow_delayed_tas=self._has_multikueue_check(cq),
         )
         full = assigner.assign()
         mode = full.representative_mode()
@@ -511,6 +512,7 @@ class Scheduler:
             assigner = FlavorAssigner(
                 e.info, cq, snapshot.resource_flavors,
                 tas_flavors=snapshot.tas_flavors,
+                allow_delayed_tas=self._has_multikueue_check(cq),
             )
             if not assigner.update_for_tas(
                 e.assignment, simulate_empty=False, attach=True
@@ -539,6 +541,14 @@ class Scheduler:
         e.status = EntryStatus.NOMINATED
         self._admit(e, cq)
         result_status = e.status  # ASSUMED on success
+
+    def _has_multikueue_check(self, cq: ClusterQueueSnapshot) -> bool:
+        for ac_name in cq.spec.admission_checks:
+            ac = self.cache.admission_checks.get(ac_name)
+            if ac is not None and ac.controller_name == \
+                    "kueue.x-k8s.io/multikueue":
+                return True
+        return False
 
     def _has_tas_podsets(self, e: Entry) -> bool:
         return any(
@@ -621,6 +631,7 @@ class Scheduler:
                     resource_usage=dict(psa.requests),
                     count=psa.count,
                     topology_assignment=psa.topology_assignment,
+                    delayed_topology_request=psa.delayed_topology_request,
                 )
                 for psa in e.assignment.pod_sets
             ],

@@ -431,3 +431,59 @@ def test_multikueue_dispatch_at_scale_even_placement():
     # Even spread across workers (capacity-driven).
     assert max(stats["placement"].values()) - \
         min(stats["placement"].values()) <= 10
+
+
+def test_multikueue_tas_worker_side_placement():
+    """A TAS workload dispatched via MultiKueue gets its topology
+    assignment computed on the winning worker cluster (the delayed-TAS
+    model: placement decided where the gang runs)."""
+    from kueue_tpu.api.types import (
+        PodSet,
+        TopologyRequest,
+        Workload,
+        quota as _q,
+    )
+    from tests.test_tas import LEVELS, make_nodes, make_topology
+
+    # Manager cluster: quota-only (no topology).
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": _q(32)}},
+                resources=["tpu"], admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    # Worker cluster with the real TPU topology.
+    worker = Manager()
+    worker.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": _q(32)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        worker.apply(node)
+
+    mk = MultiKueueController()
+    mk.add_worker("tpu-pool", worker)
+    mgr.register_check_controller(mk)
+
+    wl = Workload(
+        name="gang", queue_name="lq",
+        pod_sets=[PodSet(
+            name="main", count=2, requests={"tpu": 4},
+            topology_request=TopologyRequest(required_level=LEVELS[1]),
+        )],
+        creation_time=1.0,
+    )
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "tpu-pool"
+    remote = worker.workloads[wl.key]
+    assert is_admitted(remote)
+    ta = remote.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta is not None and sum(c for _, c in ta.domains) == 2
