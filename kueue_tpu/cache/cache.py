@@ -162,6 +162,20 @@ class Cache:
             self.workloads.pop(key, None)
             self.assumed.discard(key)
 
+    def reaccount_workload(self, key: str, mutate) -> None:
+        """Atomically re-account a stored workload whose usage is about to
+        change: remove the old usage from the live tree, apply ``mutate``,
+        then add the new usage. Needed because usage is derived from the
+        (shared, mutable) workload object."""
+        with self._lock:
+            info = self.workloads.get(key)
+            if info is None:
+                mutate()
+                return
+            self._live_remove(key)
+            mutate()
+            self._live_add(info)
+
     def is_added(self, key: str) -> bool:
         with self._lock:
             return key in self.workloads

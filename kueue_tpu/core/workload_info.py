@@ -92,13 +92,21 @@ class WorkloadInfo:
 
     def usage(self) -> FlavorResourceQuantities:
         """Quota usage keyed by (flavor, resource), derived from the podset
-        assignments stored in total_requests[...].flavors."""
+        assignments stored in total_requests[...].flavors. Reclaimable pods
+        (reference workload_types.go:874 ReclaimablePod) reduce a podset's
+        accounted usage: pods that already finished release their share of
+        the gang's quota early."""
+        reclaimable = self.obj.status.reclaimable_pods
         out: FlavorResourceQuantities = {}
         for ps in self.total_requests:
+            reclaimed = reclaimable.get(ps.name, 0) if reclaimable else 0
+            effective = ps
+            if reclaimed > 0 and ps.count > 0:
+                effective = ps.scaled_to(max(0, ps.count - reclaimed))
             frq_add(
                 out,
                 {
-                    FlavorResource(flv, res): ps.requests.get(res, 0)
+                    FlavorResource(flv, res): effective.requests.get(res, 0)
                     for res, flv in ps.flavors.items()
                 },
             )

@@ -220,6 +220,21 @@ class Manager:
         self.metrics.inc("workloads_finished_total")
         self.queues.queue_inadmissible_workloads()
 
+    def reclaim_pods(self, wl: Workload, counts: Dict[str, int]) -> None:
+        """Mark pods of an admitted workload as finished early; their
+        resources are released without waiting for the whole gang
+        (reference workload ReclaimablePods; jobframework reclaimable-pods
+        capability). counts: podset name -> total finished pods."""
+        def apply_counts() -> None:
+            for name, c in counts.items():
+                prev = wl.status.reclaimable_pods.get(name, 0)
+                # Reclaimable counts only grow (reference validation).
+                wl.status.reclaimable_pods[name] = max(prev, c)
+
+        self.cache.reaccount_workload(wl.key, apply_counts)
+        self.metrics.inc("reclaimed_pods_total")
+        self.queues.queue_inadmissible_workloads()
+
     def delete_workload(self, wl: Workload) -> None:
         self.cache.delete_workload(wl.key)
         self.queues.delete_workload(wl)

@@ -487,3 +487,37 @@ def test_multikueue_tas_worker_side_placement():
     assert is_admitted(remote)
     ta = remote.status.admission.pod_set_assignments[0].topology_assignment
     assert ta is not None and sum(c for _, c in ta.domains) == 2
+
+
+def test_reclaimable_pods_release_quota_early():
+    mgr = basic_manager()
+    job = BatchJob("gang", queue="lq", parallelism=8,
+                   requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert is_admitted(wl)  # 8000m of 8000m used
+
+    blocked = BatchJob("blocked", queue="lq", requests={"cpu": 3000})
+    wl2 = mgr.submit_job(blocked)
+    mgr.schedule_all()
+    assert not is_admitted(wl2)
+
+    # 4 of the gang's pods finish early -> 4000m released.
+    mgr.reclaim_pods(wl, {"main": 4})
+    mgr.schedule_all()
+    assert is_admitted(wl2)
+    # Reclaimable count never shrinks.
+    mgr.reclaim_pods(wl, {"main": 2})
+    assert wl.status.reclaimable_pods["main"] == 4
+
+
+def test_cohort_cycle_rejected():
+    from kueue_tpu.api.types import Cohort
+
+    mgr = basic_manager()
+    mgr.apply(Cohort(name="a", parent="b"))
+    mgr.apply(Cohort(name="b", parent="a"))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cycle"):
+        mgr.cache.snapshot()
