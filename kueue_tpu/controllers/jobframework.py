@@ -217,6 +217,12 @@ class JobReconciler:
             self.manager.finish_workload(wl)
             return wl
 
+        # Reclaimable-pods capability: jobs report early-finished pods
+        # (reference interface.go ReclaimablePods).
+        reclaimable = job.reclaimable_pods()
+        if reclaimable and is_admitted(wl):
+            self.manager.reclaim_pods(wl, reclaimable)
+
         if is_admitted(wl) and job.is_suspended():
             # startJob (reference reconciler.go:1326).
             infos = podset_infos_from_admission(wl, wl.status.admission)
