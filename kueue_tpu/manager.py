@@ -20,7 +20,12 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Union
 
-from kueue_tpu.api.constants import COND_FINISHED, CheckState, StopPolicy
+from kueue_tpu.api.constants import (
+    COND_FINISHED,
+    CheckState,
+    RequeueReason,
+    StopPolicy,
+)
 from kueue_tpu.utils.validation import (
     validate_cluster_queue,
     validate_cohort,
@@ -372,6 +377,34 @@ class Manager:
                 self.metrics.set_gauge(
                     "cohort_weighted_share", share, {"cohort": name},
                 )
+
+    def run_forever(
+        self,
+        tick_interval_s: float = 1.0,
+        stop_event=None,
+    ) -> None:
+        """Daemon mode (reference scheduler.go:221 Start +
+        pkg/util/wait UntilWithBackoff): block on pending work, run cycles,
+        and do clock-driven reconciliation between them."""
+        import threading as _threading
+
+        stop = stop_event or _threading.Event()
+        last_tick = self.clock()
+        while not stop.is_set():
+            heads_available = self.queues.heads_blocking(
+                timeout=tick_interval_s
+            )
+            if heads_available:
+                # Re-inject: heads_blocking popped them; push back and run a
+                # normal cycle so ordering semantics hold.
+                for info in heads_available:
+                    self.queues.requeue_workload(
+                        info, RequeueReason.FAILED_AFTER_NOMINATION
+                    )
+                self.schedule()
+            if self.clock() - last_tick >= tick_interval_s:
+                self.tick()
+                last_tick = self.clock()
 
     def run_until_settled(self, max_rounds: int = 1000) -> None:
         """Drive schedule + tick until no more progress."""

@@ -521,3 +521,26 @@ def test_cohort_cycle_rejected():
 
     with _pytest.raises(ValueError, match="cycle"):
         mgr.cache.snapshot()
+
+
+def test_run_forever_daemon_mode():
+    import threading
+    import time as _time
+
+    mgr = basic_manager(clock=_time.monotonic)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=mgr.run_forever,
+        kwargs={"tick_interval_s": 0.05, "stop_event": stop},
+        daemon=True,
+    )
+    t.start()
+    job = BatchJob("daemon-job", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline and not is_admitted(wl):
+        _time.sleep(0.05)
+    stop.set()
+    t.join(timeout=3)
+    assert is_admitted(wl)
+    assert not job.is_suspended()
