@@ -261,6 +261,10 @@ class Manager:
         self.metrics.observe(
             "admission_attempt_duration_seconds", result.duration_s
         )
+        self.metrics.observe("scheduler_snapshot_duration_seconds",
+                             result.snapshot_s)
+        self.metrics.observe("scheduler_nomination_duration_seconds",
+                             result.nominate_s)
         self.metrics.inc("admission_attempts_total")
         tracker = self.queues.afs_tracker
         for key in result.admitted:

@@ -86,6 +86,10 @@ class CycleResult:
     inadmissible: List[str] = field(default_factory=list)
     head_keys: frozenset = frozenset()
     duration_s: float = 0.0
+    # Per-phase timings (reference scheduler.go:305-372 structured logs).
+    snapshot_s: float = 0.0
+    nominate_s: float = 0.0
+    process_s: float = 0.0
 
     @property
     def success(self) -> bool:
@@ -132,18 +136,25 @@ class Scheduler:
             result.duration_s = self.clock() - start
             return result
 
+        t0 = self.clock()
         snapshot = self.cache.snapshot()
+        result.snapshot_s = self.clock() - t0
+
+        t0 = self.clock()
         self._cycle_oracle = make_oracle(self.preemptor, snapshot)
         entries, inadmissible = self._nominate(heads, snapshot)
+        result.nominate_s = self.clock() - t0
 
         iterator = self._make_iterator(entries, snapshot)
 
+        t0 = self.clock()
         preempted_workloads = PreemptedWorkloads()
         skipped_preemptions: Dict[str, int] = {}
         for e in iterator:
             self._process_entry(
                 e, snapshot, preempted_workloads, skipped_preemptions, result
             )
+        result.process_s = self.clock() - t0
 
         # Requeue everything not assumed/evicted.
         for e in entries:
