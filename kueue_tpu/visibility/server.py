@@ -83,6 +83,16 @@ class VisibilityServer:
         ]
         return items[offset:offset + limit]
 
+    def local_queue_status(self, lq_key: str) -> Dict:
+        """LocalQueue status analog (reference localqueue_types.go:60):
+        pending count + per-position summary for one tenant queue."""
+        items = self.pending_workloads_lq(lq_key)
+        return {
+            "local_queue": lq_key,
+            "pending_workloads": len(items),
+            "head": items[0].name if items else None,
+        }
+
     def to_json(self, cq_name: str) -> str:
         return json.dumps(asdict(self.pending_workloads_cq(cq_name)))
 

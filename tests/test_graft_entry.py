@@ -1,0 +1,20 @@
+"""Driver entry-point regression tests: entry() must stay jittable and
+dryrun_multichip must compile + execute over a virtual mesh."""
+
+import jax
+
+
+def test_entry_compiles_and_admits():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    admitted = int((out.outcome == 4).sum())
+    assert out.outcome.shape[0] == 16
+    assert admitted > 0
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
