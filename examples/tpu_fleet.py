@@ -1,0 +1,84 @@
+"""Example: quota-managed TPU fleet with topology-aware gang scheduling.
+
+Run from the repo root: python examples/tpu_fleet.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Topology,
+    TopologyRequest,
+)
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.controllers.jobs import TrainJob
+from kueue_tpu.manager import Manager
+from kueue_tpu.tas.snapshot import Node
+
+mgr = Manager()
+
+# Interconnect hierarchy: 2 superpods x 4 hosts, 8 chips per host.
+mgr.apply(Topology(name="v5e", levels=["superpod", "kubernetes.io/hostname"]))
+for sp in range(2):
+    for h in range(4):
+        mgr.apply(Node(
+            name=f"host-{sp}-{h}",
+            labels={"superpod": f"sp{sp}"},
+            capacity={"tpu": 8},
+        ))
+
+mgr.apply(
+    ResourceFlavor(name="tpu-v5e", topology_name="v5e"),
+    Cohort(name="org"),
+    ClusterQueue(
+        name="research", cohort="org",
+        resource_groups=[ResourceGroup(
+            covered_resources=["tpu"],
+            flavors=[FlavorQuotas(
+                name="tpu-v5e",
+                resources={"tpu": ResourceQuota(nominal=32,
+                                                borrowing_limit=32)},
+            )],
+        )],
+        preemption=ClusterQueuePreemption(
+            reclaim_within_cohort=PreemptionPolicy.ANY,
+        ),
+    ),
+    ClusterQueue(
+        name="prod", cohort="org",
+        resource_groups=[ResourceGroup(
+            covered_resources=["tpu"],
+            flavors=[FlavorQuotas(
+                name="tpu-v5e",
+                resources={"tpu": ResourceQuota(nominal=32)},
+            )],
+        )],
+    ),
+    LocalQueue(name="experiments", cluster_queue="research"),
+    LocalQueue(name="serving", cluster_queue="prod"),
+)
+
+# A 4-host training gang pinned inside one superpod (ICI domain).
+job = TrainJob(
+    "llm-pretrain", queue="experiments",
+    roles={"trainer": (4, {"tpu": 8})},
+    topology=TopologyRequest(required_level="superpod"),
+)
+wl = mgr.submit_job(job)
+mgr.schedule_all()
+
+assert not job.is_suspended()
+placement = job.started_with[0]
+print("admitted:", wl.status.admission.cluster_queue)
+print("hosts:", [(v[-1], c) for v, c in placement.topology_domains])

@@ -613,3 +613,48 @@ def test_partial_admission_with_preemption():
     assert "elastic" in admitted_names(cache)
     assert admission_of(cache, "elastic").pod_set_assignments[0].count == 6
     assert is_evicted(filler)
+
+
+def test_multiple_resource_groups_independent_flavors():
+    """Two resource groups pick flavors independently (reference
+    clusterqueue resourceGroups semantics): cpu/memory from group 1,
+    accelerators from group 2."""
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        ResourceGroup,
+    )
+
+    cq = ClusterQueue(
+        name="cq-mixed",
+        resource_groups=[
+            ResourceGroup(
+                covered_resources=["cpu", "memory"],
+                flavors=[FlavorQuotas(
+                    name="general",
+                    resources={"cpu": quota(8_000),
+                               "memory": quota(1 << 34)},
+                )],
+            ),
+            ResourceGroup(
+                covered_resources=["tpu"],
+                flavors=[
+                    FlavorQuotas(name="tpu-reserved",
+                                 resources={"tpu": quota(4)}),
+                    FlavorQuotas(name="tpu-spot",
+                                 resources={"tpu": quota(16)}),
+                ],
+            ),
+        ],
+    )
+    cache, queues, sched = build_env([cq])
+    wl = make_wl("mixed", requests={"cpu": 2000, "memory": 1 << 30,
+                                    "tpu": 8})
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["mixed"]
+    flavors = admission_of(cache, "mixed").pod_set_assignments[0].flavors
+    assert flavors["cpu"] == "general"
+    assert flavors["memory"] == "general"
+    # 8 tpu doesn't fit reserved (4); spills to spot within its own group.
+    assert flavors["tpu"] == "tpu-spot"
