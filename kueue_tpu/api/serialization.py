@@ -22,6 +22,8 @@ from kueue_tpu.api.constants import (
 )
 from kueue_tpu.api.types import (
     AdmissionCheck,
+    LabelSelector,
+    Namespace,
     BorrowWithinCohort,
     ClusterQueue,
     ClusterQueuePreemption,
@@ -184,7 +186,7 @@ def decode(doc: Dict[str, Any]):
                     if fung.get("preference") else None
                 ),
             ),
-            namespace_selector=spec.get("namespaceSelector"),
+            namespace_selector=_selector(spec.get("namespaceSelector")),
             stop_policy=StopPolicy(spec.get("stopPolicy", "None")),
             fair_sharing=_fair_sharing(spec),
             admission_checks=spec.get("admissionChecks", []),
@@ -216,6 +218,8 @@ def decode(doc: Dict[str, Any]):
             taints=[_taint(t) for t in spec.get("taints", [])],
             ready=doc.get("ready", True),
         )
+    if kind == "Namespace":
+        return Namespace(name=name, labels=meta.get("labels", {}))
     if kind == "Workload":
         return Workload(
             name=name,
@@ -259,6 +263,23 @@ def _podset(d: Dict[str, Any]) -> PodSet:
         tolerations=[_toleration(t) for t in template.get("tolerations", [])],
         topology_request=topology_request,
     )
+
+
+def _selector(d):
+    if d is None:
+        return None
+    if "matchLabels" in d or "matchExpressions" in d:
+        return LabelSelector(
+            match_labels=d.get("matchLabels", {}),
+            match_expressions=[
+                MatchExpression(
+                    key=e["key"], operator=e["operator"],
+                    values=tuple(e.get("values", [])),
+                )
+                for e in d.get("matchExpressions", [])
+            ],
+        )
+    return d
 
 
 def _fair_sharing(spec):

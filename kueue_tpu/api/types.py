@@ -194,7 +194,9 @@ class ClusterQueue:
         default_factory=ClusterQueuePreemption
     )
     flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
-    namespace_selector: Optional[Dict[str, str]] = None  # None selects all
+    # None selects all; a dict is treated as matchLabels; a LabelSelector
+    # supports matchExpressions too.
+    namespace_selector: Optional[object] = None
     stop_policy: StopPolicy = StopPolicy.NONE
     fair_sharing: Optional[FairSharing] = None
     admission_checks: List[str] = field(default_factory=list)
@@ -218,6 +220,29 @@ class Cohort:
     parent: Optional[str] = None
     quotas: List[FlavorQuotas] = field(default_factory=list)
     fair_sharing: Optional[FairSharing] = None
+
+
+@dataclass
+class Namespace:
+    """Namespace with labels, for ClusterQueue namespaceSelector
+    evaluation (reference uses corev1.Namespace labels)."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class LabelSelector:
+    """metav1.LabelSelector subset: matchLabels AND matchExpressions."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[MatchExpression] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(e.matches(labels) for e in self.match_expressions)
 
 
 @dataclass

@@ -44,6 +44,7 @@ class Cache:
         self.topologies: Dict[str, Topology] = {}
         self.local_queues: Dict[str, LocalQueue] = {}
         self.nodes: Dict[str, Node] = {}
+        self.namespaces: Dict[str, object] = {}
         # Usage by pods outside kueue's management, per (flavor, leaf
         # domain) (reference tas_non_tas_pod_cache.go).
         self.non_tas_usage: Dict[str, Dict[str, Dict[str, int]]] = {}

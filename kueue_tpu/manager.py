@@ -36,6 +36,7 @@ from kueue_tpu.api.types import (
     ClusterQueue,
     Cohort,
     LocalQueue,
+    Namespace,
     ResourceFlavor,
     Topology,
     Workload,
@@ -59,7 +60,7 @@ from kueue_tpu.tas.snapshot import Node
 from kueue_tpu.metrics.registry import Metrics
 
 ApplyObject = Union[
-    ClusterQueue, Cohort, LocalQueue, ResourceFlavor, Topology,
+    ClusterQueue, Cohort, LocalQueue, Namespace, ResourceFlavor, Topology,
     AdmissionCheck, Node, WorkloadPriorityClass,
 ]
 
@@ -155,6 +156,8 @@ class Manager:
                 self.cache.add_or_update_admission_check(obj)
             elif isinstance(obj, Node):
                 self.cache.add_or_update_node(obj)
+            elif isinstance(obj, Namespace):
+                self.cache.namespaces[obj.name] = obj
             elif isinstance(obj, WorkloadPriorityClass):
                 self.priority_classes[obj.name] = obj
             else:

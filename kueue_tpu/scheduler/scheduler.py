@@ -254,12 +254,21 @@ class Scheduler:
     def _namespace_allowed(
         self, cqs: ClusterQueueSnapshot, info: WorkloadInfo
     ) -> bool:
+        """namespaceSelector evaluation (reference nominate
+        ValidateAdmissibility): selects on namespace labels; the
+        kubernetes.io/metadata.name label is always implied."""
         sel = cqs.spec.namespace_selector
         if sel is None:
             return True
-        # Simplified label selector: exact-match dict against a synthetic
-        # namespace label set {"kubernetes.io/metadata.name": namespace}.
-        labels = {"kubernetes.io/metadata.name": info.obj.namespace}
+        ns = self.cache.namespaces.get(info.obj.namespace)
+        labels = dict(getattr(ns, "labels", {}) or {})
+        labels.setdefault(
+            "kubernetes.io/metadata.name", info.obj.namespace
+        )
+        from kueue_tpu.api.types import LabelSelector
+
+        if isinstance(sel, LabelSelector):
+            return sel.matches(labels)
         return all(labels.get(k) == v for k, v in sel.items())
 
     def _get_assignments(

@@ -658,3 +658,33 @@ def test_multiple_resource_groups_independent_flavors():
     assert flavors["memory"] == "general"
     # 8 tpu doesn't fit reserved (4); spills to spot within its own group.
     assert flavors["tpu"] == "tpu-spot"
+
+
+def test_namespace_selector_with_labels_and_expressions():
+    from kueue_tpu.api.types import LabelSelector, Namespace
+
+    cq = make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}})
+    cq.namespace_selector = LabelSelector(
+        match_labels={"team": "research"},
+        match_expressions=[
+            MatchExpression(key="env", operator="In",
+                            values=("dev", "staging")),
+        ],
+    )
+    cache, queues, sched = build_env([cq])
+    cache.namespaces["ok-ns"] = Namespace(
+        name="ok-ns", labels={"team": "research", "env": "dev"})
+    cache.namespaces["bad-ns"] = Namespace(
+        name="bad-ns", labels={"team": "research", "env": "prod"})
+    from kueue_tpu.api.types import LocalQueue
+
+    for ns in ("ok-ns", "bad-ns"):
+        lq = LocalQueue(name="lq", namespace=ns, cluster_queue="cq-a")
+        cache.add_or_update_local_queue(lq)
+        queues.add_local_queue(lq)
+
+    ok = make_wl("allowed", cpu_m=1000, namespace="ok-ns")
+    bad = make_wl("denied", cpu_m=1000, namespace="bad-ns")
+    submit(queues, ok, bad)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["allowed"]
