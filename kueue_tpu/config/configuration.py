@@ -248,6 +248,7 @@ def build_manager(cfg: Configuration, **kw):
         cfg.resources.exclude_resource_prefixes
     )
     mgr.resource_transformations = list(cfg.resources.transformations)
+    mgr.manage_jobs_without_queue_name = cfg.manage_jobs_without_queue_name
     from kueue_tpu.controllers.jobframework import registry
 
     for name in registry.names():

