@@ -373,6 +373,10 @@ class Workload:
     name: str
     namespace: str = "default"
     queue_name: str = ""  # LocalQueue name
+    # Open preemption gates hold this workload's preemptions until removed
+    # (reference workload_types.go:604 PreemptionGate; used by concurrent
+    # admission and MultiKueue orchestrated preemption).
+    preemption_gates: List[str] = field(default_factory=list)
     pod_sets: List[PodSet] = field(default_factory=list)
     priority: int = 0
     priority_class: Optional[str] = None

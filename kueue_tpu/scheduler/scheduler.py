@@ -493,6 +493,16 @@ class Scheduler:
             ) or "Workload didn't fit"
             return
 
+        if mode == Mode.PREEMPT and e.info.obj.preemption_gates:
+            # reference scheduler.go:436: preemption required but gated.
+            e.status = EntryStatus.SKIPPED
+            e.quota_reserved_reason = "AdmissionGated"
+            e.inadmissible_msg = (
+                "Workload requires preemption, but it's gated: "
+                + ",".join(e.info.obj.preemption_gates)
+            )
+            return
+
         if mode == Mode.PREEMPT and not e.preemption_targets:
             e.requeue_reason = RequeueReason.PREEMPTION_NO_CANDIDATES
             e.quota_reserved_reason = REASON_WAITING_FOR_QUOTA

@@ -83,15 +83,30 @@ class VisibilityServer:
         ]
         return items[offset:offset + limit]
 
-    def local_queue_status(self, lq_key: str) -> Dict:
+    def local_queue_status(self, lq_key: str, cache=None) -> Dict:
         """LocalQueue status analog (reference localqueue_types.go:60):
-        pending count + per-position summary for one tenant queue."""
+        pending count, head, and admitted flavor usage when the cache is
+        provided."""
         items = self.pending_workloads_lq(lq_key)
-        return {
+        out = {
             "local_queue": lq_key,
             "pending_workloads": len(items),
             "head": items[0].name if items else None,
         }
+        if cache is not None:
+            usage: Dict[str, int] = {}
+            admitted = 0
+            for info in cache.workloads.values():
+                key = f"{info.obj.namespace}/{info.obj.queue_name}"
+                if key != lq_key:
+                    continue
+                admitted += 1
+                for fr, v in info.usage().items():
+                    label = f"{fr.flavor}/{fr.resource}"
+                    usage[label] = usage.get(label, 0) + v
+            out["admitted_workloads"] = admitted
+            out["flavor_usage"] = usage
+        return out
 
     def to_json(self, cq_name: str) -> str:
         return json.dumps(asdict(self.pending_workloads_cq(cq_name)))

@@ -238,3 +238,31 @@ def test_device_preemption_falls_back_to_host_and_evicts():
             i.obj.name for i in cache.workloads.values()
         )
     assert results[False] == results[True] == ["hi"]
+
+
+def test_device_mode_respects_afs_head_ordering():
+    """AFS ordering happens at head selection (before the device cycle), so
+    the DeviceScheduler honors usage-based fair sharing unchanged."""
+    from kueue_tpu.api.constants import AdmissionScope
+    from kueue_tpu.queue.afs import AdmissionFairSharingConfig, AfsTracker
+
+    cache, queues, _ = build_env(
+        [make_cq("cq-a", flavors={"f0": {"cpu": ResourceQuota(2000)}})],
+        local_queues=[
+            LocalQueue(name="heavy", cluster_queue="cq-a"),
+            LocalQueue(name="light", cluster_queue="cq-a"),
+        ],
+    )
+    cache.cluster_queues["cq-a"].admission_scope = (
+        AdmissionScope.USAGE_BASED_FAIR_SHARING
+    )
+    queues.afs_tracker = AfsTracker(AdmissionFairSharingConfig())
+    queues.afs_tracker.sample("default/heavy", {"cpu": 10_000}, now=1.0)
+
+    sched = DeviceScheduler(cache, queues)
+    h = make_wl("h", queue="heavy", cpu_m=2000, creation_time=1.0)
+    l = make_wl("l", queue="light", cpu_m=2000, creation_time=2.0)
+    submit(queues, h, l)
+    sched.schedule()
+    admitted = [i.obj.name for i in cache.workloads.values()]
+    assert admitted == ["l"]

@@ -688,3 +688,35 @@ def test_namespace_selector_with_labels_and_expressions():
     submit(queues, ok, bad)
     sched.schedule_all()
     assert admitted_names(cache) == ["allowed"]
+
+
+def test_preemption_gate_holds_preemptor():
+    cache, queues, sched = build_env(
+        [
+            make_cq(
+                "cq-a",
+                flavors={"default": {"cpu": quota(4_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+                ),
+            )
+        ],
+    )
+    lo = make_wl("lo", cpu_m=4_000, priority=1, creation_time=1.0)
+    submit(queues, lo)
+    sched.schedule_all()
+
+    hi = make_wl("hi", cpu_m=4_000, priority=10, creation_time=2.0)
+    hi.preemption_gates.append("example.com/wait-for-checkpoint")
+    submit(queues, hi)
+    sched.schedule_all()
+    # Gated: no eviction happens.
+    assert not is_evicted(lo)
+    assert "hi" not in admitted_names(cache)
+
+    # Gate removed -> preemption proceeds.
+    hi.preemption_gates.clear()
+    queues.queue_inadmissible_workloads()
+    sched.schedule_all()
+    assert is_evicted(lo)
+    assert "hi" in admitted_names(cache)
