@@ -174,6 +174,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sub.add_parser("dump")
 
+    p_desc = sub.add_parser("describe")
+    p_desc.add_argument("resource")
+    p_desc.add_argument("name")
+
     p_perf = sub.add_parser("perf")
     p_perf.add_argument("generator")
     p_perf.add_argument("--rangespec", default=None)
@@ -191,6 +195,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_schedule(mgr, args)
     if args.cmd == "import":
         return cmd_import(mgr, args)
+    if args.cmd == "describe":
+        kind = args.resource.lower()
+        if kind in ("workload", "wl"):
+            wl = mgr.workloads.get(f"default/{args.name}")
+            if wl is None:
+                print("not found", file=sys.stderr)
+                return 1
+            print(f"Name: {wl.name}\nQueue: {wl.queue_name}"
+                  f"\nPriority: {wl.priority}\nActive: {wl.active}")
+            for c in wl.status.conditions:
+                print(f"  condition {c.type}={c.status} ({c.reason})")
+            if wl.status.admission:
+                print(f"  admitted to {wl.status.admission.cluster_queue}")
+                for psa in wl.status.admission.pod_set_assignments:
+                    print(f"    podset {psa.name} x{psa.count} "
+                          f"flavors={psa.flavors}")
+        elif kind in ("clusterqueue", "cq"):
+            from kueue_tpu.visibility.server import VisibilityServer
+
+            cq = mgr.cache.cluster_queues.get(args.name)
+            if cq is None:
+                print("not found", file=sys.stderr)
+                return 1
+            print(f"Name: {cq.name}\nCohort: {cq.cohort}"
+                  f"\nStrategy: {cq.queueing_strategy.value}")
+            for rg in cq.resource_groups:
+                for fq in rg.flavors:
+                    for res, q in fq.resources.items():
+                        print(f"  {fq.name}/{res}: nominal={q.nominal} "
+                              f"borrow={q.borrowing_limit} "
+                              f"lend={q.lending_limit}")
+            vis = VisibilityServer(mgr.queues)
+            print(f"Pending: {mgr.queues.pending_count(cq.name)}")
+        else:
+            print(f"unknown resource {args.resource}", file=sys.stderr)
+            return 1
+        return 0
     if args.cmd == "dump":
         from kueue_tpu.utils.debugger import dump
 

@@ -80,6 +80,7 @@ class Configuration:
     admission_fair_sharing: Optional[object] = None  # AdmissionFairSharingConfig
     feature_gates: Dict[str, bool] = field(default_factory=dict)
     object_retention_after_finished_seconds: Optional[float] = None
+    object_retention_after_deactivated_seconds: Optional[float] = None
     visibility_enabled: bool = True
     use_device_scheduler: bool = False
 
@@ -189,6 +190,10 @@ def load(source) -> Configuration:
         cfg.object_retention_after_finished_seconds = _duration(
             wl_ret["afterFinished"]
         )
+    if wl_ret.get("afterDeactivatedByKueue") is not None:
+        cfg.object_retention_after_deactivated_seconds = _duration(
+            wl_ret["afterDeactivatedByKueue"]
+        )
     cfg.use_device_scheduler = bool(
         _pick(raw, "useDeviceScheduler", "use_device_scheduler",
               default=False)
@@ -230,11 +235,17 @@ def build_manager(cfg: Configuration, **kw):
 
     apply_feature_gates(cfg)
     retention = None
-    if cfg.object_retention_after_finished_seconds is not None:
+    if (
+        cfg.object_retention_after_finished_seconds is not None
+        or cfg.object_retention_after_deactivated_seconds is not None
+    ):
         retention = RetentionConfig(
             retain_finished_seconds=(
                 cfg.object_retention_after_finished_seconds
-            )
+            ),
+            retain_deactivated_seconds=(
+                cfg.object_retention_after_deactivated_seconds
+            ),
         )
     mgr = Manager(
         fair_sharing=cfg.fair_sharing.enable,

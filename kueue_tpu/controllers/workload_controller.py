@@ -54,6 +54,7 @@ class RetentionConfig:
     """reference objectRetentionPolicies (configuration_types.go:774)."""
 
     retain_finished_seconds: Optional[float] = None  # None = keep forever
+    retain_deactivated_seconds: Optional[float] = None
 
 
 class WorkloadController:
@@ -81,6 +82,15 @@ class WorkloadController:
         if is_finished(wl):
             self._maybe_gc(wl, now)
             return
+
+        if not wl.active:
+            keep = self.retention.retain_deactivated_seconds
+            if keep is not None:
+                cond = get_condition(wl, COND_EVICTED)
+                if cond is not None and cond.status and \
+                        now - cond.last_transition_time > keep:
+                    self.manager.delete_workload(wl)
+                    return
 
         # Deactivation (spec.active=False) evicts and deactivates
         # (reference workload_controller.go DeactivationTarget path).
