@@ -157,6 +157,9 @@ class WorkloadController:
         set_condition(wl, COND_EVICTED, True, reason, message, now)
         set_condition(wl, COND_QUOTA_RESERVED, False, "Pending", message, now)
         set_condition(wl, COND_ADMITTED, False, "NoReservation", message, now)
+        self.manager.metrics.inc(
+            "evicted_workloads_total", {"reason": reason}
+        )
         wl.status.admission = None
         wl.status.admission_checks = []
         self.manager.cache.delete_workload(wl.key)

@@ -273,8 +273,16 @@ class Manager:
                              result.nominate_s)
         self.metrics.inc("admission_attempts_total")
         tracker = self.queues.afs_tracker
+        now = self.clock()
         for key in result.admitted:
             self.metrics.inc("quota_reserved_workloads_total")
+            wl0 = self.workloads.get(key)
+            if wl0 is not None:
+                # admission_wait_time_seconds (metrics.go:544).
+                self.metrics.observe(
+                    "admission_wait_time_seconds",
+                    max(0.0, now - wl0.creation_time),
+                )
             if tracker is not None:
                 wl = self.workloads.get(key)
                 if wl is not None:

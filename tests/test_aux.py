@@ -317,3 +317,16 @@ def test_webhook_validation():
                 PodSet(name="m", count=1, requests={"cpu": 1}),
             ],
         ))
+
+
+def test_cli_describe(tmp_path, capsys):
+    mpath = tmp_path / "m.yaml"
+    mpath.write_text(MANIFESTS)
+    from kueue_tpu.cli import main
+
+    assert main(["--manifests", str(mpath), "describe", "cq", "cq-a"]) == 0
+    out = capsys.readouterr().out
+    assert "Name: cq-a" in out and "nominal=" in out
+    assert main(["--manifests", str(mpath), "describe", "wl", "wl-1"]) == 0
+    out = capsys.readouterr().out
+    assert "Name: wl-1" in out
