@@ -563,3 +563,33 @@ def test_manage_jobs_without_queue_name():
     assert job2.is_suspended()
     mgr2.schedule_all()
     assert not is_admitted(wl2)  # no LocalQueue route -> stays held
+
+
+def test_multikueue_worker_lost_grace_then_redispatch():
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    worker = worker_manager()
+    mk = MultiKueueController(worker_lost_timeout_seconds=100.0)
+    mk.add_worker("w1", worker)
+    mgr.register_check_controller(mk)
+    job = BatchJob("j", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "w1"
+
+    # Worker loses the workload: inside the grace window nothing happens.
+    worker.delete_workload(worker.workloads[wl.key])
+    mk.sync_remote_status(mgr, wl)
+    assert wl.status.cluster_name == "w1"
+    clock.advance(101.0)
+    mk.sync_remote_status(mgr, wl)
+    assert wl.status.cluster_name is None  # redispatching
