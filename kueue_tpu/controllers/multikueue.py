@@ -44,6 +44,7 @@ class _GroupState:
     nominated: List[str] = field(default_factory=list)
     round_started_at: float = 0.0
     winner: Optional[str] = None
+    winner_lost_since: Optional[float] = None
 
 
 class MultiKueueController(AdmissionCheckController):
@@ -56,10 +57,14 @@ class MultiKueueController(AdmissionCheckController):
         workers: Optional[Dict[str, Manager]] = None,
         config: Optional[MultiKueueConfig] = None,
         nomination_round_seconds: float = 300.0,
+        worker_lost_timeout_seconds: float = 900.0,
     ) -> None:
         self.workers: Dict[str, Manager] = workers or {}
         self.config = config or MultiKueueConfig(name="default")
         self.nomination_round_seconds = nomination_round_seconds
+        # reference config multiKueue.workerLostTimeout: grace before a
+        # workload on an unreachable worker is redispatched.
+        self.worker_lost_timeout_seconds = worker_lost_timeout_seconds
         self.state: Dict[str, _GroupState] = {}
 
     def add_worker(self, name: str, manager: Manager) -> None:
@@ -155,14 +160,19 @@ class MultiKueueController(AdmissionCheckController):
         st = self.state.get(wl.key)
         if st is None or st.winner is None:
             return
+        now = manager.clock()
         worker = self.workers.get(st.winner)
-        if worker is None:
-            self._redispatch(manager, wl)
+        remote = worker.workloads.get(wl.key) if worker is not None else None
+        if worker is None or remote is None:
+            # Worker unreachable/lost the workload: wait out the grace
+            # period before redispatching (workerLostTimeout).
+            if st.winner_lost_since is None:
+                st.winner_lost_since = now
+                return
+            if now - st.winner_lost_since >= self.worker_lost_timeout_seconds:
+                self._redispatch(manager, wl)
             return
-        remote = worker.workloads.get(wl.key)
-        if remote is None:
-            self._redispatch(manager, wl)
-            return
+        st.winner_lost_since = None
         if is_finished(remote):
             manager.finish_workload(wl)
         elif is_evicted(remote) and not has_quota_reservation(remote):

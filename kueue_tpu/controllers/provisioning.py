@@ -35,6 +35,9 @@ class ProvisioningRequestConfig:
     parameters: Dict[str, str] = field(default_factory=dict)
     max_retries: int = 3
     retry_backoff_seconds: float = 60.0
+    # reference podSetMergePolicy: IdenticalPodTemplates merges podsets
+    # with identical per-pod requests into one entry.
+    pod_set_merge_policy: Optional[str] = "IdenticalPodTemplates"
 
 
 @dataclass
@@ -87,12 +90,24 @@ class ProvisioningController(AdmissionCheckController):
         key = f"{wl.key}/{check_name}"
         req = self.requests.get(key)
         if req is None:
+            pod_sets = list(wl.pod_sets)
+            if cfg.pod_set_merge_policy == "IdenticalPodTemplates":
+                import dataclasses as _dc
+
+                merged = {}
+                for ps in pod_sets:
+                    key2 = tuple(sorted(ps.requests.items()))
+                    if key2 in merged:
+                        merged[key2].count += ps.count
+                    else:
+                        merged[key2] = _dc.replace(ps)
+                pod_sets = list(merged.values())
             req = ProvisioningRequest(
                 name=f"{wl.name}-{check_name}-1",
                 workload_key=wl.key,
                 provisioning_class=cfg.provisioning_class,
                 parameters=dict(cfg.parameters),
-                pod_sets=list(wl.pod_sets),
+                pod_sets=pod_sets,
             )
             self.requests[key] = req
         if req.retry_at is not None:
