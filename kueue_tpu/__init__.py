@@ -6,6 +6,30 @@ flavor fungibility, classical + fair-sharing (DRF) preemption, two-phase
 admission checks, multi-cluster dispatch, topology-aware gang placement —
 with the admission hot loop reformulated as a batched tensor program solved
 with JAX/XLA on TPU.
+
+Public surface:
+
+    from kueue_tpu import Manager
+    from kueue_tpu.api.types import ClusterQueue, LocalQueue, ...
+    from kueue_tpu.controllers.jobs import TrainJob, BatchJob, ...
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy exports: importing kueue_tpu stays lightweight (no JAX import
+    # until the device path is actually used).
+    if name == "Manager":
+        from kueue_tpu.manager import Manager
+
+        return Manager
+    if name == "load_config":
+        from kueue_tpu.config.configuration import load
+
+        return load
+    if name == "build_manager":
+        from kueue_tpu.config.configuration import build_manager
+
+        return build_manager
+    raise AttributeError(name)
