@@ -221,7 +221,7 @@ def decode(doc: Dict[str, Any]):
     if kind == "Namespace":
         return Namespace(name=name, labels=meta.get("labels", {}))
     if kind == "Workload":
-        return Workload(
+        wl = Workload(
             name=name,
             namespace=meta.get("namespace", "default"),
             queue_name=spec.get("queueName", ""),
@@ -230,6 +230,49 @@ def decode(doc: Dict[str, Any]):
             active=spec.get("active", True),
             pod_sets=[_podset(ps) for ps in spec.get("podSets", [])],
         )
+        status = doc.get("status") or {}
+        adm = status.get("admission")
+        if adm:
+            from kueue_tpu.api.types import (
+                Admission,
+                PodSetAssignment,
+                TopologyAssignment,
+            )
+
+            psas = []
+            for d in adm.get("podSetAssignments", []):
+                ta = None
+                if d.get("topologyAssignment"):
+                    tad = d["topologyAssignment"]
+                    ta = TopologyAssignment(
+                        levels=list(tad.get("levels", [])),
+                        domains=[
+                            (tuple(e["values"]), e["count"])
+                            for e in tad.get("domains", [])
+                        ],
+                    )
+                by_name = {ps.name: ps for ps in wl.pod_sets}
+                src = by_name.get(d.get("name"))
+                psas.append(PodSetAssignment(
+                    name=d.get("name", ""),
+                    flavors=dict(d.get("flavors", {})),
+                    resource_usage={
+                        r: v * d.get("count", 1)
+                        for r, v in (src.requests if src else {}).items()
+                    },
+                    count=d.get("count", 0),
+                    topology_assignment=ta,
+                ))
+            wl.status.admission = Admission(
+                cluster_queue=adm.get("clusterQueue", ""),
+                pod_set_assignments=psas,
+            )
+            from kueue_tpu.core.workload_info import set_condition
+
+            for c in status.get("conditions", []):
+                set_condition(wl, c["type"], bool(c["status"]),
+                              c.get("reason", ""))
+        return wl
     raise ValueError(f"unknown kind: {kind}")
 
 
@@ -298,3 +341,176 @@ def load_manifests(text_or_path: str) -> List[Any]:
         except OSError:
             pass
     return [decode(doc) for doc in yaml.safe_load_all(text) if doc]
+
+
+# ---------------------------------------------------------------------------
+# Encoding (state export / checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def _emit_q(res: str, v: int):
+    """Emit a canonical integer so decode round-trips exactly: cpu is
+    stored in milli-units, so it serializes with the "m" suffix."""
+    return f"{v}m" if res == "cpu" else v
+
+
+def _encode_quota(res: str, q: ResourceQuota) -> Dict[str, Any]:
+    out = {"name": res, "nominalQuota": _emit_q(res, q.nominal)}
+    if q.borrowing_limit is not None:
+        out["borrowingLimit"] = _emit_q(res, q.borrowing_limit)
+    if q.lending_limit is not None:
+        out["lendingLimit"] = _emit_q(res, q.lending_limit)
+    return out
+
+
+def encode(obj) -> Dict[str, Any]:
+    """Encode an API object back into its manifest form. Quantities are
+    emitted as canonical integers (decode accepts them unchanged), so
+    encode/decode round-trips exactly."""
+    from kueue_tpu.tas.snapshot import Node as _Node
+
+    if isinstance(obj, ResourceFlavor):
+        return {
+            "kind": "ResourceFlavor",
+            "metadata": {"name": obj.name},
+            "spec": {
+                "nodeLabels": dict(obj.node_labels),
+                "nodeTaints": [
+                    {"key": t.key, "value": t.value, "effect": t.effect}
+                    for t in obj.node_taints
+                ],
+                "tolerations": [
+                    {"key": t.key, "operator": t.operator,
+                     "value": t.value, "effect": t.effect}
+                    for t in obj.tolerations
+                ],
+                **({"topologyName": obj.topology_name}
+                   if obj.topology_name else {}),
+            },
+        }
+    if isinstance(obj, Topology):
+        return {
+            "kind": "Topology",
+            "metadata": {"name": obj.name},
+            "spec": {"levels": [{"nodeLabel": lv} for lv in obj.levels]},
+        }
+    if isinstance(obj, Cohort):
+        return {
+            "kind": "Cohort",
+            "metadata": {"name": obj.name},
+            "spec": {
+                **({"parentName": obj.parent} if obj.parent else {}),
+                "resourceGroups": [{
+                    "flavors": [{
+                        "name": fq.name,
+                        "resources": [
+                            _encode_quota(r, q)
+                            for r, q in fq.resources.items()
+                        ],
+                    } for fq in obj.quotas],
+                }] if obj.quotas else [],
+            },
+        }
+    if isinstance(obj, ClusterQueue):
+        spec: Dict[str, Any] = {
+            "queueingStrategy": obj.queueing_strategy.value,
+            "resourceGroups": [{
+                "coveredResources": list(rg.covered_resources),
+                "flavors": [{
+                    "name": fq.name,
+                    "resources": [
+                        _encode_quota(r, q) for r, q in fq.resources.items()
+                    ],
+                } for fq in rg.flavors],
+            } for rg in obj.resource_groups],
+            "preemption": {
+                "withinClusterQueue":
+                    obj.preemption.within_cluster_queue.value,
+                "reclaimWithinCohort":
+                    obj.preemption.reclaim_within_cohort.value,
+                "borrowWithinCohort": {
+                    "policy": obj.preemption.borrow_within_cohort.policy.value,
+                    **({"maxPriorityThreshold":
+                        obj.preemption.borrow_within_cohort
+                        .max_priority_threshold}
+                       if obj.preemption.borrow_within_cohort
+                       .max_priority_threshold is not None else {}),
+                },
+            },
+        }
+        if obj.cohort:
+            spec["cohortName"] = obj.cohort
+        if obj.admission_checks:
+            spec["admissionChecks"] = list(obj.admission_checks)
+        if obj.stop_policy.value != "None":
+            spec["stopPolicy"] = obj.stop_policy.value
+        return {"kind": "ClusterQueue", "metadata": {"name": obj.name},
+                "spec": spec}
+    if isinstance(obj, LocalQueue):
+        return {
+            "kind": "LocalQueue",
+            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "spec": {"clusterQueue": obj.cluster_queue},
+        }
+    if isinstance(obj, AdmissionCheck):
+        return {
+            "kind": "AdmissionCheck",
+            "metadata": {"name": obj.name},
+            "spec": {"controllerName": obj.controller_name},
+        }
+    if isinstance(obj, _Node):
+        return {
+            "kind": "Node",
+            "metadata": {"name": obj.name, "labels": dict(obj.labels)},
+            "capacity": {
+                r: _emit_q(r, v) for r, v in obj.capacity.items()
+            },
+            "ready": obj.ready,
+        }
+    if isinstance(obj, Workload):
+        doc: Dict[str, Any] = {
+            "kind": "Workload",
+            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "spec": {
+                "queueName": obj.queue_name,
+                "priority": obj.priority,
+                "active": obj.active,
+                "podSets": [{
+                    "name": ps.name,
+                    "count": ps.count,
+                    "requests": {
+                        r: _emit_q(r, v) for r, v in ps.requests.items()
+                    },
+                    **({"minCount": ps.min_count}
+                       if ps.min_count is not None else {}),
+                } for ps in obj.pod_sets],
+            },
+        }
+        # Status export enables checkpoint/restore of admissions.
+        if obj.status.admission is not None:
+            doc["status"] = {
+                "admission": {
+                    "clusterQueue": obj.status.admission.cluster_queue,
+                    "podSetAssignments": [{
+                        "name": psa.name,
+                        "flavors": dict(psa.flavors),
+                        "count": psa.count,
+                        **({"topologyAssignment": {
+                            "levels": list(
+                                psa.topology_assignment.levels
+                            ),
+                            "domains": [
+                                {"values": list(v), "count": c}
+                                for v, c in
+                                psa.topology_assignment.domains
+                            ],
+                        }} if psa.topology_assignment else {}),
+                    } for psa in obj.status.admission.pod_set_assignments],
+                },
+                "conditions": [
+                    {"type": c.type, "status": c.status, "reason": c.reason}
+                    for c in obj.status.conditions
+                ],
+            }
+        return doc
+    raise TypeError(f"cannot encode {type(obj)!r}")

@@ -257,6 +257,67 @@ class Manager:
     # control loops
     # ------------------------------------------------------------------
 
+    def export_state(self) -> str:
+        """Serialize the whole control plane (specs + workloads incl.
+        admissions) as a multi-doc YAML checkpoint — the analog of the
+        reference's etcd-is-the-journal model."""
+        import yaml as _yaml
+
+        from kueue_tpu.api.serialization import encode
+
+        docs = []
+        for topo in self.cache.topologies.values():
+            docs.append(encode(topo))
+        for rf in self.cache.resource_flavors.values():
+            docs.append(encode(rf))
+        for node in self.cache.nodes.values():
+            docs.append(encode(node))
+        for cohort in self.cache.cohorts.values():
+            docs.append(encode(cohort))
+        for ac in self.cache.admission_checks.values():
+            docs.append(encode(ac))
+        for cq in self.cache.cluster_queues.values():
+            docs.append(encode(cq))
+        for lq in self.cache.local_queues.values():
+            docs.append(encode(lq))
+        for wl in self.workloads.values():
+            docs.append(encode(wl))
+        return _yaml.safe_dump_all(docs, sort_keys=False)
+
+    @classmethod
+    def restore_state(cls, text: str, **kw) -> "Manager":
+        """Rebuild a Manager from an export_state checkpoint: specs are
+        re-applied, admitted workloads re-enter the cache with their
+        admissions, pending ones re-enter the queues."""
+        from kueue_tpu.api.serialization import load_manifests
+        from kueue_tpu.core.workload_info import (
+            WorkloadInfo,
+            is_admitted as _adm,
+            has_quota_reservation as _qr,
+        )
+
+        mgr = cls(**kw)
+        workloads = []
+        for obj in load_manifests(text):
+            if isinstance(obj, Workload):
+                workloads.append(obj)
+            else:
+                mgr.apply(obj)
+        for wl in workloads:
+            if _adm(wl) or _qr(wl):
+                mgr.workloads[wl.key] = wl
+                cq_name = (
+                    wl.status.admission.cluster_queue
+                    if wl.status.admission
+                    else mgr.queues.cluster_queue_for(wl)
+                )
+                info = WorkloadInfo(wl, cq_name or "")
+                info.sync_assignment_from_admission()
+                mgr.cache.add_or_update_workload(info)
+            else:
+                mgr.create_workload(wl)
+        return mgr
+
     def schedule(self) -> CycleResult:
         if self._admission_blocked():
             # waitForPodsReady.blockAdmission (reference

@@ -330,3 +330,36 @@ def test_cli_describe(tmp_path, capsys):
     assert main(["--manifests", str(mpath), "describe", "wl", "wl-1"]) == 0
     out = capsys.readouterr().out
     assert "Name: wl-1" in out
+
+
+def test_state_export_restore_roundtrip():
+    """Checkpoint/resume: export the full control plane, restore into a
+    fresh manager; admissions, usage and pending queues carry over."""
+    from kueue_tpu.core.resources import FlavorResource
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    admitted = make_wl("running", cpu_m=3_000, creation_time=1.0)
+    pending = make_wl("waiting", cpu_m=3_000, creation_time=2.0)
+    mgr.create_workload(admitted)
+    mgr.create_workload(pending)
+    mgr.schedule_all()
+    assert is_admitted(admitted)
+
+    checkpoint = mgr.export_state()
+    mgr2 = Manager.restore_state(checkpoint)
+
+    # Admitted workload is back in the cache with its usage.
+    info = mgr2.cache.workloads["default/running"]
+    assert info.usage()[FlavorResource("default", "cpu")] == 3000
+    # The pending workload is queued and cannot admit (quota used).
+    mgr2.schedule_all()
+    assert not is_admitted(mgr2.workloads["default/waiting"])
+    # Capacity release after restore behaves normally.
+    mgr2.finish_workload(mgr2.workloads["default/running"])
+    mgr2.schedule_all()
+    assert is_admitted(mgr2.workloads["default/waiting"])
