@@ -117,7 +117,9 @@ class Cache:
     # -- workload lifecycle -------------------------------------------------
 
     def _live_add(self, info: WorkloadInfo) -> None:
-        self._ensure_live()
+        # Caller must have run _ensure_live() BEFORE storing the workload
+        # in self.workloads: the rebuild replays self.workloads, so adding
+        # first would double-count this workload's usage.
         node = self._live_nodes.get(info.cluster_queue)
         if node is not None:
             for fr, v in info.usage().items():
@@ -136,6 +138,7 @@ class Cache:
 
     def add_or_update_workload(self, info: WorkloadInfo) -> None:
         with self._lock:
+            self._ensure_live()
             self._live_remove(info.key)
             self.workloads[info.key] = info
             self.assumed.discard(info.key)
@@ -145,6 +148,7 @@ class Cache:
         """Optimistic admission before the status write lands
         (reference cache.go AssumeWorkload)."""
         with self._lock:
+            self._ensure_live()
             self._live_remove(info.key)
             self.workloads[info.key] = info
             self.assumed.add(info.key)
@@ -173,6 +177,7 @@ class Cache:
             if info is None:
                 mutate()
                 return
+            self._ensure_live()
             self._live_remove(key)
             mutate()
             self._live_add(info)

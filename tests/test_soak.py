@@ -234,3 +234,32 @@ def test_soak_tas_with_node_failures(seed):
             check_invariants(mgr)
     mgr.schedule_all()
     check_invariants(mgr)
+
+
+def test_spec_change_mid_flight_no_double_count():
+    """Regression: a spec change between workload events must not
+    double-count usage when the live tree rebuilds (the rebuild replays
+    stored workloads; the add path must not re-add)."""
+    from kueue_tpu.core.resources import FlavorResource
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    w1 = make_wl("w1", cpu_m=3_000, creation_time=1.0)
+    mgr.create_workload(w1)
+    mgr.schedule_all()
+    assert is_admitted(w1)
+
+    # Spec change bumps the generation -> next workload op rebuilds.
+    mgr.apply(ResourceFlavor(name="extra"))
+    w2 = make_wl("w2", cpu_m=3_000, creation_time=2.0)
+    mgr.create_workload(w2)
+    mgr.schedule_all()
+    assert is_admitted(w2)
+    check_invariants(mgr)
+    snap = mgr.cache.snapshot()
+    fr = FlavorResource("default", "cpu")
+    assert snap.cluster_queues["cq-a"].node.usage[fr] == 6000
