@@ -283,6 +283,10 @@ class TopologyRequest:
     # (reference workload_types.go:252 PodsetSliceRequiredTopologyConstraint).
     slice_required_level: Optional[str] = None
     slice_size: Optional[int] = None
+    # Additional inner slice layers (reference TASMultiLayerTopology):
+    # [(level, size), ...] strictly deeper than the outer layer; each size
+    # must divide the previous layer's size.
+    slice_layers: List[Tuple[str, int]] = field(default_factory=list)
 
 
 @dataclass

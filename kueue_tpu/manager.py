@@ -432,6 +432,35 @@ class Manager:
                     {"cluster_queue": cq_name, "flavor": fr.flavor,
                      "resource": fr.resource},
                 )
+        # Per-LocalQueue series behind the LocalQueueMetrics gate
+        # (reference metrics local_queue_* variants, kube_features
+        # LocalQueueMetrics).
+        from kueue_tpu.utils import features as _features
+
+        if _features.enabled("LocalQueueMetrics"):
+            lq_pending: Dict[str, int] = {}
+            lq_admitted: Dict[str, int] = {}
+            for cq_name2 in self.cache.cluster_queues:
+                for info2 in self.queues.pending_workloads(cq_name2):
+                    k2 = f"{info2.obj.namespace}/{info2.obj.queue_name}"
+                    lq_pending[k2] = lq_pending.get(k2, 0) + 1
+            for key2 in self.cache.workloads:
+                wl2 = self.workloads.get(key2)
+                if wl2 is not None:
+                    k2 = f"{wl2.namespace}/{wl2.queue_name}"
+                    lq_admitted[k2] = lq_admitted.get(k2, 0) + 1
+            for lq_key2 in self.cache.local_queues:
+                self.metrics.set_gauge(
+                    "local_queue_pending_workloads",
+                    lq_pending.get(lq_key2, 0),
+                    {"local_queue": lq_key2},
+                )
+                self.metrics.set_gauge(
+                    "local_queue_admitted_workloads",
+                    lq_admitted.get(lq_key2, 0),
+                    {"local_queue": lq_key2},
+                )
+
         # Weighted shares need the snapshot's quota tree.
         try:
             snapshot = self.cache.snapshot()

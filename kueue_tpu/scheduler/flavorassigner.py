@@ -341,6 +341,7 @@ class FlavorAssigner:
                 unconstrained=tr.unconstrained,
                 slice_size=tr.slice_size or 1,
                 slice_required_level=tr.slice_required_level,
+                slice_layers=list(getattr(tr, "slice_layers", [])),
                 node_selector=dict(ps.node_selector),
                 tolerations=list(ps.tolerations),
                 balanced=getattr(tr, "balanced", False),

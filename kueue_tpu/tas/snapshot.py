@@ -96,6 +96,8 @@ class PlacementRequest:
     tolerations: List[Toleration] = field(default_factory=list)
     leader_requests: Optional[Dict[str, int]] = None  # LWS leader pod
     balanced: bool = False
+    # Inner slice layers: [(level, size)] below the outer slice layer.
+    slice_layers: List[Tuple[str, int]] = field(default_factory=list)
 
 
 class TASFlavorSnapshot:
@@ -751,6 +753,29 @@ class TASFlavorSnapshot:
                 f"pod count {req.count} not divisible by slice size"
                 f" {slice_size}"
             )
+        # Multi-layer slice sizes (reference buildSliceSizeAtLevel): each
+        # inner layer must be strictly deeper and divide the previous size;
+        # intermediate levels inherit the inner layer's size.
+        slice_size_at_level: Dict[int, int] = {}
+        prev_idx, prev_size = slice_level_idx, slice_size
+        for layer_level, layer_size in req.slice_layers:
+            if layer_level not in self.level_keys:
+                return None, None, (
+                    f"no topology level for slice layer: {layer_level}"
+                )
+            idx2 = self.level_keys.index(layer_level)
+            if idx2 <= prev_idx:
+                return None, None, (
+                    "slice layers must be strictly finer-grained"
+                )
+            if layer_size <= 0 or prev_size % layer_size != 0:
+                return None, None, (
+                    f"slice layer size {layer_size} must divide the outer"
+                    f" layer size {prev_size}"
+                )
+            for lvl in range(prev_idx + 1, idx2 + 1):
+                slice_size_at_level[lvl] = layer_size
+            prev_idx, prev_size = idx2, layer_size
 
         leader_count = 1 if req.leader_requests is not None else 0
 
@@ -802,13 +827,26 @@ class TASFlavorSnapshot:
             )
             level_idx += 1
         while level_idx < len(self.level_keys) - 1:
-            # At/below the slice level: per-parent assignment of pods.
+            # At/below the slice level: per-parent assignment; an inner
+            # slice layer constrains child distributions to multiples of
+            # its size (reference :1100-1132).
+            inner = slice_size_at_level.get(level_idx + 1, 1)
             new_curr: List[Domain] = []
             for dom in curr:
                 lower = self._sorted_domains(list(dom.children))
-                taken = self._update_counts_to_minimum(
-                    lower, dom.state, dom.leader_state, 1, False
-                )
+                if inner > 1:
+                    for d in lower:
+                        d.slice_state = d.state // inner
+                        d.slice_state_with_leader = (
+                            d.state_with_leader // inner
+                        )
+                    taken = self._update_counts_to_minimum(
+                        lower, dom.state, dom.leader_state, inner, True
+                    )
+                else:
+                    taken = self._update_counts_to_minimum(
+                        lower, dom.state, dom.leader_state, 1, False
+                    )
                 new_curr.extend(taken)
             curr = new_curr
             level_idx += 1

@@ -593,3 +593,19 @@ def test_multikueue_worker_lost_grace_then_redispatch():
     clock.advance(101.0)
     mk.sync_remote_status(mgr, wl)
     assert wl.status.cluster_name is None  # redispatching
+
+
+def test_local_queue_metrics_behind_gate():
+    from kueue_tpu.utils import features
+
+    features.set_enabled("LocalQueueMetrics", True)
+    try:
+        mgr = basic_manager()
+        job = BatchJob("m1", queue="lq", requests={"cpu": 1000})
+        mgr.submit_job(job)
+        mgr.schedule_all()
+        assert mgr.metrics.get(
+            "local_queue_admitted_workloads", {"local_queue": "default/lq"}
+        ) == 1.0
+    finally:
+        features.reset()

@@ -295,3 +295,46 @@ def test_leader_worker_placement():
     leader_node = leader_ta.domains[0][0][-1]
     worker_nodes = {v[-1] for v, _ in ta.domains}
     assert leader_node in worker_nodes or len(worker_nodes) == 2
+
+
+def test_multi_layer_slice_constraints():
+    """Outer 4-pod slices per rack + inner 2-pod slices per host: every
+    host contributes an even pod count (reference TASMultiLayerTopology /
+    buildSliceSizeAtLevel)."""
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(
+            count=8, single_pod_requests={"tpu": 1},
+            required_level=LEVELS[0],
+            slice_size=4, slice_required_level=LEVELS[1],
+            slice_layers=[(LEVELS[2], 2)],
+        )
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 8
+    for values, count in ta.domains:
+        assert count % 2 == 0, f"host {values[-1]} got odd count {count}"
+
+
+def test_multi_layer_slice_validation():
+    snap = snapshot()
+    # Inner size 3 doesn't divide outer 4.
+    _, _, reason = snap.find_topology_assignment(
+        PlacementRequest(
+            count=8, single_pod_requests={"tpu": 1},
+            required_level=LEVELS[0],
+            slice_size=4, slice_required_level=LEVELS[1],
+            slice_layers=[(LEVELS[2], 3)],
+        )
+    )
+    assert "must divide" in reason
+    # Layer above the outer level is rejected.
+    _, _, reason = snap.find_topology_assignment(
+        PlacementRequest(
+            count=8, single_pod_requests={"tpu": 1},
+            required_level=LEVELS[0],
+            slice_size=4, slice_required_level=LEVELS[1],
+            slice_layers=[(LEVELS[0], 2)],
+        )
+    )
+    assert "finer-grained" in reason
