@@ -244,12 +244,18 @@ def decode(doc: Dict[str, Any]):
                 ta = None
                 if d.get("topologyAssignment"):
                     tad = d["topologyAssignment"]
+                    domains = [
+                        (tuple(e["values"]), e["count"])
+                        for e in tad.get("domains", [])
+                    ]
+                    for grp in tad.get("slicedDomains", []):
+                        domains.extend(
+                            (tuple(vals), grp["count"])
+                            for vals in grp.get("values", [])
+                        )
                     ta = TopologyAssignment(
                         levels=list(tad.get("levels", [])),
-                        domains=[
-                            (tuple(e["values"]), e["count"])
-                            for e in tad.get("domains", [])
-                        ],
+                        domains=sorted(domains),
                     )
                 by_name = {ps.name: ps for ps in wl.pod_sets}
                 src = by_name.get(d.get("name"))
@@ -346,6 +352,30 @@ def load_manifests(text_or_path: str) -> List[Any]:
 # ---------------------------------------------------------------------------
 # Encoding (state export / checkpoint)
 # ---------------------------------------------------------------------------
+
+
+def _encode_ta(ta) -> Dict[str, Any]:
+    """TopologyAssignment encoding. Large assignments use the sliced form
+    (reference workload_types.go:479-537 sliced encodings): domains grouped
+    by identical per-domain count — e.g. 512 hosts x 4 pods each becomes
+    one group instead of 512 entries."""
+    if len(ta.domains) > 16:
+        groups: Dict[int, list] = {}
+        for v, c in ta.domains:
+            groups.setdefault(c, []).append(list(v))
+        return {
+            "levels": list(ta.levels),
+            "slicedDomains": [
+                {"count": c, "values": vals}
+                for c, vals in sorted(groups.items())
+            ],
+        }
+    return {
+        "levels": list(ta.levels),
+        "domains": [
+            {"values": list(v), "count": c} for v, c in ta.domains
+        ],
+    }
 
 
 def _emit_q(res: str, v: int):
@@ -495,16 +525,9 @@ def encode(obj) -> Dict[str, Any]:
                         "name": psa.name,
                         "flavors": dict(psa.flavors),
                         "count": psa.count,
-                        **({"topologyAssignment": {
-                            "levels": list(
-                                psa.topology_assignment.levels
-                            ),
-                            "domains": [
-                                {"values": list(v), "count": c}
-                                for v, c in
-                                psa.topology_assignment.domains
-                            ],
-                        }} if psa.topology_assignment else {}),
+                        **({"topologyAssignment": _encode_ta(
+                            psa.topology_assignment
+                        )} if psa.topology_assignment else {}),
                     } for psa in obj.status.admission.pod_set_assignments],
                 },
                 "conditions": [

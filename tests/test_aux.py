@@ -363,3 +363,36 @@ def test_state_export_restore_roundtrip():
     mgr2.finish_workload(mgr2.workloads["default/running"])
     mgr2.schedule_all()
     assert is_admitted(mgr2.workloads["default/waiting"])
+
+
+def test_sliced_topology_assignment_roundtrip():
+    from kueue_tpu.api.serialization import decode, encode
+    from kueue_tpu.api.types import (
+        Admission,
+        PodSet,
+        PodSetAssignment,
+        TopologyAssignment,
+        Workload,
+    )
+
+    domains = [((f"host-{i}",), 4) for i in range(100)]
+    wl = Workload(
+        name="big-gang", queue_name="lq",
+        pod_sets=[PodSet(name="main", count=400, requests={"tpu": 1})],
+    )
+    wl.status.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"tpu": "v5e"}, count=400,
+            topology_assignment=TopologyAssignment(
+                levels=["kubernetes.io/hostname"], domains=domains,
+            ),
+        )],
+    )
+    doc = encode(wl)
+    tad = doc["status"]["admission"]["podSetAssignments"][0][
+        "topologyAssignment"]
+    assert "slicedDomains" in tad and len(tad["slicedDomains"]) == 1
+    back = decode(doc)
+    ta = back.status.admission.pod_set_assignments[0].topology_assignment
+    assert sorted(ta.domains) == sorted(domains)
