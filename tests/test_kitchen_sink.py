@@ -1,0 +1,123 @@
+"""Kitchen-sink integration: one scenario exercising TAS + MultiKueue +
+provisioning checks + elastic slices + reclaimable pods + preemption +
+fair sharing together — the closest analog of the reference's e2e suite
+running in-process."""
+
+from kueue_tpu.api.constants import CheckState, PreemptionPolicy
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueuePreemption,
+    Cohort,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    TopologyRequest,
+    Workload,
+    quota,
+)
+from kueue_tpu.controllers.elasticjobs import scale
+from kueue_tpu.controllers.jobs import TrainJob
+from kueue_tpu.controllers.multikueue import MultiKueueController
+from kueue_tpu.controllers.provisioning import ProvisioningController
+from kueue_tpu.core.workload_info import is_admitted, is_evicted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq
+from .test_tas import LEVELS, make_nodes, make_topology
+
+
+def test_kitchen_sink_end_to_end():
+    # --- Manager (hub) cluster: quota + fair sharing + checks ---
+    hub = Manager(fair_sharing=True)
+    hub.apply(
+        ResourceFlavor(name="tpu-v5e"),
+        Cohort(name="org"),
+        make_cq(
+            "research", cohort="org",
+            flavors={"tpu-v5e": {"tpu": quota(16, borrowing_limit=16)}},
+            resources=["tpu"],
+            preemption=ClusterQueuePreemption(
+                reclaim_within_cohort=PreemptionPolicy.ANY,
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+            admission_checks=["prov", "mk"],
+        ),
+        make_cq(
+            "prod", cohort="org",
+            flavors={"tpu-v5e": {"tpu": quota(16)}},
+            resources=["tpu"],
+        ),
+        LocalQueue(name="exp", cluster_queue="research"),
+        LocalQueue(name="serve", cluster_queue="prod"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    hub.register_check_controller(ProvisioningController())
+
+    # --- Worker cluster: the TPU fleet with real topology ---
+    worker = Manager()
+    worker.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("research", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"]),
+        LocalQueue(name="exp", cluster_queue="research"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        worker.apply(node)
+    mk = MultiKueueController()
+    mk.add_worker("tpu-pool", worker)
+    hub.register_check_controller(mk)
+
+    # --- A gang training job with a rack constraint, dispatched ---
+    job = TrainJob(
+        "pretrain", queue="exp",
+        roles={"trainer": (2, {"tpu": 2})},
+        topology=TopologyRequest(required_level=LEVELS[1]),
+    )
+    wl = hub.submit_job(job)
+    hub.schedule_all()
+    hub.tick()  # provisioning Ready + multikueue dispatch
+    hub.tick()
+    assert wl.status.cluster_name == "tpu-pool"
+    assert is_admitted(wl)
+    remote = worker.workloads[wl.key]
+    ta = remote.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta is not None and sum(c for _, c in ta.domains) == 2
+
+    # --- Elastic scale-up of the remote gang within worker quota ---
+    # 4 pods x 2 tpu = 8 tpu = exactly one rack: still placeable.
+    ok, msg = scale(worker, remote, {"trainer": 4})
+    assert ok, msg
+    assert remote.status.admission.pod_set_assignments[0].count == 4
+
+    # --- Reclaimable pods release part of the gang early ---
+    worker.reclaim_pods(remote, {"trainer": 2})
+    from kueue_tpu.core.resources import FlavorResource
+
+    info = worker.cache.workloads[remote.key]
+    assert info.usage()[FlavorResource("tpu-v5e", "tpu")] == 4  # 2 of 4 left
+
+    # --- Hub-side fair-sharing preemption still works alongside ---
+    filler = Workload(
+        name="filler", queue_name="serve",
+        pod_sets=[PodSet(name="m", count=1, requests={"tpu": 16})],
+        priority=1, creation_time=10.0,
+    )
+    hub.create_workload(filler)
+    hub.schedule_all()
+    assert is_admitted(filler)
+
+    # --- Remote completion propagates back to the hub ---
+    worker.finish_workload(remote)
+    mk.sync_remote_status(hub, wl)
+    from kueue_tpu.core.workload_info import is_finished
+
+    assert is_finished(wl)
+
+    # --- State checkpoint of the whole hub round-trips ---
+    checkpoint = hub.export_state()
+    hub2 = Manager.restore_state(checkpoint)
+    assert "default/filler" in hub2.cache.workloads
