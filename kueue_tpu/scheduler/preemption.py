@@ -61,17 +61,22 @@ class Target:
 
 
 class PreemptedWorkloads:
-    """Overlap set across one cycle (reference
-    preempted_workloads.go:1-38)."""
+    """Victims designated so far in one cycle, keyed by workload
+    (reference preempted_workloads.go:1-38 — a map, so the cycle's fit
+    checks can simulate removal of every earlier victim)."""
 
     def __init__(self) -> None:
-        self._keys: Set[str] = set()
+        self._by_key: Dict[str, WorkloadInfo] = {}
 
     def has_any(self, targets: Sequence[Target]) -> bool:
-        return any(t.info.key in self._keys for t in targets)
+        return any(t.info.key in self._by_key for t in targets)
 
     def insert(self, targets: Sequence[Target]) -> None:
-        self._keys.update(t.info.key for t in targets)
+        for t in targets:
+            self._by_key[t.info.key] = t.info
+
+    def infos(self):
+        return self._by_key.values()
 
 
 def satisfies_preemption_policy(

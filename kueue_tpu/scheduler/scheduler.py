@@ -614,9 +614,23 @@ class Scheduler:
         preempted_workloads: PreemptedWorkloads,
         targets: List[Target],
     ) -> bool:
-        """reference scheduler.go fits(): simulate removal of all preemption
-        victims so far + this entry's targets, then check quota."""
-        infos = [t.info for t in targets]
+        """reference scheduler.go fits(): simulate removal of ALL victims
+        designated earlier in this cycle plus this entry's targets, then
+        check quota (victims stay in the snapshot until their async
+        evictions land)."""
+        by_key = {info.key: info for info in preempted_workloads.infos()}
+        for t in targets:
+            by_key[t.info.key] = t.info
+        # Only remove victims still present in the snapshot (the inline
+        # eviction path may already have removed cache state, but the
+        # snapshot copy retains them).
+        infos = [
+            info for info in by_key.values()
+            if info.key in snapshot.cluster_queues.get(
+                info.cluster_queue,
+                type("E", (), {"workloads": {}})(),
+            ).workloads
+        ]
         revert = snapshot.simulate_workload_removal(infos)
         try:
             return cq.fits(usage)

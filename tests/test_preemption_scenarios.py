@@ -231,3 +231,45 @@ def test_preemption_overlap_skipped_within_cycle():
     assert "h1" in admitted_names(cache)
     assert "h2" in admitted_names(cache)
     assert is_evicted(v1) and is_evicted(v2)
+
+
+def test_in_cycle_fit_sees_earlier_victims_removed():
+    """entry1 preempts a borrower and consumes capacity; entry2 (fit-
+    nominated, different victim-free assignment) must still admit in the
+    same cycle because the victim's pending removal is simulated
+    (reference scheduler.go fits() removes every designated victim)."""
+    pre = ClusterQueuePreemption(reclaim_within_cohort=PreemptionPolicy.ANY)
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(4_000)}},
+                    preemption=pre),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(3_000)}},
+                    preemption=pre),
+            make_cq("cq-c", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}}),
+        ],
+    )
+    # Victim borrows up to 6000 of the 9000 cohort (3000 left free).
+    victim = make_wl("victim", queue="lq-cq-c", cpu_m=6_000,
+                     creation_time=1.0)
+    submit(queues, victim)
+    sched.schedule_all()
+    assert "victim" in admitted_names(cache)
+
+    # wa (4000, high prio) needs preemption; wb (3000) fits the remaining
+    # free capacity at nomination time.
+    wa = make_wl("wa", queue="lq-cq-a", cpu_m=4_000, priority=10,
+                 creation_time=2.0)
+    wb = make_wl("wb", queue="lq-cq-b", cpu_m=3_000, priority=0,
+                 creation_time=3.0)
+    submit(queues, wa, wb)
+    r = sched.schedule()
+    assert is_evicted(victim)
+    # wb is admitted in the SAME cycle: its fit check simulates the
+    # victim's removal, outweighing wa's freshly-added usage.
+    assert "default/wb" in r.admitted
+    sched.schedule_all()
+    assert "wa" in admitted_names(cache)
+    assert "wb" in admitted_names(cache)
