@@ -624,13 +624,11 @@ class Scheduler:
         # Only remove victims still present in the snapshot (the inline
         # eviction path may already have removed cache state, but the
         # snapshot copy retains them).
-        infos = [
-            info for info in by_key.values()
-            if info.key in snapshot.cluster_queues.get(
-                info.cluster_queue,
-                type("E", (), {"workloads": {}})(),
-            ).workloads
-        ]
+        infos = []
+        for info in by_key.values():
+            cqs = snapshot.cluster_queues.get(info.cluster_queue)
+            if cqs is not None and info.key in cqs.workloads:
+                infos.append(info)
         revert = snapshot.simulate_workload_removal(infos)
         try:
             return cq.fits(usage)
