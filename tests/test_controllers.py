@@ -609,3 +609,54 @@ def test_local_queue_metrics_behind_gate():
         ) == 1.0
     finally:
         features.reset()
+
+
+def test_multikueue_incremental_dispatcher_rounds():
+    """Incremental dispatch nominates 3 workers per round (reference
+    incrementaldispatcher.go): with the first round's workers saturated,
+    the winner appears only after the round timeout opens round two."""
+    t = [0.0]
+    mgr = Manager(clock=lambda: t[0])
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    from kueue_tpu.controllers.multikueue import MultiKueueConfig
+
+    mk = MultiKueueController(
+        config=MultiKueueConfig(name="cfg", dispatcher="Incremental"),
+        nomination_round_seconds=60.0,
+    )
+    workers = {}
+    for i in range(1, 6):
+        w = worker_manager()
+        workers[f"cluster-{i}"] = w
+        mk.add_worker(f"cluster-{i}", w)
+    # Saturate the first three (the first nomination round).
+    for i in range(1, 4):
+        workers[f"cluster-{i}"].submit_job(
+            BatchJob(f"filler-{i}", queue="lq", requests={"cpu": 4000}))
+        workers[f"cluster-{i}"].schedule_all()
+    mgr.register_check_controller(mk)
+
+    job = BatchJob("inc", queue="lq", requests={"cpu": 2000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    mgr.tick()
+    st = mk.state[wl.key]
+    assert st.nominated == ["cluster-1", "cluster-2", "cluster-3"]
+    assert wl.status.admission_checks[0].state != CheckState.READY
+    # Mirrored to exactly the nominated workers.
+    assert wl.key in workers["cluster-1"].workloads
+    assert wl.key not in workers["cluster-4"].workloads
+
+    # Round two after the timeout: the remaining workers join and win.
+    t[0] = 61.0
+    mgr.tick()
+    assert len(st.nominated) == 5
+    assert wl.status.admission_checks[0].state == CheckState.READY
+    assert wl.status.cluster_name in ("cluster-4", "cluster-5")
