@@ -660,3 +660,52 @@ def test_multikueue_incremental_dispatcher_rounds():
     assert len(st.nominated) == 5
     assert wl.status.admission_checks[0].state == CheckState.READY
     assert wl.status.cluster_name in ("cluster-4", "cluster-5")
+
+
+def test_provisioning_fail_backoff_then_provisioned():
+    """A transient provisioning failure retries after backoff and the
+    second ProvisioningRequest (name suffix -2) succeeds — reference
+    provisioning retry strategy with a fresh request per attempt."""
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+    )
+
+    class FlakyProvider:
+        def __init__(self):
+            self.polls = 0
+
+        def poll(self, request):
+            self.polls += 1
+            return (ProvisioningState.FAILED if self.polls == 1
+                    else ProvisioningState.PROVISIONED)
+
+    from kueue_tpu.controllers.provisioning import ProvisioningRequestConfig
+
+    prov = ProvisioningController(
+        provider=FlakyProvider(),
+        configs={"prov": ProvisioningRequestConfig(
+            name="cfg", max_retries=3, retry_backoff_seconds=10.0)},
+    )
+    mgr.register_check_controller(prov)
+
+    job = BatchJob("flaky", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    mgr.tick()  # attempt 1 fails -> backoff, still Pending
+    assert wl.status.admission_checks[0].state == CheckState.PENDING
+    clock.advance(5.0)
+    mgr.tick()  # inside backoff window: no new attempt
+    assert wl.status.admission_checks[0].state == CheckState.PENDING
+    clock.advance(6.0)
+    mgr.tick()  # attempt 2 provisions -> Ready -> Admitted
+    acs = wl.status.admission_checks[0]
+    assert acs.state == CheckState.READY
+    assert acs.message.endswith("-2")  # provisioned by the retry request
+    assert is_admitted(wl)
