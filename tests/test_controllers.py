@@ -709,3 +709,70 @@ def test_provisioning_fail_backoff_then_provisioned():
     assert acs.state == CheckState.READY
     assert acs.message.endswith("-2")  # provisioned by the retry request
     assert is_admitted(wl)
+
+
+def test_retention_gc_finished_and_deactivated():
+    """objectRetentionPolicies: finished workloads are deleted after
+    retainFinished; deactivated-evicted ones after retainDeactivated."""
+    from kueue_tpu.controllers.workload_controller import RetentionConfig
+
+    clock = FakeClock()
+    mgr = basic_manager(
+        clock,
+        retention=RetentionConfig(
+            retain_finished_seconds=100.0,
+            retain_deactivated_seconds=50.0,
+        ),
+    )
+    done = mgr.submit_job(BatchJob("done", queue="lq",
+                                   requests={"cpu": 1000}))
+    gone = mgr.submit_job(BatchJob("gone", queue="lq",
+                                   requests={"cpu": 1000}))
+    mgr.schedule_all()
+    mgr.finish_workload(done)
+    gone.active = False
+    mgr.tick()  # evicts the deactivated workload
+    assert is_evicted(gone)
+
+    clock.advance(60.0)  # past deactivated retention, not finished's
+    mgr.tick()
+    assert gone.key not in mgr.workloads
+    assert done.key in mgr.workloads
+    clock.advance(50.0)  # now past finished retention
+    mgr.tick()
+    assert done.key not in mgr.workloads
+
+
+def test_pods_ready_backoff_limit_deactivates():
+    """requeuingBackoffLimitCount: one PodsReady-timeout requeue is
+    allowed; the second timeout deactivates the workload for good."""
+    clock = FakeClock()
+    mgr = basic_manager(
+        clock,
+        pods_ready=WaitForPodsReadyConfig(
+            enable=True, timeout_seconds=10.0,
+            requeuing_backoff_base_seconds=5.0,
+            requeuing_backoff_limit_count=1,
+        ),
+    )
+    job = BatchJob("never-ready", queue="lq", parallelism=1,
+                   requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    job.set_pods_ready(False)
+
+    clock.advance(11.0)
+    mgr.tick()  # timeout 1 -> requeue with backoff (count=1, at limit)
+    assert wl.status.requeue_state.count == 1
+    assert wl.active
+    clock.advance(6.0)
+    mgr.tick()
+    mgr.schedule_all()  # readmitted for attempt 2
+    assert is_admitted(wl)
+    job.set_pods_ready(False)  # unsuspend reset the flag
+    clock.advance(11.0)
+    mgr.tick()  # timeout 2 -> past the limit -> deactivated
+    assert not wl.active
+    assert is_evicted(wl)
+    mgr.schedule_all()
+    assert not is_admitted(wl)
