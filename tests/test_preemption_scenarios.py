@@ -273,3 +273,53 @@ def test_in_cycle_fit_sees_earlier_victims_removed():
     sched.schedule_all()
     assert "wa" in admitted_names(cache)
     assert "wb" in admitted_names(cache)
+
+
+def _fair_strategy_env():
+    """cq-b borrows 2000 over its 2000 nominal (one 4000 workload,
+    share 0.5); cq-a's 3000 preemptor would land at share 0.25 —
+    between the candidate's post-removal share (0) and its original
+    share (0.5)."""
+    cache, queues, sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}},
+                    preemption=ClusterQueuePreemption(
+                        reclaim_within_cohort=PreemptionPolicy.ANY,
+                    )),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": quota(2_000)}}),
+        ],
+        fair_sharing=True,
+    )
+    big = make_wl("big-b", queue="lq-cq-b", cpu_m=4_000, creation_time=1.0)
+    submit(queues, big)
+    sched.schedule_all()
+    assert "big-b" in admitted_names(cache)
+    wa = make_wl("wa", queue="lq-cq-a", cpu_m=3_000, creation_time=2.0)
+    submit(queues, wa)
+    return cache, queues, sched
+
+
+def test_fair_strategy_s2b_fallback_preempts():
+    """Default strategy list (S2-a then S2-b, reference strategy.go):
+    S2-a rejects the lone candidate (0.25 <= 0 fails) but the S2-b
+    fallback accepts it (0.25 < 0.5), so the preemption lands."""
+    cache, queues, sched = _fair_strategy_env()
+    sched.schedule()
+    assert "wa" not in admitted_names(cache)  # eviction cycle
+    sched.schedule()
+    assert "big-b" not in admitted_names(cache)
+    assert "wa" in admitted_names(cache)
+
+
+def test_fair_strategy_s2a_only_blocks():
+    """With strategies=[LessThanOrEqualToFinalShare] alone the same
+    scenario must NOT preempt: the rule compares against the share
+    AFTER removal, which drops to 0 below the preemptor's 0.25."""
+    cache, queues, sched = _fair_strategy_env()
+    sched.preemptor.fair_strategies = ["LessThanOrEqualToFinalShare"]
+    sched.schedule()
+    sched.schedule()
+    assert "big-b" in admitted_names(cache)
+    assert "wa" not in admitted_names(cache)
