@@ -54,3 +54,37 @@ def test_usage_based_ordering_prefers_low_usage_lq():
     mgr.schedule()
     assert is_admitted(l1)
     assert not is_admitted(h1)
+
+
+def test_entry_penalty_rotates_between_queues():
+    """Entry penalties (reference afs/entry_penalties.go): an admission
+    immediately charges alpha x requests to the LQ, so with no usage
+    history two equal queues alternate rather than FIFO-starving."""
+    clockbox = [0.0]
+    mgr = Manager(
+        clock=lambda: clockbox[0],
+        admission_fair_sharing=AdmissionFairSharingConfig(
+            usage_half_life_s=600, usage_sampling_interval_s=60,
+        ),
+    )
+    cq = make_cq("cq-a", flavors={"default": {"cpu": quota(1_000)}})
+    cq.admission_scope = AdmissionScope.USAGE_BASED_FAIR_SHARING
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        cq,
+        LocalQueue(name="first", cluster_queue="cq-a"),
+        LocalQueue(name="second", cluster_queue="cq-a"),
+    )
+    # Queue "first" submits everything earlier: FIFO would admit f0, f1.
+    f0 = make_wl("f0", queue="first", cpu_m=1_000, creation_time=1.0)
+    f1 = make_wl("f1", queue="first", cpu_m=1_000, creation_time=2.0)
+    s0 = make_wl("s0", queue="second", cpu_m=1_000, creation_time=3.0)
+    for w in (f0, f1, s0):
+        mgr.create_workload(w)
+    mgr.schedule()  # f0 admitted (both zero usage; FIFO tiebreak)
+    assert is_admitted(f0)
+    mgr.finish_workload(f0)
+    # The admission penalized "first": "second" now goes ahead of f1.
+    mgr.schedule()
+    assert is_admitted(s0)
+    assert not is_admitted(f1)
