@@ -296,6 +296,10 @@ class PodSet:
     name: str
     count: int
     requests: Dict[str, int] = field(default_factory=dict)  # per-pod
+    # DRA: per-pod device requests by DeviceClass name (reference
+    # ResourceClaim device requests); translated into ``requests`` via the
+    # configured deviceClassMappings at workload creation.
+    device_requests: Dict[str, int] = field(default_factory=dict)
     min_count: Optional[int] = None  # enables partial admission
     node_selector: Dict[str, str] = field(default_factory=dict)
     required_affinity: List[MatchExpression] = field(default_factory=list)

@@ -52,11 +52,26 @@ class ResourceTransformation:
 
 
 @dataclass
+class DeviceClassMapping:
+    """DRA seam (reference configuration_types.go:634 DeviceClassMapping):
+    ``name`` is the logical resource referenced by ClusterQueue quotas;
+    ``device_class_names`` are the DRA device classes it covers. Pod-set
+    ``device_requests`` naming one of those classes are counted against
+    ``name`` at workload creation."""
+
+    name: str
+    device_class_names: List[str] = field(default_factory=list)
+
+
+@dataclass
 class ResourcesConfig:
     """reference configuration_types.go:589."""
 
     exclude_resource_prefixes: List[str] = field(default_factory=list)
     transformations: List[ResourceTransformation] = field(
+        default_factory=list
+    )
+    device_class_mappings: List[DeviceClassMapping] = field(
         default_factory=list
     )
 
@@ -165,6 +180,16 @@ def load(source) -> Configuration:
             )
             for t in res.get("transformations", [])
         ],
+        device_class_mappings=[
+            DeviceClassMapping(
+                name=m["name"],
+                device_class_names=list(
+                    m.get("deviceClassNames", m.get("device_class_names", []))
+                ),
+            )
+            for m in res.get("deviceClassMappings",
+                             res.get("device_class_mappings", []))
+        ],
     )
     afs = _pick(raw, "admissionFairSharing", default=None)
     if afs:
@@ -259,6 +284,7 @@ def build_manager(cfg: Configuration, **kw):
         cfg.resources.exclude_resource_prefixes
     )
     mgr.resource_transformations = list(cfg.resources.transformations)
+    mgr.device_class_mappings = list(cfg.resources.device_class_mappings)
     mgr.manage_jobs_without_queue_name = cfg.manage_jobs_without_queue_name
     from kueue_tpu.controllers.jobframework import registry
 

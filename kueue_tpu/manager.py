@@ -106,6 +106,8 @@ class Manager:
         # Resource preprocessing (reference config resources section).
         self.exclude_resource_prefixes: list = []
         self.resource_transformations: list = []
+        # reference configuration_types.go:634 DRA deviceClassMappings.
+        self.device_class_mappings: list = []
         # reference configuration_types.go manageJobsWithoutQueueName.
         self.manage_jobs_without_queue_name = False
         self.job_reconciler = JobReconciler(self)
@@ -208,6 +210,25 @@ class Manager:
                     self.exclude_resource_prefixes,
                     self.resource_transformations,
                 )
+        # DRA: count device-class requests against the mapped logical
+        # resource (reference configuration_types.go:634 deviceClassMappings;
+        # unmapped device classes make the workload inadmissible — here,
+        # rejected at creation).
+        if any(ps.device_requests for ps in wl.pod_sets):
+            by_class = {
+                dc: m.name
+                for m in self.device_class_mappings
+                for dc in m.device_class_names
+            }
+            for ps in wl.pod_sets:
+                for dc, n in ps.device_requests.items():
+                    res = by_class.get(dc)
+                    if res is None:
+                        raise ValueError(
+                            f"workload {wl.key}: device class {dc!r} has no "
+                            f"deviceClassMappings entry"
+                        )
+                    ps.requests[res] = ps.requests.get(res, 0) + n
         self.workloads[wl.key] = wl
         self.metrics.inc("workloads_created_total")
         self.queues.add_or_update_workload(wl)

@@ -396,3 +396,59 @@ def test_sliced_topology_assignment_roundtrip():
     back = decode(doc)
     ta = back.status.admission.pod_set_assignments[0].topology_assignment
     assert sorted(ta.domains) == sorted(domains)
+
+
+def test_dra_device_class_mappings():
+    """deviceClassMappings (reference configuration_types.go:634): pod-set
+    device requests resolve to the mapped logical resource and are counted
+    against ClusterQueue quota; unmapped classes are rejected."""
+    from kueue_tpu.api.types import (
+        LocalQueue, PodSet, ResourceFlavor, Workload, quota,
+    )
+    from kueue_tpu.core.workload_info import is_admitted
+
+    from .helpers import make_cq
+
+    cfg = load({
+        "resources": {
+            "deviceClassMappings": [
+                {"name": "tpu.google.com/v5e",
+                 "deviceClassNames": ["tpu-v5e.google.com", "tpu.dra.x-k8s.io"]},
+            ],
+        },
+    })
+    assert cfg.resources.device_class_mappings[0].name == "tpu.google.com/v5e"
+    mgr = build_manager(cfg)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", resources=("tpu.google.com/v5e",),
+                flavors={"default": {
+                    "tpu.google.com/v5e": quota(8)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = Workload(name="dra", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=2,
+               device_requests={"tpu-v5e.google.com": 4}),
+    ])
+    mgr.create_workload(wl)
+    assert wl.pod_sets[0].requests == {"tpu.google.com/v5e": 4}
+    mgr.schedule_all()
+    assert is_admitted(wl)
+
+    # A second 4-chip-per-pod pair no longer fits the 8-chip quota.
+    wl2 = Workload(name="dra2", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=2,
+               device_requests={"tpu.dra.x-k8s.io": 4}),
+    ])
+    mgr.create_workload(wl2)
+    mgr.schedule_all()
+    assert not is_admitted(wl2)
+
+    import pytest
+
+    unmapped = Workload(name="bad", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1,
+               device_requests={"unknown.dev/class": 1}),
+    ])
+    with pytest.raises(ValueError, match="deviceClassMappings"):
+        mgr.create_workload(unmapped)
