@@ -307,6 +307,9 @@ def _podset(d: Dict[str, Any]) -> PodSet:
         name=d.get("name", "main"),
         count=d.get("count", 1),
         requests=requests,
+        device_requests={
+            r: int(v) for r, v in d.get("deviceRequests", {}).items()
+        },
         min_count=d.get("minCount"),
         node_selector=template.get("nodeSelector", {}),
         tolerations=[_toleration(t) for t in template.get("tolerations", [])],
@@ -511,6 +514,8 @@ def encode(obj) -> Dict[str, Any]:
                     "requests": {
                         r: _emit_q(r, v) for r, v in ps.requests.items()
                     },
+                    **({"deviceRequests": dict(ps.device_requests)}
+                       if ps.device_requests else {}),
                     **({"minCount": ps.min_count}
                        if ps.min_count is not None else {}),
                 } for ps in obj.pod_sets],

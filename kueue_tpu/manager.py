@@ -229,6 +229,9 @@ class Manager:
                             f"deviceClassMappings entry"
                         )
                     ps.requests[res] = ps.requests.get(res, 0) + n
+                # Folded into requests; cleared so a checkpoint restore
+                # through create_workload cannot double-count.
+                ps.device_requests = {}
         self.workloads[wl.key] = wl
         self.metrics.inc("workloads_created_total")
         self.queues.add_or_update_workload(wl)
