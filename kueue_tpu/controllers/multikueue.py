@@ -145,6 +145,7 @@ class MultiKueueController(AdmissionCheckController):
             if remote is not None:
                 worker.delete_workload(remote)
         wl.status.cluster_name = winner
+        self._mirror_topology(wl, self.workers[winner].workloads.get(wl.key))
         acs.state = CheckState.READY
         acs.message = f'The workload got reservation on "{winner}"'
         acs.last_transition_time = now
@@ -173,10 +174,35 @@ class MultiKueueController(AdmissionCheckController):
                 self._redispatch(manager, wl)
             return
         st.winner_lost_since = None
+        self._mirror_topology(wl, remote)
         if is_finished(remote):
             manager.finish_workload(wl)
         elif is_evicted(remote) and not has_quota_reservation(remote):
             self._redispatch(manager, wl)
+
+    @staticmethod
+    def _mirror_topology(wl: Workload, remote: Optional[Workload]) -> None:
+        """Copy the worker's topology assignments back onto the manager's
+        delayed pod-set assignments (resolves the reference's
+        DelayedTopologyRequest Pending -> Ready transition so the manager
+        workload can become Admitted)."""
+        if (
+            remote is None
+            or remote.status.admission is None
+            or wl.status.admission is None
+        ):
+            return
+        remote_by_name = {
+            psa.name: psa
+            for psa in remote.status.admission.pod_set_assignments
+        }
+        for psa in wl.status.admission.pod_set_assignments:
+            if not psa.delayed_topology_request \
+                    or psa.topology_assignment is not None:
+                continue
+            rpsa = remote_by_name.get(psa.name)
+            if rpsa is not None and rpsa.topology_assignment is not None:
+                psa.topology_assignment = rpsa.topology_assignment
 
     def _redispatch(self, manager: Manager, wl: Workload) -> None:
         """Worker lost the workload (eviction / cluster gone): reset the

@@ -30,6 +30,7 @@ from kueue_tpu.core.workload_info import (
     all_checks_ready,
     get_condition,
     has_quota_reservation,
+    has_topology_assignments_pending,
     is_admitted,
     is_evicted,
     is_finished,
@@ -118,7 +119,11 @@ class WorkloadController:
                 for acs in wl.status.admission_checks:
                     acs.state = CheckState.PENDING
                 return
-            if all_checks_ready(wl) and not is_admitted(wl):
+            if all_checks_ready(wl) and not is_admitted(wl) \
+                    and not has_topology_assignments_pending(wl):
+                # reference admissionchecks.go:39 SyncAdmittedCondition:
+                # Admitted requires all delayed topology requests resolved
+                # (the scheduler's second pass assigns them).
                 set_condition(wl, COND_ADMITTED, True, "Admitted",
                               "The workload is admitted", now)
 

@@ -234,6 +234,18 @@ def all_checks_ready(wl: Workload) -> bool:
     )
 
 
+def has_topology_assignments_pending(wl: Workload) -> bool:
+    """reference workload.go:911 HasTopologyAssignmentsPending: any podset
+    assignment with a delayed topology request and no assignment yet.
+    Gates the Admitted condition and triggers the second scheduling pass."""
+    if wl.status.admission is None:
+        return False
+    return any(
+        psa.delayed_topology_request and psa.topology_assignment is None
+        for psa in wl.status.admission.pod_set_assignments
+    )
+
+
 def queue_order_timestamp(wl: Workload, eviction_ordering: bool = True) -> float:
     """GetQueueOrderTimestamp (reference pkg/workload/workload.go): the
     eviction transition time when present (and eviction ordering is on),

@@ -428,9 +428,73 @@ class Manager:
         self.tas_failure.reconcile()
         for wl in list(self.workloads.values()):
             self._sync_admission_checks(wl)
+            self._second_pass_assign(wl)
             self.workload_controller.reconcile(wl)
         self.workload_controller.requeue_ready_backoffs()
         self._update_gauges()
+
+    def _second_pass_assign(self, wl: Workload) -> None:
+        """The scheduler's second pass for delayed topology requests
+        (reference workload.go:889 NeedsSecondPass + scheduler second
+        pass): once quota is reserved and every admission check is Ready,
+        compute the topology placement that was deferred on the first pass
+        (ProvisioningRequest: the nodes exist only after provisioning).
+        MultiKueue-delayed assignments are resolved by the worker mirror
+        instead; podsets whose flavor has no local topology stay pending."""
+        from kueue_tpu.core.workload_info import (
+            all_checks_ready,
+            has_quota_reservation,
+            has_topology_assignments_pending,
+            is_admitted,
+            is_finished,
+        )
+        from kueue_tpu.tas.snapshot import PlacementRequest
+
+        if (
+            is_finished(wl)
+            or is_admitted(wl)
+            or not wl.active
+            or not has_quota_reservation(wl)
+            or not wl.status.admission_checks
+            or not all_checks_ready(wl)
+            or not has_topology_assignments_pending(wl)
+        ):
+            return
+        snapshot = self.cache.snapshot()
+        info = self.cache.workloads.get(wl.key)
+        changed = False
+        for i, psa in enumerate(wl.status.admission.pod_set_assignments):
+            if not psa.delayed_topology_request \
+                    or psa.topology_assignment is not None \
+                    or i >= len(wl.pod_sets):
+                continue
+            ps = wl.pod_sets[i]
+            tr = ps.topology_request
+            flavor = next(iter(psa.flavors.values()), None)
+            tas = snapshot.tas_flavors.get(flavor)
+            if tas is None or tr is None:
+                continue  # no local topology: stays pending (MultiKueue)
+            req = PlacementRequest(
+                count=psa.count or ps.count,
+                single_pod_requests=dict(ps.requests),
+                required_level=tr.required_level,
+                preferred_level=tr.preferred_level,
+                unconstrained=tr.unconstrained,
+                slice_size=tr.slice_size or 1,
+                slice_required_level=tr.slice_required_level,
+                slice_layers=list(getattr(tr, "slice_layers", [])),
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            )
+            assignment, _, reason = tas.find_topology_assignment(req)
+            if reason:
+                continue  # retried on the next tick
+            psa.topology_assignment = assignment
+            changed = True
+        if changed and info is not None:
+            info.sync_assignment_from_admission()
+            self.cache.add_or_update_workload(info)
+            self.metrics.inc("second_pass_assignments_total")
 
     def _update_gauges(self) -> None:
         """Gauge series (reference pkg/metrics/metrics.go:414,831,896):

@@ -191,6 +191,7 @@ class FlavorAssigner:
         enable_fair_sharing: bool = False,
         tas_flavors: Optional[Dict[str, object]] = None,
         allow_delayed_tas: bool = False,
+        delay_tas: bool = False,
     ) -> None:
         self.wl = wl
         self.cq = cq
@@ -201,6 +202,11 @@ class FlavorAssigner:
         # MultiKueue: topology placement happens on the target cluster
         # (reference delayedTopologyRequest).
         self.allow_delayed_tas = allow_delayed_tas
+        # reference tas_flavorassigner.go:106: delay placement outright —
+        # MultiKueue (worker assigns) or first pass with a
+        # ProvisioningRequest check (topology assigned after provisioning,
+        # in the scheduler's second pass).
+        self.delay_tas = delay_tas
 
     # -- public entry -------------------------------------------------------
 
@@ -325,6 +331,9 @@ class FlavorAssigner:
             ps = self.wl.obj.pod_sets[i]
             tr = ps.topology_request
             if tr is None or not psa.flavors:
+                continue
+            if self.delay_tas:
+                psa.delayed_topology_request = True
                 continue
             flavor_name = next(iter(psa.flavors.values())).name
             tas = self.tas_flavors.get(flavor_name)

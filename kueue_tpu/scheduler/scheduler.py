@@ -284,6 +284,7 @@ class Scheduler:
             enable_fair_sharing=self.fair_sharing,
             tas_flavors=snapshot.tas_flavors,
             allow_delayed_tas=self._has_multikueue_check(cq),
+            delay_tas=self._delay_tas(cq, info),
         )
         full = assigner.assign()
         mode = full.representative_mode()
@@ -543,6 +544,7 @@ class Scheduler:
                 e.info, cq, snapshot.resource_flavors,
                 tas_flavors=snapshot.tas_flavors,
                 allow_delayed_tas=self._has_multikueue_check(cq),
+                delay_tas=self._delay_tas(cq, e.info),
             )
             if not assigner.update_for_tas(
                 e.assignment, simulate_empty=False, attach=True
@@ -577,6 +579,23 @@ class Scheduler:
             ac = self.cache.admission_checks.get(ac_name)
             if ac is not None and ac.controller_name == \
                     "kueue.x-k8s.io/multikueue":
+                return True
+        return False
+
+    def _delay_tas(self, cq: ClusterQueueSnapshot, info: WorkloadInfo) -> bool:
+        """reference tas_flavorassigner.go:106: topology placement is
+        delayed outright for MultiKueue (the worker assigns), and on the
+        FIRST pass when a ProvisioningRequest check gates admission (the
+        nodes may not exist yet; the second pass assigns after
+        provisioning)."""
+        if self._has_multikueue_check(cq):
+            return True
+        if has_quota_reservation(info.obj):
+            return False
+        for ac_name in cq.spec.admission_checks:
+            ac = self.cache.admission_checks.get(ac_name)
+            if ac is not None and ac.controller_name == \
+                    "kueue.x-k8s.io/provisioning-request":
                 return True
         return False
 

@@ -776,3 +776,67 @@ def test_pods_ready_backoff_limit_deactivates():
     assert is_evicted(wl)
     mgr.schedule_all()
     assert not is_admitted(wl)
+
+
+def test_provisioning_delays_tas_until_second_pass():
+    """TAS + ProvisioningRequest (reference tas_flavorassigner.go:106 +
+    workload.go:889 NeedsSecondPass): the first pass reserves quota with
+    the topology request delayed (nodes may not exist yet); after the
+    check turns Ready the second pass computes the placement and only
+    then does the workload become Admitted."""
+    from kueue_tpu.api.types import (
+        PodSet, TopologyRequest, Workload, quota as _q,
+    )
+    from kueue_tpu.core.workload_info import (
+        has_quota_reservation as _hqr,
+        has_topology_assignments_pending,
+    )
+    from tests.test_tas import LEVELS, make_nodes, make_topology
+
+    class GatedProvider:
+        def __init__(self):
+            self.ready = False
+
+        def poll(self, request):
+            return (ProvisioningState.PROVISIONED if self.ready
+                    else ProvisioningState.PENDING)
+
+    provider = GatedProvider()
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"], admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        mgr.apply(node)
+
+    mgr.register_check_controller(ProvisioningController(provider=provider))
+    wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=2, requests={"tpu": 4},
+        topology_request=TopologyRequest(required_level=LEVELS[1]),
+    )], creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert _hqr(wl)
+    psa = wl.status.admission.pod_set_assignments[0]
+    assert psa.delayed_topology_request
+    assert psa.topology_assignment is None
+    assert has_topology_assignments_pending(wl)
+
+    mgr.tick()  # provisioning still pending
+    assert not is_admitted(wl)
+
+    provider.ready = True
+    mgr.tick()  # check Ready -> second pass assigns -> Admitted
+    ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta is not None and sum(c for _, c in ta.domains) == 2
+    assert not has_topology_assignments_pending(wl)
+    assert is_admitted(wl)
+    # The assignment is accounted: a second gang cannot take the same rack
+    # capacity beyond what exists.
+    assert mgr.metrics.get("second_pass_assignments_total") >= 1
