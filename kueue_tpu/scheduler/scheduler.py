@@ -790,4 +790,8 @@ def can_always_reclaim(cq: ClusterQueueSnapshot) -> bool:
 
 
 def has_second_pass(info: WorkloadInfo) -> bool:
-    return False  # TAS delayed-admission second pass: wired in kueue_tpu/tas.
+    """reference workload.go:889 NeedsSecondPass. Here the second pass is
+    tick-driven (Manager._second_pass_assign resolves delayed topology
+    requests; controllers/tas_failure.py handles the node-failure case),
+    so reserved workloads never re-enter the quota cycle."""
+    return False
