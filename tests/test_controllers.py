@@ -840,3 +840,51 @@ def test_provisioning_delays_tas_until_second_pass():
     # The assignment is accounted: a second gang cannot take the same rack
     # capacity beyond what exists.
     assert mgr.metrics.get("second_pass_assignments_total") >= 1
+
+
+def test_multikueue_tas_mirror_admits_manager_side():
+    """The worker's topology assignment mirrors back onto the manager's
+    delayed pod-set assignment, resolving the pending state so the
+    manager-side workload becomes Admitted (reference DelayedTopologyRequest
+    Pending -> Ready on remote sync)."""
+    from kueue_tpu.api.types import (
+        PodSet, TopologyRequest, Workload, quota as _q,
+    )
+    from kueue_tpu.core.workload_info import has_topology_assignments_pending
+    from tests.test_tas import LEVELS, make_nodes, make_topology
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": _q(32)}},
+                resources=["tpu"], admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    worker = Manager()
+    worker.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": _q(32)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        worker.apply(node)
+    mk = MultiKueueController()
+    mk.add_worker("tpu-pool", worker)
+    mgr.register_check_controller(mk)
+
+    wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=2, requests={"tpu": 4},
+        topology_request=TopologyRequest(required_level=LEVELS[1]),
+    )], creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    mgr.tick()
+    # Worker placed the gang; the manager's delayed assignment resolved.
+    local_ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    assert local_ta is not None and sum(c for _, c in local_ta.domains) == 2
+    assert not has_topology_assignments_pending(wl)
+    assert is_admitted(wl)
