@@ -268,6 +268,9 @@ def decode(doc: Dict[str, Any]):
                     },
                     count=d.get("count", 0),
                     topology_assignment=ta,
+                    delayed_topology_request=bool(
+                        d.get("delayedTopologyRequest", False)
+                    ),
                 ))
             wl.status.admission = Admission(
                 cluster_queue=adm.get("clusterQueue", ""),
@@ -533,6 +536,8 @@ def encode(obj) -> Dict[str, Any]:
                         **({"topologyAssignment": _encode_ta(
                             psa.topology_assignment
                         )} if psa.topology_assignment else {}),
+                        **({"delayedTopologyRequest": True}
+                           if psa.delayed_topology_request else {}),
                     } for psa in obj.status.admission.pod_set_assignments],
                 },
                 "conditions": [

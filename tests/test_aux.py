@@ -452,3 +452,57 @@ def test_dra_device_class_mappings():
     ])
     with pytest.raises(ValueError, match="deviceClassMappings"):
         mgr.create_workload(unmapped)
+
+
+def test_checkpoint_preserves_delayed_topology_state():
+    """A quota-reserved workload awaiting its second-pass placement
+    survives export/restore with the pending state intact (the restored
+    manager must not admit it without a topology assignment)."""
+    from kueue_tpu.api.types import (
+        AdmissionCheck, PodSet, TopologyRequest, Workload,
+    )
+    from kueue_tpu.controllers.provisioning import ProvisioningController
+    from kueue_tpu.core.workload_info import (
+        has_quota_reservation,
+        has_topology_assignments_pending,
+        is_admitted,
+    )
+    from kueue_tpu.manager import Manager
+
+    from .helpers import make_cq
+    from .test_tas import LEVELS, make_nodes, make_topology
+
+    class NeverReady:
+        def poll(self, request):
+            from kueue_tpu.controllers.provisioning import ProvisioningState
+            return ProvisioningState.PENDING
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"], admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        mgr.apply(node)
+    mgr.register_check_controller(ProvisioningController(NeverReady()))
+    wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=2, requests={"tpu": 4},
+        topology_request=TopologyRequest(required_level=LEVELS[1]),
+    )], creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert has_topology_assignments_pending(wl)
+
+    ckpt = mgr.export_state()
+    mgr2 = Manager.restore_state(ckpt)
+    mgr2.register_check_controller(ProvisioningController(NeverReady()))
+    wl2 = mgr2.workloads[wl.key]
+    assert has_quota_reservation(wl2)
+    assert has_topology_assignments_pending(wl2)
+    mgr2.tick()
+    assert not is_admitted(wl2)  # provisioning still pending
