@@ -276,11 +276,36 @@ def decode(doc: Dict[str, Any]):
                 cluster_queue=adm.get("clusterQueue", ""),
                 pod_set_assignments=psas,
             )
+        if status.get("conditions"):
             from kueue_tpu.core.workload_info import set_condition
 
             for c in status.get("conditions", []):
-                set_condition(wl, c["type"], bool(c["status"]),
+                # Reference-style manifests encode condition status as the
+                # strings "True"/"False"; our own round-trips use bools.
+                set_condition(wl, c["type"], c["status"] in (True, "True"),
                               c.get("reason", ""))
+        if status.get("admissionChecks"):
+            from kueue_tpu.api.constants import CheckState
+            from kueue_tpu.api.types import AdmissionCheckState
+
+            wl.status.admission_checks = [
+                AdmissionCheckState(
+                    name=acd["name"],
+                    state=CheckState(acd.get("state", "Pending")),
+                    message=acd.get("message", ""),
+                )
+                for acd in status["admissionChecks"]
+            ]
+        if status.get("requeueState"):
+            from kueue_tpu.api.types import RequeueState
+
+            rsd = status["requeueState"]
+            wl.status.requeue_state = RequeueState(
+                count=rsd.get("count", 0),
+                requeue_at=rsd.get("requeueAt"),
+            )
+        if status.get("clusterName"):
+            wl.status.cluster_name = status["clusterName"]
         return wl
     raise ValueError(f"unknown kind: {kind}")
 
@@ -302,9 +327,14 @@ def _podset(d: Dict[str, Any]) -> PodSet:
             required_level=tr.get("required"),
             preferred_level=tr.get("preferred"),
             unconstrained=tr.get("unconstrained", False),
+            balanced=tr.get("balanced", False),
             podset_group_name=tr.get("podSetGroupName"),
             slice_required_level=tr.get("podSetSliceRequiredTopology"),
             slice_size=tr.get("podSetSliceSize"),
+            slice_layers=[
+                (layer["topology"], layer["size"])
+                for layer in tr.get("sliceLayers", [])
+            ],
         )
     return PodSet(
         name=d.get("name", "main"),
@@ -358,6 +388,61 @@ def load_manifests(text_or_path: str) -> List[Any]:
 # ---------------------------------------------------------------------------
 # Encoding (state export / checkpoint)
 # ---------------------------------------------------------------------------
+
+
+def _encode_toleration(t) -> Dict[str, Any]:
+    return {
+        "key": t.key, "operator": t.operator,
+        **({"value": t.value} if t.value else {}),
+        **({"effect": t.effect} if t.effect else {}),
+    }
+
+
+def _encode_podset(ps) -> Dict[str, Any]:
+    """Inverse of _podset: round-trips every field _podset reads (requests,
+    deviceRequests, minCount, template.spec nodeSelector/tolerations,
+    topologyRequest incl. slice layers)."""
+    d: Dict[str, Any] = {
+        "name": ps.name,
+        "count": ps.count,
+        "requests": {r: _emit_q(r, v) for r, v in ps.requests.items()},
+    }
+    if ps.device_requests:
+        d["deviceRequests"] = dict(ps.device_requests)
+    if ps.min_count is not None:
+        d["minCount"] = ps.min_count
+    template_spec: Dict[str, Any] = {}
+    if ps.node_selector:
+        template_spec["nodeSelector"] = dict(ps.node_selector)
+    if ps.tolerations:
+        template_spec["tolerations"] = [
+            _encode_toleration(t) for t in ps.tolerations
+        ]
+    if template_spec:
+        d["template"] = {"spec": template_spec}
+    tr = ps.topology_request
+    if tr is not None:
+        trd: Dict[str, Any] = {}
+        if tr.required_level is not None:
+            trd["required"] = tr.required_level
+        if tr.preferred_level is not None:
+            trd["preferred"] = tr.preferred_level
+        if tr.unconstrained:
+            trd["unconstrained"] = True
+        if tr.balanced:
+            trd["balanced"] = True
+        if tr.podset_group_name is not None:
+            trd["podSetGroupName"] = tr.podset_group_name
+        if tr.slice_required_level is not None:
+            trd["podSetSliceRequiredTopology"] = tr.slice_required_level
+        if tr.slice_size is not None:
+            trd["podSetSliceSize"] = tr.slice_size
+        if tr.slice_layers:
+            trd["sliceLayers"] = [
+                {"topology": lv, "size": sz} for lv, sz in tr.slice_layers
+            ]
+        d["topologyRequest"] = trd
+    return d
 
 
 def _encode_ta(ta) -> Dict[str, Any]:
@@ -511,39 +596,48 @@ def encode(obj) -> Dict[str, Any]:
                 "queueName": obj.queue_name,
                 "priority": obj.priority,
                 "active": obj.active,
-                "podSets": [{
-                    "name": ps.name,
-                    "count": ps.count,
-                    "requests": {
-                        r: _emit_q(r, v) for r, v in ps.requests.items()
-                    },
-                    **({"deviceRequests": dict(ps.device_requests)}
-                       if ps.device_requests else {}),
-                    **({"minCount": ps.min_count}
-                       if ps.min_count is not None else {}),
-                } for ps in obj.pod_sets],
+                "podSets": [_encode_podset(ps) for ps in obj.pod_sets],
             },
         }
-        # Status export enables checkpoint/restore of admissions.
+        # Status export enables checkpoint/restore of admissions, pending
+        # admission-check state machines, requeue backoff, and MultiKueue
+        # placement.
+        status: Dict[str, Any] = {}
         if obj.status.admission is not None:
-            doc["status"] = {
-                "admission": {
-                    "clusterQueue": obj.status.admission.cluster_queue,
-                    "podSetAssignments": [{
-                        "name": psa.name,
-                        "flavors": dict(psa.flavors),
-                        "count": psa.count,
-                        **({"topologyAssignment": _encode_ta(
-                            psa.topology_assignment
-                        )} if psa.topology_assignment else {}),
-                        **({"delayedTopologyRequest": True}
-                           if psa.delayed_topology_request else {}),
-                    } for psa in obj.status.admission.pod_set_assignments],
-                },
-                "conditions": [
-                    {"type": c.type, "status": c.status, "reason": c.reason}
-                    for c in obj.status.conditions
-                ],
+            status["admission"] = {
+                "clusterQueue": obj.status.admission.cluster_queue,
+                "podSetAssignments": [{
+                    "name": psa.name,
+                    "flavors": dict(psa.flavors),
+                    "count": psa.count,
+                    **({"topologyAssignment": _encode_ta(
+                        psa.topology_assignment
+                    )} if psa.topology_assignment else {}),
+                    **({"delayedTopologyRequest": True}
+                       if psa.delayed_topology_request else {}),
+                } for psa in obj.status.admission.pod_set_assignments],
             }
+        if obj.status.conditions:
+            status["conditions"] = [
+                {"type": c.type, "status": c.status, "reason": c.reason}
+                for c in obj.status.conditions
+            ]
+        if obj.status.admission_checks:
+            status["admissionChecks"] = [{
+                "name": acs.name,
+                "state": acs.state.value,
+                **({"message": acs.message} if acs.message else {}),
+            } for acs in obj.status.admission_checks]
+        if obj.status.requeue_state is not None:
+            rs = obj.status.requeue_state
+            status["requeueState"] = {
+                "count": rs.count,
+                **({"requeueAt": rs.requeue_at}
+                   if rs.requeue_at is not None else {}),
+            }
+        if obj.status.cluster_name:
+            status["clusterName"] = obj.status.cluster_name
+        if status:
+            doc["status"] = status
         return doc
     raise TypeError(f"cannot encode {type(obj)!r}")

@@ -160,7 +160,17 @@ class MultiKueueController(AdmissionCheckController):
         remote status sync + failurerecovery redispatch)."""
         st = self.state.get(wl.key)
         if st is None or st.winner is None:
-            return
+            # Controller state is in-memory only; after a checkpoint restore
+            # rebuild it from the persisted placement (status.clusterName) so
+            # worker-lost redispatch and remote status mirroring keep working
+            # for previously dispatched workloads.
+            if wl.status.cluster_name:
+                st = self.state.setdefault(wl.key, _GroupState())
+                st.winner = wl.status.cluster_name
+                if st.winner not in st.nominated:
+                    st.nominated.append(st.winner)
+            else:
+                return
         now = manager.clock()
         worker = self.workers.get(st.winner)
         remote = worker.workloads.get(wl.key) if worker is not None else None

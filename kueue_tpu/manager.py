@@ -428,6 +428,7 @@ class Manager:
         self.tas_failure.reconcile()
         for wl in list(self.workloads.values()):
             self._sync_admission_checks(wl)
+            self._sync_remote_status(wl)
             self._second_pass_assign(wl)
             self.workload_controller.reconcile(wl)
         self.workload_controller.requeue_ready_backoffs()
@@ -638,6 +639,21 @@ class Manager:
             ctrl = self.check_controllers.get(ac.controller_name)
             if ctrl is not None:
                 ctrl.sync(self, wl, acs.name)
+
+    def _sync_remote_status(self, wl: Workload) -> None:
+        """Clock-driven remote mirroring for controllers that track a
+        workload on another cluster (MultiKueue: completion/eviction
+        mirror-back, worker-lost redispatch)."""
+        seen = set()
+        for acs in wl.status.admission_checks:
+            ac = self.cache.admission_checks.get(acs.name)
+            if ac is None or ac.controller_name in seen:
+                continue
+            seen.add(ac.controller_name)
+            ctrl = self.check_controllers.get(ac.controller_name)
+            hook = getattr(ctrl, "sync_remote_status", None)
+            if hook is not None:
+                hook(self, wl)
 
     def _reconcile_touched_jobs(self, result: CycleResult) -> None:
         touched = set(result.admitted) | set(result.preempted) | set(

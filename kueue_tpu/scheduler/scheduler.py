@@ -572,7 +572,6 @@ class Scheduler:
 
         e.status = EntryStatus.NOMINATED
         self._admit(e, cq)
-        result_status = e.status  # ASSUMED on success
 
     def _has_multikueue_check(self, cq: ClusterQueueSnapshot) -> bool:
         for ac_name in cq.spec.admission_checks:

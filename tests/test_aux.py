@@ -506,3 +506,177 @@ def test_checkpoint_preserves_delayed_topology_state():
     assert has_topology_assignments_pending(wl2)
     mgr2.tick()
     assert not is_admitted(wl2)  # provisioning still pending
+
+
+def test_checkpoint_resolves_second_pass_after_restore():
+    """Round-trip the full pending-TAS state: a quota-reserved workload
+    whose provisioning completes only AFTER restore must still get its
+    delayed second-pass topology assignment and become Admitted — this
+    exercises the podSet topologyRequest and status.admissionChecks
+    serialization (a checkpoint dropping either wedges the workload)."""
+    from kueue_tpu.api.types import (
+        AdmissionCheck, PodSet, TopologyRequest, Workload,
+    )
+    from kueue_tpu.controllers.provisioning import (
+        ProvisioningController, ProvisioningState,
+    )
+    from kueue_tpu.core.workload_info import (
+        has_quota_reservation,
+        has_topology_assignments_pending,
+        is_admitted,
+    )
+    from kueue_tpu.manager import Manager
+
+    from .helpers import make_cq
+    from .test_tas import LEVELS, make_nodes, make_topology
+
+    class Gated:
+        ready = False
+
+        def poll(self, request):
+            return (ProvisioningState.PROVISIONED if self.ready
+                    else ProvisioningState.PENDING)
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"], admission_checks=["prov"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="prov",
+                       controller_name="kueue.x-k8s.io/provisioning-request"),
+        make_topology(),
+    )
+    for node in make_nodes():
+        mgr.apply(node)
+    mgr.register_check_controller(ProvisioningController(Gated()))
+    wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=2, requests={"tpu": 4},
+        topology_request=TopologyRequest(required_level=LEVELS[1]),
+    )], creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert has_quota_reservation(wl)
+    assert has_topology_assignments_pending(wl)
+    assert wl.status.admission_checks, "check states must exist pre-restore"
+
+    mgr2 = Manager.restore_state(mgr.export_state())
+    wl2 = mgr2.workloads[wl.key]
+    # The pending check state machine survived the checkpoint.
+    assert [a.name for a in wl2.status.admission_checks] == ["prov"]
+    # The topology constraint survived on the spec.
+    assert wl2.pod_sets[0].topology_request is not None
+    assert wl2.pod_sets[0].topology_request.required_level == LEVELS[1]
+
+    provider = Gated()
+    provider.ready = True
+    mgr2.register_check_controller(ProvisioningController(provider))
+    for _ in range(3):
+        mgr2.tick()
+    assert is_admitted(wl2), "restored workload must resolve once provisioned"
+    psa = wl2.status.admission.pod_set_assignments[0]
+    assert psa.topology_assignment is not None
+    assert sum(c for _, c in psa.topology_assignment.domains) == 2
+
+
+def test_podset_spec_encode_roundtrip():
+    """topologyRequest / nodeSelector / tolerations survive encode+decode."""
+    from kueue_tpu.api.serialization import decode, encode
+    from kueue_tpu.api.types import (
+        PodSet, Toleration, TopologyRequest, Workload,
+    )
+
+    wl = Workload(name="w", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=8, requests={"tpu": 4},
+        node_selector={"pool": "tpu-v5e"},
+        tolerations=[Toleration(key="tpu", operator="Exists",
+                                effect="NoSchedule")],
+        topology_request=TopologyRequest(
+            required_level="rack", balanced=True,
+            slice_required_level="host", slice_size=4,
+            slice_layers=[("board", 2)],
+        ),
+    )])
+    back = decode(encode(wl))
+    ps = back.pod_sets[0]
+    assert ps.node_selector == {"pool": "tpu-v5e"}
+    assert ps.tolerations[0].key == "tpu"
+    assert ps.tolerations[0].operator == "Exists"
+    tr = ps.topology_request
+    assert tr.required_level == "rack" and tr.balanced
+    assert tr.slice_required_level == "host" and tr.slice_size == 4
+    assert tr.slice_layers == [("board", 2)]
+
+
+def test_condition_status_string_decode():
+    """Reference manifests encode condition status as "True"/"False"
+    strings; "False" must not parse as truthy."""
+    from kueue_tpu.api.serialization import decode
+
+    doc = {
+        "kind": "Workload",
+        "metadata": {"name": "w"},
+        "spec": {"queueName": "lq", "podSets": []},
+        "status": {"conditions": [
+            {"type": "QuotaReserved", "status": "False", "reason": "x"},
+            {"type": "Admitted", "status": "True", "reason": "y"},
+        ]},
+    }
+    wl = decode(doc)
+    by_type = {c.type: c.status for c in wl.status.conditions}
+    assert by_type == {"QuotaReserved": False, "Admitted": True}
+
+
+def test_multikueue_state_rebuilt_after_restore():
+    """MultiKueue dispatch state survives restore via status.clusterName:
+    remote finish must mirror back on a restored manager."""
+    from kueue_tpu.api.types import AdmissionCheck, Workload, PodSet
+    from kueue_tpu.controllers.multikueue import MultiKueueController
+    from kueue_tpu.core.workload_info import is_admitted, is_finished
+    from kueue_tpu.manager import Manager
+
+    from .helpers import make_cq
+
+    def worker():
+        m = Manager()
+        m.apply(
+            ResourceFlavor(name="default"),
+            make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}}),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+        )
+        return m
+
+    hub = Manager()
+    hub.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    mk = MultiKueueController()
+    w1 = worker()
+    mk.add_worker("west", w1)
+    hub.register_check_controller(mk)
+    wl = Workload(name="job", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1, requests={"cpu": 1000})])
+    hub.create_workload(wl)
+    hub.schedule_all()
+    hub.tick()
+    assert is_admitted(wl) and wl.status.cluster_name == "west"
+
+    # Restore the hub; the controller is fresh (empty in-memory state), the
+    # worker connection is re-registered as it would be on process start.
+    hub2 = Manager.restore_state(hub.export_state())
+    mk2 = MultiKueueController()
+    mk2.add_worker("west", w1)
+    hub2.register_check_controller(mk2)
+    wl2 = hub2.workloads[wl.key]
+    assert wl2.status.cluster_name == "west"
+
+    remote = w1.workloads[wl.key]
+    w1.finish_workload(remote)
+    for _ in range(2):
+        hub2.tick()
+    assert is_finished(wl2), "remote completion must mirror after restore"
