@@ -49,6 +49,7 @@ from kueue_tpu.ops.quota_ops import (
 P_NOFIT = 0
 P_NO_CANDIDATES = 1
 P_PREEMPT_RAW = 2  # preemption possible; oracle outcome unknown on device
+P_PREEMPT_OK = 3  # device-resolved preemption with a victim set
 P_FIT = 4
 
 OUT_NOFIT = 0
@@ -56,6 +57,7 @@ OUT_NO_CANDIDATES = 1
 OUT_NEEDS_HOST = 2
 OUT_FIT_SKIPPED = 3
 OUT_ADMITTED = 4
+OUT_PREEMPTING = 5  # victims designated; entry waits for their eviction
 
 _BIG = jnp.int64(1) << 40
 _NEG_INF = -(jnp.int64(1) << 60)
@@ -67,6 +69,10 @@ class NominateResult(NamedTuple):
     best_borrow: jnp.ndarray  # i32[W]
     needs_host: jnp.ndarray  # bool[W]
     tried_flavor_idx: jnp.ndarray  # i32[W] (-1 = wrapped)
+    # Device-preemption eligibility signals (see models/preempt_kernel.py):
+    praw_count: jnp.ndarray  # i32[W] flavors seen with raw preempt mode
+    praw_stop: jnp.ndarray  # bool[W] scan stopped at a raw-preempt flavor
+    considered: jnp.ndarray  # i32[W] flavors considered by the scan
 
 
 class CycleOutputs(NamedTuple):
@@ -76,6 +82,9 @@ class CycleOutputs(NamedTuple):
     tried_flavor_idx: jnp.ndarray  # i32[W]
     usage: jnp.ndarray  # i64[N,F,R] post-cycle
     order: jnp.ndarray  # i32[W] processing order (diagnostics)
+    # Device-preemption outputs (None on the no-preempt kernels).
+    victims: jnp.ndarray = None  # bool[W,A] victim set of OUT_PREEMPTING rows
+    victim_variant: jnp.ndarray = None  # i32[W,A] preemption reason codes
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -211,7 +220,8 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
         k_n = arrays.flavor_at.shape[1]
 
         def body(carry, k):
-            best_score, best_f, best_pm, best_bw, stopped, seen_praw, att = carry
+            (best_score, best_f, best_pm, best_bw, stopped, seen_praw, att,
+             praw_n, praw_stop, n_cons) = carry
             k = k.astype(jnp.int32)
             f = arrays.flavor_at[c, k]
             pos_valid = (k < arrays.n_flavors[c]) & (k >= start_k)
@@ -220,7 +230,10 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
             sc = rep_score[f]
             consider = pos_valid & ~stopped
             att = jnp.where(consider, k, att)
-            seen_praw = seen_praw | (consider & (pm == P_PREEMPT_RAW))
+            is_praw = consider & (pm == P_PREEMPT_RAW)
+            seen_praw = seen_praw | is_praw
+            praw_n = praw_n + is_praw.astype(jnp.int32)
+            n_cons = n_cons + consider.astype(jnp.int32)
 
             should_try_next = (
                 (pm == P_NOFIT)
@@ -229,6 +242,7 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
                 | ((bw > 0) & arrays.when_can_borrow_try_next[c])
             )
             stop_here = consider & ~should_try_next
+            praw_stop = praw_stop | (stop_here & (pm == P_PREEMPT_RAW))
             preferred = consider & (sc > best_score)
             take = stop_here | (preferred & ~stop_here)
             best_score = jnp.where(take, sc, best_score)
@@ -237,25 +251,27 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
             best_bw = jnp.where(take, bw, best_bw)
             stopped = stopped | stop_here
             return (best_score, best_f, best_pm, best_bw, stopped, seen_praw,
-                    att), None
+                    att, praw_n, praw_stop, n_cons), None
 
         init = (
             _NEG_INF, jnp.int32(-1), jnp.int32(P_NOFIT), jnp.int32(0),
             jnp.bool_(False), jnp.bool_(False), jnp.int32(-1),
+            jnp.int32(0), jnp.bool_(False), jnp.int32(0),
         )
-        (b_score, b_f, b_pm, b_bw, _stopped, seen_praw, att), _ = jax.lax.scan(
-            body, init, jnp.arange(k_n)
-        )
+        (b_score, b_f, b_pm, b_bw, _stopped, seen_praw, att, praw_n,
+         praw_stop, n_cons), _ = jax.lax.scan(body, init, jnp.arange(k_n))
         needs_host = (seen_praw | (b_pm == P_PREEMPT_RAW)) & active
         tried = jnp.where(att == arrays.n_flavors[c] - 1, -1, att)
         b_pm = jnp.where(active, b_pm, P_NOFIT)
-        return b_f, b_pm, b_bw, needs_host, tried
+        return b_f, b_pm, b_bw, needs_host, tried, praw_n, praw_stop, n_cons
 
-    chosen, pmode, borrow, needs_host, tried = jax.vmap(per_workload)(
+    (chosen, pmode, borrow, needs_host, tried, praw_n, praw_stop,
+     n_cons) = jax.vmap(per_workload)(
         arrays.w_cq, arrays.w_req, arrays.w_elig, arrays.w_start_flavor,
         arrays.w_active, arrays.w_priority,
     )
-    return NominateResult(chosen, pmode, borrow, needs_host, tried)
+    return NominateResult(chosen, pmode, borrow, needs_host, tried,
+                          praw_n, praw_stop, n_cons)
 
 
 def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
@@ -446,7 +462,9 @@ def admit_scan_grouped(
     usage: jnp.ndarray,
     order: jnp.ndarray,
     s_max: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    adm=None,
+    targets=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forest-parallel admission scan.
 
     Cohort trees share no quota cells, so sequential consistency is only
@@ -455,6 +473,15 @@ def admit_scan_grouped(
     vectorized across all G groups — scan length max-entries-per-group
     instead of W. Entries beyond ``s_max`` slots in one group are left
     undecided this cycle (requeued; exactness needs s_max >= max bucket).
+
+    With ``adm``/``targets`` (device preemption), the scan additionally
+    tracks the designated-victim set: every fit check simulates removal of
+    all victims designated so far plus the entry's own targets (the host's
+    scheduler.go fits()), P_PREEMPT_OK entries with non-overlapping targets
+    reserve their usage and designate their victims, and overlapping ones
+    are skipped (scheduler.go:385 _process_entry).
+
+    Returns (final_usage, admitted bool[W], preempting bool[W]).
     """
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
@@ -462,6 +489,25 @@ def admit_scan_grouped(
     f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
     f_onehot = jnp.arange(f_n)
     g_iota = jnp.arange(g_n)
+    with_preempt = targets is not None
+
+    if with_preempt:
+        a_n = adm.cq.shape[0]
+        usage_by_f = jnp.swapaxes(adm.usage, 0, 1)  # [F,A,R]
+        # in_sub[b, d]: node b lies on d's ancestor chain (victim usage at
+        # CQ d reduces availability at every such b; full subtraction is
+        # exact because preempt-eligible trees have no lending limits).
+        parent_n = jnp.where(
+            tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent
+        )
+        cols = [jnp.arange(tree.n_nodes)]
+        for _ in range(MAX_DEPTH):
+            cols.append(parent_n[cols[-1]])
+        chain_n = jnp.stack(cols, axis=1)  # [N, D+1]
+        in_sub = jnp.zeros((tree.n_nodes, tree.n_nodes), bool).at[
+            chain_n.ravel(),
+            jnp.repeat(jnp.arange(tree.n_nodes), MAX_DEPTH + 1),
+        ].set(True)
 
     # Grouped static tensors [G,Nm,F,R] (usage-independent, hoisted).
     def to_g(x, pad):
@@ -495,7 +541,8 @@ def admit_scan_grouped(
     )
     chain_is_repeat = ga.chain_local == chain_next  # [G,Nm,D+1]
 
-    def body(usage_g, s):
+    def body(carry, s):
+        usage_g, designated = carry
         pos = starts + s
         in_range = s < counts
         w = grouped_order[jnp.clip(pos, 0, w_n - 1)]  # [G]
@@ -527,17 +574,53 @@ def admit_scan_grouped(
         used_in_parent = jnp.maximum(0, sat_sub(u, lq))
         with_max = sat_add(sat_sub(stored, used_in_parent), bl)
 
-        avail = sat_sub(subtree[:, MAX_DEPTH], u[:, MAX_DEPTH])  # [G,F,R]
+        # Victim-adjusted usage for the availability walk: simulate the
+        # removal of every designated victim plus this entry's own targets
+        # (scheduler.go fits() -> SimulateWorkloadRemoval). Only the
+        # entry's flavor plane matters — its cells are all on flavor f.
+        if with_preempt:
+            my_vict = targets.victims[w]  # [G,A]
+            preempting = valid & (pm == P_PREEMPT_OK)
+            overlap = preempting & jnp.any(
+                my_vict & designated[None, :], axis=1
+            )
+            use_vict = designated[None, :] | jnp.where(
+                (preempting & ~overlap)[:, None], my_vict, False
+            )  # [G,A]
+            fcl = jnp.clip(f, 0, f_n - 1)
+            au_f = usage_by_f[fcl]  # [G,A,R]
+            chain_flat = ga.node_sel[gi, chain]  # [G,D+1] flat node ids
+            rem_levels = []
+            for i in range(MAX_DEPTH + 1):
+                on_chain = in_sub[chain_flat[:, i]][:, adm.cq]  # [G,A]
+                mask_i = (use_vict & on_chain).astype(jnp.int64)
+                rem_levels.append(jnp.einsum("ga,gar->gr", mask_i, au_f))
+            rem = jnp.stack(rem_levels, axis=1)  # [G,D+1,R]
+            f_plane = (
+                f_onehot[None, None, :, None] == fcl[:, None, None, None]
+            )
+            u_fit = u - jnp.where(f_plane, rem[:, :, None, :], 0)
+        else:
+            my_vict = None
+            preempting = jnp.zeros(g_n, bool)
+            overlap = jnp.zeros(g_n, bool)
+            u_fit = u
+
+        l_avail_fit = jnp.maximum(0, sat_sub(lq, u_fit))
+        used_in_parent_fit = jnp.maximum(0, sat_sub(u_fit, lq))
+        with_max_fit = sat_add(sat_sub(stored, used_in_parent_fit), bl)
+        avail = sat_sub(subtree[:, MAX_DEPTH], u_fit[:, MAX_DEPTH])
         for i in range(MAX_DEPTH - 1, -1, -1):
             clamped = jnp.where(
-                has_bl[:, i], jnp.minimum(with_max[:, i], avail), avail
+                has_bl[:, i], jnp.minimum(with_max_fit[:, i], avail), avail
             )
-            stepped = sat_add(l_avail[:, i], clamped)
+            stepped = sat_add(l_avail_fit[:, i], clamped)
             avail = jnp.where(is_repeat[:, i, None, None], avail, stepped)
 
         fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))  # [G]
         deferred = nom.needs_host[w]
         admit = valid & (pm == P_FIT) & fits & ~deferred
+        preempt_ok = preempting & ~overlap & fits & ~deferred
 
         borrowing = nom.best_borrow[w] > 0
         nom_c = nominal_g[gi, c_local[:, None]][:, 0]  # [G,F,R]
@@ -560,8 +643,11 @@ def admit_scan_grouped(
             & ~deferred
         )
 
+        # Both admitted FIT entries and proceeding preemptors consume their
+        # usage (scheduler.go:561 cq.AddUsage runs for either mode).
+        take_usage = admit | preempt_ok
         applied = jnp.where(
-            admit[:, None, None],
+            take_usage[:, None, None],
             delta,
             jnp.where(do_reserve[:, None, None], reserve, 0),
         )
@@ -576,34 +662,45 @@ def admit_scan_grouped(
         new_usage_g = quota_ops.sat(
             usage_g.at[gi, chain].add(deltas, mode="drop")
         )
-        w_out = jnp.where(admit, w, w_n)  # w_n = dropped by scatter
-        return new_usage_g, (w_out, admit)
+        if with_preempt:
+            designated = designated | jnp.any(
+                jnp.where(preempt_ok[:, None], my_vict, False), axis=0
+            )
+        w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
+        return (new_usage_g, designated), (w_out, admit, preempt_ok)
 
-    final_usage_g, (w_mat, admit_mat) = jax.lax.scan(
-        body, usage_g, jnp.arange(s_max), unroll=2
+    designated0 = (
+        jnp.zeros(a_n, bool) if with_preempt else jnp.zeros(1, bool)
+    )
+    (final_usage_g, _designated), (w_mat, admit_mat, pre_mat) = jax.lax.scan(
+        body, (usage_g, designated0), jnp.arange(s_max), unroll=2
     )
     admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
         admit_mat.ravel(), mode="drop"
+    )[:w_n]
+    preempting_out = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
+        pre_mat.ravel(), mode="drop"
     )[:w_n]
     # Back to flat node layout.
     final_usage = final_usage_g[ga.flat_to_group, ga.flat_to_local]
     final_usage = jnp.where(
         tree.active[:, None, None], final_usage, usage
     )
-    return final_usage, admitted
+    return final_usage, admitted, preempting_out
 
 
-def make_grouped_cycle(s_max: int = 0):
-    """Build a jittable grouped cycle; s_max=0 means exact (W slots)."""
+def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
+    """Build a jittable grouped cycle; s_max=0 means exact (W slots).
 
-    def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
-        usage = arrays.usage
-        nom = nominate(arrays, usage)
-        order = admission_order(arrays, nom)
-        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        final_usage, admitted = admit_scan_grouped(
-            arrays, ga, nom, usage, order, s
-        )
+    With ``preempt=True`` the cycle takes a third AdmittedArrays argument
+    and resolves classical preemption on device for eligible entries
+    (models/preempt_kernel.py): the oracle + full victim search run in the
+    nomination phase against cycle-start usage (matching scheduler.go:629),
+    resolved entries get exact pmodes/borrows for the admission order, and
+    the scan designates victims with overlap/fit semantics."""
+
+    def finish(arrays, nom, final_usage, admitted, preempting, order,
+               victims=None, variant=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -614,12 +711,20 @@ def make_grouped_cycle(s_max: int = 0):
                     admitted,
                     OUT_ADMITTED,
                     jnp.where(
-                        nom.best_pmode == P_FIT,
-                        OUT_FIT_SKIPPED,
+                        preempting,
+                        OUT_PREEMPTING,
                         jnp.where(
-                            nom.best_pmode == P_NO_CANDIDATES,
-                            OUT_NO_CANDIDATES,
-                            OUT_NOFIT,
+                            nom.best_pmode == P_FIT,
+                            OUT_FIT_SKIPPED,
+                            jnp.where(
+                                nom.best_pmode == P_PREEMPT_OK,
+                                OUT_FIT_SKIPPED,
+                                jnp.where(
+                                    nom.best_pmode == P_NO_CANDIDATES,
+                                    OUT_NO_CANDIDATES,
+                                    OUT_NOFIT,
+                                ),
+                            ),
                         ),
                     ),
                 ),
@@ -632,12 +737,69 @@ def make_grouped_cycle(s_max: int = 0):
             tried_flavor_idx=nom.tried_flavor_idx,
             usage=final_usage,
             order=order,
+            victims=victims,
+            victim_variant=variant,
         )
 
-    return impl
+    if not preempt:
+        def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
+            usage = arrays.usage
+            nom = nominate(arrays, usage)
+            order = admission_order(arrays, nom)
+            s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+            final_usage, admitted, preempting = admit_scan_grouped(
+                arrays, ga, nom, usage, order, s
+            )
+            return finish(arrays, nom, final_usage, admitted, preempting,
+                          order)
+
+        return impl
+
+    from kueue_tpu.models.preempt_kernel import preempt_targets
+
+    def impl_preempt(arrays: CycleArrays, ga: GroupArrays,
+                     adm) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage)
+        # Structural eligibility for on-device oracle resolution: exactly
+        # one flavor with raw preempt mode, and the fungibility scan's
+        # choice is independent of the oracle outcome (it stopped at that
+        # flavor, or there was only one to consider).
+        elig = (
+            arrays.w_active
+            & (nom.best_pmode == P_PREEMPT_RAW)
+            & (nom.praw_count == 1)
+            & arrays.preempt_simple[arrays.w_cq]
+            & ~arrays.w_has_gates
+        )
+        tgt = preempt_targets(
+            arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
+            nom.considered,
+        )
+        nom = nom._replace(
+            best_pmode=jnp.where(
+                tgt.success, P_PREEMPT_OK,
+                jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
+                          nom.best_pmode),
+            ),
+            best_borrow=jnp.where(
+                tgt.resolved, tgt.borrow_after, nom.best_borrow
+            ),
+            needs_host=nom.needs_host & ~tgt.resolved,
+        )
+        order = admission_order(arrays, nom)
+        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+        final_usage, admitted, preempting = admit_scan_grouped(
+            arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
+        )
+        return finish(arrays, nom, final_usage, admitted, preempting, order,
+                      victims=tgt.victims, variant=tgt.variant)
+
+    return impl_preempt
 
 
 cycle_grouped = jax.jit(make_grouped_cycle())
+cycle_grouped_preempt = jax.jit(make_grouped_cycle(preempt=True))
 
 
 # ---------------------------------------------------------------------------

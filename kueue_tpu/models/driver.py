@@ -75,16 +75,18 @@ class DeviceScheduler:
             bucket *= 2
         arrays, idx = encode_cycle(
             snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
-            fair_sharing=self.fair_sharing,
+            fair_sharing=self.fair_sharing, preempt=True,
         )
 
         host_entries: List[WorkloadInfo] = list(idx.host_fallback)
 
         if idx.workloads:
             t0 = self.clock()
-            # Default kernel: forest-grouped scan. The fixed-point kernel
-            # (exact for no-lending-limit trees) is opt-in until TPU
-            # measurements establish the crossover; bench.py probes both.
+            # Default kernel: forest-grouped scan with on-device classical
+            # preemption. The fixed-point kernel (exact for
+            # no-lending-limit trees, no device preemption) is opt-in until
+            # TPU measurements establish the crossover; bench.py probes
+            # both.
             if self.use_fixedpoint and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
@@ -92,10 +94,19 @@ class DeviceScheduler:
                     arrays, idx.group_arrays
                 )
             else:
-                out = batch_scheduler.cycle_grouped(arrays, idx.group_arrays)
+                out = batch_scheduler.cycle_grouped_preempt(
+                    arrays, idx.group_arrays, idx.admitted_arrays
+                )
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)
+            victims = (
+                np.asarray(out.victims) if out.victims is not None else None
+            )
+            variants = (
+                np.asarray(out.victim_variant)
+                if out.victim_variant is not None else None
+            )
             self.device_time_s += self.clock() - t0
 
             for i, info in enumerate(idx.workloads):
@@ -106,6 +117,11 @@ class DeviceScheduler:
                         snapshot,
                     )
                     result.admitted.append(info.key)
+                elif oc == batch_scheduler.OUT_PREEMPTING:
+                    self._apply_preempting(
+                        info, victims[i], variants[i], idx, int(tried[i]),
+                        snapshot, result,
+                    )
                 elif oc == batch_scheduler.OUT_NEEDS_HOST:
                     host_entries.append(info)
                 else:
@@ -206,6 +222,50 @@ class DeviceScheduler:
             set_condition(wl, COND_ADMITTED, True, "Admitted",
                           "The workload is admitted", now)
         self.cache.assume_workload(info)
+
+    def _apply_preempting(
+        self,
+        info: WorkloadInfo,
+        victim_row: np.ndarray,
+        variant_row: np.ndarray,
+        idx,
+        tried_idx: int,
+        snapshot,
+        result: CycleResult,
+    ) -> None:
+        """Issue the device-designated preemptions and requeue the
+        preemptor (host analog: scheduler.go _issue_preemptions +
+        _requeue_and_update for a PREEMPTING entry)."""
+        from kueue_tpu.api.constants import (
+            EVICTED_BY_PREEMPTION,
+            IN_CLUSTER_QUEUE_REASON,
+            IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+            IN_COHORT_RECLAMATION_REASON,
+        )
+
+        reasons = {
+            1: IN_CLUSTER_QUEUE_REASON,
+            2: IN_COHORT_RECLAMATION_REASON,
+            3: IN_COHORT_RECLAMATION_REASON,
+            4: IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+        }
+        for a in np.flatnonzero(victim_row):
+            victim = idx.admitted[a]
+            self.host.evict_fn(
+                victim, EVICTED_BY_PREEMPTION,
+                reasons.get(int(variant_row[a]), IN_COHORT_RECLAMATION_REASON),
+            )
+            result.preempted.append(victim.key)
+        result.preempting.append(info.key)
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        ps = info.total_requests[0]
+        info.last_assignment = AssignmentClusterQueueState(
+            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+            cluster_queue_generation=cqs.allocatable_generation,
+        )
+        self.queues.requeue_workload(
+            info, RequeueReason.FAILED_AFTER_NOMINATION
+        )
 
     def _apply_requeue(
         self, info: WorkloadInfo, outcome: int, tried_idx: int, snapshot

@@ -65,6 +65,15 @@ class CycleArrays(NamedTuple):
     w_timestamp: jnp.ndarray  # f64[W]
     w_quota_reserved: jnp.ndarray  # bool[W] second-pass entries first
     w_start_flavor: jnp.ndarray  # i32[W] NextFlavorToTry resume index
+    # -- device preemption (None when the preempt path is not encoded) --
+    # borrowWithinCohort policy code (0=Never, 1=LowerPriority) + threshold.
+    bwc_policy: Optional[jnp.ndarray] = None  # i32[N]
+    bwc_threshold: Optional[jnp.ndarray] = None  # i64[N]
+    bwc_has_threshold: Optional[jnp.ndarray] = None  # bool[N]
+    # CQ is in a flat no-lending-limit tree whose admitted set is fully
+    # device-representable: classical victim search can run on device.
+    preempt_simple: Optional[jnp.ndarray] = None  # bool[N]
+    w_has_gates: Optional[jnp.ndarray] = None  # bool[W] preemptionGates open
 
 
 @dataclass
@@ -77,6 +86,9 @@ class CycleIndex:
     resources: List[str] = field(default_factory=list)
     flavors: List[str] = field(default_factory=list)
     group_arrays: object = None  # batch_scheduler.GroupArrays
+    # Admitted candidates row order (device preemption victim decode).
+    admitted: List[WorkloadInfo] = field(default_factory=list)
+    admitted_arrays: object = None  # preempt_kernel.AdmittedArrays
 
 
 def _round_up(n: int, m: int) -> int:
@@ -89,8 +101,14 @@ def encode_cycle(
     resource_flavors: Dict[str, object],
     w_pad: int = 0,
     fair_sharing: bool = False,
+    preempt: bool = False,
 ) -> Tuple[CycleArrays, CycleIndex]:
-    """Build CycleArrays from the host snapshot + pending heads."""
+    """Build CycleArrays from the host snapshot + pending heads.
+
+    With ``preempt=True`` also encodes the admitted-candidate arrays and
+    per-CQ preemption policy fields consumed by the device victim-selection
+    kernel (models/preempt_kernel.py); the resulting CycleArrays must then
+    be paired with the AdmittedArrays returned via ``encode_admitted``."""
     tree, tidx, usage, is_cq = encode_tree(snapshot.roots)
     n = tree.n_nodes
     f = tree.nominal.shape[1]
@@ -118,6 +136,9 @@ def encode_cycle(
     can_always_reclaim = np.zeros(n, dtype=bool)
     policy_within = np.zeros(n, dtype=np.int32)
     policy_reclaim = np.zeros(n, dtype=np.int32)
+    bwc_policy = np.zeros(n, dtype=np.int32)
+    bwc_threshold = np.zeros(n, dtype=np.int64)
+    bwc_has_threshold = np.zeros(n, dtype=bool)
 
     single_rg_cq: Dict[str, bool] = {}
     for name, cqs in snapshot.cluster_queues.items():
@@ -172,6 +193,13 @@ def encode_cycle(
         }
         policy_within[ni] = _pol[p.within_cluster_queue]
         policy_reclaim[ni] = _pol[p.reclaim_within_cohort]
+        bwc_policy[ni] = (
+            0 if p.borrow_within_cohort.policy == BorrowWithinCohortPolicy.NEVER
+            else 1
+        )
+        thr = p.borrow_within_cohort.max_priority_threshold
+        bwc_has_threshold[ni] = thr is not None
+        bwc_threshold[ni] = thr if thr is not None else 0
 
     # Admitted usage bucketed by priority rank (preemption prefilter).
     B = 8
@@ -216,6 +244,7 @@ def encode_cycle(
     w_timestamp = np.zeros(w, dtype=np.float64)
     w_qr = np.zeros(w, dtype=bool)
     w_start = np.zeros(w, dtype=np.int32)
+    w_gates = np.zeros(w, dtype=bool)
 
     from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
 
@@ -228,6 +257,7 @@ def encode_cycle(
         w_priority[i] = info.priority()
         w_timestamp[i] = queue_order_timestamp(info.obj)
         w_qr[i] = has_quota_reservation(info.obj)
+        w_gates[i] = bool(info.obj.preemption_gates)
         ps = info.total_requests[0]
         for res, v in ps.requests.items():
             if res in tidx.resource_of:
@@ -243,13 +273,29 @@ def encode_cycle(
             cqs.allocatable_generation
             <= info.last_assignment.cluster_queue_generation
         ):
-            res0 = idx.resources[0] if idx.resources else ""
+            # Resume keys exist only for resources the workload requests
+            # (single resource group -> same index for all of them).
+            res_keys = [r for r in ps.requests if r in tidx.resource_of]
+            res0 = res_keys[0] if res_keys else ""
             w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
 
     layout = GroupLayout(np.asarray(tree.parent), np.asarray(tree.active))
     from kueue_tpu.models.batch_scheduler import GroupArrays
 
     idx.group_arrays = GroupArrays(*layout.as_jax())
+
+    preempt_fields: Dict[str, object] = {}
+    if preempt:
+        preempt_simple = _encode_admitted(
+            snapshot, tidx, tree, idx, fair_sharing
+        )
+        preempt_fields = dict(
+            bwc_policy=jnp.asarray(bwc_policy),
+            bwc_threshold=jnp.asarray(bwc_threshold),
+            bwc_has_threshold=jnp.asarray(bwc_has_threshold),
+            preempt_simple=jnp.asarray(preempt_simple),
+            w_has_gates=jnp.asarray(w_gates),
+        )
 
     arrays = CycleArrays(
         tree=tree,
@@ -277,8 +323,102 @@ def encode_cycle(
         w_timestamp=jnp.asarray(w_timestamp),
         w_quota_reserved=jnp.asarray(w_qr),
         w_start_flavor=jnp.asarray(w_start),
+        **preempt_fields,
     )
     return arrays, idx
+
+
+def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
+    """Build the admitted-candidate arrays (preempt_kernel.AdmittedArrays)
+    and the per-CQ ``preempt_simple`` flag.
+
+    A CQ's entries may use device victim selection only when the whole
+    cohort tree is "simple": flat (root's children are all CQs, matching the
+    single-LCA classical search), free of lending limits (usage bubbles
+    fully so removal math is closed-form), fair sharing off, and every
+    admitted workload's usage maps onto the encoded [F, R] cells."""
+    from kueue_tpu.core.workload_info import (
+        is_evicted,
+        quota_reservation_time,
+    )
+    from kueue_tpu.models.preempt_kernel import AdmittedArrays
+
+    n = tree.n_nodes
+    parent = np.asarray(tree.parent)
+    is_cq_node = np.zeros(n, dtype=bool)
+    for name in snapshot.cluster_queues:
+        is_cq_node[tidx.node_of[name]] = True
+    root_of = np.arange(n)
+    for _ in range(8):
+        root_of = np.where(parent[root_of] >= 0, parent[root_of], root_of)
+
+    has_lend = np.asarray(tree.has_lend_limit).any(axis=(1, 2))  # [N]
+    # Per root: flat (no nested cohorts) and lend-limit free.
+    root_ok = np.ones(n, dtype=bool)
+    for node in range(n):
+        if not np.asarray(tree.active)[node]:
+            continue
+        r = root_of[node]
+        if has_lend[node]:
+            root_ok[r] = False
+        if node != r and not is_cq_node[node]:
+            root_ok[r] = False  # nested cohort -> not flat
+
+    infos = []
+    for cqs2 in snapshot.cluster_queues.values():
+        infos.extend(cqs2.workloads.values())
+    a = max(8, _round_up(len(infos), 8))
+    f = tree.nominal.shape[1]
+    r = tree.nominal.shape[2]
+    a_cq = np.zeros(a, dtype=np.int32)
+    a_usage = np.zeros((a, f, r), dtype=np.int64)
+    a_prio = np.zeros(a, dtype=np.int64)
+    a_ts = np.zeros(a, dtype=np.float64)
+    a_qr = np.zeros(a, dtype=np.float64)
+    a_evicted = np.zeros(a, dtype=bool)
+    a_active = np.zeros(a, dtype=bool)
+
+    uids = sorted(info.obj.uid for info in infos)
+    uid_rank_of = {u: i for i, u in enumerate(uids)}
+    a_uid = np.zeros(a, dtype=np.int32)
+
+    for i, info in enumerate(infos):
+        ni = tidx.node_of[info.cluster_queue]
+        a_cq[i] = ni
+        a_active[i] = True
+        a_prio[i] = info.priority()
+        a_ts[i] = queue_order_timestamp(info.obj)
+        a_qr[i] = quota_reservation_time(info.obj, 0.0)
+        a_evicted[i] = is_evicted(info.obj)
+        a_uid[i] = uid_rank_of[info.obj.uid]
+        idx.admitted.append(info)
+        for fr2, v2 in info.usage().items():
+            fi2 = tidx.flavor_of.get(fr2.flavor)
+            ri2 = tidx.resource_of.get(fr2.resource)
+            if fi2 is None or ri2 is None:
+                # Unmappable usage: the victim-removal math would be wrong
+                # for this tree; keep it on the host path.
+                root_ok[root_of[ni]] = False
+            else:
+                a_usage[i, fi2, ri2] = v2
+
+    preempt_simple = np.zeros(n, dtype=bool)
+    if not fair_sharing:
+        for name in snapshot.cluster_queues:
+            ni = tidx.node_of[name]
+            preempt_simple[ni] = root_ok[root_of[ni]]
+
+    idx.admitted_arrays = AdmittedArrays(
+        cq=jnp.asarray(a_cq),
+        usage=jnp.asarray(a_usage),
+        prio=jnp.asarray(a_prio),
+        ts=jnp.asarray(a_ts),
+        qr_time=jnp.asarray(a_qr),
+        evicted=jnp.asarray(a_evicted),
+        active=jnp.asarray(a_active),
+        uid_rank=jnp.asarray(a_uid),
+    )
+    return preempt_simple
 
 
 def _device_compatible(

@@ -1,0 +1,376 @@
+"""Device-side classical preemption: vectorized victim selection.
+
+Tensor reformulation of the reference's nomination-phase preemption search
+(pkg/scheduler/preemption/preemption.go:281-351 classicalPreemptions +
+preemption/classical/{candidate_generator,hierarchical_preemption}.go and
+the per-cell oracle preemption_oracle.go SimulatePreemption) for the
+*flat-cohort* case: the preemptor's CQ is either standalone or a direct
+child of a root cohort whose children are all CQs, with no lending limits
+anywhere in the tree (encode_cycle gates this via ``preempt_simple``).
+
+Why this is exact under those restrictions:
+  * With no lending limits, usage bubbles fully to every ancestor, so
+    removing a victim with usage u at CQ d subtracts u at d and at the
+    root — availability after removing a candidate *prefix* is a pair of
+    running sums (same-CQ / whole-tree), and remove-until-fit becomes a
+    prefix-sum argmax instead of a mutate-check loop.
+  * Candidate validity (candidate_generator.go:137: a reclaim candidate is
+    skipped once its CQ falls within nominal) is absorbing — removal only
+    lowers the CQ's usage — so validity is a per-CQ prefix property,
+    computable with segment cumsums.
+  * The fill-back minimization pass (preemption.go:338) is a short reverse
+    scan over the selected prefix with additive running sums.
+
+Two search granularities run per entry, matching the host exactly:
+  * one single-FlavorResource probe per contested cell — the oracle the
+    flavor assigner consults (its success and post-removal borrow height
+    set the cell's PMode and the assignment's ordering borrow), and
+  * the full multi-resource search that yields the actual victim set.
+
+Everything is batched over the pending-workload axis W, the probe axis
+(R+1), and the admitted-candidate axis A; the only sequential construct is
+the fill-back ``lax.scan`` over A (shared across batches via vmap).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.quota_ops import sat_add, sat_sub
+
+_INF = jnp.int64(1) << 61
+
+# Variant codes (scheduler.preemption.Variant; 0 = not a candidate).
+V_WITHIN_CQ = 1
+V_HIERARCHICAL_RECLAIM = 2
+V_RECLAIM_WITHOUT_BORROWING = 3
+V_RECLAIM_WHILE_BORROWING = 4
+
+
+class AdmittedArrays(NamedTuple):
+    """The cycle-start admitted set — the candidate pool (padded axis A)."""
+
+    cq: jnp.ndarray  # i32[A] CQ node index
+    usage: jnp.ndarray  # i64[A,F,R] admitted usage per cell
+    prio: jnp.ndarray  # i64[A]
+    ts: jnp.ndarray  # f64[A] queue-order timestamp
+    qr_time: jnp.ndarray  # f64[A] quota-reservation time
+    evicted: jnp.ndarray  # bool[A]
+    active: jnp.ndarray  # bool[A] (padding = False)
+    uid_rank: jnp.ndarray  # i32[A] UID sort rank (final ordering tiebreak)
+
+
+class PreemptTargets(NamedTuple):
+    victims: jnp.ndarray  # bool[W,A] final victim set per preemptor
+    variant: jnp.ndarray  # i32[W,A] Variant code per victim (0 = none)
+    success: jnp.ndarray  # bool[W] device-resolved Preempt with targets
+    resolved_nc: jnp.ndarray  # bool[W] device-resolved, no targets (reserve)
+    resolved: jnp.ndarray  # bool[W] = success | resolved_nc
+    borrow_after: jnp.ndarray  # i32[W] assignment-order borrow key
+
+
+def _seg_excl_prefix(sorted_vals, head):
+    """Exclusive prefix sums within segments (head marks segment starts)."""
+    c = jnp.cumsum(sorted_vals, axis=0)
+    excl = c - sorted_vals
+    n = head.shape[0]
+    head_idx = jnp.where(head, jnp.arange(n), -1)
+    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
+    return excl - excl[seg_head]
+
+
+def _seg_incl_cumsum(vals, head):
+    """Inclusive prefix sums within segments for a 1-D int array."""
+    c = jnp.cumsum(vals)
+    n = head.shape[0]
+    head_idx = jnp.where(head, jnp.arange(n), -1)
+    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
+    return c - (c - vals)[seg_head]
+
+
+def preempt_targets(
+    arrays: CycleArrays,
+    adm: AdmittedArrays,
+    chosen_flavor: jnp.ndarray,  # i32[W]
+    eligible: jnp.ndarray,  # bool[W] structurally device-resolvable entries
+    praw_stop: jnp.ndarray,  # bool[W] fungibility scan stopped at the raw flavor
+    considered: jnp.ndarray,  # i32[W] flavors considered by the scan
+) -> PreemptTargets:
+    """Victim selection for every eligible entry at once, against the
+    cycle-start usage (matching the host's nomination-phase get_targets)."""
+    tree = arrays.tree
+    usage = arrays.usage
+    sq = tree.subtree_quota
+    avail0 = quota_ops.available_all(tree, usage)
+
+    n = tree.n_nodes
+    parent_or_self = jnp.where(tree.parent < 0, jnp.arange(n), tree.parent)
+    root_of = jnp.arange(n)
+    for _ in range(quota_ops.MAX_DEPTH):
+        root_of = parent_or_self[root_of]
+    has_par_n = tree.parent >= 0
+
+    a_n = adm.cq.shape[0]
+    r_n = tree.nominal.shape[2]
+    a_iota = jnp.arange(a_n)
+
+    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered):
+        f = jnp.maximum(f0, 0)
+        full_active = (req > 0) & arrays.covered[c]  # [R]
+        contested_full = full_active & (req > avail0[c, f])  # [R]
+        au = adm.usage[:, f, :]  # [A,R]
+
+        same = adm.cq == c
+        cross = (root_of[adm.cq] == root_of[c]) & ~same & has_par_n[c]
+        lower = prio > adm.prio
+        neq = (prio == adm.prio) & (ts < adm.ts)
+
+        def pol_ok(pol):
+            return jnp.where(
+                pol == 3, jnp.ones_like(lower),
+                jnp.where(pol == 2, lower | neq,
+                          jnp.where(pol == 1, lower,
+                                    jnp.zeros_like(lower))),
+            )
+
+        pol_w = arrays.policy_within[c]
+        pol_r = arrays.policy_reclaim[c]
+        policy_pass = (
+            (same & (pol_w != 0) & pol_ok(pol_w))
+            | (cross & (pol_r != 0) & pol_ok(pol_r))
+        )
+
+        has_par = has_par_n[c]
+        root = root_of[c]
+        u_c = usage[c, f]  # [R]
+        u_root = usage[root, f]
+        sq_c = sq[c, f]
+        sq_root = sq[root, f]
+        t_c = jnp.where(
+            has_par,
+            jnp.where(tree.has_borrow_limit[c, f],
+                      sat_add(sq_c, tree.borrow_limit[c, f]), _INF),
+            sq_c,
+        )  # [R]
+
+        def search(active_req, contested, req_vec):
+            """One classical search (preemption.go:296): requests =
+            req_vec over active_req cells, contested cells needing
+            preemption. Returns (success, victims[A])."""
+            uses = jnp.any(contested[None, :] & (au > 0), axis=1)
+            # Cross-CQ collection gate: candidate CQ not within nominal in
+            # the contested cells (hierarchical_preemption.go:176).
+            above_nom = jnp.any(
+                contested[None, :]
+                & (usage[adm.cq, f, :] > sq[adm.cq, f, :]),
+                axis=1,
+            )
+            cand = adm.active & uses & policy_pass & (same | above_nom)
+
+            # Hierarchical advantage: requests fit in the preemptor CQ's
+            # own quota (hierarchical_preemption.go:129).
+            advantage = jnp.all(
+                ~active_req | (sq_c >= sat_add(u_c, req_vec))
+            )
+            bwc = arrays.bwc_policy[c]
+            rwob = (bwc == 0) | (adm.prio >= prio) | (
+                arrays.bwc_has_threshold[c]
+                & (adm.prio > arrays.bwc_threshold[c])
+            )
+            variant = jnp.where(
+                ~cand, 0,
+                jnp.where(same, V_WITHIN_CQ,
+                          jnp.where(advantage, V_HIERARCHICAL_RECLAIM,
+                                    jnp.where(rwob,
+                                              V_RECLAIM_WITHOUT_BORROWING,
+                                              V_RECLAIM_WHILE_BORROWING))),
+            ).astype(jnp.int32)
+
+            # Global candidate order: evicted-class split then per-class
+            # CandidatesOrdering (ordering.go:42). Within a class the
+            # evicted / same-CQ key components are uniform, so the
+            # concatenation of per-class sorts equals one sort by
+            # (class_rank, prio, -qr_time, uid).
+            class_rank = (
+                jnp.where(same, 2, jnp.where(advantage, 0, 1))
+                + jnp.where(adm.evicted, 0, 3)
+            )
+            ord_ = jnp.lexsort((
+                adm.uid_rank, -adm.qr_time, adm.prio, class_rank,
+                (~cand).astype(jnp.int32),
+            )).astype(jnp.int32)
+            pos = jnp.zeros(a_n, jnp.int32).at[ord_].set(
+                a_iota.astype(jnp.int32)
+            )
+            ord2 = jnp.lexsort((pos, adm.cq)).astype(jnp.int32)
+            s_cq = adm.cq[ord2]
+            head2 = jnp.concatenate(
+                [jnp.ones(1, bool), s_cq[1:] != s_cq[:-1]]
+            )
+            same_g = same[ord_]
+            au_g = au[ord_]
+
+            # Attempt plan (preemption.go:312-336).
+            has_cross = jnp.any(cand & cross)
+            borrow_forbidden = bwc == 0
+            under_nom = jnp.all(
+                ~contested | (tree.nominal[c, f] > u_c)
+            )
+            single = ~has_cross | (borrow_forbidden & ~under_nom)
+            has_hier = has_cross & advantage
+            first_borrow = jnp.where(
+                single, True, ~(borrow_forbidden & ~has_hier)
+            )
+            second_on = ~single
+
+            def fits_with(s_same, s_all, borrow_b):
+                """req_vec fits after removing s_same at the CQ / s_all at
+                the root (workloadFits, preemption.go:628)."""
+                term_c = jnp.where(
+                    t_c >= _INF, _INF, sat_sub(t_c, u_c - s_same)
+                )
+                term_root = sat_sub(sq_root, u_root - s_all)
+                avail = jnp.minimum(
+                    term_c, jnp.where(has_par, term_root, _INF)
+                )
+                ok = (req_vec <= avail) | ~active_req
+                no_borrow_ok = (
+                    (u_c - s_same + req_vec <= sq_c) | ~active_req
+                )
+                ok = ok & (borrow_b | no_borrow_ok)
+                return jnp.all(ok, axis=-1)
+
+            def attempt(borrow_b):
+                elig = cand & ~(
+                    borrow_b & (variant == V_RECLAIM_WITHOUT_BORROWING)
+                )
+                contrib = jnp.where(elig[:, None], au, 0).astype(jnp.int64)
+                # Per-CQ dynamic validity: naive above-nominal check
+                # against the CQ-segment exclusive prefix, folded with a
+                # cumulative AND (validity is absorbing).
+                excl2 = _seg_excl_prefix(contrib[ord2], head2)  # [A,R]
+                naive = same[ord2] | jnp.any(
+                    contested[None, :]
+                    & (usage[s_cq, f, :] - excl2 > sq[s_cq, f, :]),
+                    axis=1,
+                )
+                bad = (elig[ord2] & ~naive).astype(jnp.int32)
+                valid2 = _seg_incl_cumsum(bad, head2) == 0
+                valid = jnp.zeros(a_n, bool).at[ord2].set(valid2)
+                removal = elig & valid
+
+                rg = removal[ord_]
+                cg = jnp.where(rg[:, None], au_g, 0).astype(jnp.int64)
+                cum_all = jnp.cumsum(cg, axis=0)
+                cum_same = jnp.cumsum(
+                    jnp.where(same_g[:, None], cg, 0), axis=0
+                )
+                fits_k = fits_with(cum_same, cum_all, borrow_b)  # [A]
+                hit = rg & fits_k
+                success = jnp.any(hit)
+                k_star = jnp.argmax(hit).astype(jnp.int32)
+                pre = rg & (a_iota <= k_star)
+
+                # Fill-back (preemption.go:338): reverse pass over the
+                # prefix targets except the last, restoring any
+                # no-longer-needed one.
+                s_same0 = cum_same[k_star]
+                s_all0 = cum_all[k_star]
+
+                def fb(carry, xs):
+                    s_s, s_a = carry
+                    is_t, c_p, is_same_p = xs
+                    t_s = s_s - jnp.where(is_same_p, c_p, 0)
+                    t_a = s_a - c_p
+                    drop = is_t & fits_with(t_s, t_a, borrow_b)
+                    s_s = jnp.where(drop, t_s, s_s)
+                    s_a = jnp.where(drop, t_a, s_a)
+                    return (s_s, s_a), drop
+
+                fb_mask = pre & (a_iota < k_star)
+                xs = (fb_mask[::-1], cg[::-1], same_g[::-1])
+                _, drops_rev = jax.lax.scan(fb, (s_same0, s_all0), xs)
+                drops = drops_rev[::-1]
+                victims_g = pre & ~drops & success
+                victims = jnp.zeros(a_n, bool).at[ord_].set(victims_g)
+                return success, victims
+
+            ok1, v1 = attempt(first_borrow)
+            ok2, v2 = attempt(~first_borrow)
+            use2 = ~ok1 & second_on & ok2
+            success = ok1 | use2
+            victims = jnp.where(success, jnp.where(ok1, v1, v2), False)
+            return success, victims, variant
+
+        # Probe axis: slot 0 = the full multi-resource search; slot 1+r =
+        # the per-cell oracle probe for resource r (SimulatePreemption).
+        eye = jnp.eye(r_n, dtype=bool)
+        probe_active = jnp.concatenate(
+            [full_active[None, :], eye & full_active[None, :]]
+        )  # [R+1, R]
+        probe_contested = jnp.concatenate(
+            [contested_full[None, :], eye & contested_full[None, :]]
+        )
+        probe_req = jnp.where(probe_active, req[None, :], 0)
+        succ_p, vict_p, variant_p = jax.vmap(search)(
+            probe_active, probe_contested, probe_req
+        )
+        full_success = succ_p[0]
+        full_victims = vict_p[0]
+        variant = variant_p[0]
+        cell_success = succ_p[1:]  # [R]
+        cell_victims = vict_p[1:]  # [R, A]
+
+        # Per-cell borrow = the oracle's post-removal height for
+        # successful probes, the current height otherwise; FIT cells keep
+        # the current height (flavorassigner.go:1213 + oracle).
+        root_h = tree.height[root]
+        rem_same_cell = jnp.einsum(
+            "ra,ar->r",
+            (cell_victims & same[None, :]).astype(jnp.int64),
+            au,
+        )  # [R] same-CQ removal per single-fr probe at its own cell
+        h_pre = jnp.where(
+            has_par & (sat_add(u_c, req) > sq_c), root_h, 0
+        )  # [R]
+        h_post = jnp.where(
+            has_par & (sat_add(u_c - rem_same_cell, req) > sq_c), root_h, 0
+        )
+        cell_borrow = jnp.where(
+            contested_full,
+            jnp.where(cell_success, h_post, h_pre),
+            h_pre,
+        )
+        borrow_after = jnp.max(
+            jnp.where(full_active, cell_borrow, 0)
+        ).astype(jnp.int32)
+
+        # Flavor-scan consistency: when the host stopped the fungibility
+        # scan at this flavor, it did so because every contested cell's
+        # oracle reported preempt-mode; a NoCandidates cell would have
+        # continued to later flavors, so such entries must stay on the
+        # host path. A single-flavor CQ has no later flavor — the choice
+        # is forced either way.
+        all_cells_ok = jnp.all(~contested_full | cell_success)
+        resolved = elig_w & (
+            (considered == 1) | (stopped_at_praw & all_cells_ok)
+        )
+        success = resolved & full_success
+        victims = jnp.where(success, full_victims, False)
+        resolved_nc = resolved & ~full_success
+
+        return victims, jnp.where(victims, variant, 0), success, \
+            resolved_nc, resolved, borrow_after
+
+    victims, variant, success, resolved_nc, resolved, borrow_after = \
+        jax.vmap(per_w)(
+            arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
+            arrays.w_timestamp, eligible, praw_stop, considered,
+        )
+    return PreemptTargets(victims, variant, success, resolved_nc, resolved,
+                          borrow_after)

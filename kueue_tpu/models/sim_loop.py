@@ -93,7 +93,9 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000):
             a = arrays._replace(w_active=pending, usage=usage)
             nom = bs.nominate(a, usage)
             order = bs.admission_order(a, nom)
-            _u, admit = bs.admit_scan_grouped(a, ga, nom, usage, order, s_max)
+            _u, admit, _pre = bs.admit_scan_grouped(
+                a, ga, nom, usage, order, s_max
+            )
 
             newly = admit & pending
             any_admit = jnp.any(newly)

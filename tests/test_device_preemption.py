@@ -1,0 +1,246 @@
+"""Differential tests: device-side classical preemption vs host-exact.
+
+Random *preemption-enabled* scenarios restricted to the device-resolvable
+class (flat cohorts, no lending limits, oracle-independent flavor choice):
+the DeviceScheduler must produce the same admitted sets, identical flavor
+assignments AND the same preemption victims as the host-exact Scheduler,
+with zero host fallback.
+"""
+
+import random
+from typing import Dict, List, Tuple
+
+import pytest
+
+from kueue_tpu.api.constants import (
+    FlavorFungibilityPolicy,
+    PreemptionPolicy,
+    QueueingStrategy,
+)
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    Cohort,
+    FlavorFungibility,
+    ResourceFlavor,
+    ResourceQuota,
+)
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.scheduler.scheduler import Scheduler
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+RESOURCES = ["cpu", "memory"]
+POLICIES = [
+    PreemptionPolicy.NEVER,
+    PreemptionPolicy.LOWER_PRIORITY,
+    PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+    PreemptionPolicy.ANY,
+]
+
+
+def random_scenario(seed: int):
+    """Flat cohort forest, no lending limits, preemption-heavy workloads
+    submitted in two waves (low priority first) so victims exist."""
+    rng = random.Random(10_000 + seed)
+    n_flavors = rng.randint(1, 2)
+    flavor_specs = [ResourceFlavor(name=f"f{i}") for i in range(n_flavors)]
+
+    n_cohorts = rng.randint(0, 2)
+    cohorts = [Cohort(name=f"co{i}") for i in range(n_cohorts)]
+
+    cqs = []
+    n_cqs = rng.randint(1, 4)
+    for i in range(n_cqs):
+        flavors: Dict[str, Dict[str, ResourceQuota]] = {}
+        for fs in flavor_specs[: rng.randint(1, n_flavors)]:
+            cells = {}
+            for res in RESOURCES:
+                nominal = rng.randrange(1, 8) * 1000
+                bl = rng.choice([None, rng.randrange(0, 5) * 1000])
+                cells[res] = ResourceQuota(nominal, bl, None)
+            flavors[fs.name] = cells
+        bwc = BorrowWithinCohort()
+        if rng.random() < 0.4:
+            from kueue_tpu.api.constants import BorrowWithinCohortPolicy
+
+            bwc = BorrowWithinCohort(
+                policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                max_priority_threshold=rng.choice([None, 100]),
+            )
+        preemption = ClusterQueuePreemption(
+            within_cluster_queue=rng.choice(POLICIES),
+            reclaim_within_cohort=rng.choice(POLICIES),
+            borrow_within_cohort=bwc,
+        )
+        # Oracle-independent flavor choice: stop at the first preempt-mode
+        # flavor and never skip past a borrowing one.
+        fung = FlavorFungibility(
+            when_can_borrow=FlavorFungibilityPolicy.BORROW,
+            when_can_preempt=FlavorFungibilityPolicy.PREEMPT,
+        )
+        cohort = rng.choice([None] + [c.name for c in cohorts]) if cohorts \
+            else None
+        cqs.append(
+            make_cq(
+                f"cq{i}",
+                cohort=cohort,
+                flavors=flavors,
+                resources=RESOURCES,
+                strategy=rng.choice(
+                    [QueueingStrategy.BEST_EFFORT_FIFO,
+                     QueueingStrategy.STRICT_FIFO]
+                ),
+                fungibility=fung,
+                preemption=preemption,
+            )
+        )
+
+    def wave(n, lo_prio, hi_prio, t0):
+        out = []
+        for i in range(n):
+            cq = rng.choice(cqs)
+            reqs = {}
+            for res in rng.sample(RESOURCES, rng.randint(1, 2)):
+                reqs[res] = rng.randrange(1, 6) * 500
+            out.append(
+                make_wl(
+                    f"w{t0}-{i}",
+                    queue=f"lq-{cq.name}",
+                    requests=reqs,
+                    priority=rng.randrange(lo_prio, hi_prio) * 100,
+                    creation_time=float(t0 + i),
+                )
+            )
+        return out
+
+    wave1 = wave(rng.randint(3, 10), 0, 2, 0)
+    wave2 = wave(rng.randint(2, 8), 1, 4, 100)
+    return flavor_specs, cohorts, cqs, wave1, wave2
+
+
+def run_one(seed: int, device: bool):
+    flavor_specs, cohorts, cqs, wave1, wave2 = random_scenario(seed)
+    cache, queues, host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    evictions: List[str] = []
+    if device:
+        sched = DeviceScheduler(cache, queues)
+        inner = sched.host
+        fallbacks: List[str] = []
+        orig_hp = sched._host_process
+
+        def spy(infos):
+            fallbacks.extend(i.obj.name for i in infos)
+            return orig_hp(infos)
+
+        sched._host_process = spy
+    else:
+        sched = host
+        inner = sched
+        fallbacks = []
+    orig_evict = inner.evict_fn
+
+    def evict(victim, eviction_reason, preemption_reason):
+        evictions.append(f"{victim.obj.name}:{preemption_reason}")
+        orig_evict(victim, eviction_reason, preemption_reason)
+
+    inner.evict_fn = evict
+    if device:
+        sched.host.evict_fn = evict
+
+    # Bounded cycles: preemption scenarios can churn indefinitely under an
+    # instant clock (victim requeues, re-admits, preempts back); running
+    # the SAME bounded cycle sequence on both schedulers keeps the
+    # comparison exact regardless.
+    submit(queues, *wave1)
+    sched.schedule_all(max_cycles=40)
+    submit(queues, *wave2)
+    sched.schedule_all(max_cycles=40)
+
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    return admissions, sorted(admissions), sorted(evictions), fallbacks
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_device_preemption_matches_host(seed):
+    host_adm, host_names, host_evictions, _ = run_one(seed, device=False)
+    dev_adm, dev_names, dev_evictions, fallbacks = run_one(seed, device=True)
+    assert not fallbacks, (
+        f"device-eligible scenario fell back to host for: {fallbacks}"
+    )
+    assert dev_names == host_names, (
+        f"admitted sets differ: host={host_names} device={dev_names}"
+    )
+    assert dev_evictions == host_evictions, (
+        f"victim sets differ: host={host_evictions} device={dev_evictions}"
+    )
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name]
+
+
+def test_cross_cq_reclaim_on_device():
+    """Borrower in the cohort gets reclaimed by the nominal owner — the
+    RECLAIM variants run on device with the right reason codes."""
+    from kueue_tpu.core.workload_info import is_evicted
+
+    for device in (False, True):
+        preemption = ClusterQueuePreemption(
+            reclaim_within_cohort=PreemptionPolicy.ANY,
+        )
+        cache, queues, host = build_env(
+            [
+                make_cq("owner", cohort="co",
+                        flavors={"f0": {"cpu": ResourceQuota(4000)}},
+                        preemption=preemption),
+                make_cq("borrower", cohort="co",
+                        flavors={"f0": {"cpu": ResourceQuota(1000)}}),
+            ],
+        )
+        sched = DeviceScheduler(cache, queues) if device else host
+        filler = make_wl("filler", queue="lq-borrower", cpu_m=5000,
+                         priority=100, creation_time=1.0)
+        submit(queues, filler)
+        sched.schedule_all()
+        assert "default/filler" in cache.workloads
+
+        claim = make_wl("claim", queue="lq-owner", cpu_m=4000, priority=0,
+                        creation_time=2.0)
+        submit(queues, claim)
+        result = sched.schedule()
+        assert result.preempted == ["default/filler"], (device, result)
+        assert is_evicted(filler)
+        sched.schedule_all()
+        assert "default/claim" in cache.workloads
+
+
+def test_overlapping_targets_skip_second_preemptor():
+    """Two entries nominating the same victim: the first designates it, the
+    second is skipped this cycle (scheduler.go:518 overlap check)."""
+    for device in (False, True):
+        preemption = ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        )
+        cache, queues, host = build_env(
+            [make_cq("cq-a", flavors={"f0": {"cpu": ResourceQuota(4000)}},
+                     preemption=preemption)],
+        )
+        sched = DeviceScheduler(cache, queues) if device else host
+        lo = make_wl("lo", cpu_m=4000, priority=1, creation_time=1.0)
+        submit(queues, lo)
+        sched.schedule_all()
+        hi1 = make_wl("hi1", cpu_m=4000, priority=10, creation_time=2.0)
+        hi2 = make_wl("hi2", cpu_m=4000, priority=10, creation_time=3.0)
+        submit(queues, hi1, hi2)
+        result = sched.schedule()
+        assert result.preempted == ["default/lo"], (device, result)
+        assert len(result.preempting) == 1
+        sched.schedule_all()
+        # Only one of the two fits afterwards (hi1 by FIFO).
+        assert sorted(i.obj.name for i in cache.workloads.values()) == ["hi1"]
