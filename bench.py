@@ -7,14 +7,22 @@ withinClusterQueue=LowerPriority, and per CQ 350 small (req 1, prio 50),
 100 medium (req 5, prio 100), 50 large (req 20, prio 200) workloads with
 200/500/1000 ms runtimes.
 
-Differences from the reference harness, by design: all workloads are
-submitted upfront and execution is simulated on a virtual clock (completion
-is instantaneous when the scheduler is otherwise stuck), so the measured
-wall time is pure scheduling compute — the framework's sustainable
-admission throughput. The reference's derived number on this config is
-~42.7 admissions/s (BASELINE.md); vs_baseline = ours / 42.7.
+Measurements emitted (one JSON line on stdout):
+  * value / vs_baseline — the host control-plane's sustainable admission
+    throughput on the full 15k-workload scenario (virtual clock; pure
+    scheduling compute). The reference's derived number on this config is
+    ~42.7 admissions/s (BASELINE.md).
+  * device.sim — the SAME scenario simulated END TO END ON THE DEVICE:
+    one compiled XLA dispatch running every scheduling round + virtual-time
+    completion until all workloads finish (models/sim_loop.py).
+  * device.mega — one batched scheduling cycle at the north-star scale
+    (50k pending workloads x 2000 CQs x 32 flavors) for both admission
+    kernels (grouped scan / fixed point).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Device probes run in /usr/bin/timeout-guarded subprocesses: a wedged
+accelerator transport (observed with the remote-TPU tunnel) then costs a
+bounded timeout instead of hanging the bench; the JSON line reports
+device.ok=false in that case.
 """
 
 from __future__ import annotations
@@ -22,6 +30,8 @@ from __future__ import annotations
 import argparse
 import heapq
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -116,8 +126,6 @@ def build_scenario(scale: float):
 
 
 def run(kind: str, scale: float) -> dict:
-    from kueue_tpu.core.workload_info import is_evicted
-
     cache, queues, workloads = build_scenario(scale)
     if kind == "device":
         from kueue_tpu.models.driver import DeviceScheduler
@@ -158,7 +166,6 @@ def run(kind: str, scale: float) -> dict:
                 if not result.head_keys:
                     log(f"DEADLOCK: finished={finished}/{n_total}")
                     break
-                # heads exist but nothing runs/admits: keep cycling guard
                 log(f"stall: finished={finished}/{n_total}")
                 break
             vclock, key = heapq.heappop(completions)
@@ -170,7 +177,6 @@ def run(kind: str, scale: float) -> dict:
             for k in batch:
                 if k in running:
                     del running[k]
-                    info = cache.workloads.get(k)
                     cache.delete_workload(k)
                     finished += 1
             queues.queue_inadmissible_workloads()
@@ -195,10 +201,87 @@ def run(kind: str, scale: float) -> dict:
     }
 
 
-def device_mega_cycle_probe():
-    """Secondary metric (stderr): one batched scheduling cycle at the
-    north-star scale — 50k pending workloads x 2000 CQs (50 cohorts) x 32
-    flavors — as a single compiled program on the attached accelerator."""
+# ---------------------------------------------------------------------------
+# Device probes (run in timeout-guarded subprocesses; each prints one JSON
+# line on stdout and exits via os._exit so a half-wedged transport cannot
+# hang interpreter teardown).
+# ---------------------------------------------------------------------------
+
+
+def probe_sim(scale: float):
+    """The full baseline scenario as ONE device dispatch: every scheduling
+    round + virtual-clock completion runs inside a compiled while_loop
+    (models/sim_loop.py)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.core.workload_info import WorkloadInfo
+    from kueue_tpu.models.encode import encode_cycle
+    from kueue_tpu.models.sim_loop import make_sim_loop
+
+    cache, queues, workloads = build_scenario(scale)
+    infos = []
+    runtimes = []
+    for wl, runtime_s in workloads:
+        lq = cache.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        infos.append(WorkloadInfo(wl, lq.cluster_queue))
+        runtimes.append(int(runtime_s * 1000))
+    snapshot = cache.snapshot()
+    t_enc = time.monotonic()
+    arrays, idx = encode_cycle(snapshot, infos, snapshot.resource_flavors)
+    encode_s = time.monotonic() - t_enc
+    w_pad = arrays.w_cq.shape[0]
+    runtime_ms = jnp.asarray(
+        np.pad(np.asarray(runtimes, np.int64), (0, w_pad - len(runtimes)))
+    )
+    # Exactness needs the per-round scan depth >= the largest per-tree
+    # entry bucket, not the full W (trees scan in parallel).
+    group_of = np.asarray(idx.group_arrays.flat_to_group)[
+        np.asarray(arrays.w_cq)
+    ]
+    s_max = int(np.bincount(group_of).max())
+    sim = jax.jit(make_sim_loop(s_max=s_max))
+    platform = jax.devices()[0].platform
+
+    t0 = time.monotonic()
+    out = sim(arrays, idx.group_arrays, runtime_ms)
+    out.rounds.block_until_ready()
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = sim(arrays, idx.group_arrays, runtime_ms)
+    out.rounds.block_until_ready()
+    dt = time.monotonic() - t0
+    admitted = int((np.asarray(out.admitted_at) >= 0).sum())
+    return {
+        "probe": "sim",
+        "ok": True,
+        "platform": platform,
+        "n": len(infos),
+        "admitted": admitted,
+        "rounds": int(out.rounds),
+        "encode_s": round(encode_s, 3),
+        "compile_s": round(compile_s, 1),
+        "device_wall_s": round(dt, 3),
+        "admissions_per_s": round(admitted / dt, 1) if dt > 0 else 0.0,
+    }
+
+
+def probe_ping():
+    """Cheap device-aliveness check: backend init + one tiny computation."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    x = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+    return {"probe": "ping", "ok": True, "platform": d.platform,
+            "check": float(x[0, 0])}
+
+
+def probe_mega():
+    """One batched scheduling cycle at the north-star scale — 50k pending
+    workloads x 2000 CQs (50 cohorts) x 32 flavors — as a single compiled
+    program on the attached accelerator."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -264,24 +347,57 @@ def device_mega_cycle_probe():
     )
     layout = GroupLayout(parent, np.ones(N, bool))
     ga = bs.GroupArrays(*layout.as_jax())
+    out_stats = {"probe": "mega", "ok": True,
+                 "platform": jax.devices()[0].platform}
     for name, fn in (
-        ("fixed-point", jax.jit(bs.make_fixedpoint_cycle())),
-        ("grouped-scan", jax.jit(
+        ("fixedpoint", jax.jit(bs.make_fixedpoint_cycle())),
+        ("grouped", jax.jit(
             bs.make_grouped_cycle(2 * W // layout.n_groups))),
     ):
+        t0 = time.monotonic()
         out = fn(arrays, ga)
         out.outcome.block_until_ready()  # compile
+        compile_s = time.monotonic() - t0
         t0 = time.monotonic()
         out = fn(arrays, ga)
         out.outcome.block_until_ready()
         dt = time.monotonic() - t0
         admitted = int((np.asarray(out.outcome) == 4).sum())
-        log(
-            f"device mega-cycle[{name}] (50k wl x 2000 CQ x 32 flavors, "
-            f"{jax.devices()[0].platform}): {dt*1000:.0f} ms, "
-            f"{admitted} admitted, equivalent {admitted/dt:.0f} admissions/s"
+        out_stats[name + "_ms"] = round(dt * 1000, 1)
+        out_stats[name + "_compile_s"] = round(compile_s, 1)
+        out_stats["admitted"] = admitted
+        log(f"mega[{name}]: {dt*1000:.0f} ms, {admitted} admitted, "
+            f"~{admitted/dt:.0f} admissions/s equivalent")
+    return out_stats
+
+
+def run_probe_subprocess(
+    probe: str, timeout_s: int, scale: float, platform: str = None
+) -> dict:
+    """Run one probe in a timeout-guarded subprocess; parse its JSON line."""
+    cmd = [
+        "/usr/bin/timeout", str(timeout_s), sys.executable, __file__,
+        "--probe", probe, "--scale", str(scale),
+    ]
+    if platform:
+        cmd += ["--platform", platform]
+    try:
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s + 30
         )
-    return dt
+    except subprocess.TimeoutExpired:
+        return {"probe": probe, "ok": False, "error": "outer timeout"}
+    for line in reversed(res.stdout.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    tail = (res.stderr or "").strip().splitlines()[-3:]
+    return {
+        "probe": probe, "ok": False, "rc": res.returncode,
+        "error": " | ".join(tail)[-300:] or f"rc={res.returncode}",
+    }
 
 
 def main():
@@ -289,29 +405,75 @@ def main():
     ap.add_argument("--kind", default="host", choices=["device", "host"])
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
-    ap.add_argument("--with-mega", action="store_true")
+    ap.add_argument("--probe", default=None,
+                    choices=["ping", "mega", "sim"],
+                    help="internal: run one device probe and exit")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform inside the probe (the "
+                         "JAX_PLATFORMS env var is NOT equivalent: the "
+                         "environment's sitecustomize hangs on it)")
+    ap.add_argument("--skip-device", action="store_true")
     args = ap.parse_args()
 
-    stats = run(args.kind, args.scale)
-    log(f"stats: {stats}")
-    if args.with_mega:
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.probe:
         try:
-            device_mega_cycle_probe()
-        except Exception as exc:  # pragma: no cover
-            log(f"device mega-cycle probe failed: {exc}")
+            stats = {
+                "ping": probe_ping,
+                "mega": probe_mega,
+                "sim": lambda: probe_sim(args.scale),
+            }[args.probe]()
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            stats = {"probe": args.probe, "ok": False,
+                     "error": repr(exc)[:300]}
+        print(json.dumps(stats), flush=True)
+        os._exit(0)
+
+    stats = run(args.kind, args.scale)
+    log(f"host stats: {stats}")
+
+    device = {}
+    if not args.skip_device:
+        # Fast aliveness gate: a wedged device tunnel costs one bounded
+        # timeout here instead of one per heavy probe.
+        device["ping"] = run_probe_subprocess(
+            "ping", 90, args.scale, args.platform
+        )
+        log(f"device ping: {device['ping']}")
+        if device["ping"].get("ok"):
+            device["sim"] = run_probe_subprocess(
+                "sim", 420, args.scale, args.platform
+            )
+            log(f"device sim probe: {device['sim']}")
+            device["mega"] = run_probe_subprocess(
+                "mega", 420, args.scale, args.platform
+            )
+            log(f"device mega probe: {device['mega']}")
+        device["ok"] = bool(
+            (device.get("sim") or {}).get("ok")
+            or (device.get("mega") or {}).get("ok")
+        )
+
     baseline_throughput = 42.7  # BASELINE.md derived admissions/s
     value = round(stats["throughput"], 2)
-    print(json.dumps({
+    out = {
         "metric": "baseline_admission_throughput",
         "value": value,
         "unit": "workloads/s",
         "vs_baseline": round(value / baseline_throughput, 2),
-    }), flush=True)
+    }
+    if device:
+        out["device"] = device
+        sim = device.get("sim") or {}
+        out["device_time_s"] = sim.get("device_wall_s", 0.0)
+    print(json.dumps(out), flush=True)
     # Skip interpreter teardown: a wedged accelerator transport can hang
     # JAX's backend finalizers, and the result is already on stdout.
-    import os as _os
-
-    _os._exit(0)
+    os._exit(0)
 
 
 if __name__ == "__main__":
