@@ -1,0 +1,354 @@
+"""Device-side TAS gang placement: the full phase 1/2a/2b pipeline.
+
+Tensor twin of tas/snapshot.py find_topology_assignment (reference
+tas_flavor_snapshot.go:943 findTopologyAssignment) for the device-eligible
+class: no leaders, no balanced placement, no inner slice layers, no
+per-workload node selectors/taint filtering (encode gates those to the
+host path). Supports required / preferred (walk-up + top-level gather) /
+unconstrained modes and the outer slice constraint (sliceSize pinned at a
+sliceRequiredLevel) — the long-context/ICI-critical case.
+
+Layout: every TAS flavor's topology becomes right-padded per-level arrays
+(axis D = max domains per level across flavors, LMAX static levels), with
+domains at each level PRE-SORTED by their levelValues tuple so the host's
+lexicographic tie-break equals the device index order. The phase-2b greedy
+descent ("take domains in BestFit order until one can finish, then pick the
+smallest sufficient finisher" — updateCountsToMinimumGeneric :1578) is one
+segmented prefix-sum + masked argmin per level, for both the free
+slice-redistribution region above the slice level and the per-parent pods
+region at/below it.
+
+All level indices (requested, slice, leaf) are traced values, so one
+compiled kernel serves every flavor/request shape; the static loops run
+LMAX times with masks.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LMAX = 8
+_INF = jnp.int64(1) << 60
+
+
+class TASDeviceTopo(NamedTuple):
+    """Padded topologies for all TAS flavors (leading axis T)."""
+
+    n_levels: jnp.ndarray  # i32[T]
+    level_size: jnp.ndarray  # i32[T, LMAX]
+    parent_idx: jnp.ndarray  # i32[T, LMAX, D]: level-l domain -> parent pos
+    leaf_cap: jnp.ndarray  # i64[T, D, R] capacity in cycle-resource space
+    leaf_pods: jnp.ndarray  # i64[T, D] "pods" capacity bound (INF if none)
+    pods_res_idx: int  # static: cycle resource index of "pods" (-1 if none)
+
+
+def encode_device_topos(
+    tas_flavors: dict, flavor_names: List[str], resource_of: dict
+) -> Tuple[TASDeviceTopo, List[object], List[List[int]]]:
+    """Build TASDeviceTopo from host TASFlavorSnapshots.
+
+    Returns (topo, per-T host snapshots, per-T leaf permutation mapping the
+    device leaf position -> host leaf index). Only flavors in
+    ``flavor_names`` (device-eligible) are encoded.
+    """
+    r_n = max(len(resource_of), 1)
+    t_n = max(len(flavor_names), 1)
+    lmax_sizes = [1]
+    per_flavor = []
+    for name in flavor_names:
+        tas = tas_flavors[name]
+        sizes = [len(lvl) for lvl in tas.domains_per_level]
+        lmax_sizes.extend(sizes)
+        per_flavor.append(tas)
+    d_n = max(lmax_sizes)
+
+    n_levels = np.ones(t_n, np.int32)
+    level_size = np.zeros((t_n, LMAX), np.int32)
+    parent_idx = np.zeros((t_n, LMAX, d_n), np.int32)
+    leaf_cap = np.zeros((t_n, d_n, r_n), np.int64)
+    leaf_pods = np.full((t_n, d_n), 1 << 60, np.int64)
+    leaf_perm: List[List[int]] = []
+
+    pods_res_idx = resource_of.get("pods", -1)
+
+    for t, tas in enumerate(per_flavor):
+        nl = len(tas.level_keys)
+        n_levels[t] = nl
+        # Sort each level's domains by levelValues (the host tie-break);
+        # keep position maps for parent indices.
+        sorted_levels = []
+        pos_maps = []
+        for lvl in tas.domains_per_level:
+            s = sorted(range(len(lvl)), key=lambda i: lvl[i].level_values)
+            sorted_levels.append([lvl[i] for i in s])
+            pos_maps.append({id(lvl[i]): j for j, i in enumerate(s)})
+        for l in range(nl):
+            level_size[t, l] = len(sorted_levels[l])
+            if l >= 1:
+                for j, dom in enumerate(sorted_levels[l]):
+                    parent_idx[t, l, j] = pos_maps[l - 1][id(dom.parent)]
+        host_leaf_index = {leaf.id: i for i, leaf in enumerate(tas.leaves)}
+        perm = []
+        for j, dom in enumerate(sorted_levels[nl - 1]):
+            hi = host_leaf_index[dom.id]
+            perm.append(hi)
+            for r, ri in tas._res_index.items():
+                ci = resource_of.get(r)
+                if ci is not None:
+                    leaf_cap[t, j, ci] = tas._leaf_cap[hi, ri]
+            if "pods" in tas._res_index and pods_res_idx < 0:
+                leaf_pods[t, j] = tas._leaf_cap[hi, tas._res_index["pods"]]
+        leaf_perm.append(perm)
+
+    return (
+        TASDeviceTopo(
+            n_levels=jnp.asarray(n_levels),
+            level_size=jnp.asarray(level_size),
+            parent_idx=jnp.asarray(parent_idx),
+            leaf_cap=jnp.asarray(leaf_cap),
+            leaf_pods=jnp.asarray(leaf_pods),
+            pods_res_idx=pods_res_idx,
+        ),
+        per_flavor,
+        leaf_perm,
+    )
+
+
+def _seg_excl_cumsum(vals, head):
+    c = jnp.cumsum(vals)
+    excl = c - vals
+    n = head.shape[0]
+    head_idx = jnp.where(head, jnp.arange(n), -1)
+    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
+    return excl - excl[seg_head], seg_head
+
+
+def _seg_min_scan(vals, head):
+    """Per-position minimum over the position's WHOLE segment: scatter-min
+    into the segment-head slot, then gather back."""
+    n = head.shape[0]
+    head_idx = jnp.where(head, jnp.arange(n), -1)
+    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
+    seg_total = jnp.full(n, _INF, vals.dtype).at[seg_head].min(vals)
+    return seg_total[seg_head]
+
+
+def segmented_greedy(
+    values: jnp.ndarray,  # i64[D] capacity per candidate (in units)
+    cand: jnp.ndarray,  # bool[D] candidate mask
+    seg: jnp.ndarray,  # i32[D] segment id (monotone grouping key)
+    target: jnp.ndarray,  # i64[D] per-position target of its segment
+    tiebreak_state: jnp.ndarray,  # i64[D] host BestFit secondary key
+    primary_desc: jnp.ndarray,  # i64[D] host BestFit primary key (desc)
+) -> jnp.ndarray:
+    """One host ``updateCountsToMinimum`` pass per segment: walk candidates
+    in (primary desc, state asc, index) order, taking full capacity until a
+    candidate can finish the remaining target, then give the remainder to
+    the smallest sufficient candidate at/after that point. Returns takes
+    [D] in ``values`` units."""
+    d_n = values.shape[0]
+    iota = jnp.arange(d_n)
+    order = jnp.lexsort((
+        iota, tiebreak_state, -primary_desc, jnp.where(cand, 0, 1), seg
+    )).astype(jnp.int32)
+    v = jnp.where(cand, values, 0)[order]
+    c = cand[order]
+    s = seg[order]
+    t_seg = target[order]
+    head = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+    prefix, _ = _seg_excl_cumsum(v, head)
+    remaining = t_seg - prefix  # target left before this candidate
+    can_finish = c & (v >= remaining) & (remaining > 0)
+    # First finisher per segment: segment-min of (can_finish ? position : INF).
+    pos_key = jnp.where(can_finish, iota, _INF)
+    first_fin = _seg_min_scan(pos_key, head)  # per-position segment min
+    jstar = first_fin  # i64 position of first finisher (INF if none)
+    before_star = iota < jstar
+    at_or_after = iota >= jstar
+    # remaining at jstar, broadcast per segment: gather via remaining[jstar]
+    jstar_c = jnp.clip(jstar, 0, d_n - 1).astype(jnp.int32)
+    rem_star = jnp.where(jstar < _INF, remaining[jstar_c], 0)
+    # Best-fit winner: min (value, position) among sufficient candidates at
+    # or after jstar.
+    suff = c & at_or_after & (v >= rem_star) & (rem_star > 0)
+    bf_key = jnp.where(suff, v * d_n + iota, _INF)
+    bf_min = _seg_min_scan(bf_key, head)
+    winner = suff & (bf_key == bf_min)
+    takes_sorted = jnp.where(
+        winner, rem_star,
+        jnp.where(c & before_star & (remaining > 0), v, 0),
+    )
+    takes = jnp.zeros(d_n, jnp.int64).at[order].set(takes_sorted)
+    return takes
+
+
+def place(
+    topo: TASDeviceTopo,
+    t: jnp.ndarray,  # i32 flavor row
+    leaf_usage: jnp.ndarray,  # i64[D, R] current usage (device leaf order)
+    req: jnp.ndarray,  # i64[R] per-pod requests
+    count: jnp.ndarray,  # i64 pod count
+    slice_size: jnp.ndarray,  # i64 (1 when unconstrained)
+    slice_level: jnp.ndarray,  # i32 (leaf level when no slice constraint)
+    req_level: jnp.ndarray,  # i32 requested level index
+    required: jnp.ndarray,  # bool
+    unconstrained: jnp.ndarray,  # bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (feasible bool, leaf_take i64[D] pods per leaf domain)."""
+    d_n = topo.leaf_cap.shape[1]
+    r_n = topo.leaf_cap.shape[2]
+    iota = jnp.arange(d_n)
+    nl = topo.n_levels[t]
+    leaf_l = nl - 1
+    ss = jnp.maximum(slice_size, 1)
+    slice_count = count // ss
+
+    def valid_at(l):
+        return iota < topo.level_size[t, jnp.clip(l, 0, LMAX - 1)]
+
+    # ---- phase 1: leaf fill + roll-up -------------------------------------
+    free = topo.leaf_cap[t] - leaf_usage  # [D,R]
+    fits = jnp.full(d_n, _INF, jnp.int64)
+    for r in range(r_n):  # static unroll over the resource axis
+        fits = jnp.where(
+            req[r] > 0,
+            jnp.minimum(
+                fits, jnp.maximum(free[:, r], 0) // jnp.maximum(req[r], 1)
+            ),
+            fits,
+        )
+    pods_bound = jnp.maximum(topo.leaf_pods[t], 0)
+    if topo.pods_res_idx >= 0:
+        apply_pods = req[topo.pods_res_idx] <= 0
+        pods_free = jnp.maximum(free[:, topo.pods_res_idx], 0)
+        fits = jnp.where(apply_pods, jnp.minimum(fits, pods_free), fits)
+    else:
+        fits = jnp.minimum(fits, pods_bound)
+    state_leaf = jnp.where(fits >= _INF, 0, fits)
+    state_leaf = jnp.where(valid_at(leaf_l), state_leaf, 0)
+
+    states = jnp.zeros((LMAX, d_n), jnp.int64)
+    states = states.at[jnp.clip(leaf_l, 0, LMAX - 1)].set(state_leaf)
+    for s in range(1, LMAX):
+        l = leaf_l - s
+        lc = jnp.clip(l, 0, LMAX - 1)
+        child_l = jnp.clip(l + 1, 0, LMAX - 1)
+        pidx = topo.parent_idx[t, child_l]
+        child = jnp.where(valid_at(l + 1), states[child_l], 0)
+        acc = jnp.zeros(d_n, jnp.int64).at[pidx].add(child)
+        states = jnp.where(l >= 0, states.at[lc].set(acc), states)
+
+    sls = jnp.zeros((LMAX, d_n), jnp.int64)
+    sl_lc = jnp.clip(slice_level, 0, LMAX - 1)
+    sls = sls.at[sl_lc].set(states[sl_lc] // ss)
+    for s in range(1, LMAX):
+        l = slice_level - s
+        lc = jnp.clip(l, 0, LMAX - 1)
+        child_l = jnp.clip(l + 1, 0, LMAX - 1)
+        pidx = topo.parent_idx[t, child_l]
+        child = jnp.where(valid_at(l + 1), sls[child_l], 0)
+        acc = jnp.zeros(d_n, jnp.int64).at[pidx].add(child)
+        sls = jnp.where(l >= 0, sls.at[lc].set(acc), sls)
+
+    # ---- phase 2a: level search -------------------------------------------
+    lvl_iota = jnp.arange(LMAX)
+    best = jnp.max(jnp.where(valid_at(lvl_iota[:, None]) &
+                             (lvl_iota[:, None] < nl), sls, 0), axis=1)
+    total = jnp.sum(jnp.where(valid_at(lvl_iota[:, None]) &
+                              (lvl_iota[:, None] < nl), sls, 0), axis=1)
+    fits_level = best >= slice_count
+    req_lc = jnp.clip(req_level, 0, LMAX - 1)
+    walk_cand = fits_level & (lvl_iota <= req_level) & (lvl_iota < nl)
+    deepest_fit = jnp.max(jnp.where(walk_cand, lvl_iota, -1))
+
+    single_level = jnp.where(
+        required | unconstrained, req_level, deepest_fit
+    )
+    single_ok = jnp.where(
+        required | unconstrained, fits_level[req_lc], deepest_fit >= 0
+    )
+    gather_level = jnp.where(unconstrained, req_level, 0)
+    gather_ok = total[jnp.clip(gather_level, 0, LMAX - 1)] >= slice_count
+    use_gather = ~single_ok & ~required
+    feasible = single_ok | (use_gather & gather_ok)
+    start_level = jnp.where(use_gather, gather_level, single_level)
+    start_lc = jnp.clip(start_level, 0, LMAX - 1)
+
+    # ---- phase 2b: initial selection at the start level -------------------
+    sl_start = jnp.where(valid_at(start_level), sls[start_lc], 0)
+    st_start = jnp.where(valid_at(start_level), states[start_lc], 0)
+    # Single-domain: lowest sufficient slice capacity; ties broken by the
+    # host sort order (-slice_state, state, values) = rank below.
+    order0 = jnp.lexsort((iota, st_start, -sl_start)).astype(jnp.int32)
+    rank0 = jnp.zeros(d_n, jnp.int64).at[order0].set(
+        jnp.arange(d_n, dtype=jnp.int64)
+    )
+    suff = (sl_start >= slice_count) & valid_at(start_level)
+    bf_key = jnp.where(suff, sl_start * d_n + rank0, _INF)
+    dstar = jnp.argmin(bf_key)
+    single_take = jnp.zeros(d_n, jnp.int64).at[dstar].set(slice_count)
+    gather_take = segmented_greedy(
+        sl_start, valid_at(start_level), jnp.zeros(d_n, jnp.int32),
+        jnp.full(d_n, slice_count), st_start, sl_start,
+    )
+    take_slices = jnp.where(use_gather, gather_take, single_take)
+
+    # Convert to pods immediately when the start level IS the slice level
+    # (or deeper: start <= slice_level always holds).
+    at_slice = start_level == slice_level
+    take = jnp.where(at_slice, take_slices * ss, take_slices)
+    in_pods = at_slice
+
+    # ---- descent ----------------------------------------------------------
+    cur_level = start_level
+    for _ in range(LMAX - 1):
+        child_level = cur_level + 1
+        active = (child_level <= leaf_l) & feasible
+        child_lc = jnp.clip(child_level, 0, LMAX - 1)
+        pidx = topo.parent_idx[t, child_lc]
+        parent_take = take[pidx]
+        child_valid = valid_at(child_level) & (parent_take > 0)
+        mode_a = child_level <= slice_level  # free slice redistribution
+        sl_child = jnp.where(valid_at(child_level), sls[child_lc], 0)
+        st_child = jnp.where(valid_at(child_level), states[child_lc], 0)
+        values = jnp.where(mode_a, sl_child, st_child)
+        seg = jnp.where(mode_a, jnp.zeros(d_n, jnp.int32), pidx)
+        target = jnp.where(
+            mode_a, jnp.full(d_n, slice_count), parent_take
+        )
+        new_take = segmented_greedy(
+            values, child_valid, seg, target, st_child, sl_child
+        )
+        # Slice->pod conversion when the child level is the slice level.
+        to_pods = mode_a & (child_level == slice_level)
+        new_take = jnp.where(to_pods, new_take * ss, new_take)
+        take = jnp.where(active, new_take, take)
+        in_pods = jnp.where(active, in_pods | to_pods | ~mode_a, in_pods)
+        cur_level = jnp.where(active, child_level, cur_level)
+
+    # At the leaf level the take is in pods unless no slice conversion
+    # happened (slice_level == leaf and start == leaf handled by at_slice).
+    leaf_take = jnp.where(in_pods, take, take * ss)
+    leaf_take = jnp.where(feasible & valid_at(leaf_l), leaf_take, 0)
+    return feasible, leaf_take
+
+
+def feasible_only(
+    topo: TASDeviceTopo,
+    t: jnp.ndarray,
+    leaf_usage: jnp.ndarray,
+    req: jnp.ndarray,
+    count: jnp.ndarray,
+    slice_size: jnp.ndarray,
+    slice_level: jnp.ndarray,
+    req_level: jnp.ndarray,
+    required: jnp.ndarray,
+    unconstrained: jnp.ndarray,
+) -> jnp.ndarray:
+    f, _ = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
+                 req_level, required, unconstrained)
+    return f

@@ -1,0 +1,154 @@
+"""Differential tests: device TAS placement kernel vs the host engine.
+
+Random topologies / usage / placement requests across required, preferred
+(walk-up + top gather), unconstrained and outer-slice-constraint modes; the
+device kernel (ops/tas_place.py) must agree with
+tas/snapshot.find_topology_assignment on feasibility AND produce the exact
+same per-leaf pod counts.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import Topology
+from kueue_tpu.ops.tas_place import encode_device_topos, place
+from kueue_tpu.tas.snapshot import Node, PlacementRequest, TASFlavorSnapshot
+
+LEVELS3 = ["block", "rack", "kubernetes.io/hostname"]
+
+
+def random_topology(rng: random.Random):
+    n_levels = rng.randint(2, 3)
+    levels = LEVELS3[-n_levels:] if rng.random() < 0.5 else \
+        LEVELS3[:n_levels]
+    if levels[-1] != "kubernetes.io/hostname":
+        levels = levels[:-1] + ["kubernetes.io/hostname"]
+    topo = Topology(name="t", levels=levels)
+    nodes = []
+    n_blocks = rng.randint(1, 3)
+    for b in range(n_blocks):
+        for r in range(rng.randint(1, 3)):
+            for h in range(rng.randint(1, 4)):
+                labels = {}
+                if len(levels) >= 2:
+                    labels[levels[0]] = f"b{b}"
+                if len(levels) == 3:
+                    labels[levels[1]] = f"b{b}-r{r}"
+                cap = {
+                    "tpu": rng.choice([0, 4, 8, 16]),
+                    "memory": rng.choice([0, 1000, 4000]),
+                }
+                nodes.append(Node(
+                    name=f"n-{b}-{r}-{h}", labels=labels, capacity=cap,
+                ))
+    return topo, nodes
+
+
+def random_request(rng: random.Random, levels):
+    count = rng.choice([1, 2, 3, 4, 6, 8, 12])
+    mode = rng.choice(["required", "preferred", "unconstrained"])
+    level = rng.choice(levels)
+    req = PlacementRequest(
+        count=count,
+        single_pod_requests={
+            "tpu": rng.choice([1, 2, 4]),
+            **({"memory": rng.choice([100, 500])}
+               if rng.random() < 0.5 else {}),
+        },
+        required_level=level if mode == "required" else None,
+        preferred_level=level if mode == "preferred" else None,
+        unconstrained=mode == "unconstrained",
+    )
+    # Outer slice constraint: pin slices of the gang under a deeper level.
+    if rng.random() < 0.4:
+        level_idx = levels.index(level) if level in levels else 0
+        deeper = [lv for i, lv in enumerate(levels) if i >= level_idx]
+        slice_level = rng.choice(deeper)
+        for ss in (2, 3, 4, 1):
+            if count % ss == 0:
+                break
+        req.slice_size = ss
+        req.slice_required_level = slice_level
+    return req
+
+
+def random_usage(rng: random.Random, tas: TASFlavorSnapshot):
+    usage = {}
+    for leaf in tas.leaves:
+        if rng.random() < 0.4:
+            usage[leaf.id] = {
+                "tpu": rng.choice([1, 2, 4, 8]),
+                "memory": rng.choice([0, 500, 1000]),
+            }
+    return usage
+
+
+@pytest.mark.parametrize("seed", range(120))
+def test_place_matches_host(seed):
+    rng = random.Random(7000 + seed)
+    topo_spec, nodes = random_topology(rng)
+    tas = TASFlavorSnapshot(topo_spec, nodes)
+    tas.usage = random_usage(rng, tas)
+    req = random_request(rng, topo_spec.levels)
+
+    ta, _leader, reason = tas.find_topology_assignment(req)
+    host_ok = not reason
+    host_counts = {}
+    if host_ok:
+        for values, cnt in ta.domains:
+            leaf_id = "/".join(values) if len(values) > 1 or \
+                not tas.lowest_is_node else values[0]
+            leaf_id = tas._canonical_leaf_id("/".join(values))
+            host_counts[leaf_id] = host_counts.get(leaf_id, 0) + cnt
+
+    resource_of = {"tpu": 0, "memory": 1}
+    dev_topo, flavors, leaf_perms = encode_device_topos(
+        {"f": tas}, ["f"], resource_of
+    )
+    d_n = dev_topo.leaf_cap.shape[1]
+    leaf_usage = np.zeros((d_n, 2), np.int64)
+    perm = leaf_perms[0]
+    host_leaf_ids = [leaf.id for leaf in tas.leaves]
+    for j, hi in enumerate(perm):
+        used = tas.usage.get(host_leaf_ids[hi], {})
+        leaf_usage[j, 0] = used.get("tpu", 0)
+        leaf_usage[j, 1] = used.get("memory", 0)
+
+    levels = topo_spec.levels
+    level_key = req.required_level or req.preferred_level
+    if req.unconstrained and level_key is None:
+        level_key = levels[-1]
+    req_level = levels.index(level_key)
+    if req.slice_required_level is not None:
+        slice_level = levels.index(req.slice_required_level)
+        slice_size = req.slice_size
+    else:
+        slice_level = len(levels) - 1
+        slice_size = 1
+
+    feasible, leaf_take = place(
+        dev_topo, jnp.int32(0), jnp.asarray(leaf_usage),
+        jnp.asarray([req.single_pod_requests.get("tpu", 0),
+                     req.single_pod_requests.get("memory", 0)],
+                    dtype=jnp.int64),
+        jnp.int64(req.count), jnp.int64(slice_size),
+        jnp.int32(slice_level), jnp.int32(req_level),
+        jnp.asarray(req.required_level is not None),
+        jnp.asarray(req.unconstrained),
+    )
+    feasible = bool(feasible)
+    assert feasible == host_ok, (
+        f"feasibility differs: host={host_ok} ({reason}) device={feasible}"
+    )
+    if host_ok:
+        dev_counts = {}
+        take = np.asarray(leaf_take)
+        for j, hi in enumerate(perm):
+            if take[j]:
+                dev_counts[host_leaf_ids[hi]] = int(take[j])
+        assert dev_counts == host_counts, (
+            f"placement differs:\n host={host_counts}\n dev ={dev_counts}"
+        )
