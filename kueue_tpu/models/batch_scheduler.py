@@ -490,6 +490,14 @@ def admit_scan_grouped(
     f_onehot = jnp.arange(f_n)
     g_iota = jnp.arange(g_n)
     with_preempt = targets is not None
+    with_tas = getattr(arrays, "tas_topo", None) is not None
+
+    if with_tas:
+        from kueue_tpu.ops import tas_place as _tas_place
+
+        t_n = arrays.tas_usage0.shape[0]
+        f_all = arrays.w_elig.shape[1]
+        w_iota_all = jnp.arange(w_n)
 
     if with_preempt:
         a_n = adm.cq.shape[0]
@@ -542,7 +550,7 @@ def admit_scan_grouped(
     chain_is_repeat = ga.chain_local == chain_next  # [G,Nm,D+1]
 
     def body(carry, s):
-        usage_g, designated = carry
+        usage_g, designated, tas_usage = carry
         pos = starts + s
         in_range = s < counts
         w = grouped_order[jnp.clip(pos, 0, w_n - 1)]  # [G]
@@ -619,7 +627,36 @@ def admit_scan_grouped(
 
         fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))  # [G]
         deferred = nom.needs_host[w]
-        admit = valid & (pm == P_FIT) & fits & ~deferred
+
+        # TAS placement recheck against the running topology state
+        # (scheduler.go:409 updateAssignmentIfNeeded): earlier entries may
+        # have taken the domains; infeasible-now entries are skipped.
+        if with_tas:
+            t_of_g = jnp.where(
+                f >= 0, arrays.tas_of_flavor[jnp.clip(f, 0, f_all - 1)], -1
+            )
+            tas_do = valid & arrays.w_tas[w] & (t_of_g >= 0) & (pm == P_FIT)
+            t_idx_g = jnp.clip(t_of_g, 0, tas_usage.shape[0] - 1)
+            rl_g = arrays.w_tas_req_level[w, t_idx_g]
+            sl_g = arrays.w_tas_slice_level[w, t_idx_g]
+
+            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_):
+                return _tas_place.place(
+                    arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
+                    jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+                )
+
+            tas_feas, tas_take = jax.vmap(place_one)(
+                t_idx_g, arrays.w_tas_req[w], arrays.w_tas_count[w],
+                arrays.w_tas_slice_size[w], sl_g, rl_g,
+                arrays.w_tas_required[w], arrays.w_tas_unconstrained[w],
+            )  # [G], [G, D]
+            tas_ok = jnp.where(tas_do, tas_feas, True)
+        else:
+            tas_ok = True
+            tas_do = None
+
+        admit = valid & (pm == P_FIT) & fits & ~deferred & tas_ok
         preempt_ok = preempting & ~overlap & fits & ~deferred
 
         borrowing = nom.best_borrow[w] > 0
@@ -666,15 +703,34 @@ def admit_scan_grouped(
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], my_vict, False), axis=0
             )
+        if with_tas:
+            # Consume topology capacity for admitted TAS entries. Trees
+            # sharing a flavor are merged into one scan group, so at most
+            # one entry per step touches a given flavor row.
+            do_take = admit & tas_do
+            usage_delta = (
+                tas_take[:, :, None]
+                * arrays.w_tas_usage_req[w][:, None, :]
+            )  # [G, D, R1]
+            usage_delta = jnp.where(
+                do_take[:, None, None], usage_delta, 0
+            )
+            tas_usage = tas_usage.at[t_idx_g].add(usage_delta)
         w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
-        return (new_usage_g, designated), (w_out, admit, preempt_ok)
+        return (new_usage_g, designated, tas_usage), \
+            (w_out, admit, preempt_ok)
 
     designated0 = (
         jnp.zeros(a_n, bool) if with_preempt else jnp.zeros(1, bool)
     )
-    (final_usage_g, _designated), (w_mat, admit_mat, pre_mat) = jax.lax.scan(
-        body, (usage_g, designated0), jnp.arange(s_max), unroll=2
+    tas_usage0 = (
+        arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
     )
+    (final_usage_g, _designated, _tas_u), (w_mat, admit_mat, pre_mat) = \
+        jax.lax.scan(
+            body, (usage_g, designated0, tas_usage0), jnp.arange(s_max),
+            unroll=2,
+        )
     admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
         admit_mat.ravel(), mode="drop"
     )[:w_n]
@@ -761,10 +817,65 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
                      adm) -> CycleOutputs:
         usage = arrays.usage
         nom = nominate(arrays, usage)
+
+        # Device TAS hook (flavorassigner.go:796-835 order): feasibility of
+        # the chosen flavor's topology placement downgrades Fit->Preempt;
+        # preempt-mode entries that cannot place even on an empty fleet
+        # demote to NoFit; surviving preempt-mode TAS entries need the
+        # host's TAS-aware victim search.
+        if arrays.tas_topo is not None:
+            from kueue_tpu.ops import tas_place
+
+            w_n = arrays.w_cq.shape[0]
+            w_iota = jnp.arange(w_n)
+            f_n = arrays.w_elig.shape[1]
+            chosen_c = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
+            t_of = jnp.where(
+                nom.chosen_flavor >= 0, arrays.tas_of_flavor[chosen_c], -1
+            )
+            tas_entry = arrays.w_tas & arrays.w_active & (t_of >= 0)
+            t_idx = jnp.clip(t_of, 0, arrays.tas_usage0.shape[0] - 1)
+            rl = arrays.w_tas_req_level[w_iota, t_idx]
+            sl = arrays.w_tas_slice_level[w_iota, t_idx]
+
+            def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_):
+                return tas_place.feasible_only(
+                    arrays.tas_topo, t, usage_all[t], req, count, ssz,
+                    jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+                )
+
+            feas_args = (
+                t_idx, arrays.w_tas_req, arrays.w_tas_count,
+                arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
+                arrays.w_tas_unconstrained,
+            )
+            feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 8)(
+                arrays.tas_usage0, *feas_args
+            )
+            feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 8)(
+                jnp.zeros_like(arrays.tas_usage0), *feas_args
+            )
+            ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid
+            feas_now = feas_now & ok_levels
+            feas_empty = feas_empty & ok_levels
+
+            pm0 = nom.best_pmode
+            downgrade = tas_entry & (pm0 == P_FIT) & ~feas_now
+            pm1 = jnp.where(downgrade, P_PREEMPT_RAW, pm0)
+            pre_mode = tas_entry & (
+                (pm1 == P_PREEMPT_RAW) | (pm1 == P_NO_CANDIDATES)
+            )
+            pm2 = jnp.where(pre_mode & ~feas_empty, P_NOFIT, pm1)
+            needs_host2 = jnp.where(
+                tas_entry, pm2 == P_PREEMPT_RAW, nom.needs_host
+            )
+            nom = nom._replace(best_pmode=pm2, needs_host=needs_host2)
+
         # Structural eligibility for on-device oracle resolution: exactly
         # one flavor with raw preempt mode, and the fungibility scan's
         # choice is independent of the oracle outcome (it stopped at that
-        # flavor, or there was only one to consider).
+        # flavor, or there was only one to consider). TAS entries are
+        # excluded — their victim search needs the topology probe.
         elig = (
             arrays.w_active
             & (nom.best_pmode == P_PREEMPT_RAW)
@@ -772,6 +883,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
             & arrays.preempt_simple[arrays.w_cq]
             & ~arrays.w_has_gates
         )
+        if arrays.w_tas is not None:
+            elig = elig & ~arrays.w_tas
         tgt = preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
             nom.considered,

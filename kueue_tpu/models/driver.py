@@ -76,6 +76,8 @@ class DeviceScheduler:
         arrays, idx = encode_cycle(
             snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
             fair_sharing=self.fair_sharing, preempt=True,
+            delay_tas_fn=lambda cqs, info: self.host._delay_tas(cqs, info)
+            or self.host._has_multikueue_check(cqs),
         )
 
         host_entries: List[WorkloadInfo] = list(idx.host_fallback)
@@ -109,12 +111,20 @@ class DeviceScheduler:
             )
             self.device_time_s += self.clock() - t0
 
+            # Admitted TAS entries: replay the exact placement host-side in
+            # scan order (the device kernel made the same decisions; this
+            # decodes the domain assignments), accumulating assumed usage
+            # per flavor like update_for_tas.
+            tas_assignments = self._replay_tas_placements(
+                out, outcome, chosen, idx, snapshot
+            )
+
             for i, info in enumerate(idx.workloads):
                 oc = outcome[i]
                 if oc == batch_scheduler.OUT_ADMITTED:
                     self._apply_admission(
                         info, idx.flavors[chosen[i]], int(tried[i]),
-                        snapshot,
+                        snapshot, topology_assignment=tas_assignments.get(i),
                     )
                     result.admitted.append(info.key)
                 elif oc == batch_scheduler.OUT_PREEMPTING:
@@ -185,8 +195,67 @@ class DeviceScheduler:
             self.host._requeue_and_update(e)
         return result
 
+    def _replay_tas_placements(self, out, outcome, chosen, idx, snapshot):
+        """Decode device-TAS admissions: recompute each admitted TAS
+        entry's placement with the host engine in scan order, accumulating
+        assumed usage per flavor (mirrors the device scan state; the
+        kernels are differential-equal, so this reproduces the device's
+        exact domains)."""
+        from kueue_tpu.tas.snapshot import PlacementRequest
+
+        if not idx.tas_flavor_names:
+            return {}
+        assignments = {}
+        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        order = np.asarray(out.order)
+        pos = {int(w): k for k, w in enumerate(order)}
+        rows = [
+            i for i, info in enumerate(idx.workloads)
+            if outcome[i] == batch_scheduler.OUT_ADMITTED
+            and info.obj.pod_sets[0].topology_request is not None
+        ]
+        for i in sorted(rows, key=lambda r: pos.get(r, 1 << 30)):
+            info = idx.workloads[i]
+            ps = info.obj.pod_sets[0]
+            tr = ps.topology_request
+            fname = idx.flavors[chosen[i]]
+            tas = snapshot.tas_flavors.get(fname)
+            if tas is None:
+                continue
+            req = PlacementRequest(
+                count=ps.count,
+                single_pod_requests=dict(ps.requests),
+                required_level=tr.required_level,
+                preferred_level=tr.preferred_level,
+                unconstrained=tr.unconstrained,
+                slice_size=tr.slice_size or 1,
+                slice_required_level=tr.slice_required_level,
+            )
+            ta, _leader, reason = tas.find_topology_assignment(
+                req, assumed_usage=assumed.get(fname)
+            )
+            if reason:
+                # Should be unreachable (the device admitted only feasible
+                # placements); surface loudly in debug runs.
+                import sys
+
+                print(
+                    f"TAS replay diverged for {info.key}: {reason}",
+                    file=sys.stderr,
+                )
+                continue
+            assignments[i] = ta
+            dst_f = assumed.setdefault(fname, {})
+            for values, count in ta.domains:
+                leaf_id = "/".join(values)
+                dst = dst_f.setdefault(leaf_id, {})
+                for res, v in ps.requests.items():
+                    dst[res] = dst.get(res, 0) + v * count
+        return assignments
+
     def _apply_admission(
-        self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot
+        self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot,
+        topology_assignment=None,
     ) -> None:
         now = self.clock()
         cqs = snapshot.cluster_queues[info.cluster_queue]
@@ -200,6 +269,7 @@ class DeviceScheduler:
                     flavors=dict(flavors),
                     resource_usage=dict(ps.requests),
                     count=ps.count,
+                    topology_assignment=topology_assignment,
                 )
             ],
         )

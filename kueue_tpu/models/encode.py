@@ -74,6 +74,20 @@ class CycleArrays(NamedTuple):
     # device-representable: classical victim search can run on device.
     preempt_simple: Optional[jnp.ndarray] = None  # bool[N]
     w_has_gates: Optional[jnp.ndarray] = None  # bool[W] preemptionGates open
+    # -- device TAS (None when no TAS flavor is device-encoded) --
+    tas_topo: Optional[object] = None  # ops.tas_place.TASDeviceTopo
+    tas_usage0: Optional[jnp.ndarray] = None  # i64[T, D, R+1] cycle-start
+    tas_of_flavor: Optional[jnp.ndarray] = None  # i32[F] -> T row (-1 none)
+    w_tas: Optional[jnp.ndarray] = None  # bool[W] TAS entry on device path
+    w_tas_req: Optional[jnp.ndarray] = None  # i64[W, R+1] incl. implicit pods
+    w_tas_usage_req: Optional[jnp.ndarray] = None  # i64[W, R+1] usage deltas
+    w_tas_count: Optional[jnp.ndarray] = None  # i64[W]
+    w_tas_slice_size: Optional[jnp.ndarray] = None  # i64[W]
+    w_tas_req_level: Optional[jnp.ndarray] = None  # i32[W, T] (-1 missing)
+    w_tas_slice_level: Optional[jnp.ndarray] = None  # i32[W, T]
+    w_tas_required: Optional[jnp.ndarray] = None  # bool[W]
+    w_tas_unconstrained: Optional[jnp.ndarray] = None  # bool[W]
+    w_tas_invalid: Optional[jnp.ndarray] = None  # bool[W] always-infeasible
 
 
 @dataclass
@@ -89,6 +103,10 @@ class CycleIndex:
     # Admitted candidates row order (device preemption victim decode).
     admitted: List[WorkloadInfo] = field(default_factory=list)
     admitted_arrays: object = None  # preempt_kernel.AdmittedArrays
+    # Device-TAS decode state: per-T host snapshots + device-leaf order.
+    tas_flavor_names: List[str] = field(default_factory=list)
+    tas_snapshots: List[object] = field(default_factory=list)
+    tas_leaf_perm: List[List[int]] = field(default_factory=list)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -102,6 +120,7 @@ def encode_cycle(
     w_pad: int = 0,
     fair_sharing: bool = False,
     preempt: bool = False,
+    delay_tas_fn=None,
 ) -> Tuple[CycleArrays, CycleIndex]:
     """Build CycleArrays from the host snapshot + pending heads.
 
@@ -227,10 +246,26 @@ def encode_cycle(
                     if fi2 is not None and ri2 is not None:
                         usage_by_prio[ni2, fi2, ri2, b] += v2
 
+    # Device-encodable TAS flavors: topology present and every usage key
+    # mappable onto the cycle resource axis (else the device free-capacity
+    # math would diverge — those flavors' TAS entries stay on the host).
+    tas_device_flavors: List[str] = []
+    if preempt:
+        for fname, tas in snapshot.tas_flavors.items():
+            ok = len(tas.level_keys) <= 8 and tas.level_keys
+            for leaf_usage in tas.usage.values():
+                for res in leaf_usage:
+                    if res not in tidx.resource_of and res != "pods":
+                        ok = False
+            if ok:
+                tas_device_flavors.append(fname)
+
     # Workload arrays.
     device_wls: List[WorkloadInfo] = []
     for info in heads:
-        if _device_compatible(info, snapshot, single_rg_cq):
+        if _device_compatible(info, snapshot, single_rg_cq,
+                              set(tas_device_flavors), delay_tas_fn,
+                              preempt):
             device_wls.append(info)
         else:
             idx.host_fallback.append(info)
@@ -279,12 +314,8 @@ def encode_cycle(
             res0 = res_keys[0] if res_keys else ""
             w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
 
-    layout = GroupLayout(np.asarray(tree.parent), np.asarray(tree.active))
-    from kueue_tpu.models.batch_scheduler import GroupArrays
-
-    idx.group_arrays = GroupArrays(*layout.as_jax())
-
     preempt_fields: Dict[str, object] = {}
+    root_merge = None
     if preempt:
         preempt_simple = _encode_admitted(
             snapshot, tidx, tree, idx, fair_sharing
@@ -296,6 +327,23 @@ def encode_cycle(
             preempt_simple=jnp.asarray(preempt_simple),
             w_has_gates=jnp.asarray(w_gates),
         )
+        if tas_device_flavors:
+            tas_fields, root_merge = _encode_tas(
+                snapshot, tidx, idx, device_wls, w, tas_device_flavors,
+                np.asarray(tree.parent),
+            )
+            preempt_fields.update(tas_fields)
+
+    # Cohort trees sharing a device TAS flavor are merged into one scan
+    # group: their entries consume the same topology state, so the grouped
+    # scan must serialize them (quota trees alone are independent).
+    layout = GroupLayout(
+        np.asarray(tree.parent), np.asarray(tree.active),
+        root_merge=root_merge,
+    )
+    from kueue_tpu.models.batch_scheduler import GroupArrays
+
+    idx.group_arrays = GroupArrays(*layout.as_jax())
 
     arrays = CycleArrays(
         tree=tree,
@@ -326,6 +374,161 @@ def encode_cycle(
         **preempt_fields,
     )
     return arrays, idx
+
+
+def _encode_tas(
+    snapshot, tidx, idx, device_wls, w, flavor_names, parent_arr
+) -> Tuple[Dict[str, object], Dict[int, int]]:
+    """Encode device-TAS arrays: padded topologies, cycle-start leaf usage,
+    per-workload placement requests, and the root-merge map for scan
+    grouping."""
+    from kueue_tpu.ops.tas_place import encode_device_topos
+
+    topo, tas_snaps, leaf_perm = encode_device_topos(
+        snapshot.tas_flavors, flavor_names, tidx.resource_of
+    )
+    idx.tas_flavor_names = list(flavor_names)
+    idx.tas_snapshots = tas_snaps
+    idx.tas_leaf_perm = leaf_perm
+    t_n = max(len(flavor_names), 1)
+    d_n = topo.leaf_cap.shape[1]
+    r1 = topo.leaf_cap.shape[2]  # cycle resources + implicit pods column
+    r_cy = r1 - 1
+
+    usage0 = np.zeros((t_n, d_n, r1), np.int64)
+    for t, tas in enumerate(tas_snaps):
+        inv = {hi: j for j, hi in enumerate(leaf_perm[t])}
+        for leaf_id, used in tas.usage.items():
+            hi = tas._leaf_index.get(tas._canonical_leaf_id(leaf_id))
+            if hi is None:
+                continue
+            j = inv[hi]
+            for res, v in used.items():
+                ci = tidx.resource_of.get(res)
+                if ci is not None:
+                    usage0[t, j, ci] += v
+                if res == "pods":
+                    # Mirror into the implicit-pods column so unrequested
+                    # pod-count bounds see explicit pods consumption too.
+                    usage0[t, j, r_cy] += v
+
+    f_n = max(len(tidx.flavors), 1)
+    tas_of_flavor = np.full(f_n, -1, np.int32)
+    for t, name in enumerate(flavor_names):
+        fi = tidx.flavor_of.get(name)
+        if fi is not None:
+            tas_of_flavor[fi] = t
+
+    w_tas = np.zeros(w, bool)
+    w_tas_req = np.zeros((w, r1), np.int64)
+    w_tas_usage_req = np.zeros((w, r1), np.int64)  # per-pod usage deltas
+    w_tas_count = np.zeros(w, np.int64)
+    w_tas_slice_size = np.ones(w, np.int64)
+    w_tas_req_level = np.full((w, t_n), -1, np.int32)
+    w_tas_slice_level = np.full((w, t_n), -1, np.int32)
+    w_tas_required = np.zeros(w, bool)
+    w_tas_uncon = np.zeros(w, bool)
+    w_tas_invalid = np.zeros(w, bool)
+
+    for i, info in enumerate(device_wls):
+        ps = info.obj.pod_sets[0]
+        tr = ps.topology_request
+        if tr is None:
+            continue
+        w_tas[i] = True
+        w_tas_count[i] = ps.count
+        for res, v in ps.requests.items():
+            ci = tidx.resource_of.get(res)
+            if ci is not None:
+                w_tas_req[i, ci] = v
+                w_tas_usage_req[i, ci] = v
+        pods_req = ps.requests.get("pods", 0)
+        # Fit vector: implicit 1-pod bound unless pods explicitly requested.
+        # Usage vector: only explicit pods consumption mirrors into the
+        # implicit column (add_usage adds requested resources only).
+        w_tas_req[i, r_cy] = 0 if pods_req > 0 else 1
+        w_tas_usage_req[i, r_cy] = pods_req
+
+        required = tr.required_level is not None
+        uncon = tr.unconstrained or (
+            tr.required_level is None and tr.preferred_level is None
+        )
+        level_key = tr.required_level or tr.preferred_level
+        has_slice = tr.slice_required_level is not None
+        ssz = (tr.slice_size or 1) if has_slice else 1
+        w_tas_slice_size[i] = ssz
+        w_tas_required[i] = required
+        w_tas_uncon[i] = uncon
+        if ssz > 0 and ps.count % ssz != 0:
+            w_tas_invalid[i] = True
+        for t, tas in enumerate(tas_snaps):
+            keys = tas.level_keys
+            lk = level_key if level_key is not None else (
+                keys[-1] if keys else None
+            )
+            if lk not in keys:
+                continue  # stays -1: infeasible on this flavor
+            rl = keys.index(lk)
+            if has_slice:
+                if tr.slice_required_level not in keys:
+                    continue
+                sl = keys.index(tr.slice_required_level)
+            else:
+                sl = len(keys) - 1
+            if rl > sl:
+                continue  # host rejects: slice level above podset level
+            w_tas_req_level[i, t] = rl
+            w_tas_slice_level[i, t] = sl
+
+    # Root merging: union roots of CQs sharing a device TAS flavor.
+    n = parent_arr.shape[0]
+    root_of = np.arange(n)
+    for _ in range(9):
+        root_of = np.where(
+            parent_arr[root_of] >= 0, parent_arr[root_of], root_of
+        )
+    uf: Dict[int, int] = {}
+
+    def find(x):
+        while uf.get(x, x) != x:
+            uf[x] = uf.get(uf[x], uf[x])
+            x = uf[x]
+        return x
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            uf[max(ra, rb)] = min(ra, rb)
+
+    flavor_anchor: Dict[str, int] = {}
+    for cq_name, cqs2 in snapshot.cluster_queues.items():
+        ni = tidx.node_of[cq_name]
+        for rg in cqs2.spec.resource_groups:
+            for fq in rg.flavors:
+                if fq.name in flavor_names:
+                    anchor = flavor_anchor.get(fq.name)
+                    if anchor is None:
+                        flavor_anchor[fq.name] = int(root_of[ni])
+                    else:
+                        union(anchor, int(root_of[ni]))
+    root_merge = {int(r): find(int(r)) for r in set(root_of.tolist())}
+
+    fields = dict(
+        tas_topo=topo,
+        tas_usage0=jnp.asarray(usage0),
+        tas_of_flavor=jnp.asarray(tas_of_flavor),
+        w_tas=jnp.asarray(w_tas),
+        w_tas_req=jnp.asarray(w_tas_req),
+        w_tas_usage_req=jnp.asarray(w_tas_usage_req),
+        w_tas_count=jnp.asarray(w_tas_count),
+        w_tas_slice_size=jnp.asarray(w_tas_slice_size),
+        w_tas_req_level=jnp.asarray(w_tas_req_level),
+        w_tas_slice_level=jnp.asarray(w_tas_slice_level),
+        w_tas_required=jnp.asarray(w_tas_required),
+        w_tas_unconstrained=jnp.asarray(w_tas_uncon),
+        w_tas_invalid=jnp.asarray(w_tas_invalid),
+    )
+    return fields, root_merge
 
 
 def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
@@ -422,7 +625,12 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
 
 
 def _device_compatible(
-    info: WorkloadInfo, snapshot: Snapshot, single_rg_cq: Dict[str, bool]
+    info: WorkloadInfo,
+    snapshot: Snapshot,
+    single_rg_cq: Dict[str, bool],
+    tas_device_flavors: set = frozenset(),
+    delay_tas_fn=None,
+    preempt: bool = False,
 ) -> bool:
     if info.cluster_queue not in snapshot.cluster_queues:
         return False
@@ -433,9 +641,25 @@ def _device_compatible(
     ps = info.obj.pod_sets[0]
     if ps.min_count is not None and ps.min_count < ps.count:
         return False  # partial admission -> host path
-    if ps.topology_request is not None:
-        return False  # TAS -> host path (device TAS kernel comes separately)
     cqs = snapshot.cluster_queues[info.cluster_queue]
+    if ps.topology_request is not None:
+        tr = ps.topology_request
+        if not preempt:
+            return False
+        # Device TAS class: no balanced placement, no inner slice layers,
+        # no per-workload node filtering, no delayed placement.
+        if tr.balanced or tr.slice_layers:
+            return False
+        if ps.node_selector or ps.tolerations:
+            return False
+        if delay_tas_fn is not None and delay_tas_fn(cqs, info):
+            return False
+        # Every topology-backed flavor of the CQ must be device-encoded.
+        rg0 = cqs.spec.resource_groups[0]
+        for fq in rg0.flavors:
+            if fq.name in snapshot.tas_flavors and \
+                    fq.name not in tas_device_flavors:
+                return False
     rg = cqs.spec.resource_groups[0]
     return all(
         res in rg.covered_resources

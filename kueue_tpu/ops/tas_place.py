@@ -36,14 +36,19 @@ _INF = jnp.int64(1) << 60
 
 
 class TASDeviceTopo(NamedTuple):
-    """Padded topologies for all TAS flavors (leading axis T)."""
+    """Padded topologies for all TAS flavors (leading axis T).
+
+    The capacity resource axis is the cycle resource index PLUS one trailing
+    "implicit pods" column (reference resources.CountIn bounds pod counts by
+    the node's "pods" capacity even when unrequested): the per-entry TAS
+    request vector carries 1 in that column when "pods" isn't requested,
+    reproducing the bound as ordinary division; INF capacity when the fleet
+    doesn't track pods."""
 
     n_levels: jnp.ndarray  # i32[T]
     level_size: jnp.ndarray  # i32[T, LMAX]
     parent_idx: jnp.ndarray  # i32[T, LMAX, D]: level-l domain -> parent pos
-    leaf_cap: jnp.ndarray  # i64[T, D, R] capacity in cycle-resource space
-    leaf_pods: jnp.ndarray  # i64[T, D] "pods" capacity bound (INF if none)
-    pods_res_idx: int  # static: cycle resource index of "pods" (-1 if none)
+    leaf_cap: jnp.ndarray  # i64[T, D, R+1]
 
 
 def encode_device_topos(
@@ -69,11 +74,9 @@ def encode_device_topos(
     n_levels = np.ones(t_n, np.int32)
     level_size = np.zeros((t_n, LMAX), np.int32)
     parent_idx = np.zeros((t_n, LMAX, d_n), np.int32)
-    leaf_cap = np.zeros((t_n, d_n, r_n), np.int64)
-    leaf_pods = np.full((t_n, d_n), 1 << 60, np.int64)
+    leaf_cap = np.zeros((t_n, d_n, r_n + 1), np.int64)
+    leaf_cap[:, :, r_n] = 1 << 60  # implicit-pods column: INF by default
     leaf_perm: List[List[int]] = []
-
-    pods_res_idx = resource_of.get("pods", -1)
 
     for t, tas in enumerate(per_flavor):
         nl = len(tas.level_keys)
@@ -100,8 +103,8 @@ def encode_device_topos(
                 ci = resource_of.get(r)
                 if ci is not None:
                     leaf_cap[t, j, ci] = tas._leaf_cap[hi, ri]
-            if "pods" in tas._res_index and pods_res_idx < 0:
-                leaf_pods[t, j] = tas._leaf_cap[hi, tas._res_index["pods"]]
+                if r == "pods":
+                    leaf_cap[t, j, r_n] = tas._leaf_cap[hi, ri]
         leaf_perm.append(perm)
 
     return (
@@ -110,8 +113,6 @@ def encode_device_topos(
             level_size=jnp.asarray(level_size),
             parent_idx=jnp.asarray(parent_idx),
             leaf_cap=jnp.asarray(leaf_cap),
-            leaf_pods=jnp.asarray(leaf_pods),
-            pods_res_idx=pods_res_idx,
         ),
         per_flavor,
         leaf_perm,
@@ -211,7 +212,7 @@ def place(
         return iota < topo.level_size[t, jnp.clip(l, 0, LMAX - 1)]
 
     # ---- phase 1: leaf fill + roll-up -------------------------------------
-    free = topo.leaf_cap[t] - leaf_usage  # [D,R]
+    free = topo.leaf_cap[t] - leaf_usage  # [D,R] (incl. implicit-pods col)
     fits = jnp.full(d_n, _INF, jnp.int64)
     for r in range(r_n):  # static unroll over the resource axis
         fits = jnp.where(
@@ -221,13 +222,6 @@ def place(
             ),
             fits,
         )
-    pods_bound = jnp.maximum(topo.leaf_pods[t], 0)
-    if topo.pods_res_idx >= 0:
-        apply_pods = req[topo.pods_res_idx] <= 0
-        pods_free = jnp.maximum(free[:, topo.pods_res_idx], 0)
-        fits = jnp.where(apply_pods, jnp.minimum(fits, pods_free), fits)
-    else:
-        fits = jnp.minimum(fits, pods_bound)
     state_leaf = jnp.where(fits >= _INF, 0, fits)
     state_leaf = jnp.where(valid_at(leaf_l), state_leaf, 0)
 

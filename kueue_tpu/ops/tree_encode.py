@@ -137,7 +137,12 @@ class GroupLayout:
     max-entries-per-group. Built host-side from the flat arrays (static per
     spec change)."""
 
-    def __init__(self, parent: np.ndarray, active: np.ndarray) -> None:
+    def __init__(
+        self, parent: np.ndarray, active: np.ndarray, root_merge=None
+    ) -> None:
+        """``root_merge`` (optional): root node -> merge label; roots with
+        the same label share one group (used when trees share external
+        state, e.g. a TAS topology, and must serialize their scans)."""
         n = parent.shape[0]
         root_of = np.arange(n)
         # Resolve roots by pointer-jumping (depth bounded by MAX_DEPTH).
@@ -145,7 +150,14 @@ class GroupLayout:
             has_parent = parent[root_of] >= 0
             root_of = np.where(has_parent, parent[root_of], root_of)
         roots = sorted(set(root_of[active].tolist())) if active.any() else [0]
-        g_of_root = {r: g for g, r in enumerate(roots)}
+        if root_merge:
+            label_of = {r: root_merge.get(r, r) for r in roots}
+            labels = sorted(set(label_of.values()))
+            g_of_label = {lb: g for g, lb in enumerate(labels)}
+            g_of_root = {r: g_of_label[label_of[r]] for r in roots}
+            roots = labels
+        else:
+            g_of_root = {r: g for g, r in enumerate(roots)}
         self.n_groups = max(len(roots), 1)
         self.flat_to_group = np.zeros(n, dtype=np.int32)
         self.flat_to_local = np.zeros(n, dtype=np.int32)

@@ -109,7 +109,7 @@ def test_place_matches_host(seed):
         {"f": tas}, ["f"], resource_of
     )
     d_n = dev_topo.leaf_cap.shape[1]
-    leaf_usage = np.zeros((d_n, 2), np.int64)
+    leaf_usage = np.zeros((d_n, 3), np.int64)  # + implicit pods column
     perm = leaf_perms[0]
     host_leaf_ids = [leaf.id for leaf in tas.leaves]
     for j, hi in enumerate(perm):
@@ -132,7 +132,7 @@ def test_place_matches_host(seed):
     feasible, leaf_take = place(
         dev_topo, jnp.int32(0), jnp.asarray(leaf_usage),
         jnp.asarray([req.single_pod_requests.get("tpu", 0),
-                     req.single_pod_requests.get("memory", 0)],
+                     req.single_pod_requests.get("memory", 0), 1],
                     dtype=jnp.int64),
         jnp.int64(req.count), jnp.int64(slice_size),
         jnp.int32(slice_level), jnp.int32(req_level),
