@@ -861,7 +861,15 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
 
             pm0 = nom.best_pmode
             downgrade = tas_entry & (pm0 == P_FIT) & ~feas_now
-            pm1 = jnp.where(downgrade, P_PREEMPT_RAW, pm0)
+            # A downgraded entry on a CQ that can never find preemption
+            # targets resolves on device: the host's get_targets trivially
+            # returns none and the entry takes the reserve path.
+            pm1 = jnp.where(
+                downgrade,
+                jnp.where(arrays.never_preempts[arrays.w_cq],
+                          P_NO_CANDIDATES, P_PREEMPT_RAW),
+                pm0,
+            )
             pre_mode = tas_entry & (
                 (pm1 == P_PREEMPT_RAW) | (pm1 == P_NO_CANDIDATES)
             )

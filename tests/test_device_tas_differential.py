@@ -1,0 +1,129 @@
+"""Differential tests: device TAS scheduling vs host-exact scheduler.
+
+Random topology-aware scenarios (multi-rack fleets, required/preferred/
+unconstrained constraints, slice constraints, partial usage, multiple
+gangs): the DeviceScheduler must admit the same workloads with identical
+flavor choices AND identical topology domain assignments, without host
+fallback for the device-eligible class.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import (
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    Topology,
+    TopologyRequest,
+    Workload,
+    quota,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.tas.snapshot import Node
+
+from .helpers import make_cq
+
+LEVELS = ["tpu.block", "tpu.rack", "kubernetes.io/hostname"]
+
+
+def build_manager(seed: int, device: bool):
+    rng = random.Random(30_000 + seed)
+    n_levels = rng.randint(2, 3)
+    levels = LEVELS[-n_levels:]
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=levels),
+    )
+    for b in range(rng.randint(1, 3)):
+        for r in range(rng.randint(1, 3)):
+            for h in range(rng.randint(1, 3)):
+                labels = {}
+                if n_levels == 3:
+                    labels["tpu.block"] = f"b{b}"
+                    labels["tpu.rack"] = f"b{b}-r{r}"
+                else:
+                    labels["tpu.rack"] = f"b{b}-r{r}"
+                mgr.apply(Node(
+                    name=f"n-{b}-{r}-{h}", labels=labels,
+                    capacity={"tpu": rng.choice([4, 8])},
+                ))
+
+    workloads = []
+    for i in range(rng.randint(3, 9)):
+        mode = rng.choice(["required", "preferred", "unconstrained"])
+        level = rng.choice(levels)
+        count = rng.choice([1, 2, 3, 4, 6])
+        tr = TopologyRequest(
+            required_level=level if mode == "required" else None,
+            preferred_level=level if mode == "preferred" else None,
+            unconstrained=mode == "unconstrained",
+        )
+        if rng.random() < 0.35:
+            li = levels.index(level)
+            tr.slice_required_level = rng.choice(levels[li:])
+            for ss in (2, 3, 1):
+                if count % ss == 0:
+                    tr.slice_size = ss
+                    break
+        workloads.append(Workload(
+            name=f"g{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=count,
+                requests={"tpu": rng.choice([1, 2, 4])},
+                topology_request=tr,
+            )],
+            priority=rng.randrange(0, 3) * 100,
+            creation_time=float(i + 1),
+        ))
+    return mgr, workloads
+
+
+def run_one(seed: int, device: bool):
+    mgr, workloads = build_manager(seed, device)
+    fallbacks = []
+    if device:
+        sched = DeviceScheduler(mgr.cache, mgr.queues)
+        orig = sched._host_process
+
+        def spy(infos):
+            fallbacks.extend(i.obj.name for i in infos)
+            return orig(infos)
+
+        sched._host_process = spy
+    else:
+        sched = mgr.scheduler
+    for wl in workloads:
+        mgr.create_workload(wl)
+    sched.schedule_all(max_cycles=60)
+
+    state = {}
+    for wl in workloads:
+        adm = wl.status.admission
+        if adm is None:
+            state[wl.name] = None
+        else:
+            psa = adm.pod_set_assignments[0]
+            ta = psa.topology_assignment
+            state[wl.name] = (
+                sorted(psa.flavors.items()),
+                sorted(ta.domains) if ta else None,
+            )
+    return state, fallbacks
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_device_tas_matches_host(seed):
+    host_state, _ = run_one(seed, device=False)
+    dev_state, fallbacks = run_one(seed, device=True)
+    assert not fallbacks, f"unexpected host fallback: {fallbacks}"
+    for name in host_state:
+        assert dev_state[name] == host_state[name], (
+            f"{name}: host={host_state[name]} device={dev_state[name]}"
+        )
