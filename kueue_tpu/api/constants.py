@@ -115,3 +115,8 @@ class RequeueReason(str, enum.Enum):
     NO_FIT = "NoFit"
     PREEMPTION_NO_CANDIDATES = "PreemptionNoCandidates"
     NAMESPACE_MISMATCH = "NamespaceMismatch"
+    # The entry issued preemptions and waits for its victims' capacity
+    # (reference RequeueReasonPendingPreemption): requeued immediately and,
+    # under BestEffortFIFO, pinned to the head (stickyWorkload) so other
+    # entries cannot steal the freed capacity.
+    PENDING_PREEMPTION = "PendingPreemption"

@@ -334,7 +334,7 @@ class DeviceScheduler:
             cluster_queue_generation=cqs.allocatable_generation,
         )
         self.queues.requeue_workload(
-            info, RequeueReason.FAILED_AFTER_NOMINATION
+            info, RequeueReason.PENDING_PREEMPTION
         )
 
     def _apply_requeue(

@@ -297,13 +297,23 @@ def encode_cycle(
         for res, v in ps.requests.items():
             if res in tidx.resource_of:
                 w_req[i, tidx.resource_of[res]] = v
-        # Taints/affinity eligibility per flavor (host-side; reuses the exact
-        # assigner's check).
-        assigner = FlavorAssigner(info, cqs, resource_flavors)
-        pod_sets = [info.obj.pod_sets[0]]
-        for fname, fi in tidx.flavor_of.items():
-            ok, _ = assigner._check_flavor_for_podsets(fname, pod_sets)
-            w_elig[i, fi] = ok
+        # Taints/affinity eligibility per flavor (host-side; reuses the
+        # exact assigner's check). The verdict depends only on flavor specs
+        # and the podset, so it is cached on the WorkloadInfo keyed by the
+        # cache spec generation — a requeued workload re-encodes in O(F)
+        # array copy instead of re-running the matcher every cycle.
+        gen = cqs.allocatable_generation
+        cached = getattr(info, "_elig_cache", None)
+        if cached is not None and cached[0] == gen \
+                and cached[1].shape[0] == f:
+            w_elig[i] = cached[1]
+        else:
+            assigner = FlavorAssigner(info, cqs, resource_flavors)
+            pod_sets = [info.obj.pod_sets[0]]
+            for fname, fi in tidx.flavor_of.items():
+                ok, _ = assigner._check_flavor_for_podsets(fname, pod_sets)
+                w_elig[i, fi] = ok
+            info._elig_cache = (gen, w_elig[i].copy())
         if info.last_assignment is not None and (
             cqs.allocatable_generation
             <= info.last_assignment.cluster_queue_generation

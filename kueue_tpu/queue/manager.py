@@ -40,6 +40,11 @@ class ClusterQueueHeap:
         # Cycle snapshot guard (reference queueInadmissibleCycle): if capacity
         # changed since the last failed attempt, requeue immediately.
         self.queue_inadmissible_cycle = -1
+        # Sticky workload (reference cluster_queue.go stickyWorkload): the
+        # head currently preempting victims keeps the head slot on
+        # BestEffortFIFO until admitted, unschedulable, or deleted — other
+        # entries must not race for the capacity its evictions free.
+        self.sticky: Optional[str] = None
 
     @property
     def strategy(self) -> QueueingStrategy:
@@ -55,6 +60,12 @@ class ClusterQueueHeap:
             self._items[key] = info
 
     def pop_head(self, afs_usage_fn=None) -> Optional[WorkloadInfo]:
+        if self.sticky is not None:
+            info = self._items.pop(self.sticky, None)
+            if info is not None:
+                return info
+            # Admitted or gone: the sticky entry no longer pends.
+            self.sticky = None
         if afs_usage_fn is not None and self._items:
             # Usage-based admission fair sharing: lowest LocalQueue usage
             # first, base order as tiebreak (reference cluster_queue.go
@@ -78,6 +89,8 @@ class ClusterQueueHeap:
     def delete(self, key: str) -> None:
         self._items.pop(key, None)
         self.inadmissible.pop(key, None)
+        if self.sticky == key:
+            self.sticky = None
 
     def requeue_if_not_present(
         self, info: WorkloadInfo, reason: RequeueReason, scheduling_cycle: int
@@ -85,11 +98,20 @@ class ClusterQueueHeap:
         """reference cluster_queue.go:575 requeueIfNotPresent. Returns True
         when the workload went back to the active heap."""
         key = info.key
+        if (
+            reason == RequeueReason.PENDING_PREEMPTION
+            and self.strategy == QueueingStrategy.BEST_EFFORT_FIFO
+        ):
+            self.sticky = key
+        elif self.sticky == key:
+            # Unschedulable for another reason: loses the head pin.
+            self.sticky = None
         if key in self._items:
             return False
         immediate = (
             self.strategy == QueueingStrategy.STRICT_FIFO
             or reason == RequeueReason.FAILED_AFTER_NOMINATION
+            or reason == RequeueReason.PENDING_PREEMPTION
             or self.queue_inadmissible_cycle >= scheduling_cycle
         )
         if immediate:

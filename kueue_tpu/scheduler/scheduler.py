@@ -162,6 +162,10 @@ class Scheduler:
                 result.admitted.append(e.info.key)
             elif e.status == EntryStatus.PREEMPTING:
                 result.preempting.append(e.info.key)
+                # reference scheduler.go:287: the preemptor returns
+                # immediately and stays pinned at the head while its
+                # victims' evictions land.
+                e.requeue_reason = RequeueReason.PENDING_PREEMPTION
                 self._requeue_and_update(e)
             elif e.status != EntryStatus.EVICTED:
                 result.skipped.append(e.info.key)
