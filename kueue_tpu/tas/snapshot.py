@@ -666,50 +666,324 @@ class TASFlavorSnapshot:
             i += 1
         return result if remaining <= 0 else []
 
-    def _balance_counts(
-        self, domains: List[Domain], count: int, slice_size: int
-    ) -> List[Domain]:
-        """Balanced placement (reference tas_balanced_placement.go,
-        simplified): use the greedy-minimal number of domains, then spread
-        slices as evenly as capacity allows — maximizing the minimum
-        per-domain slice count instead of best-fit packing."""
-        slice_count = count // slice_size
-        ordered = self._sorted_domains(list(domains))
-        chosen: List[Domain] = []
+    # -- balanced placement (reference tas_balanced_placement.go) ------------
+
+    def _evaluate_greedy(
+        self, domains: List[Domain], slice_count: int, leader_count: int
+    ) -> Tuple[bool, int, Optional[Domain], Optional[Domain]]:
+        """evaluateGreedyAssignment :28: does the request fit, how many
+        domains the greedy takes, and the last domain used (with/without a
+        leader)."""
+        selected = 0
+        last_dom = None
+        last_dom_leader = None
         remaining = slice_count
-        for dom in ordered:
-            if remaining <= 0:
-                break
-            if dom.slice_state <= 0:
-                continue
-            chosen.append(dom)
-            remaining -= dom.slice_state
-        if remaining > 0 or not chosen:
-            return self._update_counts_to_minimum(
-                domains, count, 0, slice_size, True
+        remaining_leaders = leader_count
+        rest = list(domains)
+        if leader_count > 0:
+            with_leader = self._sorted_domains_with_leader(rest)
+            idx = 0
+            while (
+                remaining_leaders > 0
+                and idx < len(with_leader)
+                and with_leader[idx].leader_state > 0
+            ):
+                selected += 1
+                last_dom_leader = with_leader[idx]
+                remaining_leaders -= with_leader[idx].leader_state
+                remaining -= with_leader[idx].slice_state_with_leader
+                idx += 1
+            rest = with_leader[idx:]
+        if remaining_leaders > 0:
+            return False, 0, None, None
+        ordered = self._sorted_domains(rest)
+        idx = 0
+        while remaining > 0 and idx < len(ordered) and \
+                ordered[idx].slice_state > 0:
+            selected += 1
+            last_dom = ordered[idx]
+            remaining -= ordered[idx].slice_state
+            idx += 1
+        if remaining > 0:
+            return False, 0, None, None
+        return True, selected, last_dom_leader, last_dom
+
+    @staticmethod
+    def _balance_threshold(
+        slice_count: int, selected: int,
+        last_leader: Optional[Domain], last: Optional[Domain],
+    ) -> int:
+        """balanceThresholdValue :66."""
+        threshold = slice_count // selected
+        if last_leader is not None:
+            threshold = min(threshold, last_leader.slice_state_with_leader)
+        if last is not None:
+            threshold = min(threshold, last.slice_state)
+        return threshold
+
+    @staticmethod
+    def _domains_entropy(domains: List[Domain]) -> float:
+        total = sum(d.state for d in domains)
+        if not domains or total == 0:
+            return 0.0
+        entropy = 0.0
+        for d in domains:
+            if d.state > 0:
+                p = d.state / total
+                entropy += -p * math.log2(p)
+        return entropy
+
+    def _select_optimal_domain_set(
+        self, domains: List[Domain], slice_count: int, leader_count: int,
+        slice_size: int, prioritize_by_entropy: bool,
+    ) -> Optional[List[Domain]]:
+        """selectOptimalDomainSetToFit :82: DP over (domains-used,
+        leaders-left, capacity-left) finding a minimal-domain-count set
+        with minimal total capacity."""
+        fits, optimal_n, _, _ = self._evaluate_greedy(
+            domains, slice_count, leader_count
+        )
+        if not fits:
+            return None
+        ordered = list(domains)
+        if prioritize_by_entropy:
+            ordered.sort(key=lambda d: (
+                -d.leader_state, -d.slice_state_with_leader,
+                -self._domains_entropy(d.children), d.level_values,
+            ))
+        else:
+            ordered.sort(key=lambda d: d.level_values)
+
+        # placements[i][leaders_left][state_left] -> domain list.
+        placements: List[Dict[int, Dict[int, List[Domain]]]] = [
+            {} for _ in range(optimal_n + 1)
+        ]
+        placements[0][leader_count] = {slice_count * slice_size: []}
+        for d in ordered:
+            for i in range(optimal_n, 0, -1):
+                for before_leader in sorted(placements[i - 1]):
+                    for before_state in sorted(
+                        placements[i - 1][before_leader]
+                    ):
+                        if before_leader <= 0 and before_state <= 0:
+                            continue
+                        before = placements[i - 1][before_leader][
+                            before_state]
+                        new_placement = before + [d]
+                        if before_leader > 0 and d.leader_state > 0:
+                            after_l = before_leader - d.leader_state
+                            after_s = before_state - d.state_with_leader
+                            placements[i].setdefault(
+                                after_l, {}
+                            ).setdefault(after_s, new_placement)
+                        if d.slice_state > 0:
+                            after_s = before_state - d.state
+                            placements[i].setdefault(
+                                before_leader, {}
+                            ).setdefault(after_s, new_placement)
+
+        best_by_state = placements[optimal_n].get(0, {})
+        best_slice = None
+        best_placement = None
+        for slices_left in sorted(best_by_state):
+            if slices_left <= 0 and (
+                best_slice is None or slices_left > best_slice
+            ):
+                best_slice = slices_left
+                best_placement = best_by_state[slices_left]
+        return best_placement
+
+    def _place_slices_balanced(
+        self, domains: List[Domain], slice_count: int, leader_count: int,
+        slice_size: int, threshold: int,
+    ) -> Tuple[Optional[List[Domain]], str]:
+        """placeSlicesOnDomainsBalanced :150: give every selected domain
+        ``threshold`` slices, then distribute the extras front-to-back."""
+        result = self._select_optimal_domain_set(
+            domains, slice_count, leader_count, slice_size, False
+        )
+        if result is None:
+            return None, ("TAS Balanced Placement: Cannot find optimal"
+                          " domain set to fit the request")
+        if slice_count < len(result) * threshold:
+            return None, ("TAS Balanced Placement: Not enough slices to"
+                          " meet the threshold")
+        result = self._sorted_domains_with_leader(result)
+        extra_left = slice_count - len(result) * threshold
+        leaders_left = leader_count
+        for dom in result:
+            if leaders_left > 0:
+                take = min(dom.slice_state_with_leader - threshold,
+                           extra_left)
+                dom.leader_state = 1
+                leaders_left -= 1
+            elif extra_left > 0:
+                take = min(dom.slice_state - threshold, extra_left)
+                dom.leader_state = 0
+            else:
+                dom.leader_state = 0
+                take = 0
+            dom.state = (threshold + take) * slice_size
+            dom.slice_state = threshold + take
+            dom.slice_state_with_leader = dom.slice_state
+            dom.state_with_leader = dom.state - dom.leader_state
+            extra_left -= take
+        if extra_left > 0 or leaders_left > 0:
+            return None, ("TAS Balanced Placement: Not all slices or"
+                          " leaders could be placed")
+        return result, ""
+
+    def _clone_domain(self, d: Domain, parent: Optional[Domain]) -> Domain:
+        clone = Domain(d.level_values)
+        clone.parent = parent
+        clone.state = d.state
+        clone.state_with_leader = d.state_with_leader
+        clone.slice_state = d.slice_state
+        clone.slice_state_with_leader = d.slice_state_with_leader
+        clone.leader_state = d.leader_state
+        clone.free_capacity = dict(d.free_capacity)
+        clone.children = [
+            self._clone_domain(c, clone) for c in d.children
+        ]
+        return clone
+
+    @staticmethod
+    def _clear_state(d: Domain) -> None:
+        d.state = d.slice_state = 0
+        d.state_with_leader = d.slice_state_with_leader = 0
+        d.leader_state = 0
+        for c in d.children:
+            TASFlavorSnapshot._clear_state(c)
+
+    @staticmethod
+    def _clear_leader_capacity(d: Domain) -> None:
+        d.state_with_leader = d.slice_state_with_leader = 0
+        d.leader_state = 0
+        for c in d.children:
+            TASFlavorSnapshot._clear_leader_capacity(c)
+
+    @classmethod
+    def _prune_node_below_threshold(
+        cls, d: Domain, threshold: int, leader_required: bool
+    ) -> None:
+        if d.slice_state < threshold:
+            cls._clear_state(d)
+            return
+        if leader_required and d.leader_state > 0 and \
+                d.slice_state_with_leader < threshold:
+            cls._clear_leader_capacity(d)
+
+    def _prune_below_threshold(
+        self, domains: List[Domain], threshold: int, slice_size: int,
+        slice_level_idx: int, level: int, leader_required: bool,
+    ) -> None:
+        """pruneDomainsBelowThreshold :363."""
+        for d in domains:
+            for c in d.children:
+                self._prune_node_below_threshold(
+                    c, threshold, leader_required
+                )
+        for d in domains:
+            self._fill_counts_helper(
+                d, slice_size, slice_level_idx, level, leader_required
             )
-        # Even spread with capacity-aware waterfill.
-        alloc = {id(d): 0 for d in chosen}
-        left = slice_count
-        while left > 0:
-            # Give one slice to the chosen domain with the lowest allocation
-            # that still has room (maximizes the minimum).
-            candidates = [
-                d for d in chosen if alloc[id(d)] < d.slice_state
-            ]
-            d = min(candidates, key=lambda x: (alloc[id(x)],
-                                               x.level_values))
-            alloc[id(d)] += 1
-            left -= 1
-        out = []
-        for d in chosen:
-            if alloc[id(d)] == 0:
+            self._prune_node_below_threshold(d, threshold, leader_required)
+
+    def _lower_level_domains(self, domains: List[Domain]) -> List[Domain]:
+        return [c for d in domains for c in d.children]
+
+    def _find_best_domains_balanced(
+        self, slice_count: int, leader_count: int, slice_size: int,
+        slice_level_idx: int, requested_level_idx: int,
+    ) -> Tuple[Optional[List[Domain]], int]:
+        """findBestDomainsForBalancedPlacement :232: evaluate each
+        requested-level sibling group, maximizing the balance threshold."""
+        if requested_level_idx == 0:
+            groups = [list(self.domains_per_level[0])]
+        else:
+            uppers = sorted(
+                self.domains_per_level[requested_level_idx - 1],
+                key=lambda d: d.level_values,
+            )
+            groups = [list(u.children) for u in uppers]
+
+        best_threshold = 0
+        best_count = 0
+        best_fit: Optional[List[Domain]] = None
+        for group in groups:
+            candidates = [self._clone_domain(d, None) for d in group]
+            lower = (
+                self._lower_level_domains(candidates)
+                if requested_level_idx < slice_level_idx else candidates
+            )
+            fits, selected, last_leader, last = self._evaluate_greedy(
+                lower, slice_count, leader_count
+            )
+            if not fits:
                 continue
-            d.slice_state = alloc[id(d)]
-            d.state = alloc[id(d)] * slice_size
-            d.leader_state = 0
-            out.append(d)
-        return out
+            threshold = self._balance_threshold(
+                slice_count, selected, last_leader, last
+            )
+            threshold_with_reserve = threshold
+            if leader_count > 0 and last is not None:
+                threshold_with_reserve = min(
+                    threshold, last.slice_state_with_leader
+                )
+            if threshold < best_threshold:
+                continue
+            self._prune_below_threshold(
+                candidates, threshold, slice_size, slice_level_idx,
+                requested_level_idx, leader_count > 0,
+            )
+            fits2, count2, _, _ = self._evaluate_greedy(
+                candidates, slice_count, leader_count
+            )
+            if not fits2 and threshold_with_reserve < threshold:
+                if threshold_with_reserve <= 0 or \
+                        threshold_with_reserve < best_threshold:
+                    continue
+                threshold = threshold_with_reserve
+                candidates = [self._clone_domain(d, None) for d in group]
+                self._prune_below_threshold(
+                    candidates, threshold, slice_size, slice_level_idx,
+                    requested_level_idx, leader_count > 0,
+                )
+                fits2, count2, _, _ = self._evaluate_greedy(
+                    candidates, slice_count, leader_count
+                )
+            if not fits2:
+                continue
+            if threshold > best_threshold or (
+                threshold == best_threshold and count2 < best_count
+            ):
+                best_threshold = threshold
+                best_count = count2
+                best_fit = candidates
+        return best_fit, best_threshold
+
+    def _apply_balanced_placement(
+        self, curr_fit: List[Domain], best_threshold: int,
+        slice_count: int, leader_count: int, slice_size: int,
+        slice_level_idx: int, requested_level_idx: int,
+    ) -> Tuple[Optional[List[Domain]], int, str]:
+        """applyBalancedPlacementAlgorithm :293."""
+        if requested_level_idx < slice_level_idx:
+            result = self._select_optimal_domain_set(
+                curr_fit, slice_count, leader_count, slice_size, True
+            )
+            if result is None:
+                return None, 0, ("TAS Balanced Placement: Cannot find"
+                                 " optimal domain set to fit the request")
+            curr_fit = self._lower_level_domains(result)
+            fit_level_idx = requested_level_idx + 1
+        else:
+            fit_level_idx = requested_level_idx
+        placed, reason = self._place_slices_balanced(
+            curr_fit, slice_count, leader_count, slice_size, best_threshold
+        )
+        if reason:
+            return None, 0, reason
+        return placed, fit_level_idx, ""
 
     # -- main entry ------------------------------------------------------------
 
@@ -785,43 +1059,52 @@ class TASFlavorSnapshot:
             required_replacement_domain,
         )
 
-        # phase 2a
-        fit_level_idx, curr, reason = self._find_level_with_fit(
-            requested_level_idx, req, slice_size, required, unconstrained,
-            leader_count,
-        )
-        if reason:
-            return None, None, reason
-
-        # phase 2b: descend, minimizing domains per level.
-        use_balanced = req.balanced and not required and not unconstrained
-        balance_level = requested_level_idx if use_balanced else -1
-        if fit_level_idx == balance_level:
-            # Fit found at the balance level: spread evenly right here,
-            # using the pristine phase-1 counts of the whole level.
-            curr = self._balance_counts(
-                self._sorted_domains(
-                    list(self.domains_per_level[balance_level])
-                ),
-                req.count, slice_size,
+        # Balanced placement (reference tas_balanced_placement.go +
+        # tas_flavor_snapshot.go:1068): find the sibling group with the
+        # highest balance threshold, pick a minimal optimal domain set via
+        # DP, give every selected domain the threshold, distribute the
+        # extras; fall back to BestFit on any failure.
+        slice_count = req.count // slice_size
+        use_balanced = False
+        curr: List[Domain] = []
+        fit_level_idx = 0
+        if req.balanced and not required and not unconstrained:
+            best_fit, best_threshold = self._find_best_domains_balanced(
+                slice_count, leader_count, slice_size, slice_level_idx,
+                requested_level_idx,
             )
-        else:
+            if best_threshold > 0 and best_fit is not None:
+                placed, fl, reason_b = self._apply_balanced_placement(
+                    best_fit, best_threshold, slice_count, leader_count,
+                    slice_size, slice_level_idx, requested_level_idx,
+                )
+                if not reason_b and placed is not None:
+                    use_balanced = True
+                    curr = placed
+                    fit_level_idx = fl
+
+        # phase 2a
+        if not use_balanced:
+            fit_level_idx, curr, reason = self._find_level_with_fit(
+                requested_level_idx, req, slice_size, required,
+                unconstrained, leader_count,
+            )
+            if reason:
+                return None, None, reason
+
+            # phase 2b: descend, minimizing domains per level.
             curr = self._update_counts_to_minimum(
                 curr, req.count, leader_count, slice_size, True
             )
         level_idx = fit_level_idx
-        while level_idx < min(len(self.level_keys) - 1, slice_level_idx):
+        while level_idx < min(len(self.level_keys) - 1, slice_level_idx) \
+                and not use_balanced:
             # Above the slice level: slices may be re-distributed freely
-            # across all lower domains (reference :1092-1099). Under
-            # balanced placement, stop the free loop at the requested
-            # level and spread evenly there (tas_balanced_placement.go).
+            # across all lower domains (reference :1092-1099); balanced
+            # placement skips this loop — its per-domain counts are final.
             lower = self._sorted_domains(
                 [c for d in curr for c in d.children]
             )
-            if level_idx + 1 == balance_level:
-                curr = self._balance_counts(lower, req.count, slice_size)
-                level_idx += 1
-                break
             curr = self._update_counts_to_minimum(
                 lower, req.count, leader_count, slice_size, True
             )

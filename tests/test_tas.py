@@ -257,23 +257,55 @@ def test_e2e_tas_usage_released_on_delete():
 
 
 def test_balanced_placement_spreads_evenly():
-    """Balanced preferred placement: 4 slices over 2 racks -> 2+2, not
-    best-fit packing into one domain chain."""
+    """Balanced preferred placement (reference tas_balanced_placement.go):
+    the balance threshold applies at the slice-level domains (nodes here) —
+    6 pods land as 2+2+2 on three nodes, never 2+2+1+1 or lopsided
+    packing."""
     snap = snapshot()
-    # rack capacity: 2 nodes x 4 tpu = 8 tpu = 4 pods of 2 tpu.
+    # node capacity: 4 tpu = 2 pods of 2 tpu.
     ta, _, reason = snap.find_topology_assignment(
         PlacementRequest(count=6, single_pod_requests={"tpu": 2},
                          preferred_level=LEVELS[1], balanced=True)
     )
     assert reason == ""
     assert sum(c for _, c in ta.domains) == 6
-    # Count pods per rack (nodes are named node-<b>-<r>-<n>).
-    per_rack = {}
-    for v, c in ta.domains:
-        rack = v[-1].rsplit("-", 1)[0]
-        per_rack[rack] = per_rack.get(rack, 0) + c
-    # 6 pods over 2 racks balanced -> 3 + 3 (not 4 + 2).
-    assert sorted(per_rack.values()) == [3, 3], per_rack
+    # Every chosen node carries exactly the threshold (2 pods).
+    per_node = {v[-1]: c for v, c in ta.domains}
+    assert sorted(per_node.values()) == [2, 2, 2], per_node
+
+
+def test_balanced_placement_threshold_maximizes_minimum():
+    """With uneven free capacity the balanced threshold is the max-min:
+    usage on one node forces the spread to use the remaining capacity
+    while keeping every selected slice-level domain at >= threshold."""
+    snap = snapshot()
+    # Take 2 tpu on one node: its capacity drops to 1 pod of 2 tpu.
+    snap.add_usage(snap.leaves[0].id, {"tpu": 2})
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=6, single_pod_requests={"tpu": 2},
+                         preferred_level=LEVELS[1], balanced=True)
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 6
+    per_node = {v[-1]: c for v, c in ta.domains}
+    # Threshold 2 still achievable on three full nodes.
+    assert sorted(per_node.values()) == [2, 2, 2], per_node
+    assert snap.leaves[0].id.split("/")[-1] not in per_node
+
+
+def test_balanced_placement_distributes_extras():
+    """Extras above the threshold go front-to-back in sorted order: 5 pods
+    over nodes of 2 -> threshold 1 would waste balance; the algorithm picks
+    3 nodes (greedy minimum) and splits 2+2+1."""
+    snap = snapshot()
+    ta, _, reason = snap.find_topology_assignment(
+        PlacementRequest(count=5, single_pod_requests={"tpu": 2},
+                         preferred_level=LEVELS[1], balanced=True)
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 5
+    per_node = {v[-1]: c for v, c in ta.domains}
+    assert sorted(per_node.values()) == [1, 2, 2], per_node
 
 
 def test_leader_worker_placement():
@@ -338,3 +370,70 @@ def test_multi_layer_slice_validation():
         )
     )
     assert "finer-grained" in reason
+
+
+def test_balanced_placement_with_leader():
+    """Leaders under balanced mode (reference evaluateGreedyAssignment
+    leader branch): the leader lands on a selected domain and worker
+    capacity still meets the threshold."""
+    snap = snapshot()
+    ta, leader_ta, reason = snap.find_topology_assignment(
+        PlacementRequest(count=4, single_pod_requests={"tpu": 2},
+                         preferred_level=LEVELS[1], balanced=True,
+                         leader_requests={"tpu": 1})
+    )
+    assert reason == ""
+    assert sum(c for _, c in ta.domains) == 4
+    assert leader_ta is not None
+    assert sum(c for _, c in leader_ta.domains) == 1
+    # The leader's node is one of the worker nodes (colocated capacity).
+    leader_node = leader_ta.domains[0][0][-1]
+    assert leader_node in {v[-1] for v, c in ta.domains}
+
+
+def test_balanced_threshold_is_maximal_brute_force():
+    """Property check on enumerated small cases: the per-domain minimum
+    achieved by balanced placement equals the best possible max-min over
+    all feasible greedy-minimal domain subsets."""
+    import itertools
+    import random as _random
+
+    rng = _random.Random(5)
+    for trial in range(40):
+        caps = [rng.randint(0, 4) for _ in range(rng.randint(2, 5))]
+        total = sum(caps)
+        if total == 0:
+            continue
+        count = rng.randint(1, total)
+        nodes = [
+            Node(name=f"h{i}", labels={"tpu.rack": "r0"},
+                 capacity={"tpu": c})
+            for i, c in enumerate(caps)
+        ]
+        topo = Topology(name="t",
+                        levels=["tpu.rack", "kubernetes.io/hostname"])
+        snap = TASFlavorSnapshot(topo, nodes)
+        ta, _, reason = snap.find_topology_assignment(
+            PlacementRequest(count=count, single_pod_requests={"tpu": 1},
+                             preferred_level="tpu.rack", balanced=True)
+        )
+        assert reason == "", (caps, count, reason)
+        got = sorted(c for _, c in ta.domains)
+        assert sum(got) == count
+
+        # Brute force: minimal number of domains needed (greedy), then the
+        # best achievable minimum allocation over subsets of that size.
+        n_min = None
+        for k in range(1, len(caps) + 1):
+            if sum(sorted(caps, reverse=True)[:k]) >= count:
+                n_min = k
+                break
+        best_min = 0
+        for subset in itertools.combinations(range(len(caps)), n_min):
+            if sum(caps[i] for i in subset) < count:
+                continue
+            floor = count // n_min
+            best_min = max(best_min, min(
+                min(caps[i] for i in subset), floor
+            ))
+        assert min(got) >= best_min, (caps, count, got, best_min)
