@@ -220,6 +220,27 @@ def decode(doc: Dict[str, Any]):
         )
     if kind == "Namespace":
         return Namespace(name=name, labels=meta.get("labels", {}))
+    if kind == "ResourceSlice":
+        from kueue_tpu.dra import Device, ResourceSlice
+
+        return ResourceSlice(
+            name=name,
+            driver=spec.get("driver", ""),
+            pool=(spec.get("pool") or {}).get("name", spec.get("pool", ""))
+            if isinstance(spec.get("pool"), dict) else spec.get("pool", ""),
+            devices=[
+                Device(
+                    name=d.get("name", ""),
+                    attributes=dict(d.get("attributes", {})),
+                    capacity={
+                        r: parse_quantity(v, r)
+                        for r, v in d.get("capacity", {}).items()
+                    },
+                    counters=dict(d.get("counters", {})),
+                )
+                for d in spec.get("devices", [])
+            ],
+        )
     if kind == "Workload":
         wl = Workload(
             name=name,

@@ -45,6 +45,11 @@ class Cache:
         self.local_queues: Dict[str, LocalQueue] = {}
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, object] = {}
+        # DRA inventory (kueue_tpu.dra.ResourceSlice) by name.
+        self.resource_slices: Dict[str, object] = {}
+        # DeviceClassMappings used to fold slice devices into TAS leaf
+        # capacity (set by the Manager from configuration).
+        self.device_class_mappings: list = []
         # Usage by pods outside kueue's management, per (flavor, leaf
         # domain) (reference tas_non_tas_pod_cache.go).
         self.non_tas_usage: Dict[str, Dict[str, Dict[str, int]]] = {}
@@ -112,6 +117,18 @@ class Cache:
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
+            self.generation += 1
+
+    def add_or_update_resource_slice(self, rs) -> None:
+        """DRA inventory (kueue_tpu.dra.ResourceSlice); slices feed charge
+        computation and TAS leaf capacity, so spec generation bumps."""
+        with self._lock:
+            self.resource_slices[rs.name] = rs
+            self.generation += 1
+
+    def delete_resource_slice(self, name: str) -> None:
+        with self._lock:
+            self.resource_slices.pop(name, None)
             self.generation += 1
 
     # -- workload lifecycle -------------------------------------------------
@@ -278,13 +295,39 @@ class Cache:
             # Per-flavor topology snapshots (reference tas_flavor.go). The
             # domain tree + capacity arrays are immutable between node or
             # topology changes, so they're cached and shared per cycle.
+            # DRA: ResourceSlices whose pool names a node add the mapped
+            # logical-resource device counts to that node's TAS capacity
+            # (kueue_tpu.dra.node_device_counts).
+            tas_nodes = self.nodes
+            if self.resource_slices and self.device_class_mappings:
+                from kueue_tpu.dra import node_device_counts
+
+                counts = node_device_counts(
+                    list(self.resource_slices.values()),
+                    self.device_class_mappings,
+                )
+                if counts:
+                    tas_nodes = {}
+                    for name2, node in self.nodes.items():
+                        extra = counts.get(name2)
+                        if extra:
+                            node = Node(
+                                name=node.name, labels=dict(node.labels),
+                                capacity=dict(node.capacity),
+                                taints=list(node.taints), ready=node.ready,
+                            )
+                            for r2, v2 in extra.items():
+                                node.capacity[r2] = (
+                                    node.capacity.get(r2, 0) + v2
+                                )
+                        tas_nodes[name2] = node
             for name, rf in self.resource_flavors.items():
                 if rf.topology_name and rf.topology_name in self.topologies:
                     cached = self._tas_templates.get(name)
                     if cached is None or cached[0] != self.generation:
                         template = TASFlavorSnapshot(
                             self.topologies[rf.topology_name],
-                            self.nodes.values(),
+                            tas_nodes.values(),
                             flavor_taints=rf.node_taints,
                             flavor_tolerations=rf.tolerations,
                         )

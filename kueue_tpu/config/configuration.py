@@ -57,10 +57,36 @@ class DeviceClassMapping:
     ``name`` is the logical resource referenced by ClusterQueue quotas;
     ``device_class_names`` are the DRA device classes it covers. Pod-set
     ``device_requests`` naming one of those classes are counted against
-    ``name`` at workload creation."""
+    ``name`` at workload creation. ``sources`` switches from whole-device
+    counting to ResourceSlice-derived counter/capacity charges
+    (kueue_tpu.dra)."""
 
     name: str
     device_class_names: List[str] = field(default_factory=list)
+    sources: List[object] = field(default_factory=list)
+
+
+def _parse_dra_sources(raw: List[dict]) -> List[object]:
+    """Parse DeviceClassMapping sources (counter / capacity)."""
+    from kueue_tpu.dra import CapacitySource, CounterSource
+
+    out: List[object] = []
+    for s in raw:
+        if "counter" in s:
+            c = s["counter"]
+            out.append(CounterSource(
+                driver=c.get("driver", ""), name=c.get("name", ""),
+                selector=dict(c.get("selector", {})),
+            ))
+        if "capacity" in s:
+            c = s["capacity"]
+            out.append(CapacitySource(
+                driver=c.get("driver", ""),
+                resource_name=c.get("resourceName",
+                                    c.get("resource_name", "")),
+                selector=dict(c.get("selector", {})),
+            ))
+    return out
 
 
 @dataclass
@@ -186,6 +212,7 @@ def load(source) -> Configuration:
                 device_class_names=list(
                     m.get("deviceClassNames", m.get("device_class_names", []))
                 ),
+                sources=_parse_dra_sources(m.get("sources", [])),
             )
             for m in res.get("deviceClassMappings",
                              res.get("device_class_mappings", []))
@@ -285,6 +312,7 @@ def build_manager(cfg: Configuration, **kw):
     )
     mgr.resource_transformations = list(cfg.resources.transformations)
     mgr.device_class_mappings = list(cfg.resources.device_class_mappings)
+    mgr.cache.device_class_mappings = mgr.device_class_mappings
     mgr.manage_jobs_without_queue_name = cfg.manage_jobs_without_queue_name
     from kueue_tpu.controllers.jobframework import registry
 

@@ -1,0 +1,182 @@
+"""DRA: ResourceSlice capacity model.
+
+Behavioral surface: reference pkg/dra/{resourceslice_cache,mapper,counters,
+capacity}.go — DeviceClassMappings may carry *sources* that derive the
+quota charge of a device request from driver-published ResourceSlices
+instead of whole-device counting:
+
+  * counter source: charge = max over matching devices of the named
+    counter's consumption, times the requested device count
+    (counters.go:328 computeCounterCharges);
+  * capacity source: charge = max over matching devices of the named
+    capacity dimension (explicit claim request taking precedence), times
+    the count (capacity.go computeCapacityCharge);
+  * no sources: whole-device counting (one logical unit per device).
+
+Device selection is the idiomatic analog of the reference's CEL device
+selectors: a flat attribute-equality match on the device's published
+attributes. Insufficient matching devices is a cluster-state error
+(retryable in the reference; surfaced as a ValueError here).
+
+ResourceSlices whose ``pool`` names a fleet Node also feed that node's TAS
+leaf capacity (the reference counts DRA devices into TAS leaf domains via
+the node's slices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Device:
+    """One device in a ResourceSlice (reference resourcev1.Device)."""
+
+    name: str
+    attributes: Dict[str, object] = field(default_factory=dict)
+    capacity: Dict[str, int] = field(default_factory=dict)
+    # Flattened consumesCounters: counter name -> consumption.
+    counters: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceSlice:
+    """reference resourcev1.ResourceSlice (driver-published inventory)."""
+
+    name: str
+    driver: str = ""
+    pool: str = ""  # commonly the node name
+    devices: List[Device] = field(default_factory=list)
+
+
+@dataclass
+class CounterSource:
+    """reference configuration DeviceClassMapping counter source."""
+
+    driver: str
+    name: str  # counter name
+    selector: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class CapacitySource:
+    """reference configuration DeviceClassMapping capacity source."""
+
+    driver: str
+    resource_name: str  # capacity dimension on the device
+    selector: Dict[str, object] = field(default_factory=dict)
+
+
+def _device_matches(dev: Device, selector: Dict[str, object]) -> bool:
+    return all(dev.attributes.get(k) == v for k, v in selector.items())
+
+
+def match_devices(
+    slices: List[ResourceSlice], driver: str, selector: Dict[str, object]
+) -> List[Device]:
+    """matchDevicesForSource: list the driver's slices, filter devices by
+    the selector."""
+    out: List[Device] = []
+    for s in slices:
+        if driver and s.driver != driver:
+            continue
+        for dev in s.devices:
+            if _device_matches(dev, selector):
+                out.append(dev)
+    return out
+
+
+def counter_charge(
+    slices: List[ResourceSlice], src: CounterSource, count: int
+) -> int:
+    """computeCounterCharges (counters.go:328): max matching-device counter
+    consumption x count; insufficient devices or no counter entry raise."""
+    matched = match_devices(slices, src.driver, src.selector)
+    if len(matched) < count:
+        raise ValueError(
+            f"insufficient matching devices for counter driver "
+            f"{src.driver!r}: {len(matched)} device(s) match but "
+            f"{count} requested"
+        )
+    best: Optional[int] = None
+    for dev in matched:
+        v = dev.counters.get(src.name)
+        if v is not None and (best is None or v > best):
+            best = v
+    if best is None:
+        raise ValueError(
+            f"matched devices have no consumesCounters entry for counter "
+            f"{src.name!r}"
+        )
+    return max(best, 0) * count
+
+
+def capacity_charge(
+    slices: List[ResourceSlice], src: CapacitySource, count: int,
+    explicit_request: Optional[int] = None,
+) -> int:
+    """computeCapacityCharge (capacity.go): max matching-device capacity in
+    the named dimension (explicit claim request wins when given) x count."""
+    matched = match_devices(slices, src.driver, src.selector)
+    if len(matched) < count:
+        raise ValueError(
+            f"insufficient matching devices for capacity driver "
+            f"{src.driver!r}: {len(matched)} device(s) match but "
+            f"{count} requested"
+        )
+    best: Optional[int] = None
+    for dev in matched:
+        cap = dev.capacity.get(src.resource_name)
+        if cap is None:
+            continue
+        v = explicit_request if explicit_request is not None else cap
+        if best is None or v > best:
+            best = v
+    if best is None:
+        raise ValueError(
+            f"matched devices have no capacity dimension "
+            f"{src.resource_name!r}"
+        )
+    return max(best, 0) * count
+
+
+def charges_for_request(
+    slices: List[ResourceSlice], mapping, count: int
+) -> int:
+    """Quota charge of one device-class request under a mapping
+    (mapper.go + counters.go + capacity.go). Whole-device counting when the
+    mapping has no sources."""
+    sources = getattr(mapping, "sources", None) or []
+    if not sources:
+        return count
+    total = 0
+    for src in sources:
+        if isinstance(src, CounterSource):
+            total += counter_charge(slices, src, count)
+        else:
+            total += capacity_charge(slices, src, count)
+    return total
+
+
+def node_device_counts(
+    slices: List[ResourceSlice], mappings
+) -> Dict[str, Dict[str, int]]:
+    """Per-node logical-resource device counts: slices whose pool names a
+    node contribute one unit per mapped device (TAS leaf capacity feed)."""
+    by_class: Dict[str, object] = {}
+    for m in mappings:
+        for dc in m.device_class_names:
+            by_class.setdefault(dc, m)
+    out: Dict[str, Dict[str, int]] = {}
+    for s in slices:
+        if not s.pool:
+            continue
+        for dev in s.devices:
+            dc = dev.attributes.get("deviceClass")
+            m = by_class.get(dc) if dc else None
+            if m is None:
+                continue
+            dst = out.setdefault(s.pool, {})
+            dst[m.name] = dst.get(m.name, 0) + 1
+    return out

@@ -160,6 +160,9 @@ class Manager:
                 self.cache.add_or_update_admission_check(obj)
             elif isinstance(obj, Node):
                 self.cache.add_or_update_node(obj)
+            elif type(obj).__name__ == "ResourceSlice":
+                self.cache.device_class_mappings = self.device_class_mappings
+                self.cache.add_or_update_resource_slice(obj)
             elif isinstance(obj, Namespace):
                 self.cache.namespaces[obj.name] = obj
             elif isinstance(obj, WorkloadPriorityClass):
@@ -215,20 +218,29 @@ class Manager:
         # unmapped device classes make the workload inadmissible — here,
         # rejected at creation).
         if any(ps.device_requests for ps in wl.pod_sets):
+            from kueue_tpu.dra import charges_for_request
+
             by_class = {
-                dc: m.name
+                dc: m
                 for m in self.device_class_mappings
                 for dc in m.device_class_names
             }
+            slices = list(self.cache.resource_slices.values())
             for ps in wl.pod_sets:
                 for dc, n in ps.device_requests.items():
-                    res = by_class.get(dc)
-                    if res is None:
+                    m = by_class.get(dc)
+                    if m is None:
                         raise ValueError(
                             f"workload {wl.key}: device class {dc!r} has no "
                             f"deviceClassMappings entry"
                         )
-                    ps.requests[res] = ps.requests.get(res, 0) + n
+                    try:
+                        charge = charges_for_request(slices, m, n)
+                    except ValueError as exc:
+                        raise ValueError(
+                            f"workload {wl.key}: {exc}"
+                        ) from exc
+                    ps.requests[m.name] = ps.requests.get(m.name, 0) + charge
                 # Folded into requests; cleared so a checkpoint restore
                 # through create_workload cannot double-count.
                 ps.device_requests = {}

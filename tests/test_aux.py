@@ -680,3 +680,128 @@ def test_multikueue_state_rebuilt_after_restore():
     for _ in range(2):
         hub2.tick()
     assert is_finished(wl2), "remote completion must mirror after restore"
+
+
+def test_dra_resourceslice_counter_and_capacity_sources():
+    """ResourceSlice-derived charges (reference pkg/dra/counters.go:328 +
+    capacity.go): counter source charges max-consumption x count; capacity
+    source charges max-capacity x count; insufficient devices reject."""
+    from kueue_tpu.api.types import LocalQueue, PodSet, Workload, quota
+    from kueue_tpu.core.workload_info import is_admitted
+    from kueue_tpu.dra import Device, ResourceSlice
+
+    from .helpers import make_cq
+
+    cfg = load({
+        "resources": {
+            "deviceClassMappings": [
+                {"name": "tpu-cores",
+                 "deviceClassNames": ["tpu.dra.x-k8s.io"],
+                 "sources": [{"counter": {
+                     "driver": "tpu.google.com",
+                     "name": "cores",
+                 }}]},
+                {"name": "accel-memory",
+                 "deviceClassNames": ["mem.dra.x-k8s.io"],
+                 "sources": [{"capacity": {
+                     "driver": "tpu.google.com",
+                     "resourceName": "memory",
+                 }}]},
+            ],
+        },
+    })
+    mgr = build_manager(cfg)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", resources=("tpu-cores", "accel-memory"),
+                flavors={"default": {
+                    "tpu-cores": quota(64), "accel-memory": quota(1000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.apply(ResourceSlice(
+        name="slice-1", driver="tpu.google.com", pool="host-1",
+        devices=[
+            Device(name="d0", counters={"cores": 8},
+                   capacity={"memory": 100}),
+            Device(name="d1", counters={"cores": 4},
+                   capacity={"memory": 200}),
+        ],
+    ))
+
+    wl = Workload(name="dra-counter", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1,
+               device_requests={"tpu.dra.x-k8s.io": 2}),
+    ])
+    mgr.create_workload(wl)
+    # charge = max(8, 4) x 2 = 16 cores.
+    assert wl.pod_sets[0].requests == {"tpu-cores": 16}
+    mgr.schedule_all()
+    assert is_admitted(wl)
+
+    wl2 = Workload(name="dra-capacity", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1,
+               device_requests={"mem.dra.x-k8s.io": 2}),
+    ])
+    mgr.create_workload(wl2)
+    # charge = max(100, 200) x 2 = 400 memory units.
+    assert wl2.pod_sets[0].requests == {"accel-memory": 400}
+
+    import pytest
+
+    too_many = Workload(name="dra-overflow", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1,
+               device_requests={"tpu.dra.x-k8s.io": 3}),
+    ])
+    with pytest.raises(ValueError, match="insufficient matching devices"):
+        mgr.create_workload(too_many)
+
+
+def test_dra_resourceslice_feeds_tas_leaf_capacity():
+    """Slices pooled on a node add mapped device counts to that node's TAS
+    leaf capacity: a gang whose chips exist only via ResourceSlices places
+    on the right host."""
+    from kueue_tpu.api.types import (
+        LocalQueue, PodSet, TopologyRequest, Workload, quota,
+    )
+    from kueue_tpu.core.workload_info import is_admitted
+    from kueue_tpu.dra import Device, ResourceSlice
+
+    from .helpers import make_cq
+    from .test_tas import LEVELS, make_nodes, make_topology
+
+    cfg = load({
+        "resources": {
+            "deviceClassMappings": [
+                {"name": "tpu",
+                 "deviceClassNames": ["tpu.dra.x-k8s.io"]},
+            ],
+        },
+    })
+    mgr = build_manager(cfg)
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="tpu-topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(64)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        make_topology(),
+    )
+    for node in make_nodes(tpu=0):  # nodes publish NO static tpu capacity
+        mgr.apply(node)
+    # One node's chips arrive via a ResourceSlice instead.
+    mgr.apply(ResourceSlice(
+        name="slice-n000", driver="tpu.google.com", pool="node-0-0-0",
+        devices=[
+            Device(name=f"chip{i}",
+                   attributes={"deviceClass": "tpu.dra.x-k8s.io"})
+            for i in range(4)
+        ],
+    ))
+    wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=1, requests={"tpu": 4},
+        topology_request=TopologyRequest(required_level=LEVELS[2]),
+    )], creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl), wl.status
+    ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta.domains == [(("node-0-0-0",), 1)]
