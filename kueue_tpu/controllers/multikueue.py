@@ -105,25 +105,35 @@ class MultiKueueController(AdmissionCheckController):
             st.nominated = list(clusters)
 
         # Mirror the workload to nominated workers (readGroup/createRemote).
+        # Unreachable workers are skipped; the reconnect/backoff lives in
+        # the transport client (reference multikueuecluster.go).
         for cluster in st.nominated:
             worker = self.workers[cluster]
-            if wl.key not in worker.workloads:
-                copy = wl.clone()
-                copy.status = type(copy.status)()  # fresh status on remote
-                try:
+            try:
+                if wl.key not in worker.workloads:
+                    copy = wl.clone()
+                    copy.status = type(copy.status)()  # fresh remote status
                     worker.create_workload(copy)
-                except ValueError:
-                    continue
+            except ValueError:
+                continue
+            except ConnectionError:
+                continue
 
         # Let the remote schedulers make progress, then look for a winner.
         for cluster in st.nominated:
             worker = self.workers[cluster]
-            worker.schedule()
+            try:
+                worker.schedule()
+            except ConnectionError:
+                continue
 
         winner = st.winner
         if winner is None:
             for cluster in st.nominated:
-                remote = self.workers[cluster].workloads.get(wl.key)
+                try:
+                    remote = self.workers[cluster].workloads.get(wl.key)
+                except ConnectionError:
+                    continue
                 if remote is not None and has_quota_reservation(remote):
                     winner = cluster
                     break
@@ -141,9 +151,12 @@ class MultiKueueController(AdmissionCheckController):
             if cluster == winner:
                 continue
             worker = self.workers[cluster]
-            remote = worker.workloads.get(wl.key)
-            if remote is not None:
-                worker.delete_workload(remote)
+            try:
+                remote = worker.workloads.get(wl.key)
+                if remote is not None:
+                    worker.delete_workload(remote)
+            except ConnectionError:
+                continue  # retried on the next sync
         wl.status.cluster_name = winner
         self._mirror_topology(wl, self.workers[winner].workloads.get(wl.key))
         acs.state = CheckState.READY
@@ -173,7 +186,14 @@ class MultiKueueController(AdmissionCheckController):
                 return
         now = manager.clock()
         worker = self.workers.get(st.winner)
-        remote = worker.workloads.get(wl.key) if worker is not None else None
+        try:
+            remote = (
+                worker.workloads.get(wl.key) if worker is not None else None
+            )
+        except ConnectionError:
+            # Transport down: indistinguishable from a lost worker; start
+            # (or continue) the workerLostTimeout clock.
+            remote = None
         if worker is None or remote is None:
             # Worker unreachable/lost the workload: wait out the grace
             # period before redispatching (workerLostTimeout).

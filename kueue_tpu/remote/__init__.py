@@ -1,0 +1,14 @@
+"""Process-boundary transport for MultiKueue worker clusters.
+
+The manager talks to worker clusters through a serialized-snapshot seam
+(SURVEY §5/§7): workloads cross the boundary as manifest documents over a
+length-delimited JSON protocol on a local socket — the idiomatic analog of
+the reference's per-cluster kubeconfig clients with reconnect/watch
+(pkg/controller/admissionchecks/multikueue/remote_client.go,
+multikueuecluster.go).
+"""
+
+from kueue_tpu.remote.client import RemoteWorkerClient
+from kueue_tpu.remote.worker import serve_worker
+
+__all__ = ["RemoteWorkerClient", "serve_worker"]

@@ -1,0 +1,134 @@
+"""Remote worker client: the MultiKueue-facing worker interface over the
+socket protocol, with reconnect + backoff.
+
+Implements exactly the surface MultiKueueController drives on a worker
+(`workloads` lookup, create/delete, schedule) so an in-process Manager and
+a remote cluster are interchangeable (reference remote_client.go keeps the
+same shape behind a kubeconfig client; multikueuecluster.go owns the
+reconnect loop)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Optional
+
+from kueue_tpu.api.serialization import decode, encode
+from kueue_tpu.api.types import Workload
+
+
+class WorkerUnreachable(ConnectionError):
+    pass
+
+
+class _WorkloadView:
+    """Mapping-ish facade: each access is an RPC (the remote state IS the
+    source of truth; nothing is cached across calls)."""
+
+    def __init__(self, client: "RemoteWorkerClient") -> None:
+        self._client = client
+
+    def get(self, key: str) -> Optional[Workload]:
+        doc = self._client._call({"op": "get_workload", "key": key}).get(
+            "workload"
+        )
+        return decode(doc) if doc else None
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def __getitem__(self, key: str) -> Workload:
+        wl = self.get(key)
+        if wl is None:
+            raise KeyError(key)
+        return wl
+
+
+class RemoteWorkerClient:
+    """A MultiKueue worker behind the socket seam."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        connect_timeout: float = 2.0,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+    ) -> None:
+        self.socket_path = socket_path
+        self.connect_timeout = connect_timeout
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self.workloads = _WorkloadView(self)
+
+    # -- transport ---------------------------------------------------------
+
+    def _connect(self) -> None:
+        self.close()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.connect_timeout)
+        s.connect(self.socket_path)
+        self._sock = s
+        self._file = s.makefile("rwb")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._file = None
+
+    def _call(self, req: dict) -> dict:
+        """One RPC with reconnect + backoff on transport failure
+        (multikueuecluster.go reconnect loop)."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                if self._file is None:
+                    self._connect()
+                self._file.write(json.dumps(req).encode() + b"\n")
+                self._file.flush()
+                line = self._file.readline()
+                if not line:
+                    raise ConnectionError("worker closed the connection")
+                resp = json.loads(line)
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error", "remote error"))
+                return resp
+            except (OSError, ConnectionError, json.JSONDecodeError) as exc:
+                last_exc = exc
+                self.close()
+                if attempt < self.retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        raise WorkerUnreachable(
+            f"worker at {self.socket_path} unreachable: {last_exc!r}"
+        )
+
+    # -- worker interface --------------------------------------------------
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._call({"op": "ping"}).get("pong"))
+        except WorkerUnreachable:
+            return False
+
+    def create_workload(self, wl: Workload) -> None:
+        try:
+            self._call({"op": "create_workload", "workload": encode(wl)})
+        except RuntimeError as exc:
+            if "exists" in str(exc):
+                raise ValueError(str(exc)) from exc
+            raise
+
+    def delete_workload(self, wl: Workload) -> None:
+        self._call({"op": "delete_workload", "key": wl.key})
+
+    def schedule(self) -> None:
+        self._call({"op": "schedule"})
+
+    def finish_workload(self, wl: Workload) -> None:
+        self._call({"op": "finish_workload", "key": wl.key})

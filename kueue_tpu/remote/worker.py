@@ -1,0 +1,116 @@
+"""MultiKueue worker endpoint: a Manager served over a local socket.
+
+One JSON object per request/response, newline-delimited, over a Unix
+domain socket (or TCP for cross-host). Workloads cross the boundary as
+manifest documents (api/serialization), never as Python objects — the
+same serialized-snapshot seam a multi-host deployment would use over
+gRPC/DCN.
+
+Run standalone:
+    python -m kueue_tpu.remote.worker --manifests cluster.yaml \
+        --socket /tmp/worker.sock
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from kueue_tpu.api.serialization import decode, encode
+from kueue_tpu.manager import Manager
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:  # one connection, many requests
+        mgr: Manager = self.server.manager  # type: ignore[attr-defined]
+        lock: threading.Lock = self.server.lock  # type: ignore[attr-defined]
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                with lock:
+                    resp = self._dispatch(mgr, req)
+            except Exception as exc:  # noqa: BLE001 - wire errors back
+                resp = {"ok": False, "error": repr(exc)[:500]}
+            self.wfile.write(json.dumps(resp).encode() + b"\n")
+            self.wfile.flush()
+
+    @staticmethod
+    def _dispatch(mgr: Manager, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "create_workload":
+            wl = decode(req["workload"])
+            if wl.key in mgr.workloads:
+                return {"ok": False, "error": "exists"}
+            mgr.create_workload(wl)
+            return {"ok": True}
+        if op == "delete_workload":
+            wl = mgr.workloads.get(req["key"])
+            if wl is not None:
+                mgr.delete_workload(wl)
+            return {"ok": True}
+        if op == "get_workload":
+            wl = mgr.workloads.get(req["key"])
+            return {"ok": True,
+                    "workload": encode(wl) if wl is not None else None}
+        if op == "schedule":
+            result = mgr.schedule_all()
+            mgr.tick()
+            return {"ok": True, "cycles": result}
+        if op == "finish_workload":
+            wl = mgr.workloads.get(req["key"])
+            if wl is not None:
+                mgr.finish_workload(wl)
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class _Server(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def serve_worker(
+    manager: Manager, socket_path: str, in_thread: bool = True
+):
+    """Serve ``manager`` on a unix socket. Returns the server (call
+    ``shutdown()`` to stop) when ``in_thread``."""
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    server = _Server(socket_path, _Handler)
+    server.manager = manager  # type: ignore[attr-defined]
+    server.lock = threading.Lock()  # type: ignore[attr-defined]
+    if in_thread:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    server.serve_forever()
+    return server
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--manifests", required=False)
+    ap.add_argument("--socket", required=True)
+    args = ap.parse_args(argv)
+    mgr = Manager()
+    if args.manifests:
+        from kueue_tpu.api.serialization import load_manifests
+
+        for obj in load_manifests(open(args.manifests).read()):
+            mgr.apply(obj)
+    serve_worker(mgr, args.socket, in_thread=False)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
