@@ -29,7 +29,9 @@ from kueue_tpu.api.constants import (
 from kueue_tpu.utils.validation import (
     validate_cluster_queue,
     validate_cohort,
+    validate_resource_flavor,
     validate_workload,
+    validate_workload_update,
 )
 from kueue_tpu.api.types import (
     AdmissionCheck,
@@ -153,6 +155,7 @@ class Manager:
                 self.cache.add_or_update_local_queue(obj)
                 self.queues.add_local_queue(obj)
             elif isinstance(obj, ResourceFlavor):
+                validate_resource_flavor(obj)
                 self.cache.add_or_update_resource_flavor(obj)
             elif isinstance(obj, Topology):
                 self.cache.add_or_update_topology(obj)
@@ -247,6 +250,19 @@ class Manager:
         self.workloads[wl.key] = wl
         self.metrics.inc("workloads_created_total")
         self.queues.add_or_update_workload(wl)
+
+    def update_workload(self, wl: Workload, elastic: bool = False) -> None:
+        """Spec/status update with webhook-grade invariants (reference
+        workload_webhook.go ValidateWorkloadUpdate): podSets frozen under
+        quota reservation (elastic scale-down exempt), admission immutable
+        once set, reclaimable counts monotone, clusterName write-once."""
+        old = self.workloads.get(wl.key)
+        if old is None:
+            raise ValueError(f"workload {wl.key} does not exist")
+        validate_workload_update(wl, old, elastic=elastic)
+        self.workloads[wl.key] = wl
+        if wl.key not in self.cache.workloads:
+            self.queues.add_or_update_workload(wl)
 
     def submit_job(self, job: GenericJob) -> Optional[Workload]:
         """Returns the managed Workload, or None when the job is outside

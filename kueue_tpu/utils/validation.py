@@ -2,17 +2,23 @@
 
 Behavioral surface: reference pkg/webhooks/{clusterqueue,cohort,
 resourceflavor,workload}_webhook.go — structural invariants enforced at
-apply/create time.
+apply/create time, plus the update-path invariants (podSets immutability
+under quota reservation, admission immutability, reclaimablePods
+monotonicity, clusterName transitions).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 from kueue_tpu.api.constants import BorrowWithinCohortPolicy, PreemptionPolicy
-from kueue_tpu.api.types import ClusterQueue, Cohort, Workload
+from kueue_tpu.api.types import ClusterQueue, Cohort, ResourceFlavor, Workload
+
+_VALID_TAINT_EFFECTS = {"NoSchedule", "PreferNoSchedule", "NoExecute"}
 
 
 def validate_cluster_queue(cq: ClusterQueue) -> None:
-    """reference clusterqueue_webhook.go:62-96."""
+    """reference clusterqueue_webhook.go:96-400."""
     if len(cq.resource_groups) > 16:
         raise ValueError("a ClusterQueue supports at most 16 resourceGroups")
     total_flavors = sum(len(rg.flavors) for rg in cq.resource_groups)
@@ -22,30 +28,53 @@ def validate_cluster_queue(cq: ClusterQueue) -> None:
     for rg in cq.resource_groups:
         if not rg.covered_resources:
             raise ValueError("resourceGroup needs coveredResources")
+        covered = set(rg.covered_resources)
+        if len(covered) != len(rg.covered_resources):
+            raise ValueError("coveredResources must not repeat")
         for res in rg.covered_resources:
             if res in seen_resources:
                 raise ValueError(
                     f"resource {res} appears in multiple resourceGroups"
                 )
             seen_resources.add(res)
+        seen_flavors = set()
         for fq in rg.flavors:
+            if fq.name in seen_flavors:
+                raise ValueError(
+                    f"flavor {fq.name} appears twice in one resourceGroup"
+                )
+            seen_flavors.add(fq.name)
+            # validateFlavorQuotas: the flavor's resources must match the
+            # group's covered resources exactly (:331).
+            if set(fq.resources) != covered:
+                raise ValueError(
+                    f"flavor {fq.name} must define quota for exactly the"
+                    f" coveredResources {sorted(covered)}"
+                )
             for res, q in fq.resources.items():
-                if res not in rg.covered_resources:
-                    raise ValueError(
-                        f"flavor {fq.name} defines quota for uncovered"
-                        f" resource {res}"
-                    )
                 if q.nominal < 0:
                     raise ValueError("nominalQuota must be >= 0")
-                if q.borrowing_limit is not None and q.borrowing_limit < 0:
-                    raise ValueError("borrowingLimit must be >= 0")
-                if q.lending_limit is not None and q.lending_limit < 0:
-                    raise ValueError("lendingLimit must be >= 0")
-                if q.lending_limit is not None and not cq.cohort:
-                    raise ValueError(
-                        "lendingLimit requires the ClusterQueue to be in a"
-                        " cohort"
-                    )
+                if q.borrowing_limit is not None:
+                    if q.borrowing_limit < 0:
+                        raise ValueError("borrowingLimit must be >= 0")
+                    if not cq.cohort:
+                        raise ValueError(
+                            "borrowingLimit requires the ClusterQueue to"
+                            " be in a cohort"
+                        )
+                if q.lending_limit is not None:
+                    if q.lending_limit < 0:
+                        raise ValueError("lendingLimit must be >= 0")
+                    if not cq.cohort:
+                        raise ValueError(
+                            "lendingLimit requires the ClusterQueue to be"
+                            " in a cohort"
+                        )
+                    if q.lending_limit > q.nominal:
+                        raise ValueError(
+                            "lendingLimit must not exceed nominalQuota"
+                            " (clusterqueue_webhook.go:383)"
+                        )
     bwc = cq.preemption.borrow_within_cohort
     if (
         bwc.policy == BorrowWithinCohortPolicy.NEVER
@@ -55,6 +84,14 @@ def validate_cluster_queue(cq: ClusterQueue) -> None:
             "maxPriorityThreshold requires borrowWithinCohort policy"
             " != Never"
         )
+    if (
+        bwc.policy != BorrowWithinCohortPolicy.NEVER
+        and cq.preemption.reclaim_within_cohort == PreemptionPolicy.NEVER
+    ):
+        # clusterqueue_webhook.go:278 validatePreemption.
+        raise ValueError(
+            "borrowWithinCohort requires reclaimWithinCohort != Never"
+        )
 
 
 def validate_cohort(cohort: Cohort) -> None:
@@ -62,25 +99,169 @@ def validate_cohort(cohort: Cohort) -> None:
         raise ValueError("a Cohort cannot be its own parent")
 
 
+def validate_resource_flavor(rf: ResourceFlavor) -> None:
+    """reference resourceflavor_webhook.go:84-110."""
+    for taint in rf.node_taints:
+        if not taint.key:
+            raise ValueError("flavor taint key must not be empty")
+        if taint.effect not in _VALID_TAINT_EFFECTS:
+            raise ValueError(
+                f"invalid taint effect {taint.effect!r}; must be one of"
+                f" {sorted(_VALID_TAINT_EFFECTS)}"
+            )
+
+
 def validate_workload(wl: Workload) -> None:
-    """reference workload_webhook.go."""
+    """reference workload_webhook.go:119 ValidateWorkload (create path)."""
     if not wl.pod_sets:
         raise ValueError("workload needs at least one podset")
     if len(wl.pod_sets) > 18:
         raise ValueError("workload supports at most 18 podsets")
     names = set()
+    variable_count = 0
     for ps in wl.pod_sets:
+        if not ps.name:
+            raise ValueError("podset name must not be empty")
         if ps.name in names:
             raise ValueError(f"duplicate podset name {ps.name}")
         names.add(ps.name)
         if ps.count < 0:
             raise ValueError("podset count must be >= 0")
-        if ps.min_count is not None and not (
-            0 < ps.min_count <= ps.count
-        ):
-            raise ValueError("minCount must be in (0, count]")
+        if ps.min_count is not None:
+            variable_count += 1
+            if not (0 < ps.min_count <= ps.count):
+                raise ValueError("minCount must be in (0, count]")
+        for res, v in ps.requests.items():
+            if v < 0:
+                raise ValueError(
+                    f"podset {ps.name} request {res} must be >= 0"
+                )
         tr = ps.topology_request
-        if tr is not None and tr.required_level and tr.preferred_level:
+        if tr is not None:
+            if tr.required_level and tr.preferred_level:
+                raise ValueError(
+                    "topologyRequest cannot set both required and preferred"
+                )
+            if tr.slice_required_level is not None and (
+                tr.slice_size is None or tr.slice_size <= 0
+            ):
+                raise ValueError(
+                    "podSetSliceRequiredTopology requires a positive"
+                    " podSetSliceSize"
+                )
+            if tr.slice_size is not None and tr.slice_size <= 0:
+                raise ValueError("podSetSliceSize must be > 0")
+    if variable_count > 1:
+        raise ValueError("at most one podSet can use minCount")
+
+    # Status-side invariants (validateAdmission / validateAdmissionChecks).
+    adm = wl.status.admission
+    if adm is not None:
+        psa_names = [psa.name for psa in adm.pod_set_assignments]
+        if len(set(psa_names)) != len(psa_names):
+            raise ValueError("podSetAssignments names must be unique")
+        unknown = set(psa_names) - names
+        if unknown:
             raise ValueError(
-                "topologyRequest cannot set both required and preferred"
+                f"podSetAssignments reference unknown podsets: "
+                f"{sorted(unknown)}"
             )
+    acs_names = [a.name for a in wl.status.admission_checks]
+    if len(set(acs_names)) != len(acs_names):
+        raise ValueError("admissionChecks names must be unique")
+    for psn, count in wl.status.reclaimable_pods.items():
+        if psn not in names:
+            raise ValueError(
+                f"reclaimablePods references unknown podset {psn}"
+            )
+        if count < 0:
+            raise ValueError("reclaimablePods count must be >= 0")
+
+
+def _podset_immutable_eq(new_ps, old_ps, allow_scale_down: bool) -> bool:
+    """validateImmutablePodSet :448: every field but count is frozen;
+    elastic jobs may scale count down."""
+    count_ok = new_ps.count == old_ps.count or (
+        allow_scale_down and new_ps.count < old_ps.count
+    )
+    return (
+        count_ok
+        and new_ps.name == old_ps.name
+        and new_ps.requests == old_ps.requests
+        and new_ps.min_count == old_ps.min_count
+        and new_ps.node_selector == old_ps.node_selector
+        and new_ps.tolerations == old_ps.tolerations
+        and new_ps.topology_request == old_ps.topology_request
+    )
+
+
+def validate_workload_update(
+    new: Workload, old: Workload, elastic: bool = False
+) -> None:
+    """reference workload_webhook.go:343 ValidateWorkloadUpdate."""
+    from kueue_tpu.core.workload_info import has_quota_reservation
+
+    validate_workload(new)
+
+    if has_quota_reservation(old):
+        if len(new.pod_sets) != len(old.pod_sets):
+            raise ValueError(
+                "podSets are immutable while quota is reserved"
+            )
+        for nps, ops in zip(new.pod_sets, old.pod_sets):
+            if not _podset_immutable_eq(nps, ops, elastic):
+                raise ValueError(
+                    f"podSet {ops.name} is immutable while quota is"
+                    " reserved (workload_webhook.go:448)"
+                )
+
+    # Admission may be set or cleared, but not changed (topology
+    # assignments may be attached later — the delayed-TAS second pass).
+    new_adm, old_adm = new.status.admission, old.status.admission
+    if new_adm is not None and old_adm is not None:
+        if len(new_adm.pod_set_assignments) != \
+                len(old_adm.pod_set_assignments):
+            raise ValueError("admission is immutable once set")
+        for npsa, opsa in zip(new_adm.pod_set_assignments,
+                              old_adm.pod_set_assignments):
+            if (
+                npsa.name != opsa.name
+                or npsa.flavors != opsa.flavors
+                or npsa.count != opsa.count
+            ):
+                raise ValueError(
+                    "admission is immutable once set"
+                    " (workload_webhook.go:368)"
+                )
+
+    # Reclaimable counts must not decrease while quota is reserved
+    # (workload_webhook.go:387); scaled-down podsets are exempt.
+    if has_quota_reservation(new) and has_quota_reservation(old):
+        scaled_down = set()
+        if elastic and new.status.admission is not None:
+            current = {ps.name: ps.count for ps in new.pod_sets}
+            for psa in new.status.admission.pod_set_assignments:
+                if psa.count > current.get(psa.name, psa.count):
+                    scaled_down.add(psa.name)
+        for name, old_count in old.status.reclaimable_pods.items():
+            if name in scaled_down:
+                continue
+            new_count = new.status.reclaimable_pods.get(name)
+            if new_count is None:
+                raise ValueError(
+                    f"reclaimablePods for {name} cannot be removed"
+                )
+            if new_count < old_count:
+                raise ValueError(
+                    f"reclaimablePods for {name} cannot decrease"
+                    f" ({new_count} < {old_count})"
+                )
+
+    # clusterName may be set once and cleared on eviction, never rewritten
+    # (workload_webhook.go:470).
+    if (
+        old.status.cluster_name
+        and new.status.cluster_name
+        and new.status.cluster_name != old.status.cluster_name
+    ):
+        raise ValueError("status.clusterName cannot change once set")

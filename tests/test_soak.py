@@ -87,13 +87,16 @@ def test_soak_random_lifecycle(seed):
         Cohort(name="co-1", parent="co-0"),
     )
     for i in range(6):
+        cohort = rng.choice(["co-0", "co-1", None])
+        # borrowingLimit without a cohort is webhook-invalid.
+        bl = rng.choice([None, 4000]) if cohort else None
         mgr.apply(
             make_cq(
                 f"cq{i}",
-                cohort=rng.choice(["co-0", "co-1", None]),
+                cohort=cohort,
                 flavors={"default": {"cpu": quota(
                     rng.randrange(2, 8) * 1000,
-                    borrowing_limit=rng.choice([None, 4000]),
+                    borrowing_limit=bl,
                 )}},
                 preemption=ClusterQueuePreemption(
                     within_cluster_queue=rng.choice(

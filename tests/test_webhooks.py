@@ -1,0 +1,179 @@
+"""Webhook-grade validation tests (reference pkg/webhooks/*_webhook.go)."""
+
+import pytest
+
+from kueue_tpu.api.constants import BorrowWithinCohortPolicy, PreemptionPolicy
+from kueue_tpu.api.types import (
+    Admission,
+    BorrowWithinCohort,
+    ClusterQueuePreemption,
+    LocalQueue,
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    ResourceQuota,
+    Taint,
+    TopologyRequest,
+    Workload,
+    quota,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.utils.validation import (
+    validate_cluster_queue,
+    validate_resource_flavor,
+    validate_workload,
+    validate_workload_update,
+)
+
+from .helpers import make_cq, make_wl, submit
+
+
+def test_cq_flavor_resources_must_match_covered():
+    cq = make_cq("bad", resources=("cpu", "memory"),
+                 flavors={"f0": {"cpu": ResourceQuota(1000)}})
+    with pytest.raises(ValueError, match="exactly the coveredResources"):
+        validate_cluster_queue(cq)
+
+
+def test_cq_limits_require_cohort():
+    cq = make_cq("bad", flavors={"f0": {"cpu": ResourceQuota(1000, 500)}})
+    with pytest.raises(ValueError, match="borrowingLimit requires"):
+        validate_cluster_queue(cq)
+    cq2 = make_cq("bad2",
+                  flavors={"f0": {"cpu": ResourceQuota(1000, None, 500)}})
+    with pytest.raises(ValueError, match="lendingLimit requires"):
+        validate_cluster_queue(cq2)
+
+
+def test_cq_lending_limit_above_nominal_rejected():
+    cq = make_cq("bad", cohort="co",
+                 flavors={"f0": {"cpu": ResourceQuota(1000, None, 2000)}})
+    with pytest.raises(ValueError, match="not exceed nominalQuota"):
+        validate_cluster_queue(cq)
+
+
+def test_cq_borrow_within_cohort_needs_reclaim():
+    cq = make_cq("bad", cohort="co",
+                 flavors={"f0": {"cpu": ResourceQuota(1000)}},
+                 preemption=ClusterQueuePreemption(
+                     reclaim_within_cohort=PreemptionPolicy.NEVER,
+                     borrow_within_cohort=BorrowWithinCohort(
+                         policy=BorrowWithinCohortPolicy.LOWER_PRIORITY),
+                 ))
+    with pytest.raises(ValueError, match="reclaimWithinCohort"):
+        validate_cluster_queue(cq)
+
+
+def test_flavor_taint_validation():
+    with pytest.raises(ValueError, match="taint effect"):
+        validate_resource_flavor(ResourceFlavor(
+            name="f", node_taints=[Taint(key="k", effect="Bogus")]))
+    with pytest.raises(ValueError, match="taint key"):
+        validate_resource_flavor(ResourceFlavor(
+            name="f", node_taints=[Taint(key="", effect="NoSchedule")]))
+
+
+def test_workload_single_mincount_podset():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="a", count=4, min_count=2, requests={"cpu": 1}),
+        PodSet(name="b", count=4, min_count=2, requests={"cpu": 1}),
+    ])
+    with pytest.raises(ValueError, match="at most one podSet"):
+        validate_workload(wl)
+
+
+def test_workload_negative_request_rejected():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="a", count=1, requests={"cpu": -5}),
+    ])
+    with pytest.raises(ValueError, match="must be >= 0"):
+        validate_workload(wl)
+
+
+def test_workload_slice_level_requires_size():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="a", count=4, requests={"cpu": 1},
+               topology_request=TopologyRequest(
+                   required_level="rack",
+                   slice_required_level="host")),
+    ])
+    with pytest.raises(ValueError, match="podSetSliceSize"):
+        validate_workload(wl)
+
+
+def test_podsets_immutable_under_quota_reservation():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = make_wl("w", cpu_m=1000)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+
+    newer = wl.clone() if hasattr(wl, "clone") else None
+    import copy
+
+    newer = copy.deepcopy(wl)
+    newer.pod_sets[0].requests = {"cpu": 2000}
+    with pytest.raises(ValueError, match="immutable while quota"):
+        mgr.update_workload(newer)
+
+    # Count scale-down allowed only for elastic workloads.
+    shrink = copy.deepcopy(wl)
+    shrink.pod_sets[0].count = 0
+    with pytest.raises(ValueError, match="immutable while quota"):
+        mgr.update_workload(shrink)
+    mgr.update_workload(shrink, elastic=True)  # ok
+
+
+def test_admission_immutable_once_set():
+    old = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1, requests={"cpu": 1000})])
+    old.status.admission = Admission(
+        cluster_queue="cq-a",
+        pod_set_assignments=[PodSetAssignment(
+            name="main", flavors={"cpu": "f0"}, count=1)],
+    )
+    import copy
+
+    new = copy.deepcopy(old)
+    new.status.admission.pod_set_assignments[0].flavors = {"cpu": "f1"}
+    with pytest.raises(ValueError, match="admission is immutable"):
+        validate_workload_update(new, old)
+
+
+def test_reclaimable_pods_monotone():
+    from kueue_tpu.api.constants import COND_QUOTA_RESERVED
+    from kueue_tpu.core.workload_info import set_condition
+
+    old = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=4, requests={"cpu": 1000})])
+    set_condition(old, COND_QUOTA_RESERVED, True, "r", "", 1.0)
+    old.status.reclaimable_pods = {"main": 2}
+    import copy
+
+    new = copy.deepcopy(old)
+    new.status.reclaimable_pods = {"main": 1}
+    with pytest.raises(ValueError, match="cannot decrease"):
+        validate_workload_update(new, old)
+    new.status.reclaimable_pods = {}
+    with pytest.raises(ValueError, match="cannot be removed"):
+        validate_workload_update(new, old)
+    new.status.reclaimable_pods = {"main": 3}
+    validate_workload_update(new, old)  # increase ok
+
+
+def test_cluster_name_write_once():
+    old = Workload(name="w", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1, requests={"cpu": 1000})])
+    old.status.cluster_name = "west"
+    import copy
+
+    new = copy.deepcopy(old)
+    new.status.cluster_name = "east"
+    with pytest.raises(ValueError, match="clusterName cannot change"):
+        validate_workload_update(new, old)
+    new.status.cluster_name = None  # cleared on eviction: allowed
+    validate_workload_update(new, old)
