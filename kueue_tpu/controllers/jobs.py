@@ -320,10 +320,155 @@ class SparkApplication(_BaseJob):
         ]
 
 
-# Aliases covering the kubeflow job family shapes (TFJob/PyTorchJob/
-# XGBoostJob/PaddleJob/JAXJob all reduce to role -> (count, requests)).
-TFJob = PyTorchJob = XGBoostJob = PaddleJob = JAXJob = TrainJob
-Deployment = StatefulSet = ServingGroup
+# ---------------------------------------------------------------------------
+# Kubeflow training job family — distinct adapters with each framework's
+# canonical replica roles, ordering and structural validation (reference
+# pkg/controller/jobs/kubeflow/jobs/{tfjob,pytorchjob,xgboostjob,paddlejob,
+# jaxjob}: podsets are emitted in the framework's replica-type order and
+# the per-framework invariants are enforced at construction).
+# ---------------------------------------------------------------------------
+
+
+class _KubeflowJob(_BaseJob):
+    """Common kubeflow ReplicaSpec handling: ordered roles, single-master
+    invariants, per-role podsets (reference kubeflowjob.go)."""
+
+    ROLE_ORDER: Tuple[str, ...] = ()
+    SINGLETON_ROLES: Tuple[str, ...] = ()
+
+    def __init__(self, name: str, queue: str,
+                 replicas: Dict[str, Tuple[int, Dict[str, int]]],
+                 topology: Optional[TopologyRequest] = None, **kw) -> None:
+        super().__init__(name, queue, **kw)
+        unknown = set(replicas) - set(self.ROLE_ORDER)
+        if unknown:
+            raise ValueError(
+                f"{type(self).__name__} does not support replica types"
+                f" {sorted(unknown)}; valid: {list(self.ROLE_ORDER)}"
+            )
+        for role in self.SINGLETON_ROLES:
+            if role in replicas and replicas[role][0] > 1:
+                raise ValueError(
+                    f"{type(self).__name__} allows at most one {role}"
+                )
+        self.replicas = replicas
+        self.topology = topology
+
+    def pod_sets(self) -> List[PodSet]:
+        out = []
+        for role in self.ROLE_ORDER:
+            if role not in self.replicas:
+                continue
+            count, reqs = self.replicas[role]
+            out.append(PodSet(
+                name=role.lower(), count=count, requests=dict(reqs),
+                topology_request=self.topology,
+            ))
+        return out
+
+
+class TFJob(_KubeflowJob):
+    """reference kubeflow/jobs/tfjob: Chief/Master, PS, Worker, Evaluator
+    replica order (tfjob_multikueue_adapter order)."""
+
+    ROLE_ORDER = ("Chief", "Master", "PS", "Worker", "Evaluator")
+    SINGLETON_ROLES = ("Chief", "Master")
+
+
+class PyTorchJob(_KubeflowJob):
+    """reference kubeflow/jobs/pytorchjob: one Master + Workers."""
+
+    ROLE_ORDER = ("Master", "Worker")
+    SINGLETON_ROLES = ("Master",)
+
+
+class XGBoostJob(_KubeflowJob):
+    """reference kubeflow/jobs/xgboostjob: one Master + Workers."""
+
+    ROLE_ORDER = ("Master", "Worker")
+    SINGLETON_ROLES = ("Master",)
+
+
+class PaddleJob(_KubeflowJob):
+    """reference kubeflow/jobs/paddlejob: Master + Workers."""
+
+    ROLE_ORDER = ("Master", "Worker")
+    SINGLETON_ROLES = ("Master",)
+
+
+class JAXJob(_KubeflowJob):
+    """reference kubeflow/jobs/jaxjob: a single Worker replica set — one
+    process per host of a TPU slice."""
+
+    ROLE_ORDER = ("Worker",)
+
+
+class RayJob(_BaseJob):
+    """reference pkg/controller/jobs/rayjob: head + worker groups, plus the
+    submitter pod when the job is submitted via a Kubernetes Job
+    (rayjob spec.submissionMode == K8sJobMode)."""
+
+    def __init__(self, name: str, queue: str,
+                 head_requests: Dict[str, int],
+                 worker_groups: Dict[str, Tuple[int, Dict[str, int]]],
+                 submission_mode: str = "K8sJobMode",
+                 submitter_requests: Optional[Dict[str, int]] = None,
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.head_requests = head_requests
+        self.worker_groups = worker_groups
+        self.submission_mode = submission_mode
+        self.submitter_requests = submitter_requests or {"cpu": 500}
+
+    def pod_sets(self) -> List[PodSet]:
+        out = [PodSet(name="head", count=1,
+                      requests=dict(self.head_requests))]
+        for g, (count, reqs) in self.worker_groups.items():
+            out.append(PodSet(name=g, count=count, requests=dict(reqs)))
+        if self.submission_mode == "K8sJobMode":
+            out.append(PodSet(name="submitter", count=1,
+                              requests=dict(self.submitter_requests)))
+        return out
+
+
+class RayService(_BaseJob):
+    """reference pkg/controller/jobs/rayservice: a long-running serve
+    deployment on a Ray cluster — head + worker groups, never 'finished'
+    on its own (torn down by deletion, like serving workloads)."""
+
+    def __init__(self, name: str, queue: str,
+                 head_requests: Dict[str, int],
+                 worker_groups: Dict[str, Tuple[int, Dict[str, int]]],
+                 **kw) -> None:
+        super().__init__(name, queue, **kw)
+        self.head_requests = head_requests
+        self.worker_groups = worker_groups
+
+    def pod_sets(self) -> List[PodSet]:
+        out = [PodSet(name="head", count=1,
+                      requests=dict(self.head_requests))]
+        for g, (count, reqs) in self.worker_groups.items():
+            out.append(PodSet(name=g, count=count, requests=dict(reqs)))
+        return out
+
+    def finished(self) -> Tuple[bool, bool, str]:
+        # A serve deployment never self-terminates.
+        return self._finished, self._success, self._message
+
+
+class Deployment(ServingGroup):
+    """reference pkg/controller/jobs/deployment: stateless replicas; the
+    replica count may change at runtime — scale-down is always safe,
+    scale-up re-enters admission via elastic workload slices."""
+
+    def scale(self, replicas: int) -> None:
+        self.replicas = replicas
+
+
+class StatefulSet(ServingGroup):
+    """reference pkg/controller/jobs/statefulset: ordered, identity-bearing
+    replicas admitted as one group."""
+
 
 for _name, _cls in [
     ("jobset", JobSet),
@@ -334,6 +479,8 @@ for _name, _cls in [
     ("kubeflow/xgboostjob", XGBoostJob),
     ("kubeflow/paddlejob", PaddleJob),
     ("kubeflow/jaxjob", JAXJob),
+    ("rayjob", RayJob),
+    ("rayservice", RayService),
     ("deployment", Deployment),
     ("statefulset", StatefulSet),
 ]:

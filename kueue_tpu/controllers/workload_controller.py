@@ -25,6 +25,7 @@ from kueue_tpu.api.constants import (
     RequeueReason,
 )
 from kueue_tpu.api.types import RequeueState, Workload
+from kueue_tpu.utils import features
 from kueue_tpu.core.workload_info import (
     WorkloadInfo,
     all_checks_ready,
@@ -137,8 +138,11 @@ class WorkloadController:
                 self.evict(wl, EVICTED_BY_DEACTIVATION,
                            "Exceeded the maximum execution time", now)
                 return
-            # WaitForPodsReady timeout.
-            if self.pods_ready.enable:
+            # WaitForPodsReady timeout (DisableWaitForPodsReady gate turns
+            # the whole mechanism off regardless of configuration).
+            if self.pods_ready.enable and not features.enabled(
+                "DisableWaitForPodsReady"
+            ):
                 job = self.manager.job_reconciler.job_of_workload.get(key)
                 ready = job.pods_ready() if job is not None else True
                 if ready:

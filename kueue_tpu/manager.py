@@ -222,7 +222,18 @@ class Manager:
         # rejected at creation).
         if any(ps.device_requests for ps in wl.pod_sets):
             from kueue_tpu.dra import charges_for_request
+            from kueue_tpu.utils import features
 
+            if not features.enabled("KueueDRAIntegration"):
+                if features.enabled(
+                    "KueueDRARejectWorkloadsWhenDRADisabled"
+                ):
+                    raise ValueError(
+                        f"workload {wl.key}: DRA device requests present"
+                        " but KueueDRAIntegration is disabled"
+                    )
+                for ps in wl.pod_sets:
+                    ps.device_requests = {}
             by_class = {
                 dc: m
                 for m in self.device_class_mappings

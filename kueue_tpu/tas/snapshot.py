@@ -1032,6 +1032,14 @@ class TASFlavorSnapshot:
         # intermediate levels inherit the inner layer's size.
         slice_size_at_level: Dict[int, int] = {}
         prev_idx, prev_size = slice_level_idx, slice_size
+        if req.slice_layers:
+            from kueue_tpu.utils import features as _features
+
+            if not _features.enabled("TASMultiLayerTopology"):
+                return None, None, (
+                    "multi-layer slice topologies are disabled"
+                    " (TASMultiLayerTopology feature gate)"
+                )
         for layer_level, layer_size in req.slice_layers:
             if layer_level not in self.level_keys:
                 return None, None, (
@@ -1064,11 +1072,14 @@ class TASFlavorSnapshot:
         # highest balance threshold, pick a minimal optimal domain set via
         # DP, give every selected domain the threshold, distribute the
         # extras; fall back to BestFit on any failure.
+        from kueue_tpu.utils import features
+
         slice_count = req.count // slice_size
         use_balanced = False
         curr: List[Domain] = []
         fit_level_idx = 0
-        if req.balanced and not required and not unconstrained:
+        balanced_on = req.balanced or features.enabled("TASBalancedPlacement")
+        if balanced_on and not required and not unconstrained:
             best_fit, best_threshold = self._find_best_domains_balanced(
                 slice_count, leader_count, slice_size, slice_level_idx,
                 requested_level_idx,

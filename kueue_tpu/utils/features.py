@@ -1,45 +1,113 @@
 """Feature gates (reference pkg/features/kube_features.go).
 
-A small mutable registry with the reference's defaults. Gates not yet wired
-into behavior are still registered so user configs carry over unchanged;
-they're marked below as they become load-bearing.
+The complete reference gate registry (78 gates) with each gate's latest
+versioned default. ``LOAD_BEARING`` lists the gates that change behavior in
+kueue_tpu today; the rest are registered so user configs carry over
+unchanged and flips become observable as they are wired in.
 """
 
 from __future__ import annotations
 
 from typing import Dict
 
+# Every gate from kube_features.go:35-536, defaults = the newest
+# VersionedSpecs entry's Default.
 _DEFAULTS: Dict[str, bool] = {
-    # -- load-bearing in kueue_tpu --
+    "PartialAdmission": True,
     "FlavorFungibility": True,
+    "VisibilityOnDemand": True,
+    "DisableWaitForPodsReady": False,
     "PrioritySortingWithinCohort": True,
     "FairSharingPreemptWithinNominal": True,
-    "TopologyAwareScheduling": True,
-    "PartialAdmission": True,
-    "WaitForPodsReady": True,
-    "LocalQueueMetrics": False,
-    "ElasticJobsViaWorkloadSlices": False,
-    "ConcurrentAdmission": False,
-    "AdmissionFairSharing": False,
+    "FairSharingPrioritizeNonBorrowing": True,
     "MultiKueue": True,
-    "MultiKueueBatchJobWithManagedBy": False,
+    "TopologyAwareScheduling": True,
+    "LocalQueueMetrics": True,
+    "TASProfileMixed": True,
     "HierarchicalCohorts": True,
+    "AdmissionFairSharing": True,
+    "ObjectRetentionPolicies": True,
     "TASFailedNodeReplacement": True,
+    "ElasticJobsViaWorkloadSlices": True,
+    "ElasticJobsViaWorkloadSlicesWithTAS": False,
     "TASFailedNodeReplacementFailFast": True,
     "TASReplaceNodeOnPodTermination": True,
-    "WorkloadRequestUseMergePatch": False,
-    "ObjectRetentionPolicies": True,
-    "SchedulerTimestampPreemptionBuffer": False,
-    "DynamicResourceAllocation": False,
-    "ProvisioningACC": True,
-    "VisibilityOnDemand": True,
-    "QueueVisibility": False,
-    "PodIntegrationAutoEnable": True,
-    "ConfigurableResourceTransformations": True,
+    "SkipReassignmentForPodOwnedWorkloads": True,
+    "TASReplaceNodeDueToNotReadyOverFixedTime": False,
     "ManagedJobsNamespaceSelectorAlwaysRespected": True,
-    "PrioritizedAccessToFlavors": False,
-    "FairSharingPrioritizeNonBorrowing": False,
+    "TASBalancedPlacement": False,
+    "TASAssignmentsEncodingByHostnamePrefix": True,
+    "KueueDRAIntegration": True,
+    "KueueDRAIntegrationExtendedResource": True,
+    "KueueDRARejectWorkloadsWhenDRADisabled": True,
+    "KueueDRAIntegrationPartitionableDevices": True,
+    "KueueDRAIntegrationConsumableCapacity": False,
+    "MultiKueueAdaptersForCustomJobs": True,
+    "WorkloadRequestUseMergePatch": False,
+    "MultiKueueAllowInsecureKubeconfigs": True,
+    "MultiKueueKubeConfigPathValidation": False,
+    "ReclaimablePods": True,
+    "PropagateBatchJobLabelsToWorkload": True,
+    "MultiKueueClusterProfile": False,
+    "FailureRecoveryPolicy": False,
+    "SkipFinalizersForPodsSuspendedByParent": True,
+    "MultiKueueWaitForWorkloadAdmitted": True,
+    "MultiKueueRedoAdmissionOnEvictionInWorker": True,
+    "TLSOptions": True,
+    "RemoveFinalizersWithStrictPatch": True,
+    "TASReplaceNodeOnNodeTaints": True,
+    "AssignQueueLabelsForPods": True,
+    "TASMultiLayerTopology": True,
+    "SchedulingEquivalenceHashing": True,
+    "SchedulerLongRequeueInterval": False,
+    "SchedulerTimestampPreemptionBuffer": False,
+    "CustomMetricLabels": False,
+    "SparkApplicationIntegration": False,
+    "MultiKueueOrchestratedPreemption": False,
+    "PriorityBoost": False,
+    "AdmissionGatedBy": True,
+    "ShortWorkloadNames": False,
+    "FastQuotaReleaseInPodIntegration": False,
+    "RejectUpdatesToCQWithInvalidOnFlavors": False,
+    "FinishOrphanedWorkloads": True,
+    "MultiKueueIncrementalDispatcherConfig": True,
+    "MultiKueueIncrementalDispatcherRespectConfigOrder": True,
+    "ConcurrentAdmission": False,
+    "QuotaCheckStrategy": True,
+    "MetricForWorkloadCreationLatency": True,
+    "TASRespectNodeAffinityPreferred": False,
+    "MultiKueueManagerQuotaAutomation": False,
+    "WorkloadIdentifierAnnotations": True,
+    "WorkloadPriorityClassDefaulting": False,
+    "MetricsForCohorts": True,
+    "CleanupProvisioningRequestsOnEviction": True,
+    "TASHandleOverlappingFlavors": True,
+    "UnadmittedWorkloadsObservability": False,
+    "TASRecomputeAssignmentWithinSchedulingCycle": True,
+    "UnadmittedWorkloadsExplicitStatus": False,
+    "DeferRayServiceFinalizationForRedisCleanup": True,
+    "TASCacheNodeMatchResults": True,
+    "TASCachingRemainingResources": True,
+    "SchedulerLibraryIntegration": False,
+    "VectorizedResourceRequests": True,
+    "WorkloadValidateResourcesAreNonNegative": True,
 }
+
+# Gates that flip observable behavior in kueue_tpu today.
+LOAD_BEARING = frozenset({
+    "PartialAdmission",            # scheduler partial-admission search
+    "PrioritySortingWithinCohort",  # admission order + fair tournament key
+    "FairSharingPreemptWithinNominal",  # fair preemption rule S1 shortcut
+    "TASFailedNodeReplacement",    # node-health replacement pipeline
+    "TASFailedNodeReplacementFailFast",  # evict instead of waiting
+    "TASBalancedPlacement",        # balanced placement for preferred gangs
+    "TASMultiLayerTopology",       # inner slice layers
+    "KueueDRAIntegration",         # device-class request mapping
+    "KueueDRARejectWorkloadsWhenDRADisabled",  # reject vs ignore when off
+    "WorkloadValidateResourcesAreNonNegative",  # webhook request check
+    "DisableWaitForPodsReady",     # turn PodsReady gating off globally
+    "ElasticJobsViaWorkloadSlices",  # workload-slice scale paths
+})
 
 _overrides: Dict[str, bool] = {}
 

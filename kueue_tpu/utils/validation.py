@@ -131,11 +131,14 @@ def validate_workload(wl: Workload) -> None:
             variable_count += 1
             if not (0 < ps.min_count <= ps.count):
                 raise ValueError("minCount must be in (0, count]")
-        for res, v in ps.requests.items():
-            if v < 0:
-                raise ValueError(
-                    f"podset {ps.name} request {res} must be >= 0"
-                )
+        from kueue_tpu.utils import features
+
+        if features.enabled("WorkloadValidateResourcesAreNonNegative"):
+            for res, v in ps.requests.items():
+                if v < 0:
+                    raise ValueError(
+                        f"podset {ps.name} request {res} must be >= 0"
+                    )
         tr = ps.topology_request
         if tr is not None:
             if tr.required_level and tr.preferred_level:

@@ -888,3 +888,63 @@ def test_multikueue_tas_mirror_admits_manager_side():
     assert local_ta is not None and sum(c for _, c in local_ta.domains) == 2
     assert not has_topology_assignments_pending(wl)
     assert is_admitted(wl)
+
+
+def test_kubeflow_distinct_adapters():
+    """Per-framework kubeflow semantics: role vocabularies, singleton
+    masters, podset ordering (reference kubeflow/jobs/*)."""
+    import pytest
+
+    from kueue_tpu.controllers.jobs import (
+        JAXJob, PaddleJob, PyTorchJob, TFJob, XGBoostJob,
+    )
+
+    tf = TFJob("t", queue="lq", replicas={
+        "Worker": (4, {"cpu": 1000}),
+        "PS": (2, {"cpu": 500}),
+        "Chief": (1, {"cpu": 500}),
+    })
+    assert [ps.name for ps in tf.pod_sets()] == ["chief", "ps", "worker"]
+
+    with pytest.raises(ValueError, match="at most one Master"):
+        PyTorchJob("p", queue="lq", replicas={"Master": (2, {"cpu": 1})})
+    with pytest.raises(ValueError, match="does not support replica types"):
+        XGBoostJob("x", queue="lq", replicas={"PS": (1, {"cpu": 1})})
+    with pytest.raises(ValueError, match="does not support replica types"):
+        JAXJob("j", queue="lq", replicas={"Master": (1, {"cpu": 1})})
+
+    pd = PaddleJob("pd", queue="lq", replicas={
+        "Worker": (2, {"cpu": 1000}), "Master": (1, {"cpu": 500}),
+    })
+    assert [ps.name for ps in pd.pod_sets()] == ["master", "worker"]
+
+
+def test_rayjob_submitter_pod_modes():
+    from kueue_tpu.controllers.jobs import RayJob, RayService
+
+    rj = RayJob("r", queue="lq", head_requests={"cpu": 1000},
+                worker_groups={"gpu-group": (4, {"cpu": 2000})})
+    names = [ps.name for ps in rj.pod_sets()]
+    assert names == ["head", "gpu-group", "submitter"]
+
+    rj2 = RayJob("r2", queue="lq", head_requests={"cpu": 1000},
+                 worker_groups={}, submission_mode="HTTPMode")
+    assert [ps.name for ps in rj2.pod_sets()] == ["head"]
+
+    rs = RayService("s", queue="lq", head_requests={"cpu": 1000},
+                    worker_groups={"serve": (2, {"cpu": 1000})})
+    assert [ps.name for ps in rs.pod_sets()] == ["head", "serve"]
+    assert rs.finished() == (False, True, "")
+
+
+def test_kubeflow_jobs_schedule_end_to_end():
+    from kueue_tpu.controllers.jobs import PyTorchJob, RayJob
+
+    mgr = basic_manager()
+    wl = mgr.submit_job(PyTorchJob("train", queue="lq", replicas={
+        "Master": (1, {"cpu": 500}), "Worker": (2, {"cpu": 1000}),
+    }))
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    assert [psa.name for psa in
+            wl.status.admission.pod_set_assignments] == ["master", "worker"]

@@ -177,3 +177,85 @@ def test_cluster_name_write_once():
         validate_workload_update(new, old)
     new.status.cluster_name = None  # cleared on eviction: allowed
     validate_workload_update(new, old)
+
+
+def test_feature_gates_observably_flip_behavior():
+    """Flipped gates change real behavior (not decorative): DRA rejection,
+    non-negative validation, multi-layer TAS."""
+    from kueue_tpu.utils import features
+
+    try:
+        # WorkloadValidateResourcesAreNonNegative off -> negative passes.
+        wl = Workload(name="w", queue_name="lq", pod_sets=[
+            PodSet(name="a", count=1, requests={"cpu": -5})])
+        features.set_enabled(
+            "WorkloadValidateResourcesAreNonNegative", False)
+        validate_workload(wl)  # no raise
+        features.reset()
+        with pytest.raises(ValueError):
+            validate_workload(wl)
+
+        # KueueDRAIntegration off + reject gate -> creation fails.
+        mgr = Manager()
+        mgr.device_class_mappings = []
+        mgr.apply(
+            ResourceFlavor(name="default"),
+            make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+        )
+        features.set_enabled("KueueDRAIntegration", False)
+        dra_wl = Workload(name="d", queue_name="lq", pod_sets=[
+            PodSet(name="main", count=1, requests={"cpu": 100},
+                   device_requests={"tpu.dra": 1})])
+        with pytest.raises(ValueError, match="KueueDRAIntegration"):
+            mgr.create_workload(dra_wl)
+        # Ignore mode: device requests dropped silently.
+        features.set_enabled("KueueDRARejectWorkloadsWhenDRADisabled", False)
+        dra_wl2 = Workload(name="d2", queue_name="lq", pod_sets=[
+            PodSet(name="main", count=1, requests={"cpu": 100},
+                   device_requests={"tpu.dra": 1})])
+        mgr.create_workload(dra_wl2)
+        assert dra_wl2.pod_sets[0].device_requests == {}
+        assert dra_wl2.pod_sets[0].requests == {"cpu": 100}
+    finally:
+        features.reset()
+
+
+def test_multilayer_gate_disables_slice_layers():
+    from kueue_tpu.tas.snapshot import (
+        Node as TASNode, PlacementRequest, TASFlavorSnapshot,
+    )
+    from kueue_tpu.api.types import Topology
+    from kueue_tpu.utils import features
+
+    nodes = [TASNode(name=f"h{i}", labels={"rack": "r0"},
+                     capacity={"tpu": 8}) for i in range(2)]
+    snap = TASFlavorSnapshot(
+        Topology(name="t", levels=["rack", "kubernetes.io/hostname"]),
+        nodes,
+    )
+    req = PlacementRequest(
+        count=8, single_pod_requests={"tpu": 1},
+        required_level="rack",
+        slice_required_level="rack", slice_size=8,
+        slice_layers=[("kubernetes.io/hostname", 4)],
+    )
+    ta, _, reason = snap.find_topology_assignment(req)
+    assert reason == "" and ta is not None
+    try:
+        features.set_enabled("TASMultiLayerTopology", False)
+        ta2, _, reason2 = snap.find_topology_assignment(req)
+        assert ta2 is None and "TASMultiLayerTopology" in reason2
+    finally:
+        features.reset()
+
+
+def test_all_reference_gates_registered():
+    from kueue_tpu.utils import features
+
+    gates = features.all_gates()
+    assert len(gates) >= 78
+    for name in ("TASBalancedPlacement", "SchedulingEquivalenceHashing",
+                 "KueueDRAIntegrationConsumableCapacity", "PriorityBoost",
+                 "VectorizedResourceRequests"):
+        assert name in gates
