@@ -129,6 +129,24 @@ class WorkloadController:
                               "The workload is admitted", now)
 
         if is_admitted(wl):
+            if key not in self.admitted_at:
+                # First Admitted observation: admission lifecycle series
+                # (reference metrics.go admitted_workloads_total,
+                # admission_wait_time_seconds,
+                # admission_checks_wait_time_seconds).
+                m = self.manager.metrics
+                cq = self.manager.queues.cluster_queue_for(wl) or ""
+                m.inc("admitted_workloads_total", {"cluster_queue": cq})
+                m.observe("admission_wait_time_seconds",
+                          max(0.0, now - wl.creation_time),
+                          {"cluster_queue": cq})
+                qr = get_condition(wl, COND_QUOTA_RESERVED)
+                if qr is not None and qr.status:
+                    m.observe(
+                        "admission_checks_wait_time_seconds",
+                        max(0.0, now - qr.last_transition_time),
+                        {"cluster_queue": cq},
+                    )
             self.admitted_at.setdefault(key, now)
             # maximumExecutionTime (reference evictions by
             # MaximumExecutionTimeExceeded).
@@ -169,6 +187,20 @@ class WorkloadController:
         self.manager.metrics.inc(
             "evicted_workloads_total", {"reason": reason}
         )
+        # First-ever eviction of this workload (reference
+        # evicted_workloads_once_total) + time from PodsReady to eviction.
+        if not getattr(wl, "_evicted_once", False):
+            wl._evicted_once = True
+            self.manager.metrics.inc(
+                "evicted_workloads_once_total", {"reason": reason}
+            )
+        pr = get_condition(wl, COND_PODS_READY)
+        if pr is not None and pr.status:
+            self.manager.metrics.observe(
+                "pods_ready_to_evicted_time_seconds",
+                max(0.0, now - pr.last_transition_time),
+                {"reason": reason},
+            )
         wl.status.admission = None
         wl.status.admission_checks = []
         self.manager.cache.delete_workload(wl.key)

@@ -292,6 +292,8 @@ class Manager:
         self.cache.delete_workload(wl.key)
         self.queues.delete_workload(wl)
         self.metrics.inc("workloads_finished_total")
+        cq = self.queues.cluster_queue_for(wl) or ""
+        self.metrics.inc("finished_workloads_total", {"cluster_queue": cq})
         self.queues.queue_inadmissible_workloads()
 
     def reclaim_pods(self, wl: Workload, counts: Dict[str, int]) -> None:
@@ -402,9 +404,11 @@ class Manager:
             self.metrics.inc("quota_reserved_workloads_total")
             wl0 = self.workloads.get(key)
             if wl0 is not None:
-                # admission_wait_time_seconds (metrics.go:544).
+                # quota_reserved_wait_time_seconds (metrics.go:497):
+                # creation -> QuotaReserved. Admitted-side series emit on
+                # the Admitted transition in the workload controller.
                 self.metrics.observe(
-                    "admission_wait_time_seconds",
+                    "quota_reserved_wait_time_seconds",
                     max(0.0, now - wl0.creation_time),
                 )
             if tracker is not None:
@@ -420,6 +424,11 @@ class Manager:
                     )
         for key in result.preempted:
             self.metrics.inc("preempted_workloads_total")
+        for cq_name, skips in result.preemption_skips.items():
+            self.metrics.set_gauge(
+                "admission_cycle_preemption_skips", skips,
+                {"cluster_queue": cq_name},
+            )
         # Sync jobs whose workload state changed.
         self._reconcile_touched_jobs(result)
         return result
@@ -543,10 +552,69 @@ class Manager:
         from kueue_tpu.core.resources import FlavorResource
 
         snapshot = None
-        for name in self.cache.cluster_queues:
+        self.metrics.set_gauge("build_info", 1, {"framework": "kueue_tpu"})
+        for name, cq_spec in self.cache.cluster_queues.items():
             self.metrics.set_gauge(
                 "pending_workloads", self.queues.pending_count(name),
                 {"cluster_queue": name, "status": "active"},
+            )
+            active = self.cache.cluster_queue_active(cq_spec)
+            self.metrics.set_gauge(
+                "cluster_queue_status", 1.0 if active else 0.0,
+                {"cluster_queue": name, "status": "active"},
+            )
+            self.metrics.set_gauge(
+                "cluster_queue_info", 1,
+                {"cluster_queue": name, "cohort": cq_spec.cohort or ""},
+            )
+            # Spec quota series (metrics.go cluster_queue_nominal_quota /
+            # borrowing_limit / lending_limit).
+            for rg in cq_spec.resource_groups:
+                for fq in rg.flavors:
+                    for res, q in fq.resources.items():
+                        lbl = {"cluster_queue": name, "flavor": fq.name,
+                               "resource": res}
+                        self.metrics.set_gauge(
+                            "cluster_queue_nominal_quota", q.nominal, lbl
+                        )
+                        if q.borrowing_limit is not None:
+                            self.metrics.set_gauge(
+                                "cluster_queue_borrowing_limit",
+                                q.borrowing_limit, lbl,
+                            )
+                        if q.lending_limit is not None:
+                            self.metrics.set_gauge(
+                                "cluster_queue_lending_limit",
+                                q.lending_limit, lbl,
+                            )
+        for co_name, co in self.cache.cohorts.items():
+            self.metrics.set_gauge(
+                "cohort_info", 1,
+                {"cohort": co_name, "parent": co.parent or ""},
+            )
+        # Active admitted / reserving counts (metrics.go
+        # admitted_active_workloads, reserving_active_workloads).
+        admitted_n: Dict[str, int] = {}
+        reserving_n: Dict[str, int] = {}
+        from kueue_tpu.core.workload_info import is_admitted as _is_adm
+
+        for key3, info3 in self.cache.workloads.items():
+            wl3 = self.workloads.get(key3)
+            reserving_n[info3.cluster_queue] = (
+                reserving_n.get(info3.cluster_queue, 0) + 1
+            )
+            if wl3 is not None and _is_adm(wl3):
+                admitted_n[info3.cluster_queue] = (
+                    admitted_n.get(info3.cluster_queue, 0) + 1
+                )
+        for name in self.cache.cluster_queues:
+            self.metrics.set_gauge(
+                "admitted_active_workloads", admitted_n.get(name, 0),
+                {"cluster_queue": name},
+            )
+            self.metrics.set_gauge(
+                "reserving_active_workloads", reserving_n.get(name, 0),
+                {"cluster_queue": name},
             )
         usage_by_cq: Dict[str, Dict] = {}
         for info in self.cache.workloads.values():

@@ -84,6 +84,9 @@ class CycleResult:
     preempted: List[str] = field(default_factory=list)
     skipped: List[str] = field(default_factory=list)
     inadmissible: List[str] = field(default_factory=list)
+    # Per-CQ count of entries skipped because their preemption targets
+    # overlapped or no longer fit (reference admission_cycle_preemption_skips).
+    preemption_skips: Dict[str, int] = field(default_factory=dict)
     head_keys: frozenset = frozenset()
     duration_s: float = 0.0
     # Per-phase timings (reference scheduler.go:305-372 structured logs).
@@ -154,6 +157,7 @@ class Scheduler:
             self._process_entry(
                 e, snapshot, preempted_workloads, skipped_preemptions, result
             )
+        result.preemption_skips = skipped_preemptions
         result.process_s = self.clock() - t0
 
         # Requeue everything not assumed/evicted.

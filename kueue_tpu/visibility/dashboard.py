@@ -1,18 +1,24 @@
 """Web dashboard (kueueviz equivalent).
 
 Behavioral surface: reference cmd/kueueviz — a live view of ClusterQueues,
-pending/admitted workloads and quota usage. Single self-contained HTML page
-polling the JSON API; serve with ``serve_dashboard(manager)`` or mount into
-the visibility server.
+cohort topology, pending/admitted workloads, quota utilization and
+scheduling activity. Self-contained single page (no external assets):
+polls the JSON API and renders utilization bars, a cohort tree, an
+activity time-series chart (pending/admitted/preempted) and per-flavor
+breakdowns as inline SVG.
+
+Serve with ``serve_dashboard(manager)`` or mount into the visibility
+server.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
+from collections import deque
 from typing import Dict
 
-from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.core.workload_info import is_admitted
 
 _PAGE = """<!DOCTYPE html>
@@ -20,31 +26,80 @@ _PAGE = """<!DOCTYPE html>
 body{font-family:monospace;margin:2em;background:#111;color:#ddd}
 table{border-collapse:collapse;margin:1em 0}
 td,th{border:1px solid #444;padding:4px 10px;text-align:left}
-th{background:#222}.bar{background:#333;width:160px;height:12px}
-.fill{background:#4a8;height:12px}h2{color:#8cf}
+th{background:#222}.bar{background:#333;width:160px;height:12px;display:inline-block}
+.fill{background:#4a8;height:12px}.fill.hot{background:#e74}
+h2{color:#8cf}.cohort{margin-left:1.5em}.muted{color:#777}
+.tile{display:inline-block;border:1px solid #444;margin:4px;padding:8px 16px}
+.tile b{font-size:1.6em;color:#8cf;display:block}
+svg{background:#181818;border:1px solid #333}
 </style></head><body>
 <h1>kueue_tpu dashboard</h1>
+<div id="tiles"></div>
+<h2>Scheduling activity</h2>
+<svg id="chart" width="720" height="160"></svg>
+<div class="muted">pending <span style="color:#8cf">&#9632;</span>
+ admitted <span style="color:#4a8">&#9632;</span>
+ preempted-total <span style="color:#e74">&#9632;</span></div>
 <div id="content">loading...</div>
 <script>
+function polyline(points, color, w, h, maxY){
+  if (points.length < 2) return '';
+  const step = w / Math.max(points.length - 1, 1);
+  const pts = points.map((v,i) =>
+    `${(i*step).toFixed(1)},${(h - h*(v/Math.max(maxY,1))).toFixed(1)}`
+  ).join(' ');
+  return `<polyline fill="none" stroke="${color}" stroke-width="1.5" points="${pts}"/>`;
+}
 async function refresh(){
   const r = await fetch('/api/state'); const s = await r.json();
-  let h = '<h2>ClusterQueues</h2><table><tr><th>name</th><th>cohort</th>'+
-    '<th>pending</th><th>admitted</th><th>usage</th></tr>';
+
+  let tiles = '';
+  for (const [label, v] of Object.entries(s.totals)){
+    tiles += `<div class=tile><b>${v}</b>${label}</div>`;
+  }
+  document.getElementById('tiles').innerHTML = tiles;
+
+  const hist = s.history;
+  const maxY = Math.max(...hist.pending, ...hist.admitted, 1);
+  const maxP = Math.max(...hist.preempted_total, 1);
+  document.getElementById('chart').innerHTML =
+    polyline(hist.pending, '#8cf', 720, 160, maxY) +
+    polyline(hist.admitted, '#4a8', 720, 160, maxY) +
+    polyline(hist.preempted_total, '#e74', 720, 160, maxP);
+
+  let h = '<h2>Cohort topology</h2>';
+  function renderCohort(node, depth){
+    let out = `<div class=cohort style="margin-left:${depth*1.5}em">`+
+      `&#9656; <b>${node.name}</b> <span class=muted>`+
+      `${node.cqs.length} queues</span></div>`;
+    for (const cq of node.cqs){
+      out += `<div class=cohort style="margin-left:${(depth+1)*1.5}em">`+
+        `${cq}</div>`;
+    }
+    for (const child of node.children) out += renderCohort(child, depth+1);
+    return out;
+  }
+  for (const root of s.cohort_tree) h += renderCohort(root, 0);
+
+  h += '<h2>ClusterQueues</h2><table><tr><th>name</th><th>cohort</th>'+
+    '<th>pending</th><th>admitted</th><th>utilization (per flavor)</th></tr>';
   for (const cq of s.cluster_queues){
     h += `<tr><td>${cq.name}</td><td>${cq.cohort||''}</td>`+
       `<td>${cq.pending}</td><td>${cq.admitted}</td><td>`;
-    for (const [res, u] of Object.entries(cq.usage)){
+    for (const [key, u] of Object.entries(cq.usage)){
       const pct = Math.min(100, u.pct);
-      h += `${res}: ${u.used}/${u.nominal} `+
-        `<div class=bar><div class=fill style="width:${pct*1.6}px"></div></div>`;
+      const hot = u.pct > 95 ? ' hot' : '';
+      h += `${key}: ${u.used}/${u.nominal} (${u.pct}%)`+
+        `<div class=bar><div class="fill${hot}" style="width:${pct*1.6}px">`+
+        `</div></div><br>`;
     }
     h += '</td></tr>';
   }
   h += '</table><h2>Workloads</h2><table><tr><th>key</th><th>queue</th>'+
-    '<th>priority</th><th>status</th></tr>';
+    '<th>priority</th><th>status</th><th>topology</th></tr>';
   for (const w of s.workloads){
     h += `<tr><td>${w.key}</td><td>${w.queue}</td><td>${w.priority}</td>`+
-      `<td>${w.status}</td></tr>`;
+      `<td>${w.status}</td><td class=muted>${w.topology||''}</td></tr>`;
   }
   h += '</table>';
   document.getElementById('content').innerHTML = h;
@@ -52,47 +107,139 @@ async function refresh(){
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
+# Activity ring buffer sampled on every /api/state call (kueueviz keeps a
+# live websocket stream; polling + history is the self-contained analog).
+_HISTORY_LEN = 360
+
+
+class _History:
+    def __init__(self) -> None:
+        self.pending = deque(maxlen=_HISTORY_LEN)
+        self.admitted = deque(maxlen=_HISTORY_LEN)
+        self.preempted_total = deque(maxlen=_HISTORY_LEN)
+        self.t = deque(maxlen=_HISTORY_LEN)
+
+    def sample(self, pending: int, admitted: int, preempted: float) -> None:
+        self.pending.append(pending)
+        self.admitted.append(admitted)
+        self.preempted_total.append(preempted)
+        self.t.append(time.time())
+
+
+_history = _History()
+
+
+def _cohort_tree(manager):
+    children: Dict[str, list] = {}
+    cq_of: Dict[str, list] = {}
+    roots = []
+    for name, co in manager.cache.cohorts.items():
+        if co.parent:
+            children.setdefault(co.parent, []).append(name)
+        else:
+            roots.append(name)
+    for cq_name, cq in manager.cache.cluster_queues.items():
+        if cq.cohort:
+            cq_of.setdefault(cq.cohort, []).append(cq_name)
+
+    def build(name):
+        return {
+            "name": name,
+            "cqs": sorted(cq_of.get(name, [])),
+            "children": [build(c) for c in sorted(children.get(name, []))],
+        }
+
+    return [build(r) for r in sorted(roots)]
+
 
 def state_json(manager) -> Dict:
     cqs = []
+    total_pending = 0
+    total_admitted = 0
     for name, cq in sorted(manager.cache.cluster_queues.items()):
         usage: Dict[str, Dict] = {}
-        nominal: Dict[str, int] = {}
+        nominal: Dict[tuple, int] = {}
         for rg in cq.resource_groups:
             for fq in rg.flavors:
                 for res, q in fq.resources.items():
-                    nominal[res] = nominal.get(res, 0) + q.nominal
-        used: Dict[str, int] = {}
+                    nominal[(fq.name, res)] = q.nominal
+        used: Dict[tuple, int] = {}
         for info in manager.cache.workloads.values():
             if info.cluster_queue != name:
                 continue
             for fr, v in info.usage().items():
-                used[fr.resource] = used.get(fr.resource, 0) + v
-        for res, nom in nominal.items():
-            u = used.get(res, 0)
-            usage[res] = {
+                used[(fr.flavor, fr.resource)] = (
+                    used.get((fr.flavor, fr.resource), 0) + v
+                )
+        for (flavor, res), nom in nominal.items():
+            u = used.get((flavor, res), 0)
+            usage[f"{flavor}/{res}"] = {
                 "used": u, "nominal": nom,
                 "pct": round(100.0 * u / nom, 1) if nom else 0.0,
             }
+        pending = manager.queues.pending_count(name)
+        admitted = sum(
+            1 for i in manager.cache.workloads.values()
+            if i.cluster_queue == name
+        )
+        total_pending += pending
+        total_admitted += admitted
         cqs.append({
             "name": name,
             "cohort": cq.cohort,
-            "pending": manager.queues.pending_count(name),
-            "admitted": sum(
-                1 for i in manager.cache.workloads.values()
-                if i.cluster_queue == name
-            ),
+            "pending": pending,
+            "admitted": admitted,
             "usage": usage,
         })
     wls = []
     for key, wl in sorted(manager.workloads.items()):
+        topo = ""
+        if wl.status.admission is not None:
+            for psa in wl.status.admission.pod_set_assignments:
+                ta = psa.topology_assignment
+                if ta is not None and ta.domains:
+                    topo = ", ".join(
+                        f"{'/'.join(v)}x{c}" for v, c in ta.domains[:4]
+                    )
+                    if len(ta.domains) > 4:
+                        topo += f" +{len(ta.domains) - 4} more"
         wls.append({
             "key": key,
             "queue": wl.queue_name,
             "priority": wl.priority,
             "status": "Admitted" if is_admitted(wl) else "Pending",
+            "topology": topo,
         })
-    return {"cluster_queues": cqs, "workloads": wls}
+    m = manager.metrics
+    preempted_total = sum(
+        m.counters.get("preempted_workloads_total", {}).values()
+    )
+    totals = {
+        "pending": total_pending,
+        "admitted": total_admitted,
+        "preempted (total)": int(preempted_total),
+        "evicted (total)": int(sum(
+            m.counters.get("evicted_workloads_total", {}).values()
+        )),
+        "finished (total)": int(sum(
+            m.counters.get("workloads_finished_total", {}).values()
+        )),
+        "cycles": int(sum(
+            m.counters.get("admission_attempts_total", {}).values()
+        )),
+    }
+    _history.sample(total_pending, total_admitted, preempted_total)
+    return {
+        "cluster_queues": cqs,
+        "workloads": wls,
+        "cohort_tree": _cohort_tree(manager),
+        "totals": totals,
+        "history": {
+            "pending": list(_history.pending),
+            "admitted": list(_history.admitted),
+            "preempted_total": list(_history.preempted_total),
+        },
+    }
 
 
 def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081):
@@ -103,6 +250,9 @@ def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081):
             if self.path == "/api/state":
                 body = json.dumps(state_json(manager)).encode()
                 ctype = "application/json"
+            elif self.path == "/api/metrics":
+                body = manager.metrics.expose().encode()
+                ctype = "text/plain"
             elif self.path in ("/", "/index.html"):
                 body = _PAGE.encode()
                 ctype = "text/html"

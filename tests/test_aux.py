@@ -248,7 +248,10 @@ def test_dashboard_state_and_http():
     mgr.create_workload(make_wl("d1", cpu_m=1000))
     mgr.schedule_all()
     state = state_json(mgr)
-    assert state["cluster_queues"][0]["usage"]["cpu"]["used"] == 1000
+    assert state["cluster_queues"][0]["usage"]["default/cpu"]["used"] == 1000
+    assert state["totals"]["admitted"] == 1
+    assert state["cohort_tree"] == []
+    assert len(state["history"]["pending"]) >= 1
     httpd = serve_dashboard(mgr, port=0)
     port = httpd.server_address[1]
     try:
@@ -805,3 +808,44 @@ def test_dra_resourceslice_feeds_tas_leaf_capacity():
     assert is_admitted(wl), wl.status
     ta = wl.status.admission.pod_set_assignments[0].topology_assignment
     assert ta.domains == [(("node-0-0-0",), 1)]
+
+
+def test_metrics_lifecycle_series():
+    """Admission lifecycle metric series land at the right transitions
+    (reference metrics.go): quota_reserved/admission wait histograms,
+    admitted/evicted/finished counters, spec + activity gauges."""
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", cohort=None,
+                flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = make_wl("m1", cpu_m=1000, creation_time=0.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    m = mgr.metrics
+    assert m.get("admitted_workloads_total", {"cluster_queue": "cq-a"}) == 1
+    assert m.histograms["quota_reserved_wait_time_seconds"]
+    assert m.histograms["admission_wait_time_seconds"]
+    assert m.get("admitted_active_workloads", {"cluster_queue": "cq-a"}) == 1
+    assert m.get("cluster_queue_nominal_quota",
+                 {"cluster_queue": "cq-a", "flavor": "default",
+                  "resource": "cpu"}) == 4000
+    assert m.get("cluster_queue_status",
+                 {"cluster_queue": "cq-a", "status": "active"}) == 1
+    assert m.get("build_info", {"framework": "kueue_tpu"}) == 1
+
+    mgr.workload_controller.evict(wl, "TestReason", "bye", mgr.clock())
+    assert m.get("evicted_workloads_total", {"reason": "TestReason"}) == 1
+    assert m.get("evicted_workloads_once_total",
+                 {"reason": "TestReason"}) == 1
+
+    wl2 = make_wl("m2", cpu_m=500, creation_time=1.0)
+    mgr.create_workload(wl2)
+    mgr.schedule_all()
+    mgr.finish_workload(wl2)
+    assert m.get("finished_workloads_total", {"cluster_queue": "cq-a"}) == 1
+    text = mgr.metrics.expose()
+    assert "kueue_admitted_workloads_total" in text
+    assert "kueue_cluster_queue_nominal_quota" in text
