@@ -127,3 +127,74 @@ def test_device_tas_matches_host(seed):
         assert dev_state[name] == host_state[name], (
             f"{name}: host={host_state[name]} device={dev_state[name]}"
         )
+
+
+def test_mixed_tas_and_preemption_fallback_ordering():
+    """TAS workloads alongside preemption-needing entries: some entries
+    resolve on device, TAS+preempt ones fall back to host within the same
+    cycle — the final states must still match the pure-host scheduler
+    (validates the driver's device-then-host split)."""
+    import random as _random
+
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption
+    from kueue_tpu.tas.snapshot import Node
+
+    LVL = ["rack", "kubernetes.io/hostname"]
+
+    def build(seed, device):
+        rng = _random.Random(seed)
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(64)}},
+                    resources=["tpu"],
+                    preemption=ClusterQueuePreemption(
+                        within_cluster_queue=(
+                            PreemptionPolicy.LOWER_PRIORITY))),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LVL),
+        )
+        for r in range(2):
+            for h in range(2):
+                mgr.apply(Node(name=f"n{r}{h}", labels={"rack": f"r{r}"},
+                               capacity={"tpu": 8}))
+        wls = []
+        for i in range(rng.randint(4, 8)):
+            tas = rng.random() < 0.6
+            wls.append(Workload(
+                name=f"w{i}", queue_name="lq",
+                pod_sets=[PodSet(
+                    name="main", count=rng.choice([1, 2]),
+                    requests={"tpu": rng.choice([2, 4, 8])},
+                    topology_request=TopologyRequest(
+                        required_level=rng.choice(LVL)) if tas else None,
+                )],
+                priority=rng.randrange(0, 3) * 100,
+                creation_time=float(i + 1),
+            ))
+        sched = DeviceScheduler(mgr.cache, mgr.queues) if device \
+            else mgr.scheduler
+        return mgr, sched, wls
+
+    def run(seed, device):
+        mgr, sched, wls = build(seed, device)
+        for i, wl in enumerate(wls):
+            mgr.create_workload(wl)
+            if i % 3 == 2:
+                sched.schedule_all(max_cycles=30)
+        sched.schedule_all(max_cycles=30)
+        out = {}
+        for wl in wls:
+            adm = wl.status.admission
+            if adm is None:
+                out[wl.name] = None
+            else:
+                psa = adm.pod_set_assignments[0]
+                ta = psa.topology_assignment
+                out[wl.name] = (sorted(psa.flavors.items()),
+                                sorted(ta.domains) if ta else None)
+        return out
+
+    for seed in range(8):
+        assert run(seed, False) == run(seed, True), f"seed {seed}"
