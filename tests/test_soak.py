@@ -266,3 +266,80 @@ def test_spec_change_mid_flight_no_double_count():
     snap = mgr.cache.snapshot()
     fr = FlavorResource("default", "cpu")
     assert snap.cluster_queues["cq-a"].node.usage[fr] == 6000
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_device_scheduler_soak(seed):
+    """The DeviceScheduler under the same random-lifecycle churn: device
+    preemption + device TAS + host fallbacks interleaved, with the global
+    invariants checked after every step."""
+    from kueue_tpu.api.types import PodSet, Topology, TopologyRequest, Workload
+    from kueue_tpu.tas.snapshot import Node
+
+    rng = random.Random(4000 + seed)
+    mgr = Manager(use_device_scheduler=True)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        Cohort(name="co-0"),
+        Topology(name="topo",
+                 levels=["rack", "kubernetes.io/hostname"]),
+    )
+    for r in range(2):
+        for h in range(2):
+            mgr.apply(Node(name=f"n{r}{h}", labels={"rack": f"r{r}"},
+                           capacity={"tpu": 8}))
+    mgr.apply(
+        make_cq("cq-cpu", cohort="co-0",
+                flavors={"default": {"cpu": quota(6_000)}},
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                    reclaim_within_cohort=PreemptionPolicy.ANY)),
+        make_cq("cq-cpu2", cohort="co-0",
+                flavors={"default": {"cpu": quota(4_000)}}),
+        make_cq("cq-tpu",
+                flavors={"tpu-v5e": {"tpu": quota(32)}},
+                resources=["tpu"],
+                preemption=ClusterQueuePreemption(
+                    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)),
+        LocalQueue(name="lq-cpu", cluster_queue="cq-cpu"),
+        LocalQueue(name="lq-cpu2", cluster_queue="cq-cpu2"),
+        LocalQueue(name="lq-tpu", cluster_queue="cq-tpu"),
+    )
+
+    live = []
+    n = 0
+    for step in range(60):
+        op = rng.random()
+        if op < 0.5 or not live:
+            n += 1
+            if rng.random() < 0.4:
+                wl = Workload(
+                    name=f"g{n}", queue_name="lq-tpu",
+                    pod_sets=[PodSet(
+                        name="main", count=rng.choice([1, 2]),
+                        requests={"tpu": rng.choice([2, 4, 8])},
+                        topology_request=TopologyRequest(
+                            required_level=rng.choice(
+                                ["rack", "kubernetes.io/hostname"])),
+                    )],
+                    priority=rng.randrange(0, 3) * 100,
+                    creation_time=float(step + 1),
+                )
+            else:
+                wl = make_wl(
+                    f"w{n}", queue=rng.choice(["lq-cpu", "lq-cpu2"]),
+                    cpu_m=rng.choice([500, 1500, 3000]),
+                    priority=rng.randrange(0, 3) * 100,
+                    creation_time=float(step + 1),
+                )
+            mgr.create_workload(wl)
+            live.append(wl)
+        elif op < 0.8:
+            wl = rng.choice(live)
+            live.remove(wl)
+            mgr.finish_workload(wl)
+        else:
+            mgr.scheduler.schedule_all(max_cycles=20)
+        mgr.scheduler.schedule_all(max_cycles=20)
+        check_invariants(mgr)
