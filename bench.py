@@ -278,20 +278,16 @@ def probe_ping():
             "check": float(x[0, 0])}
 
 
-def probe_mega():
-    """One batched scheduling cycle at the north-star scale — 50k pending
-    workloads x 2000 CQs (50 cohorts) x 32 flavors — as a single compiled
-    program on the attached accelerator."""
+def build_mega(W=50_000, C=2000, F=32, R=2, CO=50):
+    """Dense north-star-scale cycle arrays (50k pending workloads x 2000
+    CQs in 50 cohorts x 32 flavors by default). Shared by the mega probe
+    and the offline tuning sweep (tools/tune_mega.py)."""
     import numpy as np
-    import jax
     import jax.numpy as jnp
 
-    from kueue_tpu.models import batch_scheduler as bs
     from kueue_tpu.models.encode import CycleArrays
     from kueue_tpu.ops.quota_ops import QuotaTreeArrays, compute_subtree
     from kueue_tpu.ops.tree_encode import GroupLayout
-
-    W, C, F, R, CO = 50_000, 2000, 32, 2, 50
     rng = np.random.default_rng(0)
     N = C + CO
     parent = np.full(N, -1, np.int32)
@@ -346,6 +342,20 @@ def probe_mega():
         w_start_flavor=jnp.zeros(W, np.int32),
     )
     layout = GroupLayout(parent, np.ones(N, bool))
+    return arrays, layout
+
+
+def probe_mega():
+    """One batched scheduling cycle at the north-star scale — 50k pending
+    workloads x 2000 CQs (50 cohorts) x 32 flavors — as a single compiled
+    program on the attached accelerator."""
+    import numpy as np
+    import jax
+
+    from kueue_tpu.models import batch_scheduler as bs
+
+    W = 50_000
+    arrays, layout = build_mega(W=W)
     ga = bs.GroupArrays(*layout.as_jax())
     out_stats = {"probe": "mega", "ok": True,
                  "platform": jax.devices()[0].platform}

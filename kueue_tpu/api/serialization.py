@@ -197,6 +197,7 @@ def decode(doc: Dict[str, Any]):
             namespace=meta.get("namespace", "default"),
             cluster_queue=spec.get("clusterQueue", ""),
             stop_policy=StopPolicy(spec.get("stopPolicy", "None")),
+            labels=meta.get("labels", {}),
         )
     if kind == "AdmissionCheck":
         return AdmissionCheck(
@@ -250,6 +251,8 @@ def decode(doc: Dict[str, Any]):
             priority_class=spec.get("priorityClassName"),
             active=spec.get("active", True),
             pod_sets=[_podset(ps) for ps in spec.get("podSets", [])],
+            labels=meta.get("labels", {}),
+            annotations=meta.get("annotations", {}),
         )
         status = doc.get("status") or {}
         adm = status.get("admission")
@@ -589,10 +592,20 @@ def encode(obj) -> Dict[str, Any]:
         return {"kind": "ClusterQueue", "metadata": {"name": obj.name},
                 "spec": spec}
     if isinstance(obj, LocalQueue):
+        from kueue_tpu.api.constants import StopPolicy as _SP
+
         return {
             "kind": "LocalQueue",
-            "metadata": {"name": obj.name, "namespace": obj.namespace},
-            "spec": {"clusterQueue": obj.cluster_queue},
+            "metadata": {
+                "name": obj.name,
+                "namespace": obj.namespace,
+                **({"labels": dict(obj.labels)} if obj.labels else {}),
+            },
+            "spec": {
+                "clusterQueue": obj.cluster_queue,
+                **({"stopPolicy": obj.stop_policy.value}
+                   if obj.stop_policy != _SP.NONE else {}),
+            },
         }
     if isinstance(obj, AdmissionCheck):
         return {
@@ -612,7 +625,13 @@ def encode(obj) -> Dict[str, Any]:
     if isinstance(obj, Workload):
         doc: Dict[str, Any] = {
             "kind": "Workload",
-            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "metadata": {
+                "name": obj.name,
+                "namespace": obj.namespace,
+                **({"labels": dict(obj.labels)} if obj.labels else {}),
+                **({"annotations": dict(obj.annotations)}
+                   if obj.annotations else {}),
+            },
             "spec": {
                 "queueName": obj.queue_name,
                 "priority": obj.priority,

@@ -255,6 +255,7 @@ class LocalQueue:
     cluster_queue: str = ""
     stop_policy: StopPolicy = StopPolicy.NONE
     fair_sharing: Optional[FairSharing] = None
+    labels: Dict[str, str] = field(default_factory=dict)
 
     @property
     def key(self) -> str:
@@ -392,6 +393,7 @@ class Workload:
     creation_time: float = 0.0
     uid: str = field(default_factory=_new_uid)
     labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
     maximum_execution_time_seconds: Optional[int] = None
     status: WorkloadStatus = field(default_factory=WorkloadStatus)
 

@@ -88,7 +88,7 @@ class WorkloadInfo:
         return self.obj.key
 
     def priority(self) -> int:
-        return self.obj.priority
+        return effective_priority(self.obj)
 
     def usage(self) -> FlavorResourceQuantities:
         """Quota usage keyed by (flavor, resource), derived from the podset
@@ -171,6 +171,27 @@ class WorkloadInfo:
 
 
 # ---- condition helpers (reference pkg/workload condition functions) ------
+
+
+PRIORITY_BOOST_ANNOTATION = "kueue.x-k8s.io/priority-boost"
+
+
+def effective_priority(wl: Workload) -> int:
+    """Base priority adjusted by the priority-boost annotation behind the
+    PriorityBoost gate (reference pkg/util/priority/priority.go:64-86,
+    KEP-7990): invalid values fall back to the base priority (the webhook
+    rejects them at admission; this is defense in depth)."""
+    from kueue_tpu.utils import features
+
+    if not features.enabled("PriorityBoost"):
+        return wl.priority
+    raw = wl.annotations.get(PRIORITY_BOOST_ANNOTATION)
+    if not raw:
+        return wl.priority
+    try:
+        return wl.priority + int(raw)
+    except ValueError:
+        return wl.priority
 
 
 def get_condition(wl: Workload, cond_type: str) -> Optional[Condition]:

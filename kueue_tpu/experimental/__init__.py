@@ -1,0 +1,7 @@
+"""Experimental controllers (reference cmd/experimental): the LocalQueue
+populator and the time-sharing priority booster."""
+
+from kueue_tpu.experimental.populator import PopulatorController
+from kueue_tpu.experimental.priority_booster import PriorityBoostController
+
+__all__ = ["PopulatorController", "PriorityBoostController"]

@@ -1,0 +1,100 @@
+"""LocalQueue populator (reference cmd/experimental/kueue-populator).
+
+Watches namespaces and ClusterQueues; for every (namespace, CQ) pair where
+the CQ's namespaceSelector matches the namespace labels (and the namespace
+passes the populator's own selector), ensures a LocalQueue pointing at the
+CQ exists in that namespace. Behavioral surface:
+cmd/experimental/kueue-populator/pkg/controller/controller.go:108-282.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kueue_tpu.api.types import LabelSelector, LocalQueue
+
+AUTO_GENERATED_LABEL = "kueue.x-k8s.io/auto-generated"
+
+# LocalQueueNameMode (pkg/config/config.go)
+NAME_MODE_FIXED = "Fixed"
+NAME_MODE_AS_CLUSTER_QUEUE = "AsClusterQueue"
+
+
+@dataclass
+class PopulatorEvent:
+    kind: str  # Created | Exists | Skipped
+    namespace: str
+    local_queue: str
+    cluster_queue: str
+
+
+@dataclass
+class PopulatorController:
+    """Call-driven reconciler: ``reconcile(manager)`` scans all namespaces
+    known to the cache (plus any defaults) against all ClusterQueues."""
+
+    namespace_selector: Optional[LabelSelector] = None
+    local_queue_name: str = "default"
+    local_queue_name_mode: str = NAME_MODE_AS_CLUSTER_QUEUE
+    events: List[PopulatorEvent] = field(default_factory=list)
+
+    def _ns_matches(self, labels: Dict[str, str]) -> bool:
+        if self.namespace_selector is None:
+            return True
+        return self.namespace_selector.matches(labels)
+
+    def _cq_selects(self, cq, labels: Dict[str, str]) -> bool:
+        sel = cq.namespace_selector
+        if sel is None:
+            return True
+        if isinstance(sel, LabelSelector):
+            return sel.matches(labels)
+        return all(labels.get(k) == v for k, v in sel.items())
+
+    def reconcile(self, manager) -> List[PopulatorEvent]:
+        """Ensure LocalQueues exist for every matching (namespace, CQ).
+        Returns the events of this pass (also appended to ``events``)."""
+        cache = manager.cache
+        out: List[PopulatorEvent] = []
+        namespaces = dict(cache.namespaces)
+        # Namespaces referenced by workloads but not registered get the
+        # implicit metadata.name label (mirrors the implied label the
+        # scheduler's namespaceSelector check uses).
+        for ns_name, ns in namespaces.items():
+            labels = dict(getattr(ns, "labels", {}) or {})
+            labels.setdefault("kubernetes.io/metadata.name", ns_name)
+            if not self._ns_matches(labels):
+                continue
+            for cq_name, cq in cache.cluster_queues.items():
+                if not self._cq_selects(cq, labels):
+                    continue
+                lq_name = (
+                    cq_name
+                    if self.local_queue_name_mode == NAME_MODE_AS_CLUSTER_QUEUE
+                    else self.local_queue_name
+                )
+                key = f"{ns_name}/{lq_name}"
+                existing = cache.local_queues.get(key)
+                if existing is not None:
+                    kind = (
+                        "Exists"
+                        if existing.cluster_queue == cq_name
+                        else "Skipped"  # name collision with other CQ
+                    )
+                    out.append(
+                        PopulatorEvent(kind, ns_name, lq_name, cq_name)
+                    )
+                    continue
+                lq = LocalQueue(
+                    name=lq_name,
+                    namespace=ns_name,
+                    cluster_queue=cq_name,
+                    labels={AUTO_GENERATED_LABEL: "true"},
+                )
+                manager.apply(lq)
+                out.append(
+                    PopulatorEvent("Created", ns_name, lq_name, cq_name)
+                )
+        self.events.extend(out)
+        return out

@@ -1,0 +1,125 @@
+"""Time-sharing priority booster
+(reference cmd/experimental/kueue-priority-booster).
+
+Once a workload has been Admitted for at least ``time_sharing_interval``,
+sets the ``kueue.x-k8s.io/priority-boost`` annotation to a negative value so
+same-base-priority pending workloads can preempt it under
+withinClusterQueue: LowerPriority — cooperative time slicing on top of the
+normal preemption machinery. Behavioral surface:
+cmd/experimental/kueue-priority-booster/pkg/controller/controller.go:40-285.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import time
+
+from kueue_tpu.api.constants import COND_ADMITTED
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import (
+    PRIORITY_BOOST_ANNOTATION,
+    get_condition,
+)
+
+
+@dataclass
+class PriorityBoostController:
+    """Call-driven reconciler: ``reconcile(manager)`` sweeps all workloads.
+
+    * admitted for >= ``time_sharing_interval`` seconds and in scope →
+      annotation set to ``-negative_boost_value``;
+    * out of scope / not admitted → a controller-managed (negative)
+      annotation is cleared; zero/positive values are treated as
+      manually set and left untouched.
+    """
+
+    time_sharing_interval: float = 0.0
+    negative_boost_value: int = 100_000
+    workload_selector: Optional[Callable[[Workload], bool]] = None
+    max_workload_priority: Optional[int] = None
+    clock: Callable[[], float] = time.monotonic
+    changed: List[str] = field(default_factory=list)
+
+    def _in_scope(self, wl: Workload) -> bool:
+        if self.workload_selector is not None and not self.workload_selector(
+            wl
+        ):
+            return False
+        if (
+            self.max_workload_priority is not None
+            and wl.priority > self.max_workload_priority
+        ):
+            return False
+        return True
+
+    def _compute_boost(self, wl: Workload) -> int:
+        """(boost, 0) after the time-sharing window; 0 otherwise."""
+        if self.time_sharing_interval <= 0:
+            return 0
+        cond = get_condition(wl, COND_ADMITTED)
+        if cond is None or not cond.status:
+            return 0
+        if self.clock() - cond.last_transition_time \
+                < self.time_sharing_interval:
+            return 0
+        return -self.negative_boost_value
+
+    def reconcile_workload(self, manager, wl: Workload) -> bool:
+        """Returns True when the annotation changed (priority re-resolves
+        through the queue update)."""
+        current = wl.annotations.get(PRIORITY_BOOST_ANNOTATION, "")
+        if not self._in_scope(wl):
+            # Clear only controller-managed (negative) values.
+            try:
+                managed = current != "" and int(current) < 0
+            except ValueError:
+                managed = False
+            if not managed:
+                return False
+            del wl.annotations[PRIORITY_BOOST_ANNOTATION]
+            self._requeue(manager, wl)
+            return True
+
+        boost = self._compute_boost(wl)
+        desired = str(boost) if boost != 0 else ""
+        if current == desired:
+            return False
+        if desired:
+            wl.annotations[PRIORITY_BOOST_ANNOTATION] = desired
+        else:
+            wl.annotations.pop(PRIORITY_BOOST_ANNOTATION, None)
+        self._requeue(manager, wl)
+        return True
+
+    @staticmethod
+    def _requeue(manager, wl: Workload) -> None:
+        """Effective priority changed (reference workload.go:1525
+        PriorityChanged -> workload_controller.go:1471): re-sort queue
+        membership for pending workloads; for admitted ones, wake the
+        associated inadmissible workloads so a pending peer can now try to
+        preempt the deprioritized workload."""
+        from kueue_tpu.core.workload_info import is_admitted
+
+        if is_admitted(wl):
+            cq = manager.queues.cluster_queue_for(wl)
+            manager.queues.queue_inadmissible_workloads(
+                [cq] if cq else None
+            )
+        else:
+            manager.queues.add_or_update_workload(wl)
+
+    def reconcile(self, manager) -> List[str]:
+        """Sweep every workload known to the manager's cache + queues."""
+        out: List[str] = []
+        seen: Dict[str, Workload] = {}
+        for info in manager.cache.workloads.values():
+            seen[info.obj.key] = info.obj
+        for wl in list(getattr(manager, "workloads", {}).values()):
+            seen.setdefault(wl.key, wl)
+        for wl in seen.values():
+            if self.reconcile_workload(manager, wl):
+                out.append(wl.key)
+        self.changed.extend(out)
+        return out

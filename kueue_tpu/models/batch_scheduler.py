@@ -58,6 +58,7 @@ OUT_NEEDS_HOST = 2
 OUT_FIT_SKIPPED = 3
 OUT_ADMITTED = 4
 OUT_PREEMPTING = 5  # victims designated; entry waits for their eviction
+OUT_SHADOWED = 6  # fair tournament: a later same-CQ entry displaced this one
 
 _BIG = jnp.int64(1) << 40
 _NEG_INF = -(jnp.int64(1) << 60)
@@ -464,6 +465,7 @@ def admit_scan_grouped(
     s_max: int,
     adm=None,
     targets=None,
+    unroll: int = 2,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forest-parallel admission scan.
 
@@ -729,7 +731,7 @@ def admit_scan_grouped(
     (final_usage_g, _designated, _tas_u), (w_mat, admit_mat, pre_mat) = \
         jax.lax.scan(
             body, (usage_g, designated0, tas_usage0), jnp.arange(s_max),
-            unroll=2,
+            unroll=unroll,
         )
     admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
         admit_mat.ravel(), mode="drop"
@@ -745,7 +747,8 @@ def admit_scan_grouped(
     return final_usage, admitted, preempting_out
 
 
-def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
+def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
+                       unroll: int = 2):
     """Build a jittable grouped cycle; s_max=0 means exact (W slots).
 
     With ``preempt=True`` the cycle takes a third AdmittedArrays argument
@@ -804,7 +807,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             final_usage, admitted, preempting = admit_scan_grouped(
-                arrays, ga, nom, usage, order, s
+                arrays, ga, nom, usage, order, s, unroll=unroll
             )
             return finish(arrays, nom, final_usage, admitted, preempting,
                           order)
@@ -912,6 +915,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False):
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         final_usage, admitted, preempting = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
+            unroll=unroll,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
                       victims=tgt.victims, variant=tgt.variant)
@@ -964,10 +968,11 @@ def admit_fixedpoint(
     usage: jnp.ndarray,
     order: jnp.ndarray,
     max_rounds: int = 64,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Order-exact admission equivalent to admit_scan_grouped, computed in
-    O(rounds) fully-vectorized passes. Requires no lending limits (caller
-    checks has_lend_limit is all-False)."""
+    O(rounds) fully-vectorized passes; also returns the rounds taken.
+    Requires no lending limits (caller checks has_lend_limit is
+    all-False)."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
@@ -1130,7 +1135,7 @@ def admit_fixedpoint(
         vals = jnp.where(is_repeat[:, None, None], 0, contrib)
         add_d = add_d.at[chains[:, d]].add(vals, mode="drop")
         final_usage = quota_ops.sat(final_usage + add_d)
-    return final_usage, admitted
+    return final_usage, admitted, rounds
 
 
 def make_fixedpoint_cycle(max_rounds: int = 64):
@@ -1143,7 +1148,7 @@ def make_fixedpoint_cycle(max_rounds: int = 64):
         usage = arrays.usage
         nom = nominate(arrays, usage)
         order = admission_order(arrays, nom)
-        final_usage, admitted = admit_fixedpoint(
+        final_usage, admitted, _rounds = admit_fixedpoint(
             arrays, ga, nom, usage, order, max_rounds
         )
         outcome = jnp.where(

@@ -85,11 +85,15 @@ class DeviceScheduler:
         if idx.workloads:
             t0 = self.clock()
             # Default kernel: forest-grouped scan with on-device classical
-            # preemption. The fixed-point kernel (exact for
-            # no-lending-limit trees, no device preemption) is opt-in until
-            # TPU measurements establish the crossover; bench.py probes
-            # both.
-            if self.use_fixedpoint and not bool(
+            # preemption. Fair sharing swaps in the DRS tournament kernel.
+            # The fixed-point kernel (exact for no-lending-limit trees, no
+            # device preemption) is opt-in until TPU measurements establish
+            # the crossover; bench.py probes both.
+            if self.fair_sharing:
+                from kueue_tpu.models.fair_kernel import cycle_fair
+
+                out = cycle_fair(arrays)
+            elif self.use_fixedpoint and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
                 out = batch_scheduler.cycle_fixedpoint(
@@ -119,8 +123,29 @@ class DeviceScheduler:
                 out, outcome, chosen, idx, snapshot
             )
 
+            # Fair tournaments interleave per cohort tree: if any entry of
+            # a tree must run on the host (preempt mode, encode fallback),
+            # the device's per-tree ordering is incomplete — discard the
+            # whole tree's device outcomes and route it through the host.
+            discarded_roots = set()
+            if self.fair_sharing:
+                def _root_id(cq_name: str):
+                    cqs = snapshot.cluster_queues.get(cq_name)
+                    return id(cqs.node.root()) if cqs is not None else None
+
+                for info in idx.host_fallback:
+                    discarded_roots.add(_root_id(info.cluster_queue))
+                for i, info in enumerate(idx.workloads):
+                    if outcome[i] == batch_scheduler.OUT_NEEDS_HOST:
+                        discarded_roots.add(_root_id(info.cluster_queue))
+                discarded_roots.discard(None)
+
             for i, info in enumerate(idx.workloads):
                 oc = outcome[i]
+                if discarded_roots and \
+                        self._in_discarded(info, snapshot, discarded_roots):
+                    host_entries.append(info)
+                    continue
                 if oc == batch_scheduler.OUT_ADMITTED:
                     self._apply_admission(
                         info, idx.flavors[chosen[i]], int(tried[i]),
@@ -167,6 +192,11 @@ class DeviceScheduler:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _in_discarded(info, snapshot, discarded_roots) -> bool:
+        cqs = snapshot.cluster_queues.get(info.cluster_queue)
+        return cqs is not None and id(cqs.node.root()) in discarded_roots
+
     def _host_process(self, infos: List[WorkloadInfo]) -> CycleResult:
         """Run the host-exact pipeline on specific workloads by temporarily
         feeding them as the only heads."""
@@ -186,6 +216,9 @@ class DeviceScheduler:
                 result.admitted.append(e.info.key)
             elif e.status == EntryStatus.PREEMPTING:
                 result.preempting.append(e.info.key)
+                # Mirror Scheduler.schedule: the preemptor stays pinned at
+                # the head while its victims' evictions land.
+                e.requeue_reason = RequeueReason.PENDING_PREEMPTION
                 self.host._requeue_and_update(e)
             elif e.status != EntryStatus.EVICTED:
                 result.skipped.append(e.info.key)
@@ -351,6 +384,12 @@ class DeviceScheduler:
             batch_scheduler.OUT_NO_CANDIDATES:
                 RequeueReason.PREEMPTION_NO_CANDIDATES,
             batch_scheduler.OUT_FIT_SKIPPED:
+                RequeueReason.FAILED_AFTER_NOMINATION,
+            # A shadowed fair-tournament entry was nominated but never
+            # evaluated; the host upgrades its GENERIC reason to
+            # FAILED_AFTER_NOMINATION (scheduler._requeue_and_update), which
+            # re-heaps immediately instead of parking it inadmissible.
+            batch_scheduler.OUT_SHADOWED:
                 RequeueReason.FAILED_AFTER_NOMINATION,
         }.get(outcome, RequeueReason.GENERIC)
         self.queues.requeue_workload(info, reason)

@@ -88,6 +88,8 @@ class CycleArrays(NamedTuple):
     w_tas_required: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_unconstrained: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_invalid: Optional[jnp.ndarray] = None  # bool[W] always-infeasible
+    # -- fair sharing (None unless the fair tournament kernel is in use) --
+    node_weight: Optional[jnp.ndarray] = None  # f64[N] FairSharing weight
 
 
 @dataclass
@@ -260,12 +262,39 @@ def encode_cycle(
             if ok:
                 tas_device_flavors.append(fname)
 
+    # Fair-tournament tree eligibility: DRS simulated additions assume full
+    # usage bubbling, so any lending limit in the tree routes its entries
+    # to the host; TAS entries also stay host-side under fair (the
+    # tournament kernel has no topology recheck yet). Parentless CQs are
+    # order-independent and always eligible.
+    fair_tree_ok = None
+    if fair_sharing:
+        from kueue_tpu.ops.quota_ops import MAX_DEPTH
+
+        parent_np = np.asarray(tree.parent)
+        root_np = np.arange(n)
+        for _ in range(MAX_DEPTH):
+            root_np = np.where(
+                parent_np[root_np] >= 0, parent_np[root_np], root_np
+            )
+        lend_any = np.asarray(tree.has_lend_limit).any(axis=(1, 2))
+        tree_lend = np.zeros(n, dtype=bool)
+        np.maximum.at(tree_lend, root_np, lend_any)
+        fair_tree_ok = ~tree_lend[root_np]
+
     # Workload arrays.
     device_wls: List[WorkloadInfo] = []
     for info in heads:
-        if _device_compatible(info, snapshot, single_rg_cq,
-                              set(tas_device_flavors), delay_tas_fn,
-                              preempt):
+        fair_host = False
+        if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
+            ni0 = tidx.node_of[info.cluster_queue]
+            fair_host = not bool(fair_tree_ok[ni0]) or (
+                info.obj.pod_sets[0].topology_request is not None
+            )
+        if not fair_host and _device_compatible(
+                info, snapshot, single_rg_cq,
+                set(tas_device_flavors), delay_tas_fn,
+                preempt):
             device_wls.append(info)
         else:
             idx.host_fallback.append(info)
@@ -343,6 +372,11 @@ def encode_cycle(
                 np.asarray(tree.parent),
             )
             preempt_fields.update(tas_fields)
+    if fair_sharing:
+        node_weight = np.ones(n, dtype=np.float64)
+        for i, nd in enumerate(tidx.nodes):
+            node_weight[i] = nd.fair_weight
+        preempt_fields["node_weight"] = jnp.asarray(node_weight)
 
     # Cohort trees sharing a device TAS flavor are merged into one scan
     # group: their entries consume the same topology state, so the grouped
@@ -556,13 +590,15 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
     )
     from kueue_tpu.models.preempt_kernel import AdmittedArrays
 
+    from kueue_tpu.ops.quota_ops import MAX_DEPTH
+
     n = tree.n_nodes
     parent = np.asarray(tree.parent)
     is_cq_node = np.zeros(n, dtype=bool)
     for name in snapshot.cluster_queues:
         is_cq_node[tidx.node_of[name]] = True
     root_of = np.arange(n)
-    for _ in range(8):
+    for _ in range(MAX_DEPTH):
         root_of = np.where(parent[root_of] >= 0, parent[root_of], root_of)
 
     has_lend = np.asarray(tree.has_lend_limit).any(axis=(1, 2))  # [N]

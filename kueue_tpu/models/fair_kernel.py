@@ -1,0 +1,355 @@
+"""Device-side fair-sharing admission: the DRS tournament as a scan.
+
+Tensor reformulation of the reference's fair-sharing iterator
+(pkg/scheduler/fair_sharing_iterator.go + pkg/cache/scheduler/fair_sharing.go
+dominantResourceShare/CompareDRS): each scheduling step recomputes every
+remaining entry's DominantResourceShare at each ancestor of its ClusterQueue
+(with the entry's nominated usage simulated in), runs the hierarchical
+tournament (champions bubble from the leaves to the root, compared at each
+cohort by the DRS of the child on the entry's path, tie-broken by priority
+then queue timestamp), and processes the per-tree winner with the usual
+fit-or-skip admission body.
+
+Exactness preconditions (the encoder gates entries accordingly —
+models/encode.py):
+  * no lending limits anywhere in the entry's cohort tree, so simulated
+    usage additions bubble fully to every ancestor and availability is the
+    chain min of ``T_b - usage_b`` (same closed form as the fixed-point
+    kernel);
+  * at most one tournament entry per CQ — the host iterator keys entries
+    by CQ and keeps only the LAST nominated one (fair_sharing_iterator
+    semantics); earlier same-CQ entries are reported OUT_SHADOWED and
+    requeued unprocessed, exactly like the host's untouched entries;
+  * preemption-mode and TAS entries stay on the host path; the driver
+    discards device outcomes for any tree containing one (or any encode
+    host-fallback entry) and routes that whole tree through the host so
+    tournament interleaving stays exact per tree.
+
+The tournament is independent per cohort tree, so every step processes one
+winner per tree simultaneously on the flat usage state — no grouped layout
+needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.batch_scheduler import (
+    CycleOutputs,
+    NominateResult,
+    OUT_ADMITTED,
+    OUT_FIT_SKIPPED,
+    OUT_NEEDS_HOST,
+    OUT_NO_CANDIDATES,
+    OUT_NOFIT,
+    OUT_SHADOWED,
+    P_FIT,
+    P_NO_CANDIDATES,
+    admission_order,
+    nominate,
+)
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.quota_ops import MAX_DEPTH, sat_add, sat_sub
+
+_INF64 = jnp.int64(1) << 61
+_F64_INF = jnp.float64(jnp.inf)
+
+
+def fair_admit_scan(
+    arrays: CycleArrays,
+    nom: NominateResult,
+    usage: jnp.ndarray,
+    s_max: int,
+):
+    """Tournament-ordered admission. Returns (final_usage, admitted[W],
+    shadowed[W], participated[W])."""
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    n = tree.n_nodes
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    f_onehot = jnp.arange(f_n)
+    w_iota = jnp.arange(w_n, dtype=jnp.int32)
+
+    parent = jnp.where(tree.parent < 0, jnp.arange(n), tree.parent)
+    # Entry ancestor chains in flat node ids, root-padded.
+    chain_cols = [arrays.w_cq.astype(jnp.int32)]
+    for _ in range(MAX_DEPTH):
+        chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
+    chains = jnp.stack(chain_cols, axis=1)  # [W, D+1]
+    chain_is_root = tree.parent[chains] < 0  # [W, D+1]
+    # Repeat positions past the root must not double-count usage updates.
+    chain_repeat = jnp.concatenate(
+        [jnp.zeros((w_n, 1), bool), chains[:, 1:] == chains[:, :-1]], axis=1
+    )
+
+    root_of = jnp.arange(n)
+    for _ in range(MAX_DEPTH):
+        root_of = parent[root_of]
+    w_root = root_of[arrays.w_cq]  # [W]
+
+    # Static DRS ingredients.
+    sq = tree.subtree_quota
+    pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
+    lendable = jnp.sum(pot_all, axis=1).astype(jnp.float64)  # [N,R]
+    weight = arrays.node_weight  # f64[N]
+    # T_b - usage_b chain availability (no lending limits precondition).
+    t_node = jnp.where(
+        (tree.parent < 0)[:, None, None],
+        sq,
+        jnp.where(
+            tree.has_borrow_limit, sat_add(sq, tree.borrow_limit), _INF64
+        ),
+    )
+
+    # Tournament membership: the LAST active entry of each CQ (host dict
+    # semantics); earlier ones are shadowed.
+    last_of_cq = (
+        jnp.full(n, -1, jnp.int32)
+        .at[arrays.w_cq]
+        .max(jnp.where(arrays.w_active, w_iota, -1), mode="drop")
+    )
+    shadowed = arrays.w_active & (last_of_cq[arrays.w_cq] != w_iota)
+    part = arrays.w_active & ~shadowed
+
+    fe = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
+    cell_mask = (
+        (f_onehot[None, :, None] == nom.chosen_flavor[:, None, None])
+        & (arrays.w_req[:, None, :] > 0)
+        & arrays.covered[arrays.w_cq][:, None, :]
+    )  # [W,F,R]
+    delta = jnp.where(cell_mask, arrays.w_req[:, None, :], 0).astype(
+        jnp.int64
+    )
+    # The nominated usage simulated into the DRS (assignment.usage): the
+    # request vector on the chosen flavor. Entries with no chosen flavor
+    # (NoFit everywhere) simulate nothing, like the host's empty usage.
+    sim_req = jnp.where(
+        (nom.chosen_flavor >= 0)[:, None] & (arrays.w_req > 0),
+        arrays.w_req,
+        0,
+    )  # [W,R]
+
+    depth_w = tree.depth[arrays.w_cq]  # [W]
+    prio = arrays.w_priority
+    ts = arrays.w_timestamp
+
+    def keys_for(usage_now):
+        """Per-entry DRS key at each chain position [W, D+1]:
+        (zwb bool, value f64). Root positions are never compared."""
+        u_chain = usage_now[chains]  # [W,D+1,F,R]
+        sq_chain = sq[chains]
+        over_base = jnp.maximum(0, u_chain - sq_chain)
+        borrowed_base = jnp.sum(over_base, axis=2)  # [W,D+1,R]
+        # Adjust the chosen-flavor plane for the simulated addition.
+        idx_fe = fe[:, None, None, None]
+        u_fe = jnp.take_along_axis(u_chain, idx_fe, axis=2)[:, :, 0, :]
+        sq_fe = jnp.take_along_axis(sq_chain, idx_fe, axis=2)[:, :, 0, :]
+        over_fe_now = jnp.maximum(0, u_fe - sq_fe)
+        over_fe_sim = jnp.maximum(0, u_fe + sim_req[:, None, :] - sq_fe)
+        borrowed = borrowed_base + over_fe_sim - over_fe_now  # [W,D+1,R]
+
+        lend_par = lendable[parent[chains]]  # [W,D+1,R]
+        ratio_r = jnp.where(
+            (lend_par > 0) & (borrowed > 0),
+            borrowed.astype(jnp.float64) * 1000.0 / lend_par,
+            0.0,
+        )
+        ratio = jnp.max(ratio_r, axis=-1)  # [W,D+1]
+        wgt = weight[chains]
+        zwb = (wgt == 0.0) & (ratio > 0.0)
+        val = jnp.where(
+            zwb,
+            ratio,
+            jnp.where(ratio == 0.0, 0.0, ratio / jnp.where(wgt == 0.0, 1.0,
+                                                           wgt)),
+        )
+        # weight==0 && ratio>0 handled by zwb; weight==0 && ratio==0 -> 0.
+        return zwb, val
+
+    def tournament(zwb_k, val_k, remaining):
+        """champ[node] = winning entry of the node's subtree (-1 none)."""
+        live = part & remaining
+        champ = (
+            jnp.full(n, -1, jnp.int32)
+            .at[arrays.w_cq]
+            .max(jnp.where(live, w_iota, -1), mode="drop")
+        )
+        # ≤1 live entry per CQ, so scatter-max IS selection, not a race.
+        for d in range(MAX_DEPTH, 0, -1):
+            has = champ >= 0
+            lvl = (tree.depth == d) & has & tree.active
+            e = jnp.clip(champ, 0, w_n - 1)
+            j = jnp.clip(depth_w[e] - d, 0, MAX_DEPTH)
+            kz = zwb_k[e, j]
+            kv = val_k[e, j]
+            kp = prio[e]
+            kt = ts[e]
+            p = parent  # [N]
+
+            def scat_min(vals, init, mask):
+                return (
+                    jnp.full(n, init, vals.dtype)
+                    .at[p]
+                    .min(jnp.where(mask, vals, init), mode="drop")
+                )
+
+            def scat_max(vals, init, mask):
+                return (
+                    jnp.full(n, init, vals.dtype)
+                    .at[p]
+                    .max(jnp.where(mask, vals, init), mode="drop")
+                )
+
+            bz = scat_min(kz.astype(jnp.int32), jnp.int32(2), lvl)
+            m = lvl & (kz.astype(jnp.int32) == bz[p])
+            bv = scat_min(kv, _F64_INF, m)
+            m = m & (kv == bv[p])
+            bp = scat_max(kp, -_INF64, m)
+            m = m & (kp == bp[p])
+            bt = scat_min(kt, _F64_INF, m)
+            m = m & (kt == bt[p])
+            be = scat_min(
+                jnp.where(m, champ[jnp.arange(n)], jnp.int32(w_n)),
+                jnp.int32(w_n), m,
+            )
+            new_champ = jnp.where(be < w_n, be, -1)
+            # Write winners into parents one level up; nodes at other
+            # depths keep their champions.
+            parent_at_lvl = (
+                jnp.zeros(n, bool).at[p].max(lvl, mode="drop")
+            )
+            champ = jnp.where(
+                parent_at_lvl & (tree.depth == d - 1), new_champ, champ
+            )
+        return champ
+
+    def body(carry, _):
+        usage_now, remaining, admitted = carry
+        zwb_k, val_k = keys_for(usage_now)
+        champ = tournament(zwb_k, val_k, remaining)
+        win = (
+            part
+            & remaining
+            & (champ[w_root] == w_iota)
+        )
+
+        pm = nom.best_pmode
+        # Chain availability for winners (full [F,R] planes; the cell mask
+        # restricts to the entry's cells).
+        u_chain = usage_now[chains]  # [W,D+1,F,R]
+        slack = jnp.where(
+            t_node[chains] >= _INF64, _INF64,
+            sat_sub(t_node[chains], u_chain),
+        )
+        slack = jnp.where(
+            chain_repeat[:, :, None, None], _INF64, slack
+        )
+        avail = jnp.min(slack, axis=1)  # [W,F,R]
+        fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))
+
+        deferred = nom.needs_host
+        admit = win & (pm == P_FIT) & fits & ~deferred
+
+        # NO_CANDIDATES capacity reserve (scheduler.go:513) at the CQ.
+        u_cq = usage_now[arrays.w_cq]  # [W,F,R]
+        nominal_c = tree.nominal[arrays.w_cq]
+        has_bl_c = tree.has_borrow_limit[arrays.w_cq]
+        bl_c = tree.borrow_limit[arrays.w_cq]
+        borrowing = nom.best_borrow > 0
+        reserve_borrowing = jnp.where(
+            has_bl_c,
+            jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl_c), u_cq)),
+            delta,
+        )
+        reserve_plain = jnp.maximum(
+            0, jnp.minimum(delta, sat_sub(nominal_c, u_cq))
+        )
+        reserve = jnp.where(
+            borrowing[:, None, None], reserve_borrowing, reserve_plain
+        )
+        reserve = jnp.where(cell_mask, reserve, 0)
+        do_reserve = (
+            win
+            & (pm == P_NO_CANDIDATES)
+            & ~arrays.can_always_reclaim[arrays.w_cq]
+            & ~deferred
+        )
+
+        applied = jnp.where(
+            admit[:, None, None], delta,
+            jnp.where(do_reserve[:, None, None], reserve, 0),
+        )
+        # Full-bubble scatter along each winner's chain (repeats masked).
+        contrib = jnp.where(
+            (win[:, None] & ~chain_repeat)[:, :, None, None],
+            applied[:, None, :, :],
+            0,
+        )  # [W,D+1,F,R]
+        new_usage = quota_ops.sat(
+            usage_now.at[chains.ravel()].add(
+                contrib.reshape(-1, f_n, r_n), mode="drop"
+            )
+        )
+        return (new_usage, remaining & ~win, admitted | admit), None
+
+    init = (usage, jnp.ones(w_n, bool), jnp.zeros(w_n, bool))
+    (final_usage, remaining, admitted), _ = jax.lax.scan(
+        body, init, None, length=s_max
+    )
+    participated = part & ~remaining
+    return final_usage, admitted, shadowed, participated
+
+
+def make_fair_cycle(s_max: int = 0):
+    """Jittable fair-sharing cycle: nominate -> DRS tournament scan."""
+
+    def impl(arrays: CycleArrays) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage)
+        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+        final_usage, admitted, shadowed, _done = fair_admit_scan(
+            arrays, nom, usage, s
+        )
+        outcome = jnp.where(
+            ~arrays.w_active,
+            OUT_NOFIT,
+            jnp.where(
+                nom.needs_host,
+                OUT_NEEDS_HOST,
+                jnp.where(
+                    shadowed,
+                    OUT_SHADOWED,
+                    jnp.where(
+                        admitted,
+                        OUT_ADMITTED,
+                        jnp.where(
+                            nom.best_pmode == P_FIT,
+                            OUT_FIT_SKIPPED,
+                            jnp.where(
+                                nom.best_pmode == P_NO_CANDIDATES,
+                                OUT_NO_CANDIDATES,
+                                OUT_NOFIT,
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        # Diagnostics order: the classical sort (the true order is the
+        # dynamic tournament; decode never needs it under fair).
+        order = admission_order(arrays, nom)
+        return CycleOutputs(
+            outcome=outcome,
+            chosen_flavor=nom.chosen_flavor,
+            borrow=nom.best_borrow,
+            tried_flavor_idx=nom.tried_flavor_idx,
+            usage=final_usage,
+            order=order,
+        )
+
+    return impl
+
+
+cycle_fair = jax.jit(make_fair_cycle())

@@ -115,6 +115,19 @@ def validate_workload(wl: Workload) -> None:
     """reference workload_webhook.go:119 ValidateWorkload (create path)."""
     if not wl.pod_sets:
         raise ValueError("workload needs at least one podset")
+    # KEP-7990: the priority-boost annotation must be a valid signed
+    # integer when set (reference workload_webhook.go:153).
+    from kueue_tpu.core.workload_info import PRIORITY_BOOST_ANNOTATION
+
+    boost = wl.annotations.get(PRIORITY_BOOST_ANNOTATION)
+    if boost is not None:
+        try:
+            int(boost)
+        except ValueError:
+            raise ValueError(
+                f"metadata.annotations[{PRIORITY_BOOST_ANNOTATION}] must "
+                f"be a valid signed integer, got {boost!r}"
+            )
     if len(wl.pod_sets) > 18:
         raise ValueError("workload supports at most 18 podsets")
     names = set()

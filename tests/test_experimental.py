@@ -1,0 +1,212 @@
+"""Experimental controllers: LocalQueue populator + priority booster
+(reference cmd/experimental/{kueue-populator,kueue-priority-booster})."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LabelSelector,
+    Namespace,
+    PodSet,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.config.configuration import Configuration, build_manager
+from kueue_tpu.experimental import (
+    PopulatorController,
+    PriorityBoostController,
+)
+from kueue_tpu.utils import features
+
+
+def _cq(name, selector=None):
+    return ClusterQueue(
+        name=name,
+        namespace_selector=selector,
+        resource_groups=[
+            ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[
+                    FlavorQuotas(
+                        name="default",
+                        resources={"cpu": ResourceQuota(nominal=10_000)},
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def _manager(**kw):
+    mgr = build_manager(Configuration(), **kw)
+    from kueue_tpu.api.types import ResourceFlavor
+
+    mgr.apply(ResourceFlavor(name="default"))
+    return mgr
+
+
+def test_populator_creates_localqueues_per_matching_cq():
+    mgr = _manager()
+    mgr.apply(
+        Namespace(name="team-a", labels={"team": "a"}),
+        Namespace(name="infra", labels={"kind": "infra"}),
+        _cq("shared"),
+        _cq("a-only", selector={"team": "a"}),
+    )
+    pop = PopulatorController()
+    events = pop.reconcile(mgr)
+    created = {(e.namespace, e.local_queue) for e in events
+               if e.kind == "Created"}
+    # shared matches both namespaces; a-only matches team-a only.
+    assert created == {
+        ("team-a", "shared"),
+        ("infra", "shared"),
+        ("team-a", "a-only"),
+    }
+    assert mgr.cache.local_queues["team-a/a-only"].cluster_queue == "a-only"
+    # Second pass is idempotent.
+    events = pop.reconcile(mgr)
+    assert all(e.kind == "Exists" for e in events)
+
+
+def test_populator_namespace_selector_and_collision():
+    mgr = _manager()
+    mgr.apply(
+        Namespace(name="ns1", labels={"env": "prod"}),
+        Namespace(name="ns2", labels={"env": "dev"}),
+        _cq("cq1"),
+    )
+    pop = PopulatorController(
+        namespace_selector=LabelSelector(match_labels={"env": "prod"})
+    )
+    events = pop.reconcile(mgr)
+    assert {(e.namespace, e.kind) for e in events} == {("ns1", "Created")}
+    # A pre-existing LocalQueue with the same name but different CQ is
+    # reported Skipped, never overwritten.
+    from kueue_tpu.api.types import LocalQueue
+
+    mgr.apply(_cq("cq2"), LocalQueue(
+        name="cq2", namespace="ns1", cluster_queue="cq1"
+    ))
+    events = pop.reconcile(mgr)
+    skipped = [e for e in events if e.kind == "Skipped"]
+    assert [(e.namespace, e.local_queue, e.cluster_queue)
+            for e in skipped] == [("ns1", "cq2", "cq2")]
+    assert mgr.cache.local_queues["ns1/cq2"].cluster_queue == "cq1"
+
+
+def _submit(mgr, name, prio=0, t=1.0):
+    wl = Workload(
+        name=name,
+        queue_name="lq",
+        pod_sets=[PodSet(name="m", count=1, requests={"cpu": 1000})],
+        priority=prio,
+        creation_time=t,
+    )
+    mgr.create_workload(wl)
+    return wl
+
+
+def _boost_env(clock=None):
+    mgr = _manager(**({"clock": clock} if clock else {}))
+    from kueue_tpu.api.types import LocalQueue
+
+    mgr.apply(_cq("cq"), LocalQueue(name="lq", cluster_queue="cq"))
+    return mgr
+
+
+def test_booster_boosts_after_time_sharing_interval():
+    features.set_enabled("PriorityBoost", True)
+    try:
+        now = [0.0]
+        mgr = _boost_env(clock=lambda: now[0])
+        booster = PriorityBoostController(
+            time_sharing_interval=60.0, negative_boost_value=1000,
+            clock=lambda: now[0],
+        )
+        wl = _submit(mgr, "w0", prio=100)
+        mgr.schedule()
+        assert booster.reconcile(mgr) == []  # inside the window: no boost
+        now[0] = 61.0
+        assert booster.reconcile(mgr) == [wl.key]
+        assert wl.annotations["kueue.x-k8s.io/priority-boost"] == "-1000"
+        # Effective priority drops below a fresh same-base-prio workload.
+        info = mgr.cache.workloads[wl.key]
+        assert info.priority() == 100 - 1000
+        # Idempotent.
+        assert booster.reconcile(mgr) == []
+    finally:
+        features.set_enabled("PriorityBoost", False)
+
+
+def test_booster_enables_same_priority_time_slicing():
+    """The annotated workload becomes preemptible by an equal-base-priority
+    pending workload under withinClusterQueue: LowerPriority."""
+    features.set_enabled("PriorityBoost", True)
+    try:
+        from kueue_tpu.api.constants import PreemptionPolicy
+        from kueue_tpu.api.types import ClusterQueuePreemption, LocalQueue
+
+        now = [0.0]
+        mgr = _manager(clock=lambda: now[0])
+        cq = _cq("cq")
+        cq.preemption = ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY
+        )
+        mgr.apply(cq, LocalQueue(name="lq", cluster_queue="cq"))
+        booster = PriorityBoostController(
+            time_sharing_interval=60.0, clock=lambda: now[0]
+        )
+        w0 = _submit(mgr, "w0", prio=100, t=1.0)
+        mgr.schedule()
+        # Fill the queue: w1 (same base priority) cannot fit.
+        w1 = Workload(
+            name="w1", queue_name="lq",
+            pod_sets=[PodSet(name="m", count=1, requests={"cpu": 10_000})],
+            priority=100, creation_time=2.0,
+        )
+        mgr.create_workload(w1)
+        r = mgr.schedule()
+        assert not r.admitted and not r.preempting
+        now[0] = 100.0
+        booster.reconcile(mgr)
+        r = mgr.schedule()
+        assert w0.key in [k for k in r.preempted] or \
+            w0.key in [k for k in r.preempting] or r.preempting
+    finally:
+        features.set_enabled("PriorityBoost", False)
+
+
+def test_booster_clears_out_of_scope_managed_annotation():
+    features.set_enabled("PriorityBoost", True)
+    try:
+        now = [100.0]
+        mgr = _boost_env(clock=lambda: now[0])
+        booster = PriorityBoostController(
+            time_sharing_interval=60.0, clock=lambda: now[0],
+            max_workload_priority=50,
+        )
+        wl = _submit(mgr, "w0", prio=100)
+        mgr.schedule()
+        wl.annotations["kueue.x-k8s.io/priority-boost"] = "-500"
+        assert booster.reconcile(mgr) == [wl.key]  # out of scope: cleared
+        assert "kueue.x-k8s.io/priority-boost" not in wl.annotations
+        # Manually-set non-negative values are left untouched.
+        wl.annotations["kueue.x-k8s.io/priority-boost"] = "250"
+        assert booster.reconcile(mgr) == []
+        assert wl.annotations["kueue.x-k8s.io/priority-boost"] == "250"
+    finally:
+        features.set_enabled("PriorityBoost", False)
+
+
+def test_invalid_boost_annotation_rejected_at_create():
+    import pytest
+
+    mgr = _boost_env()
+    wl = Workload(
+        name="bad", queue_name="lq",
+        pod_sets=[PodSet(name="m", count=1, requests={"cpu": 1000})],
+        annotations={"kueue.x-k8s.io/priority-boost": "not-an-int"},
+    )
+    with pytest.raises(ValueError, match="priority-boost"):
+        mgr.create_workload(wl)

@@ -1,0 +1,102 @@
+"""Mega-cycle tuning sweep on the attached accelerator.
+
+Measures the north-star cycle (bench.build_mega: 50k x 2000 x 32) across
+kernel variants/knobs and prints one JSON line per config:
+  * grouped scan with exact s_max (max per-tree entry bucket) vs the
+    conservative 2W/G, unroll 2/4/8;
+  * fixed-point rounds actually taken + wall time.
+
+Usage:  python tools/tune_mega.py [--platform tpu] [--configs a,b,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--configs", default="")
+    ap.add_argument("--w", type=int, default=50_000)
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_mega
+    from kueue_tpu.models import batch_scheduler as bs
+
+    arrays, layout = build_mega(W=args.w)
+    ga = bs.GroupArrays(*layout.as_jax())
+    group_of = np.asarray(layout.flat_to_group)[np.asarray(arrays.w_cq)]
+    s_exact = int(np.bincount(group_of, minlength=layout.n_groups).max())
+    s_cons = 2 * args.w // layout.n_groups
+    log(f"platform={jax.devices()[0].platform} groups={layout.n_groups} "
+        f"s_exact={s_exact} s_conservative={s_cons}")
+
+    configs = []
+    for unroll in (2, 4, 8):
+        configs.append((f"grouped_sx{s_exact}_u{unroll}",
+                        lambda u=unroll: jax.jit(
+                            bs.make_grouped_cycle(s_exact, unroll=u))))
+    configs.append((f"grouped_sx{s_cons}_u2",
+                    lambda: jax.jit(bs.make_grouped_cycle(s_cons))))
+    configs.append(("fixedpoint", lambda: jax.jit(
+        bs.make_fixedpoint_cycle())))
+    if args.configs:
+        want = set(args.configs.split(","))
+        configs = [(n, f) for n, f in configs
+                   if any(w in n for w in want)]
+
+    ref_admitted = None
+    for name, mk in configs:
+        fn = mk()
+        t0 = time.monotonic()
+        out = fn(arrays, ga)
+        out.outcome.block_until_ready()
+        compile_s = time.monotonic() - t0
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            out = fn(arrays, ga)
+            out.outcome.block_until_ready()
+            best = min(best, time.monotonic() - t0)
+        admitted = int((np.asarray(out.outcome) == bs.OUT_ADMITTED).sum())
+        if ref_admitted is None:
+            ref_admitted = admitted
+        rec = {"config": name, "ms": round(best * 1000, 1),
+               "compile_s": round(compile_s, 1), "admitted": admitted,
+               "match": admitted == ref_admitted}
+        print(json.dumps(rec), flush=True)
+
+    # Fixed-point rounds diagnostic.
+    if any("fixedpoint" in n for n, _ in configs):
+        usage = arrays.usage
+        nom = jax.jit(bs.nominate)(arrays, usage)
+        order = jax.jit(bs.admission_order)(arrays, nom)
+
+        @jax.jit
+        def fp(arrays, nom, usage, order):
+            return bs.admit_fixedpoint(arrays, ga, nom, usage, order)
+
+        _u, _a, rounds = fp(arrays, nom, usage, order)
+        print(json.dumps({"config": "fixedpoint_rounds",
+                          "rounds": int(rounds)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
