@@ -507,17 +507,7 @@ def admit_scan_grouped(
         # in_sub[b, d]: node b lies on d's ancestor chain (victim usage at
         # CQ d reduces availability at every such b; full subtraction is
         # exact because preempt-eligible trees have no lending limits).
-        parent_n = jnp.where(
-            tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent
-        )
-        cols = [jnp.arange(tree.n_nodes)]
-        for _ in range(MAX_DEPTH):
-            cols.append(parent_n[cols[-1]])
-        chain_n = jnp.stack(cols, axis=1)  # [N, D+1]
-        in_sub = jnp.zeros((tree.n_nodes, tree.n_nodes), bool).at[
-            chain_n.ravel(),
-            jnp.repeat(jnp.arange(tree.n_nodes), MAX_DEPTH + 1),
-        ].set(True)
+        in_sub = quota_ops.ancestor_matrix(tree)
 
     # Grouped static tensors [G,Nm,F,R] (usage-independent, hoisted).
     def to_g(x, pad):

@@ -78,6 +78,7 @@ class DeviceScheduler:
             fair_sharing=self.fair_sharing, preempt=True,
             delay_tas_fn=lambda cqs, info: self.host._delay_tas(cqs, info)
             or self.host._has_multikueue_check(cqs),
+            fair_strategies=self.host.preemptor.fair_strategies,
         )
 
         host_entries: List[WorkloadInfo] = list(idx.host_fallback)
@@ -90,9 +91,9 @@ class DeviceScheduler:
             # device preemption) is opt-in until TPU measurements establish
             # the crossover; bench.py probes both.
             if self.fair_sharing:
-                from kueue_tpu.models.fair_kernel import cycle_fair
+                from kueue_tpu.models.fair_kernel import cycle_fair_preempt
 
-                out = cycle_fair(arrays)
+                out = cycle_fair_preempt(arrays, idx.admitted_arrays)
             elif self.use_fixedpoint and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
@@ -342,6 +343,7 @@ class DeviceScheduler:
         from kueue_tpu.api.constants import (
             EVICTED_BY_PREEMPTION,
             IN_CLUSTER_QUEUE_REASON,
+            IN_COHORT_FAIR_SHARING_REASON,
             IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
             IN_COHORT_RECLAMATION_REASON,
         )
@@ -351,6 +353,9 @@ class DeviceScheduler:
             2: IN_COHORT_RECLAMATION_REASON,
             3: IN_COHORT_RECLAMATION_REASON,
             4: IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+            # Fair-sharing tournament variants (fair_preempt_kernel).
+            5: IN_COHORT_FAIR_SHARING_REASON,
+            6: IN_COHORT_RECLAMATION_REASON,
         }
         for a in np.flatnonzero(victim_row):
             victim = idx.admitted[a]

@@ -90,6 +90,13 @@ class CycleArrays(NamedTuple):
     w_tas_invalid: Optional[jnp.ndarray] = None  # bool[W] always-infeasible
     # -- fair sharing (None unless the fair tournament kernel is in use) --
     node_weight: Optional[jnp.ndarray] = None  # f64[N] FairSharing weight
+    node_is_cq: Optional[jnp.ndarray] = None  # bool[N]
+    fair_pwn: Optional[jnp.ndarray] = None  # bool[] PreemptWithinNominal gate
+    fair_strat0: Optional[jnp.ndarray] = None  # i32[] 0=S2a-first, 1=S2b
+    fair_has_s2: Optional[jnp.ndarray] = None  # bool[] second strategy on
+    # CQ's tree is lend-limit free with fully mappable admitted usage: the
+    # fair preemption tournament can run on device.
+    fair_preempt_ok: Optional[jnp.ndarray] = None  # bool[N]
 
 
 @dataclass
@@ -123,6 +130,7 @@ def encode_cycle(
     fair_sharing: bool = False,
     preempt: bool = False,
     delay_tas_fn=None,
+    fair_strategies: Optional[Sequence[str]] = None,
 ) -> Tuple[CycleArrays, CycleIndex]:
     """Build CycleArrays from the host snapshot + pending heads.
 
@@ -355,8 +363,9 @@ def encode_cycle(
 
     preempt_fields: Dict[str, object] = {}
     root_merge = None
+    fair_node_ok = None
     if preempt:
-        preempt_simple = _encode_admitted(
+        preempt_simple, fair_node_ok = _encode_admitted(
             snapshot, tidx, tree, idx, fair_sharing
         )
         preempt_fields = dict(
@@ -373,10 +382,27 @@ def encode_cycle(
             )
             preempt_fields.update(tas_fields)
     if fair_sharing:
+        from kueue_tpu.utils import features as _features
+
         node_weight = np.ones(n, dtype=np.float64)
         for i, nd in enumerate(tidx.nodes):
             node_weight[i] = nd.fair_weight
+        strategies = list(
+            fair_strategies
+            or ["LessThanOrEqualToFinalShare", "LessThanInitialShare"]
+        )
         preempt_fields["node_weight"] = jnp.asarray(node_weight)
+        preempt_fields["node_is_cq"] = jnp.asarray(np.asarray(is_cq))
+        preempt_fields["fair_pwn"] = jnp.asarray(
+            _features.enabled("FairSharingPreemptWithinNominal")
+        )
+        preempt_fields["fair_strat0"] = jnp.asarray(
+            np.int32(0 if strategies[0] == "LessThanOrEqualToFinalShare"
+                     else 1)
+        )
+        preempt_fields["fair_has_s2"] = jnp.asarray(len(strategies) > 1)
+        if fair_node_ok is not None:
+            preempt_fields["fair_preempt_ok"] = jnp.asarray(fair_node_ok)
 
     # Cohort trees sharing a device TAS flavor are merged into one scan
     # group: their entries consume the same topology state, so the grouped
@@ -575,15 +601,17 @@ def _encode_tas(
     return fields, root_merge
 
 
-def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
-    """Build the admitted-candidate arrays (preempt_kernel.AdmittedArrays)
-    and the per-CQ ``preempt_simple`` flag.
+def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
+    """Build the admitted-candidate arrays (preempt_kernel.AdmittedArrays),
+    the per-CQ classical ``preempt_simple`` flag and the fair-tournament
+    ``fair_node_ok`` flag.
 
-    A CQ's entries may use device victim selection only when the whole
-    cohort tree is "simple": flat (root's children are all CQs, matching the
-    single-LCA classical search), free of lending limits (usage bubbles
-    fully so removal math is closed-form), fair sharing off, and every
-    admitted workload's usage maps onto the encoded [F, R] cells."""
+    Classical device victim selection needs a "simple" tree: flat (root's
+    children are all CQs, matching the single-LCA classical search), free
+    of lending limits (usage bubbles fully so removal math is closed-form),
+    fair sharing off, and every admitted workload's usage mappable onto the
+    encoded [F, R] cells. The fair tournament kernel handles nested trees,
+    so its flag drops only the flatness requirement."""
     from kueue_tpu.core.workload_info import (
         is_evicted,
         quota_reservation_time,
@@ -602,14 +630,17 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
         root_of = np.where(parent[root_of] >= 0, parent[root_of], root_of)
 
     has_lend = np.asarray(tree.has_lend_limit).any(axis=(1, 2))  # [N]
-    # Per root: flat (no nested cohorts) and lend-limit free.
+    # Per root: flat (no nested cohorts) and lend-limit free; the fair
+    # variant skips the flatness requirement.
     root_ok = np.ones(n, dtype=bool)
+    root_fair_ok = np.ones(n, dtype=bool)
     for node in range(n):
         if not np.asarray(tree.active)[node]:
             continue
         r = root_of[node]
         if has_lend[node]:
             root_ok[r] = False
+            root_fair_ok[r] = False
         if node != r and not is_cq_node[node]:
             root_ok[r] = False  # nested cohort -> not flat
 
@@ -648,14 +679,20 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
                 # Unmappable usage: the victim-removal math would be wrong
                 # for this tree; keep it on the host path.
                 root_ok[root_of[ni]] = False
+                root_fair_ok[root_of[ni]] = False
             else:
                 a_usage[i, fi2, ri2] = v2
 
     preempt_simple = np.zeros(n, dtype=bool)
+    fair_node_ok = np.zeros(n, dtype=bool)
     if not fair_sharing:
         for name in snapshot.cluster_queues:
             ni = tidx.node_of[name]
             preempt_simple[ni] = root_ok[root_of[ni]]
+    else:
+        for name in snapshot.cluster_queues:
+            ni = tidx.node_of[name]
+            fair_node_ok[ni] = root_fair_ok[root_of[ni]]
 
     idx.admitted_arrays = AdmittedArrays(
         cq=jnp.asarray(a_cq),
@@ -667,7 +704,7 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing) -> np.ndarray:
         active=jnp.asarray(a_active),
         uid_rank=jnp.asarray(a_uid),
     )
-    return preempt_simple
+    return preempt_simple, fair_node_ok
 
 
 def _device_compatible(

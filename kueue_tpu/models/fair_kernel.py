@@ -43,9 +43,12 @@ from kueue_tpu.models.batch_scheduler import (
     OUT_NEEDS_HOST,
     OUT_NO_CANDIDATES,
     OUT_NOFIT,
+    OUT_PREEMPTING,
     OUT_SHADOWED,
     P_FIT,
     P_NO_CANDIDATES,
+    P_PREEMPT_OK,
+    P_PREEMPT_RAW,
     admission_order,
     nominate,
 )
@@ -62,9 +65,14 @@ def fair_admit_scan(
     nom: NominateResult,
     usage: jnp.ndarray,
     s_max: int,
+    adm=None,
+    targets=None,
 ):
-    """Tournament-ordered admission. Returns (final_usage, admitted[W],
-    shadowed[W], participated[W])."""
+    """Tournament-ordered admission. With ``adm``/``targets`` (device fair
+    preemption) winners resolved to P_PREEMPT_OK designate their victims
+    with the host's overlap/fit semantics and consume usage like admitted
+    entries. Returns (final_usage, admitted[W], preempting[W], shadowed[W],
+    participated[W])."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     n = tree.n_nodes
@@ -88,6 +96,12 @@ def fair_admit_scan(
     for _ in range(MAX_DEPTH):
         root_of = parent[root_of]
     w_root = root_of[arrays.w_cq]  # [W]
+
+    with_preempt = targets is not None
+    if with_preempt:
+        # Victim usage at CQ d reduces availability at every ancestor;
+        # full subtraction is exact in lend-limit-free trees.
+        on_chain_adm = quota_ops.ancestor_matrix(tree)[:, adm.cq]  # [N, A]
 
     # Static DRS ingredients.
     sq = tree.subtree_quota
@@ -226,7 +240,7 @@ def fair_admit_scan(
         return champ
 
     def body(carry, _):
-        usage_now, remaining, admitted = carry
+        usage_now, remaining, admitted, preempting_acc, designated = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
         win = (
@@ -237,11 +251,33 @@ def fair_admit_scan(
 
         pm = nom.best_pmode
         # Chain availability for winners (full [F,R] planes; the cell mask
-        # restricts to the entry's cells).
+        # restricts to the entry's cells). The fit check simulates removal
+        # of every designated victim plus the entry's own targets
+        # (scheduler fits() -> SimulateWorkloadRemoval).
         u_chain = usage_now[chains]  # [W,D+1,F,R]
+        if with_preempt:
+            my_vict = targets.victims  # [W,A]
+            is_pre = win & (pm == P_PREEMPT_OK)
+            overlap = is_pre & jnp.any(
+                my_vict & designated[None, :], axis=1
+            )
+            use_vict = designated[None, :] | jnp.where(
+                (is_pre & ~overlap)[:, None], my_vict, False
+            )  # [W,A]
+            chain_sub = on_chain_adm[chains]  # [W,D+1,A]
+            rem = jnp.einsum(
+                "wda,afr->wdfr",
+                (use_vict[:, None, :] & chain_sub).astype(jnp.int64),
+                adm.usage,
+            )
+            u_fit = u_chain - rem
+        else:
+            is_pre = jnp.zeros(w_n, bool)
+            overlap = jnp.zeros(w_n, bool)
+            u_fit = u_chain
         slack = jnp.where(
             t_node[chains] >= _INF64, _INF64,
-            sat_sub(t_node[chains], u_chain),
+            sat_sub(t_node[chains], u_fit),
         )
         slack = jnp.where(
             chain_repeat[:, :, None, None], _INF64, slack
@@ -251,6 +287,7 @@ def fair_admit_scan(
 
         deferred = nom.needs_host
         admit = win & (pm == P_FIT) & fits & ~deferred
+        preempt_ok = is_pre & ~overlap & fits & ~deferred
 
         # NO_CANDIDATES capacity reserve (scheduler.go:513) at the CQ.
         u_cq = usage_now[arrays.w_cq]  # [W,F,R]
@@ -277,8 +314,11 @@ def fair_admit_scan(
             & ~deferred
         )
 
+        # Both admitted FIT entries and proceeding preemptors consume
+        # their usage (scheduler.go:561 cq.AddUsage runs for either mode).
+        take_usage = admit | preempt_ok
         applied = jnp.where(
-            admit[:, None, None], delta,
+            take_usage[:, None, None], delta,
             jnp.where(do_reserve[:, None, None], reserve, 0),
         )
         # Full-bubble scatter along each winner's chain (repeats masked).
@@ -292,26 +332,35 @@ def fair_admit_scan(
                 contrib.reshape(-1, f_n, r_n), mode="drop"
             )
         )
-        return (new_usage, remaining & ~win, admitted | admit), None
+        if with_preempt:
+            designated = designated | jnp.any(
+                jnp.where(preempt_ok[:, None], targets.victims, False),
+                axis=0,
+            )
+        return (new_usage, remaining & ~win, admitted | admit,
+                preempting_acc | preempt_ok, designated), None
 
-    init = (usage, jnp.ones(w_n, bool), jnp.zeros(w_n, bool))
-    (final_usage, remaining, admitted), _ = jax.lax.scan(
-        body, init, None, length=s_max
+    designated0 = (
+        jnp.zeros(adm.cq.shape[0], bool) if with_preempt
+        else jnp.zeros(1, bool)
     )
+    init = (usage, jnp.ones(w_n, bool), jnp.zeros(w_n, bool),
+            jnp.zeros(w_n, bool), designated0)
+    (final_usage, remaining, admitted, preempting, _desig), _ = \
+        jax.lax.scan(body, init, None, length=s_max)
     participated = part & ~remaining
-    return final_usage, admitted, shadowed, participated
+    return final_usage, admitted, preempting, shadowed, participated
 
 
-def make_fair_cycle(s_max: int = 0):
-    """Jittable fair-sharing cycle: nominate -> DRS tournament scan."""
+def make_fair_cycle(s_max: int = 0, preempt: bool = False):
+    """Jittable fair-sharing cycle: nominate -> DRS tournament scan.
 
-    def impl(arrays: CycleArrays) -> CycleOutputs:
-        usage = arrays.usage
-        nom = nominate(arrays, usage)
-        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        final_usage, admitted, shadowed, _done = fair_admit_scan(
-            arrays, nom, usage, s
-        )
+    With ``preempt=True`` the cycle takes the AdmittedArrays and resolves
+    the fair preemption tournament on device for eligible entries
+    (models/fair_preempt_kernel.py) before the admission scan."""
+
+    def finish(arrays, nom, final_usage, admitted, preempting, shadowed,
+               victims=None, variant=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -325,31 +374,85 @@ def make_fair_cycle(s_max: int = 0):
                         admitted,
                         OUT_ADMITTED,
                         jnp.where(
-                            nom.best_pmode == P_FIT,
-                            OUT_FIT_SKIPPED,
+                            preempting,
+                            OUT_PREEMPTING,
                             jnp.where(
-                                nom.best_pmode == P_NO_CANDIDATES,
-                                OUT_NO_CANDIDATES,
-                                OUT_NOFIT,
+                                (nom.best_pmode == P_FIT)
+                                | (nom.best_pmode == P_PREEMPT_OK),
+                                OUT_FIT_SKIPPED,
+                                jnp.where(
+                                    nom.best_pmode == P_NO_CANDIDATES,
+                                    OUT_NO_CANDIDATES,
+                                    OUT_NOFIT,
+                                ),
                             ),
                         ),
                     ),
                 ),
             ),
         ).astype(jnp.int32)
-        # Diagnostics order: the classical sort (the true order is the
-        # dynamic tournament; decode never needs it under fair).
-        order = admission_order(arrays, nom)
         return CycleOutputs(
             outcome=outcome,
             chosen_flavor=nom.chosen_flavor,
             borrow=nom.best_borrow,
             tried_flavor_idx=nom.tried_flavor_idx,
             usage=final_usage,
-            order=order,
+            # Diagnostics order: the classical sort (the true order is the
+            # dynamic tournament; decode never needs it under fair).
+            order=admission_order(arrays, nom),
+            victims=victims,
+            victim_variant=variant,
         )
 
-    return impl
+    if not preempt:
+        def impl(arrays: CycleArrays) -> CycleOutputs:
+            usage = arrays.usage
+            nom = nominate(arrays, usage)
+            s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+            final_usage, admitted, preempting, shadowed, _done = \
+                fair_admit_scan(arrays, nom, usage, s)
+            return finish(arrays, nom, final_usage, admitted, preempting,
+                          shadowed)
+
+        return impl
+
+    from kueue_tpu.models.fair_preempt_kernel import fair_preempt_targets
+
+    def impl_preempt(arrays: CycleArrays, adm) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage)
+        elig = (
+            arrays.w_active
+            & (nom.best_pmode == P_PREEMPT_RAW)
+            & (nom.praw_count == 1)
+            & arrays.fair_preempt_ok[arrays.w_cq]
+            & ~arrays.w_has_gates
+        )
+        if arrays.w_tas is not None:
+            elig = elig & ~arrays.w_tas
+        tgt = fair_preempt_targets(
+            arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
+            nom.considered,
+        )
+        nom = nom._replace(
+            best_pmode=jnp.where(
+                tgt.success, P_PREEMPT_OK,
+                jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
+                          nom.best_pmode),
+            ),
+            best_borrow=jnp.where(
+                tgt.resolved, tgt.borrow_after, nom.best_borrow
+            ),
+            needs_host=nom.needs_host & ~tgt.resolved,
+        )
+        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+        final_usage, admitted, preempting, shadowed, _done = \
+            fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
+        return finish(arrays, nom, final_usage, admitted, preempting,
+                      shadowed, victims=tgt.victims, variant=tgt.variant)
+
+    return impl_preempt
 
 
 cycle_fair = jax.jit(make_fair_cycle())
+cycle_fair_preempt = jax.jit(make_fair_cycle(preempt=True))

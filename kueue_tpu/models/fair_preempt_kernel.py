@@ -1,0 +1,609 @@
+"""Device-side fair-sharing preemption: the DRS victim tournament.
+
+Tensor reformulation of the reference's fair preemption search
+(pkg/scheduler/preemption/preemption.go:362-548 fairPreemptions +
+preemption/fairsharing/{strategy,ordering,target,least_common_ancestor}.go),
+mirrored host-side by kueue_tpu/scheduler/fair_preemption.py.
+
+Per eligible preemptor entry the kernel runs the exact sequential search as
+a bounded ``lax.while_loop`` (the tournament is inherently a data-dependent
+greedy — each removal changes every DominantResourceShare — so the
+sequential structure is kept and the per-step *math* is vectorized):
+
+  * candidates: within-CQ by policy + cross-CQ from borrowing CQs, ordered
+    by CandidatesOrdering (evicted first, other-CQ first, priority,
+    quota-reservation time, UID);
+  * strategy S1: descend from the root to the highest-DRS ClusterQueue with
+    remaining candidates (cohorts pruned when not borrowing and off the
+    preemptor's path), compare DRS at the almost-least-common-ancestors,
+    apply LessThanOrEqualToFinalShare / LessThanInitialShare, remove until
+    the preemptor fits; failures go to the retry list;
+  * strategy S2 (rule S2-b) over the retries, one candidate per CQ;
+  * fill-back minimization replaying the host's list semantics.
+
+Like the classical kernel (models/preempt_kernel.py), a probe axis runs the
+single-FlavorResource oracle searches the flavor assigner consults
+(preemption_oracle.go SimulatePreemption) alongside the full multi-resource
+search, so cell preemption modes and post-removal borrow heights are exact.
+
+Exactness preconditions (encoder-gated): no lending limits in the tree
+(usage bubbles fully; availability is the chain min), admitted usage fully
+mappable onto the [F, R] cells, single-praw-flavor entries with
+oracle-independent flavor choice, no TAS, no preemption gates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.models.preempt_kernel import AdmittedArrays, PreemptTargets
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.quota_ops import MAX_DEPTH, sat_add, sat_sub
+
+_INF64 = jnp.int64(1) << 61
+_NEG = -(jnp.int64(1) << 60)
+_FINF = jnp.float64(jnp.inf)
+
+# Variant codes surfaced to the driver (reason mapping):
+FV_WITHIN_CQ = 1  # InClusterQueueReason
+FV_FAIR_SHARING = 5  # InCohortFairSharingReason
+FV_RECLAMATION = 6  # InCohortReclamationReason (preemptor within nominal)
+
+STRAT_S2A = 0  # LessThanOrEqualToFinalShare
+STRAT_S2B = 1  # LessThanInitialShare
+
+
+def _drs_key_at(usage_node, sq_node, lend_par, wgt):
+    """DRS comparison key of one node: (borrowing, zwb, val).
+    usage_node/sq_node: [F,R]; lend_par: f64[R]; wgt: f64 scalar."""
+    borrowed = jnp.sum(
+        jnp.maximum(0, usage_node - sq_node), axis=0
+    ).astype(jnp.float64)  # [R]
+    ratio = jnp.max(
+        jnp.where((lend_par > 0) & (borrowed > 0),
+                  borrowed * 1000.0 / lend_par, 0.0)
+    )
+    borrowing = jnp.any(borrowed > 0)
+    zwb = (wgt == 0.0) & (ratio > 0.0)
+    val = jnp.where(
+        zwb, ratio,
+        jnp.where(ratio == 0.0, 0.0,
+                  ratio / jnp.where(wgt == 0.0, 1.0, wgt)),
+    )
+    return borrowing, zwb, val
+
+
+def _key_gt(z1, v1, z2, v2):
+    """compare_drs(a, b) > 0 (a preferred for preemption)."""
+    return jnp.where(
+        z1 & z2, v1 > v2, jnp.where(z1, True, jnp.where(z2, False, v1 > v2))
+    )
+
+
+def _key_ge(z1, v1, z2, v2):
+    return jnp.where(
+        z1 & z2, v1 >= v2,
+        jnp.where(z1, True, jnp.where(z2, False, v1 >= v2)),
+    )
+
+
+def _key_le(z1, v1, z2, v2):
+    return ~_key_gt(z1, v1, z2, v2)
+
+
+def _key_lt(z1, v1, z2, v2):
+    return ~_key_ge(z1, v1, z2, v2)
+
+
+def fair_preempt_targets(
+    arrays: CycleArrays,
+    adm: AdmittedArrays,
+    chosen_flavor: jnp.ndarray,  # i32[W]
+    eligible: jnp.ndarray,  # bool[W]
+    praw_stop: jnp.ndarray,  # bool[W]
+    considered: jnp.ndarray,  # i32[W]
+) -> PreemptTargets:
+    tree = arrays.tree
+    usage0 = arrays.usage
+    sq = tree.subtree_quota
+    n = tree.n_nodes
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    a_n = adm.cq.shape[0]
+    a_iota = jnp.arange(a_n)
+    n_iota = jnp.arange(n)
+
+    parent = jnp.where(tree.parent < 0, n_iota, tree.parent)
+    chain_cols = [n_iota.astype(jnp.int32)]
+    for _ in range(MAX_DEPTH):
+        chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
+    chain_n = jnp.stack(chain_cols, axis=1)  # [N, D+1]
+    root_of = chain_n[:, MAX_DEPTH]
+    has_par_n = tree.parent >= 0
+
+    # in_sub[b, d]: b on d's root path (usage at b includes d's subtree).
+    in_sub = quota_ops.ancestor_matrix(tree)
+
+    pot_all = quota_ops.potential_available_all(tree)
+    lendable = jnp.sum(pot_all, axis=1).astype(jnp.float64)  # [N,R]
+    weight = arrays.node_weight
+    is_cq = arrays.node_is_cq
+    avail0 = quota_ops.available_all(tree, usage0)
+    # T_b for chain-min availability (no lending limits precondition).
+    t_node = jnp.where(
+        (tree.parent < 0)[:, None, None],
+        sq,
+        jnp.where(
+            tree.has_borrow_limit, sat_add(sq, tree.borrow_limit), _INF64
+        ),
+    )
+    pwn_gate = arrays.fair_pwn  # FairSharingPreemptWithinNominal enabled
+    strat0 = arrays.fair_strat0
+    has_s2 = arrays.fair_has_s2
+
+    adm_usage_full = adm.usage  # [A,F,R]
+
+    def per_w(c, f0, req_full, prio, ts, elig_w, stopped_at_praw, consid):
+        f = jnp.maximum(f0, 0)
+        full_active = (req_full > 0) & arrays.covered[c]  # [R]
+        contested_full = full_active & (req_full > avail0[c, f])
+        au = adm_usage_full[:, f, :]  # [A,R]
+
+        same = adm.cq == c
+        same_root = root_of[adm.cq] == root_of[c]
+        cross = same_root & ~same & has_par_n[c]
+        lower = prio > adm.prio
+        neq = (prio == adm.prio) & (ts < adm.ts)
+
+        def pol_ok(pol):
+            return jnp.where(
+                pol == 3, jnp.ones_like(lower),
+                jnp.where(pol == 2, lower | neq,
+                          jnp.where(pol == 1, lower,
+                                    jnp.zeros_like(lower))),
+            )
+
+        pol_w = arrays.policy_within[c]
+        pol_r = arrays.policy_reclaim[c]
+
+        on_path_c = in_sub[:, c]  # [N] ancestors-or-self of c
+        chain_c = chain_n[c]  # [D+1]
+        chain_c_repeat = jnp.concatenate(
+            [jnp.zeros(1, bool), chain_c[1:] == chain_c[:-1]]
+        )
+
+        # Almost-LCA nodes per candidate CQ (static): first chain position
+        # of d that lies on c's path is the LCA; one below on each side.
+        def alcas(d):
+            chain_d = chain_n[d]
+            on = on_path_c[chain_d]  # [D+1]
+            j_lca = jnp.argmax(on)  # first True
+            tgt = chain_d[jnp.maximum(j_lca - 1, 0)]
+            lca = chain_d[j_lca]
+            pre_pos = jnp.argmax(chain_c == lca)
+            pre = chain_c[jnp.maximum(pre_pos - 1, 0)]
+            return pre.astype(jnp.int32), tgt.astype(jnp.int32)
+
+        pre_alca_of, tgt_alca_of = jax.vmap(alcas)(n_iota)  # [N], [N]
+
+        def search(active_req, contested, req_vec):
+            """One fair search. Returns (success, victims[A], variant[A],
+            borrow_after i32)."""
+            uses = jnp.any(contested[None, :] & (au > 0), axis=1)
+            cq_borrow = jnp.any(
+                contested[None, :]
+                & (usage0[adm.cq, f, :] > sq[adm.cq, f, :]),
+                axis=1,
+            )
+            cand = adm.active & uses & (
+                (same & (pol_w != 0) & pol_ok(pol_w))
+                | (cross & (pol_r != 0) & pol_ok(pol_r) & cq_borrow)
+            )
+
+            # CandidatesOrdering rank (static per search).
+            rank_pos = jnp.lexsort((
+                adm.uid_rank, -adm.qr_time, adm.prio,
+                same.astype(jnp.int32), (~adm.evicted).astype(jnp.int32),
+                (~cand).astype(jnp.int32),
+            ))
+            rank = jnp.zeros(a_n, jnp.int32).at[rank_pos].set(
+                a_iota.astype(jnp.int32)
+            )
+            rank = jnp.where(cand, rank, jnp.int32(a_n))
+
+            # Simulated preemptor usage on c's path (full bubble).
+            add_cell = jnp.zeros((f_n, r_n), jnp.int64).at[f].set(
+                jnp.where(active_req, req_vec, 0)
+            )
+            sim_add = jnp.where(
+                on_path_c[:, None, None], add_cell[None, :, :], 0
+            )
+
+            pwn = pwn_gate & ~jnp.any(
+                contested & (usage0[c, f] + add_cell[f] > sq[c, f])
+            )
+
+            def usage_now_fn(removed):
+                rem = jnp.einsum(
+                    "na,afr->nfr",
+                    (removed[None, :] & in_sub[:, adm.cq]).astype(
+                        jnp.int64
+                    ),
+                    adm_usage_full,
+                )
+                return usage0 + sim_add - rem
+
+            def drs_all(usage_now):
+                """Per-node DRS keys [N]: (borrowing, zwb, val)."""
+                borrowed = jnp.sum(
+                    jnp.maximum(0, usage_now - sq), axis=1
+                ).astype(jnp.float64)  # [N,R]
+                lend_par = lendable[parent]  # [N,R]
+                ratio = jnp.max(
+                    jnp.where((lend_par > 0) & (borrowed > 0),
+                              borrowed * 1000.0 / lend_par, 0.0),
+                    axis=1,
+                )
+                borrowing = jnp.any(borrowed > 0, axis=1)
+                # Root nodes have no parent: DRS is the zero default.
+                ratio = jnp.where(has_par_n, ratio, 0.0)
+                borrowing = borrowing & has_par_n
+                zwb = (weight == 0.0) & (ratio > 0.0)
+                val = jnp.where(
+                    zwb, ratio,
+                    jnp.where(ratio == 0.0, 0.0,
+                              ratio / jnp.where(weight == 0.0, 1.0,
+                                                weight)),
+                )
+                return borrowing, zwb, val
+
+            def fits_removed(removed):
+                """workloadFitsForFairSharing: incoming usage removed."""
+                u = usage_now_fn(removed) - sim_add
+                slack = jnp.where(
+                    t_node[chain_c] >= _INF64, _INF64,
+                    sat_sub(t_node[chain_c], u[chain_c]),
+                )  # [D+1,F,R]
+                slack = jnp.where(
+                    chain_c_repeat[:, None, None], _INF64, slack
+                )
+                avail = jnp.min(slack, axis=0)  # [F,R]
+                return jnp.all(
+                    (req_vec <= avail[f]) | ~active_req
+                )
+
+            def drs_at(usage_now, node):
+                return _drs_key_at(
+                    usage_now[node], sq[node], lendable[parent[node]],
+                    weight[node],
+                )
+
+            # ---------------- S1 + S2 while_loop ----------------
+            # phase: 0 = S1 descend/pop, 1 = S2, 2 = done.
+            def cond(st):
+                # Step cap: every step consumes a candidate or transitions
+                # phase; 4A+16 is a safety net far above any real search.
+                return (st["phase"] < 2) & (st["step"] < 4 * a_n + 16)
+
+            def body(st):
+                removed = st["removed"]
+                consumed = st["consumed"]
+                usage_now = usage_now_fn(removed)
+                in_s2 = st["phase"] == 1
+                pool_retry = jnp.where(in_s2, st["retry"],
+                                       jnp.ones(a_n, bool))
+                b_all, z_all, v_all = drs_all(usage_now)
+                pool = cand & ~consumed & pool_retry
+                head_rank = jnp.full(n, jnp.int32(a_n)).at[adm.cq].min(
+                    jnp.where(pool, rank, jnp.int32(a_n)), mode="drop"
+                )
+                alive_cq = is_cq & (head_rank < a_n) & (
+                    b_all | (n_iota == c)
+                ) & ~(in_s2 & st["s2_dropped"])
+                sub_alive = alive_cq
+                for d in range(MAX_DEPTH, 0, -1):
+                    lvl = (tree.depth == d) & tree.active
+                    par_alive = jnp.zeros(n, bool).at[parent].max(
+                        jnp.where(lvl, sub_alive, False), mode="drop"
+                    )
+                    coh = (tree.depth == d - 1) & ~is_cq
+                    sub_alive = jnp.where(
+                        coh, par_alive & (b_all | on_path_c), sub_alive
+                    )
+
+                sticky = st["sticky"]
+                need_descent = sticky < 0
+                # Sticky CQ may have exhausted its candidates.
+                sticky_has = jnp.where(
+                    sticky >= 0,
+                    head_rank[jnp.maximum(sticky, 0)] < a_n,
+                    False,
+                )
+                need_descent = need_descent | ~sticky_has
+
+                def best(mask, tie_last):
+                    any_ = jnp.any(mask)
+                    best_z = jnp.any(mask & z_all)
+                    m1 = mask & (z_all == best_z)
+                    best_v = jnp.max(jnp.where(m1, v_all, -_FINF))
+                    m2 = m1 & (v_all == best_v)
+                    if tie_last:
+                        pick = jnp.max(jnp.where(m2, n_iota, -1))
+                    else:
+                        best_r = jnp.min(
+                            jnp.where(m2, head_rank, jnp.int32(a_n))
+                        )
+                        m3 = m2 & (head_rank == best_r)
+                        pick = jnp.max(jnp.where(m3, n_iota, -1))
+                    return any_, pick.astype(jnp.int32), best_z, best_v
+
+                def do_descend(_):
+                    root = root_of[c]
+
+                    def desc_body(state):
+                        cur, tgt, done = state
+                        children = (parent == cur) & (n_iota != cur) & \
+                            tree.active
+                        cq_any, cq_pick, cq_z, cq_v = best(
+                            children & alive_cq, False
+                        )
+                        co_any, co_pick, co_z, co_v = best(
+                            children & ~is_cq & sub_alive, True
+                        )
+                        go_coh = co_any & (
+                            ~cq_any | _key_ge(co_z, co_v, cq_z, cq_v)
+                        )
+                        new_tgt = jnp.where(
+                            go_coh, -1,
+                            jnp.where(cq_any, cq_pick, -1),
+                        )
+                        return (
+                            jnp.where(go_coh, co_pick, cur),
+                            new_tgt,
+                            ~go_coh,
+                        )
+
+                    cur0 = root.astype(jnp.int32)
+                    tgt0 = jnp.where(
+                        is_cq[root] & alive_cq[root], root, -1
+                    ).astype(jnp.int32)
+                    done0 = is_cq[root] | ~sub_alive[root]
+                    state = (cur0, tgt0, done0)
+                    for _ in range(MAX_DEPTH + 1):
+                        cur, tgt, done = state
+                        nc, nt, nd = desc_body((cur, tgt, done))
+                        state = (
+                            jnp.where(done, cur, nc),
+                            jnp.where(done, tgt, nt),
+                            done | nd,
+                        )
+                    return state[1]
+
+                new_target = jax.lax.cond(
+                    need_descent, do_descend, lambda _: sticky,
+                    operand=None,
+                )
+                no_target = new_target < 0
+
+                # Visit-start DRS keys (stored when (re)entering a CQ).
+                entering = need_descent & ~no_target
+                pre_node = pre_alca_of[jnp.maximum(new_target, 0)]
+                tgt_node = tgt_alca_of[jnp.maximum(new_target, 0)]
+                pz, pv = st["pre_z"], st["pre_v"]
+                toz, tov = st["tgold_z"], st["tgold_v"]
+                _, ez, ev = drs_at(usage_now, pre_node)
+                _, etz, etv = drs_at(usage_now, tgt_node)
+                pz = jnp.where(entering, ez, pz)
+                pv = jnp.where(entering, ev, pv)
+                toz = jnp.where(entering, etz, toz)
+                tov = jnp.where(entering, etv, tov)
+
+                # Pop the lowest-rank candidate of the target CQ.
+                r_t = head_rank[jnp.maximum(new_target, 0)]
+                have = (new_target >= 0) & (r_t < a_n)
+                ac = jnp.argmax(rank == r_t).astype(jnp.int32)
+                ac = jnp.where(have, ac, 0)
+                a_same = same[ac]
+
+                # Strategy evaluation (cross-CQ, not pwn, S1 only).
+                u_tgt_after = usage_now[tgt_node] - adm_usage_full[ac]
+                _, tnz, tnv = _drs_key_at(
+                    u_tgt_after, sq[tgt_node],
+                    lendable[parent[tgt_node]], weight[tgt_node],
+                )
+                s2a_pass = _key_le(pz, pv, tnz, tnv)
+                s2b_pass = _key_lt(pz, pv, toz, tov)
+                strat_pass = jnp.where(strat0 == STRAT_S2A,
+                                       s2a_pass, s2b_pass)
+                # S2 rule is always LessThanInitialShare with FRESH keys.
+                s2_pass = _key_lt(ez, ev, etz, etv)
+
+                uncond = a_same | (pwn & ~in_s2)
+                take = have & jnp.where(
+                    in_s2, s2_pass, uncond | strat_pass
+                )
+                variant_a = jnp.where(
+                    a_same, FV_WITHIN_CQ,
+                    jnp.where(pwn & ~in_s2, FV_RECLAMATION,
+                              FV_FAIR_SHARING),
+                )
+
+                removed2 = removed.at[ac].set(
+                    removed[ac] | take, mode="drop"
+                )
+                consumed2 = consumed.at[ac].set(
+                    consumed[ac] | have, mode="drop"
+                )
+                retry2 = st["retry"].at[ac].set(
+                    st["retry"][ac] | (have & ~take & ~in_s2),
+                    mode="drop",
+                )
+                order2 = jnp.where(
+                    (a_iota == ac) & take & (st["rm_step"][ac] < 0),
+                    st["step"], st["rm_step"],
+                )
+                var2 = jnp.where(
+                    (a_iota == ac) & take, variant_a, st["variant"]
+                )
+                s2_dropped2 = jnp.where(
+                    in_s2 & ~no_target,
+                    st["s2_dropped"].at[jnp.maximum(new_target, 0)].set(
+                        True, mode="drop"
+                    ),
+                    st["s2_dropped"],
+                )
+
+                fit_now = take & fits_removed(removed2)
+
+                # Next sticky: removals re-pick the CQ (host break/continue);
+                # strategy failures stay on the CQ (inner while); S2 always
+                # re-picks (drop_queue after one pop).
+                sticky2 = jnp.where(
+                    in_s2 | take | no_target | ~have,
+                    jnp.int32(-1),
+                    new_target,
+                )
+
+                # Phase transitions.
+                start_s2 = (~in_s2) & no_target & has_s2 & \
+                    jnp.any(st["retry"] & ~removed2)
+                # Reset consumed for S2 over the retry set.
+                consumed3 = jnp.where(
+                    start_s2, consumed2 & ~st["retry"], consumed2
+                )
+                phase2 = jnp.where(
+                    fit_now, 2,
+                    jnp.where(
+                        start_s2, 1,
+                        jnp.where(no_target & ~start_s2, 2, st["phase"]),
+                    ),
+                ).astype(jnp.int32)
+
+                return {
+                    "phase": phase2,
+                    "sticky": sticky2,
+                    "removed": removed2,
+                    "consumed": consumed3,
+                    "retry": retry2,
+                    "s2_dropped": s2_dropped2,
+                    "rm_step": order2,
+                    "variant": var2,
+                    "pre_z": pz, "pre_v": pv,
+                    "tgold_z": toz, "tgold_v": tov,
+                    "fit": st["fit"] | fit_now,
+                    "step": st["step"] + 1,
+                }
+
+            init = {
+                "phase": jnp.int32(0),
+                "sticky": jnp.int32(-1),
+                "removed": jnp.zeros(a_n, bool),
+                "consumed": jnp.zeros(a_n, bool),
+                "retry": jnp.zeros(a_n, bool),
+                "s2_dropped": jnp.zeros(n, bool),
+                "rm_step": jnp.full(a_n, -1, jnp.int32),
+                "variant": jnp.zeros(a_n, jnp.int32),
+                "pre_z": jnp.bool_(False), "pre_v": jnp.float64(0.0),
+                "tgold_z": jnp.bool_(False), "tgold_v": jnp.float64(0.0),
+                "fit": jnp.bool_(False),
+                "step": jnp.int32(0),
+            }
+            st = jax.lax.while_loop(cond, body, init)
+            success = st["fit"]
+            removed = st["removed"] & success
+
+            # Fill-back (host list semantics: targets in removal order,
+            # last element escapes examination, dropped slots receive the
+            # current last element).
+            t_count = jnp.sum(removed.astype(jnp.int32))
+            slot_of = jnp.where(removed, st["rm_step"], jnp.int32(1 << 30))
+            slot_order = jnp.argsort(slot_of).astype(jnp.int32)  # [A]
+
+            # At examination of list position i the host always sees the
+            # ORIGINAL i-th removed target (swaps only ever write to
+            # already-examined higher positions), so iterating original
+            # slots T-2..0 and skipping dropped ones is exact; the last
+            # slot (i == t_count-1) is never examined.
+            def fb_step(kept, i):
+                idx = slot_order[i]
+                alive = kept[idx] & (i < t_count - 1)
+                test = kept.at[idx].set(False)
+                ok = alive & fits_removed(test)
+                return jnp.where(ok, test, kept), None
+
+            idxs = jnp.arange(a_n - 2, -1, -1)
+            kept, _ = jax.lax.scan(fb_step, removed, idxs)
+            victims = kept & success
+
+            # Post-removal borrow height (oracle borrow_after /
+            # find_height_of_lowest_subtree_that_fits, lend-free form).
+            rem_final = jnp.einsum(
+                "na,afr->nfr",
+                (victims[None, :] & in_sub[:, adm.cq]).astype(jnp.int64),
+                adm_usage_full,
+            )
+            u_after = usage0 - rem_final
+
+            def borrow_height(u_state):
+                val_cell = jnp.where(active_req, req_vec, 0)  # [R]
+                fits_j = jnp.all(
+                    (u_state[chain_c, f] + val_cell[None, :]
+                     <= sq[chain_c, f]) | ~active_req[None, :],
+                    axis=1,
+                )  # [D+1]
+                h = tree.height[chain_c]
+                first = jnp.argmax(fits_j)
+                any_fit = jnp.any(fits_j)
+                root_h = tree.height[root_of[c]]
+                return jnp.where(
+                    any_fit, h[first], root_h
+                ).astype(jnp.int32)
+
+            borrow_after = jnp.where(
+                success, borrow_height(u_after), borrow_height(usage0)
+            )
+            return success, victims, jnp.where(victims, st["variant"], 0), \
+                borrow_after
+
+        # Probe axis: slot 0 = full search; slot 1+r = per-cell oracle.
+        eye = jnp.eye(r_n, dtype=bool)
+        probe_active = jnp.concatenate(
+            [full_active[None, :], eye & full_active[None, :]]
+        )
+        probe_contested = jnp.concatenate(
+            [contested_full[None, :], eye & contested_full[None, :]]
+        )
+        probe_req = jnp.where(probe_active, req_full[None, :], 0)
+        succ_p, vict_p, var_p, borrow_p = jax.vmap(search)(
+            probe_active, probe_contested, probe_req
+        )
+        full_success = succ_p[0]
+        full_victims = vict_p[0]
+        variant = var_p[0]
+        cell_success = succ_p[1:]  # [R]
+
+        all_cells_ok = jnp.all(~contested_full | cell_success)
+        resolved = elig_w & (
+            (consid == 1) | (stopped_at_praw & all_cells_ok)
+        )
+        success = resolved & full_success
+        victims = jnp.where(success, full_victims, False)
+        resolved_nc = resolved & ~full_success
+        # Per-cell assignment borrow: the single-cell probes return the
+        # oracle's post-removal height for contested cells and the plain
+        # lowest-fitting-subtree height for fit cells; the assignment's
+        # ordering borrow is the max across active cells.
+        borrow_after = jnp.max(
+            jnp.where(full_active, borrow_p[1:], 0)
+        ).astype(jnp.int32)
+        return victims, jnp.where(victims, variant, 0), success, \
+            resolved_nc, resolved, borrow_after
+
+    victims, variant, success, resolved_nc, resolved, borrow_after = \
+        jax.vmap(per_w)(
+            arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
+            arrays.w_timestamp, eligible, praw_stop, considered,
+        )
+    return PreemptTargets(victims, variant, success, resolved_nc, resolved,
+                          borrow_after)

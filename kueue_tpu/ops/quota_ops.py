@@ -206,6 +206,21 @@ def ancestor_chain(tree: QuotaTreeArrays, node: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(chain)
 
 
+def ancestor_matrix(tree: QuotaTreeArrays) -> jnp.ndarray:
+    """bool[N, N]: entry [b, d] is True when b lies on d's root path
+    (b is an ancestor-or-self of d). With no lending limits, usage at b
+    includes the full usage of every d with [b, d] set."""
+    n = tree.parent.shape[0]
+    parent = _parent_or_self(tree)
+    cols = [jnp.arange(n)]
+    for _ in range(MAX_DEPTH):
+        cols.append(parent[cols[-1]])
+    chain = jnp.stack(cols, axis=1)  # [N, D+1]
+    return jnp.zeros((n, n), bool).at[
+        chain.ravel(), jnp.repeat(jnp.arange(n), MAX_DEPTH + 1)
+    ].set(True)
+
+
 def add_usage(
     tree: QuotaTreeArrays, usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray
 ) -> jnp.ndarray:

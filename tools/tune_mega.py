@@ -50,17 +50,16 @@ def main():
 
     configs = []
     for unroll in (2, 4, 8):
-        configs.append((f"grouped_sx{s_exact}_u{unroll}",
+        configs.append((f"exact_u{unroll}",
                         lambda u=unroll: jax.jit(
                             bs.make_grouped_cycle(s_exact, unroll=u))))
-    configs.append((f"grouped_sx{s_cons}_u2",
+    configs.append(("cons_u2",
                     lambda: jax.jit(bs.make_grouped_cycle(s_cons))))
     configs.append(("fixedpoint", lambda: jax.jit(
         bs.make_fixedpoint_cycle())))
     if args.configs:
         want = set(args.configs.split(","))
-        configs = [(n, f) for n, f in configs
-                   if any(w in n for w in want)]
+        configs = [(n, f) for n, f in configs if n in want]
 
     ref_admitted = None
     for name, mk in configs:
