@@ -555,24 +555,24 @@ def admit_scan_grouped(
         is_repeat = chain_is_repeat[g_iota, c_local]  # [G,D+1]
 
         req = arrays.w_req[w]  # [G,R]
+        # All of a step's quota math lives on the entry's single chosen
+        # flavor plane — gather [G,D+1,R] slices instead of [G,D+1,F,R].
+        fcl = jnp.clip(f, 0, f_n - 1)
         cell_mask = (
-            (f_onehot[None, :, None] == f[:, None, None])
-            & (req[:, None, :] > 0)
-            & arrays.covered[c][:, None, :]
-        )  # [G,F,R]
-        delta = jnp.where(cell_mask, req[:, None, :], 0).astype(jnp.int64)
+            (f[:, None] >= 0) & (req > 0) & arrays.covered[c]
+        )  # [G,R]
+        delta = jnp.where(cell_mask, req, 0).astype(jnp.int64)
 
         gi = g_iota[:, None]
-        u = usage_g[gi, chain]  # [G,D+1,F,R]
-        lq = lq_g[gi, chain]
-        subtree = subtree_g[gi, chain]
-        bl = bl_g[gi, chain]
-        has_bl = has_bl_g[gi, chain]
+        fg = fcl[:, None]
+        u = usage_g[gi, chain, fg]  # [G,D+1,R]
+        lq = lq_g[gi, chain, fg]
+        subtree = subtree_g[gi, chain, fg]
+        bl = bl_g[gi, chain, fg]
+        has_bl = has_bl_g[gi, chain, fg]
 
         l_avail = jnp.maximum(0, sat_sub(lq, u))
         stored = sat_sub(subtree, lq)
-        used_in_parent = jnp.maximum(0, sat_sub(u, lq))
-        with_max = sat_add(sat_sub(stored, used_in_parent), bl)
 
         # Victim-adjusted usage for the availability walk: simulate the
         # removal of every designated victim plus this entry's own targets
@@ -587,7 +587,6 @@ def admit_scan_grouped(
             use_vict = designated[None, :] | jnp.where(
                 (preempting & ~overlap)[:, None], my_vict, False
             )  # [G,A]
-            fcl = jnp.clip(f, 0, f_n - 1)
             au_f = usage_by_f[fcl]  # [G,A,R]
             chain_flat = ga.node_sel[gi, chain]  # [G,D+1] flat node ids
             rem_levels = []
@@ -596,10 +595,7 @@ def admit_scan_grouped(
                 mask_i = (use_vict & on_chain).astype(jnp.int64)
                 rem_levels.append(jnp.einsum("ga,gar->gr", mask_i, au_f))
             rem = jnp.stack(rem_levels, axis=1)  # [G,D+1,R]
-            f_plane = (
-                f_onehot[None, None, :, None] == fcl[:, None, None, None]
-            )
-            u_fit = u - jnp.where(f_plane, rem[:, :, None, :], 0)
+            u_fit = u - rem
         else:
             my_vict = None
             preempting = jnp.zeros(g_n, bool)
@@ -615,9 +611,9 @@ def admit_scan_grouped(
                 has_bl[:, i], jnp.minimum(with_max_fit[:, i], avail), avail
             )
             stepped = sat_add(l_avail_fit[:, i], clamped)
-            avail = jnp.where(is_repeat[:, i, None, None], avail, stepped)
+            avail = jnp.where(is_repeat[:, i, None], avail, stepped)
 
-        fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))  # [G]
+        fits = jnp.all((delta <= avail) | ~cell_mask, axis=1)  # [G]
         deferred = nom.needs_host[w]
 
         # TAS placement recheck against the running topology state
@@ -652,7 +648,7 @@ def admit_scan_grouped(
         preempt_ok = preempting & ~overlap & fits & ~deferred
 
         borrowing = nom.best_borrow[w] > 0
-        nom_c = nominal_g[gi, c_local[:, None]][:, 0]  # [G,F,R]
+        nom_c = nominal_g[g_iota, c_local, fcl]  # [G,R]
         reserve_borrowing = jnp.where(
             has_bl[:, 0],
             jnp.minimum(delta, sat_sub(sat_add(nom_c, bl[:, 0]), u[:, 0])),
@@ -662,7 +658,7 @@ def admit_scan_grouped(
             0, jnp.minimum(delta, sat_sub(nom_c, u[:, 0]))
         )
         reserve = jnp.where(
-            borrowing[:, None, None], reserve_borrowing, reserve_plain
+            borrowing[:, None], reserve_borrowing, reserve_plain
         )
         reserve = jnp.where(cell_mask, reserve, 0)
         do_reserve = (
@@ -676,21 +672,23 @@ def admit_scan_grouped(
         # usage (scheduler.go:561 cq.AddUsage runs for either mode).
         take_usage = admit | preempt_ok
         applied = jnp.where(
-            take_usage[:, None, None],
+            take_usage[:, None],
             delta,
-            jnp.where(do_reserve[:, None, None], reserve, 0),
+            jnp.where(do_reserve[:, None], reserve, 0),
         )
-        deltas = jnp.zeros((g_n, MAX_DEPTH + 1, f_n, r_n), dtype=jnp.int64)
+        deltas = jnp.zeros((g_n, MAX_DEPTH + 1, r_n), dtype=jnp.int64)
         cur = applied
         for i in range(MAX_DEPTH + 1):
             deltas = deltas.at[:, i].set(cur)
-            cont = (~is_repeat[:, i, None, None]) if i < MAX_DEPTH else False
+            cont = (~is_repeat[:, i, None]) if i < MAX_DEPTH else False
             cur = jnp.where(
                 cont, jnp.maximum(0, sat_sub(cur, l_avail[:, i])), 0
             )
-        new_usage_g = quota_ops.sat(
-            usage_g.at[gi, chain].add(deltas, mode="drop")
-        )
+        # Plain scatter-add on the flavor plane: usage stays far below the
+        # saturation cap (it is bounded by the sum of admitted requests),
+        # so no full-array sat() pass is needed per step. Chain repeats
+        # past the root carry zero deltas, so duplicate indices are benign.
+        new_usage_g = usage_g.at[gi, chain, fg].add(deltas, mode="drop")
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], my_vict, False), axis=0
@@ -993,12 +991,16 @@ def admit_fixedpoint(
         t_node >= _INF64, _INF64, sat_sub(t_node, usage)
     )  # [N,F,R] capacity left before this cycle's admissions
 
+    # Every entry reads and writes a single flavor plane, so all per-entry
+    # tensors are [W,R] plane slices and the per-level segments are keyed
+    # by (node, flavor) — a factor-F cut in the per-round data volume.
+    fcl = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
     cell_mask = (
-        (f_onehot[None, :, None] == nom.chosen_flavor[:, None, None])
-        & (arrays.w_req[:, None, :] > 0)
-        & arrays.covered[arrays.w_cq][:, None, :]
-    )  # [W,F,R]
-    delta = jnp.where(cell_mask, arrays.w_req[:, None, :], 0).astype(jnp.int64)
+        (nom.chosen_flavor[:, None] >= 0)
+        & (arrays.w_req > 0)
+        & arrays.covered[arrays.w_cq]
+    )  # [W,R]
+    delta = jnp.where(cell_mask, arrays.w_req, 0).astype(jnp.int64)
 
     deferred = nom.needs_host
     is_fit = arrays.w_active & (nom.best_pmode == P_FIT) & ~deferred
@@ -1009,20 +1011,23 @@ def admit_fixedpoint(
         & ~deferred
     )
     borrowing = nom.best_borrow > 0
-    nominal_c = tree.nominal[arrays.w_cq]  # [W,F,R]
-    has_bl_c = tree.has_borrow_limit[arrays.w_cq]
-    bl_c = tree.borrow_limit[arrays.w_cq]
+    nominal_c = tree.nominal[arrays.w_cq, fcl]  # [W,R]
+    has_bl_c = tree.has_borrow_limit[arrays.w_cq, fcl]
+    bl_c = tree.borrow_limit[arrays.w_cq, fcl]
+    slack0_chain = slack0[chains, fcl[:, None]]  # [W,D+1,R]
 
-    # Per-level sorted orders (static): entries sorted by (chain node, rank).
+    # Per-level sorted orders (static): entries sorted by ((chain node,
+    # flavor), rank) — contributions within a segment share the plane.
     perms = []
     heads = []
     inv_perms = []
     for d in range(MAX_DEPTH + 1):
-        key = chains[:, d].astype(jnp.int64) * (w_n + 1) + rank
+        seg_id = chains[:, d].astype(jnp.int64) * f_n + fcl
+        key = seg_id * (w_n + 1) + rank
         perm = jnp.argsort(key)
-        node_sorted = chains[:, d][perm]
+        seg_sorted = seg_id[perm]
         head = jnp.concatenate([
-            jnp.ones(1, bool), node_sorted[1:] != node_sorted[:-1]
+            jnp.ones(1, bool), seg_sorted[1:] != seg_sorted[:-1]
         ])
         inv = jnp.zeros(w_n, dtype=jnp.int32).at[perm].set(
             jnp.arange(w_n, dtype=jnp.int32)
@@ -1033,31 +1038,32 @@ def admit_fixedpoint(
 
     def chain_slack(contrib):
         """min over chain levels of (slack0[b] - prefix_b(i)) for every
-        entry, given per-entry finalized/assumed contributions [W,F,R]."""
-        avail = jnp.full((w_n, f_n, r_n), _INF64, dtype=jnp.int64)
+        entry, given per-entry finalized/assumed plane contributions
+        [W,R]."""
+        avail = jnp.full((w_n, r_n), _INF64, dtype=jnp.int64)
         for d in range(MAX_DEPTH + 1):
             perm, head, inv = perms[d], heads[d], inv_perms[d]
             pre = _seg_excl_prefix(contrib[perm], head)[inv]
-            term = sat_sub(slack0[chains[:, d]], pre)
-            term = jnp.where(slack0[chains[:, d]] >= _INF64, _INF64, term)
+            term = sat_sub(slack0_chain[:, d], pre)
+            term = jnp.where(slack0_chain[:, d] >= _INF64, _INF64, term)
             # Repeated root levels recompute the same term: harmless.
             avail = jnp.minimum(avail, term)
-        return avail  # [W,F,R]
+        return avail  # [W,R]
 
     def body(state):
         admitted, rejected, reserved, decided, changed, rounds = state
         undecided = ~decided
 
-        contrib_lo = jnp.where(admitted[:, None, None], delta, 0) + reserved
+        contrib_lo = jnp.where(admitted[:, None], delta, 0) + reserved
         maybe = undecided & (is_fit | is_nc)
-        contrib_hi = contrib_lo + jnp.where(maybe[:, None, None], delta, 0)
+        contrib_hi = contrib_lo + jnp.where(maybe[:, None], delta, 0)
 
         avail_lo = chain_slack(contrib_hi)  # worst case (most usage)
         avail_hi = chain_slack(contrib_lo)  # best case (least usage)
-        exact = jnp.all(avail_lo == avail_hi, axis=(1, 2))
+        exact = jnp.all(avail_lo == avail_hi, axis=1)
 
-        fits_worst = jnp.all((delta <= avail_lo) | ~cell_mask, axis=(1, 2))
-        fits_best = jnp.all((delta <= avail_hi) | ~cell_mask, axis=(1, 2))
+        fits_worst = jnp.all((delta <= avail_lo) | ~cell_mask, axis=1)
+        fits_best = jnp.all((delta <= avail_hi) | ~cell_mask, axis=1)
 
         new_admit = undecided & is_fit & fits_worst
         new_reject = undecided & is_fit & ~fits_best
@@ -1073,9 +1079,9 @@ def admit_fixedpoint(
         pre0_hi = _seg_excl_prefix(
             contrib_hi[perms[0]], heads[0]
         )[inv_perms[0]]
-        exact0 = jnp.all(pre0 == pre0_hi, axis=(1, 2))
+        exact0 = jnp.all(pre0 == pre0_hi, axis=1)
         nc_final = undecided & is_nc & exact0
-        u_c = usage[arrays.w_cq] + pre0
+        u_c = usage[arrays.w_cq, fcl] + pre0
         reserve_borrowing = jnp.where(
             has_bl_c,
             jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl_c), u_c)),
@@ -1085,10 +1091,10 @@ def admit_fixedpoint(
             0, jnp.minimum(delta, sat_sub(nominal_c, u_c))
         )
         res_amt = jnp.where(
-            borrowing[:, None, None], reserve_borrowing, reserve_plain
+            borrowing[:, None], reserve_borrowing, reserve_plain
         )
         res_amt = jnp.where(cell_mask, res_amt, 0)
-        reserved = jnp.where(nc_final[:, None, None], res_amt, reserved)
+        reserved = jnp.where(nc_final[:, None], res_amt, reserved)
 
         newly = new_admit | new_reject | nc_final
         admitted = admitted | new_admit
@@ -1104,7 +1110,7 @@ def admit_fixedpoint(
     init = (
         jnp.zeros(w_n, bool),
         jnp.zeros(w_n, bool),
-        jnp.zeros((w_n, f_n, r_n), jnp.int64),
+        jnp.zeros((w_n, r_n), jnp.int64),
         ~(is_fit | is_nc),  # everything else is decided from the start
         jnp.bool_(True),
         jnp.int32(0),
@@ -1114,16 +1120,16 @@ def admit_fixedpoint(
     )
 
     # Final usage: base + all finalized contributions bubbled to ancestors.
-    contrib = jnp.where(admitted[:, None, None], delta, 0) + reserved
+    contrib = jnp.where(admitted[:, None], delta, 0) + reserved
     final_usage = usage
     for d in range(MAX_DEPTH + 1):
         add_d = jnp.zeros_like(usage)
-        # Scatter each entry's contribution at its chain-d node; repeated
-        # roots would double-count, so mask repeats.
+        # Scatter each entry's contribution at its chain-d node (on its
+        # flavor plane); repeated roots would double-count, so mask repeats.
         is_repeat = (chains[:, d] == chains[:, d - 1]) if d > 0 else \
             jnp.zeros(w_n, bool)
-        vals = jnp.where(is_repeat[:, None, None], 0, contrib)
-        add_d = add_d.at[chains[:, d]].add(vals, mode="drop")
+        vals = jnp.where(is_repeat[:, None], 0, contrib)
+        add_d = add_d.at[chains[:, d], fcl].add(vals, mode="drop")
         final_usage = quota_ops.sat(final_usage + add_d)
     return final_usage, admitted, rounds
 
