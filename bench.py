@@ -241,7 +241,14 @@ def probe_sim(scale: float):
         np.asarray(arrays.w_cq)
     ]
     s_max = int(np.bincount(group_of).max())
-    sim = jax.jit(make_sim_loop(s_max=s_max))
+    # Lending-limit-free trees take the fixed-point admission pass: a
+    # handful of fully-parallel rounds per cycle instead of a sequential
+    # per-tree scan (identical decisions; see models/batch_scheduler.py).
+    kernel = (
+        "grouped" if bool(np.asarray(arrays.tree.has_lend_limit).any())
+        else "fixedpoint"
+    )
+    sim = jax.jit(make_sim_loop(s_max=s_max, kernel=kernel))
     platform = jax.devices()[0].platform
 
     t0 = time.monotonic()

@@ -875,19 +875,43 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         # choice is independent of the oracle outcome (it stopped at that
         # flavor, or there was only one to consider). TAS entries are
         # excluded — their victim search needs the topology probe.
-        elig = (
+        base_elig = (
             arrays.w_active
             & (nom.best_pmode == P_PREEMPT_RAW)
             & (nom.praw_count == 1)
-            & arrays.preempt_simple[arrays.w_cq]
             & ~arrays.w_has_gates
         )
         if arrays.w_tas is not None:
-            elig = elig & ~arrays.w_tas
+            base_elig = base_elig & ~arrays.w_tas
+        elig = base_elig & arrays.preempt_simple[arrays.w_cq]
         tgt = preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
             nom.considered,
         )
+        if arrays.preempt_hier is not None:
+            # Nested lend-free trees: hierarchical victim-search kernel
+            # (models/preempt_kernel.hier_targets); the encoder omits the
+            # field entirely when no such tree exists this cycle.
+            from kueue_tpu.models.preempt_kernel import hier_targets
+
+            elig_h = base_elig & arrays.preempt_hier[arrays.w_cq]
+            tgt_h = hier_targets(
+                arrays, adm, nom.chosen_flavor, elig_h, nom.praw_stop,
+                nom.considered,
+            )
+            hm = elig_h
+            tgt = tgt.__class__(
+                victims=jnp.where(hm[:, None], tgt_h.victims, tgt.victims),
+                variant=jnp.where(hm[:, None], tgt_h.variant, tgt.variant),
+                success=jnp.where(hm, tgt_h.success, tgt.success),
+                resolved_nc=jnp.where(
+                    hm, tgt_h.resolved_nc, tgt.resolved_nc
+                ),
+                resolved=jnp.where(hm, tgt_h.resolved, tgt.resolved),
+                borrow_after=jnp.where(
+                    hm, tgt_h.borrow_after, tgt.borrow_after
+                ),
+            )
         nom = nom._replace(
             best_pmode=jnp.where(
                 tgt.success, P_PREEMPT_OK,
@@ -938,15 +962,40 @@ cycle_grouped_preempt = jax.jit(make_grouped_cycle(preempt=True))
 _INF64 = (jnp.int64(1) << 61)
 
 
+def _cumsum0(x):
+    """Axis-0 cumulative sum as an explicit Hillis-Steele shift-add ladder
+    (log2(n) elementwise adds). The native jnp.cumsum lowering for int64
+    on TPU emits a u32-pair reduce-window whose scoped-vmem scratch
+    overflows the 16M limit at 50k-long axes; plain shifted adds lower to
+    simple fusions with no scratch at all."""
+    n = x.shape[0]
+    if n <= 1024:
+        return jnp.cumsum(x, axis=0)
+    pad_cfg = [(0, 0)] * (x.ndim - 1)
+    k = 1
+    while k < n:
+        shifted = jnp.pad(x, [(k, 0)] + pad_cfg)[:n]
+        x = x + shifted
+        k *= 2
+    return x
+
+
 def _seg_excl_prefix(sorted_vals, head):
-    """Exclusive prefix sums within segments. sorted_vals: [W,F,R] in sorted
-    order; head: bool[W] marking segment starts. Returns [W,F,R]."""
-    c = jnp.cumsum(sorted_vals, axis=0)
+    """Exclusive prefix sums within segments. sorted_vals: [W,...] in sorted
+    order; head: bool[W] marking segment starts. Returns [W,...].
+
+    The per-position segment base is recovered by scattering each head's
+    global prefix into its segment slot (segment ids = cumsum(head)-1)
+    and gathering back — no cumulative-max scan needed."""
+    c = _cumsum0(sorted_vals)
     excl = c - sorted_vals  # global exclusive prefix
     w = head.shape[0]
-    head_idx = jnp.where(head, jnp.arange(w), -1)
-    seg_head = jax.lax.associative_scan(jnp.maximum, head_idx)
-    return excl - excl[seg_head]
+    seg_ids = _cumsum0(head.astype(jnp.int32)) - 1
+    head_b = head.reshape((w,) + (1,) * (sorted_vals.ndim - 1))
+    base = jnp.zeros_like(excl).at[seg_ids].add(
+        jnp.where(head_b, excl, 0), mode="drop"
+    )
+    return excl - base[seg_ids]
 
 
 def admit_fixedpoint(
@@ -1047,7 +1096,12 @@ def admit_fixedpoint(
             term = sat_sub(slack0_chain[:, d], pre)
             term = jnp.where(slack0_chain[:, d] >= _INF64, _INF64, term)
             # Repeated root levels recompute the same term: harmless.
-            avail = jnp.minimum(avail, term)
+            # The barrier keeps XLA from fusing every level's segmented
+            # prefix into one kernel, whose combined scoped buffers
+            # overflow the TPU's 16M vmem scratch limit.
+            avail = jax.lax.optimization_barrier(
+                jnp.minimum(avail, term)
+            )
         return avail  # [W,R]
 
     def body(state):

@@ -73,6 +73,9 @@ class CycleArrays(NamedTuple):
     # CQ is in a flat no-lending-limit tree whose admitted set is fully
     # device-representable: classical victim search can run on device.
     preempt_simple: Optional[jnp.ndarray] = None  # bool[N]
+    # CQ is in a *nested* no-lending-limit tree with device-representable
+    # admitted usage: the hierarchical victim-search kernel applies.
+    preempt_hier: Optional[jnp.ndarray] = None  # bool[N]
     w_has_gates: Optional[jnp.ndarray] = None  # bool[W] preemptionGates open
     # -- device TAS (None when no TAS flavor is device-encoded) --
     tas_topo: Optional[object] = None  # ops.tas_place.TASDeviceTopo
@@ -365,7 +368,7 @@ def encode_cycle(
     root_merge = None
     fair_node_ok = None
     if preempt:
-        preempt_simple, fair_node_ok = _encode_admitted(
+        preempt_simple, preempt_hier, fair_node_ok = _encode_admitted(
             snapshot, tidx, tree, idx, fair_sharing
         )
         preempt_fields = dict(
@@ -375,6 +378,10 @@ def encode_cycle(
             preempt_simple=jnp.asarray(preempt_simple),
             w_has_gates=jnp.asarray(w_gates),
         )
+        if preempt_hier.any():
+            # Omitted (None) when no nested lend-free tree exists, so the
+            # common flat-only cycle compiles without the hier kernel.
+            preempt_fields["preempt_hier"] = jnp.asarray(preempt_hier)
         if tas_device_flavors:
             tas_fields, root_merge = _encode_tas(
                 snapshot, tidx, idx, device_wls, w, tas_device_flavors,
@@ -684,11 +691,16 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
                 a_usage[i, fi2, ri2] = v2
 
     preempt_simple = np.zeros(n, dtype=bool)
+    preempt_hier = np.zeros(n, dtype=bool)
     fair_node_ok = np.zeros(n, dtype=bool)
     if not fair_sharing:
         for name in snapshot.cluster_queues:
             ni = tidx.node_of[name]
             preempt_simple[ni] = root_ok[root_of[ni]]
+            # Nested lend-free trees take the hierarchical kernel.
+            preempt_hier[ni] = (
+                root_fair_ok[root_of[ni]] and not root_ok[root_of[ni]]
+            )
     else:
         for name in snapshot.cluster_queues:
             ni = tidx.node_of[name]
@@ -704,7 +716,7 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
         active=jnp.asarray(a_active),
         uid_rank=jnp.asarray(a_uid),
     )
-    return preempt_simple, fair_node_ok
+    return preempt_simple, preempt_hier, fair_node_ok
 
 
 def _device_compatible(

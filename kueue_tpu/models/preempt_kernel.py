@@ -374,3 +374,372 @@ def preempt_targets(
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)
+
+
+def hier_targets(
+    arrays: CycleArrays,
+    adm: AdmittedArrays,
+    chosen_flavor: jnp.ndarray,  # i32[W]
+    eligible: jnp.ndarray,  # bool[W] structurally device-resolvable entries
+    praw_stop: jnp.ndarray,  # bool[W]
+    considered: jnp.ndarray,  # i32[W]
+) -> PreemptTargets:
+    """Victim selection for entries in *nested* (depth > 1) lending-limit-
+    free cohort trees — the hierarchical-reclaim generalization of
+    ``preempt_targets`` (reference hierarchical_preemption.go:149
+    collectCandidatesForHierarchicalReclaim + candidate_generator.go:135
+    candidateIsValid + preemption.go:281 classicalPreemptions).
+
+    Differences from the flat kernel:
+      * per-candidate LCA with the preemptor and an advantage state that
+        evolves along the preemptor's root path (QuantitiesFitInQuota
+        walk, resource_node.go:233);
+      * candidate collection and in-run validity check the candidate's CQ
+        *and every cohort strictly below the LCA* for above-nominal usage;
+      * the fit test is a chain-min over all of the preemptor's ancestors;
+      * remove-until-fit runs as a lax.scan over the ordered candidate
+        axis carrying per-node removed usage (exact sequential semantics —
+        cross-CQ removals under shared cohorts interleave, so the flat
+        kernel's per-CQ prefix trick does not apply).
+
+    Exactness relies on the encoder's ``preempt_hier`` gate: no lending
+    limits anywhere in the tree (usage bubbles fully, so removal at CQ d
+    subtracts at every ancestor of d) and fully mappable admitted usage.
+    """
+    tree = arrays.tree
+    usage = arrays.usage
+    sq = tree.subtree_quota
+    avail0 = quota_ops.available_all(tree, usage)
+
+    n = tree.n_nodes
+    parent_or_self = jnp.where(tree.parent < 0, jnp.arange(n), tree.parent)
+    root_of = jnp.arange(n)
+    for _ in range(quota_ops.MAX_DEPTH):
+        root_of = parent_or_self[root_of]
+    has_par_n = tree.parent >= 0
+    chain_cols = [jnp.arange(n)]
+    for _ in range(quota_ops.MAX_DEPTH):
+        chain_cols.append(parent_or_self[chain_cols[-1]])
+    chain_table = jnp.stack(chain_cols, axis=1)  # [N, D+1]
+    in_sub = quota_ops.ancestor_matrix(tree)  # [b, d]: b ancestor-or-self of d
+    lq_all = quota_ops.local_quota(tree)
+    height_n = tree.height
+    d1 = quota_ops.MAX_DEPTH + 1
+
+    a_n = adm.cq.shape[0]
+    r_n = tree.nominal.shape[2]
+    a_iota = jnp.arange(a_n)
+    cand_chain = chain_table[adm.cq]  # [A, D+1]
+
+    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered):
+        f = jnp.maximum(f0, 0)
+        full_active = (req > 0) & arrays.covered[c]  # [R]
+        contested_full = full_active & (req > avail0[c, f])  # [R]
+        au = adm.usage[:, f, :]  # [A,R]
+        u0_f = usage[:, f, :]  # [N,R] cycle-start plane
+        sq_f = sq[:, f, :]
+        lq_f = lq_all[:, f, :]
+        bl_f = tree.borrow_limit[:, f, :]
+        has_bl_f = tree.has_borrow_limit[:, f, :]
+
+        same = adm.cq == c
+        cross = (root_of[adm.cq] == root_of[c]) & ~same & has_par_n[c]
+        lower = prio > adm.prio
+        neq = (prio == adm.prio) & (ts < adm.ts)
+
+        def pol_ok(pol):
+            return jnp.where(
+                pol == 3, jnp.ones_like(lower),
+                jnp.where(pol == 2, lower | neq,
+                          jnp.where(pol == 1, lower,
+                                    jnp.zeros_like(lower))),
+            )
+
+        pol_w = arrays.policy_within[c]
+        pol_r = arrays.policy_reclaim[c]
+        policy_pass = (
+            (same & (pol_w != 0) & pol_ok(pol_w))
+            | (cross & (pol_r != 0) & pol_ok(pol_r))
+        )
+
+        has_par = has_par_n[c]
+        chain_c = chain_table[c]  # [D+1]
+        is_real_lvl = jnp.concatenate([
+            jnp.ones(1, bool), chain_c[1:] != chain_c[:-1]
+        ])  # [D+1] first occurrence of each chain node
+        # Fit-test constraint term per chain level (lend-free closed form).
+        t_chain = jnp.where(
+            (tree.parent[chain_c] < 0)[:, None],
+            sq_f[chain_c],
+            jnp.where(has_bl_f[chain_c],
+                      sat_add(sq_f[chain_c], bl_f[chain_c]), _INF),
+        )  # [D+1,R]
+        u_c0 = u0_f[c]
+        sq_c = sq_f[c]
+
+        # LCA of preemptor and each candidate: first chain level (>=1)
+        # whose node covers the candidate's CQ.
+        anc = in_sub[chain_c][:, adm.cq]  # [D+1, A]
+        anc = anc & (jnp.arange(d1) > 0)[:, None]
+        lca_lvl = jnp.argmax(anc, axis=0).astype(jnp.int32)  # [A]
+        lca_node = chain_c[lca_lvl]
+        # Candidate path levels strictly below the LCA (its own CQ apart).
+        lvl_of_lca_on_cand = jnp.argmax(
+            cand_chain == lca_node[:, None], axis=1
+        ).astype(jnp.int32)  # [A]
+        cand_real = jnp.concatenate([
+            jnp.ones((a_n, 1), bool),
+            cand_chain[:, 1:] != cand_chain[:, :-1],
+        ], axis=1)
+        path_mask = (
+            (jnp.arange(d1)[None, :] >= 1)
+            & (jnp.arange(d1)[None, :] < lvl_of_lca_on_cand[:, None])
+            & cand_real
+        )  # [A, D+1]
+
+        def search(active_req, contested, req_vec):
+            uses = jnp.any(contested[None, :] & (au > 0), axis=1)
+
+            def above_nominal(u_f, nodes):
+                """∃ contested cell with usage above subtree quota."""
+                return jnp.any(
+                    contested & (u_f[nodes] > sq_f[nodes]), axis=-1
+                )
+
+            # Advantage state along the preemptor's root path
+            # (hierarchical_preemption.go:160-172): candidates found at
+            # LCA level i get the state *before* that level's fit update.
+            adv = jnp.all(
+                ~active_req | (sat_add(u_c0, req_vec) <= sq_c)
+            )
+            remaining = sat_sub(
+                req_vec, jnp.maximum(0, sat_sub(lq_f[c], u_c0))
+            )
+            adv_at_rows = [adv]  # state entering level 1
+            for i in range(1, d1):
+                b = chain_c[i]
+                fits_i = jnp.all(
+                    ~active_req
+                    | (sat_add(u0_f[b], remaining) <= sq_f[b])
+                )
+                adv = adv | (fits_i & is_real_lvl[i])
+                if i < d1 - 1:
+                    adv_at_rows.append(adv)
+                remaining = sat_sub(
+                    remaining, jnp.maximum(0, sat_sub(lq_f[b], u0_f[b]))
+                )
+            adv_at = jnp.stack(adv_at_rows)  # [D] state entering level i+1
+            cand_adv = adv_at[jnp.clip(lca_lvl - 1, 0, d1 - 2)]  # [A]
+
+            # Static collection gate: candidate CQ and every cohort
+            # strictly below the LCA above nominal at cycle start
+            # (collectCandidatesInSubtree skips within-nominal subtrees).
+            # all path nodes above nominal <=> count(above) == count(path)
+            above0_cnt = jnp.sum(
+                path_mask
+                & jnp.any(
+                    contested[None, None, :]
+                    & (u0_f[cand_chain] > sq_f[cand_chain]),
+                    axis=-1,
+                ),
+                axis=1,
+            )
+            path_ok0 = above0_cnt == jnp.sum(path_mask, axis=1)
+            cq_ok0 = above_nominal(u0_f, adm.cq)
+            cand = adm.active & uses & policy_pass & (
+                same | (path_ok0 & cq_ok0)
+            )
+
+            bwc = arrays.bwc_policy[c]
+            rwob = (bwc == 0) | (adm.prio >= prio) | (
+                arrays.bwc_has_threshold[c]
+                & (adm.prio > arrays.bwc_threshold[c])
+            )
+            variant = jnp.where(
+                ~cand, 0,
+                jnp.where(same, V_WITHIN_CQ,
+                          jnp.where(cand_adv, V_HIERARCHICAL_RECLAIM,
+                                    jnp.where(rwob,
+                                              V_RECLAIM_WITHOUT_BORROWING,
+                                              V_RECLAIM_WHILE_BORROWING))),
+            ).astype(jnp.int32)
+
+            class_rank = (
+                jnp.where(same, 2, jnp.where(cand_adv, 0, 1))
+                + jnp.where(adm.evicted, 0, 3)
+            )
+            ord_ = jnp.lexsort((
+                adm.uid_rank, -adm.qr_time, adm.prio, class_rank,
+                (~cand).astype(jnp.int32),
+            )).astype(jnp.int32)
+
+            # Attempt plan (preemption.go:308-316).
+            has_cross = jnp.any(cand & cross)
+            has_hier = jnp.any(cand & cross & cand_adv)
+            borrow_forbidden = bwc == 0
+            under_nom = jnp.all(
+                ~contested | (tree.nominal[c, f] > u_c0)
+            )
+            single = ~has_cross | (borrow_forbidden & ~under_nom)
+            first_borrow = jnp.where(
+                single, True, ~(borrow_forbidden & ~has_hier)
+            )
+            second_on = ~single
+
+            def fits_state(u_f, borrow_b):
+                """workloadFits against per-node plane usage u_f [N,R]."""
+                term = jnp.where(
+                    t_chain >= _INF, _INF, sat_sub(t_chain, u_f[chain_c])
+                )  # [D+1,R]
+                term = jnp.where(is_real_lvl[:, None], term, _INF)
+                avail = jnp.min(term, axis=0)  # [R]
+                avail = jnp.where(
+                    has_par, avail,
+                    sat_sub(sq_c, u_f[c]),
+                )
+                ok = (req_vec <= avail) | ~active_req
+                no_borrow_ok = (
+                    (sat_add(u_f[c], req_vec) <= sq_c) | ~active_req
+                )
+                return jnp.all(ok & (borrow_b | no_borrow_ok))
+
+            def attempt(borrow_b):
+                elig = cand & ~(
+                    borrow_b & (variant == V_RECLAIM_WITHOUT_BORROWING)
+                )
+
+                def fwd(carry, a):
+                    u_f, stopped = carry
+                    # Dynamic validity (candidate_generator.go:135):
+                    # same-CQ always valid; cross needs CQ + path-to-LCA
+                    # above nominal against the running usage.
+                    d_cq = adm.cq[a]
+                    above_cq = above_nominal(u_f, d_cq)
+                    path_above = jnp.any(
+                        contested[None, :]
+                        & (u_f[cand_chain[a]] > sq_f[cand_chain[a]]),
+                        axis=-1,
+                    )  # [D+1]
+                    path_all = jnp.all(~path_mask[a] | path_above)
+                    valid = jnp.where(same[a], True, above_cq & path_all)
+                    remove = elig[a] & valid & ~stopped
+                    sub = jnp.where(
+                        remove, in_sub[:, d_cq], False
+                    )[:, None] * au[a][None, :]
+                    u_f = u_f - sub
+                    hit = remove & fits_state(u_f, borrow_b)
+                    return (u_f, stopped | hit), (remove, hit)
+
+                (u_end, _), (removed_o, hit_o) = jax.lax.scan(
+                    fwd, (u0_f, jnp.bool_(False)), ord_
+                )
+                success = jnp.any(hit_o)
+                k_star = jnp.argmax(hit_o).astype(jnp.int32)
+                pos = jnp.arange(a_n)
+                pre = removed_o & (pos <= k_star)
+
+                def fb(carry, xs):
+                    u_f = carry
+                    is_t, a = xs
+                    u_t = u_f + (
+                        jnp.where(is_t, in_sub[:, adm.cq[a]], False)[:, None]
+                        * au[a][None, :]
+                    )
+                    drop = is_t & fits_state(u_t, borrow_b)
+                    u_f = jnp.where(drop, u_t, u_f)
+                    return u_f, drop
+
+                fb_mask = pre & (pos < k_star)
+                u_fb, drops_rev = jax.lax.scan(
+                    fb, u_end, (fb_mask[::-1], ord_[::-1])
+                )
+                drops = drops_rev[::-1]
+                victims_o = pre & ~drops & success
+                victims = jnp.zeros(a_n, bool).at[ord_].set(victims_o)
+                return success, victims
+
+            ok1, v1 = attempt(first_borrow)
+            ok2, v2 = attempt(~first_borrow)
+            use2 = ~ok1 & second_on & ok2
+            success = ok1 | use2
+            victims = jnp.where(success, jnp.where(ok1, v1, v2), False)
+            return success, victims, variant
+
+        eye = jnp.eye(r_n, dtype=bool)
+        probe_active = jnp.concatenate(
+            [full_active[None, :], eye & full_active[None, :]]
+        )
+        probe_contested = jnp.concatenate(
+            [contested_full[None, :], eye & contested_full[None, :]]
+        )
+        probe_req = jnp.where(probe_active, req[None, :], 0)
+        succ_p, vict_p, variant_p = jax.vmap(search)(
+            probe_active, probe_contested, probe_req
+        )
+        full_success = succ_p[0]
+        full_victims = vict_p[0]
+        variant = variant_p[0]
+        cell_success = succ_p[1:]  # [R]
+        cell_victims = vict_p[1:]  # [R, A]
+
+        # Post-removal borrow height per cell: the generalized
+        # FindHeightOfLowestSubtreeThatFits walk (lend-free: per-level
+        # local available is zero, so `remaining` stays the request).
+        def height_walk(u_f_r, val):
+            """u_f_r: [D+1] usage along the preemptor chain for one
+            resource; val: scalar request."""
+            borrowing0 = sat_add(u_f_r[0], val) > sq_c_r
+            fits_lvls = (
+                (sat_add(u_f_r[1:], val) <= sq_chain_r[1:])
+                & is_real_lvl[1:]
+            )
+            any_fit = jnp.any(fits_lvls)
+            first = jnp.argmax(fits_lvls).astype(jnp.int32) + 1
+            h_up = jnp.where(
+                any_fit, height_n[chain_c[first]],
+                height_n[chain_c[quota_ops.MAX_DEPTH]],
+            )
+            return jnp.where(~borrowing0 | ~has_par, 0, h_up)
+
+        sq_chain_r = None  # bound per-resource below
+        sq_c_r = None
+        h_pre = jnp.zeros(r_n, jnp.int32)
+        h_post = jnp.zeros(r_n, jnp.int32)
+        rem_nodes = jnp.einsum(
+            "ra,na,as->rns",
+            cell_victims.astype(jnp.int64), in_sub[:, adm.cq], au,
+        )  # [R, N, R'] removal at every node per cell probe's victim set
+        for r in range(r_n):
+            sq_chain_r = sq_f[chain_c, r]
+            sq_c_r = sq_f[c, r]
+            u_pre_chain = u0_f[chain_c, r]
+            u_post_chain = u_pre_chain - rem_nodes[r][chain_c, r]
+            h_pre = h_pre.at[r].set(height_walk(u_pre_chain, req[r]))
+            h_post = h_post.at[r].set(height_walk(u_post_chain, req[r]))
+        cell_borrow = jnp.where(
+            contested_full,
+            jnp.where(cell_success, h_post, h_pre),
+            h_pre,
+        )
+        borrow_after = jnp.max(
+            jnp.where(full_active, cell_borrow, 0)
+        ).astype(jnp.int32)
+
+        all_cells_ok = jnp.all(~contested_full | cell_success)
+        resolved = elig_w & (
+            (considered == 1) | (stopped_at_praw & all_cells_ok)
+        )
+        success = resolved & full_success
+        victims = jnp.where(success, full_victims, False)
+        resolved_nc = resolved & ~full_success
+
+        return victims, jnp.where(victims, variant, 0), success, \
+            resolved_nc, resolved, borrow_after
+
+    victims, variant, success, resolved_nc, resolved, borrow_after = \
+        jax.vmap(per_w)(
+            arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
+            arrays.w_timestamp, eligible, praw_stop, considered,
+        )
+    return PreemptTargets(victims, variant, success, resolved_nc, resolved,
+                          borrow_after)

@@ -37,9 +37,15 @@ class SimOutputs(NamedTuple):
     final_vclock: jnp.ndarray  # i64 virtual ms when the simulation settled
 
 
-def make_sim_loop(s_max: int, max_rounds: int = 100000):
+def make_sim_loop(s_max: int, max_rounds: int = 100000,
+                  kernel: str = "grouped"):
     """Build the jittable simulator. ``s_max`` is the per-tree admission
-    scan depth (see admit_scan_grouped)."""
+    scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
+    admission pass: "grouped" (the sequential per-tree scan) or
+    "fixedpoint" (monotone-bounds rounds — usually far fewer device steps
+    per cycle; exact only for lending-limit-free trees, which the caller
+    must check)."""
+    assert kernel in ("grouped", "fixedpoint")
 
     def simulate(
         arrays: CycleArrays, ga: GroupArrays, runtime_ms: jnp.ndarray
@@ -93,9 +99,14 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000):
             a = arrays._replace(w_active=pending, usage=usage)
             nom = bs.nominate(a, usage)
             order = bs.admission_order(a, nom)
-            _u, admit, _pre = bs.admit_scan_grouped(
-                a, ga, nom, usage, order, s_max
-            )
+            if kernel == "fixedpoint":
+                _u, admit, _r = bs.admit_fixedpoint(
+                    a, ga, nom, usage, order
+                )
+            else:
+                _u, admit, _pre = bs.admit_scan_grouped(
+                    a, ga, nom, usage, order, s_max
+                )
 
             newly = admit & pending
             any_admit = jnp.any(newly)

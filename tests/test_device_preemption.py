@@ -244,3 +244,166 @@ def test_overlapping_targets_skip_second_preemptor():
         sched.schedule_all()
         # Only one of the two fits afterwards (hi1 by FIFO).
         assert sorted(i.obj.name for i in cache.workloads.values()) == ["hi1"]
+
+
+def nested_scenario(seed: int):
+    """Depth-2/3 cohort trees (nested cohorts, some with own quotas), no
+    lending limits — the hierarchical device-preemption class."""
+    rng = random.Random(50_000 + seed)
+    n_flavors = rng.randint(1, 2)
+    flavor_specs = [ResourceFlavor(name=f"f{i}") for i in range(n_flavors)]
+
+    from kueue_tpu.api.types import FlavorQuotas
+
+    cohorts = []
+    attach = []
+    for t in range(rng.randint(1, 2)):
+        quotas = []
+        if rng.random() < 0.5:
+            quotas = [FlavorQuotas(
+                name="f0",
+                resources={"cpu": ResourceQuota(rng.randrange(0, 4) * 1000)},
+            )]
+        root = Cohort(name=f"root{t}", quotas=quotas)
+        cohorts.append(root)
+        attach.append(root.name)
+        for m in range(rng.randint(1, 2)):
+            mid = Cohort(name=f"mid{t}-{m}", parent=root.name)
+            cohorts.append(mid)
+            attach.append(mid.name)
+            if rng.random() < 0.5:
+                leaf = Cohort(name=f"leaf{t}-{m}", parent=mid.name)
+                cohorts.append(leaf)
+                attach.append(leaf.name)
+
+    cqs = []
+    n_cqs = rng.randint(2, 5)
+    for i in range(n_cqs):
+        flavors: Dict[str, Dict[str, ResourceQuota]] = {}
+        for fs in flavor_specs[: rng.randint(1, n_flavors)]:
+            cells = {}
+            for res in RESOURCES:
+                nominal = rng.randrange(1, 8) * 1000
+                bl = rng.choice([None, rng.randrange(0, 5) * 1000])
+                cells[res] = ResourceQuota(nominal, bl, None)
+            flavors[fs.name] = cells
+        bwc = BorrowWithinCohort()
+        if rng.random() < 0.4:
+            from kueue_tpu.api.constants import BorrowWithinCohortPolicy
+
+            bwc = BorrowWithinCohort(
+                policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                max_priority_threshold=rng.choice([None, 100]),
+            )
+        preemption = ClusterQueuePreemption(
+            within_cluster_queue=rng.choice(POLICIES),
+            reclaim_within_cohort=rng.choice(POLICIES),
+            borrow_within_cohort=bwc,
+        )
+        fung = FlavorFungibility(
+            when_can_borrow=FlavorFungibilityPolicy.BORROW,
+            when_can_preempt=FlavorFungibilityPolicy.PREEMPT,
+        )
+        cqs.append(
+            make_cq(
+                f"cq{i}",
+                cohort=rng.choice(attach),
+                flavors=flavors,
+                resources=RESOURCES,
+                strategy=rng.choice(
+                    [QueueingStrategy.BEST_EFFORT_FIFO,
+                     QueueingStrategy.STRICT_FIFO]
+                ),
+                fungibility=fung,
+                preemption=preemption,
+            )
+        )
+
+    def wave(n, lo_prio, hi_prio, t0):
+        out = []
+        for i in range(n):
+            cq = rng.choice(cqs)
+            reqs = {}
+            for res in rng.sample(RESOURCES, rng.randint(1, 2)):
+                reqs[res] = rng.randrange(1, 6) * 500
+            out.append(
+                make_wl(
+                    f"w{t0}-{i}",
+                    queue=f"lq-{cq.name}",
+                    requests=reqs,
+                    priority=rng.randrange(lo_prio, hi_prio) * 100,
+                    creation_time=float(t0 + i),
+                )
+            )
+        return out
+
+    wave1 = wave(rng.randint(3, 10), 0, 2, 0)
+    wave2 = wave(rng.randint(2, 8), 1, 4, 100)
+    return flavor_specs, cohorts, cqs, wave1, wave2
+
+
+def run_nested(seed: int, device: bool):
+    flavor_specs, cohorts, cqs, wave1, wave2 = nested_scenario(seed)
+    cache, queues, host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    evictions: List[str] = []
+    if device:
+        sched = DeviceScheduler(cache, queues)
+        inner = sched.host
+        fallbacks: List[str] = []
+        orig_hp = sched._host_process
+
+        def spy(infos):
+            fallbacks.extend(i.obj.name for i in infos)
+            return orig_hp(infos)
+
+        sched._host_process = spy
+    else:
+        sched = host
+        inner = sched
+        fallbacks = []
+    orig_evict = inner.evict_fn
+
+    def evict(victim, eviction_reason, preemption_reason):
+        evictions.append(f"{victim.obj.name}:{preemption_reason}")
+        orig_evict(victim, eviction_reason, preemption_reason)
+
+    inner.evict_fn = evict
+    if device:
+        sched.host.evict_fn = evict
+
+    submit(queues, *wave1)
+    sched.schedule_all(max_cycles=40)
+    submit(queues, *wave2)
+    sched.schedule_all(max_cycles=40)
+
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    return admissions, sorted(admissions), sorted(evictions), fallbacks
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_hierarchical_device_preemption_matches_host(seed):
+    """Nested lend-free trees: the hierarchical victim-search kernel must
+    reproduce the host's admitted sets, flavors and victim sets with no
+    host fallback."""
+    host_adm, host_names, host_evictions, _ = run_nested(seed, device=False)
+    dev_adm, dev_names, dev_evictions, fallbacks = run_nested(
+        seed, device=True
+    )
+    assert not fallbacks, (
+        f"hier-eligible scenario fell back to host for: {fallbacks}"
+    )
+    assert dev_names == host_names, (
+        f"admitted sets differ: host={host_names} device={dev_names}"
+    )
+    assert dev_evictions == host_evictions, (
+        f"victim sets differ: host={host_evictions} device={dev_evictions}"
+    )
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name]

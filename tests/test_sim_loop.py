@@ -106,3 +106,25 @@ def test_sim_loop_matches_python_reference(seed):
     np.testing.assert_array_equal(np.asarray(out.admitted_at), ref_adm)
     np.testing.assert_array_equal(np.asarray(out.completed_at), ref_comp)
     assert int(out.rounds) > 0
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_sim_loop_fixedpoint_kernel_matches_grouped(seed):
+    """The fixed-point admission pass must drive the simulator to the
+    exact same trajectory as the per-tree sequential scan (valid here:
+    synth trees carry no lending limits)."""
+    arrays, ga = synth(seed + 5, W=48, C=8, F=2, R=2, COHORTS=3)
+    assert not bool(np.asarray(arrays.tree.has_lend_limit).any())
+    rng = np.random.default_rng(seed)
+    runtime_ms = jnp.asarray(rng.integers(100, 1000, 48).astype(np.int64))
+    out_g = jax.jit(make_sim_loop(s_max=48))(arrays, ga, runtime_ms)
+    out_f = jax.jit(make_sim_loop(s_max=48, kernel="fixedpoint"))(
+        arrays, ga, runtime_ms
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_g.admitted_at), np.asarray(out_f.admitted_at)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_g.completed_at), np.asarray(out_f.completed_at)
+    )
+    assert int(out_g.rounds) == int(out_f.rounds)
