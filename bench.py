@@ -248,7 +248,9 @@ def probe_sim(scale: float):
         "grouped" if bool(np.asarray(arrays.tree.has_lend_limit).any())
         else "fixedpoint"
     )
-    sim = jax.jit(make_sim_loop(s_max=s_max, kernel=kernel))
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    sim = jax.jit(make_sim_loop(s_max=s_max, kernel=kernel,
+                                n_levels=n_levels))
     platform = jax.devices()[0].platform
 
     t0 = time.monotonic()
@@ -322,6 +324,14 @@ def build_mega(W=50_000, C=2000, F=32, R=2, CO=50):
     usage0 = jnp.zeros((N, F, R), jnp.int64)
     subtree, usage = compute_subtree(tree, usage0, jnp.asarray(is_cq))
     tree = tree._replace(subtree_quota=subtree)
+    from kueue_tpu.models.encode import _order_rank
+
+    # Draw order matches the original generator so results stay comparable.
+    w_cq_np = rng.integers(CO, N, W).astype(np.int32)
+    w_req_np = rng.integers(1, 20, (W, R)) * 500
+    w_elig_np = rng.random((W, F)) < 0.9
+    w_prio = rng.integers(0, 3, W) * 100
+    w_ts = np.arange(W, dtype=np.float64)
     arrays = CycleArrays(
         tree=tree, usage=usage,
         flavor_at=jnp.asarray(np.tile(np.arange(F, dtype=np.int32), (N, 1))),
@@ -339,14 +349,15 @@ def build_mega(W=50_000, C=2000, F=32, R=2, CO=50):
         policy_within=jnp.zeros(N, jnp.int32),
         policy_reclaim=jnp.zeros(N, jnp.int32),
         nominal_cq=tree.nominal,
-        w_cq=jnp.asarray(rng.integers(CO, N, W).astype(np.int32)),
-        w_req=jnp.asarray(rng.integers(1, 20, (W, R)) * 500),
-        w_elig=jnp.asarray(rng.random((W, F)) < 0.9),
+        w_cq=jnp.asarray(w_cq_np),
+        w_req=jnp.asarray(w_req_np),
+        w_elig=jnp.asarray(w_elig_np),
         w_active=jnp.ones(W, bool),
-        w_priority=jnp.asarray(rng.integers(0, 3, W) * 100),
-        w_timestamp=jnp.asarray(np.arange(W, dtype=np.float64)),
+        w_priority=jnp.asarray(w_prio),
+        w_timestamp=jnp.asarray(w_ts),
         w_quota_reserved=jnp.zeros(W, bool),
         w_start_flavor=jnp.zeros(W, np.int32),
+        w_order_rank=jnp.asarray(_order_rank(w_prio, w_ts)),
     )
     layout = GroupLayout(parent, np.ones(N, bool))
     return arrays, layout
@@ -364,12 +375,16 @@ def probe_mega():
     W = 50_000
     arrays, layout = build_mega(W=W)
     ga = bs.GroupArrays(*layout.as_jax())
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    group_of = np.asarray(layout.flat_to_group)[np.asarray(arrays.w_cq)]
+    s_exact = int(np.bincount(group_of, minlength=layout.n_groups).max())
     out_stats = {"probe": "mega", "ok": True,
                  "platform": jax.devices()[0].platform}
     for name, fn in (
-        ("fixedpoint", jax.jit(bs.make_fixedpoint_cycle())),
-        ("grouped", jax.jit(
-            bs.make_grouped_cycle(2 * W // layout.n_groups))),
+        ("fixedpoint", jax.jit(
+            bs.make_fixedpoint_cycle(n_levels=n_levels))),
+        ("grouped", jax.jit(bs.make_grouped_cycle(
+            s_exact, unroll=4, n_levels=n_levels))),
     ):
         t0 = time.monotonic()
         out = fn(arrays, ga)

@@ -100,16 +100,26 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
 def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
     """Vectorized flavor assignment for every workload against the
     cycle-start usage (reference scheduler.go:629 nominate +
-    flavorassigner.go:946 findFlavorForPodSets)."""
+    flavorassigner.go:946 findFlavorForPodSets).
+
+    Flat [W,·] formulation: the per-workload fungibility scan is a
+    first-stop/argmax computation over the [W,K] preference axis, the
+    preemption-candidate prefilter reads per-cell minimum-priority-cut
+    aggregates precomputed once per cycle, and preference scores are small
+    int32 keys — no inner lax.scan and no [W,F,R,B] temporaries."""
     tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
     avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
     pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
+    w_iota = jnp.arange(w_n)
 
-    # Preemption-candidate prefilter: tree-level aggregates of "borrowing
-    # CQ with eligible admitted usage" per priority bucket, so the oracle's
-    # NoCandidates outcome resolves on device whenever zero candidates can
-    # exist (a sound subset of reference preemption_oracle.go outcomes; any
-    # possible candidate still routes to the host path).
+    # Preemption-candidate prefilter aggregates, once per cycle [N,F,R]:
+    # the minimum priority cut among buckets with same-CQ admitted usage
+    # (resolves policy thresholds by comparison) and the equivalent over
+    # "borrowing CQs elsewhere in this tree" counts. A sound subset of
+    # reference preemption_oracle.go outcomes; any possible candidate
+    # still routes to the host path.
     parent_or_self = jnp.where(
         tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent
     )
@@ -123,155 +133,156 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
     tree_count = jnp.zeros_like(contrib, dtype=jnp.int32).at[root_of].add(
         contrib.astype(jnp.int32), mode="drop"
     )  # indexed by root node id
+    cuts = arrays.prio_cuts  # i64[B] sorted ascending
+    _PINF = jnp.int64(1) << 62
+    has_same = arrays.usage_by_prio > 0  # [N,F,R,B]
+    same_mincut = jnp.min(
+        jnp.where(has_same, cuts, _PINF), axis=-1
+    )  # [N,F,R]
+    same_any = jnp.any(has_same, axis=-1)
+    has_other = (tree_count[root_of] - contrib.astype(jnp.int32)) > 0
+    other_mincut = jnp.min(jnp.where(has_other, cuts, _PINF), axis=-1)
+    other_any = jnp.any(has_other, axis=-1)
 
-    def per_workload(c, req, elig, start_k, active, prio):
-        # req: i64[R]; elig: bool[F].
-        f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
-        req_cell = jnp.broadcast_to(req[None, :], (f_n, r_n))
-        cell_active = (req[None, :] > 0) & arrays.covered[c][None, :]
+    # ---- per-cell modes/heights, [W,F,R] ----------------------------------
+    c = arrays.w_cq
+    req = arrays.w_req  # i64[W,R]
+    prio = arrays.w_priority
+    req_cell = jnp.broadcast_to(req[:, None, :], (w_n, f_n, r_n))
+    cell_active = (req[:, None, :] > 0) & arrays.covered[c][:, None, :]
 
-        avail_c = avail_all[c]
-        pot_c = pot_all[c]
-        height, proper = quota_ops.borrow_height(tree, usage, c, req_cell)
+    height, proper = jax.vmap(
+        lambda cc, rq: quota_ops.borrow_height(tree, usage, cc, rq)
+    )(c, req_cell)
 
-        no_fit = req_cell > pot_c
-        fit = req_cell <= avail_c
-        preempt_gate = (
-            (arrays.nominal_cq[c] >= req_cell)
-            | proper
-            | arrays.can_preempt_while_borrowing[c]
-        )
-        pmode_cell = jnp.where(
-            fit,
-            P_FIT,
-            jnp.where(
-                no_fit, P_NOFIT,
-                jnp.where(preempt_gate, P_PREEMPT_RAW, P_NOFIT),
-            ),
-        ).astype(jnp.int32)
-        # CQs that can never find preemption targets resolve the oracle on
-        # device: NoCandidates, borrow from the no-preemption fit search.
-        pmode_cell = jnp.where(
-            (pmode_cell == P_PREEMPT_RAW) & arrays.never_preempts[c],
-            P_NO_CANDIDATES,
-            pmode_cell,
-        )
-        # Prefilter: zero possible candidates -> exact NoCandidates.
-        cuts = arrays.prio_cuts
-        mask_lower = cuts < prio
-        mask_loweq = cuts <= prio
-
-        def bucket_elig(pol):
-            return jnp.where(
-                pol == 3,
-                jnp.ones_like(cuts, dtype=bool),
-                jnp.where(
-                    pol == 2, mask_loweq,
-                    jnp.where(pol == 1, mask_lower,
-                              jnp.zeros_like(cuts, dtype=bool)),
-                ),
-            )
-
-        same_elig = bucket_elig(arrays.policy_within[c])  # [B]
-        same_exists = jnp.any(
-            (arrays.usage_by_prio[c] > 0) & same_elig[None, None, :],
-            axis=-1,
-        )  # [F,R]
-        reclaim_elig = bucket_elig(arrays.policy_reclaim[c])
-        others = (
-            tree_count[root_of[c]] - contrib[c].astype(jnp.int32)
-        ) > 0  # [F,R,B]
-        cross_exists = jnp.any(others & reclaim_elig[None, None, :], axis=-1)
-        no_candidates = (
-            arrays.prefilter_valid & ~(same_exists | cross_exists)
-        )
-        pmode_cell = jnp.where(
-            (pmode_cell == P_PREEMPT_RAW) & no_candidates,
-            P_NO_CANDIDATES,
-            pmode_cell,
-        )
-        borrow_cell = height.astype(jnp.int32)
-
-        # Representative (worst) mode over active cells per flavor.
-        score_cell = _pref_score(
-            pmode_cell.astype(jnp.int64),
-            borrow_cell.astype(jnp.int64),
-            arrays.pref_preempt_over_borrow[c],
-        )
-        best_score_inactive = _pref_score(
-            jnp.int64(P_FIT), jnp.int64(0),
-            arrays.pref_preempt_over_borrow[c],
-        )
-        score_cell = jnp.where(cell_active, score_cell, best_score_inactive)
-        rep_idx = jnp.argmin(score_cell, axis=1)  # worst resource per flavor
-        f_iota = jnp.arange(f_n)
-        rep_pmode = pmode_cell[f_iota, rep_idx]
-        rep_borrow = borrow_cell[f_iota, rep_idx]
-        # A flavor failing taints/affinity is NOFIT outright
-        # (checkFlavorForPodSets precedes the quota loop).
-        rep_pmode = jnp.where(elig, rep_pmode, P_NOFIT)
-        rep_borrow = jnp.where(elig, rep_borrow, 0)
-        rep_score = _pref_score(
-            rep_pmode.astype(jnp.int64),
-            rep_borrow.astype(jnp.int64),
-            arrays.pref_preempt_over_borrow[c],
-        )
-
-        # Fungibility scan over the CQ's flavor preference order.
-        k_n = arrays.flavor_at.shape[1]
-
-        def body(carry, k):
-            (best_score, best_f, best_pm, best_bw, stopped, seen_praw, att,
-             praw_n, praw_stop, n_cons) = carry
-            k = k.astype(jnp.int32)
-            f = arrays.flavor_at[c, k]
-            pos_valid = (k < arrays.n_flavors[c]) & (k >= start_k)
-            pm = rep_pmode[f]
-            bw = rep_borrow[f]
-            sc = rep_score[f]
-            consider = pos_valid & ~stopped
-            att = jnp.where(consider, k, att)
-            is_praw = consider & (pm == P_PREEMPT_RAW)
-            seen_praw = seen_praw | is_praw
-            praw_n = praw_n + is_praw.astype(jnp.int32)
-            n_cons = n_cons + consider.astype(jnp.int32)
-
-            should_try_next = (
-                (pm == P_NOFIT)
-                | (pm == P_NO_CANDIDATES)
-                | ((pm == P_PREEMPT_RAW) & arrays.when_can_preempt_try_next[c])
-                | ((bw > 0) & arrays.when_can_borrow_try_next[c])
-            )
-            stop_here = consider & ~should_try_next
-            praw_stop = praw_stop | (stop_here & (pm == P_PREEMPT_RAW))
-            preferred = consider & (sc > best_score)
-            take = stop_here | (preferred & ~stop_here)
-            best_score = jnp.where(take, sc, best_score)
-            best_f = jnp.where(take, f, best_f)
-            best_pm = jnp.where(take, pm, best_pm)
-            best_bw = jnp.where(take, bw, best_bw)
-            stopped = stopped | stop_here
-            return (best_score, best_f, best_pm, best_bw, stopped, seen_praw,
-                    att, praw_n, praw_stop, n_cons), None
-
-        init = (
-            _NEG_INF, jnp.int32(-1), jnp.int32(P_NOFIT), jnp.int32(0),
-            jnp.bool_(False), jnp.bool_(False), jnp.int32(-1),
-            jnp.int32(0), jnp.bool_(False), jnp.int32(0),
-        )
-        (b_score, b_f, b_pm, b_bw, _stopped, seen_praw, att, praw_n,
-         praw_stop, n_cons), _ = jax.lax.scan(body, init, jnp.arange(k_n))
-        needs_host = (seen_praw | (b_pm == P_PREEMPT_RAW)) & active
-        tried = jnp.where(att == arrays.n_flavors[c] - 1, -1, att)
-        b_pm = jnp.where(active, b_pm, P_NOFIT)
-        return b_f, b_pm, b_bw, needs_host, tried, praw_n, praw_stop, n_cons
-
-    (chosen, pmode, borrow, needs_host, tried, praw_n, praw_stop,
-     n_cons) = jax.vmap(per_workload)(
-        arrays.w_cq, arrays.w_req, arrays.w_elig, arrays.w_start_flavor,
-        arrays.w_active, arrays.w_priority,
+    no_fit = req_cell > pot_all[c]
+    fit = req_cell <= avail_all[c]
+    preempt_gate = (
+        (arrays.nominal_cq[c] >= req_cell)
+        | proper
+        | arrays.can_preempt_while_borrowing[c][:, None, None]
     )
-    return NominateResult(chosen, pmode, borrow, needs_host, tried,
+    pmode_cell = jnp.where(
+        fit,
+        P_FIT,
+        jnp.where(
+            no_fit, P_NOFIT,
+            jnp.where(preempt_gate, P_PREEMPT_RAW, P_NOFIT),
+        ),
+    ).astype(jnp.int32)
+    # CQs that can never find preemption targets resolve the oracle on
+    # device: NoCandidates, borrow from the no-preemption fit search.
+    pmode_cell = jnp.where(
+        (pmode_cell == P_PREEMPT_RAW)
+        & arrays.never_preempts[c][:, None, None],
+        P_NO_CANDIDATES,
+        pmode_cell,
+    )
+
+    def exists(pol, mincut, anyb):
+        # pol: i32[W]; mincut/anyb: [W,F,R]. Policy codes as in encode.
+        p = pol[:, None, None]
+        return jnp.where(
+            p == 3, anyb,
+            jnp.where(
+                p == 2, mincut <= prio[:, None, None],
+                jnp.where(p == 1, mincut < prio[:, None, None], False),
+            ),
+        )
+
+    same_exists = exists(arrays.policy_within[c], same_mincut[c],
+                         same_any[c])
+    cross_exists = exists(arrays.policy_reclaim[c], other_mincut[c],
+                          other_any[c])
+    no_candidates = arrays.prefilter_valid & ~(same_exists | cross_exists)
+    pmode_cell = jnp.where(
+        (pmode_cell == P_PREEMPT_RAW) & no_candidates,
+        P_NO_CANDIDATES,
+        pmode_cell,
+    )
+    borrow_cell = height.astype(jnp.int32)
+
+    # ---- representative (worst) cell per flavor, small-int scores --------
+    # Lexicographic (mode, borrow) preference as an int32 key: borrow
+    # heights are bounded by MAX_DEPTH, so 16 separates the components.
+    _SNEG = jnp.int32(-(1 << 30))
+    pob = arrays.pref_preempt_over_borrow[c][:, None, None]
+
+    def score_of(pm, bw):
+        s = jnp.where(pob, -bw * 16 + pm, pm * 16 - bw)
+        return jnp.where(pm == P_NOFIT, _SNEG, s).astype(jnp.int32)
+
+    score_cell = score_of(pmode_cell, borrow_cell)
+    best_inactive = jnp.where(pob, jnp.int32(P_FIT), jnp.int32(P_FIT * 16))
+    score_cell = jnp.where(cell_active, score_cell,
+                           jnp.broadcast_to(best_inactive, score_cell.shape))
+    rep_idx = jnp.argmin(score_cell, axis=2)  # [W,F] worst resource
+    f_iota = jnp.arange(f_n)
+    rep_pmode = pmode_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+    rep_borrow = borrow_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+    # A flavor failing taints/affinity is NOFIT outright
+    # (checkFlavorForPodSets precedes the quota loop).
+    rep_pmode = jnp.where(arrays.w_elig, rep_pmode, P_NOFIT)
+    rep_borrow = jnp.where(arrays.w_elig, rep_borrow, 0)
+    pob_w = arrays.pref_preempt_over_borrow[c][:, None]
+    rep_score = jnp.where(
+        pob_w, -rep_borrow * 16 + rep_pmode, rep_pmode * 16 - rep_borrow
+    )
+    rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
+
+    # ---- fungibility scan as first-stop/argmax over [W,K] ----------------
+    k_n = arrays.flavor_at.shape[1]
+    k_iota = jnp.arange(k_n, dtype=jnp.int32)
+    f_k = arrays.flavor_at[c]  # [W,K]
+    pos_valid = (
+        (k_iota[None, :] < arrays.n_flavors[c][:, None])
+        & (k_iota[None, :] >= arrays.w_start_flavor[:, None])
+    )
+    pm_k = rep_pmode[w_iota[:, None], f_k]
+    bw_k = rep_borrow[w_iota[:, None], f_k]
+    sc_k = rep_score[w_iota[:, None], f_k]
+    should_try_next = (
+        (pm_k == P_NOFIT)
+        | (pm_k == P_NO_CANDIDATES)
+        | ((pm_k == P_PREEMPT_RAW)
+           & arrays.when_can_preempt_try_next[c][:, None])
+        | ((bw_k > 0) & arrays.when_can_borrow_try_next[c][:, None])
+    )
+    stop_k = pos_valid & ~should_try_next
+    any_stop = jnp.any(stop_k, axis=1)
+    kstop = jnp.where(
+        any_stop, jnp.argmax(stop_k, axis=1).astype(jnp.int32),
+        jnp.int32(k_n),
+    )
+    considered = pos_valid & (k_iota[None, :] <= kstop[:, None])
+    n_cons = jnp.sum(considered, axis=1).astype(jnp.int32)
+    att = jnp.max(
+        jnp.where(considered, k_iota[None, :], -1), axis=1
+    ).astype(jnp.int32)
+    is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
+    praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
+    seen_praw = praw_n > 0
+    kstop_c = jnp.clip(kstop, 0, k_n - 1)
+    praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
+
+    # Best-scoring considered flavor, first occurrence winning ties (the
+    # host scan's strict-> update); a stop takes its own flavor outright.
+    sc_masked = jnp.where(considered, sc_k, _SNEG)
+    k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
+    none_considered = ~jnp.any(considered & (sc_k > _SNEG), axis=1)
+    k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
+    b_f = jnp.where(none_considered & ~any_stop, -1,
+                    f_k[w_iota, k_take])
+    b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
+                     pm_k[w_iota, k_take])
+    b_bw = jnp.where(none_considered & ~any_stop, 0,
+                     bw_k[w_iota, k_take])
+
+    needs_host = (seen_praw | (b_pm == P_PREEMPT_RAW)) & arrays.w_active
+    tried = jnp.where(att == arrays.n_flavors[c] - 1, -1, att)
+    b_pm = jnp.where(arrays.w_active, b_pm, P_NOFIT)
+    return NominateResult(b_f.astype(jnp.int32), b_pm.astype(jnp.int32),
+                          b_bw.astype(jnp.int32), needs_host, tried,
                           praw_n, praw_stop, n_cons)
 
 
@@ -281,6 +292,20 @@ def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
     to the end."""
     w = arrays.w_cq.shape[0]
     borrows = jnp.where(nom.best_pmode > P_NOFIT, nom.best_borrow, 0)
+    if getattr(arrays, "w_order_rank", None) is not None:
+        # Host-precomputed (priority desc, timestamp, submission) rank:
+        # fold the dynamic keys on top into ONE composite int64 and sort
+        # once instead of five stable passes. Keys are unique (the rank
+        # is a permutation), so an unstable sort is exact.
+        key = (
+            (~arrays.w_active).astype(jnp.int64) * (jnp.int64(1) << 40)
+            + (~arrays.w_quota_reserved).astype(jnp.int64)
+            * (jnp.int64(1) << 39)
+            + jnp.clip(borrows, 0, 127).astype(jnp.int64)
+            * (jnp.int64(1) << 32)
+            + arrays.w_order_rank.astype(jnp.int64)
+        )
+        return jnp.argsort(key).astype(jnp.int32)
     # Least-significant key first; each pass is a stable argsort applied on
     # top of the previous permutation (equivalent to lexsort, but compiles
     # to simple single-key sorts). Submission-index tiebreak is implicit in
@@ -466,8 +491,14 @@ def admit_scan_grouped(
     adm=None,
     targets=None,
     unroll: int = 2,
+    n_levels: int = MAX_DEPTH + 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forest-parallel admission scan.
+
+    ``n_levels`` statically bounds the ancestor-chain walk (callers pass
+    the forest's true max depth + 1; levels past the root are repeats and
+    carry no information, so truncating them shrinks every per-step
+    tensor).
 
     Cohort trees share no quota cells, so sequential consistency is only
     required *within* a tree. Entries are bucketed per tree (group) in
@@ -551,8 +582,8 @@ def admit_scan_grouped(
         f = nom.chosen_flavor[w]
         pm = nom.best_pmode[w]
         c_local = ga.flat_to_local[c]
-        chain = ga.chain_local[g_iota, c_local]  # [G,D+1]
-        is_repeat = chain_is_repeat[g_iota, c_local]  # [G,D+1]
+        chain = ga.chain_local[g_iota, c_local][:, :n_levels]  # [G,L]
+        is_repeat = chain_is_repeat[g_iota, c_local][:, :n_levels]
 
         req = arrays.w_req[w]  # [G,R]
         # All of a step's quota math lives on the entry's single chosen
@@ -590,7 +621,7 @@ def admit_scan_grouped(
             au_f = usage_by_f[fcl]  # [G,A,R]
             chain_flat = ga.node_sel[gi, chain]  # [G,D+1] flat node ids
             rem_levels = []
-            for i in range(MAX_DEPTH + 1):
+            for i in range(n_levels):
                 on_chain = in_sub[chain_flat[:, i]][:, adm.cq]  # [G,A]
                 mask_i = (use_vict & on_chain).astype(jnp.int64)
                 rem_levels.append(jnp.einsum("ga,gar->gr", mask_i, au_f))
@@ -605,8 +636,8 @@ def admit_scan_grouped(
         l_avail_fit = jnp.maximum(0, sat_sub(lq, u_fit))
         used_in_parent_fit = jnp.maximum(0, sat_sub(u_fit, lq))
         with_max_fit = sat_add(sat_sub(stored, used_in_parent_fit), bl)
-        avail = sat_sub(subtree[:, MAX_DEPTH], u_fit[:, MAX_DEPTH])
-        for i in range(MAX_DEPTH - 1, -1, -1):
+        avail = sat_sub(subtree[:, n_levels - 1], u_fit[:, n_levels - 1])
+        for i in range(n_levels - 2, -1, -1):
             clamped = jnp.where(
                 has_bl[:, i], jnp.minimum(with_max_fit[:, i], avail), avail
             )
@@ -676,11 +707,11 @@ def admit_scan_grouped(
             delta,
             jnp.where(do_reserve[:, None], reserve, 0),
         )
-        deltas = jnp.zeros((g_n, MAX_DEPTH + 1, r_n), dtype=jnp.int64)
+        deltas = jnp.zeros((g_n, n_levels, r_n), dtype=jnp.int64)
         cur = applied
-        for i in range(MAX_DEPTH + 1):
+        for i in range(n_levels):
             deltas = deltas.at[:, i].set(cur)
-            cont = (~is_repeat[:, i, None]) if i < MAX_DEPTH else False
+            cont = (~is_repeat[:, i, None]) if i < n_levels - 1 else False
             cur = jnp.where(
                 cont, jnp.maximum(0, sat_sub(cur, l_avail[:, i])), 0
             )
@@ -736,7 +767,7 @@ def admit_scan_grouped(
 
 
 def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
-                       unroll: int = 2):
+                       unroll: int = 2, n_levels: int = MAX_DEPTH + 1):
     """Build a jittable grouped cycle; s_max=0 means exact (W slots).
 
     With ``preempt=True`` the cycle takes a third AdmittedArrays argument
@@ -795,7 +826,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             final_usage, admitted, preempting = admit_scan_grouped(
-                arrays, ga, nom, usage, order, s, unroll=unroll
+                arrays, ga, nom, usage, order, s, unroll=unroll,
+                n_levels=n_levels,
             )
             return finish(arrays, nom, final_usage, admitted, preempting,
                           order)
@@ -927,7 +959,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         final_usage, admitted, preempting = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
-            unroll=unroll,
+            unroll=unroll, n_levels=n_levels,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
                       victims=tgt.victims, variant=tgt.variant)
@@ -1005,6 +1037,7 @@ def admit_fixedpoint(
     usage: jnp.ndarray,
     order: jnp.ndarray,
     max_rounds: int = 64,
+    n_levels: int = MAX_DEPTH + 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Order-exact admission equivalent to admit_scan_grouped, computed in
     O(rounds) fully-vectorized passes; also returns the rounds taken.
@@ -1021,9 +1054,9 @@ def admit_fixedpoint(
     )
     parent = jnp.where(tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent)
     chain_cols = [arrays.w_cq.astype(jnp.int32)]
-    for _ in range(MAX_DEPTH):
+    for _ in range(n_levels - 1):
         chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
-    chains = jnp.stack(chain_cols, axis=1)  # [W, D+1] flat node ids
+    chains = jnp.stack(chain_cols, axis=1)  # [W, L] flat node ids
     is_root = tree.parent[chains] < 0  # [W, D+1]
 
     # Constraint term per chain node: T_b - base_usage_b (or +inf).
@@ -1070,7 +1103,7 @@ def admit_fixedpoint(
     perms = []
     heads = []
     inv_perms = []
-    for d in range(MAX_DEPTH + 1):
+    for d in range(n_levels):
         seg_id = chains[:, d].astype(jnp.int64) * f_n + fcl
         key = seg_id * (w_n + 1) + rank
         perm = jnp.argsort(key)
@@ -1090,7 +1123,7 @@ def admit_fixedpoint(
         entry, given per-entry finalized/assumed plane contributions
         [W,R]."""
         avail = jnp.full((w_n, r_n), _INF64, dtype=jnp.int64)
-        for d in range(MAX_DEPTH + 1):
+        for d in range(n_levels):
             perm, head, inv = perms[d], heads[d], inv_perms[d]
             pre = _seg_excl_prefix(contrib[perm], head)[inv]
             term = sat_sub(slack0_chain[:, d], pre)
@@ -1176,7 +1209,7 @@ def admit_fixedpoint(
     # Final usage: base + all finalized contributions bubbled to ancestors.
     contrib = jnp.where(admitted[:, None], delta, 0) + reserved
     final_usage = usage
-    for d in range(MAX_DEPTH + 1):
+    for d in range(n_levels):
         add_d = jnp.zeros_like(usage)
         # Scatter each entry's contribution at its chain-d node (on its
         # flavor plane); repeated roots would double-count, so mask repeats.
@@ -1188,7 +1221,8 @@ def admit_fixedpoint(
     return final_usage, admitted, rounds
 
 
-def make_fixedpoint_cycle(max_rounds: int = 64):
+def make_fixedpoint_cycle(max_rounds: int = 64,
+                          n_levels: int = MAX_DEPTH + 1):
     """Grouped-cycle equivalent using the fixed-point admission pass.
     Exact iff the tree has no lending limits AND max_rounds suffices (the
     driver checks the former; rounds cap is a safety net far above any
@@ -1199,7 +1233,7 @@ def make_fixedpoint_cycle(max_rounds: int = 64):
         nom = nominate(arrays, usage)
         order = admission_order(arrays, nom)
         final_usage, admitted, _rounds = admit_fixedpoint(
-            arrays, ga, nom, usage, order, max_rounds
+            arrays, ga, nom, usage, order, max_rounds, n_levels=n_levels
         )
         outcome = jnp.where(
             ~arrays.w_active,

@@ -38,7 +38,8 @@ class SimOutputs(NamedTuple):
 
 
 def make_sim_loop(s_max: int, max_rounds: int = 100000,
-                  kernel: str = "grouped"):
+                  kernel: str = "grouped",
+                  n_levels: int = quota_ops.MAX_DEPTH + 1):
     """Build the jittable simulator. ``s_max`` is the per-tree admission
     scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
     admission pass: "grouped" (the sequential per-tree scan) or
@@ -101,11 +102,11 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             order = bs.admission_order(a, nom)
             if kernel == "fixedpoint":
                 _u, admit, _r = bs.admit_fixedpoint(
-                    a, ga, nom, usage, order
+                    a, ga, nom, usage, order, n_levels=n_levels
                 )
             else:
                 _u, admit, _pre = bs.admit_scan_grouped(
-                    a, ga, nom, usage, order, s_max
+                    a, ga, nom, usage, order, s_max, n_levels=n_levels
                 )
 
             newly = admit & pending

@@ -20,7 +20,8 @@ import jax
 if os.environ.get("KUEUE_TPU_TEST_ON_TPU", "") != "1":
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-# Persistent compilation cache: the batched kernels (esp. the while_loop
-# simulator) take minutes to compile; cache them across test processes.
-jax.config.update("jax_compilation_cache_dir", "/tmp/kueue_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# Persistent compilation cache: disabled — this jaxlib intermittently
+# SEGFAULTS inside PJRT executable.serialize() on the cache-write path
+# (observed repeatedly killing whole pytest runs). The in-process cache
+# still covers repeated jits within one run.
+jax.config.update("jax_enable_compilation_cache", False)

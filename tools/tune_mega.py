@@ -48,15 +48,16 @@ def main():
     log(f"platform={jax.devices()[0].platform} groups={layout.n_groups} "
         f"s_exact={s_exact} s_conservative={s_cons}")
 
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
     configs = []
     for unroll in (2, 4, 8):
         configs.append((f"exact_u{unroll}",
-                        lambda u=unroll: jax.jit(
-                            bs.make_grouped_cycle(s_exact, unroll=u))))
+                        lambda u=unroll: jax.jit(bs.make_grouped_cycle(
+                            s_exact, unroll=u, n_levels=n_levels))))
     configs.append(("cons_u2",
                     lambda: jax.jit(bs.make_grouped_cycle(s_cons))))
     configs.append(("fixedpoint", lambda: jax.jit(
-        bs.make_fixedpoint_cycle())))
+        bs.make_fixedpoint_cycle(n_levels=n_levels))))
     if args.configs:
         want = set(args.configs.split(","))
         configs = [(n, f) for n, f in configs if n in want]
