@@ -97,7 +97,8 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
     return jnp.where(pmode == P_NOFIT, _NEG_INF, score)
 
 
-def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
+def nominate(arrays: CycleArrays, usage: jnp.ndarray,
+             n_levels: int = MAX_DEPTH + 1) -> NominateResult:
     """Vectorized flavor assignment for every workload against the
     cycle-start usage (reference scheduler.go:629 nominate +
     flavorassigner.go:946 findFlavorForPodSets).
@@ -152,7 +153,9 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray) -> NominateResult:
     cell_active = (req[:, None, :] > 0) & arrays.covered[c][:, None, :]
 
     height, proper = jax.vmap(
-        lambda cc, rq: quota_ops.borrow_height(tree, usage, cc, rq)
+        lambda cc, rq: quota_ops.borrow_height(
+            tree, usage, cc, rq, n_levels=n_levels
+        )
     )(c, req_cell)
 
     no_fit = req_cell > pot_all[c]
@@ -822,7 +825,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
     if not preempt:
         def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
             usage = arrays.usage
-            nom = nominate(arrays, usage)
+            nom = nominate(arrays, usage, n_levels=n_levels)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             final_usage, admitted, preempting = admit_scan_grouped(
@@ -839,7 +842,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
     def impl_preempt(arrays: CycleArrays, ga: GroupArrays,
                      adm) -> CycleOutputs:
         usage = arrays.usage
-        nom = nominate(arrays, usage)
+        nom = nominate(arrays, usage, n_levels=n_levels)
 
         # Device TAS hook (flavorassigner.go:796-835 order): feasibility of
         # the chosen flavor's topology placement downgrades Fit->Preempt;
@@ -1230,7 +1233,7 @@ def make_fixedpoint_cycle(max_rounds: int = 64,
 
     def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
         usage = arrays.usage
-        nom = nominate(arrays, usage)
+        nom = nominate(arrays, usage, n_levels=n_levels)
         order = admission_order(arrays, nom)
         final_usage, admitted, _rounds = admit_fixedpoint(
             arrays, ga, nom, usage, order, max_rounds, n_levels=n_levels

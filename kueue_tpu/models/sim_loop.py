@@ -98,7 +98,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
 
             usage = recompute_usage(running, chosen_flavor)
             a = arrays._replace(w_active=pending, usage=usage)
-            nom = bs.nominate(a, usage)
+            nom = bs.nominate(a, usage, n_levels=n_levels)
             order = bs.admission_order(a, nom)
             if kernel == "fixedpoint":
                 _u, admit, _r = bs.admit_fixedpoint(

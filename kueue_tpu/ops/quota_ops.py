@@ -271,6 +271,7 @@ def borrow_height(
     usage: jnp.ndarray,
     cq: jnp.ndarray,
     fr_val: jnp.ndarray,
+    n_levels: int = MAX_DEPTH + 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """FindHeightOfLowestSubtreeThatFits, batched over [F, R]
     (reference hierarchical_preemption.go:221).
@@ -300,8 +301,8 @@ def borrow_height(
     done = done | fits_here
 
     remaining = sat_sub(fr_val, l_avail[cq])
-    root_height = tree.height[chain[MAX_DEPTH]]
-    for i in range(1, MAX_DEPTH + 1):
+    root_height = tree.height[chain[min(n_levels - 1, MAX_DEPTH)]]
+    for i in range(1, n_levels):
         idx = chain[i]
         is_real = idx != chain[i - 1]  # chain pads by repeating the root
         borrowing = sat_add(usage[idx], remaining) > tree.subtree_quota[idx]
