@@ -916,8 +916,22 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             & (nom.praw_count == 1)
             & ~arrays.w_has_gates
         )
+        base_hier = base_elig
         if arrays.w_tas is not None:
-            base_elig = base_elig & ~arrays.w_tas
+            # TAS entries may use the flat kernel's tas_fits-aware search
+            # when the tree's admitted TAS usage is device-representable
+            # and the preempt mode came from nominate (a Fit->Preempt TAS
+            # downgrade re-enters the host fungibility scan instead).
+            tas_allowed = jnp.zeros_like(base_elig)
+            if (arrays.tas_topo is not None
+                    and arrays.preempt_tas_ok is not None):
+                tas_allowed = (
+                    arrays.w_tas
+                    & arrays.preempt_tas_ok[arrays.w_cq]
+                    & ~downgrade
+                )
+            base_elig = base_elig & (~arrays.w_tas | tas_allowed)
+            base_hier = base_hier & ~arrays.w_tas
         elig = base_elig & arrays.preempt_simple[arrays.w_cq]
         tgt = preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
@@ -929,7 +943,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             # field entirely when no such tree exists this cycle.
             from kueue_tpu.models.preempt_kernel import hier_targets
 
-            elig_h = base_elig & arrays.preempt_hier[arrays.w_cq]
+            elig_h = base_hier & arrays.preempt_hier[arrays.w_cq]
             tgt_h = hier_targets(
                 arrays, adm, nom.chosen_flavor, elig_h, nom.praw_stop,
                 nom.considered,

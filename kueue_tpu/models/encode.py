@@ -79,6 +79,9 @@ class CycleArrays(NamedTuple):
     # CQ is in a *nested* no-lending-limit tree with device-representable
     # admitted usage: the hierarchical victim-search kernel applies.
     preempt_hier: Optional[jnp.ndarray] = None  # bool[N]
+    # CQ's tree has fully device-representable admitted TAS usage: the
+    # victim search may run its tas_fits probe on device for TAS entries.
+    preempt_tas_ok: Optional[jnp.ndarray] = None  # bool[N]
     w_has_gates: Optional[jnp.ndarray] = None  # bool[W] preemptionGates open
     # -- device TAS (None when no TAS flavor is device-encoded) --
     tas_topo: Optional[object] = None  # ops.tas_place.TASDeviceTopo
@@ -122,6 +125,7 @@ class CycleIndex:
     tas_flavor_names: List[str] = field(default_factory=list)
     tas_snapshots: List[object] = field(default_factory=list)
     tas_leaf_perm: List[List[int]] = field(default_factory=list)
+    tas_pad_shape: Tuple[int, int] = (0, 0)  # (D, R+1) padded axes
 
 
 def _round_up(n: int, m: int) -> int:
@@ -371,26 +375,31 @@ def encode_cycle(
     root_merge = None
     fair_node_ok = None
     if preempt:
-        preempt_simple, preempt_hier, fair_node_ok = _encode_admitted(
-            snapshot, tidx, tree, idx, fair_sharing
-        )
-        preempt_fields = dict(
-            bwc_policy=jnp.asarray(bwc_policy),
-            bwc_threshold=jnp.asarray(bwc_threshold),
-            bwc_has_threshold=jnp.asarray(bwc_has_threshold),
-            preempt_simple=jnp.asarray(preempt_simple),
-            w_has_gates=jnp.asarray(w_gates),
-        )
-        if preempt_hier.any():
-            # Omitted (None) when no nested lend-free tree exists, so the
-            # common flat-only cycle compiles without the hier kernel.
-            preempt_fields["preempt_hier"] = jnp.asarray(preempt_hier)
+        # TAS encoding first: _encode_admitted reuses its snapshots/leaf
+        # permutations to express admitted workloads' TAS usage on the
+        # device topologies (victim-release modeling in the preempt
+        # kernel's tas_fits probe).
         if tas_device_flavors:
             tas_fields, root_merge = _encode_tas(
                 snapshot, tidx, idx, device_wls, w, tas_device_flavors,
                 np.asarray(tree.parent),
             )
             preempt_fields.update(tas_fields)
+        preempt_simple, preempt_hier, fair_node_ok, preempt_tas_ok = \
+            _encode_admitted(snapshot, tidx, tree, idx, fair_sharing)
+        preempt_fields.update(
+            bwc_policy=jnp.asarray(bwc_policy),
+            bwc_threshold=jnp.asarray(bwc_threshold),
+            bwc_has_threshold=jnp.asarray(bwc_has_threshold),
+            preempt_simple=jnp.asarray(preempt_simple),
+            w_has_gates=jnp.asarray(w_gates),
+        )
+        if tas_device_flavors:
+            preempt_fields["preempt_tas_ok"] = jnp.asarray(preempt_tas_ok)
+        if preempt_hier.any():
+            # Omitted (None) when no nested lend-free tree exists, so the
+            # common flat-only cycle compiles without the hier kernel.
+            preempt_fields["preempt_hier"] = jnp.asarray(preempt_hier)
     if fair_sharing:
         from kueue_tpu.utils import features as _features
 
@@ -485,6 +494,7 @@ def _encode_tas(
     d_n = topo.leaf_cap.shape[1]
     r1 = topo.leaf_cap.shape[2]  # cycle resources + implicit pods column
     r_cy = r1 - 1
+    idx.tas_pad_shape = (d_n, r1)
 
     usage0 = np.zeros((t_n, d_n, r1), np.int64)
     for t, tas in enumerate(tas_snaps):
@@ -683,6 +693,18 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
     uid_rank_of = {u: i for i, u in enumerate(uids)}
     a_uid = np.zeros(a, dtype=np.int32)
 
+    # Admitted TAS usage on device topologies (victim release modeling in
+    # the preempt kernel's tas_fits probe). Axis layout matches tas_usage0
+    # ([T, D, R+1], same leaf permutation, same implicit-pods mirroring).
+    t_n = len(idx.tas_flavor_names)
+    tas_root_ok = np.ones(n, dtype=bool)
+    a_tas_t = np.full(a, -1, dtype=np.int32)
+    a_tas_usage = None
+    tas_row_of = {name: t for t, name in enumerate(idx.tas_flavor_names)}
+    if t_n:
+        d_n, r1 = idx.tas_pad_shape
+        a_tas_usage = np.zeros((a, d_n, r1), np.int64)
+
     for i, info in enumerate(infos):
         ni = tidx.node_of[info.cluster_queue]
         a_cq[i] = ni
@@ -703,6 +725,35 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
                 root_fair_ok[root_of[ni]] = False
             else:
                 a_usage[i, fi2, ri2] = v2
+        if t_n:
+            rows = [
+                tas_row_of[f] for f in info.tas_usage() if f in tas_row_of
+            ]
+            if len(rows) > 1:
+                # Multi-topology victims: release modeling out of scope.
+                tas_root_ok[root_of[ni]] = False
+            elif rows:
+                t = rows[0]
+                tas = idx.tas_snapshots[t]
+                inv = {
+                    hi: j for j, hi in enumerate(idx.tas_leaf_perm[t])
+                }
+                a_tas_t[i] = t
+                flavor = idx.tas_flavor_names[t]
+                for leaf_id, used in info.tas_usage()[flavor].items():
+                    hi = tas._leaf_index.get(
+                        tas._canonical_leaf_id(leaf_id)
+                    )
+                    j = inv.get(hi) if hi is not None else None
+                    if j is None:
+                        tas_root_ok[root_of[ni]] = False
+                        continue
+                    for res, v in used.items():
+                        ci = tidx.resource_of.get(res)
+                        if ci is not None:
+                            a_tas_usage[i, j, ci] += v
+                        if res == "pods":
+                            a_tas_usage[i, j, r1 - 1] += v
 
     preempt_simple = np.zeros(n, dtype=bool)
     preempt_hier = np.zeros(n, dtype=bool)
@@ -720,6 +771,11 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
             ni = tidx.node_of[name]
             fair_node_ok[ni] = root_fair_ok[root_of[ni]]
 
+    preempt_tas_ok = np.zeros(n, dtype=bool)
+    for name in snapshot.cluster_queues:
+        ni = tidx.node_of[name]
+        preempt_tas_ok[ni] = tas_root_ok[root_of[ni]]
+
     idx.admitted_arrays = AdmittedArrays(
         cq=jnp.asarray(a_cq),
         usage=jnp.asarray(a_usage),
@@ -729,8 +785,10 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
         evicted=jnp.asarray(a_evicted),
         active=jnp.asarray(a_active),
         uid_rank=jnp.asarray(a_uid),
+        tas_t=jnp.asarray(a_tas_t) if t_n else None,
+        tas_usage=jnp.asarray(a_tas_usage) if t_n else None,
     )
-    return preempt_simple, preempt_hier, fair_node_ok
+    return preempt_simple, preempt_hier, fair_node_ok, preempt_tas_ok
 
 
 def _device_compatible(

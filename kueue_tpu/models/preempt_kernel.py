@@ -63,6 +63,10 @@ class AdmittedArrays(NamedTuple):
     evicted: jnp.ndarray  # bool[A]
     active: jnp.ndarray  # bool[A] (padding = False)
     uid_rank: jnp.ndarray  # i32[A] UID sort rank (final ordering tiebreak)
+    # Admitted TAS usage on device topologies (None when no device TAS):
+    # removal of victim a releases tas_usage[a] on topology row tas_t[a].
+    tas_t: jnp.ndarray = None  # i32[A] topo row (-1 = not TAS / host topo)
+    tas_usage: jnp.ndarray = None  # i64[A, D, R+1] per-leaf usage
 
 
 class PreemptTargets(NamedTuple):
@@ -102,7 +106,14 @@ def preempt_targets(
     considered: jnp.ndarray,  # i32[W] flavors considered by the scan
 ) -> PreemptTargets:
     """Victim selection for every eligible entry at once, against the
-    cycle-start usage (matching the host's nomination-phase get_targets)."""
+    cycle-start usage (matching the host's nomination-phase get_targets).
+
+    TAS entries (when the encoder's ``preempt_tas_ok`` gate admits them)
+    run the same search with the host's tas_fits probe folded in
+    (preemption.go:637): victim removal releases per-leaf topology usage,
+    and — placement feasibility being monotone in the removal prefix —
+    the placement threshold is found by binary search over the ordered
+    candidate prefix instead of a per-candidate probe."""
     tree = arrays.tree
     usage = arrays.usage
     sq = tree.subtree_quota
@@ -119,7 +130,46 @@ def preempt_targets(
     r_n = tree.nominal.shape[2]
     a_iota = jnp.arange(a_n)
 
-    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered):
+    with_tas = (
+        getattr(arrays, "tas_topo", None) is not None
+        and adm.tas_t is not None
+    )
+    if with_tas:
+        from kueue_tpu.ops import tas_place as _tas_place
+
+        w_n = arrays.w_cq.shape[0]
+        w_iota = jnp.arange(w_n)
+        f_all = arrays.w_elig.shape[1]
+        t_of_w = jnp.where(
+            chosen_flavor >= 0,
+            arrays.tas_of_flavor[jnp.clip(chosen_flavor, 0, f_all - 1)],
+            -1,
+        )
+        t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
+        tas_in = dict(
+            do_tas=arrays.w_tas & (t_of_w >= 0),
+            t_row=t_idx_w,
+            t_req=arrays.w_tas_req,
+            t_cnt=arrays.w_tas_count,
+            t_ssz=arrays.w_tas_slice_size,
+            t_sl=jnp.maximum(
+                arrays.w_tas_slice_level[w_iota, t_idx_w], 0
+            ),
+            t_rl=jnp.maximum(arrays.w_tas_req_level[w_iota, t_idx_w], 0),
+            t_rq=arrays.w_tas_required,
+            t_un=arrays.w_tas_unconstrained,
+        )
+    else:
+        zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
+        tas_in = dict(
+            do_tas=zw.astype(bool), t_row=zw.astype(jnp.int32),
+            t_req=zw[:, None], t_cnt=zw, t_ssz=zw,
+            t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
+            t_rq=zw.astype(bool), t_un=zw.astype(bool),
+        )
+
+    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
+              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -158,10 +208,12 @@ def preempt_targets(
             sq_c,
         )  # [R]
 
-        def search(active_req, contested, req_vec):
+        def search(active_req, contested, req_vec, tas_probe=False):
             """One classical search (preemption.go:296): requests =
             req_vec over active_req cells, contested cells needing
-            preemption. Returns (success, victims[A])."""
+            preemption. Returns (success, victims[A]). With ``tas_probe``
+            the host's tas_fits placement check gates the stop point and
+            the fill-back (preemption.go:637)."""
             uses = jnp.any(contested[None, :] & (au > 0), axis=1)
             # Cross-CQ collection gate: candidate CQ not within nominal in
             # the contested cells (hierarchical_preemption.go:176).
@@ -271,7 +323,49 @@ def preempt_targets(
                     jnp.where(same_g[:, None], cg, 0), axis=0
                 )
                 fits_k = fits_with(cum_same, cum_all, borrow_b)  # [A]
-                hit = rg & fits_k
+
+                if tas_probe:
+                    # Placement threshold: smallest removal-prefix length
+                    # after which the entry places on its topology (the
+                    # released victim usage only grows along the prefix,
+                    # so feasibility is monotone — binary search). ``pos``
+                    # from the enclosing search() is the ord_-position map.
+                    pos_of = pos
+                    rel_mask = removal & (adm.tas_t == t_row)
+                    tas0_row = arrays.tas_usage0[t_row]  # [D,R1]
+
+                    def tas_state(k):
+                        wgt = (rel_mask & (pos_of <= k)).astype(jnp.int64)
+                        rel = jnp.einsum("a,adr->dr", wgt, adm.tas_usage)
+                        return tas0_row - rel
+
+                    def feas(state):
+                        return _tas_place.feasible_only(
+                            arrays.tas_topo, t_row, state, t_req, t_cnt,
+                            t_ssz, t_sl, t_rl, t_rq, t_un,
+                        )
+
+                    def bisect(_, st):
+                        lo, hi = st
+                        mid = (lo + hi) // 2
+                        ok = feas(tas_state(mid))
+                        go = lo < hi
+                        hi = jnp.where(go & ok, mid, hi)
+                        lo = jnp.where(go & ~ok, mid + 1, lo)
+                        return lo, hi
+
+                    # Lower bound over k in [-1, a_n-1], sentinel a_n =
+                    # never feasible; fori_loop so the placement probe
+                    # traces once, not once per bisection step.
+                    steps = max(a_n + 1, 1).bit_length() + 1
+                    kt, _hi = jax.lax.fori_loop(
+                        0, steps, bisect,
+                        (jnp.int32(-1), jnp.int32(a_n)),
+                    )
+                    kt = jnp.where(do_tas, kt, jnp.int32(-1))
+                    hit = rg & fits_k & (a_iota >= kt)
+                else:
+                    hit = rg & fits_k
                 success = jnp.any(hit)
                 k_star = jnp.argmax(hit).astype(jnp.int32)
                 pre = rg & (a_iota <= k_star)
@@ -281,20 +375,47 @@ def preempt_targets(
                 # no-longer-needed one.
                 s_same0 = cum_same[k_star]
                 s_all0 = cum_all[k_star]
-
-                def fb(carry, xs):
-                    s_s, s_a = carry
-                    is_t, c_p, is_same_p = xs
-                    t_s = s_s - jnp.where(is_same_p, c_p, 0)
-                    t_a = s_a - c_p
-                    drop = is_t & fits_with(t_s, t_a, borrow_b)
-                    s_s = jnp.where(drop, t_s, s_s)
-                    s_a = jnp.where(drop, t_a, s_a)
-                    return (s_s, s_a), drop
-
                 fb_mask = pre & (a_iota < k_star)
-                xs = (fb_mask[::-1], cg[::-1], same_g[::-1])
-                _, drops_rev = jax.lax.scan(fb, (s_same0, s_all0), xs)
+
+                if tas_probe:
+                    t_state0 = tas_state(k_star)
+                    rel_g = rel_mask[ord_]
+
+                    def fb(carry, xs):
+                        s_s, s_a, t_state = carry
+                        is_t, c_p, is_same_p, a_p, rel_p = xs
+                        t_s = s_s - jnp.where(is_same_p, c_p, 0)
+                        t_a = s_a - c_p
+                        t_try = t_state + jnp.where(
+                            rel_p, adm.tas_usage[a_p], 0
+                        )
+                        ok = fits_with(t_s, t_a, borrow_b) & (
+                            ~do_tas | feas(t_try)
+                        )
+                        drop = is_t & ok
+                        s_s = jnp.where(drop, t_s, s_s)
+                        s_a = jnp.where(drop, t_a, s_a)
+                        t_state = jnp.where(drop, t_try, t_state)
+                        return (s_s, s_a, t_state), drop
+
+                    xs = (fb_mask[::-1], cg[::-1], same_g[::-1],
+                          ord_[::-1], rel_g[::-1])
+                    _, drops_rev = jax.lax.scan(
+                        fb, (s_same0, s_all0, t_state0), xs
+                    )
+                else:
+                    def fb(carry, xs):
+                        s_s, s_a = carry
+                        is_t, c_p, is_same_p = xs
+                        t_s = s_s - jnp.where(is_same_p, c_p, 0)
+                        t_a = s_a - c_p
+                        drop = is_t & fits_with(t_s, t_a, borrow_b)
+                        s_s = jnp.where(drop, t_s, s_s)
+                        s_a = jnp.where(drop, t_a, s_a)
+                        return (s_s, s_a), drop
+
+                    xs = (fb_mask[::-1], cg[::-1], same_g[::-1])
+                    _, drops_rev = jax.lax.scan(fb, (s_same0, s_all0), xs)
                 drops = drops_rev[::-1]
                 victims_g = pre & ~drops & success
                 victims = jnp.zeros(a_n, bool).at[ord_].set(victims_g)
@@ -307,24 +428,20 @@ def preempt_targets(
             victims = jnp.where(success, jnp.where(ok1, v1, v2), False)
             return success, victims, variant
 
-        # Probe axis: slot 0 = the full multi-resource search; slot 1+r =
-        # the per-cell oracle probe for resource r (SimulatePreemption).
+        # Full multi-resource search (with the tas_fits probe for TAS
+        # entries) + per-cell oracle probes (quota-only, matching the
+        # reference SimulatePreemption).
         eye = jnp.eye(r_n, dtype=bool)
-        probe_active = jnp.concatenate(
-            [full_active[None, :], eye & full_active[None, :]]
-        )  # [R+1, R]
-        probe_contested = jnp.concatenate(
-            [contested_full[None, :], eye & contested_full[None, :]]
+        cell_active_p = eye & full_active[None, :]  # [R, R]
+        cell_contested_p = eye & contested_full[None, :]
+        cell_req = jnp.where(cell_active_p, req[None, :], 0)
+        full_success, full_victims, variant = search(
+            full_active, contested_full, jnp.where(full_active, req, 0),
+            tas_probe=with_tas,
         )
-        probe_req = jnp.where(probe_active, req[None, :], 0)
-        succ_p, vict_p, variant_p = jax.vmap(search)(
-            probe_active, probe_contested, probe_req
-        )
-        full_success = succ_p[0]
-        full_victims = vict_p[0]
-        variant = variant_p[0]
-        cell_success = succ_p[1:]  # [R]
-        cell_victims = vict_p[1:]  # [R, A]
+        cell_success, cell_victims, _vc = jax.vmap(search)(
+            cell_active_p, cell_contested_p, cell_req
+        )  # [R], [R, A]
 
         # Per-cell borrow = the oracle's post-removal height for
         # successful probes, the current height otherwise; FIT cells keep
@@ -371,6 +488,9 @@ def preempt_targets(
         jax.vmap(per_w)(
             arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
             arrays.w_timestamp, eligible, praw_stop, considered,
+            tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
+            tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
+            tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)

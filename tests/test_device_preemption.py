@@ -109,7 +109,7 @@ def random_scenario(seed: int):
                     queue=f"lq-{cq.name}",
                     requests=reqs,
                     priority=rng.randrange(lo_prio, hi_prio) * 100,
-                    creation_time=float(t0 + i),
+                    creation_time=float(t0 + i + 1),
                 )
             )
         return out
@@ -332,7 +332,7 @@ def nested_scenario(seed: int):
                     queue=f"lq-{cq.name}",
                     requests=reqs,
                     priority=rng.randrange(lo_prio, hi_prio) * 100,
-                    creation_time=float(t0 + i),
+                    creation_time=float(t0 + i + 1),
                 )
             )
         return out

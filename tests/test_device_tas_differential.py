@@ -198,3 +198,105 @@ def test_mixed_tas_and_preemption_fallback_ordering():
 
     for seed in range(8):
         assert run(seed, False) == run(seed, True), f"seed {seed}"
+
+
+def test_tas_preemption_on_device_no_fallback():
+    """Flat lend-free tree, TAS entries that need preemption: the victim
+    search (incl. the tas_fits placement probe and victim TAS-usage
+    release) resolves on device — no host fallback — and end states match
+    the pure-host scheduler exactly."""
+    import random as _random
+
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption
+    from kueue_tpu.tas.snapshot import Node
+
+    LVL = ["rack", "kubernetes.io/hostname"]
+
+    def build(seed, device):
+        rng = _random.Random(900 + seed)
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                    resources=["tpu"],
+                    preemption=ClusterQueuePreemption(
+                        within_cluster_queue=(
+                            PreemptionPolicy.LOWER_PRIORITY))),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LVL),
+        )
+        for r in range(2):
+            for h in range(2):
+                mgr.apply(Node(name=f"n{r}{h}", labels={"rack": f"r{r}"},
+                               capacity={"tpu": 8}))
+        low = [Workload(
+            name=f"low{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=rng.choice([1, 2]),
+                requests={"tpu": rng.choice([4, 8])},
+                topology_request=TopologyRequest(
+                    required_level=rng.choice(LVL)),
+            )],
+            priority=0, creation_time=float(i + 1),
+        ) for i in range(rng.randint(3, 5))]
+        high = [Workload(
+            name=f"high{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=rng.choice([1, 2]),
+                requests={"tpu": rng.choice([4, 8])},
+                topology_request=TopologyRequest(
+                    required_level=rng.choice(LVL)),
+            )],
+            priority=200, creation_time=float(100 + i),
+        ) for i in range(rng.randint(1, 3))]
+        sched = DeviceScheduler(mgr.cache, mgr.queues) if device \
+            else mgr.scheduler
+        return mgr, sched, low, high
+
+    def run(seed, device):
+        mgr, sched, low, high = build(seed, device)
+        fallbacks = []
+        if device:
+            orig_hp = sched._host_process
+
+            def spy(infos):
+                fallbacks.extend(i.obj.name for i in infos)
+                return orig_hp(infos)
+
+            sched._host_process = spy
+        evictions = []
+        inner = sched.host if device else sched
+        orig_evict = inner.evict_fn
+
+        def evict(victim, er, pr):
+            evictions.append(f"{victim.obj.name}:{pr}")
+            orig_evict(victim, er, pr)
+
+        inner.evict_fn = evict
+        if device:
+            sched.host.evict_fn = evict
+        for wl in low:
+            mgr.create_workload(wl)
+        sched.schedule_all(max_cycles=30)
+        for wl in high:
+            mgr.create_workload(wl)
+        sched.schedule_all(max_cycles=30)
+        out = {}
+        for wl in low + high:
+            adm = wl.status.admission
+            if adm is None:
+                out[wl.name] = None
+            else:
+                psa = adm.pod_set_assignments[0]
+                ta = psa.topology_assignment
+                out[wl.name] = (sorted(psa.flavors.items()),
+                                sorted(ta.domains) if ta else None)
+        return out, sorted(evictions), fallbacks
+
+    for seed in range(6):
+        h_out, h_ev, _ = run(seed, False)
+        d_out, d_ev, d_fb = run(seed, True)
+        assert d_out == h_out, f"seed {seed}: {h_out} vs {d_out}"
+        assert d_ev == h_ev, f"seed {seed}: {h_ev} vs {d_ev}"
+        assert not d_fb, f"seed {seed}: fell back for {d_fb}"
