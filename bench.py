@@ -380,12 +380,18 @@ def probe_mega():
     s_exact = int(np.bincount(group_of, minlength=layout.n_groups).max())
     out_stats = {"probe": "mega", "ok": True,
                  "platform": jax.devices()[0].platform}
-    for name, fn in (
+    from kueue_tpu.models import pallas_scan as ps
+
+    variants = [
         ("fixedpoint", jax.jit(
             bs.make_fixedpoint_cycle(n_levels=n_levels))),
         ("grouped", jax.jit(bs.make_grouped_cycle(
             s_exact, unroll=4, n_levels=n_levels))),
-    ):
+    ]
+    if ps.fits_int32(arrays):
+        variants.append(("pallas", jax.jit(
+            ps.make_pallas_cycle(s_exact, n_levels=n_levels))))
+    for name, fn in variants:
         t0 = time.monotonic()
         out = fn(arrays, ga)
         out.outcome.block_until_ready()  # compile

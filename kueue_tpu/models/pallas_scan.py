@@ -1,0 +1,389 @@
+"""Pallas TPU kernel for the grouped admission scan.
+
+The XLA ``admit_scan_grouped`` (models/batch_scheduler.py) runs the
+order-dependent admission loop as a ``lax.scan`` whose per-step tensors are
+tiny ([G, L, R] gathers at north-star scale) — the step cost is dominated
+by op-dispatch latency, not compute. This module runs the WHOLE scan as a
+single Pallas kernel: each grid program owns one cohort tree (group), its
+usage state lives in VMEM for the entire bucket, and every step is a
+handful of full-lane VPU row operations. No per-step XLA dispatch, no
+HBM round-trips between steps.
+
+Semantics are identical to ``admit_scan_grouped`` for the no-preemption,
+no-TAS cycle (the reference fast path, scheduler.go:385 processEntry +
+resource_node.go available()/addUsage) and are differential-tested against
+it (tests/test_pallas_scan.py).
+
+Int32 discipline: the attached TPU backend cannot pass s64 operands
+through a pallas custom call (its X64-rewriting pass does not support
+``tpu_custom_call``), so the kernel computes in int32 with saturation at
+``CAP32`` standing in for quota_ops.CAP. ``fits_int32`` checks — host-side,
+once per cycle encode — that every quantity and every worst-case
+accumulation stays below CAP32, so the int32 math is bit-equivalent to the
+int64 path; callers must fall back to the XLA scan when it returns False
+(real kueue quantities are canonical milli-units/bytes and can exceed
+2**30 — e.g. 1Gi of memory is 2**30 bytes exactly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kueue_tpu.models import batch_scheduler as bs
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.ops import quota_ops
+
+# Saturation cap for the in-kernel int32 quota math. (1 << 30) - 1 so that
+# CAP32 + CAP32 still fits int32; plays the role of quota_ops.CAP
+# (UNLIMITED): sat_sub keeps an unlimited minuend unlimited, sat_add
+# clamps, and min(with_max_from_parent, avail) degenerates to avail for
+# unlimited borrow limits exactly like the int64 path.
+CAP32 = (1 << 30) - 1
+
+_META_LOCAL_BITS = 16  # low bits of slot meta = local node id
+_META_ADMIT = 1 << 16  # entry is FIT, active, in range, not host-deferred
+_META_RESERVE = 1 << 17  # entry reserves (NO_CANDIDATES, can't reclaim)
+_META_BORROWING = 1 << 18  # nominated assignment borrows
+
+
+def _sat32(v):
+    return jnp.clip(v, -CAP32, CAP32)
+
+
+def _sadd(a, b):
+    return _sat32(a + b)
+
+
+def _ssub(a, b):
+    """a - b with an Unlimited (CAP32) minuend staying Unlimited."""
+    return jnp.where(a >= CAP32, CAP32, _sat32(a - b))
+
+
+def fits_int32(arrays: CycleArrays) -> bool:
+    """Host-side gate: True when the int32 kernel is bit-exact for this
+    cycle. Checks every encoded quantity and the worst-case usage
+    accumulation (initial usage + all pending requests + reserves) against
+    CAP32. Call once per encode; on False use the XLA int64 scan."""
+    tree = arrays.tree
+    finite_max = 0
+    for t in (tree.nominal, tree.subtree_quota, arrays.usage):
+        finite_max = max(finite_max, int(jnp.max(jnp.abs(t))))
+    # Limits are CAP (unlimited) where unset; only set limits must fit.
+    for t, has in (
+        (tree.borrow_limit, tree.has_borrow_limit),
+        (tree.lend_limit, tree.has_lend_limit),
+    ):
+        set_vals = jnp.where(has, jnp.abs(t), 0)
+        finite_max = max(finite_max, int(jnp.max(set_vals)))
+    req_sum = int(
+        jnp.sum(
+            jnp.where(arrays.w_active[:, None], arrays.w_req, 0).max(axis=1)
+        )
+    )
+    if arrays.w_cq.shape[0] and int(jnp.max(arrays.w_req)) >= CAP32:
+        return False
+    # Local node ids pack into the meta word's low bits; the total node
+    # count bounds every per-group local id.
+    if arrays.tree.parent.shape[0] >= (1 << _META_LOCAL_BITS):
+        return False
+    return finite_max + req_sum < CAP32
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _to_g32(x, ga, pad, g_n, nm, fr, frp):
+    """[N,F,R] int64 -> grouped, int32, lane-flattened [G, Nm, FRp]."""
+    y = x[ga.node_sel]  # [G,Nm,F,R]
+    y = jnp.where(ga.local_valid[..., None, None], y, pad)
+    y = _sat32(y).astype(jnp.int32).reshape(g_n, nm, fr)
+    if frp > fr:
+        y = jnp.pad(y, ((0, 0), (0, 0), (0, frp - fr)))
+    return y
+
+
+def _kernel(n_levels, counts_ref, meta_ref, chain_ref, delta_ref, usage_ref,
+            lq_ref, sub_ref, bl_ref, nom_ref, uout_ref, aout_ref):
+    """One grid program = one cohort tree's whole admission bucket.
+
+    Refs: counts [1,1,1] SMEM; meta [1,1,S] SMEM (packed local-id +
+    flags); chain [1,Nm,L] SMEM; delta [1,S,FRp] VMEM (pre-masked per-slot
+    request rows on the chosen flavor's lanes); usage/lq/sub/bl/nom
+    [1,Nm,FRp] VMEM; outputs uout [1,Nm,FRp], aout [1,S,1].
+    """
+    L = n_levels
+    uout_ref[:] = usage_ref[:]
+    aout_ref[:] = jnp.zeros_like(aout_ref)
+    cnt = counts_ref[0, 0, 0]
+
+    def step(s, carry):
+        meta = meta_ref[0, 0, s]
+        c = meta & ((1 << _META_LOCAL_BITS) - 1)
+        admit_el = (meta & _META_ADMIT) != 0
+        res_el = (meta & _META_RESERVE) != 0
+        borrowing = (meta & _META_BORROWING) != 0
+        delta = delta_ref[0, pl.ds(s, 1), :]  # [1, FRp]
+
+        nodes = [chain_ref[0, c, i] for i in range(L)]
+        u = [uout_ref[0, pl.ds(nodes[i], 1), :] for i in range(L)]
+        lq = [lq_ref[0, pl.ds(nodes[i], 1), :] for i in range(L)]
+        sub = [sub_ref[0, pl.ds(nodes[i], 1), :] for i in range(L)]
+        bl = [bl_ref[0, pl.ds(nodes[i], 1), :] for i in range(L)]
+        # chain pads by repeating the root: rep[i] marks chain[i] being the
+        # last real node (chain[i] == chain[i+1]).
+        rep = [nodes[i] == nodes[i + 1] for i in range(L - 1)]
+
+        l_avail = [jnp.maximum(0, _ssub(lq[i], u[i])) for i in range(L)]
+
+        # available() down the chain, root first (resource_node.go:106).
+        # Unlimited borrow limits saturate with_max at CAP32, making the
+        # min() a no-op — no has_borrow_limit branch needed.
+        avail = _ssub(sub[L - 1], u[L - 1])
+        for i in range(L - 2, -1, -1):
+            stored = _ssub(sub[i], lq[i])
+            uip = jnp.maximum(0, _ssub(u[i], lq[i]))
+            with_max = _sadd(_ssub(stored, uip), bl[i])
+            stepped = _sadd(l_avail[i], jnp.minimum(with_max, avail))
+            avail = jnp.where(rep[i], avail, stepped)
+
+        fits = jnp.all((delta <= avail) | (delta == 0))
+        admit = admit_el & fits
+
+        # reserveCapacityForUnreclaimablePreempt (scheduler.go:513).
+        nomr = nom_ref[0, pl.ds(c, 1), :]
+        res_b = jnp.minimum(delta, _ssub(_sadd(nomr, bl[0]), u[0]))
+        res_p = jnp.maximum(0, jnp.minimum(delta, _ssub(nomr, u[0])))
+        reserve = jnp.where(borrowing, res_b, res_p)
+        reserve = jnp.where(delta > 0, reserve, 0)
+
+        applied = jnp.where(
+            admit, delta, jnp.where(res_el, reserve, jnp.zeros_like(delta))
+        )
+
+        # addUsage bubbling (resource_node.go:144): level i+1 receives the
+        # part of level i's delta exceeding its pre-update local
+        # availability. Stores are guarded so a repeated root row is only
+        # written once (u[] rows were loaded pre-update).
+        cur = applied
+        real = None
+        for i in range(L):
+            d_i = cur
+            new_row = u[i] + d_i
+            if i == 0:
+                uout_ref[0, pl.ds(nodes[0], 1), :] = new_row
+                real = jnp.bool_(True)
+            else:
+                real = real & ~rep[i - 1]
+
+                @pl.when(real)
+                def _(new_row=new_row, node=nodes[i]):
+                    uout_ref[0, pl.ds(node, 1), :] = new_row
+
+            if i < L - 1:
+                cur = jnp.where(
+                    rep[i],
+                    jnp.zeros_like(cur),
+                    jnp.maximum(0, _ssub(cur, l_avail[i])),
+                )
+
+        aout_ref[0, pl.ds(s, 1), :] = jnp.where(admit, 1, 0).astype(
+            jnp.int32
+        ).reshape(1, 1)
+        return carry
+
+    jax.lax.fori_loop(0, cnt, step, 0)
+
+
+def pallas_admit_scan(
+    arrays: CycleArrays,
+    ga: bs.GroupArrays,
+    nom: bs.NominateResult,
+    usage: jnp.ndarray,
+    order: jnp.ndarray,
+    s_max: int,
+    n_levels: int = quota_ops.MAX_DEPTH + 1,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Drop-in for ``admit_scan_grouped`` (no-preempt, no-TAS, int32-safe
+    cycles only — see ``fits_int32``). Returns (final_usage int64,
+    admitted bool[W], preempting bool[W] all-False)."""
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    g_n, nm = ga.node_sel.shape
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    L = n_levels
+    fr = f_n * r_n
+    frp = _round_up(fr, 128)
+    S = s_max
+
+    # --- XLA-side prep: grouped static tensors (int32 lane rows) ---
+    gargs = (ga, 0, g_n, nm, fr, frp)
+    lq_g = _to_g32(quota_ops.local_quota(tree), ga, 0, g_n, nm, fr, frp)
+    sub_g = _to_g32(tree.subtree_quota, ga, 0, g_n, nm, fr, frp)
+    bl_g = _to_g32(tree.borrow_limit, ga, quota_ops.CAP, g_n, nm, fr, frp)
+    nom_g = _to_g32(tree.nominal, ga, 0, g_n, nm, fr, frp)
+    usage_g = _to_g32(usage, ga, 0, g_n, nm, fr, frp)
+
+    # --- slot bucketing (same one-sort layout as admit_scan_grouped) ---
+    rank = jnp.zeros(w_n, dtype=jnp.int64).at[order].set(
+        jnp.arange(w_n, dtype=jnp.int64)
+    )
+    g_w = ga.flat_to_group[arrays.w_cq].astype(jnp.int64)
+    sort_key = jnp.where(
+        arrays.w_active, g_w * w_n + rank, jnp.int64(w_n) * w_n + w_n
+    )
+    grouped_order = jnp.argsort(sort_key).astype(jnp.int32)
+    counts = jnp.zeros(g_n, dtype=jnp.int32).at[
+        ga.flat_to_group[arrays.w_cq]
+    ].add(arrays.w_active.astype(jnp.int32), mode="drop")
+    starts = jnp.cumsum(counts) - counts
+
+    slot_idx = starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    wslot = grouped_order[jnp.clip(slot_idx, 0, w_n - 1)]  # [G,S]
+    in_range = jnp.arange(S)[None, :] < counts[:, None]
+
+    c_w = arrays.w_cq[wslot]  # [G,S]
+    c_local = ga.flat_to_local[c_w].astype(jnp.int32)
+    f = nom.chosen_flavor[wslot]
+    pm = nom.best_pmode[wslot]
+    valid = in_range & arrays.w_active[wslot]
+    deferred = nom.needs_host[wslot]
+    admit_el = valid & (pm == bs.P_FIT) & ~deferred
+    res_el = (
+        valid
+        & (pm == bs.P_NO_CANDIDATES)
+        & ~arrays.can_always_reclaim[c_w]
+        & ~deferred
+    )
+    borrowing = nom.best_borrow[wslot] > 0
+    meta = (
+        c_local
+        | jnp.where(admit_el, _META_ADMIT, 0)
+        | jnp.where(res_el, _META_RESERVE, 0)
+        | jnp.where(borrowing, _META_BORROWING, 0)
+    ).astype(jnp.int32)
+
+    req = arrays.w_req[wslot]  # [G,S,R] i64
+    cell = (f[..., None] >= 0) & (req > 0) & arrays.covered[c_w]
+    delta_fr = jnp.where(
+        (jnp.arange(f_n, dtype=jnp.int32)[None, None, :, None]
+         == f[..., None, None])
+        & cell[:, :, None, :],
+        req[:, :, None, :],
+        0,
+    )  # [G,S,F,R]
+    delta = _sat32(delta_fr).astype(jnp.int32).reshape(g_n, S, fr)
+    if frp > fr:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, frp - fr)))
+
+    chain_l = ga.chain_local[:, :, :L].astype(jnp.int32)  # [G,Nm,L]
+    counts2 = counts.reshape(g_n, 1, 1)
+    meta3 = meta.reshape(g_n, 1, S)
+
+    out_usage, out_admit = pl.pallas_call(
+        functools.partial(_kernel, L),
+        grid=(g_n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1), lambda g: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, S), lambda g: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, nm, L), lambda g: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, S, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S, 1), lambda g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g_n, nm, frp), jnp.int32),
+            jax.ShapeDtypeStruct((g_n, S, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(counts2, meta3, chain_l, delta, usage_g, lq_g, sub_g, bl_g, nom_g)
+
+    admit_slots = out_admit[..., 0] != 0  # [G,S]
+    w_out = jnp.where(admit_slots & in_range, wslot, w_n)
+    admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_out.ravel()].max(
+        admit_slots.ravel(), mode="drop"
+    )[:w_n]
+
+    final_g = out_usage[:, :, :fr].astype(jnp.int64).reshape(
+        g_n, nm, f_n, r_n
+    )
+    final_usage = final_g[ga.flat_to_group, ga.flat_to_local]
+    final_usage = jnp.where(
+        tree.active[:, None, None], final_usage, usage
+    )
+    preempting = jnp.zeros(w_n, dtype=bool)
+    return final_usage, admitted, preempting
+
+
+def make_pallas_cycle(s_max: int, n_levels: int = quota_ops.MAX_DEPTH + 1,
+                      interpret: bool = False):
+    """Jittable no-preempt cycle with the Pallas admission scan. Same
+    contract as ``bs.make_grouped_cycle(s_max, preempt=False)``; callers
+    gate on ``fits_int32(arrays)``."""
+
+    def impl(arrays: CycleArrays, ga: bs.GroupArrays) -> bs.CycleOutputs:
+        usage = arrays.usage
+        nom = bs.nominate(arrays, usage, n_levels=n_levels)
+        order = bs.admission_order(arrays, nom)
+        final_usage, admitted, preempting = pallas_admit_scan(
+            arrays, ga, nom, usage, order, s_max, n_levels=n_levels,
+            interpret=interpret,
+        )
+        outcome = jnp.where(
+            ~arrays.w_active,
+            bs.OUT_NOFIT,
+            jnp.where(
+                nom.needs_host,
+                bs.OUT_NEEDS_HOST,
+                jnp.where(
+                    admitted,
+                    bs.OUT_ADMITTED,
+                    jnp.where(
+                        nom.best_pmode == bs.P_FIT,
+                        bs.OUT_FIT_SKIPPED,
+                        jnp.where(
+                            nom.best_pmode == bs.P_NO_CANDIDATES,
+                            bs.OUT_NO_CANDIDATES,
+                            bs.OUT_NOFIT,
+                        ),
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        return bs.CycleOutputs(
+            outcome=outcome,
+            chosen_flavor=nom.chosen_flavor,
+            borrow=nom.best_borrow,
+            tried_flavor_idx=nom.tried_flavor_idx,
+            usage=final_usage,
+            order=order,
+        )
+
+    return impl

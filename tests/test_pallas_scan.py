@@ -1,0 +1,175 @@
+"""Differential: Pallas admission-scan kernel vs the XLA grouped scan.
+
+Random no-preempt forests (depths 1-3, borrow/lend limits, initial usage,
+multi-flavor fungibility) — the Pallas cycle (interpret mode on CPU) must
+produce bit-identical outcomes, flavors, and final usage to
+``bs.make_grouped_cycle``. The same scenarios run through ``fits_int32``
+to confirm the gate admits them; an oversized scenario must be rejected.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kueue_tpu.models import batch_scheduler as bs
+from kueue_tpu.models.encode import CycleArrays, _order_rank
+from kueue_tpu.models.pallas_scan import (
+    CAP32,
+    fits_int32,
+    make_pallas_cycle,
+)
+from kueue_tpu.ops.quota_ops import QuotaTreeArrays, compute_subtree
+from kueue_tpu.ops.tree_encode import GroupLayout
+
+
+def build_random(seed, big=False):
+    rng = np.random.default_rng(seed)
+    n_roots = rng.integers(1, 4)
+    parent_l = []
+    depth_l = []
+    is_cq_l = []
+    for r in range(n_roots):
+        root = len(parent_l)
+        parent_l.append(-1)
+        depth_l.append(0)
+        is_cq_l.append(False)
+        mids = []
+        for _ in range(rng.integers(0, 3)):
+            mids.append(len(parent_l))
+            parent_l.append(root)
+            depth_l.append(1)
+            is_cq_l.append(False)
+        for _ in range(rng.integers(1, 5)):
+            p = root if (not mids or rng.random() < 0.5) else int(
+                rng.choice(mids)
+            )
+            parent_l.append(p)
+            depth_l.append(depth_l[p] + 1)
+            is_cq_l.append(True)
+    # Lone CQs (their own group).
+    for _ in range(rng.integers(0, 3)):
+        parent_l.append(-1)
+        depth_l.append(0)
+        is_cq_l.append(True)
+    parent = np.asarray(parent_l, np.int32)
+    depth = np.asarray(depth_l, np.int32)
+    is_cq = np.asarray(is_cq_l, bool)
+    N = len(parent_l)
+    height = np.zeros(N, np.int32)
+    for i in range(N):
+        d, p = 0, i
+        while parent[p] >= 0:
+            p = parent[p]
+            d += 1
+        # height = distance to deepest descendant; approximate as max chain
+    for i in range(N):
+        p, h = parent[i], 1
+        while p >= 0:
+            height[p] = max(height[p], h)
+            p, h = parent[p], h + 1
+
+    F = int(rng.integers(1, 4))
+    R = int(rng.integers(1, 3))
+    scale = (1 << 24) if big else 10
+    nominal = np.zeros((N, F, R), np.int64)
+    nominal[is_cq] = rng.integers(0, 20, (is_cq.sum(), F, R)) * scale
+    CAPV = 1 << 62
+    borrow = np.full((N, F, R), CAPV, np.int64)
+    has_borrow = np.zeros((N, F, R), bool)
+    lend = np.full((N, F, R), CAPV, np.int64)
+    has_lend = np.zeros((N, F, R), bool)
+    for i in range(N):
+        if parent[i] >= 0 and rng.random() < 0.3:
+            has_borrow[i] = True
+            borrow[i] = rng.integers(0, 15, (F, R)) * scale
+        if parent[i] >= 0 and rng.random() < 0.2:
+            has_lend[i] = True
+            lend[i] = np.minimum(
+                rng.integers(0, 15, (F, R)) * scale, nominal[i]
+            )
+    tree = QuotaTreeArrays(
+        parent=jnp.asarray(parent), active=jnp.ones(N, bool),
+        depth=jnp.asarray(depth), height=jnp.asarray(height),
+        nominal=jnp.asarray(nominal), borrow_limit=jnp.asarray(borrow),
+        has_borrow_limit=jnp.asarray(has_borrow),
+        lend_limit=jnp.asarray(lend), has_lend_limit=jnp.asarray(has_lend),
+        subtree_quota=jnp.zeros((N, F, R), jnp.int64),
+    )
+    cq_usage = np.zeros((N, F, R), np.int64)
+    cq_usage[is_cq] = rng.integers(0, 6, (is_cq.sum(), F, R)) * scale
+    subtree, usage = compute_subtree(
+        tree, jnp.asarray(cq_usage), jnp.asarray(is_cq)
+    )
+    tree = tree._replace(subtree_quota=subtree)
+
+    W = int(rng.integers(20, 120))
+    cq_ids = np.flatnonzero(is_cq)
+    w_cq = rng.choice(cq_ids, W).astype(np.int32)
+    w_req = (rng.integers(1, 8, (W, R)) * scale).astype(np.int64)
+    w_prio = (rng.integers(0, 3, W) * 100).astype(np.int64)
+    w_ts = np.arange(W, dtype=np.float64)
+    w_elig = rng.random((W, F)) < 0.85
+    flavor_at = np.tile(np.arange(F, dtype=np.int32), (N, 1))
+    arrays = CycleArrays(
+        tree=tree, usage=usage,
+        flavor_at=jnp.asarray(flavor_at),
+        n_flavors=jnp.full(N, F, jnp.int32),
+        covered=jnp.asarray(rng.random((N, R)) < 0.95),
+        when_can_borrow_try_next=jnp.asarray(rng.random(N) < 0.5),
+        when_can_preempt_try_next=jnp.ones(N, bool),
+        pref_preempt_over_borrow=jnp.zeros(N, bool),
+        can_preempt_while_borrowing=jnp.zeros(N, bool),
+        never_preempts=jnp.ones(N, bool),
+        can_always_reclaim=jnp.asarray(rng.random(N) < 0.3),
+        usage_by_prio=jnp.zeros((N, F, R, 8), jnp.int64),
+        prio_cuts=jnp.full(8, (1 << 62), jnp.int64),
+        prefilter_valid=jnp.asarray(False),
+        policy_within=jnp.zeros(N, jnp.int32),
+        policy_reclaim=jnp.zeros(N, jnp.int32),
+        nominal_cq=tree.nominal,
+        w_cq=jnp.asarray(w_cq),
+        w_req=jnp.asarray(w_req),
+        w_elig=jnp.asarray(w_elig),
+        w_active=jnp.asarray(rng.random(W) < 0.95),
+        w_priority=jnp.asarray(w_prio),
+        w_timestamp=jnp.asarray(w_ts),
+        w_quota_reserved=jnp.zeros(W, bool),
+        w_start_flavor=jnp.zeros(W, np.int32),
+        w_order_rank=jnp.asarray(_order_rank(w_prio, w_ts)),
+    )
+    layout = GroupLayout(parent, np.ones(N, bool))
+    return arrays, layout
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_pallas_matches_grouped_scan(seed):
+    arrays, layout = build_random(seed)
+    assert fits_int32(arrays)
+    ga = bs.GroupArrays(*layout.as_jax())
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    group_of = np.asarray(layout.flat_to_group)[np.asarray(arrays.w_cq)]
+    s_exact = int(
+        np.bincount(group_of, minlength=layout.n_groups).max()
+    )
+    ref = bs.make_grouped_cycle(s_exact, n_levels=n_levels)(arrays, ga)
+    out = make_pallas_cycle(s_exact, n_levels=n_levels, interpret=True)(
+        arrays, ga
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.outcome), np.asarray(out.outcome)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.chosen_flavor), np.asarray(out.chosen_flavor)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.usage), np.asarray(out.usage)
+    )
+
+
+def test_fits_int32_rejects_oversized():
+    arrays, _ = build_random(0, big=True)
+    # 2**24-scale quantities x many workloads overflow the int32 budget.
+    big_req = arrays.w_req * (1 << 12)
+    arrays = arrays._replace(w_req=big_req)
+    assert not fits_int32(arrays)
