@@ -11,4 +11,20 @@ multikueuecluster.go).
 from kueue_tpu.remote.client import RemoteWorkerClient
 from kueue_tpu.remote.worker import serve_worker
 
-__all__ = ["RemoteWorkerClient", "serve_worker"]
+
+def __getattr__(name):
+    # grpc transport imported lazily so environments without grpcio can
+    # still use the socket seam.
+    if name in ("GrpcWorkerClient", "serve_worker_grpc"):
+        from kueue_tpu.remote import grpc_transport
+
+        return getattr(grpc_transport, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "RemoteWorkerClient",
+    "serve_worker",
+    "GrpcWorkerClient",
+    "serve_worker_grpc",
+]

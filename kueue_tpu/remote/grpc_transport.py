@@ -1,0 +1,189 @@
+"""gRPC transport for the MultiKueue worker seam — the DCN tier.
+
+The unix-socket JSON protocol (remote/worker.py) is the local-process
+boundary; this module carries the SAME op surface over gRPC/HTTP2 TCP,
+which is how a real multi-cluster deployment crosses the data-center
+network (reference pkg/controller/admissionchecks/multikueue talks to
+worker clusters through kubeconfig REST clients; the seam here is the
+serialized-manifest analog, SURVEY §5/§7).
+
+No .proto codegen: requests/responses are JSON payloads over a generic
+unary method (``/kueue.tpu.MultiKueueWorker/Call``). The wire contract is
+the ``remote.worker.dispatch`` op table, so the socket worker, the gRPC
+worker, and an in-process Manager remain interchangeable behind the
+controller's worker interface.
+
+Run standalone:
+    python -m kueue_tpu.remote.grpc_transport --manifests cluster.yaml \
+        --listen 127.0.0.1:50061
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from kueue_tpu.api.serialization import decode, encode
+from kueue_tpu.api.types import Workload
+from kueue_tpu.manager import Manager
+from kueue_tpu.remote.client import WorkerUnreachable, _WorkloadView
+from kueue_tpu.remote.worker import dispatch
+
+_SERVICE = "kueue.tpu.MultiKueueWorker"
+_METHOD = f"/{_SERVICE}/Call"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+def serve_worker_grpc(
+    manager: Manager, address: str = "127.0.0.1:0", in_thread: bool = True
+):
+    """Serve ``manager`` over gRPC. Returns (server, bound_address);
+    call ``server.stop(0)`` to kill it."""
+    lock = threading.Lock()
+
+    def call(request: bytes, context) -> bytes:
+        try:
+            req = json.loads(request)
+            with lock:
+                resp = dispatch(manager, req)
+        except Exception as exc:  # noqa: BLE001 - wire errors back
+            resp = {"ok": False, "error": repr(exc)[:500]}
+        return json.dumps(resp).encode()
+
+    handler = grpc.method_handlers_generic_handler(
+        _SERVICE,
+        {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                call,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port(address)
+    host = address.rsplit(":", 1)[0]
+    bound = f"{host}:{port}"
+    server.start()
+    if not in_thread:
+        server.wait_for_termination()
+    return server, bound
+
+
+class GrpcWorkerClient:
+    """A MultiKueue worker behind the gRPC seam. Same surface as
+    ``RemoteWorkerClient`` (workloads view, create/delete, schedule,
+    finish, ping) with reconnect + backoff on transport failure."""
+
+    def __init__(
+        self,
+        address: str,
+        connect_timeout: float = 2.0,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+    ) -> None:
+        self.address = address
+        self.connect_timeout = connect_timeout
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._channel: Optional[grpc.Channel] = None
+        self._call_fn = None
+        self.workloads = _WorkloadView(self)
+
+    # -- transport ---------------------------------------------------------
+
+    def _connect(self) -> None:
+        self.close()
+        self._channel = grpc.insecure_channel(self.address)
+        self._call_fn = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    def close(self) -> None:
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._channel = None
+        self._call_fn = None
+
+    def _call(self, req: dict) -> dict:
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                if self._call_fn is None:
+                    self._connect()
+                raw = self._call_fn(
+                    json.dumps(req).encode(), timeout=self.connect_timeout
+                )
+                resp = json.loads(raw)
+                if not resp.get("ok"):
+                    raise RuntimeError(resp.get("error", "remote error"))
+                return resp
+            except (grpc.RpcError, json.JSONDecodeError) as exc:
+                last_exc = exc
+                self.close()
+                if attempt < self.retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        raise WorkerUnreachable(
+            f"worker at {self.address} unreachable: {last_exc!r}"
+        )
+
+    # -- worker interface --------------------------------------------------
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._call({"op": "ping"}).get("pong"))
+        except WorkerUnreachable:
+            return False
+
+    def create_workload(self, wl: Workload) -> None:
+        try:
+            self._call({"op": "create_workload", "workload": encode(wl)})
+        except RuntimeError as exc:
+            if "exists" in str(exc):
+                raise ValueError(str(exc)) from exc
+            raise
+
+    def delete_workload(self, wl: Workload) -> None:
+        self._call({"op": "delete_workload", "key": wl.key})
+
+    def schedule(self) -> None:
+        self._call({"op": "schedule"})
+
+    def finish_workload(self, wl: Workload) -> None:
+        self._call({"op": "finish_workload", "key": wl.key})
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--manifests", required=False)
+    ap.add_argument("--listen", required=True)
+    args = ap.parse_args(argv)
+    mgr = Manager()
+    if args.manifests:
+        from kueue_tpu.api.serialization import load_manifests
+
+        for obj in load_manifests(open(args.manifests).read()):
+            mgr.apply(obj)
+    server, bound = serve_worker_grpc(mgr, args.listen, in_thread=True)
+    print(bound, flush=True)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,42 +36,44 @@ class _Handler(socketserver.StreamRequestHandler):
             try:
                 req = json.loads(line)
                 with lock:
-                    resp = self._dispatch(mgr, req)
+                    resp = dispatch(mgr, req)
             except Exception as exc:  # noqa: BLE001 - wire errors back
                 resp = {"ok": False, "error": repr(exc)[:500]}
             self.wfile.write(json.dumps(resp).encode() + b"\n")
             self.wfile.flush()
 
-    @staticmethod
-    def _dispatch(mgr: Manager, req: dict) -> dict:
-        op = req.get("op")
-        if op == "ping":
-            return {"ok": True, "pong": True}
-        if op == "create_workload":
-            wl = decode(req["workload"])
-            if wl.key in mgr.workloads:
-                return {"ok": False, "error": "exists"}
-            mgr.create_workload(wl)
-            return {"ok": True}
-        if op == "delete_workload":
-            wl = mgr.workloads.get(req["key"])
-            if wl is not None:
-                mgr.delete_workload(wl)
-            return {"ok": True}
-        if op == "get_workload":
-            wl = mgr.workloads.get(req["key"])
-            return {"ok": True,
-                    "workload": encode(wl) if wl is not None else None}
-        if op == "schedule":
-            result = mgr.schedule_all()
-            mgr.tick()
-            return {"ok": True, "cycles": result}
-        if op == "finish_workload":
-            wl = mgr.workloads.get(req["key"])
-            if wl is not None:
-                mgr.finish_workload(wl)
-            return {"ok": True}
-        return {"ok": False, "error": f"unknown op {op!r}"}
+
+def dispatch(mgr: Manager, req: dict) -> dict:
+    """Worker-side op dispatch, shared by every transport (socket JSON
+    lines, gRPC) — the op surface IS the seam."""
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    if op == "create_workload":
+        wl = decode(req["workload"])
+        if wl.key in mgr.workloads:
+            return {"ok": False, "error": "exists"}
+        mgr.create_workload(wl)
+        return {"ok": True}
+    if op == "delete_workload":
+        wl = mgr.workloads.get(req["key"])
+        if wl is not None:
+            mgr.delete_workload(wl)
+        return {"ok": True}
+    if op == "get_workload":
+        wl = mgr.workloads.get(req["key"])
+        return {"ok": True,
+                "workload": encode(wl) if wl is not None else None}
+    if op == "schedule":
+        result = mgr.schedule_all()
+        mgr.tick()
+        return {"ok": True, "cycles": result}
+    if op == "finish_workload":
+        wl = mgr.workloads.get(req["key"])
+        if wl is not None:
+            mgr.finish_workload(wl)
+        return {"ok": True}
+    return {"ok": False, "error": f"unknown op {op!r}"}
 
 
 class _Server(socketserver.ThreadingUnixStreamServer):

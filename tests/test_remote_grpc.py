@@ -1,0 +1,149 @@
+"""MultiKueue over the gRPC seam (the DCN-tier transport): dispatch and
+status mirroring cross a real gRPC/HTTP2 boundary into a separate OS
+process; killing the winning worker drives the workerLostTimeout
+redispatch exactly like the socket transport.
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kueue_tpu.api.serialization import load_manifests
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    Workload,
+    quota,
+)
+from kueue_tpu.controllers.multikueue import MultiKueueController
+from kueue_tpu.core.workload_info import is_admitted, is_finished
+from kueue_tpu.manager import Manager
+from kueue_tpu.remote import GrpcWorkerClient, serve_worker_grpc
+
+from .helpers import make_cq
+from .test_remote_worker import WORKER_MANIFESTS, make_hub
+
+
+def spawn_grpc_worker(tmp_path, name="w1"):
+    manifests = tmp_path / f"{name}.yaml"
+    manifests.write_text(WORKER_MANIFESTS)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kueue_tpu.remote.grpc_transport",
+         "--manifests", str(manifests), "--listen", "127.0.0.1:0"],
+        cwd="/root/repo",
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    bound = proc.stdout.readline().strip()
+    client = GrpcWorkerClient(bound)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if client.ping():
+            return proc, client
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError(f"grpc worker at {bound!r} did not come up")
+
+
+def test_grpc_dispatch_across_process_boundary(tmp_path):
+    proc, client = spawn_grpc_worker(tmp_path)
+    try:
+        hub = make_hub()
+        mk = MultiKueueController()
+        mk.add_worker("west", client)
+        hub.register_check_controller(mk)
+
+        wl = Workload(name="job", queue_name="lq", pod_sets=[
+            PodSet(name="main", count=1, requests={"cpu": 2000})])
+        hub.create_workload(wl)
+        hub.schedule_all()
+        hub.tick()
+        assert is_admitted(wl)
+        assert wl.status.cluster_name == "west"
+        remote = client.workloads.get(wl.key)
+        assert remote is not None and is_admitted(remote)
+
+        client.finish_workload(wl)
+        hub.tick()
+        assert is_finished(wl)
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_grpc_worker_loss_redispatches(tmp_path):
+    proc1, client1 = spawn_grpc_worker(tmp_path, "doomed")
+    survivor = Manager()
+    for obj in load_manifests(WORKER_MANIFESTS):
+        survivor.apply(obj)
+
+    now = [0.0]
+    hub = Manager(clock=lambda: now[0])
+    hub.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    mk = MultiKueueController(worker_lost_timeout_seconds=60.0)
+    mk.config.dispatcher = "Incremental"
+    mk.add_worker("doomed", client1)
+    mk.add_worker("survivor", survivor)
+    hub.register_check_controller(mk)
+    try:
+        wl = Workload(name="job", queue_name="lq", pod_sets=[
+            PodSet(name="main", count=1, requests={"cpu": 2000})])
+        hub.create_workload(wl)
+        hub.schedule_all()
+        hub.tick()
+        assert is_admitted(wl)
+        if wl.status.cluster_name != "doomed":
+            pytest.skip("survivor won the first round; loss path untested")
+
+        proc1.kill()
+        proc1.wait()
+        now[0] = 10.0
+        hub.tick()
+        assert wl.status.cluster_name == "doomed"  # grace period running
+        now[0] = 100.0
+        hub.tick()
+        now[0] = 101.0
+        hub.schedule_all()
+        hub.tick()
+        assert wl.status.cluster_name == "survivor", wl.status
+        assert wl.key in survivor.workloads
+    finally:
+        if proc1.poll() is None:
+            proc1.kill()
+            proc1.wait()
+
+
+def test_grpc_in_thread_roundtrip():
+    """In-thread gRPC server: protocol smoke (create/get/schedule/
+    delete) plus unreachable-address ping returning False."""
+    mgr = Manager()
+    for obj in load_manifests(WORKER_MANIFESTS):
+        mgr.apply(obj)
+    server, bound = serve_worker_grpc(mgr, "127.0.0.1:0")
+    try:
+        client = GrpcWorkerClient(bound)
+        assert client.ping()
+        wl = Workload(name="j1", queue_name="lq", pod_sets=[
+            PodSet(name="main", count=1, requests={"cpu": 1000})])
+        client.create_workload(wl)
+        with pytest.raises(ValueError):
+            client.create_workload(wl)
+        client.schedule()
+        got = client.workloads.get(wl.key)
+        assert got is not None and is_admitted(got)
+        client.delete_workload(wl)
+        assert client.workloads.get(wl.key) is None
+    finally:
+        server.stop(0)
+    dead = GrpcWorkerClient("127.0.0.1:1", retries=0)
+    assert not dead.ping()
