@@ -50,9 +50,7 @@ function polyline(points, color, w, h, maxY){
   ).join(' ');
   return `<polyline fill="none" stroke="${color}" stroke-width="1.5" points="${pts}"/>`;
 }
-async function refresh(){
-  const r = await fetch('/api/state'); const s = await r.json();
-
+function render(s){
   let tiles = '';
   for (const [label, v] of Object.entries(s.totals)){
     tiles += `<div class=tile><b>${v}</b>${label}</div>`;
@@ -104,7 +102,27 @@ async function refresh(){
   h += '</table>';
   document.getElementById('content').innerHTML = h;
 }
-refresh(); setInterval(refresh, 2000);
+async function refresh(){
+  const r = await fetch('/api/state'); render(await r.json());
+}
+let wsLive = false, wsRetry = null;
+function scheduleReconnect(){
+  wsLive = false;
+  if (wsRetry === null) {
+    wsRetry = setTimeout(() => { wsRetry = null; connectWS(); }, 2000);
+  }
+}
+function connectWS(){
+  try {
+    const ws = new WebSocket(`ws://${location.host}/ws`);
+    ws.onopen = () => { wsLive = true; };
+    ws.onmessage = (ev) => render(JSON.parse(ev.data));
+    ws.onclose = scheduleReconnect;
+    ws.onerror = scheduleReconnect;
+  } catch (e) { wsLive = false; }
+}
+connectWS();
+refresh(); setInterval(() => { if (!wsLive) refresh(); }, 2000);
 </script></body></html>"""
 
 # Activity ring buffer sampled on every /api/state call (kueueviz keeps a
@@ -152,7 +170,7 @@ def _cohort_tree(manager):
     return [build(r) for r in sorted(roots)]
 
 
-def state_json(manager) -> Dict:
+def state_json(manager, sample_history: bool = True) -> Dict:
     cqs = []
     total_pending = 0
     total_admitted = 0
@@ -228,7 +246,8 @@ def state_json(manager) -> Dict:
             m.counters.get("admission_attempts_total", {}).values()
         )),
     }
-    _history.sample(total_pending, total_admitted, preempted_total)
+    if sample_history:
+        _history.sample(total_pending, total_admitted, preempted_total)
     return {
         "cluster_queues": cqs,
         "workloads": wls,
@@ -242,11 +261,88 @@ def state_json(manager) -> Dict:
     }
 
 
-def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081):
+def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081,
+                    ws_interval_s: float = 0.25):
+    """HTTP + WebSocket dashboard server. ``/ws`` upgrades to a live
+    stream (kueueviz's websocket analog): the full state document is
+    pushed immediately on connect and whenever it changes, checked every
+    ``ws_interval_s``; pings are answered, close frames honored."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from kueue_tpu.visibility import ws as wsmod
+
     class Handler(BaseHTTPRequestHandler):
+        def _serve_ws(self):
+            key = self.headers.get("Sec-WebSocket-Key")
+            if not key or "websocket" not in (
+                self.headers.get("Upgrade", "").lower()
+            ):
+                self.send_response(400)
+                self.end_headers()
+                return
+            self.connection.sendall(wsmod.handshake_response(key))
+            self.close_connection = True
+            last_core = None
+            reader = wsmod.SockReader(self.connection)
+            try:
+                while True:
+                    # Change detection excludes the history lists (and
+                    # skips the history sample) so the periodic check
+                    # itself cannot manufacture a difference; a sample is
+                    # recorded only when a change is actually pushed.
+                    doc = state_json(manager, sample_history=False)
+                    core = json.dumps(
+                        {k: v for k, v in doc.items() if k != "history"}
+                    ).encode()
+                    if core != last_core:
+                        t = doc["totals"]
+                        _history.sample(
+                            t["pending"], t["admitted"],
+                            t["preempted (total)"],
+                        )
+                        doc["history"] = {
+                            "pending": list(_history.pending),
+                            "admitted": list(_history.admitted),
+                            "preempted_total": list(
+                                _history.preempted_total
+                            ),
+                        }
+                        self.connection.sendall(wsmod.encode_frame(
+                            json.dumps(doc).encode(), wsmod.OP_TEXT
+                        ))
+                        last_core = core
+                    # Handle one client frame per tick (pings, close).
+                    # select() only when the reader holds no read-ahead,
+                    # so frames coalesced into one TCP segment are not
+                    # stranded behind a quiet socket.
+                    import select
+
+                    if not reader.has_buffered:
+                        ready, _, _ = select.select(
+                            [self.connection], [], [], ws_interval_s
+                        )
+                        if not ready:
+                            continue
+                    frame = wsmod.read_frame(reader)
+                    if frame is None:
+                        return
+                    op, payload = frame
+                    if op == wsmod.OP_CLOSE:
+                        self.connection.sendall(
+                            wsmod.encode_frame(payload, wsmod.OP_CLOSE)
+                        )
+                        return
+                    if op == wsmod.OP_PING:
+                        self.connection.sendall(
+                            wsmod.encode_frame(payload, wsmod.OP_PONG)
+                        )
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+
         def do_GET(self):  # noqa: N802
+            if self.path == "/ws":
+                self._serve_ws()
+                return
             if self.path == "/api/state":
                 body = json.dumps(state_json(manager)).encode()
                 ctype = "application/json"

@@ -1,0 +1,109 @@
+"""Minimal RFC 6455 WebSocket server-side plumbing (stdlib only).
+
+kueueviz (reference cmd/kueueviz) streams cluster state to the browser
+over websockets; this module provides the handshake and frame codec used
+by the dashboard's ``/ws`` endpoint (visibility/dashboard.py). Only the
+server side of the protocol is implemented: text pushes, client-masked
+frame reads, ping/pong, close.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import struct
+from typing import Optional, Tuple
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def accept_key(client_key: str) -> str:
+    """Sec-WebSocket-Accept for a client's Sec-WebSocket-Key."""
+    digest = hashlib.sha1((client_key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def handshake_response(client_key: str) -> bytes:
+    return (
+        "HTTP/1.1 101 Switching Protocols\r\n"
+        "Upgrade: websocket\r\n"
+        "Connection: Upgrade\r\n"
+        f"Sec-WebSocket-Accept: {accept_key(client_key)}\r\n"
+        "\r\n"
+    ).encode()
+
+
+def encode_frame(payload: bytes, opcode: int = OP_TEXT,
+                 mask: bool = False) -> bytes:
+    """One FIN frame. Servers send unmasked; the test client masks."""
+    head = bytes([0x80 | opcode])
+    mbit = 0x80 if mask else 0
+    n = len(payload)
+    if n < 126:
+        head += bytes([mbit | n])
+    elif n < (1 << 16):
+        head += bytes([mbit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mbit | 127]) + struct.pack(">Q", n)
+    if mask:
+        key = b"\x37\xfa\x21\x3d"  # fixed mask is fine for tests
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+class SockReader:
+    """Blocking exact-read wrapper over a socket with an inspectable
+    buffer — unlike BufferedReader, ``has_buffered`` lets a server poll
+    select() only when nothing is already read ahead (so coalesced
+    frames are never stranded) and never blocks on a peek."""
+
+    def __init__(self, sock) -> None:
+        self.sock = sock
+        self.buf = b""
+
+    def read(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                break
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    @property
+    def has_buffered(self) -> bool:
+        return bool(self.buf)
+
+
+def read_frame(rfile) -> Optional[Tuple[int, bytes]]:
+    """Read one frame from a file-like socket reader. Returns
+    (opcode, payload) or None on EOF. Unmasks client payloads."""
+    h = rfile.read(2)
+    if len(h) < 2:
+        return None
+    opcode = h[0] & 0x0F
+    masked = bool(h[1] & 0x80)
+    n = h[1] & 0x7F
+    if n == 126:
+        ext = rfile.read(2)
+        if len(ext) < 2:
+            return None
+        n = struct.unpack(">H", ext)[0]
+    elif n == 127:
+        ext = rfile.read(8)
+        if len(ext) < 8:
+            return None
+        n = struct.unpack(">Q", ext)[0]
+    key = rfile.read(4) if masked else b""
+    payload = rfile.read(n) if n else b""
+    if masked and payload:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return opcode, payload

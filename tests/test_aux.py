@@ -849,3 +849,69 @@ def test_metrics_lifecycle_series():
     text = mgr.metrics.expose()
     assert "kueue_admitted_workloads_total" in text
     assert "kueue_cluster_queue_nominal_quota" in text
+
+
+def test_dashboard_websocket_stream():
+    """kueueviz-style live stream: /ws upgrades (RFC 6455 handshake),
+    pushes the state immediately, pushes again when state changes, and
+    answers pings."""
+    import base64
+    import json as _json
+    import socket as _socket
+
+    from kueue_tpu.visibility import ws as wsmod
+    from kueue_tpu.visibility.dashboard import serve_dashboard
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-ws", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-ws"),
+    )
+    httpd = serve_dashboard(mgr, port=0, ws_interval_s=0.05)
+    port = httpd.server_address[1]
+    sock = _socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        sock.sendall(
+            (f"GET /ws HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Key: {key}\r\n"
+             "Sec-WebSocket-Version: 13\r\n\r\n").encode()
+        )
+        rfile = sock.makefile("rb")
+        status = rfile.readline().decode()
+        assert "101" in status
+        headers = {}
+        while True:
+            line = rfile.readline().decode().strip()
+            if not line:
+                break
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        assert headers["sec-websocket-accept"] == wsmod.accept_key(key)
+
+        op, payload = wsmod.read_frame(rfile)
+        assert op == wsmod.OP_TEXT
+        state = _json.loads(payload)
+        assert state["totals"]["admitted"] == 0
+
+        # A state change must be pushed without the client asking.
+        mgr.create_workload(make_wl("ws-1", cpu_m=1000))
+        mgr.schedule_all()
+        op, payload = wsmod.read_frame(rfile)
+        assert op == wsmod.OP_TEXT
+        state = _json.loads(payload)
+        assert state["totals"]["admitted"] == 1
+
+        # Ping -> pong.
+        sock.sendall(wsmod.encode_frame(b"hb", wsmod.OP_PING, mask=True))
+        op, payload = wsmod.read_frame(rfile)
+        while op == wsmod.OP_TEXT:  # history sampling may push again
+            op, payload = wsmod.read_frame(rfile)
+        assert op == wsmod.OP_PONG and payload == b"hb"
+
+        sock.sendall(wsmod.encode_frame(b"", wsmod.OP_CLOSE, mask=True))
+    finally:
+        sock.close()
+        httpd.shutdown()
