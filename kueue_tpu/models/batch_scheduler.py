@@ -908,20 +908,19 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         # Structural eligibility for on-device oracle resolution: exactly
         # one flavor with raw preempt mode, and the fungibility scan's
         # choice is independent of the oracle outcome (it stopped at that
-        # flavor, or there was only one to consider). TAS entries are
-        # excluded — their victim search needs the topology probe.
+        # flavor, or there was only one to consider).
         base_elig = (
             arrays.w_active
             & (nom.best_pmode == P_PREEMPT_RAW)
             & (nom.praw_count == 1)
             & ~arrays.w_has_gates
         )
-        base_hier = base_elig
         if arrays.w_tas is not None:
-            # TAS entries may use the flat kernel's tas_fits-aware search
-            # when the tree's admitted TAS usage is device-representable
-            # and the preempt mode came from nominate (a Fit->Preempt TAS
-            # downgrade re-enters the host fungibility scan instead).
+            # TAS entries may use the kernels' tas_fits-aware searches
+            # (flat and hierarchical) when the tree's admitted TAS usage
+            # is device-representable and the preempt mode came from
+            # nominate (a Fit->Preempt TAS downgrade re-enters the host
+            # fungibility scan instead).
             tas_allowed = jnp.zeros_like(base_elig)
             if (arrays.tas_topo is not None
                     and arrays.preempt_tas_ok is not None):
@@ -931,7 +930,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                     & ~downgrade
                 )
             base_elig = base_elig & (~arrays.w_tas | tas_allowed)
-            base_hier = base_hier & ~arrays.w_tas
+        base_hier = base_elig
         elig = base_elig & arrays.preempt_simple[arrays.w_cq]
         tgt = preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,

@@ -525,6 +525,12 @@ def hier_targets(
     Exactness relies on the encoder's ``preempt_hier`` gate: no lending
     limits anywhere in the tree (usage bubbles fully, so removal at CQ d
     subtracts at every ancestor of d) and fully mappable admitted usage.
+
+    TAS entries (``preempt_tas_ok``) run the same search with the host's
+    tas_fits probe folded in (preemption.go:637): the remove-until-fit
+    scan carries the topology state alongside the per-node usage, victim
+    removal releases per-leaf usage, and both the stop test and the
+    fill-back check placement feasibility.
     """
     tree = arrays.tree
     usage = arrays.usage
@@ -551,7 +557,46 @@ def hier_targets(
     a_iota = jnp.arange(a_n)
     cand_chain = chain_table[adm.cq]  # [A, D+1]
 
-    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered):
+    with_tas = (
+        getattr(arrays, "tas_topo", None) is not None
+        and adm.tas_t is not None
+    )
+    if with_tas:
+        from kueue_tpu.ops import tas_place as _tas_place
+
+        w_n = arrays.w_cq.shape[0]
+        w_iota = jnp.arange(w_n)
+        f_all = arrays.w_elig.shape[1]
+        t_of_w = jnp.where(
+            chosen_flavor >= 0,
+            arrays.tas_of_flavor[jnp.clip(chosen_flavor, 0, f_all - 1)],
+            -1,
+        )
+        t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
+        tas_in = dict(
+            do_tas=arrays.w_tas & (t_of_w >= 0),
+            t_row=t_idx_w,
+            t_req=arrays.w_tas_req,
+            t_cnt=arrays.w_tas_count,
+            t_ssz=arrays.w_tas_slice_size,
+            t_sl=jnp.maximum(
+                arrays.w_tas_slice_level[w_iota, t_idx_w], 0
+            ),
+            t_rl=jnp.maximum(arrays.w_tas_req_level[w_iota, t_idx_w], 0),
+            t_rq=arrays.w_tas_required,
+            t_un=arrays.w_tas_unconstrained,
+        )
+    else:
+        zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
+        tas_in = dict(
+            do_tas=zw.astype(bool), t_row=zw.astype(jnp.int32),
+            t_req=zw[:, None], t_cnt=zw, t_ssz=zw,
+            t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
+            t_rq=zw.astype(bool), t_un=zw.astype(bool),
+        )
+
+    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
+              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -617,8 +662,18 @@ def hier_targets(
             & cand_real
         )  # [A, D+1]
 
-        def search(active_req, contested, req_vec):
+        def search(active_req, contested, req_vec, tas_probe=False):
             uses = jnp.any(contested[None, :] & (au > 0), axis=1)
+
+            if tas_probe:
+                rel_ok = adm.tas_t == t_row  # [A] same-topology victims
+                tas0_row = arrays.tas_usage0[t_row]  # [D,R1]
+
+                def tas_feas(state):
+                    return _tas_place.feasible_only(
+                        arrays.tas_topo, t_row, state, t_req, t_cnt,
+                        t_ssz, t_sl, t_rl, t_rq, t_un,
+                    )
 
             def above_nominal(u_f, nodes):
                 """∃ contested cell with usage above subtree quota."""
@@ -727,9 +782,10 @@ def hier_targets(
                 elig = cand & ~(
                     borrow_b & (variant == V_RECLAIM_WITHOUT_BORROWING)
                 )
+                t_state0 = tas0_row if tas_probe else jnp.zeros((), jnp.int64)
 
                 def fwd(carry, a):
-                    u_f, stopped = carry
+                    u_f, stopped, t_state = carry
                     # Dynamic validity (candidate_generator.go:135):
                     # same-CQ always valid; cross needs CQ + path-to-LCA
                     # above nominal against the running usage.
@@ -748,10 +804,15 @@ def hier_targets(
                     )[:, None] * au[a][None, :]
                     u_f = u_f - sub
                     hit = remove & fits_state(u_f, borrow_b)
-                    return (u_f, stopped | hit), (remove, hit)
+                    if tas_probe:
+                        t_state = t_state - jnp.where(
+                            remove & rel_ok[a], adm.tas_usage[a], 0
+                        )
+                        hit = hit & (~do_tas | tas_feas(t_state))
+                    return (u_f, stopped | hit, t_state), (remove, hit)
 
-                (u_end, _), (removed_o, hit_o) = jax.lax.scan(
-                    fwd, (u0_f, jnp.bool_(False)), ord_
+                (u_end, _, t_end), (removed_o, hit_o) = jax.lax.scan(
+                    fwd, (u0_f, jnp.bool_(False), t_state0), ord_
                 )
                 success = jnp.any(hit_o)
                 k_star = jnp.argmax(hit_o).astype(jnp.int32)
@@ -759,19 +820,25 @@ def hier_targets(
                 pre = removed_o & (pos <= k_star)
 
                 def fb(carry, xs):
-                    u_f = carry
+                    u_f, t_state = carry
                     is_t, a = xs
                     u_t = u_f + (
                         jnp.where(is_t, in_sub[:, adm.cq[a]], False)[:, None]
                         * au[a][None, :]
                     )
                     drop = is_t & fits_state(u_t, borrow_b)
+                    if tas_probe:
+                        t_try = t_state + jnp.where(
+                            is_t & rel_ok[a], adm.tas_usage[a], 0
+                        )
+                        drop = drop & (~do_tas | tas_feas(t_try))
+                        t_state = jnp.where(drop, t_try, t_state)
                     u_f = jnp.where(drop, u_t, u_f)
-                    return u_f, drop
+                    return (u_f, t_state), drop
 
                 fb_mask = pre & (pos < k_star)
-                u_fb, drops_rev = jax.lax.scan(
-                    fb, u_end, (fb_mask[::-1], ord_[::-1])
+                (u_fb, _t_fb), drops_rev = jax.lax.scan(
+                    fb, (u_end, t_end), (fb_mask[::-1], ord_[::-1])
                 )
                 drops = drops_rev[::-1]
                 victims_o = pre & ~drops & success
@@ -785,22 +852,20 @@ def hier_targets(
             victims = jnp.where(success, jnp.where(ok1, v1, v2), False)
             return success, victims, variant
 
+        # Full multi-resource search (with the tas_fits probe for TAS
+        # entries) + per-cell oracle probes (quota-only, matching the
+        # reference SimulatePreemption).
         eye = jnp.eye(r_n, dtype=bool)
-        probe_active = jnp.concatenate(
-            [full_active[None, :], eye & full_active[None, :]]
+        cell_active_p = eye & full_active[None, :]
+        cell_contested_p = eye & contested_full[None, :]
+        cell_req = jnp.where(cell_active_p, req[None, :], 0)
+        full_success, full_victims, variant = search(
+            full_active, contested_full,
+            jnp.where(full_active, req, 0), tas_probe=with_tas,
         )
-        probe_contested = jnp.concatenate(
-            [contested_full[None, :], eye & contested_full[None, :]]
-        )
-        probe_req = jnp.where(probe_active, req[None, :], 0)
-        succ_p, vict_p, variant_p = jax.vmap(search)(
-            probe_active, probe_contested, probe_req
-        )
-        full_success = succ_p[0]
-        full_victims = vict_p[0]
-        variant = variant_p[0]
-        cell_success = succ_p[1:]  # [R]
-        cell_victims = vict_p[1:]  # [R, A]
+        cell_success, cell_victims, _vc = jax.vmap(search)(
+            cell_active_p, cell_contested_p, cell_req
+        )  # [R], [R, A]
 
         # Post-removal borrow height per cell: the generalized
         # FindHeightOfLowestSubtreeThatFits walk (lend-free: per-level
@@ -860,6 +925,9 @@ def hier_targets(
         jax.vmap(per_w)(
             arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
             arrays.w_timestamp, eligible, praw_stop, considered,
+            tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
+            tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
+            tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)

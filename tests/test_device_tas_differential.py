@@ -200,6 +200,48 @@ def test_mixed_tas_and_preemption_fallback_ordering():
         assert run(seed, False) == run(seed, True), f"seed {seed}"
 
 
+def _run_preemption_differential(build, seed, device):
+    """Shared scaffolding for the TAS-preemption differential tests:
+    drive the scheduler built by ``build(seed, device)``, spy on host
+    fallback and evictions, return (end states, evictions, fallbacks)."""
+    mgr, sched, low, high = build(seed, device)
+    fallbacks = []
+    if device:
+        orig_hp = sched._host_process
+
+        def spy(infos):
+            fallbacks.extend(i.obj.name for i in infos)
+            return orig_hp(infos)
+
+        sched._host_process = spy
+    evictions = []
+    inner = sched.host if device else sched
+    orig_evict = inner.evict_fn
+
+    def evict(victim, er, pr):
+        evictions.append(f"{victim.obj.name}:{pr}")
+        orig_evict(victim, er, pr)
+
+    inner.evict_fn = evict
+    for wl in low:
+        mgr.create_workload(wl)
+    sched.schedule_all(max_cycles=30)
+    for wl in high:
+        mgr.create_workload(wl)
+    sched.schedule_all(max_cycles=30)
+    out = {}
+    for wl in low + high:
+        adm = wl.status.admission
+        if adm is None:
+            out[wl.name] = None
+        else:
+            psa = adm.pod_set_assignments[0]
+            ta = psa.topology_assignment
+            out[wl.name] = (sorted(psa.flavors.items()),
+                            sorted(ta.domains) if ta else None)
+    return out, sorted(evictions), fallbacks
+
+
 def test_tas_preemption_on_device_no_fallback():
     """Flat lend-free tree, TAS entries that need preemption: the victim
     search (incl. the tas_fits placement probe and victim TAS-usage
@@ -254,49 +296,82 @@ def test_tas_preemption_on_device_no_fallback():
             else mgr.scheduler
         return mgr, sched, low, high
 
-    def run(seed, device):
-        mgr, sched, low, high = build(seed, device)
-        fallbacks = []
-        if device:
-            orig_hp = sched._host_process
-
-            def spy(infos):
-                fallbacks.extend(i.obj.name for i in infos)
-                return orig_hp(infos)
-
-            sched._host_process = spy
-        evictions = []
-        inner = sched.host if device else sched
-        orig_evict = inner.evict_fn
-
-        def evict(victim, er, pr):
-            evictions.append(f"{victim.obj.name}:{pr}")
-            orig_evict(victim, er, pr)
-
-        inner.evict_fn = evict
-        if device:
-            sched.host.evict_fn = evict
-        for wl in low:
-            mgr.create_workload(wl)
-        sched.schedule_all(max_cycles=30)
-        for wl in high:
-            mgr.create_workload(wl)
-        sched.schedule_all(max_cycles=30)
-        out = {}
-        for wl in low + high:
-            adm = wl.status.admission
-            if adm is None:
-                out[wl.name] = None
-            else:
-                psa = adm.pod_set_assignments[0]
-                ta = psa.topology_assignment
-                out[wl.name] = (sorted(psa.flavors.items()),
-                                sorted(ta.domains) if ta else None)
-        return out, sorted(evictions), fallbacks
-
     for seed in range(6):
-        h_out, h_ev, _ = run(seed, False)
-        d_out, d_ev, d_fb = run(seed, True)
+        h_out, h_ev, _ = _run_preemption_differential(build, seed, False)
+        d_out, d_ev, d_fb = _run_preemption_differential(build, seed, True)
         assert d_out == h_out, f"seed {seed}: {h_out} vs {d_out}"
         assert d_ev == h_ev, f"seed {seed}: {h_ev} vs {d_ev}"
         assert not d_fb, f"seed {seed}: fell back for {d_fb}"
+
+
+def test_tas_preemption_hierarchical_on_device_no_fallback():
+    """Depth-2 lend-free cohort tree + TAS entries whose victim search
+    must reclaim across CQs: the hierarchical kernel (with the tas_fits
+    placement probe carried through the remove-until-fit scan) resolves
+    on device — no host fallback — and end states match the pure host
+    scheduler exactly."""
+    import random as _random
+
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption, Cohort
+
+    LVL = ["rack", "kubernetes.io/hostname"]
+
+    def build(seed, device):
+        rng = _random.Random(4200 + seed)
+        mgr = Manager()
+        pre = ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=PreemptionPolicy.ANY,
+        )
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            Cohort(name="root"),
+            Cohort(name="mid", parent="root"),
+            make_cq("cq-a", cohort="mid",
+                    flavors={"tpu-v5e": {"tpu": quota(16)}},
+                    resources=["tpu"], preemption=pre),
+            make_cq("cq-b", cohort="mid",
+                    flavors={"tpu-v5e": {"tpu": quota(16)}},
+                    resources=["tpu"], preemption=pre),
+            LocalQueue(name="lq-a", cluster_queue="cq-a"),
+            LocalQueue(name="lq-b", cluster_queue="cq-b"),
+            Topology(name="topo", levels=LVL),
+        )
+        for r in range(2):
+            for h in range(2):
+                mgr.apply(Node(name=f"n{r}{h}", labels={"rack": f"r{r}"},
+                               capacity={"tpu": 8}))
+        low = [Workload(
+            name=f"low{i}", queue_name="lq-b",
+            pod_sets=[PodSet(
+                name="main", count=rng.choice([1, 2]),
+                requests={"tpu": rng.choice([4, 8])},
+                topology_request=TopologyRequest(
+                    required_level=rng.choice(LVL)),
+            )],
+            priority=0, creation_time=float(i + 1),
+        ) for i in range(rng.randint(3, 5))]
+        high = [Workload(
+            name=f"high{i}", queue_name="lq-a",
+            pod_sets=[PodSet(
+                name="main", count=rng.choice([1, 2]),
+                requests={"tpu": rng.choice([4, 8])},
+                topology_request=TopologyRequest(
+                    required_level=rng.choice(LVL)),
+            )],
+            priority=200, creation_time=float(100 + i),
+        ) for i in range(rng.randint(1, 3))]
+        sched = DeviceScheduler(mgr.cache, mgr.queues) if device \
+            else mgr.scheduler
+        return mgr, sched, low, high
+
+    saw_eviction = False
+    for seed in range(6):
+        h_out, h_ev, _ = _run_preemption_differential(build, seed, False)
+        d_out, d_ev, d_fb = _run_preemption_differential(build, seed, True)
+        assert d_out == h_out, f"seed {seed}: {h_out} vs {d_out}"
+        assert d_ev == h_ev, f"seed {seed}: {h_ev} vs {d_ev}"
+        assert not d_fb, f"seed {seed}: fell back for {d_fb}"
+        saw_eviction = saw_eviction or bool(h_ev)
+    assert saw_eviction, "no scenario exercised hierarchical preemption"
