@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from kueue_tpu.api.constants import (
@@ -388,18 +389,18 @@ def encode_cycle(
         preempt_simple, preempt_hier, fair_node_ok, preempt_tas_ok = \
             _encode_admitted(snapshot, tidx, tree, idx, fair_sharing)
         preempt_fields.update(
-            bwc_policy=jnp.asarray(bwc_policy),
-            bwc_threshold=jnp.asarray(bwc_threshold),
-            bwc_has_threshold=jnp.asarray(bwc_has_threshold),
-            preempt_simple=jnp.asarray(preempt_simple),
-            w_has_gates=jnp.asarray(w_gates),
+            bwc_policy=np.asarray(bwc_policy),
+            bwc_threshold=np.asarray(bwc_threshold),
+            bwc_has_threshold=np.asarray(bwc_has_threshold),
+            preempt_simple=np.asarray(preempt_simple),
+            w_has_gates=np.asarray(w_gates),
         )
         if tas_device_flavors:
-            preempt_fields["preempt_tas_ok"] = jnp.asarray(preempt_tas_ok)
+            preempt_fields["preempt_tas_ok"] = np.asarray(preempt_tas_ok)
         if preempt_hier.any():
             # Omitted (None) when no nested lend-free tree exists, so the
             # common flat-only cycle compiles without the hier kernel.
-            preempt_fields["preempt_hier"] = jnp.asarray(preempt_hier)
+            preempt_fields["preempt_hier"] = np.asarray(preempt_hier)
     if fair_sharing:
         from kueue_tpu.utils import features as _features
 
@@ -410,18 +411,18 @@ def encode_cycle(
             fair_strategies
             or ["LessThanOrEqualToFinalShare", "LessThanInitialShare"]
         )
-        preempt_fields["node_weight"] = jnp.asarray(node_weight)
-        preempt_fields["node_is_cq"] = jnp.asarray(np.asarray(is_cq))
-        preempt_fields["fair_pwn"] = jnp.asarray(
+        preempt_fields["node_weight"] = np.asarray(node_weight)
+        preempt_fields["node_is_cq"] = np.asarray(np.asarray(is_cq))
+        preempt_fields["fair_pwn"] = np.asarray(
             _features.enabled("FairSharingPreemptWithinNominal")
         )
-        preempt_fields["fair_strat0"] = jnp.asarray(
+        preempt_fields["fair_strat0"] = np.asarray(
             np.int32(0 if strategies[0] == "LessThanOrEqualToFinalShare"
                      else 1)
         )
-        preempt_fields["fair_has_s2"] = jnp.asarray(len(strategies) > 1)
+        preempt_fields["fair_has_s2"] = np.asarray(len(strategies) > 1)
         if fair_node_ok is not None:
-            preempt_fields["fair_preempt_ok"] = jnp.asarray(fair_node_ok)
+            preempt_fields["fair_preempt_ok"] = np.asarray(fair_node_ok)
 
     # Cohort trees sharing a device TAS flavor are merged into one scan
     # group: their entries consume the same topology state, so the grouped
@@ -432,36 +433,43 @@ def encode_cycle(
     )
     from kueue_tpu.models.batch_scheduler import GroupArrays
 
-    idx.group_arrays = GroupArrays(*layout.as_jax())
+    idx.group_arrays = GroupArrays(*layout.as_numpy())
 
     arrays = CycleArrays(
         tree=tree,
         usage=usage_full,
-        flavor_at=jnp.asarray(flavor_at),
-        n_flavors=jnp.asarray(n_flavors),
-        covered=jnp.asarray(covered),
-        when_can_borrow_try_next=jnp.asarray(borrow_try_next),
-        when_can_preempt_try_next=jnp.asarray(preempt_try_next),
-        pref_preempt_over_borrow=jnp.asarray(pref_pob),
-        can_preempt_while_borrowing=jnp.asarray(cpwb),
-        never_preempts=jnp.asarray(never_preempts),
-        can_always_reclaim=jnp.asarray(can_always_reclaim),
-        usage_by_prio=jnp.asarray(usage_by_prio),
-        prio_cuts=jnp.asarray(prio_cuts),
-        prefilter_valid=jnp.asarray(prefilter_valid),
-        policy_within=jnp.asarray(policy_within),
-        policy_reclaim=jnp.asarray(policy_reclaim),
+        flavor_at=np.asarray(flavor_at),
+        n_flavors=np.asarray(n_flavors),
+        covered=np.asarray(covered),
+        when_can_borrow_try_next=np.asarray(borrow_try_next),
+        when_can_preempt_try_next=np.asarray(preempt_try_next),
+        pref_preempt_over_borrow=np.asarray(pref_pob),
+        can_preempt_while_borrowing=np.asarray(cpwb),
+        never_preempts=np.asarray(never_preempts),
+        can_always_reclaim=np.asarray(can_always_reclaim),
+        usage_by_prio=np.asarray(usage_by_prio),
+        prio_cuts=np.asarray(prio_cuts),
+        prefilter_valid=np.asarray(prefilter_valid),
+        policy_within=np.asarray(policy_within),
+        policy_reclaim=np.asarray(policy_reclaim),
         nominal_cq=tree.nominal,
-        w_cq=jnp.asarray(w_cq),
-        w_req=jnp.asarray(w_req),
-        w_elig=jnp.asarray(w_elig),
-        w_active=jnp.asarray(w_active),
-        w_priority=jnp.asarray(w_priority),
-        w_timestamp=jnp.asarray(w_timestamp),
-        w_quota_reserved=jnp.asarray(w_qr),
-        w_start_flavor=jnp.asarray(w_start),
-        w_order_rank=jnp.asarray(_order_rank(w_priority, w_timestamp)),
+        w_cq=np.asarray(w_cq),
+        w_req=np.asarray(w_req),
+        w_elig=np.asarray(w_elig),
+        w_active=np.asarray(w_active),
+        w_priority=np.asarray(w_priority),
+        w_timestamp=np.asarray(w_timestamp),
+        w_quota_reserved=np.asarray(w_qr),
+        w_start_flavor=np.asarray(w_start),
+        w_order_rank=np.asarray(_order_rank(w_priority, w_timestamp)),
         **preempt_fields,
+    )
+    # ONE batched host->device transfer for every encoded tensor: over a
+    # remote device transport (axon tunnel: 20-65 ms per dispatch),
+    # per-field jnp.asarray costs a round trip each — ~50 fields made the
+    # encode transfer-bound (2.2 s at the 15k-workload baseline).
+    arrays, idx.group_arrays, idx.admitted_arrays = jax.device_put(
+        (arrays, idx.group_arrays, idx.admitted_arrays)
     )
     return arrays, idx
 
@@ -616,18 +624,18 @@ def _encode_tas(
 
     fields = dict(
         tas_topo=topo,
-        tas_usage0=jnp.asarray(usage0),
-        tas_of_flavor=jnp.asarray(tas_of_flavor),
-        w_tas=jnp.asarray(w_tas),
-        w_tas_req=jnp.asarray(w_tas_req),
-        w_tas_usage_req=jnp.asarray(w_tas_usage_req),
-        w_tas_count=jnp.asarray(w_tas_count),
-        w_tas_slice_size=jnp.asarray(w_tas_slice_size),
-        w_tas_req_level=jnp.asarray(w_tas_req_level),
-        w_tas_slice_level=jnp.asarray(w_tas_slice_level),
-        w_tas_required=jnp.asarray(w_tas_required),
-        w_tas_unconstrained=jnp.asarray(w_tas_uncon),
-        w_tas_invalid=jnp.asarray(w_tas_invalid),
+        tas_usage0=np.asarray(usage0),
+        tas_of_flavor=np.asarray(tas_of_flavor),
+        w_tas=np.asarray(w_tas),
+        w_tas_req=np.asarray(w_tas_req),
+        w_tas_usage_req=np.asarray(w_tas_usage_req),
+        w_tas_count=np.asarray(w_tas_count),
+        w_tas_slice_size=np.asarray(w_tas_slice_size),
+        w_tas_req_level=np.asarray(w_tas_req_level),
+        w_tas_slice_level=np.asarray(w_tas_slice_level),
+        w_tas_required=np.asarray(w_tas_required),
+        w_tas_unconstrained=np.asarray(w_tas_uncon),
+        w_tas_invalid=np.asarray(w_tas_invalid),
     )
     return fields, root_merge
 
@@ -777,16 +785,16 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
         preempt_tas_ok[ni] = tas_root_ok[root_of[ni]]
 
     idx.admitted_arrays = AdmittedArrays(
-        cq=jnp.asarray(a_cq),
-        usage=jnp.asarray(a_usage),
-        prio=jnp.asarray(a_prio),
-        ts=jnp.asarray(a_ts),
-        qr_time=jnp.asarray(a_qr),
-        evicted=jnp.asarray(a_evicted),
-        active=jnp.asarray(a_active),
-        uid_rank=jnp.asarray(a_uid),
-        tas_t=jnp.asarray(a_tas_t) if t_n else None,
-        tas_usage=jnp.asarray(a_tas_usage) if t_n else None,
+        cq=np.asarray(a_cq),
+        usage=np.asarray(a_usage),
+        prio=np.asarray(a_prio),
+        ts=np.asarray(a_ts),
+        qr_time=np.asarray(a_qr),
+        evicted=np.asarray(a_evicted),
+        active=np.asarray(a_active),
+        uid_rank=np.asarray(a_uid),
+        tas_t=np.asarray(a_tas_t) if t_n else None,
+        tas_usage=np.asarray(a_tas_usage) if t_n else None,
     )
     return preempt_simple, preempt_hier, fair_node_ok, preempt_tas_ok
 

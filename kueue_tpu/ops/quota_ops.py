@@ -59,6 +59,9 @@ def sat_scatter_add(base: jnp.ndarray, idx: jnp.ndarray, deltas: jnp.ndarray) ->
     as an overflow detector, the returned values stay exact int64 below the
     cap.
     """
+    # Accept numpy inputs (encoders build host-side and batch the
+    # device transfer; eager callers may hand us either kind).
+    base = jnp.asarray(base)
     int_sum = base.at[idx].add(deltas, mode="drop")
     f_sum = base.astype(jnp.float64).at[idx].add(
         deltas.astype(jnp.float64), mode="drop"

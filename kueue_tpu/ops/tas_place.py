@@ -109,10 +109,10 @@ def encode_device_topos(
 
     return (
         TASDeviceTopo(
-            n_levels=jnp.asarray(n_levels),
-            level_size=jnp.asarray(level_size),
-            parent_idx=jnp.asarray(parent_idx),
-            leaf_cap=jnp.asarray(leaf_cap),
+            n_levels=np.asarray(n_levels),
+            level_size=np.asarray(level_size),
+            parent_idx=np.asarray(parent_idx),
+            leaf_cap=np.asarray(leaf_cap),
         ),
         per_flavor,
         leaf_perm,

@@ -115,19 +115,22 @@ def encode_tree(
             fi, ri = idx.fr_index(fr)
             subtree[i, fi, ri] = v
 
+    # Numpy leaves throughout: the cycle encoder ships the finished
+    # pytrees to the device in ONE batched transfer (models/encode.py) —
+    # per-field transfers cost a round trip each over a remote transport.
     tree = QuotaTreeArrays(
-        parent=jnp.asarray(parent),
-        active=jnp.asarray(active),
-        depth=jnp.asarray(depth),
-        height=jnp.asarray(height),
-        nominal=jnp.asarray(nominal),
-        borrow_limit=jnp.asarray(borrow_limit),
-        has_borrow_limit=jnp.asarray(has_borrow),
-        lend_limit=jnp.asarray(lend_limit),
-        has_lend_limit=jnp.asarray(has_lend),
-        subtree_quota=jnp.asarray(subtree),
+        parent=parent,
+        active=active,
+        depth=depth,
+        height=height,
+        nominal=nominal,
+        borrow_limit=borrow_limit,
+        has_borrow_limit=has_borrow,
+        lend_limit=lend_limit,
+        has_lend_limit=has_lend,
+        subtree_quota=subtree,
     )
-    return tree, idx, jnp.asarray(usage), jnp.asarray(is_cq)
+    return tree, idx, usage, is_cq
 
 
 class GroupLayout:
@@ -200,4 +203,13 @@ class GroupLayout:
             jnp.asarray(self.node_sel),
             jnp.asarray(self.local_valid),
             jnp.asarray(self.chain_local),
+        )
+
+    def as_numpy(self):
+        return (
+            self.flat_to_group,
+            self.flat_to_local,
+            self.node_sel,
+            self.local_valid,
+            self.chain_local,
         )
