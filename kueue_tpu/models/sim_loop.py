@@ -39,14 +39,18 @@ class SimOutputs(NamedTuple):
 
 def make_sim_loop(s_max: int, max_rounds: int = 100000,
                   kernel: str = "grouped",
-                  n_levels: int = quota_ops.MAX_DEPTH + 1):
+                  n_levels: int = quota_ops.MAX_DEPTH + 1,
+                  interpret: bool = False):
     """Build the jittable simulator. ``s_max`` is the per-tree admission
     scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
-    admission pass: "grouped" (the sequential per-tree scan) or
+    admission pass: "grouped" (the sequential per-tree scan),
     "fixedpoint" (monotone-bounds rounds — usually far fewer device steps
     per cycle; exact only for lending-limit-free trees, which the caller
-    must check)."""
-    assert kernel in ("grouped", "fixedpoint")
+    must check), or "pallas" (the whole per-tree scan as one Pallas
+    kernel with VMEM-resident state — exact only when
+    ``pallas_scan.fits_int32`` holds for the cycle arrays, which the
+    caller must check; ``interpret`` runs it in interpreter mode off-TPU)."""
+    assert kernel in ("grouped", "fixedpoint", "pallas")
 
     def simulate(
         arrays: CycleArrays, ga: GroupArrays, runtime_ms: jnp.ndarray
@@ -103,6 +107,13 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             if kernel == "fixedpoint":
                 _u, admit, _r = bs.admit_fixedpoint(
                     a, ga, nom, usage, order, n_levels=n_levels
+                )
+            elif kernel == "pallas":
+                from kueue_tpu.models.pallas_scan import pallas_admit_scan
+
+                _u, admit, _pre = pallas_admit_scan(
+                    a, ga, nom, usage, order, s_max, n_levels=n_levels,
+                    interpret=interpret,
                 )
             else:
                 _u, admit, _pre = bs.admit_scan_grouped(

@@ -128,3 +128,27 @@ def test_sim_loop_fixedpoint_kernel_matches_grouped(seed):
         np.asarray(out_g.completed_at), np.asarray(out_f.completed_at)
     )
     assert int(out_g.rounds) == int(out_f.rounds)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_sim_loop_pallas_kernel_matches_grouped(seed):
+    """The Pallas admission scan must drive the simulator to the exact
+    same trajectory as the XLA per-tree scan (valid here: synth arrays
+    satisfy the int32 gate)."""
+    from kueue_tpu.models.pallas_scan import fits_int32
+
+    arrays, ga = synth(seed + 11, W=48, C=8, F=2, R=2, COHORTS=3)
+    assert fits_int32(arrays)
+    rng = np.random.default_rng(seed)
+    runtime_ms = jnp.asarray(rng.integers(100, 1000, 48).astype(np.int64))
+    out_g = jax.jit(make_sim_loop(s_max=48))(arrays, ga, runtime_ms)
+    out_p = jax.jit(
+        make_sim_loop(s_max=48, kernel="pallas", interpret=True)
+    )(arrays, ga, runtime_ms)
+    np.testing.assert_array_equal(
+        np.asarray(out_g.admitted_at), np.asarray(out_p.admitted_at)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_g.completed_at), np.asarray(out_p.completed_at)
+    )
+    assert int(out_g.rounds) == int(out_p.rounds)
