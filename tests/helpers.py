@@ -68,7 +68,7 @@ def make_wl(
     cpu_m: int = 1000,
     count: int = 1,
     priority: int = 0,
-    creation_time: float = 0.0,
+    creation_time: Optional[float] = None,
     min_count: Optional[int] = None,
     requests: Optional[Dict[str, int]] = None,
     namespace: str = "default",
@@ -79,13 +79,20 @@ def make_wl(
         requests=requests or {"cpu": cpu_m},
         min_count=min_count,
     )
+    # None -> unique auto timestamp. An explicit value (including 0.0) is
+    # used verbatim: a falsy-zero fallthrough here once made differential
+    # tests compare two DIFFERENT scenarios (the counter is process-global,
+    # so the second run of the same build saw different timestamps).
     return Workload(
         name=name,
         namespace=namespace,
         queue_name=queue,
         pod_sets=[ps],
         priority=priority,
-        creation_time=creation_time or float(next(_counter)),
+        creation_time=(
+            float(next(_counter)) if creation_time is None
+            else creation_time
+        ),
     )
 
 
