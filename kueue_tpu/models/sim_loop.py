@@ -46,11 +46,14 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
     admission pass: "grouped" (the sequential per-tree scan),
     "fixedpoint" (monotone-bounds rounds — usually far fewer device steps
     per cycle; exact only for lending-limit-free trees, which the caller
-    must check), or "pallas" (the whole per-tree scan as one Pallas
+    must check), "pallas" (the whole per-tree scan as one Pallas
     kernel with VMEM-resident state — exact only when
     ``pallas_scan.fits_int32`` holds for the cycle arrays, which the
-    caller must check; ``interpret`` runs it in interpreter mode off-TPU)."""
-    assert kernel in ("grouped", "fixedpoint", "pallas")
+    caller must check; ``interpret`` runs it in interpreter mode
+    off-TPU), or "fair" (the DRS tournament admission — requires the
+    fair fields on CycleArrays; per round each CQ is represented by its
+    last pending entry, mirroring the per-CQ-heads cycle semantics)."""
+    assert kernel in ("grouped", "fixedpoint", "pallas", "fair")
 
     def simulate(
         arrays: CycleArrays, ga: GroupArrays, runtime_ms: jnp.ndarray
@@ -103,19 +106,28 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             usage = recompute_usage(running, chosen_flavor)
             a = arrays._replace(w_active=pending, usage=usage)
             nom = bs.nominate(a, usage, n_levels=n_levels)
-            order = bs.admission_order(a, nom)
-            if kernel == "fixedpoint":
+            if kernel == "fair":
+                from kueue_tpu.models.fair_kernel import fair_admit_scan
+
+                # The tournament orders entries itself (dynamic DRS keys).
+                _u, admit, _pre, _shadowed, _part = fair_admit_scan(
+                    a, nom, usage, s_max
+                )
+            elif kernel == "fixedpoint":
+                order = bs.admission_order(a, nom)
                 _u, admit, _r = bs.admit_fixedpoint(
                     a, ga, nom, usage, order, n_levels=n_levels
                 )
             elif kernel == "pallas":
                 from kueue_tpu.models.pallas_scan import pallas_admit_scan
 
+                order = bs.admission_order(a, nom)
                 _u, admit, _pre = pallas_admit_scan(
                     a, ga, nom, usage, order, s_max, n_levels=n_levels,
                     interpret=interpret,
                 )
             else:
+                order = bs.admission_order(a, nom)
                 _u, admit, _pre = bs.admit_scan_grouped(
                     a, ga, nom, usage, order, s_max, n_levels=n_levels
                 )

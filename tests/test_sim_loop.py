@@ -152,3 +152,104 @@ def test_sim_loop_pallas_kernel_matches_grouped(seed):
         np.asarray(out_g.completed_at), np.asarray(out_p.completed_at)
     )
     assert int(out_g.rounds) == int(out_p.rounds)
+
+
+def _with_fair_fields(arrays, seed):
+    """Attach the fair-tournament fields (normally set by encode_cycle
+    with fair_sharing=True) with non-uniform weights."""
+    rng = np.random.default_rng(seed)
+    n = arrays.tree.n_nodes
+    parent = np.asarray(arrays.tree.parent)
+    is_parent = np.zeros(n, bool)
+    for p in parent:
+        if p >= 0:
+            is_parent[p] = True
+    is_cq = np.asarray(arrays.tree.active) & ~is_parent
+    weight = rng.choice([0.5, 1.0, 2.0, 4.0], n)
+    return arrays._replace(
+        node_weight=jnp.asarray(weight),
+        node_is_cq=jnp.asarray(is_cq),
+        fair_pwn=jnp.asarray(False),
+        fair_strat0=jnp.asarray(np.int32(0)),
+        fair_has_s2=jnp.asarray(True),
+    )
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_sim_loop_fair_kernel_matches_python_loop(seed):
+    """kernel="fair": the while_loop simulator must reproduce the exact
+    trajectory of a python-driven loop over the same per-round fair
+    tournament (nominate -> fair_admit_scan -> apply -> advance)."""
+    from kueue_tpu.models.fair_kernel import fair_admit_scan
+
+    arrays, ga = synth(seed + 21, W=48, C=8, F=2, R=2, COHORTS=3)
+    arrays = _with_fair_fields(arrays, seed)
+    rng = np.random.default_rng(seed)
+    runtime_ms = jnp.asarray(rng.integers(100, 1000, 48).astype(np.int64))
+    out = jax.jit(make_sim_loop(s_max=48, kernel="fair"))(
+        arrays, ga, runtime_ms
+    )
+
+    # Python-driven twin.
+    fair_jit = jax.jit(lambda a, n, u: fair_admit_scan(a, n, u, 48))
+    w_n = 48
+    tree = arrays.tree
+    parent = np.asarray(tree.parent)
+    is_parent = np.zeros(tree.n_nodes, bool)
+    for p in parent:
+        if p >= 0:
+            is_parent[p] = True
+    is_cq = np.asarray(tree.active) & ~is_parent
+    base_cq = np.where(is_cq[:, None, None], np.asarray(arrays.usage), 0)
+    pending = np.asarray(arrays.w_active).copy()
+    running = np.zeros(w_n, bool)
+    admitted_at = np.full(w_n, -1, np.int64)
+    completed_at = np.full(w_n, -1, np.int64)
+    chosen = np.full(w_n, -1, np.int32)
+    vclock = 0
+    w_req = np.asarray(arrays.w_req)
+    w_cq = np.asarray(arrays.w_cq)
+    covered = np.asarray(arrays.covered)
+    for _ in range(500):
+        if not pending.any():
+            break
+        cq_add = np.zeros_like(base_cq)
+        for i in range(w_n):
+            if running[i]:
+                for r in range(w_req.shape[1]):
+                    v = w_req[i, r]
+                    if v > 0 and covered[w_cq[i], r]:
+                        cq_add[w_cq[i], chosen[i], r] += v
+        _s, u = quota_ops.compute_subtree_jit(
+            tree, jnp.asarray(base_cq + cq_add), jnp.asarray(is_cq)
+        )
+        a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
+        nom = _nominate_jit(a, u)
+        _u2, admit, _pre, _sh, _part = fair_jit(a, nom, u)
+        admit = np.asarray(admit) & pending
+        if admit.any():
+            for i in np.where(admit)[0]:
+                pending[i] = False
+                running[i] = True
+                admitted_at[i] = vclock
+                chosen[i] = int(np.asarray(nom.chosen_flavor)[i])
+            continue
+        comps = [
+            (admitted_at[i] + int(runtime_ms[i]), i)
+            for i in range(w_n) if running[i]
+        ]
+        if not comps:
+            break
+        vclock = min(c for c, _ in comps)
+        for c, i in comps:
+            if c <= vclock:
+                running[i] = False
+                completed_at[i] = vclock
+    for i in range(w_n):
+        if running[i]:
+            completed_at[i] = admitted_at[i] + int(runtime_ms[i])
+
+    np.testing.assert_array_equal(np.asarray(out.admitted_at), admitted_at)
+    np.testing.assert_array_equal(
+        np.asarray(out.completed_at), completed_at
+    )
