@@ -86,6 +86,8 @@ class CycleOutputs(NamedTuple):
     # Device-preemption outputs (None on the no-preempt kernels).
     victims: jnp.ndarray = None  # bool[W,A] victim set of OUT_PREEMPTING rows
     victim_variant: jnp.ndarray = None  # i32[W,A] preemption reason codes
+    # Partial admission: reduced pod count (-1 = full count / not found).
+    partial_count: jnp.ndarray = None  # i64[W]
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -287,6 +289,109 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
     return NominateResult(b_f.astype(jnp.int32), b_pm.astype(jnp.int32),
                           b_bw.astype(jnp.int32), needs_host, tried,
                           praw_n, praw_stop, n_cons)
+
+
+# Static probe-step bound for the partial-admission binary search: the
+# search space is [0, count - min_count]; 22 halvings cover 4M pods.
+_PARTIAL_STEPS = 22
+
+
+def partial_search(
+    arrays: CycleArrays, usage: jnp.ndarray, nom: NominateResult,
+    n_levels: int = MAX_DEPTH + 1,
+) -> Tuple[NominateResult, jnp.ndarray, jnp.ndarray]:
+    """Device PodSetReducer (reference flavorassigner/podset_reducer.go:67
+    + the host's Scheduler._search_partial): for every reducible entry
+    whose full-count assignment is not Fit, binary-search the smallest
+    reduction whose assignment mode is Fit, replicating the host's exact
+    probe sequence (sort.Search semantics — same midpoints, same final
+    lo-probe, so results agree even off the monotone happy path).
+
+    The class is pre-gated by the encoder to never-preempts CQs, so the
+    probe predicate is pure Fit — no oracle. Each probe re-runs the full
+    vectorized ``nominate`` on scaled per-pod requests (flavor choice may
+    change with the count, exactly like the host re-running assign()).
+
+    Returns (updated nominate result, updated w_req, partial_count[W]
+    with -1 for full-count entries).
+    """
+    delta = arrays.w_count - arrays.w_min_count
+    searching = (
+        arrays.w_partial
+        & arrays.w_active
+        & (nom.best_pmode != P_FIT)
+        & ~nom.needs_host
+        & (delta > 0)
+    )
+
+    def probe(count_probe):
+        req_p = jnp.where(
+            searching[:, None],
+            arrays.w_req_pp * count_probe[:, None],
+            arrays.w_req,
+        )
+        return nominate(
+            arrays._replace(w_req=req_p), usage, n_levels=n_levels
+        )
+
+    def step(carry, _):
+        lo, hi, best, bf, bb, bt = carry
+        go = searching & (lo < hi)
+        mid = (lo + hi) // 2
+        # Probe only while some lane is still searching; converged
+        # iterations of the fixed-length scan skip the nominate pass
+        # (its results would be fully masked by ``go`` anyway).
+        nm = jax.lax.cond(
+            jnp.any(go),
+            lambda: probe(arrays.w_count - mid),
+            lambda: nom,
+        )
+        fit = go & (nm.best_pmode == P_FIT)
+        best = jnp.where(fit, mid, best)
+        bf = jnp.where(fit, nm.chosen_flavor, bf)
+        bb = jnp.where(fit, nm.best_borrow, bb)
+        bt = jnp.where(fit, nm.tried_flavor_idx, bt)
+        hi = jnp.where(fit, mid, hi)
+        lo = jnp.where(go & ~fit, mid + 1, lo)
+        return (lo, hi, best, bf, bb, bt), None
+
+    init = (
+        jnp.zeros_like(delta), delta, jnp.full_like(delta, -1),
+        nom.chosen_flavor, nom.best_borrow, nom.tried_flavor_idx,
+    )
+    (lo, _hi, best, bf, bb, bt), _ = jax.lax.scan(
+        step, init, None, length=_PARTIAL_STEPS
+    )
+
+    # sort.Search tail: nothing found inside the loop -> one last probe
+    # at lo (== hi after convergence).
+    need_final = searching & (best < 0) & (lo <= delta)
+    nm = jax.lax.cond(
+        jnp.any(need_final),
+        lambda: probe(
+            jnp.where(need_final, arrays.w_count - lo, arrays.w_count)
+        ),
+        lambda: nom,
+    )
+    fit_f = need_final & (nm.best_pmode == P_FIT)
+    best = jnp.where(fit_f, lo, best)
+    bf = jnp.where(fit_f, nm.chosen_flavor, bf)
+    bb = jnp.where(fit_f, nm.best_borrow, bb)
+    bt = jnp.where(fit_f, nm.tried_flavor_idx, bt)
+
+    found = searching & (best >= 0)
+    new_count = arrays.w_count - jnp.maximum(best, 0)
+    new_req = jnp.where(
+        found[:, None], arrays.w_req_pp * new_count[:, None], arrays.w_req
+    )
+    nom2 = nom._replace(
+        chosen_flavor=jnp.where(found, bf, nom.chosen_flavor),
+        best_pmode=jnp.where(found, P_FIT, nom.best_pmode),
+        best_borrow=jnp.where(found, bb, nom.best_borrow),
+        tried_flavor_idx=jnp.where(found, bt, nom.tried_flavor_idx),
+    )
+    partial_count = jnp.where(found, new_count, jnp.int64(-1))
+    return nom2, new_req, partial_count
 
 
 def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
@@ -781,7 +886,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
     the scan designates victims with overlap/fit semantics."""
 
     def finish(arrays, nom, final_usage, admitted, preempting, order,
-               victims=None, variant=None):
+               victims=None, variant=None, partial_count=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -820,12 +925,19 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             order=order,
             victims=victims,
             victim_variant=variant,
+            partial_count=partial_count,
         )
 
     if not preempt:
         def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
             usage = arrays.usage
             nom = nominate(arrays, usage, n_levels=n_levels)
+            partial_count = None
+            if arrays.w_partial is not None:
+                nom, new_req, partial_count = partial_search(
+                    arrays, usage, nom, n_levels=n_levels
+                )
+                arrays = arrays._replace(w_req=new_req)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             final_usage, admitted, preempting = admit_scan_grouped(
@@ -833,7 +945,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 n_levels=n_levels,
             )
             return finish(arrays, nom, final_usage, admitted, preempting,
-                          order)
+                          order, partial_count=partial_count)
 
         return impl
 
@@ -971,6 +1083,14 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             ),
             needs_host=nom.needs_host & ~tgt.resolved,
         )
+        partial_count = None
+        if arrays.w_partial is not None:
+            # Partial entries live on never-preempts CQs, so the search
+            # runs after (and independent of) the preemption resolution.
+            nom, new_req, partial_count = partial_search(
+                arrays, usage, nom, n_levels=n_levels
+            )
+            arrays = arrays._replace(w_req=new_req)
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         final_usage, admitted, preempting = admit_scan_grouped(
@@ -978,7 +1098,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             unroll=unroll, n_levels=n_levels,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
-                      victims=tgt.victims, variant=tgt.variant)
+                      victims=tgt.victims, variant=tgt.variant,
+                      partial_count=partial_count)
 
     return impl_preempt
 

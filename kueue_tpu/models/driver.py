@@ -94,7 +94,7 @@ class DeviceScheduler:
                 from kueue_tpu.models.fair_kernel import cycle_fair_preempt
 
                 out = cycle_fair_preempt(arrays, idx.admitted_arrays)
-            elif self.use_fixedpoint and not bool(
+            elif self.use_fixedpoint and not idx.has_partial and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
                 out = batch_scheduler.cycle_fixedpoint(
@@ -107,6 +107,10 @@ class DeviceScheduler:
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)
+            partial = (
+                np.asarray(out.partial_count)
+                if out.partial_count is not None else None
+            )
             victims = (
                 np.asarray(out.victims) if out.victims is not None else None
             )
@@ -151,6 +155,11 @@ class DeviceScheduler:
                     self._apply_admission(
                         info, idx.flavors[chosen[i]], int(tried[i]),
                         snapshot, topology_assignment=tas_assignments.get(i),
+                        reduced_count=(
+                            int(partial[i])
+                            if partial is not None and partial[i] >= 0
+                            else None
+                        ),
                     )
                     result.admitted.append(info.key)
                 elif oc == batch_scheduler.OUT_PREEMPTING:
@@ -289,11 +298,17 @@ class DeviceScheduler:
 
     def _apply_admission(
         self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot,
-        topology_assignment=None,
+        topology_assignment=None, reduced_count=None,
     ) -> None:
         now = self.clock()
         cqs = snapshot.cluster_queues[info.cluster_queue]
         ps = info.total_requests[0]
+        if reduced_count is not None and reduced_count != ps.count:
+            # Partial admission: scale the tracked totals to the found
+            # count (host analog: Scheduler._admit's ps.scaled_to).
+            scaled = ps.scaled_to(reduced_count)
+            ps.requests = scaled.requests
+            ps.count = reduced_count
         flavors = {res: flavor for res, v in ps.requests.items()}
         admission = Admission(
             cluster_queue=info.cluster_queue,

@@ -83,6 +83,12 @@ class CycleArrays(NamedTuple):
     # CQ's tree has fully device-representable admitted TAS usage: the
     # victim search may run its tas_fits probe on device for TAS entries.
     preempt_tas_ok: Optional[jnp.ndarray] = None  # bool[N]
+    # -- partial admission (None when no device partial entry this cycle;
+    # PodSetReducer class: single podset, never-preempts CQ) --
+    w_req_pp: Optional[jnp.ndarray] = None  # i64[W,R] per-pod requests
+    w_count: Optional[jnp.ndarray] = None  # i64[W] requested pod count
+    w_min_count: Optional[jnp.ndarray] = None  # i64[W]
+    w_partial: Optional[jnp.ndarray] = None  # bool[W] reducible entry
     w_has_gates: Optional[jnp.ndarray] = None  # bool[W] preemptionGates open
     # -- device TAS (None when no TAS flavor is device-encoded) --
     tas_topo: Optional[object] = None  # ops.tas_place.TASDeviceTopo
@@ -127,6 +133,7 @@ class CycleIndex:
     tas_snapshots: List[object] = field(default_factory=list)
     tas_leaf_perm: List[List[int]] = field(default_factory=list)
     tas_pad_shape: Tuple[int, int] = (0, 0)  # (D, R+1) padded axes
+    has_partial: bool = False  # any reducible (partial-admission) entry
 
 
 def _round_up(n: int, m: int) -> int:
@@ -313,7 +320,7 @@ def encode_cycle(
         if not fair_host and _device_compatible(
                 info, snapshot, single_rg_cq,
                 set(tas_device_flavors), delay_tas_fn,
-                preempt):
+                preempt, fair_sharing):
             device_wls.append(info)
         else:
             idx.host_fallback.append(info)
@@ -328,8 +335,15 @@ def encode_cycle(
     w_qr = np.zeros(w, dtype=bool)
     w_start = np.zeros(w, dtype=np.int32)
     w_gates = np.zeros(w, dtype=bool)
+    w_pp = np.zeros((w, r), dtype=np.int64)
+    w_cnt = np.ones(w, dtype=np.int64)
+    w_minc = np.ones(w, dtype=np.int64)
+    w_part = np.zeros(w, dtype=bool)
 
     from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
+    from kueue_tpu.utils import features as _feat
+
+    partial_on = _feat.enabled("PartialAdmission") and not fair_sharing
 
     for i, info in enumerate(device_wls):
         idx.workloads.append(info)
@@ -345,6 +359,18 @@ def encode_cycle(
         for res, v in ps.requests.items():
             if res in tidx.resource_of:
                 w_req[i, tidx.resource_of[res]] = v
+        ps0 = info.obj.pod_sets[0]
+        w_cnt[i] = ps0.count
+        w_minc[i] = ps0.count
+        if (partial_on and ps0.min_count is not None
+                and ps0.min_count < ps0.count):
+            # Reducible entry (vetted by _device_compatible: single
+            # podset, never-preempts CQ, exact per-pod totals).
+            w_part[i] = True
+            w_minc[i] = ps0.min_count
+            for res, v in ps0.requests.items():
+                if res in tidx.resource_of:
+                    w_pp[i, tidx.resource_of[res]] = v
         # Taints/affinity eligibility per flavor (host-side; reuses the
         # exact assigner's check). The verdict depends only on flavor specs
         # and the podset, so it is cached on the WorkloadInfo keyed by the
@@ -371,6 +397,14 @@ def encode_cycle(
             res_keys = [r for r in ps.requests if r in tidx.resource_of]
             res0 = res_keys[0] if res_keys else ""
             w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
+
+    partial_fields: Dict[str, object] = {}
+    if w_part.any():
+        idx.has_partial = True
+        partial_fields = dict(
+            w_req_pp=w_pp, w_count=w_cnt, w_min_count=w_minc,
+            w_partial=w_part,
+        )
 
     preempt_fields: Dict[str, object] = {}
     root_merge = None
@@ -462,6 +496,7 @@ def encode_cycle(
         w_quota_reserved=np.asarray(w_qr),
         w_start_flavor=np.asarray(w_start),
         w_order_rank=np.asarray(_order_rank(w_priority, w_timestamp)),
+        **partial_fields,
         **preempt_fields,
     )
     # ONE batched host->device transfer for every encoded tensor: over a
@@ -806,6 +841,7 @@ def _device_compatible(
     tas_device_flavors: set = frozenset(),
     delay_tas_fn=None,
     preempt: bool = False,
+    fair_sharing: bool = False,
 ) -> bool:
     if info.cluster_queue not in snapshot.cluster_queues:
         return False
@@ -814,9 +850,37 @@ def _device_compatible(
     if len(info.total_requests) != 1:
         return False
     ps = info.obj.pod_sets[0]
-    if ps.min_count is not None and ps.min_count < ps.count:
-        return False  # partial admission -> host path
     cqs = snapshot.cluster_queues[info.cluster_queue]
+    if ps.min_count is not None and ps.min_count < ps.count:
+        # Partial admission (PodSetReducer): the device search handles the
+        # single-podset never-preempts class under the PartialAdmission
+        # gate (the probe predicate is then pure FIT — no oracle). With
+        # the feature off there is no search anywhere, so the entry is an
+        # ordinary full-count entry.
+        from kueue_tpu.api.constants import PreemptionPolicy
+        from kueue_tpu.utils import features as _features
+
+        if _features.enabled("PartialAdmission"):
+            p = cqs.spec.preemption
+            never = (
+                p.within_cluster_queue == PreemptionPolicy.NEVER
+                and p.reclaim_within_cohort == PreemptionPolicy.NEVER
+            )
+            if fair_sharing or not never or ps.topology_request is not None:
+                return False
+            # The search scales per-pod requests; totals must be the
+            # plain per-pod x count product (no reclaimed-pods skew).
+            tot = info.total_requests[0]
+            if tot.count != ps.count or any(
+                tot.requests.get(res, 0) != v * ps.count
+                for res, v in ps.requests.items()
+            ):
+                return False
+            # The device binary search is bounded by
+            # batch_scheduler._PARTIAL_STEPS (22) probe halvings; a wider
+            # reduction range could not converge — host path.
+            if ps.count - ps.min_count >= (1 << 22):
+                return False
     if ps.topology_request is not None:
         tr = ps.topology_request
         if not preempt:

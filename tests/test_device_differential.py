@@ -266,3 +266,81 @@ def test_device_mode_respects_afs_head_ordering():
     sched.schedule()
     admitted = [i.obj.name for i in cache.workloads.values()]
     assert admitted == ["l"]
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_device_partial_admission_matches_host(seed):
+    """Reducible (min_count < count) workloads on never-preempts CQs:
+    the device PodSetReducer binary search must admit the exact same
+    reduced counts, flavors and end states as the host scheduler, with
+    zero host fallback."""
+    rng = random.Random(7_000 + seed)
+    n_flavors = rng.randint(1, 3)
+    flavor_specs = [ResourceFlavor(name=f"f{j}") for j in range(n_flavors)]
+    cohorts = [Cohort(name="co")] if rng.random() < 0.5 else []
+    cqs = []
+    for c in range(rng.randint(1, 3)):
+        flavors = {
+            f"f{j}": {"cpu": quota(rng.randrange(2, 10) * 1000)}
+            for j in range(n_flavors)
+        }
+        cqs.append(make_cq(
+            f"cq{c}",
+            cohort="co" if cohorts else None,
+            flavors=flavors,
+            resources=["cpu"],
+        ))
+
+    def scenario():
+        out = []
+        for i in range(rng.randint(3, 10)):
+            cq = rng.choice(cqs)
+            count = rng.randrange(2, 12)
+            wl = make_wl(
+                f"wl{i}",
+                queue=f"lq-{cq.name}",
+                cpu_m=rng.randrange(1, 4) * 500,
+                count=count,
+                min_count=(
+                    rng.randrange(1, count) if rng.random() < 0.7 else None
+                ),
+                priority=rng.randrange(0, 3) * 100,
+                creation_time=float(i + 1),
+            )
+            out.append(wl)
+        return out
+
+    state = rng.getstate()
+
+    def run(device):
+        rng.setstate(state)
+        cache, queues, host = build_env(
+            cqs, cohorts=cohorts, flavors=flavor_specs
+        )
+        sched = DeviceScheduler(cache, queues) if device else host
+        fallbacks = []
+        if device:
+            orig = sched._host_process
+            sched._host_process = lambda infos: (
+                fallbacks.extend(i.obj.name for i in infos)
+                or orig(infos)
+            )
+        submit(queues, *scenario())
+        sched.schedule_all(max_cycles=30)
+        admissions = {}
+        for key, info in cache.workloads.items():
+            adm = info.obj.status.admission
+            if adm is None:
+                admissions[info.obj.name] = None
+            else:
+                psa = adm.pod_set_assignments[0]
+                admissions[info.obj.name] = (
+                    sorted(psa.flavors.items()), psa.count,
+                    sorted(psa.resource_usage.items()),
+                )
+        return admissions, fallbacks
+
+    h_adm, _ = run(False)
+    d_adm, d_fb = run(True)
+    assert d_adm == h_adm, f"host={h_adm} device={d_adm}"
+    assert not d_fb, f"device fell back for {d_fb}"
