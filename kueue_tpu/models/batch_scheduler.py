@@ -767,16 +767,19 @@ def admit_scan_grouped(
             rl_g = arrays.w_tas_req_level[w, t_idx_g]
             sl_g = arrays.w_tas_slice_level[w, t_idx_g]
 
-            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_):
+            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_):
                 return _tas_place.place(
                     arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+                    cap_override=cap_,
                 )
 
+            cap_g = _tas_place.entry_leaf_cap(arrays, t_idx_g, w=w)
             tas_feas, tas_take = jax.vmap(place_one)(
                 t_idx_g, arrays.w_tas_req[w], arrays.w_tas_count[w],
                 arrays.w_tas_slice_size[w], sl_g, rl_g,
                 arrays.w_tas_required[w], arrays.w_tas_unconstrained[w],
+                cap_g,
             )  # [G], [G, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:
@@ -976,21 +979,26 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             rl = arrays.w_tas_req_level[w_iota, t_idx]
             sl = arrays.w_tas_slice_level[w_iota, t_idx]
 
-            def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_):
+            def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_,
+                     cap_):
                 return tas_place.feasible_only(
                     arrays.tas_topo, t, usage_all[t], req, count, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+                    cap_override=cap_,
                 )
 
+            # Per-entry filtered leaf capacity (node selector / taint
+            # matching) replaces the topology's static capacity where set.
+            cap_all = tas_place.entry_leaf_cap(arrays, t_idx)
             feas_args = (
                 t_idx, arrays.w_tas_req, arrays.w_tas_count,
                 arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
-                arrays.w_tas_unconstrained,
+                arrays.w_tas_unconstrained, cap_all,
             )
-            feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 8)(
+            feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
                 arrays.tas_usage0, *feas_args
             )
-            feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 8)(
+            feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
                 jnp.zeros_like(arrays.tas_usage0), *feas_args
             )
             ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid

@@ -273,6 +273,8 @@ class DeviceScheduler:
                 unconstrained=tr.unconstrained,
                 slice_size=tr.slice_size or 1,
                 slice_required_level=tr.slice_required_level,
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
             )
             ta, _leader, reason = tas.find_topology_assignment(
                 req, assumed_usage=assumed.get(fname)

@@ -104,6 +104,11 @@ class CycleArrays(NamedTuple):
     w_tas_required: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_unconstrained: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_invalid: Optional[jnp.ndarray] = None  # bool[W] always-infeasible
+    # Per-entry filtered leaf capacity (selector/taint matching; None when
+    # no entry this cycle needs node filtering): i64[W, D, R+1] rows are
+    # meaningful where w_tas_has_cap; other entries use the topology cap.
+    w_tas_cap: Optional[jnp.ndarray] = None
+    w_tas_has_cap: Optional[jnp.ndarray] = None  # bool[W]
     # -- fair sharing (None unless the fair tournament kernel is in use) --
     node_weight: Optional[jnp.ndarray] = None  # f64[N] FairSharing weight
     node_is_cq: Optional[jnp.ndarray] = None  # bool[N]
@@ -573,6 +578,14 @@ def _encode_tas(
     w_tas_required = np.zeros(w, bool)
     w_tas_uncon = np.zeros(w, bool)
     w_tas_invalid = np.zeros(w, bool)
+    # Per-entry filtered leaf capacity (host _matching_capacity analog):
+    # required whenever the fleet has tainted nodes or the entry carries a
+    # node selector / tolerations — capacity must come only from nodes the
+    # entry's pods can land on. Built lazily; None when nobody needs it.
+    w_tas_cap = None
+    w_tas_has_cap = None
+    fleet_tainted = [tas.has_tainted_nodes for tas in tas_snaps]
+    row_of_flavor = {name: t for t, name in enumerate(flavor_names)}
 
     for i, info in enumerate(device_wls):
         ps = info.obj.pod_sets[0]
@@ -624,6 +637,53 @@ def _encode_tas(
             w_tas_req_level[i, t] = rl
             w_tas_slice_level[i, t] = sl
 
+        # Only topologies reachable through the entry's OWN CQ flavors:
+        # w_tas_req_level is filled for every snapshot whose level keys
+        # match, but the runtime row comes from tas_of_flavor of the CQ's
+        # resource group — a foreign topology's cap row would be wrong.
+        cq_spec = snapshot.cluster_queues[info.cluster_queue].spec
+        cq_rows = {
+            row_of_flavor[fq.name]
+            for rg2 in cq_spec.resource_groups[:1]
+            for fq in rg2.flavors
+            if fq.name in row_of_flavor
+        }
+        need_filter = [
+            t for t in sorted(cq_rows)
+            if w_tas_req_level[i, t] >= 0
+            and (fleet_tainted[t] or ps.node_selector or ps.tolerations)
+        ]
+        if need_filter:
+            # Exactly one mappable topology per filtered entry (the
+            # _device_compatible multi-flavor gate guarantees it), so one
+            # [D, R+1] row in that topology's device leaf order is exact.
+            if w_tas_cap is None:
+                w_tas_cap = np.zeros((w, d_n, r1), np.int64)
+                w_tas_has_cap = np.zeros(w, bool)
+            from kueue_tpu.tas.snapshot import PlacementRequest
+
+            req_obj = PlacementRequest(
+                count=ps.count,
+                single_pod_requests=dict(ps.requests),
+                node_selector=dict(ps.node_selector),
+                tolerations=list(ps.tolerations),
+            )
+            t = need_filter[0]
+            tas = tas_snaps[t]
+            inv = {hi: j for j, hi in enumerate(leaf_perm[t])}
+            cap = tas._matching_capacity(req_obj)  # [leaves, host R]
+            row = np.zeros((d_n, r1), np.int64)
+            row[:, r_cy] = 1 << 60  # implicit-pods column default
+            for hi, j in inv.items():
+                for res, ri in tas._res_index.items():
+                    ci = tidx.resource_of.get(res)
+                    if ci is not None:
+                        row[j, ci] = cap[hi, ri]
+                    if res == "pods":
+                        row[j, r_cy] = cap[hi, ri]
+            w_tas_cap[i] = row
+            w_tas_has_cap[i] = True
+
     # Root merging: union roots of CQs sharing a device TAS flavor.
     n = parent_arr.shape[0]
     root_of = np.arange(n)
@@ -672,6 +732,9 @@ def _encode_tas(
         w_tas_unconstrained=np.asarray(w_tas_uncon),
         w_tas_invalid=np.asarray(w_tas_invalid),
     )
+    if w_tas_cap is not None:
+        fields["w_tas_cap"] = w_tas_cap
+        fields["w_tas_has_cap"] = w_tas_has_cap
     return fields, root_merge
 
 
@@ -886,19 +949,28 @@ def _device_compatible(
         if not preempt:
             return False
         # Device TAS class: no balanced placement, no inner slice layers,
-        # no per-workload node filtering, no delayed placement.
+        # no delayed placement.
         if tr.balanced or tr.slice_layers:
-            return False
-        if ps.node_selector or ps.tolerations:
             return False
         if delay_tas_fn is not None and delay_tas_fn(cqs, info):
             return False
         # Every topology-backed flavor of the CQ must be device-encoded.
         rg0 = cqs.spec.resource_groups[0]
+        tas_flavor_count = 0
+        any_tainted = False
         for fq in rg0.flavors:
-            if fq.name in snapshot.tas_flavors and \
-                    fq.name not in tas_device_flavors:
-                return False
+            if fq.name in snapshot.tas_flavors:
+                if fq.name not in tas_device_flavors:
+                    return False
+                tas_flavor_count += 1
+                any_tainted = any_tainted or \
+                    snapshot.tas_flavors[fq.name].has_tainted_nodes
+        # Node-filtered capacity (selector/tolerations/tainted fleet) is
+        # encoded as ONE per-entry leaf-capacity row, which is exact only
+        # when a single topology can host the entry.
+        if (ps.node_selector or ps.tolerations or any_tainted) \
+                and tas_flavor_count > 1:
+            return False
     rg = cqs.spec.resource_groups[0]
     return all(
         res in rg.covered_resources

@@ -146,6 +146,7 @@ def preempt_targets(
             -1,
         )
         t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
+        cap_w = _tas_place.entry_leaf_cap(arrays, t_idx_w)
         tas_in = dict(
             do_tas=arrays.w_tas & (t_of_w >= 0),
             t_row=t_idx_w,
@@ -158,6 +159,7 @@ def preempt_targets(
             t_rl=jnp.maximum(arrays.w_tas_req_level[w_iota, t_idx_w], 0),
             t_rq=arrays.w_tas_required,
             t_un=arrays.w_tas_unconstrained,
+            t_cap=cap_w,
         )
     else:
         zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
@@ -166,10 +168,12 @@ def preempt_targets(
             t_req=zw[:, None], t_cnt=zw, t_ssz=zw,
             t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
             t_rq=zw.astype(bool), t_un=zw.astype(bool),
+            t_cap=zw[:, None, None],
         )
 
     def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
-              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un):
+              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un,
+              t_cap):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -343,6 +347,7 @@ def preempt_targets(
                         return _tas_place.feasible_only(
                             arrays.tas_topo, t_row, state, t_req, t_cnt,
                             t_ssz, t_sl, t_rl, t_rq, t_un,
+                            cap_override=t_cap,
                         )
 
                     def bisect(_, st):
@@ -491,6 +496,7 @@ def preempt_targets(
             tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
             tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
             tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
+            tas_in["t_cap"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)
@@ -573,6 +579,7 @@ def hier_targets(
             -1,
         )
         t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
+        cap_w = _tas_place.entry_leaf_cap(arrays, t_idx_w)
         tas_in = dict(
             do_tas=arrays.w_tas & (t_of_w >= 0),
             t_row=t_idx_w,
@@ -585,6 +592,7 @@ def hier_targets(
             t_rl=jnp.maximum(arrays.w_tas_req_level[w_iota, t_idx_w], 0),
             t_rq=arrays.w_tas_required,
             t_un=arrays.w_tas_unconstrained,
+            t_cap=cap_w,
         )
     else:
         zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
@@ -593,10 +601,12 @@ def hier_targets(
             t_req=zw[:, None], t_cnt=zw, t_ssz=zw,
             t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
             t_rq=zw.astype(bool), t_un=zw.astype(bool),
+            t_cap=zw[:, None, None],
         )
 
     def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
-              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un):
+              do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un,
+              t_cap):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -673,6 +683,7 @@ def hier_targets(
                     return _tas_place.feasible_only(
                         arrays.tas_topo, t_row, state, t_req, t_cnt,
                         t_ssz, t_sl, t_rl, t_rq, t_un,
+                        cap_override=t_cap,
                     )
 
             def above_nominal(u_f, nodes):
@@ -928,6 +939,7 @@ def hier_targets(
             tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
             tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
             tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
+            tas_in["t_cap"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)

@@ -187,6 +187,19 @@ def segmented_greedy(
     return takes
 
 
+def entry_leaf_cap(arrays, t_idx, w=None):
+    """Per-entry leaf capacity for placement probes: the entry's filtered
+    row (node selector / taint matching) where ``w_tas_has_cap``, else the
+    topology's static capacity. ``w`` optionally gathers a subset of
+    entries (e.g. the scan step's per-group workload indices)."""
+    leaf = arrays.tas_topo.leaf_cap[t_idx]
+    if arrays.w_tas_cap is None:
+        return leaf
+    has = arrays.w_tas_has_cap if w is None else arrays.w_tas_has_cap[w]
+    cap = arrays.w_tas_cap if w is None else arrays.w_tas_cap[w]
+    return jnp.where(has[:, None, None], cap, leaf)
+
+
 def place(
     topo: TASDeviceTopo,
     t: jnp.ndarray,  # i32 flavor row
@@ -198,8 +211,15 @@ def place(
     req_level: jnp.ndarray,  # i32 requested level index
     required: jnp.ndarray,  # bool
     unconstrained: jnp.ndarray,  # bool
+    cap_override: jnp.ndarray = None,  # i64[D, R] entry's filtered leaf cap
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (feasible bool, leaf_take i64[D] pods per leaf domain)."""
+    """Returns (feasible bool, leaf_take i64[D] pods per leaf domain).
+
+    ``cap_override`` replaces the topology's static leaf capacity for
+    this entry — the per-entry analog of the host's node-selector/
+    taint-filtered matching capacity (tas/snapshot.py _matching_capacity):
+    capacity comes only from nodes the entry's pods may land on, while
+    usage stays the leaf total."""
     d_n = topo.leaf_cap.shape[1]
     r_n = topo.leaf_cap.shape[2]
     iota = jnp.arange(d_n)
@@ -212,7 +232,8 @@ def place(
         return iota < topo.level_size[t, jnp.clip(l, 0, LMAX - 1)]
 
     # ---- phase 1: leaf fill + roll-up -------------------------------------
-    free = topo.leaf_cap[t] - leaf_usage  # [D,R] (incl. implicit-pods col)
+    cap = topo.leaf_cap[t] if cap_override is None else cap_override
+    free = cap - leaf_usage  # [D,R] (incl. implicit-pods col)
     fits = jnp.full(d_n, _INF, jnp.int64)
     for r in range(r_n):  # static unroll over the resource axis
         fits = jnp.where(
@@ -342,7 +363,9 @@ def feasible_only(
     req_level: jnp.ndarray,
     required: jnp.ndarray,
     unconstrained: jnp.ndarray,
+    cap_override: jnp.ndarray = None,
 ) -> jnp.ndarray:
     f, _ = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
-                 req_level, required, unconstrained)
+                 req_level, required, unconstrained,
+                 cap_override=cap_override)
     return f

@@ -251,6 +251,21 @@ class TASFlavorSnapshot:
                 cap[res] = cap.get(res, 0) - used
         return cap
 
+    @property
+    def has_tainted_nodes(self) -> bool:
+        """Any node in the fleet carries taints — the single definition
+        used by the host fast path, the device-compat gate and the cycle
+        encoder (memoized; node sets only change via snapshot rebuild)."""
+        cached = getattr(self, "_has_tainted", None)
+        if cached is None:
+            cached = any(
+                n.taints
+                for nodes in self.nodes_by_leaf.values()
+                for n in nodes
+            )
+            self._has_tainted = cached
+        return cached
+
     def _matching_capacity(self, req: PlacementRequest) -> np.ndarray:
         """Per-leaf capacity restricted to nodes passing the request's
         selector/tolerations; memoized per distinct (selector, tolerations)
@@ -262,11 +277,7 @@ class TASFlavorSnapshot:
         cached = self._match_cache.get(key)
         if cached is not None:
             return cached
-        if not req.node_selector and not any(
-            n.taints
-            for nodes in self.nodes_by_leaf.values()
-            for n in nodes
-        ):
+        if not req.node_selector and not self.has_tainted_nodes:
             cap = self._leaf_cap
         else:
             cap = np.zeros_like(self._leaf_cap)

@@ -375,3 +375,113 @@ def test_tas_preemption_hierarchical_on_device_no_fallback():
         assert not d_fb, f"seed {seed}: fell back for {d_fb}"
         saw_eviction = saw_eviction or bool(h_ev)
     assert saw_eviction, "no scenario exercised hierarchical preemption"
+
+
+def test_tas_node_filtering_on_device_no_fallback():
+    """Tainted nodes, per-workload node selectors and tolerations: device
+    placement must use the host's matching-capacity semantics (capacity
+    only from nodes the entry's pods can land on) — previously the device
+    used the unfiltered static leaf capacity and admitted onto tainted
+    nodes the host refuses. Zero host fallback; exact domains."""
+    import random as _random
+
+    from kueue_tpu.api.types import Taint, Toleration
+
+    LVL = ["rack", "kubernetes.io/hostname"]
+
+    def build(seed, device):
+        rng = _random.Random(5600 + seed)
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(64)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LVL),
+        )
+        for r in range(2):
+            for h in range(3):
+                taints = []
+                if rng.random() < 0.4:
+                    taints = [Taint(key="maint", value="x",
+                                    effect="NoSchedule")]
+                mgr.apply(Node(
+                    name=f"n{r}{h}",
+                    labels={"rack": f"r{r}", "zone": rng.choice(["a", "b"])},
+                    capacity={"tpu": 8}, taints=taints,
+                ))
+        wls = []
+        for i in range(rng.randint(3, 6)):
+            tol = ([Toleration(key="maint", operator="Exists")]
+                   if rng.random() < 0.5 else [])
+            sel = ({"zone": rng.choice(["a", "b"])}
+                   if rng.random() < 0.5 else {})
+            wls.append(Workload(
+                name=f"w{i}", queue_name="lq",
+                pod_sets=[PodSet(
+                    name="main", count=rng.choice([1, 2]),
+                    requests={"tpu": rng.choice([4, 8])},
+                    tolerations=tol, node_selector=sel,
+                    topology_request=TopologyRequest(
+                        required_level=rng.choice(LVL)),
+                )],
+                priority=0, creation_time=float(i + 1),
+            ))
+        sched = DeviceScheduler(mgr.cache, mgr.queues) if device \
+            else mgr.scheduler
+        return mgr, sched, wls, []
+
+    for seed in range(8):
+        h_out, _, _ = _run_preemption_differential(build, seed, False)
+        d_out, _, d_fb = _run_preemption_differential(build, seed, True)
+        assert d_out == h_out, f"seed {seed}: {h_out} vs {d_out}"
+        assert not d_fb, f"seed {seed}: fell back for {d_fb}"
+
+
+def test_tas_filter_rows_respect_cq_topology():
+    """Two CQs on two topologies sharing level keys, where flavor fa
+    carries an untolerated flavor-level node taint: a selector-carrying
+    workload on cq-b (flavor fb) must NOT inherit a filtered capacity
+    row built from fa's snapshot (whose flavor taint zeroes every node)
+    — the filter row selection is restricted to topologies reachable
+    through the entry's own CQ flavors."""
+    from kueue_tpu.api.types import Taint
+
+    LVL = ["rack", "kubernetes.io/hostname"]
+
+    def build(device):
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="fa", topology_name="topo-a",
+                           node_taints=[Taint(key="maint", value="x",
+                                              effect="NoSchedule")]),
+            ResourceFlavor(name="fb", topology_name="topo-b"),
+            make_cq("cq-a", flavors={"fa": {"tpu": quota(32)}},
+                    resources=["tpu"]),
+            make_cq("cq-b", flavors={"fb": {"tpu": quota(32)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq-a", cluster_queue="cq-a"),
+            LocalQueue(name="lq-b", cluster_queue="cq-b"),
+            Topology(name="topo-a", levels=LVL),
+            Topology(name="topo-b", levels=LVL),
+        )
+        for h in range(2):
+            mgr.apply(Node(name=f"n{h}", labels={"rack": "rb",
+                                                 "zone": "a"},
+                           capacity={"tpu": 8}))
+        sched = DeviceScheduler(mgr.cache, mgr.queues) if device \
+            else mgr.scheduler
+        wl = Workload(name="wb", queue_name="lq-b", pod_sets=[
+            PodSet(name="main", count=2, requests={"tpu": 8},
+                   node_selector={"zone": "a"},
+                   topology_request=TopologyRequest(
+                       required_level="rack"))])
+        return mgr, sched, [wl], []
+
+    h_out, _, _ = _run_preemption_differential(
+        lambda s, d: build(d), 0, False)
+    d_out, _, d_fb = _run_preemption_differential(
+        lambda s, d: build(d), 0, True)
+    assert d_out == h_out, (h_out, d_out)
+    assert d_out["wb"] is not None, "workload should admit via fb"
+    assert not d_fb, d_fb
