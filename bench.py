@@ -442,6 +442,71 @@ def probe_mega():
     return out_stats
 
 
+def probe_phases():
+    """Per-phase device timing at the north-star scale: nominate /
+    admission-order / admit-scan measured as separately-jitted programs,
+    plus data-volume accounting (bytes shipped host->device per cycle and
+    the per-scan-step working set). The reference logs per-phase durations
+    inside its schedule cycle (pkg/scheduler/scheduler.go:305-372); this
+    is the device analog, so regressions inside the cycle are visible
+    instead of hiding in one wall number."""
+    import numpy as np
+    import jax
+
+    from kueue_tpu.models import batch_scheduler as bs
+
+    W = 50_000
+    arrays, layout = build_mega(W=W)
+    ga = bs.GroupArrays(*layout.as_jax())
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    group_of = np.asarray(layout.flat_to_group)[np.asarray(arrays.w_cq)]
+    s_exact = int(np.bincount(group_of, minlength=layout.n_groups).max())
+    stats = {"probe": "phases", "ok": True,
+             "platform": jax.devices()[0].platform}
+    leaves = jax.tree_util.tree_leaves((arrays, ga))
+    stats["encode_bytes"] = int(sum(x.nbytes for x in leaves))
+    # Per-step working set of the grouped scan: [G, L, R] gathers of the
+    # five chain tensors plus the delta scatter (i64 = 8 bytes).
+    g_n = int(layout.n_groups)
+    r_n = int(arrays.w_req.shape[1])
+    stats["scan_step_bytes"] = int(g_n * n_levels * r_n * 8 * 6)
+    stats["scan_steps"] = s_exact
+
+    nom_fn = jax.jit(
+        lambda a: bs.nominate(a, a.usage, n_levels=n_levels)
+    )
+    order_fn = jax.jit(lambda a, nom: bs.admission_order(a, nom))
+
+    def scan_impl(a, g, nom, order):
+        return bs.admit_scan_grouped(
+            a, g, nom, a.usage, order, s_exact, unroll=4,
+            n_levels=n_levels,
+        )
+
+    scan_fn = jax.jit(scan_impl)
+
+    def timeit(name, fn, *args):
+        try:
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.monotonic()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            stats[name + "_ms"] = round((time.monotonic() - t0) * 1000, 1)
+            return out
+        except Exception as exc:  # noqa: BLE001 - record and continue
+            stats[name + "_error"] = repr(exc)[:300]
+            stats["ok"] = False
+            return None
+
+    nom = timeit("nominate", nom_fn, arrays)
+    if nom is not None:
+        order = timeit("order", order_fn, arrays, nom)
+        if order is not None:
+            timeit("scan", scan_fn, arrays, ga, nom, order)
+    return stats
+
+
 def run_probe_subprocess(
     probe: str, timeout_s: int, scale: float, platform: str = None
 ) -> dict:
@@ -477,7 +542,7 @@ def main():
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
     ap.add_argument("--probe", default=None,
-                    choices=["ping", "mega", "sim"],
+                    choices=["ping", "mega", "sim", "phases"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -497,6 +562,7 @@ def main():
                 "ping": probe_ping,
                 "mega": probe_mega,
                 "sim": lambda: probe_sim(args.scale),
+                "phases": probe_phases,
             }[args.probe]()
         except Exception as exc:  # noqa: BLE001 - report, don't crash
             stats = {"probe": args.probe, "ok": False,
@@ -524,6 +590,10 @@ def main():
                 "mega", 420, args.scale, args.platform
             )
             log(f"device mega probe: {device['mega']}")
+            device["phases"] = run_probe_subprocess(
+                "phases", 420, args.scale, args.platform
+            )
+            log(f"device phases probe: {device['phases']}")
         device["ok"] = bool(
             (device.get("sim") or {}).get("ok")
             or (device.get("mega") or {}).get("ok")

@@ -74,6 +74,13 @@ class NominateResult(NamedTuple):
     praw_count: jnp.ndarray  # i32[W] flavors seen with raw preempt mode
     praw_stop: jnp.ndarray  # bool[W] scan stopped at a raw-preempt flavor
     considered: jnp.ndarray  # i32[W] flavors considered by the scan
+    # Per-slot results (multi-podset / multi-resource-group cycles only;
+    # None on the dense legacy layout). Slot order matches host
+    # evaluation order — see encode._workload_slots.
+    s_flavor: jnp.ndarray = None  # i32[W,S] chosen flavor per slot
+    s_pmode: jnp.ndarray = None  # i32[W,S]
+    s_borrow: jnp.ndarray = None  # i32[W,S]
+    s_tried: jnp.ndarray = None  # i32[W,S] (-1 = wrapped)
 
 
 class CycleOutputs(NamedTuple):
@@ -88,6 +95,10 @@ class CycleOutputs(NamedTuple):
     victim_variant: jnp.ndarray = None  # i32[W,A] preemption reason codes
     # Partial admission: reduced pod count (-1 = full count / not found).
     partial_count: jnp.ndarray = None  # i64[W]
+    # Per-slot decode outputs (slot-layout cycles only).
+    s_flavor: jnp.ndarray = None  # i32[W,S]
+    s_pmode: jnp.ndarray = None  # i32[W,S]
+    s_tried: jnp.ndarray = None  # i32[W,S]
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -99,30 +110,14 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
     return jnp.where(pmode == P_NOFIT, _NEG_INF, score)
 
 
-def nominate(arrays: CycleArrays, usage: jnp.ndarray,
-             n_levels: int = MAX_DEPTH + 1) -> NominateResult:
-    """Vectorized flavor assignment for every workload against the
-    cycle-start usage (reference scheduler.go:629 nominate +
-    flavorassigner.go:946 findFlavorForPodSets).
-
-    Flat [W,·] formulation: the per-workload fungibility scan is a
-    first-stop/argmax computation over the [W,K] preference axis, the
-    preemption-candidate prefilter reads per-cell minimum-priority-cut
-    aggregates precomputed once per cycle, and preference scores are small
-    int32 keys — no inner lax.scan and no [W,F,R,B] temporaries."""
+def _prefilter_aggregates(arrays: CycleArrays, usage: jnp.ndarray):
+    """Preemption-candidate prefilter aggregates, once per cycle [N,F,R]:
+    the minimum priority cut among buckets with same-CQ admitted usage
+    (resolves policy thresholds by comparison) and the equivalent over
+    "borrowing CQs elsewhere in this tree" counts. A sound subset of
+    reference preemption_oracle.go outcomes; any possible candidate
+    still routes to the host path."""
     tree = arrays.tree
-    w_n = arrays.w_cq.shape[0]
-    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
-    avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
-    pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
-    w_iota = jnp.arange(w_n)
-
-    # Preemption-candidate prefilter aggregates, once per cycle [N,F,R]:
-    # the minimum priority cut among buckets with same-CQ admitted usage
-    # (resolves policy thresholds by comparison) and the equivalent over
-    # "borrowing CQs elsewhere in this tree" counts. A sound subset of
-    # reference preemption_oracle.go outcomes; any possible candidate
-    # still routes to the host path.
     parent_or_self = jnp.where(
         tree.parent < 0, jnp.arange(tree.n_nodes), tree.parent
     )
@@ -146,6 +141,35 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
     has_other = (tree_count[root_of] - contrib.astype(jnp.int32)) > 0
     other_mincut = jnp.min(jnp.where(has_other, cuts, _PINF), axis=-1)
     other_any = jnp.any(has_other, axis=-1)
+    return same_mincut, same_any, other_mincut, other_any
+
+
+def nominate(arrays: CycleArrays, usage: jnp.ndarray,
+             n_levels: int = MAX_DEPTH + 1) -> NominateResult:
+    """Vectorized flavor assignment for every workload against the
+    cycle-start usage (reference scheduler.go:629 nominate +
+    flavorassigner.go:946 findFlavorForPodSets).
+
+    Flat [W,·] formulation: the per-workload fungibility scan is a
+    first-stop/argmax computation over the [W,K] preference axis, the
+    preemption-candidate prefilter reads per-cell minimum-priority-cut
+    aggregates precomputed once per cycle, and preference scores are small
+    int32 keys — no inner lax.scan and no [W,F,R,B] temporaries.
+
+    Slot-layout cycles (multi-podset / multi-resource-group entries
+    present) dispatch to the slot-sequential variant."""
+    if arrays.s_req is not None:
+        return _nominate_slots(arrays, usage, n_levels)
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
+    pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
+    w_iota = jnp.arange(w_n)
+
+    same_mincut, same_any, other_mincut, other_any = _prefilter_aggregates(
+        arrays, usage
+    )
 
     # ---- per-cell modes/heights, [W,F,R] ----------------------------------
     c = arrays.w_cq
@@ -291,6 +315,219 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
                           praw_n, praw_stop, n_cons)
 
 
+def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
+                    n_levels: int = MAX_DEPTH + 1) -> NominateResult:
+    """Slot-sequential flavor assignment (flavorassigner.go:712 Assign over
+    podset groups x resource groups): each slot runs the same vectorized
+    fungibility scan as the legacy path, with earlier slots' assigned
+    usage folded into the requested value per cell — the host's
+    assignment.usage accumulation, where _fits_resource_quota checks
+    ``val = assumed + request`` (flavorassigner.go:1213). Slot order
+    matches host evaluation order, so the early-return on a failed group
+    is modeled by the ``done`` prefix; the workload-level mode is the
+    min over processed slots (Assignment.RepresentativeMode) and the
+    borrow is the max over assigned flavors (flavorassigner.go:901)."""
+    tree = arrays.tree
+    w_n = arrays.w_cq.shape[0]
+    s_n = arrays.s_req.shape[1]
+    f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
+    avail_all = quota_ops.available_all(tree, usage)  # [N,F,R]
+    pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
+    w_iota = jnp.arange(w_n)
+    f_iota = jnp.arange(f_n)
+    c = arrays.w_cq
+    prio = arrays.w_priority
+
+    same_mincut, same_any, other_mincut, other_any = _prefilter_aggregates(
+        arrays, usage
+    )
+
+    def exists(pol, mincut, anyb):
+        p = pol[:, None, None]
+        return jnp.where(
+            p == 3, anyb,
+            jnp.where(
+                p == 2, mincut <= prio[:, None, None],
+                jnp.where(p == 1, mincut < prio[:, None, None], False),
+            ),
+        )
+
+    same_exists = exists(arrays.policy_within[c], same_mincut[c],
+                         same_any[c])
+    cross_exists = exists(arrays.policy_reclaim[c], other_mincut[c],
+                          other_any[c])
+    no_candidates = arrays.prefilter_valid & ~(same_exists | cross_exists)
+
+    pob3 = arrays.pref_preempt_over_borrow[c][:, None, None]
+    cpwb3 = arrays.can_preempt_while_borrowing[c][:, None, None]
+    nevp3 = arrays.never_preempts[c][:, None, None]
+    _SNEG = jnp.int32(-(1 << 30))
+    k_n = arrays.s_flavor_at.shape[2]
+    k_iota = jnp.arange(k_n, dtype=jnp.int32)
+
+    def score_of(pm, bw):
+        sc = jnp.where(pob3, -bw * 16 + pm, pm * 16 - bw)
+        return jnp.where(pm == P_NOFIT, _SNEG, sc).astype(jnp.int32)
+
+    acc = jnp.zeros((w_n, f_n, r_n), dtype=jnp.int64)
+    outs = []
+    for s in range(s_n):
+        req = arrays.s_req[:, s]  # [W,R]
+        val = req[:, None, :] + acc  # [W,F,R]
+        height, proper = jax.vmap(
+            lambda cc, rq: quota_ops.borrow_height(
+                tree, usage, cc, rq, n_levels=n_levels
+            )
+        )(c, val)
+        no_fit = val > pot_all[c]
+        fit = val <= avail_all[c]
+        preempt_gate = (arrays.nominal_cq[c] >= val) | proper | cpwb3
+        pmode_cell = jnp.where(
+            fit, P_FIT,
+            jnp.where(no_fit, P_NOFIT,
+                      jnp.where(preempt_gate, P_PREEMPT_RAW, P_NOFIT)),
+        ).astype(jnp.int32)
+        pmode_cell = jnp.where(
+            (pmode_cell == P_PREEMPT_RAW) & nevp3,
+            P_NO_CANDIDATES, pmode_cell,
+        )
+        pmode_cell = jnp.where(
+            (pmode_cell == P_PREEMPT_RAW) & no_candidates,
+            P_NO_CANDIDATES, pmode_cell,
+        )
+        borrow_cell = height.astype(jnp.int32)
+
+        score_cell = score_of(pmode_cell, borrow_cell)
+        best_inactive = jnp.where(
+            pob3, jnp.int32(P_FIT), jnp.int32(P_FIT * 16)
+        )
+        cell3 = jnp.broadcast_to(req[:, None, :] > 0, score_cell.shape)
+        score_cell = jnp.where(
+            cell3, score_cell,
+            jnp.broadcast_to(best_inactive, score_cell.shape),
+        )
+        rep_idx = jnp.argmin(score_cell, axis=2)  # [W,F] worst resource
+        rep_pmode = pmode_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+        rep_borrow = borrow_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+        elig = arrays.s_elig[:, s]
+        rep_pmode = jnp.where(elig, rep_pmode, P_NOFIT)
+        rep_borrow = jnp.where(elig, rep_borrow, 0)
+        pob_w = arrays.pref_preempt_over_borrow[c][:, None]
+        rep_score = jnp.where(
+            pob_w, -rep_borrow * 16 + rep_pmode,
+            rep_pmode * 16 - rep_borrow,
+        )
+        rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
+
+        # Fungibility scan over the slot's own flavor list.
+        f_k = arrays.s_flavor_at[:, s]  # [W,K]
+        pos_valid = (
+            (k_iota[None, :] < arrays.s_n_flavors[:, s][:, None])
+            & (k_iota[None, :] >= arrays.s_start[:, s][:, None])
+        )
+        pm_k = rep_pmode[w_iota[:, None], f_k]
+        bw_k = rep_borrow[w_iota[:, None], f_k]
+        sc_k = rep_score[w_iota[:, None], f_k]
+        should_try_next = (
+            (pm_k == P_NOFIT)
+            | (pm_k == P_NO_CANDIDATES)
+            | ((pm_k == P_PREEMPT_RAW)
+               & arrays.when_can_preempt_try_next[c][:, None])
+            | ((bw_k > 0) & arrays.when_can_borrow_try_next[c][:, None])
+        )
+        stop_k = pos_valid & ~should_try_next
+        any_stop = jnp.any(stop_k, axis=1)
+        kstop = jnp.where(
+            any_stop, jnp.argmax(stop_k, axis=1).astype(jnp.int32),
+            jnp.int32(k_n),
+        )
+        considered = pos_valid & (k_iota[None, :] <= kstop[:, None])
+        n_cons = jnp.sum(considered, axis=1).astype(jnp.int32)
+        att = jnp.max(
+            jnp.where(considered, k_iota[None, :], -1), axis=1
+        ).astype(jnp.int32)
+        is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
+        praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
+        kstop_c = jnp.clip(kstop, 0, k_n - 1)
+        praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
+        sc_masked = jnp.where(considered, sc_k, _SNEG)
+        k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
+        none_considered = ~jnp.any(considered & (sc_k > _SNEG), axis=1)
+        k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
+        b_f = jnp.where(none_considered & ~any_stop, -1,
+                        f_k[w_iota, k_take]).astype(jnp.int32)
+        b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
+                         pm_k[w_iota, k_take]).astype(jnp.int32)
+        b_bw = jnp.where(none_considered & ~any_stop, 0,
+                         bw_k[w_iota, k_take]).astype(jnp.int32)
+        tried = jnp.where(
+            att == arrays.s_n_flavors[:, s] - 1, -1, att
+        ).astype(jnp.int32)
+
+        # Accumulate the slot's assigned usage onto its chosen plane: the
+        # host appends psa.flavors usage for any mode above NoFit
+        # (flavorassigner.go:901 _append).
+        take = arrays.s_valid[:, s] & (b_pm != P_NOFIT) & (b_f >= 0)
+        onehot = (
+            (f_iota[None, :, None]
+             == jnp.clip(b_f, 0, f_n - 1)[:, None, None])
+            & (req[:, None, :] > 0)
+            & take[:, None, None]
+        )
+        acc = acc + jnp.where(onehot, req[:, None, :], 0)
+        outs.append((b_f, b_pm, b_bw, tried, praw_n, praw_stop, n_cons))
+
+    s_f = jnp.stack([o[0] for o in outs], axis=1)
+    s_pm = jnp.stack([o[1] for o in outs], axis=1)
+    s_bw = jnp.stack([o[2] for o in outs], axis=1)
+    s_tried = jnp.stack([o[3] for o in outs], axis=1)
+    s_praw_n = jnp.stack([o[4] for o in outs], axis=1)
+    s_praw_stop = jnp.stack([o[5] for o in outs], axis=1)
+    s_cons = jnp.stack([o[6] for o in outs], axis=1)
+
+    sv = arrays.s_valid
+    # done[s]: every earlier valid slot assigned — the host early-returns
+    # on a failed group, so later slots are never evaluated.
+    ok_slot = ~sv | (s_pm != P_NOFIT)
+    done = jnp.cumprod(
+        jnp.concatenate(
+            [jnp.ones((w_n, 1), dtype=jnp.int32),
+             ok_slot[:, :-1].astype(jnp.int32)], axis=1
+        ), axis=1
+    ).astype(bool)
+    eff = sv & done
+    wl_nofit = jnp.any(eff & (s_pm == P_NOFIT), axis=1)
+    any_praw = jnp.any(eff & (s_pm == P_PREEMPT_RAW), axis=1)
+    any_nc = jnp.any(eff & (s_pm == P_NO_CANDIDATES), axis=1)
+    best_pmode = jnp.where(
+        wl_nofit, P_NOFIT,
+        jnp.where(any_praw, P_PREEMPT_RAW,
+                  jnp.where(any_nc, P_NO_CANDIDATES, P_FIT)),
+    ).astype(jnp.int32)
+    best_pmode = jnp.where(arrays.w_active, best_pmode, P_NOFIT)
+    assigned = eff & (s_pm != P_NOFIT)
+    best_borrow = jnp.max(
+        jnp.where(assigned, s_bw, 0), axis=1
+    ).astype(jnp.int32)
+    seen_praw = jnp.any(eff & (s_praw_n > 0), axis=1)
+    needs_host = (seen_praw | any_praw) & arrays.w_active
+
+    return NominateResult(
+        chosen_flavor=s_f[:, 0],
+        best_pmode=best_pmode,
+        best_borrow=best_borrow,
+        needs_host=needs_host,
+        tried_flavor_idx=s_tried[:, 0],
+        praw_count=s_praw_n[:, 0],
+        praw_stop=s_praw_stop[:, 0],
+        considered=s_cons[:, 0],
+        s_flavor=s_f,
+        s_pmode=jnp.where(eff, s_pm, P_NOFIT).astype(jnp.int32),
+        s_borrow=s_bw,
+        s_tried=s_tried,
+    )
+
+
 # Static probe-step bound for the partial-admission binary search: the
 # search space is [0, count - min_count]; 22 halvings cover 4M pods.
 _PARTIAL_STEPS = 22
@@ -330,9 +567,12 @@ def partial_search(
             arrays.w_req_pp * count_probe[:, None],
             arrays.w_req,
         )
-        return nominate(
-            arrays._replace(w_req=req_p), usage, n_levels=n_levels
-        )
+        arr2 = arrays._replace(w_req=req_p)
+        if arrays.s_req is not None:
+            # Slot-layout cycles: nominate reads s_req; partial entries
+            # are single-slot (slot 0 mirrors w_req by construction).
+            arr2 = arr2._replace(s_req=arrays.s_req.at[:, 0].set(req_p))
+        return nominate(arr2, usage, n_levels=n_levels)
 
     def step(carry, _):
         lo, hi, best, bf, bb, bt = carry
@@ -390,6 +630,20 @@ def partial_search(
         best_borrow=jnp.where(found, bb, nom.best_borrow),
         tried_flavor_idx=jnp.where(found, bt, nom.tried_flavor_idx),
     )
+    if nom.s_flavor is not None:
+        # Mirror the reduction into slot 0 (partial entries are
+        # single-slot) so the slot-layout admission scan sees it.
+        nom2 = nom2._replace(
+            s_flavor=nom.s_flavor.at[:, 0].set(
+                jnp.where(found, bf, nom.s_flavor[:, 0])
+            ),
+            s_pmode=nom.s_pmode.at[:, 0].set(
+                jnp.where(found, P_FIT, nom.s_pmode[:, 0])
+            ),
+            s_tried=nom.s_tried.at[:, 0].set(
+                jnp.where(found, bt, nom.s_tried[:, 0])
+            ),
+        )
     partial_count = jnp.where(found, new_count, jnp.int64(-1))
     return nom2, new_req, partial_count
 
@@ -632,6 +886,7 @@ def admit_scan_grouped(
     g_iota = jnp.arange(g_n)
     with_preempt = targets is not None
     with_tas = getattr(arrays, "tas_topo", None) is not None
+    with_slots = getattr(arrays, "s_req", None) is not None
 
     if with_tas:
         from kueue_tpu.ops import tas_place as _tas_place
@@ -693,30 +948,7 @@ def admit_scan_grouped(
         chain = ga.chain_local[g_iota, c_local][:, :n_levels]  # [G,L]
         is_repeat = chain_is_repeat[g_iota, c_local][:, :n_levels]
 
-        req = arrays.w_req[w]  # [G,R]
-        # All of a step's quota math lives on the entry's single chosen
-        # flavor plane — gather [G,D+1,R] slices instead of [G,D+1,F,R].
-        fcl = jnp.clip(f, 0, f_n - 1)
-        cell_mask = (
-            (f[:, None] >= 0) & (req > 0) & arrays.covered[c]
-        )  # [G,R]
-        delta = jnp.where(cell_mask, req, 0).astype(jnp.int64)
-
         gi = g_iota[:, None]
-        fg = fcl[:, None]
-        u = usage_g[gi, chain, fg]  # [G,D+1,R]
-        lq = lq_g[gi, chain, fg]
-        subtree = subtree_g[gi, chain, fg]
-        bl = bl_g[gi, chain, fg]
-        has_bl = has_bl_g[gi, chain, fg]
-
-        l_avail = jnp.maximum(0, sat_sub(lq, u))
-        stored = sat_sub(subtree, lq)
-
-        # Victim-adjusted usage for the availability walk: simulate the
-        # removal of every designated victim plus this entry's own targets
-        # (scheduler.go fits() -> SimulateWorkloadRemoval). Only the
-        # entry's flavor plane matters — its cells are all on flavor f.
         if with_preempt:
             my_vict = targets.victims[w]  # [G,A]
             preempting = valid & (pm == P_PREEMPT_OK)
@@ -726,33 +958,141 @@ def admit_scan_grouped(
             use_vict = designated[None, :] | jnp.where(
                 (preempting & ~overlap)[:, None], my_vict, False
             )  # [G,A]
-            au_f = usage_by_f[fcl]  # [G,A,R]
             chain_flat = ga.node_sel[gi, chain]  # [G,D+1] flat node ids
-            rem_levels = []
+            vict_masks = []
             for i in range(n_levels):
                 on_chain = in_sub[chain_flat[:, i]][:, adm.cq]  # [G,A]
-                mask_i = (use_vict & on_chain).astype(jnp.int64)
-                rem_levels.append(jnp.einsum("ga,gar->gr", mask_i, au_f))
-            rem = jnp.stack(rem_levels, axis=1)  # [G,D+1,R]
-            u_fit = u - rem
+                vict_masks.append(
+                    (use_vict & on_chain).astype(jnp.int64)
+                )
         else:
             my_vict = None
             preempting = jnp.zeros(g_n, bool)
             overlap = jnp.zeros(g_n, bool)
-            u_fit = u
 
-        l_avail_fit = jnp.maximum(0, sat_sub(lq, u_fit))
-        used_in_parent_fit = jnp.maximum(0, sat_sub(u_fit, lq))
-        with_max_fit = sat_add(sat_sub(stored, used_in_parent_fit), bl)
-        avail = sat_sub(subtree[:, n_levels - 1], u_fit[:, n_levels - 1])
-        for i in range(n_levels - 2, -1, -1):
-            clamped = jnp.where(
-                has_bl[:, i], jnp.minimum(with_max_fit[:, i], avail), avail
+        if with_slots:
+            # Slot-layout step: the entry touches up to S flavor planes
+            # (one per assigned slot). Joint fit and usage application use
+            # per-plane totals aggregated across same-flavor slots — the
+            # host checks and adds assignment.usage per FlavorResource
+            # (scheduler.go fits / cq.AddUsage) — applied once per
+            # distinct plane (``dedup``). Kept as a separate branch (not
+            # S=1-unified with the legacy path below) so the tuned legacy
+            # compiled program stays byte-identical; changes to the
+            # availability walk / reserve semantics must land in BOTH
+            # branches — the differential suites cover each layout.
+            s_ax = arrays.s_req.shape[1]
+            f_s = nom.s_flavor[w]  # [G,S]
+            req_s_raw = arrays.s_req[w]  # [G,S,R]
+            act_s = (
+                arrays.s_valid[w] & (f_s >= 0)
+                & (nom.s_pmode[w] != P_NOFIT)
+            )  # [G,S]
+            fcl_s = jnp.clip(f_s, 0, f_n - 1)
+            cell_s = (req_s_raw > 0) & act_s[..., None]  # [G,S,R]
+            req_m = jnp.where(cell_s, req_s_raw, 0).astype(jnp.int64)
+            same = (
+                (fcl_s[:, :, None] == fcl_s[:, None, :])
+                & act_s[:, :, None] & act_s[:, None, :]
+            )  # [G,S,S]
+            agg = jnp.einsum(
+                "gst,gtr->gsr", same.astype(jnp.int64), req_m
+            )  # [G,S,R] per-plane totals
+            first_idx = jnp.argmax(same, axis=2).astype(jnp.int32)
+            dedup = (
+                first_idx == jnp.arange(s_ax, dtype=jnp.int32)[None, :]
+            ) & act_s  # [G,S] first slot of each distinct plane
+
+            gi3 = g_iota[:, None, None]
+            ch3 = chain[:, None, :]
+            fg3 = fcl_s[:, :, None]
+            u = usage_g[gi3, ch3, fg3]  # [G,S,L,R]
+            lq = lq_g[gi3, ch3, fg3]
+            subtree = subtree_g[gi3, ch3, fg3]
+            bl = bl_g[gi3, ch3, fg3]
+            has_bl = has_bl_g[gi3, ch3, fg3]
+            l_avail = jnp.maximum(0, sat_sub(lq, u))
+            stored = sat_sub(subtree, lq)
+            if with_preempt:
+                au_f = usage_by_f[fcl_s]  # [G,S,A,R]
+                rem = jnp.stack(
+                    [
+                        jnp.einsum("ga,gsar->gsr", vict_masks[i], au_f)
+                        for i in range(n_levels)
+                    ],
+                    axis=2,
+                )  # [G,S,L,R]
+                u_fit = u - rem
+            else:
+                u_fit = u
+            l_avail_fit = jnp.maximum(0, sat_sub(lq, u_fit))
+            used_in_parent_fit = jnp.maximum(0, sat_sub(u_fit, lq))
+            with_max_fit = sat_add(sat_sub(stored, used_in_parent_fit), bl)
+            avail = sat_sub(
+                subtree[:, :, n_levels - 1], u_fit[:, :, n_levels - 1]
             )
-            stepped = sat_add(l_avail_fit[:, i], clamped)
-            avail = jnp.where(is_repeat[:, i, None], avail, stepped)
+            for i in range(n_levels - 2, -1, -1):
+                clamped = jnp.where(
+                    has_bl[:, :, i],
+                    jnp.minimum(with_max_fit[:, :, i], avail), avail,
+                )
+                stepped = sat_add(l_avail_fit[:, :, i], clamped)
+                avail = jnp.where(
+                    is_repeat[:, None, i, None], avail, stepped
+                )
+            fits = jnp.all((agg <= avail) | ~cell_s, axis=(1, 2))  # [G]
+        else:
+            req = arrays.w_req[w]  # [G,R]
+            # All of a step's quota math lives on the entry's single
+            # chosen flavor plane — gather [G,D+1,R] slices instead of
+            # [G,D+1,F,R].
+            fcl = jnp.clip(f, 0, f_n - 1)
+            cell_mask = (
+                (f[:, None] >= 0) & (req > 0) & arrays.covered[c]
+            )  # [G,R]
+            delta = jnp.where(cell_mask, req, 0).astype(jnp.int64)
 
-        fits = jnp.all((delta <= avail) | ~cell_mask, axis=1)  # [G]
+            fg = fcl[:, None]
+            u = usage_g[gi, chain, fg]  # [G,D+1,R]
+            lq = lq_g[gi, chain, fg]
+            subtree = subtree_g[gi, chain, fg]
+            bl = bl_g[gi, chain, fg]
+            has_bl = has_bl_g[gi, chain, fg]
+
+            l_avail = jnp.maximum(0, sat_sub(lq, u))
+            stored = sat_sub(subtree, lq)
+
+            # Victim-adjusted usage for the availability walk: simulate
+            # the removal of every designated victim plus this entry's own
+            # targets (scheduler.go fits() -> SimulateWorkloadRemoval).
+            # Only the entry's flavor plane matters — its cells are all on
+            # flavor f.
+            if with_preempt:
+                au_f = usage_by_f[fcl]  # [G,A,R]
+                rem = jnp.stack(
+                    [
+                        jnp.einsum("ga,gar->gr", vict_masks[i], au_f)
+                        for i in range(n_levels)
+                    ],
+                    axis=1,
+                )  # [G,D+1,R]
+                u_fit = u - rem
+            else:
+                u_fit = u
+
+            l_avail_fit = jnp.maximum(0, sat_sub(lq, u_fit))
+            used_in_parent_fit = jnp.maximum(0, sat_sub(u_fit, lq))
+            with_max_fit = sat_add(sat_sub(stored, used_in_parent_fit), bl)
+            avail = sat_sub(subtree[:, n_levels - 1], u_fit[:, n_levels - 1])
+            for i in range(n_levels - 2, -1, -1):
+                clamped = jnp.where(
+                    has_bl[:, i], jnp.minimum(with_max_fit[:, i], avail),
+                    avail,
+                )
+                stepped = sat_add(l_avail_fit[:, i], clamped)
+                avail = jnp.where(is_repeat[:, i, None], avail, stepped)
+
+            fits = jnp.all((delta <= avail) | ~cell_mask, axis=1)  # [G]
         deferred = nom.needs_host[w]
 
         # TAS placement recheck against the running topology state
@@ -790,47 +1130,94 @@ def admit_scan_grouped(
         preempt_ok = preempting & ~overlap & fits & ~deferred
 
         borrowing = nom.best_borrow[w] > 0
-        nom_c = nominal_g[g_iota, c_local, fcl]  # [G,R]
-        reserve_borrowing = jnp.where(
-            has_bl[:, 0],
-            jnp.minimum(delta, sat_sub(sat_add(nom_c, bl[:, 0]), u[:, 0])),
-            delta,
-        )
-        reserve_plain = jnp.maximum(
-            0, jnp.minimum(delta, sat_sub(nom_c, u[:, 0]))
-        )
-        reserve = jnp.where(
-            borrowing[:, None], reserve_borrowing, reserve_plain
-        )
-        reserve = jnp.where(cell_mask, reserve, 0)
         do_reserve = (
             valid
             & (pm == P_NO_CANDIDATES)
             & ~arrays.can_always_reclaim[c]
             & ~deferred
         )
-
         # Both admitted FIT entries and proceeding preemptors consume their
         # usage (scheduler.go:561 cq.AddUsage runs for either mode).
         take_usage = admit | preempt_ok
-        applied = jnp.where(
-            take_usage[:, None],
-            delta,
-            jnp.where(do_reserve[:, None], reserve, 0),
-        )
-        deltas = jnp.zeros((g_n, n_levels, r_n), dtype=jnp.int64)
-        cur = applied
-        for i in range(n_levels):
-            deltas = deltas.at[:, i].set(cur)
-            cont = (~is_repeat[:, i, None]) if i < n_levels - 1 else False
-            cur = jnp.where(
-                cont, jnp.maximum(0, sat_sub(cur, l_avail[:, i])), 0
+        if with_slots:
+            nom_c = nominal_g[
+                g_iota[:, None], c_local[:, None], fcl_s
+            ]  # [G,S,R]
+            pcell = agg > 0  # plane-union cells (assignment.usage keys)
+            reserve_borrowing = jnp.where(
+                has_bl[:, :, 0],
+                jnp.minimum(
+                    agg, sat_sub(sat_add(nom_c, bl[:, :, 0]), u[:, :, 0])
+                ),
+                agg,
             )
-        # Plain scatter-add on the flavor plane: usage stays far below the
-        # saturation cap (it is bounded by the sum of admitted requests),
-        # so no full-array sat() pass is needed per step. Chain repeats
-        # past the root carry zero deltas, so duplicate indices are benign.
-        new_usage_g = usage_g.at[gi, chain, fg].add(deltas, mode="drop")
+            reserve_plain = jnp.maximum(
+                0, jnp.minimum(agg, sat_sub(nom_c, u[:, :, 0]))
+            )
+            reserve = jnp.where(
+                borrowing[:, None, None], reserve_borrowing, reserve_plain
+            )
+            reserve = jnp.where(pcell, reserve, 0)
+            applied = jnp.where(
+                (take_usage[:, None] & dedup)[:, :, None],
+                agg,
+                jnp.where(
+                    (do_reserve[:, None] & dedup)[:, :, None], reserve, 0
+                ),
+            )  # [G,S,R]
+            deltas = jnp.zeros(
+                (g_n, s_ax, n_levels, r_n), dtype=jnp.int64
+            )
+            cur = applied
+            for i in range(n_levels):
+                deltas = deltas.at[:, :, i].set(cur)
+                cont = (
+                    (~is_repeat[:, None, i, None])
+                    if i < n_levels - 1 else False
+                )
+                cur = jnp.where(
+                    cont, jnp.maximum(0, sat_sub(cur, l_avail[:, :, i])), 0
+                )
+            new_usage_g = usage_g.at[gi3, ch3, fg3].add(
+                deltas, mode="drop"
+            )
+        else:
+            nom_c = nominal_g[g_iota, c_local, fcl]  # [G,R]
+            reserve_borrowing = jnp.where(
+                has_bl[:, 0],
+                jnp.minimum(
+                    delta, sat_sub(sat_add(nom_c, bl[:, 0]), u[:, 0])
+                ),
+                delta,
+            )
+            reserve_plain = jnp.maximum(
+                0, jnp.minimum(delta, sat_sub(nom_c, u[:, 0]))
+            )
+            reserve = jnp.where(
+                borrowing[:, None], reserve_borrowing, reserve_plain
+            )
+            reserve = jnp.where(cell_mask, reserve, 0)
+            applied = jnp.where(
+                take_usage[:, None],
+                delta,
+                jnp.where(do_reserve[:, None], reserve, 0),
+            )
+            deltas = jnp.zeros((g_n, n_levels, r_n), dtype=jnp.int64)
+            cur = applied
+            for i in range(n_levels):
+                deltas = deltas.at[:, i].set(cur)
+                cont = (
+                    (~is_repeat[:, i, None]) if i < n_levels - 1 else False
+                )
+                cur = jnp.where(
+                    cont, jnp.maximum(0, sat_sub(cur, l_avail[:, i])), 0
+                )
+            # Plain scatter-add on the flavor plane: usage stays far below
+            # the saturation cap (it is bounded by the sum of admitted
+            # requests), so no full-array sat() pass is needed per step.
+            # Chain repeats past the root carry zero deltas, so duplicate
+            # indices are benign.
+            new_usage_g = usage_g.at[gi, chain, fg].add(deltas, mode="drop")
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], my_vict, False), axis=0
@@ -929,7 +1316,21 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             victims=victims,
             victim_variant=variant,
             partial_count=partial_count,
+            s_flavor=nom.s_flavor,
+            s_pmode=nom.s_pmode,
+            s_tried=nom.s_tried,
         )
+
+    def apply_partial(arrays, nom):
+        nom, new_req, partial_count = partial_search(
+            arrays, arrays.usage, nom, n_levels=n_levels
+        )
+        arrays = arrays._replace(w_req=new_req)
+        if arrays.s_req is not None:
+            arrays = arrays._replace(
+                s_req=arrays.s_req.at[:, 0].set(new_req)
+            )
+        return arrays, nom, partial_count
 
     if not preempt:
         def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
@@ -937,10 +1338,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             nom = nominate(arrays, usage, n_levels=n_levels)
             partial_count = None
             if arrays.w_partial is not None:
-                nom, new_req, partial_count = partial_search(
-                    arrays, usage, nom, n_levels=n_levels
-                )
-                arrays = arrays._replace(w_req=new_req)
+                arrays, nom, partial_count = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             final_usage, admitted, preempting = admit_scan_grouped(
@@ -1035,6 +1433,11 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             & (nom.praw_count == 1)
             & ~arrays.w_has_gates
         )
+        if arrays.w_simple_slot is not None:
+            # The per-entry victim-search kernels read the legacy
+            # single-slot fields; multi-slot / off-RG0 entries defer to
+            # the host preemptor.
+            base_elig = base_elig & arrays.w_simple_slot
         if arrays.w_tas is not None:
             # TAS entries may use the kernels' tas_fits-aware searches
             # (flat and hierarchical) when the tree's admitted TAS usage
@@ -1095,10 +1498,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         if arrays.w_partial is not None:
             # Partial entries live on never-preempts CQs, so the search
             # runs after (and independent of) the preemption resolution.
-            nom, new_req, partial_count = partial_search(
-                arrays, usage, nom, n_levels=n_levels
-            )
-            arrays = arrays._replace(w_req=new_req)
+            arrays, nom, partial_count = apply_partial(arrays, nom)
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         final_usage, admitted, preempting = admit_scan_grouped(

@@ -94,7 +94,8 @@ class DeviceScheduler:
                 from kueue_tpu.models.fair_kernel import cycle_fair_preempt
 
                 out = cycle_fair_preempt(arrays, idx.admitted_arrays)
-            elif self.use_fixedpoint and not idx.has_partial and not bool(
+            elif self.use_fixedpoint and not idx.has_partial \
+                    and arrays.s_req is None and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
                 out = batch_scheduler.cycle_fixedpoint(
@@ -107,6 +108,18 @@ class DeviceScheduler:
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)
+            s_flavor = (
+                np.asarray(out.s_flavor)
+                if out.s_flavor is not None else None
+            )
+            s_pmode = (
+                np.asarray(out.s_pmode)
+                if out.s_pmode is not None else None
+            )
+            s_tried = (
+                np.asarray(out.s_tried)
+                if out.s_tried is not None else None
+            )
             partial = (
                 np.asarray(out.partial_count)
                 if out.partial_count is not None else None
@@ -147,20 +160,29 @@ class DeviceScheduler:
 
             for i, info in enumerate(idx.workloads):
                 oc = outcome[i]
+                slots_i = idx.slots[i] if idx.slots else None
+                multi = slots_i is not None and len(slots_i) > 1
                 if discarded_roots and \
                         self._in_discarded(info, snapshot, discarded_roots):
                     host_entries.append(info)
                     continue
                 if oc == batch_scheduler.OUT_ADMITTED:
-                    self._apply_admission(
-                        info, idx.flavors[chosen[i]], int(tried[i]),
-                        snapshot, topology_assignment=tas_assignments.get(i),
-                        reduced_count=(
-                            int(partial[i])
-                            if partial is not None and partial[i] >= 0
-                            else None
-                        ),
-                    )
+                    if multi:
+                        self._apply_admission_slots(
+                            info, slots_i, s_flavor[i], s_tried[i], idx,
+                            snapshot,
+                        )
+                    else:
+                        self._apply_admission(
+                            info, idx.flavors[chosen[i]], int(tried[i]),
+                            snapshot,
+                            topology_assignment=tas_assignments.get(i),
+                            reduced_count=(
+                                int(partial[i])
+                                if partial is not None and partial[i] >= 0
+                                else None
+                            ),
+                        )
                     result.admitted.append(info.key)
                 elif oc == batch_scheduler.OUT_PREEMPTING:
                     self._apply_preempting(
@@ -170,8 +192,12 @@ class DeviceScheduler:
                 elif oc == batch_scheduler.OUT_NEEDS_HOST:
                     host_entries.append(info)
                 else:
-                    self._apply_requeue(info, int(oc), int(tried[i]),
-                                        snapshot)
+                    self._apply_requeue(
+                        info, int(oc), int(tried[i]), snapshot,
+                        slots=slots_i if multi else None,
+                        s_pmode_row=s_pmode[i] if multi else None,
+                        s_tried_row=s_tried[i] if multi else None,
+                    )
                     result.skipped.append(info.key)
 
         # Host-exact path for fallback + preemption entries, in one go.
@@ -306,11 +332,13 @@ class DeviceScheduler:
         cqs = snapshot.cluster_queues[info.cluster_queue]
         ps = info.total_requests[0]
         if reduced_count is not None and reduced_count != ps.count:
-            # Partial admission: scale the tracked totals to the found
-            # count (host analog: Scheduler._admit's ps.scaled_to).
-            scaled = ps.scaled_to(reduced_count)
-            ps.requests = scaled.requests
-            ps.count = reduced_count
+            # Partial admission: replace the tracked totals with the
+            # scaled copy (host analog: Scheduler._admit's ps.scaled_to).
+            # Mutating the existing PodSetResources in place would leak
+            # the reduction to any other holder of the object if the
+            # admission were rolled back.
+            ps = ps.scaled_to(reduced_count)
+            info.total_requests[0] = ps
         flavors = {res: flavor for res, v in ps.requests.items()}
         admission = Admission(
             cluster_queue=info.cluster_queue,
@@ -343,6 +371,95 @@ class DeviceScheduler:
             set_condition(wl, COND_ADMITTED, True, "Admitted",
                           "The workload is admitted", now)
         self.cache.assume_workload(info)
+
+    def _apply_admission_slots(
+        self, info: WorkloadInfo, slots, flavor_row, tried_row, idx,
+        snapshot,
+    ) -> None:
+        """Multi-podset / multi-resource-group admission decode: one
+        PodSetAssignment per podset with per-resource flavors recovered
+        from the slot results (host analog: Scheduler._admit over
+        assignment.pod_sets, reference scheduler.go:561)."""
+        now = self.clock()
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        flavors_by_ps = [dict() for _ in info.total_requests]
+        tried_by_ps = [dict() for _ in info.total_requests]
+        for si, sl in enumerate(slots):
+            fname = idx.flavors[int(flavor_row[si])]
+            for pid in sl.ps_ids:
+                for res in info.total_requests[pid].requests:
+                    if res in sl.requests:
+                        flavors_by_ps[pid][res] = fname
+                        tried_by_ps[pid][res] = int(tried_row[si])
+        psas = []
+        for pid, ps in enumerate(info.total_requests):
+            psas.append(
+                PodSetAssignment(
+                    name=ps.name,
+                    flavors=dict(flavors_by_ps[pid]),
+                    resource_usage=dict(ps.requests),
+                    count=ps.count,
+                )
+            )
+            ps.flavors = dict(flavors_by_ps[pid])
+        wl = info.obj
+        wl.status.admission = Admission(
+            cluster_queue=info.cluster_queue, pod_set_assignments=psas
+        )
+        set_condition(wl, COND_QUOTA_RESERVED, True, "QuotaReserved",
+                      f"Quota reserved in ClusterQueue {cqs.name}", now)
+        info.last_assignment = AssignmentClusterQueueState(
+            last_tried_flavor_idx=tried_by_ps,
+            cluster_queue_generation=cqs.allocatable_generation,
+        )
+        checks = cqs.spec.admission_checks
+        if checks:
+            wl.status.admission_checks = [
+                AdmissionCheckState(name=c, state=CheckState.PENDING)
+                for c in checks
+            ]
+        else:
+            set_condition(wl, COND_ADMITTED, True, "Admitted",
+                          "The workload is admitted", now)
+        self.cache.assume_workload(info)
+
+    @staticmethod
+    def _slot_tried_state(info, slots, pmode_row, tried_row):
+        """Rebuild the host's partial last_tried_flavor_idx for a requeued
+        multi-slot entry: one dict per podset of every processed group, up
+        to and including the group whose slot failed (the assigner
+        early-returns there — flavorassigner.go:296); resources of failed
+        or unevaluated slots are absent (next_flavor_to_try -> 0)."""
+        out = []
+        i = 0
+        n = len(slots)
+        stop = False
+        while i < n and not stop:
+            ids = slots[i].ps_ids
+            group = []
+            j = i
+            while j < n and slots[j].ps_ids == ids:
+                group.append(j)
+                j += 1
+            rec: dict = {}
+            for sj in group:
+                if pmode_row[sj] == batch_scheduler.P_NOFIT:
+                    # The host drops the whole group's flavors on failure
+                    # (flavorassigner.go:757), so nothing is recorded for
+                    # any of its resources.
+                    rec = {}
+                    stop = True
+                    break
+                for res in slots[sj].requests:
+                    rec[res] = int(tried_row[sj])
+            for pid in ids:
+                out.append({
+                    res: rec[res]
+                    for res in info.total_requests[pid].requests
+                    if res in rec
+                })
+            i = j
+        return out
 
     def _apply_preempting(
         self,
@@ -393,14 +510,23 @@ class DeviceScheduler:
         )
 
     def _apply_requeue(
-        self, info: WorkloadInfo, outcome: int, tried_idx: int, snapshot
+        self, info: WorkloadInfo, outcome: int, tried_idx: int, snapshot,
+        slots=None, s_pmode_row=None, s_tried_row=None,
     ) -> None:
         cqs = snapshot.cluster_queues[info.cluster_queue]
         ps = info.total_requests[0]
-        info.last_assignment = AssignmentClusterQueueState(
-            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
-            cluster_queue_generation=cqs.allocatable_generation,
-        )
+        if slots is not None:
+            info.last_assignment = AssignmentClusterQueueState(
+                last_tried_flavor_idx=self._slot_tried_state(
+                    info, slots, s_pmode_row, s_tried_row
+                ),
+                cluster_queue_generation=cqs.allocatable_generation,
+            )
+        else:
+            info.last_assignment = AssignmentClusterQueueState(
+                last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+                cluster_queue_generation=cqs.allocatable_generation,
+            )
         reason = {
             batch_scheduler.OUT_NOFIT: RequeueReason.NO_FIT,
             batch_scheduler.OUT_NO_CANDIDATES:

@@ -69,6 +69,23 @@ class CycleArrays(NamedTuple):
     # Host-precomputed (priority desc, timestamp, submission) sort rank:
     # lets admission_order run one composite sort instead of five.
     w_order_rank: Optional[jnp.ndarray] = None  # i32[W] unique per row
+    # -- multi-slot assignment (None when every device workload is one
+    # (podset-group x resource-group) slot on its CQ's first resource
+    # group — the dense legacy layout). A slot mirrors one
+    # _find_flavor_for_podsets call (flavorassigner.go:946): its own
+    # request vector, flavor list, eligibility row and resume index.
+    # Slots are ordered exactly as the host evaluates them (podset-group
+    # order, then resource groups by first triggering resource); slot 0
+    # of a single-slot workload equals the legacy fields above.
+    s_req: Optional[jnp.ndarray] = None  # i64[W,S,R]
+    s_elig: Optional[jnp.ndarray] = None  # bool[W,S,F]
+    s_flavor_at: Optional[jnp.ndarray] = None  # i32[W,S,K]
+    s_n_flavors: Optional[jnp.ndarray] = None  # i32[W,S]
+    s_start: Optional[jnp.ndarray] = None  # i32[W,S]
+    s_valid: Optional[jnp.ndarray] = None  # bool[W,S]
+    # Single slot on resource-group 0: the per-entry device preemption /
+    # partial kernels (which read the legacy fields) remain applicable.
+    w_simple_slot: Optional[jnp.ndarray] = None  # bool[W]
     # -- device preemption (None when the preempt path is not encoded) --
     # borrowWithinCohort policy code (0=Never, 1=LowerPriority) + threshold.
     bwc_policy: Optional[jnp.ndarray] = None  # i32[N]
@@ -139,6 +156,11 @@ class CycleIndex:
     tas_leaf_perm: List[List[int]] = field(default_factory=list)
     tas_pad_shape: Tuple[int, int] = (0, 0)  # (D, R+1) padded axes
     has_partial: bool = False  # any reducible (partial-admission) entry
+    # Multi-slot decode state: per device workload, the ordered slot list
+    # from _workload_slots (None entries for trivially-single workloads
+    # when the cycle is in legacy layout).
+    slots: List[object] = field(default_factory=list)
+    n_slots: int = 1  # padded S axis (1 = legacy layout, no slot fields)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -192,11 +214,9 @@ def encode_cycle(
     bwc_threshold = np.zeros(n, dtype=np.int64)
     bwc_has_threshold = np.zeros(n, dtype=bool)
 
-    single_rg_cq: Dict[str, bool] = {}
     for name, cqs in snapshot.cluster_queues.items():
         ni = tidx.node_of[name]
         spec = cqs.spec
-        single_rg_cq[name] = len(spec.resource_groups) == 1
         if not spec.resource_groups:
             continue
         rg = spec.resource_groups[0]
@@ -315,6 +335,7 @@ def encode_cycle(
 
     # Workload arrays.
     device_wls: List[WorkloadInfo] = []
+    wl_slots: List[List[AssignSlot]] = []
     for info in heads:
         fair_host = False
         if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
@@ -322,13 +343,29 @@ def encode_cycle(
             fair_host = not bool(fair_tree_ok[ni0]) or (
                 info.obj.pod_sets[0].topology_request is not None
             )
+        slots = (
+            _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
+            if info.cluster_queue in snapshot.cluster_queues else None
+        )
         if not fair_host and _device_compatible(
-                info, snapshot, single_rg_cq,
+                info, snapshot, slots,
                 set(tas_device_flavors), delay_tas_fn,
                 preempt, fair_sharing):
             device_wls.append(info)
+            wl_slots.append(slots)
         else:
             idx.host_fallback.append(info)
+
+    # Layout: the dense legacy (single-slot, first-RG) layout compiles the
+    # existing kernels unchanged; any multi-podset or off-RG0 entry
+    # switches the cycle to the slot layout (padded S axis, slot fields).
+    need_slots = any(
+        len(sl) > 1 or sl[0].rg_idx != 0 for sl in wl_slots
+    )
+    s_n = 1
+    if need_slots:
+        s_n = max(len(sl) for sl in wl_slots)
+        s_n = 1 << (s_n - 1).bit_length()  # power-of-two compile bucket
 
     w = _round_up(len(device_wls), 8) if w_pad == 0 else w_pad
     w_cq = np.zeros(w, dtype=np.int32)
@@ -350,8 +387,19 @@ def encode_cycle(
 
     partial_on = _feat.enabled("PartialAdmission") and not fair_sharing
 
+    k_n = max(f, 1)
+    if need_slots:
+        s_req = np.zeros((w, s_n, r), dtype=np.int64)
+        s_elig = np.zeros((w, s_n, f), dtype=bool)
+        s_flavor_at = np.zeros((w, s_n, k_n), dtype=np.int32)
+        s_nf = np.zeros((w, s_n), dtype=np.int32)
+        s_start_arr = np.zeros((w, s_n), dtype=np.int32)
+        s_valid = np.zeros((w, s_n), dtype=bool)
+        w_simple = np.zeros(w, dtype=bool)
+
     for i, info in enumerate(device_wls):
         idx.workloads.append(info)
+        slots = wl_slots[i]
         cqs = snapshot.cluster_queues[info.cluster_queue]
         ni = tidx.node_of[info.cluster_queue]
         w_cq[i] = ni
@@ -360,8 +408,10 @@ def encode_cycle(
         w_timestamp[i] = queue_order_timestamp(info.obj)
         w_qr[i] = has_quota_reservation(info.obj)
         w_gates[i] = bool(info.obj.preemption_gates)
-        ps = info.total_requests[0]
-        for res, v in ps.requests.items():
+        # Legacy request vector = slot 0 (equals total_requests[0] for
+        # single-slot first-RG workloads; the per-entry preemption and
+        # partial-admission kernels only apply to those — w_simple_slot).
+        for res, v in slots[0].requests.items():
             if res in tidx.resource_of:
                 w_req[i, tidx.resource_of[res]] = v
         ps0 = info.obj.pod_sets[0]
@@ -376,32 +426,75 @@ def encode_cycle(
             for res, v in ps0.requests.items():
                 if res in tidx.resource_of:
                     w_pp[i, tidx.resource_of[res]] = v
-        # Taints/affinity eligibility per flavor (host-side; reuses the
-        # exact assigner's check). The verdict depends only on flavor specs
-        # and the podset, so it is cached on the WorkloadInfo keyed by the
-        # cache spec generation — a requeued workload re-encodes in O(F)
-        # array copy instead of re-running the matcher every cycle.
+        # Taints/affinity eligibility per flavor and slot (host-side;
+        # reuses the exact assigner's check). The verdict depends only on
+        # flavor specs and the slot's podsets, so it is cached on the
+        # WorkloadInfo keyed by the cache spec generation — a requeued
+        # workload re-encodes in O(S*F) array copy instead of re-running
+        # the matcher every cycle.
         gen = cqs.allocatable_generation
         cached = getattr(info, "_elig_cache", None)
         if cached is not None and cached[0] == gen \
-                and cached[1].shape[0] == f:
-            w_elig[i] = cached[1]
+                and cached[1].shape == (len(slots), f):
+            erows = cached[1]
         else:
             assigner = FlavorAssigner(info, cqs, resource_flavors)
-            pod_sets = [info.obj.pod_sets[0]]
-            for fname, fi in tidx.flavor_of.items():
-                ok, _ = assigner._check_flavor_for_podsets(fname, pod_sets)
-                w_elig[i, fi] = ok
-            info._elig_cache = (gen, w_elig[i].copy())
-        if info.last_assignment is not None and (
+            erows = np.zeros((len(slots), f), dtype=bool)
+            for si, sl in enumerate(slots):
+                pod_sets = [info.obj.pod_sets[j] for j in sl.ps_ids]
+                for fname, fi in tidx.flavor_of.items():
+                    ok, _ = assigner._check_flavor_for_podsets(
+                        fname, pod_sets
+                    )
+                    erows[si, fi] = ok
+            info._elig_cache = (gen, erows)
+        allowed = info.obj.labels.get(
+            "kueue.x-k8s.io/allowed-resource-flavor"
+        )
+        if allowed is not None:
+            # ConcurrentAdmission variants race one flavor each: the host
+            # scan skips every other flavor (flavorassigner.go:981
+            # semantics); masking eligibility is the identical device
+            # behavior (skipped and NoFit flavors both advance the scan).
+            amask = np.zeros(f, dtype=bool)
+            ai = tidx.flavor_of.get(allowed)
+            if ai is not None:
+                amask[ai] = True
+            erows = erows & amask[None, :]
+        w_elig[i] = erows[0]
+        resume = info.last_assignment is not None and (
             cqs.allocatable_generation
             <= info.last_assignment.cluster_queue_generation
-        ):
-            # Resume keys exist only for resources the workload requests
-            # (single resource group -> same index for all of them).
-            res_keys = [r for r in ps.requests if r in tidx.resource_of]
-            res0 = res_keys[0] if res_keys else ""
-            w_start[i] = info.last_assignment.next_flavor_to_try(0, res0)
+        )
+        if resume:
+            # Per-slot resume key: the resource that opens the slot's RG
+            # search (first in sorted group-request order), exactly the
+            # host's res_name at flavorassigner.go:425.
+            w_start[i] = info.last_assignment.next_flavor_to_try(
+                slots[0].ps_ids[0], slots[0].trigger_res
+            )
+        if need_slots:
+            w_simple[i] = len(slots) == 1 and slots[0].rg_idx == 0
+            for si, sl in enumerate(slots):
+                s_valid[i, si] = True
+                rg_s = cqs.spec.resource_groups[sl.rg_idx]
+                flist = [
+                    fq.name for fq in rg_s.flavors
+                    if fq.name in tidx.flavor_of
+                ]
+                s_nf[i, si] = len(flist)
+                for k2, fname in enumerate(flist):
+                    s_flavor_at[i, si, k2] = tidx.flavor_of[fname]
+                for res, v in sl.requests.items():
+                    if res in tidx.resource_of:
+                        s_req[i, si, tidx.resource_of[res]] = v
+                s_elig[i, si] = erows[si]
+                if resume:
+                    s_start_arr[i, si] = (
+                        info.last_assignment.next_flavor_to_try(
+                            sl.ps_ids[0], sl.trigger_res
+                        )
+                    )
 
     partial_fields: Dict[str, object] = {}
     if w_part.any():
@@ -409,6 +502,16 @@ def encode_cycle(
         partial_fields = dict(
             w_req_pp=w_pp, w_count=w_cnt, w_min_count=w_minc,
             w_partial=w_part,
+        )
+
+    slot_fields: Dict[str, object] = {}
+    if need_slots:
+        idx.slots = wl_slots
+        idx.n_slots = s_n
+        slot_fields = dict(
+            s_req=s_req, s_elig=s_elig, s_flavor_at=s_flavor_at,
+            s_n_flavors=s_nf, s_start=s_start_arr, s_valid=s_valid,
+            w_simple_slot=w_simple,
         )
 
     preempt_fields: Dict[str, object] = {}
@@ -502,6 +605,7 @@ def encode_cycle(
         w_start_flavor=np.asarray(w_start),
         w_order_rank=np.asarray(_order_rank(w_priority, w_timestamp)),
         **partial_fields,
+        **slot_fields,
         **preempt_fields,
     )
     # ONE batched host->device transfer for every encoded tensor: over a
@@ -897,10 +1001,75 @@ def _encode_admitted(snapshot, tidx, tree, idx, fair_sharing):
     return preempt_simple, preempt_hier, fair_node_ok, preempt_tas_ok
 
 
+@dataclass
+class AssignSlot:
+    """One (podset-group x resource-group) flavor-search unit, mirroring a
+    single _find_flavor_for_podsets call (flavorassigner.go:712+946)."""
+
+    ps_ids: List[int]
+    rg_idx: int
+    requests: Dict[str, int]
+    trigger_res: str  # the sorted-order resource that opens the RG search
+
+
+# Hard cap on the padded slot axis; wider workloads take the host path.
+MAX_SLOTS = 16
+
+
+def _workload_slots(info: WorkloadInfo, cqs) -> Optional[List[AssignSlot]]:
+    """Mirror FlavorAssigner._assign_flavors grouping: podset groups in
+    first-appearance order, then resource groups in the order their first
+    resource appears in sorted(group_requests). Returns None when any
+    positive request has no resource group, or a resource is covered by
+    more than one group (ambiguous first-match semantics) — host path."""
+    res_rg: Dict[str, int] = {}
+    for gi, rg in enumerate(cqs.spec.resource_groups):
+        for res in rg.covered_resources:
+            if res in res_rg:
+                return None  # overlapping coverage: keep host semantics
+            res_rg[res] = gi
+
+    groups: Dict[str, List[int]] = {}
+    order: List[str] = []
+    for i, _ps in enumerate(info.total_requests):
+        key = str(i)
+        tr = info.obj.pod_sets[i].topology_request
+        if tr is not None and tr.podset_group_name:
+            key = tr.podset_group_name
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    slots: List[AssignSlot] = []
+    for key in order:
+        ps_ids = groups[key]
+        group_requests: Dict[str, int] = {}
+        for i in ps_ids:
+            for res, v in info.total_requests[i].requests.items():
+                group_requests[res] = group_requests.get(res, 0) + v
+        by_rg: Dict[int, AssignSlot] = {}
+        rg_order: List[int] = []
+        for res in sorted(group_requests):
+            gi = res_rg.get(res)
+            if gi is None:
+                if group_requests[res] == 0:
+                    continue
+                return None  # uncovered positive request: host path
+            if gi not in by_rg:
+                by_rg[gi] = AssignSlot(
+                    ps_ids=ps_ids, rg_idx=gi, requests={}, trigger_res=res
+                )
+                rg_order.append(gi)
+            by_rg[gi].requests[res] = group_requests[res]
+        slots.extend(by_rg[gi] for gi in rg_order)
+    return slots
+
+
 def _device_compatible(
     info: WorkloadInfo,
     snapshot: Snapshot,
-    single_rg_cq: Dict[str, bool],
+    slots: Optional[List[AssignSlot]],
     tas_device_flavors: set = frozenset(),
     delay_tas_fn=None,
     preempt: bool = False,
@@ -908,12 +1077,25 @@ def _device_compatible(
 ) -> bool:
     if info.cluster_queue not in snapshot.cluster_queues:
         return False
-    if not single_rg_cq.get(info.cluster_queue, False):
+    if slots is None or not slots or len(slots) > MAX_SLOTS:
         return False
-    if len(info.total_requests) != 1:
+    multi_slot = len(slots) > 1 or slots[0].rg_idx != 0
+    if multi_slot and fair_sharing:
+        # The fair tournament kernel evaluates single-slot entries only.
+        return False
+    if any(
+        ps.topology_request is not None for ps in info.obj.pod_sets
+    ) and (len(info.obj.pod_sets) != 1 or multi_slot):
+        # Device TAS stays single-podset / first-RG for now.
         return False
     ps = info.obj.pod_sets[0]
     cqs = snapshot.cluster_queues[info.cluster_queue]
+    if any(
+        p.min_count is not None and p.min_count < p.count
+        for p in info.obj.pod_sets
+    ) and (len(info.obj.pod_sets) != 1 or multi_slot):
+        # Device PodSetReducer handles the single-podset class only.
+        return False
     if ps.min_count is not None and ps.min_count < ps.count:
         # Partial admission (PodSetReducer): the device search handles the
         # single-podset never-preempts class under the PartialAdmission
@@ -971,9 +1153,6 @@ def _device_compatible(
         if (ps.node_selector or ps.tolerations or any_tainted) \
                 and tas_flavor_count > 1:
             return False
-    rg = cqs.spec.resource_groups[0]
-    return all(
-        res in rg.covered_resources
-        for res, v in info.total_requests[0].requests.items()
-        if v > 0
-    )
+    # Coverage is guaranteed by the slot computation (None on any
+    # uncovered positive request).
+    return True

@@ -24,6 +24,8 @@ import argparse
 import json
 import threading
 import time
+import uuid
+from collections import deque
 from concurrent import futures
 from typing import Optional
 
@@ -47,14 +49,46 @@ def serve_worker_grpc(
     manager: Manager, address: str = "127.0.0.1:0", in_thread: bool = True
 ):
     """Serve ``manager`` over gRPC. Returns (server, bound_address);
-    call ``server.stop(0)`` to kill it."""
+    call ``server.stop(0)`` to kill it.
+
+    Requests carrying a client ``rid`` are deduplicated: a retry of an
+    already-executed call (client deadline fired after the op applied)
+    replays the recorded response instead of re-executing non-idempotent
+    ops like ``schedule`` (double virtual-clock tick) or
+    ``create_workload`` (spurious 'exists')."""
     lock = threading.Lock()
+    seen: dict = {}
+    seen_order: deque = deque()
+
+    # Only mutating ops need replay protection; caching reads would churn
+    # useful entries and pin response payloads for no benefit.
+    _MUTATING = {"create_workload", "delete_workload", "schedule",
+                 "finish_workload"}
 
     def call(request: bytes, context) -> bytes:
+        rid = None
         try:
             req = json.loads(request)
+            rid = req.pop("rid", None)
+            if req.get("op") not in _MUTATING:
+                rid = None
             with lock:
-                resp = dispatch(manager, req)
+                if rid is not None and rid in seen:
+                    return seen[rid]
+                # The op may have mutated state even when it raises, so
+                # the error response is recorded under the rid too —
+                # otherwise a retry would re-execute the half-applied op.
+                try:
+                    resp = dispatch(manager, req)
+                except Exception as exc:  # noqa: BLE001
+                    resp = {"ok": False, "error": repr(exc)[:500]}
+                out = json.dumps(resp).encode()
+                if rid is not None:
+                    seen[rid] = out
+                    seen_order.append(rid)
+                    while len(seen_order) > 1024:
+                        seen.pop(seen_order.popleft(), None)
+                return out
         except Exception as exc:  # noqa: BLE001 - wire errors back
             resp = {"ok": False, "error": repr(exc)[:500]}
         return json.dumps(resp).encode()
@@ -91,9 +125,15 @@ class GrpcWorkerClient:
         connect_timeout: float = 2.0,
         retries: int = 2,
         backoff_s: float = 0.05,
+        op_timeout: float = 30.0,
     ) -> None:
         self.address = address
+        # connect_timeout bounds cheap control ops (ping); op_timeout
+        # bounds real work — a schedule cycle at DCN scale can legally
+        # exceed 2 s, and timing it out mid-execution would leave the
+        # retry racing an op that completes server-side.
         self.connect_timeout = connect_timeout
+        self.op_timeout = max(op_timeout, connect_timeout)
         self.retries = retries
         self.backoff_s = backoff_s
         self._channel: Optional[grpc.Channel] = None
@@ -120,14 +160,19 @@ class GrpcWorkerClient:
         self._channel = None
         self._call_fn = None
 
-    def _call(self, req: dict) -> dict:
+    def _call(self, req: dict, timeout: Optional[float] = None) -> dict:
+        # One request id across all attempts of this logical call: the
+        # server dedupes replays, so retrying after an ambiguous failure
+        # (deadline fired after the op applied) cannot re-execute it.
+        req = dict(req, rid=uuid.uuid4().hex)
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:
                 if self._call_fn is None:
                     self._connect()
                 raw = self._call_fn(
-                    json.dumps(req).encode(), timeout=self.connect_timeout
+                    json.dumps(req).encode(),
+                    timeout=timeout or self.op_timeout,
                 )
                 resp = json.loads(raw)
                 if not resp.get("ok"):
@@ -136,6 +181,9 @@ class GrpcWorkerClient:
             except (grpc.RpcError, json.JSONDecodeError) as exc:
                 last_exc = exc
                 self.close()
+                # Retry connection-establishment failures; a DEADLINE or
+                # INTERNAL mid-call is retried too, but the rid dedupe
+                # makes the replay safe.
                 if attempt < self.retries:
                     time.sleep(self.backoff_s * (2 ** attempt))
         raise WorkerUnreachable(
@@ -146,7 +194,10 @@ class GrpcWorkerClient:
 
     def ping(self) -> bool:
         try:
-            return bool(self._call({"op": "ping"}).get("pong"))
+            return bool(
+                self._call({"op": "ping"}, timeout=self.connect_timeout)
+                .get("pong")
+            )
         except WorkerUnreachable:
             return False
 

@@ -274,6 +274,11 @@ class FlavorAssigner:
                 group_reasons.extend(reasons)
                 group_no_fit_reason = nf_reason or group_no_fit_reason
                 if not flavors and group_requests:
+                    # The whole group's flavors are dropped so the podset
+                    # reads NoFit — a partial assignment must not mask the
+                    # failed resource (flavorassigner.go:757 groupFlavors
+                    # = nil).
+                    group_flavors = {}
                     failed = True
                     break
                 group_flavors.update(flavors)

@@ -146,6 +146,45 @@ class _History:
 
 _history = _History()
 
+# Shared state snapshot: every reader (each websocket connection, every
+# /api/state poll) goes through one cache + one history sampler, so N
+# connected clients cannot record N duplicate history samples per state
+# change, and the O(workloads) serialization runs at most once per
+# refresh interval regardless of client count.
+_state_lock = threading.Lock()
+_state_cache = {"ts": 0.0, "core": None, "doc": None, "mgr": None}
+
+
+def shared_state_doc(manager, max_age_s: float = 0.2):
+    """Compute (or reuse) the state document. Returns (doc, core_bytes);
+    ``core_bytes`` excludes the history lists so callers can use it for
+    change detection. History is sampled exactly once per distinct state
+    revision across all callers."""
+    now = time.monotonic()
+    with _state_lock:
+        if (
+            _state_cache["doc"] is not None
+            and _state_cache["mgr"] is manager
+            and now - _state_cache["ts"] < max_age_s
+        ):
+            return _state_cache["doc"], _state_cache["core"]
+        doc = state_json(manager, sample_history=False)
+        core = json.dumps(
+            {k: v for k, v in doc.items() if k != "history"}
+        ).encode()
+        if core != _state_cache["core"]:
+            t = doc["totals"]
+            _history.sample(
+                t["pending"], t["admitted"], t["preempted (total)"]
+            )
+        doc["history"] = {
+            "pending": list(_history.pending),
+            "admitted": list(_history.admitted),
+            "preempted_total": list(_history.preempted_total),
+        }
+        _state_cache.update(ts=now, core=core, doc=doc, mgr=manager)
+        return doc, core
+
 
 def _cohort_tree(manager):
     children: Dict[str, list] = {}
@@ -171,6 +210,20 @@ def _cohort_tree(manager):
 
 
 def state_json(manager, sample_history: bool = True) -> Dict:
+    """Serialize live manager state. The scheduler may mutate its dicts
+    concurrently (the dashboard handler threads share the process);
+    iteration races surface as RuntimeError — retry on a fresh view
+    rather than killing the caller's stream."""
+    for attempt in range(5):
+        try:
+            return _state_json_once(manager, sample_history)
+        except RuntimeError:
+            if attempt == 4:
+                raise
+            time.sleep(0.005)
+
+
+def _state_json_once(manager, sample_history: bool = True) -> Dict:
     cqs = []
     total_pending = 0
     total_admitted = 0
@@ -286,27 +339,13 @@ def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081,
             reader = wsmod.SockReader(self.connection)
             try:
                 while True:
-                    # Change detection excludes the history lists (and
-                    # skips the history sample) so the periodic check
-                    # itself cannot manufacture a difference; a sample is
-                    # recorded only when a change is actually pushed.
-                    doc = state_json(manager, sample_history=False)
-                    core = json.dumps(
-                        {k: v for k, v in doc.items() if k != "history"}
-                    ).encode()
+                    # Shared snapshot: computed once per tick across all
+                    # connections, history sampled once per distinct
+                    # revision (shared_state_doc). Change detection
+                    # excludes the history lists so the periodic check
+                    # itself cannot manufacture a difference.
+                    doc, core = shared_state_doc(manager)
                     if core != last_core:
-                        t = doc["totals"]
-                        _history.sample(
-                            t["pending"], t["admitted"],
-                            t["preempted (total)"],
-                        )
-                        doc["history"] = {
-                            "pending": list(_history.pending),
-                            "admitted": list(_history.admitted),
-                            "preempted_total": list(
-                                _history.preempted_total
-                            ),
-                        }
                         self.connection.sendall(wsmod.encode_frame(
                             json.dumps(doc).encode(), wsmod.OP_TEXT
                         ))
@@ -344,7 +383,7 @@ def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081,
                 self._serve_ws()
                 return
             if self.path == "/api/state":
-                body = json.dumps(state_json(manager)).encode()
+                body = json.dumps(shared_state_doc(manager)[0]).encode()
                 ctype = "application/json"
             elif self.path == "/api/metrics":
                 body = manager.metrics.expose().encode()

@@ -83,9 +83,19 @@ class SockReader:
         return bool(self.buf)
 
 
-def read_frame(rfile) -> Optional[Tuple[int, bytes]]:
+# Upper bound on accepted client frames. The dashboard only ever expects
+# tiny control/close frames from browsers; a client-declared 64-bit length
+# must not drive the reader into buffering gigabytes.
+MAX_CLIENT_FRAME = 1 << 20
+_CONTROL_OPS = (OP_CLOSE, OP_PING, OP_PONG)
+
+
+def read_frame(rfile, require_mask: bool = True) -> Optional[Tuple[int, bytes]]:
     """Read one frame from a file-like socket reader. Returns
-    (opcode, payload) or None on EOF. Unmasks client payloads."""
+    (opcode, payload) or None on EOF / protocol violation. Unmasks masked
+    payloads. With ``require_mask`` (the server side), unmasked frames
+    fail the connection (RFC 6455 5.1); oversized declared lengths always
+    do (5.5 bounds control frames; MAX_CLIENT_FRAME bounds the rest)."""
     h = rfile.read(2)
     if len(h) < 2:
         return None
@@ -102,6 +112,14 @@ def read_frame(rfile) -> Optional[Tuple[int, bytes]]:
         if len(ext) < 8:
             return None
         n = struct.unpack(">Q", ext)[0]
+    if require_mask and not masked:
+        return None  # clients MUST mask; fail the connection
+    if opcode in _CONTROL_OPS and n > 125:
+        return None  # control frames are bounded by RFC 6455 5.5
+    if require_mask and n > MAX_CLIENT_FRAME:
+        # The size cap protects the SERVER from client-declared lengths;
+        # server->client pushes (state documents) are legitimately large.
+        return None
     key = rfile.read(4) if masked else b""
     payload = rfile.read(n) if n else b""
     if masked and payload:

@@ -891,7 +891,7 @@ def test_dashboard_websocket_stream():
             headers[k.strip().lower()] = v.strip()
         assert headers["sec-websocket-accept"] == wsmod.accept_key(key)
 
-        op, payload = wsmod.read_frame(rfile)
+        op, payload = wsmod.read_frame(rfile, require_mask=False)
         assert op == wsmod.OP_TEXT
         state = _json.loads(payload)
         assert state["totals"]["admitted"] == 0
@@ -899,16 +899,16 @@ def test_dashboard_websocket_stream():
         # A state change must be pushed without the client asking.
         mgr.create_workload(make_wl("ws-1", cpu_m=1000))
         mgr.schedule_all()
-        op, payload = wsmod.read_frame(rfile)
+        op, payload = wsmod.read_frame(rfile, require_mask=False)
         assert op == wsmod.OP_TEXT
         state = _json.loads(payload)
         assert state["totals"]["admitted"] == 1
 
         # Ping -> pong.
         sock.sendall(wsmod.encode_frame(b"hb", wsmod.OP_PING, mask=True))
-        op, payload = wsmod.read_frame(rfile)
+        op, payload = wsmod.read_frame(rfile, require_mask=False)
         while op == wsmod.OP_TEXT:  # history sampling may push again
-            op, payload = wsmod.read_frame(rfile)
+            op, payload = wsmod.read_frame(rfile, require_mask=False)
         assert op == wsmod.OP_PONG and payload == b"hb"
 
         sock.sendall(wsmod.encode_frame(b"", wsmod.OP_CLOSE, mask=True))
