@@ -507,8 +507,78 @@ def probe_phases():
     return stats
 
 
+def probe_multichip():
+    """Weak-scaling curve on the virtual host mesh: the north-star cycle
+    timed over 1/2/4/8 devices with the workload axis sharded (nominate is
+    the FLOP-parallel phase; the grouped admission scan is sequential by
+    semantics and replicated). Runs on the forced-CPU host platform — the
+    same compiled sharding program a real multi-chip TPU mesh would run,
+    minus the interconnect speeds."""
+    import numpy as np
+    import jax
+
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.parallel import sharding as par
+
+    n_avail = len(jax.devices())
+    W = 50_000
+    arrays, layout = build_mega(W=W)
+    ga = bs.GroupArrays(*layout.as_jax())
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    group_of = np.asarray(layout.flat_to_group)[np.asarray(arrays.w_cq)]
+    s_exact = int(np.bincount(group_of, minlength=layout.n_groups).max())
+    stats = {
+        "probe": "multichip", "ok": True, "devices": n_avail, "w": W,
+        "note": (
+            "virtual host devices share one CPU's cores: this curve "
+            "measures sharding/collective overhead and program validity, "
+            "not speedup; real chips split the nominate FLOPs"
+        ),
+    }
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nom_proto = bs.NominateResult(*([0] * 8))
+    for n in (1, 2, 4, 8):
+        if n > n_avail or W % n:
+            continue
+        try:
+            mesh = par.make_mesh(n)
+            rep = NamedSharding(mesh, P())
+            nom_fn = jax.jit(
+                lambda a: bs.nominate(a, a.usage, n_levels=n_levels),
+                in_shardings=(par.arrays_shardings(mesh, arrays),),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda _: rep, nom_proto
+                ),
+            )
+            out = nom_fn(arrays)
+            jax.block_until_ready(out)
+            t0 = time.monotonic()
+            out = nom_fn(arrays)
+            jax.block_until_ready(out)
+            stats[f"nominate_{n}dev_ms"] = round(
+                (time.monotonic() - t0) * 1000, 1
+            )
+            cyc = par.sharded_grouped_cycle(
+                mesh, arrays, ga, s_max=s_exact, n_levels=n_levels,
+                unroll=4,
+            )
+            out = cyc(arrays, ga)
+            jax.block_until_ready(out.outcome)
+            t0 = time.monotonic()
+            out = cyc(arrays, ga)
+            jax.block_until_ready(out.outcome)
+            stats[f"cycle_{n}dev_ms"] = round(
+                (time.monotonic() - t0) * 1000, 1
+            )
+        except Exception as exc:  # noqa: BLE001 - record and continue
+            stats[f"{n}dev_error"] = repr(exc)[:300]
+    return stats
+
+
 def run_probe_subprocess(
-    probe: str, timeout_s: int, scale: float, platform: str = None
+    probe: str, timeout_s: int, scale: float, platform: str = None,
+    env_extra: dict = None,
 ) -> dict:
     """Run one probe in a timeout-guarded subprocess; parse its JSON line."""
     cmd = [
@@ -517,9 +587,14 @@ def run_probe_subprocess(
     ]
     if platform:
         cmd += ["--platform", platform]
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     try:
         res = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s + 30
+            cmd, capture_output=True, text=True, timeout=timeout_s + 30,
+            env=env,
         )
     except subprocess.TimeoutExpired:
         return {"probe": probe, "ok": False, "error": "outer timeout"}
@@ -542,7 +617,7 @@ def main():
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
     ap.add_argument("--probe", default=None,
-                    choices=["ping", "mega", "sim", "phases"],
+                    choices=["ping", "mega", "sim", "phases", "multichip"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -563,6 +638,7 @@ def main():
                 "mega": probe_mega,
                 "sim": lambda: probe_sim(args.scale),
                 "phases": probe_phases,
+                "multichip": probe_multichip,
             }[args.probe]()
         except Exception as exc:  # noqa: BLE001 - report, don't crash
             stats = {"probe": args.probe, "ok": False,
@@ -599,6 +675,19 @@ def main():
             or (device.get("mega") or {}).get("ok")
         )
 
+    multichip = {}
+    if not args.skip_device:
+        # Weak-scaling curve on the virtual host mesh (tunnel-independent;
+        # the same sharded program a real multi-chip mesh runs).
+        multichip = run_probe_subprocess(
+            "multichip", 900, args.scale, "cpu",
+            env_extra={
+                "XLA_FLAGS": os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            },
+        )
+        log(f"multichip probe: {multichip}")
+
     baseline_throughput = 42.7  # BASELINE.md derived admissions/s
     value = round(stats["throughput"], 2)
     out = {
@@ -611,6 +700,8 @@ def main():
         out["device"] = device
         sim = device.get("sim") or {}
         out["device_time_s"] = sim.get("device_wall_s", 0.0)
+    if multichip:
+        out["multichip"] = multichip
     print(json.dumps(out), flush=True)
     # Skip interpreter teardown: a wedged accelerator transport can hang
     # JAX's backend finalizers, and the result is already on stdout.

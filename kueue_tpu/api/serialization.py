@@ -221,6 +221,42 @@ def decode(doc: Dict[str, Any]):
         )
     if kind == "Namespace":
         return Namespace(name=name, labels=meta.get("labels", {}))
+    if kind == "LimitRange":
+        from kueue_tpu.api.types import LimitRange, LimitRangeItem
+
+        def _qmap(d):
+            return {
+                r: parse_quantity(v, r) for r, v in (d or {}).items()
+            }
+
+        return LimitRange(
+            name=name,
+            namespace=meta.get("namespace", "default"),
+            items=[
+                LimitRangeItem(
+                    type=it.get("type", "Container"),
+                    max=_qmap(it.get("max")),
+                    min=_qmap(it.get("min")),
+                    default=_qmap(it.get("default")),
+                    default_request=_qmap(it.get("defaultRequest")),
+                    max_limit_request_ratio={
+                        r: int(v) for r, v in
+                        (it.get("maxLimitRequestRatio") or {}).items()
+                    },
+                )
+                for it in spec.get("limits", [])
+            ],
+        )
+    if kind == "RuntimeClass":
+        from kueue_tpu.api.types import RuntimeClass
+
+        pod_fixed = (doc.get("overhead") or {}).get("podFixed", {})
+        return RuntimeClass(
+            name=name,
+            overhead={
+                r: parse_quantity(v, r) for r, v in pod_fixed.items()
+            },
+        )
     if kind == "ResourceSlice":
         from kueue_tpu.dra import Device, ResourceSlice
 
@@ -334,15 +370,59 @@ def decode(doc: Dict[str, Any]):
     raise ValueError(f"unknown kind: {kind}")
 
 
+def _container(c: Dict[str, Any]):
+    from kueue_tpu.api.types import Container
+
+    res = c.get("resources", {}) or {}
+    return Container(
+        name=c.get("name", ""),
+        requests={
+            r: parse_quantity(v, r)
+            for r, v in (res.get("requests") or {}).items()
+        },
+        limits={
+            r: parse_quantity(v, r)
+            for r, v in (res.get("limits") or {}).items()
+        },
+        restart_policy=c.get("restartPolicy"),
+    )
+
+
 def _podset(d: Dict[str, Any]) -> PodSet:
     template = d.get("template", {}).get("spec", {})
-    containers = template.get("containers", [])
+    containers = [_container(c) for c in template.get("containers", [])]
+    init_containers = [
+        _container(c) for c in template.get("initContainers", [])
+    ]
+    overhead = {
+        r: parse_quantity(v, r)
+        for r, v in (template.get("overhead") or {}).items()
+    }
+    pod_res = template.get("resources") or {}
+    pod_requests = {
+        r: parse_quantity(v, r)
+        for r, v in (pod_res.get("requests") or {}).items()
+    }
+    pod_limits = {
+        r: parse_quantity(v, r)
+        for r, v in (pod_res.get("limits") or {}).items()
+    }
     requests: Dict[str, int] = {}
-    for c in containers:
-        for r, v in (c.get("resources", {}).get("requests") or {}).items():
-            requests[r] = requests.get(r, 0) + parse_quantity(v, r)
+    if containers or init_containers:
+        # Initial derivation without LimitRange context (the Manager
+        # re-derives with namespace LimitRanges at workload creation):
+        # k8s PodRequests semantics incl. the init-container max rule,
+        # sidecars and overhead (utils/limitrange.pod_requests).
+        from kueue_tpu.utils.limitrange import pod_requests as _pr
+
+        requests = _pr(PodSet(
+            name="", count=1, containers=containers,
+            init_containers=init_containers, overhead=overhead,
+            pod_requests=pod_requests, pod_limits=pod_limits,
+        ))
+    explicit = d.get("requests", {})
     requests.update({
-        r: parse_quantity(v, r) for r, v in d.get("requests", {}).items()
+        r: parse_quantity(v, r) for r, v in explicit.items()
     })
     tr = d.get("topologyRequest")
     topology_request = None
@@ -371,6 +451,13 @@ def _podset(d: Dict[str, Any]) -> PodSet:
         node_selector=template.get("nodeSelector", {}),
         tolerations=[_toleration(t) for t in template.get("tolerations", [])],
         topology_request=topology_request,
+        containers=containers,
+        init_containers=init_containers,
+        overhead=overhead,
+        runtime_class_name=template.get("runtimeClassName"),
+        pod_requests=pod_requests,
+        pod_limits=pod_limits,
+        requests_explicit=bool(explicit),
     )
 
 
@@ -680,4 +767,26 @@ def encode(obj) -> Dict[str, Any]:
         if status:
             doc["status"] = status
         return doc
+    if type(obj).__name__ == "LimitRange":
+        return {
+            "kind": "LimitRange",
+            "metadata": {"name": obj.name, "namespace": obj.namespace},
+            "spec": {"limits": [{
+                "type": it.type,
+                **({"max": dict(it.max)} if it.max else {}),
+                **({"min": dict(it.min)} if it.min else {}),
+                **({"default": dict(it.default)} if it.default else {}),
+                **({"defaultRequest": dict(it.default_request)}
+                   if it.default_request else {}),
+                **({"maxLimitRequestRatio":
+                    dict(it.max_limit_request_ratio)}
+                   if it.max_limit_request_ratio else {}),
+            } for it in obj.items]},
+        }
+    if type(obj).__name__ == "RuntimeClass":
+        return {
+            "kind": "RuntimeClass",
+            "metadata": {"name": obj.name},
+            "overhead": {"podFixed": dict(obj.overhead)},
+        }
     raise TypeError(f"cannot encode {type(obj)!r}")

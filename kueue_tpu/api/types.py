@@ -291,6 +291,56 @@ class TopologyRequest:
 
 
 @dataclass
+class Container:
+    """Container resource spec for pod-spec-level request derivation
+    (corev1.Container subset; reference pkg/workload/resources.go applies
+    LimitRange defaults and limits-as-missing-requests to these before
+    totaling)."""
+
+    name: str = ""
+    requests: Dict[str, int] = field(default_factory=dict)
+    limits: Dict[str, int] = field(default_factory=dict)
+    # "Always" on an init container marks a sidecar (restartable): its
+    # requests add to the running base instead of the init peak.
+    restart_policy: Optional[str] = None
+
+
+@dataclass
+class LimitRangeItem:
+    """One constraint row of a LimitRange (corev1.LimitRangeItem)."""
+
+    type: str = "Container"  # "Container" | "Pod"
+    max: Dict[str, int] = field(default_factory=dict)
+    min: Dict[str, int] = field(default_factory=dict)
+    default: Dict[str, int] = field(default_factory=dict)  # limits default
+    default_request: Dict[str, int] = field(default_factory=dict)
+    max_limit_request_ratio: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    """Namespace resource bounds/defaults (corev1.LimitRange; consumed by
+    the request-derivation pipeline, reference pkg/util/limitrange)."""
+
+    name: str
+    namespace: str = "default"
+    items: List[LimitRangeItem] = field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class RuntimeClass:
+    """nodev1.RuntimeClass subset: pod overhead source (reference
+    pkg/workload/resources.go handlePodOverhead)."""
+
+    name: str
+    overhead: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class PodSet:
     """Homogeneous group of pods (reference workload_types.go:556)."""
 
@@ -306,6 +356,21 @@ class PodSet:
     required_affinity: List[MatchExpression] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_request: Optional[TopologyRequest] = None
+    # Optional pod-spec level (reference PodSpec subset): when containers
+    # are present, ``requests`` is DERIVED at workload creation — the
+    # init-container max rule, sidecar accumulation, pod overhead and
+    # LimitRange defaulting (utils/limitrange.py; reference
+    # pkg/workload/resources.go AdjustResources + k8s PodRequests).
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    overhead: Dict[str, int] = field(default_factory=dict)
+    runtime_class_name: Optional[str] = None
+    # Pod-level resources (KEP-2837): override totals for named resources.
+    pod_requests: Dict[str, int] = field(default_factory=dict)
+    pod_limits: Dict[str, int] = field(default_factory=dict)
+    # True when the manifest stated ``requests`` directly (the abstract
+    # shorthand): derivation must not overwrite the user's numbers.
+    requests_explicit: bool = False
 
 
 @dataclass

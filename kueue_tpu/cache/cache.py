@@ -45,6 +45,10 @@ class Cache:
         self.local_queues: Dict[str, LocalQueue] = {}
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, object] = {}
+        # Pod-spec request derivation inputs (utils/limitrange.py):
+        # LimitRanges by "ns/name", RuntimeClasses by name.
+        self.limit_ranges: Dict[str, object] = {}
+        self.runtime_classes: Dict[str, object] = {}
         # DRA inventory (kueue_tpu.dra.ResourceSlice) by name.
         self.resource_slices: Dict[str, object] = {}
         # DeviceClassMappings used to fold slice devices into TAS leaf
@@ -108,6 +112,10 @@ class Cache:
     def add_or_update_local_queue(self, lq: LocalQueue) -> None:
         with self._lock:
             self.local_queues[lq.key] = lq
+
+    def delete_local_queue(self, key: str) -> None:
+        with self._lock:
+            self.local_queues.pop(key, None)
 
     def add_or_update_node(self, node: Node) -> None:
         with self._lock:

@@ -129,6 +129,218 @@ def _set_stop_policy(mgr: Manager, args, policy: StopPolicy) -> int:
     return 0
 
 
+def _parse_flavor_quotas(specs: List[str], field: str) -> dict:
+    """Parse repeatable ``<flavor>:<res>=<qty>[,<res>=<qty>...]`` flags
+    (reference kueuectl create clusterqueue --nominal-quota format,
+    cmd/kueuectl/app/create/create_clusterqueue.go). Returns
+    {flavor: {resource: int}}."""
+    from kueue_tpu.api.serialization import parse_quantity
+
+    out: dict = {}
+    for spec in specs:
+        flavor, sep, rest = spec.partition(":")
+        if not sep or not flavor:
+            raise ValueError(
+                f"--{field} must look like flavor:res=qty[,res=qty]; "
+                f"got {spec!r}"
+            )
+        cells = out.setdefault(flavor, {})
+        for pair in rest.split(","):
+            res, sep2, qty = pair.partition("=")
+            if not sep2:
+                raise ValueError(f"bad quantity {pair!r} in --{field}")
+            cells[res.strip()] = parse_quantity(qty.strip(), res.strip())
+    return out
+
+
+def cmd_create(mgr: Manager, args) -> int:
+    """kueuectl create clusterqueue/localqueue/resourceflavor
+    (reference cmd/kueuectl/app/create/create.go)."""
+    from kueue_tpu.api.constants import (
+        PreemptionPolicy,
+        QueueingStrategy,
+    )
+    from kueue_tpu.api.serialization import encode
+    from kueue_tpu.api.types import (
+        ClusterQueuePreemption,
+        FlavorQuotas,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Taint,
+    )
+    import yaml
+
+    kind = args.resource.lower()
+    if kind in ("clusterqueue", "cq"):
+        if args.name in mgr.cache.cluster_queues:
+            print(f"ClusterQueue {args.name} already exists",
+                  file=sys.stderr)
+            return 1
+        nominal = _parse_flavor_quotas(args.nominal_quota, "nominal-quota")
+        borrow = _parse_flavor_quotas(
+            args.borrowing_limit, "borrowing-limit"
+        )
+        lend = _parse_flavor_quotas(args.lending_limit, "lending-limit")
+        if not nominal:
+            print("--nominal-quota is required", file=sys.stderr)
+            return 1
+        for flag, cells_by_flavor in (("borrowing-limit", borrow),
+                                      ("lending-limit", lend)):
+            for fname, cells in cells_by_flavor.items():
+                for res in cells:
+                    if res not in nominal.get(fname, {}):
+                        # A silently-dropped limit would mean UNBOUNDED
+                        # borrowing — the opposite of what was asked.
+                        print(
+                            f"--{flag} {fname}:{res} has no matching "
+                            "--nominal-quota entry",
+                            file=sys.stderr,
+                        )
+                        return 1
+        covered: List[str] = []
+        flavors = []
+        for fname, cells in nominal.items():
+            for res in cells:
+                if res not in covered:
+                    covered.append(res)
+            flavors.append(FlavorQuotas(
+                name=fname,
+                resources={
+                    res: ResourceQuota(
+                        nominal=qty,
+                        borrowing_limit=borrow.get(fname, {}).get(res),
+                        lending_limit=lend.get(fname, {}).get(res),
+                    )
+                    for res, qty in cells.items()
+                },
+            ))
+        pol = {
+            "Never": PreemptionPolicy.NEVER,
+            "LowerPriority": PreemptionPolicy.LOWER_PRIORITY,
+            "LowerOrNewerEqualPriority":
+                PreemptionPolicy.LOWER_OR_NEWER_EQUAL_PRIORITY,
+            "Any": PreemptionPolicy.ANY,
+        }
+        obj = ClusterQueue(
+            name=args.name,
+            cohort=args.cohort or None,
+            resource_groups=[ResourceGroup(
+                covered_resources=covered, flavors=flavors
+            )],
+            queueing_strategy=(
+                QueueingStrategy.STRICT_FIFO
+                if args.queuing_strategy == "StrictFIFO"
+                else QueueingStrategy.BEST_EFFORT_FIFO
+            ),
+            preemption=ClusterQueuePreemption(
+                reclaim_within_cohort=pol[args.reclaim_within_cohort],
+                within_cluster_queue=pol[args.preemption_within_cq],
+            ),
+        )
+    elif kind in ("localqueue", "lq"):
+        key = f"{args.namespace}/{args.name}"
+        if key in mgr.cache.local_queues:
+            print(f"LocalQueue {key} already exists", file=sys.stderr)
+            return 1
+        if (args.clusterqueue not in mgr.cache.cluster_queues
+                and not args.ignore_unknown_cq):
+            print(
+                f"ClusterQueue {args.clusterqueue} not found "
+                "(use --ignore-unknown-cq to create anyway)",
+                file=sys.stderr,
+            )
+            return 1
+        obj = LocalQueue(
+            name=args.name, namespace=args.namespace,
+            cluster_queue=args.clusterqueue,
+        )
+    elif kind in ("resourceflavor", "rf"):
+        if args.name in mgr.cache.resource_flavors:
+            print(f"ResourceFlavor {args.name} already exists",
+                  file=sys.stderr)
+            return 1
+        labels = {}
+        for pair in (args.node_labels or "").split(","):
+            if not pair:
+                continue
+            k, _, v = pair.partition("=")
+            labels[k.strip()] = v.strip()
+        taints = []
+        for spec in args.node_taints or []:
+            kv, _, effect = spec.partition(":")
+            k, _, v = kv.partition("=")
+            taints.append(Taint(key=k, value=v,
+                                effect=effect or "NoSchedule"))
+        obj = ResourceFlavor(
+            name=args.name, node_labels=labels, node_taints=taints,
+            topology_name=args.topology or None,
+        )
+    else:
+        print(f"unknown resource {args.resource}", file=sys.stderr)
+        return 1
+    mgr.apply(obj)
+    print(yaml.safe_dump(encode(obj), sort_keys=False), end="")
+    _maybe_save(mgr, args)
+    return 0
+
+
+def cmd_delete(mgr: Manager, args) -> int:
+    """kueuectl delete clusterqueue/localqueue/workload/resourceflavor."""
+    kind = args.resource.lower()
+    if kind in ("clusterqueue", "cq"):
+        obj = mgr.cache.cluster_queues.get(args.name)
+    elif kind in ("localqueue", "lq"):
+        obj = mgr.cache.local_queues.get(f"{args.namespace}/{args.name}")
+    elif kind in ("resourceflavor", "rf"):
+        obj = mgr.cache.resource_flavors.get(args.name)
+    elif kind in ("workload", "wl"):
+        obj = mgr.workloads.get(f"{args.namespace}/{args.name}")
+    else:
+        print(f"unknown resource {args.resource}", file=sys.stderr)
+        return 1
+    if obj is None:
+        print(f"{args.resource}/{args.name} not found", file=sys.stderr)
+        return 1
+    if isinstance(obj, Workload):
+        mgr.delete_workload(obj)
+    else:
+        mgr.delete(obj)
+    print(f"{args.resource}/{args.name} deleted")
+    _maybe_save(mgr, args)
+    return 0
+
+
+def cmd_apply(mgr: Manager, args) -> int:
+    """Manifest passthrough (the kubectl-delegation analog of reference
+    kueuectl's passthrough verbs): apply every object in the file."""
+    n = 0
+    for obj in load_manifests(args.file):
+        if isinstance(obj, Workload):
+            mgr.create_workload(obj)
+        else:
+            mgr.apply(obj)
+        n += 1
+    print(f"applied {n} object(s)")
+    _maybe_save(mgr, args)
+    return 0
+
+
+def _maybe_save(mgr: Manager, args) -> None:
+    """Persist the control plane back to YAML (--save): the standalone
+    analog of kueuectl's writes landing in the apiserver. Uses the full
+    checkpoint serializer so nodes, limit ranges, admission checks and
+    workloads survive the round trip."""
+    path = getattr(args, "save", None)
+    if not path:
+        return
+    state = mgr.export_state()
+    with open(path, "w") as f:
+        f.write(state)
+    n = sum(1 for doc in state.split("\n---") if doc.strip())
+    print(f"saved {n} object(s) to {path}")
+
+
 def cmd_schedule(mgr: Manager, args) -> int:
     cycles = mgr.schedule_all(max_cycles=args.cycles)
     admitted = sum(
@@ -156,6 +368,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_list = sub.add_parser("list")
     p_list.add_argument("resource")
     p_list.add_argument("--cluster-queue", default="")
+
+    p_create = sub.add_parser("create")
+    p_create.add_argument("resource")
+    p_create.add_argument("name")
+    p_create.add_argument("--cohort", default="")
+    p_create.add_argument("--queuing-strategy", default="BestEffortFIFO",
+                          choices=["BestEffortFIFO", "StrictFIFO"])
+    p_create.add_argument("--nominal-quota", action="append", default=[],
+                          help="flavor:res=qty[,res=qty] (repeatable)")
+    p_create.add_argument("--borrowing-limit", action="append", default=[])
+    p_create.add_argument("--lending-limit", action="append", default=[])
+    p_create.add_argument("--reclaim-within-cohort", default="Never")
+    p_create.add_argument("--preemption-within-cq",
+                          "--preemption-within-cluster-queue",
+                          dest="preemption_within_cq", default="Never")
+    p_create.add_argument("-c", "--clusterqueue", default="")
+    p_create.add_argument("-i", "--ignore-unknown-cq", action="store_true")
+    p_create.add_argument("--namespace", default="default")
+    p_create.add_argument("--node-labels", default="")
+    p_create.add_argument("--node-taints", action="append", default=[],
+                          help="key=value:Effect (repeatable)")
+    p_create.add_argument("--topology", default="")
+    p_create.add_argument("--save", default=None,
+                          help="write the control-plane spec back to YAML")
+
+    p_del = sub.add_parser("delete")
+    p_del.add_argument("resource")
+    p_del.add_argument("name")
+    p_del.add_argument("--namespace", default="default")
+    p_del.add_argument("--save", default=None)
+
+    p_apply = sub.add_parser("apply")
+    p_apply.add_argument("file")
+    p_apply.add_argument("--save", default=None)
 
     p_stop = sub.add_parser("stop")
     p_stop.add_argument("resource")
@@ -187,6 +433,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.cmd == "list":
         return cmd_list(mgr, args)
+    if args.cmd == "create":
+        try:
+            return cmd_create(mgr, args)
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
+    if args.cmd == "delete":
+        return cmd_delete(mgr, args)
+    if args.cmd == "apply":
+        return cmd_apply(mgr, args)
     if args.cmd == "stop":
         return _set_stop_policy(mgr, args, StopPolicy.HOLD)
     if args.cmd == "resume":

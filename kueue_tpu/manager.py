@@ -170,6 +170,10 @@ class Manager:
                 self.cache.namespaces[obj.name] = obj
             elif isinstance(obj, WorkloadPriorityClass):
                 self.priority_classes[obj.name] = obj
+            elif type(obj).__name__ == "LimitRange":
+                self.cache.limit_ranges[obj.key] = obj
+            elif type(obj).__name__ == "RuntimeClass":
+                self.cache.runtime_classes[obj.name] = obj
             else:
                 raise TypeError(f"unsupported object {type(obj)!r}")
         self.queues.queue_inadmissible_workloads()
@@ -181,6 +185,7 @@ class Manager:
         elif isinstance(obj, Cohort):
             self.cache.delete_cohort(obj.name)
         elif isinstance(obj, LocalQueue):
+            self.cache.delete_local_queue(obj.key)
             self.queues.delete_local_queue(obj.key)
         elif isinstance(obj, ResourceFlavor):
             self.cache.delete_resource_flavor(obj.name)
@@ -203,6 +208,25 @@ class Manager:
         if wl.key in self.workloads:
             raise ValueError(f"workload {wl.key} already exists")
         validate_workload(wl)
+        if any(ps.containers or ps.init_containers for ps in wl.pod_sets):
+            # Pod-spec-shaped podsets: derive effective requests (pod
+            # overhead, LimitRange defaults, limits-as-missing-requests,
+            # init-container max rule — reference
+            # pkg/workload/resources.go AdjustResources) and enforce the
+            # namespace bounds. The reference surfaces violations as
+            # inadmissibility; the standalone analog rejects at the
+            # webhook seam.
+            from kueue_tpu.utils import limitrange as _lr
+
+            ranges = [
+                lr for lr in self.cache.limit_ranges.values()
+                if lr.namespace == wl.namespace
+            ]
+            _lr.adjust_resources(wl, ranges, self.cache.runtime_classes)
+            errs = _lr.validate_resources(wl)
+            errs += _lr.validate_limit_ranges(wl, ranges)
+            if errs:
+                raise ValueError("; ".join(errs))
         if wl.creation_time == 0.0:
             wl.creation_time = self.clock()
         if wl.priority_class and wl.priority_class in self.priority_classes:
@@ -341,6 +365,10 @@ class Manager:
             docs.append(encode(cohort))
         for ac in self.cache.admission_checks.values():
             docs.append(encode(ac))
+        for lrange in self.cache.limit_ranges.values():
+            docs.append(encode(lrange))
+        for rc in self.cache.runtime_classes.values():
+            docs.append(encode(rc))
         for cq in self.cache.cluster_queues.values():
             docs.append(encode(cq))
         for lq in self.cache.local_queues.values():

@@ -110,6 +110,77 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
     return jnp.where(pmode == P_NOFIT, _NEG_INF, score)
 
 
+_SNEG32 = jnp.int32(-(1 << 30))
+
+
+def _policy_exists(pol, mincut, anyb, prio):
+    """Preemption-candidate existence per policy code (0=Never,
+    1=LowerPriority, 2=LowerOrNewerEqual superset, 3=Any). pol: i32[W];
+    mincut/anyb: [W,F,R]; prio: i64[W]."""
+    p = pol[:, None, None]
+    return jnp.where(
+        p == 3, anyb,
+        jnp.where(
+            p == 2, mincut <= prio[:, None, None],
+            jnp.where(p == 1, mincut < prio[:, None, None], False),
+        ),
+    )
+
+
+def _fungibility_scan(rep_pmode, rep_borrow, rep_score, f_k, n_fl, start,
+                      preempt_try_next, borrow_try_next):
+    """First-stop/argmax fungibility scan over the [W,K] preference axis
+    (flavorassigner.go:1142 shouldTryNextFlavor + the strictly-preferred
+    best keep). Shared by the legacy and slot nominate paths — any rule
+    change lands in both automatically. Returns
+    (b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons)."""
+    w_n, k_n = f_k.shape
+    w_iota = jnp.arange(w_n)
+    k_iota = jnp.arange(k_n, dtype=jnp.int32)
+    pos_valid = (
+        (k_iota[None, :] < n_fl[:, None])
+        & (k_iota[None, :] >= start[:, None])
+    )
+    pm_k = rep_pmode[w_iota[:, None], f_k]
+    bw_k = rep_borrow[w_iota[:, None], f_k]
+    sc_k = rep_score[w_iota[:, None], f_k]
+    should_try_next = (
+        (pm_k == P_NOFIT)
+        | (pm_k == P_NO_CANDIDATES)
+        | ((pm_k == P_PREEMPT_RAW) & preempt_try_next[:, None])
+        | ((bw_k > 0) & borrow_try_next[:, None])
+    )
+    stop_k = pos_valid & ~should_try_next
+    any_stop = jnp.any(stop_k, axis=1)
+    kstop = jnp.where(
+        any_stop, jnp.argmax(stop_k, axis=1).astype(jnp.int32),
+        jnp.int32(k_n),
+    )
+    considered = pos_valid & (k_iota[None, :] <= kstop[:, None])
+    n_cons = jnp.sum(considered, axis=1).astype(jnp.int32)
+    att = jnp.max(
+        jnp.where(considered, k_iota[None, :], -1), axis=1
+    ).astype(jnp.int32)
+    is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
+    praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
+    kstop_c = jnp.clip(kstop, 0, k_n - 1)
+    praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
+
+    # Best-scoring considered flavor, first occurrence winning ties (the
+    # host scan's strict-> update); a stop takes its own flavor outright.
+    sc_masked = jnp.where(considered, sc_k, _SNEG32)
+    k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
+    none_considered = ~jnp.any(considered & (sc_k > _SNEG32), axis=1)
+    k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
+    b_f = jnp.where(none_considered & ~any_stop, -1,
+                    f_k[w_iota, k_take]).astype(jnp.int32)
+    b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
+                     pm_k[w_iota, k_take]).astype(jnp.int32)
+    b_bw = jnp.where(none_considered & ~any_stop, 0,
+                     bw_k[w_iota, k_take]).astype(jnp.int32)
+    return b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons
+
+
 def _prefilter_aggregates(arrays: CycleArrays, usage: jnp.ndarray):
     """Preemption-candidate prefilter aggregates, once per cycle [N,F,R]:
     the minimum priority cut among buckets with same-CQ admitted usage
@@ -208,21 +279,10 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
         pmode_cell,
     )
 
-    def exists(pol, mincut, anyb):
-        # pol: i32[W]; mincut/anyb: [W,F,R]. Policy codes as in encode.
-        p = pol[:, None, None]
-        return jnp.where(
-            p == 3, anyb,
-            jnp.where(
-                p == 2, mincut <= prio[:, None, None],
-                jnp.where(p == 1, mincut < prio[:, None, None], False),
-            ),
-        )
-
-    same_exists = exists(arrays.policy_within[c], same_mincut[c],
-                         same_any[c])
-    cross_exists = exists(arrays.policy_reclaim[c], other_mincut[c],
-                          other_any[c])
+    same_exists = _policy_exists(arrays.policy_within[c], same_mincut[c],
+                                 same_any[c], prio)
+    cross_exists = _policy_exists(arrays.policy_reclaim[c],
+                                  other_mincut[c], other_any[c], prio)
     no_candidates = arrays.prefilter_valid & ~(same_exists | cross_exists)
     pmode_cell = jnp.where(
         (pmode_cell == P_PREEMPT_RAW) & no_candidates,
@@ -260,58 +320,18 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
     rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
 
     # ---- fungibility scan as first-stop/argmax over [W,K] ----------------
-    k_n = arrays.flavor_at.shape[1]
-    k_iota = jnp.arange(k_n, dtype=jnp.int32)
-    f_k = arrays.flavor_at[c]  # [W,K]
-    pos_valid = (
-        (k_iota[None, :] < arrays.n_flavors[c][:, None])
-        & (k_iota[None, :] >= arrays.w_start_flavor[:, None])
+    b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons = _fungibility_scan(
+        rep_pmode, rep_borrow, rep_score, arrays.flavor_at[c],
+        arrays.n_flavors[c], arrays.w_start_flavor,
+        arrays.when_can_preempt_try_next[c],
+        arrays.when_can_borrow_try_next[c],
     )
-    pm_k = rep_pmode[w_iota[:, None], f_k]
-    bw_k = rep_borrow[w_iota[:, None], f_k]
-    sc_k = rep_score[w_iota[:, None], f_k]
-    should_try_next = (
-        (pm_k == P_NOFIT)
-        | (pm_k == P_NO_CANDIDATES)
-        | ((pm_k == P_PREEMPT_RAW)
-           & arrays.when_can_preempt_try_next[c][:, None])
-        | ((bw_k > 0) & arrays.when_can_borrow_try_next[c][:, None])
-    )
-    stop_k = pos_valid & ~should_try_next
-    any_stop = jnp.any(stop_k, axis=1)
-    kstop = jnp.where(
-        any_stop, jnp.argmax(stop_k, axis=1).astype(jnp.int32),
-        jnp.int32(k_n),
-    )
-    considered = pos_valid & (k_iota[None, :] <= kstop[:, None])
-    n_cons = jnp.sum(considered, axis=1).astype(jnp.int32)
-    att = jnp.max(
-        jnp.where(considered, k_iota[None, :], -1), axis=1
-    ).astype(jnp.int32)
-    is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
-    praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
     seen_praw = praw_n > 0
-    kstop_c = jnp.clip(kstop, 0, k_n - 1)
-    praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
-
-    # Best-scoring considered flavor, first occurrence winning ties (the
-    # host scan's strict-> update); a stop takes its own flavor outright.
-    sc_masked = jnp.where(considered, sc_k, _SNEG)
-    k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
-    none_considered = ~jnp.any(considered & (sc_k > _SNEG), axis=1)
-    k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
-    b_f = jnp.where(none_considered & ~any_stop, -1,
-                    f_k[w_iota, k_take])
-    b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
-                     pm_k[w_iota, k_take])
-    b_bw = jnp.where(none_considered & ~any_stop, 0,
-                     bw_k[w_iota, k_take])
-
     needs_host = (seen_praw | (b_pm == P_PREEMPT_RAW)) & arrays.w_active
     tried = jnp.where(att == arrays.n_flavors[c] - 1, -1, att)
     b_pm = jnp.where(arrays.w_active, b_pm, P_NOFIT)
-    return NominateResult(b_f.astype(jnp.int32), b_pm.astype(jnp.int32),
-                          b_bw.astype(jnp.int32), needs_host, tried,
+    return NominateResult(b_f, b_pm.astype(jnp.int32),
+                          b_bw, needs_host, tried,
                           praw_n, praw_stop, n_cons)
 
 
@@ -342,28 +362,16 @@ def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
         arrays, usage
     )
 
-    def exists(pol, mincut, anyb):
-        p = pol[:, None, None]
-        return jnp.where(
-            p == 3, anyb,
-            jnp.where(
-                p == 2, mincut <= prio[:, None, None],
-                jnp.where(p == 1, mincut < prio[:, None, None], False),
-            ),
-        )
-
-    same_exists = exists(arrays.policy_within[c], same_mincut[c],
-                         same_any[c])
-    cross_exists = exists(arrays.policy_reclaim[c], other_mincut[c],
-                          other_any[c])
+    same_exists = _policy_exists(arrays.policy_within[c], same_mincut[c],
+                                 same_any[c], prio)
+    cross_exists = _policy_exists(arrays.policy_reclaim[c],
+                                  other_mincut[c], other_any[c], prio)
     no_candidates = arrays.prefilter_valid & ~(same_exists | cross_exists)
 
     pob3 = arrays.pref_preempt_over_borrow[c][:, None, None]
     cpwb3 = arrays.can_preempt_while_borrowing[c][:, None, None]
     nevp3 = arrays.never_preempts[c][:, None, None]
-    _SNEG = jnp.int32(-(1 << 30))
-    k_n = arrays.s_flavor_at.shape[2]
-    k_iota = jnp.arange(k_n, dtype=jnp.int32)
+    _SNEG = _SNEG32
 
     def score_of(pm, bw):
         sc = jnp.where(pob3, -bw * 16 + pm, pm * 16 - bw)
@@ -420,46 +428,14 @@ def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
         rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
 
         # Fungibility scan over the slot's own flavor list.
-        f_k = arrays.s_flavor_at[:, s]  # [W,K]
-        pos_valid = (
-            (k_iota[None, :] < arrays.s_n_flavors[:, s][:, None])
-            & (k_iota[None, :] >= arrays.s_start[:, s][:, None])
-        )
-        pm_k = rep_pmode[w_iota[:, None], f_k]
-        bw_k = rep_borrow[w_iota[:, None], f_k]
-        sc_k = rep_score[w_iota[:, None], f_k]
-        should_try_next = (
-            (pm_k == P_NOFIT)
-            | (pm_k == P_NO_CANDIDATES)
-            | ((pm_k == P_PREEMPT_RAW)
-               & arrays.when_can_preempt_try_next[c][:, None])
-            | ((bw_k > 0) & arrays.when_can_borrow_try_next[c][:, None])
-        )
-        stop_k = pos_valid & ~should_try_next
-        any_stop = jnp.any(stop_k, axis=1)
-        kstop = jnp.where(
-            any_stop, jnp.argmax(stop_k, axis=1).astype(jnp.int32),
-            jnp.int32(k_n),
-        )
-        considered = pos_valid & (k_iota[None, :] <= kstop[:, None])
-        n_cons = jnp.sum(considered, axis=1).astype(jnp.int32)
-        att = jnp.max(
-            jnp.where(considered, k_iota[None, :], -1), axis=1
-        ).astype(jnp.int32)
-        is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
-        praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
-        kstop_c = jnp.clip(kstop, 0, k_n - 1)
-        praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
-        sc_masked = jnp.where(considered, sc_k, _SNEG)
-        k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
-        none_considered = ~jnp.any(considered & (sc_k > _SNEG), axis=1)
-        k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
-        b_f = jnp.where(none_considered & ~any_stop, -1,
-                        f_k[w_iota, k_take]).astype(jnp.int32)
-        b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
-                         pm_k[w_iota, k_take]).astype(jnp.int32)
-        b_bw = jnp.where(none_considered & ~any_stop, 0,
-                         bw_k[w_iota, k_take]).astype(jnp.int32)
+        b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons = \
+            _fungibility_scan(
+                rep_pmode, rep_borrow, rep_score,
+                arrays.s_flavor_at[:, s], arrays.s_n_flavors[:, s],
+                arrays.s_start[:, s],
+                arrays.when_can_preempt_try_next[c],
+                arrays.when_can_borrow_try_next[c],
+            )
         tried = jnp.where(
             att == arrays.s_n_flavors[:, s] - 1, -1, att
         ).astype(jnp.int32)
@@ -1264,6 +1240,73 @@ def admit_scan_grouped(
     return final_usage, admitted, preempting_out
 
 
+def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
+    """Device TAS hook (flavorassigner.go:796-835 order): feasibility of
+    the chosen flavor's topology placement downgrades Fit->Preempt;
+    preempt-mode entries that cannot place even on an empty fleet demote
+    to NoFit; surviving preempt-mode TAS entries need the host's
+    TAS-aware victim search. Shared by the classical grouped cycle and
+    the fair tournament cycle. Returns (updated nom, downgrade mask)."""
+    from kueue_tpu.ops import tas_place
+
+    w_n = arrays.w_cq.shape[0]
+    w_iota = jnp.arange(w_n)
+    f_n = arrays.w_elig.shape[1]
+    chosen_c = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
+    t_of = jnp.where(
+        nom.chosen_flavor >= 0, arrays.tas_of_flavor[chosen_c], -1
+    )
+    tas_entry = arrays.w_tas & arrays.w_active & (t_of >= 0)
+    t_idx = jnp.clip(t_of, 0, arrays.tas_usage0.shape[0] - 1)
+    rl = arrays.w_tas_req_level[w_iota, t_idx]
+    sl = arrays.w_tas_slice_level[w_iota, t_idx]
+
+    def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_, cap_):
+        return tas_place.feasible_only(
+            arrays.tas_topo, t, usage_all[t], req, count, ssz,
+            jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+            cap_override=cap_,
+        )
+
+    # Per-entry filtered leaf capacity (node selector / taint matching)
+    # replaces the topology's static capacity where set.
+    cap_all = tas_place.entry_leaf_cap(arrays, t_idx)
+    feas_args = (
+        t_idx, arrays.w_tas_req, arrays.w_tas_count,
+        arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
+        arrays.w_tas_unconstrained, cap_all,
+    )
+    feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
+        arrays.tas_usage0, *feas_args
+    )
+    feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
+        jnp.zeros_like(arrays.tas_usage0), *feas_args
+    )
+    ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid
+    feas_now = feas_now & ok_levels
+    feas_empty = feas_empty & ok_levels
+
+    pm0 = nom.best_pmode
+    downgrade = tas_entry & (pm0 == P_FIT) & ~feas_now
+    # A downgraded entry on a CQ that can never find preemption targets
+    # resolves on device: the host's get_targets trivially returns none
+    # and the entry takes the reserve path.
+    pm1 = jnp.where(
+        downgrade,
+        jnp.where(arrays.never_preempts[arrays.w_cq],
+                  P_NO_CANDIDATES, P_PREEMPT_RAW),
+        pm0,
+    )
+    pre_mode = tas_entry & (
+        (pm1 == P_PREEMPT_RAW) | (pm1 == P_NO_CANDIDATES)
+    )
+    pm2 = jnp.where(pre_mode & ~feas_empty, P_NOFIT, pm1)
+    needs_host2 = jnp.where(
+        tas_entry, pm2 == P_PREEMPT_RAW, nom.needs_host
+    )
+    return nom._replace(best_pmode=pm2, needs_host=needs_host2), downgrade
+
+
 def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                        unroll: int = 2, n_levels: int = MAX_DEPTH + 1):
     """Build a jittable grouped cycle; s_max=0 means exact (W slots).
@@ -1356,72 +1399,9 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                      adm) -> CycleOutputs:
         usage = arrays.usage
         nom = nominate(arrays, usage, n_levels=n_levels)
-
-        # Device TAS hook (flavorassigner.go:796-835 order): feasibility of
-        # the chosen flavor's topology placement downgrades Fit->Preempt;
-        # preempt-mode entries that cannot place even on an empty fleet
-        # demote to NoFit; surviving preempt-mode TAS entries need the
-        # host's TAS-aware victim search.
+        downgrade = None
         if arrays.tas_topo is not None:
-            from kueue_tpu.ops import tas_place
-
-            w_n = arrays.w_cq.shape[0]
-            w_iota = jnp.arange(w_n)
-            f_n = arrays.w_elig.shape[1]
-            chosen_c = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
-            t_of = jnp.where(
-                nom.chosen_flavor >= 0, arrays.tas_of_flavor[chosen_c], -1
-            )
-            tas_entry = arrays.w_tas & arrays.w_active & (t_of >= 0)
-            t_idx = jnp.clip(t_of, 0, arrays.tas_usage0.shape[0] - 1)
-            rl = arrays.w_tas_req_level[w_iota, t_idx]
-            sl = arrays.w_tas_slice_level[w_iota, t_idx]
-
-            def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_,
-                     cap_):
-                return tas_place.feasible_only(
-                    arrays.tas_topo, t, usage_all[t], req, count, ssz,
-                    jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-                    cap_override=cap_,
-                )
-
-            # Per-entry filtered leaf capacity (node selector / taint
-            # matching) replaces the topology's static capacity where set.
-            cap_all = tas_place.entry_leaf_cap(arrays, t_idx)
-            feas_args = (
-                t_idx, arrays.w_tas_req, arrays.w_tas_count,
-                arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
-                arrays.w_tas_unconstrained, cap_all,
-            )
-            feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
-                arrays.tas_usage0, *feas_args
-            )
-            feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
-                jnp.zeros_like(arrays.tas_usage0), *feas_args
-            )
-            ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid
-            feas_now = feas_now & ok_levels
-            feas_empty = feas_empty & ok_levels
-
-            pm0 = nom.best_pmode
-            downgrade = tas_entry & (pm0 == P_FIT) & ~feas_now
-            # A downgraded entry on a CQ that can never find preemption
-            # targets resolves on device: the host's get_targets trivially
-            # returns none and the entry takes the reserve path.
-            pm1 = jnp.where(
-                downgrade,
-                jnp.where(arrays.never_preempts[arrays.w_cq],
-                          P_NO_CANDIDATES, P_PREEMPT_RAW),
-                pm0,
-            )
-            pre_mode = tas_entry & (
-                (pm1 == P_PREEMPT_RAW) | (pm1 == P_NO_CANDIDATES)
-            )
-            pm2 = jnp.where(pre_mode & ~feas_empty, P_NOFIT, pm1)
-            needs_host2 = jnp.where(
-                tas_entry, pm2 == P_PREEMPT_RAW, nom.needs_host
-            )
-            nom = nom._replace(best_pmode=pm2, needs_host=needs_host2)
+            nom, downgrade = apply_tas_nominate_hook(arrays, nom)
 
         # Structural eligibility for on-device oracle resolution: exactly
         # one flavor with raw preempt mode, and the fungibility scan's

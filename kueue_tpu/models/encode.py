@@ -313,25 +313,25 @@ def encode_cycle(
             if ok:
                 tas_device_flavors.append(fname)
 
-    # Fair-tournament tree eligibility: DRS simulated additions assume full
-    # usage bubbling, so any lending limit in the tree routes its entries
-    # to the host; TAS entries also stay host-side under fair (the
-    # tournament kernel has no topology recheck yet). Parentless CQs are
-    # order-independent and always eligible.
-    fair_tree_ok = None
+    # Fair x TAS: a TAS flavor shared by more than one cohort tree would
+    # let same-step tournament winners of different trees race on shared
+    # topology state; those entries take the host path (the driver then
+    # routes their whole tree through the host for exact interleaving).
+    # Lending limits need no gate: the fair kernel's availability walk and
+    # clamped bubbling are exact for partially-lent trees.
+    fair_tas_single: Dict[str, bool] = {}
     if fair_sharing:
-        from kueue_tpu.ops.quota_ops import MAX_DEPTH
-
-        parent_np = np.asarray(tree.parent)
-        root_np = np.arange(n)
-        for _ in range(MAX_DEPTH):
-            root_np = np.where(
-                parent_np[root_np] >= 0, parent_np[root_np], root_np
-            )
-        lend_any = np.asarray(tree.has_lend_limit).any(axis=(1, 2))
-        tree_lend = np.zeros(n, dtype=bool)
-        np.maximum.at(tree_lend, root_np, lend_any)
-        fair_tree_ok = ~tree_lend[root_np]
+        roots_of_flavor: Dict[str, set] = {}
+        for cq_name2, cqs2 in snapshot.cluster_queues.items():
+            rid = id(cqs2.node.root())
+            for rg2 in cqs2.spec.resource_groups:
+                for fq2 in rg2.flavors:
+                    if fq2.name in snapshot.tas_flavors:
+                        roots_of_flavor.setdefault(fq2.name, set()).add(rid)
+        fair_tas_single = {
+            name: len(roots) == 1
+            for name, roots in roots_of_flavor.items()
+        }
 
     # Workload arrays.
     device_wls: List[WorkloadInfo] = []
@@ -339,10 +339,18 @@ def encode_cycle(
     for info in heads:
         fair_host = False
         if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
-            ni0 = tidx.node_of[info.cluster_queue]
-            fair_host = not bool(fair_tree_ok[ni0]) or (
-                info.obj.pod_sets[0].topology_request is not None
-            )
+            tr0 = info.obj.pod_sets[0].topology_request
+            if tr0 is not None:
+                rgs0 = snapshot.cluster_queues[
+                    info.cluster_queue
+                ].spec.resource_groups
+                tas_names = [
+                    fq.name for fq in (rgs0[0].flavors if rgs0 else [])
+                    if fq.name in snapshot.tas_flavors
+                ]
+                fair_host = not tas_names or not all(
+                    fair_tas_single.get(nm, False) for nm in tas_names
+                )
         slots = (
             _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
             if info.cluster_queue in snapshot.cluster_queues else None

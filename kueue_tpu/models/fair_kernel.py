@@ -12,18 +12,25 @@ fit-or-skip admission body.
 
 Exactness preconditions (the encoder gates entries accordingly —
 models/encode.py):
-  * no lending limits anywhere in the entry's cohort tree, so simulated
-    usage additions bubble fully to every ancestor and availability is the
-    chain min of ``T_b - usage_b`` (same closed form as the fixed-point
-    kernel);
   * at most one tournament entry per CQ — the host iterator keys entries
     by CQ and keeps only the LAST nominated one (fair_sharing_iterator
     semantics); earlier same-CQ entries are reported OUT_SHADOWED and
     requeued unprocessed, exactly like the host's untouched entries;
-  * preemption-mode and TAS entries stay on the host path; the driver
-    discards device outcomes for any tree containing one (or any encode
-    host-fallback entry) and routes that whole tree through the host so
-    tournament interleaving stays exact per tree.
+  * entries needing a preemption oracle the device cannot resolve stay on
+    the host path; the driver discards device outcomes for any tree
+    containing one (or any encode host-fallback entry) and routes that
+    whole tree through the host so tournament interleaving stays exact
+    per tree;
+  * TAS entries are device-eligible when their topology flavor is used by
+    a single cohort tree (winners of different trees in the same step
+    would otherwise race on shared topology state).
+
+Lending limits are exact: the DRS simulation adds the workload's usage
+unclamped at every ancestor (reference fair_sharing.go:149 adds wlReq in
+full), while fit checks run the same availability walk as the grouped
+admission scan and winner usage bubbles with local-availability clamping
+(resource_node.go:144) — so partially-lent trees evolve identically to
+the host cache.
 
 The tournament is independent per cohort tree, so every step processes one
 winner per tree simultaneously on the flat usage state — no grouped layout
@@ -49,7 +56,7 @@ from kueue_tpu.models.batch_scheduler import (
     P_NO_CANDIDATES,
     P_PREEMPT_OK,
     P_PREEMPT_RAW,
-    admission_order,
+    apply_tas_nominate_hook,
     nominate,
 )
 from kueue_tpu.models.encode import CycleArrays
@@ -72,12 +79,11 @@ def fair_admit_scan(
     preemption) winners resolved to P_PREEMPT_OK designate their victims
     with the host's overlap/fit semantics and consume usage like admitted
     entries. Returns (final_usage, admitted[W], preempting[W], shadowed[W],
-    participated[W])."""
+    participated[W], win_step[W])."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     n = tree.n_nodes
     f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
-    f_onehot = jnp.arange(f_n)
     w_iota = jnp.arange(w_n, dtype=jnp.int32)
 
     parent = jnp.where(tree.parent < 0, jnp.arange(n), tree.parent)
@@ -86,11 +92,12 @@ def fair_admit_scan(
     for _ in range(MAX_DEPTH):
         chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
     chains = jnp.stack(chain_cols, axis=1)  # [W, D+1]
-    chain_is_root = tree.parent[chains] < 0  # [W, D+1]
-    # Repeat positions past the root must not double-count usage updates.
-    chain_repeat = jnp.concatenate(
-        [jnp.zeros((w_n, 1), bool), chains[:, 1:] == chains[:, :-1]], axis=1
-    )
+    # Walk-repeat semantics (position at/past root): matches the grouped
+    # admission scan's is_repeat, so the availability walk and bubbling
+    # treat the root layer exactly once.
+    walk_repeat = chains == jnp.concatenate(
+        [chains[:, 1:], chains[:, -1:]], axis=1
+    )  # [W, D+1]
 
     root_of = jnp.arange(n)
     for _ in range(MAX_DEPTH):
@@ -98,24 +105,23 @@ def fair_admit_scan(
     w_root = root_of[arrays.w_cq]  # [W]
 
     with_preempt = targets is not None
+    with_tas = getattr(arrays, "tas_topo", None) is not None
     if with_preempt:
         # Victim usage at CQ d reduces availability at every ancestor;
-        # full subtraction is exact in lend-limit-free trees.
+        # victims only exist in lend-limit-free trees (fair_preempt_ok),
+        # where full subtraction is exact; entries of other trees never
+        # have victims on their chains.
         on_chain_adm = quota_ops.ancestor_matrix(tree)[:, adm.cq]  # [N, A]
+        usage_by_f = jnp.swapaxes(adm.usage, 0, 1)  # [F,A,R]
 
     # Static DRS ingredients.
     sq = tree.subtree_quota
     pot_all = quota_ops.potential_available_all(tree)  # [N,F,R]
     lendable = jnp.sum(pot_all, axis=1).astype(jnp.float64)  # [N,R]
     weight = arrays.node_weight  # f64[N]
-    # T_b - usage_b chain availability (no lending limits precondition).
-    t_node = jnp.where(
-        (tree.parent < 0)[:, None, None],
-        sq,
-        jnp.where(
-            tree.has_borrow_limit, sat_add(sq, tree.borrow_limit), _INF64
-        ),
-    )
+    # Per-plane walk statics (hoisted; availability honors lending limits
+    # exactly like admit_scan_grouped).
+    lq_all = quota_ops.local_quota(tree)  # [N,F,R]
 
     # Tournament membership: the LAST active entry of each CQ (host dict
     # semantics); earlier ones are shadowed.
@@ -128,14 +134,20 @@ def fair_admit_scan(
     part = arrays.w_active & ~shadowed
 
     fe = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
-    cell_mask = (
-        (f_onehot[None, :, None] == nom.chosen_flavor[:, None, None])
-        & (arrays.w_req[:, None, :] > 0)
-        & arrays.covered[arrays.w_cq][:, None, :]
-    )  # [W,F,R]
-    delta = jnp.where(cell_mask, arrays.w_req[:, None, :], 0).astype(
-        jnp.int64
-    )
+    # All fit/apply math lives on the entry's chosen flavor plane.
+    cell_pl = (
+        (nom.chosen_flavor >= 0)[:, None]
+        & (arrays.w_req > 0)
+        & arrays.covered[arrays.w_cq]
+    )  # [W,R]
+    delta_pl = jnp.where(cell_pl, arrays.w_req, 0).astype(jnp.int64)
+    # Plane statics along each entry's chain [W,D+1,R].
+    fe_col = fe[:, None]
+    lq_pl = lq_all[chains, fe_col]
+    sub_pl = sq[chains, fe_col]
+    bl_pl = tree.borrow_limit[chains, fe_col]
+    hbl_pl = tree.has_borrow_limit[chains, fe_col]
+    nominal_pl = tree.nominal[arrays.w_cq, fe]  # [W,R]
     # The nominated usage simulated into the DRS (assignment.usage): the
     # request vector on the chosen flavor. Entries with no chosen flavor
     # (NoFit everywhere) simulate nothing, like the host's empty usage.
@@ -144,6 +156,17 @@ def fair_admit_scan(
         arrays.w_req,
         0,
     )  # [W,R]
+
+    if with_tas:
+        from kueue_tpu.ops import tas_place as _tas_place
+
+        t_of_w = jnp.where(
+            nom.chosen_flavor >= 0, arrays.tas_of_flavor[fe], -1
+        )
+        t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
+        rl_w = arrays.w_tas_req_level[w_iota, t_idx_w]
+        sl_w = arrays.w_tas_slice_level[w_iota, t_idx_w]
+        cap_w = _tas_place.entry_leaf_cap(arrays, t_idx_w)
 
     depth_w = tree.depth[arrays.w_cq]  # [W]
     prio = arrays.w_priority
@@ -239,8 +262,9 @@ def fair_admit_scan(
             )
         return champ
 
-    def body(carry, _):
-        usage_now, remaining, admitted, preempting_acc, designated = carry
+    def body(carry, step):
+        (usage_now, tas_usage, remaining, admitted, preempting_acc,
+         designated, win_step) = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
         win = (
@@ -250,11 +274,12 @@ def fair_admit_scan(
         )
 
         pm = nom.best_pmode
-        # Chain availability for winners (full [F,R] planes; the cell mask
-        # restricts to the entry's cells). The fit check simulates removal
-        # of every designated victim plus the entry's own targets
-        # (scheduler fits() -> SimulateWorkloadRemoval).
-        u_chain = usage_now[chains]  # [W,D+1,F,R]
+        # Chain availability on the entry's chosen plane, via the same
+        # walk as the grouped admission scan — exact under lending
+        # limits. The fit check simulates removal of every designated
+        # victim plus the entry's own targets (scheduler fits() ->
+        # SimulateWorkloadRemoval).
+        u_pl = usage_now[chains, fe_col]  # [W,D+1,R]
         if with_preempt:
             my_vict = targets.victims  # [W,A]
             is_pre = win & (pm == P_PREEMPT_OK)
@@ -265,48 +290,77 @@ def fair_admit_scan(
                 (is_pre & ~overlap)[:, None], my_vict, False
             )  # [W,A]
             chain_sub = on_chain_adm[chains]  # [W,D+1,A]
+            au_pl = usage_by_f[fe]  # [W,A,R]
             rem = jnp.einsum(
-                "wda,afr->wdfr",
+                "wda,war->wdr",
                 (use_vict[:, None, :] & chain_sub).astype(jnp.int64),
-                adm.usage,
+                au_pl,
             )
-            u_fit = u_chain - rem
+            u_fit = u_pl - rem
         else:
             is_pre = jnp.zeros(w_n, bool)
             overlap = jnp.zeros(w_n, bool)
-            u_fit = u_chain
-        slack = jnp.where(
-            t_node[chains] >= _INF64, _INF64,
-            sat_sub(t_node[chains], u_fit),
-        )
-        slack = jnp.where(
-            chain_repeat[:, :, None, None], _INF64, slack
-        )
-        avail = jnp.min(slack, axis=1)  # [W,F,R]
-        fits = jnp.all((delta <= avail) | ~cell_mask, axis=(1, 2))
+            u_fit = u_pl
+        l_avail_fit = jnp.maximum(0, sat_sub(lq_pl, u_fit))
+        stored = sat_sub(sub_pl, lq_pl)
+        used_in_parent = jnp.maximum(0, sat_sub(u_fit, lq_pl))
+        with_max = sat_add(sat_sub(stored, used_in_parent), bl_pl)
+        L = MAX_DEPTH + 1
+        avail = sat_sub(sub_pl[:, L - 1], u_fit[:, L - 1])
+        for i in range(L - 2, -1, -1):
+            clamped = jnp.where(
+                hbl_pl[:, i], jnp.minimum(with_max[:, i], avail), avail
+            )
+            stepped = sat_add(l_avail_fit[:, i], clamped)
+            avail = jnp.where(walk_repeat[:, i, None], avail, stepped)
+        fits = jnp.all((delta_pl <= avail) | ~cell_pl, axis=1)
 
         deferred = nom.needs_host
-        admit = win & (pm == P_FIT) & fits & ~deferred
+        # TAS placement recheck against the running topology state for
+        # winners (scheduler.go:409 updateAssignmentIfNeeded): earlier
+        # winners may have taken the domains.
+        if with_tas:
+            tas_do = (
+                win & arrays.w_tas & (t_of_w >= 0) & (pm == P_FIT)
+            )
+
+            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_):
+                return _tas_place.place(
+                    arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
+                    jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+                    cap_override=cap_,
+                )
+
+            tas_feas, tas_take = jax.vmap(place_one)(
+                t_idx_w, arrays.w_tas_req, arrays.w_tas_count,
+                arrays.w_tas_slice_size, sl_w, rl_w,
+                arrays.w_tas_required, arrays.w_tas_unconstrained,
+                cap_w,
+            )  # [W], [W, D]
+            tas_ok = jnp.where(tas_do, tas_feas, True)
+        else:
+            tas_ok = True
+            tas_do = None
+        admit = win & (pm == P_FIT) & fits & ~deferred & tas_ok
         preempt_ok = is_pre & ~overlap & fits & ~deferred
 
         # NO_CANDIDATES capacity reserve (scheduler.go:513) at the CQ.
-        u_cq = usage_now[arrays.w_cq]  # [W,F,R]
-        nominal_c = tree.nominal[arrays.w_cq]
-        has_bl_c = tree.has_borrow_limit[arrays.w_cq]
-        bl_c = tree.borrow_limit[arrays.w_cq]
+        u_cq_pl = u_pl[:, 0]  # [W,R]
         borrowing = nom.best_borrow > 0
         reserve_borrowing = jnp.where(
-            has_bl_c,
-            jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl_c), u_cq)),
-            delta,
+            hbl_pl[:, 0],
+            jnp.minimum(
+                delta_pl, sat_sub(sat_add(nominal_pl, bl_pl[:, 0]), u_cq_pl)
+            ),
+            delta_pl,
         )
         reserve_plain = jnp.maximum(
-            0, jnp.minimum(delta, sat_sub(nominal_c, u_cq))
+            0, jnp.minimum(delta_pl, sat_sub(nominal_pl, u_cq_pl))
         )
         reserve = jnp.where(
-            borrowing[:, None, None], reserve_borrowing, reserve_plain
+            borrowing[:, None], reserve_borrowing, reserve_plain
         )
-        reserve = jnp.where(cell_mask, reserve, 0)
+        reserve = jnp.where(cell_pl, reserve, 0)
         do_reserve = (
             win
             & (pm == P_NO_CANDIDATES)
@@ -318,38 +372,63 @@ def fair_admit_scan(
         # their usage (scheduler.go:561 cq.AddUsage runs for either mode).
         take_usage = admit | preempt_ok
         applied = jnp.where(
-            take_usage[:, None, None], delta,
-            jnp.where(do_reserve[:, None, None], reserve, 0),
-        )
-        # Full-bubble scatter along each winner's chain (repeats masked).
-        contrib = jnp.where(
-            (win[:, None] & ~chain_repeat)[:, :, None, None],
-            applied[:, None, :, :],
-            0,
-        )  # [W,D+1,F,R]
-        new_usage = quota_ops.sat(
-            usage_now.at[chains.ravel()].add(
-                contrib.reshape(-1, f_n, r_n), mode="drop"
+            take_usage[:, None], delta_pl,
+            jnp.where(do_reserve[:, None], reserve, 0),
+        )  # [W,R]
+        # addUsage bubbling with local-availability clamping
+        # (resource_node.go:144) — exact under lending limits; l_avail
+        # comes from the pre-update usage.
+        l_avail_pre = jnp.maximum(0, sat_sub(lq_pl, u_pl))
+        deltas = jnp.zeros((w_n, L, r_n), dtype=jnp.int64)
+        cur = applied
+        for i in range(L):
+            deltas = deltas.at[:, i].set(cur)
+            cont = (
+                (~walk_repeat[:, i, None]) if i < L - 1 else False
             )
+            cur = jnp.where(
+                cont, jnp.maximum(0, sat_sub(cur, l_avail_pre[:, i])), 0
+            )
+        deltas = jnp.where(win[:, None, None], deltas, 0)
+        new_usage = quota_ops.sat(
+            usage_now.at[chains, fe_col].add(deltas, mode="drop")
         )
+        if with_tas:
+            do_take = admit & tas_do
+            usage_delta = (
+                tas_take[:, :, None]
+                * arrays.w_tas_usage_req[:, None, :]
+            )  # [W, D, R1]
+            usage_delta = jnp.where(
+                do_take[:, None, None], usage_delta, 0
+            )
+            tas_usage = tas_usage.at[t_idx_w].add(usage_delta)
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], targets.victims, False),
                 axis=0,
             )
-        return (new_usage, remaining & ~win, admitted | admit,
-                preempting_acc | preempt_ok, designated), None
+        win_step = jnp.where(win, step, win_step)
+        return (new_usage, tas_usage, remaining & ~win, admitted | admit,
+                preempting_acc | preempt_ok, designated, win_step), None
 
     designated0 = (
         jnp.zeros(adm.cq.shape[0], bool) if with_preempt
         else jnp.zeros(1, bool)
     )
-    init = (usage, jnp.ones(w_n, bool), jnp.zeros(w_n, bool),
-            jnp.zeros(w_n, bool), designated0)
-    (final_usage, remaining, admitted, preempting, _desig), _ = \
-        jax.lax.scan(body, init, None, length=s_max)
+    tas_usage0 = (
+        arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
+    )
+    init = (usage, tas_usage0, jnp.ones(w_n, bool), jnp.zeros(w_n, bool),
+            jnp.zeros(w_n, bool), designated0,
+            jnp.full(w_n, -1, jnp.int32))
+    (final_usage, _tas_u, remaining, admitted, preempting, _desig,
+     win_step), _ = jax.lax.scan(
+        body, init, jnp.arange(s_max, dtype=jnp.int32)
+    )
     participated = part & ~remaining
-    return final_usage, admitted, preempting, shadowed, participated
+    return (final_usage, admitted, preempting, shadowed, participated,
+            win_step)
 
 
 def make_fair_cycle(s_max: int = 0, preempt: bool = False):
@@ -360,7 +439,7 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
     (models/fair_preempt_kernel.py) before the admission scan."""
 
     def finish(arrays, nom, final_usage, admitted, preempting, shadowed,
-               victims=None, variant=None):
+               win_step, victims=None, variant=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -397,9 +476,18 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             borrow=nom.best_borrow,
             tried_flavor_idx=nom.tried_flavor_idx,
             usage=final_usage,
-            # Diagnostics order: the classical sort (the true order is the
-            # dynamic tournament; decode never needs it under fair).
-            order=admission_order(arrays, nom),
+            # Processing order = the dynamic tournament order (step each
+            # entry won at; losers sink to the end). The TAS decode
+            # replays placements in this order to reproduce the device's
+            # domain choices.
+            order=jnp.argsort(
+                jnp.where(
+                    win_step >= 0, win_step.astype(jnp.int64),
+                    jnp.int64(1) << 40,
+                )
+                * arrays.w_cq.shape[0]
+                + jnp.arange(arrays.w_cq.shape[0], dtype=jnp.int64)
+            ).astype(jnp.int32),
             victims=victims,
             victim_variant=variant,
         )
@@ -408,11 +496,13 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
         def impl(arrays: CycleArrays) -> CycleOutputs:
             usage = arrays.usage
             nom = nominate(arrays, usage)
+            if arrays.tas_topo is not None:
+                nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            final_usage, admitted, preempting, shadowed, _done = \
-                fair_admit_scan(arrays, nom, usage, s)
+            (final_usage, admitted, preempting, shadowed, _done,
+             win_step) = fair_admit_scan(arrays, nom, usage, s)
             return finish(arrays, nom, final_usage, admitted, preempting,
-                          shadowed)
+                          shadowed, win_step)
 
         return impl
 
@@ -421,6 +511,8 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
     def impl_preempt(arrays: CycleArrays, adm) -> CycleOutputs:
         usage = arrays.usage
         nom = nominate(arrays, usage)
+        if arrays.tas_topo is not None:
+            nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
         elig = (
             arrays.w_active
             & (nom.best_pmode == P_PREEMPT_RAW)
@@ -446,10 +538,11 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             needs_host=nom.needs_host & ~tgt.resolved,
         )
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        final_usage, admitted, preempting, shadowed, _done = \
+        (final_usage, admitted, preempting, shadowed, _done, win_step) = \
             fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
         return finish(arrays, nom, final_usage, admitted, preempting,
-                      shadowed, victims=tgt.victims, variant=tgt.variant)
+                      shadowed, win_step, victims=tgt.victims,
+                      variant=tgt.variant)
 
     return impl_preempt
 

@@ -110,7 +110,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 from kueue_tpu.models.fair_kernel import fair_admit_scan
 
                 # The tournament orders entries itself (dynamic DRS keys).
-                _u, admit, _pre, _shadowed, _part = fair_admit_scan(
+                _u, admit, _pre, _shadowed, _part, _step = fair_admit_scan(
                     a, nom, usage, s_max
                 )
             elif kernel == "fixedpoint":

@@ -2,9 +2,15 @@
 
 The solver is data-parallel over the workload axis: per-workload nomination
 (the FLOP-heavy part — W x F x R fit/borrow tensors) shards across devices
-over a 1-D ``('w',)`` mesh, while the quota tree and policy arrays are
-replicated. XLA inserts the collectives (an all-gather before the global
-admission sort/scan, which is sequential by semantics and tiny by volume).
+over a 1-D ``('w',)`` mesh, while the quota tree, policy arrays, admitted
+candidates and topology state are replicated. XLA inserts the collectives
+(an all-gather before the global admission sort/scan, which is sequential
+by semantics and tiny by volume).
+
+Every per-workload field of CycleArrays (``w_*`` vectors, the slot-layout
+``s_*`` tensors, per-entry TAS rows) shards on its leading axis; everything
+else replicates — the spec is derived from the field names, so new encoder
+fields inherit the right placement automatically.
 
 On multi-host TPU fleets the same program spans hosts via jax.distributed;
 the mesh axis simply grows. No NCCL-analog hand-plumbing: ICI/DCN routing is
@@ -31,44 +37,66 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), ("w",))
 
 
-def cycle_shardings(mesh: Mesh):
-    """(in_shardings, out_shardings) for batch_scheduler.cycle_impl: workload
-    axis sharded, tree/policy replicated, outputs replicated."""
+def arrays_shardings(mesh: Mesh, arrays: CycleArrays) -> CycleArrays:
+    """Sharding pytree matching ``arrays``: per-workload tensors (w_*/s_*)
+    shard their leading axis over the 'w' mesh axis, everything else
+    (tree, per-CQ policy, TAS topology, fair fields) replicates."""
     rep = NamedSharding(mesh, P())
     wsh = NamedSharding(mesh, P("w"))
-    tree_sh = jax.tree_util.tree_map(lambda _: rep, _tree_proto())
-    in_sh = CycleArrays(
-        tree=tree_sh,
-        usage=rep,
-        flavor_at=rep,
-        n_flavors=rep,
-        covered=rep,
-        when_can_borrow_try_next=rep,
-        when_can_preempt_try_next=rep,
-        pref_preempt_over_borrow=rep,
-        can_preempt_while_borrowing=rep,
-        never_preempts=rep,
-        can_always_reclaim=rep,
-        usage_by_prio=rep,
-        prio_cuts=rep,
-        prefilter_valid=rep,
-        policy_within=rep,
-        policy_reclaim=rep,
-        nominal_cq=rep,
-        w_cq=wsh,
-        w_req=wsh,
-        w_elig=wsh,
-        w_active=wsh,
-        w_priority=wsh,
-        w_timestamp=wsh,
-        w_quota_reserved=wsh,
-        w_start_flavor=wsh,
+
+    def leaf_spec(sharded):
+        return lambda leaf: (wsh if sharded else rep)
+
+    out = {}
+    for name in CycleArrays._fields:
+        val = getattr(arrays, name)
+        if val is None:
+            out[name] = None
+            continue
+        sharded = name.startswith("w_") or name.startswith("s_")
+        out[name] = jax.tree_util.tree_map(leaf_spec(sharded), val)
+    return CycleArrays(**out)
+
+
+def group_shardings(mesh: Mesh, ga) -> object:
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda _: rep, ga)
+
+
+def admitted_shardings(mesh: Mesh, adm) -> object:
+    # The admitted-candidate set is consumed by victim searches indexed
+    # per pending workload; replicating it keeps the [W,A] interactions
+    # local to each shard of W.
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda _: rep, adm)
+
+
+def out_shardings(mesh: Mesh) -> object:
+    # Outputs are decoded on the host each cycle: replicate (the final
+    # all-gather is tiny relative to the nomination FLOPs).
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda _: rep,
+        batch_scheduler.CycleOutputs(
+            outcome=0, chosen_flavor=0, borrow=0, tried_flavor_idx=0,
+            usage=0, order=0,
+        ),
     )
-    out_sh = batch_scheduler.CycleOutputs(
-        outcome=rep, chosen_flavor=rep, borrow=rep, tried_flavor_idx=rep,
-        usage=rep, order=rep,
+
+
+def cycle_shardings(mesh: Mesh):
+    """Legacy helper for the dense layout (back-compat): builds the specs
+    from a minimal CycleArrays prototype."""
+    proto = CycleArrays(
+        tree=_tree_proto(), usage=0, flavor_at=0, n_flavors=0, covered=0,
+        when_can_borrow_try_next=0, when_can_preempt_try_next=0,
+        pref_preempt_over_borrow=0, can_preempt_while_borrowing=0,
+        never_preempts=0, can_always_reclaim=0, usage_by_prio=0,
+        prio_cuts=0, prefilter_valid=0, policy_within=0, policy_reclaim=0,
+        nominal_cq=0, w_cq=0, w_req=0, w_elig=0, w_active=0, w_priority=0,
+        w_timestamp=0, w_quota_reserved=0, w_start_flavor=0,
     )
-    return in_sh, out_sh
+    return arrays_shardings(mesh, proto), out_shardings(mesh)
 
 
 def _tree_proto():
@@ -78,9 +106,86 @@ def _tree_proto():
 
 
 def sharded_cycle(mesh: Mesh):
-    """Compile the cycle for the mesh. Workload axis length must divide the
-    mesh size (the encoder pads to a multiple of 8)."""
+    """Compile the flat cycle for the mesh (workload axis sharded). The
+    workload axis length must divide the mesh size (the encoder pads to a
+    multiple of 8)."""
     in_sh, out_sh = cycle_shardings(mesh)
     return jax.jit(
-        batch_scheduler.cycle_impl, in_shardings=(in_sh,), out_shardings=out_sh
+        batch_scheduler.cycle_impl, in_shardings=(in_sh,),
+        out_shardings=out_sh,
+    )
+
+
+def sharded_grouped_cycle(mesh: Mesh, arrays: CycleArrays, ga,
+                          adm=None, s_max: int = 0,
+                          n_levels: Optional[int] = None,
+                          unroll: int = 2):
+    """Compile the forest-grouped cycle (the production kernel) with the
+    workload axis sharded over ``mesh``. With ``adm`` the classical
+    device-preemption cycle is compiled (victim search + designated-victim
+    scan), matching DeviceScheduler's default kernel."""
+    from kueue_tpu.ops.quota_ops import MAX_DEPTH
+
+    nl = n_levels if n_levels is not None else MAX_DEPTH + 1
+    in_sh = [arrays_shardings(mesh, arrays), group_shardings(mesh, ga)]
+    rep = NamedSharding(mesh, P())
+    if adm is not None:
+        in_sh.append(admitted_shardings(mesh, adm))
+    impl = batch_scheduler.make_grouped_cycle(
+        s_max=s_max, preempt=adm is not None, n_levels=nl, unroll=unroll,
+    )
+    return jax.jit(
+        impl, in_shardings=tuple(in_sh),
+        out_shardings=jax.tree_util.tree_map(lambda _: rep, _out_proto(
+            preempt=adm is not None, arrays=arrays,
+        )),
+    )
+
+
+def sharded_sim_loop(mesh: Mesh, arrays: CycleArrays, ga, s_max: int,
+                     kernel: str = "grouped",
+                     n_levels: Optional[int] = None):
+    """Compile the on-device multi-cycle simulation loop
+    (models/sim_loop.py) with the workload axis sharded over ``mesh``:
+    per-round nomination fans out across devices, the sequential
+    admission state stays replicated, and XLA places the collectives."""
+    from kueue_tpu.models.sim_loop import make_sim_loop
+    from kueue_tpu.ops.quota_ops import MAX_DEPTH
+
+    nl = n_levels if n_levels is not None else MAX_DEPTH + 1
+    rep = NamedSharding(mesh, P())
+    wsh = NamedSharding(mesh, P("w"))
+    sim = make_sim_loop(s_max=s_max, kernel=kernel, n_levels=nl)
+    return jax.jit(
+        sim,
+        in_shardings=(
+            arrays_shardings(mesh, arrays),
+            group_shardings(mesh, ga),
+            wsh,  # runtime_ms[W]
+        ),
+        out_shardings=jax.tree_util.tree_map(
+            lambda _: rep, _sim_out_proto()
+        ),
+    )
+
+
+def _sim_out_proto():
+    from kueue_tpu.models.sim_loop import SimOutputs
+
+    return SimOutputs(admitted_at=0, completed_at=0, rounds=0,
+                      final_vclock=0)
+
+
+def _out_proto(preempt: bool, arrays: CycleArrays):
+    has_slots = arrays.s_req is not None
+    has_partial = arrays.w_partial is not None
+    return batch_scheduler.CycleOutputs(
+        outcome=0, chosen_flavor=0, borrow=0, tried_flavor_idx=0,
+        usage=0, order=0,
+        victims=0 if preempt else None,
+        victim_variant=0 if preempt else None,
+        partial_count=0 if has_partial else None,
+        s_flavor=0 if has_slots else None,
+        s_pmode=0 if has_slots else None,
+        s_tried=0 if has_slots else None,
     )

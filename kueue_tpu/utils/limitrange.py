@@ -1,0 +1,230 @@
+"""Pod-spec-level request derivation: LimitRange defaulting, the
+init-container max rule, sidecar accumulation and pod overhead.
+
+Behavioral surface:
+  * reference pkg/util/limitrange/limitrange.go — Summarize (keep-min of
+    Max/MaxLimitRequestRatio, keep-max of Min, keep-first of defaults) and
+    ValidatePodSpec (per-container and per-pod bound checks);
+  * reference pkg/workload/resources.go AdjustResources — RuntimeClass
+    overhead, LimitRange container defaults, limits-as-missing-requests;
+  * k8s resourcehelpers.PodRequests — effective pod requests =
+    max(sum of app containers + accumulated sidecars, running init peak)
+    + overhead, with restartable (sidecar) init containers adding to the
+    running base.
+
+A migrating user's effective requests therefore match the reference for
+pod-spec-shaped podsets; podsets that state ``requests`` directly (the
+abstract shape) are taken as given.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kueue_tpu.api.types import (
+    Container,
+    LimitRange,
+    LimitRangeItem,
+    PodSet,
+    RuntimeClass,
+    Workload,
+)
+
+REQUESTS_ABOVE_LIMITRANGE_MAX = "requests must not be above the limitRange max"
+REQUESTS_BELOW_LIMITRANGE_MIN = "requests must not be below the limitRange min"
+REQUESTS_EXCEED_LIMITS = "resource requests must not exceed limits"
+
+
+def _keep_min(dst: Dict[str, int], src: Dict[str, int]) -> Dict[str, int]:
+    out = dict(dst)
+    for k, v in src.items():
+        out[k] = min(out[k], v) if k in out else v
+    return out
+
+
+def _keep_max(dst: Dict[str, int], src: Dict[str, int]) -> Dict[str, int]:
+    out = dict(dst)
+    for k, v in src.items():
+        out[k] = max(out[k], v) if k in out else v
+    return out
+
+
+def _keep_first(dst: Dict[str, int], src: Dict[str, int]) -> Dict[str, int]:
+    out = dict(src)
+    out.update(dst)
+    return out
+
+
+def summarize(ranges: List[LimitRange]) -> Dict[str, LimitRangeItem]:
+    """limitrange.go:38 Summarize: one LimitRangeItem per type with the
+    tightest bounds and first-encountered defaults."""
+    out: Dict[str, LimitRangeItem] = {}
+    for lr in ranges:
+        for item in lr.items:
+            cur = out.get(item.type)
+            if cur is None:
+                cur = LimitRangeItem(type=item.type)
+                out[item.type] = cur
+            cur.max = _keep_min(cur.max, item.max)
+            cur.min = _keep_max(cur.min, item.min)
+            cur.default = _keep_first(cur.default, item.default)
+            cur.default_request = _keep_first(
+                cur.default_request, item.default_request
+            )
+            cur.max_limit_request_ratio = _keep_min(
+                cur.max_limit_request_ratio, item.max_limit_request_ratio
+            )
+    return out
+
+
+def _is_sidecar(c: Container) -> bool:
+    return c.restart_policy == "Always"
+
+
+def pod_requests(ps: PodSet) -> Dict[str, int]:
+    """Effective per-pod requests (k8s resourcehelpers.PodRequests):
+    max(sum of app containers + accumulated sidecars, init peak) with
+    sidecars folding into the running base, plus overhead; pod-level
+    resources (KEP-2837) override the aggregate for resources they name."""
+    reqs: Dict[str, int] = {}
+    for c in ps.containers:
+        for k, v in c.requests.items():
+            reqs[k] = reqs.get(k, 0) + v
+    restartable: Dict[str, int] = {}
+    init_peak: Dict[str, int] = {}
+    for c in ps.init_containers:
+        if _is_sidecar(c):
+            for k, v in c.requests.items():
+                restartable[k] = restartable.get(k, 0) + v
+            step = dict(restartable)
+        else:
+            step = dict(c.requests)
+            for k, v in restartable.items():
+                step[k] = step.get(k, 0) + v
+        init_peak = _keep_max(init_peak, step)
+    for k, v in restartable.items():
+        reqs[k] = reqs.get(k, 0) + v
+    reqs = _keep_max(reqs, init_peak)
+    if ps.pod_requests:
+        # Pod-level resources take precedence for the resources they name.
+        reqs.update(ps.pod_requests)
+    for k, v in ps.overhead.items():
+        reqs[k] = reqs.get(k, 0) + v
+    return reqs
+
+
+def _apply_container_defaults(c: Container, item: LimitRangeItem) -> None:
+    c.limits = _keep_first(c.limits, item.default)
+    c.requests = _keep_first(c.requests, item.default_request)
+
+
+def adjust_resources(
+    wl: Workload,
+    limit_ranges: List[LimitRange],
+    runtime_classes: Optional[Dict[str, RuntimeClass]] = None,
+) -> None:
+    """reference resources.go AdjustResources: pod overhead from the
+    RuntimeClass (when unset), LimitRange container defaults,
+    limits-as-missing-requests — then derive each podset's effective
+    ``requests`` for podsets that carry containers."""
+    summary = summarize(limit_ranges)
+    container_item = summary.get("Container")
+    pod_item = summary.get("Pod")
+    for ps in wl.pod_sets:
+        if not ps.containers and not ps.init_containers:
+            continue
+        if ps.runtime_class_name and not ps.overhead:
+            rc = (runtime_classes or {}).get(ps.runtime_class_name)
+            if rc is not None:
+                ps.overhead = dict(rc.overhead)
+        if container_item is not None:
+            for c in ps.init_containers:
+                _apply_container_defaults(c, container_item)
+            for c in ps.containers:
+                _apply_container_defaults(c, container_item)
+        if pod_item is not None and (ps.pod_requests or ps.pod_limits):
+            ps.pod_limits = _keep_first(ps.pod_limits, pod_item.default)
+            ps.pod_requests = _keep_first(
+                ps.pod_requests, pod_item.default_request
+            )
+        # UseLimitsAsMissingRequestsInPod (resources.go:124).
+        for c in list(ps.init_containers) + list(ps.containers):
+            c.requests = _keep_first(c.requests, c.limits)
+        if ps.pod_limits:
+            ps.pod_requests = _keep_first(ps.pod_requests, ps.pod_limits)
+        if not ps.requests_explicit:
+            # Explicitly-stated requests (the abstract shorthand) win over
+            # the container-derived totals.
+            ps.requests = pod_requests(ps)
+
+
+def _greater_keys(a: Dict[str, int], b: Dict[str, int]) -> List[str]:
+    """Resources where a > b (only for keys present in both — reference
+    resources.GreaterKeys semantics on typed lists)."""
+    return sorted(k for k, v in a.items() if k in b and v > b[k])
+
+
+def validate_resources(wl: Workload) -> List[str]:
+    """resources.go ValidateResources: requests must not exceed limits."""
+    errs: List[str] = []
+    for i, ps in enumerate(wl.pod_sets):
+        for c in list(ps.init_containers) + list(ps.containers):
+            over = _greater_keys(c.requests, c.limits)
+            if over:
+                errs.append(
+                    f"podSets[{i}] container {c.name or '?'} {over}: "
+                    + REQUESTS_EXCEED_LIMITS
+                )
+        over = _greater_keys(ps.pod_requests, ps.pod_limits)
+        if over:
+            errs.append(
+                f"podSets[{i}] pod resources {over}: "
+                + REQUESTS_EXCEED_LIMITS
+            )
+    return errs
+
+
+def validate_limit_ranges(
+    wl: Workload, limit_ranges: List[LimitRange]
+) -> List[str]:
+    """limitrange.go ValidatePodSpec over every podset with containers."""
+    if not limit_ranges:
+        return []
+    summary = summarize(limit_ranges)
+    errs: List[str] = []
+    container_item = summary.get("Container")
+    pod_item = summary.get("Pod")
+    for i, ps in enumerate(wl.pod_sets):
+        if not ps.containers and not ps.init_containers:
+            continue
+        if container_item is not None:
+            for c in list(ps.init_containers) + list(ps.containers):
+                c_min = _keep_min(c.requests, c.limits)
+                c_max = _keep_max(c.requests, c.limits)
+                over = _greater_keys(c_max, container_item.max)
+                if over:
+                    errs.append(
+                        f"podSets[{i}] container {c.name or '?'} {over}: "
+                        + REQUESTS_ABOVE_LIMITRANGE_MAX
+                    )
+                under = _greater_keys(container_item.min, c_min)
+                if under:
+                    errs.append(
+                        f"podSets[{i}] container {c.name or '?'} {under}: "
+                        + REQUESTS_BELOW_LIMITRANGE_MIN
+                    )
+        if pod_item is not None:
+            total = pod_requests(ps)
+            over = _greater_keys(total, pod_item.max)
+            if over:
+                errs.append(
+                    f"podSets[{i}] {over}: "
+                    + REQUESTS_ABOVE_LIMITRANGE_MAX
+                )
+            under = _greater_keys(pod_item.min, total)
+            if under:
+                errs.append(
+                    f"podSets[{i}] {under}: "
+                    + REQUESTS_BELOW_LIMITRANGE_MIN
+                )
+    return errs

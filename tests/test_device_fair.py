@@ -345,3 +345,222 @@ def test_fair_preempt_differential_random(seed):
     dev_adm, dev_trace = _end_state(seed, True)
     assert host_adm == dev_adm
     assert host_trace == dev_trace
+
+
+# ---------------------------------------------------------------------------
+# Fair sharing with lending limits (device-exact; previously host-gated).
+# ---------------------------------------------------------------------------
+
+
+def test_fair_lending_limits_on_device():
+    """Lending limits change both availability and the post-admission
+    tree state; the device tournament must agree with the host per cycle
+    and decide on device (no fallback)."""
+
+    def run(device):
+        cqs = [
+            make_cq(
+                "cq-a", cohort="co",
+                flavors={"default": {"cpu": ResourceQuota(
+                    nominal=10_000, lending_limit=4_000)}},
+            ),
+            make_cq(
+                "cq-b", cohort="co",
+                flavors={"default": {"cpu": ResourceQuota(nominal=6_000)}},
+            ),
+            make_cq(
+                "cq-c", cohort="co",
+                flavors={"default": {"cpu": ResourceQuota(nominal=0)}},
+            ),
+        ]
+        cache, queues, host = build_env(
+            cqs, cohorts=[Cohort(name="co")], fair_sharing=True
+        )
+        sched = (
+            DeviceScheduler(cache, queues, fair_sharing=True)
+            if device else host
+        )
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    f"host fallback for {[i.obj.name for i in infos]}"
+                )
+
+            sched._host_process = boom
+        submit(
+            queues,
+            make_wl("b0", "lq-cq-b", cpu_m=9_000, creation_time=1.0),
+            make_wl("c0", "lq-cq-c", cpu_m=2_000, creation_time=2.0),
+            make_wl("c1", "lq-cq-c", cpu_m=2_000, creation_time=3.0),
+        )
+        trace = []
+        for _ in range(8):
+            r = sched.schedule()
+            trace.append((sorted(r.admitted), sorted(r.skipped)))
+            if not r.admitted and not r.preempted:
+                break
+        admitted = sorted(i.obj.name for i in cache.workloads.values())
+        return admitted, trace
+
+    assert run(False) == run(True)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fair_lending_differential_random(seed):
+    """Random cohorts with lending limits and fair weights: device per-
+    cycle traces must match the host with zero fallback (no preemption
+    configured, so every entry is tournament-eligible)."""
+    rng = random.Random(77_000 + seed)
+
+    def scenario():
+        n_cqs = rng.randint(2, 4)
+        cqs = []
+        for i in range(n_cqs):
+            ll = rng.choice([None, rng.randrange(0, 5) * 1000])
+            cqs.append(make_cq(
+                f"cq{i}", cohort="co",
+                flavors={"default": {"cpu": ResourceQuota(
+                    nominal=rng.randrange(0, 8) * 1000,
+                    borrowing_limit=rng.choice(
+                        [None, rng.randrange(0, 6) * 1000]
+                    ),
+                    lending_limit=ll,
+                )}},
+                fair_weight=rng.choice([None, 0.5, 2.0]),
+            ))
+        wls = []
+        for i in range(rng.randint(4, 12)):
+            wls.append(make_wl(
+                f"w{i}", f"lq-cq{rng.randrange(n_cqs)}",
+                cpu_m=rng.randint(1, 8) * 1000,
+                priority=rng.choice([0, 0, 100]),
+                creation_time=float(i + 1),
+            ))
+        return cqs, wls
+
+    state = rng.getstate()
+
+    def run(device):
+        rng.setstate(state)
+        cqs, wls = scenario()
+        cache, queues, host = build_env(
+            cqs, cohorts=[Cohort(name="co")], fair_sharing=True
+        )
+        sched = (
+            DeviceScheduler(cache, queues, fair_sharing=True)
+            if device else host
+        )
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    f"host fallback for {[i.obj.name for i in infos]}"
+                )
+
+            sched._host_process = boom
+        submit(queues, *wls)
+        trace = []
+        for _ in range(40):
+            r = sched.schedule()
+            trace.append((sorted(r.admitted), sorted(r.skipped)))
+            if not r.admitted and not r.preempted:
+                break
+        admitted = sorted(i.obj.name for i in cache.workloads.values())
+        return admitted, trace
+
+    assert run(False) == run(True)
+
+
+# ---------------------------------------------------------------------------
+# Fair sharing x TAS on device (topology recheck inside the tournament).
+# ---------------------------------------------------------------------------
+
+
+def test_fair_tas_on_device():
+    """A TAS entry participates in the fair tournament on device: the
+    placement probe runs inside the scan, domains decode exactly, and the
+    DRS order (not FIFO) picks the winner."""
+    from kueue_tpu.api.types import (
+        PodSet,
+        ResourceFlavor,
+        Topology,
+        TopologyRequest,
+        Workload,
+        quota,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.tas.snapshot import Node
+
+    def run(device):
+        mgr = Manager(fair_sharing=True)
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            Cohort(name="co"),
+            make_cq("cq-a", cohort="co",
+                    flavors={"tpu-v5e": {"tpu": quota(4)}},
+                    resources=["tpu"]),
+            make_cq("cq-b", cohort="co",
+                    flavors={"tpu-v5e": {"tpu": quota(4)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq-a", cluster_queue="cq-a"),
+            LocalQueue(name="lq-b", cluster_queue="cq-b"),
+            Topology(name="topo",
+                     levels=["tpu.rack", "kubernetes.io/hostname"]),
+        )
+        for r in range(2):
+            for h in range(2):
+                mgr.apply(Node(
+                    name=f"n{r}{h}", labels={"tpu.rack": f"r{r}"},
+                    capacity={"tpu": 4},
+                ))
+
+        def tas_wl(name, lq, count, t):
+            return Workload(
+                name=name, queue_name=lq, creation_time=t,
+                pod_sets=[PodSet(
+                    name="main", count=count, requests={"tpu": 1},
+                    topology_request=TopologyRequest(
+                        required_level="tpu.rack"
+                    ),
+                )],
+            )
+
+        if device:
+            sched = DeviceScheduler(
+                mgr.cache, mgr.queues, fair_sharing=True
+            )
+
+            def boom(infos):
+                raise AssertionError(
+                    f"host fallback for {[i.obj.name for i in infos]}"
+                )
+
+            sched._host_process = boom
+        else:
+            sched = mgr.scheduler
+
+        mgr.create_workload(tas_wl("a0", "lq-a", 4, 1.0))
+        r = sched.schedule()
+        assert sorted(r.admitted) == ["default/a0"], (device, r.admitted)
+        # a1 (earlier timestamp, would borrow) vs b1 (within nominal):
+        # classical FIFO would pick a1, the DRS tournament must pick b1.
+        mgr.create_workload(tas_wl("a1", "lq-a", 4, 2.0))
+        mgr.create_workload(tas_wl("b1", "lq-b", 4, 3.0))
+        r = sched.schedule()
+        assert sorted(r.admitted) == ["default/b1"], (device, r.admitted)
+        out = {}
+        for name in ("a0", "a1", "b1"):
+            wl = mgr.cache.workloads.get(f"default/{name}")
+            adm = wl.obj.status.admission if wl else None
+            if adm is None:
+                out[name] = None
+            else:
+                ta = adm.pod_set_assignments[0].topology_assignment
+                out[name] = sorted(ta.domains) if ta else None
+        if device:
+            assert sched.device_time_s > 0
+        return out
+
+    host_out = run(False)
+    dev_out = run(True)
+    assert host_out == dev_out
+    assert dev_out["b1"] is not None

@@ -1,0 +1,220 @@
+"""Pod-spec request derivation: LimitRange defaulting, init-container max
+rule, sidecar accumulation, pod overhead (reference pkg/util/limitrange +
+pkg/workload/resources.go AdjustResources + k8s PodRequests)."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    Container,
+    LimitRange,
+    LimitRangeItem,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    RuntimeClass,
+    Workload,
+    quota,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.utils import limitrange as lr
+
+from .helpers import make_cq
+
+
+def ps_with(containers=(), init=(), overhead=None, **kw):
+    return PodSet(
+        name="main", count=1,
+        containers=list(containers), init_containers=list(init),
+        overhead=dict(overhead or {}), **kw,
+    )
+
+
+def test_pod_requests_init_container_max_rule():
+    ps = ps_with(
+        containers=[
+            Container(name="a", requests={"cpu": 1000, "memory": 100}),
+            Container(name="b", requests={"cpu": 500}),
+        ],
+        init=[
+            Container(name="init1", requests={"cpu": 4000}),
+            Container(name="init2", requests={"memory": 50}),
+        ],
+    )
+    # cpu: max(1000+500, init peak 4000) = 4000; memory: max(100, 50).
+    assert lr.pod_requests(ps) == {"cpu": 4000, "memory": 100}
+
+
+def test_pod_requests_sidecar_accumulation():
+    ps = ps_with(
+        containers=[Container(name="a", requests={"cpu": 1000})],
+        init=[
+            Container(name="sc", requests={"cpu": 200},
+                      restart_policy="Always"),
+            Container(name="init", requests={"cpu": 2000}),
+        ],
+    )
+    # Sidecar adds to the running base: init step = 2000+200; main sum =
+    # 1000+200. Effective cpu = max(1200, 2200).
+    assert lr.pod_requests(ps) == {"cpu": 2200}
+
+
+def test_pod_requests_overhead_added_after_max():
+    ps = ps_with(
+        containers=[Container(name="a", requests={"cpu": 1000})],
+        overhead={"cpu": 250},
+    )
+    assert lr.pod_requests(ps) == {"cpu": 1250}
+
+
+def test_summarize_merges():
+    s = lr.summarize([
+        LimitRange(name="a", items=[LimitRangeItem(
+            type="Container", max={"cpu": 4000}, min={"cpu": 100},
+            default={"cpu": 2000}, default_request={"cpu": 1000},
+        )]),
+        LimitRange(name="b", items=[LimitRangeItem(
+            type="Container", max={"cpu": 3000}, min={"cpu": 200},
+            default={"cpu": 9000}, default_request={"cpu": 9000},
+        )]),
+    ])
+    c = s["Container"]
+    assert c.max == {"cpu": 3000}  # keep min
+    assert c.min == {"cpu": 200}  # keep max
+    assert c.default == {"cpu": 2000}  # keep first
+    assert c.default_request == {"cpu": 1000}
+
+
+def test_adjust_resources_defaults_and_limits_as_requests():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[ps_with(
+        containers=[
+            Container(name="a"),  # gets DefaultRequest
+            Container(name="b", limits={"cpu": 3000}),  # limit -> request
+        ],
+    )])
+    lr.adjust_resources(wl, [LimitRange(name="d", items=[LimitRangeItem(
+        type="Container", default={"cpu": 2000},
+        default_request={"cpu": 500},
+    )])])
+    a, b = wl.pod_sets[0].containers
+    assert a.requests == {"cpu": 500} and a.limits == {"cpu": 2000}
+    # DefaultRequest applies BEFORE limits-as-missing-requests
+    # (resources.go AdjustResources order), so b gets 500, not its limit.
+    assert b.requests == {"cpu": 500}
+    assert wl.pod_sets[0].requests == {"cpu": 1000}
+
+
+def test_validate_limit_ranges_bounds():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[ps_with(
+        containers=[Container(name="a", requests={"cpu": 5000})],
+    )])
+    errs = lr.validate_limit_ranges(wl, [LimitRange(name="m", items=[
+        LimitRangeItem(type="Container", max={"cpu": 4000}),
+    ])])
+    assert errs and "above the limitRange max" in errs[0]
+    errs = lr.validate_limit_ranges(wl, [LimitRange(name="m", items=[
+        LimitRangeItem(type="Pod", min={"cpu": 9000}),
+    ])])
+    assert errs and "below the limitRange min" in errs[0]
+
+
+def _mgr():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def test_manager_derives_requests_end_to_end():
+    mgr = _mgr()
+    mgr.apply(
+        LimitRange(name="ns-defaults", items=[LimitRangeItem(
+            type="Container", default_request={"cpu": 500},
+        )]),
+        RuntimeClass(name="gvisor", overhead={"cpu": 250}),
+    )
+    wl = Workload(name="w", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=2,
+        containers=[Container(name="a", requests={"cpu": 1000}),
+                    Container(name="b")],  # defaulted to 500
+        init_containers=[Container(name="i", requests={"cpu": 3000})],
+        runtime_class_name="gvisor",
+    )])
+    mgr.create_workload(wl)
+    # per pod: max(1000+500, 3000) + 250 overhead = 3250.
+    assert wl.pod_sets[0].requests == {"cpu": 3250}
+    mgr.schedule_all()
+    info = mgr.cache.workloads["default/w"]
+    assert info.total_requests[0].requests == {"cpu": 6500}  # x count 2
+
+
+def test_manager_rejects_limit_range_violation():
+    mgr = _mgr()
+    mgr.apply(LimitRange(name="caps", items=[LimitRangeItem(
+        type="Pod", max={"cpu": 2000},
+    )]))
+    wl = Workload(name="w", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=1,
+        containers=[Container(name="a", requests={"cpu": 3000})],
+    )])
+    with pytest.raises(ValueError, match="limitRange max"):
+        mgr.create_workload(wl)
+
+
+def test_manager_rejects_requests_above_limits():
+    mgr = _mgr()
+    wl = Workload(name="w", queue_name="lq", pod_sets=[PodSet(
+        name="main", count=1,
+        containers=[Container(name="a", requests={"cpu": 3000},
+                              limits={"cpu": 1000})],
+    )])
+    with pytest.raises(ValueError, match="exceed limits"):
+        mgr.create_workload(wl)
+
+
+def test_manifest_roundtrip_with_pod_template():
+    from kueue_tpu.api.serialization import load_manifests
+
+    objs = load_manifests("""
+kind: LimitRange
+metadata: {name: d, namespace: default}
+spec:
+  limits:
+  - type: Container
+    defaultRequest: {cpu: 300m}
+    max: {cpu: "8"}
+---
+kind: RuntimeClass
+metadata: {name: rc}
+overhead:
+  podFixed: {cpu: 100m}
+---
+kind: Workload
+metadata: {name: w, namespace: default}
+spec:
+  queueName: lq
+  podSets:
+  - name: main
+    count: 1
+    template:
+      spec:
+        runtimeClassName: rc
+        initContainers:
+        - name: init
+          resources: {requests: {cpu: "2"}}
+        containers:
+        - name: a
+          resources: {requests: {cpu: 500m}}
+        - name: b
+          resources: {limits: {cpu: 700m}}
+""")
+    lrange, rc, wl = objs
+    assert lrange.items[0].default_request == {"cpu": 300}
+    assert rc.overhead == {"cpu": 100}
+    mgr = _mgr()
+    mgr.apply(lrange, rc)
+    mgr.create_workload(wl)
+    # b: limit 700 -> request; per pod max(500+700, init 2000) + 100.
+    assert wl.pod_sets[0].requests == {"cpu": 2100}

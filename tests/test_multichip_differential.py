@@ -1,0 +1,126 @@
+"""Sharded-vs-unsharded differential on the virtual 8-device CPU mesh.
+
+Full random scenarios (cohort forests, preemption policies, fungibility,
+taints — the test_device_differential generator) are encoded once and run
+through the production grouped+preempt cycle both unsharded and sharded
+over a ('w',) device mesh; every output must be bit-identical. The sim
+loop (whole lifecycle in one dispatch) gets the same treatment. This is
+the correctness half of the multi-chip story; the weak-scaling curve
+lives in bench.py --probe multichip.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models import batch_scheduler
+from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.parallel import sharding as par
+
+from .helpers import build_env, submit
+from .test_device_differential import random_scenario
+
+
+def encode_scenario(seed: int):
+    flavor_specs, cohorts, cqs, workloads = random_scenario(seed)
+    cache, queues, _host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    submit(queues, *workloads)
+    snapshot = cache.snapshot()
+    heads = queues.heads()
+    arrays, idx = encode_cycle(
+        snapshot, heads, snapshot.resource_flavors, preempt=True
+    )
+    return arrays, idx
+
+
+def assert_outputs_equal(base, out):
+    for name in ("outcome", "chosen_flavor", "borrow", "tried_flavor_idx",
+                 "usage", "victims", "victim_variant", "partial_count",
+                 "s_flavor", "s_pmode", "s_tried"):
+        a = getattr(base, name)
+        b = getattr(out, name)
+        if a is None:
+            assert b is None, name
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+@pytest.mark.parametrize("ndev", [2, 8])
+def test_sharded_grouped_cycle_matches_unsharded(seed, ndev):
+    arrays, idx = encode_scenario(seed)
+    base = batch_scheduler.cycle_grouped_preempt(
+        arrays, idx.group_arrays, idx.admitted_arrays
+    )
+    mesh = par.make_mesh(ndev)
+    fn = par.sharded_grouped_cycle(
+        mesh, arrays, idx.group_arrays, adm=idx.admitted_arrays
+    )
+    out = fn(arrays, idx.group_arrays, idx.admitted_arrays)
+    assert_outputs_equal(base, out)
+
+
+def test_sharded_multislot_cycle_matches_unsharded():
+    """Slot-layout (multi-podset / multi-RG) cycles shard the s_* tensors
+    too; outputs must agree with the unsharded kernel."""
+    from .test_device_multislot import random_scenario as ms_scenario
+
+    flavor_specs, cohorts, cqs, workloads = ms_scenario(3)
+    cache, queues, _host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    submit(queues, *workloads)
+    snapshot = cache.snapshot()
+    heads = queues.heads()
+    arrays, idx = encode_cycle(
+        snapshot, heads, snapshot.resource_flavors, preempt=True
+    )
+    assert arrays.s_req is not None, "scenario did not produce slot layout"
+    base = batch_scheduler.cycle_grouped_preempt(
+        arrays, idx.group_arrays, idx.admitted_arrays
+    )
+    mesh = par.make_mesh(8)
+    fn = par.sharded_grouped_cycle(
+        mesh, arrays, idx.group_arrays, adm=idx.admitted_arrays
+    )
+    out = fn(arrays, idx.group_arrays, idx.admitted_arrays)
+    assert_outputs_equal(base, out)
+
+
+def test_sharded_sim_loop_matches_unsharded():
+    """The whole-lifecycle sim loop produces identical admission/completion
+    timelines when the workload axis is sharded over the mesh."""
+    import jax.numpy as jnp
+
+    from kueue_tpu.models.sim_loop import make_sim_loop
+
+    flavor_specs, cohorts, cqs, workloads = random_scenario(2)
+    cache, queues, _host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    submit(queues, *workloads)
+    snapshot = cache.snapshot()
+    heads = queues.heads()
+    arrays, idx = encode_cycle(snapshot, heads, snapshot.resource_flavors)
+    w_pad = arrays.w_cq.shape[0]
+    group_of = np.asarray(idx.group_arrays.flat_to_group)[
+        np.asarray(arrays.w_cq)
+    ]
+    s_max = int(np.bincount(group_of).max())
+    runtime_ms = jnp.asarray(
+        np.full(w_pad, 100, np.int64)
+    )
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+
+    base_fn = make_sim_loop(s_max=s_max, n_levels=n_levels)
+    base = base_fn(arrays, idx.group_arrays, runtime_ms)
+    mesh = par.make_mesh(8)
+    fn = par.sharded_sim_loop(
+        mesh, arrays, idx.group_arrays, s_max, n_levels=n_levels
+    )
+    out = fn(arrays, idx.group_arrays, runtime_ms)
+    for name in ("admitted_at", "completed_at", "rounds", "final_vclock"):
+        assert np.array_equal(
+            np.asarray(getattr(base, name)), np.asarray(getattr(out, name))
+        ), name

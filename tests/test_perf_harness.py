@@ -66,3 +66,47 @@ def test_checker_flags_violations():
         "wlClassesMaxAvgTimeToAdmissionMs": {"small": 0},
     })
     assert len(bad) == 3
+
+
+SMALL_FAIR = {
+    # 1/25-scale fair-sharing config (perf_configs/fair-sharing): the
+    # harness path with fairSharing enabled must admit everything and
+    # satisfy scaled expectation bands.
+    "fairSharing": {"enable": True},
+    "cohorts": [{
+        "className": "cohort",
+        "count": 2,
+        "queuesSets": [{
+            "className": "cq",
+            "count": 4,
+            "nominalQuota": 20,
+            "borrowingLimit": 100,
+            "reclaimWithinCohort": "Any",
+            "withinClusterQueue": "LowerPriority",
+            "workloadsSets": [
+                {"count": 18, "creationIntervalMs": 60,
+                 "workloads": [{"className": "small", "runtimeMs": 150,
+                                "priority": 50, "request": 1}]},
+                {"count": 5, "creationIntervalMs": 300,
+                 "workloads": [{"className": "medium", "runtimeMs": 350,
+                                "priority": 100, "request": 5}]},
+                {"count": 2, "creationIntervalMs": 700,
+                 "workloads": [{"className": "large", "runtimeMs": 700,
+                                "priority": 200, "request": 20}]},
+            ],
+        }],
+    }],
+}
+
+
+def test_fair_sharing_config_admits_and_passes_band():
+    result = run(SMALL_FAIR)
+    assert result.admitted == result.total_workloads
+    violations = check(result, {
+        "cmd": {"maxWallMs": 6_000},
+        "clusterQueueClassesMinUsage": {"cq": 40},
+        "wlClassesMaxAvgTimeToAdmissionMs": {
+            "large": 500, "medium": 1_200, "small": 1_500,
+        },
+    })
+    assert not violations, violations
