@@ -240,7 +240,8 @@ def decode(doc: Dict[str, Any]):
                     default=_qmap(it.get("default")),
                     default_request=_qmap(it.get("defaultRequest")),
                     max_limit_request_ratio={
-                        r: int(v) for r, v in
+                        # k8s Quantities; ratios may be fractional.
+                        r: float(v) for r, v in
                         (it.get("maxLimitRequestRatio") or {}).items()
                     },
                 )

@@ -314,7 +314,8 @@ class LimitRangeItem:
     min: Dict[str, int] = field(default_factory=dict)
     default: Dict[str, int] = field(default_factory=dict)  # limits default
     default_request: Dict[str, int] = field(default_factory=dict)
-    max_limit_request_ratio: Dict[str, int] = field(default_factory=dict)
+    # Quantity ratios (may be fractional, e.g. "1.5").
+    max_limit_request_ratio: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass

@@ -61,6 +61,10 @@ class Cache:
         self.workloads: Dict[str, WorkloadInfo] = {}
         self.assumed: Set[str] = set()
         self.generation = 0
+        # Bumped on every workload-set mutation (add/assume/forget/
+        # delete/reaccount): lets the encoder reuse the admitted-state
+        # arrays across cycles when nothing changed.
+        self.workload_generation = 0
         # Structure cache for TAS snapshots: (generation, template).
         self._tas_templates: Dict[str, tuple] = {}
         # Live quota tree with incrementally maintained usage (reference
@@ -168,6 +172,7 @@ class Cache:
             self.workloads[info.key] = info
             self.assumed.discard(info.key)
             self._live_add(info)
+            self.workload_generation += 1
 
     def assume_workload(self, info: WorkloadInfo) -> None:
         """Optimistic admission before the status write lands
@@ -178,6 +183,7 @@ class Cache:
             self.workloads[info.key] = info
             self.assumed.add(info.key)
             self._live_add(info)
+            self.workload_generation += 1
 
     def forget_workload(self, key: str) -> None:
         with self._lock:
@@ -185,12 +191,14 @@ class Cache:
                 self._live_remove(key)
                 self.assumed.discard(key)
                 self.workloads.pop(key, None)
+                self.workload_generation += 1
 
     def delete_workload(self, key: str) -> None:
         with self._lock:
             self._live_remove(key)
             self.workloads.pop(key, None)
             self.assumed.discard(key)
+            self.workload_generation += 1
 
     def reaccount_workload(self, key: str, mutate) -> None:
         """Atomically re-account a stored workload whose usage is about to
@@ -206,6 +214,7 @@ class Cache:
             self._live_remove(key)
             mutate()
             self._live_add(info)
+            self.workload_generation += 1
 
     def is_added(self, key: str) -> bool:
         with self._lock:

@@ -440,9 +440,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(str(exc), file=sys.stderr)
             return 1
     if args.cmd == "delete":
-        return cmd_delete(mgr, args)
+        try:
+            return cmd_delete(mgr, args)
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
     if args.cmd == "apply":
-        return cmd_apply(mgr, args)
+        try:
+            return cmd_apply(mgr, args)
+        except ValueError as exc:
+            # e.g. a Workload violating a namespace LimitRange, or a
+            # duplicate create — clean stderr, not a traceback.
+            print(str(exc), file=sys.stderr)
+            return 1
     if args.cmd == "stop":
         return _set_stop_policy(mgr, args, StopPolicy.HOLD)
     if args.cmd == "resume":

@@ -37,9 +37,11 @@ from kueue_tpu.api.types import (
     AdmissionCheck,
     ClusterQueue,
     Cohort,
+    LimitRange,
     LocalQueue,
     Namespace,
     ResourceFlavor,
+    RuntimeClass,
     Topology,
     Workload,
     WorkloadPriorityClass,
@@ -170,9 +172,9 @@ class Manager:
                 self.cache.namespaces[obj.name] = obj
             elif isinstance(obj, WorkloadPriorityClass):
                 self.priority_classes[obj.name] = obj
-            elif type(obj).__name__ == "LimitRange":
+            elif isinstance(obj, LimitRange):
                 self.cache.limit_ranges[obj.key] = obj
-            elif type(obj).__name__ == "RuntimeClass":
+            elif isinstance(obj, RuntimeClass):
                 self.cache.runtime_classes[obj.name] = obj
             else:
                 raise TypeError(f"unsupported object {type(obj)!r}")

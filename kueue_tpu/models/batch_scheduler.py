@@ -99,6 +99,11 @@ class CycleOutputs(NamedTuple):
     s_flavor: jnp.ndarray = None  # i32[W,S]
     s_pmode: jnp.ndarray = None  # i32[W,S]
     s_tried: jnp.ndarray = None  # i32[W,S]
+    # Device-TAS decode: pods placed per leaf domain (device leaf order)
+    # for each admitted TAS entry — the placement kernel's own takes, so
+    # the driver maps them straight to TopologyAssignment domains instead
+    # of replaying the host placement engine (None when no TAS).
+    tas_takes: jnp.ndarray = None  # i32[W,D]
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -852,7 +857,10 @@ def admit_scan_grouped(
     reserve their usage and designate their victims, and overlapping ones
     are skipped (scheduler.go:385 _process_entry).
 
-    Returns (final_usage, admitted bool[W], preempting bool[W]).
+    Returns (final_usage, admitted bool[W], preempting bool[W],
+    tas_takes i32[W+1,D] or None — pods placed per leaf domain for
+    admitted TAS entries, decoded by the driver into
+    TopologyAssignments).
     """
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
@@ -912,7 +920,7 @@ def admit_scan_grouped(
     chain_is_repeat = ga.chain_local == chain_next  # [G,Nm,D+1]
 
     def body(carry, s):
-        usage_g, designated, tas_usage = carry
+        usage_g, designated, tas_usage, w_takes = carry
         pos = starts + s
         in_range = s < counts
         w = grouped_order[jnp.clip(pos, 0, w_n - 1)]  # [G]
@@ -1083,19 +1091,21 @@ def admit_scan_grouped(
             rl_g = arrays.w_tas_req_level[w, t_idx_g]
             sl_g = arrays.w_tas_slice_level[w, t_idx_g]
 
-            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_):
+            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_,
+                          sz_):
                 return _tas_place.place(
                     arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-                    cap_override=cap_,
+                    cap_override=cap_, sizes=sz_,
                 )
 
             cap_g = _tas_place.entry_leaf_cap(arrays, t_idx_g, w=w)
+            sizes_g = arrays.w_tas_sizes[w, t_idx_g]
             tas_feas, tas_take = jax.vmap(place_one)(
                 t_idx_g, arrays.w_tas_req[w], arrays.w_tas_count[w],
                 arrays.w_tas_slice_size[w], sl_g, rl_g,
                 arrays.w_tas_required[w], arrays.w_tas_unconstrained[w],
-                cap_g,
+                cap_g, sizes_g,
             )  # [G], [G, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:
@@ -1211,8 +1221,14 @@ def admit_scan_grouped(
                 do_take[:, None, None], usage_delta, 0
             )
             tas_usage = tas_usage.at[t_idx_g].add(usage_delta)
+            # Record the entry's own leaf takes for the driver's direct
+            # domain decode (row w_n is the trash row for non-TAS steps).
+            w_takes = w_takes.at[jnp.where(do_take, w, w_n)].add(
+                jnp.where(do_take[:, None], tas_take, 0).astype(jnp.int32),
+                mode="drop",
+            )
         w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
-        return (new_usage_g, designated, tas_usage), \
+        return (new_usage_g, designated, tas_usage, w_takes), \
             (w_out, admit, preempt_ok)
 
     designated0 = (
@@ -1221,10 +1237,14 @@ def admit_scan_grouped(
     tas_usage0 = (
         arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
     )
-    (final_usage_g, _designated, _tas_u), (w_mat, admit_mat, pre_mat) = \
-        jax.lax.scan(
-            body, (usage_g, designated0, tas_usage0), jnp.arange(s_max),
-            unroll=unroll,
+    takes0 = (
+        jnp.zeros((w_n + 1, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
+        if with_tas else jnp.zeros((1,), jnp.int32)
+    )
+    (final_usage_g, _designated, _tas_u, w_takes_f), \
+        (w_mat, admit_mat, pre_mat) = jax.lax.scan(
+            body, (usage_g, designated0, tas_usage0, takes0),
+            jnp.arange(s_max), unroll=unroll,
         )
     admitted = jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
         admit_mat.ravel(), mode="drop"
@@ -1237,7 +1257,8 @@ def admit_scan_grouped(
     final_usage = jnp.where(
         tree.active[:, None, None], final_usage, usage
     )
-    return final_usage, admitted, preempting_out
+    tas_takes = w_takes_f[:w_n] if with_tas else None
+    return final_usage, admitted, preempting_out, tas_takes
 
 
 def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
@@ -1261,25 +1282,26 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
     rl = arrays.w_tas_req_level[w_iota, t_idx]
     sl = arrays.w_tas_slice_level[w_iota, t_idx]
 
-    def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_, cap_):
+    def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_, cap_, sz_):
         return tas_place.feasible_only(
             arrays.tas_topo, t, usage_all[t], req, count, ssz,
             jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-            cap_override=cap_,
+            cap_override=cap_, sizes=sz_,
         )
 
     # Per-entry filtered leaf capacity (node selector / taint matching)
     # replaces the topology's static capacity where set.
     cap_all = tas_place.entry_leaf_cap(arrays, t_idx)
+    sizes_all = arrays.w_tas_sizes[w_iota, t_idx]
     feas_args = (
         t_idx, arrays.w_tas_req, arrays.w_tas_count,
         arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
-        arrays.w_tas_unconstrained, cap_all,
+        arrays.w_tas_unconstrained, cap_all, sizes_all,
     )
-    feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
+    feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 10)(
         arrays.tas_usage0, *feas_args
     )
-    feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 9)(
+    feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 10)(
         jnp.zeros_like(arrays.tas_usage0), *feas_args
     )
     ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid
@@ -1319,7 +1341,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
     the scan designates victims with overlap/fit semantics."""
 
     def finish(arrays, nom, final_usage, admitted, preempting, order,
-               victims=None, variant=None, partial_count=None):
+               victims=None, variant=None, partial_count=None,
+               tas_takes=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -1362,6 +1385,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             s_flavor=nom.s_flavor,
             s_pmode=nom.s_pmode,
             s_tried=nom.s_tried,
+            tas_takes=tas_takes,
         )
 
     def apply_partial(arrays, nom):
@@ -1384,12 +1408,14 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 arrays, nom, partial_count = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            final_usage, admitted, preempting = admit_scan_grouped(
-                arrays, ga, nom, usage, order, s, unroll=unroll,
-                n_levels=n_levels,
-            )
+            final_usage, admitted, preempting, tas_takes = \
+                admit_scan_grouped(
+                    arrays, ga, nom, usage, order, s, unroll=unroll,
+                    n_levels=n_levels,
+                )
             return finish(arrays, nom, final_usage, admitted, preempting,
-                          order, partial_count=partial_count)
+                          order, partial_count=partial_count,
+                          tas_takes=tas_takes)
 
         return impl
 
@@ -1481,13 +1507,13 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             arrays, nom, partial_count = apply_partial(arrays, nom)
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        final_usage, admitted, preempting = admit_scan_grouped(
+        final_usage, admitted, preempting, tas_takes = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
             unroll=unroll, n_levels=n_levels,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
                       victims=tgt.victims, variant=tgt.variant,
-                      partial_count=partial_count)
+                      partial_count=partial_count, tas_takes=tas_takes)
 
     return impl_preempt
 

@@ -54,6 +54,9 @@ class DeviceScheduler:
         self.device_time_s = 0.0
         self.cycles = 0
         self.use_fixedpoint = False
+        # Incremental encode: admitted-state tensors reused across cycles
+        # while the (spec, workload) generations are unchanged.
+        self._adm_cache: Dict = {}
 
     # ------------------------------------------------------------------
 
@@ -79,6 +82,11 @@ class DeviceScheduler:
             delay_tas_fn=lambda cqs, info: self.host._delay_tas(cqs, info)
             or self.host._has_multikueue_check(cqs),
             fair_strategies=self.host.preemptor.fair_strategies,
+            admitted_cache=self._adm_cache,
+            admitted_key=(
+                self.cache.generation, self.cache.workload_generation,
+                self.fair_sharing,
+            ),
         )
 
         host_entries: List[WorkloadInfo] = list(idx.host_fallback)
@@ -95,7 +103,8 @@ class DeviceScheduler:
 
                 out = cycle_fair_preempt(arrays, idx.admitted_arrays)
             elif self.use_fixedpoint and not idx.has_partial \
-                    and arrays.s_req is None and not bool(
+                    and arrays.s_req is None \
+                    and arrays.tas_topo is None and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
                 out = batch_scheduler.cycle_fixedpoint(
@@ -133,12 +142,11 @@ class DeviceScheduler:
             )
             self.device_time_s += self.clock() - t0
 
-            # Admitted TAS entries: replay the exact placement host-side in
-            # scan order (the device kernel made the same decisions; this
-            # decodes the domain assignments), accumulating assumed usage
-            # per flavor like update_for_tas.
-            tas_assignments = self._replay_tas_placements(
-                out, outcome, chosen, idx, snapshot
+            # Admitted TAS entries: the placement kernel emits its own
+            # per-leaf takes (CycleOutputs.tas_takes), so domains decode
+            # directly in O(assignments) — no host placement replay.
+            tas_assignments = self._decode_tas_assignments(
+                out, outcome, chosen, idx
             )
 
             # Fair tournaments interleave per cohort tree: if any entry of
@@ -264,64 +272,43 @@ class DeviceScheduler:
             self.host._requeue_and_update(e)
         return result
 
-    def _replay_tas_placements(self, out, outcome, chosen, idx, snapshot):
-        """Decode device-TAS admissions: recompute each admitted TAS
-        entry's placement with the host engine in scan order, accumulating
-        assumed usage per flavor (mirrors the device scan state; the
-        kernels are differential-equal, so this reproduces the device's
-        exact domains)."""
-        from kueue_tpu.tas.snapshot import PlacementRequest
+    def _decode_tas_assignments(self, out, outcome, chosen, idx):
+        """Decode device-TAS admissions straight from the placement
+        kernel's per-leaf takes: map each nonzero leaf (device leaf order)
+        through the encode permutation to the host leaf's level values.
+        O(assignments) — the host placement engine is not invoked."""
+        from kueue_tpu.api.types import TopologyAssignment
 
-        if not idx.tas_flavor_names:
+        if not idx.tas_flavor_names or out.tas_takes is None:
             return {}
+        takes = np.asarray(out.tas_takes)
+        row_of = {name: t for t, name in enumerate(idx.tas_flavor_names)}
         assignments = {}
-        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
-        order = np.asarray(out.order)
-        pos = {int(w): k for k, w in enumerate(order)}
-        rows = [
-            i for i, info in enumerate(idx.workloads)
-            if outcome[i] == batch_scheduler.OUT_ADMITTED
-            and info.obj.pod_sets[0].topology_request is not None
-        ]
-        for i in sorted(rows, key=lambda r: pos.get(r, 1 << 30)):
-            info = idx.workloads[i]
-            ps = info.obj.pod_sets[0]
-            tr = ps.topology_request
-            fname = idx.flavors[chosen[i]]
-            tas = snapshot.tas_flavors.get(fname)
-            if tas is None:
+        for i, info in enumerate(idx.workloads):
+            if outcome[i] != batch_scheduler.OUT_ADMITTED:
                 continue
-            req = PlacementRequest(
-                count=ps.count,
-                single_pod_requests=dict(ps.requests),
-                required_level=tr.required_level,
-                preferred_level=tr.preferred_level,
-                unconstrained=tr.unconstrained,
-                slice_size=tr.slice_size or 1,
-                slice_required_level=tr.slice_required_level,
-                node_selector=dict(ps.node_selector),
-                tolerations=list(ps.tolerations),
-            )
-            ta, _leader, reason = tas.find_topology_assignment(
-                req, assumed_usage=assumed.get(fname)
-            )
-            if reason:
-                # Should be unreachable (the device admitted only feasible
-                # placements); surface loudly in debug runs.
-                import sys
-
-                print(
-                    f"TAS replay diverged for {info.key}: {reason}",
-                    file=sys.stderr,
+            if info.obj.pod_sets[0].topology_request is None:
+                continue
+            t = row_of.get(idx.flavors[chosen[i]])
+            if t is None:
+                continue
+            tas = idx.tas_snapshots[t]
+            perm = idx.tas_leaf_perm[t]
+            row = takes[i]
+            # buildAssignment semantics (tas_flavor_snapshot.py:1175 /
+            # reference :1663): node-level topologies emit hostname-only
+            # domains; device leaf order is level_values-sorted, matching
+            # the host's domain sort.
+            li = len(tas.level_keys) - 1 if tas.lowest_is_node else 0
+            domains = []
+            for j in np.flatnonzero(row[: len(perm)]):
+                leaf = tas.leaves[perm[int(j)]]
+                domains.append(
+                    (tuple(leaf.level_values[li:]), int(row[j]))
                 )
-                continue
-            assignments[i] = ta
-            dst_f = assumed.setdefault(fname, {})
-            for values, count in ta.domains:
-                leaf_id = "/".join(values)
-                dst = dst_f.setdefault(leaf_id, {})
-                for res, v in ps.requests.items():
-                    dst[res] = dst.get(res, 0) + v * count
+            assignments[i] = TopologyAssignment(
+                levels=list(tas.level_keys[li:]), domains=domains
+            )
         return assignments
 
     def _apply_admission(

@@ -167,6 +167,7 @@ def fair_admit_scan(
         rl_w = arrays.w_tas_req_level[w_iota, t_idx_w]
         sl_w = arrays.w_tas_slice_level[w_iota, t_idx_w]
         cap_w = _tas_place.entry_leaf_cap(arrays, t_idx_w)
+        sizes_w = arrays.w_tas_sizes[w_iota, t_idx_w]
 
     depth_w = tree.depth[arrays.w_cq]  # [W]
     prio = arrays.w_priority
@@ -264,7 +265,7 @@ def fair_admit_scan(
 
     def body(carry, step):
         (usage_now, tas_usage, remaining, admitted, preempting_acc,
-         designated, win_step) = carry
+         designated, win_step, w_takes) = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
         win = (
@@ -324,18 +325,19 @@ def fair_admit_scan(
                 win & arrays.w_tas & (t_of_w >= 0) & (pm == P_FIT)
             )
 
-            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_):
+            def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_,
+                          sz_):
                 return _tas_place.place(
                     arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-                    cap_override=cap_,
+                    cap_override=cap_, sizes=sz_,
                 )
 
             tas_feas, tas_take = jax.vmap(place_one)(
                 t_idx_w, arrays.w_tas_req, arrays.w_tas_count,
                 arrays.w_tas_slice_size, sl_w, rl_w,
                 arrays.w_tas_required, arrays.w_tas_unconstrained,
-                cap_w,
+                cap_w, sizes_w,
             )  # [W], [W, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:
@@ -403,6 +405,9 @@ def fair_admit_scan(
                 do_take[:, None, None], usage_delta, 0
             )
             tas_usage = tas_usage.at[t_idx_w].add(usage_delta)
+            w_takes = w_takes + jnp.where(
+                do_take[:, None], tas_take, 0
+            ).astype(jnp.int32)
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], targets.victims, False),
@@ -410,7 +415,8 @@ def fair_admit_scan(
             )
         win_step = jnp.where(win, step, win_step)
         return (new_usage, tas_usage, remaining & ~win, admitted | admit,
-                preempting_acc | preempt_ok, designated, win_step), None
+                preempting_acc | preempt_ok, designated, win_step,
+                w_takes), None
 
     designated0 = (
         jnp.zeros(adm.cq.shape[0], bool) if with_preempt
@@ -419,16 +425,20 @@ def fair_admit_scan(
     tas_usage0 = (
         arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
     )
+    takes0 = (
+        jnp.zeros((w_n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
+        if with_tas else jnp.zeros((1,), jnp.int32)
+    )
     init = (usage, tas_usage0, jnp.ones(w_n, bool), jnp.zeros(w_n, bool),
             jnp.zeros(w_n, bool), designated0,
-            jnp.full(w_n, -1, jnp.int32))
+            jnp.full(w_n, -1, jnp.int32), takes0)
     (final_usage, _tas_u, remaining, admitted, preempting, _desig,
-     win_step), _ = jax.lax.scan(
+     win_step, w_takes_f), _ = jax.lax.scan(
         body, init, jnp.arange(s_max, dtype=jnp.int32)
     )
     participated = part & ~remaining
     return (final_usage, admitted, preempting, shadowed, participated,
-            win_step)
+            win_step, w_takes_f if with_tas else None)
 
 
 def make_fair_cycle(s_max: int = 0, preempt: bool = False):
@@ -439,7 +449,7 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
     (models/fair_preempt_kernel.py) before the admission scan."""
 
     def finish(arrays, nom, final_usage, admitted, preempting, shadowed,
-               win_step, victims=None, variant=None):
+               win_step, victims=None, variant=None, tas_takes=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -476,10 +486,9 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             borrow=nom.best_borrow,
             tried_flavor_idx=nom.tried_flavor_idx,
             usage=final_usage,
-            # Processing order = the dynamic tournament order (step each
-            # entry won at; losers sink to the end). The TAS decode
-            # replays placements in this order to reproduce the device's
-            # domain choices.
+            # Diagnostics only: the dynamic tournament order (step each
+            # entry won at; losers sink to the end). Domain decode reads
+            # tas_takes directly and does not depend on this.
             order=jnp.argsort(
                 jnp.where(
                     win_step >= 0, win_step.astype(jnp.int64),
@@ -490,6 +499,7 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             ).astype(jnp.int32),
             victims=victims,
             victim_variant=variant,
+            tas_takes=tas_takes,
         )
 
     if not preempt:
@@ -500,9 +510,9 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
                 nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             (final_usage, admitted, preempting, shadowed, _done,
-             win_step) = fair_admit_scan(arrays, nom, usage, s)
+             win_step, tas_takes) = fair_admit_scan(arrays, nom, usage, s)
             return finish(arrays, nom, final_usage, admitted, preempting,
-                          shadowed, win_step)
+                          shadowed, win_step, tas_takes=tas_takes)
 
         return impl
 
@@ -538,11 +548,12 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             needs_host=nom.needs_host & ~tgt.resolved,
         )
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        (final_usage, admitted, preempting, shadowed, _done, win_step) = \
+        (final_usage, admitted, preempting, shadowed, _done, win_step,
+         tas_takes) = \
             fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
         return finish(arrays, nom, final_usage, admitted, preempting,
                       shadowed, win_step, victims=tgt.victims,
-                      variant=tgt.variant)
+                      variant=tgt.variant, tas_takes=tas_takes)
 
     return impl_preempt
 

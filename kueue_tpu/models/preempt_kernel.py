@@ -160,6 +160,7 @@ def preempt_targets(
             t_rq=arrays.w_tas_required,
             t_un=arrays.w_tas_unconstrained,
             t_cap=cap_w,
+            t_sz=arrays.w_tas_sizes[w_iota, t_idx_w],
         )
     else:
         zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
@@ -169,11 +170,12 @@ def preempt_targets(
             t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
             t_rq=zw.astype(bool), t_un=zw.astype(bool),
             t_cap=zw[:, None, None],
+            t_sz=zw[:, None],
         )
 
     def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
               do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un,
-              t_cap):
+              t_cap, t_sz):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -347,7 +349,7 @@ def preempt_targets(
                         return _tas_place.feasible_only(
                             arrays.tas_topo, t_row, state, t_req, t_cnt,
                             t_ssz, t_sl, t_rl, t_rq, t_un,
-                            cap_override=t_cap,
+                            cap_override=t_cap, sizes=t_sz,
                         )
 
                     def bisect(_, st):
@@ -496,7 +498,7 @@ def preempt_targets(
             tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
             tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
             tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
-            tas_in["t_cap"],
+            tas_in["t_cap"], tas_in["t_sz"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)
@@ -593,6 +595,7 @@ def hier_targets(
             t_rq=arrays.w_tas_required,
             t_un=arrays.w_tas_unconstrained,
             t_cap=cap_w,
+            t_sz=arrays.w_tas_sizes[w_iota, t_idx_w],
         )
     else:
         zw = jnp.zeros(arrays.w_cq.shape[0], jnp.int64)
@@ -602,11 +605,12 @@ def hier_targets(
             t_sl=zw.astype(jnp.int32), t_rl=zw.astype(jnp.int32),
             t_rq=zw.astype(bool), t_un=zw.astype(bool),
             t_cap=zw[:, None, None],
+            t_sz=zw[:, None],
         )
 
     def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
               do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un,
-              t_cap):
+              t_cap, t_sz):
         f = jnp.maximum(f0, 0)
         full_active = (req > 0) & arrays.covered[c]  # [R]
         contested_full = full_active & (req > avail0[c, f])  # [R]
@@ -683,7 +687,7 @@ def hier_targets(
                     return _tas_place.feasible_only(
                         arrays.tas_topo, t_row, state, t_req, t_cnt,
                         t_ssz, t_sl, t_rl, t_rq, t_un,
-                        cap_override=t_cap,
+                        cap_override=t_cap, sizes=t_sz,
                     )
 
             def above_nominal(u_f, nodes):
@@ -939,7 +943,7 @@ def hier_targets(
             tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
             tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
             tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],
-            tas_in["t_cap"],
+            tas_in["t_cap"], tas_in["t_sz"],
         )
     return PreemptTargets(victims, variant, success, resolved_nc, resolved,
                           borrow_after)

@@ -110,7 +110,8 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 from kueue_tpu.models.fair_kernel import fair_admit_scan
 
                 # The tournament orders entries itself (dynamic DRS keys).
-                _u, admit, _pre, _shadowed, _part, _step = fair_admit_scan(
+                (_u, admit, _pre, _shadowed, _part, _step,
+                 _tk) = fair_admit_scan(
                     a, nom, usage, s_max
                 )
             elif kernel == "fixedpoint":
@@ -128,7 +129,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 )
             else:
                 order = bs.admission_order(a, nom)
-                _u, admit, _pre = bs.admit_scan_grouped(
+                _u, admit, _pre, _tk = bs.admit_scan_grouped(
                     a, ga, nom, usage, order, s_max, n_levels=n_levels
                 )
 

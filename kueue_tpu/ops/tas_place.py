@@ -212,6 +212,7 @@ def place(
     required: jnp.ndarray,  # bool
     unconstrained: jnp.ndarray,  # bool
     cap_override: jnp.ndarray = None,  # i64[D, R] entry's filtered leaf cap
+    sizes: jnp.ndarray = None,  # i64[LMAX] inner slice unit per level
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (feasible bool, leaf_take i64[D] pods per leaf domain).
 
@@ -219,7 +220,13 @@ def place(
     this entry — the per-entry analog of the host's node-selector/
     taint-filtered matching capacity (tas/snapshot.py _matching_capacity):
     capacity comes only from nodes the entry's pods may land on, while
-    usage stays the leaf total."""
+    usage stays the leaf total.
+
+    ``sizes``: multi-layer slice units (reference buildSliceSizeAtLevel +
+    tas_flavor_snapshot.go:1100-1132): below the outer slice level, the
+    per-parent distribution at level l runs in multiples of ``sizes[l]``
+    (domain values = state // size, target = parent take // size, takes
+    scale back by size). All-ones when the request has no inner layers."""
     d_n = topo.leaf_cap.shape[1]
     r_n = topo.leaf_cap.shape[2]
     iota = jnp.arange(d_n)
@@ -246,6 +253,8 @@ def place(
     state_leaf = jnp.where(fits >= _INF, 0, fits)
     state_leaf = jnp.where(valid_at(leaf_l), state_leaf, 0)
 
+    if sizes is None:
+        sizes = jnp.ones(LMAX, jnp.int64)
     states = jnp.zeros((LMAX, d_n), jnp.int64)
     states = states.at[jnp.clip(leaf_l, 0, LMAX - 1)].set(state_leaf)
     for s in range(1, LMAX):
@@ -254,6 +263,12 @@ def place(
         child_l = jnp.clip(l + 1, 0, LMAX - 1)
         pidx = topo.parent_idx[t, child_l]
         child = jnp.where(valid_at(l + 1), states[child_l], 0)
+        # Multi-layer inner constraint at the child level: contributions
+        # round down to inner-size multiples (reference
+        # fillInCountsHelper :1926), so parent capacity reflects what can
+        # actually be grouped.
+        inner_c = jnp.maximum(sizes[child_l], 1)
+        child = (child // inner_c) * inner_c
         acc = jnp.zeros(d_n, jnp.int64).at[pidx].add(child)
         states = jnp.where(l >= 0, states.at[lc].set(acc), states)
 
@@ -330,17 +345,30 @@ def place(
         mode_a = child_level <= slice_level  # free slice redistribution
         sl_child = jnp.where(valid_at(child_level), sls[child_lc], 0)
         st_child = jnp.where(valid_at(child_level), states[child_lc], 0)
-        values = jnp.where(mode_a, sl_child, st_child)
+        # Inner slice layer at the child level: per-parent distribution
+        # runs in multiples of its size (host recomputes slice_state =
+        # state // inner and sorts/greedy-fills in those units).
+        inner = jnp.maximum(sizes[child_lc], 1)
+        vals_b = st_child // inner
+        values = jnp.where(mode_a, sl_child, vals_b)
         seg = jnp.where(mode_a, jnp.zeros(d_n, jnp.int32), pidx)
         target = jnp.where(
-            mode_a, jnp.full(d_n, slice_count), parent_take
+            mode_a, jnp.full(d_n, slice_count), parent_take // inner
         )
+        # Primary BestFit key: ALWAYS the phase-1 slice states — the host
+        # sorts children before recomputing inner-unit slice states
+        # (snapshot.py:1141-1147), so an inner layer changes candidate
+        # values/targets but NOT the walk order.
         new_take = segmented_greedy(
             values, child_valid, seg, target, st_child, sl_child
         )
-        # Slice->pod conversion when the child level is the slice level.
+        # Slice->pod conversion when the child level is the slice level;
+        # inner-layer units always convert back to pods immediately.
         to_pods = mode_a & (child_level == slice_level)
-        new_take = jnp.where(to_pods, new_take * ss, new_take)
+        new_take = jnp.where(
+            to_pods, new_take * ss,
+            jnp.where(~mode_a, new_take * inner, new_take),
+        )
         take = jnp.where(active, new_take, take)
         in_pods = jnp.where(active, in_pods | to_pods | ~mode_a, in_pods)
         cur_level = jnp.where(active, child_level, cur_level)
@@ -364,8 +392,9 @@ def feasible_only(
     required: jnp.ndarray,
     unconstrained: jnp.ndarray,
     cap_override: jnp.ndarray = None,
+    sizes: jnp.ndarray = None,
 ) -> jnp.ndarray:
     f, _ = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
                  req_level, required, unconstrained,
-                 cap_override=cap_override)
+                 cap_override=cap_override, sizes=sizes)
     return f

@@ -179,6 +179,7 @@ def _sim_out_proto():
 def _out_proto(preempt: bool, arrays: CycleArrays):
     has_slots = arrays.s_req is not None
     has_partial = arrays.w_partial is not None
+    has_tas = arrays.tas_topo is not None
     return batch_scheduler.CycleOutputs(
         outcome=0, chosen_flavor=0, borrow=0, tried_flavor_idx=0,
         usage=0, order=0,
@@ -188,4 +189,5 @@ def _out_proto(preempt: bool, arrays: CycleArrays):
         s_flavor=0 if has_slots else None,
         s_pmode=0 if has_slots else None,
         s_tried=0 if has_slots else None,
+        tas_takes=0 if has_tas else None,
     )

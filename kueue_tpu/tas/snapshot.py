@@ -309,6 +309,7 @@ class TASFlavorSnapshot:
         simulate_empty: bool,
         assumed_usage: Optional[Dict[str, Dict[str, int]]],
         required_replacement_domain: Optional[str] = None,
+        sizes_at_level: Optional[Dict[int, int]] = None,
     ) -> None:
         """reference fillInCounts :1760 + fillLeafCounts :1863."""
         for dom in self.domains.values():
@@ -384,10 +385,12 @@ class TASFlavorSnapshot:
                     leaf.state_with_leader = count_fits(requests, cap)
 
         leader_required = req.leader_requests is not None
-        self._roll_up_counts(slice_size, slice_level_idx, leader_required)
+        self._roll_up_counts(slice_size, slice_level_idx, leader_required,
+                             sizes_at_level)
 
     def _roll_up_counts(
-        self, slice_size: int, slice_level_idx: int, leader_required: bool
+        self, slice_size: int, slice_level_idx: int, leader_required: bool,
+        sizes_at_level: Optional[Dict[int, int]] = None,
     ) -> None:
         """Vectorized bottom-up accumulation (fillInCountsHelper :1902) as
         per-level segment reductions over parent-index vectors."""
@@ -410,8 +413,14 @@ class TASFlavorSnapshot:
         for l in range(last - 1, -1, -1):
             pidx = self._level_parent_idx[l + 1]
             n_parent = len(self.domains_per_level[l])
+            # Multi-layer inner constraint at the child level: a child can
+            # only contribute pods in multiples of the inner slice size
+            # (reference fillInCountsHelper :1926 rounds childState down).
+            inner = (sizes_at_level or {}).get(l + 1, 1)
+            c_state = (state // inner) * inner if inner > 1 else state
+            c_swl = (swl // inner) * inner if inner > 1 else swl
             p_state = np.zeros(n_parent, dtype=np.int64)
-            np.add.at(p_state, pidx, state)
+            np.add.at(p_state, pidx, c_state)
             p_slice = np.zeros(n_parent, dtype=np.int64)
             np.add.at(p_slice, pidx, sl)
             p_leader = np.zeros(n_parent, dtype=np.int64)
@@ -421,7 +430,7 @@ class TASFlavorSnapshot:
                 np.ones_like(leader, dtype=bool)
                 if not leader_required else (leader > 0)
             )
-            diff = np.where(contributes, state - swl, INF)
+            diff = np.where(contributes, c_state - c_swl, INF)
             sdiff = np.where(contributes, sl - sl_wl, INF)
             min_diff = np.full(n_parent, INF, dtype=np.int64)
             np.minimum.at(min_diff, pidx, diff)
@@ -1076,6 +1085,7 @@ class TASFlavorSnapshot:
         self._fill_in_counts(
             req, slice_size, slice_level_idx, simulate_empty, assumed_usage,
             required_replacement_domain,
+            sizes_at_level=slice_size_at_level or None,
         )
 
         # Balanced placement (reference tas_balanced_placement.go +

@@ -213,6 +213,19 @@ def validate_limit_ranges(
                         f"podSets[{i}] container {c.name or '?'} {under}: "
                         + REQUESTS_BELOW_LIMITRANGE_MIN
                     )
+                for res, max_ratio in (
+                    container_item.max_limit_request_ratio or {}
+                ).items():
+                    req_v = c.requests.get(res, 0)
+                    lim_v = c.limits.get(res)
+                    if req_v > 0 and lim_v is not None \
+                            and lim_v / req_v > max_ratio:
+                        errs.append(
+                            f"podSets[{i}] container {c.name or '?'} "
+                            f"{res}: limit/request ratio "
+                            f"{lim_v / req_v:g} exceeds "
+                            f"maxLimitRequestRatio {max_ratio:g}"
+                        )
         if pod_item is not None:
             total = pod_requests(ps)
             over = _greater_keys(total, pod_item.max)

