@@ -1144,8 +1144,16 @@ class TASFlavorSnapshot:
         while level_idx < len(self.level_keys) - 1:
             # At/below the slice level: per-parent assignment; an inner
             # slice layer constrains child distributions to multiples of
-            # its size (reference :1100-1132).
-            inner = slice_size_at_level.get(level_idx + 1, 1)
+            # its size (reference :1100-1132). Above the slice level —
+            # reachable only on the balanced path, whose fit level may sit
+            # above it — distribution runs in OUTER slice units so slices
+            # never split across sub-slice domains (reference :1104:
+            # sliceSizeOnLevel = sliceSize when currentLevel <
+            # sliceLevelIdx).
+            if level_idx < slice_level_idx:
+                inner = slice_size
+            else:
+                inner = slice_size_at_level.get(level_idx + 1, 1)
             new_curr: List[Domain] = []
             for dom in curr:
                 lower = self._sorted_domains(list(dom.children))
@@ -1165,6 +1173,21 @@ class TASFlavorSnapshot:
                 new_curr.extend(taken)
             curr = new_curr
             level_idx += 1
+
+        # Safety net (deliberate deviation): the reference's balanced
+        # descent recomputes sliceState = state // sliceSize above the
+        # slice level (:1113), which over-counts fragmented subtrees and
+        # can silently emit an assignment with FEWER pods than requested
+        # (updateCountsToMinimum absorbs the shortage). We keep the
+        # reference's counting bit-for-bit but refuse to admit a short
+        # gang: surface a placement failure instead.
+        placed_total = sum(d.state for d in curr)
+        if placed_total != req.count:
+            return None, None, (
+                f"topology assignment under-placed: {placed_total} of"
+                f" {req.count} pods (fragmented capacity at an"
+                " intermediate level)"
+            )
 
         # phase 3
         leader_assignment: Optional[TopologyAssignment] = None

@@ -437,3 +437,91 @@ def test_balanced_threshold_is_maximal_brute_force():
                 min(caps[i] for i in subset), floor
             ))
         assert min(got) >= best_min, (caps, count, got, best_min)
+
+
+def test_balanced_descent_distributes_in_slice_units():
+    """Balanced placement whose fit level sits ABOVE the slice level must
+    distribute to children in outer-slice units (reference
+    tas_flavor_snapshot.go:1104 sliceSizeOnLevel), never splitting a
+    slice across sub-slice domains via pod-greedy takes."""
+    from kueue_tpu.api.types import LocalQueue
+    from kueue_tpu.manager import Manager
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=[
+            "tpu.block", "tpu.rack", "kubernetes.io/hostname"]),
+    )
+    for r, caps in (("r0", (3, 3)), ("r1", (3, 3))):
+        for h, cap in enumerate(caps):
+            mgr.apply(Node(
+                name=f"n-{r}-{h}",
+                labels={"tpu.block": "b0", "tpu.rack": r},
+                capacity={"tpu": cap},
+            ))
+    snap = mgr.cache.snapshot()
+    tas = snap.tas_flavors["tpu-v5e"]
+    req = PlacementRequest(
+        count=8, single_pod_requests={"tpu": 1},
+        preferred_level="tpu.block",
+        slice_required_level="kubernetes.io/hostname", slice_size=2,
+        balanced=True,
+    )
+    ta, _leader, reason = tas.find_topology_assignment(req)
+    assert not reason, reason
+    total = sum(c for _, c in ta.domains)
+    assert total == 8, ta.domains
+    assert all(c % 2 == 0 for _, c in ta.domains), (
+        f"slice split across domains: {ta.domains}"
+    )
+
+
+def test_balanced_fragmented_intermediate_level_never_short_places():
+    """Reference-faithful balanced counting recomputes slice states above
+    the slice level (:1113), which over-counts fragmented subtrees; the
+    engine must surface a placement failure rather than silently admit a
+    gang with fewer pods than requested."""
+    from kueue_tpu.api.types import LocalQueue
+    from kueue_tpu.manager import Manager
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=[
+            "tpu.block", "tpu.rack", "tpu.subrack",
+            "kubernetes.io/hostname"]),
+    )
+    fleet = {
+        ("rA", "s0"): (4, 4, 4, 3, 3),  # 18 pods but only 3 real slices
+        ("rA", "s1"): (4, 4),
+        ("rB", "s2"): (4, 4, 4, 4, 4),
+    }
+    for (rack, sub), caps in fleet.items():
+        for h, cap in enumerate(caps):
+            mgr.apply(Node(
+                name=f"n-{rack}-{sub}-{h}",
+                labels={"tpu.block": "b0", "tpu.rack": rack,
+                        "tpu.subrack": f"{rack}-{sub}"},
+                capacity={"tpu": cap},
+            ))
+    snap = mgr.cache.snapshot()
+    tas = snap.tas_flavors["tpu-v5e"]
+    req = PlacementRequest(
+        count=40, single_pod_requests={"tpu": 1},
+        preferred_level="tpu.block",
+        slice_required_level="kubernetes.io/hostname", slice_size=4,
+        balanced=True,
+    )
+    ta, _leader, reason = tas.find_topology_assignment(req)
+    if not reason:
+        total = sum(c for _, c in ta.domains)
+        assert total == 40, (
+            f"silently under-placed: {total}/40 — {ta.domains}"
+        )
