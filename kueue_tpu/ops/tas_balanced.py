@@ -1,0 +1,120 @@
+"""Device primitives for TAS balanced placement (no leaders yet).
+
+Building blocks for the round-4 device balanced kernel (reference
+tas_balanced_placement.go; host twin tas/snapshot.py
+_find_best_domains_balanced / _select_optimal_domain_set /
+_place_slices_balanced). Not yet wired into the admission scan — each
+primitive is differential-tested against the host implementation
+directly (tests/test_tas_balanced_ops.py).
+
+The optimal-domain-set DP reduces to subset enumeration: for the
+no-leader case, the host DP's answer over domains in a given order is
+EXACTLY "among subsets of n_sel domains (positive-slice-state members
+only, built in rank order) whose total state reaches the target AND
+whose every proper prefix stays below it (the DP cannot extend an
+exhausted prefix — `before_state <= 0: continue`; since prefix sums are
+monotone, only the largest proper prefix binds): minimal total state,
+then minimal bitmask" — the insertion-ordered setdefault tie-break
+collapses to integer bitmask comparison (smaller highest-set-bit wins
+first). Verified against the host DP on random instances INCLUDING
+fragmented states that are not slice-size multiples. Subsets enumerate
+as one static [2^BMAX, BMAX] bit-matrix contraction — MXU-shaped work;
+sibling groups wider than BMAX must stay on the host.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+BMAX = 14
+_INF = jnp.int64(1) << 60
+
+_bits_np = (
+    (np.arange(1 << BMAX, dtype=np.int64)[:, None]
+     >> np.arange(BMAX)) & 1
+).astype(np.int32)
+_BITS = jnp.asarray(_bits_np)  # i32[2^BMAX, BMAX]
+_POPCNT = jnp.asarray(_bits_np.sum(1))  # i32[2^BMAX]
+# Highest set bit per mask (0 for the empty mask): the rank of a
+# subset's LAST-inserted member, whose removal gives the largest proper
+# prefix.
+_HIBIT = jnp.asarray(
+    np.maximum(
+        np.int64(np.floor(np.log2(np.maximum(
+            np.arange(1 << BMAX, dtype=np.float64), 1.0
+        )))), 0
+    ).astype(np.int32)
+)
+
+
+def greedy_eval(slice_vals, state_vals, cand, target):
+    """evaluateGreedyAssignment :28 (no leaders): walk candidates in the
+    host BestFit order (-slice_state, state, index), taking whole
+    positive slice states until the target is covered. Returns
+    (fits bool, n_selected i32, last_slice i64 — the slice state of the
+    last domain taken, 0 when none)."""
+    d_n = slice_vals.shape[0]
+    iota = jnp.arange(d_n)
+    usable = cand & (slice_vals > 0)
+    order = jnp.lexsort(
+        (iota, state_vals, -slice_vals, jnp.where(usable, 0, 1))
+    )
+    v = jnp.where(usable, slice_vals, 0)[order]
+    prefix_incl = jnp.cumsum(v)
+    taken = (prefix_incl - v < target) & (v > 0)
+    total = jnp.sum(jnp.where(taken, v, 0))
+    fits = total >= target
+    n_sel = jnp.sum(taken).astype(jnp.int32)
+    last_slice = jnp.min(jnp.where(taken, v, _INF))
+    last_slice = jnp.where(n_sel > 0, last_slice, 0)
+    return fits, n_sel, last_slice
+
+
+def optimal_subset(state_vals, slice_vals, cand, n_sel, target_state,
+                   rank):
+    """selectOptimalDomainSetToFit :82 (no leaders) as subset
+    enumeration: exactly ``n_sel`` members, every member a candidate
+    with positive slice state, total state >= ``target_state``; minimal
+    total state wins, ties resolved by minimal bitmask over ``rank``
+    (the host's `ordered` position of each domain; rank >= BMAX excludes
+    the domain). Returns (found bool, selected bool[D])."""
+    d_n = state_vals.shape[0]
+    participate = cand & (rank >= 0) & (rank < BMAX)
+    rank_c = jnp.clip(rank, 0, BMAX - 1)
+    state_by_bit = jnp.zeros(BMAX, jnp.int64).at[rank_c].add(
+        jnp.where(participate, state_vals, 0), mode="drop"
+    )
+    ok_bit = jnp.zeros(BMAX, bool).at[rank_c].max(
+        participate & (slice_vals > 0), mode="drop"
+    )
+    sums = _BITS.astype(jnp.int64) @ state_by_bit  # [2^BMAX]
+    bad = (_BITS @ (~ok_bit).astype(jnp.int32)) > 0
+    # Host-DP reachability: the largest proper prefix (subset minus its
+    # highest-rank member) must stay below the target, else the DP would
+    # have stopped extending it.
+    last_state = state_by_bit[_HIBIT]  # [2^BMAX]
+    reachable = (sums - last_state) < target_state
+    feas = (
+        (_POPCNT == n_sel) & ~bad & (sums >= target_state) & reachable
+    )
+    mask_iota = jnp.arange(1 << BMAX, dtype=jnp.int64)
+    key = jnp.where(feas, sums * (1 << BMAX) + mask_iota, _INF)
+    win = jnp.argmin(key)
+    found = key[win] < _INF
+    selected = participate & (((win >> rank_c) & 1) == 1) & found
+    return found, selected
+
+
+def distribute_extras(slice_vals, selected, threshold, extras):
+    """placeSlicesOnDomainsBalanced :150 tail: every selected domain gets
+    ``threshold`` slices; the remaining ``extras`` distribute
+    front-to-back in the given index order, each domain absorbing up to
+    its capacity above the threshold. Returns (takes i64[D] in slices,
+    leftover i64)."""
+    avail = jnp.where(selected, jnp.maximum(slice_vals - threshold, 0), 0)
+    excl = jnp.cumsum(avail) - avail
+    take_extra = jnp.clip(extras - excl, 0, avail)
+    takes = jnp.where(selected, threshold + take_extra, 0)
+    leftover = extras - jnp.sum(take_extra)
+    return takes, leftover

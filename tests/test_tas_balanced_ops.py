@@ -1,0 +1,115 @@
+"""Differentials for the device balanced-placement primitives
+(ops/tas_balanced.py) against the host engine's building blocks —
+greedy evaluation, the optimal-domain-set DP (as subset enumeration),
+and the threshold+extras distribution."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kueue_tpu.ops import tas_balanced as tb
+from kueue_tpu.tas.snapshot import Domain, TASFlavorSnapshot
+
+snap = TASFlavorSnapshot.__new__(TASFlavorSnapshot)
+
+
+def mk_domains(states_pods, ss):
+    doms = []
+    for i, s in enumerate(states_pods):
+        d = Domain((f"d{i}",))
+        d.state = s
+        d.slice_state = s // ss
+        d.slice_state_with_leader = d.slice_state
+        d.state_with_leader = d.state
+        d.leader_state = 0
+        d.children = []
+        doms.append(d)
+    return doms
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_greedy_eval_matches_host(seed):
+    rng = random.Random(90_000 + seed)
+    for _ in range(200):
+        ss = rng.choice([1, 2, 3])
+        n = rng.randint(1, 12)
+        states = [rng.randint(0, 10 * ss) for _ in range(n)]
+        target = rng.randint(1, 16)
+        doms = mk_domains(states, ss)
+        fits_h, n_h, _ldr, last_dom = TASFlavorSnapshot._evaluate_greedy(
+            snap, doms, target, 0
+        )
+        slice_vals = jnp.asarray([d.slice_state for d in doms])
+        state_vals = jnp.asarray([d.state for d in doms])
+        fits_d, n_d, last_d = tb.greedy_eval(
+            slice_vals, state_vals, jnp.ones(n, bool), target
+        )
+        assert bool(fits_d) == fits_h, (states, ss, target)
+        if fits_h:
+            assert int(n_d) == n_h, (states, ss, target)
+            assert int(last_d) == last_dom.slice_state, (states, ss, target)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_optimal_subset_matches_host_dp(seed):
+    rng = random.Random(91_000 + seed)
+    for _ in range(150):
+        ss = rng.choice([1, 1, 2, 3])
+        n = rng.randint(1, 9)
+        # Fragmented states (NOT slice multiples) reach the host DP's
+        # prefix-blocking regime — the equivalence must hold there too.
+        states = [rng.randint(0, 12 * ss) for _ in range(n)]
+        slice_count = rng.randint(1, 14)
+        doms = mk_domains(states, ss)
+        host = TASFlavorSnapshot._select_optimal_domain_set(
+            snap, doms, slice_count, 0, ss, False
+        )
+        host_idx = (
+            None if host is None
+            else sorted(int(d.level_values[0][1:]) for d in host)
+        )
+        # Device: greedy count first (the DP's n), then the subset.
+        slice_vals = jnp.asarray([d.slice_state for d in doms])
+        state_vals = jnp.asarray([d.state for d in doms])
+        fits, n_sel, _last = tb.greedy_eval(
+            slice_vals, state_vals, jnp.ones(n, bool), slice_count
+        )
+        # Host `ordered` for prioritize_by_entropy=False is level_values
+        # order == index order here.
+        rank = jnp.arange(n, dtype=jnp.int32)
+        found, selected = tb.optimal_subset(
+            state_vals, slice_vals, jnp.ones(n, bool), n_sel,
+            slice_count * ss, rank,
+        )
+        found = bool(found) and bool(fits)
+        dev_idx = (
+            sorted(np.flatnonzero(np.asarray(selected)).tolist())
+            if found else None
+        )
+        assert (host_idx is None) == (dev_idx is None), (
+            states, ss, slice_count, host_idx, dev_idx
+        )
+        assert host_idx == dev_idx, (states, ss, slice_count)
+
+
+def test_distribute_extras_matches_host_tail():
+    rng = random.Random(92_000)
+    for _ in range(300):
+        n = rng.randint(1, 8)
+        threshold = rng.randint(0, 4)
+        caps = [threshold + rng.randint(0, 5) for _ in range(n)]
+        extras = rng.randint(0, sum(c - threshold for c in caps) + 2)
+        takes, leftover = tb.distribute_extras(
+            jnp.asarray(caps), jnp.ones(n, bool), threshold, extras
+        )
+        # Host loop semantics: front-to-back absorption.
+        exp = []
+        left = extras
+        for c in caps:
+            t = min(c - threshold, left)
+            exp.append(threshold + t)
+            left -= t
+        assert np.asarray(takes).tolist() == exp
+        assert int(leftover) == left
