@@ -133,6 +133,8 @@ def decode(doc: Dict[str, Any]):
                 for fq in rg.get("flavors", [])
             ],
             fair_sharing=_fair_sharing(spec),
+            labels=meta.get("labels", {}),
+            annotations=meta.get("annotations", {}),
         )
     if kind == "ClusterQueue":
         preemption = spec.get("preemption", {})
@@ -190,6 +192,8 @@ def decode(doc: Dict[str, Any]):
             stop_policy=StopPolicy(spec.get("stopPolicy", "None")),
             fair_sharing=_fair_sharing(spec),
             admission_checks=spec.get("admissionChecks", []),
+            labels=meta.get("labels", {}),
+            annotations=meta.get("annotations", {}),
         )
     if kind == "LocalQueue":
         return LocalQueue(

@@ -204,6 +204,9 @@ class ClusterQueue:
     # ConcurrentAdmission (reference clusterqueue_types.go:204): when
     # "Enabled", workloads race one variant per candidate flavor.
     concurrent_admission_policy: Optional[str] = None
+    # Object metadata (custom metric label sources, KEP 7066).
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
 
     def flavors_for(self, resource: str) -> List[str]:
         for rg in self.resource_groups:
@@ -220,6 +223,9 @@ class Cohort:
     parent: Optional[str] = None
     quotas: List[FlavorQuotas] = field(default_factory=list)
     fair_sharing: Optional[FairSharing] = None
+    # Object metadata (custom metric label sources, KEP 7066).
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

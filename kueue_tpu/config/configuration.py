@@ -124,6 +124,13 @@ class Configuration:
     object_retention_after_deactivated_seconds: Optional[float] = None
     visibility_enabled: bool = True
     use_device_scheduler: bool = False
+    # KEP 7066 custom metric labels: entries of
+    # {name, sourceKind: Workload|ClusterQueue|Cohort, sourceLabelKey,
+    # sourceAnnotationKey}; values are read from the source object's
+    # labels/annotations and appended to that kind's metric series.
+    metrics_custom_labels: List[Dict[str, str]] = field(
+        default_factory=list
+    )
 
 
 def _pick(d: dict, *names, default=None):
@@ -150,6 +157,21 @@ def load(source) -> Configuration:
 
     cfg = Configuration()
     cfg.namespace = _pick(raw, "namespace", default=cfg.namespace)
+    metrics_raw = _pick(raw, "metrics", default={}) or {}
+    for entry in _pick(metrics_raw, "customLabels", "custom_labels",
+                       default=[]) or []:
+        cfg.metrics_custom_labels.append({
+            "name": entry.get("name", ""),
+            "source_kind": _pick(entry, "sourceKind", "source_kind",
+                                 default="Workload"),
+            "source_label_key": _pick(
+                entry, "sourceLabelKey", "source_label_key", default=""
+            ),
+            "source_annotation_key": _pick(
+                entry, "sourceAnnotationKey", "source_annotation_key",
+                default=""
+            ),
+        })
     cfg.manage_jobs_without_queue_name = _pick(
         raw, "manageJobsWithoutQueueName", "manage_jobs_without_queue_name",
         default=False,
@@ -310,6 +332,7 @@ def build_manager(cfg: Configuration, **kw):
     mgr.exclude_resource_prefixes = list(
         cfg.resources.exclude_resource_prefixes
     )
+    mgr.metrics_custom_labels = list(cfg.metrics_custom_labels)
     mgr.resource_transformations = list(cfg.resources.transformations)
     mgr.device_class_mappings = list(cfg.resources.device_class_mappings)
     mgr.cache.device_class_mappings = mgr.device_class_mappings

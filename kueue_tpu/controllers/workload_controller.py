@@ -136,7 +136,33 @@ class WorkloadController:
                 # admission_checks_wait_time_seconds).
                 m = self.manager.metrics
                 cq = self.manager.queues.cluster_queue_for(wl) or ""
-                m.inc("admitted_workloads_total", {"cluster_queue": cq})
+                wl_extra = self.manager._custom_metric_labels(
+                    "Workload", wl
+                )
+                m.inc("admitted_workloads_total",
+                      {"cluster_queue": cq, **wl_extra})
+                # Per-subtree admission counters (reference metrics.go
+                # cohort_subtree_admitted_workloads_total): every ancestor
+                # cohort of the admitting CQ counts the admission.
+                co_name = None
+                cq_spec = self.manager.cache.cluster_queues.get(cq)
+                if cq_spec is not None:
+                    co_name = cq_spec.cohort
+                seen_cohorts = set()
+                while co_name and co_name not in seen_cohorts:
+                    seen_cohorts.add(co_name)
+                    co_obj = self.manager.cache.cohorts.get(co_name)
+                    m.inc(
+                        "cohort_subtree_admitted_workloads_total",
+                        {"cohort": co_name,
+                         "priority_class": wl.priority_class or "",
+                         **(self.manager._custom_metric_labels(
+                             "Cohort", co_obj)
+                            if co_obj is not None else {})},
+                    )
+                    co_name = (
+                        co_obj.parent if co_obj is not None else None
+                    )
                 m.observe("admission_wait_time_seconds",
                           max(0.0, now - wl.creation_time),
                           {"cluster_queue": cq})

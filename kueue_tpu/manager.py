@@ -131,6 +131,24 @@ class Manager:
     # configuration objects
     # ------------------------------------------------------------------
 
+    def _custom_metric_labels(self, kind: str, obj) -> Dict[str, str]:
+        """KEP 7066 custom metric labels: configured entries for ``kind``
+        resolve against the source object's labels/annotations (label key
+        defaulting to the entry name); missing sources emit ""."""
+        out: Dict[str, str] = {}
+        for entry in getattr(self, "metrics_custom_labels", []) or []:
+            if entry.get("source_kind", "Workload") != kind:
+                continue
+            labels = getattr(obj, "labels", {}) or {}
+            annotations = getattr(obj, "annotations", {}) or {}
+            if entry.get("source_annotation_key"):
+                val = annotations.get(entry["source_annotation_key"], "")
+            else:
+                key = entry.get("source_label_key") or entry.get("name", "")
+                val = labels.get(key, "")
+            out[entry.get("name", "")] = val
+        return out
+
     def apply(self, *objects: ApplyObject) -> None:
 
         for obj in objects:
@@ -581,7 +599,13 @@ class Manager:
         cluster_queue_weighted_share / cohort_weighted_share."""
         from kueue_tpu.core.resources import FlavorResource
 
-        snapshot = None
+        # One snapshot serves the cohort-subtree aggregates and the
+        # weighted shares; a cohort-hierarchy cycle (ValueError) degrades
+        # those series gracefully instead of killing the tick.
+        try:
+            snapshot = self.cache.snapshot()
+        except ValueError:
+            snapshot = None
         self.metrics.set_gauge("build_info", 1, {"framework": "kueue_tpu"})
         for name, cq_spec in self.cache.cluster_queues.items():
             self.metrics.set_gauge(
@@ -595,7 +619,8 @@ class Manager:
             )
             self.metrics.set_gauge(
                 "cluster_queue_info", 1,
-                {"cluster_queue": name, "cohort": cq_spec.cohort or ""},
+                {"cluster_queue": name, "cohort": cq_spec.cohort or "",
+                 **self._custom_metric_labels("ClusterQueue", cq_spec)},
             )
             # Spec quota series (metrics.go cluster_queue_nominal_quota /
             # borrowing_limit / lending_limit).
@@ -620,8 +645,38 @@ class Manager:
         for co_name, co in self.cache.cohorts.items():
             self.metrics.set_gauge(
                 "cohort_info", 1,
-                {"cohort": co_name, "parent": co.parent or ""},
+                {"cohort": co_name, "parent": co.parent or "",
+                 **self._custom_metric_labels("Cohort", co)},
             )
+        # Cohort subtree aggregates (reference metrics.go:919
+        # cohort_subtree_quota / _resource_reservations /
+        # _admitted_active_workloads): the quota tree's cohort nodes
+        # already carry subtree-rolled quota and usage.
+        cohort_nodes = []
+        stack = list(snapshot.roots) if snapshot is not None else []
+        while stack:
+            node = stack.pop()
+            if not node.is_cq:
+                cohort_nodes.append(node)
+                stack.extend(node.children)
+        for node in cohort_nodes:
+            co_obj = self.cache.cohorts.get(node.name)
+            extra = self._custom_metric_labels("Cohort", co_obj) \
+                if co_obj is not None else {}
+            # Iterate the union of quota and usage cells so a cell whose
+            # reservations dropped to zero RESETS its gauge instead of
+            # exporting the last nonzero value forever.
+            for fr in set(node.subtree_quota) | set(node.usage):
+                lbl = {"cohort": node.name, "flavor": fr.flavor,
+                       "resource": fr.resource, **extra}
+                self.metrics.set_gauge(
+                    "cohort_subtree_quota",
+                    node.subtree_quota.get(fr, 0), lbl,
+                )
+                self.metrics.set_gauge(
+                    "cohort_subtree_resource_reservations",
+                    node.usage.get(fr, 0), lbl,
+                )
         # Active admitted / reserving counts (metrics.go
         # admitted_active_workloads, reserving_active_workloads).
         admitted_n: Dict[str, int] = {}
@@ -645,6 +700,26 @@ class Manager:
             self.metrics.set_gauge(
                 "reserving_active_workloads", reserving_n.get(name, 0),
                 {"cluster_queue": name},
+            )
+        # Per-subtree admitted-active rollup (reference metrics.go:946).
+        subtree_admitted: Dict[str, int] = {}
+        cq_snaps = snapshot.cluster_queues if snapshot is not None else {}
+        for name, cqs in cq_snaps.items():
+            node = cqs.node.parent
+            while node is not None:
+                subtree_admitted[node.name] = (
+                    subtree_admitted.get(node.name, 0)
+                    + admitted_n.get(name, 0)
+                )
+                node = node.parent
+        for node in cohort_nodes:
+            co_obj = self.cache.cohorts.get(node.name)
+            extra = self._custom_metric_labels("Cohort", co_obj) \
+                if co_obj is not None else {}
+            self.metrics.set_gauge(
+                "cohort_subtree_admitted_active_workloads",
+                subtree_admitted.get(node.name, 0),
+                {"cohort": node.name, **extra},
             )
         usage_by_cq: Dict[str, Dict] = {}
         for info in self.cache.workloads.values():
@@ -688,9 +763,7 @@ class Manager:
                 )
 
         # Weighted shares need the snapshot's quota tree.
-        try:
-            snapshot = self.cache.snapshot()
-        except ValueError:
+        if snapshot is None:
             return
         for name, cqs in snapshot.cluster_queues.items():
             drs = cqs.dominant_resource_share()

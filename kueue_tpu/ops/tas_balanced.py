@@ -24,8 +24,13 @@ sibling groups wider than BMAX must stay on the host.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Exact int64 math end to end (as in quota_ops): without x64 the module
+# constants silently truncate to int32 and the _INF sentinel corrupts.
+jax.config.update("jax_enable_x64", True)
 
 BMAX = 14
 _INF = jnp.int64(1) << 60
@@ -50,7 +55,9 @@ _HIBIT = jnp.asarray(
 
 def greedy_eval(slice_vals, state_vals, cand, target):
     """evaluateGreedyAssignment :28 (no leaders): walk candidates in the
-    host BestFit order (-slice_state, state, index), taking whole
+    host BestFit order (-slice_state, state, level_values) — the caller
+    must present domains in level_values-sorted index order (the device
+    topology encode already sorts each level that way), taking whole
     positive slice states until the target is covered. Returns
     (fits bool, n_selected i32, last_slice i64 — the slice state of the
     last domain taken, 0 when none)."""

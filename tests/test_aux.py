@@ -997,3 +997,50 @@ spec: {clusterQueue: cq-p}
     assert main(["apply", str(m)]) == 0
     out = capsys.readouterr().out
     assert "applied 3 object(s)" in out
+
+
+def test_cohort_subtree_metrics_and_custom_labels():
+    """cohort_subtree_* series (reference metrics.go:919-946) and KEP
+    7066 custom metric labels sourced from Workload/Cohort metadata."""
+    from kueue_tpu.api.types import Cohort, LocalQueue, ResourceFlavor
+    from kueue_tpu.config.configuration import Configuration, build_manager
+
+    cfg = Configuration()
+    cfg.metrics_custom_labels = [
+        {"name": "team", "source_kind": "Workload",
+         "source_label_key": "team", "source_annotation_key": ""},
+        {"name": "org", "source_kind": "Cohort",
+         "source_label_key": "org", "source_annotation_key": ""},
+    ]
+    mgr = build_manager(cfg)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="root-co", labels={"org": "research"}),
+        Cohort(name="child-co", parent="root-co"),
+        make_cq("cq-a", cohort="child-co",
+                flavors={"default": {"cpu": quota(8_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = make_wl("w1", "lq", cpu_m=3000)
+    wl.labels["team"] = "brain"
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    mgr.tick()
+
+    m = mgr.metrics
+    # Subtree quota/reservations roll up through BOTH ancestor cohorts.
+    for co in ("child-co", "root-co"):
+        lbl = {"cohort": co, "flavor": "default", "resource": "cpu"}
+        if co == "root-co":
+            lbl["org"] = "research"
+        else:
+            lbl["org"] = ""
+        assert m.get("cohort_subtree_quota", lbl) == 8_000, (co, lbl)
+        assert m.get("cohort_subtree_resource_reservations", lbl) == 3000
+        alb = {"cohort": co, "org": lbl["org"]}
+        assert m.get("cohort_subtree_admitted_active_workloads", alb) == 1
+        clb = {"cohort": co, "priority_class": "", "org": lbl["org"]}
+        assert m.get("cohort_subtree_admitted_workloads_total", clb) == 1
+    # Workload-sourced custom label on the admission counter.
+    assert m.get("admitted_workloads_total",
+                 {"cluster_queue": "cq-a", "team": "brain"}) == 1

@@ -77,7 +77,9 @@ def test_optimal_subset_matches_host_dp(seed):
             slice_vals, state_vals, jnp.ones(n, bool), slice_count
         )
         # Host `ordered` for prioritize_by_entropy=False is level_values
-        # order == index order here.
+        # order; the d0..d9 names used here sort like indices (n <= 9),
+        # so index rank is valid. Real callers must pass
+        # level_values-sorted ranks (see optimal_subset docstring).
         rank = jnp.arange(n, dtype=jnp.int32)
         found, selected = tb.optimal_subset(
             state_vals, slice_vals, jnp.ones(n, bool), n_sel,
