@@ -40,12 +40,14 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_scenario(scale: float):
+def build_scenario(scale: float, n_cohorts: int = 5, n_cqs: int = 6,
+                   classes=None, fair: bool = False):
     from kueue_tpu.api.constants import PreemptionPolicy
     from kueue_tpu.api.types import (
         ClusterQueue,
         ClusterQueuePreemption,
         Cohort,
+        FairSharing,
         FlavorQuotas,
         LocalQueue,
         PodSet,
@@ -61,17 +63,18 @@ def build_scenario(scale: float):
     queues = QueueManager()
     cache.add_or_update_resource_flavor(ResourceFlavor(name="default"))
 
-    classes = [
-        ("small", int(350 * scale), 1000, 50, 0.2),
-        ("medium", int(100 * scale), 5000, 100, 0.5),
-        ("large", int(50 * scale), 20000, 200, 1.0),
-    ]
+    if classes is None:
+        classes = [
+            ("small", int(350 * scale), 1000, 50, 0.2),
+            ("medium", int(100 * scale), 5000, 100, 0.5),
+            ("large", int(50 * scale), 20000, 200, 1.0),
+        ]
 
     workloads = []
     t = 0.0
-    for ci in range(5):
+    for ci in range(n_cohorts):
         cache.add_or_update_cohort(Cohort(name=f"cohort-{ci}"))
-        for qi in range(6):
+        for qi in range(n_cqs):
             cq_name = f"cq-{ci}-{qi}"
             cq = ClusterQueue(
                 name=cq_name,
@@ -96,6 +99,7 @@ def build_scenario(scale: float):
                     reclaim_within_cohort=PreemptionPolicy.ANY,
                     within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
                 ),
+                fair_sharing=FairSharing(weight=1.0) if fair else None,
             )
             cache.add_or_update_cluster_queue(cq)
             queues.add_cluster_queue(cq)
@@ -300,6 +304,92 @@ def probe_sim(scale: float):
         "admissions_per_s": round(admitted / dt, 1) if dt > 0 else 0.0,
         # Honest end-to-end number for the host-vs-device crossover:
         # encode + dispatch (compile amortizes via the persistent cache).
+        "end_to_end_s": round(encode_s + dt, 3),
+        "end_to_end_adm_per_s": round(
+            admitted / (encode_s + dt), 1
+        ) if encode_s + dt > 0 else 0.0,
+    })
+    return stats
+
+
+def probe_fair(scale: float):
+    """The flagship fair-sharing configuration (BASELINE.json config #3 /
+    perf_configs/fair-sharing: 50 cohorts x 40 CQs = 2,000 CQs, 25
+    workloads per CQ = 50k at scale 1.0) simulated end to end on the
+    device with the DRS-tournament kernel (models/fair_kernel.py) —
+    the fair analog of the sim probe, because the host fair tournament
+    is the slowest host path and the device kernel is its replacement."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from kueue_tpu.core.workload_info import WorkloadInfo
+    from kueue_tpu.models.encode import encode_cycle
+    from kueue_tpu.models.sim_loop import make_sim_loop
+
+    # Linear scaling contract (like probe_sim): per-CQ class counts are
+    # fixed; only the cohort count scales, so workload count tracks
+    # ``scale`` linearly and cross-scale adm/s numbers stay comparable.
+    classes = [
+        ("small", 18, 1000, 50, 0.15),
+        ("medium", 5, 5000, 100, 0.35),
+        ("large", 2, 20000, 200, 0.7),
+    ]
+    n_cohorts = max(int(50 * scale), 1)
+    cache, queues, workloads = build_scenario(
+        1.0, n_cohorts=n_cohorts, n_cqs=40, classes=classes, fair=True
+    )
+    infos = []
+    runtimes = []
+    for wl, runtime_s in workloads:
+        lq = cache.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        infos.append(WorkloadInfo(wl, lq.cluster_queue))
+        runtimes.append(int(runtime_s * 1000))
+    snapshot = cache.snapshot()
+    t_enc = time.monotonic()
+    arrays, idx = encode_cycle(
+        snapshot, infos, snapshot.resource_flavors, fair_sharing=True
+    )
+    encode_s = time.monotonic() - t_enc
+    w_pad = arrays.w_cq.shape[0]
+    runtime_ms = jnp.asarray(
+        np.pad(np.asarray(runtimes, np.int64), (0, w_pad - len(runtimes)))
+    )
+    group_of = np.asarray(idx.group_arrays.flat_to_group)[
+        np.asarray(arrays.w_cq)
+    ]
+    s_max = int(np.bincount(group_of).max())
+    n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
+    stats = {
+        "probe": "fair",
+        "ok": True,
+        "platform": jax.devices()[0].platform,
+        "n": len(infos),
+        "cqs": n_cohorts * 40,
+        "encode_s": round(encode_s, 3),
+    }
+    try:
+        sim = jax.jit(make_sim_loop(s_max=s_max, kernel="fair",
+                                    n_levels=n_levels))
+        t0 = time.monotonic()
+        out = sim(arrays, idx.group_arrays, runtime_ms)
+        out.rounds.block_until_ready()
+        compile_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        out = sim(arrays, idx.group_arrays, runtime_ms)
+        out.rounds.block_until_ready()
+        dt = time.monotonic() - t0
+        admitted = int((np.asarray(out.admitted_at) >= 0).sum())
+    except Exception as exc:  # noqa: BLE001 - record and report
+        stats["ok"] = False
+        stats["error"] = repr(exc)[:300]
+        return stats
+    stats.update({
+        "admitted": admitted,
+        "rounds": int(out.rounds),
+        "compile_s": round(compile_s, 1),
+        "device_wall_s": round(dt, 3),
+        "admissions_per_s": round(admitted / dt, 1) if dt > 0 else 0.0,
         "end_to_end_s": round(encode_s + dt, 3),
         "end_to_end_adm_per_s": round(
             admitted / (encode_s + dt), 1
@@ -577,8 +667,40 @@ def probe_multichip():
             stats[f"cycle_{n}dev_ms"] = round(
                 (time.monotonic() - t0) * 1000, 1
             )
+            if n > 1:
+                # Group-axis-sharded scan variant (VERDICT r3 #6):
+                # measured for the record; see scan_floor_analysis.
+                cyc_g = par.sharded_grouped_cycle(
+                    mesh, arrays, ga, s_max=s_exact, n_levels=n_levels,
+                    unroll=4, shard_scan_by_group=True,
+                )
+                out = cyc_g(arrays, ga)
+                jax.block_until_ready(out.outcome)
+                t0 = time.monotonic()
+                out = cyc_g(arrays, ga)
+                jax.block_until_ready(out.outcome)
+                stats[f"cycle_gshard_{n}dev_ms"] = round(
+                    (time.monotonic() - t0) * 1000, 1
+                )
         except Exception as exc:  # noqa: BLE001 - record and continue
             stats[f"{n}dev_error"] = repr(exc)[:300]
+    stats["scan_floor_analysis"] = (
+        "The grouped admission scan is step-latency-bound, not "
+        "width-bound: each of its s_max sequential steps touches "
+        "O(G*Nm*F*R) ~1MB of state but costs ~0.2ms of dispatch/memory "
+        "latency, so sharding the group axis (independent cohort "
+        "forests; bit-identical outcomes, validated in "
+        "tests/test_multichip_differential.py) removes width a device "
+        "never waits on while adding SPMD partition overhead per step — "
+        "XLA inserts per-step reshards (273 vs 42 all-gathers in the "
+        "compiled HLO). Multi-chip speedup for the cycle therefore comes "
+        "from (a) the W-sharded nominate phase (the FLOP term) and (b) "
+        "eliminating the sequential scan itself — the fixed-point kernel "
+        "already replaces it with a handful of fully-parallel rounds for "
+        "lending-limit-free trees; a group-sharded scan would only win "
+        "when per-step width work dominates per-step latency, i.e. "
+        "forests far wider than the 50-cohort flagship."
+    )
     return stats
 
 
@@ -625,7 +747,8 @@ def main():
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
     ap.add_argument("--probe", default=None,
-                    choices=["ping", "mega", "sim", "phases", "multichip"],
+                    choices=["ping", "mega", "sim", "fair", "phases",
+                             "multichip"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -665,6 +788,7 @@ def main():
                 "ping": probe_ping,
                 "mega": probe_mega,
                 "sim": lambda: probe_sim(args.scale),
+                "fair": lambda: probe_fair(args.scale),
                 "phases": probe_phases,
                 "multichip": probe_multichip,
             }[args.probe]()
@@ -704,6 +828,7 @@ def main():
 
             device["sim"] = probe_with_cache_fallback("sim")
             device["mega"] = probe_with_cache_fallback("mega")
+            device["fair"] = probe_with_cache_fallback("fair")
             device["phases"] = run_probe_subprocess(
                 "phases", 420, args.scale, args.platform
             )

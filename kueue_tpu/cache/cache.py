@@ -283,6 +283,7 @@ class Cache:
             c.quotas = node.quotas  # shared (immutable between gens)
             c.subtree_quota = node.subtree_quota  # shared
             c.usage = dict(node.usage)  # the mutable transaction state
+            c.usage_gen = 0
             c.fair_weight = node.fair_weight
             clones[name] = c
         for name, node in self._live_nodes.items():

@@ -56,6 +56,12 @@ class QuotaNode:
         self.subtree_quota: FlavorResourceQuantities = {}
         self.usage: FlavorResourceQuantities = {}
         self.fair_weight: float = 1.0
+        # Usage generation: bumped by every REAL usage mutation (not by
+        # simulate/revert pairs, which pass bump=False). DRS of a node is
+        # a pure function of (node.usage, static quota config), so DRS
+        # caches key their validity on this counter — the fair-sharing
+        # tournament's incremental cache depends on it.
+        self.usage_gen: int = 0
 
     # -- navigation ---------------------------------------------------------
 
@@ -118,21 +124,30 @@ class QuotaNode:
 
     # -- usage mutation -----------------------------------------------------
 
-    def add_usage(self, fr: FlavorResource, val: int) -> None:
+    def add_usage(self, fr: FlavorResource, val: int,
+                  bump: bool = True) -> None:
         """resource_node.go:144. Negative val is not allowed here; use
-        remove_usage (their bubbling rules differ)."""
+        remove_usage (their bubbling rules differ). ``bump=False`` is for
+        simulate/revert pairs whose net usage change is zero — they must
+        not advance ``usage_gen`` or every DRS cache keyed on it would be
+        spuriously invalidated."""
         local_avail = self.local_available(fr)
         self.usage[fr] = sat_add(self.usage.get(fr, 0), val)
+        if bump:
+            self.usage_gen += 1
         if self.parent is not None and val > local_avail:
-            self.parent.add_usage(fr, sat_sub(val, local_avail))
+            self.parent.add_usage(fr, sat_sub(val, local_avail), bump)
 
-    def remove_usage(self, fr: FlavorResource, val: int) -> None:
+    def remove_usage(self, fr: FlavorResource, val: int,
+                     bump: bool = True) -> None:
         """resource_node.go:156."""
         stored_in_parent = sat_sub(self.usage.get(fr, 0), self.local_quota(fr))
         self.usage[fr] = sat_sub(self.usage.get(fr, 0), val)
+        if bump:
+            self.usage_gen += 1
         if stored_in_parent <= 0 or self.parent is None:
             return
-        self.parent.remove_usage(fr, min(val, stored_in_parent))
+        self.parent.remove_usage(fr, min(val, stored_in_parent), bump)
 
     # -- fit predicates -----------------------------------------------------
 
@@ -241,16 +256,20 @@ class DRS:
     dominant_resource: str = ""
     borrowing: bool = False
     borrowed_frs: List[FlavorResource] = field(default_factory=list)
+    _pws: Optional[float] = None  # memoized precise_weighted_share
 
     def is_zero(self) -> bool:
         return self.unweighted_ratio == 0
 
     def precise_weighted_share(self) -> float:
-        if self.is_zero():
-            return 0.0
-        if self.fair_weight == 0:
-            return float("inf")
-        return self.unweighted_ratio / self.fair_weight
+        if self._pws is None:
+            if self.is_zero():
+                self._pws = 0.0
+            elif self.fair_weight == 0:
+                self._pws = float("inf")
+            else:
+                self._pws = self.unweighted_ratio / self.fair_weight
+        return self._pws
 
     def zero_weight_borrows(self) -> bool:
         return self.fair_weight == 0 and not self.is_zero()

@@ -77,21 +77,25 @@ class ClusterQueueSnapshot:
     def usage_for(self, fr: FlavorResource) -> int:
         return self.node.usage.get(fr, 0)
 
-    def add_usage(self, usage: FlavorResourceQuantities) -> None:
+    def add_usage(self, usage: FlavorResourceQuantities,
+                  bump: bool = True) -> None:
         for fr, v in usage.items():
-            self.node.add_usage(fr, v)
+            self.node.add_usage(fr, v, bump)
 
-    def remove_usage(self, usage: FlavorResourceQuantities) -> None:
+    def remove_usage(self, usage: FlavorResourceQuantities,
+                     bump: bool = True) -> None:
         for fr, v in usage.items():
-            self.node.remove_usage(fr, v)
+            self.node.remove_usage(fr, v, bump)
 
     def simulate_usage_addition(self, usage: FlavorResourceQuantities) -> Callable[[], None]:
-        self.add_usage(usage)
-        return lambda: self.remove_usage(usage)
+        """Temporary what-if mutation: reverted by the returned closure,
+        so it leaves ``usage_gen`` untouched (net change is zero)."""
+        self.add_usage(usage, bump=False)
+        return lambda: self.remove_usage(usage, bump=False)
 
     def simulate_usage_removal(self, usage: FlavorResourceQuantities) -> Callable[[], None]:
-        self.remove_usage(usage)
-        return lambda: self.add_usage(usage)
+        self.remove_usage(usage, bump=False)
+        return lambda: self.add_usage(usage, bump=False)
 
     def fits(self, usage: FlavorResourceQuantities) -> bool:
         return all(v <= self.available(fr) for fr, v in usage.items())
@@ -117,20 +121,44 @@ class Snapshot:
     def cluster_queue(self, name: str) -> ClusterQueueSnapshot:
         return self.cluster_queues[name]
 
-    def add_workload(self, info: WorkloadInfo) -> None:
+    def cqs_under_root(self, root) -> List[ClusterQueueSnapshot]:
+        """CQs grouped by cohort-tree root, memoized for the snapshot's
+        lifetime (tree structure is fixed within a cycle): preemption
+        candidate discovery is root-scoped (preemption.go:592) and must
+        not rescan every CQ in the fleet per preemptor."""
+        by_root = getattr(self, "_cqs_by_root", None)
+        if by_root is None:
+            by_root = {}
+            for cq in self.cluster_queues.values():
+                by_root.setdefault(id(cq.node.root()), []).append(cq)
+            self._cqs_by_root = by_root
+        return by_root.get(id(root), [])
+
+    def cq_by_node(self) -> Dict[str, "ClusterQueueSnapshot"]:
+        """Node-name -> CQ snapshot, memoized per snapshot lifetime (the
+        other structural memo beside cqs_under_root): candidate
+        collection resolves tree leaves back to CQ snapshots per
+        preemptor and must not rebuild an O(fleet) map each time."""
+        memo = getattr(self, "_cq_by_node", None)
+        if memo is None:
+            memo = {c.node.name: c for c in self.cluster_queues.values()}
+            self._cq_by_node = memo
+        return memo
+
+    def add_workload(self, info: WorkloadInfo, bump: bool = True) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads[info.key] = info
-        cq.add_usage(info.usage())
+        cq.add_usage(info.usage(), bump)
         for flavor, leaf_usage in info.tas_usage().items():
             tas = self.tas_flavors.get(flavor)
             if tas is not None:
                 for leaf_id, reqs in leaf_usage.items():
                     tas.add_usage(leaf_id, reqs)
 
-    def remove_workload(self, info: WorkloadInfo) -> None:
+    def remove_workload(self, info: WorkloadInfo, bump: bool = True) -> None:
         cq = self.cluster_queues[info.cluster_queue]
         cq.workloads.pop(info.key, None)
-        cq.remove_usage(info.usage())
+        cq.remove_usage(info.usage(), bump)
         for flavor, leaf_usage in info.tas_usage().items():
             tas = self.tas_flavors.get(flavor)
             if tas is not None:
@@ -140,14 +168,16 @@ class Snapshot:
     def simulate_workload_removal(
         self, infos: Iterable[WorkloadInfo]
     ) -> Callable[[], None]:
-        """reference snapshot.go:77 — the preemption oracle's transaction."""
+        """reference snapshot.go:77 — the preemption oracle's transaction.
+        Gen-neutral (bump=False): the simulate/revert pair nets to zero
+        usage, so it must not invalidate ``usage_gen``-keyed DRS caches."""
         infos = list(infos)
         for info in infos:
-            self.remove_workload(info)
+            self.remove_workload(info, bump=False)
 
         def revert() -> None:
             for info in infos:
-                self.add_workload(info)
+                self.add_workload(info, bump=False)
 
         return revert
 

@@ -1,0 +1,200 @@
+"""High-availability manager replication: leader lease + warm standby.
+
+Behavioral analog of the reference's HA story: the scheduler only runs on
+the elected leader (reference pkg/scheduler/scheduler.go:230
+NeedLeaderElection), while non-leader replicas keep their caches warm by
+read-only reconciliation so failover is fast (reference
+pkg/controller/core/leader_aware_reconciler.go:60 — non-leader replicas
+reconcile reads; roletracker labels lead/follow transitions).
+
+The reference delegates durability to etcd (CRD status is the journal) and
+leases to the kube leader-election API. Standalone, the same contract is:
+
+  * ``LeaseStore`` — the lease + journal backend (in-process here; the
+    same interface maps onto any CAS-capable store).
+  * the leader publishes ``Manager.export_state()`` checkpoints and
+    appends every accepted client object to an event journal; the
+    checkpoint truncates the journal (etcd-compaction analog);
+  * followers continuously fold checkpoint+journal into a local standby
+    Manager (read-reconcile) WITHOUT scheduling — admissions are the
+    leader's exclusive write;
+  * on lease expiry a follower promotes: it re-applies the journal tail
+    and starts scheduling from the recovered state.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.manager import Manager
+
+
+@dataclass
+class Lease:
+    """One leader lease record (kube coordination.k8s.io Lease analog)."""
+
+    holder: Optional[str] = None
+    term: int = 0
+    expires_at: float = 0.0
+
+
+class LeaseStore:
+    """Shared lease + checkpoint + journal. In-process reference backend;
+    every mutation is synchronous and linearizable (the CAS the kube
+    leader-election client gets from the apiserver)."""
+
+    def __init__(self, lease_duration_s: float = 15.0) -> None:
+        self.lease = Lease()
+        self.lease_duration_s = lease_duration_s
+        self.checkpoint: Optional[str] = None
+        self.checkpoint_term: int = 0
+        # Journal of (seq, yaml-doc) accepted since the last checkpoint.
+        self.journal: List[Tuple[int, str]] = []
+        self._seq = itertools.count(1)
+
+    # -- lease ---------------------------------------------------------
+
+    def try_acquire(self, identity: str, now: float) -> bool:
+        """Acquire or renew: holder renews unconditionally; others win
+        only after expiry (a new term)."""
+        if self.lease.holder == identity:
+            self.lease.expires_at = now + self.lease_duration_s
+            return True
+        if self.lease.holder is None or now >= self.lease.expires_at:
+            self.lease.holder = identity
+            self.lease.term += 1
+            self.lease.expires_at = now + self.lease_duration_s
+            return True
+        return False
+
+    def is_leader(self, identity: str, now: float) -> bool:
+        return self.lease.holder == identity and now < self.lease.expires_at
+
+    # -- durable state -------------------------------------------------
+
+    def publish_checkpoint(self, state: str, term: int) -> None:
+        self.checkpoint = state
+        self.checkpoint_term = term
+        self.journal = []
+
+    def append_event(self, doc: str) -> int:
+        seq = next(self._seq)
+        self.journal.append((seq, doc))
+        return seq
+
+
+@dataclass
+class RoleTracker:
+    """Lead/follow transition log (reference pkg/util/roletracker)."""
+
+    transitions: List[str] = field(default_factory=list)
+    role: str = "follow"
+
+    def observe(self, leading: bool) -> None:
+        role = "lead" if leading else "follow"
+        if role != self.role:
+            self.role = role
+            self.transitions.append(role)
+
+
+class HAReplica:
+    """One manager replica participating in leader election.
+
+    Drive it with ``tick(now)``; submit client objects with ``submit``
+    (accepted only by the leader — the apiserver would route writes).
+    """
+
+    def __init__(self, identity: str, store: LeaseStore,
+                 manager_kw: Optional[dict] = None,
+                 checkpoint_every: int = 1) -> None:
+        self.identity = identity
+        self.store = store
+        self.manager_kw = dict(manager_kw or {})
+        self.manager = Manager(**self.manager_kw)
+        self.roletracker = RoleTracker()
+        self.checkpoint_every = checkpoint_every
+        self._cycles_since_checkpoint = 0
+        self._applied_seq = 0
+        self._restored_term = 0
+
+    # -- client surface ------------------------------------------------
+
+    def submit(self, obj, now: float) -> bool:
+        """Leader-only write: apply the object and journal it. Returns
+        False when this replica is not the leader (client retries against
+        the current leader)."""
+        if not self.store.is_leader(self.identity, now):
+            return False
+        from kueue_tpu.api.serialization import encode
+        import yaml as _yaml
+
+        from kueue_tpu.api.types import Workload
+
+        if isinstance(obj, Workload):
+            self.manager.create_workload(obj)
+        else:
+            self.manager.apply(obj)
+        self.store.append_event(_yaml.safe_dump(encode(obj),
+                                                sort_keys=False))
+        return True
+
+    # -- replication ---------------------------------------------------
+
+    def _read_reconcile(self) -> None:
+        """Follower: fold the shared checkpoint + journal into the local
+        standby manager (read-only — never schedules, never writes
+        admissions; leader_aware_reconciler.go:60 semantics)."""
+        store = self.store
+        if store.checkpoint is not None and \
+                store.checkpoint_term > self._restored_term:
+            self.manager = Manager.restore_state(
+                store.checkpoint, **self.manager_kw
+            )
+            self._restored_term = store.checkpoint_term
+            self._applied_seq = 0
+        from kueue_tpu.api.serialization import load_manifests
+        from kueue_tpu.api.types import Workload
+
+        for seq, doc in store.journal:
+            if seq <= self._applied_seq:
+                continue
+            for obj in load_manifests(doc):
+                if isinstance(obj, Workload):
+                    # Pending client submissions re-enter the queues; the
+                    # leader's admission outcomes arrive via checkpoints.
+                    if obj.key not in self.manager.workloads:
+                        self.manager.create_workload(obj)
+                else:
+                    self.manager.apply(obj)
+            self._applied_seq = seq
+
+    def tick(self, now: float, max_cycles: int = 10) -> dict:
+        """One control-loop beat: renew/contend the lease, then act the
+        role. Returns {"role", "admitted": [...]} for observability."""
+        leading = self.store.try_acquire(self.identity, now)
+        admitted: List[str] = []
+        if leading and self.roletracker.role != "lead":
+            # Fresh promotion: recover the latest durable state first.
+            self._read_reconcile()
+        self.roletracker.observe(leading)
+        if leading:
+            for _ in range(max_cycles):
+                result = self.manager.schedule()
+                admitted.extend(result.admitted)
+                if not result.admitted and not result.preempted:
+                    break
+            self._cycles_since_checkpoint += 1
+            if self._cycles_since_checkpoint >= self.checkpoint_every:
+                self.store.publish_checkpoint(
+                    self.manager.export_state(), self.store.lease.term
+                )
+                self._cycles_since_checkpoint = 0
+        else:
+            self._read_reconcile()
+        return {"role": self.roletracker.role, "admitted": admitted}
+
+    def stop(self) -> None:
+        """Crash/drain this replica: it simply stops ticking; the lease
+        expires on its own (no explicit release — the crash path)."""

@@ -123,6 +123,10 @@ class CycleArrays(NamedTuple):
     w_tas_required: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_unconstrained: Optional[jnp.ndarray] = None  # bool[W]
     w_tas_invalid: Optional[jnp.ndarray] = None  # bool[W] always-infeasible
+    # Balanced placement requested (tr.balanced or the global gate); None
+    # when no entry this cycle is balanced, so the common program never
+    # compiles the subset-enumeration pipeline.
+    w_tas_balanced: Optional[jnp.ndarray] = None  # bool[W]
     # Per-entry filtered leaf capacity (selector/taint matching; None when
     # no entry this cycle needs node filtering): i64[W, D, R+1] rows are
     # meaningful where w_tas_has_cap; other entries use the topology cap.
@@ -397,7 +401,15 @@ def encode_cycle(
         s_n = max(len(sl) for sl in wl_slots)
         s_n = 1 << (s_n - 1).bit_length()  # power-of-two compile bucket
 
-    w = _round_up(len(device_wls), 8) if w_pad == 0 else w_pad
+    # Power-of-two compile bucket (min 16): the W axis shrinks cycle over
+    # cycle as entries admit, and an exact-size pad would recompile every
+    # kernel per cycle; bucketing reuses one compiled program across
+    # cycles (and across same-bucket scenarios in one process). Padding
+    # rows are inert (w_active=False), identical to the old %8 rows.
+    if w_pad == 0:
+        w = max(16, 1 << max(len(device_wls) - 1, 0).bit_length())
+    else:
+        w = w_pad
     w_cq = np.zeros(w, dtype=np.int32)
     w_req = np.zeros((w, r), dtype=np.int64)
     w_elig = np.zeros((w, f), dtype=bool)
@@ -737,6 +749,7 @@ def _encode_tas(
     w_tas_required = np.zeros(w, bool)
     w_tas_uncon = np.zeros(w, bool)
     w_tas_invalid = np.zeros(w, bool)
+    w_tas_bal = np.zeros(w, bool)
     # Per-entry filtered leaf capacity (host _matching_capacity analog):
     # required whenever the fleet has tainted nodes or the entry carries a
     # node selector / tolerations — capacity must come only from nodes the
@@ -745,6 +758,9 @@ def _encode_tas(
     w_tas_has_cap = None
     fleet_tainted = [tas.has_tainted_nodes for tas in tas_snaps]
     row_of_flavor = {name: t for t, name in enumerate(flavor_names)}
+    from kueue_tpu.utils import features as _bfeat
+
+    bal_gate_on = _bfeat.enabled("TASBalancedPlacement")
 
     for i, info in enumerate(device_wls):
         ps = info.obj.pod_sets[0]
@@ -775,6 +791,9 @@ def _encode_tas(
         w_tas_slice_size[i] = ssz
         w_tas_required[i] = required
         w_tas_uncon[i] = uncon
+        w_tas_bal[i] = (
+            (tr.balanced or bal_gate_on) and not required and not uncon
+        )
         if ssz > 0 and ps.count % ssz != 0:
             w_tas_invalid[i] = True
         for t, tas in enumerate(tas_snaps):
@@ -918,6 +937,8 @@ def _encode_tas(
         w_tas_unconstrained=np.asarray(w_tas_uncon),
         w_tas_invalid=np.asarray(w_tas_invalid),
     )
+    if w_tas_bal.any():
+        fields["w_tas_balanced"] = np.asarray(w_tas_bal)
     if w_tas_cap is not None:
         fields["w_tas_cap"] = w_tas_cap
         fields["w_tas_has_cap"] = w_tas_has_cap
@@ -1148,6 +1169,55 @@ def _workload_slots(info: WorkloadInfo, cqs) -> Optional[List[AssignSlot]]:
     return slots
 
 
+def _balanced_widths_ok(tas, tr) -> bool:
+    """Device balanced placement enumerates optimal-domain-set DP inputs
+    as 2^BMAX subsets (ops/tas_balanced.py); an entry is device-eligible
+    only when every DP input on this topology fits in BMAX domains: the
+    widest sibling group at the requested level (DP over the pruned
+    group, reference selectOptimalDomainSetToFit :82) and, when the
+    request sits above the slice level, the widest set of
+    requested-level+1 descendants under one group (the placement DP runs
+    over children of the selected set, :293)."""
+    from kueue_tpu.ops.tas_balanced import BMAX as _BMAX
+
+    keys = tas.level_keys
+    if tr.preferred_level not in keys:
+        return True  # flavor infeasible for the entry: never placed here
+    rl = keys.index(tr.preferred_level)
+    if tr.slice_required_level is not None:
+        if tr.slice_required_level not in keys:
+            return True
+        sl = keys.index(tr.slice_required_level)
+    else:
+        sl = len(keys) - 1
+    if rl > sl:
+        return True
+
+    def _max_group(level: int, hops_up: int) -> int:
+        counts: Dict[int, int] = {}
+        for d in tas.domains_per_level[level]:
+            anc = d
+            for _ in range(hops_up):
+                anc = anc.parent
+            counts[id(anc)] = counts.get(id(anc), 0) + 1
+        return max(counts.values(), default=0)
+
+    if rl == 0:
+        gw = len(tas.domains_per_level[0])
+    else:
+        gw = _max_group(rl, 1)
+    if gw > _BMAX:
+        return False
+    if rl < sl:
+        if rl == 0:
+            g2 = len(tas.domains_per_level[1])
+        else:
+            g2 = _max_group(rl + 1, 2)
+        if g2 > _BMAX:
+            return False
+    return True
+
+
 def _device_compatible(
     info: WorkloadInfo,
     snapshot: Snapshot,
@@ -1212,10 +1282,28 @@ def _device_compatible(
         tr = ps.topology_request
         if not preempt:
             return False
-        # Device TAS class: no balanced placement, no delayed placement
-        # (multi-layer slices run on device via per-level units).
-        if tr.balanced:
-            return False
+        # Device TAS class: no delayed placement (multi-layer slices run
+        # on device via per-level units; balanced placement runs on
+        # device when the optimal-domain-set DP widths fit the subset
+        # enumeration — see _balanced_widths_ok).
+        from kueue_tpu.utils import features as _bfeat2
+
+        balanced_applies = (
+            (tr.balanced or _bfeat2.enabled("TASBalancedPlacement"))
+            and tr.required_level is None
+            and tr.preferred_level is not None
+            and not tr.unconstrained
+        )
+        if balanced_applies:
+            # Inner slice layers would flow through the prune/refill with
+            # the host's (reference-exact) non-rounded fillInCountsHelper
+            # — keep balanced x multi-layer on the host.
+            if getattr(tr, "slice_layers", None):
+                return False
+            for fq in cqs.spec.resource_groups[0].flavors:
+                tas2 = snapshot.tas_flavors.get(fq.name)
+                if tas2 is not None and not _balanced_widths_ok(tas2, tr):
+                    return False
         if delay_tas_fn is not None and delay_tas_fn(cqs, info):
             return False
         # Every topology-backed flavor of the CQ must be device-encoded.

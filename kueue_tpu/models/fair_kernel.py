@@ -326,18 +326,23 @@ def fair_admit_scan(
             )
 
             def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_,
-                          sz_):
+                          sz_, bal_=None):
                 return _tas_place.place(
                     arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-                    cap_override=cap_, sizes=sz_,
+                    cap_override=cap_, sizes=sz_, balanced=bal_,
                 )
 
-            tas_feas, tas_take = jax.vmap(place_one)(
+            place_args = (
                 t_idx_w, arrays.w_tas_req, arrays.w_tas_count,
                 arrays.w_tas_slice_size, sl_w, rl_w,
                 arrays.w_tas_required, arrays.w_tas_unconstrained,
                 cap_w, sizes_w,
+            )
+            if arrays.w_tas_balanced is not None:
+                place_args = place_args + (arrays.w_tas_balanced,)
+            tas_feas, tas_take = jax.vmap(place_one)(
+                *place_args
             )  # [W], [W, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:

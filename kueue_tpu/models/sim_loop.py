@@ -40,7 +40,7 @@ class SimOutputs(NamedTuple):
 def make_sim_loop(s_max: int, max_rounds: int = 100000,
                   kernel: str = "grouped",
                   n_levels: int = quota_ops.MAX_DEPTH + 1,
-                  interpret: bool = False):
+                  interpret: bool = False, mesh=None):
     """Build the jittable simulator. ``s_max`` is the per-tree admission
     scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
     admission pass: "grouped" (the sequential per-tree scan),
@@ -130,7 +130,8 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             else:
                 order = bs.admission_order(a, nom)
                 _u, admit, _pre, _tk = bs.admit_scan_grouped(
-                    a, ga, nom, usage, order, s_max, n_levels=n_levels
+                    a, ga, nom, usage, order, s_max, n_levels=n_levels,
+                    mesh=mesh,
                 )
 
             newly = admit & pending

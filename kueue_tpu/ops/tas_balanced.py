@@ -78,6 +78,43 @@ def greedy_eval(slice_vals, state_vals, cand, target):
     return fits, n_sel, last_slice
 
 
+def seg_greedy_eval(slice_vals, state_vals, cand, grp, target):
+    """Per-sibling-group evaluateGreedyAssignment :28 (no leaders):
+    every group (label in ``grp``) walks its candidates in the host
+    BestFit order (-slice_state, state, index), taking whole positive
+    slice states until ``target`` is covered. Returns (fits bool[D],
+    n_sel i64[D], last_slice i64[D]) indexed by GROUP id — position g
+    holds group g's result; positions that are no group's id hold
+    garbage and must be masked by the caller."""
+    d_n = slice_vals.shape[0]
+    iota = jnp.arange(d_n)
+    usable = cand & (slice_vals > 0)
+    order = jnp.lexsort(
+        (iota, state_vals, -slice_vals, jnp.where(usable, 0, 1), grp)
+    )
+    v = jnp.where(usable, slice_vals, 0)[order]
+    u = usable[order]
+    g = grp[order]
+    head = jnp.concatenate([jnp.ones(1, bool), g[1:] != g[:-1]])
+    excl_glob = jnp.cumsum(v) - v
+    seg_head = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(head, iota, -1)
+    )
+    excl = excl_glob - excl_glob[seg_head]
+    taken = u & (excl < target)
+    total = jnp.zeros(d_n, jnp.int64).at[g].add(
+        jnp.where(taken, v, 0), mode="drop"
+    )
+    nsel = jnp.zeros(d_n, jnp.int64).at[g].add(
+        taken.astype(jnp.int64), mode="drop"
+    )
+    last = jnp.full(d_n, _INF).at[g].min(
+        jnp.where(taken, v, _INF), mode="drop"
+    )
+    last = jnp.where(nsel > 0, last, 0)
+    return total >= target, nsel, last
+
+
 def optimal_subset(state_vals, slice_vals, cand, n_sel, target_state,
                    rank):
     """selectOptimalDomainSetToFit :82 (no leaders) as subset
@@ -95,8 +132,14 @@ def optimal_subset(state_vals, slice_vals, cand, n_sel, target_state,
     ok_bit = jnp.zeros(BMAX, bool).at[rank_c].max(
         participate & (slice_vals > 0), mode="drop"
     )
-    sums = _BITS.astype(jnp.int64) @ state_by_bit  # [2^BMAX]
-    bad = (_BITS @ (~ok_bit).astype(jnp.int32)) > 0
+    # Subset sums by doubling (mask m's low bit b splits [0, 2^(b+1)) into
+    # copies without/with bit b) — BMAX concats replace a [2^BMAX, BMAX]
+    # contraction, which XLA compiles and runs far faster under vmap.
+    sums = jnp.zeros(1, jnp.int64)
+    bad = jnp.zeros(1, bool)
+    for b in range(BMAX):
+        sums = jnp.concatenate([sums, sums + state_by_bit[b]])
+        bad = jnp.concatenate([bad, bad | ~ok_bit[b]])
     # Host-DP reachability: the largest proper prefix (subset minus its
     # highest-rank member) must stay below the target, else the DP would
     # have stopped extending it.

@@ -2,11 +2,12 @@
 
 Tensor twin of tas/snapshot.py find_topology_assignment (reference
 tas_flavor_snapshot.go:943 findTopologyAssignment) for the device-eligible
-class: no leaders, no balanced placement, no inner slice layers, no
-per-workload node selectors/taint filtering (encode gates those to the
-host path). Supports required / preferred (walk-up + top-level gather) /
-unconstrained modes and the outer slice constraint (sliceSize pinned at a
-sliceRequiredLevel) — the long-context/ICI-critical case.
+class: no leaders (encode gates those to the host path; balanced
+placement runs on device via ``_balanced_place`` when the DP widths fit
+BMAX, and inner slice layers via per-level ``sizes``). Supports required
+/ preferred (walk-up + top-level gather) / unconstrained modes and the
+outer slice constraint (sliceSize pinned at a sliceRequiredLevel) — the
+long-context/ICI-critical case.
 
 Layout: every TAS flavor's topology becomes right-padded per-level arrays
 (axis D = max domains per level across flavors, LMAX static levels), with
@@ -200,6 +201,238 @@ def entry_leaf_cap(arrays, t_idx, w=None):
     return jnp.where(has[:, None, None], cap, leaf)
 
 
+def _balanced_place(
+    topo: TASDeviceTopo,
+    t: jnp.ndarray,
+    states: jnp.ndarray,  # i64[LMAX, D] phase-1 pod states
+    sls: jnp.ndarray,  # i64[LMAX, D] phase-1 slice states
+    rl: jnp.ndarray,  # i32 requested level
+    sl: jnp.ndarray,  # i32 slice level
+    ss: jnp.ndarray,  # i64 slice size (>=1)
+    slice_count: jnp.ndarray,  # i64
+    count: jnp.ndarray,  # i64
+    leaf_l: jnp.ndarray,  # i32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device twin of the host balanced-placement pipeline (reference
+    tas_balanced_placement.go:232 findBestDomainsForBalancedPlacement +
+    :293 applyBalancedPlacementAlgorithm + :150
+    placeSlicesOnDomainsBalanced; host tas/snapshot.py
+    _find_best_domains_balanced/_apply_balanced_placement), no leaders,
+    no inner slice layers (encode gates both to the host).
+
+    Returns (ok bool, leaf_take i64[D] pods). Every sibling group at the
+    requested level is evaluated in parallel with segmented reductions;
+    the two optimal-domain-set DPs run as 2^BMAX subset enumerations
+    (encode guarantees the DP input widths fit in BMAX for balanced
+    entries — wider entries stay on the host path)."""
+    from kueue_tpu.ops import tas_balanced as _bal
+
+    d_n = states.shape[1]
+    iota = jnp.arange(d_n)
+
+    def valid_at(l):
+        return iota < topo.level_size[t, jnp.clip(l, 0, LMAX - 1)]
+
+    rl_c = jnp.clip(rl, 0, LMAX - 1)
+    rl1_c = jnp.clip(rl + 1, 0, LMAX - 1)
+    valid_rl = valid_at(rl)
+    has_children = rl < leaf_l
+    valid_rl1 = valid_at(rl + 1) & has_children
+
+    # Sibling groups at the requested level: one group per level-(rl-1)
+    # parent; the whole level is a single group when rl == 0
+    # (findBestDomainsForBalancedPlacement :238-247).
+    grp_rl = jnp.where(rl > 0, topo.parent_idx[t, rl_c], 0)
+    pidx_rl1 = topo.parent_idx[t, rl1_c]
+    grp_rl1 = grp_rl[pidx_rl1]
+
+    # Greedy evaluation runs one level below the request when the slice
+    # level is deeper (:255 lowerLevelDomains), else on the group itself.
+    lower_is_child = rl < sl
+    st_rl = jnp.where(valid_rl, states[rl_c], 0)
+    sl_rl = jnp.where(valid_rl, sls[rl_c], 0)
+    st_rl1 = jnp.where(valid_rl1, states[rl1_c], 0)
+    sl_rl1 = jnp.where(valid_rl1, sls[rl1_c], 0)
+    grp_low = jnp.where(lower_is_child, grp_rl1, grp_rl)
+    low_valid = jnp.where(lower_is_child, valid_rl1, valid_rl)
+    st_low = jnp.where(lower_is_child, st_rl1, st_rl)
+    sl_low = jnp.where(lower_is_child, sl_rl1, sl_rl)
+
+    fits_g, nsel_g, last_g = _bal.seg_greedy_eval(
+        sl_low, st_low, low_valid, grp_low, slice_count
+    )
+    # balanceThresholdValue :66 (no leaders).
+    thr_g = jnp.minimum(
+        slice_count // jnp.maximum(nsel_g, 1), last_g
+    )
+    thr_g = jnp.where(fits_g & (nsel_g > 0), thr_g, 0)
+
+    # pruneDomainsBelowThreshold :363: drop children below the group's
+    # threshold, refill the candidates from the survivors, then drop
+    # candidates that fell below the threshold themselves.
+    thr_child = thr_g[grp_rl1]
+    keep_child = valid_rl1 & (sl_rl1 >= thr_child)
+    cand_state2 = jnp.where(
+        has_children,
+        jnp.zeros(d_n, jnp.int64).at[pidx_rl1].add(
+            jnp.where(keep_child, st_rl1, 0), mode="drop"
+        ),
+        st_rl,
+    )
+    child_slice_sum = jnp.zeros(d_n, jnp.int64).at[pidx_rl1].add(
+        jnp.where(keep_child, sl_rl1, 0), mode="drop"
+    )
+    cand_sls2 = jnp.where(
+        rl == sl, cand_state2 // ss,
+        jnp.where(has_children, child_slice_sum, sl_rl),
+    )
+    keep_cand = valid_rl & (cand_sls2 >= thr_g[grp_rl])
+    cand_state3 = jnp.where(keep_cand, cand_state2, 0)
+    cand_sls3 = jnp.where(keep_cand, cand_sls2, 0)
+
+    fits2_g, count2_g, _ = _bal.seg_greedy_eval(
+        cand_sls3, cand_state3, valid_rl, grp_rl, slice_count
+    )
+
+    # Best group: threshold desc, post-prune count asc, group order
+    # (:276-287 keeps the first winner on ties).
+    ok_g = fits_g & (thr_g >= 1) & fits2_g
+    ordg = jnp.lexsort(
+        (iota, count2_g, -thr_g, jnp.where(ok_g, 0, 1))
+    )
+    win_g = ordg[0]
+    any_g = ok_g[win_g]
+    thr = thr_g[win_g]
+
+    # applyBalancedPlacementAlgorithm :293. When the request sits above
+    # the slice level, a first DP (entropy-prioritized ordering,
+    # selectOptimalDomainSetToFit :82) picks the minimal candidate set
+    # and the placement happens one level down on its children.
+    member = valid_rl & (grp_rl == win_g)
+    kc_full = keep_child & keep_cand[pidx_rl1]
+    s_child = jnp.where(kc_full, st_rl1, 0).astype(jnp.float64)
+    log_terms = jnp.where(
+        s_child > 0, s_child * jnp.log2(jnp.maximum(s_child, 1.0)), 0.0
+    )
+    tot_c = jnp.zeros(d_n, jnp.float64).at[pidx_rl1].add(
+        s_child, mode="drop"
+    )
+    sum_t = jnp.zeros(d_n, jnp.float64).at[pidx_rl1].add(
+        log_terms, mode="drop"
+    )
+    entropy = jnp.where(
+        tot_c > 0,
+        jnp.log2(jnp.maximum(tot_c, 1.0)) - sum_t / jnp.maximum(tot_c, 1.0),
+        0.0,
+    )
+    order1 = jnp.lexsort(
+        (iota, -entropy, -cand_sls3, jnp.where(member, 0, 1))
+    )
+    rank1 = jnp.zeros(d_n, jnp.int32).at[order1].set(
+        jnp.arange(d_n, dtype=jnp.int32)
+    )
+    rank1 = jnp.where(member, rank1, _bal.BMAX)
+    n1 = count2_g[win_g].astype(jnp.int32)
+    found1, sel1 = _bal.optimal_subset(
+        cand_state3, cand_sls3, member, n1, slice_count * ss, rank1
+    )
+
+    # The placement set (curr): children of the DP-selected candidates
+    # when the request is above the slice level, else the pruned group.
+    curr_mask = jnp.where(
+        lower_is_child, valid_rl1 & sel1[pidx_rl1], member
+    )
+    st_low_p = jnp.where(
+        lower_is_child, jnp.where(kc_full, st_rl1, 0), cand_state3
+    )
+    sl_low_p = jnp.where(
+        lower_is_child, jnp.where(kc_full, sl_rl1, 0), cand_sls3
+    )
+
+    # placeSlicesOnDomainsBalanced :150: second DP in level-values order.
+    zero_grp = jnp.zeros(d_n, jnp.int32)
+    fits_c_g, n2_g, _ = _bal.seg_greedy_eval(
+        sl_low_p, st_low_p, curr_mask, zero_grp, slice_count
+    )
+    fits_c = fits_c_g[0]
+    n2 = n2_g[0].astype(jnp.int32)
+    rank2 = jnp.cumsum(curr_mask.astype(jnp.int32)) - 1
+    rank2 = jnp.where(curr_mask, rank2, _bal.BMAX)
+    found2, sel2 = _bal.optimal_subset(
+        st_low_p, sl_low_p, curr_mask, n2, slice_count * ss, rank2
+    )
+
+    # Every selected domain gets the threshold; extras distribute
+    # front-to-back in (-slice_state, state, level_values) order.
+    n_res = jnp.sum(sel2).astype(jnp.int64)
+    thr_ok = slice_count >= n_res * thr
+    order3 = jnp.lexsort(
+        (iota, st_low_p, -sl_low_p, jnp.where(sel2, 0, 1))
+    )
+    extras = slice_count - n_res * thr
+    takes_s, leftover = _bal.distribute_extras(
+        sl_low_p[order3], sel2[order3], thr, extras
+    )
+    take_low = jnp.zeros(d_n, jnp.int64).at[order3].set(takes_s) * ss
+
+    ok = (
+        any_g
+        & jnp.where(lower_is_child, found1, True)
+        & fits_c & found2 & thr_ok & (leftover == 0)
+    )
+
+    # Pruned per-level states for the descent: the prune clears whole
+    # subtrees, so a domain below the prune level survives iff its
+    # ancestor chain does.
+    keep_levels = [valid_at(0)]
+    for l in range(1, LMAX):
+        pidx_l = topo.parent_idx[t, l]
+        prev = keep_levels[l - 1][pidx_l]
+        at_prune = l == rl + 1
+        k_here = (jnp.where(valid_at(l), sls[l], 0) >= thr) \
+            & keep_cand[pidx_l]
+        keep_levels.append(
+            valid_at(l) & jnp.where(at_prune, k_here, prev)
+        )
+    states_p = jnp.stack([
+        jnp.where(keep_levels[l], states[l], 0) for l in range(LMAX)
+    ])
+    sls_p = jnp.stack([
+        jnp.where(keep_levels[l], sls[l], 0) for l in range(LMAX)
+    ])
+
+    # Descent: per-parent distribution at every level (the balanced path
+    # skips the free slice-redistribution loop — snapshot.py:1132), in
+    # OUTER slice units above/at the slice level (reference :1104) and in
+    # pods below it. Walk order stays the phase-1 (pruned) slice states;
+    # values/targets rescale by the slice size (snapshot.py:1153-1167).
+    low_l = jnp.where(lower_is_child, rl + 1, rl)
+    take_b = take_low
+    cur = low_l
+    for _ in range(LMAX - 1):
+        child_level = cur + 1
+        clc = jnp.clip(child_level, 0, LMAX - 1)
+        active = child_level <= leaf_l
+        pidx_c = topo.parent_idx[t, clc]
+        ptake = take_b[pidx_c]
+        cvalid = valid_at(child_level) & (ptake > 0)
+        sp = states_p[clc]
+        slp = sls_p[clc]
+        use_slices = child_level <= sl
+        values = jnp.where(use_slices, sp // ss, sp)
+        target = jnp.where(use_slices, ptake // ss, ptake)
+        nt = segmented_greedy(values, cvalid, pidx_c, target, sp, slp)
+        nt = jnp.where(use_slices, nt * ss, nt)
+        take_b = jnp.where(active, nt, take_b)
+        cur = jnp.where(active, child_level, cur)
+
+    # Under-placement safety net (host snapshot.py:1177-1190): refuse a
+    # short gang instead of admitting fewer pods than requested.
+    leaf_total = jnp.sum(jnp.where(valid_at(leaf_l), take_b, 0))
+    ok = ok & (leaf_total == count)
+    return ok, jnp.where(valid_at(leaf_l), take_b, 0)
+
+
 def place(
     topo: TASDeviceTopo,
     t: jnp.ndarray,  # i32 flavor row
@@ -213,6 +446,7 @@ def place(
     unconstrained: jnp.ndarray,  # bool
     cap_override: jnp.ndarray = None,  # i64[D, R] entry's filtered leaf cap
     sizes: jnp.ndarray = None,  # i64[LMAX] inner slice unit per level
+    balanced: jnp.ndarray = None,  # bool: balanced placement requested
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (feasible bool, leaf_take i64[D] pods per leaf domain).
 
@@ -377,6 +611,22 @@ def place(
     # happened (slice_level == leaf and start == leaf handled by at_slice).
     leaf_take = jnp.where(in_pods, take, take * ss)
     leaf_take = jnp.where(feasible & valid_at(leaf_l), leaf_take, 0)
+    # Under-placement safety net (host snapshot.py:1177-1190): a gang
+    # shorter than requested is a placement failure, not an admission.
+    feasible = feasible & (jnp.sum(leaf_take) == count)
+    leaf_take = jnp.where(feasible, leaf_take, 0)
+
+    if balanced is not None:
+        # Balanced placement wins over the standard path when it succeeds
+        # (host snapshot.py:1099-1125); on failure the standard result
+        # above stands (reference falls back to BestFit).
+        bal_ok, bal_take = _balanced_place(
+            topo, t, states, sls, req_level, slice_level, ss,
+            slice_count, count, leaf_l,
+        )
+        bal_sel = balanced & ~required & ~unconstrained & bal_ok
+        feasible = jnp.where(bal_sel, True, feasible)
+        leaf_take = jnp.where(bal_sel, bal_take, leaf_take)
     return feasible, leaf_take
 
 
@@ -394,6 +644,20 @@ def feasible_only(
     cap_override: jnp.ndarray = None,
     sizes: jnp.ndarray = None,
 ) -> jnp.ndarray:
+    """Feasibility-only probe. Deliberately ignores balanced placement:
+    a balanced success requires one sibling group to cover the whole
+    request, which implies the standard preferred-mode walk-up/top-gather
+    covers it too, so entry FEASIBILITY is identical on both paths (the
+    host falls back to BestFit on balanced failure, snapshot.py:1119) —
+    only the chosen domains differ, which feasibility probes (nominate,
+    preemption oracles) never see. The under-placement guard in place()
+    does not break this: on the STANDARD path the phase-1 slice states
+    are true sums of slice-level counts (no state//sliceSize
+    re-derivation above the slice level), so the greedy descent always
+    realizes the full count — the host documents its safety net as
+    reachable only via the balanced descent (tas/snapshot.py:1177).
+    Skipping balanced here keeps the 2^BMAX subset enumeration out of
+    the W-wide vmaps."""
     f, _ = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
                  req_level, required, unconstrained,
                  cap_override=cap_override, sizes=sizes)

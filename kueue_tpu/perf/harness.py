@@ -365,6 +365,16 @@ def check(result: RunResult, rangespec: dict) -> List[str]:
             f"virtual wall {result.virtual_wall_s*1000:.0f}ms > "
             f"maxWallMs {max_wall_ms}"
         )
+    # Real scheduling-compute wall bound (reference rangespecs bound the
+    # actual run wall, configs/baseline/rangespec.yaml:7-9 — the virtual
+    # clock alone would hide a slow scheduler).
+    max_sched_ms = cmd.get("maxSchedulingWallMs")
+    if max_sched_ms is not None and \
+            result.scheduling_wall_s * 1000 > max_sched_ms:
+        violations.append(
+            f"scheduling wall {result.scheduling_wall_s*1000:.0f}ms > "
+            f"maxSchedulingWallMs {max_sched_ms}"
+        )
     for cq_class, floor in (
         rangespec.get("clusterQueueClassesMinUsage") or {}
     ).items():

@@ -105,8 +105,8 @@ def _find_candidates(ctx, satisfies_policy, uses_frs) -> List[WorkloadInfo]:
                 out.append(wl)
     if cq.has_parent() and p.reclaim_within_cohort != PreemptionPolicy.NEVER:
         root = cq.node.root()
-        for other in ctx.snapshot.cluster_queues.values():
-            if other.name == cq.name or other.node.root() is not root:
+        for other in ctx.snapshot.cqs_under_root(root):
+            if other.name == cq.name:
                 continue
             if not _cq_is_borrowing(other, ctx.frs_need_preemption):
                 continue
@@ -141,6 +141,14 @@ class _DRSCache:
     def invalidate(self) -> None:
         self._cache.clear()
 
+    def invalidate_path(self, cq: ClusterQueueSnapshot) -> None:
+        """A workload removal/addition on ``cq`` only mutates usage on
+        its CQ→root path; DRS of every other node is untouched (it reads
+        only the node's own usage plus static quota config)."""
+        self._cache.pop(id(cq.node), None)
+        for anc in cq.path_parent_to_root():
+            self._cache.pop(id(anc), None)
+
 
 class _Ordering:
     """TargetClusterQueueOrdering (ordering.go)."""
@@ -149,7 +157,19 @@ class _Ordering:
                  drs_cache: Optional[_DRSCache] = None):
         self.ctx = ctx
         self.preemptor_cq: ClusterQueueSnapshot = ctx.preemptor_cq
-        self.ordering_key = ordering_key
+        # The key is a pure function of (workload, preemptor CQ, now) —
+        # all fixed for this ordering's lifetime — so memoize it: the
+        # tie-break in _next_target recomputes it per comparison.
+        key_memo: Dict[str, object] = {}
+
+        def memo_key(wl, cq_name, now):
+            k = key_memo.get(wl.key)
+            if k is None:
+                k = ordering_key(wl, cq_name, now)
+                key_memo[wl.key] = k
+            return k
+
+        self.ordering_key = memo_key
         self.drs = drs_cache or _DRSCache()
         self.preemptor_ancestors = set(
             id(n) for n in self.preemptor_cq.path_parent_to_root()
@@ -284,7 +304,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
         if cand_cq is ctx.preemptor_cq:
             wl = ordering.pop_workload(cand_cq.name)
             ctx.snapshot.remove_workload(wl)
-            drs.invalidate()
+            drs.invalidate_path(cand_cq)
             targets.append(Target(wl, IN_CLUSTER_QUEUE_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets, retry
@@ -293,7 +313,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
         if preemptor_within_nominal:
             wl = ordering.pop_workload(cand_cq.name)
             ctx.snapshot.remove_workload(wl)
-            drs.invalidate()
+            drs.invalidate_path(cand_cq)
             targets.append(Target(wl, IN_COHORT_RECLAMATION_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets, retry
@@ -318,7 +338,7 @@ def _run_first_strategy(ctx, candidates, strategy, Target, ordering_key):
                 removal_memo[mkey] = target_new
             if strategy(preemptor_new, target_old, target_new):
                 ctx.snapshot.remove_workload(wl)
-                drs.invalidate()
+                drs.invalidate_path(cand_cq)
                 targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
                 if _workload_fits_fair(ctx):
                     return True, targets, retry
@@ -339,7 +359,7 @@ def _run_second_strategy(ctx, retry_candidates, targets, Target, ordering_key):
         wl = ordering.pop_workload(cand_cq.name)
         if _strategy_s2b(preemptor_new, target_old, DRS()):
             ctx.snapshot.remove_workload(wl)
-            ordering.drs.invalidate()
+            ordering.drs.invalidate_path(cand_cq)
             targets.append(Target(wl, IN_COHORT_FAIR_SHARING_REASON))
             if _workload_fits_fair(ctx):
                 return True, targets

@@ -247,9 +247,8 @@ class Preemptor:
         ):
             return hierarchy, priority_c, same_queue
 
-        cq_by_node: Dict[str, ClusterQueueSnapshot] = {
-            c.node.name: c for c in ctx.snapshot.cluster_queues.values()
-        }
+        cq_by_node: Dict[str, ClusterQueueSnapshot] = \
+            ctx.snapshot.cq_by_node()
 
         def collect_in_subtree(
             cohort: QuotaNode,
@@ -477,8 +476,8 @@ def make_oracle(
         if cq.has_parent() and \
                 p.reclaim_within_cohort != PreemptionPolicy.NEVER:
             root = cq.node.root()
-            for other in snapshot.cluster_queues.values():
-                if other.name == cq.name or other.node.root() is not root:
+            for other in snapshot.cqs_under_root(root):
+                if other.name == cq.name:
                     continue
                 if other.node.is_within_nominal_in({fr}):
                     continue

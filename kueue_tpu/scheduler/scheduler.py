@@ -399,7 +399,14 @@ class Scheduler:
         return iter(sorted(entries, key=key))
 
     def _fair_iterator(self, entries: List[Entry], snapshot: Snapshot):
-        """Fair-sharing tournament (reference fair_sharing_iterator.go)."""
+        """Fair-sharing tournament (reference fair_sharing_iterator.go).
+
+        computeDRS is incremental: the DRS chain of an entry is a pure
+        function of the usage of the nodes on its CQ→root path (plus its
+        own simulated usage), so each chain is cached and revalidated
+        against those nodes' ``usage_gen`` counters — a winner's
+        admission only invalidates chains that share path nodes with it,
+        instead of recomputing every remaining entry per pop."""
         cq_to_entry: Dict[str, Entry] = {
             e.info.cluster_queue: e for e in entries
         }
@@ -407,33 +414,77 @@ class Scheduler:
         def assignment_usage(e: Entry):
             return e.assignment.usage if e.assignment else {}
 
-        def pop_one() -> Entry:
-            # Any CQ without a cohort goes directly.
-            for cq_name, e in cq_to_entry.items():
-                cqs = snapshot.cluster_queues[cq_name]
-                if not cqs.has_parent():
-                    del cq_to_entry[cq_name]
-                    return e
-            some_cq = next(iter(cq_to_entry))
-            root = snapshot.cluster_queues[some_cq].node.root()
+        # Cohort-less CQs pop first, in entry order (no tournament).
+        cohortless: List[str] = [
+            name for name, e in cq_to_entry.items()
+            if not snapshot.cluster_queues[name].has_parent()
+        ]
 
-            # computeDRS: per (ancestor-cohort, workload) DRS with the
-            # workload's usage simulated in.
-            drs_values: Dict[Tuple[int, str], object] = {}
-            for cq_name, e in cq_to_entry.items():
-                cqs = snapshot.cluster_queues[cq_name]
-                if cqs.node.root() is not root:
-                    continue
-                revert = cqs.simulate_usage_addition(assignment_usage(e))
+        # Per-root buckets so one pop only touches its own cohort tree
+        # (the reference's computeDRS is also root-scoped); `order` + a
+        # skip pointer preserve the original pick-first-remaining order.
+        order: List[str] = list(cq_to_entry)
+        pos = 0
+        buckets: Dict[int, Dict[str, Entry]] = {}
+        root_of: Dict[str, object] = {}
+        for name, e in cq_to_entry.items():
+            cqs = snapshot.cluster_queues[name]
+            if cqs.has_parent():
+                r = cqs.node.root()
+                root_of[name] = r
+                buckets.setdefault(id(r), {})[name] = e
+
+        # cq_name -> (dep_nodes, dep_gens, {id(ancestor): DRS}) where the
+        # DRS stored at an ancestor is the share of the path node just
+        # below it (with the entry's usage simulated in).
+        chain_cache: Dict[str, tuple] = {}
+
+        def chain_for(cq_name: str, e: Entry) -> Dict[int, object]:
+            cqs = snapshot.cluster_queues[cq_name]
+            hit = chain_cache.get(cq_name)
+            if hit is not None:
+                dep_nodes, dep_gens, chain = hit
+                if all(
+                    n.usage_gen == g for n, g in zip(dep_nodes, dep_gens)
+                ):
+                    return chain
+            revert = cqs.simulate_usage_addition(assignment_usage(e))
+            try:
                 drs = dominant_resource_share(cqs.node, {})
+                chain: Dict[int, object] = {}
+                dep_nodes = [cqs.node]
                 for anc in cqs.path_parent_to_root():
-                    drs_values[(id(anc), e.info.key)] = drs
-                    drs = dominant_resource_share(anc, {})
+                    chain[id(anc)] = drs
+                    if anc.parent is not None:
+                        dep_nodes.append(anc)
+                        drs = dominant_resource_share(anc, {})
+            finally:
                 revert()
+            chain_cache[cq_name] = (
+                dep_nodes, [n.usage_gen for n in dep_nodes], chain
+            )
+            return chain
+
+        def pop_one() -> Entry:
+            nonlocal pos
+            while cohortless:
+                name = cohortless.pop(0)
+                e = cq_to_entry.pop(name, None)
+                if e is not None:
+                    return e
+            while order[pos] not in cq_to_entry:
+                pos += 1
+            some_cq = order[pos]
+            root = root_of[some_cq]
+            bucket = buckets[id(root)]
+
+            chains: Dict[str, Dict[int, object]] = {}
+            for cq_name, e in bucket.items():
+                chains[cq_name] = chain_for(cq_name, e)
 
             def less(a: Entry, b: Entry, parent_id: int) -> bool:
-                a_drs = drs_values[(parent_id, a.info.key)]
-                b_drs = drs_values[(parent_id, b.info.key)]
+                a_drs = chains[a.info.cluster_queue][parent_id]
+                b_drs = chains[b.info.cluster_queue][parent_id]
                 c = compare_drs(a_drs, b_drs)
                 if c != 0:
                     return c < 0
@@ -465,7 +516,10 @@ class Scheduler:
 
             winner = tournament(root)
             assert winner is not None
-            del cq_to_entry[winner.info.cluster_queue]
+            wname = winner.info.cluster_queue
+            del cq_to_entry[wname]
+            del bucket[wname]
+            chain_cache.pop(wname, None)
             return winner
 
         def gen():

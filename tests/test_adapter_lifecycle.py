@@ -1,0 +1,127 @@
+"""Adapter-generic lifecycle test: ONE evict-and-restore round-trip
+exercised uniformly across every registered job-framework adapter
+(reference reconciler.go:1326 startJob / :1368 stopJob — the
+RunWithPodSetsInfo / RestorePodSetsInfo contract, interface.go:37).
+
+Each framework goes through: submit -> admit -> started with injected
+podset infos (flavor node labels as node selectors) -> PodsReady timeout
+eviction -> suspended + infos restored -> requeue backoff -> re-admitted
+-> started again. Shape (podset names/counts) must be stable across the
+whole cycle."""
+
+import pytest
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.controllers.jobs import registry
+from kueue_tpu.controllers.workload_controller import WaitForPodsReadyConfig
+from kueue_tpu.core.workload_info import is_admitted, is_evicted
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# Minimal constructor kwargs per framework (shapes kept tiny; every
+# framework requests plain cpu so one CQ serves all).
+R = {"cpu": 500}
+ADAPTER_KW = {
+    "batch/job": dict(parallelism=2, requests=R),
+    "trainjob": dict(roles={"trainer": (2, R)}),
+    "jobset": dict(replicated_jobs={"workers": (1, 2, R)}),
+    "appwrapper": dict(components=[("comp", 2, R)]),
+    "mpijob": dict(workers=2, worker_requests=R),
+    "leaderworkerset": dict(workers=2, worker_requests=R),
+    "pod": dict(count=2, requests=R),
+    "deployment": dict(replicas=2, requests=R),
+    "statefulset": dict(replicas=2, requests=R),
+    "serving": dict(replicas=2, requests=R),
+    "sparkapplication": dict(executors=2, executor_requests=R),
+    "raycluster": dict(head_requests=R, worker_groups={"wg": (2, R)}),
+    "rayjob": dict(head_requests=R, worker_groups={"wg": (2, R)}),
+    "rayservice": dict(head_requests=R, worker_groups={"wg": (2, R)}),
+    "kubeflow/tfjob": dict(replicas={"Worker": (2, R)}),
+    "kubeflow/pytorchjob": dict(replicas={"Worker": (2, R)}),
+    "kubeflow/xgboostjob": dict(replicas={"Worker": (2, R)}),
+    "kubeflow/paddlejob": dict(replicas={"Worker": (2, R)}),
+    "kubeflow/jaxjob": dict(replicas={"Worker": (2, R)}),
+}
+
+
+def _manager():
+    clock = FakeClock()
+    mgr = Manager(
+        clock=clock,
+        pods_ready=WaitForPodsReadyConfig(
+            enable=True, timeout_seconds=10.0,
+            requeuing_backoff_base_seconds=1.0,
+        ),
+    )
+    mgr.apply(
+        ResourceFlavor(name="default", node_labels={"pool": "tpu-pool"}),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(64_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr, clock
+
+
+def test_every_registered_framework_has_a_lifecycle_spec():
+    assert set(registry.names()) == set(ADAPTER_KW), (
+        "adapter registry and lifecycle coverage drifted"
+    )
+
+
+@pytest.mark.parametrize("framework", sorted(ADAPTER_KW))
+def test_evict_and_restore_roundtrip(framework):
+    mgr, clock = _manager()
+    factory = registry.factory(framework)
+    assert factory is not None
+    job = factory(name="j", queue="lq", **ADAPTER_KW[framework])
+
+    shape0 = [(ps.name, ps.count) for ps in job.pod_sets()]
+    assert shape0, f"{framework}: no podsets"
+
+    wl = mgr.submit_job(job)
+    mgr.schedule_all()
+    assert is_admitted(wl), f"{framework}: not admitted"
+    assert not job.is_suspended(), f"{framework}: not started"
+    # startJob injected one PodSetInfo per podset, carrying the flavor's
+    # node labels as node selectors (reconciler.go:1326).
+    assert len(job.started_with) == len(shape0)
+    for info in job.started_with:
+        assert info.node_selector.get("pool") == "tpu-pool", (
+            f"{framework}: flavor node labels not injected: "
+            f"{info.node_selector}"
+        )
+
+    # PodsReady timeout -> eviction -> stopJob: suspended + restored.
+    job.set_pods_ready(False)
+    clock.advance(11.0)
+    mgr.tick()
+    assert is_evicted(wl), f"{framework}: not evicted"
+    assert job.is_suspended(), f"{framework}: not suspended on evict"
+    assert job.started_with == [], (
+        f"{framework}: podset infos not restored on stop"
+    )
+    assert [(ps.name, ps.count) for ps in job.pod_sets()] == shape0, (
+        f"{framework}: shape changed across evict"
+    )
+
+    # Requeue backoff elapses -> re-admission -> started again.
+    clock.advance(5.0)
+    mgr.tick()
+    mgr.schedule_all()
+    mgr.reconcile_job(job)
+    assert is_admitted(wl), f"{framework}: not re-admitted"
+    assert not job.is_suspended(), f"{framework}: not restarted"
+    assert len(job.started_with) == len(shape0)
+    assert [(ps.name, ps.count) for ps in job.pod_sets()] == shape0

@@ -487,6 +487,227 @@ def test_tas_filter_rows_respect_cq_topology():
     assert not d_fb, d_fb
 
 
+@pytest.mark.parametrize("seed", range(8))
+def test_device_balanced_placement_matches_host(seed):
+    """Balanced placement (reference tas_balanced_placement.go) on
+    device: preferred-mode entries with tr.balanced — sibling-group
+    threshold search, prune/refill, optimal-domain-set DPs and the
+    balanced descent — must produce the host's exact domains with zero
+    host fallback, interleaved with plain preferred/required entries so
+    thresholds react to partial usage."""
+    rng = random.Random(70_000 + seed)
+    n_levels = rng.randint(2, 3)
+    levels = LEVELS[-n_levels:]
+
+    # FIXED topology shape per level count (only capacities vary): every
+    # seed with the same depth shares one (D, W) compile bucket, so the
+    # expensive balanced-pipeline programs compile once per xdist worker
+    # instead of once per seed.
+    node_specs = []
+    for b in range(2 if n_levels == 3 else 1):
+        for r in range(3):
+            for h in range(2):
+                labels = {"tpu.rack": f"b{b}-r{r}"}
+                if n_levels == 3:
+                    labels["tpu.block"] = f"b{b}"
+                node_specs.append(
+                    (f"n-{b}-{r}-{h}", labels, rng.choice([4, 8]))
+                )
+
+    def build():
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=levels),
+        )
+        for name, labels, cap in node_specs:
+            mgr.apply(Node(name=name, labels=dict(labels),
+                           capacity={"tpu": cap}))
+        return mgr
+    workloads = []
+    for i in range(rng.randint(4, 9)):
+        count = rng.choice([2, 3, 4, 6, 8])
+        mode = rng.choice(["balanced", "balanced", "balanced",
+                           "preferred", "required"])
+        level = rng.choice(levels)
+        tr = TopologyRequest(
+            required_level=level if mode == "required" else None,
+            preferred_level=level if mode != "required" else None,
+            balanced=mode == "balanced",
+        )
+        if rng.random() < 0.4:
+            li = levels.index(level)
+            tr.slice_required_level = rng.choice(levels[li:])
+            for ss in (2, 3, 1):
+                if count % ss == 0:
+                    tr.slice_size = ss
+                    break
+        workloads.append(Workload(
+            name=f"g{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=count,
+                requests={"tpu": rng.choice([1, 2])},
+                topology_request=tr,
+            )],
+            priority=rng.randrange(0, 3) * 100,
+            creation_time=float(i + 1),
+        ))
+
+    def run(device):
+        import copy
+
+        mgr = build()
+        fallbacks = []
+        if device:
+            sched = DeviceScheduler(mgr.cache, mgr.queues)
+            orig = sched._host_process
+
+            def spy(infos):
+                fallbacks.extend(i.obj.name for i in infos)
+                return orig(infos)
+
+            sched._host_process = spy
+        else:
+            sched = mgr.scheduler
+        wls = copy.deepcopy(workloads)
+        for wl in wls:
+            mgr.create_workload(wl)
+        sched.schedule_all(max_cycles=25)
+        state = {}
+        for wl in wls:
+            adm = wl.status.admission
+            if adm is None:
+                state[wl.name] = None
+            else:
+                ta = adm.pod_set_assignments[0].topology_assignment
+                state[wl.name] = sorted(ta.domains) if ta else None
+        return state, fallbacks
+
+    host_state, _ = run(False)
+    dev_state, fallbacks = run(True)
+    assert not fallbacks, f"unexpected host fallback: {fallbacks}"
+    assert dev_state == host_state, (
+        f"host={host_state} device={dev_state}"
+    )
+
+
+def test_balanced_feature_gate_routes_device():
+    """With the TASBalancedPlacement feature gate on, plain preferred
+    entries take the balanced path (host snapshot.py:1102) — the device
+    must mirror that, still with zero fallback and exact domains."""
+    from kueue_tpu.utils import features
+
+    def run(device):
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(1000)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LEVELS[-2:]),
+        )
+        for r in range(3):
+            for h in range(2):
+                mgr.apply(Node(name=f"n{r}{h}",
+                               labels={"tpu.rack": f"r{r}"},
+                               capacity={"tpu": 8}))
+        fallbacks = []
+        if device:
+            sched = DeviceScheduler(mgr.cache, mgr.queues)
+            orig = sched._host_process
+
+            def spy(infos):
+                fallbacks.extend(i.obj.name for i in infos)
+                return orig(infos)
+
+            sched._host_process = spy
+        else:
+            sched = mgr.scheduler
+        wls = [Workload(
+            name=f"w{i}", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=c, requests={"tpu": 2},
+                topology_request=TopologyRequest(
+                    preferred_level="tpu.rack"),
+            )],
+            priority=0, creation_time=float(i + 1),
+        ) for i, c in enumerate([6, 4, 8])]
+        for wl in wls:
+            mgr.create_workload(wl)
+        sched.schedule_all(max_cycles=30)
+        out = {}
+        for wl in wls:
+            adm = wl.status.admission
+            ta = (adm.pod_set_assignments[0].topology_assignment
+                  if adm else None)
+            out[wl.name] = sorted(ta.domains) if ta else None
+        return out, fallbacks
+
+    features.set_enabled("TASBalancedPlacement", True)
+    try:
+        h, _ = run(False)
+        d, fb = run(True)
+    finally:
+        features.set_enabled("TASBalancedPlacement", False)
+    assert not fb, fb
+    assert d == h, (h, d)
+
+
+def test_balanced_wide_group_falls_back_to_host():
+    """A sibling group wider than the subset-enumeration bound (BMAX)
+    cannot run the balanced DP on device — the entry must route to the
+    host path (and still match host results end to end)."""
+    from kueue_tpu.ops.tas_balanced import BMAX
+
+    def run(device):
+        mgr = Manager()
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(10_000)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LEVELS[-2:]),
+        )
+        for r in range(BMAX + 2):
+            mgr.apply(Node(name=f"n{r}", labels={"tpu.rack": f"r{r:02d}"},
+                           capacity={"tpu": 8}))
+        fallbacks = []
+        if device:
+            sched = DeviceScheduler(mgr.cache, mgr.queues)
+            orig = sched._host_process
+
+            def spy(infos):
+                fallbacks.extend(i.obj.name for i in infos)
+                return orig(infos)
+
+            sched._host_process = spy
+        else:
+            sched = mgr.scheduler
+        wl = Workload(
+            name="wide", queue_name="lq",
+            pod_sets=[PodSet(
+                name="main", count=6, requests={"tpu": 2},
+                topology_request=TopologyRequest(
+                    preferred_level="tpu.rack", balanced=True),
+            )],
+            creation_time=1.0,
+        )
+        mgr.create_workload(wl)
+        sched.schedule_all(max_cycles=10)
+        adm = wl.status.admission
+        ta = (adm.pod_set_assignments[0].topology_assignment
+              if adm else None)
+        return sorted(ta.domains) if ta else None, fallbacks
+
+    h, _ = run(False)
+    d, fb = run(True)
+    assert "wide" in fb, "expected host fallback for the wide group"
+    assert d == h, (h, d)
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_device_multilayer_slices_match_host(seed):
     """Multi-layer slice topologies (outer slices at the rack level with

@@ -1,9 +1,18 @@
 """Elastic jobs (workload slices) tests."""
 
-from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.api.types import (
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    Topology,
+    TopologyRequest,
+    Workload,
+    quota,
+)
 from kueue_tpu.controllers.elasticjobs import scale
 from kueue_tpu.core.workload_info import is_admitted
 from kueue_tpu.manager import Manager
+from kueue_tpu.tas.snapshot import Node
 
 from .helpers import make_cq, make_wl
 
@@ -70,3 +79,103 @@ def test_scale_down_releases_quota():
     mgr.create_workload(other)
     mgr.schedule_all()
     assert is_admitted(other)
+
+
+def _tas_env():
+    """Two racks x two hosts of 8 tpu under one TAS flavor."""
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(64)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=["rack", "kubernetes.io/hostname"]),
+    )
+    for r in range(2):
+        for h in range(2):
+            mgr.apply(Node(name=f"n{r}{h}", labels={"rack": f"r{r}"},
+                           capacity={"tpu": 8}))
+    return mgr
+
+
+def _tas_wl(name, count, req=4, level="rack"):
+    return Workload(
+        name=name, queue_name="lq",
+        pod_sets=[PodSet(
+            name="main", count=count, requests={"tpu": req},
+            topology_request=TopologyRequest(required_level=level),
+        )],
+        creation_time=1.0,
+    )
+
+
+def test_scale_up_recomputes_topology_assignment():
+    """Elastic x TAS (reference tas_elastic_workloads.go:1-140): a scaled
+    slice must carry a freshly computed, valid TopologyAssignment covering
+    the new count — and the recompute may reuse the old slice's domains
+    (the old slice is the replacement target)."""
+    mgr = _tas_env()
+    wl = _tas_wl("elastic-tas", count=2, req=4)
+    mgr.create_workload(wl)
+    mgr.scheduler.schedule_all(max_cycles=10)
+    assert is_admitted(wl)
+    ta0 = wl.status.admission.pod_set_assignments[0].topology_assignment
+    assert ta0 is not None and sum(c for _, c in ta0.domains) == 2
+
+    # 2 -> 4 pods x 4 tpu = one full rack; only fits if the old slice's
+    # domain usage is treated as reclaimable during placement.
+    ok, msg = scale(mgr, wl, {"main": 4})
+    assert ok, msg
+    psa = wl.status.admission.pod_set_assignments[0]
+    assert psa.count == 4
+    ta = psa.topology_assignment
+    assert ta is not None, "scaled slice lost its topology assignment"
+    assert sum(c for _, c in ta.domains) == 4
+    # Rack-required: every assigned host lives in one rack (domains are
+    # hostname-level tuples; rack comes from the node's labels).
+    racks = {
+        mgr.cache.nodes[d[-1]].labels["rack"] for d, _c in ta.domains
+    }
+    assert len(racks) == 1, f"scaled slice crosses racks: {ta.domains}"
+
+    # The cache's per-leaf usage must match the new assignment: a second
+    # rack-required workload still fits on the other rack.
+    other = _tas_wl("other", count=2, req=8)
+    mgr.create_workload(other)
+    mgr.scheduler.schedule_all(max_cycles=10)
+    assert is_admitted(other), "stale TAS usage blocked the free rack"
+
+
+def test_scale_up_tas_infeasible_keeps_old_assignment():
+    """A scale-up the topology cannot place (rack-required beyond one
+    rack's capacity) must be refused with the old slice intact."""
+    mgr = _tas_env()
+    wl = _tas_wl("elastic-tas", count=2, req=4)
+    mgr.create_workload(wl)
+    mgr.scheduler.schedule_all(max_cycles=10)
+    assert is_admitted(wl)
+
+    ok, msg = scale(mgr, wl, {"main": 5})  # 5x4=20 tpu > 16 per rack
+    assert not ok
+    psa = wl.status.admission.pod_set_assignments[0]
+    assert psa.count == 2
+    assert psa.topology_assignment is not None
+    assert sum(c for _, c in psa.topology_assignment.domains) == 2
+
+
+def test_scale_down_tas_releases_domain_usage():
+    """Scale-down shrinks the slice in place; the released per-leaf TAS
+    capacity must be visible to the next placement."""
+    mgr = _tas_env()
+    wl = _tas_wl("elastic-tas", count=4, req=4)
+    mgr.create_workload(wl)
+    mgr.scheduler.schedule_all(max_cycles=10)
+    assert is_admitted(wl)
+
+    ok, msg = scale(mgr, wl, {"main": 1})
+    assert ok, msg
+    # 3 pods x 4 tpu released; a rack-required 3x4 entry must now place.
+    other = _tas_wl("other", count=3, req=4)
+    mgr.create_workload(other)
+    mgr.scheduler.schedule_all(max_cycles=10)
+    assert is_admitted(other), "scale-down did not release TAS capacity"

@@ -1,0 +1,224 @@
+"""Concurrency soak for the threaded aux paths — the `-race` analog.
+
+The reference runs its whole test suite under Go's race detector
+(Makefile-test.mk GOFLAGS=-race). Python's equivalent risk class is
+shared-structure mutation during iteration (dict/list RuntimeError) and
+lock-discipline gaps in the threaded servers. This soak runs the
+visibility HTTP server, the kueueviz dashboard (HTTP + WebSocket
+snapshot path), the metrics registry and the remote in-proc worker under
+sustained concurrent reads WHILE the manager mutates: workloads are
+created, admitted, finished and evicted the whole time. Any reader
+exception, non-200, unparseable payload or violated invariant fails."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.manager import Manager
+from kueue_tpu.visibility.server import VisibilityServer
+
+from .helpers import make_cq, make_wl
+
+SOAK_S = 4.0
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_manager():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(16)}}),
+        make_cq("cq-b", flavors={"default": {"cpu": quota(16)}}),
+        LocalQueue(name="lq-a", cluster_queue="cq-a"),
+        LocalQueue(name="lq-b", cluster_queue="cq-b"),
+    )
+    return mgr
+
+
+def _mutate(mgr: Manager, stop: threading.Event, errors: list):
+    """Churn the control plane: create/schedule/finish in a tight loop."""
+    i = 0
+    live = []
+    try:
+        while not stop.is_set():
+            i += 1
+            wl = make_wl(
+                f"soak-{i}", cpu_m=2000,
+                queue="lq-a" if i % 2 else "lq-b",
+                creation_time=float(i),
+            )
+            mgr.create_workload(wl)
+            live.append(wl)
+            mgr.schedule()
+            if len(live) > 12:
+                old = live.pop(0)
+                mgr.finish_workload(old)
+            if i % 7 == 0:
+                mgr.queues.queue_inadmissible_workloads()
+    except Exception as exc:  # noqa: BLE001 - the test asserts on this
+        errors.append(("mutator", repr(exc)))
+
+
+def _http_reader(url: str, stop: threading.Event, errors: list,
+                 validate=None):
+    def run():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    if resp.status != 200:
+                        errors.append((url, f"status {resp.status}"))
+                        return
+                    body = resp.read()
+                if validate is not None:
+                    validate(body)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((url, repr(exc)))
+                return
+    return run
+
+
+def test_visibility_and_dashboard_survive_concurrent_mutation():
+    from kueue_tpu.visibility.dashboard import serve_dashboard
+
+    mgr = _build_manager()
+    vis = VisibilityServer(mgr.queues)
+    vis_port = _free_port()
+    vis_httpd = vis.serve(port=vis_port)
+    dash_port = _free_port()
+    dash_httpd = serve_dashboard(mgr, port=dash_port)
+    try:
+        stop = threading.Event()
+        errors: list = []
+
+        def check_pending(body: bytes):
+            doc = json.loads(body)
+            for item in doc.get("items", []):
+                # Heap positions are 0-based and dense per CQ.
+                assert item.get("positionInClusterQueue", 0) >= 0
+                assert item.get("positionInLocalQueue", 0) >= 0
+
+        def check_dashboard(body: bytes):
+            doc = json.loads(body)
+            assert "clusterQueues" in doc or "cluster_queues" in doc or doc
+
+        readers = [
+            threading.Thread(target=_http_reader(
+                f"http://127.0.0.1:{vis_port}/visibility/clusterqueues/"
+                f"cq-a/pendingworkloads",
+                stop, errors, check_pending,
+            ))
+            for _ in range(3)
+        ] + [
+            threading.Thread(target=_http_reader(
+                f"http://127.0.0.1:{dash_port}/api/state", stop, errors,
+                check_dashboard,
+            ))
+            for _ in range(3)
+        ]
+        mutator = threading.Thread(
+            target=_mutate, args=(mgr, stop, errors)
+        )
+        for t in readers:
+            t.start()
+        mutator.start()
+        time.sleep(SOAK_S)
+        stop.set()
+        mutator.join(10)
+        for t in readers:
+            t.join(10)
+        assert not errors, errors
+    finally:
+        vis_httpd.shutdown()
+        dash_httpd.shutdown()
+
+
+def test_metrics_registry_concurrent_observe_and_render():
+    mgr = _build_manager()
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                i += 1
+                mgr.metrics.observe(
+                    "admission_attempt_duration_seconds", 0.001 * (i % 7)
+                )
+                mgr.metrics.inc(
+                    "admission_attempts_total",
+                    {"result": "success" if i % 2 else "inadmissible"},
+                )
+        except Exception as exc:  # noqa: BLE001
+            errors.append(("writer", repr(exc)))
+
+    def renderer():
+        try:
+            while not stop.is_set():
+                text = mgr.metrics.expose()
+                assert "admission_attempts_total" in text or text == ""
+        except Exception as exc:  # noqa: BLE001
+            errors.append(("renderer", repr(exc)))
+
+    threads = [threading.Thread(target=writer) for _ in range(2)] + [
+        threading.Thread(target=renderer) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors
+
+
+def test_remote_worker_concurrent_dispatch():
+    """The unix-socket remote worker under concurrent dispatchers: every
+    request gets a complete, well-formed response (the transport lock
+    must serialize frame writes)."""
+    import tempfile
+    import os
+
+    from kueue_tpu.remote import RemoteWorkerClient, serve_worker
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "w.sock")
+        server = serve_worker(_build_manager(), path)
+        try:
+            stop = threading.Event()
+            errors: list = []
+
+            def client(n):
+                def run():
+                    try:
+                        c = RemoteWorkerClient(path)
+                        i = 0
+                        while not stop.is_set() and i < 200:
+                            i += 1
+                            assert c.ping()
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append((f"client-{n}", repr(exc)))
+                return run
+
+            threads = [
+                threading.Thread(target=client(n)) for n in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(2.0)
+            stop.set()
+            for t in threads:
+                t.join(10)
+            assert not errors, errors
+        finally:
+            server.shutdown()
