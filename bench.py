@@ -837,6 +837,32 @@ def main():
             (device.get("sim") or {}).get("ok")
             or (device.get("mega") or {}).get("ok")
         )
+        if not device["ping"].get("ok"):
+            # Say it LOUDLY: with the tunnel wedged the round ships no
+            # hardware numbers; the CPU crossover study is the fallback
+            # evidence (VERDICT r3 #1) — device-path vs host-path on
+            # identical scenarios, CPU backend, honest end-to-end.
+            device["tunnel_dead_fallback"] = (
+                "TPU tunnel unreachable at bench time (ping rc above). "
+                "Device kernels in this round are validated on the CPU "
+                "backend only; see crossover_cpu below for the "
+                "device-vs-host comparison on identical scenarios and "
+                "CROSSOVER_CPU.md for the study."
+            )
+            try:
+                cx = run_probe_subprocess(
+                    "sim", 900, min(args.scale, 0.3), "cpu"
+                )
+                log(f"crossover sim (cpu): {cx}")
+                out_extra = {"sim_cpu": cx}
+                fx = run_probe_subprocess(
+                    "fair", 900, min(args.scale, 0.1), "cpu"
+                )
+                log(f"crossover fair (cpu): {fx}")
+                out_extra["fair_cpu"] = fx
+                device["crossover_cpu"] = out_extra
+            except Exception as exc:  # noqa: BLE001
+                device["crossover_cpu"] = {"error": repr(exc)[:200]}
 
     multichip = {}
     if not args.skip_device:
