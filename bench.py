@@ -355,10 +355,11 @@ def probe_fair(scale: float):
     runtime_ms = jnp.asarray(
         np.pad(np.asarray(runtimes, np.int64), (0, w_pad - len(runtimes)))
     )
-    group_of = np.asarray(idx.group_arrays.flat_to_group)[
-        np.asarray(arrays.w_cq)
-    ]
-    s_max = int(np.bincount(group_of).max())
+    # Exact tournament bound: one entry per CQ participates per scan
+    # (last-entry shadowing), so a root can produce at most
+    # #participating-CQs winners — NOT #entries (26x fewer steps at the
+    # flagship's 25 workloads/CQ).
+    s_max = int(idx.fair_s_bound) or arrays.w_cq.shape[0]
     n_levels = int(np.asarray(arrays.tree.depth).max()) + 1
     stats = {
         "probe": "fair",

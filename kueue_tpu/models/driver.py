@@ -101,7 +101,9 @@ class DeviceScheduler:
             if self.fair_sharing:
                 from kueue_tpu.models.fair_kernel import cycle_fair_preempt
 
-                out = cycle_fair_preempt(arrays, idx.admitted_arrays)
+                out = cycle_fair_preempt(
+                    arrays, idx.admitted_arrays, s_max=idx.fair_s_bound
+                )
             elif self.use_fixedpoint and not idx.has_partial \
                     and arrays.s_req is None \
                     and arrays.tas_topo is None and not bool(

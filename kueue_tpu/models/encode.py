@@ -167,6 +167,11 @@ class CycleIndex:
     # when the cycle is in legacy layout).
     slots: List[object] = field(default_factory=list)
     n_slots: int = 1  # padded S axis (1 = legacy layout, no slot fields)
+    # Exact step bound for the fair tournament scan: at most one entry
+    # per CQ participates (last-entry shadowing), and each scan step
+    # resolves one winner per cohort root, so a root needs at most
+    # #participating-CQs steps. Power-of-two bucketed for compile reuse.
+    fair_s_bound: int = 0
 
 
 def _round_up(n: int, m: int) -> int:
@@ -389,6 +394,20 @@ def encode_cycle(
             wl_slots.append(slots)
         else:
             idx.host_fallback.append(info)
+
+    if fair_sharing:
+        # Steps the tournament scan actually needs (see CycleIndex):
+        # max over cohort roots of the number of device CQs with >=1
+        # entry under that root.
+        cqs_of_root: Dict[int, set] = {}
+        for info in device_wls:
+            # _device_compatible guarantees the CQ is in the snapshot.
+            cqs2 = snapshot.cluster_queues[info.cluster_queue]
+            cqs_of_root.setdefault(
+                id(cqs2.node.root()), set()
+            ).add(info.cluster_queue)
+        bound = max((len(s) for s in cqs_of_root.values()), default=1)
+        idx.fair_s_bound = 1 << max(bound - 1, 2).bit_length()
 
     # Layout: the dense legacy (single-slot, first-RG) layout compiles the
     # existing kernels unchanged; any multi-podset or off-RG0 entry

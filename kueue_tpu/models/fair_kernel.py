@@ -39,6 +39,8 @@ needed.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -133,97 +135,138 @@ def fair_admit_scan(
     shadowed = arrays.w_active & (last_of_cq[arrays.w_cq] != w_iota)
     part = arrays.w_active & ~shadowed
 
-    fe = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
+    # ---- participant compaction ------------------------------------------
+    # At most one entry per CQ ever participates in a scan (last-entry
+    # shadowing above is static), so every per-step tensor lives on the
+    # NODE axis [n] — one slot per CQ, cohort/root slots inert. At the
+    # 50k x 2,000-CQ flagship that is ~25x narrower than the padded W
+    # axis; the whole scan body (DRS keys, tournament, fit walk, TAS
+    # placement) scales with participants, not entries. Results scatter
+    # back to [W] once, after the scan. In the opposite regime (drained
+    # queue: W bucket 16 << n) this widens keys/fit tensors to [n], but
+    # the tournament's [n]-wide scatters dominated that regime before
+    # the compaction too, and the s_max bound shrinks with participants
+    # — the absolute per-scan cost there stays microseconds.
+    p_e = last_of_cq  # [n] participant entry index (-1 none)
+    p_has = p_e >= 0
+    pe = jnp.clip(p_e, 0, w_n - 1)
+    n_iota = jnp.arange(n, dtype=jnp.int32)
+
+    chains_c = chains[pe]  # [n, D+1]
+    walk_rep_c = walk_repeat[pe]
+    root_c = w_root[pe]
+    own_cq_c = chains_c[:, 0]
+    depth_c = tree.depth[own_cq_c]
+    prio_c = arrays.w_priority[pe]
+    ts_c = arrays.w_timestamp[pe]
+    pm_c = nom.best_pmode[pe]
+    deferred_c = nom.needs_host[pe]
+    borrowing_c = nom.best_borrow[pe] > 0
+    chosen_c = nom.chosen_flavor[pe]
+    fe_c = jnp.clip(chosen_c, 0, f_n - 1)
+    fe_col_c = fe_c[:, None]
+    req_c = arrays.w_req[pe]
     # All fit/apply math lives on the entry's chosen flavor plane.
-    cell_pl = (
-        (nom.chosen_flavor >= 0)[:, None]
-        & (arrays.w_req > 0)
-        & arrays.covered[arrays.w_cq]
-    )  # [W,R]
-    delta_pl = jnp.where(cell_pl, arrays.w_req, 0).astype(jnp.int64)
-    # Plane statics along each entry's chain [W,D+1,R].
-    fe_col = fe[:, None]
-    lq_pl = lq_all[chains, fe_col]
-    sub_pl = sq[chains, fe_col]
-    bl_pl = tree.borrow_limit[chains, fe_col]
-    hbl_pl = tree.has_borrow_limit[chains, fe_col]
-    nominal_pl = tree.nominal[arrays.w_cq, fe]  # [W,R]
+    cell_c = (
+        (chosen_c >= 0)[:, None]
+        & (req_c > 0)
+        & arrays.covered[own_cq_c]
+    )  # [n,R]
+    delta_c = jnp.where(cell_c, req_c, 0).astype(jnp.int64)
+    # Plane statics along each participant's chain [n,D+1,R].
+    lq_c = lq_all[chains_c, fe_col_c]
+    sub_c = sq[chains_c, fe_col_c]
+    bl_c = tree.borrow_limit[chains_c, fe_col_c]
+    hbl_c = tree.has_borrow_limit[chains_c, fe_col_c]
+    nominal_c = tree.nominal[own_cq_c, fe_c]  # [n,R]
+    reclaim_c = arrays.can_always_reclaim[own_cq_c]
     # The nominated usage simulated into the DRS (assignment.usage): the
     # request vector on the chosen flavor. Entries with no chosen flavor
     # (NoFit everywhere) simulate nothing, like the host's empty usage.
-    sim_req = jnp.where(
-        (nom.chosen_flavor >= 0)[:, None] & (arrays.w_req > 0),
-        arrays.w_req,
-        0,
-    )  # [W,R]
+    sim_req_c = jnp.where(
+        (chosen_c >= 0)[:, None] & (req_c > 0), req_c, 0
+    )  # [n,R]
+
+    if with_preempt:
+        victims_c = targets.victims[pe]  # [n,A]
+        chain_sub_c = on_chain_adm[chains_c]  # [n,D+1,A]
+        au_c = usage_by_f[fe_c]  # [n,A,R]
 
     if with_tas:
         from kueue_tpu.ops import tas_place as _tas_place
 
-        t_of_w = jnp.where(
-            nom.chosen_flavor >= 0, arrays.tas_of_flavor[fe], -1
+        t_of_c = jnp.where(
+            chosen_c >= 0, arrays.tas_of_flavor[fe_c], -1
         )
-        t_idx_w = jnp.clip(t_of_w, 0, arrays.tas_usage0.shape[0] - 1)
-        rl_w = arrays.w_tas_req_level[w_iota, t_idx_w]
-        sl_w = arrays.w_tas_slice_level[w_iota, t_idx_w]
-        cap_w = _tas_place.entry_leaf_cap(arrays, t_idx_w)
-        sizes_w = arrays.w_tas_sizes[w_iota, t_idx_w]
+        t_idx_c = jnp.clip(t_of_c, 0, arrays.tas_usage0.shape[0] - 1)
+        rl_c = arrays.w_tas_req_level[pe, t_idx_c]
+        sl_c = arrays.w_tas_slice_level[pe, t_idx_c]
+        cap_c = _tas_place.entry_leaf_cap(arrays, t_idx_c, w=pe)
+        sizes_c = arrays.w_tas_sizes[pe, t_idx_c]
+        w_tas_c = arrays.w_tas[pe]
+        tas_req_c = arrays.w_tas_req[pe]
+        tas_count_c = arrays.w_tas_count[pe]
+        tas_ss_c = arrays.w_tas_slice_size[pe]
+        tas_required_c = arrays.w_tas_required[pe]
+        tas_uncon_c = arrays.w_tas_unconstrained[pe]
+        tas_usage_req_c = arrays.w_tas_usage_req[pe]
+        tas_bal_c = (
+            arrays.w_tas_balanced[pe]
+            if arrays.w_tas_balanced is not None else None
+        )
 
-    depth_w = tree.depth[arrays.w_cq]  # [W]
-    prio = arrays.w_priority
-    ts = arrays.w_timestamp
+    lend_par_c = lendable[parent[chains_c]]  # [n,D+1,R]
+    wgt_c = weight[chains_c]  # [n,D+1]
 
     def keys_for(usage_now):
-        """Per-entry DRS key at each chain position [W, D+1]:
+        """Per-participant DRS key at each chain position [n, D+1]:
         (zwb bool, value f64). Root positions are never compared."""
-        u_chain = usage_now[chains]  # [W,D+1,F,R]
-        sq_chain = sq[chains]
+        u_chain = usage_now[chains_c]  # [n,D+1,F,R]
+        sq_chain = sq[chains_c]
         over_base = jnp.maximum(0, u_chain - sq_chain)
-        borrowed_base = jnp.sum(over_base, axis=2)  # [W,D+1,R]
+        borrowed_base = jnp.sum(over_base, axis=2)  # [n,D+1,R]
         # Adjust the chosen-flavor plane for the simulated addition.
-        idx_fe = fe[:, None, None, None]
+        idx_fe = fe_c[:, None, None, None]
         u_fe = jnp.take_along_axis(u_chain, idx_fe, axis=2)[:, :, 0, :]
         sq_fe = jnp.take_along_axis(sq_chain, idx_fe, axis=2)[:, :, 0, :]
         over_fe_now = jnp.maximum(0, u_fe - sq_fe)
-        over_fe_sim = jnp.maximum(0, u_fe + sim_req[:, None, :] - sq_fe)
-        borrowed = borrowed_base + over_fe_sim - over_fe_now  # [W,D+1,R]
+        over_fe_sim = jnp.maximum(
+            0, u_fe + sim_req_c[:, None, :] - sq_fe
+        )
+        borrowed = borrowed_base + over_fe_sim - over_fe_now  # [n,D+1,R]
 
-        lend_par = lendable[parent[chains]]  # [W,D+1,R]
         ratio_r = jnp.where(
-            (lend_par > 0) & (borrowed > 0),
-            borrowed.astype(jnp.float64) * 1000.0 / lend_par,
+            (lend_par_c > 0) & (borrowed > 0),
+            borrowed.astype(jnp.float64) * 1000.0 / lend_par_c,
             0.0,
         )
-        ratio = jnp.max(ratio_r, axis=-1)  # [W,D+1]
-        wgt = weight[chains]
-        zwb = (wgt == 0.0) & (ratio > 0.0)
+        ratio = jnp.max(ratio_r, axis=-1)  # [n,D+1]
+        zwb = (wgt_c == 0.0) & (ratio > 0.0)
         val = jnp.where(
             zwb,
             ratio,
-            jnp.where(ratio == 0.0, 0.0, ratio / jnp.where(wgt == 0.0, 1.0,
-                                                           wgt)),
+            jnp.where(
+                ratio == 0.0, 0.0,
+                ratio / jnp.where(wgt_c == 0.0, 1.0, wgt_c),
+            ),
         )
         # weight==0 && ratio>0 handled by zwb; weight==0 && ratio==0 -> 0.
         return zwb, val
 
     def tournament(zwb_k, val_k, remaining):
-        """champ[node] = winning entry of the node's subtree (-1 none)."""
-        live = part & remaining
-        champ = (
-            jnp.full(n, -1, jnp.int32)
-            .at[arrays.w_cq]
-            .max(jnp.where(live, w_iota, -1), mode="drop")
-        )
-        # ≤1 live entry per CQ, so scatter-max IS selection, not a race.
+        """champ[node] = CQ slot of the node's winning subtree (-1)."""
+        live = p_has & remaining
+        champ = jnp.where(live, n_iota, jnp.int32(-1))
         for d in range(MAX_DEPTH, 0, -1):
             has = champ >= 0
             lvl = (tree.depth == d) & has & tree.active
-            e = jnp.clip(champ, 0, w_n - 1)
-            j = jnp.clip(depth_w[e] - d, 0, MAX_DEPTH)
-            kz = zwb_k[e, j]
-            kv = val_k[e, j]
-            kp = prio[e]
-            kt = ts[e]
+            c = jnp.clip(champ, 0, n - 1)
+            j = jnp.clip(depth_c[c] - d, 0, MAX_DEPTH)
+            kz = zwb_k[c, j]
+            kv = val_k[c, j]
+            kp = prio_c[c]
+            kt = ts_c[c]
+            ke = pe[c]  # host tie-break: queue order = entry index
             p = parent  # [N]
 
             def scat_min(vals, init, mask):
@@ -249,10 +292,13 @@ def fair_admit_scan(
             bt = scat_min(kt, _F64_INF, m)
             m = m & (kt == bt[p])
             be = scat_min(
-                jnp.where(m, champ[jnp.arange(n)], jnp.int32(w_n)),
-                jnp.int32(w_n), m,
+                jnp.where(m, ke, jnp.int32(w_n)), jnp.int32(w_n), m
             )
-            new_champ = jnp.where(be < w_n, be, -1)
+            m = m & (ke == be[p])
+            bc = scat_min(
+                jnp.where(m, c, jnp.int32(n)), jnp.int32(n), m
+            )
+            new_champ = jnp.where(bc < n, bc, -1)
             # Write winners into parents one level up; nodes at other
             # depths keep their champions.
             parent_at_lvl = (
@@ -268,61 +314,54 @@ def fair_admit_scan(
          designated, win_step, w_takes) = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
-        win = (
-            part
-            & remaining
-            & (champ[w_root] == w_iota)
-        )
+        win = p_has & remaining & (champ[root_c] == n_iota)
 
-        pm = nom.best_pmode
+        pm = pm_c
         # Chain availability on the entry's chosen plane, via the same
         # walk as the grouped admission scan — exact under lending
         # limits. The fit check simulates removal of every designated
         # victim plus the entry's own targets (scheduler fits() ->
         # SimulateWorkloadRemoval).
-        u_pl = usage_now[chains, fe_col]  # [W,D+1,R]
+        u_pl = usage_now[chains_c, fe_col_c]  # [n,D+1,R]
         if with_preempt:
-            my_vict = targets.victims  # [W,A]
             is_pre = win & (pm == P_PREEMPT_OK)
             overlap = is_pre & jnp.any(
-                my_vict & designated[None, :], axis=1
+                victims_c & designated[None, :], axis=1
             )
             use_vict = designated[None, :] | jnp.where(
-                (is_pre & ~overlap)[:, None], my_vict, False
-            )  # [W,A]
-            chain_sub = on_chain_adm[chains]  # [W,D+1,A]
-            au_pl = usage_by_f[fe]  # [W,A,R]
+                (is_pre & ~overlap)[:, None], victims_c, False
+            )  # [n,A]
             rem = jnp.einsum(
                 "wda,war->wdr",
-                (use_vict[:, None, :] & chain_sub).astype(jnp.int64),
-                au_pl,
+                (use_vict[:, None, :] & chain_sub_c).astype(jnp.int64),
+                au_c,
             )
             u_fit = u_pl - rem
         else:
-            is_pre = jnp.zeros(w_n, bool)
-            overlap = jnp.zeros(w_n, bool)
+            is_pre = jnp.zeros(n, bool)
+            overlap = jnp.zeros(n, bool)
             u_fit = u_pl
-        l_avail_fit = jnp.maximum(0, sat_sub(lq_pl, u_fit))
-        stored = sat_sub(sub_pl, lq_pl)
-        used_in_parent = jnp.maximum(0, sat_sub(u_fit, lq_pl))
-        with_max = sat_add(sat_sub(stored, used_in_parent), bl_pl)
+        l_avail_fit = jnp.maximum(0, sat_sub(lq_c, u_fit))
+        stored = sat_sub(sub_c, lq_c)
+        used_in_parent = jnp.maximum(0, sat_sub(u_fit, lq_c))
+        with_max = sat_add(sat_sub(stored, used_in_parent), bl_c)
         L = MAX_DEPTH + 1
-        avail = sat_sub(sub_pl[:, L - 1], u_fit[:, L - 1])
+        avail = sat_sub(sub_c[:, L - 1], u_fit[:, L - 1])
         for i in range(L - 2, -1, -1):
             clamped = jnp.where(
-                hbl_pl[:, i], jnp.minimum(with_max[:, i], avail), avail
+                hbl_c[:, i], jnp.minimum(with_max[:, i], avail), avail
             )
             stepped = sat_add(l_avail_fit[:, i], clamped)
-            avail = jnp.where(walk_repeat[:, i, None], avail, stepped)
-        fits = jnp.all((delta_pl <= avail) | ~cell_pl, axis=1)
+            avail = jnp.where(walk_rep_c[:, i, None], avail, stepped)
+        fits = jnp.all((delta_c <= avail) | ~cell_c, axis=1)
 
-        deferred = nom.needs_host
+        deferred = deferred_c
         # TAS placement recheck against the running topology state for
         # winners (scheduler.go:409 updateAssignmentIfNeeded): earlier
         # winners may have taken the domains.
         if with_tas:
             tas_do = (
-                win & arrays.w_tas & (t_of_w >= 0) & (pm == P_FIT)
+                win & w_tas_c & (t_of_c >= 0) & (pm == P_FIT)
             )
 
             def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_,
@@ -334,16 +373,16 @@ def fair_admit_scan(
                 )
 
             place_args = (
-                t_idx_w, arrays.w_tas_req, arrays.w_tas_count,
-                arrays.w_tas_slice_size, sl_w, rl_w,
-                arrays.w_tas_required, arrays.w_tas_unconstrained,
-                cap_w, sizes_w,
+                t_idx_c, tas_req_c, tas_count_c,
+                tas_ss_c, sl_c, rl_c,
+                tas_required_c, tas_uncon_c,
+                cap_c, sizes_c,
             )
-            if arrays.w_tas_balanced is not None:
-                place_args = place_args + (arrays.w_tas_balanced,)
+            if tas_bal_c is not None:
+                place_args = place_args + (tas_bal_c,)
             tas_feas, tas_take = jax.vmap(place_one)(
                 *place_args
-            )  # [W], [W, D]
+            )  # [n], [n, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:
             tas_ok = True
@@ -352,26 +391,25 @@ def fair_admit_scan(
         preempt_ok = is_pre & ~overlap & fits & ~deferred
 
         # NO_CANDIDATES capacity reserve (scheduler.go:513) at the CQ.
-        u_cq_pl = u_pl[:, 0]  # [W,R]
-        borrowing = nom.best_borrow > 0
+        u_cq_pl = u_pl[:, 0]  # [n,R]
         reserve_borrowing = jnp.where(
-            hbl_pl[:, 0],
+            hbl_c[:, 0],
             jnp.minimum(
-                delta_pl, sat_sub(sat_add(nominal_pl, bl_pl[:, 0]), u_cq_pl)
+                delta_c, sat_sub(sat_add(nominal_c, bl_c[:, 0]), u_cq_pl)
             ),
-            delta_pl,
+            delta_c,
         )
         reserve_plain = jnp.maximum(
-            0, jnp.minimum(delta_pl, sat_sub(nominal_pl, u_cq_pl))
+            0, jnp.minimum(delta_c, sat_sub(nominal_c, u_cq_pl))
         )
         reserve = jnp.where(
-            borrowing[:, None], reserve_borrowing, reserve_plain
+            borrowing_c[:, None], reserve_borrowing, reserve_plain
         )
-        reserve = jnp.where(cell_pl, reserve, 0)
+        reserve = jnp.where(cell_c, reserve, 0)
         do_reserve = (
             win
             & (pm == P_NO_CANDIDATES)
-            & ~arrays.can_always_reclaim[arrays.w_cq]
+            & ~reclaim_c
             & ~deferred
         )
 
@@ -379,43 +417,43 @@ def fair_admit_scan(
         # their usage (scheduler.go:561 cq.AddUsage runs for either mode).
         take_usage = admit | preempt_ok
         applied = jnp.where(
-            take_usage[:, None], delta_pl,
+            take_usage[:, None], delta_c,
             jnp.where(do_reserve[:, None], reserve, 0),
-        )  # [W,R]
+        )  # [n,R]
         # addUsage bubbling with local-availability clamping
         # (resource_node.go:144) — exact under lending limits; l_avail
         # comes from the pre-update usage.
-        l_avail_pre = jnp.maximum(0, sat_sub(lq_pl, u_pl))
-        deltas = jnp.zeros((w_n, L, r_n), dtype=jnp.int64)
+        l_avail_pre = jnp.maximum(0, sat_sub(lq_c, u_pl))
+        deltas = jnp.zeros((n, L, r_n), dtype=jnp.int64)
         cur = applied
         for i in range(L):
             deltas = deltas.at[:, i].set(cur)
             cont = (
-                (~walk_repeat[:, i, None]) if i < L - 1 else False
+                (~walk_rep_c[:, i, None]) if i < L - 1 else False
             )
             cur = jnp.where(
                 cont, jnp.maximum(0, sat_sub(cur, l_avail_pre[:, i])), 0
             )
         deltas = jnp.where(win[:, None, None], deltas, 0)
         new_usage = quota_ops.sat(
-            usage_now.at[chains, fe_col].add(deltas, mode="drop")
+            usage_now.at[chains_c, fe_col_c].add(deltas, mode="drop")
         )
         if with_tas:
             do_take = admit & tas_do
             usage_delta = (
                 tas_take[:, :, None]
-                * arrays.w_tas_usage_req[:, None, :]
-            )  # [W, D, R1]
+                * tas_usage_req_c[:, None, :]
+            )  # [n, D, R1]
             usage_delta = jnp.where(
                 do_take[:, None, None], usage_delta, 0
             )
-            tas_usage = tas_usage.at[t_idx_w].add(usage_delta)
+            tas_usage = tas_usage.at[t_idx_c].add(usage_delta)
             w_takes = w_takes + jnp.where(
                 do_take[:, None], tas_take, 0
             ).astype(jnp.int32)
         if with_preempt:
             designated = designated | jnp.any(
-                jnp.where(preempt_ok[:, None], targets.victims, False),
+                jnp.where(preempt_ok[:, None], victims_c, False),
                 axis=0,
             )
         win_step = jnp.where(win, step, win_step)
@@ -431,17 +469,37 @@ def fair_admit_scan(
         arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
     )
     takes0 = (
-        jnp.zeros((w_n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
+        jnp.zeros((n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
         if with_tas else jnp.zeros((1,), jnp.int32)
     )
-    init = (usage, tas_usage0, jnp.ones(w_n, bool), jnp.zeros(w_n, bool),
-            jnp.zeros(w_n, bool), designated0,
-            jnp.full(w_n, -1, jnp.int32), takes0)
-    (final_usage, _tas_u, remaining, admitted, preempting, _desig,
-     win_step, w_takes_f), _ = jax.lax.scan(
+    init = (usage, tas_usage0, jnp.ones(n, bool), jnp.zeros(n, bool),
+            jnp.zeros(n, bool), designated0,
+            jnp.full(n, -1, jnp.int32), takes0)
+    (final_usage, _tas_u, remaining_c, admitted_c, preempting_c, _desig,
+     win_step_c, takes_c), _ = jax.lax.scan(
         body, init, jnp.arange(s_max, dtype=jnp.int32)
     )
-    participated = part & ~remaining
+
+    # Scatter participant results back onto the entry axis.
+    idx_w = jnp.where(p_has, pe, jnp.int32(w_n))  # OOB rows drop
+    admitted = jnp.zeros(w_n, bool).at[idx_w].set(
+        admitted_c & p_has, mode="drop"
+    )
+    preempting = jnp.zeros(w_n, bool).at[idx_w].set(
+        preempting_c & p_has, mode="drop"
+    )
+    participated = jnp.zeros(w_n, bool).at[idx_w].set(
+        p_has & ~remaining_c, mode="drop"
+    )
+    win_step = jnp.full(w_n, -1, jnp.int32).at[idx_w].set(
+        jnp.where(p_has, win_step_c, -1), mode="drop"
+    )
+    if with_tas:
+        w_takes_f = jnp.zeros(
+            (w_n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32
+        ).at[idx_w].set(
+            jnp.where(p_has[:, None], takes_c, 0), mode="drop"
+        )
     return (final_usage, admitted, preempting, shadowed, participated,
             win_step, w_takes_f if with_tas else None)
 
@@ -564,4 +622,16 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
 
 
 cycle_fair = jax.jit(make_fair_cycle())
-cycle_fair_preempt = jax.jit(make_fair_cycle(preempt=True))
+@functools.lru_cache(maxsize=None)
+def fair_cycle_preempt_for(s_max: int):
+    """Compiled fair cycle for a given (bucketed) tournament step count.
+
+    ``s_max=0`` falls back to the full padded width — always correct but
+    wasteful; callers should pass CycleIndex.fair_s_bound (at most one
+    entry per CQ participates per scan, so #participating-CQs steps per
+    root suffice)."""
+    return jax.jit(make_fair_cycle(s_max=s_max, preempt=True))
+
+
+def cycle_fair_preempt(arrays, adm, s_max: int = 0):
+    return fair_cycle_preempt_for(s_max)(arrays, adm)
