@@ -351,10 +351,12 @@ def encode_cycle(
     # Lending limits need no gate: the fair kernel's availability walk and
     # clamped bubbling are exact for partially-lent trees.
     fair_tas_single: Dict[str, bool] = {}
+    root_of_cq: Dict[str, int] = {}
     if fair_sharing:
         roots_of_flavor: Dict[str, set] = {}
         for cq_name2, cqs2 in snapshot.cluster_queues.items():
             rid = id(cqs2.node.root())
+            root_of_cq[cq_name2] = rid
             for rg2 in cqs2.spec.resource_groups:
                 for fq2 in rg2.flavors:
                     if fq2.name in snapshot.tas_flavors:
@@ -401,10 +403,10 @@ def encode_cycle(
         # entry under that root.
         cqs_of_root: Dict[int, set] = {}
         for info in device_wls:
-            # _device_compatible guarantees the CQ is in the snapshot.
-            cqs2 = snapshot.cluster_queues[info.cluster_queue]
+            # root_of_cq covers every snapshot CQ, and _device_compatible
+            # guarantees device entries' CQs are in the snapshot.
             cqs_of_root.setdefault(
-                id(cqs2.node.root()), set()
+                root_of_cq[info.cluster_queue], set()
             ).add(info.cluster_queue)
         bound = max((len(s) for s in cqs_of_root.values()), default=1)
         idx.fair_s_bound = 1 << max(bound - 1, 2).bit_length()

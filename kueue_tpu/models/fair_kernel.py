@@ -89,22 +89,10 @@ def fair_admit_scan(
     w_iota = jnp.arange(w_n, dtype=jnp.int32)
 
     parent = jnp.where(tree.parent < 0, jnp.arange(n), tree.parent)
-    # Entry ancestor chains in flat node ids, root-padded.
-    chain_cols = [arrays.w_cq.astype(jnp.int32)]
-    for _ in range(MAX_DEPTH):
-        chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
-    chains = jnp.stack(chain_cols, axis=1)  # [W, D+1]
-    # Walk-repeat semantics (position at/past root): matches the grouped
-    # admission scan's is_repeat, so the availability walk and bubbling
-    # treat the root layer exactly once.
-    walk_repeat = chains == jnp.concatenate(
-        [chains[:, 1:], chains[:, -1:]], axis=1
-    )  # [W, D+1]
 
     root_of = jnp.arange(n)
     for _ in range(MAX_DEPTH):
         root_of = parent[root_of]
-    w_root = root_of[arrays.w_cq]  # [W]
 
     with_preempt = targets is not None
     with_tas = getattr(arrays, "tas_topo", None) is not None
@@ -152,11 +140,21 @@ def fair_admit_scan(
     pe = jnp.clip(p_e, 0, w_n - 1)
     n_iota = jnp.arange(n, dtype=jnp.int32)
 
-    chains_c = chains[pe]  # [n, D+1]
-    walk_rep_c = walk_repeat[pe]
-    root_c = w_root[pe]
-    own_cq_c = chains_c[:, 0]
-    depth_c = tree.depth[own_cq_c]
+    # A participant slot's chain is the ancestor chain of its OWN node —
+    # built directly on the node axis (no [W]-wide intermediates).
+    chain_cols = [n_iota]
+    for _ in range(MAX_DEPTH):
+        chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
+    chains_c = jnp.stack(chain_cols, axis=1)  # [n, D+1]
+    # Walk-repeat semantics (position at/past root): matches the grouped
+    # admission scan's is_repeat, so the availability walk and bubbling
+    # treat the root layer exactly once.
+    walk_rep_c = chains_c == jnp.concatenate(
+        [chains_c[:, 1:], chains_c[:, -1:]], axis=1
+    )  # [n, D+1]
+    root_c = root_of  # [n]
+    own_cq_c = n_iota
+    depth_c = tree.depth
     prio_c = arrays.w_priority[pe]
     ts_c = arrays.w_timestamp[pe]
     pm_c = nom.best_pmode[pe]
@@ -294,11 +292,13 @@ def fair_admit_scan(
             be = scat_min(
                 jnp.where(m, ke, jnp.int32(w_n)), jnp.int32(w_n), m
             )
-            m = m & (ke == be[p])
-            bc = scat_min(
-                jnp.where(m, c, jnp.int32(n)), jnp.int32(n), m
+            # The winning entry's slot IS its CQ node — a gather on the
+            # unique surviving entry index, no further scatter needed.
+            new_champ = jnp.where(
+                be < w_n,
+                arrays.w_cq[jnp.clip(be, 0, w_n - 1)].astype(jnp.int32),
+                -1,
             )
-            new_champ = jnp.where(bc < n, bc, -1)
             # Write winners into parents one level up; nodes at other
             # depths keep their champions.
             parent_at_lvl = (

@@ -54,8 +54,23 @@ _META_RESERVE = 1 << 17  # entry reserves (NO_CANDIDATES, can't reclaim)
 _META_BORROWING = 1 << 18  # nominated assignment borrows
 
 
+# int32-typed constants: a bare Python literal is a weak-typed scalar
+# that materializes as int64 under x64, and this jaxlib's Mosaic
+# lowering recurses forever on any in-kernel int64->int32 convert.
+_CAP32_I32 = np.int32(CAP32)
+_NCAP32_I32 = np.int32(-CAP32)
+
+
+def _im3(g):
+    """Grid->block index map. The zero coordinates must be int32-typed:
+    a bare literal 0 is a weak scalar that lowers to an i64 constant
+    under x64, giving every generated transform function an
+    (i32, i64, i64) func.return that Mosaic fails to legalize."""
+    return (g, np.int32(0), np.int32(0))
+
+
 def _sat32(v):
-    return jnp.clip(v, -CAP32, CAP32)
+    return jnp.clip(v, _NCAP32_I32, _CAP32_I32)
 
 
 def _sadd(a, b):
@@ -64,7 +79,7 @@ def _sadd(a, b):
 
 def _ssub(a, b):
     """a - b with an Unlimited (CAP32) minuend staying Unlimited."""
-    return jnp.where(a >= CAP32, CAP32, _sat32(a - b))
+    return jnp.where(a >= _CAP32_I32, _CAP32_I32, _sat32(a - b))
 
 
 def fits_int32(arrays: CycleArrays) -> bool:
@@ -155,7 +170,10 @@ def _kernel(n_levels, counts_ref, meta_ref, chain_ref, delta_ref, usage_ref,
             stepped = _sadd(l_avail[i], jnp.minimum(with_max, avail))
             avail = jnp.where(rep[i], avail, stepped)
 
-        fits = jnp.all((delta <= avail) | (delta == 0))
+        # Reduce in int32: this jaxlib's Mosaic lowers a bool jnp.all()
+        # scalarization through float64 under x64, which it then rejects.
+        ok32 = ((delta <= avail) | (delta == 0)).astype(jnp.int32)
+        fits = jnp.min(ok32) > 0
         admit = admit_el & fits
 
         # reserveCapacityForUnreclaimablePreempt (scheduler.go:513).
@@ -163,7 +181,7 @@ def _kernel(n_levels, counts_ref, meta_ref, chain_ref, delta_ref, usage_ref,
         res_b = jnp.minimum(delta, _ssub(_sadd(nomr, bl[0]), u[0]))
         res_p = jnp.maximum(0, jnp.minimum(delta, _ssub(nomr, u[0])))
         reserve = jnp.where(borrowing, res_b, res_p)
-        reserve = jnp.where(delta > 0, reserve, 0)
+        reserve = jnp.where(delta > 0, reserve, np.int32(0))
 
         applied = jnp.where(
             admit, delta, jnp.where(res_el, reserve, jnp.zeros_like(delta))
@@ -195,12 +213,15 @@ def _kernel(n_levels, counts_ref, meta_ref, chain_ref, delta_ref, usage_ref,
                     jnp.maximum(0, _ssub(cur, l_avail[i])),
                 )
 
-        aout_ref[0, pl.ds(s, 1), :] = jnp.where(admit, 1, 0).astype(
-            jnp.int32
+        # int32 literals: under x64 a weak-int where() yields int64, and
+        # this jaxlib's Mosaic lowering recurses forever on an in-kernel
+        # int64->int32 convert (no 64-bit trunci rule).
+        aout_ref[0, pl.ds(s, 1), :] = jnp.where(
+            admit, jnp.int32(1), jnp.int32(0)
         ).reshape(1, 1)
         return carry
 
-    jax.lax.fori_loop(0, cnt, step, 0)
+    jax.lax.fori_loop(np.int32(0), cnt, step, np.int32(0))
 
 
 def pallas_admit_scan(
@@ -293,29 +314,29 @@ def pallas_admit_scan(
         functools.partial(_kernel, L),
         grid=(g_n,),
         in_specs=[
-            pl.BlockSpec((1, 1, 1), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, 1, 1), _im3,
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, S), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, 1, S), _im3,
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, nm, L), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, L), _im3,
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, S, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, S, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, nm, frp), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, nm, frp), _im3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S, 1), lambda g: (g, 0, 0),
+            pl.BlockSpec((1, S, 1), _im3,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
