@@ -116,6 +116,50 @@ def _pref_score(pmode, borrow, pref_preempt_over_borrow):
 
 
 _SNEG32 = jnp.int32(-(1 << 30))
+_I32_MAX = jnp.int32((1 << 31) - 1)
+
+
+def cast_arrays_i32(arrays: CycleArrays) -> CycleArrays:
+    """Quota tensors to int32 with CAP->CAP32 saturation semantics.
+
+    Exactness gate: ``pallas_scan.fits_int32(arrays)`` (every quantity and
+    worst-case accumulation below CAP32, priorities below INT32_MAX).
+    Halves the HBM traffic of the [W,F,R]-wide nominate phase and the
+    sort-key widths — the cycle-dominant cost at north-star scale is
+    bandwidth, not FLOPs. Only the no-preempt/no-TAS class uses this
+    (the pallas cycle); preemption/TAS kernels keep int64 inputs."""
+    tree = arrays.tree
+
+    def sat32(x):
+        return jnp.clip(x, -quota_ops.CAP32, quota_ops.CAP32).astype(
+            jnp.int32
+        )
+
+    def lim32(x, has):
+        return jnp.where(
+            has, sat32(x), quota_ops.CAP32
+        ).astype(jnp.int32)
+
+    tree32 = tree._replace(
+        nominal=sat32(tree.nominal),
+        subtree_quota=sat32(tree.subtree_quota),
+        borrow_limit=lim32(tree.borrow_limit, tree.has_borrow_limit),
+        lend_limit=lim32(tree.lend_limit, tree.has_lend_limit),
+    )
+    rep = dict(
+        tree=tree32,
+        usage=sat32(arrays.usage),
+        nominal_cq=sat32(arrays.nominal_cq),
+        w_req=sat32(arrays.w_req),
+        usage_by_prio=sat32(arrays.usage_by_prio),
+        # INT32_MAX keeps the "no bucket" sentinel semantics: fits_int32
+        # guarantees every real priority is strictly below it.
+        prio_cuts=jnp.minimum(arrays.prio_cuts, _I32_MAX).astype(jnp.int32),
+        w_priority=arrays.w_priority.astype(jnp.int32),
+    )
+    if getattr(arrays, "s_req", None) is not None:
+        rep["s_req"] = sat32(arrays.s_req)
+    return arrays._replace(**rep)
 
 
 def _policy_exists(pol, mincut, anyb, prio):
@@ -207,8 +251,11 @@ def _prefilter_aggregates(arrays: CycleArrays, usage: jnp.ndarray):
     tree_count = jnp.zeros_like(contrib, dtype=jnp.int32).at[root_of].add(
         contrib.astype(jnp.int32), mode="drop"
     )  # indexed by root node id
-    cuts = arrays.prio_cuts  # i64[B] sorted ascending
-    _PINF = jnp.int64(1) << 62
+    cuts = arrays.prio_cuts  # i64[B] sorted ascending (i32 in cast mode)
+    # "No bucket" sentinel: must exceed every real priority; dtype-max
+    # keeps the comparison in the cuts dtype (no silent i64 promotion on
+    # the [W,F,R]-wide gathers in the int32-cast mode).
+    _PINF = jnp.asarray(jnp.iinfo(cuts.dtype).max, cuts.dtype)
     has_same = arrays.usage_by_prio > 0  # [N,F,R,B]
     same_mincut = jnp.min(
         jnp.where(has_same, cuts, _PINF), axis=-1
@@ -637,9 +684,23 @@ def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
     borrows = jnp.where(nom.best_pmode > P_NOFIT, nom.best_borrow, 0)
     if getattr(arrays, "w_order_rank", None) is not None:
         # Host-precomputed (priority desc, timestamp, submission) rank:
-        # fold the dynamic keys on top into ONE composite int64 and sort
+        # fold the dynamic keys on top into ONE composite key and sort
         # once instead of five stable passes. Keys are unique (the rank
         # is a permutation), so an unstable sort is exact.
+        if w <= (1 << 25):
+            # int32 composite: rank(25) | borrows(4) | reserved | active.
+            # Borrow heights are tree heights <= MAX_DEPTH=8, so 4 bits
+            # are exact; an int32 sort is ~2x the int64 sort's speed on
+            # TPU (the sort is bandwidth-bound on (key, index) pairs).
+            key32 = (
+                (~arrays.w_active).astype(jnp.int32) * jnp.int32(1 << 30)
+                + (~arrays.w_quota_reserved).astype(jnp.int32)
+                * jnp.int32(1 << 29)
+                + jnp.clip(borrows, 0, 15).astype(jnp.int32)
+                * jnp.int32(1 << 25)
+                + arrays.w_order_rank.astype(jnp.int32)
+            )
+            return jnp.argsort(key32).astype(jnp.int32)
         key = (
             (~arrays.w_active).astype(jnp.int64) * (jnp.int64(1) << 40)
             + (~arrays.w_quota_reserved).astype(jnp.int64)

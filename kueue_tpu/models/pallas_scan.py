@@ -109,6 +109,14 @@ def fits_int32(arrays: CycleArrays) -> bool:
     # count bounds every per-group local id.
     if arrays.tree.parent.shape[0] >= (1 << _META_LOCAL_BITS):
         return False
+    # Priorities must be strictly below INT32_MAX so the int32-cast
+    # prefilter keeps its "no bucket" sentinel semantics
+    # (batch_scheduler.cast_arrays_i32). k8s priorities are int32 API
+    # fields, so this only excludes the literal INT32_MAX.
+    if arrays.w_cq.shape[0] and int(
+        jnp.max(jnp.abs(arrays.w_priority))
+    ) >= (1 << 31) - 1:
+        return False
     return finite_max + req_sum < CAP32
 
 
@@ -255,12 +263,15 @@ def pallas_admit_scan(
     usage_g = _to_g32(usage, ga, 0, g_n, nm, fr, frp)
 
     # --- slot bucketing (same one-sort layout as admit_scan_grouped) ---
-    rank = jnp.zeros(w_n, dtype=jnp.int64).at[order].set(
-        jnp.arange(w_n, dtype=jnp.int64)
+    # int32 (group, rank) keys when they fit: the sort is bandwidth-bound,
+    # so halving the key width matters at north-star scale.
+    kdt = jnp.int32 if (g_n + 1) * (w_n + 1) < (1 << 31) else jnp.int64
+    rank = jnp.zeros(w_n, dtype=kdt).at[order].set(
+        jnp.arange(w_n, dtype=kdt)
     )
-    g_w = ga.flat_to_group[arrays.w_cq].astype(jnp.int64)
+    g_w = ga.flat_to_group[arrays.w_cq].astype(kdt)
     sort_key = jnp.where(
-        arrays.w_active, g_w * w_n + rank, jnp.int64(w_n) * w_n + w_n
+        arrays.w_active, g_w * w_n + rank, kdt(g_n) * w_n + w_n
     )
     grouped_order = jnp.argsort(sort_key).astype(jnp.int32)
     counts = jnp.zeros(g_n, dtype=jnp.int32).at[
@@ -364,12 +375,18 @@ def pallas_admit_scan(
 
 
 def make_pallas_cycle(s_max: int, n_levels: int = quota_ops.MAX_DEPTH + 1,
-                      interpret: bool = False):
+                      interpret: bool = False, i32: bool = False):
     """Jittable no-preempt cycle with the Pallas admission scan. Same
     contract as ``bs.make_grouped_cycle(s_max, preempt=False)``; callers
-    gate on ``fits_int32(arrays)``."""
+    gate on ``fits_int32(arrays)``.
+
+    ``i32=True`` additionally runs the nominate/order phases on
+    int32-cast quota tensors (bs.cast_arrays_i32) — exact under the same
+    fits_int32 gate and half the HBM traffic of the [W,F,R]-wide phase."""
 
     def impl(arrays: CycleArrays, ga: bs.GroupArrays) -> bs.CycleOutputs:
+        if i32:
+            arrays = bs.cast_arrays_i32(arrays)
         usage = arrays.usage
         nom = bs.nominate(arrays, usage, n_levels=n_levels)
         order = bs.admission_order(arrays, nom)

@@ -32,10 +32,20 @@ MAX_DEPTH = 8
 
 I64 = jnp.int64
 CAP = jnp.int64(UNLIMITED)
+# int32-mode saturation cap: (1 << 30) - 1 so two in-range values add (and
+# subtract) without int32 overflow — the same role CAP plays for int64.
+# Bit-exactness of int32 quota math is gated by models.pallas_scan
+# fits_int32 (every quantity and worst-case accumulation below CAP32).
+CAP32 = jnp.int32((1 << 30) - 1)
+
+
+def _cap_of(dtype) -> jnp.ndarray:
+    return CAP32 if dtype == jnp.int32 else CAP
 
 
 def sat(v: jnp.ndarray) -> jnp.ndarray:
-    return jnp.clip(v, -CAP, CAP)
+    cap = _cap_of(jnp.result_type(v))
+    return jnp.clip(v, -cap, cap)
 
 
 def sat_add(a, b):
@@ -44,7 +54,8 @@ def sat_add(a, b):
 
 def sat_sub(a, b):
     """a - b with Unlimited minuend staying Unlimited."""
-    return jnp.where(a >= CAP, CAP, sat(a - b))
+    cap = _cap_of(jnp.result_type(a, b))
+    return jnp.where(a >= cap, cap, sat(a - b))
 
 
 _CAP_F = float(UNLIMITED)

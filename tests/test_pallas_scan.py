@@ -142,8 +142,9 @@ def build_random(seed, big=False):
     return arrays, layout
 
 
+@pytest.mark.parametrize("i32", [False, True])
 @pytest.mark.parametrize("seed", range(12))
-def test_pallas_matches_grouped_scan(seed):
+def test_pallas_matches_grouped_scan(seed, i32):
     arrays, layout = build_random(seed)
     assert fits_int32(arrays)
     ga = bs.GroupArrays(*layout.as_jax())
@@ -153,9 +154,9 @@ def test_pallas_matches_grouped_scan(seed):
         np.bincount(group_of, minlength=layout.n_groups).max()
     )
     ref = bs.make_grouped_cycle(s_exact, n_levels=n_levels)(arrays, ga)
-    out = make_pallas_cycle(s_exact, n_levels=n_levels, interpret=True)(
-        arrays, ga
-    )
+    out = make_pallas_cycle(
+        s_exact, n_levels=n_levels, interpret=True, i32=i32
+    )(arrays, ga)
     np.testing.assert_array_equal(
         np.asarray(ref.outcome), np.asarray(out.outcome)
     )

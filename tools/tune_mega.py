@@ -58,9 +58,21 @@ def main():
                     lambda: jax.jit(bs.make_grouped_cycle(s_cons))))
     configs.append(("fixedpoint", lambda: jax.jit(
         bs.make_fixedpoint_cycle(n_levels=n_levels))))
+    from kueue_tpu.models import pallas_scan as ps
+
+    configs.append(("pallas", lambda: jax.jit(
+        ps.make_pallas_cycle(s_exact, n_levels=n_levels))))
+    configs.append(("pallas32", lambda: jax.jit(
+        ps.make_pallas_cycle(s_exact, n_levels=n_levels, i32=True))))
     if args.configs:
         want = set(args.configs.split(","))
         configs = [(n, f) for n, f in configs if n in want]
+    if any(n.startswith("pallas") for n, _ in configs) \
+            and not ps.fits_int32(arrays):
+        log("pallas configs skipped: fits_int32(arrays) is False")
+        configs = [
+            (n, f) for n, f in configs if not n.startswith("pallas")
+        ]
 
     ref_admitted = None
     for name, mk in configs:
