@@ -41,12 +41,15 @@ from kueue_tpu.models import batch_scheduler as bs
 from kueue_tpu.models.encode import CycleArrays
 from kueue_tpu.ops import quota_ops
 
-# Saturation cap for the in-kernel int32 quota math. (1 << 30) - 1 so that
-# CAP32 + CAP32 still fits int32; plays the role of quota_ops.CAP
-# (UNLIMITED): sat_sub keeps an unlimited minuend unlimited, sat_add
-# clamps, and min(with_max_from_parent, avail) degenerates to avail for
-# unlimited borrow limits exactly like the int64 path.
-CAP32 = (1 << 30) - 1
+# Saturation cap for the in-kernel int32 quota math — the SAME constant
+# the dtype-aware saturation helpers clamp at (quota_ops.CAP32), so the
+# fits_int32 gate and the int32 arithmetic can never disagree. (1 << 30)
+# - 1 so that CAP32 + CAP32 still fits int32; plays the role of
+# quota_ops.CAP (UNLIMITED): sat_sub keeps an unlimited minuend
+# unlimited, sat_add clamps, and min(with_max_from_parent, avail)
+# degenerates to avail for unlimited borrow limits exactly like the
+# int64 path.
+CAP32 = int(quota_ops.CAP32)
 
 _META_LOCAL_BITS = 16  # low bits of slot meta = local node id
 _META_ADMIT = 1 << 16  # entry is FIT, active, in range, not host-deferred

@@ -29,6 +29,11 @@ from __future__ import annotations
 from typing import List, NamedTuple, Tuple
 
 import jax
+
+# Exact int64 placement math; without this a standalone import silently
+# truncates _INF (and every i64 tensor) to int32.
+jax.config.update("jax_enable_x64", True)
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -186,6 +191,102 @@ def segmented_greedy(
     )
     takes = jnp.zeros(d_n, jnp.int64).at[order].set(takes_sorted)
     return takes
+
+
+def segmented_greedy_leader(
+    values: jnp.ndarray,  # i64[D] plain capacity (slice/pod units)
+    values_wl: jnp.ndarray,  # i64[D] with-leader capacity
+    lead: jnp.ndarray,  # bool[D] domain can host the leader
+    cand: jnp.ndarray,  # bool[D]
+    seg: jnp.ndarray,  # i32[D]
+    target: jnp.ndarray,  # i64[D] per-position segment target
+    need_leader: jnp.ndarray,  # bool[D] segment consumes a leader
+    tiebreak_state: jnp.ndarray,  # i64[D]
+    primary_desc: jnp.ndarray,  # i64[D]
+    order_rank: jnp.ndarray = None,  # i64[D] explicit walk order override
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``updateCountsToMinimumGeneric`` with a leader (host
+    _update_counts_to_minimum, snapshot.py:626): the first leader-hosting
+    candidate jL in walk order consumes min(with_leader, remaining) and
+    keeps the leader; everyone else follows the standard walk
+    (full takes until a finisher, then the BestFit winner takes the
+    remainder). The leader branch engages only when no standard finisher
+    precedes jL — otherwise the walk finishes early and the leader is
+    dropped, exactly like the host (the early-return in the non-leader
+    branch never checks remaining_leaders).
+
+    Returns (takes i64[D], leader_at bool[D] — one-hot per engaged
+    segment marking the domain that kept the leader)."""
+    d_n = values.shape[0]
+    iota = jnp.arange(d_n)
+    if order_rank is None:
+        order = jnp.lexsort((
+            iota, tiebreak_state, -primary_desc, jnp.where(cand, 0, 1), seg
+        )).astype(jnp.int32)
+    else:
+        order = jnp.lexsort((
+            iota, order_rank, jnp.where(cand, 0, 1), seg
+        )).astype(jnp.int32)
+    v = jnp.where(cand, values, 0)[order]
+    vwl = jnp.where(cand, values_wl, 0)[order]
+    ld = (lead & cand)[order]
+    c = cand[order]
+    s = seg[order]
+    t_seg = target[order]
+    nl = need_leader[order]
+    head = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+
+    prefix, _ = _seg_excl_cumsum(v, head)
+    rem0 = t_seg - prefix
+    can_fin = c & (v >= rem0) & (rem0 > 0)
+    jF = _seg_min_scan(jnp.where(can_fin, iota, _INF), head)
+    jL = _seg_min_scan(jnp.where(ld, iota, _INF), head)
+    engaged = nl & (jL < _INF) & (jL <= jF)
+
+    # Standard walk (exact segmented_greedy semantics).
+    jF_c = jnp.clip(jF, 0, d_n - 1).astype(jnp.int32)
+    rem_star = jnp.where(jF < _INF, rem0[jF_c], 0)
+    suff = c & (iota >= jF) & (v >= rem_star) & (rem_star > 0)
+    bf_key = jnp.where(suff, v * d_n + iota, _INF)
+    winner = suff & (bf_key == _seg_min_scan(bf_key, head))
+    takes_std = jnp.where(
+        winner, rem_star,
+        jnp.where(c & (iota < jF) & (rem0 > 0), v, 0),
+    )
+
+    # Leader-engaged walk: jL takes min(with_leader, remaining-at-jL);
+    # positions after jL see the budget shifted by (v[jL] - takeL)
+    # because the standard prefix counted v[jL].
+    jL_c = jnp.clip(jL, 0, d_n - 1).astype(jnp.int32)
+    remL = jnp.where(jL < _INF, jnp.maximum(rem0[jL_c], 0), 0)
+    tL = jnp.minimum(vwl[jL_c], remL)
+    rem2 = rem0 + jnp.where(jL < _INF, v[jL_c] - tL, 0)
+    can_fin2 = c & (iota > jL) & (v >= rem2) & (rem2 > 0)
+    jF2 = _seg_min_scan(jnp.where(can_fin2, iota, _INF), head)
+    jF2_c = jnp.clip(jF2, 0, d_n - 1).astype(jnp.int32)
+    rem_star2 = jnp.where(jF2 < _INF, rem2[jF2_c], 0)
+    suff2 = c & (iota >= jF2) & (v >= rem_star2) & (rem_star2 > 0)
+    bf_key2 = jnp.where(suff2, v * d_n + iota, _INF)
+    winner2 = suff2 & (bf_key2 == _seg_min_scan(bf_key2, head))
+    at_jL = iota == jL
+    takes_led = jnp.where(
+        at_jL, tL,
+        jnp.where(
+            winner2, rem_star2,
+            jnp.where(
+                c & (iota < jL) & (rem0 > 0), v,
+                jnp.where(
+                    c & (iota > jL) & (iota < jF2) & (rem2 > 0), v, 0
+                ),
+            ),
+        ),
+    )
+
+    takes_sorted = jnp.where(engaged, takes_led, takes_std)
+    leader_sorted = engaged & at_jL
+    takes = jnp.zeros(d_n, jnp.int64).at[order].set(takes_sorted)
+    leader_at = jnp.zeros(d_n, bool).at[order].set(leader_sorted)
+    return takes, leader_at
 
 
 def entry_leaf_cap(arrays, t_idx, w=None):
@@ -447,8 +548,12 @@ def place(
     cap_override: jnp.ndarray = None,  # i64[D, R] entry's filtered leaf cap
     sizes: jnp.ndarray = None,  # i64[LMAX] inner slice unit per level
     balanced: jnp.ndarray = None,  # bool: balanced placement requested
+    leader_req: jnp.ndarray = None,  # i64[R] LWS leader pod requests
+    has_leader: jnp.ndarray = None,  # bool (traced; default True)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (feasible bool, leaf_take i64[D] pods per leaf domain).
+    """Returns (feasible bool, leaf_take i64[D] pods per leaf domain);
+    with ``leader_req`` given, (feasible, leaf_take, leader_take bool[D]
+    one-hot of the leaf hosting the LWS leader pod).
 
     ``cap_override`` replaces the topology's static leaf capacity for
     this entry — the per-entry analog of the host's node-selector/
@@ -487,10 +592,55 @@ def place(
     state_leaf = jnp.where(fits >= _INF, 0, fits)
     state_leaf = jnp.where(valid_at(leaf_l), state_leaf, 0)
 
+    wl = leader_req is not None  # static: leader planes compiled in
+    leaf_lc = jnp.clip(leaf_l, 0, LMAX - 1)
+    if wl:
+        if has_leader is None:
+            has_leader = jnp.asarray(True)
+        # Leaf leader planes (host fillLeafCounts + leader block,
+        # snapshot.py:366-385): leader_state = one leader pod fits the
+        # leaf's free capacity; state_with_leader = worker count on the
+        # leader-reduced capacity where the leader fits, else the plain
+        # worker count.
+        lfits = jnp.full(d_n, _INF, jnp.int64)
+        for r in range(r_n):
+            lfits = jnp.where(
+                leader_req[r] > 0,
+                jnp.minimum(
+                    lfits,
+                    jnp.maximum(free[:, r], 0)
+                    // jnp.maximum(leader_req[r], 1),
+                ),
+                lfits,
+            )
+        lead_leaf = valid_at(leaf_l) & (
+            jnp.where(lfits >= _INF, 0, lfits) > 0
+        )
+        free2 = free - leader_req[None, :]
+        fits2 = jnp.full(d_n, _INF, jnp.int64)
+        for r in range(r_n):
+            fits2 = jnp.where(
+                req[r] > 0,
+                jnp.minimum(
+                    fits2,
+                    jnp.maximum(free2[:, r], 0)
+                    // jnp.maximum(req[r], 1),
+                ),
+                fits2,
+            )
+        swl_leaf = jnp.where(
+            lead_leaf, jnp.where(fits2 >= _INF, 0, fits2), state_leaf
+        )
+        swl_leaf = jnp.where(valid_at(leaf_l), swl_leaf, 0)
+        leads = jnp.zeros((LMAX, d_n), bool).at[leaf_lc].set(lead_leaf)
+        states_wl = jnp.zeros((LMAX, d_n), jnp.int64).at[leaf_lc].set(
+            swl_leaf
+        )
+
     if sizes is None:
         sizes = jnp.ones(LMAX, jnp.int64)
     states = jnp.zeros((LMAX, d_n), jnp.int64)
-    states = states.at[jnp.clip(leaf_l, 0, LMAX - 1)].set(state_leaf)
+    states = states.at[leaf_lc].set(state_leaf)
     for s in range(1, LMAX):
         l = leaf_l - s
         lc = jnp.clip(l, 0, LMAX - 1)
@@ -505,10 +655,29 @@ def place(
         child = (child // inner_c) * inner_c
         acc = jnp.zeros(d_n, jnp.int64).at[pidx].add(child)
         states = jnp.where(l >= 0, states.at[lc].set(acc), states)
+        if wl:
+            # Parent with-leader state: total minus the smallest
+            # (state - state_with_leader) among leader-hosting children;
+            # zero without a leader contributor (host _roll_up_counts
+            # with leader_required=True, snapshot.py:426-442).
+            c_lead = jnp.where(valid_at(l + 1), leads[child_l], False)
+            c_swl = jnp.where(valid_at(l + 1), states_wl[child_l], 0)
+            c_swl = (c_swl // inner_c) * inner_c
+            diff = jnp.where(c_lead, child - c_swl, _INF)
+            min_diff = jnp.full(d_n, _INF, jnp.int64).at[pidx].min(diff)
+            has_contrib = jnp.zeros(d_n, bool).at[pidx].max(c_lead)
+            p_swl = jnp.where(has_contrib, acc - min_diff, 0)
+            states_wl = jnp.where(
+                l >= 0, states_wl.at[lc].set(p_swl), states_wl
+            )
+            leads = jnp.where(l >= 0, leads.at[lc].set(has_contrib), leads)
 
     sls = jnp.zeros((LMAX, d_n), jnp.int64)
     sl_lc = jnp.clip(slice_level, 0, LMAX - 1)
     sls = sls.at[sl_lc].set(states[sl_lc] // ss)
+    if wl:
+        sls_wl = jnp.zeros((LMAX, d_n), jnp.int64)
+        sls_wl = sls_wl.at[sl_lc].set(states_wl[sl_lc] // ss)
     for s in range(1, LMAX):
         l = slice_level - s
         lc = jnp.clip(l, 0, LMAX - 1)
@@ -517,6 +686,14 @@ def place(
         child = jnp.where(valid_at(l + 1), sls[child_l], 0)
         acc = jnp.zeros(d_n, jnp.int64).at[pidx].add(child)
         sls = jnp.where(l >= 0, sls.at[lc].set(acc), sls)
+        if wl:
+            c_lead = jnp.where(valid_at(l + 1), leads[child_l], False)
+            c_slwl = jnp.where(valid_at(l + 1), sls_wl[child_l], 0)
+            sdiff = jnp.where(c_lead, child - c_slwl, _INF)
+            min_sdiff = jnp.full(d_n, _INF, jnp.int64).at[pidx].min(sdiff)
+            has_contrib = jnp.zeros(d_n, bool).at[pidx].max(c_lead)
+            p_slwl = jnp.where(has_contrib, acc - min_sdiff, 0)
+            sls_wl = jnp.where(l >= 0, sls_wl.at[lc].set(p_slwl), sls_wl)
 
     # ---- phase 2a: level search -------------------------------------------
     lvl_iota = jnp.arange(LMAX)
@@ -560,6 +737,90 @@ def place(
         jnp.full(d_n, slice_count), st_start, sl_start,
     )
     take_slices = jnp.where(use_gather, gather_take, single_take)
+    leader_at = jnp.zeros(d_n, bool)
+
+    if wl:
+        # ---- phase 2a with a leader (host _find_level_with_fit with
+        # leader_count=1, snapshot.py:552-622). A level has a single-fit
+        # iff the with-leader sort's top — the leader-hosting domain with
+        # the highest slice_state_with_leader — covers the request.
+        fits_level_wl = jnp.max(
+            jnp.where(
+                valid_at(lvl_iota[:, None]) & (lvl_iota[:, None] < nl)
+                & leads, sls_wl, 0
+            ),
+            axis=1,
+        ) >= slice_count
+        walk_wl = fits_level_wl & (lvl_iota <= req_level) & (lvl_iota < nl)
+        deepest_wl = jnp.max(jnp.where(walk_wl, lvl_iota, -1))
+        single_level_w = jnp.where(
+            required | unconstrained, req_level, deepest_wl
+        )
+        single_ok_w = jnp.where(
+            required | unconstrained, fits_level_wl[req_lc], deepest_wl >= 0
+        )
+        use_gather_w = ~single_ok_w & ~required
+        start_level_w = jnp.where(use_gather_w, gather_level, single_level_w)
+        start_w_lc = jnp.clip(start_level_w, 0, LMAX - 1)
+        v_s = valid_at(start_level_w)
+        sl_s = jnp.where(v_s, sls[start_w_lc], 0)
+        st_s = jnp.where(v_s, states[start_w_lc], 0)
+        slwl_s = jnp.where(v_s, sls_wl[start_w_lc], 0)
+        swl_s = jnp.where(v_s, states_wl[start_w_lc], 0)
+        lead_s = v_s & leads[start_w_lc]
+        # With-leader sort rank (-leader, -slice_wl, state_wl, values).
+        ord_wl = jnp.lexsort(
+            (iota, swl_s, -slwl_s, jnp.where(lead_s, 0, 1))
+        ).astype(jnp.int32)
+        rank_wl = jnp.zeros(d_n, jnp.int64).at[ord_wl].set(
+            jnp.arange(d_n, dtype=jnp.int64)
+        )
+        # Single-domain winner: lowest sufficient slice_state_with_leader
+        # over ALL domains (host _best_fit_for_slices get=with-leader; a
+        # non-leader winner drops the leader in phase 2b, host-exactly).
+        suff_w = v_s & (slwl_s >= slice_count)
+        dstar_w = jnp.argmin(
+            jnp.where(suff_w, slwl_s * d_n + rank_wl, _INF)
+        )
+        # Top-gather phase L reduces to ONE pick (see the proof in
+        # segmented_greedy_leader's caller tests): if the top leader
+        # domain covers the request, the best-fit substitute wins and
+        # must itself host a leader or the gather fails ("not enough
+        # leader capacity"); otherwise the top leader domain is taken.
+        any_lead = jnp.any(lead_s)
+        top_suff = jnp.max(jnp.where(lead_s, slwl_s, -1)) >= slice_count
+        pickB = jnp.argmin(jnp.where(lead_s, rank_wl, _INF))
+        pick = jnp.where(top_suff, dstar_w, pickB)
+        ok_L = any_lead & jnp.where(top_suff, lead_s[dstar_w], True)
+        remaining_after = slice_count - slwl_s[pick]
+        rest_total = jnp.sum(jnp.where(v_s & (iota != pick), sl_s, 0))
+        gather_ok_w = ok_L & (rest_total >= remaining_after)
+        feasible_w = single_ok_w | (use_gather_w & gather_ok_w)
+
+        # Phase 2b: one leader-aware walk covers both cases — the
+        # single-domain winner as a singleton candidate set, or the
+        # gather's selection order (leader pick first, then the plain
+        # BestFit order).
+        cand0 = jnp.where(
+            use_gather_w, v_s, iota == dstar_w
+        )
+        rank_plain = jnp.zeros(d_n, jnp.int64).at[
+            jnp.lexsort((iota, st_s, -sl_s)).astype(jnp.int32)
+        ].set(jnp.arange(d_n, dtype=jnp.int64))
+        ordr0 = jnp.where(
+            use_gather_w & (iota == pick), jnp.int64(-1), rank_plain
+        )
+        takes0_w, lead0 = segmented_greedy_leader(
+            sl_s, slwl_s, lead_s, cand0, jnp.zeros(d_n, jnp.int32),
+            jnp.full(d_n, slice_count),
+            jnp.broadcast_to(has_leader, (d_n,)),
+            st_s, sl_s, order_rank=ordr0,
+        )
+        feasible = jnp.where(has_leader, feasible_w, feasible)
+        start_level = jnp.where(has_leader, start_level_w, start_level)
+        use_gather = jnp.where(has_leader, use_gather_w, use_gather)
+        take_slices = jnp.where(has_leader, takes0_w, take_slices)
+        leader_at = jnp.where(has_leader, lead0, leader_at)
 
     # Convert to pods immediately when the start level IS the slice level
     # (or deeper: start <= slice_level always holds).
@@ -593,9 +854,32 @@ def place(
         # sorts children before recomputing inner-unit slice states
         # (snapshot.py:1141-1147), so an inner layer changes candidate
         # values/targets but NOT the walk order.
-        new_take = segmented_greedy(
-            values, child_valid, seg, target, st_child, sl_child
-        )
+        if wl:
+            # Free slice redistribution re-engages the original leader
+            # count at every level (host passes the function-level
+            # leader_count, snapshot.py:1140); per-parent distribution
+            # consumes the parent's kept leader (dom.leader_state,
+            # :1166-1171).
+            slwl_child = jnp.where(valid_at(child_level), sls_wl[child_lc], 0)
+            swl_child = jnp.where(
+                valid_at(child_level), states_wl[child_lc], 0
+            )
+            lead_child = valid_at(child_level) & leads[child_lc]
+            values_wl = jnp.where(mode_a, slwl_child, swl_child // inner)
+            need = jnp.where(
+                mode_a,
+                jnp.broadcast_to(has_leader, (d_n,)),
+                leader_at[pidx],
+            )
+            new_take, new_lead = segmented_greedy_leader(
+                values, values_wl, lead_child, child_valid, seg, target,
+                need, st_child, sl_child,
+            )
+            leader_at = jnp.where(active, new_lead, leader_at)
+        else:
+            new_take = segmented_greedy(
+                values, child_valid, seg, target, st_child, sl_child
+            )
         # Slice->pod conversion when the child level is the slice level;
         # inner-layer units always convert back to pods immediately.
         to_pods = mode_a & (child_level == slice_level)
@@ -619,14 +903,20 @@ def place(
     if balanced is not None:
         # Balanced placement wins over the standard path when it succeeds
         # (host snapshot.py:1099-1125); on failure the standard result
-        # above stands (reference falls back to BestFit).
+        # above stands (reference falls back to BestFit). Balanced with
+        # a leader stays on the host path (encode gate).
         bal_ok, bal_take = _balanced_place(
             topo, t, states, sls, req_level, slice_level, ss,
             slice_count, count, leaf_l,
         )
         bal_sel = balanced & ~required & ~unconstrained & bal_ok
+        if wl:
+            bal_sel = bal_sel & ~has_leader
         feasible = jnp.where(bal_sel, True, feasible)
         leaf_take = jnp.where(bal_sel, bal_take, leaf_take)
+    if wl:
+        leader_take = leader_at & feasible & has_leader & valid_at(leaf_l)
+        return feasible, leaf_take, leader_take
     return feasible, leaf_take
 
 

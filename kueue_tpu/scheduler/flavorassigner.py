@@ -324,12 +324,20 @@ class FlavorAssigner:
         attach: bool,
     ) -> bool:
         """Find topology placements for every TAS podset of the
-        assignment. Accumulates assumed usage across podsets so sibling
-        podsets of one workload don't double-book domains. Returns False if
-        any TAS podset has no placement."""
+        assignment. Podsets sharing a podset_group_name place as ONE
+        request: for a two-podset group the smaller-count podset is the
+        LWS leader whose single pod must land with the workers
+        (reference tas_flavor_snapshot.go:651-737 findLeaderAndWorkers;
+        leaderRequests = leader pod requests + OnePodRequest :963-965).
+        Accumulates assumed usage across groups so sibling podsets of one
+        workload don't double-book domains. Returns False if any TAS
+        podset has no placement."""
         from kueue_tpu.tas.snapshot import PlacementRequest
 
-        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        # Group TAS podsets (reference :651: index-keyed unless a
+        # podset_group_name joins them).
+        groups: List[List[int]] = []
+        group_of: Dict[str, int] = {}
         for i, psa in enumerate(assignment.pod_sets):
             if i >= len(self.wl.obj.pod_sets):
                 continue
@@ -337,16 +345,52 @@ class FlavorAssigner:
             tr = ps.topology_request
             if tr is None or not psa.flavors:
                 continue
+            gname = getattr(tr, "podset_group_name", None)
+            if gname and gname in group_of:
+                groups[group_of[gname]].append(i)
+                continue
+            if gname:
+                group_of[gname] = len(groups)
+            groups.append([i])
+
+        assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
+        for members in groups:
+            # Two-podset group: leader = the smaller-count member
+            # (reference findLeaderAndWorkers :726-737).
+            leader_i: Optional[int] = None
+            worker_i = members[0]
+            if len(members) > 1:
+                leader_i = members[1]
+                if (self.wl.obj.pod_sets[leader_i].count
+                        > self.wl.obj.pod_sets[worker_i].count):
+                    leader_i, worker_i = worker_i, leader_i
+            ps = self.wl.obj.pod_sets[worker_i]
+            psa = assignment.pod_sets[worker_i]
+            tr = ps.topology_request
             if self.delay_tas:
-                psa.delayed_topology_request = True
+                for i in members:
+                    assignment.pod_sets[i].delayed_topology_request = True
                 continue
             flavor_name = next(iter(psa.flavors.values())).name
             tas = self.tas_flavors.get(flavor_name)
             if tas is None:
                 if self.allow_delayed_tas:
-                    psa.delayed_topology_request = True
+                    for i in members:
+                        assignment.pod_sets[i].delayed_topology_request = \
+                            True
                     continue
                 return False
+            leader_requests = None
+            if leader_i is not None:
+                lr = dict(self.wl.obj.pod_sets[leader_i].requests)
+                # OnePodRequest analog (reference :965): the leader
+                # occupies one pod slot — only meaningful on fleets that
+                # track a "pods" node capacity (k8s nodes always do; a
+                # bare TPU fleet may not, and an unbacked request would
+                # zero the leader's fit count).
+                if "pods" in tas._res_index:
+                    lr["pods"] = lr.get("pods", 0) + 1
+                leader_requests = lr
             req = PlacementRequest(
                 count=psa.count,
                 single_pod_requests=dict(ps.requests),
@@ -359,8 +403,9 @@ class FlavorAssigner:
                 node_selector=dict(ps.node_selector),
                 tolerations=list(ps.tolerations),
                 balanced=getattr(tr, "balanced", False),
+                leader_requests=leader_requests,
             )
-            ta, _leader_ta, reason = tas.find_topology_assignment(
+            ta, leader_ta, reason = tas.find_topology_assignment(
                 req, simulate_empty=simulate_empty,
                 assumed_usage=assumed.get(flavor_name),
             )
@@ -369,13 +414,23 @@ class FlavorAssigner:
                 return False
             if attach:
                 psa.topology_assignment = ta
-            # Track assumed usage for subsequent podsets.
+                if leader_i is not None:
+                    assignment.pod_sets[leader_i].topology_assignment = \
+                        leader_ta
+            # Track assumed usage for subsequent groups.
             dst_f = assumed.setdefault(flavor_name, {})
             for values, count in ta.domains:
                 leaf_id = "/".join(values)
                 dst = dst_f.setdefault(leaf_id, {})
                 for res, v in ps.requests.items():
                     dst[res] = dst.get(res, 0) + v * count
+            if leader_i is not None and leader_ta is not None:
+                lreq = self.wl.obj.pod_sets[leader_i].requests
+                for values, count in leader_ta.domains:
+                    leaf_id = "/".join(values)
+                    dst = dst_f.setdefault(leaf_id, {})
+                    for res, v in lreq.items():
+                        dst[res] = dst.get(res, 0) + v * count
         return True
 
     def _append(

@@ -9,7 +9,7 @@ monotonicity, clusterName transitions).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 from kueue_tpu.api.constants import BorrowWithinCohortPolicy, PreemptionPolicy
 from kueue_tpu.api.types import ClusterQueue, Cohort, ResourceFlavor, Workload
@@ -169,6 +169,32 @@ def validate_workload(wl: Workload) -> None:
                 raise ValueError("podSetSliceSize must be > 0")
     if variable_count > 1:
         raise ValueError("at most one podSet can use minCount")
+
+    # Podset-group shape (reference jobframework/tas_validation.go:213
+    # ValidatePodSetGroupingTopology): exactly 2 podsets per group, at
+    # least one with a single replica (the LWS leader); grouping is
+    # incompatible with slice constraints (:77-81).
+    group_members: Dict[str, list] = {}
+    for ps in wl.pod_sets:
+        tr = ps.topology_request
+        if tr is not None and getattr(tr, "podset_group_name", None):
+            if tr.slice_required_level is not None:
+                raise ValueError(
+                    "podSetGroupName may not be combined with"
+                    " podSetSliceRequiredTopology"
+                )
+            group_members.setdefault(tr.podset_group_name, []).append(ps)
+    for gname, members in group_members.items():
+        if len(members) != 2:
+            raise ValueError(
+                f"podset group {gname!r} can only define groups of exactly"
+                f" 2 pod sets, got: {len(members)}"
+            )
+        if all(ps.count != 1 for ps in members):
+            raise ValueError(
+                f"podset group {gname!r} needs at least one pod set with"
+                " only 1 replica"
+            )
 
     # Status-side invariants (validateAdmission / validateAdmissionChecks).
     adm = wl.status.admission

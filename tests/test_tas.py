@@ -238,6 +238,86 @@ def test_e2e_tas_two_gangs_get_disjoint_racks():
     assert not (d1 & d2), f"overlapping node assignment: {d1 & d2}"
 
 
+def test_e2e_lws_leader_places_with_workers():
+    """LeaderWorkerSet x TAS: leader and worker podsets sharing a
+    podset_group_name place as ONE topology request — the 1-pod leader
+    lands in the workers' topology domain (reference
+    tas_flavor_snapshot.go:651-737 + :1137-1154)."""
+    cache, queues, sched = tas_env()
+    wl = Workload(
+        name="lws",
+        queue_name="lq",
+        pod_sets=[
+            PodSet(
+                name="leader", count=1, requests={"tpu": 1},
+                topology_request=TopologyRequest(
+                    required_level=LEVELS[1], podset_group_name="g",
+                ),
+            ),
+            PodSet(
+                name="workers", count=2, requests={"tpu": 3},
+                topology_request=TopologyRequest(
+                    required_level=LEVELS[1], podset_group_name="g",
+                ),
+            ),
+        ],
+        creation_time=1.0,
+    )
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["lws"]
+    adm = admission_of(cache, "lws")
+    worker_ta = adm.pod_set_assignments[1].topology_assignment
+    leader_ta = adm.pod_set_assignments[0].topology_assignment
+    assert worker_ta is not None and leader_ta is not None
+    assert sum(c for _, c in worker_ta.domains) == 2
+    assert sum(c for _, c in leader_ta.domains) == 1
+    # The leader lands in the workers' rack: node names are
+    # node-{block}-{rack}-{n}, so the "block-rack" prefix must match.
+    def rack_of(values):
+        parts = values[-1].split("-")
+        return tuple(parts[1:3])
+
+    worker_racks = {rack_of(v) for v, _ in worker_ta.domains}
+    leader_rack = rack_of(leader_ta.domains[0][0])
+    assert leader_rack in worker_racks, (
+        f"leader in rack {leader_rack}, workers in {worker_racks}"
+    )
+
+
+def test_e2e_lws_leader_requests_counted_in_quota():
+    """The leader podset's quota flows through the normal flavor
+    assignment: leader 1x1 + workers 2x3 = 7 tpu booked."""
+    cache, queues, sched = tas_env()
+    wl = Workload(
+        name="lws2",
+        queue_name="lq",
+        pod_sets=[
+            PodSet(
+                name="leader", count=1, requests={"tpu": 1},
+                topology_request=TopologyRequest(
+                    preferred_level=LEVELS[1], podset_group_name="g",
+                ),
+            ),
+            PodSet(
+                name="workers", count=2, requests={"tpu": 3},
+                topology_request=TopologyRequest(
+                    preferred_level=LEVELS[1], podset_group_name="g",
+                ),
+            ),
+        ],
+        creation_time=1.0,
+    )
+    submit(queues, wl)
+    sched.schedule_all()
+    assert admitted_names(cache) == ["lws2"]
+    snap = cache.snapshot()
+    cqs = snap.cluster_queues["cq-a"]
+    from kueue_tpu.core.resources import FlavorResource
+
+    assert cqs.usage_for(FlavorResource("tpu-v5e", "tpu")) == 7
+
+
 def test_e2e_tas_usage_released_on_delete():
     cache, queues, sched = tas_env()
     for i in range(4):

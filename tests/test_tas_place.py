@@ -86,6 +86,96 @@ def random_usage(rng: random.Random, tas: TASFlavorSnapshot):
     return usage
 
 
+@pytest.mark.parametrize("seed", range(80))
+def test_place_with_leader_matches_host(seed):
+    """LWS leader differential: the device kernel's with-leader planes,
+    level search, gather pick and leader-aware greedy must reproduce the
+    host's worker AND leader assignments (find_topology_assignment with
+    leader_requests, reference tas_flavor_snapshot.go:963-1154)."""
+    rng = random.Random(41000 + seed)
+    topo_spec, nodes = random_topology(rng)
+    tas = TASFlavorSnapshot(topo_spec, nodes)
+    tas.usage = random_usage(rng, tas)
+    req = random_request(rng, topo_spec.levels)
+    req.leader_requests = {
+        "tpu": rng.choice([1, 2, 4, 8]),
+        **({"memory": rng.choice([100, 500, 2000])}
+           if rng.random() < 0.5 else {}),
+    }
+
+    ta, leader_ta, reason = tas.find_topology_assignment(req)
+    host_ok = not reason
+    host_counts = {}
+    host_leader = {}
+    if host_ok:
+        for values, cnt in ta.domains:
+            leaf_id = tas._canonical_leaf_id("/".join(values))
+            host_counts[leaf_id] = host_counts.get(leaf_id, 0) + cnt
+        for values, cnt in leader_ta.domains:
+            leaf_id = tas._canonical_leaf_id("/".join(values))
+            host_leader[leaf_id] = host_leader.get(leaf_id, 0) + cnt
+
+    resource_of = {"tpu": 0, "memory": 1}
+    dev_topo, flavors, leaf_perms = encode_device_topos(
+        {"f": tas}, ["f"], resource_of
+    )
+    d_n = dev_topo.leaf_cap.shape[1]
+    leaf_usage = np.zeros((d_n, 3), np.int64)
+    perm = leaf_perms[0]
+    host_leaf_ids = [leaf.id for leaf in tas.leaves]
+    for j, hi in enumerate(perm):
+        used = tas.usage.get(host_leaf_ids[hi], {})
+        leaf_usage[j, 0] = used.get("tpu", 0)
+        leaf_usage[j, 1] = used.get("memory", 0)
+
+    levels = topo_spec.levels
+    level_key = req.required_level or req.preferred_level
+    if req.unconstrained and level_key is None:
+        level_key = levels[-1]
+    req_level = levels.index(level_key)
+    if req.slice_required_level is not None:
+        slice_level = levels.index(req.slice_required_level)
+        slice_size = req.slice_size
+    else:
+        slice_level = len(levels) - 1
+        slice_size = 1
+
+    feasible, leaf_take, leader_take = place(
+        dev_topo, jnp.int32(0), jnp.asarray(leaf_usage),
+        jnp.asarray([req.single_pod_requests.get("tpu", 0),
+                     req.single_pod_requests.get("memory", 0), 1],
+                    dtype=jnp.int64),
+        jnp.int64(req.count), jnp.int64(slice_size),
+        jnp.int32(slice_level), jnp.int32(req_level),
+        jnp.asarray(req.required_level is not None),
+        jnp.asarray(req.unconstrained),
+        leader_req=jnp.asarray(
+            [req.leader_requests.get("tpu", 0),
+             req.leader_requests.get("memory", 0), 1], dtype=jnp.int64
+        ),
+    )
+    feasible = bool(feasible)
+    assert feasible == host_ok, (
+        f"feasibility differs: host={host_ok} ({reason}) device={feasible}"
+    )
+    if host_ok:
+        dev_counts = {}
+        dev_leader = {}
+        take = np.asarray(leaf_take)
+        ltake = np.asarray(leader_take)
+        for j, hi in enumerate(perm):
+            if take[j]:
+                dev_counts[host_leaf_ids[hi]] = int(take[j])
+            if ltake[j]:
+                dev_leader[host_leaf_ids[hi]] = 1
+        assert dev_counts == host_counts, (
+            f"placement differs:\n host={host_counts}\n dev ={dev_counts}"
+        )
+        assert dev_leader == host_leader, (
+            f"leader differs:\n host={host_leader}\n dev ={dev_leader}"
+        )
+
+
 @pytest.mark.parametrize("seed", range(120))
 def test_place_matches_host(seed):
     rng = random.Random(7000 + seed)
