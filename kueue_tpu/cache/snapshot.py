@@ -203,7 +203,12 @@ def build_quota_tree(
                     q.nominal, q.borrowing_limit, q.lending_limit
                 )
         if cohort.fair_sharing is not None:
-            node.fair_weight = cohort.fair_sharing.weight
+            # nil weight defaults to 1 (reference FairSharing.Weight
+            # *Quantity, fair_sharing.go dominantResourceShare).
+            node.fair_weight = (
+                1.0 if cohort.fair_sharing.weight is None
+                else cohort.fair_sharing.weight
+            )
         if cohort.parent:
             parent = cohort_node(cohort.parent)
             node.parent = parent
@@ -219,7 +224,10 @@ def build_quota_tree(
                         q.nominal, q.borrowing_limit, q.lending_limit
                     )
         if cq.fair_sharing is not None:
-            node.fair_weight = cq.fair_sharing.weight
+            node.fair_weight = (
+                1.0 if cq.fair_sharing.weight is None
+                else cq.fair_sharing.weight
+            )
         if cq.cohort:
             parent = cohort_node(cq.cohort)
             node.parent = parent

@@ -519,7 +519,14 @@ class Manager:
             for lq_key, usage in running.items():
                 lq = self.cache.local_queues.get(lq_key)
                 if lq is not None and lq.fair_sharing is not None:
-                    tracker.set_lq_weight(lq_key, lq.fair_sharing.weight)
+                    # nil weight defaults to 1 (reference FairSharing
+                    # semantics) — and must RESET a previously set
+                    # weight in the persistent tracker.
+                    tracker.set_lq_weight(
+                        lq_key,
+                        1.0 if lq.fair_sharing.weight is None
+                        else lq.fair_sharing.weight,
+                    )
                 tracker.sample(lq_key, usage, now)
         self.tas_failure.reconcile()
         for wl in list(self.workloads.values()):

@@ -81,6 +81,10 @@ class NominateResult(NamedTuple):
     s_pmode: jnp.ndarray = None  # i32[W,S]
     s_borrow: jnp.ndarray = None  # i32[W,S]
     s_tried: jnp.ndarray = None  # i32[W,S] (-1 = wrapped)
+    # Per-slot preemption-eligibility signals (device victim search):
+    s_praw_count: jnp.ndarray = None  # i32[W,S] praw flavors seen by slot
+    s_praw_stop: jnp.ndarray = None  # bool[W,S] slot stopped at praw flavor
+    s_considered: jnp.ndarray = None  # i32[W,S] flavors considered by slot
 
 
 class CycleOutputs(NamedTuple):
@@ -104,6 +108,9 @@ class CycleOutputs(NamedTuple):
     # the driver maps them straight to TopologyAssignment domains instead
     # of replaying the host placement engine (None when no TAS).
     tas_takes: jnp.ndarray = None  # i32[W,D]
+    # LWS leader leaf one-hot per admitted leader-group entry (None when
+    # no leader-group entry this cycle).
+    tas_leader_takes: jnp.ndarray = None  # i32[W,D]
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -176,23 +183,34 @@ def _policy_exists(pol, mincut, anyb, prio):
     )
 
 
-def _fungibility_scan(rep_pmode, rep_borrow, rep_score, f_k, n_fl, start,
+def _fungibility_scan(rep_pmode, rep_borrow, pob_w, f_k, n_fl, start,
                       preempt_try_next, borrow_try_next):
     """First-stop/argmax fungibility scan over the [W,K] preference axis
     (flavorassigner.go:1142 shouldTryNextFlavor + the strictly-preferred
     best keep). Shared by the legacy and slot nominate paths — any rule
     change lands in both automatically. Returns
-    (b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons)."""
+    (b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons).
+
+    Per-workload fancy-index gathers (``x[w_iota, f_k]``) lower to scalar
+    gathers on TPU and dominated the cycle (~18 ms each at 50k); the
+    [W,F]->[W,K] permutation is instead one onehot contraction of a
+    packed (pmode, borrow) payload, and the per-row scalar picks are
+    K-onehot masked reductions — elementwise + reduce only."""
     w_n, k_n = f_k.shape
-    w_iota = jnp.arange(w_n)
+    f_n = rep_pmode.shape[1]
     k_iota = jnp.arange(k_n, dtype=jnp.int32)
     pos_valid = (
         (k_iota[None, :] < n_fl[:, None])
         & (k_iota[None, :] >= start[:, None])
     )
-    pm_k = rep_pmode[w_iota[:, None], f_k]
-    bw_k = rep_borrow[w_iota[:, None], f_k]
-    sc_k = rep_score[w_iota[:, None], f_k]
+    # pmode <= 4 and borrow <= MAX_DEPTH (8) pack into 7 bits.
+    payload = (rep_pmode * 16 + rep_borrow).astype(jnp.int32)  # [W,F]
+    oh_f = f_k[:, :, None] == jnp.arange(f_n, dtype=f_k.dtype)[None, None, :]
+    pay_k = jnp.sum(jnp.where(oh_f, payload[:, None, :], 0), axis=2)
+    pm_k = pay_k // 16
+    bw_k = pay_k % 16
+    sc_k = jnp.where(pob_w[:, None], -bw_k * 16 + pm_k, pm_k * 16 - bw_k)
+    sc_k = jnp.where(pm_k == P_NOFIT, _SNEG32, sc_k).astype(jnp.int32)
     should_try_next = (
         (pm_k == P_NOFIT)
         | (pm_k == P_NO_CANDIDATES)
@@ -213,7 +231,9 @@ def _fungibility_scan(rep_pmode, rep_borrow, rep_score, f_k, n_fl, start,
     is_praw_k = considered & (pm_k == P_PREEMPT_RAW)
     praw_n = jnp.sum(is_praw_k, axis=1).astype(jnp.int32)
     kstop_c = jnp.clip(kstop, 0, k_n - 1)
-    praw_stop = any_stop & (pm_k[w_iota, kstop_c] == P_PREEMPT_RAW)
+    oh_stop = k_iota[None, :] == kstop_c[:, None]
+    pm_stop = jnp.sum(jnp.where(oh_stop, pm_k, 0), axis=1)
+    praw_stop = any_stop & (pm_stop == P_PREEMPT_RAW)
 
     # Best-scoring considered flavor, first occurrence winning ties (the
     # host scan's strict-> update); a stop takes its own flavor outright.
@@ -221,12 +241,15 @@ def _fungibility_scan(rep_pmode, rep_borrow, rep_score, f_k, n_fl, start,
     k_best = jnp.argmax(sc_masked, axis=1).astype(jnp.int32)
     none_considered = ~jnp.any(considered & (sc_k > _SNEG32), axis=1)
     k_take = jnp.where(any_stop, kstop_c, jnp.clip(k_best, 0, k_n - 1))
-    b_f = jnp.where(none_considered & ~any_stop, -1,
-                    f_k[w_iota, k_take]).astype(jnp.int32)
-    b_pm = jnp.where(none_considered & ~any_stop, P_NOFIT,
-                     pm_k[w_iota, k_take]).astype(jnp.int32)
-    b_bw = jnp.where(none_considered & ~any_stop, 0,
-                     bw_k[w_iota, k_take]).astype(jnp.int32)
+    oh_take = k_iota[None, :] == k_take[:, None]
+
+    def pick(v):
+        return jnp.sum(jnp.where(oh_take, v, 0), axis=1)
+
+    miss = none_considered & ~any_stop
+    b_f = jnp.where(miss, -1, pick(f_k)).astype(jnp.int32)
+    b_pm = jnp.where(miss, P_NOFIT, pick(pm_k)).astype(jnp.int32)
+    b_bw = jnp.where(miss, 0, pick(bw_k)).astype(jnp.int32)
     return b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons
 
 
@@ -358,22 +381,24 @@ def nominate(arrays: CycleArrays, usage: jnp.ndarray,
     score_cell = jnp.where(cell_active, score_cell,
                            jnp.broadcast_to(best_inactive, score_cell.shape))
     rep_idx = jnp.argmin(score_cell, axis=2)  # [W,F] worst resource
-    f_iota = jnp.arange(f_n)
-    rep_pmode = pmode_cell[w_iota[:, None], f_iota[None, :], rep_idx]
-    rep_borrow = borrow_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+    # Extract the argmin cell's (pmode, borrow) with an R-onehot masked
+    # reduction — the [W,F]-indexed gather lowers to 1.6M scalar gathers
+    # on TPU (~20 ms at 50k); the onehot is fused elementwise.
+    oh_r = (
+        jnp.arange(r_n, dtype=jnp.int32)[None, None, :]
+        == rep_idx[..., None]
+    )
+    rep_pmode = jnp.sum(jnp.where(oh_r, pmode_cell, 0), axis=2)
+    rep_borrow = jnp.sum(jnp.where(oh_r, borrow_cell, 0), axis=2)
     # A flavor failing taints/affinity is NOFIT outright
     # (checkFlavorForPodSets precedes the quota loop).
     rep_pmode = jnp.where(arrays.w_elig, rep_pmode, P_NOFIT)
     rep_borrow = jnp.where(arrays.w_elig, rep_borrow, 0)
-    pob_w = arrays.pref_preempt_over_borrow[c][:, None]
-    rep_score = jnp.where(
-        pob_w, -rep_borrow * 16 + rep_pmode, rep_pmode * 16 - rep_borrow
-    )
-    rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
 
     # ---- fungibility scan as first-stop/argmax over [W,K] ----------------
     b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons = _fungibility_scan(
-        rep_pmode, rep_borrow, rep_score, arrays.flavor_at[c],
+        rep_pmode, rep_borrow, arrays.pref_preempt_over_borrow[c],
+        arrays.flavor_at[c],
         arrays.n_flavors[c], arrays.w_start_flavor,
         arrays.when_can_preempt_try_next[c],
         arrays.when_can_borrow_try_next[c],
@@ -467,22 +492,21 @@ def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
             jnp.broadcast_to(best_inactive, score_cell.shape),
         )
         rep_idx = jnp.argmin(score_cell, axis=2)  # [W,F] worst resource
-        rep_pmode = pmode_cell[w_iota[:, None], f_iota[None, :], rep_idx]
-        rep_borrow = borrow_cell[w_iota[:, None], f_iota[None, :], rep_idx]
+        oh_r = (
+            jnp.arange(r_n, dtype=jnp.int32)[None, None, :]
+            == rep_idx[..., None]
+        )
+        rep_pmode = jnp.sum(jnp.where(oh_r, pmode_cell, 0), axis=2)
+        rep_borrow = jnp.sum(jnp.where(oh_r, borrow_cell, 0), axis=2)
         elig = arrays.s_elig[:, s]
         rep_pmode = jnp.where(elig, rep_pmode, P_NOFIT)
         rep_borrow = jnp.where(elig, rep_borrow, 0)
-        pob_w = arrays.pref_preempt_over_borrow[c][:, None]
-        rep_score = jnp.where(
-            pob_w, -rep_borrow * 16 + rep_pmode,
-            rep_pmode * 16 - rep_borrow,
-        )
-        rep_score = jnp.where(rep_pmode == P_NOFIT, _SNEG, rep_score)
 
         # Fungibility scan over the slot's own flavor list.
         b_f, b_pm, b_bw, att, praw_n, praw_stop, n_cons = \
             _fungibility_scan(
-                rep_pmode, rep_borrow, rep_score,
+                rep_pmode, rep_borrow,
+                arrays.pref_preempt_over_borrow[c],
                 arrays.s_flavor_at[:, s], arrays.s_n_flavors[:, s],
                 arrays.s_start[:, s],
                 arrays.when_can_preempt_try_next[c],
@@ -553,6 +577,9 @@ def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
         s_pmode=jnp.where(eff, s_pm, P_NOFIT).astype(jnp.int32),
         s_borrow=s_bw,
         s_tried=s_tried,
+        s_praw_count=s_praw_n,
+        s_praw_stop=s_praw_stop,
+        s_considered=s_cons,
     )
 
 
@@ -941,6 +968,9 @@ def admit_scan_grouped(
     with_preempt = targets is not None
     with_tas = getattr(arrays, "tas_topo", None) is not None
     with_slots = getattr(arrays, "s_req", None) is not None
+    with_leader = (
+        with_tas and getattr(arrays, "w_tas_leader_req", None) is not None
+    )
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as _P
@@ -1015,7 +1045,7 @@ def admit_scan_grouped(
     chain_is_repeat = gsh(ga.chain_local == chain_next)  # [G,Nm,D+1]
 
     def body(carry, s):
-        usage_g, designated, tas_usage, w_takes = carry
+        usage_g, designated, tas_usage, w_takes, w_ltakes = carry
         pos = starts + s
         in_range = s < counts
         # Per-step gathers pull from REPLICATED [W]/[N] sources with a
@@ -1192,11 +1222,13 @@ def admit_scan_grouped(
             bal_all = arrays.w_tas_balanced
 
             def place_one(t, req_v, cnt, ssz, sl_, rl_, rq_, un_, cap_,
-                          sz_, bal_=None):
+                          sz_, bal_=None, leader_req_=None,
+                          has_leader_=None):
                 return _tas_place.place(
                     arrays.tas_topo, t, tas_usage[t], req_v, cnt, ssz,
                     jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
                     cap_override=cap_, sizes=sz_, balanced=bal_,
+                    leader_req=leader_req_, has_leader=has_leader_,
                 )
 
             cap_g = _tas_place.entry_leaf_cap(arrays, t_idx_g, w=w)
@@ -1209,9 +1241,24 @@ def admit_scan_grouped(
             )
             if bal_all is not None:
                 place_args = place_args + (bal_all[w],)
-            tas_feas, tas_take = jax.vmap(place_one)(
-                *place_args
-            )  # [G], [G, D]
+            if with_leader:
+                # LWS groups: leader planes through the placement kernel
+                # (reference tas_flavor_snapshot.go:725); entries without
+                # a leader pass has_leader=False and place identically to
+                # the plain kernel.
+                out_p = jax.vmap(
+                    lambda lr, hl, *a: place_one(
+                        *a, leader_req_=lr, has_leader_=hl
+                    ),
+                    in_axes=(0, 0) + (0,) * len(place_args),
+                )(arrays.w_tas_leader_req[w],
+                  arrays.w_tas_has_leader[w], *place_args)
+                tas_feas, tas_take, tas_ltake = out_p
+            else:
+                tas_feas, tas_take = jax.vmap(place_one)(
+                    *place_args
+                )  # [G], [G, D]
+                tas_ltake = None
             tas_ok = jnp.where(tas_do, tas_feas, True)
         else:
             tas_ok = True
@@ -1322,6 +1369,16 @@ def admit_scan_grouped(
                 tas_take[:, :, None]
                 * arrays.w_tas_usage_req[w][:, None, :]
             )  # [G, D, R1]
+            if with_leader:
+                # The leader pod's explicit resources land on its leaf
+                # (host _add_tas_usage adds every podset's TA usage).
+                lmask = arrays.w_tas_has_leader[w]
+                usage_delta = usage_delta + jnp.where(
+                    lmask[:, None, None],
+                    tas_ltake[:, :, None].astype(jnp.int64)
+                    * arrays.w_tas_leader_usage_req[w][:, None, :],
+                    0,
+                )
             usage_delta = jnp.where(
                 do_take[:, None, None], usage_delta, 0
             )
@@ -1332,8 +1389,15 @@ def admit_scan_grouped(
                 jnp.where(do_take[:, None], tas_take, 0).astype(jnp.int32),
                 mode="drop",
             )
+            if with_leader:
+                w_ltakes = w_ltakes.at[jnp.where(do_take, w, w_n)].add(
+                    jnp.where(
+                        do_take[:, None] & lmask[:, None], tas_ltake, False
+                    ).astype(jnp.int32),
+                    mode="drop",
+                )
         w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
-        return (new_usage_g, designated, tas_usage, w_takes), \
+        return (new_usage_g, designated, tas_usage, w_takes, w_ltakes), \
             (w_out, admit, preempt_ok)
 
     designated0 = (
@@ -1346,9 +1410,13 @@ def admit_scan_grouped(
         jnp.zeros((w_n + 1, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
         if with_tas else jnp.zeros((1,), jnp.int32)
     )
-    (final_usage_g, _designated, _tas_u, w_takes_f), \
+    ltakes0 = (
+        jnp.zeros((w_n + 1, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
+        if with_leader else jnp.zeros((1,), jnp.int32)
+    )
+    (final_usage_g, _designated, _tas_u, w_takes_f, w_ltakes_f), \
         (w_mat, admit_mat, pre_mat) = jax.lax.scan(
-            body, (usage_g, designated0, tas_usage0, takes0),
+            body, (usage_g, designated0, tas_usage0, takes0, ltakes0),
             jnp.arange(s_max), unroll=unroll,
         )
     admitted = rep(jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
@@ -1365,7 +1433,9 @@ def admit_scan_grouped(
         tree.active[:, None, None], final_usage, usage
     )
     tas_takes = w_takes_f[:w_n] if with_tas else None
-    return final_usage, admitted, preempting_out, tas_takes
+    tas_leader_takes = w_ltakes_f[:w_n] if with_leader else None
+    return final_usage, admitted, preempting_out, tas_takes, \
+        tas_leader_takes
 
 
 def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
@@ -1389,11 +1459,14 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
     rl = arrays.w_tas_req_level[w_iota, t_idx]
     sl = arrays.w_tas_slice_level[w_iota, t_idx]
 
-    def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_, cap_, sz_):
+    with_leader = arrays.w_tas_leader_req is not None
+
+    def feas(usage_all, t, req, count, ssz, sl_, rl_, rq_, un_, cap_, sz_,
+             lr_=None, hl_=None):
         return tas_place.feasible_only(
             arrays.tas_topo, t, usage_all[t], req, count, ssz,
             jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
-            cap_override=cap_, sizes=sz_,
+            cap_override=cap_, sizes=sz_, leader_req=lr_, has_leader=hl_,
         )
 
     # Per-entry filtered leaf capacity (node selector / taint matching)
@@ -1405,10 +1478,17 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
         arrays.w_tas_slice_size, sl, rl, arrays.w_tas_required,
         arrays.w_tas_unconstrained, cap_all, sizes_all,
     )
-    feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * 10)(
+    if with_leader:
+        # LWS groups: feasibility must include the leader pod (the host's
+        # find_topology_assignment places worker and leader together).
+        feas_args = feas_args + (
+            arrays.w_tas_leader_req, arrays.w_tas_has_leader,
+        )
+    n_in = len(feas_args)
+    feas_now = jax.vmap(feas, in_axes=(None,) + (0,) * n_in)(
         arrays.tas_usage0, *feas_args
     )
-    feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * 10)(
+    feas_empty = jax.vmap(feas, in_axes=(None,) + (0,) * n_in)(
         jnp.zeros_like(arrays.tas_usage0), *feas_args
     )
     ok_levels = (rl >= 0) & (sl >= 0) & ~arrays.w_tas_invalid
@@ -1450,7 +1530,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
 
     def finish(arrays, nom, final_usage, admitted, preempting, order,
                victims=None, variant=None, partial_count=None,
-               tas_takes=None):
+               tas_takes=None, tas_leader_takes=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -1494,6 +1574,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             s_pmode=nom.s_pmode,
             s_tried=nom.s_tried,
             tas_takes=tas_takes,
+            tas_leader_takes=tas_leader_takes,
         )
 
     def apply_partial(arrays, nom):
@@ -1516,14 +1597,14 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 arrays, nom, partial_count = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            final_usage, admitted, preempting, tas_takes = \
+            final_usage, admitted, preempting, tas_takes, tas_ltakes = \
                 admit_scan_grouped(
                     arrays, ga, nom, usage, order, s, unroll=unroll,
                     n_levels=n_levels, mesh=mesh,
                 )
             return finish(arrays, nom, final_usage, admitted, preempting,
                           order, partial_count=partial_count,
-                          tas_takes=tas_takes)
+                          tas_takes=tas_takes, tas_leader_takes=tas_ltakes)
 
         return impl
 
@@ -1537,21 +1618,40 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         if arrays.tas_topo is not None:
             nom, downgrade = apply_tas_nominate_hook(arrays, nom)
 
-        # Structural eligibility for on-device oracle resolution: exactly
-        # one flavor with raw preempt mode, and the fungibility scan's
-        # choice is independent of the oracle outcome (it stopped at that
-        # flavor, or there was only one to consider).
-        base_elig = (
+        # Structural eligibility for on-device oracle resolution: the
+        # fungibility scan's choice must be independent of the oracle
+        # outcome. Slot-layout cycles gate per slot: a preempting slot
+        # saw exactly one raw-preempt flavor (its stop is forced), and a
+        # non-preempting slot saw none (its choice never consulted the
+        # oracle); any other shape defers to the host, because a
+        # different oracle verdict would change that slot's flavor and
+        # every later slot's accumulated usage.
+        base_core = (
             arrays.w_active
             & (nom.best_pmode == P_PREEMPT_RAW)
-            & (nom.praw_count == 1)
             & ~arrays.w_has_gates
         )
-        if arrays.w_simple_slot is not None:
-            # The per-entry victim-search kernels read the legacy
-            # single-slot fields; multi-slot / off-RG0 entries defer to
-            # the host preemptor.
-            base_elig = base_elig & arrays.w_simple_slot
+        slot_nom = None
+        if arrays.s_req is not None and nom.s_flavor is not None:
+            from kueue_tpu.models.preempt_kernel import SlotNom
+
+            eff_s = arrays.s_valid & (nom.s_pmode != P_NOFIT)
+            s_is_praw = eff_s & (nom.s_pmode == P_PREEMPT_RAW)
+            slot_gate = jnp.where(
+                s_is_praw,
+                nom.s_praw_count == 1,
+                ~eff_s | (nom.s_praw_count == 0),
+            )
+            base_elig = base_core & jnp.all(slot_gate, axis=1)
+            slot_nom = SlotNom(
+                s_flavor=nom.s_flavor,
+                s_on=eff_s & (nom.s_flavor >= 0),
+                s_is_praw=s_is_praw,
+                s_praw_stop=nom.s_praw_stop,
+                s_considered=nom.s_considered,
+            )
+        else:
+            base_elig = base_core & (nom.praw_count == 1)
         if arrays.w_tas is not None:
             # TAS entries may use the kernels' tas_fits-aware searches
             # (flat and hierarchical) when the tree's admitted TAS usage
@@ -1566,12 +1666,22 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                     & arrays.preempt_tas_ok[arrays.w_cq]
                     & ~downgrade
                 )
+                if arrays.w_tas_has_leader is not None:
+                    # Leader-group entries keep the host's TAS-aware
+                    # victim search (the kernels' tas_fits probe has no
+                    # leader planes).
+                    tas_allowed = tas_allowed & ~arrays.w_tas_has_leader
             base_elig = base_elig & (~arrays.w_tas | tas_allowed)
+        # The hierarchical kernel still reads the legacy single-slot
+        # fields; multi-slot / off-RG0 entries on nested trees defer to
+        # the host preemptor (the flat kernel is slot-aware).
         base_hier = base_elig
+        if arrays.w_simple_slot is not None:
+            base_hier = base_hier & arrays.w_simple_slot
         elig = base_elig & arrays.preempt_simple[arrays.w_cq]
         tgt = preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
-            nom.considered,
+            nom.considered, slot_nom=slot_nom,
         )
         if arrays.preempt_hier is not None:
             # Nested lend-free trees: hierarchical victim-search kernel
@@ -1615,13 +1725,15 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             arrays, nom, partial_count = apply_partial(arrays, nom)
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        final_usage, admitted, preempting, tas_takes = admit_scan_grouped(
+        (final_usage, admitted, preempting, tas_takes,
+         tas_ltakes) = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
             unroll=unroll, n_levels=n_levels, mesh=mesh,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
                       victims=tgt.victims, variant=tgt.variant,
-                      partial_count=partial_count, tas_takes=tas_takes)
+                      partial_count=partial_count, tas_takes=tas_takes,
+                      tas_leader_takes=tas_ltakes)
 
     return impl_preempt
 

@@ -147,26 +147,34 @@ class DeviceScheduler:
             # Admitted TAS entries: the placement kernel emits its own
             # per-leaf takes (CycleOutputs.tas_takes), so domains decode
             # directly in O(assignments) — no host placement replay.
-            tas_assignments = self._decode_tas_assignments(
+            tas_assignments, leader_tas = self._decode_tas_assignments(
                 out, outcome, chosen, idx
             )
 
-            # Fair tournaments interleave per cohort tree: if any entry of
-            # a tree must run on the host (preempt mode, encode fallback),
-            # the device's per-tree ordering is incomplete — discard the
-            # whole tree's device outcomes and route it through the host.
+            # In-cycle interleaving is per cohort tree: entries of one
+            # tree contend for the same quota in admission order, and a
+            # host-fallback entry (encode fallback or OUT_NEEDS_HOST) may
+            # precede device-resolved entries in that order — or need to
+            # see a device preemptor's transient in-cycle usage
+            # (scheduler.go:561 adds usage for PREEMPTING entries too).
+            # The device scan skips deferred entries entirely, so the
+            # tree's device ordering is incomplete: discard the whole
+            # tree's device outcomes and route it through the host
+            # (host-exact within the tree; trees are quota-independent,
+            # so other trees' device outcomes stay valid). Cycles with
+            # zero fallbacks — the production configs — discard nothing.
             discarded_roots = set()
-            if self.fair_sharing:
-                def _root_id(cq_name: str):
-                    cqs = snapshot.cluster_queues.get(cq_name)
-                    return id(cqs.node.root()) if cqs is not None else None
 
-                for info in idx.host_fallback:
+            def _root_id(cq_name: str):
+                cqs = snapshot.cluster_queues.get(cq_name)
+                return id(cqs.node.root()) if cqs is not None else None
+
+            for info in idx.host_fallback:
+                discarded_roots.add(_root_id(info.cluster_queue))
+            for i, info in enumerate(idx.workloads):
+                if outcome[i] == batch_scheduler.OUT_NEEDS_HOST:
                     discarded_roots.add(_root_id(info.cluster_queue))
-                for i, info in enumerate(idx.workloads):
-                    if outcome[i] == batch_scheduler.OUT_NEEDS_HOST:
-                        discarded_roots.add(_root_id(info.cluster_queue))
-                discarded_roots.discard(None)
+            discarded_roots.discard(None)
 
             for i, info in enumerate(idx.workloads):
                 oc = outcome[i]
@@ -177,10 +185,30 @@ class DeviceScheduler:
                     host_entries.append(info)
                     continue
                 if oc == batch_scheduler.OUT_ADMITTED:
+                    delayed_i = bool(
+                        idx.delayed_tas and idx.delayed_tas[i]
+                    )
+                    from kueue_tpu.scheduler.flavorassigner import (
+                        is_lws_group,
+                    )
+
+                    lws_group = (
+                        not multi and is_lws_group(info.obj.pod_sets)
+                    )
                     if multi:
                         self._apply_admission_slots(
                             info, slots_i, s_flavor[i], s_tried[i], idx,
-                            snapshot,
+                            snapshot, delayed_tas=delayed_i,
+                        )
+                    elif lws_group:
+                        # Keyed on the GROUP SHAPE, not on decode output:
+                        # a delayed first pass or a placement without a
+                        # leader take must still emit BOTH podsets'
+                        # assignments (the host always does).
+                        self._apply_admission_lws(
+                            info, idx.flavors[chosen[i]], int(tried[i]),
+                            snapshot, tas_assignments.get(i),
+                            leader_tas.get(i), delayed_tas=delayed_i,
                         )
                     else:
                         self._apply_admission(
@@ -192,12 +220,16 @@ class DeviceScheduler:
                                 if partial is not None and partial[i] >= 0
                                 else None
                             ),
+                            delayed_tas=delayed_i,
                         )
                     result.admitted.append(info.key)
                 elif oc == batch_scheduler.OUT_PREEMPTING:
                     self._apply_preempting(
                         info, victims[i], variants[i], idx, int(tried[i]),
                         snapshot, result,
+                        slots=slots_i if multi else None,
+                        s_pmode_row=s_pmode[i] if multi else None,
+                        s_tried_row=s_tried[i] if multi else None,
                     )
                 elif oc == batch_scheduler.OUT_NEEDS_HOST:
                     host_entries.append(info)
@@ -282,15 +314,22 @@ class DeviceScheduler:
         from kueue_tpu.api.types import TopologyAssignment
 
         if not idx.tas_flavor_names or out.tas_takes is None:
-            return {}
+            return {}, {}
         takes = np.asarray(out.tas_takes)
+        ltakes = (
+            np.asarray(out.tas_leader_takes)
+            if out.tas_leader_takes is not None else None
+        )
         row_of = {name: t for t, name in enumerate(idx.tas_flavor_names)}
         assignments = {}
+        leader_assignments = {}
         for i, info in enumerate(idx.workloads):
             if outcome[i] != batch_scheduler.OUT_ADMITTED:
                 continue
             if info.obj.pod_sets[0].topology_request is None:
                 continue
+            if idx.delayed_tas and idx.delayed_tas[i]:
+                continue  # quota-only first pass: second pass places
             t = row_of.get(idx.flavors[chosen[i]])
             if t is None:
                 continue
@@ -311,14 +350,23 @@ class DeviceScheduler:
             assignments[i] = TopologyAssignment(
                 levels=list(tas.level_keys[li:]), domains=domains
             )
-        return assignments
+            if ltakes is not None and ltakes[i].any():
+                lrow = ltakes[i]
+                ldomains = []
+                for j in np.flatnonzero(lrow[: len(perm)]):
+                    leaf = tas.leaves[perm[int(j)]]
+                    ldomains.append(
+                        (tuple(leaf.level_values[li:]), int(lrow[j]))
+                    )
+                leader_assignments[i] = TopologyAssignment(
+                    levels=list(tas.level_keys[li:]), domains=ldomains
+                )
+        return assignments, leader_assignments
 
     def _apply_admission(
         self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot,
-        topology_assignment=None, reduced_count=None,
+        topology_assignment=None, reduced_count=None, delayed_tas=False,
     ) -> None:
-        now = self.clock()
-        cqs = snapshot.cluster_queues[info.cluster_queue]
         ps = info.total_requests[0]
         if reduced_count is not None and reduced_count != ps.count:
             # Partial admission: replace the tracked totals with the
@@ -329,25 +377,41 @@ class DeviceScheduler:
             ps = ps.scaled_to(reduced_count)
             info.total_requests[0] = ps
         flavors = {res: flavor for res, v in ps.requests.items()}
-        admission = Admission(
-            cluster_queue=info.cluster_queue,
-            pod_set_assignments=[
-                PodSetAssignment(
-                    name=ps.name,
-                    flavors=dict(flavors),
-                    resource_usage=dict(ps.requests),
-                    count=ps.count,
-                    topology_assignment=topology_assignment,
-                )
-            ],
+        psas = [
+            PodSetAssignment(
+                name=ps.name,
+                flavors=dict(flavors),
+                resource_usage=dict(ps.requests),
+                count=ps.count,
+                topology_assignment=topology_assignment,
+                # Delayed placement (tas_flavorassigner.go:106): the
+                # manager's second pass assigns topology later.
+                delayed_topology_request=bool(
+                    delayed_tas
+                    and info.obj.pod_sets[0].topology_request
+                    is not None
+                ),
+            )
+        ]
+        ps.flavors = dict(flavors)
+        self._finish_admission(
+            info, psas, [{r: tried_idx for r in ps.requests}], snapshot
         )
+
+    def _finish_admission(self, info, psas, tried_state, snapshot) -> None:
+        """Shared admission tail for every applier: status, conditions,
+        requeue state, admission checks, cache assume (host analog:
+        Scheduler._admit, reference scheduler.go:561)."""
+        now = self.clock()
+        cqs = snapshot.cluster_queues[info.cluster_queue]
         wl = info.obj
-        wl.status.admission = admission
+        wl.status.admission = Admission(
+            cluster_queue=info.cluster_queue, pod_set_assignments=psas
+        )
         set_condition(wl, COND_QUOTA_RESERVED, True, "QuotaReserved",
                       f"Quota reserved in ClusterQueue {cqs.name}", now)
-        ps.flavors = dict(flavors)
         info.last_assignment = AssignmentClusterQueueState(
-            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+            last_tried_flavor_idx=tried_state,
             cluster_queue_generation=cqs.allocatable_generation,
         )
         checks = cqs.spec.admission_checks
@@ -361,16 +425,49 @@ class DeviceScheduler:
                           "The workload is admitted", now)
         self.cache.assume_workload(info)
 
+    def _apply_admission_lws(
+        self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot,
+        worker_ta, leader_ta, delayed_tas=False,
+    ) -> None:
+        """LWS leader-group admission decode: the two grouped podsets
+        place as one request — the worker podset carries the placement
+        TA, the leader podset the leader leaf one-hot
+        (flavorassigner.update_for_tas, tas_flavor_snapshot.go:725).
+        With ``delayed_tas`` both podsets admit quota-only with
+        delayed_topology_request set (the second pass places)."""
+        from kueue_tpu.scheduler.flavorassigner import (
+            find_leader_and_workers,
+        )
+
+        leader_pid, worker_pid = find_leader_and_workers(
+            info.obj.pod_sets, [0, 1]
+        )
+        psas = []
+        tried_state = []
+        for pid, ps in enumerate(info.total_requests):
+            psas.append(PodSetAssignment(
+                name=ps.name,
+                flavors={res: flavor for res in ps.requests},
+                resource_usage=dict(ps.requests),
+                count=ps.count,
+                topology_assignment=(
+                    None if delayed_tas
+                    else (worker_ta if pid == worker_pid else leader_ta)
+                ),
+                delayed_topology_request=delayed_tas,
+            ))
+            ps.flavors = {res: flavor for res in ps.requests}
+            tried_state.append({r: tried_idx for r in ps.requests})
+        self._finish_admission(info, psas, tried_state, snapshot)
+
     def _apply_admission_slots(
         self, info: WorkloadInfo, slots, flavor_row, tried_row, idx,
-        snapshot,
+        snapshot, delayed_tas=False,
     ) -> None:
         """Multi-podset / multi-resource-group admission decode: one
         PodSetAssignment per podset with per-resource flavors recovered
         from the slot results (host analog: Scheduler._admit over
         assignment.pod_sets, reference scheduler.go:561)."""
-        now = self.clock()
-        cqs = snapshot.cluster_queues[info.cluster_queue]
         flavors_by_ps = [dict() for _ in info.total_requests]
         tried_by_ps = [dict() for _ in info.total_requests]
         for si, sl in enumerate(slots):
@@ -388,29 +485,16 @@ class DeviceScheduler:
                     flavors=dict(flavors_by_ps[pid]),
                     resource_usage=dict(ps.requests),
                     count=ps.count,
+                    delayed_topology_request=bool(
+                        delayed_tas
+                        and pid < len(info.obj.pod_sets)
+                        and info.obj.pod_sets[pid].topology_request
+                        is not None
+                    ),
                 )
             )
             ps.flavors = dict(flavors_by_ps[pid])
-        wl = info.obj
-        wl.status.admission = Admission(
-            cluster_queue=info.cluster_queue, pod_set_assignments=psas
-        )
-        set_condition(wl, COND_QUOTA_RESERVED, True, "QuotaReserved",
-                      f"Quota reserved in ClusterQueue {cqs.name}", now)
-        info.last_assignment = AssignmentClusterQueueState(
-            last_tried_flavor_idx=tried_by_ps,
-            cluster_queue_generation=cqs.allocatable_generation,
-        )
-        checks = cqs.spec.admission_checks
-        if checks:
-            wl.status.admission_checks = [
-                AdmissionCheckState(name=c, state=CheckState.PENDING)
-                for c in checks
-            ]
-        else:
-            set_condition(wl, COND_ADMITTED, True, "Admitted",
-                          "The workload is admitted", now)
-        self.cache.assume_workload(info)
+        self._finish_admission(info, psas, tried_by_ps, snapshot)
 
     @staticmethod
     def _slot_tried_state(info, slots, pmode_row, tried_row):
@@ -459,6 +543,9 @@ class DeviceScheduler:
         tried_idx: int,
         snapshot,
         result: CycleResult,
+        slots=None,
+        s_pmode_row=None,
+        s_tried_row=None,
     ) -> None:
         """Issue the device-designated preemptions and requeue the
         preemptor (host analog: scheduler.go _issue_preemptions +
@@ -490,8 +577,14 @@ class DeviceScheduler:
         result.preempting.append(info.key)
         cqs = snapshot.cluster_queues[info.cluster_queue]
         ps = info.total_requests[0]
+        if slots is not None:
+            tried_state = self._slot_tried_state(
+                info, slots, s_pmode_row, s_tried_row
+            )
+        else:
+            tried_state = [{r: tried_idx for r in ps.requests}]
         info.last_assignment = AssignmentClusterQueueState(
-            last_tried_flavor_idx=[{r: tried_idx for r in ps.requests}],
+            last_tried_flavor_idx=tried_state,
             cluster_queue_generation=cqs.allocatable_generation,
         )
         self.queues.requeue_workload(

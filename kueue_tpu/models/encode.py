@@ -132,6 +132,15 @@ class CycleArrays(NamedTuple):
     # meaningful where w_tas_has_cap; other entries use the topology cap.
     w_tas_cap: Optional[jnp.ndarray] = None
     w_tas_has_cap: Optional[jnp.ndarray] = None  # bool[W]
+    # -- LWS leader group (None when no leader-group entry this cycle):
+    # a two-podset group places as ONE request — the worker podset's
+    # count/per-pod requests fill the w_tas_* fields above; the leader's
+    # fit vector (requests + one pod slot, flavorassigner OnePodRequest)
+    # and usage vector ride along, and the placement kernel emits the
+    # leader leaf one-hot (ops/tas_place.place leader planes).
+    w_tas_leader_req: Optional[jnp.ndarray] = None  # i64[W,R+1]
+    w_tas_leader_usage_req: Optional[jnp.ndarray] = None  # i64[W,R+1]
+    w_tas_has_leader: Optional[jnp.ndarray] = None  # bool[W]
     # -- fair sharing (None unless the fair tournament kernel is in use) --
     node_weight: Optional[jnp.ndarray] = None  # f64[N] FairSharing weight
     node_is_cq: Optional[jnp.ndarray] = None  # bool[N]
@@ -167,6 +176,10 @@ class CycleIndex:
     # when the cycle is in legacy layout).
     slots: List[object] = field(default_factory=list)
     n_slots: int = 1  # padded S axis (1 = legacy layout, no slot fields)
+    # Delayed topology placement (tas_flavorassigner.go:106): entries
+    # admitted quota-only on device; the driver marks every TAS podset's
+    # delayed_topology_request and the manager's second pass places.
+    delayed_tas: List[bool] = field(default_factory=list)
     # Exact step bound for the fair tournament scan: at most one entry
     # per CQ participates (last-entry shadowing), and each scan step
     # resolves one winner per cohort root, so a root needs at most
@@ -388,12 +401,24 @@ def encode_cycle(
             _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
             if info.cluster_queue in snapshot.cluster_queues else None
         )
+        delayed = bool(
+            delay_tas_fn is not None
+            and info.cluster_queue in snapshot.cluster_queues
+            and any(
+                ps.topology_request is not None
+                for ps in info.obj.pod_sets
+            )
+            and delay_tas_fn(
+                snapshot.cluster_queues[info.cluster_queue], info
+            )
+        )
         if not fair_host and _device_compatible(
                 info, snapshot, slots,
-                set(tas_device_flavors), delay_tas_fn,
+                set(tas_device_flavors), delayed,
                 preempt, fair_sharing):
             device_wls.append(info)
             wl_slots.append(slots)
+            idx.delayed_tas.append(delayed)
         else:
             idx.host_fallback.append(info)
 
@@ -783,10 +808,28 @@ def _encode_tas(
 
     bal_gate_on = _bfeat.enabled("TASBalancedPlacement")
 
+    w_tas_leader_req = None
+    w_tas_leader_usage = None
+    w_tas_has_leader = None
+
     for i, info in enumerate(device_wls):
-        ps = info.obj.pod_sets[0]
+        pods = info.obj.pod_sets
+        ps = pods[0]
+        leader_ps = None
+        from kueue_tpu.scheduler.flavorassigner import (
+            find_leader_and_workers,
+            is_lws_group,
+        )
+
+        if is_lws_group(pods):
+            li_, wi_ = find_leader_and_workers(pods, [0, 1])
+            leader_ps, ps = pods[li_], pods[wi_]
         tr = ps.topology_request
         if tr is None:
+            continue
+        if idx.delayed_tas and idx.delayed_tas[i]:
+            # Quota-only first pass: no topology tensors; the second
+            # pass places after provisioning (scheduler.go:840-884).
             continue
         w_tas[i] = True
         w_tas_count[i] = ps.count
@@ -795,6 +838,23 @@ def _encode_tas(
             if ci is not None:
                 w_tas_req[i, ci] = v
                 w_tas_usage_req[i, ci] = v
+        if leader_ps is not None:
+            if w_tas_leader_req is None:
+                w_tas_leader_req = np.zeros((w, r1), np.int64)
+                w_tas_leader_usage = np.zeros((w, r1), np.int64)
+                w_tas_has_leader = np.zeros(w, bool)
+            w_tas_has_leader[i] = True
+            for res, v in leader_ps.requests.items():
+                ci = tidx.resource_of.get(res)
+                if ci is not None:
+                    w_tas_leader_req[i, ci] = v
+                    w_tas_leader_usage[i, ci] = v
+            # Fit vector: the leader occupies one pod slot on top of any
+            # explicit pods request (OnePodRequest, flavorassigner :965);
+            # usage adds only the explicit resources (_add_tas_usage).
+            lp = leader_ps.requests.get("pods", 0)
+            w_tas_leader_req[i, r_cy] = lp + 1
+            w_tas_leader_usage[i, r_cy] = lp
         pods_req = ps.requests.get("pods", 0)
         # Fit vector: implicit 1-pod bound unless pods explicitly requested.
         # Usage vector: only explicit pods consumption mirrors into the
@@ -963,6 +1023,10 @@ def _encode_tas(
     if w_tas_cap is not None:
         fields["w_tas_cap"] = w_tas_cap
         fields["w_tas_has_cap"] = w_tas_has_cap
+    if w_tas_has_leader is not None:
+        fields["w_tas_leader_req"] = np.asarray(w_tas_leader_req)
+        fields["w_tas_leader_usage_req"] = np.asarray(w_tas_leader_usage)
+        fields["w_tas_has_leader"] = np.asarray(w_tas_has_leader)
     return fields, root_merge
 
 
@@ -1244,7 +1308,7 @@ def _device_compatible(
     snapshot: Snapshot,
     slots: Optional[List[AssignSlot]],
     tas_device_flavors: set = frozenset(),
-    delay_tas_fn=None,
+    delayed: bool = False,
     preempt: bool = False,
     fair_sharing: bool = False,
 ) -> bool:
@@ -1253,14 +1317,58 @@ def _device_compatible(
     if slots is None or not slots or len(slots) > MAX_SLOTS:
         return False
     multi_slot = len(slots) > 1 or slots[0].rg_idx != 0
-    if multi_slot and fair_sharing:
-        # The fair tournament kernel evaluates single-slot entries only.
-        return False
-    if any(
+    if delayed:
+        # Delayed topology placement (tas_flavorassigner.go:106): the
+        # first pass is pure quota admission — the entry rides the normal
+        # (slot) machinery with no topology tensors; the driver marks
+        # delayed_topology_request and the manager's second pass places.
+        # Partial-admission TAS still stays host (gated below).
+        pass
+    elif any(
         ps.topology_request is not None for ps in info.obj.pod_sets
     ) and (len(info.obj.pod_sets) != 1 or multi_slot):
-        # Device TAS stays single-podset / first-RG for now.
-        return False
+        # LWS leader group on device: two podsets sharing a
+        # podset_group_name place as ONE request with the smaller-count
+        # member as the leader (flavorassigner.update_for_tas /
+        # reference tas_flavor_snapshot.go:725) — the placement kernel
+        # carries the leader planes. Other multi-podset TAS shapes stay
+        # on the host for now.
+        if not preempt or fair_sharing:
+            return False
+        from kueue_tpu.scheduler.flavorassigner import is_lws_group
+
+        if multi_slot or not is_lws_group(info.obj.pod_sets):
+            return False
+        cqs0 = snapshot.cluster_queues[info.cluster_queue]
+        from kueue_tpu.utils import features as _mbfeat
+
+        bal_gate = _mbfeat.enabled("TASBalancedPlacement")
+        for ps2 in info.obj.pod_sets:
+            tr2 = ps2.topology_request
+            # Balanced placement stays single-podset on device.
+            if tr2.balanced or (
+                bal_gate
+                and tr2.required_level is None
+                and tr2.preferred_level is not None
+                and not tr2.unconstrained
+            ):
+                return False
+            # Node-filtered capacity (selector/tolerations) is encoded
+            # as a single worker-shaped row — keep filtered groups host.
+            if ps2.node_selector or ps2.tolerations:
+                return False
+        # Every topology flavor of the group's RG must be encoded and
+        # untainted (no per-entry capacity filter rows for groups).
+        for sl in slots:
+            rg2 = cqs0.spec.resource_groups[sl.rg_idx]
+            for fq in rg2.flavors:
+                tas2 = snapshot.tas_flavors.get(fq.name)
+                if tas2 is None:
+                    continue
+                if fq.name not in tas_device_flavors:
+                    return False
+                if tas2.has_tainted_nodes:
+                    return False
     ps = info.obj.pod_sets[0]
     cqs = snapshot.cluster_queues[info.cluster_queue]
     if any(
@@ -1299,7 +1407,7 @@ def _device_compatible(
             # reduction range could not converge — host path.
             if ps.count - ps.min_count >= (1 << 22):
                 return False
-    if ps.topology_request is not None:
+    if ps.topology_request is not None and not delayed:
         tr = ps.topology_request
         if not preempt:
             return False
@@ -1325,8 +1433,6 @@ def _device_compatible(
                 tas2 = snapshot.tas_flavors.get(fq.name)
                 if tas2 is not None and not _balanced_widths_ok(tas2, tr):
                     return False
-        if delay_tas_fn is not None and delay_tas_fn(cqs, info):
-            return False
         # Every topology-backed flavor of the CQ must be device-encoded.
         rg0 = cqs.spec.resource_groups[0]
         tas_flavor_count = 0

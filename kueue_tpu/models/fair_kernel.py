@@ -56,6 +56,7 @@ from kueue_tpu.models.batch_scheduler import (
     OUT_SHADOWED,
     P_FIT,
     P_NO_CANDIDATES,
+    P_NOFIT,
     P_PREEMPT_OK,
     P_PREEMPT_RAW,
     apply_tas_nominate_hook,
@@ -164,6 +165,47 @@ def fair_admit_scan(
     fe_c = jnp.clip(chosen_c, 0, f_n - 1)
     fe_col_c = fe_c[:, None]
     req_c = arrays.w_req[pe]
+    # Slot layout (multi-podset / multi-resource-group entries present):
+    # an entry touches up to S flavor planes, one per assigned slot.
+    # Fit/apply/DRS use per-plane totals aggregated across same-flavor
+    # slots (``agg``), applied once per distinct plane (``dedup``) — the
+    # host sees the summed FlavorResource usage map. Single-slot cycles
+    # keep the legacy single-plane tensors so the tuned compiled program
+    # is unchanged.
+    with_slots = arrays.s_req is not None and nom.s_flavor is not None
+    if with_slots:
+        s_ax = arrays.s_req.shape[1]
+        fs_c = nom.s_flavor[pe]  # [n,S]
+        act_c = (
+            arrays.s_valid[pe] & (fs_c >= 0)
+            & (nom.s_pmode[pe] != P_NOFIT)
+        )
+        fes_c = jnp.clip(fs_c, 0, f_n - 1)
+        sreq_c = arrays.s_req[pe]  # [n,S,R]
+        # NOTE: no ``covered`` mask here — covered[] describes the FIRST
+        # resource group only (legacy single-plane layout); slots span
+        # all RGs and _workload_slots guarantees coverage (None on any
+        # uncovered positive request).
+        cell_s = (sreq_c > 0) & act_c[..., None]  # [n,S,R]
+        req_m = jnp.where(cell_s, sreq_c, 0).astype(jnp.int64)
+        samef = (
+            (fes_c[:, :, None] == fes_c[:, None, :])
+            & act_c[:, :, None] & act_c[:, None, :]
+        )  # [n,S,S]
+        agg_c = jnp.einsum(
+            "nst,ntr->nsr", samef.astype(jnp.int64), req_m
+        )  # [n,S,R] per-plane totals
+        dedup_c = (
+            jnp.argmax(samef, axis=2).astype(jnp.int32)
+            == jnp.arange(s_ax, dtype=jnp.int32)[None, :]
+        ) & act_c  # [n,S] first slot of each distinct plane
+        ch_sl = chains_c[:, None, :]  # [n,1,L] -> broadcast with [n,S,1]
+        fe_sl = fes_c[:, :, None]
+        lq_s = lq_all[ch_sl, fe_sl]  # [n,S,L,R]
+        sub_s = sq[ch_sl, fe_sl]
+        bl_s = tree.borrow_limit[ch_sl, fe_sl]
+        hbl_s = tree.has_borrow_limit[ch_sl, fe_sl]
+        nominal_s = tree.nominal[own_cq_c[:, None], fes_c]  # [n,S,R]
     # All fit/apply math lives on the entry's chosen flavor plane.
     cell_c = (
         (chosen_c >= 0)[:, None]
@@ -223,15 +265,39 @@ def fair_admit_scan(
         sq_chain = sq[chains_c]
         over_base = jnp.maximum(0, u_chain - sq_chain)
         borrowed_base = jnp.sum(over_base, axis=2)  # [n,D+1,R]
-        # Adjust the chosen-flavor plane for the simulated addition.
-        idx_fe = fe_c[:, None, None, None]
-        u_fe = jnp.take_along_axis(u_chain, idx_fe, axis=2)[:, :, 0, :]
-        sq_fe = jnp.take_along_axis(sq_chain, idx_fe, axis=2)[:, :, 0, :]
-        over_fe_now = jnp.maximum(0, u_fe - sq_fe)
-        over_fe_sim = jnp.maximum(
-            0, u_fe + sim_req_c[:, None, :] - sq_fe
-        )
-        borrowed = borrowed_base + over_fe_sim - over_fe_now  # [n,D+1,R]
+        if with_slots:
+            # Adjust each DISTINCT assigned plane once with its
+            # aggregated simulated usage (the host adds the whole
+            # assignment's FlavorResource map, fair_sharing.go:149).
+            L_ax = MAX_DEPTH + 1
+            ni4 = jnp.arange(n)[:, None, None]
+            li4 = jnp.arange(L_ax)[None, :, None]
+            fe4 = fes_c[:, None, :]
+            u_fe_s = u_chain[ni4, li4, fe4]  # [n,L,S,R]
+            sq_fe_s = sq_chain[ni4, li4, fe4]
+            over_now = jnp.maximum(0, u_fe_s - sq_fe_s)
+            over_sim = jnp.maximum(
+                0, u_fe_s + agg_c[:, None, :, :] - sq_fe_s
+            )
+            adj = jnp.sum(
+                jnp.where(
+                    dedup_c[:, None, :, None], over_sim - over_now, 0
+                ),
+                axis=2,
+            )
+            borrowed = borrowed_base + adj  # [n,D+1,R]
+        else:
+            # Adjust the chosen-flavor plane for the simulated addition.
+            idx_fe = fe_c[:, None, None, None]
+            u_fe = jnp.take_along_axis(u_chain, idx_fe, axis=2)[:, :, 0, :]
+            sq_fe = jnp.take_along_axis(
+                sq_chain, idx_fe, axis=2
+            )[:, :, 0, :]
+            over_fe_now = jnp.maximum(0, u_fe - sq_fe)
+            over_fe_sim = jnp.maximum(
+                0, u_fe + sim_req_c[:, None, :] - sq_fe
+            )
+            borrowed = borrowed_base + over_fe_sim - over_fe_now
 
         ratio_r = jnp.where(
             (lend_par_c > 0) & (borrowed > 0),
@@ -317,12 +383,12 @@ def fair_admit_scan(
         win = p_has & remaining & (champ[root_c] == n_iota)
 
         pm = pm_c
-        # Chain availability on the entry's chosen plane, via the same
+        # Chain availability on the entry's chosen plane(s), via the same
         # walk as the grouped admission scan — exact under lending
         # limits. The fit check simulates removal of every designated
         # victim plus the entry's own targets (scheduler fits() ->
         # SimulateWorkloadRemoval).
-        u_pl = usage_now[chains_c, fe_col_c]  # [n,D+1,R]
+        L = MAX_DEPTH + 1
         if with_preempt:
             is_pre = win & (pm == P_PREEMPT_OK)
             overlap = is_pre & jnp.any(
@@ -331,29 +397,61 @@ def fair_admit_scan(
             use_vict = designated[None, :] | jnp.where(
                 (is_pre & ~overlap)[:, None], victims_c, False
             )  # [n,A]
-            rem = jnp.einsum(
-                "wda,war->wdr",
-                (use_vict[:, None, :] & chain_sub_c).astype(jnp.int64),
-                au_c,
-            )
-            u_fit = u_pl - rem
         else:
             is_pre = jnp.zeros(n, bool)
             overlap = jnp.zeros(n, bool)
-            u_fit = u_pl
-        l_avail_fit = jnp.maximum(0, sat_sub(lq_c, u_fit))
-        stored = sat_sub(sub_c, lq_c)
-        used_in_parent = jnp.maximum(0, sat_sub(u_fit, lq_c))
-        with_max = sat_add(sat_sub(stored, used_in_parent), bl_c)
-        L = MAX_DEPTH + 1
-        avail = sat_sub(sub_c[:, L - 1], u_fit[:, L - 1])
-        for i in range(L - 2, -1, -1):
-            clamped = jnp.where(
-                hbl_c[:, i], jnp.minimum(with_max[:, i], avail), avail
-            )
-            stepped = sat_add(l_avail_fit[:, i], clamped)
-            avail = jnp.where(walk_rep_c[:, i, None], avail, stepped)
-        fits = jnp.all((delta_c <= avail) | ~cell_c, axis=1)
+        if with_slots:
+            u_pl_s = usage_now[ch_sl, fe_sl]  # [n,S,L,R]
+            if with_preempt:
+                au_s = usage_by_f[fes_c]  # [n,S,A,R]
+                rem_s = jnp.einsum(
+                    "nda,nsar->nsdr",
+                    (use_vict[:, None, :]
+                     & chain_sub_c).astype(jnp.int64),
+                    au_s,
+                )
+                u_fit_s = u_pl_s - rem_s
+            else:
+                u_fit_s = u_pl_s
+            l_avail_fit_s = jnp.maximum(0, sat_sub(lq_s, u_fit_s))
+            stored_s = sat_sub(sub_s, lq_s)
+            uip_s = jnp.maximum(0, sat_sub(u_fit_s, lq_s))
+            with_max_s = sat_add(sat_sub(stored_s, uip_s), bl_s)
+            avail_s = sat_sub(sub_s[:, :, L - 1], u_fit_s[:, :, L - 1])
+            for i in range(L - 2, -1, -1):
+                clamped = jnp.where(
+                    hbl_s[:, :, i],
+                    jnp.minimum(with_max_s[:, :, i], avail_s), avail_s,
+                )
+                stepped = sat_add(l_avail_fit_s[:, :, i], clamped)
+                avail_s = jnp.where(
+                    walk_rep_c[:, None, i, None], avail_s, stepped
+                )
+            fits = jnp.all((agg_c <= avail_s) | ~cell_s, axis=(1, 2))
+        else:
+            u_pl = usage_now[chains_c, fe_col_c]  # [n,D+1,R]
+            if with_preempt:
+                rem = jnp.einsum(
+                    "wda,war->wdr",
+                    (use_vict[:, None, :]
+                     & chain_sub_c).astype(jnp.int64),
+                    au_c,
+                )
+                u_fit = u_pl - rem
+            else:
+                u_fit = u_pl
+            l_avail_fit = jnp.maximum(0, sat_sub(lq_c, u_fit))
+            stored = sat_sub(sub_c, lq_c)
+            used_in_parent = jnp.maximum(0, sat_sub(u_fit, lq_c))
+            with_max = sat_add(sat_sub(stored, used_in_parent), bl_c)
+            avail = sat_sub(sub_c[:, L - 1], u_fit[:, L - 1])
+            for i in range(L - 2, -1, -1):
+                clamped = jnp.where(
+                    hbl_c[:, i], jnp.minimum(with_max[:, i], avail), avail
+                )
+                stepped = sat_add(l_avail_fit[:, i], clamped)
+                avail = jnp.where(walk_rep_c[:, i, None], avail, stepped)
+            fits = jnp.all((delta_c <= avail) | ~cell_c, axis=1)
 
         deferred = deferred_c
         # TAS placement recheck against the running topology state for
@@ -391,53 +489,95 @@ def fair_admit_scan(
         preempt_ok = is_pre & ~overlap & fits & ~deferred
 
         # NO_CANDIDATES capacity reserve (scheduler.go:513) at the CQ.
-        u_cq_pl = u_pl[:, 0]  # [n,R]
-        reserve_borrowing = jnp.where(
-            hbl_c[:, 0],
-            jnp.minimum(
-                delta_c, sat_sub(sat_add(nominal_c, bl_c[:, 0]), u_cq_pl)
-            ),
-            delta_c,
-        )
-        reserve_plain = jnp.maximum(
-            0, jnp.minimum(delta_c, sat_sub(nominal_c, u_cq_pl))
-        )
-        reserve = jnp.where(
-            borrowing_c[:, None], reserve_borrowing, reserve_plain
-        )
-        reserve = jnp.where(cell_c, reserve, 0)
         do_reserve = (
             win
             & (pm == P_NO_CANDIDATES)
             & ~reclaim_c
             & ~deferred
         )
-
         # Both admitted FIT entries and proceeding preemptors consume
         # their usage (scheduler.go:561 cq.AddUsage runs for either mode).
         take_usage = admit | preempt_ok
-        applied = jnp.where(
-            take_usage[:, None], delta_c,
-            jnp.where(do_reserve[:, None], reserve, 0),
-        )  # [n,R]
-        # addUsage bubbling with local-availability clamping
-        # (resource_node.go:144) — exact under lending limits; l_avail
-        # comes from the pre-update usage.
-        l_avail_pre = jnp.maximum(0, sat_sub(lq_c, u_pl))
-        deltas = jnp.zeros((n, L, r_n), dtype=jnp.int64)
-        cur = applied
-        for i in range(L):
-            deltas = deltas.at[:, i].set(cur)
-            cont = (
-                (~walk_rep_c[:, i, None]) if i < L - 1 else False
+        if with_slots:
+            u_cq_s = u_pl_s[:, :, 0]  # [n,S,R]
+            res_borrow_s = jnp.where(
+                hbl_s[:, :, 0],
+                jnp.minimum(
+                    agg_c,
+                    sat_sub(sat_add(nominal_s, bl_s[:, :, 0]), u_cq_s),
+                ),
+                agg_c,
             )
-            cur = jnp.where(
-                cont, jnp.maximum(0, sat_sub(cur, l_avail_pre[:, i])), 0
+            res_plain_s = jnp.maximum(
+                0, jnp.minimum(agg_c, sat_sub(nominal_s, u_cq_s))
             )
-        deltas = jnp.where(win[:, None, None], deltas, 0)
-        new_usage = quota_ops.sat(
-            usage_now.at[chains_c, fe_col_c].add(deltas, mode="drop")
-        )
+            reserve_s = jnp.where(
+                borrowing_c[:, None, None], res_borrow_s, res_plain_s
+            )
+            reserve_s = jnp.where(cell_s, reserve_s, 0)
+            applied_s = jnp.where(
+                take_usage[:, None, None], agg_c,
+                jnp.where(do_reserve[:, None, None], reserve_s, 0),
+            )  # [n,S,R]
+            # One application per distinct plane.
+            applied_s = jnp.where(dedup_c[..., None], applied_s, 0)
+            l_avail_pre_s = jnp.maximum(0, sat_sub(lq_s, u_pl_s))
+            deltas_s = jnp.zeros((n, s_ax, L, r_n), dtype=jnp.int64)
+            cur = applied_s
+            for i in range(L):
+                deltas_s = deltas_s.at[:, :, i].set(cur)
+                cont = (
+                    (~walk_rep_c[:, None, i, None]) if i < L - 1 else False
+                )
+                cur = jnp.where(
+                    cont,
+                    jnp.maximum(0, sat_sub(cur, l_avail_pre_s[:, :, i])),
+                    0,
+                )
+            deltas_s = jnp.where(win[:, None, None, None], deltas_s, 0)
+            new_usage = quota_ops.sat(
+                usage_now.at[ch_sl, fe_sl].add(deltas_s, mode="drop")
+            )
+        else:
+            u_cq_pl = u_pl[:, 0]  # [n,R]
+            reserve_borrowing = jnp.where(
+                hbl_c[:, 0],
+                jnp.minimum(
+                    delta_c,
+                    sat_sub(sat_add(nominal_c, bl_c[:, 0]), u_cq_pl),
+                ),
+                delta_c,
+            )
+            reserve_plain = jnp.maximum(
+                0, jnp.minimum(delta_c, sat_sub(nominal_c, u_cq_pl))
+            )
+            reserve = jnp.where(
+                borrowing_c[:, None], reserve_borrowing, reserve_plain
+            )
+            reserve = jnp.where(cell_c, reserve, 0)
+            applied = jnp.where(
+                take_usage[:, None], delta_c,
+                jnp.where(do_reserve[:, None], reserve, 0),
+            )  # [n,R]
+            # addUsage bubbling with local-availability clamping
+            # (resource_node.go:144) — exact under lending limits;
+            # l_avail comes from the pre-update usage.
+            l_avail_pre = jnp.maximum(0, sat_sub(lq_c, u_pl))
+            deltas = jnp.zeros((n, L, r_n), dtype=jnp.int64)
+            cur = applied
+            for i in range(L):
+                deltas = deltas.at[:, i].set(cur)
+                cont = (
+                    (~walk_rep_c[:, i, None]) if i < L - 1 else False
+                )
+                cur = jnp.where(
+                    cont, jnp.maximum(0, sat_sub(cur, l_avail_pre[:, i])),
+                    0,
+                )
+            deltas = jnp.where(win[:, None, None], deltas, 0)
+            new_usage = quota_ops.sat(
+                usage_now.at[chains_c, fe_col_c].add(deltas, mode="drop")
+            )
         if with_tas:
             do_take = admit & tas_do
             usage_delta = (
@@ -562,6 +702,9 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             ).astype(jnp.int32),
             victims=victims,
             victim_variant=variant,
+            s_flavor=nom.s_flavor,
+            s_pmode=nom.s_pmode,
+            s_tried=nom.s_tried,
             tas_takes=tas_takes,
         )
 
@@ -595,6 +738,12 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
         )
         if arrays.w_tas is not None:
             elig = elig & ~arrays.w_tas
+        if arrays.w_simple_slot is not None:
+            # The fair victim tournament reads the legacy single-slot
+            # fields; a multi-slot entry needing preemption stays
+            # needs_host and the driver routes its whole tree through
+            # the host (tournament interleaving stays exact per tree).
+            elig = elig & arrays.w_simple_slot
         tgt = fair_preempt_targets(
             arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
             nom.considered,

@@ -78,6 +78,20 @@ class PreemptTargets(NamedTuple):
     borrow_after: jnp.ndarray  # i32[W] assignment-order borrow key
 
 
+class SlotNom(NamedTuple):
+    """Per-slot nominate outputs for slot-layout (multi-podset /
+    multi-resource-group) cycles — the victim search then runs over
+    (slot, resource) cells on each slot's chosen flavor plane, the way
+    the host preemptor sees the whole assignment's FlavorResource usage
+    (preemption.go:131 GetTargets over assignment.Usage)."""
+
+    s_flavor: jnp.ndarray  # i32[W,S] chosen flavor per slot (-1 none)
+    s_on: jnp.ndarray  # bool[W,S] effective assigned slots
+    s_is_praw: jnp.ndarray  # bool[W,S] slot stopped in raw-preempt mode
+    s_praw_stop: jnp.ndarray  # bool[W,S] slot scan stopped at a praw flavor
+    s_considered: jnp.ndarray  # i32[W,S] flavors considered by slot scan
+
+
 def _seg_excl_prefix(sorted_vals, head):
     """Exclusive prefix sums within segments (head marks segment starts)."""
     c = jnp.cumsum(sorted_vals, axis=0)
@@ -104,16 +118,27 @@ def preempt_targets(
     eligible: jnp.ndarray,  # bool[W] structurally device-resolvable entries
     praw_stop: jnp.ndarray,  # bool[W] fungibility scan stopped at the raw flavor
     considered: jnp.ndarray,  # i32[W] flavors considered by the scan
+    slot_nom: SlotNom = None,
 ) -> PreemptTargets:
     """Victim selection for every eligible entry at once, against the
     cycle-start usage (matching the host's nomination-phase get_targets).
+
+    The search runs over (slot, resource) cells: each slot contributes its
+    requests on its own chosen flavor plane, same-flavor slots aggregate
+    (the host preemptor sees the summed FlavorResource usage map), and the
+    per-cell oracle probes use the slot-accumulated value exactly like the
+    host's ``val = assumed + request`` (flavorassigner.go:1213). Legacy
+    single-slot cycles pass ``slot_nom=None`` and run with S=1, which is
+    definitionally the same search.
 
     TAS entries (when the encoder's ``preempt_tas_ok`` gate admits them)
     run the same search with the host's tas_fits probe folded in
     (preemption.go:637): victim removal releases per-leaf topology usage,
     and — placement feasibility being monotone in the removal prefix —
     the placement threshold is found by binary search over the ordered
-    candidate prefix instead of a per-candidate probe."""
+    candidate prefix instead of a per-candidate probe. Device TAS entries
+    are single-podset by encoder gate, so the probe stays workload-level.
+    """
     tree = arrays.tree
     usage = arrays.usage
     sq = tree.subtree_quota
@@ -129,6 +154,23 @@ def preempt_targets(
     a_n = adm.cq.shape[0]
     r_n = tree.nominal.shape[2]
     a_iota = jnp.arange(a_n)
+
+    w_count = arrays.w_cq.shape[0]
+    if slot_nom is not None and arrays.s_req is not None:
+        sl_f = slot_nom.s_flavor
+        sl_req = arrays.s_req
+        sl_on = slot_nom.s_on
+        sl_praw = slot_nom.s_is_praw
+        sl_stop = slot_nom.s_praw_stop
+        sl_cons = slot_nom.s_considered
+    else:
+        sl_f = chosen_flavor[:, None]
+        sl_req = arrays.w_req[:, None, :]
+        sl_on = jnp.ones((w_count, 1), bool)
+        sl_praw = jnp.ones((w_count, 1), bool)
+        sl_stop = praw_stop[:, None]
+        sl_cons = considered[:, None]
+    s_n = sl_req.shape[1]
 
     with_tas = (
         getattr(arrays, "tas_topo", None) is not None
@@ -173,13 +215,42 @@ def preempt_targets(
             t_sz=zw[:, None],
         )
 
-    def per_w(c, f0, req, prio, ts, elig_w, stopped_at_praw, considered,
+    def per_w(c, sf, sreq_own, son, spraw, sstop, scons,
+              prio, ts, elig_w,
               do_tas, t_row, t_req, t_cnt, t_ssz, t_sl, t_rl, t_rq, t_un,
               t_cap, t_sz):
-        f = jnp.maximum(f0, 0)
-        full_active = (req > 0) & arrays.covered[c]  # [R]
-        contested_full = full_active & (req > avail0[c, f])  # [R]
-        au = adm.usage[:, f, :]  # [A,R]
+        f = jnp.maximum(sf, 0)  # [S]
+        on = son & (sf >= 0)
+        sreq = jnp.where(on[:, None], sreq_own, 0)  # [S,R]
+        # Same-flavor aggregation: the host preemptor's usage map sums
+        # podset requests per FlavorResource; duplicate slot planes carry
+        # the identical total (harmless duplicate checks).
+        samef = (f[:, None] == f[None, :]) & on[:, None] & on[None, :]
+        req_tot = jnp.einsum(
+            "st,tr->sr", samef.astype(jnp.int64), sreq
+        )  # [S,R]
+        # Inclusive slot accumulation for the per-cell oracle probes: the
+        # host consults the oracle with val = assumed + request, where
+        # assumed covers EARLIER slots assigned on the same plane
+        # (flavorassigner.go:1213).
+        s_iota_ax = jnp.arange(s_n)
+        acc_incl = jnp.einsum(
+            "st,tr->sr",
+            (samef
+             & (s_iota_ax[None, :] <= s_iota_ax[:, None])).astype(
+                 jnp.int64),
+            sreq,
+        )  # [S,R]
+        full_active = (req_tot > 0) & on[:, None]  # [S,R]
+        if s_n == 1:
+            # Legacy single-slot layout: requests live on the first
+            # resource group, whose coverage ``covered`` describes.
+            # Slot layouts span all RGs — coverage is guaranteed by
+            # _workload_slots (None on any uncovered positive request),
+            # and covered[] would wrongly drop later-RG cells.
+            full_active = full_active & arrays.covered[c][None, :]
+        contested_full = full_active & (req_tot > avail0[c][f])  # [S,R]
+        au = adm.usage[:, f, :]  # [A,S,R]
 
         same = adm.cq == c
         cross = (root_of[adm.cq] == root_of[c]) & ~same & has_par_n[c]
@@ -216,17 +287,18 @@ def preempt_targets(
 
         def search(active_req, contested, req_vec, tas_probe=False):
             """One classical search (preemption.go:296): requests =
-            req_vec over active_req cells, contested cells needing
+            req_vec over active_req [S,R] cells, contested cells needing
             preemption. Returns (success, victims[A]). With ``tas_probe``
             the host's tas_fits placement check gates the stop point and
             the fill-back (preemption.go:637)."""
-            uses = jnp.any(contested[None, :] & (au > 0), axis=1)
+            uses = jnp.any(contested[None] & (au > 0), axis=(1, 2))
             # Cross-CQ collection gate: candidate CQ not within nominal in
             # the contested cells (hierarchical_preemption.go:176).
             above_nom = jnp.any(
-                contested[None, :]
-                & (usage[adm.cq, f, :] > sq[adm.cq, f, :]),
-                axis=1,
+                contested[None]
+                & (usage[adm.cq[:, None], f[None, :], :]
+                   > sq[adm.cq[:, None], f[None, :], :]),
+                axis=(1, 2),
             )
             cand = adm.active & uses & policy_pass & (same | above_nom)
 
@@ -301,21 +373,24 @@ def preempt_targets(
                     (u_c - s_same + req_vec <= sq_c) | ~active_req
                 )
                 ok = ok & (borrow_b | no_borrow_ok)
-                return jnp.all(ok, axis=-1)
+                return jnp.all(ok, axis=(-2, -1))
 
             def attempt(borrow_b):
                 elig = cand & ~(
                     borrow_b & (variant == V_RECLAIM_WITHOUT_BORROWING)
                 )
-                contrib = jnp.where(elig[:, None], au, 0).astype(jnp.int64)
+                contrib = jnp.where(
+                    elig[:, None, None], au, 0
+                ).astype(jnp.int64)
                 # Per-CQ dynamic validity: naive above-nominal check
                 # against the CQ-segment exclusive prefix, folded with a
                 # cumulative AND (validity is absorbing).
-                excl2 = _seg_excl_prefix(contrib[ord2], head2)  # [A,R]
+                excl2 = _seg_excl_prefix(contrib[ord2], head2)  # [A,S,R]
                 naive = same[ord2] | jnp.any(
-                    contested[None, :]
-                    & (usage[s_cq, f, :] - excl2 > sq[s_cq, f, :]),
-                    axis=1,
+                    contested[None]
+                    & (usage[s_cq[:, None], f[None, :], :] - excl2
+                       > sq[s_cq[:, None], f[None, :], :]),
+                    axis=(1, 2),
                 )
                 bad = (elig[ord2] & ~naive).astype(jnp.int32)
                 valid2 = _seg_incl_cumsum(bad, head2) == 0
@@ -323,10 +398,12 @@ def preempt_targets(
                 removal = elig & valid
 
                 rg = removal[ord_]
-                cg = jnp.where(rg[:, None], au_g, 0).astype(jnp.int64)
+                cg = jnp.where(
+                    rg[:, None, None], au_g, 0
+                ).astype(jnp.int64)
                 cum_all = jnp.cumsum(cg, axis=0)
                 cum_same = jnp.cumsum(
-                    jnp.where(same_g[:, None], cg, 0), axis=0
+                    jnp.where(same_g[:, None, None], cg, 0), axis=0
                 )
                 fits_k = fits_with(cum_same, cum_all, borrow_b)  # [A]
 
@@ -437,33 +514,44 @@ def preempt_targets(
 
         # Full multi-resource search (with the tas_fits probe for TAS
         # entries) + per-cell oracle probes (quota-only, matching the
-        # reference SimulatePreemption).
-        eye = jnp.eye(r_n, dtype=bool)
-        cell_active_p = eye & full_active[None, :]  # [R, R]
-        cell_contested_p = eye & contested_full[None, :]
-        cell_req = jnp.where(cell_active_p, req[None, :], 0)
+        # reference SimulatePreemption). Cells enumerate the (slot,
+        # resource) plane; inactive cells run inert searches.
+        k_cells = s_n * r_n
+        cs = jnp.repeat(jnp.arange(s_n), r_n)  # [K] slot of cell
+        cr = jnp.tile(jnp.arange(r_n), s_n)  # [K] resource of cell
+        eye_sr = (
+            (cs[:, None, None] == jnp.arange(s_n)[None, :, None])
+            & (cr[:, None, None] == jnp.arange(r_n)[None, None, :])
+        )  # [K,S,R]
+        cell_active_p = eye_sr & full_active[None]
+        cell_contested_p = eye_sr & contested_full[None]
+        cell_req = jnp.where(cell_active_p, acc_incl[None], 0)
         full_success, full_victims, variant = search(
-            full_active, contested_full, jnp.where(full_active, req, 0),
+            full_active, contested_full,
+            jnp.where(full_active, req_tot, 0),
             tas_probe=with_tas,
         )
-        cell_success, cell_victims, _vc = jax.vmap(search)(
+        cell_success_k, cell_victims_k, _vc = jax.vmap(search)(
             cell_active_p, cell_contested_p, cell_req
-        )  # [R], [R, A]
+        )  # [K], [K, A]
+        cell_success = cell_success_k.reshape(s_n, r_n)
 
         # Per-cell borrow = the oracle's post-removal height for
         # successful probes, the current height otherwise; FIT cells keep
         # the current height (flavorassigner.go:1213 + oracle).
         root_h = tree.height[root]
+        au_cells = jnp.moveaxis(au, 0, -1).reshape(k_cells, a_n)
         rem_same_cell = jnp.einsum(
-            "ra,ar->r",
-            (cell_victims & same[None, :]).astype(jnp.int64),
-            au,
-        )  # [R] same-CQ removal per single-fr probe at its own cell
+            "ka,ka->k",
+            (cell_victims_k & same[None, :]).astype(jnp.int64),
+            au_cells,
+        ).reshape(s_n, r_n)  # same-CQ removal per probe at its own cell
         h_pre = jnp.where(
-            has_par & (sat_add(u_c, req) > sq_c), root_h, 0
-        )  # [R]
+            has_par & (sat_add(u_c, req_tot) > sq_c), root_h, 0
+        )  # [S,R]
         h_post = jnp.where(
-            has_par & (sat_add(u_c - rem_same_cell, req) > sq_c), root_h, 0
+            has_par & (sat_add(u_c - rem_same_cell, req_tot) > sq_c),
+            root_h, 0,
         )
         cell_borrow = jnp.where(
             contested_full,
@@ -474,16 +562,19 @@ def preempt_targets(
             jnp.where(full_active, cell_borrow, 0)
         ).astype(jnp.int32)
 
-        # Flavor-scan consistency: when the host stopped the fungibility
-        # scan at this flavor, it did so because every contested cell's
-        # oracle reported preempt-mode; a NoCandidates cell would have
-        # continued to later flavors, so such entries must stay on the
-        # host path. A single-flavor CQ has no later flavor — the choice
-        # is forced either way.
-        all_cells_ok = jnp.all(~contested_full | cell_success)
-        resolved = elig_w & (
-            (considered == 1) | (stopped_at_praw & all_cells_ok)
+        # Flavor-scan consistency, per slot: when the host stopped a
+        # slot's fungibility scan at its flavor, it did so because every
+        # contested cell's oracle reported preempt-mode; a NoCandidates
+        # cell would have continued to later flavors, so such entries must
+        # stay on the host path. A single-flavor slot has no later flavor
+        # — the choice is forced either way. Non-praw slots (Fit or
+        # device-resolved NoCandidates with zero praw flavors seen, per
+        # the caller's structural gate) are oracle-independent.
+        cells_ok_s = jnp.all(~contested_full | cell_success, axis=1)  # [S]
+        slot_ok = (
+            ~on | ~spraw | (scons == 1) | (sstop & cells_ok_s)
         )
+        resolved = elig_w & jnp.all(slot_ok)
         success = resolved & full_success
         victims = jnp.where(success, full_victims, False)
         resolved_nc = resolved & ~full_success
@@ -493,8 +584,8 @@ def preempt_targets(
 
     victims, variant, success, resolved_nc, resolved, borrow_after = \
         jax.vmap(per_w)(
-            arrays.w_cq, chosen_flavor, arrays.w_req, arrays.w_priority,
-            arrays.w_timestamp, eligible, praw_stop, considered,
+            arrays.w_cq, sl_f, sl_req, sl_on, sl_praw, sl_stop, sl_cons,
+            arrays.w_priority, arrays.w_timestamp, eligible,
             tas_in["do_tas"], tas_in["t_row"], tas_in["t_req"],
             tas_in["t_cnt"], tas_in["t_ssz"], tas_in["t_sl"],
             tas_in["t_rl"], tas_in["t_rq"], tas_in["t_un"],

@@ -933,6 +933,8 @@ def feasible_only(
     unconstrained: jnp.ndarray,
     cap_override: jnp.ndarray = None,
     sizes: jnp.ndarray = None,
+    leader_req: jnp.ndarray = None,
+    has_leader: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Feasibility-only probe. Deliberately ignores balanced placement:
     a balanced success requires one sibling group to cover the whole
@@ -948,7 +950,8 @@ def feasible_only(
     reachable only via the balanced descent (tas/snapshot.py:1177).
     Skipping balanced here keeps the 2^BMAX subset enumeration out of
     the W-wide vmaps."""
-    f, _ = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
-                 req_level, required, unconstrained,
-                 cap_override=cap_override, sizes=sizes)
-    return f
+    out = place(topo, t, leaf_usage, req, count, slice_size, slice_level,
+                req_level, required, unconstrained,
+                cap_override=cap_override, sizes=sizes,
+                leader_req=leader_req, has_leader=has_leader)
+    return out[0]

@@ -74,6 +74,33 @@ class GranularMode:
         return self.pmode in (PMode.PREEMPT, PMode.RECLAIM)
 
 
+def is_lws_group(pod_sets) -> bool:
+    """The whole workload is ONE two-podset LWS group: both podsets carry
+    a topology_request with a podset_group_name (webhook-validated shape,
+    utils/validation.py). The ONE copy of the group-membership test —
+    the device encoder, the driver decoder and the compatibility gate
+    all key off it, so leader tensors and leader decode stay in step."""
+    return len(pod_sets) == 2 and all(
+        p.topology_request is not None
+        and p.topology_request.podset_group_name for p in pod_sets
+    )
+
+
+def find_leader_and_workers(pod_sets, members):
+    """Two-podset group: leader = the smaller-count member, members[1]
+    on ties (reference findLeaderAndWorkers :726-737). Returns
+    (leader_i or None, worker_i). The ONE copy of this rule — the
+    device encode and driver decode both key worker/leader roles off it,
+    so the worker TA and leader TA attach to the right podsets."""
+    leader_i = None
+    worker_i = members[0]
+    if len(members) > 1:
+        leader_i = members[1]
+        if pod_sets[leader_i].count > pod_sets[worker_i].count:
+            leader_i, worker_i = worker_i, leader_i
+    return leader_i, worker_i
+
+
 def worst_mode() -> GranularMode:
     return GranularMode(PMode.NO_FIT, 1 << 30)
 
@@ -355,15 +382,9 @@ class FlavorAssigner:
 
         assumed: Dict[str, Dict[str, Dict[str, int]]] = {}
         for members in groups:
-            # Two-podset group: leader = the smaller-count member
-            # (reference findLeaderAndWorkers :726-737).
-            leader_i: Optional[int] = None
-            worker_i = members[0]
-            if len(members) > 1:
-                leader_i = members[1]
-                if (self.wl.obj.pod_sets[leader_i].count
-                        > self.wl.obj.pod_sets[worker_i].count):
-                    leader_i, worker_i = worker_i, leader_i
+            leader_i, worker_i = find_leader_and_workers(
+                self.wl.obj.pod_sets, members
+            )
             ps = self.wl.obj.pod_sets[worker_i]
             psa = assignment.pod_sets[worker_i]
             tr = ps.topology_request

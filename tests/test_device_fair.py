@@ -564,3 +564,232 @@ def test_fair_tas_on_device():
     dev_out = run(True)
     assert host_out == dev_out
     assert dev_out["b1"] is not None
+
+
+# ---------------------------------------------------------------------------
+# Fair sharing x multi-podset / multi-resource-group (slot layout).
+# ---------------------------------------------------------------------------
+
+
+def _fair_multislot_env(n_cqs=3, weights=(1.0, 1.0, 2.0)):
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FairSharing,
+        FlavorQuotas,
+        ResourceGroup,
+    )
+
+    cqs = []
+    for i in range(n_cqs):
+        rgs = [
+            ResourceGroup(
+                covered_resources=["cpu", "memory"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=8_000),
+                    "memory": ResourceQuota(nominal=1 << 40),
+                })],
+            ),
+            ResourceGroup(
+                covered_resources=["gpu"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "gpu": ResourceQuota(nominal=8_000),
+                })],
+            ),
+        ]
+        cqs.append(ClusterQueue(
+            name=f"cq{i}", cohort="co", resource_groups=rgs,
+            fair_sharing=FairSharing(weight=weights[i % len(weights)]),
+        ))
+    return build_env(cqs, cohorts=[Cohort(name="co")], fair_sharing=True)
+
+
+def _multi_wl(name, queue, pod_reqs, t, priority=0):
+    from kueue_tpu.api.types import PodSet, Workload
+
+    return Workload(
+        name=name, namespace="default", queue_name=queue,
+        pod_sets=[
+            PodSet(name=f"ps{j}", count=1, requests=dict(r))
+            for j, r in enumerate(pod_reqs)
+        ],
+        priority=priority, creation_time=t,
+    )
+
+
+def test_fair_multislot_on_device():
+    """Multi-podset entries (two RGs -> two slots) run the DRS tournament
+    on device with zero host fallback: per-plane fit walk, dedup-aggregated
+    DRS simulation, per-plane usage bubbling (fair_sharing.go:149 adds the
+    whole assignment map)."""
+    results = {}
+    for device in (False, True):
+        cache, queues, host = _fair_multislot_env()
+        sched = (
+            DeviceScheduler(cache, queues, fair_sharing=True)
+            if device else host
+        )
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    f"host fallback for {[i.obj.name for i in infos]}"
+                )
+
+            sched._host_process = boom
+        submit(
+            queues,
+            _multi_wl("m0", "lq-cq0",
+                      [{"cpu": 3000, "gpu": 2000}, {"cpu": 2000}], t=1.0),
+            _multi_wl("m1", "lq-cq1",
+                      [{"cpu": 4000}, {"gpu": 3000}], t=2.0),
+            _multi_wl("m2", "lq-cq2", [{"cpu": 2000, "gpu": 2000}], t=3.0),
+        )
+        trace = []
+        for _ in range(6):
+            r = sched.schedule()
+            trace.append((sorted(r.admitted), sorted(r.preempted)))
+            if not r.admitted and not r.preempted:
+                break
+        results[device] = trace
+    assert results[True] == results[False]
+
+
+def test_fair_multislot_tournament_order():
+    """The DRS simulation for a multi-slot entry adds usage on BOTH its
+    planes — a borrowing multi-slot entry must lose the tournament to an
+    idle CQ's entry exactly like the host decides."""
+    results = {}
+    for device in (False, True):
+        cache, queues, host = _fair_multislot_env(weights=(1.0, 1.0, 1.0))
+        sched = (
+            DeviceScheduler(cache, queues, fair_sharing=True)
+            if device else host
+        )
+        # cq0 borrows on both planes first (gpu pool 3x8000 = 24000;
+        # after a0 only 10000 gpu remains, so exactly one of the two
+        # 6000-gpu entries below can fit).
+        submit(queues, _multi_wl(
+            "a0", "lq-cq0",
+            [{"cpu": 10_000}, {"gpu": 14_000}], t=1.0,
+        ))
+        r = sched.schedule()
+        assert sorted(r.admitted) == ["default/a0"], (device, r.admitted)
+        # Earlier multi-slot entry on the borrowing CQ vs later entry on
+        # the idle CQ: fair order must pick the idle CQ's entry.
+        submit(
+            queues,
+            _multi_wl("a1", "lq-cq0", [{"cpu": 6000}, {"gpu": 6000}],
+                      t=2.0),
+            _multi_wl("b1", "lq-cq1", [{"cpu": 6000}, {"gpu": 6000}],
+                      t=3.0),
+        )
+        r = sched.schedule()
+        results[device] = sorted(r.admitted)
+    assert results[True] == results[False]
+    assert results[True] == ["default/b1"]
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fair_multislot_differential(seed):
+    """Randomized fair scenarios with multi-podset/multi-RG workloads:
+    per-cycle traces and end states must match the host bit for bit."""
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FairSharing,
+        FlavorQuotas,
+        PodSet,
+        ResourceGroup,
+        Workload,
+    )
+
+    def scenario():
+        # Rebuilt per run: scheduling mutates the Workload objects, so
+        # sharing them across the host and device runs corrupts the
+        # second run.
+        rng = random.Random(91_000 + seed)
+        n_cohorts = rng.randint(1, 2)
+        cohorts = [Cohort(name=f"co{i}") for i in range(n_cohorts)]
+        if n_cohorts == 2 and rng.random() < 0.5:
+            cohorts[1].parent = "co0"
+        cqs = []
+        for i in range(rng.randint(2, 4)):
+            rgs = [ResourceGroup(
+                covered_resources=["cpu", "memory"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(
+                        nominal=rng.randint(0, 10) * 1000,
+                        borrowing_limit=rng.choice(
+                            [None, rng.randint(0, 8) * 1000]
+                        ),
+                    ),
+                    "memory": ResourceQuota(nominal=1 << 40),
+                })],
+            )]
+            if rng.random() < 0.8:
+                rgs.append(ResourceGroup(
+                    covered_resources=["gpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "gpu": ResourceQuota(
+                            nominal=rng.randint(0, 10) * 1000
+                        ),
+                    })],
+                ))
+            cqs.append(ClusterQueue(
+                name=f"cq{i}",
+                cohort=rng.choice([c.name for c in cohorts] + [None]),
+                resource_groups=rgs,
+                fair_sharing=FairSharing(
+                    weight=rng.choice([None, 0.5, 1.0, 2.0])
+                ),
+            ))
+        wls = []
+        t = 0.0
+        for i in range(rng.randint(4, 14)):
+            t += 1.0
+            cq = rng.choice(cqs)
+            two_rg = len(cq.resource_groups) > 1
+            n_ps = rng.randint(1, 3)
+            pod_sets = []
+            for p in range(n_ps):
+                reqs = {"cpu": rng.randrange(1, 6) * 500}
+                if two_rg and rng.random() < 0.7:
+                    reqs["gpu"] = rng.randrange(1, 5) * 500
+                pod_sets.append(
+                    PodSet(name=f"ps{p}", count=1, requests=reqs)
+                )
+            wls.append(Workload(
+                name=f"w{i}", namespace="default",
+                queue_name=f"lq-{cq.name}", pod_sets=pod_sets,
+                priority=rng.choice([0, 0, 100]), creation_time=t,
+            ))
+        return cohorts, cqs, wls
+
+    results = {}
+    for device in (False, True):
+        cohorts, cqs, wls = scenario()
+        cache, queues, host = build_env(
+            cqs, cohorts=cohorts, fair_sharing=True
+        )
+        sched = (
+            DeviceScheduler(cache, queues, fair_sharing=True)
+            if device else host
+        )
+        submit(queues, *wls)
+        trace = []
+        for _ in range(40):
+            r = sched.schedule()
+            trace.append((
+                sorted(r.admitted), sorted(r.preempted),
+                sorted(r.preempting),
+            ))
+            if not r.admitted and not r.preempted and not r.preempting:
+                break
+        admitted = {}
+        for key, info in cache.workloads.items():
+            adm = info.obj.status.admission
+            admitted[info.obj.name] = None if adm is None else [
+                (psa.name, sorted(psa.flavors.items()),
+                 sorted(psa.resource_usage.items()))
+                for psa in adm.pod_set_assignments
+            ]
+        results[device] = (trace, admitted)
+    assert results[True] == results[False]

@@ -295,9 +295,11 @@ def test_multi_rg_second_group_nofit_rejects_whole_workload():
         assert "default/w" not in cache.workloads, f"device={device}"
 
 
-def test_multislot_preemption_defers_to_host():
-    """A multi-podset workload needing preemption routes through the host
-    preemptor; end state matches the pure-host scheduler."""
+def test_multislot_preemption_on_device():
+    """A multi-podset workload needing preemption resolves its victim set
+    in the slot-aware device kernel — zero host fallback — and the end
+    state matches the pure-host scheduler (preemption.go:131 GetTargets
+    over the whole assignment's FlavorResource usage)."""
     from kueue_tpu.api.constants import PreemptionPolicy
 
     preemption = ClusterQueuePreemption(
@@ -310,6 +312,13 @@ def test_multislot_preemption_defers_to_host():
             preemption=preemption,
         )
         sched = DeviceScheduler(cache, queues) if device else host
+        if device:
+            sched._host_process = lambda infos: (_ for _ in ()).throw(
+                AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+            )
         low = _wl("low", [{"cpu": 3000}], t=1.0, priority=0)
         high = _wl("high", [{"cpu": 2000}, {"cpu": 2000}], t=2.0,
                    priority=100)
@@ -327,6 +336,123 @@ def test_multislot_preemption_defers_to_host():
             is_evicted(low),
         )
     assert results[False] == results[True]
+
+
+def test_multislot_preemption_two_planes_joint_victims():
+    """Victim selection spanning two flavor planes: the preemptor's podsets
+    land on both RGs and the victim's removal must free BOTH planes for
+    the full search to succeed (workloadFits over the whole usage map)."""
+    from kueue_tpu.api.constants import PreemptionPolicy
+
+    preemption = ClusterQueuePreemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+    )
+    results = {}
+    for device in (False, True):
+        cache, queues, host = _env_two_rg(
+            {"cpu": ResourceQuota(4000), "memory": ResourceQuota(1 << 40)},
+            quotas1a={"gpu": ResourceQuota(4000)},
+            preemption=preemption,
+        )
+        sched = DeviceScheduler(cache, queues) if device else host
+        if device:
+            sched._host_process = lambda infos: (_ for _ in ()).throw(
+                AssertionError("fallback")
+            )
+        low = _wl("low", [{"cpu": 3000, "gpu": 3000}], t=1.0, priority=0)
+        high = _wl(
+            "high", [{"cpu": 2000, "gpu": 1000}, {"cpu": 2000, "gpu": 2000}],
+            t=2.0, priority=100,
+        )
+        submit(queues, low)
+        sched.schedule_all(max_cycles=5)
+        submit(queues, high)
+        sched.schedule_all(max_cycles=5)
+        from kueue_tpu.core.workload_info import is_evicted
+
+        results[device] = (
+            sorted(
+                i.obj.name for i in cache.workloads.values()
+                if i.obj.status.admission is not None
+            ),
+            is_evicted(low),
+        )
+    assert results[False] == results[True]
+
+
+def _preempt_scenario(seed):
+    """Scenario must be rebuilt per run: scheduling mutates the Workload
+    objects (status/conditions), so sharing them across the host and
+    device runs corrupts the second run."""
+    rng = random.Random(77_000 + seed)
+    n_flavors = rng.randint(1, 2)
+    flavor_specs = [ResourceFlavor(name=f"f{i}") for i in range(n_flavors)]
+    cohorts = [Cohort(name="co0")] if rng.random() < 0.7 else []
+    from kueue_tpu.api.constants import PreemptionPolicy
+
+    cqs = []
+    for i in range(rng.randint(1, 3)):
+        two_rg = rng.random() < 0.8
+
+        def cells(res_list):
+            return {
+                res: ResourceQuota(rng.randrange(2, 8) * 1000)
+                for res in res_list
+            }
+
+        rgs = [ResourceGroup(
+            covered_resources=list(RG0_RES),
+            flavors=[FlavorQuotas(name=fs.name, resources=cells(RG0_RES))
+                     for fs in flavor_specs],
+        )]
+        if two_rg:
+            rgs.append(ResourceGroup(
+                covered_resources=list(RG1_RES),
+                flavors=[FlavorQuotas(name=fs.name,
+                                      resources=cells(RG1_RES))
+                         for fs in flavor_specs],
+            ))
+        cqs.append(ClusterQueue(
+            name=f"cq{i}",
+            cohort="co0" if cohorts else None,
+            resource_groups=rgs,
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=rng.choice(
+                    [PreemptionPolicy.LOWER_PRIORITY,
+                     PreemptionPolicy.ANY]
+                ),
+                reclaim_within_cohort=rng.choice(
+                    [PreemptionPolicy.NEVER,
+                     PreemptionPolicy.LOWER_PRIORITY]
+                ),
+            ),
+        ))
+    workloads = []
+    for i in range(rng.randint(6, 16)):
+        cq = rng.choice(cqs)
+        two_rg = len(cq.resource_groups) > 1
+        workloads.append(
+            make_multi_wl(rng, i, cq.name, n_flavors, two_rg)
+        )
+    return flavor_specs, cohorts, cqs, workloads
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_multislot_preemption_matches_host(seed):
+    """Randomized multi-podset/multi-RG scenarios WITH preemption
+    policies: flat-cohort trees (no lending limits) so every entry is
+    device-resolvable; end states must match the host bit for bit."""
+    results = {}
+    for device in (False, True):
+        flavor_specs, cohorts, cqs, workloads = _preempt_scenario(seed)
+        cache, queues, host = build_env(
+            cqs, cohorts=cohorts, flavors=flavor_specs
+        )
+        sched = DeviceScheduler(cache, queues) if device else host
+        submit(queues, *workloads)
+        sched.schedule_all(max_cycles=40)
+        results[device] = full_admissions(cache)
+    assert results[True] == results[False]
 
 
 def test_multislot_mixed_cycle_with_partial_entry():

@@ -802,3 +802,168 @@ def test_device_multilayer_slices_match_host(seed):
     assert dev_state == host_state, (
         f"host={host_state} device={dev_state}"
     )
+
+
+def test_delayed_tas_first_pass_on_device():
+    """TAS + ProvisioningRequest: the first pass is quota-only with the
+    topology request delayed (tas_flavorassigner.go:106) — it must run on
+    the DEVICE path with zero host fallback, marking
+    delayed_topology_request; the manager's second pass then places
+    identically to the pure-host run (scheduler.go:840-884)."""
+    from kueue_tpu.api.types import AdmissionCheck
+    from kueue_tpu.controllers.provisioning import (
+        ProvisioningController,
+        ProvisioningState,
+    )
+    from kueue_tpu.core.workload_info import (
+        has_quota_reservation,
+        has_topology_assignments_pending,
+        is_admitted,
+    )
+
+    class GatedProvider:
+        def __init__(self):
+            self.ready = False
+
+        def poll(self, request):
+            return (ProvisioningState.PROVISIONED if self.ready
+                    else ProvisioningState.PENDING)
+
+    def run(device: bool):
+        provider = GatedProvider()
+        mgr = Manager(use_device_scheduler=device)
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+
+            mgr.scheduler._host_process = boom
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(32)}},
+                    resources=["tpu"], admission_checks=["prov"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            AdmissionCheck(
+                name="prov",
+                controller_name="kueue.x-k8s.io/provisioning-request",
+            ),
+            Topology(name="topo", levels=LEVELS),
+        )
+        for b in range(2):
+            for r in range(2):
+                for h in range(2):
+                    mgr.apply(Node(
+                        name=f"n-{b}-{r}-{h}",
+                        labels={"tpu.block": f"b{b}",
+                                "tpu.rack": f"b{b}-r{r}"},
+                        capacity={"tpu": 8},
+                    ))
+        mgr.register_check_controller(
+            ProvisioningController(provider=provider)
+        )
+        wl = Workload(name="gang", queue_name="lq", pod_sets=[PodSet(
+            name="main", count=2, requests={"tpu": 4},
+            topology_request=TopologyRequest(required_level=LEVELS[1]),
+        )], creation_time=1.0)
+        mgr.create_workload(wl)
+        mgr.schedule_all()
+        assert has_quota_reservation(wl), f"device={device}"
+        psa = wl.status.admission.pod_set_assignments[0]
+        assert psa.delayed_topology_request, f"device={device}"
+        assert psa.topology_assignment is None
+        assert has_topology_assignments_pending(wl)
+
+        provider.ready = True
+        mgr.tick()
+        ta = wl.status.admission.pod_set_assignments[0].topology_assignment
+        assert ta is not None, f"device={device}"
+        assert is_admitted(wl)
+        return sorted(ta.domains)
+
+    host_domains = run(False)
+    dev_domains = run(True)
+    assert host_domains == dev_domains
+
+
+def test_lws_leader_group_on_device():
+    """LWS leader+worker podset group places as ONE request on the DEVICE
+    path — zero host fallback — with the leader leaf one-hot decoded into
+    the leader podset's TopologyAssignment; end state matches the host
+    (flavorassigner.update_for_tas groups, tas_flavor_snapshot.go:725)."""
+    def run(device: bool):
+        mgr = Manager(use_device_scheduler=device)
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+
+            mgr.scheduler._host_process = boom
+        mgr.apply(
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(64)}},
+                    resources=["tpu"]),
+            LocalQueue(name="lq", cluster_queue="cq-a"),
+            Topology(name="topo", levels=LEVELS),
+        )
+        for b in range(2):
+            for r in range(2):
+                for h in range(2):
+                    mgr.apply(Node(
+                        name=f"n-{b}-{r}-{h}",
+                        labels={"tpu.block": f"b{b}",
+                                "tpu.rack": f"b{b}-r{r}"},
+                        capacity={"tpu": 8},
+                    ))
+        wls = []
+        for k in range(3):
+            wls.append(Workload(
+                name=f"lws{k}", queue_name="lq",
+                pod_sets=[
+                    PodSet(
+                        name="leader", count=1, requests={"tpu": 1},
+                        topology_request=TopologyRequest(
+                            required_level=LEVELS[1],
+                            podset_group_name="g",
+                        ),
+                    ),
+                    PodSet(
+                        name="workers", count=2, requests={"tpu": 3},
+                        topology_request=TopologyRequest(
+                            required_level=LEVELS[1],
+                            podset_group_name="g",
+                        ),
+                    ),
+                ],
+                creation_time=float(k + 1),
+            ))
+        for wl in wls:
+            mgr.create_workload(wl)
+        mgr.schedule_all()
+        state = {}
+        for wl in wls:
+            adm = wl.status.admission
+            if adm is None:
+                state[wl.name] = None
+                continue
+            out = []
+            for psa in adm.pod_set_assignments:
+                ta = psa.topology_assignment
+                out.append((
+                    psa.name, sorted(psa.flavors.items()), psa.count,
+                    sorted(ta.domains) if ta else None,
+                ))
+            state[wl.name] = out
+        return state
+
+    host_state = run(False)
+    dev_state = run(True)
+    assert dev_state == host_state
+    # The scenario must actually admit with real leader assignments.
+    assert all(v is not None for v in dev_state.values())
+    for v in dev_state.values():
+        leader_psa = [p for p in v if p[0] == "leader"][0]
+        assert leader_psa[3] is not None and len(leader_psa[3]) == 1
