@@ -111,6 +111,9 @@ class CycleOutputs(NamedTuple):
     # LWS leader leaf one-hot per admitted leader-group entry (None when
     # no leader-group entry this cycle).
     tas_leader_takes: jnp.ndarray = None  # i32[W,D]
+    # Per-slot takes for generic multi-podset TAS entries (None when no
+    # such entry this cycle).
+    s_tas_takes: jnp.ndarray = None  # i32[W,S,D]
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -971,6 +974,9 @@ def admit_scan_grouped(
     with_leader = (
         with_tas and getattr(arrays, "w_tas_leader_req", None) is not None
     )
+    with_stas = (
+        with_tas and getattr(arrays, "s_tas", None) is not None
+    )
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as _P
@@ -1045,7 +1051,8 @@ def admit_scan_grouped(
     chain_is_repeat = gsh(ga.chain_local == chain_next)  # [G,Nm,D+1]
 
     def body(carry, s):
-        usage_g, designated, tas_usage, w_takes, w_ltakes = carry
+        (usage_g, designated, tas_usage, w_takes, w_ltakes,
+         w_stakes) = carry
         pos = starts + s
         in_range = s < counts
         # Per-step gathers pull from REPLICATED [W]/[N] sources with a
@@ -1260,6 +1267,83 @@ def admit_scan_grouped(
                 )  # [G], [G, D]
                 tas_ltake = None
             tas_ok = jnp.where(tas_do, tas_feas, True)
+            if with_stas:
+                # Generic multi-podset / multi-RG TAS: one placement per
+                # TAS slot, sequential in slot order with assumed-usage
+                # threading (flavorassigner.update_for_tas's ``assumed``
+                # dict). At most one entry per step touches a flavor row
+                # (trees sharing a flavor are merged into one group), so
+                # the threaded copy is step-local.
+                s_ax2 = arrays.s_tas.shape[1]
+                fs_all = nom.s_flavor[w]  # [G,S]
+                stas_w = arrays.s_tas[w]
+                t_sim = tas_usage
+                sfeas_all = jnp.ones(g_n, bool)
+                s_do_list, s_tidx_list, s_take_list = [], [], []
+
+                def place_slot(t, u_row, req_v, cnt, ssz, sl_, rl_,
+                               rq_, un_, sz_):
+                    return _tas_place.place(
+                        arrays.tas_topo, t, u_row, req_v, cnt, ssz,
+                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
+                        rq_, un_, sizes=sz_,
+                    )
+
+                for si in range(s_ax2):
+                    f_si = fs_all[:, si]
+                    t_of_si = jnp.where(
+                        f_si >= 0,
+                        arrays.tas_of_flavor[
+                            jnp.clip(f_si, 0, f_all - 1)
+                        ],
+                        -1,
+                    )
+                    do_si = (
+                        valid & stas_w[:, si] & (t_of_si >= 0)
+                        & (pm == P_FIT)
+                    )
+                    t_idx_si = jnp.clip(
+                        t_of_si, 0, tas_usage.shape[0] - 1
+                    )
+                    rl_si = arrays.s_tas_req_level[w][:, si][
+                        g_iota, t_idx_si
+                    ]
+                    sl_si = arrays.s_tas_slice_level[w][:, si][
+                        g_iota, t_idx_si
+                    ]
+                    sz_si = arrays.s_tas_sizes[w][:, si][
+                        g_iota, t_idx_si
+                    ]
+                    feas_si, take_si = jax.vmap(place_slot)(
+                        t_idx_si, t_sim[t_idx_si],
+                        arrays.s_tas_req[w][:, si],
+                        arrays.s_tas_count[w][:, si],
+                        arrays.s_tas_slice_size[w][:, si],
+                        sl_si, rl_si,
+                        arrays.s_tas_required[w][:, si],
+                        arrays.s_tas_unconstrained[w][:, si],
+                        sz_si,
+                    )
+                    feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
+                    delta_si = (
+                        take_si[:, :, None]
+                        * arrays.s_tas_usage_req[w][:, si][:, None, :]
+                    )
+                    t_sim = t_sim.at[t_idx_si].add(jnp.where(
+                        (do_si & feas_si)[:, None, None], delta_si, 0
+                    ))
+                    sfeas_all = sfeas_all & jnp.where(
+                        do_si, feas_si, True
+                    )
+                    s_do_list.append(do_si)
+                    s_tidx_list.append(t_idx_si)
+                    s_take_list.append(
+                        jnp.where(do_si[:, None], take_si, 0)
+                    )
+                has_stas_g = jnp.any(stas_w, axis=1)
+                tas_ok = tas_ok & jnp.where(
+                    valid & has_stas_g & (pm == P_FIT), sfeas_all, True
+                )
         else:
             tas_ok = True
             tas_do = None
@@ -1396,9 +1480,27 @@ def admit_scan_grouped(
                     ).astype(jnp.int32),
                     mode="drop",
                 )
+            if with_stas:
+                for si in range(s_ax2):
+                    do_c = admit & s_do_list[si]
+                    add = (
+                        s_take_list[si][:, :, None]
+                        * arrays.s_tas_usage_req[w][:, si][:, None, :]
+                    )
+                    tas_usage = tas_usage.at[s_tidx_list[si]].add(
+                        jnp.where(do_c[:, None, None], add, 0)
+                    )
+                    w_stakes = w_stakes.at[
+                        jnp.where(do_c, w, w_n), si
+                    ].add(
+                        jnp.where(
+                            do_c[:, None], s_take_list[si], 0
+                        ).astype(jnp.int32),
+                        mode="drop",
+                    )
         w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
-        return (new_usage_g, designated, tas_usage, w_takes, w_ltakes), \
-            (w_out, admit, preempt_ok)
+        return (new_usage_g, designated, tas_usage, w_takes, w_ltakes,
+                w_stakes), (w_out, admit, preempt_ok)
 
     designated0 = (
         jnp.zeros(a_n, bool) if with_preempt else jnp.zeros(1, bool)
@@ -1414,11 +1516,20 @@ def admit_scan_grouped(
         jnp.zeros((w_n + 1, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
         if with_leader else jnp.zeros((1,), jnp.int32)
     )
-    (final_usage_g, _designated, _tas_u, w_takes_f, w_ltakes_f), \
-        (w_mat, admit_mat, pre_mat) = jax.lax.scan(
-            body, (usage_g, designated0, tas_usage0, takes0, ltakes0),
-            jnp.arange(s_max), unroll=unroll,
+    stakes0 = (
+        jnp.zeros(
+            (w_n + 1, arrays.s_tas.shape[1],
+             arrays.tas_topo.leaf_cap.shape[1]),
+            jnp.int32,
         )
+        if with_stas else jnp.zeros((1,), jnp.int32)
+    )
+    (final_usage_g, _designated, _tas_u, w_takes_f, w_ltakes_f,
+     w_stakes_f), (w_mat, admit_mat, pre_mat) = jax.lax.scan(
+        body, (usage_g, designated0, tas_usage0, takes0, ltakes0,
+               stakes0),
+        jnp.arange(s_max), unroll=unroll,
+    )
     admitted = rep(jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
         admit_mat.ravel(), mode="drop"
     )[:w_n])
@@ -1434,8 +1545,9 @@ def admit_scan_grouped(
     )
     tas_takes = w_takes_f[:w_n] if with_tas else None
     tas_leader_takes = w_ltakes_f[:w_n] if with_leader else None
+    s_tas_takes = w_stakes_f[:w_n] if with_stas else None
     return final_usage, admitted, preempting_out, tas_takes, \
-        tas_leader_takes
+        tas_leader_takes, s_tas_takes
 
 
 def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
@@ -1513,6 +1625,94 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
     needs_host2 = jnp.where(
         tas_entry, pm2 == P_PREEMPT_RAW, nom.needs_host
     )
+
+    if getattr(arrays, "s_tas", None) is not None:
+        # Generic multi-podset TAS entries: per-slot sequential
+        # feasibility with per-ENTRY assumed-usage threading (the host's
+        # ``assumed`` dict is scoped to one workload's update_for_tas
+        # call — entries must not see each other's simulated takes).
+        s_ax = arrays.s_tas.shape[1]
+        t_rows = arrays.tas_usage0.shape[0]
+
+        def slot_feas(usage_all):
+            # Per-(entry, topology-row) assumed takes — the host's
+            # ``assumed`` dict is keyed by flavor within one workload.
+            # [W,T,D,R] is affordable because this branch only compiles
+            # when a multi-podset TAS entry exists (small TAS cycles; the
+            # flagship configs have none); a compact multi-TAS row index
+            # is the round-5 refinement if W-wide TAS cycles appear.
+            extra = jnp.zeros(
+                (w_n,) + arrays.tas_usage0.shape, jnp.int64
+            )
+            ok = jnp.ones(w_n, bool)
+            for si in range(s_ax):
+                f_si = nom.s_flavor[:, si]
+                t_of_si = jnp.where(
+                    f_si >= 0,
+                    arrays.tas_of_flavor[jnp.clip(f_si, 0, f_n - 1)],
+                    -1,
+                )
+                do_si = arrays.s_tas[:, si] & (t_of_si >= 0)
+                t_idx_si = jnp.clip(t_of_si, 0, t_rows - 1)
+                rl_si = arrays.s_tas_req_level[w_iota, si, t_idx_si]
+                sl_si = arrays.s_tas_slice_level[w_iota, si, t_idx_si]
+                sz_si = arrays.s_tas_sizes[w_iota, si, t_idx_si]
+                u_rows = usage_all[t_idx_si] + extra[
+                    w_iota, t_idx_si
+                ]
+
+                def pl(t, u_row, req, cnt, ssz, sl_, rl_, rq_, un_,
+                       sz_):
+                    return tas_place.place(
+                        arrays.tas_topo, t, u_row, req, cnt, ssz,
+                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
+                        rq_, un_, sizes=sz_,
+                    )
+
+                feas_si, take_si = jax.vmap(pl)(
+                    t_idx_si, u_rows,
+                    arrays.s_tas_req[:, si],
+                    arrays.s_tas_count[:, si],
+                    arrays.s_tas_slice_size[:, si],
+                    sl_si, rl_si,
+                    arrays.s_tas_required[:, si],
+                    arrays.s_tas_unconstrained[:, si],
+                    sz_si,
+                )
+                feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
+                add = (
+                    take_si[:, :, None]
+                    * arrays.s_tas_usage_req[:, si][:, None, :]
+                )
+                live = do_si & feas_si
+                extra = extra.at[w_iota, t_idx_si].add(
+                    jnp.where(live[:, None, None], add, 0)
+                )
+                ok = ok & jnp.where(do_si, feas_si, True)
+            return ok
+
+        stas_entry = (
+            jnp.any(arrays.s_tas, axis=1) & arrays.w_active
+        )
+        sfeas_now = slot_feas(arrays.tas_usage0) & ~arrays.w_tas_invalid
+        sfeas_empty = slot_feas(
+            jnp.zeros_like(arrays.tas_usage0)
+        ) & ~arrays.w_tas_invalid
+        sdown = stas_entry & (pm2 == P_FIT) & ~sfeas_now
+        pm3 = jnp.where(
+            sdown,
+            jnp.where(arrays.never_preempts[arrays.w_cq],
+                      P_NO_CANDIDATES, P_PREEMPT_RAW),
+            pm2,
+        )
+        spre = stas_entry & (
+            (pm3 == P_PREEMPT_RAW) | (pm3 == P_NO_CANDIDATES)
+        )
+        pm2 = jnp.where(spre & ~sfeas_empty, P_NOFIT, pm3)
+        needs_host2 = jnp.where(
+            stas_entry, pm2 == P_PREEMPT_RAW, needs_host2
+        )
+        downgrade = downgrade | sdown
     return nom._replace(best_pmode=pm2, needs_host=needs_host2), downgrade
 
 
@@ -1530,7 +1730,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
 
     def finish(arrays, nom, final_usage, admitted, preempting, order,
                victims=None, variant=None, partial_count=None,
-               tas_takes=None, tas_leader_takes=None):
+               tas_takes=None, tas_leader_takes=None, s_tas_takes=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -1575,6 +1775,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             s_tried=nom.s_tried,
             tas_takes=tas_takes,
             tas_leader_takes=tas_leader_takes,
+            s_tas_takes=s_tas_takes,
         )
 
     def apply_partial(arrays, nom):
@@ -1597,14 +1798,15 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 arrays, nom, partial_count = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            final_usage, admitted, preempting, tas_takes, tas_ltakes = \
-                admit_scan_grouped(
-                    arrays, ga, nom, usage, order, s, unroll=unroll,
-                    n_levels=n_levels, mesh=mesh,
-                )
+            (final_usage, admitted, preempting, tas_takes, tas_ltakes,
+             s_takes) = admit_scan_grouped(
+                arrays, ga, nom, usage, order, s, unroll=unroll,
+                n_levels=n_levels, mesh=mesh,
+            )
             return finish(arrays, nom, final_usage, admitted, preempting,
                           order, partial_count=partial_count,
-                          tas_takes=tas_takes, tas_leader_takes=tas_ltakes)
+                          tas_takes=tas_takes, tas_leader_takes=tas_ltakes,
+                          s_tas_takes=s_takes)
 
         return impl
 
@@ -1672,6 +1874,12 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                     # leader planes).
                     tas_allowed = tas_allowed & ~arrays.w_tas_has_leader
             base_elig = base_elig & (~arrays.w_tas | tas_allowed)
+        if getattr(arrays, "s_tas", None) is not None:
+            # Generic multi-podset TAS entries needing preemption keep
+            # the host victim search (per-slot tas_fits probes are not
+            # in the kernels); the whole-tree discard keeps the cycle
+            # exact.
+            base_elig = base_elig & ~jnp.any(arrays.s_tas, axis=1)
         # The hierarchical kernel still reads the legacy single-slot
         # fields; multi-slot / off-RG0 entries on nested trees defer to
         # the host preemptor (the flat kernel is slot-aware).
@@ -1726,14 +1934,14 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         (final_usage, admitted, preempting, tas_takes,
-         tas_ltakes) = admit_scan_grouped(
+         tas_ltakes, s_takes) = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
             unroll=unroll, n_levels=n_levels, mesh=mesh,
         )
         return finish(arrays, nom, final_usage, admitted, preempting, order,
                       victims=tgt.victims, variant=tgt.variant,
                       partial_count=partial_count, tas_takes=tas_takes,
-                      tas_leader_takes=tas_ltakes)
+                      tas_leader_takes=tas_ltakes, s_tas_takes=s_takes)
 
     return impl_preempt
 

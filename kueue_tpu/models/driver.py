@@ -147,7 +147,8 @@ class DeviceScheduler:
             # Admitted TAS entries: the placement kernel emits its own
             # per-leaf takes (CycleOutputs.tas_takes), so domains decode
             # directly in O(assignments) — no host placement replay.
-            tas_assignments, leader_tas = self._decode_tas_assignments(
+            (tas_assignments, leader_tas,
+             slot_tas) = self._decode_tas_assignments(
                 out, outcome, chosen, idx
             )
 
@@ -195,10 +196,15 @@ class DeviceScheduler:
                     lws_group = (
                         not multi and is_lws_group(info.obj.pod_sets)
                     )
-                    if multi:
+                    if multi or i in slot_tas:
+                        # i in slot_tas covers single-slot off-RG0 TAS
+                        # entries: encoded per-slot, decoded per-slot —
+                        # they must not fall into the single-psa applier
+                        # (which would drop their TopologyAssignment).
                         self._apply_admission_slots(
                             info, slots_i, s_flavor[i], s_tried[i], idx,
                             snapshot, delayed_tas=delayed_i,
+                            tas_by_pid=slot_tas.get(i),
                         )
                     elif lws_group:
                         # Keyed on the GROUP SHAPE, not on decode output:
@@ -314,32 +320,24 @@ class DeviceScheduler:
         from kueue_tpu.api.types import TopologyAssignment
 
         if not idx.tas_flavor_names or out.tas_takes is None:
-            return {}, {}
+            return {}, {}, {}
         takes = np.asarray(out.tas_takes)
         ltakes = (
             np.asarray(out.tas_leader_takes)
             if out.tas_leader_takes is not None else None
         )
+        stakes = (
+            np.asarray(out.s_tas_takes)
+            if out.s_tas_takes is not None else None
+        )
+        s_flavors = (
+            np.asarray(out.s_flavor) if out.s_flavor is not None else None
+        )
         row_of = {name: t for t, name in enumerate(idx.tas_flavor_names)}
-        assignments = {}
-        leader_assignments = {}
-        for i, info in enumerate(idx.workloads):
-            if outcome[i] != batch_scheduler.OUT_ADMITTED:
-                continue
-            if info.obj.pod_sets[0].topology_request is None:
-                continue
-            if idx.delayed_tas and idx.delayed_tas[i]:
-                continue  # quota-only first pass: second pass places
-            t = row_of.get(idx.flavors[chosen[i]])
-            if t is None:
-                continue
+
+        def _domains_of(t, row):
             tas = idx.tas_snapshots[t]
             perm = idx.tas_leaf_perm[t]
-            row = takes[i]
-            # buildAssignment semantics (tas_flavor_snapshot.py:1175 /
-            # reference :1663): node-level topologies emit hostname-only
-            # domains; device leaf order is level_values-sorted, matching
-            # the host's domain sort.
             li = len(tas.level_keys) - 1 if tas.lowest_is_node else 0
             domains = []
             for j in np.flatnonzero(row[: len(perm)]):
@@ -347,21 +345,51 @@ class DeviceScheduler:
                 domains.append(
                     (tuple(leaf.level_values[li:]), int(row[j]))
                 )
-            assignments[i] = TopologyAssignment(
+            return TopologyAssignment(
                 levels=list(tas.level_keys[li:]), domains=domains
             )
+
+        assignments = {}
+        leader_assignments = {}
+        slot_assignments = {}
+        for i, info in enumerate(idx.workloads):
+            if outcome[i] != batch_scheduler.OUT_ADMITTED:
+                continue
+            if idx.delayed_tas and idx.delayed_tas[i]:
+                continue  # quota-only first pass: second pass places
+            # Generic multi-podset TAS: per-slot takes decode to one TA
+            # per TAS podset (singleton groups).
+            if (
+                stakes is not None and idx.slots
+                and i < len(idx.slots) and idx.slots[i] is not None
+                and stakes[i].any()
+            ):
+                by_pid = {}
+                for si, sl in enumerate(idx.slots[i]):
+                    if si >= stakes.shape[1] or not stakes[i, si].any():
+                        continue
+                    fidx = int(s_flavors[i, si])
+                    t = row_of.get(idx.flavors[fidx]) \
+                        if 0 <= fidx < len(idx.flavors) else None
+                    if t is None:
+                        continue
+                    by_pid[sl.ps_ids[0]] = _domains_of(t, stakes[i, si])
+                if by_pid:
+                    slot_assignments[i] = by_pid
+                continue
+            if info.obj.pod_sets[0].topology_request is None:
+                continue
+            t = row_of.get(idx.flavors[chosen[i]])
+            if t is None:
+                continue
+            # buildAssignment semantics (tas_flavor_snapshot.py:1175 /
+            # reference :1663): node-level topologies emit hostname-only
+            # domains; device leaf order is level_values-sorted, matching
+            # the host's domain sort.
+            assignments[i] = _domains_of(t, takes[i])
             if ltakes is not None and ltakes[i].any():
-                lrow = ltakes[i]
-                ldomains = []
-                for j in np.flatnonzero(lrow[: len(perm)]):
-                    leaf = tas.leaves[perm[int(j)]]
-                    ldomains.append(
-                        (tuple(leaf.level_values[li:]), int(lrow[j]))
-                    )
-                leader_assignments[i] = TopologyAssignment(
-                    levels=list(tas.level_keys[li:]), domains=ldomains
-                )
-        return assignments, leader_assignments
+                leader_assignments[i] = _domains_of(t, ltakes[i])
+        return assignments, leader_assignments, slot_assignments
 
     def _apply_admission(
         self, info: WorkloadInfo, flavor: str, tried_idx: int, snapshot,
@@ -462,7 +490,7 @@ class DeviceScheduler:
 
     def _apply_admission_slots(
         self, info: WorkloadInfo, slots, flavor_row, tried_row, idx,
-        snapshot, delayed_tas=False,
+        snapshot, delayed_tas=False, tas_by_pid=None,
     ) -> None:
         """Multi-podset / multi-resource-group admission decode: one
         PodSetAssignment per podset with per-resource flavors recovered
@@ -485,6 +513,9 @@ class DeviceScheduler:
                     flavors=dict(flavors_by_ps[pid]),
                     resource_usage=dict(ps.requests),
                     count=ps.count,
+                    topology_assignment=(
+                        tas_by_pid.get(pid) if tas_by_pid else None
+                    ),
                     delayed_topology_request=bool(
                         delayed_tas
                         and pid < len(info.obj.pod_sets)

@@ -141,6 +141,24 @@ class CycleArrays(NamedTuple):
     w_tas_leader_req: Optional[jnp.ndarray] = None  # i64[W,R+1]
     w_tas_leader_usage_req: Optional[jnp.ndarray] = None  # i64[W,R+1]
     w_tas_has_leader: Optional[jnp.ndarray] = None  # bool[W]
+    # -- per-slot TAS (generic multi-podset / multi-RG TAS entries; None
+    # when every TAS entry this cycle is single-slot or an LWS pair).
+    # Each TAS slot is a singleton podset group placing on its own
+    # chosen flavor's topology, sequentially in slot order with
+    # assumed-usage threading (the host's ``assumed`` dict in
+    # flavorassigner.update_for_tas). Entries here do NOT set w_tas —
+    # the legacy per-entry fields drive single-slot/LWS entries and the
+    # two paths coexist in one cycle.
+    s_tas: Optional[jnp.ndarray] = None  # bool[W,S]
+    s_tas_req: Optional[jnp.ndarray] = None  # i64[W,S,R+1]
+    s_tas_usage_req: Optional[jnp.ndarray] = None  # i64[W,S,R+1]
+    s_tas_count: Optional[jnp.ndarray] = None  # i64[W,S]
+    s_tas_slice_size: Optional[jnp.ndarray] = None  # i64[W,S]
+    s_tas_req_level: Optional[jnp.ndarray] = None  # i32[W,S,T]
+    s_tas_slice_level: Optional[jnp.ndarray] = None  # i32[W,S,T]
+    s_tas_sizes: Optional[jnp.ndarray] = None  # i64[W,S,T,LMAX]
+    s_tas_required: Optional[jnp.ndarray] = None  # bool[W,S]
+    s_tas_unconstrained: Optional[jnp.ndarray] = None  # bool[W,S]
     # -- fair sharing (None unless the fair tournament kernel is in use) --
     node_weight: Optional[jnp.ndarray] = None  # f64[N] FairSharing weight
     node_is_cq: Optional[jnp.ndarray] = None  # bool[N]
@@ -812,7 +830,97 @@ def _encode_tas(
     w_tas_leader_usage = None
     w_tas_has_leader = None
 
+    # Generic multi-podset / multi-RG TAS entries take the per-slot
+    # path below; the legacy per-entry loop must not claim them.
+    _slots_list = idx.slots if idx.slots else None
+    _multi_tas_set = set()
+    if _slots_list is not None:
+        from kueue_tpu.scheduler.flavorassigner import is_lws_group             as _is_lws
+
+        for _i, _info in enumerate(device_wls):
+            if _i >= len(_slots_list) or _slots_list[_i] is None:
+                continue
+            _sl = _slots_list[_i]
+            if not (len(_sl) > 1 or _sl[0].rg_idx != 0):
+                continue
+            if _is_lws(_info.obj.pod_sets):
+                continue
+            if idx.delayed_tas and idx.delayed_tas[_i]:
+                continue
+            if any(ps.topology_request is not None
+                   for ps in _info.obj.pod_sets):
+                _multi_tas_set.add(_i)
+
+    def _fill_request_rows(ps, tr, set_vec, set_scalar, set_level,
+                           set_sizes):
+        """Per-request fill for the per-slot TAS rows: request/usage
+        vectors, slice config and per-topology level/size rows. Same
+        rules as the legacy per-entry loop below (which keeps its
+        long-validated inline copy) — change BOTH when the level/size
+        derivation changes."""
+        for res, v in ps.requests.items():
+            ci = tidx.resource_of.get(res)
+            if ci is not None:
+                set_vec("req", ci, v)
+                set_vec("usage", ci, v)
+        pods_req = ps.requests.get("pods", 0)
+        set_vec("req", r_cy, 0 if pods_req > 0 else 1)
+        set_vec("usage", r_cy, pods_req)
+        required = tr.required_level is not None
+        uncon = tr.unconstrained or (
+            tr.required_level is None and tr.preferred_level is None
+        )
+        level_key = tr.required_level or tr.preferred_level
+        has_slice = tr.slice_required_level is not None
+        ssz = (tr.slice_size or 1) if has_slice else 1
+        set_scalar("count", ps.count)
+        set_scalar("ssz", ssz)
+        set_scalar("required", required)
+        set_scalar("uncon", uncon)
+        invalid = bool(ssz > 0 and ps.count % ssz != 0)
+        for t, tas in enumerate(tas_snaps):
+            keys = tas.level_keys
+            lk = level_key if level_key is not None else (
+                keys[-1] if keys else None
+            )
+            if lk not in keys:
+                continue
+            rl = keys.index(lk)
+            if has_slice:
+                if tr.slice_required_level not in keys:
+                    continue
+                sl = keys.index(tr.slice_required_level)
+            else:
+                sl = len(keys) - 1
+            if rl > sl:
+                continue
+            layers_ok = True
+            if getattr(tr, "slice_layers", None):
+                from kueue_tpu.utils import features as _lfeat
+
+                if not _lfeat.enabled("TASMultiLayerTopology"):
+                    layers_ok = False
+                prev_idx2, prev_size2 = sl, ssz
+                for layer_level, layer_size in tr.slice_layers:
+                    if layer_level not in keys:
+                        layers_ok = False
+                        break
+                    li2 = keys.index(layer_level)
+                    if (li2 <= prev_idx2 or layer_size <= 0
+                            or prev_size2 % layer_size != 0):
+                        layers_ok = False
+                        break
+                    set_sizes(t, prev_idx2 + 1, li2 + 1, layer_size)
+                    prev_idx2, prev_size2 = li2, layer_size
+            if not layers_ok:
+                set_sizes(t, 0, _LMAX, 1)
+                continue
+            set_level(t, rl, sl)
+        return invalid
+
     for i, info in enumerate(device_wls):
+        if i in _multi_tas_set:
+            continue
         pods = info.obj.pod_sets
         ps = pods[0]
         leader_ps = None
@@ -1027,6 +1135,72 @@ def _encode_tas(
         fields["w_tas_leader_req"] = np.asarray(w_tas_leader_req)
         fields["w_tas_leader_usage_req"] = np.asarray(w_tas_leader_usage)
         fields["w_tas_has_leader"] = np.asarray(w_tas_has_leader)
+
+    # Per-slot TAS rows for generic multi-podset / multi-RG TAS entries
+    # (singleton podset groups only — the compat gate enforces it).
+    # These entries keep w_tas False; the grouped scan runs the per-slot
+    # sequential placement path for them alongside the legacy path.
+    multi_rows = sorted(_multi_tas_set)
+    if multi_rows:
+        slots_list = idx.slots
+        if slots_list:
+            s_n2 = idx.n_slots
+            s_tas = np.zeros((w, s_n2), bool)
+            s_req_v = np.zeros((w, s_n2, r1), np.int64)
+            s_usage_v = np.zeros((w, s_n2, r1), np.int64)
+            s_count = np.zeros((w, s_n2), np.int64)
+            s_ssz = np.ones((w, s_n2), np.int64)
+            s_rl = np.full((w, s_n2, t_n), -1, np.int32)
+            s_sl = np.full((w, s_n2, t_n), -1, np.int32)
+            s_sizes = np.ones((w, s_n2, t_n, _LMAX), np.int64)
+            s_required = np.zeros((w, s_n2), bool)
+            s_uncon = np.zeros((w, s_n2), bool)
+            for i in multi_rows:
+                for si, sl_u in enumerate(slots_list[i]):
+                    ps = device_wls[i].obj.pod_sets[sl_u.ps_ids[0]]
+                    tr = ps.topology_request
+                    if tr is None:
+                        continue
+
+                    def set_vec(kind, ci, v, i=i, si=si):
+                        (s_req_v if kind == "req" else s_usage_v)[
+                            i, si, ci
+                        ] = v
+
+                    def set_scalar(kind, v, i=i, si=si):
+                        if kind == "count":
+                            s_count[i, si] = v
+                        elif kind == "ssz":
+                            s_ssz[i, si] = v
+                        elif kind == "required":
+                            s_required[i, si] = v
+                        elif kind == "uncon":
+                            s_uncon[i, si] = v
+
+                    def set_level(t, rl, sl2, i=i, si=si):
+                        s_rl[i, si, t] = rl
+                        s_sl[i, si, t] = sl2
+
+                    def set_sizes(t, lo, hi, v, i=i, si=si):
+                        s_sizes[i, si, t, lo:hi] = v
+
+                    invalid = _fill_request_rows(
+                        ps, tr, set_vec, set_scalar, set_level, set_sizes
+                    )
+                    if invalid:
+                        w_tas_invalid[i] = True
+                    s_tas[i, si] = True
+            fields["s_tas"] = s_tas
+            fields["s_tas_req"] = s_req_v
+            fields["s_tas_usage_req"] = s_usage_v
+            fields["s_tas_count"] = s_count
+            fields["s_tas_slice_size"] = s_ssz
+            fields["s_tas_req_level"] = s_rl
+            fields["s_tas_slice_level"] = s_sl
+            fields["s_tas_sizes"] = s_sizes
+            fields["s_tas_required"] = s_required
+            fields["s_tas_unconstrained"] = s_uncon
+            fields["w_tas_invalid"] = np.asarray(w_tas_invalid)
     return fields, root_merge
 
 
@@ -1337,7 +1511,13 @@ def _device_compatible(
             return False
         from kueue_tpu.scheduler.flavorassigner import is_lws_group
 
-        if multi_slot or not is_lws_group(info.obj.pod_sets):
+        if not (
+            (not multi_slot and is_lws_group(info.obj.pod_sets))
+            or (slots is not None
+                and all(len(sl.ps_ids) == 1 for sl in slots))
+        ):
+            # LWS pair (one two-podset group) or singleton groups only;
+            # groups-of-2 mixed with other podsets stay host.
             return False
         cqs0 = snapshot.cluster_queues[info.cluster_queue]
         from kueue_tpu.utils import features as _mbfeat
@@ -1345,6 +1525,8 @@ def _device_compatible(
         bal_gate = _mbfeat.enabled("TASBalancedPlacement")
         for ps2 in info.obj.pod_sets:
             tr2 = ps2.topology_request
+            if tr2 is None:
+                continue
             # Balanced placement stays single-podset on device.
             if tr2.balanced or (
                 bal_gate

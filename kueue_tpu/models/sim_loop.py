@@ -129,7 +129,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 )
             else:
                 order = bs.admission_order(a, nom)
-                _u, admit, _pre, _tk, _ltk = bs.admit_scan_grouped(
+                _u, admit, _pre, _tk, _ltk, _stk = bs.admit_scan_grouped(
                     a, ga, nom, usage, order, s_max, n_levels=n_levels,
                     mesh=mesh,
                 )

@@ -967,3 +967,173 @@ def test_lws_leader_group_on_device():
     for v in dev_state.values():
         leader_psa = [p for p in v if p[0] == "leader"][0]
         assert leader_psa[3] is not None and len(leader_psa[3]) == 1
+
+
+def _multi_tas_env(device: bool, n_blocks=2, racks=2, hosts=2, cap=8):
+    mgr = Manager(use_device_scheduler=device)
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        make_cq("cq-a", flavors={"tpu-v5e": {"tpu": quota(1000)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        Topology(name="topo", levels=LEVELS),
+    )
+    for b in range(n_blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                mgr.apply(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={"tpu.block": f"b{b}",
+                            "tpu.rack": f"b{b}-r{r}"},
+                    capacity={"tpu": cap},
+                ))
+    return mgr
+
+
+def _state_of(wls):
+    state = {}
+    for wl in wls:
+        adm = wl.status.admission
+        if adm is None:
+            state[wl.name] = None
+            continue
+        state[wl.name] = [
+            (psa.name, sorted(psa.flavors.items()), psa.count,
+             sorted(psa.topology_assignment.domains)
+             if psa.topology_assignment else None)
+            for psa in adm.pod_set_assignments
+        ]
+    return state
+
+
+def test_multi_podset_tas_on_device():
+    """A workload whose podsets each carry their OWN topology request
+    places per podset on the device path (sequential slot placements with
+    assumed-usage threading, flavorassigner.update_for_tas), zero host
+    fallback, matching the host bit for bit."""
+    def run(device: bool):
+        mgr = _multi_tas_env(device)
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+
+            mgr.scheduler._host_process = boom
+        wls = []
+        for k in range(3):
+            wls.append(Workload(
+                name=f"m{k}", queue_name="lq",
+                pod_sets=[
+                    PodSet(name="a", count=2, requests={"tpu": 3},
+                           topology_request=TopologyRequest(
+                               required_level=LEVELS[1])),
+                    PodSet(name="b", count=2, requests={"tpu": 2},
+                           topology_request=TopologyRequest(
+                               preferred_level=LEVELS[0])),
+                ],
+                creation_time=float(k + 1),
+            ))
+        for wl in wls:
+            mgr.create_workload(wl)
+        mgr.schedule_all()
+        return _state_of(wls)
+
+    host_state = run(False)
+    dev_state = run(True)
+    assert dev_state == host_state
+    assert any(v is not None for v in dev_state.values())
+
+
+def test_multi_podset_tas_mixed_with_plain_podset():
+    """TAS and non-TAS podsets mix in one workload: the TAS podsets place,
+    the plain podset admits quota-only."""
+    def run(device: bool):
+        mgr = _multi_tas_env(device)
+        if device:
+            def boom(infos):
+                raise AssertionError("host fallback")
+
+            mgr.scheduler._host_process = boom
+        wl = Workload(
+            name="mix", queue_name="lq",
+            pod_sets=[
+                PodSet(name="tas", count=4, requests={"tpu": 2},
+                       topology_request=TopologyRequest(
+                           required_level=LEVELS[1])),
+                PodSet(name="plain", count=1, requests={"tpu": 1}),
+            ],
+            creation_time=1.0,
+        )
+        mgr.create_workload(wl)
+        mgr.schedule_all()
+        return _state_of([wl])
+
+    host_state = run(False)
+    dev_state = run(True)
+    assert dev_state == host_state
+    assert dev_state["mix"] is not None
+    by_name = {p[0]: p for p in dev_state["mix"]}
+    assert by_name["tas"][3] is not None
+    assert by_name["plain"][3] is None
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_multi_podset_tas_differential(seed):
+    """Randomized multi-podset TAS scenarios (2-3 podsets, mixed
+    required/preferred/unconstrained/slices, sequential contention):
+    end state must match the host bit for bit; no forced-device (praw
+    entries legally route host via tree discard)."""
+    def run(device: bool):
+        rng = random.Random(63_000 + seed)
+        mgr = _multi_tas_env(
+            device, n_blocks=rng.randint(1, 2),
+            racks=rng.randint(1, 3), hosts=rng.randint(1, 3),
+            cap=rng.choice([4, 8]),
+        )
+        rng2 = random.Random(63_500 + seed)
+        wls = []
+        for k in range(rng2.randint(3, 7)):
+            pod_sets = []
+            for p in range(rng2.randint(1, 3)):
+                mode = rng2.choice(
+                    ["required", "preferred", "unconstrained", "plain"])
+                tr = None
+                if mode != "plain":
+                    level = rng2.choice(LEVELS)
+                    count = rng2.choice([1, 2, 3, 4])
+                    tr = TopologyRequest(
+                        required_level=(
+                            level if mode == "required" else None),
+                        preferred_level=(
+                            level if mode == "preferred" else None),
+                        unconstrained=mode == "unconstrained",
+                    )
+                    if rng2.random() < 0.3:
+                        li = LEVELS.index(level)
+                        tr.slice_required_level = rng2.choice(LEVELS[li:])
+                        for ss in (2, 1):
+                            if count % ss == 0:
+                                tr.slice_size = ss
+                                break
+                else:
+                    count = rng2.choice([1, 2])
+                pod_sets.append(PodSet(
+                    name=f"ps{p}", count=count,
+                    requests={"tpu": rng2.choice([1, 2, 4])},
+                    topology_request=tr,
+                ))
+            wls.append(Workload(
+                name=f"g{k}", queue_name="lq", pod_sets=pod_sets,
+                priority=rng2.randrange(0, 2) * 100,
+                creation_time=float(k + 1),
+            ))
+        for wl in wls:
+            mgr.create_workload(wl)
+        mgr.schedule_all()
+        return _state_of(wls)
+
+    host_state = run(False)
+    dev_state = run(True)
+    assert dev_state == host_state

@@ -65,7 +65,7 @@ def python_reference_sim(arrays, ga, runtime_ms, s_max):
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
         order = _order_jit(a, nom)
-        _u2, admit, _pre, _tk, _ltk = _scan_jit(a, ga, nom, u, order)
+        _u2, admit, _pre, _tk, _ltk, _stk = _scan_jit(a, ga, nom, u, order)
         admit = np.asarray(admit) & pending
         if admit.any():
             for i in np.where(admit)[0]:
