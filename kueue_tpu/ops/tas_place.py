@@ -75,7 +75,11 @@ def encode_device_topos(
         sizes = [len(lvl) for lvl in tas.domains_per_level]
         lmax_sizes.extend(sizes)
         per_flavor.append(tas)
-    d_n = max(lmax_sizes)
+    # Power-of-two bucket (min 8) for the domain axis: every kernel masks
+    # the pad rows via level_size (``valid_at``), so padding is inert, and
+    # bucketing lets randomized topologies of similar width share one
+    # compiled program — the same compile-reuse trick as the W axis.
+    d_n = max(8, 1 << (max(lmax_sizes) - 1).bit_length())
 
     n_levels = np.ones(t_n, np.int32)
     level_size = np.zeros((t_n, LMAX), np.int32)
