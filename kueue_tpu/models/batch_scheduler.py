@@ -591,26 +591,71 @@ def _nominate_slots(arrays: CycleArrays, usage: jnp.ndarray,
 _PARTIAL_STEPS = 22
 
 
+def structural_elig(arrays: CycleArrays, nm: NominateResult, base_core):
+    """Oracle-independence of the fungibility choice, shared by the
+    cycle's full-count preemption resolution (make_grouped_cycle
+    impl_preempt) and the partial-admission probes (partial_search):
+    the scan must have stopped at exactly one raw-preempt flavor — per
+    slot in slot-layout cycles (a preempting slot saw exactly one praw
+    flavor, a non-preempting slot saw none) — so the victim kernel's
+    verdict cannot change the flavor choice. Returns (base_elig,
+    slot_nom) with slot_nom None outside slot-layout cycles."""
+    from kueue_tpu.models.preempt_kernel import SlotNom
+
+    slot_nom = None
+    if arrays.s_req is not None and nm.s_flavor is not None:
+        eff_s = arrays.s_valid & (nm.s_pmode != P_NOFIT)
+        s_is_praw = eff_s & (nm.s_pmode == P_PREEMPT_RAW)
+        slot_gate = jnp.where(
+            s_is_praw,
+            nm.s_praw_count == 1,
+            ~eff_s | (nm.s_praw_count == 0),
+        )
+        base_elig = base_core & jnp.all(slot_gate, axis=1)
+        slot_nom = SlotNom(
+            s_flavor=nm.s_flavor,
+            s_on=eff_s & (nm.s_flavor >= 0),
+            s_is_praw=s_is_praw,
+            s_praw_stop=nm.s_praw_stop,
+            s_considered=nm.s_considered,
+        )
+    else:
+        base_elig = base_core & (nm.praw_count == 1)
+    return base_elig, slot_nom
+
+
 def partial_search(
     arrays: CycleArrays, usage: jnp.ndarray, nom: NominateResult,
-    n_levels: int = MAX_DEPTH + 1,
-) -> Tuple[NominateResult, jnp.ndarray, jnp.ndarray]:
+    n_levels: int = MAX_DEPTH + 1, adm=None, targets=None,
+) -> Tuple[NominateResult, jnp.ndarray, jnp.ndarray, object]:
     """Device PodSetReducer (reference flavorassigner/podset_reducer.go:67
     + the host's Scheduler._search_partial): for every reducible entry
-    whose full-count assignment is not Fit, binary-search the smallest
-    reduction whose assignment mode is Fit, replicating the host's exact
-    probe sequence (sort.Search semantics — same midpoints, same final
-    lo-probe, so results agree even off the monotone happy path).
+    whose full-count assignment is not Fit (nor resolved Preempt),
+    binary-search the smallest reduction whose assignment passes,
+    replicating the host's exact probe sequence (sort.Search semantics —
+    same midpoints, same final lo-probe, so results agree even off the
+    monotone happy path).
 
-    The class is pre-gated by the encoder to never-preempts CQs, so the
-    probe predicate is pure Fit — no oracle. Each probe re-runs the full
-    vectorized ``nominate`` on scaled per-pod requests (flavor choice may
-    change with the count, exactly like the host re-running assign()).
+    A probe passes when its mode is Fit, or — in preempt cycles
+    (``adm``/``targets`` given, reference scheduler.go:803 reducer
+    fits()) — when it is a device-resolvable Preempt with a non-empty
+    victim set from the flat victim-search kernel. A probe the kernels
+    cannot decide (oracle-dependent fungibility, non-simple tree, gated
+    entry) marks the WHOLE entry host-bound: the host then re-runs the
+    full search, and the driver's whole-tree discard keeps the cycle
+    exact. Each probe re-runs the full vectorized ``nominate`` on scaled
+    per-pod requests (flavor choice may change with the count, exactly
+    like the host re-running assign()).
 
     Returns (updated nominate result, updated w_req, partial_count[W]
-    with -1 for full-count entries).
+    with -1 for full-count entries, merged PreemptTargets or None).
     """
     delta = arrays.w_count - arrays.w_min_count
+    widened = (
+        adm is not None
+        and targets is not None
+        and arrays.preempt_simple is not None
+    )
     searching = (
         arrays.w_partial
         & arrays.w_active
@@ -618,6 +663,18 @@ def partial_search(
         & ~nom.needs_host
         & (delta > 0)
     )
+    if widened:
+        # Full-count Preempt already resolved with targets: the reference
+        # reducer never runs (scheduler.go:795 returns before it).
+        searching = searching & (nom.best_pmode != P_PREEMPT_OK)
+
+    from kueue_tpu.models.preempt_kernel import (
+        PreemptTargets,
+        preempt_targets,
+    )
+
+    w_n = arrays.w_cq.shape[0]
+    a_n = adm.cq.shape[0] if widened else 1
 
     def probe(count_probe):
         req_p = jnp.where(
@@ -630,80 +687,166 @@ def partial_search(
             # Slot-layout cycles: nominate reads s_req; partial entries
             # are single-slot (slot 0 mirrors w_req by construction).
             arr2 = arr2._replace(s_req=arrays.s_req.at[:, 0].set(req_p))
-        return nominate(arr2, usage, n_levels=n_levels)
+        return arr2, nominate(arr2, usage, n_levels=n_levels)
+
+    def probe_verdict(go, arr2, nm):
+        """(ok, unres, borrow, victims, variant) for one probe, under the
+        same structural-eligibility rules as the cycle's full-count
+        resolution (make_grouped_cycle impl_preempt — change BOTH when
+        the eligibility rules change; the probe copy omits only the
+        w_tas / preempt_hier arms, which the encoder gates off for
+        partial entries)."""
+        fit = go & (nm.best_pmode == P_FIT) & ~nm.needs_host
+        if not widened:
+            return fit, jnp.zeros_like(fit), nm.best_borrow, None, None
+        praw = nm.best_pmode == P_PREEMPT_RAW
+        base_core = go & praw & ~arrays.w_has_gates
+        base_elig, slot_nom = structural_elig(arrays, nm, base_core)
+        # Partial entries are non-TAS by encoder gate; the flat kernel
+        # covers simple trees only (probes on nested trees stay host).
+        elig = base_elig & arrays.preempt_simple[arrays.w_cq]
+        zero_t = PreemptTargets(
+            victims=jnp.zeros((w_n, a_n), bool),
+            variant=jnp.zeros((w_n, a_n), jnp.int32),
+            success=jnp.zeros(w_n, bool),
+            resolved_nc=jnp.zeros(w_n, bool),
+            resolved=jnp.zeros(w_n, bool),
+            borrow_after=jnp.zeros(w_n, jnp.int32),
+        )
+        tgt_p = jax.lax.cond(
+            jnp.any(elig),
+            lambda: preempt_targets(
+                arr2, adm, nm.chosen_flavor, elig, nm.praw_stop,
+                nm.considered, slot_nom=slot_nom,
+            ),
+            lambda: zero_t,
+        )
+        pre_ok = elig & tgt_p.success
+        # Resolvable probes: oracle-independent nominate, or a
+        # kernel-resolved preempt verdict (success OR definite
+        # no-candidates). Anything else needs the host's oracle.
+        resolved_probe = ~nm.needs_host | (elig & tgt_p.resolved)
+        unres = go & ~resolved_probe
+        ok = fit | pre_ok
+        borrow = jnp.where(pre_ok, tgt_p.borrow_after, nm.best_borrow)
+        return ok, unres, borrow, \
+            jnp.where(pre_ok[:, None], tgt_p.victims, False), \
+            jnp.where(pre_ok[:, None], tgt_p.variant, 0)
 
     def step(carry, _):
-        lo, hi, best, bf, bb, bt = carry
+        lo, hi, best, bf, bb, bt, bad, bpre, bvict, bvar = carry
         go = searching & (lo < hi)
         mid = (lo + hi) // 2
         # Probe only while some lane is still searching; converged
         # iterations of the fixed-length scan skip the nominate pass
         # (its results would be fully masked by ``go`` anyway).
-        nm = jax.lax.cond(
+        arr2, nm = jax.lax.cond(
             jnp.any(go),
             lambda: probe(arrays.w_count - mid),
-            lambda: nom,
+            lambda: (arrays, nom),
         )
-        fit = go & (nm.best_pmode == P_FIT)
-        best = jnp.where(fit, mid, best)
-        bf = jnp.where(fit, nm.chosen_flavor, bf)
-        bb = jnp.where(fit, nm.best_borrow, bb)
-        bt = jnp.where(fit, nm.tried_flavor_idx, bt)
-        hi = jnp.where(fit, mid, hi)
-        lo = jnp.where(go & ~fit, mid + 1, lo)
-        return (lo, hi, best, bf, bb, bt), None
+        ok, unres, borrow, vict, var = probe_verdict(go, arr2, nm)
+        bad = bad | unres
+        best = jnp.where(ok, mid, best)
+        bf = jnp.where(ok, nm.chosen_flavor, bf)
+        bb = jnp.where(ok, borrow, bb)
+        bt = jnp.where(ok, nm.tried_flavor_idx, bt)
+        if widened:
+            # won-by-preempt iff this passing probe carried victims (a
+            # fit-passing probe's victim row is zeroed in probe_verdict).
+            pre_win = ok & jnp.any(vict, axis=1)
+            bpre = jnp.where(ok, pre_win, bpre)
+            bvict = jnp.where(ok[:, None], vict, bvict)
+            bvar = jnp.where(ok[:, None], var, bvar)
+        hi = jnp.where(ok, mid, hi)
+        lo = jnp.where(go & ~ok, mid + 1, lo)
+        return (lo, hi, best, bf, bb, bt, bad, bpre, bvict, bvar), None
 
     init = (
         jnp.zeros_like(delta), delta, jnp.full_like(delta, -1),
         nom.chosen_flavor, nom.best_borrow, nom.tried_flavor_idx,
+        jnp.zeros(w_n, bool), jnp.zeros(w_n, bool),
+        jnp.zeros((w_n, a_n), bool), jnp.zeros((w_n, a_n), jnp.int32),
     )
-    (lo, _hi, best, bf, bb, bt), _ = jax.lax.scan(
+    (lo, _hi, best, bf, bb, bt, bad, bpre, bvict, bvar), _ = jax.lax.scan(
         step, init, None, length=_PARTIAL_STEPS
     )
 
     # sort.Search tail: nothing found inside the loop -> one last probe
     # at lo (== hi after convergence).
     need_final = searching & (best < 0) & (lo <= delta)
-    nm = jax.lax.cond(
+    arr2, nm = jax.lax.cond(
         jnp.any(need_final),
         lambda: probe(
             jnp.where(need_final, arrays.w_count - lo, arrays.w_count)
         ),
-        lambda: nom,
+        lambda: (arrays, nom),
     )
-    fit_f = need_final & (nm.best_pmode == P_FIT)
-    best = jnp.where(fit_f, lo, best)
-    bf = jnp.where(fit_f, nm.chosen_flavor, bf)
-    bb = jnp.where(fit_f, nm.best_borrow, bb)
-    bt = jnp.where(fit_f, nm.tried_flavor_idx, bt)
+    ok_f, unres_f, borrow_f, vict_f, var_f = probe_verdict(
+        need_final, arr2, nm
+    )
+    bad = bad | unres_f
+    best = jnp.where(ok_f, lo, best)
+    bf = jnp.where(ok_f, nm.chosen_flavor, bf)
+    bb = jnp.where(ok_f, borrow_f, bb)
+    bt = jnp.where(ok_f, nm.tried_flavor_idx, bt)
+    if widened:
+        pre_win_f = ok_f & jnp.any(vict_f, axis=1)
+        bpre = jnp.where(ok_f, pre_win_f, bpre)
+        bvict = jnp.where(ok_f[:, None], vict_f, bvict)
+        bvar = jnp.where(ok_f[:, None], var_f, bvar)
 
-    found = searching & (best >= 0)
+    found = searching & (best >= 0) & ~bad
     new_count = arrays.w_count - jnp.maximum(best, 0)
     new_req = jnp.where(
         found[:, None], arrays.w_req_pp * new_count[:, None], arrays.w_req
     )
     nom2 = nom._replace(
         chosen_flavor=jnp.where(found, bf, nom.chosen_flavor),
-        best_pmode=jnp.where(found, P_FIT, nom.best_pmode),
+        best_pmode=jnp.where(
+            found,
+            jnp.where(found & bpre, P_PREEMPT_OK, P_FIT)
+            if widened else P_FIT,
+            nom.best_pmode,
+        ),
         best_borrow=jnp.where(found, bb, nom.best_borrow),
         tried_flavor_idx=jnp.where(found, bt, nom.tried_flavor_idx),
+        needs_host=nom.needs_host | (searching & bad),
     )
+    tgt2 = None
+    if widened:
+        pre_m = found & bpre
+        tgt2 = PreemptTargets(
+            victims=jnp.where(pre_m[:, None], bvict, targets.victims),
+            variant=jnp.where(pre_m[:, None], bvar, targets.variant),
+            success=targets.success | pre_m,
+            resolved_nc=targets.resolved_nc & ~pre_m,
+            resolved=targets.resolved | pre_m,
+            borrow_after=jnp.where(
+                pre_m, bb.astype(targets.borrow_after.dtype),
+                targets.borrow_after,
+            ),
+        )
     if nom.s_flavor is not None:
         # Mirror the reduction into slot 0 (partial entries are
         # single-slot) so the slot-layout admission scan sees it.
+        pm0 = (
+            jnp.where(found & bpre, P_PREEMPT_OK, P_FIT)
+            if widened else P_FIT
+        )
         nom2 = nom2._replace(
             s_flavor=nom.s_flavor.at[:, 0].set(
                 jnp.where(found, bf, nom.s_flavor[:, 0])
             ),
             s_pmode=nom.s_pmode.at[:, 0].set(
-                jnp.where(found, P_FIT, nom.s_pmode[:, 0])
+                jnp.where(found, pm0, nom.s_pmode[:, 0])
             ),
             s_tried=nom.s_tried.at[:, 0].set(
                 jnp.where(found, bt, nom.s_tried[:, 0])
             ),
         )
     partial_count = jnp.where(found, new_count, jnp.int64(-1))
-    return nom2, new_req, partial_count
+    return nom2, new_req, partial_count, tgt2
 
 
 def admission_order(arrays: CycleArrays, nom: NominateResult) -> jnp.ndarray:
@@ -1778,16 +1921,17 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             s_tas_takes=s_tas_takes,
         )
 
-    def apply_partial(arrays, nom):
-        nom, new_req, partial_count = partial_search(
-            arrays, arrays.usage, nom, n_levels=n_levels
+    def apply_partial(arrays, nom, adm=None, targets=None):
+        nom, new_req, partial_count, tgt2 = partial_search(
+            arrays, arrays.usage, nom, n_levels=n_levels,
+            adm=adm, targets=targets,
         )
         arrays = arrays._replace(w_req=new_req)
         if arrays.s_req is not None:
             arrays = arrays._replace(
                 s_req=arrays.s_req.at[:, 0].set(new_req)
             )
-        return arrays, nom, partial_count
+        return arrays, nom, partial_count, tgt2
 
     if not preempt:
         def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
@@ -1795,7 +1939,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             nom = nominate(arrays, usage, n_levels=n_levels)
             partial_count = None
             if arrays.w_partial is not None:
-                arrays, nom, partial_count = apply_partial(arrays, nom)
+                arrays, nom, partial_count, _ = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             (final_usage, admitted, preempting, tas_takes, tas_ltakes,
@@ -1833,27 +1977,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
             & (nom.best_pmode == P_PREEMPT_RAW)
             & ~arrays.w_has_gates
         )
-        slot_nom = None
-        if arrays.s_req is not None and nom.s_flavor is not None:
-            from kueue_tpu.models.preempt_kernel import SlotNom
-
-            eff_s = arrays.s_valid & (nom.s_pmode != P_NOFIT)
-            s_is_praw = eff_s & (nom.s_pmode == P_PREEMPT_RAW)
-            slot_gate = jnp.where(
-                s_is_praw,
-                nom.s_praw_count == 1,
-                ~eff_s | (nom.s_praw_count == 0),
-            )
-            base_elig = base_core & jnp.all(slot_gate, axis=1)
-            slot_nom = SlotNom(
-                s_flavor=nom.s_flavor,
-                s_on=eff_s & (nom.s_flavor >= 0),
-                s_is_praw=s_is_praw,
-                s_praw_stop=nom.s_praw_stop,
-                s_considered=nom.s_considered,
-            )
-        else:
-            base_elig = base_core & (nom.praw_count == 1)
+        base_elig, slot_nom = structural_elig(arrays, nom, base_core)
         if arrays.w_tas is not None:
             # TAS entries may use the kernels' tas_fits-aware searches
             # (flat and hierarchical) when the tree's admitted TAS usage
@@ -1928,9 +2052,16 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
         )
         partial_count = None
         if arrays.w_partial is not None:
-            # Partial entries live on never-preempts CQs, so the search
-            # runs after (and independent of) the preemption resolution.
-            arrays, nom, partial_count = apply_partial(arrays, nom)
+            # The search runs after the full-count preemption resolution
+            # (reference scheduler.go:803: the reducer only runs when the
+            # full assignment is neither Fit nor Preempt-with-targets);
+            # its probes consult the flat victim-search kernel, and a
+            # winning preempt probe's victims replace the entry's targets.
+            arrays, nom, partial_count, tgt2 = apply_partial(
+                arrays, nom, adm=adm, targets=tgt
+            )
+            if tgt2 is not None:
+                tgt = tgt2
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         (final_usage, admitted, preempting, tas_takes,

@@ -101,7 +101,9 @@ class CycleArrays(NamedTuple):
     # victim search may run its tas_fits probe on device for TAS entries.
     preempt_tas_ok: Optional[jnp.ndarray] = None  # bool[N]
     # -- partial admission (None when no device partial entry this cycle;
-    # PodSetReducer class: single podset, never-preempts CQ) --
+    # PodSetReducer class: single podset, non-TAS; never-preempts CQs
+    # probe pure-fit, preempting CQs probe the victim-search kernel in
+    # preempt cycles) --
     w_req_pp: Optional[jnp.ndarray] = None  # i64[W,R] per-pod requests
     w_count: Optional[jnp.ndarray] = None  # i64[W] requested pod count
     w_min_count: Optional[jnp.ndarray] = None  # i64[W]
@@ -401,24 +403,41 @@ def encode_cycle(
     device_wls: List[WorkloadInfo] = []
     wl_slots: List[List[AssignSlot]] = []
     for info in heads:
+        slots = (
+            _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
+            if info.cluster_queue in snapshot.cluster_queues else None
+        )
         fair_host = False
         if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
-            tr0 = info.obj.pod_sets[0].topology_request
-            if tr0 is not None:
+            if any(
+                ps2.topology_request is not None
+                for ps2 in info.obj.pod_sets
+            ):
+                # The tournament's placement threading is only race-free
+                # when every TAS flavor the entry might land on is
+                # reachable from a single cohort root (fair_tas_single).
+                # The check spans exactly the resource groups the entry's
+                # slots assign from (an off-RG0 single podset places on
+                # ITS group's flavors, not RG0's); uncovered entries
+                # (slots=None) never reach the device path, but check all
+                # groups anyway so fair_host never under-approximates.
                 rgs0 = snapshot.cluster_queues[
                     info.cluster_queue
                 ].spec.resource_groups
+                if slots is not None:
+                    rg_ids = sorted({sl.rg_idx for sl in slots})
+                    rgs_chk = [rgs0[i] for i in rg_ids if i < len(rgs0)]
+                else:
+                    rgs_chk = rgs0
                 tas_names = [
-                    fq.name for fq in (rgs0[0].flavors if rgs0 else [])
+                    fq.name
+                    for rg0 in rgs_chk
+                    for fq in rg0.flavors
                     if fq.name in snapshot.tas_flavors
                 ]
                 fair_host = not tas_names or not all(
                     fair_tas_single.get(nm, False) for nm in tas_names
                 )
-        slots = (
-            _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
-            if info.cluster_queue in snapshot.cluster_queues else None
-        )
         delayed = bool(
             delay_tas_fn is not None
             and info.cluster_queue in snapshot.cluster_queues
@@ -526,7 +545,9 @@ def encode_cycle(
         if (partial_on and ps0.min_count is not None
                 and ps0.min_count < ps0.count):
             # Reducible entry (vetted by _device_compatible: single
-            # podset, never-preempts CQ, exact per-pod totals).
+            # podset, non-TAS, exact per-pod totals; preempting CQs
+            # allowed in preempt cycles — the search probes the
+            # victim-search kernel).
             w_part[i] = True
             w_minc[i] = ps0.min_count
             for res, v in ps0.requests.items():
@@ -1507,14 +1528,23 @@ def _device_compatible(
         # reference tas_flavor_snapshot.go:725) — the placement kernel
         # carries the leader planes. Other multi-podset TAS shapes stay
         # on the host for now.
-        if not preempt or fair_sharing:
+        if not preempt:
             return False
         from kueue_tpu.scheduler.flavorassigner import is_lws_group
 
-        if not (
+        singleton = (
+            slots is not None
+            and all(len(sl.ps_ids) == 1 for sl in slots)
+        )
+        if fair_sharing:
+            # Fair tournament: per-slot TAS placement runs in the fair
+            # scan for singleton-group slots; the LWS leader planes are
+            # not in that kernel — LWS pairs stay host under fair.
+            if not singleton:
+                return False
+        elif not (
             (not multi_slot and is_lws_group(info.obj.pod_sets))
-            or (slots is not None
-                and all(len(sl.ps_ids) == 1 for sl in slots))
+            or singleton
         ):
             # LWS pair (one two-podset group) or singleton groups only;
             # groups-of-2 mixed with other podsets stay host.
@@ -1561,10 +1591,13 @@ def _device_compatible(
         return False
     if ps.min_count is not None and ps.min_count < ps.count:
         # Partial admission (PodSetReducer): the device search handles the
-        # single-podset never-preempts class under the PartialAdmission
-        # gate (the probe predicate is then pure FIT — no oracle). With
-        # the feature off there is no search anywhere, so the entry is an
-        # ordinary full-count entry.
+        # single-podset class under the PartialAdmission gate. On
+        # never-preempts CQs the probe predicate is pure FIT; on
+        # preempting CQs (preempt cycles only) each probe consults the
+        # flat victim-search kernel (reference scheduler.go:803), with
+        # oracle-dependent probes marking the entry host-bound
+        # dynamically. With the feature off there is no search anywhere,
+        # so the entry is an ordinary full-count entry.
         from kueue_tpu.api.constants import PreemptionPolicy
         from kueue_tpu.utils import features as _features
 
@@ -1574,7 +1607,9 @@ def _device_compatible(
                 p.within_cluster_queue == PreemptionPolicy.NEVER
                 and p.reclaim_within_cohort == PreemptionPolicy.NEVER
             )
-            if fair_sharing or not never or ps.topology_request is not None:
+            if fair_sharing or ps.topology_request is not None:
+                return False
+            if not never and not preempt:
                 return False
             # The search scales per-pod requests; totals must be the
             # plain per-pod x count product (no reclaimed-pods skew).

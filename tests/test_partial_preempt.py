@@ -1,0 +1,220 @@
+"""Differential tests for device partial admission on preempting CQs.
+
+The reference binary-searches reduced pod counts inside the full assign
+loop *including preemption*: a probe passes when the reduced assignment's
+representative mode is Fit, or Preempt with a non-empty target set
+(scheduler.go:803 reducer fits() + podset_reducer.go:67 Search). The
+device search (models/batch_scheduler.partial_search) mirrors that probe
+predicate with the vectorized nominate + the flat victim-search kernel,
+threading the winning probe's victims into the admission scan.
+
+These tests compare end states bit-for-bit against the host scheduler:
+directed scenarios force the device path (zero fallback), randomized
+seeds mix preemption policies and allow exact host fallback for shapes
+the kernels gate out (hier trees, gated entries).
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    ResourceFlavor,
+    ResourceQuota,
+)
+from kueue_tpu.models.driver import DeviceScheduler
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+
+def quota(n, borrow=None, lend=None):
+    return ResourceQuota(nominal=n, borrowing_limit=borrow,
+                         lending_limit=lend)
+
+
+def _admissions(cache):
+    out = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        if adm is None:
+            out[info.obj.name] = None
+        else:
+            out[info.obj.name] = [
+                (psa.name, sorted(psa.flavors.items()), psa.count,
+                 sorted(psa.resource_usage.items()))
+                for psa in adm.pod_set_assignments
+            ]
+    return out
+
+
+def _run(cqs, cohorts, flavors, wls, device, forbid_fallback=False,
+         max_cycles=30):
+    cache, queues, host = build_env(cqs, cohorts=cohorts, flavors=flavors)
+    if device:
+        sched = DeviceScheduler(cache, queues)
+        if forbid_fallback:
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+
+            sched._host_process = boom
+    else:
+        sched = host
+    submit(queues, *wls)
+    sched.schedule_all(max_cycles=max_cycles)
+    return _admissions(cache)
+
+
+def _preempting_cq(name, nominal_m, cohort=None):
+    return make_cq(
+        name,
+        cohort=cohort,
+        flavors={"default": {"cpu": quota(nominal_m)}},
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            reclaim_within_cohort=PreemptionPolicy.ANY,
+        ),
+    )
+
+
+def test_partial_reduces_into_preemption_window():
+    """A reducible high-priority entry whose full count fits neither free
+    quota nor quota-after-preemption must shrink to the largest count
+    that fits after evicting the low-priority victim — exactly the
+    reference reducer probing Preempt modes (scheduler.go:803)."""
+    cqs = [_preempting_cq("cq", 4000)]
+    wls = [
+        make_wl("low", queue="lq-cq", cpu_m=1000, count=2, priority=0,
+                creation_time=1.0),
+        # 6 x 1000m: full count needs 6000 > 4000 nominal; count=4 fits
+        # only after preempting "low" (2000m held).
+        make_wl("high", queue="lq-cq", cpu_m=1000, count=6, min_count=1,
+                priority=100, creation_time=2.0),
+    ]
+    host = _run(cqs, [], [], wls, device=False)
+    dev = _run(cqs, [], [], wls, device=True, forbid_fallback=True)
+    assert dev == host
+    # The reduced entry lands at count=4 with the victim evicted.
+    assert host.get("high") is not None and host["high"][0][2] == 4
+    assert host.get("low") is None
+
+
+def test_partial_prefers_full_count_preemption():
+    """When the FULL count already resolves as Preempt-with-targets, the
+    search must not run at all (reference: reducer only on a failed full
+    assignment) — the entry preempts at full count."""
+    cqs = [_preempting_cq("cq", 4000)]
+    wls = [
+        make_wl("low", queue="lq-cq", cpu_m=1000, count=2, priority=0,
+                creation_time=1.0),
+        make_wl("high", queue="lq-cq", cpu_m=1000, count=4, min_count=1,
+                priority=100, creation_time=2.0),
+    ]
+    host = _run(cqs, [], [], wls, device=False)
+    dev = _run(cqs, [], [], wls, device=True, forbid_fallback=True)
+    assert dev == host
+    assert host.get("high") is not None and host["high"][0][2] == 4
+    assert host.get("low") is None
+
+
+def test_partial_reclaim_across_cohort_on_device():
+    """Reclaim-within-cohort probes: the reducible entry's CQ reclaims
+    borrowed capacity from a sibling CQ inside the search."""
+    cohorts = [Cohort(name="co")]
+    cqs = [
+        _preempting_cq("cqa", 4000, cohort="co"),
+        make_cq(
+            "cqb", cohort="co",
+            flavors={"default": {"cpu": quota(2000)}},
+        ),
+    ]
+    wls = [
+        # cqb borrows 2000 over its 2000 nominal.
+        make_wl("borrower", queue="lq-cqb", cpu_m=1000, count=4,
+                priority=0, creation_time=1.0),
+        # Full count 8 needs 8000 > 6000 cohort total; count=4 fits
+        # cqa's nominal after reclaiming the borrowed 2000.
+        make_wl("claimer", queue="lq-cqa", cpu_m=1000, count=8,
+                min_count=1, priority=0, creation_time=2.0),
+    ]
+    host = _run(cqs, cohorts, [], wls, device=False)
+    dev = _run(cqs, cohorts, [], wls, device=True, forbid_fallback=True)
+    assert dev == host
+    assert host.get("claimer") is not None
+
+
+def test_partial_no_targets_keeps_full_reserve():
+    """A reducible entry on a preempting CQ whose probes never find
+    targets (victims too high priority) must end exactly as the host
+    ends it: unadmitted, with the full-count state preserved."""
+    cqs = [_preempting_cq("cq", 4000)]
+    wls = [
+        make_wl("vip", queue="lq-cq", cpu_m=1000, count=4, priority=500,
+                creation_time=1.0),
+        make_wl("mid", queue="lq-cq", cpu_m=1000, count=6, min_count=5,
+                priority=100, creation_time=2.0),
+    ]
+    host = _run(cqs, [], [], wls, device=False)
+    dev = _run(cqs, [], [], wls, device=True)
+    assert dev == host
+    assert host.get("mid") is None
+    assert host.get("vip") is not None
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_partial_preempt_differential(seed):
+    """Randomized mixes of reducible workloads on preempting and
+    never-preempting CQs (flat cohorts): device end state must match the
+    host bit for bit. Host fallback is allowed (whole-tree discard keeps
+    it exact) but the common flat shapes should resolve on device."""
+    rng = random.Random(21_000 + seed)
+    n_flavors = rng.randint(1, 2)
+    flavors = [ResourceFlavor(name=f"f{j}") for j in range(n_flavors)]
+    cohorts = [Cohort(name="co")] if rng.random() < 0.6 else []
+    cqs = []
+    for c in range(rng.randint(1, 3)):
+        pol = rng.choice([
+            ClusterQueuePreemption(),
+            ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+            ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.ANY,
+            ),
+            ClusterQueuePreemption(
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+        ])
+        cqs.append(make_cq(
+            f"cq{c}",
+            cohort="co" if cohorts else None,
+            flavors={
+                f"f{j}": {"cpu": quota(rng.randrange(2, 10) * 1000)}
+                for j in range(n_flavors)
+            },
+            preemption=pol,
+        ))
+    wls = []
+    for i in range(rng.randint(4, 12)):
+        cq = rng.choice(cqs)
+        count = rng.randrange(2, 10)
+        wls.append(make_wl(
+            f"wl{i}",
+            queue=f"lq-{cq.name}",
+            cpu_m=rng.randrange(1, 4) * 500,
+            count=count,
+            min_count=(
+                rng.randrange(1, count) if rng.random() < 0.6 else None
+            ),
+            priority=rng.randrange(0, 4) * 100,
+            creation_time=float(i + 1),
+        ))
+    host = _run(cqs, cohorts, flavors, wls, device=False)
+    dev = _run(cqs, cohorts, flavors, wls, device=True)
+    assert dev == host
