@@ -255,6 +255,24 @@ def fair_admit_scan(
             if arrays.w_tas_balanced is not None else None
         )
 
+    # Generic multi-podset TAS (slot-layout entries with per-slot
+    # topology requests): one placement per TAS slot, sequential in slot
+    # order with assumed-usage threading, mirroring the grouped admission
+    # scan (batch_scheduler admit_scan_grouped with_stas) and the host's
+    # update_for_tas ``assumed`` dict.
+    with_stas = with_tas and with_slots and arrays.s_tas is not None
+    if with_stas:
+        stas_c = arrays.s_tas[pe]  # [n,S]
+        stas_req_c = arrays.s_tas_req[pe]  # [n,S,R1]
+        stas_ureq_c = arrays.s_tas_usage_req[pe]  # [n,S,R1]
+        stas_cnt_c = arrays.s_tas_count[pe]  # [n,S]
+        stas_ssz_c = arrays.s_tas_slice_size[pe]  # [n,S]
+        stas_rl_c = arrays.s_tas_req_level[pe]  # [n,S,T]
+        stas_sl_c = arrays.s_tas_slice_level[pe]  # [n,S,T]
+        stas_sz_c = arrays.s_tas_sizes[pe]  # [n,S,T,LMAX]
+        stas_rq_c = arrays.s_tas_required[pe]  # [n,S]
+        stas_un_c = arrays.s_tas_unconstrained[pe]  # [n,S]
+
     lend_par_c = lendable[parent[chains_c]]  # [n,D+1,R]
     wgt_c = weight[chains_c]  # [n,D+1]
 
@@ -377,7 +395,7 @@ def fair_admit_scan(
 
     def body(carry, step):
         (usage_now, tas_usage, remaining, admitted, preempting_acc,
-         designated, win_step, w_takes) = carry
+         designated, win_step, w_takes, s_takes) = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
         win = p_has & remaining & (champ[root_c] == n_iota)
@@ -482,6 +500,75 @@ def fair_admit_scan(
                 *place_args
             )  # [n], [n, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
+            if with_stas:
+                # Per-slot sequential placement with assumed-usage
+                # threading, evaluated on a LOCAL copy of the topology
+                # state (commit below re-applies winner deltas on admit,
+                # like the grouped scan). fair_tas_single guarantees at
+                # most one root reaches a flavor, so concurrent per-root
+                # winners never race on a topology row. Twin of
+                # admit_scan_grouped's with_stas block
+                # (batch_scheduler.py) on the participant axis — change
+                # BOTH when the slot-placement semantics change.
+                s_ax2 = arrays.s_tas.shape[1]
+                t_sim = tas_usage
+                sfeas_all = jnp.ones(n, bool)
+                s_do_list, s_tidx_list, s_take_list = [], [], []
+
+                def place_slot(t, u_row, req_v, cnt, ssz, sl_, rl_,
+                               rq_, un_, sz_):
+                    return _tas_place.place(
+                        arrays.tas_topo, t, u_row, req_v, cnt, ssz,
+                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
+                        rq_, un_, sizes=sz_,
+                    )
+
+                for si in range(s_ax2):
+                    f_si = fs_c[:, si]
+                    t_of_si = jnp.where(
+                        f_si >= 0,
+                        arrays.tas_of_flavor[
+                            jnp.clip(f_si, 0, f_n - 1)
+                        ],
+                        -1,
+                    )
+                    do_si = (
+                        win & stas_c[:, si] & (t_of_si >= 0)
+                        & (pm == P_FIT)
+                    )
+                    t_idx_si = jnp.clip(
+                        t_of_si, 0, tas_usage.shape[0] - 1
+                    )
+                    n_io = jnp.arange(n)
+                    rl_si = stas_rl_c[:, si][n_io, t_idx_si]
+                    sl_si = stas_sl_c[:, si][n_io, t_idx_si]
+                    sz_si = stas_sz_c[:, si][n_io, t_idx_si]
+                    feas_si, take_si = jax.vmap(place_slot)(
+                        t_idx_si, t_sim[t_idx_si],
+                        stas_req_c[:, si], stas_cnt_c[:, si],
+                        stas_ssz_c[:, si], sl_si, rl_si,
+                        stas_rq_c[:, si], stas_un_c[:, si], sz_si,
+                    )
+                    feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
+                    delta_si = (
+                        take_si[:, :, None]
+                        * stas_ureq_c[:, si][:, None, :]
+                    )
+                    t_sim = t_sim.at[t_idx_si].add(jnp.where(
+                        (do_si & feas_si)[:, None, None], delta_si, 0
+                    ))
+                    sfeas_all = sfeas_all & jnp.where(
+                        do_si, feas_si, True
+                    )
+                    s_do_list.append(do_si)
+                    s_tidx_list.append(t_idx_si)
+                    s_take_list.append(
+                        jnp.where(do_si[:, None], take_si, 0)
+                    )
+                has_stas_c = jnp.any(stas_c, axis=1)
+                tas_ok = tas_ok & jnp.where(
+                    win & has_stas_c & (pm == P_FIT), sfeas_all, True
+                )
         else:
             tas_ok = True
             tas_do = None
@@ -591,6 +678,19 @@ def fair_admit_scan(
             w_takes = w_takes + jnp.where(
                 do_take[:, None], tas_take, 0
             ).astype(jnp.int32)
+            if with_stas:
+                for si in range(s_ax2):
+                    do_c = admit & s_do_list[si]
+                    add = (
+                        s_take_list[si][:, :, None]
+                        * stas_ureq_c[:, si][:, None, :]
+                    )
+                    tas_usage = tas_usage.at[s_tidx_list[si]].add(
+                        jnp.where(do_c[:, None, None], add, 0)
+                    )
+                    s_takes = s_takes.at[:, si].add(jnp.where(
+                        do_c[:, None], s_take_list[si], 0
+                    ).astype(jnp.int32))
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], victims_c, False),
@@ -599,7 +699,7 @@ def fair_admit_scan(
         win_step = jnp.where(win, step, win_step)
         return (new_usage, tas_usage, remaining & ~win, admitted | admit,
                 preempting_acc | preempt_ok, designated, win_step,
-                w_takes), None
+                w_takes, s_takes), None
 
     designated0 = (
         jnp.zeros(adm.cq.shape[0], bool) if with_preempt
@@ -612,11 +712,18 @@ def fair_admit_scan(
         jnp.zeros((n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
         if with_tas else jnp.zeros((1,), jnp.int32)
     )
+    stakes0 = (
+        jnp.zeros(
+            (n, arrays.s_tas.shape[1], arrays.tas_topo.leaf_cap.shape[1]),
+            jnp.int32,
+        )
+        if with_stas else jnp.zeros((1,), jnp.int32)
+    )
     init = (usage, tas_usage0, jnp.ones(n, bool), jnp.zeros(n, bool),
             jnp.zeros(n, bool), designated0,
-            jnp.full(n, -1, jnp.int32), takes0)
+            jnp.full(n, -1, jnp.int32), takes0, stakes0)
     (final_usage, _tas_u, remaining_c, admitted_c, preempting_c, _desig,
-     win_step_c, takes_c), _ = jax.lax.scan(
+     win_step_c, takes_c, stakes_c), _ = jax.lax.scan(
         body, init, jnp.arange(s_max, dtype=jnp.int32)
     )
 
@@ -640,8 +747,17 @@ def fair_admit_scan(
         ).at[idx_w].set(
             jnp.where(p_has[:, None], takes_c, 0), mode="drop"
         )
+    s_takes_f = None
+    if with_stas:
+        s_takes_f = jnp.zeros(
+            (w_n, arrays.s_tas.shape[1],
+             arrays.tas_topo.leaf_cap.shape[1]),
+            jnp.int32,
+        ).at[idx_w].set(
+            jnp.where(p_has[:, None, None], stakes_c, 0), mode="drop"
+        )
     return (final_usage, admitted, preempting, shadowed, participated,
-            win_step, w_takes_f if with_tas else None)
+            win_step, w_takes_f if with_tas else None, s_takes_f)
 
 
 def make_fair_cycle(s_max: int = 0, preempt: bool = False):
@@ -652,7 +768,8 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
     (models/fair_preempt_kernel.py) before the admission scan."""
 
     def finish(arrays, nom, final_usage, admitted, preempting, shadowed,
-               win_step, victims=None, variant=None, tas_takes=None):
+               win_step, victims=None, variant=None, tas_takes=None,
+               s_tas_takes=None):
         outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
@@ -706,6 +823,7 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             s_pmode=nom.s_pmode,
             s_tried=nom.s_tried,
             tas_takes=tas_takes,
+            s_tas_takes=s_tas_takes,
         )
 
     if not preempt:
@@ -716,9 +834,11 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
                 nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             (final_usage, admitted, preempting, shadowed, _done,
-             win_step, tas_takes) = fair_admit_scan(arrays, nom, usage, s)
+             win_step, tas_takes, s_tas_takes) = fair_admit_scan(
+                arrays, nom, usage, s)
             return finish(arrays, nom, final_usage, admitted, preempting,
-                          shadowed, win_step, tas_takes=tas_takes)
+                          shadowed, win_step, tas_takes=tas_takes,
+                          s_tas_takes=s_tas_takes)
 
         return impl
 
@@ -738,6 +858,10 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
         )
         if arrays.w_tas is not None:
             elig = elig & ~arrays.w_tas
+        if arrays.s_tas is not None:
+            # Multi-podset TAS entries needing preemption keep the host
+            # victim search (same rule as the grouped cycle).
+            elig = elig & ~jnp.any(arrays.s_tas, axis=1)
         if arrays.w_simple_slot is not None:
             # The fair victim tournament reads the legacy single-slot
             # fields; a multi-slot entry needing preemption stays
@@ -761,11 +885,12 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
         )
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         (final_usage, admitted, preempting, shadowed, _done, win_step,
-         tas_takes) = \
+         tas_takes, s_tas_takes) = \
             fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
         return finish(arrays, nom, final_usage, admitted, preempting,
                       shadowed, win_step, victims=tgt.victims,
-                      variant=tgt.variant, tas_takes=tas_takes)
+                      variant=tgt.variant, tas_takes=tas_takes,
+                      s_tas_takes=s_tas_takes)
 
     return impl_preempt
 

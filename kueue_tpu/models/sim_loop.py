@@ -111,7 +111,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
 
                 # The tournament orders entries itself (dynamic DRS keys).
                 (_u, admit, _pre, _shadowed, _part, _step,
-                 _tk) = fair_admit_scan(
+                 _tk, _stk) = fair_admit_scan(
                     a, nom, usage, s_max
                 )
             elif kernel == "fixedpoint":

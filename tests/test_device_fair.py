@@ -793,3 +793,247 @@ def test_fair_multislot_differential(seed):
             ]
         results[device] = (trace, admitted)
     assert results[True] == results[False]
+
+
+# ---------------------------------------------------------------------------
+# Fair sharing x generic multi-podset TAS on device.
+# ---------------------------------------------------------------------------
+
+
+def _fair_multi_tas_env(device: bool):
+    """Two CQs in one cohort on a TAS flavor (single root, so the fair
+    tournament's placement threading is race-free by construction)."""
+    from kueue_tpu.api.types import ResourceFlavor, Topology, quota
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.tas.snapshot import Node
+
+    mgr = Manager(fair_sharing=True, use_device_scheduler=device)
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        Cohort(name="co"),
+        make_cq("cq-a", cohort="co",
+                flavors={"tpu-v5e": {"tpu": quota(16)}},
+                resources=["tpu"]),
+        make_cq("cq-b", cohort="co",
+                flavors={"tpu-v5e": {"tpu": quota(16)}},
+                resources=["tpu"]),
+        LocalQueue(name="lq-a", cluster_queue="cq-a"),
+        LocalQueue(name="lq-b", cluster_queue="cq-b"),
+        Topology(name="topo",
+                 levels=["tpu.rack", "kubernetes.io/hostname"]),
+    )
+    for r in range(2):
+        for h in range(2):
+            mgr.apply(Node(
+                name=f"n{r}{h}", labels={"tpu.rack": f"r{r}"},
+                capacity={"tpu": 8},
+            ))
+    return mgr
+
+
+def _fair_multi_tas_state(wls):
+    state = {}
+    for wl in wls:
+        adm = wl.status.admission
+        state[wl.name] = None if adm is None else [
+            (psa.name, sorted(psa.flavors.items()), psa.count,
+             sorted(psa.topology_assignment.domains)
+             if psa.topology_assignment else None)
+            for psa in adm.pod_set_assignments
+        ]
+    return state
+
+
+def test_fair_multi_podset_tas_on_device():
+    """Multi-podset TAS workloads place per slot inside the fair
+    tournament (sequential slot placements threading assumed takes),
+    zero host fallback, DRS winner order and domains host-identical."""
+    from kueue_tpu.api.types import PodSet, TopologyRequest, Workload
+
+    def tas_wl(name, lq, t):
+        return Workload(
+            name=name, queue_name=lq, creation_time=t,
+            pod_sets=[
+                PodSet(name="a", count=2, requests={"tpu": 2},
+                       topology_request=TopologyRequest(
+                           required_level="tpu.rack")),
+                PodSet(name="b", count=2, requests={"tpu": 1},
+                       topology_request=TopologyRequest(
+                           preferred_level="tpu.rack")),
+            ],
+        )
+
+    def run(device):
+        mgr = _fair_multi_tas_env(device)
+        if device:
+            def boom(infos):
+                raise AssertionError(
+                    "host fallback for "
+                    + ", ".join(i.obj.name for i in infos)
+                )
+
+            mgr.scheduler._host_process = boom
+        wls = [
+            tas_wl("a0", "lq-a", 1.0),
+            tas_wl("a1", "lq-a", 2.0),
+            tas_wl("b0", "lq-b", 3.0),
+        ]
+        for wl in wls:
+            mgr.create_workload(wl)
+        order = []
+        for _ in range(10):
+            r = mgr.schedule()
+            order.append(sorted(r.admitted))
+            if not r.admitted:
+                break
+        return order, _fair_multi_tas_state(wls)
+
+    host = run(False)
+    dev = run(True)
+    assert dev == host
+    # Everything eventually admits; the DRS tournament must alternate
+    # CQs rather than drain lq-a FIFO-first.
+    assert all(v is not None for v in host[1].values())
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fair_multi_podset_tas_differential(seed):
+    """Randomized fair x multi-podset TAS end states match the host bit
+    for bit (fallback allowed for shapes the fair kernel gates out)."""
+    from kueue_tpu.api.types import PodSet, TopologyRequest, Workload
+
+    def run(device):
+        rng = random.Random(87_000 + seed)
+        mgr = _fair_multi_tas_env(device)
+        wls = []
+        for i in range(rng.randint(3, 8)):
+            pods = []
+            for p in range(rng.randint(1, 3)):
+                tr = None
+                roll = rng.random()
+                if roll < 0.5:
+                    tr = TopologyRequest(required_level="tpu.rack")
+                elif roll < 0.8:
+                    tr = TopologyRequest(
+                        preferred_level="kubernetes.io/hostname"
+                    )
+                pods.append(PodSet(
+                    name=f"p{p}", count=rng.randint(1, 3),
+                    requests={"tpu": rng.randint(1, 3)},
+                    topology_request=tr,
+                ))
+            wls.append(Workload(
+                name=f"w{i}",
+                queue_name=rng.choice(["lq-a", "lq-b"]),
+                pod_sets=pods,
+                priority=rng.choice([0, 0, 100]),
+                creation_time=float(i + 1),
+            ))
+        for wl in wls:
+            mgr.create_workload(wl)
+        mgr.schedule_all()
+        return _fair_multi_tas_state(wls)
+
+    host = run(False)
+    dev = run(True)
+    assert dev == host
+
+
+def test_fair_off_rg0_tas_multiroot_flavor_routes_host():
+    """A single-podset TAS entry assigning from a NON-first resource
+    group must have the fair single-root check applied to ITS group's
+    flavors: when that flavor is reachable from two cohort roots the
+    entry routes host (the tournament's placement threading would race),
+    and the end state stays host-exact."""
+    from kueue_tpu.api.types import (
+        FlavorQuotas,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Topology,
+        TopologyRequest,
+        Workload,
+        quota,
+    )
+    from kueue_tpu.api.types import ClusterQueue
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.tas.snapshot import Node
+
+    def two_rg_cq(name, cohort):
+        return ClusterQueue(
+            name=name, cohort=cohort,
+            resource_groups=[
+                ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(
+                        name="plain",
+                        resources={"cpu": ResourceQuota(nominal=8000)},
+                    )],
+                ),
+                ResourceGroup(
+                    covered_resources=["tpu"],
+                    flavors=[FlavorQuotas(
+                        name="t-shared",
+                        resources={"tpu": ResourceQuota(nominal=8)},
+                    )],
+                ),
+            ],
+        )
+
+    def run(device):
+        mgr = Manager(fair_sharing=True, use_device_scheduler=device)
+        mgr.apply(
+            ResourceFlavor(name="plain"),
+            ResourceFlavor(name="t-shared", topology_name="topo"),
+            Cohort(name="co1"),
+            Cohort(name="co2"),
+            two_rg_cq("cq-a", "co1"),
+            two_rg_cq("cq-b", "co2"),
+            LocalQueue(name="lq-a", cluster_queue="cq-a"),
+            LocalQueue(name="lq-b", cluster_queue="cq-b"),
+            Topology(name="topo",
+                     levels=["tpu.rack", "kubernetes.io/hostname"]),
+        )
+        for r in range(2):
+            mgr.apply(Node(
+                name=f"n{r}", labels={"tpu.rack": f"r{r}"},
+                capacity={"tpu": 8},
+            ))
+        fallbacks = []
+        if device:
+            orig = mgr.scheduler._host_process
+            mgr.scheduler._host_process = lambda infos: (
+                fallbacks.extend(i.obj.name for i in infos)
+                or orig(infos)
+            )
+        wls = []
+        for i, lq in enumerate(["lq-a", "lq-b"]):
+            wl = Workload(
+                name=f"t{i}", queue_name=lq, creation_time=float(i + 1),
+                pod_sets=[PodSet(
+                    name="main", count=2, requests={"tpu": 2},
+                    topology_request=TopologyRequest(
+                        required_level="tpu.rack"),
+                )],
+            )
+            wls.append(wl)
+            mgr.create_workload(wl)
+        mgr.schedule_all()
+        state = {}
+        for wl in wls:
+            adm = wl.status.admission
+            state[wl.name] = None if adm is None else [
+                (p.name, p.count,
+                 sorted(p.topology_assignment.domains)
+                 if p.topology_assignment else None)
+                for p in adm.pod_set_assignments
+            ]
+        return state, fallbacks
+
+    h_state, _ = run(False)
+    d_state, d_fb = run(True)
+    assert d_state == h_state
+    # The off-RG0 TAS entries' flavor spans two cohort roots: the fair
+    # gate must route them host.
+    assert d_fb, "expected host fallback for multi-root off-RG0 TAS"

@@ -225,7 +225,9 @@ def test_sim_loop_fair_kernel_matches_python_loop(seed):
         )
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
-        _u2, admit, _pre, _sh, _part, _step, _tk = fair_jit(a, nom, u)
+        _u2, admit, _pre, _sh, _part, _step, _tk, _stk = fair_jit(
+            a, nom, u
+        )
         admit = np.asarray(admit) & pending
         if admit.any():
             for i in np.where(admit)[0]:
