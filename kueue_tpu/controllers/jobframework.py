@@ -49,6 +49,12 @@ class PodSetInfo:
     )
 
 
+class PodSetInfoConflict(ValueError):
+    """A podset info's node selector contradicts the job's own template
+    (reference podset.go Merge: conflicting keys are an error — the job
+    author pinned a node label the admitted flavor disagrees with)."""
+
+
 class GenericJob(abc.ABC):
     """reference jobframework/interface.go:37 GenericJob."""
 
@@ -239,7 +245,15 @@ class JobReconciler:
                     if rf is not None:
                         infos[i].node_selector.update(rf.node_labels)
                         infos[i].tolerations.extend(rf.tolerations)
-            job.run_with_podsets_info(infos)
+            try:
+                job.run_with_podsets_info(infos)
+                job.start_error = None
+            except PodSetInfoConflict as e:
+                # Per-job start error, not a controller crash (reference
+                # startJob returns the Merge error and the reconciler
+                # retries): the job stays suspended; the next reconcile
+                # retries the start.
+                job.start_error = str(e)
         elif not is_admitted(wl) and not job.is_suspended():
             # stopJob (reference reconciler.go:1368): evicted/not admitted.
             job.suspend()

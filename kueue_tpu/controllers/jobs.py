@@ -27,8 +27,21 @@ from kueue_tpu.api.types import PodSet, Toleration, TopologyRequest
 from kueue_tpu.controllers.jobframework import (
     GenericJob,
     PodSetInfo,
+    PodSetInfoConflict,
     registry,
 )
+
+
+@dataclass
+class PodTemplate:
+    """The mutable scheduling fields of one role's pod template — the
+    part of a job spec RunWithPodSetsInfo customizes on start and
+    RestorePodSetsInfo puts back on stop (reference pkg/podset
+    podset.go FromAssignment/Merge + reconciler.go:1326-1424)."""
+
+    count: int
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
 
 
 class _BaseJob(GenericJob):
@@ -51,6 +64,13 @@ class _BaseJob(GenericJob):
         self._message = ""
         self._pods_ready = False
         self.started_with: List[PodSetInfo] = []
+        # Live pod templates by podset name while running; None when the
+        # job has never started or was restored (reference: a suspended
+        # job's spec carries the original template).
+        self.templates: Optional[Dict[str, PodTemplate]] = None
+        # Last startJob failure (PodSetInfoConflict message); cleared by
+        # the reconciler on a successful start.
+        self.start_error: Optional[str] = None
 
     @property
     def name(self) -> str:
@@ -72,12 +92,54 @@ class _BaseJob(GenericJob):
         self._pods_ready = False
 
     def run_with_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        """Start the job with the admission's scheduling attributes
+        applied to its pod templates (reference reconciler.go:1326
+        startJob -> job.RunWithPodSetsInfo): flavor node labels merge
+        into each role's node selector (conflicting keys are an error,
+        podset.go Merge), tolerations append, and the admitted count
+        (partial admission) replaces the role's count."""
+        base = {ps.name: ps for ps in self.pod_sets()}
+        templates: Dict[str, PodTemplate] = {}
+        for info in infos:
+            ps = base.get(info.name)
+            own_sel = dict(ps.node_selector or {}) if ps is not None else {}
+            for k, v in info.node_selector.items():
+                if k in own_sel and own_sel[k] != v:
+                    raise PodSetInfoConflict(
+                        f"podset {info.name!r}: node selector {k}="
+                        f"{own_sel[k]!r} conflicts with admitted {v!r}"
+                    )
+                own_sel[k] = v
+            tols = list(ps.tolerations or []) if ps is not None else []
+            seen = set(tols)  # Toleration is a frozen dataclass
+            for t in info.tolerations:
+                if t not in seen:
+                    tols.append(t)
+                    seen.add(t)
+            templates[info.name] = PodTemplate(
+                count=info.count, node_selector=own_sel, tolerations=tols
+            )
+        self.templates = templates
+        self._apply_counts({n: t.count for n, t in templates.items()})
         self._suspended = False
         self.started_with = infos
         self._pods_ready = True
 
     def restore_podsets_info(self, infos: List[PodSetInfo]) -> None:
+        """Undo every start-time customization (reference stopJob ->
+        RestorePodSetsInfo): templates revert to the job's own spec."""
+        self.templates = None
+        self._restore_counts()
         self.started_with = []
+
+    # Frameworks with a live scalar mirroring the template count (the
+    # reference mutates the actual spec field, e.g. job.Spec.Parallelism)
+    # override these two.
+    def _apply_counts(self, counts: Dict[str, int]) -> None:
+        pass
+
+    def _restore_counts(self) -> None:
+        pass
 
     def finished(self) -> Tuple[bool, bool, str]:
         return self._finished, self._success, self._message
@@ -111,20 +173,44 @@ class BatchJob(_BaseJob):
                  **kw) -> None:
         super().__init__(name, queue, **kw)
         self.parallelism = parallelism
+        self._spec_parallelism: Optional[int] = None
         self.min_parallelism = min_parallelism
         self.requests = requests or {"cpu": 1000}
         self.topology = topology
 
     def pod_sets(self) -> List[PodSet]:
+        # While running, parallelism mirrors the admitted count; the
+        # spec's own value (restored on stop) is the snapshot taken at
+        # start. Suspended jobs read the live public field, so callers
+        # may mutate it freely before submit.
+        count = (
+            self._spec_parallelism
+            if self._spec_parallelism is not None else self.parallelism
+        )
         return [
             PodSet(
                 name="main",
-                count=self.parallelism,
+                count=count,
                 requests=dict(self.requests),
                 min_count=self.min_parallelism,
                 topology_request=self.topology,
             )
         ]
+
+    def _apply_counts(self, counts: Dict[str, int]) -> None:
+        # reference jobs/job RunWithPodSetsInfo: the live spec's
+        # parallelism becomes the admitted (possibly reduced) count;
+        # the original is snapshotted for RestorePodSetsInfo. An
+        # unpaired restart (suspend without restore) must not clobber
+        # the snapshot with the already-reduced value.
+        if self._spec_parallelism is None:
+            self._spec_parallelism = self.parallelism
+        self.parallelism = counts.get("main", self.parallelism)
+
+    def _restore_counts(self) -> None:
+        if self._spec_parallelism is not None:
+            self.parallelism = self._spec_parallelism
+            self._spec_parallelism = None
 
 
 class TrainJob(_BaseJob):
