@@ -965,7 +965,76 @@ def main():
         out["device_time_s"] = sim.get("device_wall_s", 0.0)
     if multichip:
         out["multichip"] = multichip
-    print(json.dumps(out), flush=True)
+    # Full detail goes to a sidecar file: the driver records only the
+    # TAIL of stdout, and the complete object (multichip curve + floor
+    # analysis prose) is long enough to truncate mid-JSON (BENCH_r04's
+    # official capture has parsed:null for exactly this reason). The
+    # final stdout line is a compact summary that always fits.
+    detail_ref = "BENCH_DETAIL.json"
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        tmp = os.path.join(here, ".BENCH_DETAIL.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, os.path.join(here, "BENCH_DETAIL.json"))
+    except OSError as exc:
+        # Never advertise a stale/partial sidecar as this run's data.
+        detail_ref = f"unwritable: {exc!r}"[:120]
+
+    def _pick(d, *keys):
+        picked = {
+            k: d[k] for k in keys
+            if isinstance(d, dict) and d.get(k) is not None
+        }
+        if isinstance(d, dict) and d.get("error") and not d.get("ok"):
+            picked["error"] = str(d["error"])[:80]
+        return picked
+
+    compact = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "detail": detail_ref,
+    }
+    if device:
+        dv = {}
+        for name in ("ping", "sim", "mega", "fair", "phases"):
+            p = device.get(name)
+            if not isinstance(p, dict):
+                continue
+            if not p.get("ok"):
+                dv[name] = {"ok": False, "rc": p.get("rc")}
+                if p.get("error"):
+                    dv[name]["error"] = str(p["error"])[:80]
+            elif name == "sim":
+                dv[name] = _pick(p, "ok", "admissions_per_s",
+                                 "end_to_end_adm_per_s", "kernel")
+            elif name == "mega":
+                dv[name] = _pick(p, "ok", "percycle_ms", "pallas_i32_ms",
+                                 "grouped_ms", "dispatch_latency_ms")
+            elif name == "fair":
+                dv[name] = _pick(p, "ok", "admissions_per_s",
+                                 "end_to_end_adm_per_s")
+            else:
+                dv[name] = {"ok": True}
+        cx = device.get("crossover_cpu")
+        if isinstance(cx, dict):
+            if cx.get("error"):
+                dv["crossover_cpu"] = {"error": str(cx["error"])[:80]}
+            else:
+                dv["crossover_cpu"] = {
+                    k: _pick(v, "ok", "admissions_per_s")
+                    for k, v in cx.items() if isinstance(v, dict)
+                }
+        compact["device"] = dv
+        compact["device_time_s"] = out.get("device_time_s", 0.0)
+    if multichip:
+        compact["multichip"] = _pick(
+            multichip, "ok", "devices", "cycle_1dev_ms", "cycle_8dev_ms",
+            "nominate_1dev_ms", "nominate_8dev_ms",
+        )
+    print(json.dumps(compact), flush=True)
     # Skip interpreter teardown: a wedged accelerator transport can hang
     # JAX's backend finalizers, and the result is already on stdout.
     os._exit(0)
