@@ -1,0 +1,4 @@
+from kueue_tpu.metrics.names import METRIC_NAMES
+from kueue_tpu.metrics.registry import Histogram, Metrics
+
+__all__ = ["Histogram", "Metrics", "METRIC_NAMES"]

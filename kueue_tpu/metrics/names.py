@@ -1,0 +1,93 @@
+"""Frozen allowlist of every metric series name this codebase may emit.
+
+``tools/check_metrics_names.py`` statically verifies that each
+``metrics.inc / observe / set_gauge`` (and ``tracing.inc / observe /
+set_gauge``) call site uses a literal name from this set — a typo'd name
+would otherwise silently fork a series and dashboards would read zeros
+forever. Adding a metric means adding it here AND to
+``docs/observability.md``.
+
+Names are exposed with the ``kueue_`` prefix by
+:meth:`kueue_tpu.metrics.registry.Metrics.expose`; entries here are the
+unprefixed registry names. Reference counterparts (pkg/metrics/metrics.go)
+are listed in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+# Lifecycle / quota series carried over from the reference pkg/metrics.
+REFERENCE_SERIES = frozenset({
+    "admission_attempt_duration_seconds",
+    "admission_attempts_total",
+    "admission_checks_wait_time_seconds",
+    "admission_cycle_preemption_skips",
+    "admission_wait_time_seconds",
+    "admitted_active_workloads",
+    "admitted_workloads_total",
+    "build_info",
+    "cluster_queue_borrowing_limit",
+    "cluster_queue_info",
+    "cluster_queue_lending_limit",
+    "cluster_queue_nominal_quota",
+    "cluster_queue_resource_usage",
+    "cluster_queue_status",
+    "cluster_queue_weighted_share",
+    "cohort_info",
+    "cohort_subtree_admitted_active_workloads",
+    "cohort_subtree_admitted_workloads_total",
+    "cohort_subtree_quota",
+    "cohort_subtree_resource_reservations",
+    "cohort_weighted_share",
+    "evicted_workloads_once_total",
+    "evicted_workloads_total",
+    "finished_workloads_total",
+    "local_queue_admitted_workloads",
+    "local_queue_pending_workloads",
+    "multikueue_dispatches_total",
+    "pending_workloads",
+    "pods_ready_to_evicted_time_seconds",
+    "preempted_workloads_total",
+    "provisioning_requests_failed_total",
+    "provisioning_requests_provisioned_total",
+    "quota_reserved_wait_time_seconds",
+    "quota_reserved_workloads_total",
+    "reclaimed_pods_total",
+    "reserving_active_workloads",
+    "scheduler_nomination_duration_seconds",
+    "scheduler_snapshot_duration_seconds",
+    "second_pass_assignments_total",
+    "tas_node_replacement_failures_total",
+    "tas_node_replacements_total",
+    "workloads_created_total",
+    "workloads_finished_total",
+})
+
+# Tracing / hot-loop series introduced by metrics/tracing.py and the
+# admission-path instrumentation (spans, queue latencies, JAX solver
+# observability, remote-boundary propagation).
+TRACING_SERIES = frozenset({
+    "trace_span_duration_seconds",
+    "scheduler_admission_cycle_duration_seconds",
+    "scheduler_admission_cycle_stage_seconds",
+    "scheduler_admission_cycle_entries",
+    "queue_heads_duration_seconds",
+    "queue_heads_popped_total",
+    "queue_requeue_latency_seconds",
+    "queue_requeue_total",
+    "flavor_assignment_total",
+    "preemption_search_total",
+    "preemption_search_candidates",
+    "preemption_search_targets",
+    "tas_placement_total",
+    "fair_preemption_rounds_total",
+    "solver_jit_cache_total",
+    "solver_device_seconds",
+    "solver_trace_seconds",
+    "solver_batch_size",
+    "solver_padding_waste_pct",
+    "solver_drs_cache_total",
+    "remote_calls_total",
+    "remote_call_duration_seconds",
+})
+
+METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES

@@ -36,16 +36,24 @@ class Histogram:
         self.n += 1
 
     def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (the Prometheus histogram_quantile
+        estimator): locate the winning bucket, then interpolate linearly
+        between its bounds instead of returning the coarse upper bound."""
         if self.n == 0:
             return 0.0
         target = q * self.n
         acc = 0
         for i, c in enumerate(self.counts):
+            prev_acc = acc
             acc += c
             if acc >= target:
-                return self.buckets[i] if i < len(self.buckets) else float(
-                    "inf"
-                )
+                if i >= len(self.buckets):
+                    return float("inf")
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                if c == 0:
+                    return hi
+                return lo + (hi - lo) * (target - prev_acc) / c
         return float("inf")
 
 
@@ -86,6 +94,11 @@ class Metrics:
     def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             if name in self.counters:
+                if name in self.gauges:
+                    raise ValueError(
+                        f"metric {name!r} exists as both counter and gauge;"
+                        " read it via .counters / .gauges explicitly"
+                    )
                 return self.counters[name].get(_lk(labels), 0.0)
             return self.gauges.get(name, {}).get(_lk(labels), 0.0)
 
@@ -119,11 +132,20 @@ class Metrics:
         return "\n".join(out) + "\n"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text exposition format: label values escape backslash,
+    double-quote and line feed (in that order, so the escapes themselves
+    survive)."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _fmt(lk: LabelKey, extra: Optional[Tuple[str, str]] = None) -> str:
     items = list(lk)
     if extra:
         items.append(extra)
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
     return "{" + inner + "}"

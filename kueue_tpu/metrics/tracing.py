@@ -1,0 +1,327 @@
+"""Admission-cycle tracing: lightweight spans + hot-loop counters.
+
+Behavioral surface: the reference treats observability as its own layer
+(pkg/metrics with ~50 Prometheus series, structured per-phase scheduler
+logs, and the visibility API). This module is the measurement substrate
+for the standalone stack: contextvar-scoped nestable spans around the
+admission hot loop, a ring-buffered recorder exporting Chrome
+``trace_event`` JSON (loadable in Perfetto / chrome://tracing), and
+per-span-name duration histograms plus solver counters forwarded into a
+:class:`kueue_tpu.metrics.registry.Metrics` sink.
+
+Zero-cost when disabled: ``span()`` returns a shared no-op context
+manager and every counter helper returns immediately, so the scheduler
+microbench with tracing off stays within noise of the uninstrumented
+code. Enable per-run:
+
+    from kueue_tpu.metrics import tracing
+    tracing.enable(mgr.metrics)
+    mgr.schedule_all()
+    json.dump(tracing.export_chrome_trace(), open("trace.json", "w"))
+
+Trace-context propagation: a root span mints a ``trace_id``; the remote
+clients inject it into the wire request and ``remote.worker.dispatch``
+re-enters it via :func:`trace_context`, so worker-side spans land in the
+same logical trace as the caller's.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from kueue_tpu.metrics.registry import Metrics
+
+# Module-level fast flag: hot loops read this attribute directly. Mutate
+# only through enable()/disable().
+ENABLED = False
+
+_DEFAULT_BUFFER_LEN = 65536
+
+# Current innermost span and current trace id. contextvars give each
+# thread (and each task) its own value, so nesting is thread-safe without
+# locking the hot path.
+_span_var: contextvars.ContextVar[Optional["_Span"]] = contextvars.ContextVar(
+    "kueue_tpu_current_span", default=None
+)
+_trace_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "kueue_tpu_trace_id", default=None
+)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Tracer:
+    """Ring-buffered span recorder with an optional Metrics sink."""
+
+    def __init__(self, buffer_len: int = _DEFAULT_BUFFER_LEN) -> None:
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=buffer_len)
+        self.metrics: Optional[Metrics] = None
+        # Epoch for Chrome-trace timestamps (perf_counter is monotonic but
+        # has an arbitrary zero; export is relative to tracer creation).
+        self.epoch = time.perf_counter()
+        self.dropped = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(rec)
+
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.dropped = 0
+
+    # -- export ------------------------------------------------------------
+
+    def export_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome ``trace_event`` JSON (complete "X" events, µs units)."""
+        events = []
+        pid = os.getpid()
+        for rec in self.spans():
+            events.append({
+                "name": rec["name"],
+                "cat": "kueue_tpu",
+                "ph": "X",
+                "ts": round(rec["ts"] * 1e6, 3),
+                "dur": round(rec["dur"] * 1e6, 3),
+                "pid": pid,
+                "tid": rec["tid"],
+                "args": {
+                    "trace_id": rec["trace_id"],
+                    "parent": rec["parent"],
+                    **rec["args"],
+                },
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def phase_breakdown(self) -> Dict[str, float]:
+        """Total seconds spent per span name (self-inclusive)."""
+        out: Dict[str, float] = {}
+        for rec in self.spans():
+            out[rec["name"]] = out.get(rec["name"], 0.0) + rec["dur"]
+        return out
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def enable(metrics: Optional[Metrics] = None,
+           buffer_len: Optional[int] = None) -> Tracer:
+    """Turn tracing on. ``metrics`` becomes the sink for span histograms
+    and hot-loop counters (pass a Manager's registry so the series show
+    up on its ``/metrics`` exposition); omitted, the tracer keeps its own
+    registry so counters are never silently dropped."""
+    global ENABLED, _tracer
+    if buffer_len is not None and buffer_len != _tracer._buf.maxlen:
+        _tracer = Tracer(buffer_len)
+    _tracer.metrics = metrics if metrics is not None else (
+        _tracer.metrics or Metrics()
+    )
+    ENABLED = True
+    return _tracer
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+# ----------------------------------------------------------------------
+# spans
+# ----------------------------------------------------------------------
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def set_arg(self, key: str, value: Any) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "start", "_span_token", "_trace_token",
+                 "parent", "trace_id")
+
+    def __init__(self, name: str, args: Dict[str, Any]) -> None:
+        self.name = name
+        self.args = args
+
+    def set_arg(self, key: str, value: Any) -> None:
+        self.args[key] = value
+
+    def __enter__(self) -> "_Span":
+        parent = _span_var.get()
+        self.parent = parent.name if parent is not None else None
+        self._span_token = _span_var.set(self)
+        tid = _trace_var.get()
+        if tid is None:
+            tid = new_trace_id()
+            self._trace_token = _trace_var.set(tid)
+        else:
+            self._trace_token = None
+        self.trace_id = tid
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        end = time.perf_counter()
+        _span_var.reset(self._span_token)
+        if self._trace_token is not None:
+            _trace_var.reset(self._trace_token)
+        tr = _tracer
+        tr.record({
+            "name": self.name,
+            "ts": self.start - tr.epoch,
+            "dur": end - self.start,
+            "tid": threading.get_ident(),
+            "trace_id": self.trace_id,
+            "parent": self.parent,
+            "args": self.args,
+        })
+        m = tr.metrics
+        if m is not None:
+            m.observe("trace_span_duration_seconds", end - self.start,
+                      {"span": self.name})
+
+
+def span(name: str, **args: Any):
+    """Context manager for one named span. No-op unless tracing is on."""
+    if not ENABLED:
+        return _NOOP
+    return _Span(name, args)
+
+
+def current_trace_id() -> Optional[str]:
+    return _trace_var.get()
+
+
+class _TraceContext:
+    """Re-enter a caller's trace id (cross-boundary extraction side)."""
+
+    __slots__ = ("trace_id", "_token")
+
+    def __init__(self, trace_id: Optional[str]) -> None:
+        self.trace_id = trace_id
+
+    def __enter__(self) -> "_TraceContext":
+        self._token = _trace_var.set(self.trace_id)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _trace_var.reset(self._token)
+
+
+def trace_context(trace_id: Optional[str]) -> _TraceContext:
+    return _TraceContext(trace_id)
+
+
+# ----------------------------------------------------------------------
+# hot-loop counter helpers (forward to the sink only when enabled)
+# ----------------------------------------------------------------------
+
+
+def inc(name: str, labels: Optional[Dict[str, str]] = None,
+        value: float = 1.0) -> None:
+    if not ENABLED:
+        return
+    m = _tracer.metrics
+    if m is not None:
+        m.inc(name, labels, value)
+
+
+def observe(name: str, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+    if not ENABLED:
+        return
+    m = _tracer.metrics
+    if m is not None:
+        m.observe(name, value, labels)
+
+
+def set_gauge(name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
+    if not ENABLED:
+        return
+    m = _tracer.metrics
+    if m is not None:
+        m.set_gauge(name, value, labels)
+
+
+def export_chrome_trace() -> Dict[str, Any]:
+    return _tracer.export_chrome_trace()
+
+
+def phase_breakdown() -> Dict[str, float]:
+    return _tracer.phase_breakdown()
+
+
+# ----------------------------------------------------------------------
+# JAX solver observability
+# ----------------------------------------------------------------------
+
+
+def instrument_jit(fn, kernel: str):
+    """Wrap a jitted callable with compile-cache hit/miss counters and
+    device-vs-trace wall time histograms.
+
+    A call that grows the jit cache paid tracing+compilation
+    (``solver_trace_seconds``); a steady-state call is dispatch+device
+    time (``solver_device_seconds``; dispatch may be async, so this is a
+    lower bound unless the caller blocks on the result). Disabled tracing
+    adds a single flag check per call."""
+
+    def wrapped(*args, **kwargs):
+        if not ENABLED:
+            return fn(*args, **kwargs)
+        size_fn = getattr(fn, "_cache_size", None)
+        before = size_fn() if callable(size_fn) else -1
+        t0 = time.perf_counter()
+        with span("solver/" + kernel):
+            out = fn(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        after = size_fn() if callable(size_fn) else -1
+        miss = before >= 0 and after > before
+        inc("solver_jit_cache_total",
+            {"kernel": kernel, "event": "miss" if miss else "hit"})
+        observe("solver_trace_seconds" if miss else "solver_device_seconds",
+                wall, {"kernel": kernel})
+        return out
+
+    wrapped.__wrapped__ = fn
+    wrapped.__name__ = getattr(fn, "__name__", kernel)
+    return wrapped

@@ -27,6 +27,7 @@ from kueue_tpu.core.workload_info import (
     WorkloadInfo,
     set_condition,
 )
+from kueue_tpu.metrics import tracing
 from kueue_tpu.models import batch_scheduler
 from kueue_tpu.models.encode import encode_cycle
 from kueue_tpu.queue.manager import QueueManager
@@ -76,6 +77,12 @@ class DeviceScheduler:
         bucket = 16
         while bucket < len(heads):
             bucket *= 2
+        if tracing.ENABLED:
+            tracing.set_gauge("solver_batch_size", bucket)
+            tracing.set_gauge(
+                "solver_padding_waste_pct",
+                100.0 * (bucket - len(heads)) / bucket,
+            )
         arrays, idx = encode_cycle(
             snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
             fair_sharing=self.fair_sharing, preempt=True,
@@ -101,21 +108,26 @@ class DeviceScheduler:
             if self.fair_sharing:
                 from kueue_tpu.models.fair_kernel import cycle_fair_preempt
 
-                out = cycle_fair_preempt(
-                    arrays, idx.admitted_arrays, s_max=idx.fair_s_bound
-                )
+                with tracing.span("device/cycle_fair_preempt",
+                                  batch=bucket):
+                    out = cycle_fair_preempt(
+                        arrays, idx.admitted_arrays, s_max=idx.fair_s_bound
+                    )
             elif self.use_fixedpoint and not idx.has_partial \
                     and arrays.s_req is None \
                     and arrays.tas_topo is None and not bool(
                 np.asarray(arrays.tree.has_lend_limit).any()
             ):
-                out = batch_scheduler.cycle_fixedpoint(
-                    arrays, idx.group_arrays
-                )
+                with tracing.span("device/cycle_fixedpoint", batch=bucket):
+                    out = batch_scheduler.cycle_fixedpoint(
+                        arrays, idx.group_arrays
+                    )
             else:
-                out = batch_scheduler.cycle_grouped_preempt(
-                    arrays, idx.group_arrays, idx.admitted_arrays
-                )
+                with tracing.span("device/cycle_grouped_preempt",
+                                  batch=bucket):
+                    out = batch_scheduler.cycle_grouped_preempt(
+                        arrays, idx.group_arrays, idx.admitted_arrays
+                    )
             outcome = np.asarray(out.outcome)
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)
@@ -142,7 +154,11 @@ class DeviceScheduler:
                 np.asarray(out.victim_variant)
                 if out.victim_variant is not None else None
             )
-            self.device_time_s += self.clock() - t0
+            dt = self.clock() - t0
+            self.device_time_s += dt
+            if tracing.ENABLED:
+                tracing.observe("solver_device_seconds", dt,
+                                {"kernel": "batch_cycle"})
 
             # Admitted TAS entries: the placement kernel emits its own
             # per-leaf takes (CycleOutputs.tas_takes), so domains decode

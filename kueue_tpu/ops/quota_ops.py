@@ -24,6 +24,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 
 from kueue_tpu.core.resources import UNLIMITED
+from kueue_tpu.metrics import tracing
 
 # Maximum supported cohort-tree depth (root=0). The reference supports
 # arbitrary depth; 8 levels is far beyond any practical hierarchy and keeps
@@ -206,8 +207,11 @@ def potential_available_all(tree: QuotaTreeArrays) -> jnp.ndarray:
 
 # Jitted alias: encoders call compute_subtree once per cycle; eager
 # execution would issue ~50 small dispatches (very costly over a remote
-# device transport).
-compute_subtree_jit = jax.jit(compute_subtree)
+# device transport). Wrapped for compile-cache / wall-time observability
+# (single flag check per call when tracing is off).
+compute_subtree_jit = tracing.instrument_jit(
+    jax.jit(compute_subtree), "quota/compute_subtree"
+)
 
 
 def ancestor_chain(tree: QuotaTreeArrays, node: jnp.ndarray) -> jnp.ndarray:

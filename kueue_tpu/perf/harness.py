@@ -40,6 +40,7 @@ from kueue_tpu.api.types import (
 from kueue_tpu.tas.snapshot import Node
 from kueue_tpu.core.workload_info import get_condition
 from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import tracing
 
 CREATE, COMPLETE = 0, 1
 
@@ -79,6 +80,12 @@ class RunResult:
     avg_time_to_admission_s: Dict[str, float] = field(default_factory=dict)
     # CQ class -> minimum average utilization %
     cq_class_min_usage_pct: Dict[str, float] = field(default_factory=dict)
+    # Populated only when run(..., trace=True): span name -> total seconds,
+    # and the full Chrome trace_event document (Perfetto-loadable).
+    phase_breakdown: Optional[Dict[str, float]] = None
+    trace: Optional[dict] = None
+    # Prometheus text exposition of the run's Manager registry.
+    metrics_text: Optional[str] = None
 
     def throughput(self) -> float:
         if self.scheduling_wall_s <= 0:
@@ -229,10 +236,58 @@ def generate(config: dict) -> Tuple[Manager, List[GeneratedWorkload]]:
     return mgr, out
 
 
-def run(config: dict) -> RunResult:
+def _remote_trace_probe() -> None:
+    """One traced gRPC round-trip against an in-process worker, so the
+    exported trace contains a worker-side span carrying the caller's
+    trace id (the cross-boundary propagation proof)."""
+    try:
+        from kueue_tpu.remote.grpc_transport import (
+            GrpcWorkerClient,
+            serve_worker_grpc,
+        )
+    except Exception:  # pragma: no cover - grpc not installed
+        return
+    worker_mgr = Manager()
+    server, bound = serve_worker_grpc(worker_mgr, in_thread=True)
+    try:
+        client = GrpcWorkerClient(bound)
+        with tracing.span("harness/remote_probe"):
+            client.schedule()
+        client.close()
+    finally:
+        server.stop(0)
+
+
+def run(config: dict, trace: bool = False,
+        trace_remote: bool = False) -> RunResult:
     """Event-driven virtual-time simulation (reference runner/main.go:118
-    'mimic workload execution')."""
+    'mimic workload execution').
+
+    With ``trace=True`` the run executes under the admission-cycle tracer:
+    the result carries the per-phase wall breakdown, the Chrome trace JSON
+    and the /metrics exposition. ``trace_remote=True`` additionally drives
+    one traced gRPC round-trip against an in-process worker so the trace
+    demonstrates cross-boundary trace-id propagation."""
     mgr, gens = generate(config)
+    if not trace:
+        return _run_sim(mgr, gens)
+    was_enabled = tracing.enabled()
+    tracer = tracing.enable(mgr.metrics)
+    tracer.clear()
+    try:
+        result = _run_sim(mgr, gens)
+        if trace_remote:
+            _remote_trace_probe()
+        result.phase_breakdown = tracing.phase_breakdown()
+        result.trace = tracer.export_chrome_trace()
+        result.metrics_text = mgr.metrics.expose()
+        return result
+    finally:
+        if not was_enabled:
+            tracing.disable()
+
+
+def _run_sim(mgr: Manager, gens: List[GeneratedWorkload]) -> RunResult:
     by_key = {g.wl.key: g for g in gens}
     nominal_of: Dict[str, int] = {}
     class_of_cq: Dict[str, str] = {}
@@ -397,10 +452,11 @@ def check(result: RunResult, rangespec: dict) -> List[str]:
     return violations
 
 
-def run_config_files(generator_path: str, rangespec_path: Optional[str] = None):
+def run_config_files(generator_path: str, rangespec_path: Optional[str] = None,
+                     trace: bool = False, trace_remote: bool = False):
     with open(generator_path) as f:
         config = yaml.safe_load(f)
-    result = run(config)
+    result = run(config, trace=trace, trace_remote=trace_remote)
     violations = []
     if rangespec_path:
         with open(rangespec_path) as f:

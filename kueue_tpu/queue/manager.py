@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -20,6 +21,7 @@ from kueue_tpu.api.constants import (
 )
 from kueue_tpu.api.types import ClusterQueue, LocalQueue, Workload
 from kueue_tpu.core.workload_info import WorkloadInfo, queue_order_timestamp
+from kueue_tpu.metrics import tracing
 
 
 def _order_key(info: WorkloadInfo) -> Tuple:
@@ -158,6 +160,9 @@ class QueueManager:
         # Second-pass queue for workloads with delayed TAS admission
         # (reference second_pass_queue.go).
         self._second_pass: Dict[str, WorkloadInfo] = {}
+        # Requeue timestamps for queue_requeue_latency_seconds; only
+        # populated while tracing is enabled.
+        self._requeue_ts: Dict[str, float] = {}
 
     # -- configuration ------------------------------------------------------
 
@@ -212,6 +217,13 @@ class QueueManager:
             added = cqh.requeue_if_not_present(
                 info, reason, self.scheduling_cycle
             )
+            if tracing.ENABLED:
+                tracing.inc(
+                    "queue_requeue_total",
+                    {"reason": reason.value, "immediate": str(added).lower()},
+                )
+                if added:
+                    self._requeue_ts[info.key] = time.perf_counter()
             if added:
                 self._lock.notify_all()
             return added
@@ -251,6 +263,22 @@ class QueueManager:
         """Pop one head per CQ plus all ready second-pass workloads
         (reference manager.go:882,901). Non-blocking variant: returns []
         when nothing is pending."""
+        if not tracing.ENABLED:
+            return self._heads_impl()
+        with tracing.span("queue/heads") as s:
+            t0 = time.perf_counter()
+            out = self._heads_impl()
+            now = time.perf_counter()
+            s.set_arg("heads", len(out))
+            tracing.observe("queue_heads_duration_seconds", now - t0)
+            tracing.inc("queue_heads_popped_total", value=len(out))
+            for info in out:
+                ts = self._requeue_ts.pop(info.key, None)
+                if ts is not None:
+                    tracing.observe("queue_requeue_latency_seconds", now - ts)
+            return out
+
+    def _heads_impl(self) -> List[WorkloadInfo]:
         with self._lock:
             self.scheduling_cycle += 1
             out: List[WorkloadInfo] = []

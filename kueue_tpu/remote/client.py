@@ -16,6 +16,7 @@ from typing import Optional
 
 from kueue_tpu.api.serialization import decode, encode
 from kueue_tpu.api.types import Workload
+from kueue_tpu.metrics import tracing
 
 
 class WorkerUnreachable(ConnectionError):
@@ -83,8 +84,34 @@ class RemoteWorkerClient:
         self._file = None
 
     def _call(self, req: dict) -> dict:
+        if not tracing.ENABLED:
+            return self._call_impl(req)
+        op = req.get("op")
+        with tracing.span("remote/call", op=op, transport="socket"):
+            t0 = time.perf_counter()
+            try:
+                resp = self._call_impl(req)
+                tracing.inc("remote_calls_total",
+                            {"op": op, "transport": "socket", "ok": "true"})
+                return resp
+            except Exception:
+                tracing.inc("remote_calls_total",
+                            {"op": op, "transport": "socket", "ok": "false"})
+                raise
+            finally:
+                tracing.observe(
+                    "remote_call_duration_seconds",
+                    time.perf_counter() - t0,
+                    {"op": op, "transport": "socket"},
+                )
+
+    def _call_impl(self, req: dict) -> dict:
         """One RPC with reconnect + backoff on transport failure
         (multikueuecluster.go reconnect loop)."""
+        if tracing.ENABLED:
+            req = dict(req,
+                       trace=tracing.current_trace_id()
+                       or tracing.new_trace_id())
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:

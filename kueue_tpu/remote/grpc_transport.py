@@ -34,6 +34,7 @@ import grpc
 from kueue_tpu.api.serialization import decode, encode
 from kueue_tpu.api.types import Workload
 from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import tracing
 from kueue_tpu.remote.client import WorkerUnreachable, _WorkloadView
 from kueue_tpu.remote.worker import dispatch
 
@@ -161,10 +162,36 @@ class GrpcWorkerClient:
         self._call_fn = None
 
     def _call(self, req: dict, timeout: Optional[float] = None) -> dict:
+        if not tracing.ENABLED:
+            return self._call_impl(req, timeout)
+        op = req.get("op")
+        with tracing.span("remote/call", op=op, transport="grpc"):
+            t0 = time.perf_counter()
+            try:
+                resp = self._call_impl(req, timeout)
+                tracing.inc("remote_calls_total",
+                            {"op": op, "transport": "grpc", "ok": "true"})
+                return resp
+            except Exception:
+                tracing.inc("remote_calls_total",
+                            {"op": op, "transport": "grpc", "ok": "false"})
+                raise
+            finally:
+                tracing.observe(
+                    "remote_call_duration_seconds",
+                    time.perf_counter() - t0,
+                    {"op": op, "transport": "grpc"},
+                )
+
+    def _call_impl(self, req: dict, timeout: Optional[float] = None) -> dict:
         # One request id across all attempts of this logical call: the
         # server dedupes replays, so retrying after an ambiguous failure
         # (deadline fired after the op applied) cannot re-execute it.
         req = dict(req, rid=uuid.uuid4().hex)
+        if tracing.ENABLED:
+            # Propagate the caller's trace id so worker-side spans join
+            # this trace (mint one if the caller has no active trace).
+            req["trace"] = tracing.current_trace_id() or tracing.new_trace_id()
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:

@@ -23,6 +23,7 @@ from typing import Optional
 
 from kueue_tpu.api.serialization import decode, encode
 from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import tracing
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -45,7 +46,19 @@ class _Handler(socketserver.StreamRequestHandler):
 
 def dispatch(mgr: Manager, req: dict) -> dict:
     """Worker-side op dispatch, shared by every transport (socket JSON
-    lines, gRPC) — the op surface IS the seam."""
+    lines, gRPC) — the op surface IS the seam.
+
+    Requests may carry a caller ``trace`` id; it is re-entered here so
+    worker-side spans land in the same logical trace as the caller's."""
+    caller_trace = req.pop("trace", None)
+    if not tracing.ENABLED:
+        return _dispatch_impl(mgr, req)
+    with tracing.trace_context(caller_trace or tracing.current_trace_id()):
+        with tracing.span("remote/dispatch", op=req.get("op")):
+            return _dispatch_impl(mgr, req)
+
+
+def _dispatch_impl(mgr: Manager, req: dict) -> dict:
     op = req.get("op")
     if op == "ping":
         return {"ok": True, "pong": True}

@@ -30,6 +30,7 @@ from kueue_tpu.cache.resource_node import (
 from kueue_tpu.cache.snapshot import ClusterQueueSnapshot
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.metrics import tracing
 from kueue_tpu.utils import features
 
 # Imported lazily by preemption.py to avoid a cycle; keep the import local.
@@ -64,6 +65,8 @@ def fair_preemptions(ctx, strategies: List[str]):
     cq = ctx.preemptor_cq
     candidates = _find_candidates(ctx, satisfies_preemption_policy,
                                   workload_uses_frs)
+    if tracing.ENABLED:
+        tracing.observe("preemption_search_candidates", len(candidates))
     if not candidates:
         return []
     candidates.sort(
@@ -77,9 +80,15 @@ def fair_preemptions(ctx, strategies: List[str]):
             ctx, candidates, STRATEGIES[strategies[0]], Target,
             candidates_ordering_key,
         )
+        if tracing.ENABLED:
+            tracing.inc("fair_preemption_rounds_total",
+                        {"strategy": strategies[0]})
         if not fits and len(strategies) > 1:
             fits, targets = _run_second_strategy(ctx, retry, targets, Target,
                                                  candidates_ordering_key)
+            if tracing.ENABLED:
+                tracing.inc("fair_preemption_rounds_total",
+                            {"strategy": strategies[1]})
     finally:
         revert_sim()
 
@@ -134,8 +143,12 @@ class _DRSCache:
     def get(self, node) -> DRS:
         hit = self._cache.get(id(node))
         if hit is None:
+            if tracing.ENABLED:
+                tracing.inc("solver_drs_cache_total", {"event": "miss"})
             hit = dominant_resource_share(node, {})
             self._cache[id(node)] = hit
+        elif tracing.ENABLED:
+            tracing.inc("solver_drs_cache_total", {"event": "hit"})
         return hit
 
     def invalidate(self) -> None:

@@ -35,6 +35,7 @@ from kueue_tpu.core.workload_info import (
     PodSetResources,
     WorkloadInfo,
 )
+from kueue_tpu.metrics import tracing
 
 
 class Mode(enum.IntEnum):
@@ -359,6 +360,23 @@ class FlavorAssigner:
         Accumulates assumed usage across groups so sibling podsets of one
         workload don't double-book domains. Returns False if any TAS
         podset has no placement."""
+        if not tracing.ENABLED:
+            return self._update_for_tas_impl(
+                assignment, simulate_empty, attach
+            )
+        with tracing.span(
+            "scheduler/tas_placement", workload=self.wl.key,
+            simulate_empty=simulate_empty,
+        ) as s:
+            ok = self._update_for_tas_impl(assignment, simulate_empty, attach)
+            s.set_arg("ok", ok)
+            tracing.inc("tas_placement_total", {"ok": str(ok).lower()})
+            return ok
+
+    def _update_for_tas_impl(
+        self, assignment: "Assignment", simulate_empty: bool,
+        attach: bool,
+    ) -> bool:
         from kueue_tpu.tas.snapshot import PlacementRequest
 
         # Group TAS podsets (reference :651: index-keyed unless a

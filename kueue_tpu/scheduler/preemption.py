@@ -32,6 +32,7 @@ from kueue_tpu.core.workload_info import (
     quota_reservation_time,
     queue_order_timestamp,
 )
+from kueue_tpu.metrics import tracing
 from kueue_tpu.scheduler.flavorassigner import Assignment, Mode
 
 
@@ -175,11 +176,31 @@ class Preemptor:
             now=now,
             tas_fits=tas_fits,
         )
-        if self.enable_fair_sharing:
-            from kueue_tpu.scheduler.fair_preemption import fair_preemptions
+        if not tracing.ENABLED:
+            if self.enable_fair_sharing:
+                from kueue_tpu.scheduler.fair_preemption import (
+                    fair_preemptions,
+                )
 
-            return fair_preemptions(ctx, self.fair_strategies)
-        return self.classical_preemptions(ctx)
+                return fair_preemptions(ctx, self.fair_strategies)
+            return self.classical_preemptions(ctx)
+        with tracing.span(
+            "scheduler/preemption_search", workload=wl.key,
+            fair=self.enable_fair_sharing,
+        ) as s:
+            if self.enable_fair_sharing:
+                from kueue_tpu.scheduler.fair_preemption import (
+                    fair_preemptions,
+                )
+
+                targets = fair_preemptions(ctx, self.fair_strategies)
+            else:
+                targets = self.classical_preemptions(ctx)
+            s.set_arg("targets", len(targets))
+            tracing.inc("preemption_search_total",
+                        {"found": str(bool(targets)).lower()})
+            tracing.observe("preemption_search_targets", len(targets))
+            return targets
 
     # -- candidate generation ----------------------------------------------
 

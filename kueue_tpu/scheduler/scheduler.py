@@ -39,6 +39,7 @@ from kueue_tpu.core.workload_info import (
     queue_order_timestamp,
     set_condition,
 )
+from kueue_tpu.metrics import tracing
 from kueue_tpu.queue.manager import QueueManager
 from kueue_tpu.scheduler.flavorassigner import (
     Assignment,
@@ -133,53 +134,80 @@ class Scheduler:
         start = self.clock()
         result = CycleResult()
 
-        heads = self.queues.heads()
-        result.head_keys = frozenset(h.key for h in heads)
-        if not heads:
+        with tracing.span("scheduler/cycle", cycle=self.scheduling_cycle):
+            heads = self.queues.heads()
+            result.head_keys = frozenset(h.key for h in heads)
+            if not heads:
+                result.duration_s = self.clock() - start
+                return result
+
+            t0 = self.clock()
+            with tracing.span("scheduler/snapshot"):
+                snapshot = self.cache.snapshot()
+            result.snapshot_s = self.clock() - t0
+
+            t0 = self.clock()
+            with tracing.span("scheduler/nominate", heads=len(heads)):
+                self._cycle_oracle = make_oracle(self.preemptor, snapshot)
+                entries, inadmissible = self._nominate(heads, snapshot)
+            result.nominate_s = self.clock() - t0
+
+            iterator = self._make_iterator(entries, snapshot)
+
+            t0 = self.clock()
+            with tracing.span("scheduler/process", entries=len(entries)):
+                preempted_workloads = PreemptedWorkloads()
+                skipped_preemptions: Dict[str, int] = {}
+                for e in iterator:
+                    self._process_entry(
+                        e, snapshot, preempted_workloads,
+                        skipped_preemptions, result
+                    )
+            result.preemption_skips = skipped_preemptions
+            result.process_s = self.clock() - t0
+
+            # Requeue everything not assumed/evicted.
+            with tracing.span("scheduler/requeue"):
+                for e in entries:
+                    if e.status == EntryStatus.ASSUMED:
+                        result.admitted.append(e.info.key)
+                    elif e.status == EntryStatus.PREEMPTING:
+                        result.preempting.append(e.info.key)
+                        # reference scheduler.go:287: the preemptor returns
+                        # immediately and stays pinned at the head while its
+                        # victims' evictions land.
+                        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                        self._requeue_and_update(e)
+                    elif e.status != EntryStatus.EVICTED:
+                        result.skipped.append(e.info.key)
+                        self._requeue_and_update(e)
+                for e in inadmissible:
+                    result.inadmissible.append(e.info.key)
+                    self._requeue_and_update(e)
+
             result.duration_s = self.clock() - start
-            return result
-
-        t0 = self.clock()
-        snapshot = self.cache.snapshot()
-        result.snapshot_s = self.clock() - t0
-
-        t0 = self.clock()
-        self._cycle_oracle = make_oracle(self.preemptor, snapshot)
-        entries, inadmissible = self._nominate(heads, snapshot)
-        result.nominate_s = self.clock() - t0
-
-        iterator = self._make_iterator(entries, snapshot)
-
-        t0 = self.clock()
-        preempted_workloads = PreemptedWorkloads()
-        skipped_preemptions: Dict[str, int] = {}
-        for e in iterator:
-            self._process_entry(
-                e, snapshot, preempted_workloads, skipped_preemptions, result
-            )
-        result.preemption_skips = skipped_preemptions
-        result.process_s = self.clock() - t0
-
-        # Requeue everything not assumed/evicted.
-        for e in entries:
-            if e.status == EntryStatus.ASSUMED:
-                result.admitted.append(e.info.key)
-            elif e.status == EntryStatus.PREEMPTING:
-                result.preempting.append(e.info.key)
-                # reference scheduler.go:287: the preemptor returns
-                # immediately and stays pinned at the head while its
-                # victims' evictions land.
-                e.requeue_reason = RequeueReason.PENDING_PREEMPTION
-                self._requeue_and_update(e)
-            elif e.status != EntryStatus.EVICTED:
-                result.skipped.append(e.info.key)
-                self._requeue_and_update(e)
-        for e in inadmissible:
-            result.inadmissible.append(e.info.key)
-            self._requeue_and_update(e)
-
-        result.duration_s = self.clock() - start
+            if tracing.ENABLED:
+                self._emit_cycle_metrics(result, len(entries))
         return result
+
+    @staticmethod
+    def _emit_cycle_metrics(result: CycleResult, n_entries: int) -> None:
+        """Per-phase cycle histograms (reference scheduler.go:305-372
+        structured per-phase logs; series follow the
+        admission_attempt_duration_seconds family shape)."""
+        tracing.observe(
+            "scheduler_admission_cycle_duration_seconds", result.duration_s
+        )
+        for stage, dur in (
+            ("snapshot", result.snapshot_s),
+            ("nominate", result.nominate_s),
+            ("process", result.process_s),
+        ):
+            tracing.observe(
+                "scheduler_admission_cycle_stage_seconds", dur,
+                {"stage": stage},
+            )
+        tracing.set_gauge("scheduler_admission_cycle_entries", n_entries)
 
     def schedule_all(self, max_cycles: int = 100000) -> int:
         """Run cycles until no progress is possible. Returns cycle count."""
@@ -294,8 +322,12 @@ class Scheduler:
             allow_delayed_tas=self._has_multikueue_check(cq),
             delay_tas=self._delay_tas(cq, info),
         )
-        full = assigner.assign()
+        with tracing.span("scheduler/flavor_assignment",
+                          workload=info.key):
+            full = assigner.assign()
         mode = full.representative_mode()
+        if tracing.ENABLED:
+            tracing.inc("flavor_assignment_total", {"mode": mode.name})
 
         def tas_fits() -> bool:
             # TAS feasibility probe used by the preemptor's workloadFits
@@ -541,6 +573,24 @@ class Scheduler:
         result: CycleResult,
     ) -> None:
         """reference scheduler.go:385."""
+        if not tracing.ENABLED:
+            return self._process_entry_impl(
+                e, snapshot, preempted_workloads, skipped_preemptions, result
+            )
+        with tracing.span("scheduler/process_entry", workload=e.info.key) as s:
+            self._process_entry_impl(
+                e, snapshot, preempted_workloads, skipped_preemptions, result
+            )
+            s.set_arg("status", e.status.value)
+
+    def _process_entry_impl(
+        self,
+        e: Entry,
+        snapshot: Snapshot,
+        preempted_workloads: PreemptedWorkloads,
+        skipped_preemptions: Dict[str, int],
+        result: CycleResult,
+    ) -> None:
         cq = snapshot.cluster_queue(e.info.cluster_queue)
         assert e.assignment is not None
         usage = dict(e.assignment.usage)
@@ -742,6 +792,11 @@ class Scheduler:
 
     def _admit(self, e: Entry, cq: ClusterQueueSnapshot) -> None:
         """reference scheduler.go:890 admit + :954 assumeWorkload."""
+        assert e.assignment is not None
+        with tracing.span("scheduler/admit", workload=e.info.key):
+            self._admit_impl(e, cq)
+
+    def _admit_impl(self, e: Entry, cq: ClusterQueueSnapshot) -> None:
         assert e.assignment is not None
         now = self.clock()
         admission = Admission(

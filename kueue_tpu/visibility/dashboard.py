@@ -385,9 +385,18 @@ def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081,
             if self.path == "/api/state":
                 body = json.dumps(shared_state_doc(manager)[0]).encode()
                 ctype = "application/json"
-            elif self.path == "/api/metrics":
+            elif self.path in ("/api/metrics", "/metrics"):
+                # /metrics is the conventional Prometheus scrape path;
+                # /api/metrics is kept for existing pollers.
                 body = manager.metrics.expose().encode()
-                ctype = "text/plain"
+                ctype = "text/plain; version=0.0.4"
+            elif self.path == "/trace":
+                from kueue_tpu.metrics import tracing
+
+                body = json.dumps(
+                    tracing.get_tracer().export_chrome_trace()
+                ).encode()
+                ctype = "application/json"
             elif self.path in ("/", "/index.html"):
                 body = _PAGE.encode()
                 ctype = "text/html"

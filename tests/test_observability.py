@@ -1,0 +1,594 @@
+"""Admission-cycle tracing + metrics observability tests.
+
+Covers the tracing substrate (span nesting, thread safety, Chrome-trace
+export), the Prometheus exposition round-trip through a strict text-format
+parser, registry fixes (quantile interpolation, get() ambiguity, label
+escaping), trace-id propagation across the gRPC worker seam, the
+schedule_all() span/series surface, the metric-name allowlist checker, and
+the end-to-end acceptance run (one traced perf-harness run producing a
+valid Chrome trace with nested + remote spans and a parseable /metrics
+exposition with non-zero admission-cycle histograms).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.types import LocalQueue, PodSet, ResourceQuota, Workload
+from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import METRIC_NAMES
+from kueue_tpu.metrics import tracing
+from kueue_tpu.metrics.registry import Histogram, Metrics
+
+from .helpers import make_cq
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracing():
+    """Every test starts and ends with tracing off and a fresh
+    default-size buffer (a prior test may have installed a small one)."""
+    tracing.enable(buffer_len=tracing._DEFAULT_BUFFER_LEN)
+    tracing.disable()
+    tracing.get_tracer().clear()
+    yield
+    tracing.enable(buffer_len=tracing._DEFAULT_BUFFER_LEN)
+    tracing.disable()
+    tracing.get_tracer().clear()
+
+
+# ----------------------------------------------------------------------
+# strict Prometheus text-format parser (the round-trip oracle)
+# ----------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$"
+)
+
+
+def _parse_label_pairs(s: str) -> dict:
+    """Parse `k="v",k2="v2"` honoring \\\\, \\" and \\n escapes. Raises on
+    anything malformed — this parser is deliberately strict."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        key = s[i:eq]
+        if not _NAME_RE.match(key):
+            raise ValueError(f"bad label name {key!r}")
+        if s[eq + 1] != '"':
+            raise ValueError(f"label value not quoted at {eq}")
+        i = eq + 2
+        out = []
+        while True:
+            if i >= len(s):
+                raise ValueError("unterminated label value")
+            c = s[i]
+            if c == "\\":
+                esc = s[i + 1]
+                if esc not in ('\\', '"', "n"):
+                    raise ValueError(f"invalid escape \\{esc}")
+                out.append({"\\": "\\", '"': '"', "n": "\n"}[esc])
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            elif c == "\n":
+                raise ValueError("raw newline inside label value")
+            else:
+                out.append(c)
+                i += 1
+        labels[key] = "".join(out)
+        if i < len(s):
+            if s[i] != ",":
+                raise ValueError(f"expected ',' at {i} in {s!r}")
+            i += 1
+    return labels
+
+
+def parse_prometheus_text(text: str):
+    """Parse an exposition. Returns (types, samples) where samples maps
+    (name, frozenset(labels.items())) -> float. Raises ValueError on any
+    line a strict scraper would reject."""
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or not _NAME_RE.match(parts[2]) or \
+                    parts[3] not in ("counter", "gauge", "histogram"):
+                raise ValueError(f"bad TYPE line: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # comments are legal
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"bad sample line: {line!r}")
+        name, raw_labels, raw_value = m.groups()
+        labels = _parse_label_pairs(raw_labels) if raw_labels else {}
+        if raw_value == "+Inf":
+            value = float("inf")
+        else:
+            value = float(raw_value)  # raises on garbage
+        samples[(name, frozenset(labels.items()))] = value
+    # Histogram structure: cumulative buckets non-decreasing, +Inf == count.
+    for name, typ in types.items():
+        if typ != "histogram":
+            continue
+        by_series = {}
+        for (n, lk), v in samples.items():
+            if n == f"{name}_bucket":
+                rest = frozenset(
+                    kv for kv in lk if kv[0] != "le"
+                )
+                le = dict(lk)["le"]
+                by_series.setdefault(rest, []).append(
+                    (float("inf") if le == "+Inf" else float(le), v)
+                )
+        for rest, buckets in by_series.items():
+            buckets.sort()
+            counts = [c for _, c in buckets]
+            if counts != sorted(counts):
+                raise ValueError(f"{name}: non-cumulative buckets")
+            total = samples.get((f"{name}_count", rest))
+            if total is None or buckets[-1][1] != total:
+                raise ValueError(f"{name}: +Inf bucket != count")
+    return types, samples
+
+
+# ----------------------------------------------------------------------
+# tracer core
+# ----------------------------------------------------------------------
+
+
+def test_span_is_shared_noop_when_disabled():
+    s1 = tracing.span("a", x=1)
+    s2 = tracing.span("b")
+    assert s1 is s2  # shared singleton: no allocation on the disabled path
+    with s1 as s:
+        s.set_arg("k", "v")  # must be a no-op, not an error
+    assert tracing.get_tracer().spans() == []
+
+
+def test_span_nesting_records_parent_and_trace_id():
+    tracing.enable()
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert tracing.current_trace_id() == outer.trace_id
+    assert tracing.current_trace_id() is None  # root span resets
+    spans = tracing.get_tracer().spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["outer"]["parent"] is None
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
+    # Inner closes first and nests inside the outer interval.
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+    assert by_name["inner"]["dur"] <= by_name["outer"]["dur"]
+
+
+def test_span_thread_safety():
+    """Concurrent nested spans in N threads must not cross-contaminate
+    parents or trace ids (contextvars give each thread its own stack)."""
+    tracing.enable()
+    barrier = threading.Barrier(4)
+
+    def work(i: int) -> None:
+        barrier.wait()
+        for _ in range(50):
+            with tracing.span(f"outer-{i}"):
+                with tracing.span(f"inner-{i}"):
+                    pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tracing.get_tracer().spans()
+    assert len(spans) == 4 * 50 * 2
+    for rec in spans:
+        if rec["name"].startswith("inner-"):
+            i = rec["name"].split("-")[1]
+            assert rec["parent"] == f"outer-{i}"
+    # Each thread's spans carry its own thread id.
+    tids_by_thread = {}
+    for rec in spans:
+        i = rec["name"].split("-")[1]
+        tids_by_thread.setdefault(i, set()).add(rec["tid"])
+    for tids in tids_by_thread.values():
+        assert len(tids) == 1
+    assert len(set().union(*tids_by_thread.values())) == 4
+
+
+def test_ring_buffer_drops_oldest_and_counts():
+    tracer = tracing.enable(buffer_len=8)
+    for i in range(20):
+        with tracing.span(f"s{i}"):
+            pass
+    spans = tracer.spans()
+    assert len(spans) == 8
+    assert [s["name"] for s in spans] == [f"s{i}" for i in range(12, 20)]
+    assert tracer.dropped == 12
+
+
+def test_chrome_trace_export_shape():
+    tracing.enable()
+    with tracing.span("cycle", n=3):
+        with tracing.span("stage"):
+            pass
+    doc = tracing.export_chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"cycle", "stage"}
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["cat"] == "kueue_tpu"
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert "trace_id" in e["args"]
+    # JSON-serializable end to end (Perfetto loads the dump verbatim).
+    json.loads(json.dumps(doc))
+    cycle = next(e for e in events if e["name"] == "cycle")
+    assert cycle["args"]["n"] == 3
+
+
+def test_span_durations_land_in_metrics_sink():
+    m = Metrics()
+    tracing.enable(m)
+    with tracing.span("phase-x"):
+        pass
+    h = m.histograms["trace_span_duration_seconds"]
+    (lk, hist), = h.items()
+    assert dict(lk) == {"span": "phase-x"}
+    assert hist.n == 1
+
+
+# ----------------------------------------------------------------------
+# registry fixes (satellites 1-2)
+# ----------------------------------------------------------------------
+
+
+def test_label_value_escaping_round_trip():
+    m = Metrics()
+    nasty = 'he said "hi"\nback\\slash'
+    m.inc("workloads_created_total", {"queue": nasty})
+    m.set_gauge("pending_workloads", 2, {"cluster_queue": 'a"b'})
+    m.observe("admission_wait_time_seconds", 0.2, {"q": "x\ny"})
+    types, samples = parse_prometheus_text(m.expose())
+    assert samples[(
+        "kueue_workloads_created_total", frozenset({("queue", nasty)})
+    )] == 1.0
+    assert samples[(
+        "kueue_pending_workloads", frozenset({("cluster_queue", 'a"b')})
+    )] == 2.0
+    assert ("kueue_admission_wait_time_seconds_count",
+            frozenset({("q", "x\ny")})) in samples
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    h = Histogram()
+    # 10 observations all landing in the (0.05, 0.1] bucket.
+    for _ in range(10):
+        h.observe(0.07)
+    # Linear interpolation: lo + (hi-lo) * target/count.
+    assert h.quantile(0.5) == pytest.approx(0.075)
+    assert h.quantile(1.0) == pytest.approx(0.1)
+    assert 0.05 < h.quantile(0.1) < 0.075
+
+
+def test_histogram_quantile_across_buckets_and_overflow():
+    h = Histogram()
+    for v in (0.003, 0.003, 0.07, 0.07, 1000.0):
+        h.observe(v)
+    # q=0.2 -> target 1.0 falls in the (0.001, 0.005] bucket (2 obs).
+    assert 0.001 < h.quantile(0.2) <= 0.005
+    # q=0.7 -> target 3.5 falls in the (0.05, 0.1] bucket.
+    assert 0.05 < h.quantile(0.7) <= 0.1
+    # Overflow bucket has no finite upper bound.
+    assert h.quantile(0.99) == float("inf")
+    assert Histogram().quantile(0.5) == 0.0
+
+
+def test_metrics_get_rejects_counter_gauge_ambiguity():
+    m = Metrics()
+    m.inc("pending_workloads")
+    m.set_gauge("pending_workloads", 7)
+    with pytest.raises(ValueError, match="both counter and gauge"):
+        m.get("pending_workloads")
+    # Unambiguous reads still work.
+    m.inc("workloads_created_total")
+    assert m.get("workloads_created_total") == 1.0
+
+
+def test_full_exposition_is_strictly_parseable():
+    mgr = Manager()
+    mgr.apply(make_cq("cq-a"))
+    mgr.apply(LocalQueue(name="lq", cluster_queue="cq-a"))
+    mgr.create_workload(Workload(name="w1", queue_name="lq", pod_sets=[
+        PodSet(name="main", count=1, requests={"cpu": 1000})]))
+    mgr.schedule_all()
+    types, samples = parse_prometheus_text(mgr.metrics.expose())
+    assert types["kueue_admission_attempts_total"] == "counter"
+    assert types["kueue_admission_attempt_duration_seconds"] == "histogram"
+
+
+# ----------------------------------------------------------------------
+# cross-boundary propagation (gRPC seam)
+# ----------------------------------------------------------------------
+
+
+def test_grpc_trace_id_propagates_to_worker_spans():
+    from kueue_tpu.remote import GrpcWorkerClient, serve_worker_grpc
+
+    tracing.enable()
+    server, bound = serve_worker_grpc(Manager(), in_thread=True)
+    try:
+        client = GrpcWorkerClient(bound)
+        with tracing.span("caller") as caller:
+            client.schedule()
+            caller_tid = caller.trace_id
+        client.close()
+    finally:
+        server.stop(0)
+    spans = tracing.get_tracer().spans()
+    dispatch = [s for s in spans if s["name"] == "remote/dispatch"]
+    call = [s for s in spans if s["name"] == "remote/call"]
+    assert dispatch and call
+    # The worker-side span carries the CALLER's trace id even though it
+    # ran on a different (server executor) thread.
+    assert dispatch[0]["trace_id"] == caller_tid
+    assert call[0]["trace_id"] == caller_tid
+    assert dispatch[0]["tid"] != call[0]["tid"]
+    assert dispatch[0]["args"]["op"] == "schedule"
+
+
+def test_socket_client_injects_trace_key():
+    """The wire request carries the trace id only while tracing is on."""
+    from kueue_tpu.remote.worker import dispatch
+
+    mgr = Manager()
+    # Disabled: dispatch of a plain request works and records nothing.
+    assert dispatch(mgr, {"op": "ping"})["ok"]
+    tracing.enable()
+    resp = dispatch(mgr, {"op": "ping", "trace": "feedbeef00000000"})
+    assert resp["ok"]
+    recs = [s for s in tracing.get_tracer().spans()
+            if s["name"] == "remote/dispatch"]
+    assert recs and recs[0]["trace_id"] == "feedbeef00000000"
+
+
+# ----------------------------------------------------------------------
+# schedule_all() span/series surface
+# ----------------------------------------------------------------------
+
+
+def _contended_manager() -> Manager:
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import ClusterQueuePreemption, ResourceFlavor
+
+    mgr = Manager()
+    mgr.apply(ResourceFlavor(name="default"))
+    mgr.apply(make_cq(
+        "cq-a",
+        flavors={"default": {"cpu": ResourceQuota(nominal=2000)}},
+        preemption=ClusterQueuePreemption(
+            within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+        ),
+    ))
+    mgr.apply(LocalQueue(name="lq", cluster_queue="cq-a"))
+    for i in range(2):
+        mgr.create_workload(Workload(
+            name=f"lo-{i}", queue_name="lq", priority=0,
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 1000})],
+        ))
+    mgr.schedule_all()
+    # Higher-priority arrival forces a preemption search.
+    mgr.create_workload(Workload(
+        name="hi", queue_name="lq", priority=100,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 2000})],
+    ))
+    return mgr
+
+
+def test_schedule_all_emits_expected_spans_and_series():
+    mgr = _contended_manager()
+    tracing.enable(mgr.metrics)
+    tracing.get_tracer().clear()
+    mgr.schedule_all()
+    names = {s["name"] for s in tracing.get_tracer().spans()}
+    assert {"scheduler/cycle", "scheduler/snapshot", "scheduler/nominate",
+            "scheduler/process", "scheduler/process_entry",
+            "scheduler/flavor_assignment", "scheduler/preemption_search",
+            "queue/heads"} <= names
+    m = mgr.metrics
+    assert m.histograms["scheduler_admission_cycle_duration_seconds"]
+    stages = {
+        dict(lk)["stage"]
+        for lk in m.histograms["scheduler_admission_cycle_stage_seconds"]
+    }
+    assert stages == {"snapshot", "nominate", "process"}
+    assert sum(m.counters["queue_heads_popped_total"].values()) > 0
+    assert sum(m.counters["flavor_assignment_total"].values()) > 0
+    assert sum(m.counters["preemption_search_total"].values()) > 0
+    assert m.histograms["queue_heads_duration_seconds"]
+    # Requeue latency recorded for the preemptor pinned at the head.
+    assert m.counters["queue_requeue_total"]
+    # Every emitted series is on the frozen allowlist.
+    for store in (m.counters, m.gauges, m.histograms):
+        for name in store:
+            assert name in METRIC_NAMES, name
+
+
+def test_untraced_schedule_all_records_nothing():
+    mgr = _contended_manager()
+    mgr.schedule_all()
+    assert tracing.get_tracer().spans() == []
+    assert "scheduler_admission_cycle_duration_seconds" not in \
+        mgr.metrics.histograms
+
+
+# ----------------------------------------------------------------------
+# metric-name allowlist checker (satellite 5)
+# ----------------------------------------------------------------------
+
+
+def test_metric_names_allowlist_clean():
+    import sys
+    sys.path.insert(0, "/root/repo/tools")
+    import check_metrics_names
+
+    violations = check_metrics_names.run_check()
+    assert violations == [], "\n".join(violations)
+
+
+def test_checker_flags_unknown_and_dynamic_names(tmp_path):
+    import sys
+    sys.path.insert(0, "/root/repo/tools")
+    import check_metrics_names
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(m, tracing, name):\n"
+        "    m.inc('no_such_series_total')\n"
+        "    tracing.observe(name, 1.0)\n"
+        "    self.roletracker.observe(True)\n"  # not a metrics receiver
+    )
+    violations = check_metrics_names.check_file(
+        bad, frozenset({"workloads_created_total"})
+    )
+    assert len(violations) == 2
+    assert any("no_such_series_total" in msg for _, msg in violations)
+    assert any("not a string literal" in msg for _, msg in violations)
+
+
+# ----------------------------------------------------------------------
+# dashboard endpoints
+# ----------------------------------------------------------------------
+
+
+def test_dashboard_metrics_and_trace_endpoints():
+    from kueue_tpu.visibility.dashboard import serve_dashboard
+
+    mgr = _contended_manager()
+    tracing.enable(mgr.metrics)
+    mgr.schedule_all()
+    httpd = serve_dashboard(mgr, port=0)
+    try:
+        port = httpd.server_address[1]
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        types, samples = parse_prometheus_text(raw)
+        assert "kueue_scheduler_admission_cycle_duration_seconds" in types
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace", timeout=5
+        ).read())
+        assert doc["traceEvents"]
+        assert {e["name"] for e in doc["traceEvents"]} >= {
+            "scheduler/cycle"
+        }
+    finally:
+        httpd.shutdown()
+
+
+# ----------------------------------------------------------------------
+# acceptance: one traced harness run
+# ----------------------------------------------------------------------
+
+ACCEPTANCE_CONFIG = {
+    # Topology + preemption so one run exercises the full span tree:
+    # cycle -> flavor assignment -> preemption search -> TAS placement.
+    "topology": {
+        "name": "topo",
+        "levels": [
+            {"name": "block", "count": 1, "nodeLabel": "topology/block"},
+            {"name": "rack", "count": 2, "nodeLabel": "topology/rack"},
+            {"name": "node", "count": 2,
+             "nodeLabel": "kubernetes.io/hostname",
+             "capacity": {"cpu": "8"}},
+        ],
+    },
+    "resourceFlavor": {"name": "tas-flavor"},
+    "cohorts": [{
+        "className": "c", "count": 1,
+        "queuesSets": [{
+            "className": "q", "count": 2, "nominalQuota": 16,
+            "borrowingLimit": 16,
+            "reclaimWithinCohort": "LowerPriority",
+            "withinClusterQueue": "LowerPriority",
+            "workloadsSets": [{
+                "count": 8, "creationIntervalMs": 40,
+                "workloads": [
+                    {"className": "lo", "priority": 0, "request": 4,
+                     "runtimeMs": 400, "tasConstraint": "required",
+                     "tasLevel": "topology/rack"},
+                    {"className": "hi", "priority": 10, "request": 4,
+                     "runtimeMs": 100, "tasConstraint": "preferred",
+                     "tasLevel": "topology/block"},
+                ],
+            }],
+        }],
+    }],
+}
+
+
+def test_acceptance_traced_harness_run():
+    """ISSUE acceptance: ONE perf-harness run yields (a) a valid Chrome
+    trace with nested cycle/assignment/preemption/TAS spans including a
+    remote-worker span carrying the caller's trace id, and (b) a
+    /metrics exposition a strict parser accepts with non-zero
+    scheduler_admission_cycle-family histograms."""
+    from kueue_tpu.perf import harness
+
+    result = harness.run(ACCEPTANCE_CONFIG, trace=True, trace_remote=True)
+    assert result.admitted == result.total_workloads
+    assert not tracing.enabled()  # restored after the run
+
+    # (a) Chrome trace: loadable JSON, nested span tree, remote span.
+    doc = result.trace
+    json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"scheduler/cycle", "scheduler/flavor_assignment",
+            "scheduler/preemption_search", "scheduler/tas_placement",
+            "remote/call", "remote/dispatch",
+            "harness/remote_probe"} <= names
+    parents = {}
+    for e in events:
+        parents.setdefault(e["name"], set()).add(e["args"]["parent"])
+    assert parents["scheduler/snapshot"] == {"scheduler/cycle"}
+    # Flavor assignment runs under nomination (and under process_entry on
+    # in-cycle retries); either way it nests inside the cycle tree.
+    assert parents["scheduler/flavor_assignment"] <= {
+        "scheduler/nominate", "scheduler/process_entry"
+    }
+    probe = next(e for e in events if e["name"] == "harness/remote_probe")
+    dispatch = next(e for e in events if e["name"] == "remote/dispatch")
+    assert dispatch["args"]["trace_id"] == probe["args"]["trace_id"]
+
+    # (b) strict-parseable /metrics with non-zero cycle histograms.
+    types, samples = parse_prometheus_text(result.metrics_text)
+    assert types["kueue_scheduler_admission_cycle_duration_seconds"] == \
+        "histogram"
+    assert samples[(
+        "kueue_scheduler_admission_cycle_duration_seconds_count",
+        frozenset(),
+    )] > 0
+    stage_counts = [
+        v for (n, lk), v in samples.items()
+        if n == "kueue_scheduler_admission_cycle_stage_seconds_count"
+    ]
+    assert stage_counts and all(v > 0 for v in stage_counts)
+    # Phase breakdown covers the dominant scheduler phases.
+    assert result.phase_breakdown["scheduler/cycle"] > 0

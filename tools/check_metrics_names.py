@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Static check: every metric emission uses a name from the frozen
+allowlist (kueue_tpu/metrics/names.py).
+
+A typo'd series name doesn't fail at runtime — it silently forks a new
+series and every dashboard reading the intended one shows zeros forever.
+This walker finds each ``<metrics-ish receiver>.inc/observe/set_gauge``
+call in the package and verifies the first argument is a string literal
+present in ``METRIC_NAMES``.
+
+Receivers considered metric emitters:
+- the ``tracing`` module (``tracing.inc(...)``)
+- a bare ``m`` (the local alias convention for a Metrics registry)
+- any attribute chain containing a ``metrics`` component
+  (``self.manager.metrics.inc``, ``mgr.metrics.observe``)
+
+Other ``.observe()``-shaped calls (e.g. ``self.roletracker.observe``)
+are unrelated and skipped. The registry/tracing internals are excluded:
+they forward caller-supplied names by design.
+
+Run standalone (exit 1 on violations) or via tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "kueue_tpu"
+
+# Forwarding layers: they pass through names owned by their callers.
+EXCLUDED = {
+    PACKAGE / "metrics" / "registry.py",
+    PACKAGE / "metrics" / "tracing.py",
+}
+
+_EMIT_METHODS = {"inc", "observe", "set_gauge"}
+
+
+def _receiver_parts(node: ast.expr) -> List[str]:
+    """Flatten an attribute chain to its name components;
+    ``self.manager.metrics`` -> ["self", "manager", "metrics"]."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def _is_metrics_receiver(parts: List[str]) -> bool:
+    if not parts:
+        return False
+    if parts == ["tracing"] or parts == ["m"]:
+        return True
+    return "metrics" in parts
+
+
+def check_file(path: Path, allowlist: frozenset) -> List[Tuple[int, str]]:
+    violations: List[Tuple[int, str]] = []
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _EMIT_METHODS:
+            continue
+        if not _is_metrics_receiver(_receiver_parts(fn.value)):
+            continue
+        if not node.args:
+            violations.append(
+                (node.lineno, f"{fn.attr}() call without a metric name")
+            )
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            violations.append((
+                node.lineno,
+                f"{fn.attr}() metric name is not a string literal "
+                "(allowlist check impossible)",
+            ))
+            continue
+        if first.value not in allowlist:
+            violations.append((
+                node.lineno,
+                f"{fn.attr}({first.value!r}) not in METRIC_NAMES "
+                "(kueue_tpu/metrics/names.py)",
+            ))
+    return violations
+
+
+def run_check() -> List[str]:
+    """Returns human-readable violation lines; empty list = clean."""
+    sys.path.insert(0, str(REPO_ROOT))
+    from kueue_tpu.metrics.names import METRIC_NAMES
+
+    out: List[str] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path in EXCLUDED:
+            continue
+        for lineno, msg in check_file(path, METRIC_NAMES):
+            rel = path.relative_to(REPO_ROOT)
+            out.append(f"{rel}:{lineno}: {msg}")
+    return out
+
+
+def main() -> int:
+    violations = run_check()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} metric-name violation(s)")
+        return 1
+    print("metric names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
