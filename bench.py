@@ -781,6 +781,129 @@ def probe_multichip():
     return stats
 
 
+def probe_incremental(scale: float):
+    """Steady-state incremental-cycle probe (docs/perf.md): warm the
+    CycleArena at the ~10k-workload baseline config, churn <=5% of the
+    admitted rows per cycle, and report the host-encode cost of the
+    incremental path vs from-scratch encode_cycle plus the device-solve
+    split. Admission to steady state runs through the host-exact
+    scheduler so the probe measures encoding, not kernel recompiles;
+    one cycle is verified bit-identical against from-scratch."""
+    import numpy as np
+    import jax
+
+    from kueue_tpu.api.types import PodSet, Workload
+    from kueue_tpu.core.workload_info import WorkloadInfo
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.models.arena import CycleArena, assert_cycle_equal
+    from kueue_tpu.models.encode import encode_cycle
+    from kueue_tpu.scheduler.scheduler import Scheduler
+
+    # 10k-workload config on the baseline 5x6 quota tree, one homogeneous
+    # class so the steady-state admitted set is large (~6000 rows: 200 x
+    # 100m per 20k-nominal CQ) — the default class mix parks after one
+    # 20k "large" fills each CQ's nominal and admits only 30 rows, which
+    # is no test of O(admitted) encode cost.
+    n_per_cq = max(1, int(333 * scale))
+    cache, queues, workloads = build_scenario(
+        scale, classes=[("unit", n_per_cq, 100, 50, 1.0)]
+    )
+    for wl, _rt in workloads:
+        assert queues.add_or_update_workload(wl)
+    host = Scheduler(cache, queues)
+    for _ in range(400):
+        res = host.schedule()
+        if not res.admitted and not res.preempted:
+            break
+    admitted_n = len(cache.workloads)
+
+    heads = queues.heads()
+    arena = CycleArena(cache)
+    snap = arena.take_snapshot()
+    t0 = time.monotonic()
+    arrays, idx = arena.encode(snap, heads, snap.resource_flavors,
+                               preempt=True)
+    cold_s = time.monotonic() - t0
+    w_pad = int(np.asarray(arrays.w_cq).shape[0])
+
+    # Steady-state churn: the newest admitted row of a few CQs completes
+    # and a fresh equivalent admits in its slot (<=5% of rows per cycle).
+    churn_cqs = [n for n, d in cache._cq_workloads.items() if d]
+    k_churn = max(1, min(len(churn_cqs), admitted_n // 40))
+    inc_s, full_s, dirty = [], [], []
+    verified = False
+    nonce = 0
+    t_clock = float(len(workloads) + 1)
+    for _ in range(12):
+        for cq_name in churn_cqs[:k_churn]:
+            d = cache._cq_workloads.get(cq_name)
+            if not d:
+                continue
+            last_key = next(reversed(d))
+            old = cache.workloads[last_key].obj
+            cache.delete_workload(last_key)
+            nonce += 1
+            t_clock += 1.0
+            # uid sorts adjacent to the replaced row's so the global
+            # uid_rank column shifts only locally; fresh counter uids
+            # land mid-order lexicographically and re-rank O(A) rows.
+            repl = Workload(
+                name=f"churn-{nonce}", namespace=old.namespace,
+                queue_name=old.queue_name, uid=old.uid + "r",
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests=dict(old.pod_sets[0].requests))],
+                priority=old.priority, creation_time=t_clock,
+            )
+            cache.add_or_update_workload(WorkloadInfo(repl, cq_name))
+        snap = arena.take_snapshot()
+        t0 = time.monotonic()
+        arrays, idx = arena.encode(snap, heads, snap.resource_flavors,
+                                   w_pad=w_pad, preempt=True)
+        inc_s.append(time.monotonic() - t0)
+        if arena.last_stats.get("path") != "incremental":
+            return {"probe": "incremental", "ok": False,
+                    "error": f"fell back to full: {arena.last_stats}"}
+        dirty.append(int(arena.last_stats.get("dirty_admitted", 0)))
+        t0 = time.monotonic()
+        ref = encode_cycle(snap, heads, snap.resource_flavors,
+                           w_pad=w_pad, preempt=True)
+        full_s.append(time.monotonic() - t0)
+        if not verified:
+            assert_cycle_equal(arrays, idx, *ref)
+            verified = True
+
+    # Device-solve side of the split: one warm grouped-kernel dispatch on
+    # the arena-built arrays.
+    out = bs.cycle_grouped_preempt(arrays, idx.group_arrays,
+                                   idx.admitted_arrays)
+    t0 = time.monotonic()
+    jax.block_until_ready(out.outcome)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = bs.cycle_grouped_preempt(arrays, idx.group_arrays,
+                                   idx.admitted_arrays)
+    jax.block_until_ready(out.outcome)
+    device_s = time.monotonic() - t0
+
+    inc_med = sorted(inc_s)[len(inc_s) // 2]
+    full_med = sorted(full_s)[len(full_s) // 2]
+    return {
+        "probe": "incremental", "ok": True,
+        "platform": jax.devices()[0].platform,
+        "n": len(workloads), "admitted": admitted_n, "heads": len(heads),
+        "dirty_admitted_rows": max(dirty) if dirty else 0,
+        "dirty_pct": round(
+            100.0 * max(dirty) / max(admitted_n, 1), 2) if dirty else 0.0,
+        "cold_encode_ms": round(cold_s * 1000, 2),
+        "encode_ms": round(inc_med * 1000, 2),
+        "full_encode_ms": round(full_med * 1000, 2),
+        "encode_speedup": round(full_med / inc_med, 1) if inc_med else 0.0,
+        "device_ms": round(device_s * 1000, 2),
+        "device_compile_s": round(compile_s, 1),
+        "bit_identical": verified,
+    }
+
+
 def run_probe_subprocess(
     probe: str, timeout_s: int, scale: float, platform: str = None,
     env_extra: dict = None, compile_cache: str = None,
@@ -819,13 +942,17 @@ def run_probe_subprocess(
 
 
 def main():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "incremental":
+        # docs/perf.md spelling: `python bench.py incremental`.
+        argv = ["--probe", "incremental"] + argv[1:]
     ap = argparse.ArgumentParser()
     ap.add_argument("--kind", default="host", choices=["device", "host"])
     ap.add_argument("--scale", type=float, default=1.0,
                     help="fraction of the 15k baseline workload count")
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
-                             "multichip"],
+                             "multichip", "incremental"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -839,7 +966,7 @@ def main():
                          "own subprocess so a crash costs one probe, not "
                          "the bench")
     ap.add_argument("--skip-device", action="store_true")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.platform:
         import jax
@@ -868,6 +995,7 @@ def main():
                 "fair": lambda: probe_fair(args.scale),
                 "phases": probe_phases,
                 "multichip": probe_multichip,
+                "incremental": lambda: probe_incremental(args.scale),
             }[args.probe]()
         except Exception as exc:  # noqa: BLE001 - report, don't crash
             stats = {"probe": args.probe, "ok": False,
@@ -907,6 +1035,7 @@ def main():
             device["mega"] = probe_with_cache_fallback("mega")
             device["fair"] = probe_with_cache_fallback("fair")
             device["phases"] = probe_with_cache_fallback("phases")
+            device["incremental"] = probe_with_cache_fallback("incremental")
         device["ok"] = bool(
             (device.get("sim") or {}).get("ok")
             or (device.get("mega") or {}).get("ok")
@@ -999,7 +1128,8 @@ def main():
     }
     if device:
         dv = {}
-        for name in ("ping", "sim", "mega", "fair", "phases"):
+        for name in ("ping", "sim", "mega", "fair", "phases",
+                     "incremental"):
             p = device.get(name)
             if not isinstance(p, dict):
                 continue
@@ -1007,6 +1137,10 @@ def main():
                 dv[name] = {"ok": False, "rc": p.get("rc")}
                 if p.get("error"):
                     dv[name]["error"] = str(p["error"])[:80]
+            elif name == "incremental":
+                dv[name] = _pick(p, "ok", "encode_ms", "full_encode_ms",
+                                 "encode_speedup", "device_ms",
+                                 "dirty_pct", "bit_identical")
             elif name == "sim":
                 dv[name] = _pick(p, "ok", "admissions_per_s",
                                  "end_to_end_adm_per_s", "kernel")

@@ -65,7 +65,25 @@ class Cache:
         # delete/reaccount): lets the encoder reuse the admitted-state
         # arrays across cycles when nothing changed.
         self.workload_generation = 0
-        # Structure cache for TAS snapshots: (generation, template).
+        # Fine-grained generations (docs/perf.md): ``generation`` stays the
+        # union bump for compatibility, but consumers that only depend on
+        # one input family key off these so unrelated mutations stop
+        # invalidating their caches.
+        # CQ / cohort / resource-flavor changes: the quota tree, per-CQ
+        # policy and flavor-eligibility inputs.
+        self.quota_generation = 0
+        # Node / topology / resource-slice changes: TAS capacity only.
+        self.node_generation = 0
+        # Effective admitted-set/usage mutations (every recorded workload
+        # event bumps it; a no-op delete does not).
+        self.admitted_generation = 0
+        # Workload event log consumed by the incremental cycle encoder
+        # (models/arena.py): (kind, key, cq, usage items, priority, uid,
+        # info). kind is +1 (added to the live tree) / -1 (removed).
+        self._workload_events: list = []
+        self._workload_event_base = 0
+        # Structure cache for TAS snapshots: keyed by the generations the
+        # template actually depends on (quota + node inputs).
         self._tas_templates: Dict[str, tuple] = {}
         # Live quota tree with incrementally maintained usage (reference
         # cache.go keeps usage live; Snapshot() only clones usage maps).
@@ -79,31 +97,37 @@ class Cache:
         with self._lock:
             self.cluster_queues[cq.name] = cq
             self.generation += 1
+            self.quota_generation += 1
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
             self.cluster_queues.pop(name, None)
             self.generation += 1
+            self.quota_generation += 1
 
     def add_or_update_cohort(self, cohort: Cohort) -> None:
         with self._lock:
             self.cohorts[cohort.name] = cohort
             self.generation += 1
+            self.quota_generation += 1
 
     def delete_cohort(self, name: str) -> None:
         with self._lock:
             self.cohorts.pop(name, None)
             self.generation += 1
+            self.quota_generation += 1
 
     def add_or_update_resource_flavor(self, rf: ResourceFlavor) -> None:
         with self._lock:
             self.resource_flavors[rf.name] = rf
             self.generation += 1
+            self.quota_generation += 1
 
     def delete_resource_flavor(self, name: str) -> None:
         with self._lock:
             self.resource_flavors.pop(name, None)
             self.generation += 1
+            self.quota_generation += 1
 
     def add_or_update_admission_check(self, ac: AdmissionCheck) -> None:
         with self._lock:
@@ -112,6 +136,9 @@ class Cache:
     def add_or_update_topology(self, topo: Topology) -> None:
         with self._lock:
             self.topologies[topo.name] = topo
+            # TAS structure templates depend on the topology spec; without
+            # this bump a re-applied Topology kept serving stale templates.
+            self.node_generation += 1
 
     def add_or_update_local_queue(self, lq: LocalQueue) -> None:
         with self._lock:
@@ -125,11 +152,13 @@ class Cache:
         with self._lock:
             self.nodes[node.name] = node
             self.generation += 1
+            self.node_generation += 1
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
             self.generation += 1
+            self.node_generation += 1
 
     def add_or_update_resource_slice(self, rs) -> None:
         """DRA inventory (kueue_tpu.dra.ResourceSlice); slices feed charge
@@ -137,33 +166,61 @@ class Cache:
         with self._lock:
             self.resource_slices[rs.name] = rs
             self.generation += 1
+            self.node_generation += 1
 
     def delete_resource_slice(self, name: str) -> None:
         with self._lock:
             self.resource_slices.pop(name, None)
             self.generation += 1
+            self.node_generation += 1
 
     # -- workload lifecycle -------------------------------------------------
+
+    # Bound on the workload event log; when exceeded the older half is
+    # trimmed (consumers detect the gap through the base counter and fall
+    # back to a full re-encode).
+    _EVENT_LOG_CAP = 100_000
+
+    def _record_workload_event(self, kind: int, key: str, cq: str,
+                               items: tuple, info: WorkloadInfo) -> None:
+        """Append one effective admitted-set mutation for incremental
+        consumers (models/arena.py). kind is +1 add / -1 remove; ``items``
+        is the usage at event time (the workload object is mutable, so it
+        must be captured here, not at drain time)."""
+        self._workload_events.append(
+            (kind, key, cq, items, info.priority(), info.obj.uid, info)
+        )
+        if len(self._workload_events) > self._EVENT_LOG_CAP:
+            drop = len(self._workload_events) // 2
+            del self._workload_events[:drop]
+            self._workload_event_base += drop
+        self.admitted_generation += 1
 
     def _live_add(self, info: WorkloadInfo) -> None:
         # Caller must have run _ensure_live() BEFORE storing the workload
         # in self.workloads: the rebuild replays self.workloads, so adding
         # first would double-count this workload's usage.
         node = self._live_nodes.get(info.cluster_queue)
+        items = tuple(info.usage().items())
         if node is not None:
-            for fr, v in info.usage().items():
+            for fr, v in items:
                 node.add_usage(fr, v)
         self._cq_workloads.setdefault(info.cluster_queue, {})[info.key] = info
+        self._record_workload_event(
+            1, info.key, info.cluster_queue, items, info
+        )
 
     def _live_remove(self, key: str) -> None:
         old = self.workloads.get(key)
         if old is None or self._live_nodes is None:
             return
         node = self._live_nodes.get(old.cluster_queue)
+        items = tuple(old.usage().items())
         if node is not None:
-            for fr, v in old.usage().items():
+            for fr, v in items:
                 node.remove_usage(fr, v)
         self._cq_workloads.get(old.cluster_queue, {}).pop(key, None)
+        self._record_workload_event(-1, key, old.cluster_queue, items, old)
 
     def add_or_update_workload(self, info: WorkloadInfo) -> None:
         with self._lock:
@@ -244,8 +301,13 @@ class Cache:
         """(Re)build the live quota tree when specs changed, replaying
         admitted usage once; all later workload events update it
         incrementally."""
+        # Keyed on quota_generation: the quota tree is built from cohorts
+        # and CQs only, so node/flavor-unrelated spec bumps must not force
+        # a rebuild (a rebuild also reorders _cq_workloads, which the
+        # incremental encoder relies on staying stable between quota
+        # changes).
         if self._live_nodes is not None and \
-                self._live_generation == self.generation:
+                self._live_generation == self.quota_generation:
             return
         nodes = build_quota_tree(
             self.cohorts.values(), self.cluster_queues.values()
@@ -256,7 +318,7 @@ class Cache:
             if node.parent is None:
                 update_tree(node)
         self._live_nodes = nodes
-        self._live_generation = self.generation
+        self._live_generation = self.quota_generation
         self._cq_workloads = {}
         for info in self.workloads.values():
             node = nodes.get(info.cluster_queue)
@@ -297,12 +359,20 @@ class Cache:
         with self._lock:
             self._ensure_live()
             snap = Snapshot()
+            snap.generation = self.generation
+            snap.quota_generation = self.quota_generation
+            snap.node_generation = self.node_generation
+            snap.admitted_generation = self.admitted_generation
+            snap.workload_generation = self.workload_generation
             snap.resource_flavors = dict(self.resource_flavors)
             nodes = self._clone_live_tree()
             snap.roots = [n for n in nodes.values() if n.parent is None]
             for name, cq in self.cluster_queues.items():
                 cqs = ClusterQueueSnapshot(cq, nodes[name])
-                cqs.allocatable_generation = self.generation
+                # Flavor eligibility / assignment-resume caches depend on
+                # quota inputs only; an unrelated node add must not expire
+                # every workload's last assignment.
+                cqs.allocatable_generation = self.quota_generation
                 cqs.workloads = dict(self._cq_workloads.get(name, {}))
                 snap.cluster_queues[name] = cqs
                 if not self.cluster_queue_active(cq):
@@ -342,14 +412,18 @@ class Cache:
             for name, rf in self.resource_flavors.items():
                 if rf.topology_name and rf.topology_name in self.topologies:
                     cached = self._tas_templates.get(name)
-                    if cached is None or cached[0] != self.generation:
+                    # The template reads the topology spec, the node set
+                    # (+ DRA slices) and the flavor's taints/tolerations —
+                    # exactly the quota + node generations.
+                    tas_key = (self.quota_generation, self.node_generation)
+                    if cached is None or cached[0] != tas_key:
                         template = TASFlavorSnapshot(
                             self.topologies[rf.topology_name],
                             tas_nodes.values(),
                             flavor_taints=rf.node_taints,
                             flavor_tolerations=rf.tolerations,
                         )
-                        self._tas_templates[name] = (self.generation, template)
+                        self._tas_templates[name] = (tas_key, template)
                     else:
                         template = cached[1]
                     tas = template.share_structure()
@@ -368,3 +442,18 @@ class Cache:
                             for leaf_id, reqs in leaf_usage.items():
                                 tas.add_usage(leaf_id, reqs)
             return snap
+
+    def snapshot_with_workload_events(self, cursor: int):
+        """Snapshot plus the workload events recorded since ``cursor``,
+        under ONE lock hold so the event replay lands exactly on the
+        snapshot state. Returns ``(snapshot, events, new_cursor)``;
+        ``events`` is None when the log was trimmed past the cursor (a
+        gap — the consumer must re-encode from the snapshot)."""
+        with self._lock:
+            base = self._workload_event_base
+            end = base + len(self._workload_events)
+            if cursor < base or cursor > end:
+                events = None
+            else:
+                events = list(self._workload_events[cursor - base:])
+            return self.snapshot(), events, end

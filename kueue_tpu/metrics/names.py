@@ -86,6 +86,11 @@ TRACING_SERIES = frozenset({
     "solver_batch_size",
     "solver_padding_waste_pct",
     "solver_drs_cache_total",
+    "solver_encode_seconds",
+    "solver_arena_cycles_total",
+    "solver_arena_dirty_rows",
+    "solver_overlap_occupancy_pct",
+    "solver_overlap_host_seconds",
     "remote_calls_total",
     "remote_call_duration_seconds",
 })

@@ -1,0 +1,945 @@
+"""Device-resident incremental cycle encoding (the CycleArena).
+
+``encode_cycle`` rebuilds every dense tensor from the snapshot each cycle,
+even though successive cycles differ only in the rows touched by the last
+cycle's admissions and preemptions. The arena keeps the encoded tensors
+resident on device across cycles and reconciles them with row-level deltas:
+
+- the cache appends a workload event log (``Cache._record_workload_event``)
+  for every effective admitted-set mutation; the arena drains it atomically
+  with the snapshot (``Cache.snapshot_with_workload_events``),
+- host-side numpy mirrors of every dynamic tensor family are updated from
+  the events (O(events) python work plus C-level numpy gathers),
+- dirty rows are found by mirror comparison and applied on device by a
+  small jitted ``.at[idx].set`` scatter per family, fed by ONE batched
+  ``device_put`` of the delta payload.
+
+Families and their delta sources:
+
+- node family   — ``usage[N,F,R]`` rows of event CQs + their ancestors,
+                  re-read from the snapshot tree (exactly what
+                  ``encode_tree`` reads); ``usage_by_prio[N,F,R,B]`` by
+                  integer event arithmetic (commutative, exact).
+- A family      — the AdmittedArrays columns. Per-CQ insertion-ordered
+                  slot dicts replay the cache's ``_cq_workloads``
+                  semantics (pop on remove, append on add) so the flat
+                  row order is bit-identical to the from-scratch concat;
+                  per-row values live in a slab store and the mirrors are
+                  rebuilt by a numpy gather.
+- W family      — per-head rows, recomputed exactly like the from-scratch
+                  loop (it is O(heads) by nature) and diffed row-wise.
+- flag family   — ``preempt_simple`` / ``preempt_hier``, recomputed from
+                  static per-root topology facts and an event-maintained
+                  unmappable-usage counter per root.
+
+Everything static under the quota generation (tree, per-CQ policy, group
+arrays, bwc_*) is reused as-is from the committed device arrays.
+
+Any condition the incremental path does not model (TAS flavors, fair
+sharing, slot layout, partial admission, topology-requesting heads, a
+quota-structure change, an event-log gap, a priority-cut change, a
+``preempt_hier`` presence flip) falls back to the from-scratch
+``encode_cycle`` — which re-captures the arena, so the next steady cycle
+is incremental again. The differential guarantee is strict: arena-built
+arrays are bit-identical to from-scratch encode (``verify=True`` asserts
+it after every incremental cycle; tests/test_arena_differential.py drives
+randomized mutation sequences through it).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.core.workload_info import (
+    WorkloadInfo,
+    has_quota_reservation,
+    is_evicted,
+    queue_order_timestamp,
+    quota_reservation_time,
+)
+from kueue_tpu.metrics import tracing
+from kueue_tpu.models.encode import (
+    CycleArrays,
+    CycleIndex,
+    _device_compatible,
+    _order_rank,
+    _round_up,
+    _workload_slots,
+    encode_cycle,
+)
+from kueue_tpu.ops.quota_ops import MAX_DEPTH
+
+_B = 8  # priority-bucket axis, mirrors encode_cycle's B
+
+
+class _Fallback(Exception):
+    """Raised by the incremental path when the cycle needs a full encode."""
+
+
+@jax.jit
+def _scatter_rows(cols, idx_, rows):
+    """Apply one family's dirty rows: cols[k][idx] = rows[k]."""
+    return {k: cols[k].at[idx_].set(rows[k]) for k in rows}
+
+
+def _pad_bucket(idx_: np.ndarray, rows: Dict[str, np.ndarray]):
+    """Pad the dirty-row count to a power of two so the jitted scatter
+    compiles one program per bucket. Padding repeats the last (index, row)
+    pair — an idempotent same-value set."""
+    k = len(idx_)
+    b = 1 << max(k - 1, 0).bit_length()
+    if b == k:
+        return idx_, rows
+    pad = b - k
+    idx2 = np.concatenate([idx_, np.repeat(idx_[-1:], pad)])
+    rows2 = {
+        c: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+        for c, v in rows.items()
+    }
+    return idx2, rows2
+
+
+class _AdmittedStore:
+    """Slab store of per-admitted-workload row values, keyed by slot id.
+
+    The flat A-family mirrors are rebuilt each event cycle by a numpy
+    gather over the slot order, so the python work stays O(events)."""
+
+    def __init__(self, f: int, r: int) -> None:
+        self.f = f
+        self.r = r
+        self.cap = 0
+        self.free: List[int] = []
+        self.next = 0
+        self.cq = np.zeros(0, dtype=np.int32)
+        self.prio = np.zeros(0, dtype=np.int64)
+        self.ts = np.zeros(0, dtype=np.float64)
+        self.qr = np.zeros(0, dtype=np.float64)
+        self.evicted = np.zeros(0, dtype=bool)
+        self.uid = np.zeros(0, dtype=object)
+        self.info = np.zeros(0, dtype=object)
+
+    def _grow(self, need: int) -> None:
+        cap = max(64, self.cap * 2, need)
+        for name in ("cq", "prio", "ts", "qr", "evicted", "uid", "info"):
+            old = getattr(self, name)
+            new = np.zeros(cap, dtype=old.dtype)
+            new[: self.cap] = old
+            setattr(self, name, new)
+        if self.cap:
+            usage = np.zeros((cap, self.f, self.r), dtype=np.int64)
+            usage[: self.cap] = self.usage
+            self.usage = usage
+        else:
+            self.usage = np.zeros((cap, self.f, self.r), dtype=np.int64)
+        self.cap = cap
+
+    def alloc(self) -> int:
+        if self.free:
+            return self.free.pop()
+        if self.next >= self.cap:
+            self._grow(self.next + 1)
+        slot = self.next
+        self.next += 1
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.free.append(slot)
+
+    def set_row(self, slot, cq_i, info, items, prio, uid,
+                flavor_of, resource_of) -> int:
+        """Fill one slab row; returns the count of unmappable usage keys
+        (the per-root counter feeding preempt_simple/preempt_hier)."""
+        self.cq[slot] = cq_i
+        self.prio[slot] = prio
+        self.ts[slot] = queue_order_timestamp(info.obj)
+        self.qr[slot] = quota_reservation_time(info.obj, 0.0)
+        self.evicted[slot] = is_evicted(info.obj)
+        self.uid[slot] = uid
+        self.info[slot] = info
+        row = self.usage[slot]
+        row[:] = 0
+        unmap = 0
+        for fr, v in items:
+            fi = flavor_of.get(fr.flavor)
+            ri = resource_of.get(fr.resource)
+            if fi is None or ri is None:
+                unmap += 1
+            else:
+                row[fi, ri] = v
+        return unmap
+
+
+class CycleArena:
+    """Persistent device-resident encode state for one DeviceScheduler."""
+
+    def __init__(self, cache, fair_sharing: bool = False,
+                 verify: bool = False) -> None:
+        self.cache = cache
+        self.fair_sharing = fair_sharing
+        self.verify = verify
+        # Component cache consumed by encode_cycle on the full path
+        # ({"prio": (key, tensors), "adm": (key, tensors)}).
+        self.component_cache: dict = {}
+        self._cursor = 0
+        self._pending_events: Optional[list] = None
+        self._committed = False
+        # Rolling per-cycle stats (tests pin the perf contract on these).
+        self.last_stats: Dict[str, object] = {}
+
+    # -- snapshot pairing ---------------------------------------------------
+
+    def take_snapshot(self):
+        """Snapshot + event drain under one cache lock hold, so the mirror
+        replay exactly matches the snapshot state."""
+        snap, events, cursor = self.cache.snapshot_with_workload_events(
+            self._cursor
+        )
+        self._pending_events = events  # None = gap -> full encode
+        self._cursor = cursor
+        return snap
+
+    # -- public encode ------------------------------------------------------
+
+    def encode(self, snapshot, heads: Sequence[WorkloadInfo],
+               resource_flavors, w_pad: int = 0, preempt: bool = True,
+               delay_tas_fn=None, fair_strategies=None):
+        t0 = time.perf_counter()
+        events = self._pending_events
+        self._pending_events = None
+        reason = self._gate(snapshot, heads, preempt, events)
+        out = None
+        if reason is None:
+            try:
+                out = self._incremental(
+                    snapshot, heads, resource_flavors, w_pad, delay_tas_fn,
+                    events,
+                )
+            except _Fallback as exc:
+                reason = str(exc)
+        if out is None:
+            out = self._capture(
+                snapshot, heads, resource_flavors, w_pad, preempt,
+                delay_tas_fn, fair_strategies,
+            )
+            self.last_stats = {"path": "full", "reason": reason}
+        dt = time.perf_counter() - t0
+        self.last_stats["encode_s"] = dt
+        path = self.last_stats["path"]
+        tracing.observe("solver_encode_seconds", dt, labels={"path": path})
+        tracing.inc(
+            "solver_arena_cycles_total",
+            labels={"path": path, "reason": reason or "ok"},
+        )
+        if path == "incremental":
+            for axis in ("workload", "admitted", "node"):
+                tracing.observe(
+                    "solver_arena_dirty_rows",
+                    float(self.last_stats.get("dirty_" + axis, 0)),
+                    labels={"axis": axis},
+                )
+        if self.verify and path == "incremental":
+            self._verify(out, snapshot, heads, resource_flavors, w_pad,
+                         preempt, delay_tas_fn, fair_strategies)
+        return out
+
+    # -- gating -------------------------------------------------------------
+
+    def _gate(self, snapshot, heads, preempt, events) -> Optional[str]:
+        if self.fair_sharing:
+            return "fair"
+        if not preempt:
+            return "no-preempt"
+        if snapshot.tas_flavors:
+            return "tas"
+        if not self._committed:
+            return "cold"
+        if getattr(snapshot, "quota_generation", None) != self._quota_gen:
+            return "quota-gen"
+        if events is None:
+            return "event-gap"
+        for info in heads:
+            for ps in info.obj.pod_sets:
+                if ps.topology_request is not None:
+                    return "topology-head"
+        from kueue_tpu.utils import features as _feat
+
+        if _feat.enabled("PartialAdmission"):
+            for info in heads:
+                if any(
+                    ps.min_count is not None and ps.min_count < ps.count
+                    for ps in info.obj.pod_sets
+                ):
+                    return "partial"
+        return None
+
+    # -- full path ----------------------------------------------------------
+
+    def _component_keys(self, snapshot) -> dict:
+        qg = getattr(snapshot, "quota_generation", None)
+        ag = getattr(snapshot, "admitted_generation", None)
+        if snapshot.tas_flavors:
+            # TAS rows depend on topology snapshots and every workload's
+            # TAS usage: stay exactly as conservative as the legacy key.
+            adm = (qg, getattr(snapshot, "node_generation", None),
+                   getattr(snapshot, "workload_generation", None),
+                   self.fair_sharing, "tas")
+        else:
+            adm = (qg, ag, self.fair_sharing)
+        return {"prio": (qg, ag), "adm": adm}
+
+    def _capture(self, snapshot, heads, resource_flavors, w_pad, preempt,
+                 delay_tas_fn, fair_strategies):
+        arrays, idx = encode_cycle(
+            snapshot, heads, resource_flavors, w_pad=w_pad,
+            fair_sharing=self.fair_sharing, preempt=preempt,
+            delay_tas_fn=delay_tas_fn, fair_strategies=fair_strategies,
+            admitted_cache=self.component_cache,
+            admitted_key=self._component_keys(snapshot),
+            device_put=False,
+        )
+        dev_arrays, dev_groups, dev_adm = jax.device_put(
+            (arrays, idx.group_arrays, idx.admitted_arrays)
+        )
+        # Keep the component cache on-device so later full encodes (and
+        # non-arena callers sharing the cache) pass resident tensors through.
+        keys = self._component_keys(snapshot)
+        self.component_cache["prio"] = (
+            keys["prio"],
+            (dev_arrays.usage_by_prio, dev_arrays.prio_cuts,
+             dev_arrays.prefilter_valid),
+        )
+        if preempt and "adm" in self.component_cache:
+            k, (adm_list, _old, simple, hier, fair_ok, tas_ok) = (
+                self.component_cache["adm"]
+            )
+            self.component_cache["adm"] = (
+                k, (adm_list, dev_adm, simple, hier, fair_ok, tas_ok)
+            )
+        idx.group_arrays = dev_groups
+        idx.admitted_arrays = dev_adm
+        self._committed = False
+        if (preempt and not self.fair_sharing and not snapshot.tas_flavors
+                and not idx.has_partial and idx.n_slots == 1
+                and idx.admitted_arrays is not None):
+            self._capture_state(snapshot, arrays, idx, dev_arrays, dev_adm,
+                                dev_groups)
+        return dev_arrays, idx
+
+    def _capture_state(self, snapshot, arrays, idx, dev_arrays, dev_adm,
+                       dev_groups) -> None:
+        tidx = idx.tree_index
+        self._tidx = tidx
+        self._node_of = dict(tidx.node_of)
+        self._flavor_of = dict(tidx.flavor_of)
+        self._resource_of = dict(tidx.resource_of)
+        self._node_names = [nd.name for nd in tidx.nodes]
+        self._cq_names = list(snapshot.cluster_queues.keys())
+        self._quota_gen = getattr(snapshot, "quota_generation", None)
+        tree = dev_arrays.tree
+        n = int(tree.parent.shape[0])
+        self._n = n
+        self._f = int(tree.nominal.shape[1])
+        self._r = int(tree.nominal.shape[2])
+        self._parent = np.asarray(tree.parent)
+        # Static per-root facts (replicates _encode_admitted's topology
+        # scan; only the unmappable-usage term is dynamic).
+        active = np.asarray(tree.active)
+        has_lend = np.asarray(tree.has_lend_limit).any(axis=(1, 2))
+        is_cq_node = np.zeros(n, dtype=bool)
+        for name in snapshot.cluster_queues:
+            is_cq_node[self._node_of[name]] = True
+        root_of = np.arange(n)
+        for _ in range(MAX_DEPTH):
+            root_of = np.where(
+                self._parent[root_of] >= 0, self._parent[root_of], root_of
+            )
+        self._root_of = root_of
+        static_ok = np.ones(n, dtype=bool)
+        static_fair_ok = np.ones(n, dtype=bool)
+        for node in range(n):
+            if not active[node]:
+                continue
+            rt = root_of[node]
+            if has_lend[node]:
+                static_ok[rt] = False
+                static_fair_ok[rt] = False
+            if node != rt and not is_cq_node[node]:
+                static_ok[rt] = False
+        self._root_static_ok = static_ok
+        self._root_static_fair_ok = static_fair_ok
+        self._cq_node_idx = np.asarray(
+            [self._node_of[name] for name in self._cq_names], dtype=np.int64
+        )
+        # Dynamic admitted state: slab store + per-CQ slot order + per-root
+        # unmappable-usage counters + priority census + uid order.
+        adm = idx.admitted
+        a = int(np.asarray(dev_adm.cq).shape[0])
+        self._a = a
+        store = _AdmittedStore(self._f, self._r)
+        self._store = store
+        self._order: Dict[str, Dict[str, int]] = {}
+        self._root_unmap = np.zeros(n, dtype=np.int64)
+        prio_counter: Counter = Counter()
+        for i, info in enumerate(adm):
+            slot = store.alloc()
+            unmap = store.set_row(
+                slot, self._node_of[info.cluster_queue], info,
+                tuple(info.usage().items()), info.priority(), info.obj.uid,
+                self._flavor_of, self._resource_of,
+            )
+            self._order.setdefault(info.cluster_queue, {})[info.key] = slot
+            self._root_unmap[root_of[self._node_of[info.cluster_queue]]] += \
+                unmap
+            prio_counter[int(info.priority())] += 1
+        self._prio_counter = prio_counter
+        self._uid_sorted = np.array(
+            sorted(info.obj.uid for info in adm), dtype=object
+        )
+        self._admitted_list = list(adm)
+        # Host numpy mirrors of every dynamic tensor family.
+        asnp = lambda x: np.array(np.asarray(x))  # writable host copy
+        self._m_usage = asnp(arrays.usage)
+        self._m_ubp = asnp(arrays.usage_by_prio)
+        self._m_cuts = asnp(arrays.prio_cuts)
+        self._prefilter_valid_b = bool(np.asarray(arrays.prefilter_valid))
+        self._prio_rank = {}
+        if self._prefilter_valid_b:
+            for rank_i, pv in enumerate(sorted(prio_counter)):
+                self._prio_rank[pv] = rank_i
+        self._mw = {
+            "w_cq": asnp(arrays.w_cq),
+            "w_req": asnp(arrays.w_req),
+            "w_elig": asnp(arrays.w_elig),
+            "w_active": asnp(arrays.w_active),
+            "w_priority": asnp(arrays.w_priority),
+            "w_timestamp": asnp(arrays.w_timestamp),
+            "w_quota_reserved": asnp(arrays.w_quota_reserved),
+            "w_start_flavor": asnp(arrays.w_start_flavor),
+            "w_order_rank": asnp(arrays.w_order_rank),
+            "w_has_gates": asnp(arrays.w_has_gates),
+        }
+        self._w = int(self._mw["w_cq"].shape[0])
+        self._ma = {
+            "cq": asnp(dev_adm.cq),
+            "usage": asnp(dev_adm.usage),
+            "prio": asnp(dev_adm.prio),
+            "ts": asnp(dev_adm.ts),
+            "qr_time": asnp(dev_adm.qr_time),
+            "evicted": asnp(dev_adm.evicted),
+            "active": asnp(dev_adm.active),
+            "uid_rank": asnp(dev_adm.uid_rank),
+        }
+        self._m_simple = asnp(arrays.preempt_simple)
+        self._has_hier = arrays.preempt_hier is not None
+        self._m_hier = (
+            asnp(arrays.preempt_hier) if self._has_hier
+            else np.zeros(n, dtype=bool)
+        )
+        self._tas_ok_np = (
+            np.asarray(arrays.preempt_tas_ok)
+            if arrays.preempt_tas_ok is not None else None
+        )
+        self._dev_arrays = dev_arrays
+        self._dev_adm = dev_adm
+        self._dev_groups = dev_groups
+        self._committed = True
+
+    # -- incremental path ---------------------------------------------------
+
+    def _incremental(self, snapshot, heads, resource_flavors, w_pad,
+                     delay_tas_fn, events):
+        n, f, r = self._n, self._f, self._r
+        stats: Dict[str, object] = {"path": "incremental",
+                                    "events": len(events)}
+        # 1. Replay workload events into the admitted state.
+        dirty_nodes: set = set()
+        touched_roots = False
+        adm_dirty = bool(events)
+        for kind, key, cq, items, prio, uid, info in events:
+            cq_i = self._node_of.get(cq)
+            d = self._order.setdefault(cq, {})
+            if cq_i is None:
+                # CQ outside the encoded snapshot: from-scratch encode
+                # skips these rows too; keep only the order bookkeeping
+                # (slot -1) so a later remove pairs up.
+                if kind > 0:
+                    d[key] = -1
+                else:
+                    d.pop(key, None)
+                continue
+            if kind > 0:
+                slot = self._store.alloc()
+                unmap = self._store.set_row(
+                    slot, cq_i, info, items, prio, uid,
+                    self._flavor_of, self._resource_of,
+                )
+                d[key] = slot
+                self._prio_counter[int(prio)] += 1
+                self._uid_insert(uid)
+                sign = 1
+            else:
+                slot = d.pop(key, None)
+                if slot is None or slot < 0:
+                    continue
+                unmap = 0
+                for fr, _v in items:
+                    if (self._flavor_of.get(fr.flavor) is None
+                            or self._resource_of.get(fr.resource) is None):
+                        unmap += 1
+                self._store.release(slot)
+                c = self._prio_counter
+                c[int(prio)] -= 1
+                if c[int(prio)] <= 0:
+                    del c[int(prio)]
+                self._uid_remove(uid)
+                sign = -1
+            if unmap:
+                self._root_unmap[self._root_of[cq_i]] += sign * unmap
+                touched_roots = True
+            if self._prefilter_valid_b:
+                b = self._prio_rank.get(int(prio), _B - 1)
+                for fr, v in items:
+                    fi = self._flavor_of.get(fr.flavor)
+                    ri = self._resource_of.get(fr.resource)
+                    if fi is not None and ri is not None:
+                        self._m_ubp[cq_i, fi, ri, b] += sign * v
+            walk = cq_i
+            while walk >= 0:
+                dirty_nodes.add(int(walk))
+                walk = self._parent[walk]
+        # 2. Priority census must still match the committed buckets.
+        prios = sorted(self._prio_counter)
+        valid = len(prios) <= _B
+        if valid != self._prefilter_valid_b:
+            raise _Fallback("prio-validity")
+        if valid:
+            cuts = np.full(_B, np.iinfo(np.int64).max // 2, dtype=np.int64)
+            cuts[: len(prios)] = prios
+            if not np.array_equal(cuts, self._m_cuts):
+                raise _Fallback("prio-cuts")
+
+        payload_np: List[object] = []
+        apply_plan: List[Tuple] = []
+
+        # 3. Node family: re-read dirty usage rows from the snapshot tree
+        # (the same dicts encode_tree reads).
+        if dirty_nodes:
+            node_idx = np.asarray(sorted(dirty_nodes), dtype=np.int64)
+            rows = np.zeros((len(node_idx), f, r), dtype=np.int64)
+            for j, ni in enumerate(node_idx):
+                name = self._node_names[ni]
+                cqs = snapshot.cluster_queues.get(name)
+                node = cqs.node if cqs is not None else snapshot.cohorts[name]
+                row = rows[j]
+                for fr, v in node.usage.items():
+                    row[self._flavor_of[fr.flavor],
+                        self._resource_of[fr.resource]] = v
+            self._m_usage[node_idx] = rows
+            u_idx, u_rows = _pad_bucket(node_idx, {"usage": rows})
+            apply_plan.append(("node", u_idx, u_rows))
+            if self._prefilter_valid_b:
+                ubp_rows = self._m_ubp[node_idx]
+                p_idx, p_rows = _pad_bucket(
+                    node_idx, {"usage_by_prio": ubp_rows}
+                )
+                apply_plan.append(("prio", p_idx, p_rows))
+        stats["dirty_node"] = len(dirty_nodes)
+
+        # 4. A family: rebuild the flat admitted order + mirrors by gather.
+        a_update = None
+        if adm_dirty:
+            slots_list: List[int] = []
+            for name in self._cq_names:
+                d = self._order.get(name)
+                if d:
+                    slots_list.extend(d.values())
+            cnt = len(slots_list)
+            a_new = max(8, _round_up(cnt, 8))
+            slots_flat = np.asarray(slots_list, dtype=np.int64)
+            st = self._store
+            new_ma = {
+                k: np.zeros((a_new,) + tail, dtype=dt)
+                for k, tail, dt in (
+                    ("cq", (), np.int32), ("usage", (f, r), np.int64),
+                    ("prio", (), np.int64), ("ts", (), np.float64),
+                    ("qr_time", (), np.float64), ("evicted", (), bool),
+                    ("active", (), bool), ("uid_rank", (), np.int32),
+                )
+            }
+            if cnt:
+                new_ma["cq"][:cnt] = st.cq[slots_flat]
+                new_ma["usage"][:cnt] = st.usage[slots_flat]
+                new_ma["prio"][:cnt] = st.prio[slots_flat]
+                new_ma["ts"][:cnt] = st.ts[slots_flat]
+                new_ma["qr_time"][:cnt] = st.qr[slots_flat]
+                new_ma["evicted"][:cnt] = st.evicted[slots_flat]
+                new_ma["active"][:cnt] = True
+                new_ma["uid_rank"][:cnt] = np.searchsorted(
+                    self._uid_sorted, st.uid[slots_flat]
+                ).astype(np.int32)
+            self._admitted_list = (
+                list(st.info[slots_flat]) if cnt else []
+            )
+            if a_new != self._a:
+                self._a = a_new
+                a_update = ("full", new_ma)
+                stats["dirty_admitted"] = cnt
+            else:
+                dirty = np.zeros(a_new, dtype=bool)
+                for k2, v in new_ma.items():
+                    old = self._ma[k2]
+                    neq = v != old
+                    if neq.ndim > 1:
+                        neq = neq.any(axis=tuple(range(1, neq.ndim)))
+                    dirty |= neq
+                didx = np.flatnonzero(dirty)
+                stats["dirty_admitted"] = int(len(didx))
+                if len(didx):
+                    a_update = (
+                        "scatter", didx,
+                        {k2: v[didx] for k2, v in new_ma.items()},
+                    )
+            self._ma = new_ma
+        else:
+            stats["dirty_admitted"] = 0
+        if a_update is not None and a_update[0] == "scatter":
+            a_idx, a_rows = _pad_bucket(a_update[1], a_update[2])
+            apply_plan.append(("adm", a_idx, a_rows))
+
+        # 5. Flag family (preempt_simple / preempt_hier).
+        flags_put = None
+        if touched_roots:
+            ok_dyn = self._root_static_ok & (self._root_unmap == 0)
+            fair_dyn = self._root_static_fair_ok & (self._root_unmap == 0)
+            simple = np.zeros(n, dtype=bool)
+            hier = np.zeros(n, dtype=bool)
+            cq_i = self._cq_node_idx
+            simple[cq_i] = ok_dyn[self._root_of[cq_i]]
+            hier[cq_i] = fair_dyn[self._root_of[cq_i]] & ~ok_dyn[
+                self._root_of[cq_i]
+            ]
+            if bool(hier.any()) != self._has_hier:
+                raise _Fallback("hier-toggle")
+            if (not np.array_equal(simple, self._m_simple)
+                    or not np.array_equal(hier, self._m_hier)):
+                flags_put = (simple, hier)
+                self._m_simple = simple
+                self._m_hier = hier
+
+        # 6. W family: per-head rows (inherently O(heads)), diffed.
+        device_wls, fallbacks, new_mw = self._build_w(
+            snapshot, heads, resource_flavors, w_pad
+        )
+        stats["rows_recomputed"] = len(device_wls)
+        w_new = int(new_mw["w_cq"].shape[0])
+        w_update = None
+        if w_new != self._w:
+            self._w = w_new
+            w_update = ("full", new_mw)
+            stats["dirty_workload"] = len(device_wls)
+        else:
+            dirty = np.zeros(w_new, dtype=bool)
+            for k2, v in new_mw.items():
+                old = self._mw[k2]
+                neq = v != old
+                if neq.ndim > 1:
+                    neq = neq.any(axis=tuple(range(1, neq.ndim)))
+                dirty |= neq
+            didx = np.flatnonzero(dirty)
+            stats["dirty_workload"] = int(len(didx))
+            if len(didx):
+                w_update = (
+                    "scatter", *_pad_bucket(
+                        didx, {k2: v[didx] for k2, v in new_mw.items()}
+                    ),
+                )
+        self._mw = new_mw
+        if w_update is not None and w_update[0] == "scatter":
+            apply_plan.append(("wl", w_update[1], w_update[2]))
+
+        # 7. ONE batched transfer of the whole delta payload, then one
+        # jitted scatter per dirty family; resized families re-put whole.
+        plan_fams = [fam for fam, _, _ in apply_plan]
+        puts = {"plan": [(idx_, rows) for _, idx_, rows in apply_plan]}
+        if a_update is not None and a_update[0] == "full":
+            puts["a_full"] = a_update[1]
+        if w_update is not None and w_update[0] == "full":
+            puts["w_full"] = w_update[1]
+        if flags_put is not None:
+            puts["flags"] = flags_put
+        if len(puts) > 1 or puts["plan"]:
+            puts = jax.device_put(puts)
+
+        dev = self._dev_arrays
+        dev_adm = self._dev_adm
+        fam_cols = {
+            "node": {"usage": dev.usage},
+            "prio": {"usage_by_prio": dev.usage_by_prio},
+            "adm": {
+                "cq": dev_adm.cq, "usage": dev_adm.usage,
+                "prio": dev_adm.prio, "ts": dev_adm.ts,
+                "qr_time": dev_adm.qr_time, "evicted": dev_adm.evicted,
+                "active": dev_adm.active, "uid_rank": dev_adm.uid_rank,
+            },
+            "wl": {
+                "w_cq": dev.w_cq, "w_req": dev.w_req,
+                "w_elig": dev.w_elig, "w_active": dev.w_active,
+                "w_priority": dev.w_priority,
+                "w_timestamp": dev.w_timestamp,
+                "w_quota_reserved": dev.w_quota_reserved,
+                "w_start_flavor": dev.w_start_flavor,
+                "w_order_rank": dev.w_order_rank,
+                "w_has_gates": dev.w_has_gates,
+            },
+        }
+        updated: Dict[str, Dict[str, jnp.ndarray]] = {}
+        for fam, (idx_, rows) in zip(plan_fams, puts["plan"]):
+            updated[fam] = _scatter_rows(fam_cols[fam], idx_, rows)
+        if "a_full" in puts:
+            updated["adm"] = puts["a_full"]
+        if "w_full" in puts:
+            updated["wl"] = puts["w_full"]
+
+        repl: Dict[str, object] = {}
+        if "node" in updated:
+            repl["usage"] = updated["node"]["usage"]
+        if "prio" in updated:
+            repl["usage_by_prio"] = updated["prio"]["usage_by_prio"]
+        if "wl" in updated:
+            wl = updated["wl"]
+            repl.update(
+                w_cq=wl["w_cq"], w_req=wl["w_req"], w_elig=wl["w_elig"],
+                w_active=wl["w_active"], w_priority=wl["w_priority"],
+                w_timestamp=wl["w_timestamp"],
+                w_quota_reserved=wl["w_quota_reserved"],
+                w_start_flavor=wl["w_start_flavor"],
+                w_order_rank=wl["w_order_rank"],
+                w_has_gates=wl["w_has_gates"],
+            )
+        if "flags" in puts:
+            repl["preempt_simple"] = puts["flags"][0]
+            if self._has_hier:
+                repl["preempt_hier"] = puts["flags"][1]
+        if "adm" in updated:
+            ad = updated["adm"]
+            from kueue_tpu.models.preempt_kernel import AdmittedArrays
+
+            dev_adm = AdmittedArrays(
+                cq=ad["cq"], usage=ad["usage"], prio=ad["prio"],
+                ts=ad["ts"], qr_time=ad["qr_time"], evicted=ad["evicted"],
+                active=ad["active"], uid_rank=ad["uid_rank"],
+                tas_t=None, tas_usage=None,
+            )
+            self._dev_adm = dev_adm
+        arrays = dev._replace(**repl) if repl else dev
+        self._dev_arrays = arrays
+
+        idx = CycleIndex(
+            tree_index=self._tidx,
+            resources=list(self._tidx.resources),
+            flavors=list(self._tidx.flavors),
+        )
+        idx.workloads = device_wls
+        idx.host_fallback = fallbacks
+        idx.delayed_tas = [False] * len(device_wls)
+        idx.group_arrays = self._dev_groups
+        idx.admitted = list(self._admitted_list)
+        idx.admitted_arrays = self._dev_adm
+        self.last_stats = stats
+        # Refresh the component cache so a later full encode with the same
+        # admitted state reuses the arena-updated tensors.
+        keys = self._component_keys(snapshot)
+        self.component_cache["prio"] = (
+            keys["prio"],
+            (arrays.usage_by_prio, arrays.prio_cuts, arrays.prefilter_valid),
+        )
+        self.component_cache["adm"] = (
+            keys["adm"],
+            (list(self._admitted_list), self._dev_adm,
+             np.array(self._m_simple), np.array(self._m_hier), None,
+             self._tas_ok_np),
+        )
+        return arrays, idx
+
+    # -- uid order maintenance ---------------------------------------------
+
+    def _uid_insert(self, uid) -> None:
+        pos = int(np.searchsorted(self._uid_sorted, uid))
+        self._uid_sorted = np.insert(self._uid_sorted, pos, uid)
+
+    def _uid_remove(self, uid) -> None:
+        pos = int(np.searchsorted(self._uid_sorted, uid))
+        if pos < len(self._uid_sorted) and self._uid_sorted[pos] == uid:
+            self._uid_sorted = np.delete(self._uid_sorted, pos)
+
+    # -- W family (replicates the encode_cycle head loop, dense case) -------
+
+    def _build_w(self, snapshot, heads, resource_flavors, w_pad):
+        from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
+
+        f, r = self._f, self._r
+        device_wls: List[WorkloadInfo] = []
+        wl_slots: List[list] = []
+        fallbacks: List[WorkloadInfo] = []
+        for info in heads:
+            slots = (
+                _workload_slots(
+                    info, snapshot.cluster_queues[info.cluster_queue]
+                )
+                if info.cluster_queue in snapshot.cluster_queues else None
+            )
+            if _device_compatible(info, snapshot, slots, frozenset(), False,
+                                  True, False):
+                device_wls.append(info)
+                wl_slots.append(slots)
+            else:
+                fallbacks.append(info)
+        if any(len(sl) > 1 or sl[0].rg_idx != 0 for sl in wl_slots):
+            raise _Fallback("slots")
+        if w_pad == 0:
+            w = max(16, 1 << max(len(device_wls) - 1, 0).bit_length())
+        else:
+            w = w_pad
+        mw = {
+            "w_cq": np.zeros(w, dtype=np.int32),
+            "w_req": np.zeros((w, r), dtype=np.int64),
+            "w_elig": np.zeros((w, f), dtype=bool),
+            "w_active": np.zeros(w, dtype=bool),
+            "w_priority": np.zeros(w, dtype=np.int64),
+            "w_timestamp": np.zeros(w, dtype=np.float64),
+            "w_quota_reserved": np.zeros(w, dtype=bool),
+            "w_start_flavor": np.zeros(w, dtype=np.int32),
+            "w_has_gates": np.zeros(w, dtype=bool),
+        }
+        for i, info in enumerate(device_wls):
+            slots = wl_slots[i]
+            cqs = snapshot.cluster_queues[info.cluster_queue]
+            ps0 = info.obj.pod_sets[0]
+            if ps0.min_count is not None and ps0.min_count < ps0.count:
+                from kueue_tpu.utils import features as _feat
+
+                if _feat.enabled("PartialAdmission"):
+                    raise _Fallback("partial")
+            mw["w_cq"][i] = self._node_of[info.cluster_queue]
+            mw["w_active"][i] = True
+            mw["w_priority"][i] = info.priority()
+            mw["w_timestamp"][i] = queue_order_timestamp(info.obj)
+            mw["w_quota_reserved"][i] = has_quota_reservation(info.obj)
+            mw["w_has_gates"][i] = bool(info.obj.preemption_gates)
+            for res, v in slots[0].requests.items():
+                if res in self._resource_of:
+                    mw["w_req"][i, self._resource_of[res]] = v
+            gen = cqs.allocatable_generation
+            cached = getattr(info, "_elig_cache", None)
+            if cached is not None and cached[0] == gen \
+                    and cached[1].shape == (len(slots), f):
+                erows = cached[1]
+            else:
+                assigner = FlavorAssigner(info, cqs, resource_flavors)
+                erows = np.zeros((len(slots), f), dtype=bool)
+                for si, sl in enumerate(slots):
+                    pod_sets = [info.obj.pod_sets[j] for j in sl.ps_ids]
+                    for fname, fi in self._flavor_of.items():
+                        ok, _ = assigner._check_flavor_for_podsets(
+                            fname, pod_sets
+                        )
+                        erows[si, fi] = ok
+                info._elig_cache = (gen, erows)
+            allowed = info.obj.labels.get(
+                "kueue.x-k8s.io/allowed-resource-flavor"
+            )
+            if allowed is not None:
+                amask = np.zeros(f, dtype=bool)
+                ai = self._flavor_of.get(allowed)
+                if ai is not None:
+                    amask[ai] = True
+                erows = erows & amask[None, :]
+            mw["w_elig"][i] = erows[0]
+            resume = info.last_assignment is not None and (
+                cqs.allocatable_generation
+                <= info.last_assignment.cluster_queue_generation
+            )
+            if resume:
+                mw["w_start_flavor"][i] = (
+                    info.last_assignment.next_flavor_to_try(
+                        slots[0].ps_ids[0], slots[0].trigger_res
+                    )
+                )
+        mw["w_order_rank"] = _order_rank(
+            mw["w_priority"], mw["w_timestamp"]
+        )
+        return device_wls, fallbacks, mw
+
+    # -- differential verification ------------------------------------------
+
+    def _verify(self, out, snapshot, heads, resource_flavors, w_pad,
+                preempt, delay_tas_fn, fair_strategies) -> None:
+        arrays, idx = out
+        ref_arrays, ref_idx = encode_cycle(
+            snapshot, heads, resource_flavors, w_pad=w_pad,
+            fair_sharing=self.fair_sharing, preempt=preempt,
+            delay_tas_fn=delay_tas_fn, fair_strategies=fair_strategies,
+            device_put=False,
+        )
+        assert_cycle_equal(arrays, idx, ref_arrays, ref_idx)
+
+
+def _field_equal(name: str, a, b) -> None:
+    if a is None or b is None:
+        assert a is None and b is None, (
+            f"{name}: presence differs (incremental "
+            f"{'set' if a is not None else 'None'}, reference "
+            f"{'set' if b is not None else 'None'})"
+        )
+        return
+    an, bn = np.asarray(a), np.asarray(b)
+    assert an.dtype == bn.dtype, f"{name}: dtype {an.dtype} != {bn.dtype}"
+    assert an.shape == bn.shape, f"{name}: shape {an.shape} != {bn.shape}"
+    assert np.array_equal(an, bn), (
+        f"{name}: values differ at rows "
+        f"{np.argwhere((an != bn).reshape(an.shape[0], -1).any(axis=-1) if an.ndim else an != bn)[:8].tolist()}"
+    )
+
+
+def assert_cycle_equal(arrays: CycleArrays, idx: CycleIndex,
+                       ref_arrays: CycleArrays, ref_idx: CycleIndex) -> None:
+    """Assert the arena-built cycle is bit-identical to from-scratch."""
+    for fname in type(ref_arrays.tree)._fields:
+        _field_equal(
+            "tree." + fname,
+            getattr(arrays.tree, fname), getattr(ref_arrays.tree, fname),
+        )
+    for fname in CycleArrays._fields:
+        if fname == "tree":
+            continue
+        a = getattr(arrays, fname)
+        b = getattr(ref_arrays, fname)
+        if fname == "tas_topo":
+            continue
+        _field_equal(fname, a, b)
+    aa, bb = idx.admitted_arrays, ref_idx.admitted_arrays
+    assert (aa is None) == (bb is None), "admitted_arrays presence differs"
+    if aa is not None:
+        for fname in aa._fields:
+            _field_equal(
+                "admitted." + fname, getattr(aa, fname), getattr(bb, fname)
+            )
+    assert [i.key for i in idx.workloads] == \
+        [i.key for i in ref_idx.workloads], "device workload order differs"
+    assert [i.key for i in idx.host_fallback] == \
+        [i.key for i in ref_idx.host_fallback], "host fallback differs"
+    assert [i.key for i in idx.admitted] == \
+        [i.key for i in ref_idx.admitted], "admitted row order differs"
+    assert idx.delayed_tas == ref_idx.delayed_tas, "delayed flags differ"
+    assert idx.has_partial == ref_idx.has_partial
+    assert idx.n_slots == ref_idx.n_slots
+    assert idx.fair_s_bound == ref_idx.fair_s_bound
+    assert idx.flavors == ref_idx.flavors
+    assert idx.resources == ref_idx.resources

@@ -29,6 +29,7 @@ from kueue_tpu.core.workload_info import (
 )
 from kueue_tpu.metrics import tracing
 from kueue_tpu.models import batch_scheduler
+from kueue_tpu.models.arena import CycleArena
 from kueue_tpu.models.encode import encode_cycle
 from kueue_tpu.queue.manager import QueueManager
 from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
@@ -37,12 +38,18 @@ from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
 class DeviceScheduler:
     """Hybrid device/host scheduler."""
 
+    # Cycles the head count must fit the next-smaller padding bucket
+    # before the W axis actually shrinks (see _pick_bucket).
+    _SHRINK_PATIENCE = 4
+
     def __init__(
         self,
         cache: Cache,
         queues: QueueManager,
         fair_sharing: bool = False,
         clock: Callable[[], float] = time.monotonic,
+        use_arena: bool = True,
+        verify_arena: bool = False,
     ) -> None:
         self.cache = cache
         self.queues = queues
@@ -55,9 +62,22 @@ class DeviceScheduler:
         self.device_time_s = 0.0
         self.cycles = 0
         self.use_fixedpoint = False
-        # Incremental encode: admitted-state tensors reused across cycles
-        # while the (spec, workload) generations are unchanged.
-        self._adm_cache: Dict = {}
+        # Incremental cycle encoding: device-resident snapshot arena with
+        # row-level delta updates (models/arena.py). verify_arena re-encodes
+        # from scratch every incremental cycle and asserts bit-identity.
+        self._arena = (
+            CycleArena(cache, fair_sharing=fair_sharing, verify=verify_arena)
+            if use_arena else None
+        )
+        # Incremental encode component cache (shared with the arena when
+        # enabled): admitted-state tensors reused across cycles while the
+        # relevant generations are unchanged.
+        self._adm_cache: Dict = (
+            self._arena.component_cache if self._arena is not None else {}
+        )
+        # Padding-bucket hysteresis state.
+        self._w_bucket = 16
+        self._shrink_streak = 0
 
     # ------------------------------------------------------------------
 
@@ -71,32 +91,62 @@ class DeviceScheduler:
             result.duration_s = self.clock() - start
             return result
 
-        snapshot = self.cache.snapshot()
-        # Pad the workload axis to a power-of-two bucket so every cycle hits
-        # the same compiled program (avoids per-shape recompilation).
-        bucket = 16
-        while bucket < len(heads):
-            bucket *= 2
+        if self._arena is not None:
+            # Snapshot + event drain under one cache lock hold.
+            snapshot = self._arena.take_snapshot()
+        else:
+            snapshot = self.cache.snapshot()
+        bucket = self._pick_bucket(len(heads))
         if tracing.ENABLED:
+            # Report the bucket actually used (hysteresis holds included)
+            # so padding waste stays honest on the shrink path.
             tracing.set_gauge("solver_batch_size", bucket)
             tracing.set_gauge(
                 "solver_padding_waste_pct",
                 100.0 * (bucket - len(heads)) / bucket,
             )
-        arrays, idx = encode_cycle(
-            snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
-            fair_sharing=self.fair_sharing, preempt=True,
-            delay_tas_fn=lambda cqs, info: self.host._delay_tas(cqs, info)
-            or self.host._has_multikueue_check(cqs),
-            fair_strategies=self.host.preemptor.fair_strategies,
-            admitted_cache=self._adm_cache,
-            admitted_key=(
-                self.cache.generation, self.cache.workload_generation,
-                self.fair_sharing,
-            ),
+        delay_fn = (
+            lambda cqs, info: self.host._delay_tas(cqs, info)
+            or self.host._has_multikueue_check(cqs)
         )
+        if self._arena is not None:
+            arrays, idx = self._arena.encode(
+                snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
+                preempt=True, delay_tas_fn=delay_fn,
+                fair_strategies=self.host.preemptor.fair_strategies,
+            )
+        else:
+            arrays, idx = encode_cycle(
+                snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
+                fair_sharing=self.fair_sharing, preempt=True,
+                delay_tas_fn=delay_fn,
+                fair_strategies=self.host.preemptor.fair_strategies,
+                admitted_cache=self._adm_cache,
+                admitted_key=(
+                    self.cache.generation, self.cache.workload_generation,
+                    self.fair_sharing,
+                ),
+            )
 
-        host_entries: List[WorkloadInfo] = list(idx.host_fallback)
+        # Trees with an encode-fallback entry route through the host
+        # wholesale (device rows included, see the discard comment below),
+        # and that routing does not depend on device outcomes — so they can
+        # be host-processed while the device solve runs, in the window
+        # before the first blocking readback. Trees are quota-independent,
+        # so their host admissions cannot change other trees' device
+        # results.
+        def _root_id(cq_name: str):
+            cqs = snapshot.cluster_queues.get(cq_name)
+            return id(cqs.node.root()) if cqs is not None else None
+
+        pre_roots = set()
+        for info in idx.host_fallback:
+            pre_roots.add(_root_id(info.cluster_queue))
+        pre_roots.discard(None)
+
+        host_entries: List[WorkloadInfo] = []
+        if not idx.workloads:
+            host_entries = list(idx.host_fallback)
 
         if idx.workloads:
             t0 = self.clock()
@@ -128,7 +178,26 @@ class DeviceScheduler:
                     out = batch_scheduler.cycle_grouped_preempt(
                         arrays, idx.group_arrays, idx.admitted_arrays
                     )
-            outcome = np.asarray(out.outcome)
+            # Overlap window: the kernel call above only dispatched — run
+            # the pre-discarded trees' host work before the first blocking
+            # read so it executes while the device solves.
+            host_dt = 0.0
+            pre_entries = list(idx.host_fallback)
+            if pre_roots:
+                pre_entries.extend(
+                    info for info in idx.workloads
+                    if self._in_discarded(info, snapshot, pre_roots)
+                )
+            if pre_entries:
+                th0 = self.clock()
+                pre_result = self._host_process(pre_entries)
+                result.admitted.extend(pre_result.admitted)
+                result.preempted.extend(pre_result.preempted)
+                result.preempting.extend(pre_result.preempting)
+                result.skipped.extend(pre_result.skipped)
+                result.inadmissible.extend(pre_result.inadmissible)
+                host_dt = self.clock() - th0
+            outcome = np.asarray(out.outcome)  # first blocking read
             chosen = np.asarray(out.chosen_flavor)
             tried = np.asarray(out.tried_flavor_idx)
             s_flavor = (
@@ -143,22 +212,37 @@ class DeviceScheduler:
                 np.asarray(out.s_tried)
                 if out.s_tried is not None else None
             )
+            # Secondary planes are only copied off device when some row
+            # outcome actually consumes them (the victim matrix is the
+            # largest readback of the cycle).
+            any_admit = bool(
+                (outcome == batch_scheduler.OUT_ADMITTED).any()
+            )
+            any_preempt = bool(
+                (outcome == batch_scheduler.OUT_PREEMPTING).any()
+            )
             partial = (
                 np.asarray(out.partial_count)
-                if out.partial_count is not None else None
+                if out.partial_count is not None and any_admit else None
             )
             victims = (
-                np.asarray(out.victims) if out.victims is not None else None
+                np.asarray(out.victims)
+                if out.victims is not None and any_preempt else None
             )
             variants = (
                 np.asarray(out.victim_variant)
-                if out.victim_variant is not None else None
+                if out.victim_variant is not None and any_preempt else None
             )
             dt = self.clock() - t0
             self.device_time_s += dt
             if tracing.ENABLED:
                 tracing.observe("solver_device_seconds", dt,
                                 {"kernel": "batch_cycle"})
+                tracing.observe("solver_overlap_host_seconds", host_dt)
+                tracing.set_gauge(
+                    "solver_overlap_occupancy_pct",
+                    100.0 * min(host_dt, dt) / dt if dt > 0 else 0.0,
+                )
 
             # Admitted TAS entries: the placement kernel emits its own
             # per-leaf takes (CycleOutputs.tas_takes), so domains decode
@@ -180,14 +264,10 @@ class DeviceScheduler:
             # (host-exact within the tree; trees are quota-independent,
             # so other trees' device outcomes stay valid). Cycles with
             # zero fallbacks — the production configs — discard nothing.
-            discarded_roots = set()
-
-            def _root_id(cq_name: str):
-                cqs = snapshot.cluster_queues.get(cq_name)
-                return id(cqs.node.root()) if cqs is not None else None
-
-            for info in idx.host_fallback:
-                discarded_roots.add(_root_id(info.cluster_queue))
+            # Fallback trees (pre_roots) were already host-processed in
+            # the overlap window; OUT_NEEDS_HOST rows discovered on
+            # readback discard their tree into the post-readback batch.
+            discarded_roots = set(pre_roots)
             for i, info in enumerate(idx.workloads):
                 if outcome[i] == batch_scheduler.OUT_NEEDS_HOST:
                     discarded_roots.add(_root_id(info.cluster_queue))
@@ -197,6 +277,9 @@ class DeviceScheduler:
                 oc = outcome[i]
                 slots_i = idx.slots[i] if idx.slots else None
                 multi = slots_i is not None and len(slots_i) > 1
+                if pre_roots and \
+                        self._in_discarded(info, snapshot, pre_roots):
+                    continue  # handled in the overlap window
                 if discarded_roots and \
                         self._in_discarded(info, snapshot, discarded_roots):
                     host_entries.append(info)
@@ -291,6 +374,25 @@ class DeviceScheduler:
         return cycles
 
     # ------------------------------------------------------------------
+
+    def _pick_bucket(self, n_heads: int) -> int:
+        """Power-of-two W padding bucket with shrink hysteresis. Growth is
+        immediate (the cycle must fit); shrinking one halving step requires
+        the head count to fit the next-smaller bucket for _SHRINK_PATIENCE
+        consecutive cycles — a count oscillating across a bucket boundary
+        would otherwise recompile the cycle program every cycle."""
+        need = 16
+        while need < n_heads:
+            need *= 2
+        if need >= self._w_bucket:
+            self._w_bucket = max(self._w_bucket, need)
+            self._shrink_streak = 0
+        else:
+            self._shrink_streak += 1
+            if self._shrink_streak >= self._SHRINK_PATIENCE:
+                self._w_bucket //= 2
+                self._shrink_streak = 0
+        return self._w_bucket
 
     @staticmethod
     def _in_discarded(info, snapshot, discarded_roots) -> bool:

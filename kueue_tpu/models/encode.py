@@ -222,6 +222,7 @@ def encode_cycle(
     fair_strategies: Optional[Sequence[str]] = None,
     admitted_cache: Optional[dict] = None,
     admitted_key=None,
+    device_put: bool = True,
 ) -> Tuple[CycleArrays, CycleIndex]:
     """Build CycleArrays from the host snapshot + pending heads.
 
@@ -237,7 +238,17 @@ def encode_cycle(
     the previous cycle's, the cached (already on-device) tensors are
     reused — O(admitted) python work and the host->device transfer both
     drop out of the steady-state cycle (the reference cache is
-    incremental by construction, cache.go:775)."""
+    incremental by construction, cache.go:775).
+
+    The cache is keyed per component: ``admitted_key`` may be a dict
+    ``{"prio": key, "adm": key}`` so the priority buckets and the
+    admitted-candidate arrays invalidate independently (the arena passes
+    fine-grained cache generations); a plain hashable keys both
+    components together (legacy callers). Entries are stored as
+    ``admitted_cache[component] = (key, tensors)``.
+
+    ``device_put=False`` returns host-side arrays and skips the batched
+    transfer — the arena handles residency itself."""
     tree, tidx, usage, is_cq = encode_tree(snapshot.roots)
     n = tree.n_nodes
     f = tree.nominal.shape[1]
@@ -328,15 +339,26 @@ def encode_cycle(
         bwc_has_threshold[ni] = thr is not None
         bwc_threshold[ni] = thr if thr is not None else 0
 
-    adm_cached = (
-        admitted_cache.get(admitted_key)
-        if admitted_cache is not None and admitted_key is not None
-        else None
-    )
+    if admitted_cache is not None and admitted_key is not None:
+        comp_keys = (
+            admitted_key if isinstance(admitted_key, dict)
+            else {"prio": admitted_key, "adm": admitted_key}
+        )
+    else:
+        comp_keys = None
+
+    def _component_cached(component: str):
+        if comp_keys is None:
+            return None
+        entry = admitted_cache.get(component)
+        if entry is not None and entry[0] == comp_keys[component]:
+            return entry[1]
+        return None
 
     # Admitted usage bucketed by priority rank (preemption prefilter).
-    if adm_cached is not None:
-        usage_by_prio, prio_cuts, prefilter_valid = adm_cached["prio"]
+    prio_cached = _component_cached("prio")
+    if prio_cached is not None:
+        usage_by_prio, prio_cuts, prefilter_valid = prio_cached
     else:
         B = 8
         admitted_prios = sorted({
@@ -655,9 +677,10 @@ def encode_cycle(
                 np.asarray(tree.parent),
             )
             preempt_fields.update(tas_fields)
-        if adm_cached is not None and "adm" in adm_cached:
+        adm_comp = _component_cached("adm")
+        if adm_comp is not None:
             (adm_list, adm_arrays, preempt_simple, preempt_hier,
-             fair_node_ok, preempt_tas_ok) = adm_cached["adm"]
+             fair_node_ok, preempt_tas_ok) = adm_comp
             idx.admitted = list(adm_list)
             idx.admitted_arrays = adm_arrays
         else:
@@ -747,23 +770,21 @@ def encode_cycle(
     # remote device transport (axon tunnel: 20-65 ms per dispatch),
     # per-field jnp.asarray costs a round trip each — ~50 fields made the
     # encode transfer-bound (2.2 s at the 15k-workload baseline).
-    arrays, idx.group_arrays, idx.admitted_arrays = jax.device_put(
-        (arrays, idx.group_arrays, idx.admitted_arrays)
-    )
-    if admitted_cache is not None and admitted_key is not None:
-        entry = {
-            "prio": (
-                arrays.usage_by_prio, arrays.prio_cuts,
-                arrays.prefilter_valid,
-            )
-        }
+    if device_put:
+        arrays, idx.group_arrays, idx.admitted_arrays = jax.device_put(
+            (arrays, idx.group_arrays, idx.admitted_arrays)
+        )
+    if comp_keys is not None:
+        admitted_cache["prio"] = (
+            comp_keys["prio"],
+            (arrays.usage_by_prio, arrays.prio_cuts, arrays.prefilter_valid),
+        )
         if preempt:
-            entry["adm"] = (
-                list(idx.admitted), idx.admitted_arrays, preempt_simple,
-                preempt_hier, fair_node_ok, preempt_tas_ok,
+            admitted_cache["adm"] = (
+                comp_keys["adm"],
+                (list(idx.admitted), idx.admitted_arrays, preempt_simple,
+                 preempt_hier, fair_node_ok, preempt_tas_ok),
             )
-        admitted_cache.clear()
-        admitted_cache[admitted_key] = entry
     return arrays, idx
 
 

@@ -1,0 +1,226 @@
+"""Differential tests for the CycleArena incremental encoder.
+
+Randomized mutation sequences (admit / preempt-inducing priority mixes /
+requeue / CQ quota update / flavor change) drive DeviceScheduler with
+``verify_arena=True``: every incremental cycle re-encodes from scratch
+and asserts the arena-built arrays are bit-identical (assert_cycle_equal
+inside models/arena.py). The same sequences run arena-on vs arena-off
+and must produce identical per-cycle admission outcomes. Also pins the
+padding-bucket hysteresis and the Cache generation split.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    ResourceFlavor,
+    ResourceQuota,
+)
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.tas.snapshot import Node
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+PREEMPT = ClusterQueuePreemption(
+    reclaim_within_cohort=PreemptionPolicy.ANY,
+    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+)
+
+
+def _build(quota_a: int = 4000):
+    cohorts = [Cohort(name="co0")]
+    cqs = [
+        make_cq(
+            "cq-a", cohort="co0",
+            flavors={"default": {"cpu": ResourceQuota(
+                nominal=quota_a, borrowing_limit=8000)}},
+            preemption=PREEMPT,
+        ),
+        make_cq(
+            "cq-b", cohort="co0",
+            flavors={"default": {"cpu": ResourceQuota(nominal=4000)}},
+            preemption=PREEMPT,
+        ),
+        make_cq(
+            "cq-c",
+            flavors={"default": {"cpu": ResourceQuota(nominal=3000)}},
+            preemption=PREEMPT,
+        ),
+    ]
+    cache, queues, _ = build_env(cqs, cohorts=cohorts)
+    return cache, queues
+
+
+def _drive(seed: int, use_arena: bool, verify: bool = False):
+    """Run one randomized mutation sequence; return per-cycle outcome
+    fingerprints (admitted keys, preempted keys, cache contents) plus the
+    arena path taken per cycle (empty when arena is off)."""
+    rng = random.Random(seed)
+    cache, queues = _build()
+    sched = DeviceScheduler(
+        cache, queues, use_arena=use_arena, verify_arena=verify
+    )
+    t = 1000.0
+    wl_n = 0
+    fingerprints = []
+    paths = []
+    for step in range(14):
+        op = rng.choice(
+            ["admit", "admit", "admit", "requeue", "cq", "flavor"]
+        )
+        if op == "admit":
+            for _ in range(rng.randint(1, 3)):
+                wl_n += 1
+                t += 1.0
+                submit(queues, make_wl(
+                    f"wl-{wl_n}",
+                    queue=rng.choice(["lq-cq-a", "lq-cq-b", "lq-cq-c"]),
+                    cpu_m=rng.choice([500, 1000, 1500, 2500]),
+                    priority=rng.choice([0, 100]),
+                    creation_time=t,
+                ))
+        elif op == "requeue":
+            admitted = sorted(cache.workloads)
+            if admitted:
+                cache.delete_workload(rng.choice(admitted))
+                queues.queue_inadmissible_workloads()
+        elif op == "cq":
+            quota = rng.choice([4000, 5000, 6000])
+            cache.add_or_update_cluster_queue(make_cq(
+                "cq-a", cohort="co0",
+                flavors={"default": {"cpu": ResourceQuota(
+                    nominal=quota, borrowing_limit=8000)}},
+                preemption=PREEMPT,
+            ))
+            queues.queue_inadmissible_workloads()
+        else:  # flavor change
+            cache.add_or_update_resource_flavor(ResourceFlavor(
+                name="default", node_labels={"gen": str(step)}
+            ))
+            queues.queue_inadmissible_workloads()
+        result = sched.schedule()
+        fingerprints.append((
+            sorted(map(str, result.admitted)),
+            sorted(map(str, result.preempted)),
+            sorted(map(str, cache.workloads)),
+        ))
+        if use_arena and sched._arena is not None:
+            paths.append(sched._arena.last_stats.get("path"))
+    return fingerprints, paths
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_mutations_bitwise_and_outcomes(seed):
+    """verify_arena asserts bit-identical arrays inside every incremental
+    cycle; on top of that, arena-on and arena-off runs of the same
+    sequence must produce identical per-cycle outcomes."""
+    with_arena, _ = _drive(seed, use_arena=True, verify=True)
+    without, _ = _drive(seed, use_arena=False)
+    assert with_arena == without
+
+
+def test_incremental_path_taken_and_verified():
+    """A steady admit stream must actually exercise the incremental path
+    (not fall back to full every cycle), with verification on."""
+    cache, queues = _build()
+    sched = DeviceScheduler(cache, queues, verify_arena=True)
+    # Warm-up: first cycles introduce priorities/buckets -> full encode.
+    submit(queues, make_wl("w1", queue="lq-cq-a", cpu_m=500,
+                           creation_time=1.0))
+    submit(queues, make_wl("w2", queue="lq-cq-b", cpu_m=500,
+                           creation_time=2.0))
+    sched.schedule()
+    paths = []
+    for i in range(3, 7):
+        submit(queues, make_wl(f"w{i}", queue="lq-cq-a", cpu_m=500,
+                               creation_time=float(i)))
+        sched.schedule()
+        paths.append(sched._arena.last_stats.get("path"))
+    assert "incremental" in paths, paths
+    # The warm incremental cycle touches O(events + heads) rows.
+    last = sched._arena.last_stats
+    if last.get("path") == "incremental":
+        assert last["rows_recomputed"] <= 4
+
+
+def test_pick_bucket_hysteresis():
+    """Grow immediately; shrink one halving step only after the head
+    count fits the smaller bucket for 4 consecutive cycles."""
+    cache, queues = _build()
+    sched = DeviceScheduler(cache, queues)
+    assert sched._pick_bucket(10) == 16
+    assert sched._pick_bucket(20) == 32  # immediate growth
+    assert sched._pick_bucket(10) == 32  # hold 1
+    assert sched._pick_bucket(10) == 32  # hold 2
+    assert sched._pick_bucket(10) == 32  # hold 3
+    assert sched._pick_bucket(10) == 16  # 4th fit -> shrink one step
+    assert sched._pick_bucket(20) == 32  # oscillation grows again
+    assert sched._pick_bucket(10) == 32  # ... and does not thrash back
+    # A deep drop shrinks one halving step per patience window, not all
+    # the way down at once.
+    sched2 = DeviceScheduler(cache, queues)
+    assert sched2._pick_bucket(100) == 128
+    for _ in range(3):
+        assert sched2._pick_bucket(5) == 128
+    assert sched2._pick_bucket(5) == 64
+
+
+def test_generation_split():
+    """Node/topology changes bump node_generation only; CQ changes bump
+    quota_generation only; workload mutations bump admitted_generation."""
+    cache, queues = _build()
+    qg = cache.quota_generation
+    ng = cache.node_generation
+    ag = cache.admitted_generation
+
+    cache.add_or_update_node(Node(name="n0", capacity={"cpu": 8000}))
+    assert cache.node_generation > ng
+    assert cache.quota_generation == qg
+    assert cache.admitted_generation == ag
+
+    ng = cache.node_generation
+    cache.add_or_update_cluster_queue(make_cq(
+        "cq-c",
+        flavors={"default": {"cpu": ResourceQuota(nominal=9000)}},
+        preemption=PREEMPT,
+    ))
+    assert cache.quota_generation > qg
+    assert cache.node_generation == ng
+
+    qg = cache.quota_generation
+    sched = DeviceScheduler(cache, queues)
+    submit(queues, make_wl("w1", queue="lq-cq-c", cpu_m=500,
+                           creation_time=1.0))
+    sched.schedule()
+    assert cache.admitted_generation > ag
+    assert cache.quota_generation == qg
+    assert cache.node_generation == ng
+
+
+def test_node_change_does_not_invalidate_admitted_components():
+    """The split satellite: a node-only change must not clear the
+    encode-side admitted cache (non-TAS components key on quota/admitted
+    generations, not the node generation)."""
+    cache, queues = _build()
+    sched = DeviceScheduler(cache, queues, verify_arena=True)
+    for i in range(1, 4):
+        submit(queues, make_wl(f"w{i}", queue="lq-cq-a", cpu_m=500,
+                               creation_time=float(i)))
+    sched.schedule()
+    cc = sched._arena.component_cache
+    assert "prio" in cc and "adm" in cc
+
+    keys_before = sched._arena._component_keys(cache.snapshot())
+    cache.add_or_update_node(Node(name="n1", capacity={"cpu": 8000}))
+    # The node bump must not move the non-TAS component keys.
+    keys_after = sched._arena._component_keys(cache.snapshot())
+    assert keys_after == keys_before
+    # And the next cycle still runs (and verifies) with the cache warm.
+    submit(queues, make_wl("w9", queue="lq-cq-b", cpu_m=500,
+                           creation_time=9.0))
+    sched.schedule()
+    assert sched._arena.component_cache["prio"] is not None

@@ -1,0 +1,127 @@
+"""Perf regression contract for the CycleArena: steady-state host encode
+is O(dirty rows), not O(admitted set).
+
+Counter-based (robust in CI): the arena's per-cycle stats — events
+consumed, dirty admitted rows, dirty node rows, W rows recomputed — must
+be IDENTICAL for the same one-row churn applied to a 64-row and a
+256-row admitted set. A generous wall-clock assertion (warm incremental
+encode faster than the from-scratch capture) guards the constant factor.
+"""
+
+from kueue_tpu.api.types import PodSet, ResourceQuota, Workload
+from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.metrics import tracing
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.models.arena import CycleArena
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+
+def _bulk_env(n_per_cq: int):
+    """8 CQs bulk-admitted through the host-exact scheduler (no JAX), plus
+    two oversized pending stragglers so the head set is non-empty and
+    identical across sizes."""
+    cqs = [
+        make_cq(f"cq-{i}", flavors={"default": {"cpu": ResourceQuota(
+            nominal=100_000)}})
+        for i in range(8)
+    ]
+    cache, queues, host = build_env(cqs)
+    t = 0.0
+    for i in range(8):
+        for j in range(n_per_cq):
+            t += 1.0
+            submit(queues, make_wl(
+                f"wl-{i}-{j}", queue=f"lq-cq-{i}", cpu_m=100,
+                creation_time=t,
+            ))
+    submit(queues, make_wl("big-0", queue="lq-cq-0", cpu_m=10_000_000,
+                           creation_time=t + 1.0))
+    submit(queues, make_wl("big-1", queue="lq-cq-1", cpu_m=10_000_000,
+                           creation_time=t + 2.0))
+    for _ in range(n_per_cq + 5):
+        res = host.schedule()
+        if not res.admitted and not res.preempted:
+            break
+        queues.queue_inadmissible_workloads()
+    assert len(cache.workloads) == 8 * n_per_cq
+    queues.queue_inadmissible_workloads()
+    heads = queues.heads()
+    assert len(heads) == 2
+    return cache, queues, heads
+
+
+def _churn_one(cache, nonce: int):
+    """Replace the newest admitted row of cq-3 with an equivalent fresh
+    workload: exactly one admitted row's content changes."""
+    d = cache._cq_workloads["cq-3"]
+    last_key = next(reversed(d))
+    old = cache.workloads[last_key].obj
+    cache.delete_workload(last_key)
+    repl = Workload(
+        name=f"churn-{nonce}", namespace=old.namespace,
+        queue_name=old.queue_name, uid=old.uid + "r",
+        pod_sets=[PodSet(name="main", count=1,
+                         requests=dict(old.pod_sets[0].requests))],
+        priority=old.priority, creation_time=1e6 + nonce,
+    )
+    cache.add_or_update_workload(WorkloadInfo(repl, "cq-3"))
+
+
+def _measure(n_per_cq: int):
+    cache, queues, heads = _bulk_env(n_per_cq)
+    arena = CycleArena(cache)
+    snap = arena.take_snapshot()
+    arena.encode(snap, heads, snap.resource_flavors, preempt=True)
+    assert arena.last_stats["path"] == "full"
+    full_s = arena.last_stats["encode_s"]
+
+    stats = None
+    for nonce in range(2):  # 2nd cycle = warm scatter programs
+        _churn_one(cache, nonce)
+        snap = arena.take_snapshot()
+        arena.encode(snap, heads, snap.resource_flavors, preempt=True)
+        stats = dict(arena.last_stats)
+        assert stats["path"] == "incremental", stats
+    return stats, full_s
+
+
+def test_steady_state_encode_is_o_dirty_rows():
+    small, full_small = _measure(8)    # 64 admitted rows
+    large, full_large = _measure(32)   # 256 admitted rows
+
+    # The churn is one admitted row in both environments: every dirty
+    # counter must match exactly — none may scale with the admitted set.
+    for key in ("events", "dirty_admitted", "dirty_node",
+                "dirty_workload", "rows_recomputed"):
+        assert small.get(key) == large.get(key), (
+            key, small, large,
+        )
+    assert small["events"] == 2              # one remove + one add
+    assert small["dirty_admitted"] <= 2      # the churned slot only
+
+    # Generous wall guard at the larger size: a warm one-row incremental
+    # cycle must beat the from-scratch capture outright.
+    assert large["encode_s"] < full_large, (large, full_large)
+
+
+def test_arena_tracing_series_emitted():
+    """The PR-1 tracing plane carries the arena's cost accounting: encode
+    wall by path, path/reason counters, and dirty-row histograms."""
+    reg = Metrics()
+    tracing.enable(metrics=reg)
+    try:
+        cache, queues, heads = _bulk_env(4)
+        arena = CycleArena(cache)
+        snap = arena.take_snapshot()
+        arena.encode(snap, heads, snap.resource_flavors, preempt=True)
+        _churn_one(cache, 0)
+        snap = arena.take_snapshot()
+        arena.encode(snap, heads, snap.resource_flavors, preempt=True)
+        assert arena.last_stats["path"] == "incremental"
+    finally:
+        tracing.disable()
+    assert reg.get("solver_arena_cycles_total",
+                   {"path": "incremental", "reason": "ok"}) == 1
+    assert reg.histograms["solver_encode_seconds"]
+    assert reg.histograms["solver_arena_dirty_rows"]
