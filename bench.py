@@ -1108,7 +1108,12 @@ def main():
         os.replace(tmp, os.path.join(here, "BENCH_DETAIL.json"))
     except OSError as exc:
         # Never advertise a stale/partial sidecar as this run's data.
+        # The full object still goes to stdout (possibly truncated by the
+        # driver's tail capture, but a measurement run's data must never
+        # be silently dropped); the compact summary below remains the
+        # final, always-parseable line.
         detail_ref = f"unwritable: {exc!r}"[:120]
+        print(json.dumps(out), flush=True)
 
     def _pick(d, *keys):
         picked = {

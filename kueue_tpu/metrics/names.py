@@ -93,6 +93,11 @@ TRACING_SERIES = frozenset({
     "solver_overlap_host_seconds",
     "remote_calls_total",
     "remote_call_duration_seconds",
+    # Fault containment (models/driver.py, utils/breaker.py, remote/).
+    "solver_fallback_cycles_total",
+    "solver_breaker_state",
+    "solver_plane_validation_failures_total",
+    "remote_deadline_exceeded_total",
 })
 
 METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES

@@ -74,6 +74,7 @@ from kueue_tpu.models.encode import (
     encode_cycle,
 )
 from kueue_tpu.ops.quota_ops import MAX_DEPTH
+from kueue_tpu.utils import faults
 
 _B = 8  # priority-bucket axis, mirrors encode_cycle's B
 
@@ -204,6 +205,27 @@ class CycleArena:
         self._pending_events = events  # None = gap -> full encode
         self._cursor = cursor
         return snap
+
+    def invalidate(self, reason: str = "") -> None:
+        """Drop every piece of committed device state so the next encode
+        runs the full from-scratch path (``_gate`` answers "cold").
+
+        Called by the DeviceScheduler's fault containment after any
+        contained device failure: a dispatch that died mid-flight, or a
+        readback that failed validation, may have left the device-resident
+        tensors (or the component cache's admitted/prio tensors keyed by
+        generation) in an unknown state — a delta applied on top would
+        silently poison every later cycle. Pending events are dropped too;
+        the cursor is left alone so the next ``take_snapshot`` drains the
+        log normally and the full re-capture re-commits from it.
+        """
+        self._committed = False
+        self._pending_events = None
+        # The component cache holds device tensors reused by the full
+        # encode path under generation keys; after a fault those keys can
+        # no longer be trusted to imply valid tensors.
+        self.component_cache.clear()
+        self.last_stats = {"path": "invalidated", "reason": reason}
 
     # -- public encode ------------------------------------------------------
 
@@ -455,6 +477,8 @@ class CycleArena:
 
     def _incremental(self, snapshot, heads, resource_flavors, w_pad,
                      delay_tas_fn, events):
+        if faults.ENABLED:
+            faults.fire(faults.ARENA_DELTA_APPLY)
         n, f, r = self._n, self._f, self._r
         stats: Dict[str, object] = {"path": "incremental",
                                     "events": len(events)}

@@ -698,7 +698,11 @@ def partial_search(
         partial entries)."""
         fit = go & (nm.best_pmode == P_FIT) & ~nm.needs_host
         if not widened:
-            return fit, jnp.zeros_like(fit), nm.best_borrow, None, None
+            # No preempt widening: a probe whose nominate verdict depends
+            # on the host oracle is unresolved, exactly as on the widened
+            # path below — reporting it as a plain failure would silently
+            # shrink the entry instead of routing it to the host.
+            return fit, go & nm.needs_host, nm.best_borrow, None, None
         praw = nm.best_pmode == P_PREEMPT_RAW
         base_core = go & praw & ~arrays.w_has_gates
         base_elig, slot_nom = structural_elig(arrays, nm, base_core)

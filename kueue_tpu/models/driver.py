@@ -10,7 +10,7 @@ fall back to the host-exact path within the same loop.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -33,6 +33,22 @@ from kueue_tpu.models.arena import CycleArena
 from kueue_tpu.models.encode import encode_cycle
 from kueue_tpu.queue.manager import QueueManager
 from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
+
+
+class PlaneValidationError(ValueError):
+    """A device readback plane failed a cheap structural invariant.
+
+    Raised by :meth:`DeviceScheduler._validate_planes` BEFORE any
+    admission from the cycle is applied, so a corrupted readback (the
+    threat model of ``faults.DEVICE_READBACK`` corrupt rules) can never
+    mutate the cache — the cycle reroutes through the host-exact path.
+    """
+
+    def __init__(self, check: str, detail: str = "") -> None:
+        self.check = check
+        super().__init__(f"plane validation failed [{check}]: {detail}")
 
 
 class DeviceScheduler:
@@ -50,6 +66,10 @@ class DeviceScheduler:
         clock: Callable[[], float] = time.monotonic,
         use_arena: bool = True,
         verify_arena: bool = False,
+        containment: bool = True,
+        breaker_threshold: int = 3,
+        breaker_backoff_s: float = 1.0,
+        breaker_max_backoff_s: float = 60.0,
     ) -> None:
         self.cache = cache
         self.queues = queues
@@ -78,6 +98,22 @@ class DeviceScheduler:
         # Padding-bucket hysteresis state.
         self._w_bucket = 16
         self._shrink_streak = 0
+        # Fault containment: device-path exceptions and invalid readback
+        # planes route the cycle through the host-exact path instead of
+        # crashing the loop or applying a wrong admission; K consecutive
+        # device failures trip the breaker to all-host scheduling with
+        # exponential-backoff re-probes (utils/breaker.py). The arena is
+        # invalidated on every device failure — stale device state after
+        # a failure must force a full re-capture.
+        self.containment = containment
+        self._breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            backoff_s=breaker_backoff_s,
+            max_backoff_s=breaker_max_backoff_s,
+            clock=clock,
+        )
+        self.fault_fallback_cycles = 0
+        self.last_fault: Optional[Tuple[str, str]] = None
 
     # ------------------------------------------------------------------
 
@@ -91,11 +127,36 @@ class DeviceScheduler:
             result.duration_s = self.clock() - start
             return result
 
-        if self._arena is not None:
-            # Snapshot + event drain under one cache lock hold.
-            snapshot = self._arena.take_snapshot()
-        else:
-            snapshot = self.cache.snapshot()
+        if tracing.ENABLED:
+            tracing.set_gauge(
+                "solver_breaker_state", self._breaker.gauge_value
+            )
+        if not self._breaker.allow():
+            # Breaker open: all-host cycle, no device work at all. The
+            # arena was invalidated when the breaker tripped, so the
+            # half-open probe that eventually re-enters the device path
+            # re-captures from scratch.
+            if tracing.ENABLED:
+                tracing.inc("solver_fallback_cycles_total",
+                            {"reason": "breaker_open"})
+            self._merge_result(result, self._host_process(list(heads)))
+            result.duration_s = self.clock() - start
+            return result
+
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.CACHE_SNAPSHOT)
+            if self._arena is not None:
+                # Snapshot + event drain under one cache lock hold.
+                snapshot = self._arena.take_snapshot()
+            else:
+                snapshot = self.cache.snapshot()
+        except Exception as exc:
+            if not self._containable(exc):
+                raise
+            return self._contain_cycle(
+                result, heads, "snapshot_error", exc, start
+            )
         bucket = self._pick_bucket(len(heads))
         if tracing.ENABLED:
             # Report the bucket actually used (hysteresis holds included)
@@ -109,23 +170,30 @@ class DeviceScheduler:
             lambda cqs, info: self.host._delay_tas(cqs, info)
             or self.host._has_multikueue_check(cqs)
         )
-        if self._arena is not None:
-            arrays, idx = self._arena.encode(
-                snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
-                preempt=True, delay_tas_fn=delay_fn,
-                fair_strategies=self.host.preemptor.fair_strategies,
-            )
-        else:
-            arrays, idx = encode_cycle(
-                snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
-                fair_sharing=self.fair_sharing, preempt=True,
-                delay_tas_fn=delay_fn,
-                fair_strategies=self.host.preemptor.fair_strategies,
-                admitted_cache=self._adm_cache,
-                admitted_key=(
-                    self.cache.generation, self.cache.workload_generation,
-                    self.fair_sharing,
-                ),
+        try:
+            if self._arena is not None:
+                arrays, idx = self._arena.encode(
+                    snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
+                    preempt=True, delay_tas_fn=delay_fn,
+                    fair_strategies=self.host.preemptor.fair_strategies,
+                )
+            else:
+                arrays, idx = encode_cycle(
+                    snapshot, heads, snapshot.resource_flavors, w_pad=bucket,
+                    fair_sharing=self.fair_sharing, preempt=True,
+                    delay_tas_fn=delay_fn,
+                    fair_strategies=self.host.preemptor.fair_strategies,
+                    admitted_cache=self._adm_cache,
+                    admitted_key=(
+                        self.cache.generation, self.cache.workload_generation,
+                        self.fair_sharing,
+                    ),
+                )
+        except Exception as exc:
+            if not self._containable(exc):
+                raise
+            return self._contain_cycle(
+                result, heads, "encode_error", exc, start
             )
 
         # Trees with an encode-fallback entry route through the host
@@ -148,39 +216,55 @@ class DeviceScheduler:
         if not idx.workloads:
             host_entries = list(idx.host_fallback)
 
+        fault: Optional[Tuple[str, Exception]] = None
         if idx.workloads:
             t0 = self.clock()
-            # Default kernel: forest-grouped scan with on-device classical
-            # preemption. Fair sharing swaps in the DRS tournament kernel.
-            # The fixed-point kernel (exact for no-lending-limit trees, no
-            # device preemption) is opt-in until TPU measurements establish
-            # the crossover; bench.py probes both.
-            if self.fair_sharing:
-                from kueue_tpu.models.fair_kernel import cycle_fair_preempt
+            out = None
+            try:
+                if faults.ENABLED:
+                    faults.fire(faults.SOLVER_DISPATCH)
+                # Default kernel: forest-grouped scan with on-device
+                # classical preemption. Fair sharing swaps in the DRS
+                # tournament kernel. The fixed-point kernel (exact for
+                # no-lending-limit trees, no device preemption) is opt-in
+                # until TPU measurements establish the crossover; bench.py
+                # probes both.
+                if self.fair_sharing:
+                    from kueue_tpu.models.fair_kernel import (
+                        cycle_fair_preempt,
+                    )
 
-                with tracing.span("device/cycle_fair_preempt",
-                                  batch=bucket):
-                    out = cycle_fair_preempt(
-                        arrays, idx.admitted_arrays, s_max=idx.fair_s_bound
-                    )
-            elif self.use_fixedpoint and not idx.has_partial \
-                    and arrays.s_req is None \
-                    and arrays.tas_topo is None and not bool(
-                np.asarray(arrays.tree.has_lend_limit).any()
-            ):
-                with tracing.span("device/cycle_fixedpoint", batch=bucket):
-                    out = batch_scheduler.cycle_fixedpoint(
-                        arrays, idx.group_arrays
-                    )
-            else:
-                with tracing.span("device/cycle_grouped_preempt",
-                                  batch=bucket):
-                    out = batch_scheduler.cycle_grouped_preempt(
-                        arrays, idx.group_arrays, idx.admitted_arrays
-                    )
+                    with tracing.span("device/cycle_fair_preempt",
+                                      batch=bucket):
+                        out = cycle_fair_preempt(
+                            arrays, idx.admitted_arrays,
+                            s_max=idx.fair_s_bound,
+                        )
+                elif self.use_fixedpoint and not idx.has_partial \
+                        and arrays.s_req is None \
+                        and arrays.tas_topo is None and not bool(
+                    np.asarray(arrays.tree.has_lend_limit).any()
+                ):
+                    with tracing.span("device/cycle_fixedpoint",
+                                      batch=bucket):
+                        out = batch_scheduler.cycle_fixedpoint(
+                            arrays, idx.group_arrays
+                        )
+                else:
+                    with tracing.span("device/cycle_grouped_preempt",
+                                      batch=bucket):
+                        out = batch_scheduler.cycle_grouped_preempt(
+                            arrays, idx.group_arrays, idx.admitted_arrays
+                        )
+            except Exception as exc:
+                if not self._containable(exc):
+                    raise
+                fault = ("dispatch_error", exc)
             # Overlap window: the kernel call above only dispatched — run
             # the pre-discarded trees' host work before the first blocking
-            # read so it executes while the device solves.
+            # read so it executes while the device solves. These host
+            # results are exact and stand even if the readback below
+            # fails (trees are quota-independent).
             host_dt = 0.0
             pre_entries = list(idx.host_fallback)
             if pre_roots:
@@ -188,51 +272,52 @@ class DeviceScheduler:
                     info for info in idx.workloads
                     if self._in_discarded(info, snapshot, pre_roots)
                 )
-            if pre_entries:
+            pre_done = False
+            if fault is None and pre_entries:
                 th0 = self.clock()
-                pre_result = self._host_process(pre_entries)
-                result.admitted.extend(pre_result.admitted)
-                result.preempted.extend(pre_result.preempted)
-                result.preempting.extend(pre_result.preempting)
-                result.skipped.extend(pre_result.skipped)
-                result.inadmissible.extend(pre_result.inadmissible)
+                self._merge_result(result, self._host_process(pre_entries))
                 host_dt = self.clock() - th0
-            outcome = np.asarray(out.outcome)  # first blocking read
-            chosen = np.asarray(out.chosen_flavor)
-            tried = np.asarray(out.tried_flavor_idx)
-            s_flavor = (
-                np.asarray(out.s_flavor)
-                if out.s_flavor is not None else None
-            )
-            s_pmode = (
-                np.asarray(out.s_pmode)
-                if out.s_pmode is not None else None
-            )
-            s_tried = (
-                np.asarray(out.s_tried)
-                if out.s_tried is not None else None
-            )
-            # Secondary planes are only copied off device when some row
-            # outcome actually consumes them (the victim matrix is the
-            # largest readback of the cycle).
-            any_admit = bool(
-                (outcome == batch_scheduler.OUT_ADMITTED).any()
-            )
-            any_preempt = bool(
-                (outcome == batch_scheduler.OUT_PREEMPTING).any()
-            )
-            partial = (
-                np.asarray(out.partial_count)
-                if out.partial_count is not None and any_admit else None
-            )
-            victims = (
-                np.asarray(out.victims)
-                if out.victims is not None and any_preempt else None
-            )
-            variants = (
-                np.asarray(out.victim_variant)
-                if out.victim_variant is not None and any_preempt else None
-            )
+                pre_done = True
+            planes = None
+            if fault is None:
+                try:
+                    # Blocking readback + invariant validation + TAS
+                    # decode; validation runs BEFORE any admission is
+                    # applied, so a corrupted plane cannot reach the cache.
+                    planes = self._read_planes(out, idx)
+                except PlaneValidationError as exc:
+                    if tracing.ENABLED:
+                        tracing.inc(
+                            "solver_plane_validation_failures_total",
+                            {"check": exc.check},
+                        )
+                    if not self.containment:
+                        raise
+                    fault = ("plane_validation", exc)
+                except Exception as exc:
+                    if not self._containable(exc):
+                        raise
+                    fault = ("readback_error", exc)
+            if fault is not None:
+                self._record_device_failure(fault[0], fault[1])
+                if pre_done:
+                    # The fallback trees were already host-processed in
+                    # the overlap window; reprocessing would double-apply
+                    # their admissions. Everything else reroutes.
+                    host_entries.extend(
+                        info for info in idx.workloads
+                        if not (pre_roots and self._in_discarded(
+                            info, snapshot, pre_roots))
+                    )
+                else:
+                    host_entries.extend(idx.host_fallback)
+                    host_entries.extend(idx.workloads)
+
+        if idx.workloads and fault is None:
+            self._breaker.record_success()
+            (outcome, chosen, tried, s_flavor, s_pmode, s_tried, partial,
+             victims, variants, tas_assignments, leader_tas,
+             slot_tas) = planes
             dt = self.clock() - t0
             self.device_time_s += dt
             if tracing.ENABLED:
@@ -243,14 +328,6 @@ class DeviceScheduler:
                     "solver_overlap_occupancy_pct",
                     100.0 * min(host_dt, dt) / dt if dt > 0 else 0.0,
                 )
-
-            # Admitted TAS entries: the placement kernel emits its own
-            # per-leaf takes (CycleOutputs.tas_takes), so domains decode
-            # directly in O(assignments) — no host placement replay.
-            (tas_assignments, leader_tas,
-             slot_tas) = self._decode_tas_assignments(
-                out, outcome, chosen, idx
-            )
 
             # In-cycle interleaving is per cohort tree: entries of one
             # tree contend for the same quota in admission order, and a
@@ -398,6 +475,208 @@ class DeviceScheduler:
     def _in_discarded(info, snapshot, discarded_roots) -> bool:
         cqs = snapshot.cluster_queues.get(info.cluster_queue)
         return cqs is not None and id(cqs.node.root()) in discarded_roots
+
+    # -- fault containment ---------------------------------------------------
+
+    def _containable(self, exc: Exception) -> bool:
+        """Verification failures (arena verify mode, kernel asserts) must
+        surface — masking them behind the host fallback would hide exactly
+        the bugs the differential layers exist to catch."""
+        return self.containment and not isinstance(exc, AssertionError)
+
+    @staticmethod
+    def _merge_result(result: CycleResult, other: CycleResult) -> None:
+        result.admitted.extend(other.admitted)
+        result.preempted.extend(other.preempted)
+        result.preempting.extend(other.preempting)
+        result.skipped.extend(other.skipped)
+        result.inadmissible.extend(other.inadmissible)
+
+    def _record_device_failure(self, reason: str, exc: Exception) -> None:
+        """Book one contained device failure: breaker accounting, arena
+        invalidation (stale device state must force a full re-capture),
+        and the fallback metric series."""
+        self.fault_fallback_cycles += 1
+        self.last_fault = (reason, repr(exc))
+        if self._arena is not None:
+            self._arena.invalidate(reason)
+        self._breaker.record_failure()
+        if tracing.ENABLED:
+            tracing.inc("solver_fallback_cycles_total", {"reason": reason})
+            tracing.set_gauge(
+                "solver_breaker_state", self._breaker.gauge_value
+            )
+
+    def _contain_cycle(self, result: CycleResult, heads, reason: str,
+                       exc: Exception, start: float) -> CycleResult:
+        """Containment for failures before any device work consumed cache
+        state (snapshot / encode): the whole cycle runs host-exact."""
+        self._record_device_failure(reason, exc)
+        self._merge_result(result, self._host_process(list(heads)))
+        result.duration_s = self.clock() - start
+        return result
+
+    def _read_planes(self, out, idx):
+        """Blocking device->host readback of every plane the apply loop
+        consumes, validated against cheap structural invariants before the
+        caller applies a single admission. Also the hook point for
+        readback fault injection (``faults.DEVICE_READBACK``: raise/delay
+        rules fire before the first transfer, corrupt rules rewrite
+        individual planes)."""
+        if faults.ENABLED:
+            faults.fire(faults.DEVICE_READBACK)
+        outcome = np.asarray(out.outcome)  # first blocking read
+        chosen = np.asarray(out.chosen_flavor)
+        tried = np.asarray(out.tried_flavor_idx)
+        s_flavor = (
+            np.asarray(out.s_flavor)
+            if out.s_flavor is not None else None
+        )
+        s_pmode = (
+            np.asarray(out.s_pmode)
+            if out.s_pmode is not None else None
+        )
+        s_tried = (
+            np.asarray(out.s_tried)
+            if out.s_tried is not None else None
+        )
+        if faults.ENABLED:
+            outcome = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "outcome", outcome)
+            chosen = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "chosen", chosen)
+            tried = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "tried", tried)
+            s_flavor = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "s_flavor", s_flavor)
+        # Secondary planes are only copied off device when some row
+        # outcome actually consumes them (the victim matrix is the
+        # largest readback of the cycle). A corrupted outcome plane
+        # steers these reads exactly like a real one would.
+        any_admit = bool(
+            (outcome == batch_scheduler.OUT_ADMITTED).any()
+        )
+        any_preempt = bool(
+            (outcome == batch_scheduler.OUT_PREEMPTING).any()
+        )
+        partial = (
+            np.asarray(out.partial_count)
+            if out.partial_count is not None and any_admit else None
+        )
+        victims = (
+            np.asarray(out.victims)
+            if out.victims is not None and any_preempt else None
+        )
+        variants = (
+            np.asarray(out.victim_variant)
+            if out.victim_variant is not None and any_preempt else None
+        )
+        if faults.ENABLED:
+            partial = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "partial", partial)
+            victims = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "victims", victims)
+            variants = faults.corrupt_plane(
+                faults.DEVICE_READBACK, "variants", variants)
+        self._validate_planes(
+            outcome, chosen, tried, partial, victims, variants,
+            s_flavor, idx,
+        )
+        # Admitted TAS entries: the placement kernel emits its own
+        # per-leaf takes (CycleOutputs.tas_takes), so domains decode
+        # directly in O(assignments) — no host placement replay.
+        (tas_assignments, leader_tas,
+         slot_tas) = self._decode_tas_assignments(out, outcome, chosen, idx)
+        return (outcome, chosen, tried, s_flavor, s_pmode, s_tried,
+                partial, victims, variants, tas_assignments, leader_tas,
+                slot_tas)
+
+    def _validate_planes(self, outcome, chosen, tried, partial, victims,
+                         variants, s_flavor, idx) -> None:
+        """Cheap invariants every readback must satisfy before admissions
+        apply: index bounds on every value the apply loop will index with,
+        outcome/variant domains, admitted-row partial-count sanity, no
+        NaN. O(W) host work on planes already resident — the threat model
+        is a trashed or truncated readback buffer, not a semantically
+        plausible wrong answer (that class is covered by the differential
+        suites and the arena verify mode)."""
+        w = len(idx.workloads)
+        n_flavors = len(idx.flavors)
+        n_adm = len(idx.admitted)
+        for name, plane in (("outcome", outcome), ("chosen", chosen),
+                            ("tried", tried), ("partial", partial),
+                            ("victims", victims), ("variants", variants),
+                            ("s_flavor", s_flavor)):
+            if plane is not None and \
+                    np.issubdtype(plane.dtype, np.floating) and \
+                    np.isnan(plane).any():
+                raise PlaneValidationError("nan", f"{name} contains NaN")
+        if outcome.shape[0] < w:
+            raise PlaneValidationError(
+                "shape", f"outcome rows {outcome.shape[0]} < {w}")
+        oc = outcome[:w]
+        if ((oc < batch_scheduler.OUT_NOFIT)
+                | (oc > batch_scheduler.OUT_SHADOWED)).any():
+            raise PlaneValidationError(
+                "outcome-domain",
+                f"values outside [{batch_scheduler.OUT_NOFIT}, "
+                f"{batch_scheduler.OUT_SHADOWED}]",
+            )
+        tr = tried[:w]
+        if ((tr < -1) | (tr > n_flavors)).any():
+            raise PlaneValidationError(
+                "tried-bounds", f"values outside [-1, {n_flavors}]")
+        admitted_rows = np.flatnonzero(oc == batch_scheduler.OUT_ADMITTED)
+        preempt_rows = np.flatnonzero(oc == batch_scheduler.OUT_PREEMPTING)
+        ch = chosen[:w]
+        for i in admitted_rows:
+            if not (0 <= ch[i] < n_flavors):
+                raise PlaneValidationError(
+                    "flavor-bounds",
+                    f"row {i}: chosen {ch[i]} outside [0, {n_flavors})",
+                )
+            slots_i = idx.slots[i] if idx.slots else None
+            if s_flavor is not None and slots_i is not None:
+                for si in range(min(len(slots_i), s_flavor.shape[1])):
+                    sf = s_flavor[i, si]
+                    if not (0 <= sf < n_flavors):
+                        raise PlaneValidationError(
+                            "slot-flavor-bounds",
+                            f"row {i} slot {si}: {sf} outside "
+                            f"[0, {n_flavors})",
+                        )
+            if partial is not None and partial[i] != -1:
+                count = idx.workloads[i].total_requests[0].count
+                if not (0 < partial[i] <= count):
+                    raise PlaneValidationError(
+                        "partial-range",
+                        f"row {i}: partial count {partial[i]} outside "
+                        f"(0, {count}]",
+                    )
+        if len(preempt_rows):
+            if victims is None:
+                raise PlaneValidationError(
+                    "victims-missing", "preempting rows without a victim "
+                    "plane")
+            for i in preempt_rows:
+                marks = np.flatnonzero(victims[i])
+                if marks.size == 0:
+                    raise PlaneValidationError(
+                        "victims-empty", f"preempting row {i} designates "
+                        "no victims")
+                if int(marks.max()) >= n_adm:
+                    raise PlaneValidationError(
+                        "victim-bounds",
+                        f"row {i}: victim index {int(marks.max())} >= "
+                        f"{n_adm} admitted rows",
+                    )
+                if variants is not None:
+                    var = variants[i][marks]
+                    if ((var < 0) | (var > 6)).any():
+                        raise PlaneValidationError(
+                            "variant-domain",
+                            f"row {i}: victim variants outside [0, 6]",
+                        )
 
     def _host_process(self, infos: List[WorkloadInfo]) -> CycleResult:
         """Run the host-exact pipeline on specific workloads by temporarily

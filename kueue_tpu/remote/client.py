@@ -17,6 +17,8 @@ from typing import Optional
 from kueue_tpu.api.serialization import decode, encode
 from kueue_tpu.api.types import Workload
 from kueue_tpu.metrics import tracing
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
 
 
 class WorkerUnreachable(ConnectionError):
@@ -55,11 +57,24 @@ class RemoteWorkerClient:
         connect_timeout: float = 2.0,
         retries: int = 2,
         backoff_s: float = 0.05,
+        op_timeout: float = 30.0,
+        breaker: Optional[CircuitBreaker] = None,
     ) -> None:
         self.socket_path = socket_path
+        # connect_timeout bounds connection establishment; op_timeout is
+        # the per-op deadline on the established socket — without it a
+        # worker that accepts but never answers wedges the MultiKueue
+        # dispatch loop forever.
         self.connect_timeout = connect_timeout
+        self.op_timeout = max(op_timeout, connect_timeout)
         self.retries = retries
         self.backoff_s = backoff_s
+        # Transport breaker: a worker that exhausted its retries trips
+        # after `threshold` consecutive logical failures, and later calls
+        # fast-fail WorkerUnreachable (which MultiKueueController already
+        # treats as "skip this cluster") instead of re-paying the full
+        # connect + retry + backoff latency per call.
+        self.breaker = breaker or CircuitBreaker()
         self._sock: Optional[socket.socket] = None
         self._file = None
         self.workloads = _WorkloadView(self)
@@ -71,6 +86,7 @@ class RemoteWorkerClient:
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.settimeout(self.connect_timeout)
         s.connect(self.socket_path)
+        s.settimeout(self.op_timeout)
         self._sock = s
         self._file = s.makefile("rwb")
 
@@ -112,9 +128,16 @@ class RemoteWorkerClient:
             req = dict(req,
                        trace=tracing.current_trace_id()
                        or tracing.new_trace_id())
+        if not self.breaker.allow():
+            raise WorkerUnreachable(
+                f"worker at {self.socket_path} unreachable: breaker open "
+                f"(retry in {self.breaker.last_backoff_s:.1f}s)"
+            )
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:
+                if faults.ENABLED:
+                    faults.fire(faults.REMOTE_TRANSPORT)
                 if self._file is None:
                     self._connect()
                 self._file.write(json.dumps(req).encode() + b"\n")
@@ -123,14 +146,27 @@ class RemoteWorkerClient:
                 if not line:
                     raise ConnectionError("worker closed the connection")
                 resp = json.loads(line)
+                # A transport round-trip completed: the worker is healthy
+                # even if the op itself errors (RuntimeError below is an
+                # application failure, not a reachability one).
+                self.breaker.record_success()
                 if not resp.get("ok"):
                     raise RuntimeError(resp.get("error", "remote error"))
                 return resp
+            except socket.timeout as exc:
+                last_exc = exc
+                if tracing.ENABLED:
+                    tracing.inc("remote_deadline_exceeded_total",
+                                {"transport": "socket"})
+                self.close()
+                if attempt < self.retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
             except (OSError, ConnectionError, json.JSONDecodeError) as exc:
                 last_exc = exc
                 self.close()
                 if attempt < self.retries:
                     time.sleep(self.backoff_s * (2 ** attempt))
+        self.breaker.record_failure()
         raise WorkerUnreachable(
             f"worker at {self.socket_path} unreachable: {last_exc!r}"
         )

@@ -37,6 +37,8 @@ from kueue_tpu.manager import Manager
 from kueue_tpu.metrics import tracing
 from kueue_tpu.remote.client import WorkerUnreachable, _WorkloadView
 from kueue_tpu.remote.worker import dispatch
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
 
 _SERVICE = "kueue.tpu.MultiKueueWorker"
 _METHOD = f"/{_SERVICE}/Call"
@@ -127,6 +129,7 @@ class GrpcWorkerClient:
         retries: int = 2,
         backoff_s: float = 0.05,
         op_timeout: float = 30.0,
+        breaker: Optional[CircuitBreaker] = None,
     ) -> None:
         self.address = address
         # connect_timeout bounds cheap control ops (ping); op_timeout
@@ -137,6 +140,11 @@ class GrpcWorkerClient:
         self.op_timeout = max(op_timeout, connect_timeout)
         self.retries = retries
         self.backoff_s = backoff_s
+        # Same transport breaker as RemoteWorkerClient: consecutive
+        # retry-exhausted calls trip to fast-fail WorkerUnreachable, so an
+        # unreachable worker degrades MultiKueue dispatch (cluster is
+        # skipped) instead of stalling it for the full deadline per call.
+        self.breaker = breaker or CircuitBreaker()
         self._channel: Optional[grpc.Channel] = None
         self._call_fn = None
         self.workloads = _WorkloadView(self)
@@ -192,9 +200,16 @@ class GrpcWorkerClient:
             # Propagate the caller's trace id so worker-side spans join
             # this trace (mint one if the caller has no active trace).
             req["trace"] = tracing.current_trace_id() or tracing.new_trace_id()
+        if not self.breaker.allow():
+            raise WorkerUnreachable(
+                f"worker at {self.address} unreachable: breaker open "
+                f"(retry in {self.breaker.last_backoff_s:.1f}s)"
+            )
         last_exc: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:
+                if faults.ENABLED:
+                    faults.fire(faults.REMOTE_TRANSPORT)
                 if self._call_fn is None:
                     self._connect()
                 raw = self._call_fn(
@@ -202,17 +217,27 @@ class GrpcWorkerClient:
                     timeout=timeout or self.op_timeout,
                 )
                 resp = json.loads(raw)
+                # See RemoteWorkerClient: a completed round-trip is a
+                # transport success even when the op itself failed.
+                self.breaker.record_success()
                 if not resp.get("ok"):
                     raise RuntimeError(resp.get("error", "remote error"))
                 return resp
-            except (grpc.RpcError, json.JSONDecodeError) as exc:
+            except (grpc.RpcError, ConnectionError,
+                    json.JSONDecodeError) as exc:
                 last_exc = exc
+                if tracing.ENABLED and isinstance(exc, grpc.RpcError) \
+                        and hasattr(exc, "code") \
+                        and exc.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    tracing.inc("remote_deadline_exceeded_total",
+                                {"transport": "grpc"})
                 self.close()
                 # Retry connection-establishment failures; a DEADLINE or
                 # INTERNAL mid-call is retried too, but the rid dedupe
                 # makes the replay safe.
                 if attempt < self.retries:
                     time.sleep(self.backoff_s * (2 ** attempt))
+        self.breaker.record_failure()
         raise WorkerUnreachable(
             f"worker at {self.address} unreachable: {last_exc!r}"
         )

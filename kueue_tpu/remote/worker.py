@@ -24,6 +24,7 @@ from typing import Optional
 from kueue_tpu.api.serialization import decode, encode
 from kueue_tpu.manager import Manager
 from kueue_tpu.metrics import tracing
+from kueue_tpu.utils import faults
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -51,6 +52,12 @@ def dispatch(mgr: Manager, req: dict) -> dict:
     Requests may carry a caller ``trace`` id; it is re-entered here so
     worker-side spans land in the same logical trace as the caller's."""
     caller_trace = req.pop("trace", None)
+    if faults.ENABLED:
+        # Slow-worker / failing-worker injection: a delay rule here
+        # exercises the clients' op deadlines; a raise rule surfaces as an
+        # error response (application failure at the client — it must NOT
+        # trip the transport breaker).
+        faults.fire(faults.REMOTE_DISPATCH)
     if not tracing.ENABLED:
         return _dispatch_impl(mgr, req)
     with tracing.trace_context(caller_trace or tracing.current_trace_id()):

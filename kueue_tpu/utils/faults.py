@@ -1,0 +1,241 @@
+"""Fault-injection framework for the device solver and the remote seams.
+
+The ROADMAP north star is a production system, and the reference Kueue
+survives component failures by construction (controller-runtime requeue +
+backoff around every reconcile). The JAX/TPU hot path this rebuild runs is
+far more fragile — an XLA failure, a corrupted readback, or a dead device
+tunnel used to take out the whole admission loop. This module is the
+*test half* of the containment story: named injection points at every
+device/remote seam let tests (and soak rigs) drive raises, corrupted
+result planes, and delays through the real code paths, so the containment
+layer in ``models/driver.py`` and the transport breakers in ``remote/``
+are exercised against the exact failure classes they must absorb.
+
+Zero-cost when disabled, same pattern as ``tracing.ENABLED``: every call
+site guards with ``if faults.ENABLED:`` so the production path pays one
+module-attribute read and nothing else. ``ENABLED`` is mutated only by
+:func:`install` / :func:`clear`.
+
+Injection points (the complete set — :meth:`FaultPlan.add` rejects
+anything else so a typo'd point never silently no-ops):
+
+- ``solver.dispatch``   — the batched kernel call in the driver
+- ``arena.delta_apply`` — the CycleArena incremental scatter path
+- ``device.readback``   — blocking device->host plane transfers (also the
+  hook for *corrupt* rules: planes pass through :func:`corrupt_plane`)
+- ``remote.transport``  — client-side socket/gRPC call attempts
+- ``remote.dispatch``   — worker-side op dispatch (slow/failing worker)
+- ``cache.snapshot``    — the device path's snapshot acquisition
+
+Rule modes:
+
+- ``raise``   — raise ``exc(point)`` (default :class:`InjectedFault`);
+  pass ``exc=ConnectionError`` to model a transport drop that the
+  client's retry/backoff machinery must absorb.
+- ``delay``   — ``time.sleep(delay_s)`` (deadline / slow-worker tests).
+- ``corrupt`` — mutate a readback plane via :func:`corrupt_plane`. The
+  default corrupter writes *out-of-domain garbage* (NaN for floats,
+  huge/negative values for ints, an all-zero wipe for bool planes): the
+  threat model is a trashed or truncated readback buffer, which the
+  driver's result-plane validation is specified to catch. A corruption
+  that produces a semantically plausible but wrong answer is out of
+  scope here — that class is covered by the arena verify mode and the
+  device-vs-host differential suites.
+
+Typical use::
+
+    from kueue_tpu.utils import faults
+    plan = faults.FaultPlan(seed=7)
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", rate=0.2)
+    plan.add(faults.DEVICE_READBACK, mode="corrupt", rate=0.2,
+             planes=("victims", "partial"))
+    faults.install(plan)
+    try:
+        scheduler.schedule_all()
+    finally:
+        faults.clear()
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Module-level fast flag: hot loops read this attribute directly. Mutate
+# only through install()/clear().
+ENABLED = False
+
+SOLVER_DISPATCH = "solver.dispatch"
+ARENA_DELTA_APPLY = "arena.delta_apply"
+DEVICE_READBACK = "device.readback"
+REMOTE_TRANSPORT = "remote.transport"
+REMOTE_DISPATCH = "remote.dispatch"
+CACHE_SNAPSHOT = "cache.snapshot"
+
+POINTS = frozenset({
+    SOLVER_DISPATCH,
+    ARENA_DELTA_APPLY,
+    DEVICE_READBACK,
+    REMOTE_TRANSPORT,
+    REMOTE_DISPATCH,
+    CACHE_SNAPSHOT,
+})
+
+_MODES = ("raise", "delay", "corrupt")
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by a ``raise``-mode rule."""
+
+
+def default_corrupt(rng: random.Random, plane: str,
+                    a: np.ndarray) -> np.ndarray:
+    """Out-of-domain garbage per dtype (see module docstring for the
+    threat model). Operates on a copy provided by :func:`corrupt_plane`."""
+    if a.size == 0:
+        return a
+    if np.issubdtype(a.dtype, np.floating):
+        k = max(1, a.size // 8)
+        idxs = [rng.randrange(a.size) for _ in range(k)]
+        a.flat[idxs] = np.nan
+    elif a.dtype == np.bool_:
+        # A truncated/dropped transfer reads back as zeros.
+        a[...] = False
+    else:
+        k = max(1, a.size // 8)
+        garbage = rng.choice([-(1 << 20), 1 << 28])
+        idxs = [rng.randrange(a.size) for _ in range(k)]
+        a.flat[idxs] = garbage
+    return a
+
+
+class _Rule:
+    __slots__ = ("point", "mode", "rate", "delay_s", "exc", "corrupt_fn",
+                 "times", "planes", "fired")
+
+    def __init__(self, point: str, mode: str, rate: float, delay_s: float,
+                 exc: Optional[Callable[[str], BaseException]],
+                 corrupt_fn: Optional[Callable], times: Optional[int],
+                 planes: Optional[Tuple[str, ...]]) -> None:
+        self.point = point
+        self.mode = mode
+        self.rate = rate
+        self.delay_s = delay_s
+        self.exc = exc
+        self.corrupt_fn = corrupt_fn
+        self.times = times
+        self.planes = planes
+        self.fired = 0
+
+    def spent(self) -> bool:
+        return self.times is not None and self.fired >= self.times
+
+
+class FaultPlan:
+    """A deterministic (seeded) schedule of fault rules by point."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.rng = random.Random(seed)
+        self.rules: Dict[str, List[_Rule]] = {}
+        # (point, mode) -> times a rule actually fired.
+        self.counts: Counter = Counter()
+        # point -> times the point was consulted while installed.
+        self.evaluated: Counter = Counter()
+
+    def add(
+        self,
+        point: str,
+        mode: str = "raise",
+        rate: float = 1.0,
+        delay_s: float = 0.0,
+        exc: Optional[Callable[[str], BaseException]] = None,
+        corrupt: Optional[Callable] = None,
+        times: Optional[int] = None,
+        planes: Optional[Sequence[str]] = None,
+    ) -> "FaultPlan":
+        if point not in POINTS:
+            raise ValueError(f"unknown injection point {point!r}")
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.rules.setdefault(point, []).append(_Rule(
+            point, mode, rate, delay_s, exc, corrupt, times,
+            tuple(planes) if planes is not None else None,
+        ))
+        return self
+
+    def fired(self, point: str, mode: Optional[str] = None) -> int:
+        if mode is not None:
+            return self.counts[(point, mode)]
+        return sum(v for (p, _m), v in self.counts.items() if p == point)
+
+
+_plan: Optional[FaultPlan] = None
+_lock = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` and flip the fast flag on."""
+    global ENABLED, _plan
+    with _lock:
+        _plan = plan
+        ENABLED = True
+    return plan
+
+
+def clear() -> None:
+    global ENABLED, _plan
+    with _lock:
+        _plan = None
+        ENABLED = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def fire(point: str) -> None:
+    """Evaluate the ``raise``/``delay`` rules at ``point``. Call sites
+    guard with ``if faults.ENABLED:`` — never call unconditionally from a
+    hot loop."""
+    plan = _plan
+    if plan is None:
+        return
+    plan.evaluated[point] += 1
+    for rule in plan.rules.get(point, ()):
+        if rule.mode == "corrupt" or rule.spent():
+            continue
+        if rule.rate < 1.0 and plan.rng.random() >= rule.rate:
+            continue
+        rule.fired += 1
+        plan.counts[(point, rule.mode)] += 1
+        if rule.mode == "delay":
+            time.sleep(rule.delay_s)
+        else:
+            exc = rule.exc or InjectedFault
+            raise exc(f"injected fault at {point}")
+
+
+def corrupt_plane(point: str, plane: str, array):
+    """Return ``array``, possibly corrupted by a ``corrupt`` rule at
+    ``point``. The input is copied before mutation — callers' arrays are
+    never aliased. ``None`` passes through (absent optional planes)."""
+    plan = _plan
+    if plan is None or array is None:
+        return array
+    for rule in plan.rules.get(point, ()):
+        if rule.mode != "corrupt" or rule.spent():
+            continue
+        if rule.planes is not None and plane not in rule.planes:
+            continue
+        if rule.rate < 1.0 and plan.rng.random() >= rule.rate:
+            continue
+        rule.fired += 1
+        plan.counts[(point, "corrupt")] += 1
+        fn = rule.corrupt_fn or default_corrupt
+        array = fn(plan.rng, plane, np.array(array, copy=True))
+    return array

@@ -26,6 +26,10 @@ from kueue_tpu.tas.snapshot import Node
 
 from .helpers import make_cq
 
+# Compile-heavy: run in its own subprocess via tools/run_isolated.py so a
+# jaxlib cumulative-compile segfault can't take down the bulk suite.
+pytestmark = pytest.mark.isolated
+
 LEVELS = ["tpu.block", "tpu.rack", "kubernetes.io/hostname"]
 
 

@@ -23,6 +23,10 @@ from kueue_tpu.scheduler.scheduler import Scheduler
 
 from .helpers import build_env, make_cq, make_wl, submit
 
+# Compile-heavy: run in its own subprocess via tools/run_isolated.py so a
+# jaxlib cumulative-compile segfault can't take down the bulk suite.
+pytestmark = pytest.mark.isolated
+
 
 def _encode(cache, queues, n):
     snapshot = cache.snapshot()

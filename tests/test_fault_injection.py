@@ -1,0 +1,652 @@
+"""Fault containment: injected device/remote failures must never change
+admission outcomes or crash the loop.
+
+Three layers under test (ISSUE 3 tentpole):
+
+- the injection framework itself (``utils/faults.py``): deterministic
+  seeded schedules, rate/times gating, plane corruption on copies;
+- per-cycle containment in ``models/driver.py``: randomized fault
+  schedules (solver raise, corrupted readback planes) asserting admission
+  outcomes bit-identical to a fault-free host-only run, plus breaker
+  trip / re-probe / arena-reset transitions;
+- transport deadlines + breaker on the remote clients: drops at up to
+  20% rate are absorbed by retries, a dead worker trips to fast-fail,
+  an op-level error does NOT count as a transport failure.
+
+Plus a zero-overhead test pinning the faults-disabled hot path (same
+pattern as the tracing zero-cost test: every production call site is
+guarded by ``if faults.ENABLED:``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from kueue_tpu.models.driver import DeviceScheduler, PlaneValidationError
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+
+from .helpers import build_env, make_cq, make_wl, submit
+from .test_device_differential import random_scenario, run_host
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Framework unit tests
+
+
+def test_plan_rejects_unknown_point_and_mode():
+    plan = faults.FaultPlan()
+    with pytest.raises(ValueError):
+        plan.add("not.a.point")
+    with pytest.raises(ValueError):
+        plan.add(faults.SOLVER_DISPATCH, mode="explode")
+
+
+def test_fire_respects_times_and_counts():
+    plan = faults.FaultPlan(seed=1)
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", times=2)
+    faults.install(plan)
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.fire(faults.SOLVER_DISPATCH)
+    faults.fire(faults.SOLVER_DISPATCH)  # spent: no raise
+    assert plan.fired(faults.SOLVER_DISPATCH) == 2
+    assert plan.evaluated[faults.SOLVER_DISPATCH] == 3
+
+
+def test_fire_rate_is_deterministic_per_seed():
+    def fire_pattern(seed):
+        plan = faults.FaultPlan(seed=seed)
+        plan.add(faults.CACHE_SNAPSHOT, mode="raise", rate=0.3)
+        faults.install(plan)
+        pattern = []
+        for _ in range(50):
+            try:
+                faults.fire(faults.CACHE_SNAPSHOT)
+                pattern.append(0)
+            except faults.InjectedFault:
+                pattern.append(1)
+        faults.clear()
+        return pattern
+
+    assert fire_pattern(7) == fire_pattern(7)
+    assert fire_pattern(7) != fire_pattern(8)
+
+
+def test_custom_exception_class():
+    plan = faults.FaultPlan()
+    plan.add(faults.REMOTE_TRANSPORT, mode="raise", exc=ConnectionError)
+    faults.install(plan)
+    with pytest.raises(ConnectionError):
+        faults.fire(faults.REMOTE_TRANSPORT)
+
+
+def test_delay_mode_sleeps():
+    plan = faults.FaultPlan()
+    plan.add(faults.REMOTE_DISPATCH, mode="delay", delay_s=0.05)
+    faults.install(plan)
+    t0 = time.perf_counter()
+    faults.fire(faults.REMOTE_DISPATCH)
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_corrupt_plane_copies_and_filters():
+    plan = faults.FaultPlan(seed=3)
+    plan.add(faults.DEVICE_READBACK, mode="corrupt", planes=("outcome",))
+    faults.install(plan)
+    original = np.arange(64, dtype=np.int32)
+    keep = original.copy()
+    out = faults.corrupt_plane(faults.DEVICE_READBACK, "outcome", original)
+    assert (original == keep).all(), "caller's array must not be mutated"
+    assert not (out == keep).all(), "returned copy must be corrupted"
+    other = faults.corrupt_plane(faults.DEVICE_READBACK, "tried", original)
+    assert other is original, "plane filter must pass other planes through"
+    assert faults.corrupt_plane(faults.DEVICE_READBACK, "outcome",
+                                None) is None
+
+
+def test_default_corrupter_is_out_of_domain():
+    rng = __import__("random").Random(0)
+    floats = faults.default_corrupt(rng, "x", np.zeros(16, np.float32))
+    assert np.isnan(floats).any()
+    bools = faults.default_corrupt(rng, "x", np.ones(16, bool))
+    assert not bools.any()
+    ints = faults.default_corrupt(rng, "x", np.zeros(16, np.int32))
+    assert (np.abs(ints) >= (1 << 20)).any()
+
+
+# ---------------------------------------------------------------------------
+# Zero overhead when disabled
+
+
+def test_faults_disabled_by_default_and_call_sites_guarded():
+    """The production contract: ``faults.ENABLED`` is False unless a plan
+    is installed, and every production ``faults.fire`` /
+    ``faults.corrupt_plane`` call site sits under an ``if faults.ENABLED``
+    guard (or inside a helper that is itself only reached under one) — so
+    the disabled hot path pays one module-attribute read and nothing
+    else. Same pattern as the tracing zero-cost test."""
+    assert faults.ENABLED is False
+    assert faults.active_plan() is None
+
+    pkg_root = os.path.join(os.path.dirname(__file__), "..", "kueue_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(os.path.abspath(pkg_root)):
+        for fn in files:
+            if not fn.endswith(".py") or fn == "faults.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            src = open(path).read()
+            if "faults." not in src:
+                continue
+            lines = src.splitlines()
+            for i, line in enumerate(lines):
+                if not re.search(r"faults\.(fire|corrupt_plane)\(", line):
+                    continue
+                indent = len(line) - len(line.lstrip())
+                guarded = False
+                for j in range(i - 1, max(-1, i - 40), -1):
+                    prev = lines[j]
+                    if not prev.strip():
+                        continue
+                    p_ind = len(prev) - len(prev.lstrip())
+                    if p_ind < indent:
+                        if "if faults.ENABLED" in prev:
+                            guarded = True
+                        break
+                # _read_planes runs its body unconditionally but is only
+                # a readback helper; its internal sites still guard.
+                if not guarded:
+                    offenders.append(f"{path}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "unguarded fault-injection call sites (wrap in `if "
+        f"faults.ENABLED:`): {offenders}"
+    )
+
+
+def test_disabled_fire_is_noop():
+    faults.clear()
+    # No plan installed: fire() must return without side effects.
+    faults.fire(faults.SOLVER_DISPATCH)
+    arr = np.arange(4)
+    assert faults.corrupt_plane(faults.DEVICE_READBACK, "outcome",
+                                arr) is arr
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker unit tests
+
+
+def test_breaker_trip_probe_reset_cycle():
+    now = [0.0]
+    br = CircuitBreaker(threshold=3, backoff_s=1.0, max_backoff_s=8.0,
+                        clock=lambda: now[0])
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED
+    br.record_failure()  # third consecutive: trip
+    assert br.state == OPEN and not br.allow()
+    now[0] = 0.5
+    assert not br.allow()
+    now[0] = 1.1  # past backoff: one probe
+    assert br.allow()
+    assert br.state == HALF_OPEN
+    assert not br.allow(), "only one probe in flight"
+    br.record_failure()  # probe failed: re-open, backoff doubled
+    assert br.state == OPEN
+    assert br.last_backoff_s == 2.0
+    now[0] = 1.1 + 2.0 + 0.01
+    assert br.allow()
+    br.record_success()  # probe succeeded: fully closed, backoff reset
+    assert br.state == CLOSED and br.trips == 0
+    # Next trip sequence starts from the base backoff again.
+    for _ in range(3):
+        br.record_failure()
+    assert br.last_backoff_s == 1.0
+
+
+def test_breaker_backoff_caps():
+    now = [0.0]
+    br = CircuitBreaker(threshold=1, backoff_s=1.0, max_backoff_s=4.0,
+                        clock=lambda: now[0])
+    for _ in range(6):
+        # trip, wait out the backoff, fail the probe, repeat
+        br.record_failure()
+        now[0] += br.last_backoff_s + 0.01
+        assert br.allow()
+    assert br.last_backoff_s == 4.0
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(threshold=2)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == CLOSED, "non-consecutive failures must not trip"
+
+
+# ---------------------------------------------------------------------------
+# Plane validation unit tests
+
+
+def _fake_idx(w=2, flavors=("f0", "f1"), admitted=()):
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        workloads=[SimpleNamespace() for _ in range(w)],
+        flavors=list(flavors),
+        admitted=list(admitted),
+        slots=None,
+    )
+
+
+def _valid_planes(w=2):
+    outcome = np.zeros(w, np.int32)  # OUT_NOFIT
+    chosen = np.zeros(w, np.int32)
+    tried = np.zeros(w, np.int32)
+    return outcome, chosen, tried
+
+
+def _validate(idx, outcome, chosen, tried, partial=None, victims=None,
+              variants=None, s_flavor=None):
+    DeviceScheduler._validate_planes(
+        None, outcome, chosen, tried, partial, victims, variants,
+        s_flavor, idx,
+    )
+
+
+def test_validate_accepts_clean_planes():
+    idx = _fake_idx()
+    _validate(idx, *_valid_planes())
+
+
+@pytest.mark.parametrize("case,mutate", [
+    ("outcome-domain", lambda o, c, t: o.__setitem__(0, 99)),
+    ("outcome-domain", lambda o, c, t: o.__setitem__(1, -5)),
+    ("tried-bounds", lambda o, c, t: t.__setitem__(0, 7)),
+    ("tried-bounds", lambda o, c, t: t.__setitem__(1, -2)),
+])
+def test_validate_rejects_domain_garbage(case, mutate):
+    idx = _fake_idx()
+    outcome, chosen, tried = _valid_planes()
+    mutate(outcome, chosen, tried)
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried)
+    assert ei.value.check == case
+
+
+def test_validate_rejects_bad_admitted_flavor():
+    from kueue_tpu.models import batch_scheduler as bs
+
+    idx = _fake_idx()
+    outcome, chosen, tried = _valid_planes()
+    outcome[0] = bs.OUT_ADMITTED
+    chosen[0] = 5  # only 2 flavors exist
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried)
+    assert ei.value.check == "flavor-bounds"
+
+
+def test_validate_rejects_nan_and_truncated_planes():
+    idx = _fake_idx()
+    outcome, chosen, tried = _valid_planes()
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried,
+                  partial=np.array([np.nan, 0.0]))
+    assert ei.value.check == "nan"
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, np.zeros(1, np.int32), chosen, tried)
+    assert ei.value.check == "shape"
+
+
+def test_validate_rejects_empty_and_out_of_range_victims():
+    from kueue_tpu.models import batch_scheduler as bs
+
+    idx = _fake_idx(admitted=[object()])  # one admitted row
+    outcome, chosen, tried = _valid_planes()
+    outcome[0] = bs.OUT_PREEMPTING
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried)
+    assert ei.value.check == "victims-missing"
+    victims = np.zeros((2, 3), bool)
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried, victims=victims)
+    assert ei.value.check == "victims-empty"
+    victims[0, 2] = True  # index 2 >= 1 admitted row
+    with pytest.raises(PlaneValidationError) as ei:
+        _validate(idx, outcome, chosen, tried, victims=victims)
+    assert ei.value.check == "victim-bounds"
+
+
+# ---------------------------------------------------------------------------
+# Driver containment differentials: faulty device run == fault-free host run
+
+
+def run_device_with_faults(seed: int, plan: faults.FaultPlan):
+    flavor_specs, cohorts, cqs, workloads = random_scenario(seed)
+    cache, queues, _ = build_env(cqs, cohorts=cohorts,
+                                 flavors=flavor_specs)
+    dsched = DeviceScheduler(cache, queues)
+    submit(queues, *workloads)
+    faults.install(plan)
+    try:
+        dsched.schedule_all()
+    finally:
+        faults.clear()
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    return admissions, sorted(admissions), dsched
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_solver_raise_faults_keep_outcomes_bit_identical(seed):
+    """20% dispatch raises (+ occasional snapshot/arena faults): contained
+    cycles reroute through the host-exact path, so the final admitted set
+    and flavor assignments match the fault-free host-only run exactly."""
+    host_adm, host_names = run_host(seed)
+    plan = faults.FaultPlan(seed=seed)
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", rate=0.2)
+    plan.add(faults.CACHE_SNAPSHOT, mode="raise", rate=0.05)
+    plan.add(faults.ARENA_DELTA_APPLY, mode="raise", rate=0.2)
+    dev_adm, dev_names, dsched = run_device_with_faults(seed, plan)
+    assert dev_names == host_names
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_corrupted_readback_planes_keep_outcomes_bit_identical(seed):
+    """Corrupt result planes at 20%: validation rejects out-of-domain
+    garbage BEFORE any admission applies and the cycle replays host-side —
+    outcomes stay bit-identical to the fault-free host run."""
+    host_adm, host_names = run_host(seed)
+    plan = faults.FaultPlan(seed=seed)
+    plan.add(faults.DEVICE_READBACK, mode="corrupt", rate=0.2,
+             planes=("outcome", "tried", "victims", "partial"))
+    dev_adm, dev_names, dsched = run_device_with_faults(seed, plan)
+    assert dev_names == host_names
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name]
+
+
+def test_corrupted_outcome_plane_is_caught_and_contained():
+    """Deterministic corruption (rate 1.0, once): the validator must flag
+    the plane, the fallback counter must tick, and outcomes must still
+    match the host run."""
+    seed = 3
+    host_adm, host_names = run_host(seed)
+
+    def smash_row0(rng, plane, a):
+        # The default corrupter picks random indices, which can land
+        # entirely on padded rows beyond the live W range (harmless by
+        # design); pin the corruption to a live row so validation MUST
+        # trip.
+        a.flat[0] = 99
+        return a
+
+    plan = faults.FaultPlan(seed=seed)
+    plan.add(faults.DEVICE_READBACK, mode="corrupt", times=1,
+             planes=("outcome",), corrupt=smash_row0)
+    dev_adm, dev_names, dsched = run_device_with_faults(seed, plan)
+    assert plan.fired(faults.DEVICE_READBACK, "corrupt") == 1
+    assert dsched.fault_fallback_cycles >= 1
+    assert dsched.last_fault is not None
+    assert dsched.last_fault[0] == "plane_validation"
+    assert dev_names == host_names
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name]
+
+
+def test_assertion_errors_are_never_contained():
+    """AssertionError is the verify-mode differential signal — containment
+    must let it surface, not launder it into a host fallback."""
+    cq = make_cq("cq0")
+    cache, queues, _ = build_env([cq])
+    ds = DeviceScheduler(cache, queues)
+    plan = faults.FaultPlan()
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", exc=AssertionError)
+    submit(queues, make_wl("wl0", queue="lq-cq0", cpu_m=100))
+    faults.install(plan)
+    with pytest.raises(AssertionError):
+        ds.schedule()
+
+
+def test_containment_off_reraises():
+    cq = make_cq("cq0")
+    cache, queues, _ = build_env([cq])
+    ds = DeviceScheduler(cache, queues, containment=False)
+    plan = faults.FaultPlan()
+    plan.add(faults.SOLVER_DISPATCH, mode="raise")
+    submit(queues, make_wl("wl0", queue="lq-cq0", cpu_m=100))
+    faults.install(plan)
+    with pytest.raises(faults.InjectedFault):
+        ds.schedule()
+
+
+def test_driver_breaker_trips_reroutes_and_reprobes():
+    """K consecutive device failures trip the breaker to all-host cycles;
+    past the backoff, one probe re-enters the device path and a success
+    closes the breaker — with the arena re-captured from scratch."""
+    now = [0.0]
+
+    def clock():
+        now[0] += 0.001
+        return now[0]
+
+    cq = make_cq("cq0")
+    cache, queues, _ = build_env([cq])
+    ds = DeviceScheduler(cache, queues, clock=clock, breaker_threshold=2,
+                         breaker_backoff_s=10.0)
+    plan = faults.FaultPlan()
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", times=2)
+    faults.install(plan)
+
+    for i in range(2):
+        submit(queues, make_wl(f"wl{i}", queue="lq-cq0", cpu_m=100))
+        ds.schedule()
+    assert ds.fault_fallback_cycles == 2
+    assert ds._breaker.state == OPEN
+    # Workloads were admitted host-side despite the device failures.
+    assert len(cache.workloads) == 2
+
+    # Open breaker: the device path is not consulted at all.
+    evaluated = plan.evaluated[faults.SOLVER_DISPATCH]
+    submit(queues, make_wl("wl2", queue="lq-cq0", cpu_m=100))
+    ds.schedule()
+    assert plan.evaluated[faults.SOLVER_DISPATCH] == evaluated
+    assert len(cache.workloads) == 3
+
+    # Past the backoff the probe cycle runs the device path again (the
+    # raise rule is spent, so it succeeds) and fully closes the breaker.
+    now[0] += 10.0
+    submit(queues, make_wl("wl3", queue="lq-cq0", cpu_m=100))
+    ds.schedule()
+    assert plan.evaluated[faults.SOLVER_DISPATCH] == evaluated + 1
+    assert ds._breaker.state == CLOSED
+    assert len(cache.workloads) == 4
+    # The failure invalidated the arena: the probe cycle re-captured from
+    # scratch (gate reason "cold"), not from stale device state.
+    if ds._arena is not None:
+        assert ds._arena.last_stats.get("path") == "full"
+        assert ds._arena.last_stats.get("reason") == "cold"
+
+
+def test_arena_invalidate_clears_committed_state():
+    cq = make_cq("cq0")
+    cache, queues, _ = build_env([cq])
+    ds = DeviceScheduler(cache, queues)
+    submit(queues, make_wl("wl0", queue="lq-cq0", cpu_m=100))
+    ds.schedule()
+    arena = ds._arena
+    if arena is None:
+        pytest.skip("arena disabled")
+    arena.component_cache["sentinel"] = object()
+    arena.invalidate("test")
+    assert arena._committed is False
+    assert arena._pending_events is None
+    assert "sentinel" not in arena.component_cache
+    assert arena.last_stats == {"path": "invalidated", "reason": "test"}
+
+
+# ---------------------------------------------------------------------------
+# Remote seam: transport drops, deadlines, breaker
+
+
+def _worker_pair(tmp_path):
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.remote import RemoteWorkerClient, serve_worker
+
+    mgr = Manager()
+    sock = str(tmp_path / "w.sock")
+    server = serve_worker(mgr, sock)
+    return mgr, server, sock, RemoteWorkerClient
+
+
+def test_transport_drops_up_to_20pct_are_absorbed(tmp_path):
+    """Injected connection drops at 20% per attempt: the retry/backoff
+    machinery absorbs them and every logical op still completes."""
+    mgr, server, sock, Client = _worker_pair(tmp_path)
+    try:
+        client = Client(sock, retries=5, backoff_s=0.001)
+        plan = faults.FaultPlan(seed=11)
+        plan.add(faults.REMOTE_TRANSPORT, mode="raise", rate=0.2,
+                 exc=ConnectionError)
+        faults.install(plan)
+        from .helpers import make_wl
+
+        for i in range(20):
+            wl = make_wl(f"wl{i}", queue="lq", cpu_m=100)
+            client.create_workload(wl)
+            assert client.workloads.get(wl.key) is not None
+        assert plan.fired(faults.REMOTE_TRANSPORT) > 0, (
+            "the 20% drop schedule never fired — the test exercised "
+            "nothing"
+        )
+        assert len(mgr.workloads) == 20
+        assert client.breaker.state == CLOSED
+    finally:
+        faults.clear()
+        server.shutdown()
+
+
+def test_dead_worker_trips_breaker_to_fast_fail(tmp_path):
+    from kueue_tpu.remote.client import RemoteWorkerClient, WorkerUnreachable
+
+    now = [0.0]
+    br = CircuitBreaker(threshold=2, backoff_s=5.0, clock=lambda: now[0])
+    client = RemoteWorkerClient(str(tmp_path / "nope.sock"), retries=0,
+                                backoff_s=0.001, breaker=br)
+    for _ in range(2):
+        with pytest.raises(WorkerUnreachable):
+            client._call({"op": "ping"})
+    assert br.state == OPEN
+    # Fast-fail: no connect attempt is made while open.
+    with pytest.raises(WorkerUnreachable, match="breaker open"):
+        client._call({"op": "ping"})
+    # Past the backoff a live worker closes the breaker again.
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.remote import serve_worker
+
+    server = serve_worker(Manager(), client.socket_path)
+    try:
+        now[0] = 5.1
+        assert client.ping() is True
+        assert br.state == CLOSED
+    finally:
+        server.shutdown()
+
+
+def test_worker_op_error_is_not_a_transport_failure(tmp_path):
+    """A raise injected in worker-side dispatch comes back as an error
+    RESPONSE: the client surfaces RuntimeError but the transport breaker
+    must stay closed (the worker is reachable)."""
+    mgr, server, sock, Client = _worker_pair(tmp_path)
+    try:
+        client = Client(sock, retries=0)
+        plan = faults.FaultPlan()
+        plan.add(faults.REMOTE_DISPATCH, mode="raise", times=1)
+        faults.install(plan)
+        with pytest.raises(RuntimeError):
+            client.schedule()
+        assert client.breaker.state == CLOSED
+        assert client.breaker.failures == 0
+        faults.clear()
+        client.schedule()  # worker healthy again
+    finally:
+        faults.clear()
+        server.shutdown()
+
+
+def test_slow_worker_hits_op_deadline(tmp_path):
+    """A delay injected in worker dispatch beyond the client's op_timeout
+    surfaces as WorkerUnreachable via the per-op socket deadline instead
+    of wedging the caller."""
+    from kueue_tpu.remote.client import WorkerUnreachable
+
+    mgr, server, sock, Client = _worker_pair(tmp_path)
+    try:
+        client = Client(sock, retries=0, op_timeout=0.2,
+                        connect_timeout=0.2)
+        plan = faults.FaultPlan()
+        plan.add(faults.REMOTE_DISPATCH, mode="delay", delay_s=1.0,
+                 times=1)
+        faults.install(plan)
+        t0 = time.perf_counter()
+        with pytest.raises(WorkerUnreachable):
+            client._call({"op": "ping"})
+        assert time.perf_counter() - t0 < 0.9
+        assert client.breaker.failures == 1
+    finally:
+        faults.clear()
+        server.shutdown()
+
+
+def test_grpc_deadline_and_breaker(tmp_path):
+    pytest.importorskip("grpc")
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.remote.client import WorkerUnreachable
+    from kueue_tpu.remote.grpc_transport import (
+        GrpcWorkerClient,
+        serve_worker_grpc,
+    )
+
+    server, bound = serve_worker_grpc(Manager(), "127.0.0.1:0")
+    try:
+        client = GrpcWorkerClient(bound, retries=0, op_timeout=0.2,
+                                  connect_timeout=0.2)
+        plan = faults.FaultPlan()
+        plan.add(faults.REMOTE_DISPATCH, mode="delay", delay_s=1.0,
+                 times=1)
+        faults.install(plan)
+        with pytest.raises(WorkerUnreachable):
+            client._call({"op": "schedule"})
+        assert client.breaker.failures == 1
+        faults.clear()
+        # The timed-out dispatch is still sleeping server-side holding the
+        # dispatch lock; a generous deadline lets recovery queue behind it.
+        client._call({"op": "ping"}, timeout=10.0)
+        assert client.breaker.state == CLOSED
+    finally:
+        faults.clear()
+        server.stop(0)

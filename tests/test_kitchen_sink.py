@@ -22,8 +22,14 @@ from kueue_tpu.controllers.provisioning import ProvisioningController
 from kueue_tpu.core.workload_info import is_admitted, is_evicted
 from kueue_tpu.manager import Manager
 
+import pytest
+
 from .helpers import make_cq
 from .test_tas import LEVELS, make_nodes, make_topology
+
+# Compile-heavy: run in its own subprocess via tools/run_isolated.py so a
+# jaxlib cumulative-compile segfault can't take down the bulk suite.
+pytestmark = pytest.mark.isolated
 
 
 def test_kitchen_sink_end_to_end():

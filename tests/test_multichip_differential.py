@@ -19,6 +19,10 @@ from kueue_tpu.parallel import sharding as par
 from .helpers import build_env, submit
 from .test_device_differential import random_scenario
 
+# Compile-heavy: run in its own subprocess via tools/run_isolated.py so a
+# jaxlib cumulative-compile segfault can't take down the bulk suite.
+pytestmark = pytest.mark.isolated
+
 
 def encode_scenario(seed: int):
     flavor_specs, cohorts, cqs, workloads = random_scenario(seed)

@@ -95,6 +95,23 @@ def check_file(path: Path, allowlist: frozenset) -> List[Tuple[int, str]]:
     return violations
 
 
+def check_docs_coverage(allowlist: frozenset) -> List[str]:
+    """Every allowlisted series must be documented: names.py's contract is
+    "adding a metric means adding it here AND to docs/observability.md".
+    An undocumented series is invisible to operators — dashboards are
+    built from the doc, not from grepping emission sites."""
+    doc_path = REPO_ROOT / "docs" / "observability.md"
+    if not doc_path.exists():
+        return [f"{doc_path.relative_to(REPO_ROOT)}: missing"]
+    doc = doc_path.read_text()
+    return [
+        f"docs/observability.md: series {name!r} is in METRIC_NAMES but "
+        "undocumented"
+        for name in sorted(allowlist)
+        if name not in doc
+    ]
+
+
 def run_check() -> List[str]:
     """Returns human-readable violation lines; empty list = clean."""
     sys.path.insert(0, str(REPO_ROOT))
@@ -107,6 +124,7 @@ def run_check() -> List[str]:
         for lineno, msg in check_file(path, METRIC_NAMES):
             rel = path.relative_to(REPO_ROOT)
             out.append(f"{rel}:{lineno}: {msg}")
+    out.extend(check_docs_coverage(METRIC_NAMES))
     return out
 
 

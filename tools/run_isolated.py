@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Full-suite runner that shields the bulk run from the jaxlib
+cumulative-compile segfault.
+
+VERDICT round 5: a full single-process ``pytest tests/`` run intermittently
+dies with SIGSEGV inside jaxlib after enough cumulative XLA compilation —
+always in one of a few compile-heavy files, each of which passes cleanly
+standalone (the persistent compile cache is already disabled in
+tests/conftest.py for the same reason). The fix is process isolation:
+
+1. the bulk of the suite runs once with ``-m "(not slow) and not
+   isolated"`` — the compile-heavy files are marked
+   ``pytest.mark.isolated`` at module level and skipped here;
+2. each isolated file then runs in its own fresh subprocess, so its
+   compilation burden starts from zero and a crash kills only that
+   segment;
+3. any segment that dies on a *signal* (segfault, not a test failure) is
+   retried once in a fresh process before being counted as failed.
+
+Exit status is 0 iff every segment passed. Extra pytest args after ``--``
+are forwarded to every segment (e.g. ``tools/run_isolated.py -- -q``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+TESTS = REPO_ROOT / "tests"
+
+BASE_ARGS = [
+    "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+    "--continue-on-collection-errors",
+]
+
+
+def isolated_files() -> list:
+    """Discover the isolated set from the marks themselves, so marking a
+    new file is the only step (no second list to update here)."""
+    out = []
+    for path in sorted(TESTS.glob("test_*.py")):
+        text = path.read_text()
+        if "pytestmark = pytest.mark.isolated" in text:
+            out.append(path)
+    return out
+
+
+def run_segment(label: str, args: list, extra: list) -> int:
+    """Run one pytest segment in a fresh subprocess, streaming output.
+    Returns the exit code; a signal death (rc < 0, or 128+sig from a
+    shell) is retried once in another fresh process."""
+    cmd = [sys.executable, "-m", "pytest", *BASE_ARGS, *args, *extra]
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    for attempt in (1, 2):
+        print(f"== [{label}] attempt {attempt}: {' '.join(cmd)}",
+              flush=True)
+        rc = subprocess.call(cmd, cwd=str(REPO_ROOT), env=env)
+        if rc == 5:
+            # No tests collected (e.g. every test in the segment is
+            # deselected by the -m expression): vacuously green.
+            return 0
+        if rc >= 0 and rc != 139:
+            return rc
+        print(f"== [{label}] died on a signal (rc={rc}); retrying in a "
+              "fresh process", flush=True)
+    return rc
+
+
+def main(argv: list) -> int:
+    extra = []
+    if "--" in argv:
+        split = argv.index("--")
+        extra = argv[split + 1:]
+        argv = argv[:split]
+    if argv:
+        print(f"unknown arguments {argv!r}; pass pytest args after --",
+              file=sys.stderr)
+        return 2
+
+    failures = []
+    rc = run_segment(
+        "bulk",
+        ["tests/", "-m", "(not slow) and not isolated"],
+        extra,
+    )
+    if rc != 0:
+        failures.append(("bulk", rc))
+    for path in isolated_files():
+        rel = path.relative_to(REPO_ROOT)
+        rc = run_segment(str(rel), [str(rel), "-m", "not slow"], extra)
+        if rc != 0:
+            failures.append((str(rel), rc))
+
+    print("\n== run_isolated summary")
+    if not failures:
+        print("all segments passed")
+        return 0
+    for label, rc in failures:
+        print(f"FAILED segment {label} (rc={rc})")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
