@@ -359,6 +359,109 @@ def cmd_import(mgr: Manager, args) -> int:
     return 0
 
 
+def _parse_requests(spec: str) -> dict:
+    """``res=qty[,res=qty]`` -> canonical ints."""
+    from kueue_tpu.api.serialization import parse_quantity
+
+    out = {}
+    for pair in spec.split(","):
+        res, sep, qty = pair.partition("=")
+        if not sep:
+            raise ValueError(f"bad quantity {pair!r} in --requests")
+        out[res.strip()] = parse_quantity(qty.strip(), res.strip())
+    return out
+
+
+def _parse_quota_delta(spec: str):
+    """``node:flavor:res=+qty`` / ``...=-qty`` -> QuotaDelta. ``node``
+    may name a ClusterQueue or a Cohort."""
+    from kueue_tpu.api.serialization import parse_quantity
+    from kueue_tpu.whatif import QuotaDelta
+
+    head, sep, qty = spec.partition("=")
+    parts = head.split(":")
+    if not sep or len(parts) != 3 or not all(parts):
+        raise ValueError(
+            f"--quota-delta must look like node:flavor:res=+qty; "
+            f"got {spec!r}"
+        )
+    qty = qty.strip()
+    sign = -1 if qty.startswith("-") else 1
+    mag = parse_quantity(qty.lstrip("+-"), parts[2])
+    return QuotaDelta(
+        node=parts[0], flavor=parts[1], resource=parts[2],
+        delta=sign * mag,
+    )
+
+
+def cmd_whatif(mgr: Manager, args) -> int:
+    """Counterfactual forecasts from the what-if engine (docs/whatif.md):
+    admission ETAs, capacity probes, preemption previews."""
+    from kueue_tpu.whatif import Scenario
+
+    engine = mgr.whatif()
+    if args.whatif_cmd == "eta":
+        report = engine.eta(cluster_queue=args.cluster_queue or None)
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2))
+            return 0
+        base = report.base
+        rows = [
+            [w.key, w.cluster_queue, w.basis,
+             "-" if w.eta_ms is None else w.eta_ms,
+             w.flavor or "-",
+             "-" if w.position is None else w.position]
+            for w in base.workloads
+        ]
+        _print_table(rows, ["WORKLOAD", "CLUSTERQUEUE", "BASIS",
+                            "ETA(MS)", "FLAVOR", "POS"])
+        print(f"basis={report.basis} "
+              f"admitted_within_horizon={base.admitted_within_horizon} "
+              f"pending_after={base.pending_after}"
+              + (f" fallback_reason={report.reason}" if report.reason
+                 else ""))
+        return 0
+    if args.whatif_cmd == "capacity":
+        scens = []
+        for spec in args.quota_delta:
+            scens.append(Scenario(
+                kind="quota", label=spec,
+                quota_deltas=(_parse_quota_delta(spec),),
+            ))
+        for node in args.drain_node:
+            scens.append(Scenario(
+                kind="drain", label=f"drain:{node}", drain_node=node,
+            ))
+        if not scens:
+            print("capacity needs --quota-delta and/or --drain-node",
+                  file=sys.stderr)
+            return 1
+        report = engine.eta(
+            scenarios=scens, cluster_queue=args.cluster_queue or None
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0
+    if args.whatif_cmd == "preview":
+        from kueue_tpu.api.types import PodSet, Workload
+
+        wl = Workload(
+            name=args.name,
+            namespace=args.namespace,
+            queue_name=args.queue,
+            priority=args.priority,
+            pod_sets=[PodSet(
+                name="main", count=args.count,
+                requests=_parse_requests(args.requests),
+            )],
+        )
+        report = engine.preview(
+            wl, cluster_queue=args.cluster_queue or None
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0
+    return 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="kueuectl-tpu")
     ap.add_argument("--manifests", action="append", default=[],
@@ -428,6 +531,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_perf.add_argument("generator")
     p_perf.add_argument("--rangespec", default=None)
 
+    p_whatif = sub.add_parser(
+        "whatif", help="counterfactual forecasts (docs/whatif.md)"
+    )
+    whatif_sub = p_whatif.add_subparsers(dest="whatif_cmd", required=True)
+    w_eta = whatif_sub.add_parser("eta")
+    w_eta.add_argument("--cluster-queue", default="")
+    w_eta.add_argument("--json", action="store_true")
+    w_cap = whatif_sub.add_parser("capacity")
+    w_cap.add_argument("--quota-delta", action="append", default=[],
+                       help="node:flavor:res=+qty (repeatable)")
+    w_cap.add_argument("--drain-node", action="append", default=[])
+    w_cap.add_argument("--cluster-queue", default="")
+    w_prev = whatif_sub.add_parser("preview")
+    w_prev.add_argument("name")
+    w_prev.add_argument("--queue", default="")
+    w_prev.add_argument("--cluster-queue", default="")
+    w_prev.add_argument("--namespace", default="default")
+    w_prev.add_argument("--priority", type=int, default=0)
+    w_prev.add_argument("--count", type=int, default=1)
+    w_prev.add_argument("--requests", default="cpu=1",
+                        help="res=qty[,res=qty]")
+
     args = ap.parse_args(argv)
     mgr = build_manager(args.manifests)
 
@@ -461,6 +586,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_schedule(mgr, args)
     if args.cmd == "import":
         return cmd_import(mgr, args)
+    if args.cmd == "whatif":
+        try:
+            return cmd_whatif(mgr, args)
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
     if args.cmd == "describe":
         kind = args.resource.lower()
         if kind in ("workload", "wl"):

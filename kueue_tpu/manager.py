@@ -126,6 +126,19 @@ class Manager:
         from kueue_tpu.controllers.tas_failure import TASNodeFailureController
 
         self.tas_failure = TASNodeFailureController(self)
+        self._whatif = None
+
+    def whatif(self):
+        """Lazily built what-if forecasting engine over this manager's
+        cache and queues (docs/whatif.md). Read-only: forecasts never
+        mutate scheduler state."""
+        if self._whatif is None:
+            from kueue_tpu.whatif import WhatIfEngine
+
+            self._whatif = WhatIfEngine(
+                self.cache, self.queues, clock=self.clock
+            )
+        return self._whatif
 
     # ------------------------------------------------------------------
     # configuration objects

@@ -98,6 +98,10 @@ TRACING_SERIES = frozenset({
     "solver_breaker_state",
     "solver_plane_validation_failures_total",
     "remote_deadline_exceeded_total",
+    # What-if forecasting (whatif/engine.py).
+    "whatif_rollout_seconds",
+    "whatif_scenarios_total",
+    "whatif_fallback_total",
 })
 
 METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES

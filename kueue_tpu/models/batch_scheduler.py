@@ -1062,6 +1062,19 @@ class GroupArrays(NamedTuple):
     chain_local: jnp.ndarray  # i32[G,Nm,D+1] local-id ancestor chains
 
 
+class AdmitScanResult(NamedTuple):
+    """Result of :func:`admit_scan_grouped` (a pytree — flows through
+    jit/scan unchanged; fields formerly threaded as a positional
+    6-tuple)."""
+
+    usage: jnp.ndarray  # [N,F,R] final usage after reservations
+    admitted: jnp.ndarray  # bool[W]
+    preempting: jnp.ndarray  # bool[W] reserved-pending-preemption
+    tas_takes: jnp.ndarray  # i32[W,D] or None — pods per leaf domain
+    tas_leader_takes: jnp.ndarray  # i32[W,D] or None
+    s_tas_takes: jnp.ndarray  # i32[W,S,D] or None
+
+
 def admit_scan_grouped(
     arrays: CycleArrays,
     ga: GroupArrays,
@@ -1074,7 +1087,7 @@ def admit_scan_grouped(
     unroll: int = 2,
     n_levels: int = MAX_DEPTH + 1,
     mesh=None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> "AdmitScanResult":
     """Forest-parallel admission scan.
 
     With ``mesh`` the scan shards over the GROUP axis instead of
@@ -1104,10 +1117,9 @@ def admit_scan_grouped(
     reserve their usage and designate their victims, and overlapping ones
     are skipped (scheduler.go:385 _process_entry).
 
-    Returns (final_usage, admitted bool[W], preempting bool[W],
-    tas_takes i32[W+1,D] or None — pods placed per leaf domain for
-    admitted TAS entries, decoded by the driver into
-    TopologyAssignments).
+    Returns an :class:`AdmitScanResult` (final usage, admitted/preempting
+    masks, and the per-leaf-domain TAS take planes decoded by the driver
+    into TopologyAssignments).
     """
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
@@ -1693,8 +1705,14 @@ def admit_scan_grouped(
     tas_takes = w_takes_f[:w_n] if with_tas else None
     tas_leader_takes = w_ltakes_f[:w_n] if with_leader else None
     s_tas_takes = w_stakes_f[:w_n] if with_stas else None
-    return final_usage, admitted, preempting_out, tas_takes, \
-        tas_leader_takes, s_tas_takes
+    return AdmitScanResult(
+        usage=final_usage,
+        admitted=admitted,
+        preempting=preempting_out,
+        tas_takes=tas_takes,
+        tas_leader_takes=tas_leader_takes,
+        s_tas_takes=s_tas_takes,
+    )
 
 
 def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
@@ -1946,15 +1964,16 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 arrays, nom, partial_count, _ = apply_partial(arrays, nom)
             order = admission_order(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            (final_usage, admitted, preempting, tas_takes, tas_ltakes,
-             s_takes) = admit_scan_grouped(
+            res = admit_scan_grouped(
                 arrays, ga, nom, usage, order, s, unroll=unroll,
                 n_levels=n_levels, mesh=mesh,
             )
-            return finish(arrays, nom, final_usage, admitted, preempting,
-                          order, partial_count=partial_count,
-                          tas_takes=tas_takes, tas_leader_takes=tas_ltakes,
-                          s_tas_takes=s_takes)
+            return finish(arrays, nom, res.usage, res.admitted,
+                          res.preempting, order,
+                          partial_count=partial_count,
+                          tas_takes=res.tas_takes,
+                          tas_leader_takes=res.tas_leader_takes,
+                          s_tas_takes=res.s_tas_takes)
 
         return impl
 
@@ -2068,15 +2087,17 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                 tgt = tgt2
         order = admission_order(arrays, nom)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        (final_usage, admitted, preempting, tas_takes,
-         tas_ltakes, s_takes) = admit_scan_grouped(
+        res = admit_scan_grouped(
             arrays, ga, nom, usage, order, s, adm=adm, targets=tgt,
             unroll=unroll, n_levels=n_levels, mesh=mesh,
         )
-        return finish(arrays, nom, final_usage, admitted, preempting, order,
+        return finish(arrays, nom, res.usage, res.admitted,
+                      res.preempting, order,
                       victims=tgt.victims, variant=tgt.variant,
-                      partial_count=partial_count, tas_takes=tas_takes,
-                      tas_leader_takes=tas_ltakes, s_tas_takes=s_takes)
+                      partial_count=partial_count,
+                      tas_takes=res.tas_takes,
+                      tas_leader_takes=res.tas_leader_takes,
+                      s_tas_takes=res.s_tas_takes)
 
     return impl_preempt
 
@@ -2142,6 +2163,34 @@ def _seg_excl_prefix(sorted_vals, head):
         jnp.where(head_b, excl, 0), mode="drop"
     )
     return excl - base[seg_ids]
+
+
+def _vmem_barrier(x):
+    """optimization_barrier with a registered vmap rule. The primitive
+    ships without one (NotImplementedError: Batching rule for
+    'optimization_barrier'), which broke vmapping admit_fixedpoint from
+    the what-if engine's batched rollout. The barrier is semantically the
+    identity, so batching it is just binding it on the batched operands
+    with the batch dims passed through."""
+    return jax.lax.optimization_barrier(x)
+
+
+def _register_barrier_batching() -> None:
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - jax internals moved
+        return
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _rule(args, dims):
+        return optimization_barrier_p.bind(*args), dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _rule
+
+
+_register_barrier_batching()
 
 
 def admit_fixedpoint(
@@ -2246,9 +2295,7 @@ def admit_fixedpoint(
             # The barrier keeps XLA from fusing every level's segmented
             # prefix into one kernel, whose combined scoped buffers
             # overflow the TPU's 16M vmem scratch limit.
-            avail = jax.lax.optimization_barrier(
-                jnp.minimum(avail, term)
-            )
+            avail = _vmem_barrier(jnp.minimum(avail, term))
         return avail  # [W,R]
 
     def body(state):

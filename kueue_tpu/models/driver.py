@@ -114,8 +114,21 @@ class DeviceScheduler:
         )
         self.fault_fallback_cycles = 0
         self.last_fault: Optional[Tuple[str, str]] = None
+        # Optional what-if engine refreshed in spare time (attach_whatif).
+        self._whatif = None
+        self._whatif_interval_s = 30.0
 
     # ------------------------------------------------------------------
+
+    def attach_whatif(self, engine, refresh_interval_s: float = 30.0):
+        """Attach a WhatIfEngine (whatif/engine.py) whose cached base ETA
+        forecast is refreshed opportunistically between admission cycles:
+        only when a cycle finds no heads (the loop is quiescent), at most
+        every ``refresh_interval_s``. Forecast faults never reach the
+        admission loop — the engine contains them behind its own breaker."""
+        self._whatif = engine
+        self._whatif_interval_s = refresh_interval_s
+        return engine
 
     def schedule(self) -> CycleResult:
         self.cycles += 1
@@ -124,6 +137,8 @@ class DeviceScheduler:
         heads = self.queues.heads()
         result.head_keys = frozenset(h.key for h in heads)
         if not heads:
+            if self._whatif is not None:
+                self._whatif.maybe_refresh(self._whatif_interval_s)
             result.duration_s = self.clock() - start
             return result
 

@@ -40,6 +40,7 @@ needed.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -70,6 +71,21 @@ _INF64 = jnp.int64(1) << 61
 _F64_INF = jnp.float64(jnp.inf)
 
 
+class FairScanResult(NamedTuple):
+    """Result of :func:`fair_admit_scan` (a pytree — flows through
+    jit/scan unchanged; fields formerly threaded as a positional
+    8-tuple)."""
+
+    usage: jnp.ndarray  # [N,F,R] final usage after the tournament
+    admitted: jnp.ndarray  # bool[W]
+    preempting: jnp.ndarray  # bool[W]
+    shadowed: jnp.ndarray  # bool[W] lost to a same-CQ earlier entry
+    participated: jnp.ndarray  # bool[W] decided within s_max steps
+    win_step: jnp.ndarray  # i32[W] tournament step won at (-1 = lost)
+    tas_takes: jnp.ndarray  # i32[W,D] or None
+    s_tas_takes: jnp.ndarray  # i32[W,S,D] or None
+
+
 def fair_admit_scan(
     arrays: CycleArrays,
     nom: NominateResult,
@@ -77,12 +93,11 @@ def fair_admit_scan(
     s_max: int,
     adm=None,
     targets=None,
-):
+) -> "FairScanResult":
     """Tournament-ordered admission. With ``adm``/``targets`` (device fair
     preemption) winners resolved to P_PREEMPT_OK designate their victims
     with the host's overlap/fit semantics and consume usage like admitted
-    entries. Returns (final_usage, admitted[W], preempting[W], shadowed[W],
-    participated[W], win_step[W])."""
+    entries. Returns a :class:`FairScanResult`."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     n = tree.n_nodes
@@ -756,8 +771,16 @@ def fair_admit_scan(
         ).at[idx_w].set(
             jnp.where(p_has[:, None, None], stakes_c, 0), mode="drop"
         )
-    return (final_usage, admitted, preempting, shadowed, participated,
-            win_step, w_takes_f if with_tas else None, s_takes_f)
+    return FairScanResult(
+        usage=final_usage,
+        admitted=admitted,
+        preempting=preempting,
+        shadowed=shadowed,
+        participated=participated,
+        win_step=win_step,
+        tas_takes=w_takes_f if with_tas else None,
+        s_tas_takes=s_takes_f,
+    )
 
 
 def make_fair_cycle(s_max: int = 0, preempt: bool = False):
@@ -833,12 +856,11 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             if arrays.tas_topo is not None:
                 nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-            (final_usage, admitted, preempting, shadowed, _done,
-             win_step, tas_takes, s_tas_takes) = fair_admit_scan(
-                arrays, nom, usage, s)
-            return finish(arrays, nom, final_usage, admitted, preempting,
-                          shadowed, win_step, tas_takes=tas_takes,
-                          s_tas_takes=s_tas_takes)
+            res = fair_admit_scan(arrays, nom, usage, s)
+            return finish(arrays, nom, res.usage, res.admitted,
+                          res.preempting, res.shadowed, res.win_step,
+                          tas_takes=res.tas_takes,
+                          s_tas_takes=res.s_tas_takes)
 
         return impl
 
@@ -884,13 +906,11 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             needs_host=nom.needs_host & ~tgt.resolved,
         )
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
-        (final_usage, admitted, preempting, shadowed, _done, win_step,
-         tas_takes, s_tas_takes) = \
-            fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
-        return finish(arrays, nom, final_usage, admitted, preempting,
-                      shadowed, win_step, victims=tgt.victims,
-                      variant=tgt.variant, tas_takes=tas_takes,
-                      s_tas_takes=s_tas_takes)
+        res = fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
+        return finish(arrays, nom, res.usage, res.admitted,
+                      res.preempting, res.shadowed, res.win_step,
+                      victims=tgt.victims, variant=tgt.variant,
+                      tas_takes=res.tas_takes, s_tas_takes=res.s_tas_takes)
 
     return impl_preempt
 

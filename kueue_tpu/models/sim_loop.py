@@ -17,7 +17,7 @@ adds).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,17 +30,36 @@ from kueue_tpu.ops import quota_ops
 _T_INF = jnp.int64(1) << 60
 
 
+class SimInit(NamedTuple):
+    """Optional initial lifecycle state for :func:`make_sim_loop`.
+
+    The default start (every active entry pending, nothing running) models
+    an empty cluster. A forecast over a *live* snapshot instead seeds the
+    currently admitted workloads as already-running rows: ``running`` rows
+    must carry ``admitted_at <= 0`` (their virtual admission time, usually
+    0 = "now") and a valid ``chosen_flavor`` so the usage roll-up re-adds
+    their consumption; their remaining runtime goes in ``runtime_ms``.
+    ``pending`` and ``running`` must be disjoint."""
+
+    pending: jnp.ndarray  # bool[W]
+    running: jnp.ndarray  # bool[W]
+    admitted_at: jnp.ndarray  # i64[W] (-1 for pending rows)
+    chosen_flavor: jnp.ndarray  # i32[W] (-1 for pending rows)
+
+
 class SimOutputs(NamedTuple):
     admitted_at: jnp.ndarray  # i64[W] virtual ms (-1 = never admitted)
     completed_at: jnp.ndarray  # i64[W] virtual ms (-1 = never)
     rounds: jnp.ndarray  # i32 scheduling rounds executed
     final_vclock: jnp.ndarray  # i64 virtual ms when the simulation settled
+    chosen_flavor: jnp.ndarray = None  # i32[W] flavor at admission (-1)
 
 
 def make_sim_loop(s_max: int, max_rounds: int = 100000,
                   kernel: str = "grouped",
                   n_levels: int = quota_ops.MAX_DEPTH + 1,
-                  interpret: bool = False, mesh=None):
+                  interpret: bool = False, mesh=None,
+                  per_cq_heads: bool = False):
     """Build the jittable simulator. ``s_max`` is the per-tree admission
     scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
     admission pass: "grouped" (the sequential per-tree scan),
@@ -52,11 +71,27 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
     caller must check; ``interpret`` runs it in interpreter mode
     off-TPU), or "fair" (the DRS tournament admission — requires the
     fair fields on CycleArrays; per round each CQ is represented by its
-    last pending entry, mirroring the per-CQ-heads cycle semantics)."""
+    last pending entry, mirroring the per-CQ-heads cycle semantics).
+
+    ``per_cq_heads`` switches each round from the maximal full-batch pass
+    (every pending entry competes at once) to the live scheduler's exact
+    cycle shape: one head per ClusterQueue — the pending entry with the
+    lowest host-precomputed ``w_order_rank`` — competes per round, and a
+    head that fails is staged *inadmissible* (out of contention, so the
+    CQ's next entry gets a try) until the next completion requeues it,
+    mirroring ``QueueManager.heads()`` + the inadmissible staging. The
+    full-batch default admits a strictly priority-ordered set, which can
+    differ under cohort contention: a low-priority head of a quiet CQ is
+    admitted by the real scheduler before a higher-priority entry buried
+    deeper in a busy CQ's queue. Forecasters that must be bit-identical
+    to stepping the real scheduler (whatif/) run with this on; the
+    benchmark lifecycle probes keep the cheaper full-batch rounds."""
     assert kernel in ("grouped", "fixedpoint", "pallas", "fair")
+    _RANK_INF = jnp.int32(1) << 30
 
     def simulate(
-        arrays: CycleArrays, ga: GroupArrays, runtime_ms: jnp.ndarray
+        arrays: CycleArrays, ga: GroupArrays, runtime_ms: jnp.ndarray,
+        init: Optional[SimInit] = None,
     ) -> SimOutputs:
         w_n = arrays.w_cq.shape[0]
         tree = arrays.tree
@@ -99,21 +134,38 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             )
             return usage
 
+        if per_cq_heads:
+            assert arrays.w_order_rank is not None, (
+                "per_cq_heads needs the host-precomputed w_order_rank"
+            )
+
         def body(state):
-            (pending, running, admitted_at, completed_at, chosen_flavor,
-             vclock, rounds, _progress) = state
+            (pending, blocked, running, admitted_at, completed_at,
+             chosen_flavor, vclock, rounds, _progress) = state
 
             usage = recompute_usage(running, chosen_flavor)
-            a = arrays._replace(w_active=pending, usage=usage)
+            if per_cq_heads:
+                # One head per CQ: the eligible (pending, not staged
+                # inadmissible) row with the lowest order rank. Ranks are
+                # a permutation, so exactly one row per CQ wins.
+                eligible = pending & ~blocked
+                key = jnp.where(
+                    eligible, arrays.w_order_rank.astype(jnp.int32),
+                    _RANK_INF,
+                )
+                cq_min = jnp.full(
+                    (tree.n_nodes,), _RANK_INF, jnp.int32
+                ).at[arrays.w_cq].min(key, mode="drop")
+                active = eligible & (key == cq_min[arrays.w_cq])
+            else:
+                active = pending
+            a = arrays._replace(w_active=active, usage=usage)
             nom = bs.nominate(a, usage, n_levels=n_levels)
             if kernel == "fair":
                 from kueue_tpu.models.fair_kernel import fair_admit_scan
 
                 # The tournament orders entries itself (dynamic DRS keys).
-                (_u, admit, _pre, _shadowed, _part, _step,
-                 _tk, _stk) = fair_admit_scan(
-                    a, nom, usage, s_max
-                )
+                admit = fair_admit_scan(a, nom, usage, s_max).admitted
             elif kernel == "fixedpoint":
                 order = bs.admission_order(a, nom)
                 _u, admit, _r = bs.admit_fixedpoint(
@@ -129,12 +181,12 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 )
             else:
                 order = bs.admission_order(a, nom)
-                _u, admit, _pre, _tk, _ltk, _stk = bs.admit_scan_grouped(
+                admit = bs.admit_scan_grouped(
                     a, ga, nom, usage, order, s_max, n_levels=n_levels,
                     mesh=mesh,
-                )
+                ).admitted
 
-            newly = admit & pending
+            newly = admit & active
             any_admit = jnp.any(newly)
             pending = pending & ~newly
             running = running | newly
@@ -142,41 +194,68 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             chosen_flavor = jnp.where(
                 newly, nom.chosen_flavor, chosen_flavor
             )
+            if per_cq_heads:
+                # A failed head is staged until the next capacity event;
+                # staging IS scheduling progress (the CQ's next entry
+                # gets the following round). Advance the clock only once
+                # every eligible entry has had its try this instant.
+                failed = active & ~newly
+                blocked = blocked | failed
+                stalled = ~jnp.any(pending & ~blocked)
+                sched_progress = any_admit | jnp.any(failed)
+            else:
+                stalled = ~any_admit
+                sched_progress = any_admit
             completes = jnp.where(
                 running & (completed_at < 0),
                 admitted_at + runtime_ms,
                 _T_INF,
             )
 
-            # When no admissions: advance to the earliest completion.
+            # When stuck at this instant: advance to the earliest
+            # completion (a capacity event, which also requeues the
+            # staged inadmissible set).
             next_t = jnp.min(completes)
             can_advance = next_t < _T_INF
-            do_advance = (~any_admit) & can_advance
+            do_advance = (~any_admit) & stalled & can_advance
             new_vclock = jnp.where(do_advance, next_t, vclock)
             finishing = do_advance & running & (completes <= new_vclock)
             completed_at = jnp.where(finishing, new_vclock, completed_at)
             running = running & ~finishing
+            blocked = blocked & ~do_advance
 
-            progress = any_admit | jnp.any(finishing)
-            return (pending, running, admitted_at, completed_at,
+            progress = sched_progress | jnp.any(finishing)
+            return (pending, blocked, running, admitted_at, completed_at,
                     chosen_flavor, new_vclock, rounds + 1, progress)
 
         def cond(state):
-            (pending, running, _aa, _ca, _cf, _vc, rounds, progress) = state
+            (pending, _bl, running, _aa, _ca, _cf, _vc, rounds,
+             progress) = state
             return progress & (rounds < max_rounds) & jnp.any(pending)
 
-        init = (
-            arrays.w_active,  # pending
-            jnp.zeros(w_n, bool),  # running
-            jnp.full(w_n, -1, jnp.int64),  # admitted_at
+        if init is None:
+            pending0 = arrays.w_active
+            running0 = jnp.zeros(w_n, bool)
+            admitted_at0 = jnp.full(w_n, -1, jnp.int64)
+            chosen0 = jnp.full(w_n, -1, jnp.int32)
+        else:
+            pending0 = init.pending
+            running0 = init.running
+            admitted_at0 = init.admitted_at.astype(jnp.int64)
+            chosen0 = init.chosen_flavor.astype(jnp.int32)
+        state0 = (
+            pending0,
+            jnp.zeros(w_n, bool),  # blocked (inadmissible staging)
+            running0,
+            admitted_at0,
             jnp.full(w_n, -1, jnp.int64),  # completed_at
-            jnp.full(w_n, -1, jnp.int32),  # chosen flavor
+            chosen0,
             jnp.int64(0),  # vclock
             jnp.int32(0),  # rounds
             jnp.bool_(True),  # progress
         )
-        (pending, running, admitted_at, completed_at, chosen, vclock,
-         rounds, _p) = jax.lax.while_loop(cond, body, init)
+        (pending, _blocked, running, admitted_at, completed_at, chosen,
+         vclock, rounds, _p) = jax.lax.while_loop(cond, body, state0)
         # Drain: anything still running completes at its scheduled time.
         final_completes = jnp.where(
             running, admitted_at + runtime_ms, completed_at
@@ -189,6 +268,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
             completed_at=final_completes,
             rounds=rounds,
             final_vclock=final_vclock,
+            chosen_flavor=chosen,
         )
 
     return simulate

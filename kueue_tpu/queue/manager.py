@@ -331,3 +331,16 @@ class QueueManager:
         with self._lock:
             cqh = self.cluster_queues.get(cq_name)
             return cqh.pending() if cqh else 0
+
+    def pending_workloads_all(self, cq_name: str) -> List[WorkloadInfo]:
+        """Active AND inadmissible pending entries in head order. The
+        forecasting view: inadmissible workloads requeue on the next
+        capacity event, so a virtual-time rollout must include them."""
+        with self._lock:
+            cqh = self.cluster_queues.get(cq_name)
+            if cqh is None:
+                return []
+            return sorted(
+                list(cqh._items.values()) + list(cqh.inadmissible.values()),
+                key=_order_key,
+            )

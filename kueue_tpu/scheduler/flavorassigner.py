@@ -7,8 +7,9 @@ borrow level (cohort-subtree height), flavor-fungibility stop rules, and the
 preemption-oracle probe for Preempt mode.
 
 This is the general/fallback path and the differential-test oracle for the
-batched device assigner in kueue_tpu/models/assign (which handles the dense
-common case: single-podset workloads, one resource group).
+vectorized device assigner (`nominate` in
+kueue_tpu/models/batch_scheduler.py, which handles the dense common case:
+single-podset workloads, one resource group).
 """
 
 from __future__ import annotations

@@ -26,6 +26,8 @@ anything else so a typo'd point never silently no-ops):
 - ``remote.transport``  — client-side socket/gRPC call attempts
 - ``remote.dispatch``   — worker-side op dispatch (slow/failing worker)
 - ``cache.snapshot``    — the device path's snapshot acquisition
+- ``whatif.dispatch``   — the what-if engine's batched forecast dispatch
+  (whatif/engine.py; degrades to the queue-position heuristic)
 
 Rule modes:
 
@@ -76,6 +78,7 @@ DEVICE_READBACK = "device.readback"
 REMOTE_TRANSPORT = "remote.transport"
 REMOTE_DISPATCH = "remote.dispatch"
 CACHE_SNAPSHOT = "cache.snapshot"
+WHATIF_DISPATCH = "whatif.dispatch"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -84,6 +87,7 @@ POINTS = frozenset({
     REMOTE_TRANSPORT,
     REMOTE_DISPATCH,
     CACHE_SNAPSHOT,
+    WHATIF_DISPATCH,
 })
 
 _MODES = ("raise", "delay", "corrupt")

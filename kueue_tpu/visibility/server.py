@@ -38,10 +38,16 @@ class PendingWorkloadsSummary:
 
 
 class VisibilityServer:
-    """reference pkg/visibility/server.go:82."""
+    """reference pkg/visibility/server.go:82.
 
-    def __init__(self, queues: QueueManager) -> None:
+    With a :class:`kueue_tpu.whatif.WhatIfEngine` attached, also exposes
+    the forecasting endpoints ``/whatif/eta`` and ``/whatif/preview``
+    (docs/whatif.md) — the reference has no analog; forecasts come from
+    the on-device counterfactual rollout."""
+
+    def __init__(self, queues: QueueManager, whatif=None) -> None:
         self.queues = queues
+        self.whatif = whatif
 
     def pending_workloads_cq(
         self, cq_name: str, offset: int = 0, limit: int = 1000
@@ -111,16 +117,103 @@ class VisibilityServer:
     def to_json(self, cq_name: str) -> str:
         return json.dumps(asdict(self.pending_workloads_cq(cq_name)))
 
+    # -- what-if forecasting (docs/whatif.md) ---------------------------
+
+    def whatif_eta(self, cluster_queue: Optional[str] = None,
+                   scenarios: Optional[List[Dict]] = None) -> Dict:
+        """Per-pending-workload admission ETA + flavor forecast, plus any
+        capacity-probe scenarios (JSON dicts, see _parse_scenario)."""
+        if self.whatif is None:
+            return {"error": "whatif engine not attached"}
+        scens = [self._parse_scenario(s) for s in (scenarios or [])]
+        report = self.whatif.eta(
+            scenarios=scens, cluster_queue=cluster_queue
+        )
+        return report.to_dict()
+
+    def whatif_preview(self, spec: Dict) -> Dict:
+        """Preemption preview for one hypothetical workload. ``spec``:
+        {"name", "namespace"?, "queue"?, "clusterQueue"?, "priority"?,
+        "count"?, "requests": {resource: canonical int}}."""
+        if self.whatif is None:
+            return {"error": "whatif engine not attached"}
+        wl = self._parse_workload(spec)
+        report = self.whatif.preview(
+            wl, cluster_queue=spec.get("clusterQueue")
+        )
+        return report.to_dict()
+
+    @staticmethod
+    def _parse_workload(spec: Dict):
+        from kueue_tpu.api.types import PodSet, Workload
+
+        return Workload(
+            name=spec.get("name", "whatif-preview"),
+            namespace=spec.get("namespace", "default"),
+            queue_name=spec.get("queue", ""),
+            priority=int(spec.get("priority", 0)),
+            pod_sets=[PodSet(
+                name="main",
+                count=int(spec.get("count", 1)),
+                requests={
+                    str(r): int(v)
+                    for r, v in (spec.get("requests") or {}).items()
+                },
+            )],
+        )
+
+    def _parse_scenario(self, s: Dict):
+        from kueue_tpu.whatif.engine import QuotaDelta, Scenario
+
+        deltas = tuple(
+            QuotaDelta(
+                node=d["node"], flavor=d["flavor"],
+                resource=d["resource"], delta=int(d["delta"]),
+            )
+            for d in s.get("quotaDeltas", [])
+        )
+        workload = None
+        if s.get("workload"):
+            workload = self._parse_workload(s["workload"])
+        kind = s.get("kind") or (
+            "drain" if s.get("drainNode")
+            else "submit" if workload is not None else "quota"
+        )
+        return Scenario(
+            kind=kind, label=s.get("label", ""),
+            quota_deltas=deltas, drain_node=s.get("drainNode"),
+            workload=workload,
+            cluster_queue=s.get("clusterQueue"),
+        )
+
     def serve(self, host: str = "127.0.0.1", port: int = 8082):
-        """Optional HTTP endpoint:
-        GET /visibility/clusterqueues/<name>/pendingworkloads."""
+        """Optional HTTP endpoints:
+        GET  /visibility/clusterqueues/<name>/pendingworkloads
+        GET  /whatif/eta[?cluster_queue=<name>]
+        POST /whatif/eta      {"clusterQueue"?: ..., "scenarios": [...]}
+        POST /whatif/preview  {workload spec, see whatif_preview}."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlparse
 
         server_self = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                if n <= 0:
+                    return {}
+                return json.loads(self.rfile.read(n) or b"{}")
+
             def do_GET(self):  # noqa: N802
-                parts = self.path.strip("/").split("/")
+                url = urlparse(self.path)
+                parts = url.path.strip("/").split("/")
                 if (
                     len(parts) == 3
                     and parts[0] == "visibility"
@@ -136,6 +229,30 @@ class VisibilityServer:
                     self.send_header("Content-Type", "application/json")
                     self.end_headers()
                     self.wfile.write(body)
+                elif parts == ["whatif", "eta"]:
+                    q = parse_qs(url.query)
+                    cq = (q.get("cluster_queue") or [None])[0]
+                    self._send_json(server_self.whatif_eta(
+                        cluster_queue=cq
+                    ))
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):  # noqa: N802
+                parts = urlparse(self.path).path.strip("/").split("/")
+                try:
+                    payload = self._read_body()
+                except (ValueError, json.JSONDecodeError):
+                    self._send_json({"error": "invalid JSON body"}, 400)
+                    return
+                if parts == ["whatif", "eta"]:
+                    self._send_json(server_self.whatif_eta(
+                        cluster_queue=payload.get("clusterQueue"),
+                        scenarios=payload.get("scenarios"),
+                    ))
+                elif parts == ["whatif", "preview"]:
+                    self._send_json(server_self.whatif_preview(payload))
                 else:
                     self.send_response(404)
                     self.end_headers()

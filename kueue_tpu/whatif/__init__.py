@@ -1,0 +1,32 @@
+"""What-if engine: batched counterfactual admission forecasting.
+
+Read-only forecasting over a fork of the live snapshot — virtual-time
+rollouts answering "when will my job start, where will it land, and who
+would it preempt?" without ever mutating scheduler state. See
+docs/whatif.md for the API and scenario semantics.
+"""
+
+from kueue_tpu.whatif.engine import (
+    ForecastUnsupported,
+    PreviewReport,
+    QuotaDelta,
+    Scenario,
+    ScenarioForecast,
+    WhatIfEngine,
+    WhatIfReport,
+    WorkloadForecast,
+)
+from kueue_tpu.whatif.batched import ScenarioTensors, make_batched_rollout
+
+__all__ = [
+    "ForecastUnsupported",
+    "PreviewReport",
+    "QuotaDelta",
+    "Scenario",
+    "ScenarioForecast",
+    "ScenarioTensors",
+    "WhatIfEngine",
+    "WhatIfReport",
+    "WorkloadForecast",
+    "make_batched_rollout",
+]

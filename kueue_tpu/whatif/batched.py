@@ -1,0 +1,80 @@
+"""Batched counterfactual rollout: K scenarios, one device dispatch.
+
+A scenario is a *quota perturbation* plus an *activity mask* over the
+shared workload plane: ``nominal`` replaces the quota tree's nominal
+capacities (expressing quota deltas and node drains) and ``active``
+selects which rows start pending (expressing hypothetical submissions —
+extra encoded rows that only one scenario switches on).
+
+Everything else — the encoded cycle arrays, group layout, per-row
+runtimes, and the already-running seed state — is shared across the
+batch and closed over by vmap, so XLA keeps one copy of the heavy
+tensors and batches only the [K, ...] planes. ``subtree_quota`` depends
+solely on nominal capacities and lending limits (compute_subtree with
+zero usage), so it is recomputed per scenario inside the vmapped
+closure; the simulator re-derives usage roll-ups from the running set
+every round regardless.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.sim_loop import SimInit, SimOutputs, make_sim_loop
+from kueue_tpu.ops import quota_ops
+
+
+class ScenarioTensors(NamedTuple):
+    """Per-scenario planes; leading axis K is the batch."""
+
+    nominal: jnp.ndarray  # i64[K,N,F,R] counterfactual nominal quotas
+    active: jnp.ndarray  # bool[K,W] rows that start pending
+
+
+def make_batched_rollout(s_max: int, kernel: str = "grouped",
+                         n_levels: int = quota_ops.MAX_DEPTH + 1,
+                         max_rounds: int = 512,
+                         per_cq_heads: bool = True):
+    """Build ``rollout(arrays, ga, runtime_ms, init, scen) -> SimOutputs``
+    where every output field gains a leading K axis. The caller jits the
+    returned function once per shape bucket.
+
+    ``per_cq_heads`` defaults ON here (unlike :func:`make_sim_loop`):
+    forecasts are promises about what the live scheduler will do, so each
+    simulated round must pop one head per CQ and stage failed heads
+    inadmissible exactly like ``QueueManager.heads()`` — the differential
+    suite (tests/test_whatif.py) pins the trajectories bit-identical."""
+    sim = make_sim_loop(
+        s_max, max_rounds=max_rounds, kernel=kernel, n_levels=n_levels,
+        per_cq_heads=per_cq_heads,
+    )
+
+    def one(arrays, ga, runtime_ms, init: SimInit,
+            nominal: jnp.ndarray, active: jnp.ndarray) -> SimOutputs:
+        tree = arrays.tree._replace(nominal=nominal)
+        is_parent = jnp.zeros(tree.n_nodes, bool).at[tree.parent].max(
+            tree.parent >= 0, mode="drop"
+        )
+        is_cq = tree.active & ~is_parent
+        subtree, _usage = quota_ops.compute_subtree(
+            tree, jnp.zeros_like(nominal), is_cq
+        )
+        tree = tree._replace(subtree_quota=subtree)
+        # Rows a scenario leaves inactive must not start pending either;
+        # running seed rows are scenario-independent.
+        init = init._replace(pending=init.pending & active)
+        return sim(
+            arrays._replace(tree=tree, w_active=active), ga, runtime_ms,
+            init,
+        )
+
+    def rollout(arrays, ga, runtime_ms, init: SimInit,
+                scen: ScenarioTensors) -> SimOutputs:
+        return jax.vmap(
+            lambda nom, act: one(arrays, ga, runtime_ms, init, nom, act)
+        )(scen.nominal, scen.active)
+
+    return rollout

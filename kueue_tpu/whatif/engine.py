@@ -1,0 +1,882 @@
+"""WhatIfEngine: counterfactual admission forecasting over a live fork.
+
+The engine answers three questions without ever touching scheduler
+state:
+
+* **ETA** — for every pending workload, how many virtual milliseconds
+  until admission, and on which flavor? (``eta``)
+* **capacity probes** — how do those answers move under a quota delta
+  or a node drain? (``eta`` with scenarios)
+* **preemption preview** — if this hypothetical workload were
+  submitted right now, would it admit, and who would it evict?
+  (``preview``)
+
+Mechanically: fork the live state (``cache.snapshot()`` plus cloned
+pending queue entries), encode it host-side with ``encode_cycle``,
+seed the currently admitted workloads as already-running simulator
+rows, and run K counterfactual scenarios through one batched device
+dispatch of the vmapped virtual-time simulator
+(whatif/batched.make_batched_rollout). The live arena, cache and
+queues are never written — the only shared objects are immutable specs.
+
+Containment: the dispatch path runs behind the ``whatif.dispatch``
+fault-injection point and a dedicated circuit breaker. When the
+breaker is open (or the rollout faults), forecasts degrade to the
+queue-position heuristic — position in head order per ClusterQueue —
+flagged with ``basis="queue_position"`` so callers can tell a real
+rollout from the fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.metrics import tracing
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
+
+# Per-workload expected runtime override (virtual milliseconds). Without
+# it the engine falls back to maximum_execution_time_seconds, then to
+# the engine-wide default — forecasts are only as good as the runtime
+# model, so callers that know their job durations should annotate.
+RUNTIME_ANNOTATION = "kueue.x-k8s.io/whatif-expected-runtime-ms"
+
+
+class ForecastUnsupported(RuntimeError):
+    """The snapshot is structurally outside the rollout model (e.g. TAS
+    topologies). Not a fault: does not trip the breaker."""
+
+
+@dataclass(frozen=True)
+class QuotaDelta:
+    """Additive change to one nominal quota cell. ``node`` may name a
+    ClusterQueue or a Cohort."""
+
+    node: str
+    flavor: str
+    resource: str
+    delta: int
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One counterfactual world. ``kind`` is the metrics label:
+    "base", "quota", "drain" or "submit"."""
+
+    kind: str
+    label: str = ""
+    quota_deltas: Tuple[QuotaDelta, ...] = ()
+    drain_node: Optional[str] = None
+    workload: Optional[Workload] = None
+    cluster_queue: Optional[str] = None  # for ``workload`` resolution
+
+
+@dataclass
+class WorkloadForecast:
+    key: str
+    cluster_queue: str
+    basis: str  # "rollout" | "queue_position"
+    eta_ms: Optional[int] = None  # None = not admitted within horizon
+    completed_ms: Optional[int] = None
+    flavor: Optional[str] = None
+    position: Optional[int] = None  # queue-position heuristic only
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "clusterQueue": self.cluster_queue,
+            "basis": self.basis,
+            "etaMs": self.eta_ms,
+            "completedMs": self.completed_ms,
+            "flavor": self.flavor,
+            "position": self.position,
+        }
+
+
+@dataclass
+class ScenarioForecast:
+    kind: str
+    label: str
+    ok: bool = True
+    reason: str = ""
+    truncated: bool = False  # rollout hit the round horizon
+    rounds: int = 0
+    makespan_ms: int = 0
+    admitted_within_horizon: int = 0
+    pending_after: int = 0
+    workloads: List[WorkloadForecast] = field(default_factory=list)
+    # Aggregate deltas vs the base scenario (absent on base itself).
+    vs_base: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "label": self.label,
+            "ok": self.ok,
+            "reason": self.reason,
+            "truncated": self.truncated,
+            "rounds": self.rounds,
+            "makespanMs": self.makespan_ms,
+            "admittedWithinHorizon": self.admitted_within_horizon,
+            "pendingAfter": self.pending_after,
+            "vsBase": self.vs_base,
+            "workloads": [w.to_dict() for w in self.workloads],
+        }
+
+
+@dataclass
+class WhatIfReport:
+    basis: str  # "rollout" | "queue_position"
+    scenarios: List[ScenarioForecast] = field(default_factory=list)
+    reason: str = ""  # why the fallback basis was used
+    wall_s: float = 0.0
+    horizon_rounds: int = 0
+    modeled_running: int = 0  # admitted rows seeded into the simulator
+    unmodeled_running: int = 0  # admitted left as static base usage
+
+    @property
+    def base(self) -> ScenarioForecast:
+        return self.scenarios[0]
+
+    def to_dict(self) -> dict:
+        return {
+            "basis": self.basis,
+            "reason": self.reason,
+            "wallS": self.wall_s,
+            "horizonRounds": self.horizon_rounds,
+            "modeledRunning": self.modeled_running,
+            "unmodeledRunning": self.unmodeled_running,
+            "scenarios": [s.to_dict() for s in self.scenarios],
+        }
+
+
+@dataclass
+class PreviewVictim:
+    key: str
+    cluster_queue: str
+    priority: int
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "clusterQueue": self.cluster_queue,
+            "priority": self.priority,
+        }
+
+
+@dataclass
+class PreviewReport:
+    basis: str  # "rollout" | "queue_position"
+    outcome: str = ""
+    ok: bool = True
+    reason: str = ""
+    flavor: Optional[str] = None
+    borrowing: bool = False
+    victims: List[PreviewVictim] = field(default_factory=list)
+    position: Optional[int] = None  # queue-position fallback only
+    wall_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "basis": self.basis,
+            "outcome": self.outcome,
+            "ok": self.ok,
+            "reason": self.reason,
+            "flavor": self.flavor,
+            "borrowing": self.borrowing,
+            "victims": [v.to_dict() for v in self.victims],
+            "position": self.position,
+            "wallS": self.wall_s,
+        }
+
+
+_OUTCOME_NAMES = {
+    0: "NoFit",
+    1: "NoCandidates",
+    2: "NeedsHost",
+    3: "FitSkipped",
+    4: "Admitted",
+    5: "Preempting",
+    6: "Shadowed",
+}
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length()
+
+
+def _w_bucket(x: int) -> int:
+    """Compile-shape bucket for the forecast W axis. Pow2 up to 1024,
+    then multiples of 1024: a forecast dispatch is one-shot per shape, so
+    above 1k rows the ~60% memory a pow2 pad can waste costs more (the
+    vmapped [K, W] planes blow the cache) than the extra compile
+    buckets save."""
+    x = max(16, int(x))
+    return _pow2(x) if x <= 1024 else 1024 * ((x + 1023) // 1024)
+
+
+class WhatIfEngine:
+    """Read-only forecasting facade over a (cache, queues) pair.
+
+    Thread-safe: a lock serializes forecasts (they share jit caches and
+    the breaker), and nothing here mutates the cache or the queues.
+    """
+
+    def __init__(
+        self,
+        cache,
+        queues,
+        default_runtime_ms: int = 300_000,
+        horizon_rounds: int = 512,
+        runtime_ms_fn: Optional[Callable[[WorkloadInfo], int]] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.cache = cache
+        self.queues = queues
+        self.default_runtime_ms = int(default_runtime_ms)
+        self.horizon_rounds = int(horizon_rounds)
+        self._runtime_ms_fn = runtime_ms_fn
+        self.breaker = breaker or CircuitBreaker(
+            threshold=3, backoff_s=5.0, max_backoff_s=60.0, clock=clock
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rollout_fns: Dict[tuple, Callable] = {}
+        self._preview_fn = None
+        # Spare-time refresh state (driver hook).
+        self.last_report: Optional[WhatIfReport] = None
+        self._last_refresh = -float("inf")
+
+    # ------------------------------------------------------------------
+    # runtime model
+    # ------------------------------------------------------------------
+
+    def runtime_ms(self, info: WorkloadInfo) -> int:
+        if self._runtime_ms_fn is not None:
+            return max(1, int(self._runtime_ms_fn(info)))
+        ann = info.obj.annotations.get(RUNTIME_ANNOTATION)
+        if ann is not None:
+            try:
+                return max(1, int(ann))
+            except ValueError:
+                pass
+        if info.obj.maximum_execution_time_seconds:
+            return max(1, int(info.obj.maximum_execution_time_seconds) * 1000)
+        return self.default_runtime_ms
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def eta(
+        self,
+        scenarios: Sequence[Scenario] = (),
+        cluster_queue: Optional[str] = None,
+        include_inadmissible: bool = True,
+    ) -> WhatIfReport:
+        """Forecast admission ETAs for every pending workload under the
+        base world plus each extra scenario (one batched dispatch)."""
+        with self._lock:
+            t0 = self._clock()
+            scens = [Scenario(kind="base", label="base")] + list(scenarios)
+            for s in scens:
+                tracing.inc("whatif_scenarios_total", {"kind": s.kind})
+            reason = None
+            if self.breaker.allow():
+                try:
+                    if faults.ENABLED:
+                        faults.fire(faults.WHATIF_DISPATCH)
+                    report = self._rollout(scens, cluster_queue,
+                                           include_inadmissible)
+                    self.breaker.record_success()
+                    report.wall_s = self._clock() - t0
+                    tracing.observe("whatif_rollout_seconds", report.wall_s)
+                    return report
+                except ForecastUnsupported as exc:
+                    # Structural, not a device fault: resolve any
+                    # half-open probe as success, degrade to heuristic.
+                    self.breaker.record_success()
+                    reason = f"unsupported: {exc}"
+                except AssertionError:
+                    raise
+                except Exception as exc:  # contained: degrade, count
+                    self.breaker.record_failure()
+                    reason = f"{type(exc).__name__}: {exc}"
+            else:
+                reason = "breaker_open"
+            tracing.inc("whatif_fallback_total")
+            report = self._fallback(scens, cluster_queue, reason)
+            report.wall_s = self._clock() - t0
+            return report
+
+    def preview(
+        self,
+        workload: Workload,
+        cluster_queue: Optional[str] = None,
+    ) -> PreviewReport:
+        """One-cycle preemption preview: would this hypothetical
+        workload admit right now, and which admitted workloads would it
+        evict? Runs the device preemption cycle against the forked
+        snapshot; never executes the preemption."""
+        with self._lock:
+            t0 = self._clock()
+            tracing.inc("whatif_scenarios_total", {"kind": "preview"})
+            reason = None
+            if self.breaker.allow():
+                try:
+                    if faults.ENABLED:
+                        faults.fire(faults.WHATIF_DISPATCH)
+                    report = self._preview(workload, cluster_queue)
+                    self.breaker.record_success()
+                    report.wall_s = self._clock() - t0
+                    tracing.observe("whatif_rollout_seconds", report.wall_s)
+                    return report
+                except ForecastUnsupported as exc:
+                    self.breaker.record_success()
+                    reason = f"unsupported: {exc}"
+                except AssertionError:
+                    raise
+                except Exception as exc:
+                    self.breaker.record_failure()
+                    reason = f"{type(exc).__name__}: {exc}"
+            else:
+                reason = "breaker_open"
+            tracing.inc("whatif_fallback_total")
+            report = self._preview_fallback(workload, cluster_queue, reason)
+            report.wall_s = self._clock() - t0
+            return report
+
+    def maybe_refresh(self, interval_s: float = 30.0) -> Optional[WhatIfReport]:
+        """Driver spare-time hook: refresh the cached base ETA forecast
+        at most every ``interval_s``. Never raises."""
+        now = self._clock()
+        if now - self._last_refresh < interval_s:
+            return None
+        self._last_refresh = now
+        try:
+            self.last_report = self.eta()
+        except Exception:  # pragma: no cover - eta() already contains
+            return None
+        return self.last_report
+
+    # ------------------------------------------------------------------
+    # rollout path
+    # ------------------------------------------------------------------
+
+    def _resolve_cq(self, wl: Workload,
+                    cluster_queue: Optional[str]) -> str:
+        cq = cluster_queue or self.queues.cluster_queue_for(wl)
+        if not cq:
+            raise ForecastUnsupported(
+                f"workload {wl.namespace}/{wl.name}: no LocalQueue "
+                f"{wl.queue_name!r} / ClusterQueue mapping"
+            )
+        return cq
+
+    def _collect_pending(self, include_inadmissible: bool
+                         ) -> List[WorkloadInfo]:
+        """Cloned pending entries across every CQ (they compete for
+        shared cohort quota, so the rollout always covers the fleet;
+        reports are filtered per-CQ at decode time)."""
+        out: List[WorkloadInfo] = []
+        getter = (self.queues.pending_workloads_all if include_inadmissible
+                  else self.queues.pending_workloads)
+        for name in sorted(self.queues.cluster_queues):
+            out.extend(info.clone() for info in getter(name))
+        return out
+
+    @staticmethod
+    def _model_admitted(info: WorkloadInfo, tidx, covered,
+                        remaining: np.ndarray):
+        """If ``info``'s quota usage maps exactly onto the device model
+        (single flavor, covered resources, consistent snapshot usage),
+        return (ni, fi, {ri: qty}); else None — the workload then stays
+        as static base usage and never completes, a conservative
+        (pessimistic-ETA) approximation."""
+        ni = tidx.node_of.get(info.cluster_queue)
+        if ni is None:
+            return None
+        try:
+            u = info.usage()
+        except Exception:
+            return None
+        if not u:
+            return None
+        flavors = {fr.flavor for fr, v in u.items() if v > 0}
+        if len(flavors) != 1:
+            return None
+        fi = tidx.flavor_of.get(next(iter(flavors)))
+        if fi is None:
+            return None
+        cells: Dict[int, int] = {}
+        for fr, v in u.items():
+            if v <= 0:
+                continue
+            ri = tidx.resource_of.get(fr.resource)
+            if ri is None or not covered[ni, ri]:
+                return None
+            cells[ri] = cells.get(ri, 0) + int(v)
+        if not cells:
+            return None
+        # The subtraction must not drive base usage negative (stale or
+        # reconstructed snapshots): verify against what is left.
+        for ri, v in cells.items():
+            if remaining[ni, fi, ri] < v:
+                return None
+        for ri, v in cells.items():
+            remaining[ni, fi, ri] -= v
+        return ni, fi, cells
+
+    def _next_timestamp(self, pending: Sequence[WorkloadInfo]) -> float:
+        ts = [i.obj.creation_time for i in pending]
+        return (max(ts) + 1.0) if ts else 1.0
+
+    @staticmethod
+    def _hypo(wl: Workload, ts: float) -> Workload:
+        """Shallow-copy a hypothetical workload so the caller's object is
+        never mutated; a fresh submission sorts after every real pending
+        entry at equal priority."""
+        import copy
+
+        wl2 = copy.copy(wl)
+        if wl2.creation_time == 0.0:
+            wl2.creation_time = ts
+        return wl2
+
+    def _rollout(self, scens: List[Scenario],
+                 cluster_queue: Optional[str],
+                 include_inadmissible: bool) -> WhatIfReport:
+        import jax
+        import jax.numpy as jnp
+
+        from kueue_tpu.models.encode import encode_cycle
+        from kueue_tpu.models.sim_loop import SimInit
+        from kueue_tpu.whatif.batched import ScenarioTensors
+
+        snap = self.cache.snapshot()
+        if snap.tas_flavors:
+            raise ForecastUnsupported(
+                "TAS topologies present; rollout forecasting does not "
+                "model topology placement"
+            )
+
+        pending = self._collect_pending(include_inadmissible)
+        # Hypothetical submissions ride as extra encoded head rows that
+        # only their scenario activates. A fresh submission sorts after
+        # every real pending entry at equal priority.
+        next_ts = self._next_timestamp(pending)
+        hypo_of_scen: Dict[int, WorkloadInfo] = {}
+        heads = list(pending)
+        for k, s in enumerate(scens):
+            if s.workload is None:
+                continue
+            wl = self._hypo(s.workload, next_ts)
+            next_ts += 1.0
+            info = WorkloadInfo(wl, self._resolve_cq(wl, s.cluster_queue))
+            hypo_of_scen[k] = info
+            heads.append(info)
+
+        # Upper-bound the W axis up front (pending + hypothetical heads
+        # plus every admitted workload that may seed a running row) so
+        # the modeled-admitted pass below never forces a re-encode.
+        n_admitted = sum(
+            len(cq.workloads) for cq in snap.cluster_queues.values()
+        )
+        arrays, idx = encode_cycle(
+            snap, heads, snap.resource_flavors,
+            w_pad=_w_bucket(len(heads) + n_admitted), device_put=False,
+        )
+        tidx = idx.tree_index
+        covered = np.asarray(arrays.covered)
+
+        # Admitted workloads that the device model can represent become
+        # already-running simulator rows: their usage moves from the
+        # static base into dynamic (completing) usage.
+        remaining = np.array(arrays.usage)
+        modeled: List[Tuple[WorkloadInfo, int, int, Dict[int, int]]] = []
+        unmodeled = 0
+        for name in sorted(snap.cluster_queues):
+            for info in snap.cluster_queues[name].workloads.values():
+                m = self._model_admitted(info, tidx, covered, remaining)
+                if m is None:
+                    unmodeled += 1
+                else:
+                    modeled.append((info, m[0], m[1], m[2]))
+
+        p_dev = len(idx.workloads)
+        w_have = int(arrays.w_cq.shape[0])
+        need = p_dev + len(modeled)
+        if need > w_have:
+            arrays, idx = encode_cycle(
+                snap, heads, snap.resource_flavors,
+                w_pad=_w_bucket(need), device_put=False,
+            )
+            tidx = idx.tree_index
+            covered = np.asarray(arrays.covered)
+            remaining = np.array(arrays.usage)
+            modeled2 = []
+            for info, _ni, _fi, _cells in modeled:
+                m = self._model_admitted(info, tidx, covered, remaining)
+                if m is not None:
+                    modeled2.append((info, m[0], m[1], m[2]))
+            modeled = modeled2
+            p_dev = len(idx.workloads)
+            w_have = int(arrays.w_cq.shape[0])
+
+        w_n = w_have
+        w_cq = np.array(arrays.w_cq)
+        w_req = np.array(arrays.w_req)
+        base_usage = np.array(arrays.usage)
+        running = np.zeros(w_n, bool)
+        admitted_at0 = np.full(w_n, -1, np.int64)
+        chosen0 = np.full(w_n, -1, np.int32)
+        runtime = np.ones(w_n, np.int64)
+        for j, (info, ni, fi, cells) in enumerate(modeled):
+            row = p_dev + j
+            w_cq[row] = ni
+            w_req[row, :] = 0
+            for ri, v in cells.items():
+                w_req[row, ri] = v
+                base_usage[ni, fi, ri] -= v
+            running[row] = True
+            admitted_at0[row] = 0
+            chosen0[row] = fi
+            runtime[row] = self.runtime_ms(info)
+        arrays = arrays._replace(
+            w_cq=w_cq, w_req=w_req, usage=base_usage,
+        )
+
+        # Per-scenario planes.
+        hypo_rows: Dict[int, int] = {}  # scenario -> device row
+        row_of = {id(info): i for i, info in enumerate(idx.workloads)}
+        for k, info in hypo_of_scen.items():
+            r = row_of.get(id(info))
+            if r is None:
+                raise ForecastUnsupported(
+                    f"scenario {k} ({scens[k].label or scens[k].kind}): "
+                    "hypothetical workload needs host-side scheduling"
+                )
+            hypo_rows[k] = r
+        hypo_mask = np.zeros(w_n, bool)
+        for r in hypo_rows.values():
+            hypo_mask[r] = True
+        base_active = np.array(arrays.w_active) & ~hypo_mask
+
+        base_nom = np.array(arrays.tree.nominal)
+        K = len(scens)
+        nominal = np.broadcast_to(base_nom, (K,) + base_nom.shape).copy()
+        active = np.broadcast_to(base_active, (K, w_n)).copy()
+        scen_ok = [True] * K
+        scen_reason = [""] * K
+        for k, s in enumerate(scens):
+            try:
+                deltas = list(s.quota_deltas)
+                if s.drain_node is not None:
+                    deltas.extend(self._drain_deltas(s.drain_node, snap))
+                for d in deltas:
+                    ni = tidx.node_of.get(d.node)
+                    fi = tidx.flavor_of.get(d.flavor)
+                    ri = tidx.resource_of.get(d.resource)
+                    if ni is None or fi is None or ri is None:
+                        raise ForecastUnsupported(
+                            f"unknown quota cell {d.node}/{d.flavor}/"
+                            f"{d.resource}"
+                        )
+                    nominal[k, ni, fi, ri] = max(
+                        0, int(nominal[k, ni, fi, ri]) + int(d.delta)
+                    )
+            except ForecastUnsupported as exc:
+                scen_ok[k] = False
+                scen_reason[k] = str(exc)
+                nominal[k] = base_nom  # run the base world instead
+            if k in hypo_rows and scen_ok[k]:
+                active[k, hypo_rows[k]] = True
+
+        for i, info in enumerate(idx.workloads):
+            if not hypo_mask[i] and base_active[i]:
+                runtime[i] = self.runtime_ms(info)
+        for k, r in hypo_rows.items():
+            runtime[r] = self.runtime_ms(hypo_of_scen[k])
+
+        init = SimInit(
+            pending=jnp.asarray(np.array(arrays.w_active)),
+            running=jnp.asarray(running),
+            admitted_at=jnp.asarray(admitted_at0),
+            chosen_flavor=jnp.asarray(chosen0),
+        )
+        scen_t = ScenarioTensors(
+            nominal=jnp.asarray(nominal), active=jnp.asarray(active)
+        )
+
+        kernel = ("grouped"
+                  if bool(np.asarray(arrays.tree.has_lend_limit).any())
+                  else "fixedpoint")
+        s_max = _pow2(max(8, int(base_active.sum()) + len(hypo_rows)))
+        fn = self._rollout_fn(s_max, kernel)
+        arrays_d, ga_d = jax.device_put((arrays, idx.group_arrays))
+        out = fn(arrays_d, ga_d, jnp.asarray(runtime), init, scen_t)
+        adm = np.asarray(out.admitted_at)
+        comp = np.asarray(out.completed_at)
+        chosen = np.asarray(out.chosen_flavor)
+        rounds = np.asarray(out.rounds)
+        vclock = np.asarray(out.final_vclock)
+
+        # Decode. Per-scenario aggregates are vector math over the [K, W]
+        # planes; the per-workload forecast list (10k dataclass rows at
+        # production scale) is materialized once for the base scenario —
+        # counterfactual scenarios carry aggregates plus, for submit
+        # scenarios, the hypothetical workload's own forecast row.
+        report = WhatIfReport(
+            basis="rollout", horizon_rounds=self.horizon_rounds,
+            modeled_running=len(modeled), unmodeled_running=unmodeled,
+        )
+        fallback_heads = [
+            info for info in idx.host_fallback
+            if cluster_queue in (None, info.cluster_queue)
+        ]
+        admitted = adm >= 0  # bool [K, W]
+        n_adm_k = (admitted & active).sum(axis=1)
+        n_pend_k = (active & ~admitted).sum(axis=1)
+        # ETA deltas vs base over rows admitted in both worlds (own
+        # hypothetical rows have no base counterpart and are excluded).
+        both = active & admitted & active[0:1] & admitted[0:1] & ~hypo_mask
+        for k, s in enumerate(scens):
+            sf = ScenarioForecast(
+                kind=s.kind, label=s.label or s.kind,
+                ok=scen_ok[k], reason=scen_reason[k],
+                rounds=int(rounds[k]),
+                truncated=bool(rounds[k] >= self.horizon_rounds),
+                makespan_ms=int(vclock[k]),
+            )
+            sf.admitted_within_horizon = int(n_adm_k[k])
+            sf.pending_after = int(n_pend_k[k]) + len(fallback_heads)
+            if k == 0:
+                adm0, comp0, fl0 = adm[0], comp[0], chosen[0]
+                for i, info in enumerate(idx.workloads):
+                    if not active[0, i]:
+                        continue
+                    if cluster_queue not in (None, info.cluster_queue):
+                        continue
+                    fl = int(fl0[i])
+                    sf.workloads.append(WorkloadForecast(
+                        key=info.key, cluster_queue=info.cluster_queue,
+                        basis="rollout",
+                        eta_ms=int(adm0[i]) if adm0[i] >= 0 else None,
+                        completed_ms=(int(comp0[i]) if comp0[i] >= 0
+                                      else None),
+                        flavor=(idx.flavors[fl]
+                                if 0 <= fl < len(idx.flavors) else None),
+                    ))
+                # Device-incompatible pending entries degrade one by one.
+                for pos, info in enumerate(fallback_heads):
+                    sf.workloads.append(WorkloadForecast(
+                        key=info.key, cluster_queue=info.cluster_queue,
+                        basis="queue_position", position=pos,
+                    ))
+                sf.workloads.sort(
+                    key=lambda w: (w.eta_ms is None,
+                                   w.eta_ms or 0, w.key)
+                )
+            else:
+                r = hypo_rows.get(k)
+                if r is not None and scen_ok[k]:
+                    info = idx.workloads[r]
+                    fl = int(chosen[k, r])
+                    sf.workloads.append(WorkloadForecast(
+                        key=info.key, cluster_queue=info.cluster_queue,
+                        basis="rollout",
+                        eta_ms=int(adm[k, r]) if adm[k, r] >= 0 else None,
+                        completed_ms=(int(comp[k, r]) if comp[k, r] >= 0
+                                      else None),
+                        flavor=(idx.flavors[fl]
+                                if 0 <= fl < len(idx.flavors) else None),
+                    ))
+                deltas = (adm[k] - adm[0])[both[k]]
+                sf.vs_base = {
+                    "admitted_delta": int(n_adm_k[k]) - int(n_adm_k[0]),
+                    "mean_eta_delta_ms":
+                        (float(deltas.mean()) if deltas.size else None),
+                    "makespan_delta_ms":
+                        int(vclock[k]) - int(vclock[0]),
+                }
+            report.scenarios.append(sf)
+        return report
+
+    def _drain_deltas(self, node_name: str, snap) -> List[QuotaDelta]:
+        """Approximate a node drain as nominal-quota reductions spread
+        proportionally across the ClusterQueues of every ResourceFlavor
+        whose node_labels select the node (docs/whatif.md#node-drain)."""
+        node = self.cache.nodes.get(node_name)
+        if node is None:
+            raise ForecastUnsupported(f"unknown node {node_name!r}")
+        matched = [
+            rf for rf in snap.resource_flavors.values()
+            if rf.node_labels and all(
+                node.labels.get(k) == v for k, v in rf.node_labels.items()
+            )
+        ]
+        if not matched:
+            raise ForecastUnsupported(
+                f"node {node_name!r} matches no ResourceFlavor node_labels"
+            )
+        out: List[QuotaDelta] = []
+        for rf in matched:
+            for res, cap in node.capacity.items():
+                holders = []
+                for cq in snap.cluster_queues.values():
+                    q = 0
+                    for fr, cell in cq.node.quotas.items():
+                        if fr.flavor == rf.name and fr.resource == res:
+                            q += cell.nominal
+                    if q > 0:
+                        holders.append((cq.name, q))
+                total = sum(q for _n, q in holders)
+                if total <= 0:
+                    continue
+                for cq_name, q in holders:
+                    cut = min(q, (cap * q + total - 1) // total)
+                    if cut > 0:
+                        out.append(QuotaDelta(
+                            node=cq_name, flavor=rf.name,
+                            resource=res, delta=-cut,
+                        ))
+        return out
+
+    def _rollout_fn(self, s_max: int, kernel: str):
+        import jax
+
+        from kueue_tpu.whatif.batched import make_batched_rollout
+
+        key = (s_max, kernel, self.horizon_rounds)
+        fn = self._rollout_fns.get(key)
+        if fn is None:
+            fn = jax.jit(make_batched_rollout(
+                s_max, kernel=kernel, max_rounds=self.horizon_rounds
+            ))
+            self._rollout_fns[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # preview path
+    # ------------------------------------------------------------------
+
+    def _preview(self, workload: Workload,
+                 cluster_queue: Optional[str]) -> PreviewReport:
+        import jax
+        import jax.numpy as jnp
+
+        from kueue_tpu.models import batch_scheduler as bs
+        from kueue_tpu.models.encode import encode_cycle
+
+        snap = self.cache.snapshot()
+        if snap.tas_flavors:
+            raise ForecastUnsupported(
+                "TAS topologies present; preview does not model "
+                "topology placement"
+            )
+        cq = self._resolve_cq(workload, cluster_queue)
+        workload = self._hypo(
+            workload, self._next_timestamp(self._collect_pending(True))
+        )
+        info = WorkloadInfo(workload, cq)
+        arrays, idx = encode_cycle(
+            snap, [info], snap.resource_flavors, preempt=True,
+            device_put=False,
+        )
+        if any(h is info for h in idx.host_fallback) or not idx.workloads:
+            raise ForecastUnsupported(
+                "hypothetical workload needs host-side scheduling"
+            )
+        if self._preview_fn is None:
+            cycle = bs.make_grouped_cycle(0, preempt=True)
+            self._preview_fn = jax.jit(cycle)
+        arrays_d, ga_d, adm_d = jax.device_put(
+            (arrays, idx.group_arrays, idx.admitted_arrays)
+        )
+        out = self._preview_fn(arrays_d, ga_d, adm_d)
+        row = next(i for i, h in enumerate(idx.workloads) if h is info)
+        outcome = int(np.asarray(out.outcome)[row])
+        fl = int(np.asarray(out.chosen_flavor)[row])
+        report = PreviewReport(
+            basis="rollout",
+            outcome=_OUTCOME_NAMES.get(outcome, str(outcome)),
+            flavor=(idx.flavors[fl] if 0 <= fl < len(idx.flavors)
+                    else None),
+            borrowing=bool(np.asarray(out.borrow)[row] > 0),
+        )
+        if out.victims is not None and outcome == bs.OUT_PREEMPTING:
+            vrow = np.asarray(out.victims)[row]
+            for a, victim in enumerate(idx.admitted):
+                if a < vrow.shape[0] and vrow[a]:
+                    report.victims.append(PreviewVictim(
+                        key=victim.key,
+                        cluster_queue=victim.cluster_queue,
+                        priority=victim.priority(),
+                    ))
+        return report
+
+    # ------------------------------------------------------------------
+    # queue-position fallback
+    # ------------------------------------------------------------------
+
+    def _heuristic_workloads(self, cluster_queue: Optional[str]
+                             ) -> List[WorkloadForecast]:
+        out: List[WorkloadForecast] = []
+        names = ([cluster_queue] if cluster_queue
+                 else sorted(self.queues.cluster_queues))
+        for name in names:
+            for pos, info in enumerate(
+                self.queues.pending_workloads_all(name)
+            ):
+                out.append(WorkloadForecast(
+                    key=info.key, cluster_queue=info.cluster_queue or name,
+                    basis="queue_position", position=pos,
+                ))
+        return out
+
+    def _fallback(self, scens: List[Scenario],
+                  cluster_queue: Optional[str],
+                  reason: str) -> WhatIfReport:
+        report = WhatIfReport(
+            basis="queue_position", reason=reason or "",
+            horizon_rounds=self.horizon_rounds,
+        )
+        wls = self._heuristic_workloads(cluster_queue)
+        for k, s in enumerate(scens):
+            sf = ScenarioForecast(
+                kind=s.kind, label=s.label or s.kind,
+                ok=(k == 0), reason="" if k == 0 else (reason or ""),
+                pending_after=len(wls),
+            )
+            if k == 0:
+                sf.workloads = wls
+            report.scenarios.append(sf)
+        return report
+
+    def _preview_fallback(self, workload: Workload,
+                          cluster_queue: Optional[str],
+                          reason: str) -> PreviewReport:
+        try:
+            cq = self._resolve_cq(workload, cluster_queue)
+        except ForecastUnsupported as exc:
+            return PreviewReport(
+                basis="queue_position", ok=False,
+                reason=f"{reason}; {exc}" if reason else str(exc),
+            )
+        prio = workload.priority
+        ahead = sum(
+            1 for i in self.queues.pending_workloads_all(cq)
+            if i.priority() >= prio
+        )
+        return PreviewReport(
+            basis="queue_position", ok=False, reason=reason or "",
+            position=ahead,
+        )

@@ -65,8 +65,7 @@ def python_reference_sim(arrays, ga, runtime_ms, s_max):
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
         order = _order_jit(a, nom)
-        _u2, admit, _pre, _tk, _ltk, _stk = _scan_jit(a, ga, nom, u, order)
-        admit = np.asarray(admit) & pending
+        admit = np.asarray(_scan_jit(a, ga, nom, u, order).admitted) & pending
         if admit.any():
             for i in np.where(admit)[0]:
                 pending[i] = False
@@ -225,10 +224,7 @@ def test_sim_loop_fair_kernel_matches_python_loop(seed):
         )
         a = arrays._replace(w_active=jnp.asarray(pending), usage=u)
         nom = _nominate_jit(a, u)
-        _u2, admit, _pre, _sh, _part, _step, _tk, _stk = fair_jit(
-            a, nom, u
-        )
-        admit = np.asarray(admit) & pending
+        admit = np.asarray(fair_jit(a, nom, u).admitted) & pending
         if admit.any():
             for i in np.where(admit)[0]:
                 pending[i] = False

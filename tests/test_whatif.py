@@ -1,0 +1,543 @@
+"""What-if engine tests (whatif/engine.py).
+
+Three claims, per docs/whatif.md:
+
+1. **Differential**: the base lane of a rollout forecast predicts exactly
+   what the real host scheduler does when stepped forward under the same
+   virtual-time model (admit until quiescent, advance the clock to the
+   earliest completion, free the quota, repeat) — per-workload admission
+   ETA and completion time, on randomized contended scenarios, both from
+   a cold queue and from a snapshot with admitted workloads already
+   running. A preemption preview must name the exact victim set the real
+   scheduler then preempts.
+2. **Isolation**: forecasting is read-only. The differential tests run
+   the forecast FIRST on the very cache/queues the real run then steps —
+   any leak would break the comparison — and dedicated tests pin cache /
+   queue fingerprints and interleaved-forecast schedule equality.
+3. **Containment**: an injected dispatch fault degrades the report to the
+   queue-position heuristic, trips only the engine's own breaker, and the
+   breaker recovers through half-open; ForecastUnsupported never trips.
+
+Compile budget: every env here uses the same tensor shapes (2 CQs + one
+cohort, one flavor, one resource, <= 8 pending -> s_max 8, W bucket 16,
+horizon 64) and all engines share one jit cache, so the whole file pays
+for K in {1, 2, 3} rollout compiles plus one preview compile.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import ClusterQueuePreemption, Cohort, ResourceQuota
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CLOSED, OPEN, CircuitBreaker
+from kueue_tpu.whatif.engine import (
+    RUNTIME_ANNOTATION,
+    QuotaDelta,
+    Scenario,
+    WhatIfEngine,
+)
+
+from .helpers import admitted_names, build_env, make_cq, make_wl, submit
+
+pytestmark = pytest.mark.isolated
+
+HORIZON = 64
+
+# One jit cache for every engine in the file: the per-engine cache exists
+# so long-lived engines drop compiles with their instance, but tests spin
+# up a fresh engine per env and would otherwise recompile identical
+# (s_max, kernel, horizon) programs.
+_SHARED_FNS = {}
+
+
+def make_engine(cache, queues, **kw):
+    kw.setdefault("default_runtime_ms", 500)
+    kw.setdefault("horizon_rounds", HORIZON)
+    eng = WhatIfEngine(cache, queues, **kw)
+    eng._rollout_fns = _SHARED_FNS
+    return eng
+
+
+def std_env(nom_a=4_000, nom_b=4_000, preemption=None):
+    """The file's one tensor shape: cq-a + cq-b sharing cohort co."""
+    return build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": ResourceQuota(nominal=nom_a)}},
+                    preemption=preemption),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": ResourceQuota(nominal=nom_b)}},
+                    preemption=preemption),
+        ],
+        cohorts=[Cohort(name="co")],
+    )
+
+
+def wl_with_runtime(name, queue, cpu_m, priority, creation_time, runtime_ms):
+    wl = make_wl(name, queue=queue, cpu_m=cpu_m, priority=priority,
+                 creation_time=creation_time)
+    wl.annotations[RUNTIME_ANNOTATION] = str(runtime_ms)
+    return wl
+
+
+def run_real(cache, queues, sched, runtime_ms_of, seed_running=(),
+             max_steps=256):
+    """Step the REAL host scheduler under the engine's virtual-time
+    model: cycle until quiescent at the current instant (failed heads go
+    to inadmissible staging, letting deeper entries try), then advance
+    the clock to the earliest completion, delete those workloads (freeing
+    quota) and requeue the inadmissible set. Returns
+    {key: admitted_at_ms}."""
+    vclock = 0
+    admitted_at = {}
+    finish = [(int(ms), key) for ms, key in seed_running]
+    for _ in range(max_steps):
+        res = sched.schedule()
+        if res.admitted:
+            for key in res.admitted:
+                admitted_at[key] = vclock
+                finish.append((vclock + runtime_ms_of(key), key))
+            continue
+        if res.head_keys:
+            continue  # heads failed and were staged; next entries try now
+        if not finish:
+            break
+        finish.sort()
+        t = finish[0][0]
+        for _ft, key in [x for x in finish if x[0] == t]:
+            cache.delete_workload(key)
+        finish = [x for x in finish if x[0] != t]
+        vclock = t
+        queues.queue_inadmissible_workloads()
+    return admitted_at
+
+
+def fingerprint(cache, queues):
+    return (
+        sorted(cache.workloads),
+        cache.workload_generation,
+        {cq: [i.key for i in queues.pending_workloads_all(cq)]
+         for cq in sorted(queues.cluster_queues)},
+    )
+
+
+# ---------------------------------------------------------------------------
+# differential: forecast == real scheduler stepped forward
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_eta_differential_randomized(seed):
+    """Base-lane ETAs and completions are bit-identical to the real
+    scheduler's virtual-time trajectory. The forecast runs FIRST on the
+    same live cache/queues the real run then steps, so it doubles as an
+    isolation proof."""
+    rng = np.random.default_rng(seed)
+    cache, queues, sched = std_env()
+    runtimes = {}
+    wls = []
+    for i in range(int(rng.integers(5, 8))):
+        name = f"w{i}"
+        runtimes[f"default/{name}"] = int(rng.choice([100, 250, 400, 700]))
+        wls.append(wl_with_runtime(
+            name,
+            queue="lq" if rng.random() < 0.5 else "lq-cq-b",
+            cpu_m=int(rng.choice([1_000, 2_000, 3_000])),
+            priority=int(rng.integers(0, 4)),
+            creation_time=float(i + 1),
+            runtime_ms=runtimes[f"default/{name}"],
+        ))
+    submit(queues, *wls)
+
+    eng = make_engine(cache, queues)
+    rep = eng.eta()
+    assert rep.basis == "rollout", rep.reason
+    assert not rep.base.truncated
+    assert rep.base.admitted_within_horizon == len(wls)
+    assert rep.base.pending_after == 0
+
+    forecast = {w.key: w for w in rep.base.workloads}
+    assert set(forecast) == set(runtimes)
+    real = run_real(cache, queues, sched, lambda k: runtimes[k])
+    assert set(real) == set(runtimes)
+    for key, at in real.items():
+        f = forecast[key]
+        assert f.basis == "rollout"
+        assert f.eta_ms == at, key
+        assert f.completed_ms == at + runtimes[key], key
+        assert f.flavor == "default"
+
+
+def test_eta_differential_with_running_workloads():
+    """Admitted workloads become already-running simulator rows: their
+    completions free quota inside the forecast exactly when the real
+    scheduler sees it freed."""
+    cache, queues, sched = std_env()
+    running = [
+        wl_with_runtime("r0", "lq", 3_000, 5, 1.0, 300),
+        wl_with_runtime("r1", "lq-cq-b", 4_000, 5, 2.0, 800),
+    ]
+    submit(queues, *running)
+    res = sched.schedule()
+    assert sorted(res.admitted) == ["default/r0", "default/r1"]
+
+    runtimes = {"default/r0": 300, "default/r1": 800}
+    pending = []
+    for i, (cpu, ms) in enumerate([(2_000, 200), (3_000, 450),
+                                   (4_000, 150), (1_000, 600)]):
+        key = f"default/p{i}"
+        runtimes[key] = ms
+        pending.append(wl_with_runtime(
+            f"p{i}", "lq" if i % 2 else "lq-cq-b", cpu, 0,
+            float(10 + i), ms))
+    submit(queues, *pending)
+
+    eng = make_engine(cache, queues)
+    rep = eng.eta()
+    assert rep.basis == "rollout", rep.reason
+    assert rep.modeled_running == 2
+    assert rep.unmodeled_running == 0
+
+    forecast = {w.key: w for w in rep.base.workloads}
+    assert set(forecast) == {f"default/p{i}" for i in range(4)}
+    real = run_real(
+        cache, queues, sched, lambda k: runtimes[k],
+        seed_running=[(runtimes[k], k) for k in ("default/r0", "default/r1")],
+    )
+    assert set(real) == set(forecast)
+    for key, at in real.items():
+        assert forecast[key].eta_ms == at, key
+        assert forecast[key].completed_ms == at + runtimes[key], key
+
+
+def test_preview_victims_match_real_preemption():
+    """preview() names the exact victim set (and the no-preemption fit
+    outcome) that submitting the workload for real then produces."""
+    policy = ClusterQueuePreemption(
+        within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY)
+    cache, queues, sched = std_env(preemption=policy)
+    submit(
+        queues,
+        make_wl("lo-0", queue="lq", cpu_m=1_500, priority=0,
+                creation_time=1.0),
+        make_wl("lo-1", queue="lq", cpu_m=1_500, priority=0,
+                creation_time=2.0),
+        make_wl("other", queue="lq-cq-b", cpu_m=4_000, priority=0,
+                creation_time=3.0),
+    )
+    sched.schedule()  # heads: lo-0 + other
+    sched.schedule()  # lo-1
+    assert admitted_names(cache) == ["lo-0", "lo-1", "other"]
+    eng = make_engine(cache, queues)
+
+    # 1000m of cq-a's nominal is free: a small workload just fits.
+    fit = eng.preview(make_wl("small", queue="lq", cpu_m=500, priority=10,
+                              creation_time=0.0))
+    assert fit.basis == "rollout", fit.reason
+    assert fit.outcome == "Admitted"
+    assert fit.victims == []
+
+    # A high-priority 4000m needs 3000m back: both low-priority admitted
+    # workloads in cq-a must go (cq-b's is out of reach: reclaim is off).
+    hi = make_wl("hi", queue="lq", cpu_m=4_000, priority=10,
+                 creation_time=50.0)
+    pre = eng.preview(hi)
+    assert pre.basis == "rollout", pre.reason
+    assert pre.outcome == "Preempting"
+    assert sorted(v.key for v in pre.victims) == [
+        "default/lo-0", "default/lo-1"]
+    assert all(v.cluster_queue == "cq-a" and v.priority == 0
+               for v in pre.victims)
+    # The preview executed nothing.
+    assert admitted_names(cache) == ["lo-0", "lo-1", "other"]
+
+    submit(queues, hi)
+    res = sched.schedule()
+    assert sorted(res.preempted) == ["default/lo-0", "default/lo-1"]
+    assert "default/hi" in res.preempting
+
+
+def test_quota_scenario_matches_separately_built_world():
+    """A quota counterfactual lane must equal the base lane of a world
+    actually built with that quota — and growing capacity can only
+    improve ETAs (monotonicity)."""
+    def load(queues):
+        submit(queues, *[
+            wl_with_runtime(f"w{i}", "lq" if i % 2 else "lq-cq-b",
+                            3_000, 0, float(i + 1), 400)
+            for i in range(6)
+        ])
+
+    cache1, queues1, _ = std_env()
+    load(queues1)
+    eng1 = make_engine(cache1, queues1)
+    rep1 = eng1.eta(scenarios=[Scenario(
+        kind="quota", label="grow-a",
+        quota_deltas=(QuotaDelta(node="cq-a", flavor="default",
+                                 resource="cpu", delta=4_000),),
+    )])
+    assert rep1.basis == "rollout", rep1.reason
+    grow = rep1.scenarios[1]
+    assert grow.ok
+
+    cache2, queues2, _ = std_env(nom_a=8_000)
+    load(queues2)
+    rep2 = make_engine(cache2, queues2).eta()
+    assert rep2.basis == "rollout", rep2.reason
+
+    assert grow.admitted_within_horizon == rep2.base.admitted_within_horizon
+    assert grow.makespan_ms == rep2.base.makespan_ms
+    assert grow.rounds == rep2.base.rounds
+
+    eta1 = {w.key: w.eta_ms for w in rep1.base.workloads}
+    eta2 = {w.key: w.eta_ms for w in rep2.base.workloads}
+    assert set(eta1) == set(eta2)
+    assert all(eta2[k] <= eta1[k] for k in eta1)
+
+    assert grow.vs_base is not None
+    assert grow.vs_base["admitted_delta"] >= 0
+    assert grow.vs_base["makespan_delta_ms"] <= 0
+    delta = grow.vs_base["mean_eta_delta_ms"]
+    assert delta is None or delta <= 0
+
+
+def test_submit_scenario_and_bad_scenario_lanes():
+    """A submit lane forecasts the hypothetical's own row without ever
+    mutating the caller's Workload; a lane naming an unknown quota cell
+    degrades only itself."""
+    cache, queues, _ = std_env()
+    submit(queues, *[
+        wl_with_runtime(f"w{i}", "lq", 3_000, 0, float(i + 1), 300)
+        for i in range(4)
+    ])
+    hypo = make_wl("hypo", queue="lq", cpu_m=3_000, priority=0,
+                   creation_time=0.0)
+    hypo.annotations[RUNTIME_ANNOTATION] = "250"
+
+    eng = make_engine(cache, queues)
+    rep = eng.eta(scenarios=[
+        Scenario(kind="submit", label="submit-hypo", workload=hypo),
+        Scenario(kind="quota", label="typo", quota_deltas=(
+            QuotaDelta(node="no-such-cq", flavor="default",
+                       resource="cpu", delta=1_000),)),
+    ])
+    assert rep.basis == "rollout", rep.reason
+    sub, bad = rep.scenarios[1], rep.scenarios[2]
+
+    assert sub.ok
+    assert [w.key for w in sub.workloads] == ["default/hypo"]
+    own = sub.workloads[0]
+    assert own.eta_ms is not None
+    assert own.completed_ms == own.eta_ms + 250
+    # A fresh submission sorts behind every real pending entry at equal
+    # priority: it cannot beat any base workload's ETA.
+    base_etas = [w.eta_ms for w in rep.base.workloads]
+    assert own.eta_ms >= max(base_etas)
+    assert sub.vs_base is not None
+
+    assert not bad.ok
+    assert "unknown quota cell" in bad.reason
+    assert bad.admitted_within_horizon == rep.base.admitted_within_horizon
+    assert rep.base.ok and rep.base.reason == ""
+
+    # The caller's object was never touched (the engine forecasts a copy).
+    assert hypo.creation_time == 0.0
+    assert hypo.annotations == {RUNTIME_ANNOTATION: "250"}
+    assert "default/hypo" not in cache.workloads
+    assert all(i.key != "default/hypo"
+               for cq in queues.cluster_queues
+               for i in queues.pending_workloads_all(cq))
+
+
+# ---------------------------------------------------------------------------
+# isolation: forecasting is read-only
+# ---------------------------------------------------------------------------
+
+
+def test_forecasts_leave_cache_and_queues_untouched():
+    cache, queues, sched = std_env()
+    submit(queues,
+           make_wl("r0", queue="lq", cpu_m=3_000, creation_time=1.0),
+           *[make_wl(f"p{i}", queue="lq" if i % 2 else "lq-cq-b",
+                     cpu_m=3_000, creation_time=float(i + 2))
+             for i in range(5)])
+    sched.schedule()
+    before = fingerprint(cache, queues)
+    usage_before = {
+        name: dict(cq.node.usage)
+        for name, cq in cache.snapshot().cluster_queues.items()
+    }
+
+    eng = make_engine(cache, queues)
+    eng.eta()
+    eng.eta(scenarios=[Scenario(
+        kind="submit", workload=make_wl("ghost", queue="lq", cpu_m=1_000,
+                                        creation_time=0.0))])
+    eng.preview(make_wl("ghost2", queue="lq", cpu_m=1_000,
+                        creation_time=0.0))
+
+    assert fingerprint(cache, queues) == before
+    usage_after = {
+        name: dict(cq.node.usage)
+        for name, cq in cache.snapshot().cluster_queues.items()
+    }
+    assert usage_after == usage_before
+
+
+def test_interleaved_forecasts_do_not_change_schedule():
+    """Two identical worlds, one polluted with a forecast between every
+    scheduler step, must admit identical sets at every cycle."""
+    def build():
+        cache, queues, sched = std_env()
+        submit(queues, *[
+            make_wl(f"w{i}", queue="lq" if i % 2 else "lq-cq-b",
+                    cpu_m=3_000, priority=i % 2, creation_time=float(i + 1))
+            for i in range(6)
+        ])
+        return cache, queues, sched
+
+    ca, qa, sa = build()
+    cb, qb, sb = build()
+    eng = make_engine(cb, qb)
+    for _step in range(4):
+        eng.eta()
+        ra, rb = sa.schedule(), sb.schedule()
+        assert sorted(ra.admitted) == sorted(rb.admitted)
+        assert admitted_names(ca) == admitted_names(cb)
+        for key in sorted(ra.admitted)[:1]:  # complete one; quota frees
+            ca.delete_workload(key)
+            cb.delete_workload(key)
+            qa.queue_inadmissible_workloads()
+            qb.queue_inadmissible_workloads()
+
+
+# ---------------------------------------------------------------------------
+# containment: faults degrade, never escape; the breaker is the engine's own
+# ---------------------------------------------------------------------------
+
+
+def _contended_env():
+    cache, queues, sched = std_env()
+    submit(queues, *[
+        make_wl(f"w{i}", queue="lq", cpu_m=3_000, priority=0,
+                creation_time=float(i + 1))
+        for i in range(4)
+    ])
+    return cache, queues, sched
+
+
+def test_injected_dispatch_fault_degrades_to_queue_position():
+    cache, queues, sched = _contended_env()
+    eng = make_engine(cache, queues)
+    plan = faults.install(faults.FaultPlan().add(
+        faults.WHATIF_DISPATCH, mode="raise", rate=1.0))
+    try:
+        rep = eng.eta()
+        pre = eng.preview(make_wl("h", queue="lq", cpu_m=1_000,
+                                  priority=1, creation_time=0.0))
+    finally:
+        faults.clear()
+    assert plan.fired(faults.WHATIF_DISPATCH) == 2
+
+    assert rep.basis == "queue_position"
+    assert "InjectedFault" in rep.reason
+    positions = [w.position for w in rep.base.workloads]
+    assert positions == list(range(4))
+    assert all(w.basis == "queue_position" for w in rep.base.workloads)
+
+    assert pre.basis == "queue_position"
+    assert not pre.ok
+    assert pre.position == 0  # nothing pending outranks priority 1
+
+    # The degraded report never perturbed the real world.
+    res = sched.schedule()
+    assert len(res.admitted) >= 1
+
+
+def test_breaker_trips_opens_and_recovers_half_open():
+    t = [0.0]
+    breaker = CircuitBreaker(threshold=2, backoff_s=10.0,
+                             max_backoff_s=60.0, clock=lambda: t[0])
+    cache, queues, _ = _contended_env()
+    eng = make_engine(cache, queues, breaker=breaker, clock=lambda: t[0])
+
+    faults.install(faults.FaultPlan().add(
+        faults.WHATIF_DISPATCH, mode="raise", rate=1.0))
+    try:
+        assert eng.eta().basis == "queue_position"
+        assert breaker.state == CLOSED
+        assert eng.eta().basis == "queue_position"
+        assert breaker.state == OPEN
+    finally:
+        faults.clear()
+
+    # Open: the dispatch is not even attempted until the backoff passes.
+    rep = eng.eta()
+    assert rep.basis == "queue_position"
+    assert rep.reason == "breaker_open"
+
+    t[0] += 11.0  # past the 10 s backoff: half-open probe, fault cleared
+    rep = eng.eta()
+    assert rep.basis == "rollout", rep.reason
+    assert breaker.state == CLOSED
+
+
+def test_forecast_unsupported_never_trips_the_breaker():
+    cache, queues, _ = _contended_env()
+    eng = make_engine(cache, queues)
+    # A workload with no LocalQueue route is structurally un-forecastable:
+    # contained as ForecastUnsupported, recorded as breaker SUCCESS.
+    for _ in range(eng.breaker.threshold + 1):
+        pre = eng.preview(make_wl("x", queue="no-such-lq", cpu_m=1_000,
+                                  creation_time=0.0))
+        assert pre.basis == "queue_position"
+        assert not pre.ok
+        assert "no LocalQueue" in pre.reason
+    assert eng.breaker.state == CLOSED
+    assert eng.breaker.failures == 0
+
+
+# ---------------------------------------------------------------------------
+# plumbing: runtime model + spare-time refresh
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_ms_resolution_order():
+    cache, queues, _ = std_env()
+    eng = make_engine(cache, queues, default_runtime_ms=77)
+    from kueue_tpu.core.workload_info import WorkloadInfo
+
+    ann = make_wl("a", creation_time=1.0)
+    ann.annotations[RUNTIME_ANNOTATION] = "1234"
+    ann.maximum_execution_time_seconds = 9
+    assert eng.runtime_ms(WorkloadInfo(ann, "cq-a")) == 1234
+
+    mx = make_wl("b", creation_time=2.0)
+    mx.maximum_execution_time_seconds = 9
+    assert eng.runtime_ms(WorkloadInfo(mx, "cq-a")) == 9_000
+
+    bare = make_wl("c", creation_time=3.0)
+    assert eng.runtime_ms(WorkloadInfo(bare, "cq-a")) == 77
+
+    bad = make_wl("d", creation_time=4.0)
+    bad.annotations[RUNTIME_ANNOTATION] = "not-a-number"
+    assert eng.runtime_ms(WorkloadInfo(bad, "cq-a")) == 77
+
+    fn_eng = make_engine(cache, queues, runtime_ms_fn=lambda info: 5)
+    assert fn_eng.runtime_ms(WorkloadInfo(ann, "cq-a")) == 5
+
+
+def test_maybe_refresh_honors_interval():
+    t = [0.0]
+    cache, queues, _ = _contended_env()
+    eng = make_engine(cache, queues, clock=lambda: t[0])
+    first = eng.maybe_refresh(interval_s=30.0)
+    assert first is not None and first.basis == "rollout"
+    assert eng.last_report is first
+    t[0] += 5.0
+    assert eng.maybe_refresh(interval_s=30.0) is None
+    assert eng.last_report is first
+    t[0] += 30.0
+    again = eng.maybe_refresh(interval_s=30.0)
+    assert again is not None and again is eng.last_report

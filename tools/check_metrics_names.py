@@ -112,6 +112,25 @@ def check_docs_coverage(allowlist: frozenset) -> List[str]:
     ]
 
 
+def check_fault_points_documented() -> List[str]:
+    """Every registered fault-injection point (utils/faults.py POINTS)
+    must appear in docs/fault_containment.md. An undocumented point is a
+    containment surface nobody drills: the injection framework exists so
+    operators rehearse failures by name."""
+    from kueue_tpu.utils.faults import POINTS
+
+    doc_path = REPO_ROOT / "docs" / "fault_containment.md"
+    if not doc_path.exists():
+        return [f"{doc_path.relative_to(REPO_ROOT)}: missing"]
+    doc = doc_path.read_text()
+    return [
+        f"docs/fault_containment.md: fault point {point!r} is in "
+        "utils/faults.py POINTS but undocumented"
+        for point in sorted(POINTS)
+        if point not in doc
+    ]
+
+
 def run_check() -> List[str]:
     """Returns human-readable violation lines; empty list = clean."""
     sys.path.insert(0, str(REPO_ROOT))
@@ -125,6 +144,7 @@ def run_check() -> List[str]:
             rel = path.relative_to(REPO_ROOT)
             out.append(f"{rel}:{lineno}: {msg}")
     out.extend(check_docs_coverage(METRIC_NAMES))
+    out.extend(check_fault_points_documented())
     return out
 
 

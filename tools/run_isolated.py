@@ -15,10 +15,17 @@ tests/conftest.py for the same reason). The fix is process isolation:
    compilation burden starts from zero and a crash kills only that
    segment;
 3. any segment that dies on a *signal* (segfault, not a test failure) is
-   retried once in a fresh process before being counted as failed.
+   retried once in a fresh process; if the **bulk** segment still dies,
+   its member files are retried standalone, one fresh process each, so
+   the crash is pinned to individual casualties instead of failing the
+   whole run.
 
-Exit status is 0 iff every segment passed. Extra pytest args after ``--``
-are forwarded to every segment (e.g. ``tools/run_isolated.py -- -q``).
+The summary reports how many segments were retried after signal deaths
+and how many remained casualties (still crashing when run alone). Exit
+status is 0 iff every test ultimately passed — a segfault victim whose
+standalone retry is green does not fail the run. Extra pytest args after
+``--`` are forwarded to every segment (e.g. ``tools/run_isolated.py --
+-q``).
 """
 
 from __future__ import annotations
@@ -48,10 +55,18 @@ def isolated_files() -> list:
     return out
 
 
-def run_segment(label: str, args: list, extra: list) -> int:
+def _is_signal_death(rc: int) -> bool:
+    # Negative = killed by signal (subprocess convention); 128+sig covers
+    # a shell-wrapped child reporting the same thing.
+    return rc < 0 or rc > 128
+
+
+def run_segment(label: str, args: list, extra: list,
+                stats: dict) -> int:
     """Run one pytest segment in a fresh subprocess, streaming output.
     Returns the exit code; a signal death (rc < 0, or 128+sig from a
-    shell) is retried once in another fresh process."""
+    shell) is retried once in another fresh process and counted in
+    ``stats``."""
     cmd = [sys.executable, "-m", "pytest", *BASE_ARGS, *args, *extra]
     env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
         "JAX_PLATFORMS", "cpu"))
@@ -63,11 +78,19 @@ def run_segment(label: str, args: list, extra: list) -> int:
             # No tests collected (e.g. every test in the segment is
             # deselected by the -m expression): vacuously green.
             return 0
-        if rc >= 0 and rc != 139:
+        if not _is_signal_death(rc):
             return rc
         print(f"== [{label}] died on a signal (rc={rc}); retrying in a "
               "fresh process", flush=True)
+        if attempt == 1:
+            stats["retries"] += 1
     return rc
+
+
+def bulk_files() -> list:
+    isolated = set(isolated_files())
+    return [p for p in sorted(TESTS.glob("test_*.py"))
+            if p not in isolated]
 
 
 def main(argv: list) -> int:
@@ -81,24 +104,50 @@ def main(argv: list) -> int:
               file=sys.stderr)
         return 2
 
+    stats = {"retries": 0}
     failures = []
+    casualties = []
     rc = run_segment(
         "bulk",
         ["tests/", "-m", "(not slow) and not isolated"],
-        extra,
+        extra, stats,
     )
-    if rc != 0:
+    if _is_signal_death(rc):
+        # The cumulative-compile crash moved into the bulk segment: pin
+        # it down by retrying every member file standalone, one fresh
+        # process each. Only files that fail (or keep crashing) alone
+        # count against the run.
+        print("== [bulk] still dying on a signal; retrying member files "
+              "standalone", flush=True)
+        for path in bulk_files():
+            rel = str(path.relative_to(REPO_ROOT))
+            stats["retries"] += 1
+            frc = run_segment(
+                rel, [rel, "-m", "(not slow) and not isolated"],
+                extra, stats,
+            )
+            if _is_signal_death(frc):
+                casualties.append((rel, frc))
+            elif frc != 0:
+                failures.append((rel, frc))
+    elif rc != 0:
         failures.append(("bulk", rc))
     for path in isolated_files():
-        rel = path.relative_to(REPO_ROOT)
-        rc = run_segment(str(rel), [str(rel), "-m", "not slow"], extra)
-        if rc != 0:
-            failures.append((str(rel), rc))
+        rel = str(path.relative_to(REPO_ROOT))
+        rc = run_segment(rel, [rel, "-m", "not slow"], extra, stats)
+        if _is_signal_death(rc):
+            casualties.append((rel, rc))
+        elif rc != 0:
+            failures.append((rel, rc))
 
     print("\n== run_isolated summary")
-    if not failures:
+    print(f"signal retries: {stats['retries']}, "
+          f"casualties: {len(casualties)}")
+    if not failures and not casualties:
         print("all segments passed")
         return 0
+    for label, rc in casualties:
+        print(f"CASUALTY segment {label} (still dying on rc={rc})")
     for label, rc in failures:
         print(f"FAILED segment {label} (rc={rc})")
     return 1
