@@ -993,6 +993,93 @@ def probe_whatif(scale: float):
     }
 
 
+def probe_coldstart_child(scale: float):
+    """Child half of the cold-start probe: one fresh process, the shared
+    persistent compile cache + AOT store (KUEUE_TPU_COMPILE_CACHE), one
+    measurement of time-to-first-admission — scheduler construction
+    through the first admitting cycle, compiles included. Run twice
+    against the same cache dir by probe_coldstart; the delta is exactly
+    the compile cost the cache removes."""
+    import jax
+
+    from kueue_tpu.models.driver import DeviceScheduler
+    from kueue_tpu.perf import compile_cache
+
+    configured = compile_cache.configure()
+    compile_cache.install_listeners()
+    cache, queues, workloads = build_scenario(
+        scale, n_cohorts=1, n_cqs=2,
+        classes=[("cold", max(1, int(4 * scale)), 1000, 50, 1.0)],
+    )
+    for wl, _runtime_s in workloads:
+        queues.add_or_update_workload(wl)
+
+    t0 = time.monotonic()
+    sched = DeviceScheduler(cache, queues)
+    result = sched.schedule()
+    first_admission_s = time.monotonic() - t0
+
+    stats = compile_cache.stats()
+    out = {
+        "probe": "coldstart-child",
+        "ok": bool(result.admitted),
+        "platform": jax.devices()[0].platform,
+        "cache_dir": configured,
+        "n": len(workloads),
+        "admitted_first_cycle": len(result.admitted),
+        "first_admission_s": round(first_admission_s, 3),
+        "backend_compiles": stats["backend_compiles"],
+        "compile_s": round(stats["compile_seconds"], 3),
+        "cache_hits": stats["cache_hits"],
+        "cache_misses": stats["cache_misses"],
+        "aot_hits": stats["aot_hits"],
+        "aot_stored": [],
+    }
+    # Fallback line BEFORE the serialize step: executable.serialize()
+    # can segfault on some jaxlib CPU builds, and the parent parses the
+    # last JSON line on stdout — a crash below costs the AOT store for
+    # the next process, not this measurement.
+    print(json.dumps(out), flush=True)
+    out["aot_stored"] = sorted(compile_cache.store_recorded())
+    return out
+
+
+def probe_coldstart(scale: float, platform: str = None):
+    """Cold start vs warm cache (docs/perf.md): two fresh processes
+    sharing one persistent compile cache + AOT executable store. The
+    cold process compiles the solver cycle inside its first admission;
+    the warm one deserializes it — its time-to-first-admission must be
+    >= 3x faster on CPU."""
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="kueue-tpu-coldstart-")
+    env = {"KUEUE_TPU_COMPILE_CACHE": cache_dir}
+    cold = run_probe_subprocess(
+        "coldstart-child", 420, scale, platform, env_extra=env
+    )
+    warm = run_probe_subprocess(
+        "coldstart-child", 420, scale, platform, env_extra=env
+    )
+    out = {"probe": "coldstart", "cache_dir": cache_dir,
+           "cold": cold, "warm": warm}
+    if not (cold.get("ok") and warm.get("ok")):
+        out["ok"] = False
+        return out
+    warm_s = warm["first_admission_s"]
+    speedup = (cold["first_admission_s"] / warm_s
+               if warm_s > 0 else float("inf"))
+    out.update({
+        "cold_first_admission_s": cold["first_admission_s"],
+        "warm_first_admission_s": warm_s,
+        "speedup_x": round(speedup, 2),
+        "warm_aot_hits": warm["aot_hits"],
+        "warm_cache_hits": warm["cache_hits"],
+        "warm_backend_compiles": warm["backend_compiles"],
+        "ok": speedup >= 3.0,
+    })
+    return out
+
+
 def run_probe_subprocess(
     probe: str, timeout_s: int, scale: float, platform: str = None,
     env_extra: dict = None, compile_cache: str = None,
@@ -1041,7 +1128,8 @@ def main():
                     help="fraction of the 15k baseline workload count")
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
-                             "multichip", "incremental", "whatif"],
+                             "multichip", "incremental", "whatif",
+                             "coldstart", "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -1086,6 +1174,10 @@ def main():
                 "multichip": probe_multichip,
                 "incremental": lambda: probe_incremental(args.scale),
                 "whatif": lambda: probe_whatif(args.scale),
+                "coldstart": lambda: probe_coldstart(
+                    args.scale, args.platform),
+                "coldstart-child": lambda: probe_coldstart_child(
+                    args.scale),
             }[args.probe]()
         except Exception as exc:  # noqa: BLE001 - report, don't crash
             stats = {"probe": args.probe, "ok": False,

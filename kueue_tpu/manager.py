@@ -140,6 +140,25 @@ class Manager:
             )
         return self._whatif
 
+    def prewarm(self, max_heads: int = 16, background: bool = False,
+                aot: bool = True):
+        """Compile the device solver's bucket ladder up front so the
+        first admission cycles hit warm executables (docs/perf.md, "Cold
+        start & compile cache"). Also wires the persistent compile cache
+        from ``KUEUE_TPU_COMPILE_CACHE`` when set, so the compiles
+        persist across processes. No-op (returns ``{}``) on the
+        host-only scheduler; call after registering flavors and
+        ClusterQueues — the warmup encodes the live snapshot's shapes."""
+        from kueue_tpu.perf import compile_cache
+
+        compile_cache.configure()
+        prewarm_fn = getattr(self.scheduler, "prewarm", None)
+        if prewarm_fn is None:
+            return {}
+        return prewarm_fn(
+            max_heads=max_heads, background=background, aot=aot
+        )
+
     # ------------------------------------------------------------------
     # configuration objects
     # ------------------------------------------------------------------

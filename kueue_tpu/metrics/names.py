@@ -102,6 +102,11 @@ TRACING_SERIES = frozenset({
     "whatif_rollout_seconds",
     "whatif_scenarios_total",
     "whatif_fallback_total",
+    # Cold start / compile cache (perf/compile_cache.py, driver prewarm).
+    "solver_compile_seconds",
+    "solver_compile_cache_hits_total",
+    "solver_compile_cache_misses_total",
+    "solver_prewarm_state",
 })
 
 METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES

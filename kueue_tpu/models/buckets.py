@@ -1,0 +1,96 @@
+"""The single shape-bucket ladder for every compiled solver entry point.
+
+Compiled XLA programs are keyed by their padded argument shapes, so two
+call sites that bucket the same logical size differently compile (and
+cache, and prewarm) two executables for identical work. Before this
+module the driver's ``_pick_bucket`` used an unbounded power-of-two
+ladder while the what-if engine capped power-of-two growth at 1024 and
+switched to 1024-multiples above it — e.g. 2500 heads padded to 4096 on
+the admission path but 3072 on the forecast path, guaranteeing a
+duplicate compile of the same cycle program. Every W-axis caller
+(driver, encode defaults, whatif/engine, and through them the sim-loop
+rollouts) now resolves through :func:`bucket_for`, and the scan-depth /
+slot-axis power-of-two buckets resolve through :func:`pow2_bucket`, so
+identical logical shapes always share one executable — and
+``perf/compile_cache.py`` can prewarm the ladder knowing it covers every
+runtime shape.
+
+The ladder itself keeps the what-if engine's memory-conscious shape:
+power-of-two rungs up to :data:`LINEAR_CAP`, then multiples of
+:data:`LINEAR_STEP`. Above ~1k rows a pow2 pad can waste ~60% of the
+batch's memory (vmapped [K, W] forecast planes blow the cache) for no
+compile-count win, while below it pow2 keeps the rung count logarithmic.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+# Minimum W-axis bucket: the admission cycle's smallest compiled shape.
+FLOOR = 16
+# Pow2 rungs up to here; linear LINEAR_STEP-multiples above.
+LINEAR_CAP = 1024
+LINEAR_STEP = 1024
+
+
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor). The generic compile
+    bucket for scan depths and slot axes (encode's ``fair_s_bound`` uses
+    floor=4, the slot S axis floor=1, rollout ``s_max`` floor=8)."""
+    return 1 << (max(int(n), floor, 1) - 1).bit_length()
+
+
+def bucket_for(n: int, floor: int = FLOOR) -> int:
+    """The unified W-axis bucket for ``n`` workload rows."""
+    n = max(int(n), floor)
+    if n <= LINEAR_CAP:
+        return pow2_bucket(n)
+    return LINEAR_STEP * ((n + LINEAR_STEP - 1) // LINEAR_STEP)
+
+
+def prev_bucket(bucket: int, floor: int = FLOOR) -> int:
+    """The next-smaller rung (shrink step), clamped at ``floor``."""
+    if bucket > LINEAR_CAP:
+        return bucket - LINEAR_STEP
+    return max(floor, bucket // 2)
+
+
+def ladder(up_to: int, floor: int = FLOOR) -> List[int]:
+    """Every rung from ``floor`` up to the one covering ``up_to`` — the
+    shape set a prewarm must compile to cover workloads of that size."""
+    rungs = [bucket_for(floor, floor)]
+    top = bucket_for(up_to, floor)
+    while rungs[-1] < top:
+        rung = rungs[-1]
+        rungs.append(rung * 2 if rung < LINEAR_CAP else rung + LINEAR_STEP)
+    return rungs
+
+
+class BucketLadder:
+    """Stateful rung selection with shrink hysteresis.
+
+    Growth is immediate (the cycle must fit); shrinking one rung
+    requires the observed size to fit a smaller rung for ``patience``
+    consecutive observations — a size oscillating across a rung boundary
+    would otherwise recompile the cycle program every cycle. Any
+    observation that needs the current rung (or larger) resets the
+    streak.
+    """
+
+    def __init__(self, floor: int = FLOOR, patience: int = 4) -> None:
+        self.floor = floor
+        self.patience = patience
+        self.value = bucket_for(floor, floor)
+        self.streak = 0
+
+    def observe(self, n: int) -> int:
+        need = bucket_for(n, self.floor)
+        if need >= self.value:
+            self.value = need
+            self.streak = 0
+        else:
+            self.streak += 1
+            if self.streak >= self.patience:
+                self.value = prev_bucket(self.value, self.floor)
+                self.streak = 0
+        return self.value

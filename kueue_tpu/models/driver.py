@@ -28,9 +28,10 @@ from kueue_tpu.core.workload_info import (
     set_condition,
 )
 from kueue_tpu.metrics import tracing
-from kueue_tpu.models import batch_scheduler
+from kueue_tpu.models import batch_scheduler, buckets
 from kueue_tpu.models.arena import CycleArena
 from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.perf import compile_cache
 from kueue_tpu.queue.manager import QueueManager
 from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
 from kueue_tpu.utils import faults
@@ -95,9 +96,12 @@ class DeviceScheduler:
         self._adm_cache: Dict = (
             self._arena.component_cache if self._arena is not None else {}
         )
-        # Padding-bucket hysteresis state.
-        self._w_bucket = 16
-        self._shrink_streak = 0
+        # Padding-bucket hysteresis state (the unified ladder from
+        # models/buckets.py — the same rungs the whatif engine pads to,
+        # so both paths share one executable per logical shape).
+        self._w_ladder = buckets.BucketLadder(
+            patience=self._SHRINK_PATIENCE
+        )
         # Fault containment: device-path exceptions and invalid readback
         # planes route the cycle through the host-exact path instead of
         # crashing the loop or applying a wrong admission; K consecutive
@@ -129,6 +133,91 @@ class DeviceScheduler:
         self._whatif = engine
         self._whatif_interval_s = refresh_interval_s
         return engine
+
+    def prewarm(self, max_heads: int = 16, background: bool = False,
+                aot: bool = True):
+        """Compile the admission-cycle entry points for every W bucket
+        of the ladder covering ``max_heads`` (models/buckets.py), so the
+        first real cycles hit warm executables instead of multi-second
+        jits. Encoding the live snapshot with zero heads reproduces the
+        exact compile shape of a real cycle at each bucket (padding rows
+        are inert); with a persistent compile cache configured
+        (perf/compile_cache.configure) the compiles also land on disk
+        for the next process, and ``aot=True`` additionally serializes
+        standalone executables into the AOT store.
+
+        ``background=True`` runs the warmup in a daemon thread and
+        returns it; admission cycles proceed meanwhile (a cycle that
+        races ahead of the warmup just compiles its own shape first).
+        Synchronous calls return ``{bucket: seconds}``; failures set
+        ``solver_prewarm_state`` to 3 and are contained (a broken warmup
+        must never stop the service from admitting)."""
+        if background:
+            import threading
+
+            t = threading.Thread(
+                target=self._prewarm_sync, args=(max_heads, aot),
+                name="kueue-tpu-prewarm", daemon=True,
+            )
+            t.start()
+            return t
+        return self._prewarm_sync(max_heads, aot)
+
+    def _prewarm_sync(self, max_heads: int, aot: bool):
+        if tracing.ENABLED:
+            tracing.set_gauge("solver_prewarm_state", 1)  # running
+        timings: Dict[int, float] = {}
+        try:
+            snapshot = self.cache.snapshot()
+            if self.fair_sharing:
+                from kueue_tpu.models.fair_kernel import (
+                    fair_cycle_preempt_for,
+                )
+
+                # Upper-bound the tournament depth from the snapshot
+                # itself (every CQ under a root could hold a head);
+                # encode's per-cycle bound never exceeds it.
+                roots: Dict[int, int] = {}
+                for cqs in snapshot.cluster_queues.values():
+                    rid = id(cqs.node.root())
+                    roots[rid] = roots.get(rid, 0) + 1
+                s_bound = buckets.pow2_bucket(
+                    max(roots.values(), default=1), floor=4
+                )
+            for bucket in buckets.ladder(max_heads):
+                arrays, idx = encode_cycle(
+                    snapshot, [], snapshot.resource_flavors,
+                    w_pad=bucket, fair_sharing=self.fair_sharing,
+                    preempt=True,
+                    fair_strategies=self.host.preemptor.fair_strategies,
+                )
+                if self.fair_sharing:
+                    timings[bucket] = compile_cache.prewarm_entry(
+                        "cycle_fair_preempt",
+                        fair_cycle_preempt_for(s_bound),
+                        (arrays, idx.admitted_arrays),
+                        static=("s_max", s_bound), aot=aot,
+                    )
+                else:
+                    timings[bucket] = compile_cache.prewarm_entry(
+                        "cycle_grouped_preempt",
+                        batch_scheduler.cycle_grouped_preempt,
+                        (arrays, idx.group_arrays, idx.admitted_arrays),
+                        aot=aot,
+                    )
+                    if self.use_fixedpoint:
+                        timings[bucket] += compile_cache.prewarm_entry(
+                            "cycle_fixedpoint",
+                            batch_scheduler.cycle_fixedpoint,
+                            (arrays, idx.group_arrays), aot=aot,
+                        )
+            if tracing.ENABLED:
+                tracing.set_gauge("solver_prewarm_state", 2)  # done
+        except Exception as exc:
+            self.last_fault = ("prewarm_error", repr(exc))
+            if tracing.ENABLED:
+                tracing.set_gauge("solver_prewarm_state", 3)  # failed
+        return timings
 
     def schedule(self) -> CycleResult:
         self.cycles += 1
@@ -246,14 +335,16 @@ class DeviceScheduler:
                 # probes both.
                 if self.fair_sharing:
                     from kueue_tpu.models.fair_kernel import (
-                        cycle_fair_preempt,
+                        fair_cycle_preempt_for,
                     )
 
                     with tracing.span("device/cycle_fair_preempt",
                                       batch=bucket):
-                        out = cycle_fair_preempt(
+                        out = compile_cache.dispatch(
+                            "cycle_fair_preempt",
+                            fair_cycle_preempt_for(idx.fair_s_bound),
                             arrays, idx.admitted_arrays,
-                            s_max=idx.fair_s_bound,
+                            static=("s_max", idx.fair_s_bound),
                         )
                 elif self.use_fixedpoint and not idx.has_partial \
                         and arrays.s_req is None \
@@ -262,14 +353,18 @@ class DeviceScheduler:
                 ):
                     with tracing.span("device/cycle_fixedpoint",
                                       batch=bucket):
-                        out = batch_scheduler.cycle_fixedpoint(
-                            arrays, idx.group_arrays
+                        out = compile_cache.dispatch(
+                            "cycle_fixedpoint",
+                            batch_scheduler.cycle_fixedpoint,
+                            arrays, idx.group_arrays,
                         )
                 else:
                     with tracing.span("device/cycle_grouped_preempt",
                                       batch=bucket):
-                        out = batch_scheduler.cycle_grouped_preempt(
-                            arrays, idx.group_arrays, idx.admitted_arrays
+                        out = compile_cache.dispatch(
+                            "cycle_grouped_preempt",
+                            batch_scheduler.cycle_grouped_preempt,
+                            arrays, idx.group_arrays, idx.admitted_arrays,
                         )
             except Exception as exc:
                 if not self._containable(exc):
@@ -468,23 +563,13 @@ class DeviceScheduler:
     # ------------------------------------------------------------------
 
     def _pick_bucket(self, n_heads: int) -> int:
-        """Power-of-two W padding bucket with shrink hysteresis. Growth is
-        immediate (the cycle must fit); shrinking one halving step requires
-        the head count to fit the next-smaller bucket for _SHRINK_PATIENCE
-        consecutive cycles — a count oscillating across a bucket boundary
-        would otherwise recompile the cycle program every cycle."""
-        need = 16
-        while need < n_heads:
-            need *= 2
-        if need >= self._w_bucket:
-            self._w_bucket = max(self._w_bucket, need)
-            self._shrink_streak = 0
-        else:
-            self._shrink_streak += 1
-            if self._shrink_streak >= self._SHRINK_PATIENCE:
-                self._w_bucket //= 2
-                self._shrink_streak = 0
-        return self._w_bucket
+        """W padding bucket (models/buckets.py ladder) with shrink
+        hysteresis. Growth is immediate (the cycle must fit); shrinking
+        one rung requires the head count to fit the next-smaller bucket
+        for _SHRINK_PATIENCE consecutive cycles — a count oscillating
+        across a bucket boundary would otherwise recompile the cycle
+        program every cycle."""
+        return self._w_ladder.observe(n_heads)
 
     @staticmethod
     def _in_discarded(info, snapshot, discarded_roots) -> bool:

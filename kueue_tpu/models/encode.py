@@ -27,6 +27,7 @@ from kueue_tpu.api.constants import (
 from kueue_tpu.cache.snapshot import Snapshot
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.core.workload_info import WorkloadInfo, has_quota_reservation
+from kueue_tpu.models import buckets
 from kueue_tpu.ops.quota_ops import QuotaTreeArrays
 from kueue_tpu.ops.tree_encode import GroupLayout, TreeIndex, encode_tree
 from kueue_tpu.core.workload_info import queue_order_timestamp
@@ -493,7 +494,7 @@ def encode_cycle(
                 root_of_cq[info.cluster_queue], set()
             ).add(info.cluster_queue)
         bound = max((len(s) for s in cqs_of_root.values()), default=1)
-        idx.fair_s_bound = 1 << max(bound - 1, 2).bit_length()
+        idx.fair_s_bound = buckets.pow2_bucket(bound, floor=4)
 
     # Layout: the dense legacy (single-slot, first-RG) layout compiles the
     # existing kernels unchanged; any multi-podset or off-RG0 entry
@@ -503,16 +504,18 @@ def encode_cycle(
     )
     s_n = 1
     if need_slots:
-        s_n = max(len(sl) for sl in wl_slots)
-        s_n = 1 << (s_n - 1).bit_length()  # power-of-two compile bucket
+        # Power-of-two compile bucket for the slot axis.
+        s_n = buckets.pow2_bucket(max(len(sl) for sl in wl_slots))
 
-    # Power-of-two compile bucket (min 16): the W axis shrinks cycle over
-    # cycle as entries admit, and an exact-size pad would recompile every
-    # kernel per cycle; bucketing reuses one compiled program across
-    # cycles (and across same-bucket scenarios in one process). Padding
-    # rows are inert (w_active=False), identical to the old %8 rows.
+    # Unified compile bucket (models/buckets.py, min 16): the W axis
+    # shrinks cycle over cycle as entries admit, and an exact-size pad
+    # would recompile every kernel per cycle; bucketing reuses one
+    # compiled program across cycles (and across same-bucket scenarios
+    # in one process — driver and whatif paths share the same ladder).
+    # Padding rows are inert (w_active=False), identical to the old %8
+    # rows.
     if w_pad == 0:
-        w = max(16, 1 << max(len(device_wls) - 1, 0).bit_length())
+        w = buckets.bucket_for(len(device_wls))
     else:
         w = w_pad
     w_cq = np.zeros(w, dtype=np.int32)

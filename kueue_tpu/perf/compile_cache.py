@@ -1,0 +1,392 @@
+"""Compile-performance subsystem: persistent compile cache + AOT store.
+
+A fresh admission sidecar must serve <100ms cycles immediately, but
+every new process used to re-jit every solver variant from scratch —
+multi-second time-to-first-admission per entry point. This module kills
+the cold start in three layers:
+
+1. **Persistent compilation cache** — :func:`configure` points JAX's
+   on-disk compilation cache at a directory (``KUEUE_TPU_COMPILE_CACHE``
+   env or explicit argument), so a backend compile in one process is a
+   disk hit in the next. The threshold knobs are forced to cache *every*
+   executable (the default minimums skip exactly the small solver
+   programs this service runs).
+2. **Compile observability** — :func:`install_listeners` bridges
+   ``jax.monitoring`` events into the metrics registry
+   (``solver_compile_seconds``, ``solver_compile_cache_hits_total``,
+   ``solver_compile_cache_misses_total``) and a process-local
+   :func:`stats` counter block that the compile-count regression tests
+   assert against.
+3. **AOT executable store** — :func:`prewarm_entry` lowers, compiles and
+   serializes a solver entry point for one bucket shape
+   (``jax.experimental.serialize_executable``); :func:`dispatch` loads
+   the stored executable on the next cold start and calls it directly,
+   skipping even the persistent-cache compile round-trip. Entries are
+   keyed by (entry point, argument shape signature, static config,
+   device kind, jax/jaxlib version) and carry a sha256 integrity
+   digest; any mismatch, deserialize failure, or injected
+   ``compile.deserialize`` fault falls back to the plain jitted call —
+   behind a circuit breaker so a corrupt store cannot stall admission
+   with repeated load attempts.
+
+CAUTION — serialization writes: this jaxlib intermittently segfaults
+inside PJRT ``executable.serialize()`` under heavy cumulative compile
+load (the reason tests/conftest.py disables the persistent cache by
+default and tools/run_isolated.py exists). AOT stores therefore happen
+ONLY inside explicit prewarm calls — never on the admission hot path —
+and the persistent cache stays opt-in for the test suite.
+
+Zero-cost when disabled, same pattern as ``tracing.ENABLED`` /
+``faults.ENABLED``: :func:`dispatch` is a straight passthrough call
+until an AOT store is configured.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from kueue_tpu.metrics import tracing
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
+
+# Fast flags, mutated only under _lock by configure()/enable_aot()/reset().
+ENABLED = False  # persistent compilation cache configured
+AOT_ENABLED = False  # AOT executable store configured
+
+ENV_VAR = "KUEUE_TPU_COMPILE_CACHE"
+_AOT_SUBDIR = "aot"
+
+_lock = threading.Lock()
+_cache_dir: Optional[str] = None
+_aot: Optional["AOTCache"] = None
+_listeners_installed = False
+
+# Process-local counters (see stats()): the compile-count regression
+# tests assert on backend_compiles, the coldstart probe reports the rest.
+_stats = {
+    "cache_hits": 0,  # persistent-cache disk hits
+    "cache_misses": 0,  # persistent-cache misses (real backend compiles)
+    "backend_compiles": 0,  # backend compile requests (hits + misses)
+    "compile_seconds": 0.0,
+    "aot_hits": 0,  # dispatches served by a deserialized executable
+    "aot_load_failures": 0,  # integrity/deserialize failures (contained)
+    "prewarmed": 0,  # entries compiled by prewarm_entry
+}
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def stats() -> Dict[str, Any]:
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    for k in _stats:
+        _stats[k] = 0.0 if k == "compile_seconds" else 0
+
+
+def _on_event(event: str, *args, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        _stats["cache_hits"] += 1
+        if tracing.ENABLED:
+            tracing.inc("solver_compile_cache_hits_total")
+    elif event == _MISS_EVENT:
+        _stats["cache_misses"] += 1
+        if tracing.ENABLED:
+            tracing.inc("solver_compile_cache_misses_total")
+
+
+def _on_duration(event: str, duration: float, *args, **kwargs) -> None:
+    if event == _COMPILE_EVENT:
+        _stats["backend_compiles"] += 1
+        _stats["compile_seconds"] += duration
+        if tracing.ENABLED:
+            tracing.observe("solver_compile_seconds", duration)
+
+
+def install_listeners() -> None:
+    """Bridge jax.monitoring compile/cache events into stats() and the
+    metrics registry. Idempotent; listener registration has no public
+    removal API, so the bridge stays for the process lifetime."""
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listeners_installed = True
+
+
+def configure(cache_dir: Optional[str] = None,
+              enable_aot: bool = True) -> Optional[str]:
+    """Enable the persistent compilation cache (and, by default, the AOT
+    executable store under ``<dir>/aot``). ``cache_dir`` defaults to the
+    ``KUEUE_TPU_COMPILE_CACHE`` environment variable; returns the
+    configured directory, or None when neither is set. Idempotent."""
+    global ENABLED, _cache_dir
+    cache_dir = cache_dir or os.environ.get(ENV_VAR) or None
+    if not cache_dir:
+        return None
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    with _lock:
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # The defaults skip small/fast programs — exactly the solver
+        # executables this service runs. Cache everything.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        ENABLED = True
+        _cache_dir = cache_dir
+    install_listeners()
+    if enable_aot:
+        activate_aot(os.path.join(cache_dir, _AOT_SUBDIR))
+    return cache_dir
+
+
+def activate_aot(aot_dir: str) -> "AOTCache":
+    """Point :func:`dispatch` / :func:`prewarm_entry` at an on-disk AOT
+    executable store (normally called via :func:`configure`)."""
+    global AOT_ENABLED, _aot
+    with _lock:
+        if _aot is None or _aot.root != os.path.abspath(aot_dir):
+            _aot = AOTCache(aot_dir)
+        AOT_ENABLED = True
+    install_listeners()
+    return _aot
+
+
+def reset() -> None:
+    """Drop the AOT store binding and counters (tests). The persistent
+    jax cache config is left as-is — flipping it mid-process would
+    invalidate nothing and confuse everything."""
+    global AOT_ENABLED, _aot, ENABLED, _cache_dir
+    with _lock:
+        AOT_ENABLED = False
+        _aot = None
+        ENABLED = False
+        _cache_dir = None
+    reset_stats()
+
+
+def cache_dir() -> Optional[str]:
+    return _cache_dir
+
+
+def _device_fingerprint() -> str:
+    import jax
+
+    dev = jax.devices()[0]
+    return f"{dev.platform}/{dev.device_kind}"
+
+
+def _versions() -> str:
+    import jax
+    import jaxlib
+
+    return f"jax={jax.__version__};jaxlib={jaxlib.__version__}"
+
+
+def signature(args: Tuple[Any, ...], static: Tuple[Any, ...] = ()) -> str:
+    """Stable shape/dtype/pytree signature of a call — the part of the
+    AOT key that varies per bucket. Static (closure-baked) parameters
+    must be passed explicitly: they select a different compiled program
+    without appearing in the argument avals."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            parts.append(f"py:{type(leaf).__name__}:{leaf!r}")
+        else:
+            parts.append(f"{dtype}{tuple(shape)}")
+    return f"{treedef}|{';'.join(parts)}|static={static!r}"
+
+
+class AOTCache:
+    """On-disk store of serialized solver executables.
+
+    File layout: ``<root>/<entry>-<digest16>.aot`` where the digest is
+    sha256 over (entry, signature, device kind, versions). Payload
+    format: 64 ascii hex chars (sha256 of the body) + ``\\n`` + pickled
+    ``(serialized_executable, in_tree, out_tree)``. Loads verify the
+    digest before unpickling; every failure mode (missing file, bad
+    digest, unpickle error, deserialize error, injected
+    ``compile.deserialize`` fault) returns None and lets the caller fall
+    back to the plain jit path. A circuit breaker stops repeated load
+    attempts against a persistently corrupt store."""
+
+    def __init__(self, root: str,
+                 breaker: Optional[CircuitBreaker] = None) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.breaker = breaker or CircuitBreaker(
+            threshold=3, backoff_s=60.0, max_backoff_s=600.0
+        )
+        self._loaded: Dict[str, Any] = {}
+
+    # -- keying --------------------------------------------------------
+
+    def key(self, entry: str, sig: str) -> str:
+        blob = "\x00".join(
+            (entry, sig, _device_fingerprint(), _versions())
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def path_for(self, entry: str, sig: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in entry)
+        return os.path.join(
+            self.root, f"{safe}-{self.key(entry, sig)[:16]}.aot"
+        )
+
+    # -- store / load --------------------------------------------------
+
+    def store(self, entry: str, sig: str, compiled) -> str:
+        """Serialize a Compiled executable to disk (atomic rename).
+        ONLY call from prewarm paths — see the module caution on the
+        jaxlib serialize() hazard."""
+        from jax.experimental import serialize_executable as se
+
+        payload = pickle.dumps(se.serialize(compiled))
+        digest = hashlib.sha256(payload).hexdigest().encode()
+        path = self.path_for(entry, sig)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(digest + b"\n" + payload)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, entry: str, sig: str):
+        """Deserialize-and-load the stored executable for (entry, sig),
+        or None. Never raises: corruption is this store's threat model,
+        not its failure mode."""
+        path = self.path_for(entry, sig)
+        if not os.path.exists(path):
+            return None
+        if not self.breaker.allow():
+            return None
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.COMPILE_DESERIALIZE)
+            with open(path, "rb") as f:
+                blob = f.read()
+            digest, sep, payload = blob.partition(b"\n")
+            if not sep or hashlib.sha256(payload).hexdigest() != \
+                    digest.decode("ascii", "replace"):
+                raise ValueError(f"integrity digest mismatch in {path}")
+            from jax.experimental import serialize_executable as se
+
+            exe = se.deserialize_and_load(*pickle.loads(payload))
+            self.breaker.record_success()
+            return exe
+        except Exception:
+            _stats["aot_load_failures"] += 1
+            self.breaker.record_failure()
+            return None
+
+
+_PROBE = object()  # sentinel: "not probed yet" vs "probed, absent"
+
+# Most recent (fn, args, static) per entry, recorded by dispatch() while
+# the AOT store is active, so an explicit prewarm (store_recorded) can
+# serialize executables whose call shapes only exist at dispatch time
+# (the whatif rollout). Holds device-array references — bounded by the
+# number of distinct entry points, and only when AOT is opted in.
+_recorded: Dict[str, Tuple[Callable, Tuple, Tuple]] = {}
+
+
+def dispatch(entry: str, fn: Callable, *args, static: Tuple = ()):
+    """Call a jitted solver entry point through the AOT store.
+
+    Passthrough (one module-flag read) when no store is configured. With
+    a store: the first call per (entry, shape signature, static) probes
+    the store; a loaded executable serves this and every later matching
+    call with zero compiles, anything else falls back to ``fn(*args)``
+    (which compiles once through the persistent cache). ``static`` must
+    carry closure-baked parameters (fair s_max, rollout kernel/horizon)
+    that select a different program without changing argument shapes."""
+    aot = _aot
+    if not AOT_ENABLED or aot is None:
+        return fn(*args)
+    _recorded[entry] = (fn, args, static)
+    sig = signature(args, static)
+    ck = f"{entry}|{sig}"
+    exe = aot._loaded.get(ck, _PROBE)
+    if exe is _PROBE:
+        exe = aot.load(entry, sig)
+        aot._loaded[ck] = exe
+    if exe is not None:
+        try:
+            out = exe(*args)
+            _stats["aot_hits"] += 1
+            return out
+        except Exception:
+            # Aval/layout drift between store time and now: disable this
+            # entry for the process and take the jit path.
+            aot._loaded[ck] = None
+    return fn(*args)
+
+
+def prewarm_entry(entry: str, fn: Callable, args: Tuple,
+                  static: Tuple = (), aot: bool = True) -> float:
+    """Compile one solver entry point for one bucket shape: call the
+    jitted ``fn`` (seeding the in-process jit cache and, when enabled,
+    the persistent cache), then — if an AOT store is configured and the
+    executable is not already on disk — lower/compile/serialize it.
+    Returns wall seconds."""
+    import jax
+
+    t0 = time.monotonic()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _stats["prewarmed"] += 1
+    store = _aot
+    if aot and AOT_ENABLED and store is not None:
+        sig = signature(args, static)
+        if not os.path.exists(store.path_for(entry, sig)):
+            # With the persistent cache warm this backend compile is a
+            # disk hit; the serialize cost is the real work here.
+            compiled = fn.lower(*args).compile()
+            store.store(entry, sig, compiled)
+        store._loaded.pop(f"{entry}|{sig}", None)
+    return time.monotonic() - t0
+
+
+def store_recorded(entries: Optional[Tuple[str, ...]] = None
+                   ) -> Dict[str, str]:
+    """Serialize the most recently dispatched call of each recorded
+    entry point into the AOT store (skipping ones already on disk).
+    Prewarm-only, same serialize() hazard as :meth:`AOTCache.store` —
+    callers are explicit warmup paths like ``WhatIfEngine.prewarm``,
+    never the admission loop. Returns {entry: path} for what's now
+    stored."""
+    out: Dict[str, str] = {}
+    store = _aot
+    if not AOT_ENABLED or store is None:
+        return out
+    for entry, (fn, args, static) in list(_recorded.items()):
+        if entries is not None and entry not in entries:
+            continue
+        sig = signature(args, static)
+        path = store.path_for(entry, sig)
+        if not os.path.exists(path):
+            compiled = fn.lower(*args).compile()
+            store.store(entry, sig, compiled)
+        store._loaded.pop(f"{entry}|{sig}", None)
+        out[entry] = path
+    return out

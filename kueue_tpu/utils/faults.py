@@ -28,6 +28,9 @@ anything else so a typo'd point never silently no-ops):
 - ``cache.snapshot``    — the device path's snapshot acquisition
 - ``whatif.dispatch``   — the what-if engine's batched forecast dispatch
   (whatif/engine.py; degrades to the queue-position heuristic)
+- ``compile.deserialize`` — AOT executable loads from the on-disk
+  compile cache (perf/compile_cache.py; a corrupt or poisoned store
+  falls back to the plain jit path behind a breaker)
 
 Rule modes:
 
@@ -79,6 +82,7 @@ REMOTE_TRANSPORT = "remote.transport"
 REMOTE_DISPATCH = "remote.dispatch"
 CACHE_SNAPSHOT = "cache.snapshot"
 WHATIF_DISPATCH = "whatif.dispatch"
+COMPILE_DESERIALIZE = "compile.deserialize"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -88,6 +92,7 @@ POINTS = frozenset({
     REMOTE_DISPATCH,
     CACHE_SNAPSHOT,
     WHATIF_DISPATCH,
+    COMPILE_DESERIALIZE,
 })
 
 _MODES = ("raise", "delay", "corrupt")

@@ -208,18 +208,12 @@ _OUTCOME_NAMES = {
 }
 
 
-def _pow2(x: int) -> int:
-    return 1 << max(0, int(x) - 1).bit_length()
-
-
-def _w_bucket(x: int) -> int:
-    """Compile-shape bucket for the forecast W axis. Pow2 up to 1024,
-    then multiples of 1024: a forecast dispatch is one-shot per shape, so
-    above 1k rows the ~60% memory a pow2 pad can waste costs more (the
-    vmapped [K, W] planes blow the cache) than the extra compile
-    buckets save."""
-    x = max(16, int(x))
-    return _pow2(x) if x <= 1024 else 1024 * ((x + 1023) // 1024)
+# Compile-shape buckets for the forecast W axis and the scan depth: the
+# SAME ladder the admission driver pads with (models/buckets.py), so a
+# forecast over the shapes the live scheduler runs reuses the driver's
+# compiled executables instead of compiling near-duplicates.
+from kueue_tpu.models.buckets import bucket_for as _w_bucket
+from kueue_tpu.models.buckets import pow2_bucket as _pow2
 
 
 class WhatIfEngine:
@@ -250,7 +244,6 @@ class WhatIfEngine:
         self._clock = clock
         self._lock = threading.Lock()
         self._rollout_fns: Dict[tuple, Callable] = {}
-        self._preview_fn = None
         # Spare-time refresh state (driver hook).
         self.last_report: Optional[WhatIfReport] = None
         self._last_refresh = -float("inf")
@@ -353,6 +346,19 @@ class WhatIfEngine:
             report = self._preview_fallback(workload, cluster_queue, reason)
             report.wall_s = self._clock() - t0
             return report
+
+    def prewarm(self, aot: bool = True) -> Optional[WhatIfReport]:
+        """Compile the rollout program for the current snapshot's shapes
+        by running one base forecast; with the AOT store configured
+        (perf/compile_cache), additionally serialize the compiled
+        rollout executable for the next process. An explicit warmup
+        path — the serialize hazard never rides an admission cycle."""
+        report = self.eta()
+        if aot:
+            from kueue_tpu.perf import compile_cache
+
+            compile_cache.store_recorded(("whatif_rollout",))
+        return report
 
     def maybe_refresh(self, interval_s: float = 30.0) -> Optional[WhatIfReport]:
         """Driver spare-time hook: refresh the cached base ETA forecast
@@ -619,10 +625,17 @@ class WhatIfEngine:
         kernel = ("grouped"
                   if bool(np.asarray(arrays.tree.has_lend_limit).any())
                   else "fixedpoint")
-        s_max = _pow2(max(8, int(base_active.sum()) + len(hypo_rows)))
+        s_max = _pow2(int(base_active.sum()) + len(hypo_rows), floor=8)
         fn = self._rollout_fn(s_max, kernel)
         arrays_d, ga_d = jax.device_put((arrays, idx.group_arrays))
-        out = fn(arrays_d, ga_d, jnp.asarray(runtime), init, scen_t)
+        from kueue_tpu.perf import compile_cache
+
+        out = compile_cache.dispatch(
+            "whatif_rollout", fn,
+            arrays_d, ga_d, jnp.asarray(runtime), init, scen_t,
+            static=("s_max", s_max, "kernel", kernel,
+                    "horizon", self.horizon_rounds),
+        )
         adm = np.asarray(out.admitted_at)
         comp = np.asarray(out.completed_at)
         chosen = np.asarray(out.chosen_flavor)
@@ -787,21 +800,28 @@ class WhatIfEngine:
             workload, self._next_timestamp(self._collect_pending(True))
         )
         info = WorkloadInfo(workload, cq)
+        # Pad to the ladder's rung for one head: when the live driver's
+        # bucket sits at the same rung, the preview reuses the
+        # scheduler's own compiled cycle executable instead of jitting
+        # a duplicate (the old dedicated _preview_fn always compiled
+        # its own copy of the grouped-preempt program).
         arrays, idx = encode_cycle(
             snap, [info], snap.resource_flavors, preempt=True,
-            device_put=False,
+            w_pad=_w_bucket(1), device_put=False,
         )
         if any(h is info for h in idx.host_fallback) or not idx.workloads:
             raise ForecastUnsupported(
                 "hypothetical workload needs host-side scheduling"
             )
-        if self._preview_fn is None:
-            cycle = bs.make_grouped_cycle(0, preempt=True)
-            self._preview_fn = jax.jit(cycle)
         arrays_d, ga_d, adm_d = jax.device_put(
             (arrays, idx.group_arrays, idx.admitted_arrays)
         )
-        out = self._preview_fn(arrays_d, ga_d, adm_d)
+        from kueue_tpu.perf import compile_cache
+
+        out = compile_cache.dispatch(
+            "cycle_grouped_preempt", bs.cycle_grouped_preempt,
+            arrays_d, ga_d, adm_d,
+        )
         row = next(i for i, h in enumerate(idx.workloads) if h is info)
         outcome = int(np.asarray(out.outcome)[row])
         fl = int(np.asarray(out.chosen_flavor)[row])

@@ -11,6 +11,8 @@ jax.config, not environment variables.
 
 import os
 
+import pytest
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
@@ -20,8 +22,31 @@ import jax
 if os.environ.get("KUEUE_TPU_TEST_ON_TPU", "") != "1":
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-# Persistent compilation cache: disabled — this jaxlib intermittently
-# SEGFAULTS inside PJRT executable.serialize() on the cache-write path
-# (observed repeatedly killing whole pytest runs). The in-process cache
-# still covers repeated jits within one run.
-jax.config.update("jax_enable_compilation_cache", False)
+# Persistent compilation cache: disabled by default — this jaxlib
+# intermittently SEGFAULTS inside PJRT executable.serialize() on the
+# cache-write path (observed repeatedly killing whole pytest runs). The
+# in-process cache still covers repeated jits within one run.
+#
+# Opt in with KUEUE_TPU_COMPILE_CACHE=<dir> (perf/compile_cache.py):
+# the suite then reuses compiled solver executables across processes —
+# tools/run_isolated.py --compile-cache wires this through every
+# isolated segment, turning its fresh-process compile burden into disk
+# hits. The segfault risk rides with the opt-in.
+if os.environ.get("KUEUE_TPU_COMPILE_CACHE"):
+    from kueue_tpu.perf import compile_cache
+
+    compile_cache.configure()
+else:
+    jax.config.update("jax_enable_compilation_cache", False)
+
+
+@pytest.fixture(scope="session")
+def compile_cache_dir():
+    """The persistent compile cache directory the suite was pointed at
+    via KUEUE_TPU_COMPILE_CACHE, or None when running (default) with the
+    cache disabled. Tests that specifically exercise cross-process cache
+    behaviour should skip when this is None rather than flipping the
+    cache on themselves mid-process."""
+    from kueue_tpu.perf import compile_cache
+
+    return compile_cache.cache_dir()

@@ -1,0 +1,171 @@
+"""The unified shape-bucket ladder (models/buckets.py).
+
+Pins the tentpole claims of the cold-start work:
+
+1. **One ladder everywhere** — the driver's hysteresis bucket, the
+   what-if engine's forecast bucket and the encode default all resolve
+   the same head count to the same rung, so identical logical shapes
+   share one compiled executable. The concrete regression: 2500 heads
+   used to pad to 4096 on the admission path (unbounded pow2) but 3072
+   on the forecast path (1024-multiples above 1024) — two executables
+   for the same workload count.
+2. **Shrink hysteresis boundaries** — exactly 4-cycle patience: three
+   consecutive fits hold the rung, the 4th shrinks one rung, and any
+   intervening observation that needs the current rung (or more) resets
+   the streak.
+
+Pure host math — no jit, no device work.
+"""
+
+from kueue_tpu.models import buckets
+from kueue_tpu.models.buckets import BucketLadder, bucket_for
+
+
+def fresh_driver():
+    from kueue_tpu.api.types import ResourceQuota
+    from kueue_tpu.models.driver import DeviceScheduler
+
+    from .helpers import build_env, make_cq
+
+    cache, queues, _ = build_env([
+        make_cq("cq-a", flavors={
+            "default": {"cpu": ResourceQuota(nominal=4000)},
+        }),
+    ])
+    return DeviceScheduler(cache, queues)
+
+
+# -- one ladder everywhere -------------------------------------------------
+
+
+def test_driver_and_whatif_resolve_same_bucket():
+    """The duplicate-compile regression: a fresh driver's first bucket
+    for n heads must equal the what-if engine's bucket for n rows, for
+    counts on both sides of every rung boundary."""
+    from kueue_tpu.whatif.engine import _w_bucket
+
+    for n in (1, 10, 16, 17, 100, 1023, 1024, 1025, 2048, 2500, 5000):
+        assert _w_bucket(n) == bucket_for(n), n
+        sched = fresh_driver()
+        assert sched._pick_bucket(n) == bucket_for(n), n
+
+
+def test_divergence_example_2500_heads():
+    """2500 heads: the old driver ladder padded to pow2(2500) = 4096
+    while the forecast path padded to 3072 — same workload count, two
+    executables. Both now land on 3072."""
+    from kueue_tpu.whatif.engine import _w_bucket
+
+    assert bucket_for(2500) == 3072
+    assert _w_bucket(2500) == 3072
+    assert fresh_driver()._pick_bucket(2500) == 3072
+
+
+def test_encode_default_w_pad_uses_ladder():
+    """encode_cycle's w_pad=0 default (used by the preview path before
+    it passed an explicit bucket) resolves through the same ladder."""
+    import inspect
+
+    from kueue_tpu.models import encode
+
+    src = inspect.getsource(encode.encode_cycle)
+    assert "buckets.bucket_for" in src
+
+
+def test_ladder_rungs():
+    assert buckets.ladder(1) == [16]
+    assert buckets.ladder(100) == [16, 32, 64, 128]
+    assert buckets.ladder(3000)[-3:] == [1024, 2048, 3072]
+    # Every rung is its own bucket (idempotent resolution).
+    for rung in buckets.ladder(5000):
+        assert bucket_for(rung) == rung
+
+
+def test_pow2_bucket_floors():
+    assert buckets.pow2_bucket(0) == 1
+    assert buckets.pow2_bucket(3) == 4
+    assert buckets.pow2_bucket(8) == 8
+    assert buckets.pow2_bucket(9) == 16
+    # encode's fair_s_bound floor (old form: 1 << max(b-1, 2).bit_length()).
+    for b in range(1, 40):
+        assert buckets.pow2_bucket(b, floor=4) == \
+            1 << max(b - 1, 2).bit_length()
+
+
+# -- shrink hysteresis boundaries ------------------------------------------
+
+
+def test_shrink_on_exactly_fourth_fit():
+    lad = BucketLadder()
+    assert lad.observe(50) == 64
+    assert lad.observe(10) == 64  # fit 1
+    assert lad.observe(10) == 64  # fit 2
+    assert lad.observe(10) == 64  # fit 3
+    assert lad.observe(10) == 32  # fit 4 -> one rung, streak resets
+    assert lad.observe(10) == 32  # fresh streak: fit 1 again
+    assert lad.observe(10) == 32
+    assert lad.observe(10) == 32
+    assert lad.observe(10) == 16  # fit 4 of the new streak
+
+
+def test_intervening_grow_resets_streak():
+    lad = BucketLadder()
+    lad.observe(50)  # 64
+    lad.observe(10)
+    lad.observe(10)
+    lad.observe(10)  # three fits banked
+    assert lad.observe(100) == 128  # grow resets the streak
+    lad.observe(10)
+    lad.observe(10)
+    lad.observe(10)
+    assert lad.observe(10) == 64  # needs a full fresh patience window
+
+
+def test_exact_boundary_need_resets_streak():
+    """An observation needing exactly the current rung is NOT a fit of
+    a smaller rung: it must reset the shrink streak, not advance it."""
+    lad = BucketLadder()
+    lad.observe(50)  # 64
+    lad.observe(10)
+    lad.observe(10)
+    lad.observe(10)  # three fits
+    assert lad.observe(64) == 64  # needs the full rung: reset
+    lad.observe(10)
+    lad.observe(10)
+    lad.observe(10)
+    assert lad.value == 64  # still held
+    assert lad.observe(33) == 64  # 33 needs 64: reset again
+    assert lad.observe(32) == 64  # 32 fits rung 32: fit 1
+    lad.observe(32)
+    lad.observe(32)
+    assert lad.observe(32) == 32  # fit 4 -> shrink
+
+
+def test_shrink_in_linear_region_steps_1024():
+    lad = BucketLadder()
+    assert lad.observe(2500) == 3072
+    for _ in range(3):
+        assert lad.observe(10) == 3072
+    assert lad.observe(10) == 2048  # linear rung step down
+    for _ in range(3):
+        assert lad.observe(10) == 2048
+    assert lad.observe(10) == 1024  # back onto the pow2 region
+    for _ in range(3):
+        assert lad.observe(10) == 1024
+    assert lad.observe(10) == 512
+
+
+def test_floor_never_underflows():
+    lad = BucketLadder()
+    for _ in range(20):
+        assert lad.observe(1) == 16
+
+
+def test_driver_pick_bucket_delegates_to_ladder():
+    from kueue_tpu.models.driver import DeviceScheduler
+
+    sched = fresh_driver()
+    assert sched._pick_bucket(10) == 16
+    assert sched._pick_bucket(20) == 32
+    assert sched._w_ladder.value == 32
+    assert sched._w_ladder.patience == DeviceScheduler._SHRINK_PATIENCE

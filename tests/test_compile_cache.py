@@ -1,0 +1,229 @@
+"""Cold start / compile cache tests (perf/compile_cache.py).
+
+The compile-count regression the ISSUE demands: a scripted multi-cycle
++ what-if scenario runs under the jax.monitoring bridge and asserts
+each solver entry point compiles **at most once per bucket** — warmed
+driver cycles, a warmed forecast, and the preemption preview must all
+add ZERO backend compiles (the preview used to jit its own copy of the
+grouped-preempt program every process; it now shares the scheduler's
+executable through the unified bucket ladder). Plus: zero-head prewarm
+reproduces the exact live-cycle compile shape, padding gauges stay
+honest on hysteresis holds, and the AOT store round-trips executables
+with integrity checking, fault injection and breaker containment.
+
+Compile budget: one grouped-preempt cycle @ W=16, the arena incremental
+scatters, one fixedpoint rollout @ s_max=8, and two toy AOT programs —
+everything else in the file must be a cache hit, which is the point.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import ResourceQuota
+from kueue_tpu.metrics import tracing
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.perf import compile_cache
+from kueue_tpu.utils import faults
+from kueue_tpu.whatif.engine import WhatIfEngine
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+pytestmark = pytest.mark.isolated
+
+
+def _env():
+    cache, queues, _ = build_env([
+        make_cq("cq-a", flavors={
+            "default": {"cpu": ResourceQuota(nominal=4000)},
+        }),
+    ])
+    return cache, queues
+
+
+def _compiles():
+    return compile_cache.stats()["backend_compiles"]
+
+
+def test_compile_count_one_executable_per_entry_and_bucket():
+    compile_cache.install_listeners()
+    reg = Metrics()
+    tracing.enable(metrics=reg)
+    try:
+        cache, queues = _env()
+        sched = DeviceScheduler(cache, queues)
+        wls = [make_wl(f"w{i}", cpu_m=500) for i in range(1, 8)]
+        submit(queues, *wls[:5])
+
+        # Warmup: first cycle compiles the grouped-preempt cycle at
+        # W bucket 16; the second compiles the arena's incremental
+        # scatter path; the third must already be fully warm.
+        for _ in range(3):
+            assert sched.schedule().admitted
+        compile_cache.reset_stats()
+
+        # Scripted cycles 4 and 5: same bucket, same entry point —
+        # zero new executables.
+        assert sched.schedule().admitted  # w4
+        assert sched.schedule().admitted  # w5
+        assert _compiles() == 0, compile_cache.stats()
+
+        # Honest padding gauges on the hysteresis-held bucket: one head
+        # per cycle, bucket held at 16.
+        assert reg.get("solver_batch_size") == 16
+        assert reg.get("solver_padding_waste_pct") == \
+            pytest.approx(100.0 * 15 / 16)
+
+        # Zero-head prewarm encodes the EXACT live-cycle shape: with
+        # the cycle already compiled, prewarming the same ladder adds
+        # nothing (a prewarm that compiled a different shape would be
+        # warming an executable no real cycle ever uses).
+        timings = sched.prewarm(max_heads=16, aot=False)
+        assert list(timings) == [16]
+        assert _compiles() == 0, compile_cache.stats()
+        assert reg.get("solver_prewarm_state") == 2  # done
+
+        # Background prewarm: same result through the thread path.
+        t = sched.prewarm(max_heads=16, background=True, aot=False)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert _compiles() == 0, compile_cache.stats()
+
+        # What-if: the first forecast may compile its rollout program
+        # (a different entry point), but exactly once...
+        submit(queues, wls[5], wls[6])  # pending rows for the forecast
+        engine = WhatIfEngine(cache, queues, default_runtime_ms=500,
+                              horizon_rounds=64)
+        report = engine.prewarm()
+        assert report.basis == "rollout"
+        rollout_compiles = _compiles()
+        assert rollout_compiles >= 1
+
+        # ...and the second forecast of the same shapes adds zero.
+        report2 = engine.eta()
+        assert report2.basis == "rollout"
+        assert _compiles() == rollout_compiles, compile_cache.stats()
+
+        # The preemption preview shares the scheduler's own compiled
+        # cycle executable (unified bucket ladder): zero new compiles —
+        # this is the driver/whatif duplicate-executable regression.
+        preview = engine.preview(make_wl("hypo", cpu_m=500))
+        assert preview.basis == "rollout"
+        assert _compiles() == rollout_compiles, compile_cache.stats()
+        preview2 = engine.preview(make_wl("hypo2", cpu_m=500))
+        assert preview2.basis == "rollout"
+        assert _compiles() == rollout_compiles, compile_cache.stats()
+
+        # And the forecasts did not evict the driver's executables.
+        assert sched.schedule().admitted  # w6
+        assert _compiles() == rollout_compiles, compile_cache.stats()
+    finally:
+        tracing.disable()
+
+
+# -- AOT executable store --------------------------------------------------
+
+
+def _toy(tmp_path, name="toy_affine"):
+    import jax
+    import jax.numpy as jnp
+
+    store = compile_cache.activate_aot(str(tmp_path / "aot"))
+    fn = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(8)
+    compile_cache.prewarm_entry(name, fn, (x,))
+    return store, fn, x
+
+
+def test_aot_store_roundtrip(tmp_path):
+    compile_cache.reset()
+    try:
+        store, fn, x = _toy(tmp_path)
+        sig = compile_cache.signature((x,))
+        path = store.path_for("toy_affine", sig)
+        assert os.path.exists(path)
+        # Fresh probe (as a cold process would): the dispatch must be
+        # served by the deserialized executable.
+        store._loaded.clear()
+        before = compile_cache.stats()["aot_hits"]
+        out = compile_cache.dispatch("toy_affine", fn, x)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.arange(8) * 2 + 1
+        )
+        assert compile_cache.stats()["aot_hits"] == before + 1
+    finally:
+        compile_cache.reset()
+
+
+def test_aot_integrity_mismatch_falls_back_to_jit(tmp_path):
+    compile_cache.reset()
+    try:
+        store, fn, x = _toy(tmp_path)
+        path = store.path_for("toy_affine", compile_cache.signature((x,)))
+        blob = bytearray(open(path, "rb").read())
+        blob[-1] ^= 0xFF  # corrupt the payload tail
+        open(path, "wb").write(bytes(blob))
+        store._loaded.clear()
+        failures = compile_cache.stats()["aot_load_failures"]
+        out = compile_cache.dispatch("toy_affine", fn, x)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.arange(8) * 2 + 1
+        )
+        assert compile_cache.stats()["aot_load_failures"] == failures + 1
+        # The bad entry is remembered as absent: no re-read per call.
+        assert store._loaded[
+            f"toy_affine|{compile_cache.signature((x,))}"
+        ] is None
+    finally:
+        compile_cache.reset()
+
+
+def test_aot_deserialize_fault_point_and_breaker(tmp_path):
+    compile_cache.reset()
+    try:
+        store, fn, x = _toy(tmp_path)
+        plan = faults.FaultPlan()
+        plan.add(faults.COMPILE_DESERIALIZE, mode="raise")
+        faults.install(plan)
+        try:
+            # Threshold is 3: each faulted load is contained (the call
+            # still returns the jit result) and counts one breaker
+            # failure; the third opens the breaker.
+            for i in range(3):
+                store._loaded.clear()
+                out = compile_cache.dispatch("toy_affine", fn, x)
+                np.testing.assert_array_equal(
+                    np.asarray(out), np.arange(8) * 2 + 1
+                )
+            assert plan.fired(faults.COMPILE_DESERIALIZE) == 3
+            assert not store.breaker.allow()
+            # Breaker open: the store is not even consulted (the fault
+            # point stops firing), and dispatch still serves.
+            store._loaded.clear()
+            compile_cache.dispatch("toy_affine", fn, x)
+            assert plan.fired(faults.COMPILE_DESERIALIZE) == 3
+        finally:
+            faults.clear()
+    finally:
+        compile_cache.reset()
+
+
+def test_dispatch_passthrough_when_disabled():
+    compile_cache.reset()
+    calls = []
+
+    def fn(a, b):
+        calls.append((a, b))
+        return a + b
+
+    assert compile_cache.dispatch("nope", fn, 2, 3) == 5
+    assert calls == [(2, 3)]
+    assert compile_cache.stats()["aot_hits"] == 0
+
+
+def test_manager_prewarm_host_scheduler_is_noop():
+    from kueue_tpu.manager import Manager
+
+    assert Manager().prewarm() == {}

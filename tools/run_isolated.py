@@ -25,7 +25,10 @@ and how many remained casualties (still crashing when run alone). Exit
 status is 0 iff every test ultimately passed — a segfault victim whose
 standalone retry is green does not fail the run. Extra pytest args after
 ``--`` are forwarded to every segment (e.g. ``tools/run_isolated.py --
--q``).
+-q``). ``--compile-cache DIR`` exports KUEUE_TPU_COMPILE_CACHE=DIR to
+every segment so the fresh subprocesses share warm executables through
+the persistent compile cache instead of recompiling from zero
+(perf/compile_cache.py).
 """
 
 from __future__ import annotations
@@ -99,6 +102,21 @@ def main(argv: list) -> int:
         split = argv.index("--")
         extra = argv[split + 1:]
         argv = argv[:split]
+    if "--compile-cache" in argv:
+        # Point every segment at one persistent compile cache
+        # (tests/conftest.py reads KUEUE_TPU_COMPILE_CACHE): the
+        # isolated segments' whole point is fresh processes, which
+        # otherwise recompile everything from zero — with the cache
+        # their compiles become disk hits after the first run. The
+        # jaxlib serialize() segfault risk rides with the opt-in, but
+        # here a crashed segment is already retried and shielded.
+        i = argv.index("--compile-cache")
+        if i + 1 >= len(argv):
+            print("--compile-cache requires a directory argument",
+                  file=sys.stderr)
+            return 2
+        os.environ["KUEUE_TPU_COMPILE_CACHE"] = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     if argv:
         print(f"unknown arguments {argv!r}; pass pytest args after --",
               file=sys.stderr)
