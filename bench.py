@@ -993,6 +993,111 @@ def probe_whatif(scale: float):
     }
 
 
+def probe_steady(scale: float):
+    """Open-loop steady-load SLO probe (docs/observability.md): drive
+    the host scheduler with a constant arrival stream — arrivals do NOT
+    wait on completions, so a slow scheduler surfaces as queue growth
+    and burn rate, never as back-pressured arrivals — while a completion
+    churn frees quota at a fixed concurrency. Then read the burn-rate
+    SLO engine exactly the way the ``/slo`` endpoint does. Host-only by
+    design: it measures the admission pipeline + SLO layer, not kernels,
+    so it runs anywhere in seconds."""
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.manager import Manager
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="steady"),
+        ClusterQueue(
+            name="cq-steady", cohort="steady",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="default",
+                    resources={"cpu": ResourceQuota(nominal=16000)},
+                )],
+            )],
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+        ),
+        LocalQueue(name="lq-steady", cluster_queue="cq-steady"),
+    )
+    slo = mgr.slo()
+
+    steps = max(10, int(120 * scale))
+    per_step = 4          # arrivals per step (open loop)
+    churn_target = 8      # steady running concurrency after churn
+    running: list = []
+    submitted = 0
+    admitted_total = 0
+    t0 = time.monotonic()
+    for step in range(steps):
+        for j in range(per_step):
+            submitted += 1
+            mgr.create_workload(Workload(
+                name=f"steady-{step}-{j}",
+                queue_name="lq-steady",
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 1000})],
+                priority=(step + j) % 3,
+                creation_time=float(submitted),
+            ))
+        # One head per CQ per cycle: run a few cycles per step so
+        # admissions keep pace with arrivals (still open loop — the
+        # cycle cap, not completions, bounds the work per step).
+        for _ in range(per_step + 2):
+            result = mgr.schedule()
+            admitted_total += len(result.admitted)
+            running.extend(result.admitted)
+            if not result.admitted and not result.preempted:
+                break
+        # Completion churn: oldest running workloads finish, freeing
+        # quota for the next arrivals — the open loop stays steady
+        # instead of wedging at nominal quota.
+        while len(running) > churn_target:
+            wl = mgr.workloads.get(running.pop(0))
+            if wl is not None:
+                mgr.finish_workload(wl)
+        if step % 10 == 0:
+            slo.evaluate()
+    wall = time.monotonic() - t0
+
+    statuses = slo.evaluate()
+    children = mgr.metrics.histograms.get(
+        "admission_attempt_duration_seconds", {}
+    )
+    h = next(iter(children.values()), None)
+    return {
+        "probe": "steady",
+        "ok": bool(h is not None and h.n > 0),
+        "steps": steps,
+        "submitted": submitted,
+        "admitted": admitted_total,
+        "pending_after": mgr.queues.pending_count("cq-steady"),
+        "wall_s": round(wall, 3),
+        "admissions_per_s": round(admitted_total / wall, 2)
+        if wall > 0 else 0.0,
+        "cycle_p50_ms": round(h.quantile(0.50) * 1000, 3) if h else None,
+        "cycle_p99_ms": round(h.quantile(0.99) * 1000, 3) if h else None,
+        "healthy": all(st.healthy for st in statuses),
+        "slos": [st.to_dict() for st in statuses],
+    }
+
+
 def probe_coldstart_child(scale: float):
     """Child half of the cold-start probe: one fresh process, the shared
     persistent compile cache + AOT store (KUEUE_TPU_COMPILE_CACHE), one
@@ -1129,7 +1234,7 @@ def main():
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
-                             "coldstart", "coldstart-child"],
+                             "steady", "coldstart", "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -1174,6 +1279,7 @@ def main():
                 "multichip": probe_multichip,
                 "incremental": lambda: probe_incremental(args.scale),
                 "whatif": lambda: probe_whatif(args.scale),
+                "steady": lambda: probe_steady(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

@@ -462,6 +462,82 @@ def cmd_whatif(mgr: Manager, args) -> int:
     return 1
 
 
+def cmd_explain(mgr: Manager, args) -> int:
+    """Why is this workload (not) running? Joins live status with the
+    flight recorder's provenance and the what-if forecast
+    (docs/observability.md)."""
+    name = args.name if "/" in args.name else \
+        f"{args.namespace}/{args.name}"
+    doc = mgr.explain(
+        name,
+        include_forecast=not args.no_forecast,
+        include_preview=args.victims,
+    )
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0 if doc.get("found") else 1
+    if not doc.get("found"):
+        print(f"workload {doc['workload']} not found", file=sys.stderr)
+        return 1
+    print(f"Workload: {doc['workload']}")
+    print(f"State: {doc['state']}"
+          + (f" (queue position {doc['queuePosition']})"
+             if "queuePosition" in doc else ""))
+    print(f"ClusterQueue: {doc.get('clusterQueue')}"
+          f"  LocalQueue: {doc.get('localQueue')}"
+          f"  Priority: {doc.get('priority')}")
+    for c in doc.get("conditions") or []:
+        print(f"  condition {c['type']}={c['status']} "
+              f"({c['reason']}) {c['message']}")
+    if doc.get("lastEviction"):
+        ev = doc["lastEviction"]
+        print(f"Last eviction: {ev['reason']} — {ev['message']}")
+    adm = doc.get("admission")
+    if adm:
+        for ps in adm["podSets"]:
+            print(f"  podset {ps['name']} x{ps['count']} "
+                  f"flavors={ps['flavors']}")
+    attempts = doc.get("attempts")
+    if attempts is None:
+        print(f"Attempts: n/a ({doc.get('attemptsReason')})")
+    else:
+        print(f"Attempts ({len(attempts)} recorded):")
+        for a in attempts:
+            extra = ""
+            if a.get("flavor"):
+                extra += f" flavor={a['flavor']}"
+            if a.get("victims"):
+                extra += " victims=" + ",".join(
+                    f"{k}({r})" for k, r in a["victims"]
+                )
+            if a.get("eviction_reason"):
+                extra += f" reason={a['eviction_reason']}"
+            print(f"  cycle {a['cycle']}: {a['outcome']} "
+                  f"[{a['condition_reason']}] via {a['path']}{extra}")
+    for ev in doc.get("evictions") or []:
+        by = f" by {ev['preempted_by']}" if ev.get("preempted_by") else ""
+        print(f"  evicted cycle {ev['cycle']}: "
+              f"{ev.get('eviction_reason')}{by}")
+    fc = doc.get("forecast")
+    if fc is not None:
+        eta = fc.get("etaMs")
+        print(f"Forecast: eta_ms={'-' if eta is None else eta} "
+              f"flavor={fc.get('flavor') or '-'} "
+              f"basis={doc.get('forecastBasis')}")
+    elif "forecastReason" in doc:
+        print(f"Forecast: n/a ({doc['forecastReason']})")
+    blockers = doc.get("blockingQuota")
+    if blockers:
+        for b in blockers:
+            print(f"Blocking quota: {b['resource']} requested="
+                  f"{b['requested']} best={b['bestFlavor']} "
+                  f"available={b['available']}")
+    if doc.get("preview") is not None:
+        print("Preemption preview:")
+        print(json.dumps(doc["preview"], indent=2))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="kueuectl-tpu")
     ap.add_argument("--manifests", action="append", default=[],
@@ -553,6 +629,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     w_prev.add_argument("--requests", default="cpu=1",
                         help="res=qty[,res=qty]")
 
+    p_explain = sub.add_parser(
+        "explain",
+        help="admission provenance + forecast (docs/observability.md)",
+    )
+    p_explain.add_argument("name", help="workload name or ns/name key")
+    p_explain.add_argument("--namespace", default="default")
+    p_explain.add_argument("--json", action="store_true")
+    p_explain.add_argument("--no-forecast", action="store_true",
+                           help="skip the what-if admission forecast")
+    p_explain.add_argument("--victims", action="store_true",
+                           help="include the preemption preview")
+
     args = ap.parse_args(argv)
     mgr = build_manager(args.manifests)
 
@@ -592,6 +680,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as exc:
             print(str(exc), file=sys.stderr)
             return 1
+    if args.cmd == "explain":
+        return cmd_explain(mgr, args)
     if args.cmd == "describe":
         kind = args.resource.lower()
         if kind in ("workload", "wl"):

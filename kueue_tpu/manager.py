@@ -127,6 +127,8 @@ class Manager:
 
         self.tas_failure = TASNodeFailureController(self)
         self._whatif = None
+        self._explainer = None
+        self._slo = None
 
     def whatif(self):
         """Lazily built what-if forecasting engine over this manager's
@@ -139,6 +141,38 @@ class Manager:
                 self.cache, self.queues, clock=self.clock
             )
         return self._whatif
+
+    def explainer(self):
+        """Lazily built explain facade (docs/observability.md): live
+        status + flight-recorder provenance + what-if forecast, joined
+        per workload. Served as ``cli explain`` and ``/explain/<wl>``."""
+        if self._explainer is None:
+            from kueue_tpu.obs import Explainer
+            from kueue_tpu.obs import recorder as flight
+
+            self._explainer = Explainer(
+                self.cache, self.queues, workloads=self.workloads,
+                recorder_fn=flight.get, whatif_fn=self.whatif,
+                clock=self.clock,
+            )
+        return self._explainer
+
+    def explain(self, name: str, **kwargs) -> dict:
+        """Why is this workload (not) running? See Explainer.explain."""
+        return self.explainer().explain(name, **kwargs)
+
+    def slo(self, objectives=None):
+        """Lazily built burn-rate SLO engine over this manager's metric
+        registry (docs/observability.md). Once built, every
+        ``_update_gauges`` pass re-evaluates it so the ``slo_*`` gauges
+        stay live on /metrics."""
+        if self._slo is None:
+            from kueue_tpu.obs import SLOEngine
+
+            self._slo = SLOEngine(
+                self.metrics, objectives=objectives, clock=self.clock
+            )
+        return self._slo
 
     def prewarm(self, max_heads: int = 16, background: bool = False,
                 aot: bool = True):
@@ -645,6 +679,10 @@ class Manager:
             snapshot = self.cache.snapshot()
         except ValueError:
             snapshot = None
+        # Re-evaluate SLO burn rates (once built) so the slo_* gauges on
+        # /metrics track the same tick cadence as every other gauge.
+        if self._slo is not None:
+            self._slo.evaluate()
         self.metrics.set_gauge("build_info", 1, {"framework": "kueue_tpu"})
         for name, cq_spec in self.cache.cluster_queues.items():
             self.metrics.set_gauge(

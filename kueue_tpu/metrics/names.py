@@ -109,4 +109,13 @@ TRACING_SERIES = frozenset({
     "solver_prewarm_state",
 })
 
-METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES
+# Observability layer series (obs/): flight recorder + SLO engine.
+OBS_SERIES = frozenset({
+    "obs_recorder_cycles_total",
+    "slo_burn_rate",
+    "slo_budget_remaining",
+    "slo_objective_value",
+    "slo_healthy",
+})
+
+METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES

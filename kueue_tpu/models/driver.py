@@ -31,6 +31,7 @@ from kueue_tpu.metrics import tracing
 from kueue_tpu.models import batch_scheduler, buckets
 from kueue_tpu.models.arena import CycleArena
 from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.obs import recorder as flight
 from kueue_tpu.perf import compile_cache
 from kueue_tpu.queue.manager import QueueManager
 from kueue_tpu.scheduler.scheduler import CycleResult, Scheduler
@@ -245,6 +246,17 @@ class DeviceScheduler:
                             {"reason": "breaker_open"})
             self._merge_result(result, self._host_process(list(heads)))
             result.duration_s = self.clock() - start
+            if flight.ENABLED:
+                flight.capture_cycle(
+                    cycle=self.cycles, ts=self.clock(), heads=len(heads),
+                    bucket=0, path="breaker_open",
+                    generations=(self.cache.generation,
+                                 self.cache.workload_generation),
+                    arena=self._arena is not None,
+                    breaker_state=self._breaker.gauge_value,
+                    fallback_reason="breaker_open",
+                    result=result, duration_s=result.duration_s,
+                )
             return result
 
         try:
@@ -262,6 +274,17 @@ class DeviceScheduler:
                 result, heads, "snapshot_error", exc, start
             )
         bucket = self._pick_bucket(len(heads))
+        # Flight-recorder scratch: generation fingerprint pinned at
+        # snapshot time (apply bumps the live counters), stage timings
+        # filled in as the cycle progresses. None when recording is off —
+        # the disabled path allocates nothing.
+        rec_t = None
+        if flight.ENABLED:
+            rec_t = {
+                "gen": (self.cache.generation,
+                        self.cache.workload_generation),
+                "t0": self.clock(),
+            }
         if tracing.ENABLED:
             # Report the bucket actually used (hysteresis holds included)
             # so padding waste stays honest on the shrink path.
@@ -299,6 +322,8 @@ class DeviceScheduler:
             return self._contain_cycle(
                 result, heads, "encode_error", exc, start
             )
+        if rec_t is not None:
+            rec_t["encode_s"] = self.clock() - rec_t.pop("t0")
 
         # Trees with an encode-fallback entry route through the host
         # wholesale (device rows included, see the discard comment below),
@@ -321,6 +346,7 @@ class DeviceScheduler:
             host_entries = list(idx.host_fallback)
 
         fault: Optional[Tuple[str, Exception]] = None
+        planes = None
         if idx.workloads:
             t0 = self.clock()
             out = None
@@ -370,6 +396,8 @@ class DeviceScheduler:
                 if not self._containable(exc):
                     raise
                 fault = ("dispatch_error", exc)
+            if rec_t is not None:
+                rec_t["dispatch_s"] = self.clock() - t0
             # Overlap window: the kernel call above only dispatched — run
             # the pre-discarded trees' host work before the first blocking
             # read so it executes while the device solves. These host
@@ -388,13 +416,21 @@ class DeviceScheduler:
                 self._merge_result(result, self._host_process(pre_entries))
                 host_dt = self.clock() - th0
                 pre_done = True
+                if rec_t is not None:
+                    rec_t["overlap_host_s"] = host_dt
             planes = None
             if fault is None:
                 try:
                     # Blocking readback + invariant validation + TAS
                     # decode; validation runs BEFORE any admission is
                     # applied, so a corrupted plane cannot reach the cache.
+                    if rec_t is not None:
+                        rec_t["t_rb"] = self.clock()
                     planes = self._read_planes(out, idx)
+                    if rec_t is not None:
+                        rec_t["readback_s"] = (
+                            self.clock() - rec_t.pop("t_rb")
+                        )
                 except PlaneValidationError as exc:
                     if tracing.ENABLED:
                         tracing.inc(
@@ -544,6 +580,26 @@ class DeviceScheduler:
             result.inadmissible.extend(host_result.inadmissible)
 
         result.duration_s = self.clock() - start
+        if flight.ENABLED:
+            flight.capture_cycle(
+                cycle=self.cycles, ts=self.clock(), heads=len(heads),
+                bucket=bucket,
+                path=(
+                    "fallback" if fault is not None
+                    else "device" if planes is not None else "host"
+                ),
+                generations=(
+                    rec_t["gen"] if rec_t is not None
+                    else (self.cache.generation,
+                          self.cache.workload_generation)
+                ),
+                arena=self._arena is not None,
+                breaker_state=self._breaker.gauge_value,
+                fallback_reason=fault[0] if fault is not None else None,
+                timings=rec_t, result=result,
+                duration_s=result.duration_s,
+                idx=idx, planes=planes,
+            )
         return result
 
     def schedule_all(self, max_cycles: int = 100000) -> int:
@@ -614,6 +670,17 @@ class DeviceScheduler:
         self._record_device_failure(reason, exc)
         self._merge_result(result, self._host_process(list(heads)))
         result.duration_s = self.clock() - start
+        if flight.ENABLED:
+            flight.capture_cycle(
+                cycle=self.cycles, ts=self.clock(), heads=len(heads),
+                bucket=0, path="contained",
+                generations=(self.cache.generation,
+                             self.cache.workload_generation),
+                arena=self._arena is not None,
+                breaker_state=self._breaker.gauge_value,
+                fallback_reason=reason,
+                result=result, duration_s=result.duration_s,
+            )
         return result
 
     def _read_planes(self, out, idx):

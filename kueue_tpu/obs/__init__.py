@@ -1,0 +1,29 @@
+"""Admission provenance + SLO layer (docs/observability.md).
+
+- ``obs.recorder`` — cycle flight recorder: bounded ring of structured
+  per-cycle records captured by the device driver, zero-cost when off.
+- ``obs.explain`` — the /explain answer: recorder history (what
+  happened) joined with the what-if forecast (what will happen).
+- ``obs.slo`` — declarative burn-rate SLOs over the metric histograms.
+- ``obs.reasons`` — the outcome-code -> kueue condition reason tables.
+"""
+
+from kueue_tpu.obs.explain import Explainer
+from kueue_tpu.obs.recorder import CycleRecord, FlightRecorder, HeadAttempt
+from kueue_tpu.obs.slo import (
+    DEFAULT_OBJECTIVES,
+    SLObjective,
+    SLOEngine,
+    SLOStatus,
+)
+
+__all__ = [
+    "CycleRecord",
+    "DEFAULT_OBJECTIVES",
+    "Explainer",
+    "FlightRecorder",
+    "HeadAttempt",
+    "SLObjective",
+    "SLOEngine",
+    "SLOStatus",
+]

@@ -1,0 +1,252 @@
+"""Explain API: why is this workload (not) running?
+
+Joins three sources into one answer per workload:
+
+- live status (conditions, admission, queue position) from the cache and
+  queue manager;
+- what happened: the flight recorder's attempt history — per-cycle
+  outcome codes mapped to kueue-style condition reasons
+  (QuotaReserved / Preempted / InCohortReclamation / ...);
+- what will happen: the what-if engine's forward forecast (admission
+  ETA, flavor, queue position) plus, on request, a preemption preview
+  (candidate victims), and a blocking-quota readout computed from the
+  live snapshot headroom.
+
+Served as ``/explain/<workload>`` on the visibility server and as
+``cli explain``. Every side lookup is contained: a missing recorder,
+a faulted forecast, or a blocked quota probe degrade that one section
+to ``None`` with a reason — never the whole answer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from kueue_tpu.api.constants import COND_EVICTED
+
+
+class Explainer:
+    """Facade over (cache, queues) plus optional recorder/what-if hooks.
+
+    ``recorder_fn`` / ``whatif_fn`` are zero-arg callables resolved at
+    explain time (the recorder may be enabled after construction; the
+    manager builds its what-if engine lazily)."""
+
+    def __init__(
+        self,
+        cache,
+        queues,
+        workloads: Optional[Dict] = None,
+        recorder_fn: Optional[Callable[[], object]] = None,
+        whatif_fn: Optional[Callable[[], object]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.cache = cache
+        self.queues = queues
+        self.workloads = workloads if workloads is not None else {}
+        self._recorder_fn = recorder_fn or (lambda: None)
+        self._whatif_fn = whatif_fn or (lambda: None)
+        self._clock = clock
+
+    # -- lookup ---------------------------------------------------------
+
+    def _resolve(self, name: str):
+        """Find the workload by full key ("ns/name") or bare name; returns
+        (key, Workload-or-None). Searches the manager's registry, admitted
+        cache entries, and pending queue entries."""
+        candidates = [name] if "/" in name else [f"default/{name}"]
+        for key in candidates:
+            wl = self.workloads.get(key)
+            if wl is not None:
+                return key, wl
+            info = self.cache.workloads.get(key)
+            if info is not None:
+                return key, info.obj
+        suffix = "/" + name
+        for key, wl in self.workloads.items():
+            if key.endswith(suffix):
+                return key, wl
+        for key, info in self.cache.workloads.items():
+            if key.endswith(suffix):
+                return key, info.obj
+        for cq_name in list(self.queues.cluster_queues):
+            for info in self._pending(cq_name):
+                if info.key == name or info.key.endswith(suffix):
+                    return info.key, info.obj
+        return name if "/" in name else f"default/{name}", None
+
+    def _pending(self, cq_name: str):
+        """All pending entries — the active heap AND the BestEffortFIFO
+        inadmissible staging area (a staged workload is still pending;
+        it is the case explain answers for most often)."""
+        return self.queues.pending_workloads_all(cq_name)
+
+    def _pending_position(self, wl) -> Optional[Dict]:
+        cq_name = self.queues.cluster_queue_for(wl)
+        if not cq_name:
+            return None
+        key = f"{wl.namespace}/{wl.name}"
+        for pos, info in enumerate(self._pending(cq_name)):
+            if info.key == key:
+                return {"clusterQueue": cq_name, "position": pos}
+        return None
+
+    # -- sections -------------------------------------------------------
+
+    def _blocking_quota(self, wl, cq_name: str) -> List[Dict]:
+        """Resources for which no flavor in the workload's CQ currently
+        has headroom for the request — the quota standing between a
+        pending workload and admission."""
+        from kueue_tpu.core.resources import FlavorResource
+
+        snapshot = self.cache.snapshot()
+        cqs = snapshot.cluster_queues.get(cq_name)
+        if cqs is None:
+            return []
+        totals: Dict[str, int] = {}
+        for ps in wl.pod_sets:
+            for res, v in ps.requests.items():
+                totals[res] = totals.get(res, 0) + v * ps.count
+        blockers: List[Dict] = []
+        for res, req in sorted(totals.items()):
+            best = None
+            for rg in cqs.spec.resource_groups:
+                if res not in rg.covered_resources:
+                    continue
+                for fq in rg.flavors:
+                    if res not in fq.resources:
+                        continue
+                    avail = cqs.available(FlavorResource(fq.name, res))
+                    if best is None or avail > best[1]:
+                        best = (fq.name, avail)
+            if best is not None and req > best[1]:
+                blockers.append({
+                    "resource": res, "requested": req,
+                    "bestFlavor": best[0], "available": int(best[1]),
+                })
+        return blockers
+
+    def _forecast(self, key: str, cq_name: Optional[str]) -> Dict:
+        engine = self._whatif_fn()
+        if engine is None:
+            return {"forecast": None, "forecastReason": "whatif not attached"}
+        try:
+            report = engine.eta(cluster_queue=cq_name or None)
+        except Exception as exc:  # contained: one section, not the answer
+            return {
+                "forecast": None,
+                "forecastReason": f"{type(exc).__name__}: {exc}",
+            }
+        for wf in report.base.workloads:
+            if wf.key == key:
+                return {
+                    "forecast": wf.to_dict(),
+                    "forecastReason": report.reason or None,
+                    "forecastBasis": report.basis,
+                }
+        return {
+            "forecast": None,
+            "forecastReason": "not in forecast horizon",
+            "forecastBasis": report.basis,
+        }
+
+    def _preview(self, wl, cq_name: Optional[str]) -> Dict:
+        engine = self._whatif_fn()
+        if engine is None:
+            return {"preview": None, "previewReason": "whatif not attached"}
+        try:
+            report = engine.preview(wl, cluster_queue=cq_name or None)
+        except Exception as exc:
+            return {
+                "preview": None,
+                "previewReason": f"{type(exc).__name__}: {exc}",
+            }
+        return {"preview": report.to_dict(), "previewReason": None}
+
+    # -- public ---------------------------------------------------------
+
+    def explain(
+        self,
+        name: str,
+        include_forecast: bool = True,
+        include_preview: bool = False,
+        attempts_limit: int = 20,
+    ) -> dict:
+        key, wl = self._resolve(name)
+        doc: dict = {
+            "workload": key,
+            "found": wl is not None,
+            "explainedAt": self._clock(),
+        }
+        if wl is None:
+            doc["error"] = "workload not found"
+            return doc
+
+        admitted = wl.status.admission is not None
+        pending = self._pending_position(wl)
+        cq_name = (
+            wl.status.admission.cluster_queue if admitted
+            else self.queues.cluster_queue_for(wl)
+        )
+        doc["clusterQueue"] = cq_name
+        doc["localQueue"] = wl.queue_name
+        doc["priority"] = wl.priority
+        doc["conditions"] = [
+            {
+                "type": c.type, "status": c.status,
+                "reason": c.reason, "message": c.message,
+            }
+            for c in wl.status.conditions
+        ]
+        evicted = next(
+            (c for c in reversed(wl.status.conditions)
+             if c.type == COND_EVICTED and c.status), None
+        )
+        if admitted:
+            doc["state"] = "admitted"
+            psas = wl.status.admission.pod_set_assignments
+            doc["admission"] = {
+                "clusterQueue": cq_name,
+                "podSets": [
+                    {"name": p.name, "count": p.count,
+                     "flavors": dict(p.flavors)}
+                    for p in psas
+                ],
+            }
+        elif pending is not None:
+            doc["state"] = "pending"
+            doc["queuePosition"] = pending["position"]
+        elif evicted is not None:
+            doc["state"] = "evicted"
+        else:
+            doc["state"] = "unknown"
+        if evicted is not None:
+            doc["lastEviction"] = {
+                "reason": evicted.reason, "message": evicted.message,
+            }
+
+        # What happened: the flight recorder's attempt + eviction history.
+        rec = self._recorder_fn()
+        if rec is not None:
+            doc["attempts"] = rec.attempts_for(key, limit=attempts_limit)
+            doc["evictions"] = rec.evictions_for(key, limit=attempts_limit)
+        else:
+            doc["attempts"] = None
+            doc["attemptsReason"] = "flight recorder not enabled"
+
+        # What will happen: forecast + blocking quota for pending entries.
+        if not admitted:
+            if include_forecast:
+                doc.update(self._forecast(key, cq_name))
+            if cq_name:
+                try:
+                    doc["blockingQuota"] = self._blocking_quota(wl, cq_name)
+                except Exception as exc:
+                    doc["blockingQuota"] = None
+                    doc["blockingQuotaReason"] = (
+                        f"{type(exc).__name__}: {exc}"
+                    )
+            if include_preview:
+                doc.update(self._preview(wl, cq_name))
+        return doc

@@ -1,0 +1,124 @@
+"""Reason-code vocabulary for admission provenance.
+
+One table maps every decoded device outcome code (models/batch_scheduler
+OUT_*) and every preemption victim variant to the kueue-style workload
+condition reason it drives — the same strings the reference writes into
+workload conditions (QuotaReserved, Preempted, InCohortReclamation, ...).
+The flight recorder stamps these onto per-cycle head records, the explain
+API surfaces them, and tools/check_metrics_names.py verifies every code
+listed here is documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from kueue_tpu.api.constants import (
+    COND_EVICTED,
+    COND_QUOTA_RESERVED,
+    EVICTED_BY_PREEMPTION,
+    IN_CLUSTER_QUEUE_REASON,
+    IN_COHORT_FAIR_SHARING_REASON,
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    IN_COHORT_RECLAMATION_REASON,
+    RequeueReason,
+)
+
+# Outcome plane codes, mirrored from models/batch_scheduler.py OUT_* as
+# plain literals so this vocabulary (and everything downstream: explain,
+# the docs checker, the CLI) imports without the JAX-backed kernel module.
+# tests/test_obs.py pins these equal to the kernel's constants.
+OUT_NOFIT = 0
+OUT_NO_CANDIDATES = 1
+OUT_NEEDS_HOST = 2
+OUT_FIT_SKIPPED = 3
+OUT_ADMITTED = 4
+OUT_PREEMPTING = 5
+OUT_SHADOWED = 6
+
+
+@dataclass(frozen=True)
+class OutcomeInfo:
+    """How one decoded outcome translates to workload status."""
+
+    name: str                    # symbolic outcome (whatif _OUTCOME_NAMES)
+    condition: str               # workload condition the outcome drives
+    condition_reason: str        # kueue-style condition reason string
+    requeue_reason: Optional[str]  # RequeueReason value, None if terminal
+
+
+# Device outcome plane codes -> provenance info. Names match
+# whatif/engine.py _OUTCOME_NAMES; condition semantics match what
+# models/driver.py actually writes (QuotaReserved=True "QuotaReserved" on
+# admission, QuotaReserved=False "Pending" on every requeue).
+DEVICE_OUTCOMES: Dict[int, OutcomeInfo] = {
+    OUT_NOFIT: OutcomeInfo(
+        "NoFit", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.NO_FIT.value),
+    OUT_NO_CANDIDATES: OutcomeInfo(
+        "NoCandidates", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.PREEMPTION_NO_CANDIDATES.value),
+    OUT_NEEDS_HOST: OutcomeInfo(
+        "NeedsHost", COND_QUOTA_RESERVED, "Pending", None),
+    OUT_FIT_SKIPPED: OutcomeInfo(
+        "FitSkipped", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.FAILED_AFTER_NOMINATION.value),
+    OUT_ADMITTED: OutcomeInfo(
+        "Admitted", COND_QUOTA_RESERVED, "QuotaReserved", None),
+    OUT_PREEMPTING: OutcomeInfo(
+        "Preempting", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.PENDING_PREEMPTION.value),
+    OUT_SHADOWED: OutcomeInfo(
+        "Shadowed", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.FAILED_AFTER_NOMINATION.value),
+}
+
+# Victim eviction: Evicted=True with reason "Preempted", qualified by the
+# preemption strategy variant the kernel chose (models/driver.py
+# _apply_preempting keeps the same map).
+VICTIM_OUTCOME = OutcomeInfo(
+    "Preempted", COND_EVICTED, EVICTED_BY_PREEMPTION, None
+)
+
+VICTIM_VARIANT_REASONS: Dict[int, str] = {
+    1: IN_CLUSTER_QUEUE_REASON,
+    2: IN_COHORT_RECLAMATION_REASON,
+    3: IN_COHORT_RECLAMATION_REASON,
+    4: IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    # Fair-sharing tournament variants (fair_preempt_kernel).
+    5: IN_COHORT_FAIR_SHARING_REASON,
+    6: IN_COHORT_RECLAMATION_REASON,
+}
+
+# Host-exact path outcomes, keyed by the CycleResult category the entry
+# landed in. The host pipeline doesn't expose per-entry assignment codes
+# to the driver, so provenance is per category.
+HOST_OUTCOMES: Dict[str, OutcomeInfo] = {
+    "admitted": OutcomeInfo(
+        "Admitted", COND_QUOTA_RESERVED, "QuotaReserved", None),
+    "preempting": OutcomeInfo(
+        "Preempting", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.PENDING_PREEMPTION.value),
+    "preempted": VICTIM_OUTCOME,
+    "skipped": OutcomeInfo(
+        "Skipped", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.FAILED_AFTER_NOMINATION.value),
+    "inadmissible": OutcomeInfo(
+        "Inadmissible", COND_QUOTA_RESERVED, "Pending",
+        RequeueReason.GENERIC.value),
+}
+
+
+def documented_reason_codes() -> frozenset:
+    """Every symbolic outcome / reason string this layer can emit; the
+    docs-coverage check requires each to appear in docs/observability.md."""
+    out = set()
+    for info in list(DEVICE_OUTCOMES.values()) + list(HOST_OUTCOMES.values()):
+        out.add(info.name)
+        out.add(info.condition_reason)
+        if info.requeue_reason:
+            out.add(info.requeue_reason)
+    out.add(VICTIM_OUTCOME.condition_reason)
+    out.update(VICTIM_VARIANT_REASONS.values())
+    return frozenset(out)

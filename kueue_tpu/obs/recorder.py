@@ -1,0 +1,350 @@
+"""Cycle flight recorder: bounded ring of structured per-cycle records.
+
+Captured in models/driver.py after readback — generation fingerprints,
+bucket + padding shape, per-stage wall times, fallback/breaker state, and
+the decoded per-head outcomes (admitted flavor, inadmissible reason code,
+preemption victims with strategy reasons). Capture cost is O(heads) host
+work over planes the apply loop already read back — no extra device syncs.
+
+Zero-cost when off: this module follows the same module-flag idiom as
+``kueue_tpu.utils.faults`` / ``kueue_tpu.metrics.tracing`` — every call
+site in the driver is guarded by ``if flight.ENABLED`` so the disabled hot
+path executes no recorder code and allocates nothing
+(tests/test_obs.py pins the guard discipline by scanning the source).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.metrics import tracing
+from kueue_tpu.obs import reasons
+
+ENABLED = False
+_recorder: Optional["FlightRecorder"] = None
+
+
+def enable(capacity: int = 256) -> "FlightRecorder":
+    """Switch recording on (idempotent); returns the live recorder."""
+    global ENABLED, _recorder
+    if _recorder is None or _recorder.capacity != capacity:
+        _recorder = FlightRecorder(capacity=capacity)
+    ENABLED = True
+    return _recorder
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def get() -> Optional["FlightRecorder"]:
+    """The live recorder, or None when recording is off."""
+    return _recorder if ENABLED else None
+
+
+@dataclass
+class HeadAttempt:
+    """One workload's outcome in one cycle."""
+
+    key: str
+    outcome: str             # symbolic code (obs/reasons.py)
+    condition: str           # workload condition the outcome drives
+    condition_reason: str    # kueue-style condition reason
+    path: str                # "device" | "host"
+    requeue_reason: Optional[str] = None
+    flavor: Optional[str] = None
+    # Preemptor side: designated victims as (key, strategy_reason).
+    victims: List[Tuple[str, str]] = field(default_factory=list)
+    # Victim side: the strategy reason this eviction was issued under.
+    eviction_reason: Optional[str] = None
+
+
+@dataclass
+class CycleRecord:
+    """One admission cycle's provenance record."""
+
+    cycle: int
+    ts: float
+    path: str                # "device" | "fallback" | "breaker_open" | ...
+    heads: int
+    bucket: int              # W padding bucket (0 = no device dispatch)
+    generation: int          # cache quota/topology generation
+    workload_generation: int
+    arena: bool
+    breaker_state: float
+    fallback_reason: Optional[str] = None
+    encode_s: float = 0.0
+    dispatch_s: float = 0.0
+    readback_s: float = 0.0
+    overlap_host_s: float = 0.0
+    duration_s: float = 0.0
+    attempts: List[HeadAttempt] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of :class:`CycleRecord`."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def record(self, rec: CycleRecord) -> None:
+        with self._lock:
+            self._ring.append(rec)
+        if tracing.ENABLED:
+            tracing.inc("obs_recorder_cycles_total", {"path": rec.path})
+
+    def records(self) -> List[CycleRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def last(self) -> Optional[CycleRecord]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- provenance queries (explain API) ------------------------------
+
+    def attempts_for(self, key: str, limit: int = 20) -> List[dict]:
+        """The workload's attempt history, oldest first, newest last —
+        each entry is the per-head outcome dict plus its cycle number."""
+        out: List[dict] = []
+        for rec in self.records():
+            for att in rec.attempts:
+                if att.key != key:
+                    continue
+                d = asdict(att)
+                d["cycle"] = rec.cycle
+                d["ts"] = rec.ts
+                out.append(d)
+        return out[-limit:]
+
+    def evictions_for(self, key: str, limit: int = 20) -> List[dict]:
+        """Cycles in which this workload was evicted as a preemption
+        victim (outcome "Preempted"), with the strategy reason and — when
+        decoded on device — the preemptor that claimed it."""
+        out: List[dict] = []
+        for rec in self.records():
+            # One entry per cycle: the victim-side Preempted attempt
+            # wins (it carries the decoded eviction reason); the
+            # preemptor's victims list only stands in when the cycle has
+            # no direct row for this key. Either way the preemptor, when
+            # known, is joined in.
+            direct: Optional[dict] = None
+            by_victims: Optional[dict] = None
+            for att in rec.attempts:
+                if att.key == key and att.outcome == "Preempted":
+                    direct = asdict(att)
+                    direct["cycle"] = rec.cycle
+                    direct["ts"] = rec.ts
+                    continue
+                for vkey, vreason in att.victims:
+                    if vkey != key or by_victims is not None:
+                        continue
+                    by_victims = {
+                        "key": key, "cycle": rec.cycle, "ts": rec.ts,
+                        "outcome": "Preempted",
+                        "condition": reasons.VICTIM_OUTCOME.condition,
+                        "condition_reason":
+                            reasons.VICTIM_OUTCOME.condition_reason,
+                        "eviction_reason": vreason,
+                        "preempted_by": att.key,
+                        "path": att.path,
+                    }
+            if direct is not None:
+                if by_victims is not None:
+                    direct.setdefault(
+                        "preempted_by", by_victims["preempted_by"]
+                    )
+                    if direct.get("eviction_reason") is None:
+                        direct["eviction_reason"] = \
+                            by_victims["eviction_reason"]
+                out.append(direct)
+            elif by_victims is not None:
+                out.append(by_victims)
+        return out[-limit:]
+
+    # -- offline replay -------------------------------------------------
+
+    def dumps_jsonl(self) -> str:
+        return "\n".join(
+            json.dumps(rec.to_dict()) for rec in self.records()
+        )
+
+    def export_jsonl(self, path: str) -> int:
+        """Write one JSON object per cycle record; returns record count."""
+        recs = self.records()
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec.to_dict()))
+                f.write("\n")
+        return len(recs)
+
+
+# ----------------------------------------------------------------------
+# capture (called from models/driver.py under ``if flight.ENABLED``)
+# ----------------------------------------------------------------------
+
+
+def capture_cycle(
+    *,
+    cycle: int,
+    ts: float,
+    heads: int,
+    bucket: int,
+    path: str,
+    generations: Tuple[int, int],
+    arena: bool,
+    breaker_state: float,
+    result,
+    fallback_reason: Optional[str] = None,
+    timings: Optional[Dict[str, float]] = None,
+    duration_s: float = 0.0,
+    idx=None,
+    planes=None,
+) -> None:
+    """Build and append one CycleRecord from state the cycle already has
+    in hand. ``planes`` is the driver's _read_planes tuple (or None when
+    the cycle never read back); ``result`` is the cycle's CycleResult
+    with host outcomes already merged."""
+    rec_to = get()
+    if rec_to is None:
+        return
+    t = timings or {}
+    rec = CycleRecord(
+        cycle=cycle, ts=ts, path=path, heads=heads, bucket=bucket,
+        generation=generations[0], workload_generation=generations[1],
+        arena=arena, breaker_state=breaker_state,
+        fallback_reason=fallback_reason,
+        encode_s=t.get("encode_s", 0.0),
+        dispatch_s=t.get("dispatch_s", 0.0),
+        readback_s=t.get("readback_s", 0.0),
+        overlap_host_s=t.get("overlap_host_s", 0.0),
+        duration_s=duration_s,
+    )
+    rec.attempts = _decode_attempts(result, idx, planes)
+    rec_to.record(rec)
+
+
+def _device_rows(idx, planes):
+    """Per-key device decode: key -> (code, flavor, victims, NeedsHost?).
+    Victim map: victim key -> (preemptor key, strategy reason)."""
+    rows: Dict[str, Tuple[int, Optional[str], List[Tuple[str, str]]]] = {}
+    victim_map: Dict[str, Tuple[str, str]] = {}
+    if idx is None or planes is None:
+        return rows, victim_map
+    import numpy as np
+
+    outcome, chosen = planes[0], planes[1]
+    victims, variants = planes[7], planes[8]
+    for i, info in enumerate(idx.workloads):
+        code = int(outcome[i])
+        flavor = None
+        vlist: List[Tuple[str, str]] = []
+        if code == reasons.OUT_ADMITTED:
+            ci = int(chosen[i])
+            if 0 <= ci < len(idx.flavors):
+                flavor = idx.flavors[ci]
+        elif code == reasons.OUT_PREEMPTING and victims is not None:
+            for a in np.flatnonzero(victims[i]):
+                vkey = idx.admitted[a].key
+                vreason = reasons.VICTIM_VARIANT_REASONS.get(
+                    int(variants[i][a]) if variants is not None else 0,
+                    reasons.VICTIM_VARIANT_REASONS[2],
+                )
+                vlist.append((vkey, vreason))
+                victim_map[vkey] = (info.key, vreason)
+        rows[info.key] = (code, flavor, vlist)
+    return rows, victim_map
+
+
+# CycleResult category -> the device outcome code consistent with it. A
+# device row whose decoded code disagrees with where the key actually
+# landed was discarded (fallback tree / NeedsHost) and host-reprocessed,
+# so its provenance is attributed to the host path.
+_CATEGORY_CODES = {
+    "admitted": (reasons.OUT_ADMITTED,),
+    "preempting": (reasons.OUT_PREEMPTING,),
+    "skipped": (
+        reasons.OUT_NOFIT,
+        reasons.OUT_NO_CANDIDATES,
+        reasons.OUT_FIT_SKIPPED,
+        reasons.OUT_SHADOWED,
+    ),
+    "inadmissible": (),
+    "preempted": (),
+}
+
+
+def _decode_attempts(result, idx, planes) -> List[HeadAttempt]:
+    rows, victim_map = _device_rows(idx, planes)
+    attempts: List[HeadAttempt] = []
+    seen = set()
+    for category in (
+        "admitted", "preempting", "skipped", "inadmissible", "preempted"
+    ):
+        for key in getattr(result, category):
+            if key in seen:
+                continue
+            seen.add(key)
+            dev = rows.get(key)
+            if category == "preempted":
+                preemptor = victim_map.get(key)
+                attempts.append(HeadAttempt(
+                    key=key,
+                    outcome=reasons.VICTIM_OUTCOME.name,
+                    condition=reasons.VICTIM_OUTCOME.condition,
+                    condition_reason=(
+                        reasons.VICTIM_OUTCOME.condition_reason
+                    ),
+                    path="device" if preemptor is not None else "host",
+                    eviction_reason=(
+                        preemptor[1] if preemptor is not None else None
+                    ),
+                ))
+                continue
+            on_device = dev is not None and dev[0] in \
+                _CATEGORY_CODES[category]
+            if on_device:
+                info = reasons.DEVICE_OUTCOMES[dev[0]]
+                attempts.append(HeadAttempt(
+                    key=key, outcome=info.name, condition=info.condition,
+                    condition_reason=info.condition_reason, path="device",
+                    requeue_reason=info.requeue_reason,
+                    flavor=dev[1], victims=dev[2],
+                ))
+            else:
+                # Routed through the host pipeline — either no device row
+                # at all (encode fallback, breaker, contained cycle) or a
+                # device row whose tree was discarded. Record the
+                # NeedsHost hand-off when the device explicitly deferred.
+                if dev is not None and \
+                        dev[0] == reasons.OUT_NEEDS_HOST:
+                    ninfo = reasons.DEVICE_OUTCOMES[
+                        reasons.OUT_NEEDS_HOST
+                    ]
+                    attempts.append(HeadAttempt(
+                        key=key, outcome=ninfo.name,
+                        condition=ninfo.condition,
+                        condition_reason=ninfo.condition_reason,
+                        path="device",
+                    ))
+                info = reasons.HOST_OUTCOMES[category]
+                attempts.append(HeadAttempt(
+                    key=key, outcome=info.name, condition=info.condition,
+                    condition_reason=info.condition_reason, path="host",
+                    requeue_reason=info.requeue_reason,
+                ))
+    return attempts

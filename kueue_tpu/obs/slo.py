@@ -1,0 +1,267 @@
+"""Burn-rate SLOs over the existing metric histograms.
+
+Declarative objectives evaluated on rolling windows: the engine snapshots
+the raw counter/histogram-bucket totals at every ``evaluate()`` call and
+diffs the current totals against the oldest snapshot inside the window,
+so only traffic *within* the window counts against the budget. Two
+objective kinds:
+
+- ``latency``: fraction of histogram observations above ``threshold_s``
+  must stay under ``budget`` (threshold is resolved against bucket upper
+  bounds — observations in the bucket containing the threshold count as
+  over-threshold, the conservative reading).
+- ``ratio``: ``series`` (counter, summed over labels) divided by
+  ``den_series`` must stay under ``budget``.
+
+``burn_rate`` is the classic multi-window form: bad-fraction / budget.
+1.0 means the error budget is being consumed exactly at the sustainable
+rate; >1 means the objective is burning down. Evaluation exports the
+``slo_*`` gauges (metrics/names.py OBS_SERIES) so ``/metrics`` scrapes
+and the dashboard see the same numbers as the ``/slo`` endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from kueue_tpu.metrics.registry import Histogram, Metrics
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    name: str
+    kind: str = "latency"        # "latency" | "ratio"
+    series: str = ""             # histogram (latency) / numerator (ratio)
+    den_series: str = ""         # ratio denominator counter
+    threshold_s: float = 1.0     # latency objective threshold
+    budget: float = 0.01         # allowed bad fraction over the window
+    window_s: float = 300.0
+    description: str = ""
+
+
+DEFAULT_OBJECTIVES: Tuple[SLObjective, ...] = (
+    SLObjective(
+        name="cycle_latency",
+        kind="latency",
+        series="admission_attempt_duration_seconds",
+        threshold_s=1.0,
+        budget=0.01,
+        description="p99 admission-cycle latency: <1% of cycles over 1s",
+    ),
+    SLObjective(
+        name="admission_wait",
+        kind="latency",
+        series="admission_wait_time_seconds",
+        threshold_s=300.0,
+        budget=0.05,
+        description="admission wait: <5% of workloads wait over 5min",
+    ),
+    SLObjective(
+        name="fallback_cycles",
+        kind="ratio",
+        series="solver_fallback_cycles_total",
+        den_series="admission_attempts_total",
+        budget=0.01,
+        description="device-solver error budget: <1% contained fallbacks",
+    ),
+)
+
+
+@dataclass
+class SLOStatus:
+    name: str
+    kind: str
+    window_s: float
+    budget: float
+    samples: int = 0
+    bad: int = 0
+    bad_fraction: float = 0.0
+    burn_rate: float = 0.0
+    budget_remaining: float = 1.0
+    healthy: bool = True
+    # latency objectives: windowed quantiles; ratio: the windowed ratio.
+    value: float = 0.0
+    p50: Optional[float] = None
+    p99: Optional[float] = None
+    description: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind,
+            "windowS": self.window_s, "budget": self.budget,
+            "samples": self.samples, "bad": self.bad,
+            "badFraction": self.bad_fraction,
+            "burnRate": self.burn_rate,
+            "budgetRemaining": self.budget_remaining,
+            "healthy": self.healthy, "value": self.value,
+            "p50": self.p50, "p99": self.p99,
+            "description": self.description,
+        }
+
+
+# Raw per-objective snapshot payloads:
+#   latency -> (buckets_tuple, counts_list, n)
+#   ratio   -> (numerator, denominator)
+_Raw = Tuple
+
+
+class SLOEngine:
+    """Evaluates objectives over a Metrics registry and exports gauges."""
+
+    def __init__(
+        self,
+        metrics: Metrics,
+        objectives: Optional[Sequence[SLObjective]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.metrics = metrics
+        self.objectives: List[SLObjective] = list(
+            objectives if objectives is not None else DEFAULT_OBJECTIVES
+        )
+        self._clock = clock
+        # (t, {objective name: raw totals}) — cumulative, diffed per call.
+        self._snaps: deque = deque()
+        self.last_statuses: List[SLOStatus] = []
+
+    # -- raw totals -----------------------------------------------------
+
+    def _hist_totals(self, series: str):
+        """Aggregate one histogram series across label children into
+        (buckets, counts, n). Registry histograms share the default bucket
+        layout per series; mixed layouts fall back to the first child's."""
+        children = self.metrics.histograms.get(series, {})
+        buckets: Optional[Tuple[float, ...]] = None
+        counts: List[int] = []
+        n = 0
+        for h in children.values():
+            if buckets is None:
+                buckets = tuple(h.buckets)
+                counts = [0] * (len(h.buckets) + 1)
+            if tuple(h.buckets) != buckets:
+                continue
+            for i, c in enumerate(h.counts):
+                counts[i] += c
+            n += h.n
+        return buckets or (), counts, n
+
+    def _counter_total(self, series: str) -> float:
+        return float(sum(
+            self.metrics.counters.get(series, {}).values()
+        ))
+
+    def _raw(self, obj: SLObjective) -> _Raw:
+        if obj.kind == "latency":
+            return self._hist_totals(obj.series)
+        return (
+            self._counter_total(obj.series),
+            self._counter_total(obj.den_series),
+        )
+
+    # -- evaluation -----------------------------------------------------
+
+    def evaluate(self) -> List[SLOStatus]:
+        now = self._clock()
+        current = {o.name: self._raw(o) for o in self.objectives}
+        baseline = self._baseline(now)
+        statuses = [
+            self._status(o, baseline.get(o.name), current[o.name])
+            for o in self.objectives
+        ]
+        self._snaps.append((now, current))
+        self._trim(now)
+        self._export(statuses)
+        self.last_statuses = statuses
+        return statuses
+
+    def _baseline(self, now: float) -> Dict[str, _Raw]:
+        """Oldest snapshot still inside the widest objective window; with
+        no history yet, the diff is against zero (process start)."""
+        max_window = max(
+            (o.window_s for o in self.objectives), default=300.0
+        )
+        chosen: Dict[str, _Raw] = {}
+        for t, snap in self._snaps:
+            if now - t <= max_window:
+                return chosen or snap
+            chosen = snap
+        return chosen
+
+    def _trim(self, now: float) -> None:
+        max_window = max(
+            (o.window_s for o in self.objectives), default=300.0
+        )
+        # Keep one snapshot older than the window as the diff baseline.
+        while len(self._snaps) >= 2 and \
+                now - self._snaps[1][0] > max_window:
+            self._snaps.popleft()
+
+    def _status(self, obj: SLObjective, base: Optional[_Raw],
+                cur: _Raw) -> SLOStatus:
+        st = SLOStatus(
+            name=obj.name, kind=obj.kind, window_s=obj.window_s,
+            budget=obj.budget, description=obj.description,
+        )
+        if obj.kind == "latency":
+            buckets, counts, n = cur
+            if base is not None and base[0] == buckets:
+                counts = [c - b for c, b in zip(counts, base[1])]
+                n = n - base[2]
+            if n <= 0 or not buckets:
+                return st
+            # Observations strictly under the threshold bucket are good;
+            # the bucket containing the threshold counts as bad.
+            good = sum(
+                c for ub, c in zip(buckets, counts) if ub <= obj.threshold_s
+            )
+            bad = max(0, n - good)
+            h = Histogram(buckets=buckets)
+            h.counts = list(counts) + [0] * (
+                len(buckets) + 1 - len(counts)
+            )
+            h.n = n
+            st.p50 = h.quantile(0.50)
+            st.p99 = h.quantile(0.99)
+            st.value = st.p99
+            st.samples, st.bad = n, bad
+            st.bad_fraction = bad / n
+        else:
+            num, den = cur
+            if base is not None:
+                num, den = num - base[0], den - base[1]
+            if den <= 0:
+                return st
+            st.samples, st.bad = int(den), int(num)
+            st.bad_fraction = num / den
+            st.value = st.bad_fraction
+        st.burn_rate = (
+            st.bad_fraction / obj.budget if obj.budget > 0 else 0.0
+        )
+        st.budget_remaining = 1.0 - st.burn_rate
+        st.healthy = st.burn_rate <= 1.0
+        return st
+
+    def _export(self, statuses: List[SLOStatus]) -> None:
+        for st in statuses:
+            labels = {"slo": st.name}
+            self.metrics.set_gauge("slo_burn_rate", st.burn_rate, labels)
+            self.metrics.set_gauge(
+                "slo_budget_remaining", st.budget_remaining, labels
+            )
+            self.metrics.set_gauge("slo_objective_value", st.value, labels)
+            self.metrics.set_gauge(
+                "slo_healthy", 1.0 if st.healthy else 0.0, labels
+            )
+
+    # -- reporting ------------------------------------------------------
+
+    def to_doc(self) -> dict:
+        """The ``/slo`` endpoint body (evaluates first)."""
+        statuses = self.evaluate()
+        return {
+            "evaluatedAt": self._clock(),
+            "objectives": [st.to_dict() for st in statuses],
+            "healthy": all(st.healthy for st in statuses),
+        }

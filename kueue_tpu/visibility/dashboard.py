@@ -131,17 +131,34 @@ _HISTORY_LEN = 360
 
 
 class _History:
+    """Ring of dashboard activity samples. Writers (the state-doc cache,
+    direct ``state_json`` callers) and readers (every HTTP/websocket
+    serialization) run on different handler threads, so both go through
+    one internal lock: ``sample`` appends all four rings atomically and
+    ``snapshot`` returns a consistent same-length view — a reader can
+    never observe one ring longer than another mid-append."""
+
     def __init__(self) -> None:
         self.pending = deque(maxlen=_HISTORY_LEN)
         self.admitted = deque(maxlen=_HISTORY_LEN)
         self.preempted_total = deque(maxlen=_HISTORY_LEN)
         self.t = deque(maxlen=_HISTORY_LEN)
+        self._lock = threading.Lock()
 
     def sample(self, pending: int, admitted: int, preempted: float) -> None:
-        self.pending.append(pending)
-        self.admitted.append(admitted)
-        self.preempted_total.append(preempted)
-        self.t.append(time.time())
+        with self._lock:
+            self.pending.append(pending)
+            self.admitted.append(admitted)
+            self.preempted_total.append(preempted)
+            self.t.append(time.time())
+
+    def snapshot(self) -> Dict[str, list]:
+        with self._lock:
+            return {
+                "pending": list(self.pending),
+                "admitted": list(self.admitted),
+                "preempted_total": list(self.preempted_total),
+            }
 
 
 _history = _History()
@@ -177,11 +194,7 @@ def shared_state_doc(manager, max_age_s: float = 0.2):
             _history.sample(
                 t["pending"], t["admitted"], t["preempted (total)"]
             )
-        doc["history"] = {
-            "pending": list(_history.pending),
-            "admitted": list(_history.admitted),
-            "preempted_total": list(_history.preempted_total),
-        }
+        doc["history"] = _history.snapshot()
         _state_cache.update(ts=now, core=core, doc=doc, mgr=manager)
         return doc, core
 
@@ -306,11 +319,7 @@ def _state_json_once(manager, sample_history: bool = True) -> Dict:
         "workloads": wls,
         "cohort_tree": _cohort_tree(manager),
         "totals": totals,
-        "history": {
-            "pending": list(_history.pending),
-            "admitted": list(_history.admitted),
-            "preempted_total": list(_history.preempted_total),
-        },
+        "history": _history.snapshot(),
     }
 
 

@@ -43,11 +43,34 @@ class VisibilityServer:
     With a :class:`kueue_tpu.whatif.WhatIfEngine` attached, also exposes
     the forecasting endpoints ``/whatif/eta`` and ``/whatif/preview``
     (docs/whatif.md) — the reference has no analog; forecasts come from
-    the on-device counterfactual rollout."""
+    the on-device counterfactual rollout.
 
-    def __init__(self, queues: QueueManager, whatif=None) -> None:
+    With an :class:`kueue_tpu.obs.Explainer` / ``SLOEngine`` attached
+    (docs/observability.md), also serves ``/explain/<workload>`` and
+    ``/slo``."""
+
+    def __init__(self, queues: QueueManager, whatif=None,
+                 explainer=None, slo=None) -> None:
         self.queues = queues
         self.whatif = whatif
+        self.explainer = explainer
+        self.slo = slo
+
+    # -- observability (docs/observability.md) --------------------------
+
+    def explain(self, name: str, include_forecast: bool = True,
+                include_preview: bool = False) -> Dict:
+        if self.explainer is None:
+            return {"error": "explainer not attached"}
+        return self.explainer.explain(
+            name, include_forecast=include_forecast,
+            include_preview=include_preview,
+        )
+
+    def slo_doc(self) -> Dict:
+        if self.slo is None:
+            return {"error": "slo engine not attached"}
+        return self.slo.to_doc()
 
     def pending_workloads_cq(
         self, cq_name: str, offset: int = 0, limit: int = 1000
@@ -190,8 +213,16 @@ class VisibilityServer:
         """Optional HTTP endpoints:
         GET  /visibility/clusterqueues/<name>/pendingworkloads
         GET  /whatif/eta[?cluster_queue=<name>]
+        GET  /explain/<workload>[?forecast=0&preview=1]
+        GET  /slo
         POST /whatif/eta      {"clusterQueue"?: ..., "scenarios": [...]}
-        POST /whatif/preview  {workload spec, see whatif_preview}."""
+        POST /whatif/preview  {workload spec, see whatif_preview}.
+
+        Malformed requests (bad JSON, wrong field types, missing keys)
+        return structured 400 JSON ``{"error": "bad request", ...}``;
+        unknown paths and unknown workloads return structured 404 JSON;
+        handler bugs return structured 500 JSON — a client never sees a
+        hung connection or a bare HTML error page."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
         from urllib.parse import parse_qs, urlparse
 
@@ -211,6 +242,24 @@ class VisibilityServer:
                     return {}
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _guarded(self, fn):
+                """Run one route body; malformed input (the int()/[] /
+                KeyError family a bad payload produces) becomes a
+                structured 400, anything else a structured 500."""
+                try:
+                    fn()
+                except (KeyError, ValueError, TypeError,
+                        AttributeError) as exc:
+                    self._send_json({
+                        "error": "bad request",
+                        "detail": f"{type(exc).__name__}: {exc}",
+                    }, 400)
+                except Exception as exc:  # pragma: no cover - bug guard
+                    self._send_json({
+                        "error": "internal error",
+                        "detail": f"{type(exc).__name__}: {exc}",
+                    }, 500)
+
             def do_GET(self):  # noqa: N802
                 url = urlparse(self.path)
                 parts = url.path.strip("/").split("/")
@@ -224,20 +273,42 @@ class VisibilityServer:
                     and parts[1] == "clusterqueues"
                     and parts[3] == "pendingworkloads"
                 ):
-                    body = server_self.to_json(parts[2]).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._guarded(lambda: self._send_json(
+                        json.loads(server_self.to_json(parts[2]))
+                    ))
                 elif parts == ["whatif", "eta"]:
                     q = parse_qs(url.query)
                     cq = (q.get("cluster_queue") or [None])[0]
-                    self._send_json(server_self.whatif_eta(
-                        cluster_queue=cq
+                    self._guarded(lambda: self._send_json(
+                        server_self.whatif_eta(cluster_queue=cq)
+                    ))
+                elif len(parts) >= 2 and parts[0] == "explain":
+                    q = parse_qs(url.query)
+                    name = "/".join(parts[1:])
+                    fc = (q.get("forecast") or ["1"])[0] != "0"
+                    pv = (q.get("preview") or ["0"])[0] == "1"
+
+                    def _explain():
+                        doc = server_self.explain(
+                            name, include_forecast=fc, include_preview=pv
+                        )
+                        code = 404 if doc.get("found") is False else 200
+                        self._send_json(doc, code)
+
+                    self._guarded(_explain)
+                elif parts == ["explain"]:
+                    self._send_json({
+                        "error": "bad request",
+                        "detail": "usage: /explain/<workload>",
+                    }, 400)
+                elif parts == ["slo"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.slo_doc()
                     ))
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._send_json({
+                        "error": "not found", "path": url.path,
+                    }, 404)
 
             def do_POST(self):  # noqa: N802
                 parts = urlparse(self.path).path.strip("/").split("/")
@@ -246,16 +317,27 @@ class VisibilityServer:
                 except (ValueError, json.JSONDecodeError):
                     self._send_json({"error": "invalid JSON body"}, 400)
                     return
+                if not isinstance(payload, dict):
+                    self._send_json({
+                        "error": "bad request",
+                        "detail": "JSON body must be an object",
+                    }, 400)
+                    return
                 if parts == ["whatif", "eta"]:
-                    self._send_json(server_self.whatif_eta(
-                        cluster_queue=payload.get("clusterQueue"),
-                        scenarios=payload.get("scenarios"),
+                    self._guarded(lambda: self._send_json(
+                        server_self.whatif_eta(
+                            cluster_queue=payload.get("clusterQueue"),
+                            scenarios=payload.get("scenarios"),
+                        )
                     ))
                 elif parts == ["whatif", "preview"]:
-                    self._send_json(server_self.whatif_preview(payload))
+                    self._guarded(lambda: self._send_json(
+                        server_self.whatif_preview(payload)
+                    ))
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._send_json({
+                        "error": "not found", "path": self.path,
+                    }, 404)
 
             def log_message(self, *a):  # quiet
                 pass

@@ -1,0 +1,584 @@
+"""Admission provenance + SLO layer (docs/observability.md).
+
+Three claim families:
+
+1. The cycle flight recorder is zero-cost when off (module-flag guard
+   discipline, pinned by a source scan like the faults/tracing tests)
+   and, when on, its per-cycle records agree with the live scheduler's
+   decisions — checked end-to-end on a device manager and under a
+   randomized differential drive.
+2. The explain API joins live status, recorder provenance, and the
+   what-if forecast for admitted / pending / preempted workloads —
+   through `Manager.explain`, `cli explain`, and `/explain/<wl>`.
+3. The burn-rate SLO engine evaluates declarative objectives over
+   rolling windows and exports the `slo_*` gauges.
+"""
+
+import json
+import os
+import random
+import re
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from kueue_tpu.api.constants import (
+    IN_CLUSTER_QUEUE_REASON,
+    PreemptionPolicy,
+)
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    quota,
+)
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.manager import Manager
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.obs import recorder as flight
+from kueue_tpu.obs import reasons
+from kueue_tpu.obs.recorder import CycleRecord, FlightRecorder, HeadAttempt
+from kueue_tpu.obs.slo import SLObjective, SLOEngine
+
+from .helpers import make_cq, make_wl
+
+
+@pytest.fixture(autouse=True)
+def _restore_flight_flag():
+    prev = flight.ENABLED
+    yield
+    flight.ENABLED = prev
+
+
+# ---------------------------------------------------------------------------
+# Reason vocabulary
+
+
+def test_outcome_codes_pinned_to_kernel():
+    """obs/reasons.py mirrors the kernel's outcome-plane codes as plain
+    literals (so the obs layer imports without JAX); this pin is the
+    contract that keeps them equal."""
+    bs = pytest.importorskip("kueue_tpu.models.batch_scheduler")
+    for name in ("OUT_NOFIT", "OUT_NO_CANDIDATES", "OUT_NEEDS_HOST",
+                 "OUT_FIT_SKIPPED", "OUT_ADMITTED", "OUT_PREEMPTING",
+                 "OUT_SHADOWED"):
+        assert getattr(reasons, name) == getattr(bs, name), name
+
+
+def test_every_outcome_code_has_provenance_info():
+    for code in (reasons.OUT_NOFIT, reasons.OUT_NO_CANDIDATES,
+                 reasons.OUT_NEEDS_HOST, reasons.OUT_FIT_SKIPPED,
+                 reasons.OUT_ADMITTED, reasons.OUT_PREEMPTING,
+                 reasons.OUT_SHADOWED):
+        assert code in reasons.DEVICE_OUTCOMES
+    for category in ("admitted", "preempting", "preempted", "skipped",
+                     "inadmissible"):
+        assert category in reasons.HOST_OUTCOMES
+    # The docs checker consumes this set; it must be non-trivial and
+    # contain the strings operators actually see.
+    codes = reasons.documented_reason_codes()
+    assert "QuotaReserved" in codes
+    assert "Preempted" in codes
+    assert IN_CLUSTER_QUEUE_REASON in codes
+
+
+def test_reasons_module_imports_without_jax():
+    """The explain path (CLI, server, docs checker) must not pull the
+    JAX-backed kernel module just to translate reason codes."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import kueue_tpu.obs.reasons\n"
+        "import kueue_tpu.obs.slo\n"
+        "assert 'jax' not in sys.modules, 'obs vocabulary pulled in jax'\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# Recorder mechanics (no device required)
+
+
+def _mk_record(cycle, path="device", attempts=()):
+    return CycleRecord(
+        cycle=cycle, ts=float(cycle), path=path, heads=1, bucket=8,
+        generation=1, workload_generation=cycle, arena=False,
+        breaker_state=0.0, attempts=list(attempts),
+    )
+
+
+def test_recorder_ring_is_bounded():
+    rec = FlightRecorder(capacity=3)
+    for i in range(7):
+        rec.record(_mk_record(i))
+    got = rec.records()
+    assert len(got) == 3
+    assert [r.cycle for r in got] == [4, 5, 6]
+    assert rec.last().cycle == 6
+    rec.clear()
+    assert rec.records() == [] and rec.last() is None
+
+
+def test_recorder_jsonl_export(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec.record(_mk_record(1, attempts=[HeadAttempt(
+        key="default/a", outcome="Admitted",
+        condition="QuotaReserved", condition_reason="QuotaReserved",
+        path="device", flavor="default",
+    )]))
+    rec.record(_mk_record(2, path="fallback"))
+    lines = rec.dumps_jsonl().splitlines()
+    assert len(lines) == 2
+    docs = [json.loads(ln) for ln in lines]
+    assert docs[0]["attempts"][0]["key"] == "default/a"
+    assert docs[1]["path"] == "fallback"
+    out = tmp_path / "cycles.jsonl"
+    assert rec.export_jsonl(str(out)) == 2
+    assert len(out.read_text().splitlines()) == 2
+
+
+def test_attempts_and_evictions_queries():
+    rec = FlightRecorder(capacity=8)
+    preemptor = HeadAttempt(
+        key="default/high", outcome="Preempting",
+        condition="QuotaReserved", condition_reason="Pending",
+        path="device",
+        victims=[("default/low", IN_CLUSTER_QUEUE_REASON)],
+    )
+    victim = HeadAttempt(
+        key="default/low", outcome="Preempted",
+        condition="Evicted", condition_reason="Preempted",
+        path="device", eviction_reason=IN_CLUSTER_QUEUE_REASON,
+    )
+    rec.record(_mk_record(1, attempts=[preemptor, victim]))
+    atts = rec.attempts_for("default/high")
+    assert [a["outcome"] for a in atts] == ["Preempting"]
+    assert atts[0]["cycle"] == 1
+    evs = rec.evictions_for("default/low")
+    # One entry for the cycle, not one per source (direct row + the
+    # preemptor's victims list), with the preemptor joined in.
+    assert len(evs) == 1
+    assert evs[0]["eviction_reason"] == IN_CLUSTER_QUEUE_REASON
+    assert evs[0]["preempted_by"] == "default/high"
+
+
+def test_enable_disable_and_get():
+    assert flight.ENABLED is False or flight.get() is not None
+    rec = flight.enable(capacity=4)
+    assert flight.ENABLED and flight.get() is rec
+    # Same capacity: idempotent (records survive re-enable).
+    rec.record(_mk_record(1))
+    assert flight.enable(capacity=4) is rec
+    assert len(flight.get().records()) == 1
+    flight.disable()
+    assert flight.get() is None
+
+
+def test_recorder_disabled_by_default_and_call_sites_guarded():
+    """The zero-cost contract (same discipline as faults/tracing): a
+    fresh process has ``flight.ENABLED is False``, and every
+    ``flight.<fn>(...)`` call site in the driver sits under an
+    ``if flight.ENABLED`` guard, so the disabled hot path pays one
+    module-attribute read and allocates nothing."""
+    driver_py = os.path.join(
+        os.path.dirname(__file__), "..", "kueue_tpu", "models", "driver.py"
+    )
+    src = open(driver_py).read()
+    lines = src.splitlines()
+    call_sites = 0
+    offenders = []
+    for i, line in enumerate(lines):
+        if not re.search(r"flight\.\w+\(", line):
+            continue
+        call_sites += 1
+        indent = len(line) - len(line.lstrip())
+        guarded = False
+        for j in range(i - 1, max(-1, i - 40), -1):
+            prev = lines[j]
+            if not prev.strip():
+                continue
+            p_ind = len(prev) - len(prev.lstrip())
+            if p_ind < indent:
+                if "if flight.ENABLED" in prev:
+                    guarded = True
+                break
+        if not guarded:
+            offenders.append(f"driver.py:{i + 1}: {line.strip()}")
+    assert call_sites >= 3, "expected capture sites in the driver"
+    assert not offenders, "\n".join(offenders)
+
+
+# ---------------------------------------------------------------------------
+# Device end-to-end: recorder + explain on a live preemption story
+
+
+@pytest.fixture(scope="module")
+def device_story():
+    """One tiny device-scheduler story shared by the e2e assertions
+    (amortizes kernel compiles): ``low`` admits, ``high`` preempts it,
+    ``low`` and ``blocked`` end pending."""
+    flight.enable(capacity=64)
+    mgr = Manager(use_device_scheduler=True)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="co"),
+        make_cq(
+            "cq-a", cohort="co",
+            flavors={"default": {"cpu": quota(4_000)}},
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.ANY,
+            ),
+        ),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    low = make_wl("low", cpu_m=3_000, priority=0, creation_time=1.0)
+    mgr.create_workload(low)
+    mgr.schedule_all()
+    assert is_admitted(low)
+    high = make_wl("high", cpu_m=3_000, priority=100, creation_time=2.0)
+    mgr.create_workload(high)
+    mgr.schedule_all()
+    blocked = make_wl("blocked", cpu_m=3_000, priority=50,
+                      creation_time=3.0)
+    mgr.create_workload(blocked)
+    mgr.schedule_all()
+    assert is_admitted(high) and not is_admitted(low)
+    # Cheap deterministic forecasts for every explain call below: a
+    # tripped breaker degrades eta() to the queue-position basis
+    # (no rollout compile).
+    eng = mgr.whatif()
+    for _ in range(3):
+        eng.breaker.record_failure()
+    yield mgr
+    flight.disable()
+
+
+def test_device_records_admission_provenance(device_story):
+    rec = flight.get()
+    assert rec is not None
+    atts = rec.attempts_for("default/low")
+    admitted = [a for a in atts if a["outcome"] == "Admitted"]
+    assert admitted, atts
+    assert admitted[0]["condition_reason"] == "QuotaReserved"
+    assert admitted[0]["flavor"] == "default"
+    assert admitted[0]["path"] in ("device", "host")
+
+
+def test_device_records_preemption_with_strategy_reason(device_story):
+    rec = flight.get()
+    high = rec.attempts_for("default/high")
+    preempting = [a for a in high if a["outcome"] == "Preempting"]
+    assert preempting, high
+    assert preempting[0]["condition_reason"] == "Pending"
+    assert ["default/low"] == [v[0] for v in preempting[0]["victims"]]
+    evs = rec.evictions_for("default/low")
+    assert evs and evs[-1]["eviction_reason"] == IN_CLUSTER_QUEUE_REASON
+    assert evs[-1]["outcome"] == "Preempted"
+
+
+def test_device_records_have_cycle_metadata(device_story):
+    recs = flight.get().records()
+    assert recs
+    for r in recs:
+        assert r.path in ("device", "host", "fallback",
+                          "breaker_open", "contained")
+        assert r.heads >= 1
+        assert r.duration_s >= 0.0
+    device_cycles = [r for r in recs if r.path == "device"]
+    assert device_cycles, [r.path for r in recs]
+    assert all(r.bucket >= 1 for r in device_cycles)
+
+
+def test_explain_admitted(device_story):
+    doc = device_story.explain("high")
+    assert doc["found"] and doc["state"] == "admitted"
+    assert doc["clusterQueue"] == "cq-a"
+    assert doc["admission"]["podSets"][0]["flavors"] == {"cpu": "default"}
+    assert any(a["outcome"] == "Admitted" for a in doc["attempts"])
+
+
+def test_explain_pending_with_blockers_and_forecast(device_story):
+    doc = device_story.explain("blocked")
+    assert doc["found"] and doc["state"] == "pending"
+    assert isinstance(doc["queuePosition"], int)
+    # 3000m requested, 1000m headroom left next to `high`.
+    blockers = doc["blockingQuota"]
+    assert blockers and blockers[0]["resource"] == "cpu"
+    assert blockers[0]["requested"] == 3_000
+    assert blockers[0]["available"] == 1_000
+    # Breaker tripped in the fixture: the forecast degrades to the
+    # queue-position basis instead of compiling a rollout.
+    assert doc.get("forecastBasis") == "queue_position"
+
+
+def test_explain_preempted_history(device_story):
+    doc = device_story.explain("default/low")
+    assert doc["found"]
+    assert doc["state"] == "pending"  # requeued after the eviction
+    assert doc["lastEviction"]["reason"] == "Preempted"
+    assert doc["evictions"]
+    assert doc["evictions"][-1]["eviction_reason"] == \
+        IN_CLUSTER_QUEUE_REASON
+
+
+def test_explain_not_found(device_story):
+    doc = device_story.explain("nope")
+    assert doc["found"] is False and "error" in doc
+
+
+def test_cmd_explain_cli(device_story, capsys):
+    from kueue_tpu.cli import cmd_explain
+
+    args = SimpleNamespace(name="high", namespace="default", json=True,
+                           no_forecast=False, victims=False)
+    assert cmd_explain(device_story, args) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["state"] == "admitted"
+
+    args = SimpleNamespace(name="blocked", namespace="default",
+                           json=False, no_forecast=True, victims=False)
+    assert cmd_explain(device_story, args) == 0
+    out = capsys.readouterr().out
+    assert "State: pending" in out
+    assert "Blocking quota: cpu" in out
+
+    args = SimpleNamespace(name="nope", namespace="default", json=False,
+                           no_forecast=True, victims=False)
+    assert cmd_explain(device_story, args) == 1
+
+
+def test_explain_and_slo_http_endpoints(device_story):
+    from kueue_tpu.visibility.server import VisibilityServer
+
+    srv = VisibilityServer(
+        device_story.queues, whatif=device_story.whatif(),
+        explainer=device_story.explainer(), slo=device_story.slo(),
+    )
+    httpd = srv.serve(port=0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/explain/high", timeout=10).read())
+        assert doc["state"] == "admitted"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/explain/default/low?forecast=0", timeout=10).read())
+        assert doc["state"] == "pending"
+        assert doc["evictions"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/explain/ghost", timeout=10)
+        assert err.value.code == 404
+        assert json.loads(err.value.read())["found"] is False
+        slo_doc = json.loads(urllib.request.urlopen(
+            f"{base}/slo", timeout=10).read())
+        assert {o["name"] for o in slo_doc["objectives"]} == {
+            "cycle_latency", "admission_wait", "fallback_cycles"
+        }
+        assert isinstance(slo_doc["healthy"], bool)
+    finally:
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Randomized differential: records vs live decisions
+
+
+_CATEGORY_OUTCOMES = {
+    "admitted": {"Admitted"},
+    "preempting": {"Preempting"},
+    "preempted": {"Preempted"},
+    "skipped": {"NoFit", "NoCandidates", "FitSkipped", "Shadowed",
+                "Skipped"},
+    "inadmissible": {"Inadmissible"},
+}
+
+
+def test_recorder_differential_against_live_decisions():
+    """Drive a device manager with random submit/finish churn; after
+    every cycle the newest record's final per-key outcome must land in
+    exactly the category the live CycleResult put that key in."""
+    flight.enable(capacity=16)
+    flight.get().clear()
+    rng = random.Random(7)
+    mgr = Manager(use_device_scheduler=True)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="co"),
+        make_cq(
+            "cq-a", cohort="co",
+            flavors={"default": {"cpu": quota(5_000)}},
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.ANY,
+            ),
+        ),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    live = []
+    n = 0
+    checked = 0
+    for step in range(25):
+        if rng.random() < 0.6 or not live:
+            n += 1
+            wl = make_wl(
+                f"w{n}", cpu_m=rng.choice([1_000, 2_000, 3_000]),
+                priority=rng.randrange(0, 3) * 100,
+                creation_time=float(step + 1),
+            )
+            mgr.create_workload(wl)
+            live.append(wl)
+        elif live:
+            wl = live.pop(rng.randrange(len(live)))
+            mgr.finish_workload(wl)
+        result = mgr.scheduler.schedule()
+        if not result.head_keys:
+            continue
+        rec = flight.get().last()
+        assert rec is not None
+        assert rec.cycle == mgr.scheduler.cycles
+        final = {}
+        for att in rec.attempts:
+            final[att.key] = att
+        for category, outcomes in _CATEGORY_OUTCOMES.items():
+            for key in getattr(result, category):
+                assert key in final, (category, key, rec.to_dict())
+                assert final[key].outcome in outcomes, (
+                    category, key, final[key]
+                )
+                checked += 1
+        # Device-decoded admissions must carry the decoded flavor.
+        for att in final.values():
+            if att.outcome == "Admitted" and att.path == "device":
+                assert att.flavor == "default"
+    assert checked > 10
+    flight.disable()
+
+
+def test_recorder_off_means_no_capture():
+    """With the flag down, scheduling runs and the recorder (even a
+    previously enabled one) sees nothing."""
+    rec = flight.enable(capacity=8)
+    rec.clear()
+    flight.disable()
+    mgr = Manager(use_device_scheduler=True)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    wl = make_wl("solo", cpu_m=1_000, creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    assert flight.get() is None
+    assert rec.records() == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+
+
+def _lat_objective(**kw):
+    base = dict(name="lat", kind="latency", series="h",
+                threshold_s=1.0, budget=0.1, window_s=60.0)
+    base.update(kw)
+    return SLObjective(**base)
+
+
+def test_slo_latency_burn_rate_and_gauges():
+    m = Metrics()
+    t = [0.0]
+    eng = SLOEngine(m, objectives=[_lat_objective()], clock=lambda: t[0])
+    for _ in range(90):
+        m.observe("h", 0.1)
+    for _ in range(10):
+        m.observe("h", 5.0)
+    st = eng.evaluate()[0]
+    assert st.samples == 100 and st.bad == 10
+    assert st.bad_fraction == pytest.approx(0.1)
+    assert st.burn_rate == pytest.approx(1.0)
+    assert st.healthy  # burning exactly at the sustainable rate
+    assert st.p99 is not None and st.p99 > st.p50
+    # Gauges exported under the slo label, visible on /metrics.
+    text = m.expose()
+    assert 'kueue_slo_burn_rate{slo="lat"}' in text
+    assert 'kueue_slo_healthy{slo="lat"}' in text
+
+    # Only NEW bad traffic counts against the window.
+    t[0] = 30.0
+    for _ in range(10):
+        m.observe("h", 5.0)
+    st = eng.evaluate()[0]
+    assert st.samples == 10 and st.bad == 10
+    assert st.burn_rate == pytest.approx(10.0)
+    assert not st.healthy
+    assert st.budget_remaining == pytest.approx(-9.0)
+
+
+def test_slo_window_expiry_forgives_old_burn():
+    m = Metrics()
+    t = [0.0]
+    eng = SLOEngine(m, objectives=[_lat_objective()], clock=lambda: t[0])
+    for _ in range(10):
+        m.observe("h", 5.0)  # all bad
+    st = eng.evaluate()[0]
+    assert not st.healthy
+    # Two windows later with no new traffic: the bad burst has aged out.
+    t[0] = 120.0
+    st = eng.evaluate()[0]
+    assert st.samples == 0 and st.healthy
+
+
+def test_slo_ratio_objective():
+    m = Metrics()
+    t = [0.0]
+    obj = SLObjective(name="fb", kind="ratio", series="bad_total",
+                      den_series="all_total", budget=0.5, window_s=60.0)
+    eng = SLOEngine(m, objectives=[obj], clock=lambda: t[0])
+    for _ in range(8):
+        m.inc("all_total")
+    m.inc("bad_total")
+    st = eng.evaluate()[0]
+    assert st.kind == "ratio"
+    assert st.value == pytest.approx(1 / 8)
+    assert st.burn_rate == pytest.approx(0.25)
+    assert st.healthy
+    d = st.to_dict()
+    assert d["burnRate"] == pytest.approx(0.25)
+
+
+def test_slo_empty_registry_is_healthy():
+    eng = SLOEngine(Metrics(), clock=lambda: 0.0)
+    statuses = eng.evaluate()
+    assert len(statuses) == 3
+    assert all(st.healthy and st.samples == 0 for st in statuses)
+    doc = eng.to_doc()
+    assert doc["healthy"] is True
+
+
+def test_manager_gauge_tick_reevaluates_slo():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.slo()  # build the engine; ticks now keep it fresh
+    wl = make_wl("w", cpu_m=1_000, creation_time=1.0)
+    mgr.create_workload(wl)
+    mgr.schedule_all()
+    assert is_admitted(wl)
+    gauges = mgr.metrics.gauges.get("slo_burn_rate", {})
+    slos = {dict(k)["slo"] for k in gauges}
+    assert "cycle_latency" in slos and "fallback_cycles" in slos

@@ -15,6 +15,8 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -592,3 +594,198 @@ def test_acceptance_traced_harness_run():
     assert stage_counts and all(v > 0 for v in stage_counts)
     # Phase breakdown covers the dominant scheduler phases.
     assert result.phase_breakdown["scheduler/cycle"] > 0
+
+
+# ----------------------------------------------------------------------
+# histogram quantile edges (provenance/SLO PR satellites)
+# ----------------------------------------------------------------------
+
+
+def test_histogram_quantile_degenerate_inputs():
+    h = Histogram()
+    for v in (0.07, 0.07, 0.07):
+        h.observe(v)
+    # q=0 -> target 0 is satisfied by the very first (empty) bucket,
+    # whose zero count short-circuits to its upper bound — the estimator
+    # answers "at most the smallest bucket bound", never a negative.
+    assert h.quantile(0.0) == pytest.approx(0.001)
+    assert h.quantile(1.0) == pytest.approx(0.1)
+    # Empty histogram: every quantile is 0 (and never divides by zero).
+    assert Histogram().quantile(0.0) == 0.0
+    assert Histogram().quantile(1.0) == 0.0
+
+
+def test_histogram_quantile_single_bucket_layout():
+    h = Histogram(buckets=[1.0])
+    for v in (0.2, 0.4, 0.6, 0.8):
+        h.observe(v)
+    # All mass in the one finite bucket: interpolate within (0, 1.0].
+    assert h.quantile(0.5) == pytest.approx(0.5)
+    assert h.quantile(1.0) == pytest.approx(1.0)
+    h.observe(5.0)  # overflow bucket
+    assert h.quantile(0.99) == float("inf")
+
+
+# ----------------------------------------------------------------------
+# checker extensions: never-emitted names, reason-code docs
+# ----------------------------------------------------------------------
+
+
+def _checker():
+    import sys
+    sys.path.insert(0, "/root/repo/tools")
+    import check_metrics_names
+    return check_metrics_names
+
+
+def test_checker_collects_bare_and_conditional_emit_names(tmp_path):
+    """The emitted-name collector must see the tracing idiom: bare
+    module-level inc()/observe() calls, and a conditional first arg
+    (observe("a" if x else "b", ...)) contributing BOTH names."""
+    checker = _checker()
+    src = tmp_path / "emit.py"
+    src.write_text(
+        "def f(m, miss):\n"
+        "    inc('bare_total')\n"
+        "    m.observe('attr_seconds' if miss else 'other_seconds', 1.0)\n"
+    )
+    names = checker.collect_emitted_names(src)
+    assert {"bare_total", "attr_seconds", "other_seconds"} <= names
+
+
+def test_checker_flags_allowlisted_but_never_emitted():
+    checker = _checker()
+    violations = checker.check_emitted_coverage(
+        frozenset({"this_series_is_never_emitted_total"})
+    )
+    assert len(violations) == 1
+    assert "no call site ever emits it" in violations[0]
+    assert "this_series_is_never_emitted_total" in violations[0]
+    # The real allowlist has no dead names (also covered by run_check).
+    from kueue_tpu.metrics.names import METRIC_NAMES
+    assert checker.check_emitted_coverage(METRIC_NAMES) == []
+
+
+def test_checker_requires_reason_codes_documented():
+    checker = _checker()
+    assert checker.check_reason_codes_documented() == []
+
+
+# ----------------------------------------------------------------------
+# dashboard history: concurrent samplers vs readers
+# ----------------------------------------------------------------------
+
+
+def test_dashboard_history_snapshot_is_consistent_under_races():
+    """Writers append four rings per sample; a reader must never see
+    them mid-append with different lengths."""
+    from kueue_tpu.visibility.dashboard import _History
+
+    hist = _History()
+    stop = threading.Event()
+    bad = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            hist.sample(i, i + 1, float(i))
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            snap = hist.snapshot()
+            lengths = {len(v) for v in snap.values()}
+            if len(lengths) != 1:
+                bad.append(lengths)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not bad, bad
+    snap = hist.snapshot()
+    assert len(snap["pending"]) == len(snap["admitted"]) \
+        == len(snap["preempted_total"])
+
+
+# ----------------------------------------------------------------------
+# visibility server robustness: malformed requests -> structured errors
+# ----------------------------------------------------------------------
+
+
+def _obs_server():
+    from kueue_tpu.visibility.server import VisibilityServer
+
+    mgr = Manager()
+    mgr.apply(make_cq("cq-a"))
+    mgr.apply(LocalQueue(name="lq", cluster_queue="cq-a"))
+    srv = VisibilityServer(
+        mgr.queues, whatif=mgr.whatif(),
+        explainer=mgr.explainer(), slo=mgr.slo(),
+    )
+    httpd = srv.serve(port=0)
+    return mgr, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(url, body: bytes):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def test_server_malformed_whatif_returns_structured_400():
+    mgr, httpd, base = _obs_server()
+    try:
+        # Non-JSON body.
+        code, doc = _post(f"{base}/whatif/preview", b"{nope")
+        assert code == 400 and "error" in doc
+        # JSON but not an object.
+        code, doc = _post(f"{base}/whatif/preview", b"[1, 2]")
+        assert code == 400
+        assert doc["detail"] == "JSON body must be an object"
+        # Wrong field types inside an otherwise-valid object.
+        code, doc = _post(
+            f"{base}/whatif/preview",
+            json.dumps({"requests": {"cpu": "abc"}}).encode(),
+        )
+        assert code == 400 and doc["error"] == "bad request"
+        assert "detail" in doc
+        # Scenarios must be a list of dicts.
+        code, doc = _post(
+            f"{base}/whatif/eta",
+            json.dumps({"scenarios": 42}).encode(),
+        )
+        assert code == 400 and doc["error"] == "bad request"
+    finally:
+        httpd.shutdown()
+
+
+def test_server_unknown_paths_and_workloads_are_structured_404():
+    mgr, httpd, base = _obs_server()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/no/such/route", timeout=10)
+        assert err.value.code == 404
+        doc = json.loads(err.value.read())
+        assert doc["error"] == "not found" and doc["path"] == "/no/such/route"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/explain/ghost", timeout=10)
+        assert err.value.code == 404
+        assert json.loads(err.value.read())["found"] is False
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/explain", timeout=10)
+        assert err.value.code == 400
+        assert "usage" in json.loads(err.value.read())["detail"]
+        code, doc = _post(f"{base}/no/such/route", b"{}")
+        assert code == 404 and doc["error"] == "not found"
+    finally:
+        httpd.shutdown()

@@ -95,6 +95,77 @@ def check_file(path: Path, allowlist: frozenset) -> List[Tuple[int, str]]:
     return violations
 
 
+def collect_emitted_names(path: Path) -> set:
+    """Every string-literal metric name passed to an emit method in one
+    file — regardless of receiver heuristics or exclusions. Used for the
+    dead-allowlist check: a name only ever forwarded (registry/tracing)
+    still counts as emitted somewhere upstream of the forwarding layer."""
+    emitted: set = set()
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # Attribute form (m.inc / tracing.observe / self.metrics.set_gauge)
+        # or the bare-function form used inside tracing.py itself.
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        else:
+            continue
+        if name not in _EMIT_METHODS:
+            continue
+        if not node.args:
+            continue
+        # The name argument may be a conditional over literals
+        # ("a" if miss else "b"); any string constant inside it is a
+        # name the call can emit.
+        for sub in ast.walk(node.args[0]):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                emitted.add(sub.value)
+    return emitted
+
+
+def check_emitted_coverage(allowlist: frozenset) -> List[str]:
+    """The inverse of the typo check: an allowlisted name no call site
+    ever emits is dead weight — usually a renamed series whose allowlist
+    entry survived the rename. Dashboards reading it show zeros forever
+    with every static check green, so the allowlist itself must stay
+    honest. Scans ALL package files (including the forwarding layers the
+    per-site walk excludes) plus bench.py, which owns probe-only series."""
+    emitted: set = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        emitted |= collect_emitted_names(path)
+    bench = REPO_ROOT / "bench.py"
+    if bench.exists():
+        emitted |= collect_emitted_names(bench)
+    return [
+        f"kueue_tpu/metrics/names.py: series {name!r} is allowlisted "
+        "but no call site ever emits it"
+        for name in sorted(allowlist - emitted)
+    ]
+
+
+def check_reason_codes_documented() -> List[str]:
+    """Every provenance reason code the obs layer can stamp onto a cycle
+    record (obs/reasons.py) must appear in docs/observability.md — the
+    explain API is only as useful as the operator's ability to look a
+    code up."""
+    from kueue_tpu.obs.reasons import documented_reason_codes
+
+    doc_path = REPO_ROOT / "docs" / "observability.md"
+    if not doc_path.exists():
+        return [f"{doc_path.relative_to(REPO_ROOT)}: missing"]
+    doc = doc_path.read_text()
+    return [
+        f"docs/observability.md: reason code {code!r} is in "
+        "kueue_tpu/obs/reasons.py but undocumented"
+        for code in sorted(documented_reason_codes())
+        if code not in doc
+    ]
+
+
 def check_docs_coverage(allowlist: frozenset) -> List[str]:
     """Every allowlisted series must be documented: names.py's contract is
     "adding a metric means adding it here AND to docs/observability.md".
@@ -144,7 +215,9 @@ def run_check() -> List[str]:
             rel = path.relative_to(REPO_ROOT)
             out.append(f"{rel}:{lineno}: {msg}")
     out.extend(check_docs_coverage(METRIC_NAMES))
+    out.extend(check_emitted_coverage(METRIC_NAMES))
     out.extend(check_fault_points_documented())
+    out.extend(check_reason_codes_documented())
     return out
 
 
