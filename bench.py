@@ -40,6 +40,28 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# Set by main() from --out. Probes write their record here (atomically)
+# so the parent bench / harness can read a complete file even when the
+# probe process dies after measuring (e.g. the jaxlib serialize()
+# segfault); stdout then carries exactly one final JSON line per run.
+_OUT_PATH = None
+
+
+def _write_probe_record(doc: dict) -> None:
+    """Persist an (interim or final) probe record without touching
+    stdout: atomic write to --out when given, stderr otherwise."""
+    if _OUT_PATH:
+        try:
+            tmp = _OUT_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, _OUT_PATH)
+            return
+        except OSError as exc:
+            log(f"--out unwritable: {exc!r}")
+    log(json.dumps(doc))
+
+
 def build_scenario(scale: float, n_cohorts: int = 5, n_cqs: int = 6,
                    classes=None, fair: bool = False,
                    nominal: int = 20_000, borrowing_limit: int = 100_000):
@@ -1140,11 +1162,12 @@ def probe_coldstart_child(scale: float):
         "aot_hits": stats["aot_hits"],
         "aot_stored": [],
     }
-    # Fallback line BEFORE the serialize step: executable.serialize()
-    # can segfault on some jaxlib CPU builds, and the parent parses the
-    # last JSON line on stdout — a crash below costs the AOT store for
-    # the next process, not this measurement.
-    print(json.dumps(out), flush=True)
+    # Record the measurement BEFORE the serialize step:
+    # executable.serialize() can segfault on some jaxlib CPU builds — a
+    # crash below must cost the AOT store for the next process, not this
+    # measurement. Written to the --out sidecar (the parent prefers it
+    # over stdout), NOT printed: stdout stays one-final-JSON-line.
+    _write_probe_record(out)
     out["aot_stored"] = sorted(compile_cache.store_recorded())
     return out
 
@@ -1189,10 +1212,19 @@ def run_probe_subprocess(
     probe: str, timeout_s: int, scale: float, platform: str = None,
     env_extra: dict = None, compile_cache: str = None,
 ) -> dict:
-    """Run one probe in a timeout-guarded subprocess; parse its JSON line."""
+    """Run one probe in a timeout-guarded subprocess. The child gets a
+    tempfile ``--out`` sidecar, preferred over stdout parsing: a record
+    the child wrote before crashing (serialize() segfault) still counts,
+    and stdout formatting drift can't corrupt the result."""
+    import tempfile
+
+    fd, out_path = tempfile.mkstemp(prefix=f"kueue-tpu-{probe}-",
+                                    suffix=".json")
+    os.close(fd)
+    os.unlink(out_path)  # child creates it atomically on write
     cmd = [
         "/usr/bin/timeout", str(timeout_s), sys.executable, __file__,
-        "--probe", probe, "--scale", str(scale),
+        "--probe", probe, "--scale", str(scale), "--out", out_path,
     ]
     if platform:
         cmd += ["--platform", platform]
@@ -1208,6 +1240,21 @@ def run_probe_subprocess(
             env=env,
         )
     except subprocess.TimeoutExpired:
+        res = None
+    finally:
+        doc = None
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            doc = None
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+    if isinstance(doc, dict):
+        return doc
+    if res is None:
         return {"probe": probe, "ok": False, "error": "outer timeout"}
     for line in reversed(res.stdout.strip().splitlines() or [""]):
         if line.startswith("{"):
@@ -1248,7 +1295,20 @@ def main():
                          "own subprocess so a crash costs one probe, not "
                          "the bench")
     ap.add_argument("--skip-device", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write the final probe record (and any interim "
+                         "crash-protection record) atomically to this "
+                         "path; stdout still carries the one final JSON "
+                         "line")
+    ap.add_argument("--ledger", default=None,
+                    help="perf-ledger JSONL path (default: "
+                         "PERF_LEDGER.jsonl at the repo root, or "
+                         "$KUEUE_TPU_PERF_LEDGER)")
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="skip the perf-ledger append for this run")
     args = ap.parse_args(argv)
+    global _OUT_PATH
+    _OUT_PATH = args.out
 
     if args.platform:
         import jax
@@ -1288,6 +1348,26 @@ def main():
         except Exception as exc:  # noqa: BLE001 - report, don't crash
             stats = {"probe": args.probe, "ok": False,
                      "error": repr(exc)[:300]}
+        if args.out:
+            _write_probe_record(stats)
+        # Perf ledger: every top-level probe run leaves one JSONL record
+        # (docs/observability.md#perf-ledger). coldstart-child is the
+        # internal half of the coldstart probe — its cold and warm runs
+        # share a fingerprint, so recording them would poison the
+        # rolling median with deliberate before/after deltas.
+        if not args.no_ledger and args.probe != "coldstart-child":
+            try:
+                from kueue_tpu.perf import ledger as perf_ledger
+
+                rec = perf_ledger.make_record(
+                    args.probe, stats, scale=args.scale,
+                    platform=args.platform,
+                )
+                path = args.ledger or perf_ledger.default_ledger_path()
+                if not perf_ledger.append_record(rec, path):
+                    log(f"perf ledger unwritable at {path}")
+            except Exception as exc:  # noqa: BLE001 - never fail a probe
+                log(f"perf ledger append failed: {exc!r}")
         print(json.dumps(stats), flush=True)
         os._exit(0)
 
@@ -1397,12 +1477,11 @@ def main():
         os.replace(tmp, os.path.join(here, "BENCH_DETAIL.json"))
     except OSError as exc:
         # Never advertise a stale/partial sidecar as this run's data.
-        # The full object still goes to stdout (possibly truncated by the
-        # driver's tail capture, but a measurement run's data must never
-        # be silently dropped); the compact summary below remains the
-        # final, always-parseable line.
+        # The full object still goes to stderr (a measurement run's data
+        # must never be silently dropped) — NOT stdout, which carries
+        # exactly one final JSON line (the compact summary below).
         detail_ref = f"unwritable: {exc!r}"[:120]
-        print(json.dumps(out), flush=True)
+        log(json.dumps(out))
 
     def _pick(d, *keys):
         picked = {

@@ -93,6 +93,7 @@ TRACING_SERIES = frozenset({
     "solver_overlap_host_seconds",
     "remote_calls_total",
     "remote_call_duration_seconds",
+    "remote_spans_ingested_total",
     # Fault containment (models/driver.py, utils/breaker.py, remote/).
     "solver_fallback_cycles_total",
     "solver_breaker_state",
@@ -118,4 +119,52 @@ OBS_SERIES = frozenset({
     "slo_healthy",
 })
 
-METRIC_NAMES = REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES
+# Cost attribution + on-demand profiling (obs/costs.py).
+COST_SERIES = frozenset({
+    "solver_cost_dispatch_total",
+    "solver_cost_device_seconds_total",
+    "padding_waste_lane_fraction",
+    "profile_captures_total",
+    "profile_state",
+})
+
+METRIC_NAMES = (
+    REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES | COST_SERIES
+)
+
+# HELP text for the Prometheus exposition (registry.Metrics.expose).
+# Series without an explicit entry fall back to a docs pointer; every key
+# here MUST be in METRIC_NAMES (tools/check_metrics_names.py enforces it).
+HELP_TEXT = {
+    "solver_cost_dispatch_total":
+        "Device dispatches per solver entry point and shape bucket",
+    "solver_cost_device_seconds_total":
+        "Device wall seconds attributed per solver entry point and bucket",
+    "padding_waste_lane_fraction":
+        "Wasted-lane fraction per entry point and padded axis "
+        "(1 - real/padded)",
+    "profile_captures_total":
+        "jax.profiler capture lifecycle events (start/stop/error)",
+    "profile_state":
+        "Profiler state: 0 idle, 1 capturing, 2 failed, 3 breaker open",
+    "solver_device_seconds":
+        "Blocking device dispatch+readback wall time per kernel",
+    "solver_batch_size": "W padding bucket used by the admission cycle",
+    "solver_padding_waste_pct":
+        "Padded-minus-real head rows as a percentage of the bucket",
+    "obs_recorder_cycles_total":
+        "Cycle records captured by the flight recorder, by path",
+    "trace_span_duration_seconds": "Span durations by span name",
+    "remote_calls_total": "Remote worker calls by op/transport/outcome",
+    "remote_call_duration_seconds":
+        "Remote worker call latency by op and transport",
+    "whatif_rollout_seconds": "What-if batched rollout wall time",
+    "remote_spans_ingested_total":
+        "Worker spans merged into the client trace, by worker lane",
+}
+
+_HELP_FALLBACK = "kueue_tpu series; see docs/observability.md"
+
+
+def help_for(name: str) -> str:
+    return HELP_TEXT.get(name, _HELP_FALLBACK)

@@ -103,18 +103,25 @@ class Metrics:
             return self.gauges.get(name, {}).get(_lk(labels), 0.0)
 
     def expose(self) -> str:
-        """Prometheus text format."""
+        """Prometheus text exposition format (serve with Content-Type
+        ``text/plain; version=0.0.4``). HELP text comes from the frozen
+        names allowlist (metrics/names.py HELP_TEXT)."""
+        from kueue_tpu.metrics.names import help_for
+
         out: List[str] = []
         with self._lock:
             for name, series in sorted(self.counters.items()):
+                out.append(f"# HELP kueue_{name} {help_for(name)}")
                 out.append(f"# TYPE kueue_{name} counter")
                 for lk, v in sorted(series.items()):
                     out.append(f"kueue_{name}{_fmt(lk)} {v}")
             for name, series in sorted(self.gauges.items()):
+                out.append(f"# HELP kueue_{name} {help_for(name)}")
                 out.append(f"# TYPE kueue_{name} gauge")
                 for lk, v in sorted(series.items()):
                     out.append(f"kueue_{name}{_fmt(lk)} {v}")
             for name, series in sorted(self.histograms.items()):
+                out.append(f"# HELP kueue_{name} {help_for(name)}")
                 out.append(f"# TYPE kueue_{name} histogram")
                 for lk, h in sorted(series.items()):
                     acc = 0
@@ -130,6 +137,50 @@ class Metrics:
                     out.append(f"kueue_{name}_sum{_fmt(lk)} {h.total}")
                     out.append(f"kueue_{name}_count{_fmt(lk)} {h.n}")
         return "\n".join(out) + "\n"
+
+    def to_doc(self) -> dict:
+        """JSON-ready snapshot of every series — the machine-readable
+        sibling of :meth:`expose` (``/metrics.json``). Histograms export
+        count/sum plus interpolated p50/p99."""
+        def _labels(lk: LabelKey) -> Dict[str, str]:
+            return dict(lk)
+
+        def _q(h: Histogram, q: float):
+            v = h.quantile(q)
+            # +Inf (observation beyond the last bucket bound) is not
+            # valid strict JSON; clients read null as "off the scale".
+            return v if v == v and v not in (float("inf"),) else None
+
+        with self._lock:
+            return {
+                "counters": {
+                    name: [
+                        {"labels": _labels(lk), "value": v}
+                        for lk, v in sorted(series.items())
+                    ]
+                    for name, series in sorted(self.counters.items())
+                },
+                "gauges": {
+                    name: [
+                        {"labels": _labels(lk), "value": v}
+                        for lk, v in sorted(series.items())
+                    ]
+                    for name, series in sorted(self.gauges.items())
+                },
+                "histograms": {
+                    name: [
+                        {
+                            "labels": _labels(lk),
+                            "count": h.n,
+                            "sum": h.total,
+                            "p50": _q(h, 0.50),
+                            "p99": _q(h, 0.99),
+                        }
+                        for lk, h in sorted(series.items())
+                    ]
+                    for name, series in sorted(self.histograms.items())
+                },
+            }
 
 
 def _escape_label_value(v: str) -> str:

@@ -90,25 +90,69 @@ class Tracer:
     # -- export ------------------------------------------------------------
 
     def export_chrome_trace(self) -> Dict[str, Any]:
-        """Chrome ``trace_event`` JSON (complete "X" events, µs units)."""
-        events = []
+        """Chrome ``trace_event`` JSON (complete "X" events, µs units).
+
+        Spans ingested from remote workers (``ingest_remote_spans``)
+        carry a ``worker`` key and render as their own process lane: each
+        distinct worker gets a synthetic pid plus a ``process_name``
+        metadata event, so the merged client+worker timeline reads as one
+        trace with per-worker swimlanes."""
+        events: List[Dict[str, Any]] = []
         pid = os.getpid()
+        worker_pids: Dict[str, int] = {}
         for rec in self.spans():
+            worker = rec.get("worker")
+            if worker is None:
+                ev_pid = pid
+            else:
+                ev_pid = worker_pids.get(worker)
+                if ev_pid is None:
+                    # Deterministic synthetic lane ids, far from real pids.
+                    ev_pid = 1_000_000 + len(worker_pids)
+                    worker_pids[worker] = ev_pid
+            args = {
+                "trace_id": rec["trace_id"],
+                "parent": rec["parent"],
+                **rec["args"],
+            }
+            if worker is not None:
+                args["worker"] = worker
+                if "clock_offset_s" in rec:
+                    args["clock_offset_s"] = rec["clock_offset_s"]
             events.append({
                 "name": rec["name"],
                 "cat": "kueue_tpu",
                 "ph": "X",
                 "ts": round(rec["ts"] * 1e6, 3),
                 "dur": round(rec["dur"] * 1e6, 3),
-                "pid": pid,
+                "pid": ev_pid,
                 "tid": rec["tid"],
-                "args": {
-                    "trace_id": rec["trace_id"],
-                    "parent": rec["parent"],
-                    **rec["args"],
-                },
+                "args": args,
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "client"},
+        }]
+        for worker, wpid in sorted(worker_pids.items(), key=lambda kv: kv[1]):
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": wpid, "tid": 0,
+                "args": {"name": f"worker:{worker}"},
+            })
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def spans_for_trace(self, trace_id: str,
+                        limit: int = 200) -> List[Dict[str, Any]]:
+        """The newest ``limit`` spans recorded under ``trace_id``,
+        oldest first — the worker-side fan-in query."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for rec in reversed(self._buf):
+                if rec.get("trace_id") == trace_id:
+                    out.append(rec)
+                    if len(out) >= limit:
+                        break
+        out.reverse()
+        return out
 
     def phase_breakdown(self) -> Dict[str, float]:
         """Total seconds spent per span name (self-inclusive)."""
@@ -288,6 +332,96 @@ def export_chrome_trace() -> Dict[str, Any]:
 
 def phase_breakdown() -> Dict[str, float]:
     return _tracer.phase_breakdown()
+
+
+# ----------------------------------------------------------------------
+# remote trace fan-in (remote/worker.py response side, remote clients
+# ingest side) — workers already re-enter the caller's trace id; these
+# helpers ship the finished worker spans back in the RPC response so the
+# client's Chrome-trace export renders one merged timeline.
+# ----------------------------------------------------------------------
+
+#: Hard cap on spans shipped per RPC response. The fan-in is best-effort
+#: observability riding on the op response — it must stay far below any
+#: transport deadline/payload concern, so only the newest spans of the
+#: trace travel and everything beyond the cap is dropped silently.
+MAX_REMOTE_SPANS = 200
+
+#: Per-span wire fields. args are stringified and truncated so a caller
+#: storing a large object in span args cannot balloon the response.
+_REMOTE_ARG_MAX = 256
+
+
+def attach_remote_spans(resp: Dict[str, Any], trace_id: Optional[str],
+                        limit: int = MAX_REMOTE_SPANS) -> None:
+    """Worker side: attach this trace's finished spans plus a clock
+    sample to an RPC response (in place, best-effort). No-op when tracing
+    is off or the caller sent no trace id."""
+    if not ENABLED or not trace_id:
+        return
+    tr = _tracer
+    spans = []
+    for rec in tr.spans_for_trace(trace_id, limit=limit):
+        args = {}
+        for k, v in (rec.get("args") or {}).items():
+            if isinstance(v, (int, float, bool)) or v is None:
+                args[k] = v
+            else:
+                args[k] = str(v)[:_REMOTE_ARG_MAX]
+        spans.append({
+            "name": rec["name"],
+            "ts": rec["ts"],
+            "dur": rec["dur"],
+            "tid": rec["tid"],
+            "parent": rec.get("parent"),
+            "args": args,
+        })
+    resp["spans"] = spans
+    # Worker clock sample on the same relative clock as the span ts
+    # values — the client estimates the epoch offset from it.
+    resp["worker_now"] = time.perf_counter() - tr.epoch
+
+
+def ingest_remote_spans(resp: Dict[str, Any], worker: str,
+                        t_send: float, t_recv: float,
+                        trace_id: Optional[str] = None) -> int:
+    """Client side: pop the worker spans off an RPC response and record
+    them into the local tracer on the client's clock.
+
+    Clock-skew estimate (NTP-style midpoint): the worker sampled its
+    clock (``worker_now``) between the client's ``t_send`` and
+    ``t_recv`` (client-epoch-relative perf_counter values); assuming
+    symmetric transport latency the worker sample corresponds to the
+    midpoint, so ``offset = (t_send + t_recv)/2 - worker_now`` maps
+    worker timestamps onto the client timeline. The offset is annotated
+    on every ingested span as ``clock_offset_s``. Returns the number of
+    spans ingested."""
+    spans = resp.pop("spans", None)
+    worker_now = resp.pop("worker_now", None)
+    if not ENABLED or not spans or worker_now is None:
+        return 0
+    offset = (t_send + t_recv) / 2.0 - float(worker_now)
+    tr = _tracer
+    n = 0
+    for s in spans[:MAX_REMOTE_SPANS]:
+        try:
+            tr.record({
+                "name": s["name"],
+                "ts": float(s["ts"]) + offset,
+                "dur": float(s["dur"]),
+                "tid": s.get("tid", 0),
+                "trace_id": trace_id,
+                "parent": s.get("parent"),
+                "args": dict(s.get("args") or {}),
+                "worker": worker,
+                "clock_offset_s": round(offset, 9),
+            })
+            n += 1
+        except (KeyError, TypeError, ValueError):
+            continue  # best-effort: a malformed span is dropped, not fatal
+    if n:
+        inc("remote_spans_ingested_total", {"worker": worker}, value=n)
+    return n
 
 
 # ----------------------------------------------------------------------

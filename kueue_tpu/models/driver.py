@@ -31,6 +31,7 @@ from kueue_tpu.metrics import tracing
 from kueue_tpu.models import batch_scheduler, buckets
 from kueue_tpu.models.arena import CycleArena
 from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.obs import costs
 from kueue_tpu.obs import recorder as flight
 from kueue_tpu.perf import compile_cache
 from kueue_tpu.queue.manager import QueueManager
@@ -347,6 +348,7 @@ class DeviceScheduler:
 
         fault: Optional[Tuple[str, Exception]] = None
         planes = None
+        entry = "cycle_grouped_preempt"
         if idx.workloads:
             t0 = self.clock()
             out = None
@@ -364,6 +366,7 @@ class DeviceScheduler:
                         fair_cycle_preempt_for,
                     )
 
+                    entry = "cycle_fair_preempt"
                     with tracing.span("device/cycle_fair_preempt",
                                       batch=bucket):
                         out = compile_cache.dispatch(
@@ -377,6 +380,7 @@ class DeviceScheduler:
                         and arrays.tas_topo is None and not bool(
                     np.asarray(arrays.tree.has_lend_limit).any()
                 ):
+                    entry = "cycle_fixedpoint"
                     with tracing.span("device/cycle_fixedpoint",
                                       batch=bucket):
                         out = compile_cache.dispatch(
@@ -466,6 +470,15 @@ class DeviceScheduler:
              slot_tas) = planes
             dt = self.clock() - t0
             self.device_time_s += dt
+            if costs.ENABLED:
+                # Attribute the exact wall time booked into
+                # device_time_s, so ledger sums reconcile against the
+                # driver's own totals; W lanes: real heads vs the padded
+                # bucket the executable actually ran.
+                costs.charge(
+                    entry, bucket, dt,
+                    lanes={"W": (len(heads), bucket)},
+                )
             if tracing.ENABLED:
                 tracing.observe("solver_device_seconds", dt,
                                 {"kernel": "batch_cycle"})

@@ -6,8 +6,11 @@
   happened) joined with the what-if forecast (what will happen).
 - ``obs.slo`` — declarative burn-rate SLOs over the metric histograms.
 - ``obs.reasons`` — the outcome-code -> kueue condition reason tables.
+- ``obs.costs`` — device cost attribution per solver entry point and
+  shape bucket, plus the breaker-guarded on-demand profiler.
 """
 
+from kueue_tpu.obs.costs import CostCell, CostLedger
 from kueue_tpu.obs.explain import Explainer
 from kueue_tpu.obs.recorder import CycleRecord, FlightRecorder, HeadAttempt
 from kueue_tpu.obs.slo import (
@@ -18,6 +21,8 @@ from kueue_tpu.obs.slo import (
 )
 
 __all__ = [
+    "CostCell",
+    "CostLedger",
     "CycleRecord",
     "DEFAULT_OBJECTIVES",
     "Explainer",

@@ -1,0 +1,297 @@
+"""Device cost attribution: where do the device milliseconds go?
+
+Always-on-capable, cheap accounting per solver entry point and shape
+bucket. The driver already measures wall time around every device
+dispatch + readback (models/driver.py ``dt``) — this module books those
+numbers into a thread-safe ledger keyed by ``(entry, bucket)`` so an
+operator can answer, per executable shape:
+
+* device wall seconds and dispatch counts (executable occupancy: which
+  bucket rungs actually run, and for how long);
+* padding waste per axis — real heads/W/K lanes vs the padded bucket,
+  as a wasted-lane fraction.
+
+Zero-cost when off: same module-flag idiom as ``utils.faults`` /
+``obs.recorder`` — every call site in the driver / what-if engine is
+guarded by ``if costs.ENABLED`` so the disabled hot path pays one
+module-attribute read and allocates nothing (tests/test_costs.py pins
+the guard discipline by scanning the source).
+
+On-demand profiling: :func:`profile_start` / :func:`profile_stop` wrap
+``jax.profiler`` behind a breaker-style guard (utils/breaker.py) so a
+capture that wedges or raises can never take the admission loop with it
+— after ``_PROFILE_BREAKER.threshold`` consecutive failures the
+endpoints fast-fail until the backoff expires. Profiling is host-gated:
+nothing in the hot path ever touches the profiler; captures start only
+from an explicit operator request (``/profile/start`` on the visibility
+server).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from kueue_tpu.metrics import tracing
+from kueue_tpu.utils.breaker import CircuitBreaker
+
+ENABLED = False
+_ledger: Optional["CostLedger"] = None
+
+
+def enable() -> "CostLedger":
+    """Switch cost accounting on (idempotent); returns the live ledger."""
+    global ENABLED, _ledger
+    if _ledger is None:
+        _ledger = CostLedger()
+    ENABLED = True
+    return _ledger
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def get() -> Optional["CostLedger"]:
+    """The live ledger, or None when accounting is off."""
+    return _ledger if ENABLED else None
+
+
+def charge(entry: str, bucket: int, device_s: float,
+           lanes: Optional[Dict[str, Tuple[int, int]]] = None) -> None:
+    """Module-level charge shim for call sites (driver / what-if):
+    no-ops safely if the flag was flipped without :func:`enable`."""
+    led = get()
+    if led is not None:
+        led.charge(entry, bucket, device_s, lanes=lanes)
+
+
+@dataclass
+class CostCell:
+    """Accumulated cost for one (entry point, bucket rung)."""
+
+    entry: str
+    bucket: int
+    dispatches: int = 0
+    device_seconds: float = 0.0
+    # Padding accounting per axis: axis -> (sum real lanes, sum padded
+    # lanes) across dispatches. waste = 1 - real/padded.
+    lanes: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        waste = {
+            axis: round(1.0 - (real / padded), 6) if padded else 0.0
+            for axis, (real, padded) in sorted(self.lanes.items())
+        }
+        return {
+            "entry": self.entry,
+            "bucket": self.bucket,
+            "dispatches": self.dispatches,
+            "device_seconds": self.device_seconds,
+            "lanes": {a: list(v) for a, v in sorted(self.lanes.items())},
+            "padding_waste": waste,
+        }
+
+
+class CostLedger:
+    """Thread-safe accumulator of :class:`CostCell` per (entry, bucket)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[str, int], CostCell] = {}
+
+    def charge(
+        self,
+        entry: str,
+        bucket: int,
+        device_s: float,
+        lanes: Optional[Dict[str, Tuple[int, int]]] = None,
+    ) -> None:
+        """Book one dispatch: ``device_s`` wall seconds against the
+        ``(entry, bucket)`` cell, plus per-axis (real, padded) lane
+        counts. Call sites pass the same wall time they add to their own
+        timing totals, so attribution sums reconcile against them."""
+        with self._lock:
+            cell = self._cells.get((entry, bucket))
+            if cell is None:
+                cell = self._cells[(entry, bucket)] = CostCell(
+                    entry=entry, bucket=int(bucket)
+                )
+            cell.dispatches += 1
+            cell.device_seconds += device_s
+            for axis, (real, padded) in (lanes or {}).items():
+                r0, p0 = cell.lanes.get(axis, (0, 0))
+                cell.lanes[axis] = (r0 + int(real), p0 + int(padded))
+        if tracing.ENABLED:
+            lab = {"entry": entry, "bucket": str(int(bucket))}
+            tracing.inc("solver_cost_dispatch_total", lab)
+            tracing.inc("solver_cost_device_seconds_total", lab,
+                        value=device_s)
+            for axis, (real, padded) in (lanes or {}).items():
+                if padded:
+                    tracing.set_gauge(
+                        "padding_waste_lane_fraction",
+                        1.0 - (real / padded),
+                        {"entry": entry, "axis": axis},
+                    )
+
+    # -- queries ---------------------------------------------------------
+
+    def cells(self) -> Dict[Tuple[str, int], CostCell]:
+        with self._lock:
+            return dict(self._cells)
+
+    def total_device_seconds(self, entry: Optional[str] = None) -> float:
+        with self._lock:
+            return sum(
+                c.device_seconds for c in self._cells.values()
+                if entry is None or c.entry == entry
+            )
+
+    def total_dispatches(self, entry: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                c.dispatches for c in self._cells.values()
+                if entry is None or c.entry == entry
+            )
+
+    def waste_fraction(self, entry: str, axis: str) -> Optional[float]:
+        """Cumulative wasted-lane fraction for one entry point + axis,
+        aggregated across buckets; None when nothing was booked."""
+        real = padded = 0
+        with self._lock:
+            for c in self._cells.values():
+                if c.entry != entry or axis not in c.lanes:
+                    continue
+                r, p = c.lanes[axis]
+                real += r
+                padded += p
+        if padded == 0:
+            return None
+        return 1.0 - (real / padded)
+
+    def snapshot(self) -> dict:
+        """JSON-ready document: per-cell detail plus entry-level totals
+        (the ``/costs`` endpoint body)."""
+        cells = self.cells()
+        by_entry: Dict[str, dict] = {}
+        for c in cells.values():
+            agg = by_entry.setdefault(c.entry, {
+                "dispatches": 0, "device_seconds": 0.0, "buckets": [],
+            })
+            agg["dispatches"] += c.dispatches
+            agg["device_seconds"] += c.device_seconds
+            agg["buckets"].append(c.bucket)
+        for agg in by_entry.values():
+            agg["buckets"] = sorted(set(agg["buckets"]))
+            agg["device_seconds"] = round(agg["device_seconds"], 6)
+        return {
+            "entries": {k: by_entry[k] for k in sorted(by_entry)},
+            "cells": [
+                cells[k].to_dict() for k in sorted(cells)
+            ],
+            "total_device_seconds": round(
+                sum(c.device_seconds for c in cells.values()), 6
+            ),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+
+# ----------------------------------------------------------------------
+# On-demand jax.profiler capture (host-gated; /profile/start|stop)
+# ----------------------------------------------------------------------
+
+#: 0 = idle, 1 = capturing, 2 = last capture failed, 3 = breaker open.
+PROFILE_IDLE, PROFILE_ACTIVE, PROFILE_FAILED, PROFILE_BROKEN = 0, 1, 2, 3
+
+_profile_lock = threading.Lock()
+_profile_state = PROFILE_IDLE
+_profile_dir: Optional[str] = None
+_profile_started_at: Optional[float] = None
+# Breaker-style guard: a profiler backend that keeps raising (or a capture
+# left dangling by a crash) trips after `threshold` consecutive failures
+# and the endpoints fast-fail during the backoff window instead of
+# re-poking a wedged profiler from the serving thread.
+_PROFILE_BREAKER = CircuitBreaker(threshold=2, backoff_s=30.0,
+                                  max_backoff_s=300.0)
+
+
+def profile_status() -> dict:
+    from kueue_tpu.utils.breaker import OPEN
+
+    with _profile_lock:
+        return {
+            "state": _profile_state,
+            "active": _profile_state == PROFILE_ACTIVE,
+            "dir": _profile_dir,
+            "started_at": _profile_started_at,
+            "breaker_open": _PROFILE_BREAKER.state == OPEN,
+        }
+
+
+def profile_start(log_dir: str) -> dict:
+    """Start a ``jax.profiler`` trace into ``log_dir``. Contained: any
+    profiler failure is recorded against the breaker and reported as an
+    error document — it never propagates into the serving thread."""
+    global _profile_state, _profile_dir, _profile_started_at
+    with _profile_lock:
+        if _profile_state == PROFILE_ACTIVE:
+            return {"ok": False, "error": "capture already active",
+                    "dir": _profile_dir}
+        if not _PROFILE_BREAKER.allow():
+            _profile_state = PROFILE_BROKEN
+            _emit_profile_metric("breaker_open")
+            return {"ok": False, "error": "profiler breaker open "
+                    f"(retry in {_PROFILE_BREAKER.last_backoff_s:.0f}s)"}
+        try:
+            import jax
+
+            jax.profiler.start_trace(log_dir)
+        except Exception as exc:  # noqa: BLE001 - contained by design
+            _PROFILE_BREAKER.record_failure()
+            _profile_state = PROFILE_FAILED
+            _emit_profile_metric("error")
+            return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+        _PROFILE_BREAKER.record_success()
+        _profile_state = PROFILE_ACTIVE
+        _profile_dir = log_dir
+        _profile_started_at = time.time()
+        _emit_profile_metric("start")
+        return {"ok": True, "dir": log_dir}
+
+
+def profile_stop() -> dict:
+    """Stop the active capture; contained like :func:`profile_start`."""
+    global _profile_state, _profile_dir, _profile_started_at
+    with _profile_lock:
+        if _profile_state != PROFILE_ACTIVE:
+            return {"ok": False, "error": "no active capture"}
+        dir_ = _profile_dir
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - contained by design
+            _PROFILE_BREAKER.record_failure()
+            _profile_state = PROFILE_FAILED
+            _profile_dir = None
+            _profile_started_at = None
+            _emit_profile_metric("error")
+            return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+        _profile_state = PROFILE_IDLE
+        _profile_dir = None
+        _profile_started_at = None
+        _emit_profile_metric("stop")
+        return {"ok": True, "dir": dir_}
+
+
+def _emit_profile_metric(event: str) -> None:
+    if tracing.ENABLED:
+        tracing.inc("profile_captures_total", {"event": event})
+        tracing.set_gauge("profile_state", float(_profile_state))

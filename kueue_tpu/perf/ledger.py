@@ -1,0 +1,207 @@
+"""Perf regression ledger: append-only JSONL history of bench probes.
+
+Every ``bench.py --probe ...`` run appends one schema-versioned record
+(probe name, config fingerprint, headline metrics, git rev, jax/jaxlib
+versions, host info) to ``PERF_LEDGER.jsonl`` at the repo root. The
+checker (tools/check_perf_ledger.py) compares the newest record per
+(probe, fingerprint) group against the rolling median of its priors and
+fails on regressions past a threshold — a drift alarm that works from
+plain files, no metrics backend required.
+
+The fingerprint hashes everything that legitimately changes the numbers
+(probe, scale, platform, extra config) so records are only ever compared
+against runs of the same shape; a record from a different machine is
+still the same fingerprint — host drift is part of what the rolling
+median is for (one noisy host won't trip it, a fleet move will).
+
+Writes are best-effort: a read-only checkout or full disk must never
+fail the probe itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform as _platform
+import subprocess
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: Headline metrics per probe: metric name -> direction. "higher" means
+#: bigger is better (throughput); "lower" means smaller is better
+#: (latency). The checker only compares these — the full stats dict is
+#: stored for forensics but not gated on.
+HEADLINE: Dict[str, Dict[str, str]] = {
+    "steady": {
+        "admissions_per_s": "higher",
+        "cycle_p50_ms": "lower",
+        "cycle_p99_ms": "lower",
+    },
+    "sim": {"admissions_per_s": "higher"},
+    "fair": {
+        "admissions_per_s": "higher",
+        "device_wall_s": "lower",
+    },
+    "whatif": {
+        "scenarios_per_s": "higher",
+        "batched_wall_s": "lower",
+    },
+    "incremental": {
+        "encode_ms": "lower",
+        "full_encode_ms": "lower",
+    },
+    "coldstart": {
+        "speedup_x": "higher",
+        "warm_first_admission_s": "lower",
+    },
+}
+
+_REQUIRED_KEYS = (
+    "schema_version", "probe", "fingerprint", "ts", "ok",
+    "headline", "stats",
+)
+
+
+def default_ledger_path() -> Path:
+    """``$KUEUE_TPU_PERF_LEDGER`` or ``PERF_LEDGER.jsonl`` at repo root."""
+    env = os.environ.get("KUEUE_TPU_PERF_LEDGER")
+    if env:
+        return Path(env)
+    return Path(__file__).resolve().parents[2] / "PERF_LEDGER.jsonl"
+
+
+def config_fingerprint(probe: str, scale: float,
+                       platform: Optional[str] = None,
+                       extra: Optional[dict] = None) -> str:
+    """Stable 12-hex digest of the knobs that define a comparable run."""
+    doc = {
+        "probe": probe,
+        "scale": scale,
+        "platform": platform or "",
+        "extra": extra or {},
+    }
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def headline_metrics(probe: str, stats: dict) -> Dict[str, dict]:
+    """Extract {name: {"value", "direction"}} for the probe's headline
+    set; metrics absent from (or null in) the stats are skipped."""
+    out: Dict[str, dict] = {}
+    for name, direction in HEADLINE.get(probe, {}).items():
+        v = stats.get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[name] = {"value": float(v), "direction": direction}
+    return out
+
+
+def _git_rev() -> Optional[str]:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).resolve().parents[2],
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip() or None
+    except Exception:  # noqa: BLE001 - no git, no rev
+        return None
+
+
+def _dist_version(name: str) -> Optional[str]:
+    try:
+        from importlib.metadata import version
+
+        return version(name)
+    except Exception:  # noqa: BLE001 - not installed
+        return None
+
+
+def make_record(probe: str, stats: dict, scale: float = 1.0,
+                platform: Optional[str] = None,
+                extra_config: Optional[dict] = None) -> dict:
+    """Build one ledger record from a probe's final stats dict."""
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "probe": probe,
+        "fingerprint": config_fingerprint(
+            probe, scale, platform=platform, extra=extra_config
+        ),
+        "ts": time.time(),
+        "ok": bool(stats.get("ok")),
+        "headline": headline_metrics(probe, stats),
+        "stats": stats,
+        "config": {
+            "scale": scale,
+            "platform": platform,
+            "extra": extra_config or {},
+        },
+        "env": {
+            "git_rev": _git_rev(),
+            "jax": _dist_version("jax"),
+            "jaxlib": _dist_version("jaxlib"),
+            "python": _platform.python_version(),
+            "host": _platform.node(),
+            "machine": _platform.machine(),
+            "cpus": os.cpu_count(),
+        },
+    }
+
+
+def validate_record(rec: dict) -> List[str]:
+    """Schema check; returns a list of problems (empty == valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    for k in _REQUIRED_KEYS:
+        if k not in rec:
+            errs.append(f"missing key {k!r}")
+    if rec.get("schema_version") not in (SCHEMA_VERSION,):
+        errs.append(
+            f"unknown schema_version {rec.get('schema_version')!r}"
+        )
+    if not isinstance(rec.get("headline", {}), dict):
+        errs.append("headline is not an object")
+    else:
+        for name, h in rec.get("headline", {}).items():
+            if not isinstance(h, dict) or "value" not in h \
+                    or h.get("direction") not in ("higher", "lower"):
+                errs.append(f"malformed headline entry {name!r}")
+    if not isinstance(rec.get("stats", {}), dict):
+        errs.append("stats is not an object")
+    return errs
+
+
+def append_record(rec: dict, path: Optional[Path] = None) -> bool:
+    """Append one JSON line; best-effort (False on any I/O failure)."""
+    p = Path(path) if path is not None else default_ledger_path()
+    try:
+        line = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        with open(p, "a") as f:
+            f.write(line + "\n")
+        return True
+    except Exception:  # noqa: BLE001 - ledger must never fail the probe
+        return False
+
+
+def load_records(path: Optional[Path] = None) -> List[dict]:
+    """All parseable records in file order; malformed lines skipped."""
+    p = Path(path) if path is not None else default_ledger_path()
+    out: List[dict] = []
+    try:
+        text = p.read_text()
+    except OSError:
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out

@@ -140,6 +140,10 @@ class RemoteWorkerClient:
                     faults.fire(faults.REMOTE_TRANSPORT)
                 if self._file is None:
                     self._connect()
+                t_send = (
+                    time.perf_counter() - tracing.get_tracer().epoch
+                    if tracing.ENABLED else 0.0
+                )
                 self._file.write(json.dumps(req).encode() + b"\n")
                 self._file.flush()
                 line = self._file.readline()
@@ -150,6 +154,19 @@ class RemoteWorkerClient:
                 # even if the op itself errors (RuntimeError below is an
                 # application failure, not a reachability one).
                 self.breaker.record_success()
+                if tracing.ENABLED and isinstance(resp, dict):
+                    # Merge the worker's finished spans into this trace
+                    # (best-effort; the response stays clean either way).
+                    try:
+                        tracing.ingest_remote_spans(
+                            resp, worker=self.socket_path,
+                            t_send=t_send,
+                            t_recv=(time.perf_counter()
+                                    - tracing.get_tracer().epoch),
+                            trace_id=req.get("trace"),
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
                 if not resp.get("ok"):
                     raise RuntimeError(resp.get("error", "remote error"))
                 return resp

@@ -212,6 +212,10 @@ class GrpcWorkerClient:
                     faults.fire(faults.REMOTE_TRANSPORT)
                 if self._call_fn is None:
                     self._connect()
+                t_send = (
+                    time.perf_counter() - tracing.get_tracer().epoch
+                    if tracing.ENABLED else 0.0
+                )
                 raw = self._call_fn(
                     json.dumps(req).encode(),
                     timeout=timeout or self.op_timeout,
@@ -220,6 +224,19 @@ class GrpcWorkerClient:
                 # See RemoteWorkerClient: a completed round-trip is a
                 # transport success even when the op itself failed.
                 self.breaker.record_success()
+                if tracing.ENABLED and isinstance(resp, dict):
+                    # Merge the worker's finished spans into this trace
+                    # (best-effort; the response stays clean either way).
+                    try:
+                        tracing.ingest_remote_spans(
+                            resp, worker=self.address,
+                            t_send=t_send,
+                            t_recv=(time.perf_counter()
+                                    - tracing.get_tracer().epoch),
+                            trace_id=req.get("trace"),
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
                 if not resp.get("ok"):
                     raise RuntimeError(resp.get("error", "remote error"))
                 return resp

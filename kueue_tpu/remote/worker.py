@@ -60,9 +60,21 @@ def dispatch(mgr: Manager, req: dict) -> dict:
         faults.fire(faults.REMOTE_DISPATCH)
     if not tracing.ENABLED:
         return _dispatch_impl(mgr, req)
-    with tracing.trace_context(caller_trace or tracing.current_trace_id()):
+    trace_id = caller_trace or tracing.current_trace_id()
+    with tracing.trace_context(trace_id):
         with tracing.span("remote/dispatch", op=req.get("op")):
-            return _dispatch_impl(mgr, req)
+            resp = _dispatch_impl(mgr, req)
+    # Trace fan-in: ship this trace's finished worker spans back in the
+    # response (bounded, best-effort) so the client's Chrome export
+    # renders one merged client+worker timeline. Collected AFTER the
+    # dispatch span closed so the span covering this very call travels
+    # too. Never fails the op.
+    if caller_trace and isinstance(resp, dict):
+        try:
+            tracing.attach_remote_spans(resp, caller_trace)
+        except Exception:  # noqa: BLE001 - observability must not break ops
+            pass
+    return resp
 
 
 def _dispatch_impl(mgr: Manager, req: dict) -> dict:

@@ -394,11 +394,16 @@ def serve_dashboard(manager, host: str = "127.0.0.1", port: int = 8081,
             if self.path == "/api/state":
                 body = json.dumps(shared_state_doc(manager)[0]).encode()
                 ctype = "application/json"
-            elif self.path in ("/api/metrics", "/metrics"):
-                # /metrics is the conventional Prometheus scrape path;
-                # /api/metrics is kept for existing pollers.
+            elif self.path == "/metrics":
+                # Conventional Prometheus scrape path: text exposition
+                # format with # HELP/# TYPE lines.
                 body = manager.metrics.expose().encode()
                 ctype = "text/plain; version=0.0.4"
+            elif self.path == "/api/metrics":
+                # JSON mirror of the same registry for the dashboard's
+                # own pollers (strict-JSON: +Inf quantiles become null).
+                body = json.dumps(manager.metrics.to_doc()).encode()
+                ctype = "application/json"
             elif self.path == "/trace":
                 from kueue_tpu.metrics import tracing
 

@@ -50,11 +50,50 @@ class VisibilityServer:
     ``/slo``."""
 
     def __init__(self, queues: QueueManager, whatif=None,
-                 explainer=None, slo=None) -> None:
+                 explainer=None, slo=None, metrics=None) -> None:
         self.queues = queues
         self.whatif = whatif
         self.explainer = explainer
         self.slo = slo
+        # Optional Metrics registry: when attached, /metrics serves the
+        # Prometheus text exposition and /metrics.json the JSON mirror.
+        self.metrics = metrics
+
+    # -- cost attribution + profiling (docs/observability.md) -----------
+
+    def costs_doc(self) -> Dict:
+        from kueue_tpu.obs import costs
+
+        led = costs.get()
+        if led is None:
+            return {"error": "cost accounting not enabled"}
+        doc = led.snapshot()
+        doc["profile"] = costs.profile_status()
+        return doc
+
+    def profile_start(self, log_dir: Optional[str] = None) -> Dict:
+        from kueue_tpu.obs import costs
+
+        if not log_dir:
+            import tempfile
+
+            log_dir = tempfile.mkdtemp(prefix="kueue-tpu-profile-")
+        return costs.profile_start(log_dir)
+
+    def profile_stop(self) -> Dict:
+        from kueue_tpu.obs import costs
+
+        return costs.profile_stop()
+
+    def metrics_text(self) -> str:
+        if self.metrics is None:
+            raise KeyError("metrics registry not attached")
+        return self.metrics.expose()
+
+    def metrics_doc(self) -> Dict:
+        if self.metrics is None:
+            return {"error": "metrics registry not attached"}
+        return self.metrics.to_doc()
 
     # -- observability (docs/observability.md) --------------------------
 
@@ -215,8 +254,13 @@ class VisibilityServer:
         GET  /whatif/eta[?cluster_queue=<name>]
         GET  /explain/<workload>[?forecast=0&preview=1]
         GET  /slo
+        GET  /costs
+        GET  /metrics          (Prometheus text exposition)
+        GET  /metrics.json     (same registry, JSON document)
         POST /whatif/eta      {"clusterQueue"?: ..., "scenarios": [...]}
-        POST /whatif/preview  {workload spec, see whatif_preview}.
+        POST /whatif/preview  {workload spec, see whatif_preview}
+        POST /profile/start   {"logDir"?: ...}   (also GET, operator cURL)
+        POST /profile/stop                        (also GET).
 
         Malformed requests (bad JSON, wrong field types, missing keys)
         return structured 400 JSON ``{"error": "bad request", ...}``;
@@ -241,6 +285,13 @@ class VisibilityServer:
                 if n <= 0:
                     return {}
                 return json.loads(self.rfile.read(n) or b"{}")
+
+            def _send_text(self, body, ctype, code=200):
+                body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(body)
 
             def _guarded(self, fn):
                 """Run one route body; malformed input (the int()/[] /
@@ -305,6 +356,42 @@ class VisibilityServer:
                     self._guarded(lambda: self._send_json(
                         server_self.slo_doc()
                     ))
+                elif parts == ["costs"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.costs_doc()
+                    ))
+                elif parts == ["metrics"]:
+                    if server_self.metrics is None:
+                        self._send_json({
+                            "error": "metrics registry not attached",
+                        }, 404)
+                    else:
+                        # Prometheus text exposition format 0.0.4.
+                        self._guarded(lambda: self._send_text(
+                            server_self.metrics_text(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        ))
+                elif parts == ["metrics.json"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.metrics_doc()
+                    ))
+                elif parts == ["profile", "start"]:
+                    q = parse_qs(url.query)
+                    log_dir = (q.get("log_dir") or [None])[0]
+                    self._guarded(lambda: self._send_json(
+                        server_self.profile_start(log_dir)
+                    ))
+                elif parts == ["profile", "stop"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.profile_stop()
+                    ))
+                elif parts == ["profile", "status"]:
+                    def _status():
+                        from kueue_tpu.obs import costs
+
+                        self._send_json(costs.profile_status())
+
+                    self._guarded(_status)
                 else:
                     self._send_json({
                         "error": "not found", "path": url.path,
@@ -333,6 +420,14 @@ class VisibilityServer:
                 elif parts == ["whatif", "preview"]:
                     self._guarded(lambda: self._send_json(
                         server_self.whatif_preview(payload)
+                    ))
+                elif parts == ["profile", "start"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.profile_start(payload.get("logDir"))
+                    ))
+                elif parts == ["profile", "stop"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.profile_stop()
                     ))
                 else:
                     self._send_json({

@@ -39,6 +39,7 @@ import numpy as np
 from kueue_tpu.api.types import Workload
 from kueue_tpu.core.workload_info import WorkloadInfo
 from kueue_tpu.metrics import tracing
+from kueue_tpu.obs import costs
 from kueue_tpu.utils import faults
 from kueue_tpu.utils.breaker import CircuitBreaker
 
@@ -578,8 +579,14 @@ class WhatIfEngine:
 
         base_nom = np.array(arrays.tree.nominal)
         K = len(scens)
-        nominal = np.broadcast_to(base_nom, (K,) + base_nom.shape).copy()
-        active = np.broadcast_to(base_active, (K, w_n)).copy()
+        # K-lane padding: bucket the scenario axis on the pow2 ladder so
+        # nearby scenario counts share one compiled rollout instead of
+        # recompiling per K. Pad lanes replay the base world (base
+        # nominal + base active); vmap lanes are independent, so they
+        # cannot perturb the real lanes, and decode reads only [:K].
+        k_pad = _pow2(K, floor=1)
+        nominal = np.broadcast_to(base_nom, (k_pad,) + base_nom.shape).copy()
+        active = np.broadcast_to(base_active, (k_pad, w_n)).copy()
         scen_ok = [True] * K
         scen_reason = [""] * K
         for k, s in enumerate(scens):
@@ -630,6 +637,7 @@ class WhatIfEngine:
         arrays_d, ga_d = jax.device_put((arrays, idx.group_arrays))
         from kueue_tpu.perf import compile_cache
 
+        t_disp = self._clock()
         out = compile_cache.dispatch(
             "whatif_rollout", fn,
             arrays_d, ga_d, jnp.asarray(runtime), init, scen_t,
@@ -641,6 +649,26 @@ class WhatIfEngine:
         chosen = np.asarray(out.chosen_flavor)
         rounds = np.asarray(out.rounds)
         vclock = np.asarray(out.final_vclock)
+        disp_s = self._clock() - t_disp
+        # Honest padding gauges for the batched rollout (the PR 2 driver
+        # idiom, extended to the scenario planes): real vs padded lanes
+        # on both the K (scenario) and W (workload-row) axes.
+        w_real = p_dev + len(modeled)
+        if tracing.ENABLED:
+            tracing.set_gauge(
+                "padding_waste_lane_fraction", 1.0 - (K / k_pad),
+                {"entry": "whatif_rollout", "axis": "K"},
+            )
+            tracing.set_gauge(
+                "padding_waste_lane_fraction",
+                1.0 - (w_real / w_n) if w_n else 0.0,
+                {"entry": "whatif_rollout", "axis": "W"},
+            )
+        if costs.ENABLED:
+            costs.charge(
+                "whatif_rollout", w_n, disp_s,
+                lanes={"K": (K, k_pad), "W": (w_real, w_n)},
+            )
 
         # Decode. Per-scenario aggregates are vector math over the [K, W]
         # planes; the per-workload forecast list (10k dataclass rows at

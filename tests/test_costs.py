@@ -1,0 +1,411 @@
+"""Device cost attribution + on-demand profiling (obs/costs.py) and the
+metrics exposition pair (docs/observability.md).
+
+Claim families:
+
+1. **Zero-cost when off**: a fresh process has ``costs.ENABLED is
+   False``; every ``costs.<fn>(...)`` call site in the driver and the
+   what-if engine sits under an ``if costs.ENABLED`` guard (source scan,
+   same discipline as the faults / flight-recorder pins).
+2. **Attribution reconciles**: on a live device-scheduler run the
+   ledger's total device seconds account for >= 95% of the driver's own
+   ``device_time_s`` (by construction both book the same ``dt``), and
+   the padding-waste fractions match hand-computed values for a known
+   bucket.
+3. **Profiling is contained**: a profiler backend that raises is
+   reported as an error document, trips the breaker after two
+   consecutive failures, and never propagates.
+4. **Exposition pair**: ``/metrics`` serves Prometheus text (correct
+   Content-Type, # HELP/# TYPE from the names allowlist) and
+   ``/metrics.json`` / dashboard ``/api/metrics`` the JSON mirror;
+   ``/costs`` and ``/profile/*`` ride the same visibility server.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.types import (
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    quota,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import tracing
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.obs import costs
+from kueue_tpu.utils.breaker import CircuitBreaker
+from kueue_tpu.visibility.server import VisibilityServer
+
+from .helpers import make_cq, make_wl
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(autouse=True)
+def _restore_costs_state():
+    prev = costs.ENABLED
+    yield
+    costs.ENABLED = prev
+    if costs._ledger is not None:
+        costs._ledger.clear()
+    # Reset the profiler guard so one test's tripped breaker or dangling
+    # state never leaks into the next.
+    costs._profile_state = costs.PROFILE_IDLE
+    costs._profile_dir = None
+    costs._profile_started_at = None
+    costs._PROFILE_BREAKER = CircuitBreaker(threshold=2, backoff_s=30.0,
+                                            max_backoff_s=300.0)
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost discipline
+
+
+def test_costs_disabled_by_default_fresh_process():
+    code = (
+        "import kueue_tpu.obs.costs as c\n"
+        "assert c.ENABLED is False\n"
+        "assert c.get() is None\n"
+        "c.ENABLED = True\n"
+        "c.charge('x', 8, 0.1)\n"  # flag without enable(): safe no-op
+        "assert c.get() is None or c.get().total_dispatches() == 0\n"
+    )
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, cwd=REPO)
+    assert res.returncode == 0, res.stderr
+
+
+def test_costs_call_sites_guarded():
+    """Every ``costs.<fn>(...)`` call in the hot-path modules sits under
+    a lower-indented ``if costs.ENABLED`` within 40 lines (the
+    flight-recorder guard-scan idiom)."""
+    hot_paths = [
+        os.path.join(REPO, "kueue_tpu", "models", "driver.py"),
+        os.path.join(REPO, "kueue_tpu", "whatif", "engine.py"),
+    ]
+    call_sites = 0
+    offenders = []
+    for path in hot_paths:
+        lines = open(path).read().splitlines()
+        for i, line in enumerate(lines):
+            if not re.search(r"costs\.\w+\(", line):
+                continue
+            call_sites += 1
+            indent = len(line) - len(line.lstrip())
+            guarded = False
+            for j in range(i - 1, max(-1, i - 40), -1):
+                prev = lines[j]
+                if not prev.strip():
+                    continue
+                p_ind = len(prev) - len(prev.lstrip())
+                if p_ind < indent:
+                    if "if costs.ENABLED" in prev:
+                        guarded = True
+                    break
+            if not guarded:
+                offenders.append(
+                    f"{os.path.basename(path)}:{i + 1}: {line.strip()}"
+                )
+    assert call_sites >= 2, "expected charge sites in driver + whatif"
+    assert not offenders, "\n".join(offenders)
+
+
+# ---------------------------------------------------------------------------
+# Ledger mechanics (no device required)
+
+
+def test_ledger_accumulates_and_computes_waste():
+    led = costs.CostLedger()
+    led.charge("cycle_fixedpoint", 16, 0.010, lanes={"W": (2, 16)})
+    led.charge("cycle_fixedpoint", 16, 0.020, lanes={"W": (6, 16)})
+    led.charge("cycle_fixedpoint", 32, 0.030, lanes={"W": (20, 32)})
+    led.charge("whatif_rollout", 16, 0.005,
+               lanes={"K": (3, 4), "W": (4, 16)})
+
+    cell = led.cells()[("cycle_fixedpoint", 16)]
+    assert cell.dispatches == 2
+    assert cell.device_seconds == pytest.approx(0.030)
+    assert cell.lanes["W"] == (8, 32)
+    assert cell.to_dict()["padding_waste"]["W"] == pytest.approx(0.75)
+
+    # waste_fraction aggregates across buckets of one entry point.
+    assert led.waste_fraction("cycle_fixedpoint", "W") == pytest.approx(
+        1.0 - (8 + 20) / (32 + 32)
+    )
+    assert led.waste_fraction("whatif_rollout", "K") == pytest.approx(0.25)
+    assert led.waste_fraction("cycle_fixedpoint", "K") is None
+    assert led.waste_fraction("nope", "W") is None
+
+    assert led.total_device_seconds() == pytest.approx(0.065)
+    assert led.total_device_seconds("whatif_rollout") == pytest.approx(0.005)
+    assert led.total_dispatches() == 4
+
+    doc = led.snapshot()
+    json.dumps(doc)  # JSON-ready
+    assert set(doc["entries"]) == {"cycle_fixedpoint", "whatif_rollout"}
+    assert doc["entries"]["cycle_fixedpoint"]["buckets"] == [16, 32]
+    assert doc["total_device_seconds"] == pytest.approx(0.065)
+
+    led.clear()
+    assert led.cells() == {}
+    assert led.total_device_seconds() == 0.0
+
+
+def test_charge_emits_cost_series_when_tracing_on():
+    m = Metrics()
+    tracing.enable(m)
+    try:
+        led = costs.CostLedger()
+        led.charge("cycle_fixedpoint", 16, 0.010, lanes={"W": (2, 16)})
+    finally:
+        tracing.disable()
+    key = (("bucket", "16"), ("entry", "cycle_fixedpoint"))
+    assert m.counters["solver_cost_dispatch_total"][key] == 1.0
+    assert m.counters["solver_cost_device_seconds_total"][key] == \
+        pytest.approx(0.010)
+    gkey = (("axis", "W"), ("entry", "cycle_fixedpoint"))
+    assert m.gauges["padding_waste_lane_fraction"][gkey] == \
+        pytest.approx(1.0 - 2 / 16)
+
+
+# ---------------------------------------------------------------------------
+# Device end-to-end: attribution reconciles with the driver's totals
+
+
+def test_device_run_attribution_covers_device_time():
+    """>= 95% of the driver's measured dispatch wall time must be
+    attributed (acceptance bar; by construction both sides book the same
+    dt, so the ledger total tracks device_time_s exactly), and the first
+    cycle's W-lane waste matches the hand-computed bucket fraction."""
+    led = costs.enable()
+    led.clear()
+    mgr = Manager(use_device_scheduler=True)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="co"),
+        make_cq("cq-a", cohort="co",
+                flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.create_workload(make_wl("a", cpu_m=1_000, creation_time=1.0))
+    mgr.create_workload(make_wl("b", cpu_m=1_000, creation_time=2.0))
+    mgr.scheduler.schedule()
+
+    dev = mgr.scheduler.device_time_s
+    assert dev > 0, "device cycle did not dispatch"
+    total = led.total_device_seconds()
+    assert total >= 0.95 * dev
+    assert total == pytest.approx(dev)
+
+    # One cycle, one CQ head, floor-16 bucket: hand-computed W waste.
+    cells = list(led.cells().values())
+    assert len(cells) == 1
+    cell = cells[0]
+    assert cell.entry in ("cycle_grouped_preempt", "cycle_fixedpoint",
+                          "cycle_fair_preempt")
+    assert cell.bucket == 16
+    assert cell.dispatches == 1
+    assert cell.lanes["W"] == (1, 16)
+    assert led.waste_fraction(cell.entry, "W") == pytest.approx(1 - 1 / 16)
+
+    # More cycles keep reconciling (cumulative, multiple dispatches).
+    mgr.create_workload(make_wl("c", cpu_m=1_000, creation_time=3.0))
+    mgr.scheduler.schedule()
+    assert led.total_device_seconds() == pytest.approx(
+        mgr.scheduler.device_time_s
+    )
+    costs.disable()
+
+
+# ---------------------------------------------------------------------------
+# Profiling containment
+
+
+class _BoomProfiler:
+    def start_trace(self, log_dir):
+        raise RuntimeError("profiler backend wedged")
+
+    def stop_trace(self):
+        raise RuntimeError("profiler backend wedged")
+
+
+class _OkProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, log_dir):
+        self.calls.append(("start", log_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+def test_profile_failure_is_contained_and_trips_breaker(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _BoomProfiler())
+    r1 = costs.profile_start("/tmp/nope")
+    assert r1["ok"] is False and "wedged" in r1["error"]
+    assert costs.profile_status()["state"] == costs.PROFILE_FAILED
+    assert costs.profile_status()["breaker_open"] is False
+
+    r2 = costs.profile_start("/tmp/nope")
+    assert r2["ok"] is False
+    # Two consecutive failures: breaker open, further starts fast-fail
+    # WITHOUT touching the profiler backend again.
+    monkeypatch.setattr(jax, "profiler", None)  # would AttributeError
+    r3 = costs.profile_start("/tmp/nope")
+    assert r3["ok"] is False and "breaker open" in r3["error"]
+    assert costs.profile_status()["breaker_open"] is True
+    assert costs.profile_status()["state"] == costs.PROFILE_BROKEN
+
+
+def test_profile_start_stop_lifecycle(monkeypatch, tmp_path):
+    import jax
+
+    fake = _OkProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    assert costs.profile_stop() == {"ok": False,
+                                    "error": "no active capture"}
+    r = costs.profile_start(str(tmp_path))
+    assert r["ok"] is True and r["dir"] == str(tmp_path)
+    st = costs.profile_status()
+    assert st["active"] is True and st["dir"] == str(tmp_path)
+    # A second start while active refuses instead of nesting captures.
+    again = costs.profile_start(str(tmp_path))
+    assert again["ok"] is False and "already active" in again["error"]
+    r = costs.profile_stop()
+    assert r["ok"] is True and r["dir"] == str(tmp_path)
+    assert costs.profile_status()["active"] is False
+    assert fake.calls == [("start", str(tmp_path)), ("stop",)]
+
+
+# ---------------------------------------------------------------------------
+# HTTP: /metrics (Prometheus) + /metrics.json + /costs + /profile/*
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+def test_visibility_server_metrics_costs_profile_endpoints():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.create_workload(make_wl("w0", cpu_m=1_000, creation_time=1.0))
+    mgr.schedule_all()
+    server = VisibilityServer(mgr.queues, metrics=mgr.metrics)
+    httpd = server.serve(port=0)
+    port = httpd.server_address[1]
+    try:
+        # Prometheus text exposition: content type + HELP/TYPE lines
+        # sourced from the names allowlist.
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        text = body.decode()
+        assert "# HELP kueue_admitted_workloads_total " in text
+        assert "# TYPE kueue_admitted_workloads_total counter" in text
+        assert "kueue_admitted_workloads_total" in text
+
+        # JSON mirror of the same registry.
+        status, ctype, body = _get(port, "/metrics.json")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert "counters" in doc and "histograms" in doc
+        assert any(e["value"] >= 1 for e in
+                   doc["counters"]["admitted_workloads_total"])
+
+        # /costs: disabled -> error doc; enabled -> snapshot + profile.
+        _status, _ctype, body = _get(port, "/costs")
+        assert json.loads(body) == {"error": "cost accounting not enabled"}
+        led = costs.enable()
+        led.clear()
+        led.charge("cycle_fixedpoint", 16, 0.010, lanes={"W": (2, 16)})
+        _status, _ctype, body = _get(port, "/costs")
+        doc = json.loads(body)
+        assert doc["entries"]["cycle_fixedpoint"]["dispatches"] == 1
+        assert doc["profile"]["state"] == costs.PROFILE_IDLE
+
+        status, _ctype, body = _get(port, "/profile/status")
+        assert status == 200 and json.loads(body)["active"] is False
+
+        # POST /profile/stop with no capture: contained error doc.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile/stop", data=b"{}",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["ok"] is False
+    finally:
+        httpd.shutdown()
+
+
+def test_visibility_server_without_metrics_404s():
+    mgr = Manager()
+    server = VisibilityServer(mgr.queues)
+    httpd = server.serve(port=0)
+    port = httpd.server_address[1]
+    try:
+        try:
+            _get(port, "/metrics")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+            assert json.loads(exc.read())["error"] == \
+                "metrics registry not attached"
+    finally:
+        httpd.shutdown()
+
+
+def test_dashboard_serves_prometheus_and_json():
+    """The kueueviz dashboard pair: /metrics stays Prometheus text,
+    /api/metrics is the JSON document."""
+    from kueue_tpu.visibility.dashboard import serve_dashboard
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(4_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    mgr.create_workload(make_wl("w0", cpu_m=1_000, creation_time=1.0))
+    mgr.schedule_all()
+    httpd = serve_dashboard(mgr, port=0)
+    port = httpd.server_address[1]
+    try:
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert b"# HELP kueue_" in body and b"# TYPE kueue_" in body
+
+        status, ctype, body = _get(port, "/api/metrics")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert "counters" in doc
+    finally:
+        httpd.shutdown()
+
+
+def test_to_doc_is_strict_json_with_inf_quantiles():
+    m = Metrics()
+    m.observe("admission_attempt_duration_seconds", 10_000.0)
+    doc = m.to_doc()
+    h = doc["histograms"]["admission_attempt_duration_seconds"][0]
+    assert h["count"] == 1
+    assert h["p99"] is None  # +Inf off-the-scale -> null, not Infinity
+    json.dumps(doc, allow_nan=False)

@@ -229,7 +229,11 @@ def test_chrome_trace_export_shape():
             pass
     doc = tracing.export_chrome_trace()
     assert doc["displayTimeUnit"] == "ms"
-    events = doc["traceEvents"]
+    # Span events, plus the process_name metadata row naming the client
+    # lane (the merged-timeline export labels every process).
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert [m["args"]["name"] for m in meta] == ["client"]
+    events = [e for e in doc["traceEvents"] if e["ph"] != "M"]
     assert {e["name"] for e in events} == {"cycle", "stage"}
     for e in events:
         assert e["ph"] == "X"
@@ -560,7 +564,7 @@ def test_acceptance_traced_harness_run():
     # (a) Chrome trace: loadable JSON, nested span tree, remote span.
     doc = result.trace
     json.loads(json.dumps(doc))
-    events = doc["traceEvents"]
+    events = [e for e in doc["traceEvents"] if e["ph"] != "M"]
     names = {e["name"] for e in events}
     assert {"scheduler/cycle", "scheduler/flavor_assignment",
             "scheduler/preemption_search", "scheduler/tas_placement",

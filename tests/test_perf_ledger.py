@@ -1,0 +1,261 @@
+"""Perf regression ledger (kueue_tpu/perf/ledger.py) and its gate
+(tools/check_perf_ledger.py).
+
+Claim families:
+
+1. **Schema**: make_record produces a validate_record-clean document;
+   the validator names every defect (missing keys, alien schema
+   version, malformed headline entries).
+2. **Gate policy**: first record of a (probe, fingerprint) group seeds
+   the baseline; a newest record worse than the rolling median of its
+   priors by more than the threshold fails — in the worse DIRECTION
+   only (throughput down, latency up); improvements and small noise
+   pass; ok=false and schema-invalid records fail; the window bounds
+   how far back the median reaches.
+3. **Probe contract** (satellite b): a real ``bench.py --probe steady``
+   run prints exactly ONE stdout line (the final JSON), honors
+   ``--out``, and appends one valid ledger record; a synthetic 50%
+   regression appended to that ledger flips the gate to exit 1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu.perf import ledger
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+import check_perf_ledger  # noqa: E402
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _stats(admissions=100.0, p50=5.0, p99=20.0, ok=True):
+    return {
+        "probe": "steady",
+        "ok": ok,
+        "admissions_per_s": admissions,
+        "cycle_p50_ms": p50,
+        "cycle_p99_ms": p99,
+        "healthy": True,
+    }
+
+
+def _rec(**kw):
+    return ledger.make_record("steady", _stats(**kw), scale=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Schema
+
+
+def test_make_record_is_schema_valid():
+    rec = _rec()
+    assert ledger.validate_record(rec) == []
+    assert rec["schema_version"] == ledger.SCHEMA_VERSION
+    assert rec["probe"] == "steady"
+    assert len(rec["fingerprint"]) == 12
+    assert rec["ok"] is True
+    hl = rec["headline"]
+    assert hl["admissions_per_s"] == {"value": 100.0,
+                                      "direction": "higher"}
+    assert hl["cycle_p99_ms"] == {"value": 20.0, "direction": "lower"}
+    assert rec["config"]["scale"] == 0.05
+    assert rec["env"]["python"]
+    json.dumps(rec)  # one JSONL line's worth
+
+
+def test_validate_record_names_defects():
+    assert ledger.validate_record("nope") == ["record is not an object"]
+    rec = _rec()
+    del rec["fingerprint"]
+    rec["schema_version"] = 99
+    rec["headline"]["admissions_per_s"] = {"value": 1.0,
+                                           "direction": "sideways"}
+    errs = ledger.validate_record(rec)
+    assert any("fingerprint" in e for e in errs)
+    assert any("schema_version" in e for e in errs)
+    assert any("admissions_per_s" in e for e in errs)
+
+
+def test_headline_metrics_skips_absent_and_non_numeric():
+    hl = ledger.headline_metrics("steady", {
+        "admissions_per_s": 50.0,
+        "cycle_p50_ms": None,       # probe couldn't measure: skipped
+        "healthy": True,            # bool is not a metric
+    })
+    assert set(hl) == {"admissions_per_s"}
+    assert ledger.headline_metrics("unknown-probe", {"x": 1.0}) == {}
+
+
+def test_fingerprint_tracks_comparable_config():
+    a = ledger.config_fingerprint("steady", 0.05)
+    assert a == ledger.config_fingerprint("steady", 0.05)
+    assert a != ledger.config_fingerprint("steady", 1.0)
+    assert a != ledger.config_fingerprint("sim", 0.05)
+    assert a != ledger.config_fingerprint("steady", 0.05, platform="cpu")
+
+
+def test_append_and_load_skip_malformed_lines(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    r1, r2 = _rec(), _rec(admissions=110.0)
+    assert ledger.append_record(r1, p)
+    p.open("a").write("{not json\n\n")
+    assert ledger.append_record(r2, p)
+    recs = ledger.load_records(p)
+    assert [r["headline"]["admissions_per_s"]["value"] for r in recs] \
+        == [100.0, 110.0]
+    assert ledger.load_records(tmp_path / "missing.jsonl") == []
+
+
+def test_append_is_best_effort(tmp_path):
+    assert ledger.append_record(_rec(), tmp_path) is False  # a directory
+
+
+# ---------------------------------------------------------------------------
+# Gate policy
+
+
+def test_gate_empty_and_baseline_pass():
+    assert check_perf_ledger.check_ledger([]) == ([], [])
+    problems, notes = check_perf_ledger.check_ledger([_rec()])
+    assert problems == []
+    assert any("no history yet" in n for n in notes)
+
+
+def test_gate_fails_on_synthetic_50pct_regression():
+    records = [_rec(), _rec(), _rec()]
+    records.append(_rec(admissions=50.0))  # throughput halved
+    problems, _ = check_perf_ledger.check_ledger(records, threshold=0.2)
+    assert len(problems) == 1
+    assert "admissions_per_s" in problems[0]
+    assert "50.0% worse" in problems[0]
+
+
+def test_gate_fails_on_latency_regression_direction():
+    records = [_rec(), _rec(), _rec(p99=20.0)]
+    records.append(_rec(p99=30.0))  # p99 up 50% — lower-is-better
+    problems, _ = check_perf_ledger.check_ledger(records, threshold=0.2)
+    assert len(problems) == 1 and "cycle_p99_ms" in problems[0]
+
+
+def test_gate_passes_improvements_and_noise():
+    records = [_rec(), _rec(), _rec()]
+    # Throughput UP 50%, latency DOWN 50%: better in both directions.
+    records.append(_rec(admissions=150.0, p50=2.5, p99=10.0))
+    problems, notes = check_perf_ledger.check_ledger(records,
+                                                     threshold=0.2)
+    assert problems == []
+    # 10% worse-direction drift stays under the 20% threshold.
+    records[-1] = _rec(admissions=90.0)
+    problems, _ = check_perf_ledger.check_ledger(records, threshold=0.2)
+    assert problems == []
+
+
+def test_gate_fails_on_not_ok_and_invalid_records():
+    problems, _ = check_perf_ledger.check_ledger([_rec(), _rec(ok=False)])
+    assert any("ok=false" in p for p in problems)
+    bad = _rec()
+    del bad["headline"]
+    problems, _ = check_perf_ledger.check_ledger([bad])
+    assert any("headline" in p for p in problems)
+
+
+def test_gate_median_window_bounds_history():
+    # Five ancient runs at 1000/s, then four modern priors at 100/s: with
+    # window=4 the median forgets the ancient era, so a newest run at
+    # 95/s passes; a window reaching back into the ancient era inflates
+    # the median and trips the gate.
+    records = [_rec(admissions=1000.0)] * 5 + [_rec(admissions=100.0)] * 4
+    records.append(_rec(admissions=95.0))
+    problems, _ = check_perf_ledger.check_ledger(records, window=4)
+    assert problems == []
+    problems, _ = check_perf_ledger.check_ledger(records, window=9)
+    assert problems != []
+
+
+def test_gate_groups_by_fingerprint():
+    # A different scale is a different fingerprint: its slower numbers
+    # are a separate baseline, not a regression of the first group.
+    fast = [_rec(), _rec()]
+    slow = [ledger.make_record("steady", _stats(admissions=10.0),
+                               scale=1.0) for _ in range(2)]
+    problems, _ = check_perf_ledger.check_ledger(fast + slow)
+    assert problems == []
+
+
+def test_checker_main_exit_codes(tmp_path, capsys):
+    p = tmp_path / "ledger.jsonl"
+    assert check_perf_ledger.main(["--ledger", str(p)]) == 0  # missing
+    ledger.append_record(_rec(), p)
+    assert check_perf_ledger.main(["--ledger", str(p)]) == 0  # baseline
+    ledger.append_record(_rec(admissions=40.0), p)
+    assert check_perf_ledger.main(["--ledger", str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "admissions_per_s" in out
+
+
+# ---------------------------------------------------------------------------
+# The real probe honors the stdout/--out/ledger contract
+
+
+def test_steady_probe_writes_ledger_and_single_stdout_line(tmp_path):
+    led = tmp_path / "ledger.jsonl"
+    out = tmp_path / "steady.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KUEUE_TPU_PERF_LEDGER=str(led))
+    res = subprocess.run(
+        [sys.executable, "bench.py", "--probe", "steady",
+         "--scale", "0.05", "--out", str(out)],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    # Exactly one stdout line, and it is the final JSON document
+    # (everything else goes to stderr) — the machine-readable contract.
+    lines = res.stdout.strip().splitlines()
+    assert len(lines) == 1, res.stdout
+    stats = json.loads(lines[0])
+    assert stats["probe"] == "steady" and stats["ok"] is True
+
+    # --out sidecar carries the same document.
+    assert json.loads(out.read_text()) == stats
+
+    # One valid ledger record appended, gate passes as baseline.
+    recs = ledger.load_records(led)
+    assert len(recs) == 1
+    assert ledger.validate_record(recs[0]) == []
+    assert recs[0]["probe"] == "steady"
+    assert recs[0]["headline"]["admissions_per_s"]["direction"] == "higher"
+    assert check_perf_ledger.main(["--ledger", str(led)]) == 0
+
+    # Synthetic 50% throughput collapse on the same fingerprint: gate
+    # flips to exit 1 (the acceptance-criteria regression drill).
+    crashed = json.loads(json.dumps(recs[0]))
+    for h in crashed["headline"].values():
+        if h["direction"] == "higher":
+            h["value"] *= 0.5
+        else:
+            h["value"] *= 1.5
+    crashed["ts"] += 1
+    ledger.append_record(crashed, led)
+    ledger.append_record(json.loads(json.dumps(recs[0])), led)
+    # Order matters: newest-last. Re-append the regression as newest.
+    ledger.append_record(crashed, led)
+    assert check_perf_ledger.main(["--ledger", str(led)]) == 1
+
+
+def test_probe_source_has_single_stdout_print():
+    """Source pin for the stdout contract: bench.py prints JSON to
+    stdout at exactly two final sites (probe exit, compact summary);
+    everything else rides stderr via log()."""
+    src = (REPO / "bench.py").read_text()
+    sites = [
+        ln for ln in src.splitlines()
+        if "print(json.dumps" in ln and not ln.strip().startswith("#")
+    ]
+    assert len(sites) == 2, sites

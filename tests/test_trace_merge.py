@@ -1,0 +1,259 @@
+"""Merged remote trace timeline (metrics/tracing.py remote fan-in +
+remote/worker.py attach + remote/client.py ingest).
+
+Claim families:
+
+1. **Skew mapping**: the NTP-style midpoint estimate maps worker span
+   timestamps onto the client clock — an ingested span lands inside the
+   client's own [t_send, t_recv] RPC window and carries the offset as a
+   ``clock_offset_s`` annotation, for two workers with wildly different
+   clocks at once.
+2. **Per-worker lanes**: the Chrome export renders each distinct worker
+   as its own synthetic process (pid >= 1_000_000, stable per worker)
+   with a ``process_name`` metadata event, next to the ``client`` lane.
+3. **Bounded, best-effort payloads**: at most MAX_REMOTE_SPANS spans
+   travel per response, args are stringified/truncated to
+   _REMOTE_ARG_MAX, malformed spans are dropped without failing the op.
+4. **Zero-cost when off**: with tracing disabled the worker attaches
+   nothing and the client ingests nothing — responses stay clean.
+5. **End to end** over the socket transport: two in-thread workers, one
+   client each; the merged export shows both worker lanes and the
+   ``remote_spans_ingested_total`` counter ticks per worker.
+"""
+
+import json
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, quota
+from kueue_tpu.manager import Manager
+from kueue_tpu.metrics import tracing
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.metrics.tracing import (
+    MAX_REMOTE_SPANS,
+    _REMOTE_ARG_MAX,
+    attach_remote_spans,
+    get_tracer,
+    ingest_remote_spans,
+)
+from kueue_tpu.remote import RemoteWorkerClient, serve_worker
+
+from .helpers import make_cq
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    get_tracer().clear()
+    yield
+    tracing.disable()
+    get_tracer().clear()
+
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ts": ts, "dur": dur, "tid": 1,
+            "parent": None, "args": args}
+
+
+# ---------------------------------------------------------------------------
+# Skew mapping
+
+
+def test_ingest_maps_worker_clock_onto_client_window():
+    tracing.enable(Metrics())
+    # Worker clock is ~90s ahead of the client's: a span that covered
+    # the RPC interior, sampled right after it closed.
+    resp = {"ok": True,
+            "spans": [_span("remote/dispatch", 100.0, 0.1, op="ping")],
+            "worker_now": 100.1}
+    t_send, t_recv = 10.0, 10.2
+    n = ingest_remote_spans(resp, worker="alpha",
+                            t_send=t_send, t_recv=t_recv, trace_id="t1")
+    assert n == 1
+    assert "spans" not in resp and "worker_now" not in resp  # popped
+
+    rec = [r for r in get_tracer().spans() if r.get("worker") == "alpha"][0]
+    offset = (t_send + t_recv) / 2.0 - 100.1
+    assert rec["clock_offset_s"] == pytest.approx(offset)
+    # Mapped onto the client timeline, the worker span sits inside the
+    # RPC window even though its raw timestamps were ~90s away.
+    assert t_send <= rec["ts"] <= t_recv
+    assert t_send <= rec["ts"] + rec["dur"] <= t_recv
+    assert rec["trace_id"] == "t1"
+
+
+def test_two_workers_with_different_skews_stay_ordered():
+    tracing.enable(Metrics())
+    tr = get_tracer()
+    # A client-side parent span bracketing both RPCs.
+    tr.record({"name": "client/fanout", "ts": 9.9, "dur": 0.6, "tid": 1,
+               "trace_id": "t1", "parent": None, "args": {}})
+    # alpha's clock is ahead, beta's is behind — opposite-signed offsets.
+    ingest_remote_spans(
+        {"spans": [_span("remote/dispatch", 100.0, 0.1)],
+         "worker_now": 100.1},
+        worker="alpha", t_send=10.0, t_recv=10.2, trace_id="t1")
+    ingest_remote_spans(
+        {"spans": [_span("remote/dispatch", 3.0, 0.1)],
+         "worker_now": 3.1},
+        worker="beta", t_send=10.25, t_recv=10.45, trace_id="t1")
+
+    by_worker = {r.get("worker"): r for r in tr.spans()}
+    a, b = by_worker["alpha"], by_worker["beta"]
+    assert a["clock_offset_s"] < 0 < b["clock_offset_s"]
+    # On the merged client timeline: parent start <= alpha <= beta <=
+    # parent end — monotonic despite raw worker clocks of 100.0 and 3.0.
+    parent = by_worker[None]
+    assert parent["ts"] <= a["ts"] <= a["ts"] + a["dur"] <= b["ts"]
+    assert b["ts"] + b["dur"] <= parent["ts"] + parent["dur"]
+
+
+# ---------------------------------------------------------------------------
+# Per-worker lanes in the Chrome export
+
+
+def test_chrome_export_gives_each_worker_a_lane():
+    tracing.enable(Metrics())
+    tr = get_tracer()
+    tr.record({"name": "client/fanout", "ts": 0.0, "dur": 1.0, "tid": 1,
+               "trace_id": "t1", "parent": None, "args": {}})
+    for i, w in enumerate(("alpha", "beta")):
+        ingest_remote_spans(
+            {"spans": [_span("remote/dispatch", 0.1, 0.2)],
+             "worker_now": 0.2},
+            worker=w, t_send=0.1, t_recv=0.3, trace_id="t1")
+
+    doc = tracing.export_chrome_trace()
+    json.dumps(doc)  # valid trace-event JSON
+    meta = {e["args"]["name"]: e["pid"]
+            for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert "client" in meta
+    assert meta["worker:alpha"] == 1_000_000
+    assert meta["worker:beta"] == 1_000_001
+
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    lanes = {e["args"].get("worker"): e["pid"] for e in events}
+    assert lanes[None] == meta["client"]
+    assert lanes["alpha"] == 1_000_000
+    assert lanes["beta"] == 1_000_001
+    for e in events:
+        if e["args"].get("worker"):
+            assert "clock_offset_s" in e["args"]
+
+
+# ---------------------------------------------------------------------------
+# Bounded payloads
+
+
+def test_attach_caps_span_count_and_truncates_args():
+    tracing.enable(Metrics())
+    tr = get_tracer()
+    for i in range(MAX_REMOTE_SPANS + 50):
+        tr.record({"name": f"s{i}", "ts": float(i), "dur": 0.01, "tid": 1,
+                   "trace_id": "t1", "parent": None,
+                   "args": {"big": "x" * 1000, "obj": object(), "n": i}})
+    tr.record({"name": "other-trace", "ts": 0.0, "dur": 0.01, "tid": 1,
+               "trace_id": "t2", "parent": None, "args": {}})
+    resp = {"ok": True}
+    attach_remote_spans(resp, "t1")
+    spans = resp["spans"]
+    assert len(spans) == MAX_REMOTE_SPANS
+    # Newest spans of the trace travel (oldest first), other traces don't.
+    assert spans[0]["name"] == "s50"
+    assert spans[-1]["name"] == f"s{MAX_REMOTE_SPANS + 49}"
+    for s in spans:
+        assert len(s["args"]["big"]) == _REMOTE_ARG_MAX
+        assert len(s["args"]["obj"]) <= _REMOTE_ARG_MAX
+        assert isinstance(s["args"]["n"], int)  # primitives pass through
+    assert isinstance(resp["worker_now"], float)
+    json.dumps(resp)  # wire-safe after stringification
+
+
+def test_ingest_caps_and_drops_malformed_spans():
+    tracing.enable(m := Metrics())
+    spans = [_span(f"s{i}", float(i), 0.01) for i in range(MAX_REMOTE_SPANS + 50)]
+    spans[3] = {"no_name": True}          # malformed: dropped, not fatal
+    spans[4] = {"name": "bad-ts", "ts": "not-a-number", "dur": 0.01,
+                "tid": 1, "parent": None, "args": {}}
+    n = ingest_remote_spans({"spans": spans, "worker_now": 1.0},
+                            worker="w", t_send=0.9, t_recv=1.1)
+    assert n == MAX_REMOTE_SPANS - 2
+    assert m.counters["remote_spans_ingested_total"][(("worker", "w"),)] \
+        == float(n)
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost when off
+
+
+def test_disabled_tracing_ships_and_ingests_nothing():
+    assert not tracing.ENABLED
+    resp = {"ok": True}
+    attach_remote_spans(resp, "t1")
+    assert resp == {"ok": True}  # response untouched
+    n = ingest_remote_spans(
+        {"ok": True, "spans": [_span("s", 0.0, 0.1)], "worker_now": 0.1},
+        worker="w", t_send=0.0, t_recv=0.2)
+    assert n == 0
+    assert get_tracer().spans() == []
+
+
+def test_attach_without_trace_id_is_noop():
+    tracing.enable(Metrics())
+    get_tracer().record({"name": "s", "ts": 0.0, "dur": 0.1, "tid": 1,
+                         "trace_id": "t1", "parent": None, "args": {}})
+    resp = {"ok": True}
+    attach_remote_spans(resp, None)
+    assert resp == {"ok": True}
+
+
+# ---------------------------------------------------------------------------
+# End to end over the socket transport
+
+
+def _worker_mgr():
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(10_000)}}),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def test_socket_transport_merges_two_worker_lanes(tmp_path):
+    m = Metrics()
+    tracing.enable(m)
+    sock1 = str(tmp_path / "w1.sock")
+    sock2 = str(tmp_path / "w2.sock")
+    s1 = serve_worker(_worker_mgr(), sock1)
+    s2 = serve_worker(_worker_mgr(), sock2)
+    try:
+        c1 = RemoteWorkerClient(sock1)
+        c2 = RemoteWorkerClient(sock2)
+        assert c1.ping() and c2.ping()
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+
+    # The real RPCs shipped their worker spans back: one ingested lane
+    # per socket, each annotated with a near-zero same-host offset.
+    spans = get_tracer().spans()
+    ingested = [r for r in spans if r.get("worker")]
+    assert {r["worker"] for r in ingested} == {sock1, sock2}
+    for r in ingested:
+        assert abs(r["clock_offset_s"]) < 0.5  # same process clock
+        assert r["dur"] >= 0.0
+
+    doc = tracing.export_chrome_trace()
+    meta = {e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M"}
+    assert {"client", f"worker:{sock1}", f"worker:{sock2}"} <= meta
+    worker_pids = {e["pid"] for e in doc["traceEvents"]
+                   if e["ph"] == "X" and e["args"].get("worker")}
+    assert len(worker_pids) == 2 and all(
+        p >= 1_000_000 for p in worker_pids
+    )
+
+    key1, key2 = (("worker", sock1),), (("worker", sock2),)
+    counts = m.counters["remote_spans_ingested_total"]
+    assert counts[key1] >= 1 and counts[key2] >= 1

@@ -20,8 +20,9 @@ Three claims, per docs/whatif.md:
 
 Compile budget: every env here uses the same tensor shapes (2 CQs + one
 cohort, one flavor, one resource, <= 8 pending -> s_max 8, W bucket 16,
-horizon 64) and all engines share one jit cache, so the whole file pays
-for K in {1, 2, 3} rollout compiles plus one preview compile.
+horizon 64) and all engines share one jit cache; the scenario axis is
+pow2-bucketed (K=3 pads to 4 lanes), so the whole file pays for k_pad
+in {1, 2, 4} rollout compiles plus one preview compile.
 """
 
 import numpy as np
@@ -541,3 +542,74 @@ def test_maybe_refresh_honors_interval():
     t[0] += 30.0
     again = eng.maybe_refresh(interval_s=30.0)
     assert again is not None and again is eng.last_report
+
+
+# ---------------------------------------------------------------------------
+# K-lane padding waste + cost attribution
+# ---------------------------------------------------------------------------
+
+
+def test_k_lane_padding_waste_counted():
+    """The honest-padding discipline (PR 2's driver gauges) extended to
+    the batched rollout's scenario axis: base + 2 counterfactual lanes
+    (K=3) pad to the pow2 rung k_pad=4, and the cost ledger books the
+    hand-computed wasted-lane fractions for BOTH padded axes."""
+    from kueue_tpu.obs import costs
+
+    cache, queues, _ = std_env()
+    submit(queues, *[
+        wl_with_runtime(f"w{i}", "lq", 3_000, 0, float(i + 1), 300)
+        for i in range(4)
+    ])
+    eng = make_engine(cache, queues)
+    led = costs.enable()
+    led.clear()
+    try:
+        rep = eng.eta(scenarios=[
+            Scenario(kind="quota", label="g1", quota_deltas=(
+                QuotaDelta(node="cq-a", flavor="default",
+                           resource="cpu", delta=1_000),)),
+            Scenario(kind="quota", label="g2", quota_deltas=(
+                QuotaDelta(node="cq-b", flavor="default",
+                           resource="cpu", delta=1_000),)),
+        ])
+    finally:
+        costs.disable()
+    assert rep.basis == "rollout", rep.reason
+    assert len(rep.scenarios) == 3
+
+    # K axis: 3 real lanes in a 4-lane pow2 rung -> 1 - 3/4 waste.
+    assert led.waste_fraction("whatif_rollout", "K") == pytest.approx(0.25)
+    # W axis: 4 real workload rows in the floor-16 bucket -> 1 - 4/16.
+    assert led.waste_fraction("whatif_rollout", "W") == pytest.approx(0.75)
+    cell = next(c for c in led.cells().values()
+                if c.entry == "whatif_rollout")
+    assert cell.dispatches == 1
+    assert cell.device_seconds > 0
+    assert cell.lanes["K"] == (3, 4)
+    assert cell.lanes["W"] == (4, 16)
+
+    # Pad lanes replay the base world and never leak into the decode:
+    # the counterfactual lanes carry their own results, not lane 3's.
+    assert rep.scenarios[1].ok and rep.scenarios[2].ok
+
+
+def test_single_scenario_eta_has_no_k_padding():
+    """The common path — plain eta(), one lane — must pay zero extra
+    rollout lanes: pow2_bucket(1, floor=1) == 1, waste 0."""
+    from kueue_tpu.obs import costs
+
+    cache, queues, _ = std_env()
+    submit(queues, wl_with_runtime("w0", "lq", 3_000, 0, 1.0, 300))
+    eng = make_engine(cache, queues)
+    led = costs.enable()
+    led.clear()
+    try:
+        rep = eng.eta()
+    finally:
+        costs.disable()
+    assert rep.basis == "rollout", rep.reason
+    assert led.waste_fraction("whatif_rollout", "K") == pytest.approx(0.0)
+    cell = next(c for c in led.cells().values()
+                if c.entry == "whatif_rollout")
+    assert cell.lanes["K"] == (1, 1)

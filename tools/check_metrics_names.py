@@ -166,6 +166,20 @@ def check_reason_codes_documented() -> List[str]:
     ]
 
 
+def check_help_text_keys() -> List[str]:
+    """Every HELP_TEXT key (names.py) must itself be an allowlisted
+    series: a # HELP entry for a name that can never be emitted is a
+    leftover from a rename, and the Prometheus exposition would carry
+    documentation for a ghost."""
+    from kueue_tpu.metrics.names import HELP_TEXT, METRIC_NAMES
+
+    return [
+        f"kueue_tpu/metrics/names.py: HELP_TEXT key {name!r} is not in "
+        "METRIC_NAMES"
+        for name in sorted(set(HELP_TEXT) - set(METRIC_NAMES))
+    ]
+
+
 def check_docs_coverage(allowlist: frozenset) -> List[str]:
     """Every allowlisted series must be documented: names.py's contract is
     "adding a metric means adding it here AND to docs/observability.md".
@@ -216,6 +230,7 @@ def run_check() -> List[str]:
             out.append(f"{rel}:{lineno}: {msg}")
     out.extend(check_docs_coverage(METRIC_NAMES))
     out.extend(check_emitted_coverage(METRIC_NAMES))
+    out.extend(check_help_text_keys())
     out.extend(check_fault_points_documented())
     out.extend(check_reason_codes_documented())
     return out

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Perf-ledger regression gate.
+
+Reads ``PERF_LEDGER.jsonl`` (kueue_tpu/perf/ledger.py records), groups
+records by (probe, config fingerprint), and compares the NEWEST record's
+headline metrics against the rolling median of up to ``--window`` prior
+records in the same group. Fails (exit 1) when any headline metric is
+worse than the median by more than ``--threshold`` fraction —
+lower-is-better metrics regress upward, higher-is-better ones downward.
+
+Groups with no history (a single record) pass: the first run of a new
+config seeds the baseline. Records that fail schema validation fail the
+gate — a ledger the checker can't read is itself a regression.
+
+Standalone:
+    python tools/check_perf_ledger.py [--ledger PATH] [--threshold 0.2]
+Wired into the suite runner as ``tools/run_isolated.py --perf-gate``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from kueue_tpu.perf import ledger  # noqa: E402
+
+
+def check_ledger(records: List[dict], threshold: float = 0.2,
+                 window: int = 8) -> Tuple[List[str], List[str]]:
+    """Returns (problems, notes). Empty problems == gate passes."""
+    problems: List[str] = []
+    notes: List[str] = []
+    groups: Dict[Tuple[str, str], List[dict]] = {}
+    for i, rec in enumerate(records):
+        errs = ledger.validate_record(rec)
+        if errs:
+            problems.append(f"record #{i}: " + "; ".join(errs))
+            continue
+        groups.setdefault(
+            (rec["probe"], rec["fingerprint"]), []
+        ).append(rec)
+
+    for (probe, fp), group in sorted(groups.items()):
+        newest, priors = group[-1], group[:-1][-window:]
+        if not newest.get("ok"):
+            problems.append(
+                f"{probe}[{fp}]: newest record reports ok=false"
+            )
+            continue
+        if not priors:
+            notes.append(f"{probe}[{fp}]: no history yet (baseline run)")
+            continue
+        for name, h in newest.get("headline", {}).items():
+            base_vals = [
+                p["headline"][name]["value"] for p in priors
+                if name in p.get("headline", {}) and p.get("ok")
+            ]
+            if not base_vals:
+                notes.append(f"{probe}[{fp}].{name}: no prior values")
+                continue
+            base = statistics.median(base_vals)
+            val = h["value"]
+            if base == 0:
+                continue
+            if h["direction"] == "lower":
+                ratio = (val - base) / abs(base)
+            else:
+                ratio = (base - val) / abs(base)
+            if ratio > threshold:
+                problems.append(
+                    f"{probe}[{fp}].{name}: {val:g} vs median {base:g} "
+                    f"of {len(base_vals)} prior(s) — "
+                    f"{ratio * 100:.1f}% worse (> {threshold * 100:.0f}%)"
+                )
+            else:
+                notes.append(
+                    f"{probe}[{fp}].{name}: {val:g} vs median {base:g} "
+                    f"({ratio * 100:+.1f}% worse-direction delta, ok)"
+                )
+    return problems, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", type=Path,
+                    default=ledger.default_ledger_path())
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="max worse-direction fraction vs rolling median")
+    ap.add_argument("--window", type=int, default=8,
+                    help="how many prior records feed the median")
+    args = ap.parse_args(argv)
+
+    records = ledger.load_records(args.ledger)
+    if not records:
+        print(f"perf ledger: no records at {args.ledger} — nothing to "
+              "gate (pass)")
+        return 0
+    problems, notes = check_ledger(records, threshold=args.threshold,
+                                   window=args.window)
+    for n in notes:
+        print(f"  {n}")
+    if problems:
+        print(f"perf ledger: {len(problems)} problem(s):")
+        for p in problems:
+            print(f"  REGRESSION {p}")
+        return 1
+    print(f"perf ledger: OK ({len(records)} record(s), "
+          f"threshold {args.threshold * 100:.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,10 @@ standalone retry is green does not fail the run. Extra pytest args after
 -q``). ``--compile-cache DIR`` exports KUEUE_TPU_COMPILE_CACHE=DIR to
 every segment so the fresh subprocesses share warm executables through
 the persistent compile cache instead of recompiling from zero
-(perf/compile_cache.py).
+(perf/compile_cache.py). ``--perf-gate`` additionally runs
+``tools/check_perf_ledger.py`` after the suite, so a headline-metric
+regression recorded in PERF_LEDGER.jsonl fails the run like a test
+would.
 """
 
 from __future__ import annotations
@@ -117,6 +120,10 @@ def main(argv: list) -> int:
             return 2
         os.environ["KUEUE_TPU_COMPILE_CACHE"] = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
+    perf_gate = False
+    if "--perf-gate" in argv:
+        perf_gate = True
+        argv.remove("--perf-gate")
     if argv:
         print(f"unknown arguments {argv!r}; pass pytest args after --",
               file=sys.stderr)
@@ -157,6 +164,18 @@ def main(argv: list) -> int:
             casualties.append((rel, rc))
         elif rc != 0:
             failures.append((rel, rc))
+
+    if perf_gate:
+        # Perf-ledger gate: headline metrics in PERF_LEDGER.jsonl must
+        # not regress vs their rolling median (check_perf_ledger.py).
+        print("== [perf-gate] tools/check_perf_ledger.py", flush=True)
+        rc = subprocess.call(
+            [sys.executable, str(REPO_ROOT / "tools"
+                                 / "check_perf_ledger.py")],
+            cwd=str(REPO_ROOT),
+        )
+        if rc != 0:
+            failures.append(("perf-gate", rc))
 
     print("\n== run_isolated summary")
     print(f"signal retries: {stats['retries']}, "
