@@ -1120,6 +1120,161 @@ def probe_steady(scale: float):
     }
 
 
+def probe_scanfloor(scale: float):
+    """Scan-vs-fixed-point cycle latency + rounds-taken on tiny CPU-scale
+    encoded cycles across three quota mixes (plain borrow-limits,
+    lending limits, preemption). Each mix captures a REAL encoded cycle
+    from a scan-mode DeviceScheduler run, then times both kernels on the
+    identical arrays (best-of-N, block_until_ready) and spot-checks
+    outcome equality. The point is the shape of the floor, not absolute
+    numbers: the scan pays ~one sequential step per admission slot while
+    the fixed point pays a handful of fully-parallel rounds (BENCH_r05
+    floor analysis; docs/perf.md coverage matrix)."""
+    import jax
+    import numpy as np
+
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.models.driver import DeviceScheduler
+    from kueue_tpu.perf import compile_cache
+
+    n_cq = max(4, min(12, int(8 * scale)))
+    s_resid = 16  # residual-scan rung covering every probe cycle
+
+    def build(mix):
+        """One cohort forest + a wave of pending heads; returns the first
+        encoded (arrays, ga, adm) the scan driver actually dispatches."""
+        mgr = Manager()
+        preemption = ClusterQueuePreemption()
+        if mix == "preempt":
+            preemption = ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+                reclaim_within_cohort=PreemptionPolicy.LOWER_PRIORITY,
+            )
+        objs = [ResourceFlavor(name="default"),
+                Cohort(name="co0"), Cohort(name="co1")]
+        for i in range(n_cq):
+            lend = 2000 if (mix == "lending" and i % 2 == 0) else None
+            objs.append(ClusterQueue(
+                name=f"cq{i}", cohort=f"co{i % 2}",
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(
+                        name="default",
+                        resources={"cpu": ResourceQuota(
+                            4000 + 1000 * (i % 3), 3000, lend)},
+                    )],
+                )],
+                preemption=preemption,
+            ))
+            objs.append(LocalQueue(name=f"lq{i}", cluster_queue=f"cq{i}"))
+        mgr.apply(*objs)
+        sched = DeviceScheduler(mgr.cache, mgr.queues)
+        if mix == "preempt":
+            # Fillers first: admitted low-priority victims to preempt.
+            for i in range(n_cq):
+                mgr.create_workload(Workload(
+                    name=f"fill{i}", queue_name=f"lq{i}",
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": 4000})],
+                    priority=0, creation_time=float(i + 1),
+                ))
+            sched.schedule_all(max_cycles=20)
+        for i in range(2 * n_cq):
+            mgr.create_workload(Workload(
+                name=f"w{i}", queue_name=f"lq{i % n_cq}",
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 1500 + 500 * (i % 4)})],
+                priority=100 + (i % 3) * 100,
+                creation_time=float(100 + i),
+            ))
+        captured = []
+        orig = compile_cache.dispatch
+
+        def spy(entry, fn, *a, **kw):
+            if entry == "cycle_grouped_preempt" and not captured:
+                captured.append(a)
+            return orig(entry, fn, *a, **kw)
+
+        compile_cache.dispatch = spy
+        try:
+            sched.schedule()
+        finally:
+            compile_cache.dispatch = orig
+        if not captured:
+            raise RuntimeError(f"mix {mix}: no device cycle dispatched")
+        return captured[0]
+
+    def best_of(fn, args, n=7):
+        out = fn(*args)
+        jax.block_until_ready(out.outcome)  # compile outside the clock
+        best = None
+        for _ in range(n):
+            t = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out.outcome)
+            dt = time.perf_counter() - t
+            best = dt if best is None or dt < best else best
+        return best, out
+
+    mixes = {}
+    ok = True
+    rounds_max = 0
+    speedups = []
+    for mix in ("plain", "lending", "preempt"):
+        arrays, ga, adm = build(mix)
+        scan_s, out_scan = best_of(
+            bs.cycle_grouped_preempt, (arrays, ga, adm))
+        if mix == "preempt":
+            fp_fn = bs.fixedpoint_cycle_preempt_for(s_resid)
+            fp_s, out_fp = best_of(fp_fn, (arrays, ga, adm))
+            planes = ("outcome", "usage", "victims")
+        else:
+            fp_s, out_fp = best_of(bs.cycle_fixedpoint, (arrays, ga))
+            planes = ("outcome", "usage")
+        match = all(
+            np.array_equal(np.asarray(getattr(out_scan, p)),
+                           np.asarray(getattr(out_fp, p)))
+            for p in planes
+        )
+        rounds = int(np.asarray(out_fp.fp_rounds))
+        converged = bool(np.asarray(out_fp.converged))
+        ok = ok and match and converged
+        rounds_max = max(rounds_max, rounds)
+        speedups.append(scan_s / fp_s if fp_s > 0 else 0.0)
+        mixes[mix] = {
+            "scan_ms": round(scan_s * 1000, 3),
+            "fp_ms": round(fp_s * 1000, 3),
+            "speedup": round(scan_s / fp_s, 2) if fp_s > 0 else None,
+            "rounds": rounds,
+            "heads_bucket": int(np.asarray(arrays.w_cq).shape[0]),
+            "match": match,
+        }
+        log(f"scanfloor[{mix}]: scan={scan_s * 1e3:.2f}ms "
+            f"fp={fp_s * 1e3:.2f}ms rounds={rounds} match={match}")
+    return {
+        "probe": "scanfloor",
+        "ok": ok and rounds_max <= 8,
+        "n_cq": n_cq,
+        "fp_speedup": round(min(speedups), 2) if speedups else 0.0,
+        "rounds_max": rounds_max,
+        "mixes": mixes,
+    }
+
+
 def probe_coldstart_child(scale: float):
     """Child half of the cold-start probe: one fresh process, the shared
     persistent compile cache + AOT store (KUEUE_TPU_COMPILE_CACHE), one
@@ -1281,7 +1436,8 @@ def main():
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
-                             "steady", "coldstart", "coldstart-child"],
+                             "steady", "scanfloor", "coldstart",
+                             "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -1340,6 +1496,7 @@ def main():
                 "incremental": lambda: probe_incremental(args.scale),
                 "whatif": lambda: probe_whatif(args.scale),
                 "steady": lambda: probe_steady(args.scale),
+                "scanfloor": lambda: probe_scanfloor(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

@@ -124,6 +124,12 @@ class Configuration:
     object_retention_after_deactivated_seconds: Optional[float] = None
     visibility_enabled: bool = True
     use_device_scheduler: bool = False
+    # Device admission kernel: "scan" (grouped sequential scan, the
+    # conservative default), "fixedpoint" (monotone-bounds rounds wherever
+    # exact, host otherwise), "auto" (widest exact kernel per cycle,
+    # including the fixed-point + residual-scan preemption hybrid). See
+    # docs/perf.md "Fixed-point coverage matrix".
+    device_kernel: str = "scan"
     # KEP 7066 custom metric labels: entries of
     # {name, sourceKind: Workload|ClusterQueue|Cohort, sourceLabelKey,
     # sourceAnnotationKey}; values are read from the source object's
@@ -272,6 +278,9 @@ def load(source) -> Configuration:
         _pick(raw, "useDeviceScheduler", "use_device_scheduler",
               default=False)
     )
+    cfg.device_kernel = str(
+        _pick(raw, "deviceKernel", "device_kernel", default="scan")
+    )
 
     validate(cfg)
     return cfg
@@ -296,6 +305,11 @@ def validate(cfg: Configuration) -> None:
     for gate in cfg.feature_gates:
         if gate not in features.all_gates():
             raise ValueError(f"unknown feature gate {gate}")
+    if cfg.device_kernel not in ("scan", "fixedpoint", "auto"):
+        raise ValueError(
+            f"unknown deviceKernel {cfg.device_kernel!r} "
+            "(expected scan | fixedpoint | auto)"
+        )
 
 
 def apply_feature_gates(cfg: Configuration) -> None:
@@ -327,6 +341,7 @@ def build_manager(cfg: Configuration, **kw):
         retention=retention,
         use_device_scheduler=cfg.use_device_scheduler,
         admission_fair_sharing=cfg.admission_fair_sharing,
+        device_kernel=cfg.device_kernel,
         **kw,
     )
     mgr.exclude_resource_prefixes = list(

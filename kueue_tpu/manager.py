@@ -89,6 +89,7 @@ class Manager:
         clock: Callable[[], float] = time.monotonic,
         use_device_scheduler: bool = False,
         admission_fair_sharing=None,
+        device_kernel: str = "scan",
     ) -> None:
         self.clock = clock
         self.cache = Cache()
@@ -98,7 +99,8 @@ class Manager:
             from kueue_tpu.models.driver import DeviceScheduler
 
             self.scheduler = DeviceScheduler(
-                self.cache, self.queues, fair_sharing=fair_sharing
+                self.cache, self.queues, fair_sharing=fair_sharing,
+                device_kernel=device_kernel,
             )
         else:
             self.scheduler = Scheduler(

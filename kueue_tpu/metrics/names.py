@@ -96,6 +96,7 @@ TRACING_SERIES = frozenset({
     "remote_spans_ingested_total",
     # Fault containment (models/driver.py, utils/breaker.py, remote/).
     "solver_fallback_cycles_total",
+    "solver_fixedpoint_rounds",
     "solver_breaker_state",
     "solver_plane_validation_failures_total",
     "remote_deadline_exceeded_total",
@@ -149,6 +150,8 @@ HELP_TEXT = {
         "Profiler state: 0 idle, 1 capturing, 2 failed, 3 breaker open",
     "solver_device_seconds":
         "Blocking device dispatch+readback wall time per kernel",
+    "solver_fixedpoint_rounds":
+        "Rounds the fixed-point admission kernel took to decide a cycle",
     "solver_batch_size": "W padding bucket used by the admission cycle",
     "solver_padding_waste_pct":
         "Padded-minus-real head rows as a percentage of the bucket",

@@ -114,6 +114,11 @@ class CycleOutputs(NamedTuple):
     # Per-slot takes for generic multi-podset TAS entries (None when no
     # such entry this cycle).
     s_tas_takes: jnp.ndarray = None  # i32[W,S,D]
+    # Fixed-point kernels only: did the bounds iteration settle every
+    # entry within the rounds cap, and how many rounds it took. None on
+    # the scan kernels (the driver treats None as trivially converged).
+    converged: jnp.ndarray = None  # bool[] scalar
+    fp_rounds: jnp.ndarray = None  # i32[] scalar
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -1881,10 +1886,174 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
     return nom._replace(best_pmode=pm2, needs_host=needs_host2), downgrade
 
 
+def _finish_outputs(arrays, nom, final_usage, admitted, preempting, order,
+                    victims=None, variant=None, partial_count=None,
+                    tas_takes=None, tas_leader_takes=None, s_tas_takes=None,
+                    converged=None, fp_rounds=None):
+    """Decode the admission planes into the per-workload outcome nest and
+    assemble CycleOutputs — shared by the scan, fixed-point and hybrid
+    cycle factories so every kernel reports decisions identically."""
+    outcome = jnp.where(
+        ~arrays.w_active,
+        OUT_NOFIT,
+        jnp.where(
+            nom.needs_host,
+            OUT_NEEDS_HOST,
+            jnp.where(
+                admitted,
+                OUT_ADMITTED,
+                jnp.where(
+                    preempting,
+                    OUT_PREEMPTING,
+                    jnp.where(
+                        nom.best_pmode == P_FIT,
+                        OUT_FIT_SKIPPED,
+                        jnp.where(
+                            nom.best_pmode == P_PREEMPT_OK,
+                            OUT_FIT_SKIPPED,
+                            jnp.where(
+                                nom.best_pmode == P_NO_CANDIDATES,
+                                OUT_NO_CANDIDATES,
+                                OUT_NOFIT,
+                            ),
+                        ),
+                    ),
+                ),
+            ),
+        ),
+    ).astype(jnp.int32)
+    return CycleOutputs(
+        outcome=outcome,
+        chosen_flavor=nom.chosen_flavor,
+        borrow=nom.best_borrow,
+        tried_flavor_idx=nom.tried_flavor_idx,
+        usage=final_usage,
+        order=order,
+        victims=victims,
+        victim_variant=variant,
+        partial_count=partial_count,
+        s_flavor=nom.s_flavor,
+        s_pmode=nom.s_pmode,
+        s_tried=nom.s_tried,
+        tas_takes=tas_takes,
+        tas_leader_takes=tas_leader_takes,
+        s_tas_takes=s_tas_takes,
+        converged=converged,
+        fp_rounds=fp_rounds,
+    )
+
+
+def _resolve_preempt_nominate(arrays, adm, nom):
+    """The device-preemption front half shared by the grouped-preempt and
+    fixed-point-hybrid cycles: structural eligibility, the flat and
+    hierarchical victim-search kernels, and the nominate overrides for
+    device-resolved entries. Returns the patched NominateResult plus the
+    target planes (victims/variant/success/resolved...)."""
+    from kueue_tpu.models.preempt_kernel import preempt_targets
+
+    downgrade = None
+    if arrays.tas_topo is not None:
+        nom, downgrade = apply_tas_nominate_hook(arrays, nom)
+
+    # Structural eligibility for on-device oracle resolution: the
+    # fungibility scan's choice must be independent of the oracle
+    # outcome. Slot-layout cycles gate per slot: a preempting slot
+    # saw exactly one raw-preempt flavor (its stop is forced), and a
+    # non-preempting slot saw none (its choice never consulted the
+    # oracle); any other shape defers to the host, because a
+    # different oracle verdict would change that slot's flavor and
+    # every later slot's accumulated usage.
+    base_core = (
+        arrays.w_active
+        & (nom.best_pmode == P_PREEMPT_RAW)
+        & ~arrays.w_has_gates
+    )
+    base_elig, slot_nom = structural_elig(arrays, nom, base_core)
+    if arrays.w_tas is not None:
+        # TAS entries may use the kernels' tas_fits-aware searches
+        # (flat and hierarchical) when the tree's admitted TAS usage
+        # is device-representable and the preempt mode came from
+        # nominate (a Fit->Preempt TAS downgrade re-enters the host
+        # fungibility scan instead).
+        tas_allowed = jnp.zeros_like(base_elig)
+        if (arrays.tas_topo is not None
+                and arrays.preempt_tas_ok is not None):
+            tas_allowed = (
+                arrays.w_tas
+                & arrays.preempt_tas_ok[arrays.w_cq]
+                & ~downgrade
+            )
+            if arrays.w_tas_has_leader is not None:
+                # Leader-group entries keep the host's TAS-aware
+                # victim search (the kernels' tas_fits probe has no
+                # leader planes).
+                tas_allowed = tas_allowed & ~arrays.w_tas_has_leader
+        base_elig = base_elig & (~arrays.w_tas | tas_allowed)
+    if getattr(arrays, "s_tas", None) is not None:
+        # Generic multi-podset TAS entries needing preemption keep
+        # the host victim search (per-slot tas_fits probes are not
+        # in the kernels); the whole-tree discard keeps the cycle
+        # exact.
+        base_elig = base_elig & ~jnp.any(arrays.s_tas, axis=1)
+    # The hierarchical kernel still reads the legacy single-slot
+    # fields; multi-slot / off-RG0 entries on nested trees defer to
+    # the host preemptor (the flat kernel is slot-aware).
+    base_hier = base_elig
+    if arrays.w_simple_slot is not None:
+        base_hier = base_hier & arrays.w_simple_slot
+    elig = base_elig & arrays.preempt_simple[arrays.w_cq]
+    tgt = preempt_targets(
+        arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
+        nom.considered, slot_nom=slot_nom,
+    )
+    if arrays.preempt_hier is not None:
+        # Nested lend-free trees: hierarchical victim-search kernel
+        # (models/preempt_kernel.hier_targets); the encoder omits the
+        # field entirely when no such tree exists this cycle.
+        from kueue_tpu.models.preempt_kernel import hier_targets
+
+        elig_h = base_hier & arrays.preempt_hier[arrays.w_cq]
+        tgt_h = hier_targets(
+            arrays, adm, nom.chosen_flavor, elig_h, nom.praw_stop,
+            nom.considered,
+        )
+        hm = elig_h
+        tgt = tgt.__class__(
+            victims=jnp.where(hm[:, None], tgt_h.victims, tgt.victims),
+            variant=jnp.where(hm[:, None], tgt_h.variant, tgt.variant),
+            success=jnp.where(hm, tgt_h.success, tgt.success),
+            resolved_nc=jnp.where(
+                hm, tgt_h.resolved_nc, tgt.resolved_nc
+            ),
+            resolved=jnp.where(hm, tgt_h.resolved, tgt.resolved),
+            borrow_after=jnp.where(
+                hm, tgt_h.borrow_after, tgt.borrow_after
+            ),
+        )
+    nom = nom._replace(
+        best_pmode=jnp.where(
+            tgt.success, P_PREEMPT_OK,
+            jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
+                      nom.best_pmode),
+        ),
+        best_borrow=jnp.where(
+            tgt.resolved, tgt.borrow_after, nom.best_borrow
+        ),
+        needs_host=nom.needs_host & ~tgt.resolved,
+    )
+    return nom, tgt
+
+
 def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                        unroll: int = 2, n_levels: int = MAX_DEPTH + 1,
                        mesh=None):
     """Build a jittable grouped cycle; s_max=0 means exact (W slots).
+
+    kernel-entry: cycle_grouped_preempt
+
+    (No gate-requires markers: the grouped-preempt scan is the driver's
+    unconditional default — exact for every device-compatible cycle
+    shape.)
 
     With ``preempt=True`` the cycle takes a third AdmittedArrays argument
     and resolves classical preemption on device for eligible entries
@@ -1893,55 +2062,7 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
     resolved entries get exact pmodes/borrows for the admission order, and
     the scan designates victims with overlap/fit semantics."""
 
-    def finish(arrays, nom, final_usage, admitted, preempting, order,
-               victims=None, variant=None, partial_count=None,
-               tas_takes=None, tas_leader_takes=None, s_tas_takes=None):
-        outcome = jnp.where(
-            ~arrays.w_active,
-            OUT_NOFIT,
-            jnp.where(
-                nom.needs_host,
-                OUT_NEEDS_HOST,
-                jnp.where(
-                    admitted,
-                    OUT_ADMITTED,
-                    jnp.where(
-                        preempting,
-                        OUT_PREEMPTING,
-                        jnp.where(
-                            nom.best_pmode == P_FIT,
-                            OUT_FIT_SKIPPED,
-                            jnp.where(
-                                nom.best_pmode == P_PREEMPT_OK,
-                                OUT_FIT_SKIPPED,
-                                jnp.where(
-                                    nom.best_pmode == P_NO_CANDIDATES,
-                                    OUT_NO_CANDIDATES,
-                                    OUT_NOFIT,
-                                ),
-                            ),
-                        ),
-                    ),
-                ),
-            ),
-        ).astype(jnp.int32)
-        return CycleOutputs(
-            outcome=outcome,
-            chosen_flavor=nom.chosen_flavor,
-            borrow=nom.best_borrow,
-            tried_flavor_idx=nom.tried_flavor_idx,
-            usage=final_usage,
-            order=order,
-            victims=victims,
-            victim_variant=variant,
-            partial_count=partial_count,
-            s_flavor=nom.s_flavor,
-            s_pmode=nom.s_pmode,
-            s_tried=nom.s_tried,
-            tas_takes=tas_takes,
-            tas_leader_takes=tas_leader_takes,
-            s_tas_takes=s_tas_takes,
-        )
+    finish = _finish_outputs
 
     def apply_partial(arrays, nom, adm=None, targets=None):
         nom, new_req, partial_count, tgt2 = partial_search(
@@ -1977,102 +2098,11 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
 
         return impl
 
-    from kueue_tpu.models.preempt_kernel import preempt_targets
-
     def impl_preempt(arrays: CycleArrays, ga: GroupArrays,
                      adm) -> CycleOutputs:
         usage = arrays.usage
         nom = nominate(arrays, usage, n_levels=n_levels)
-        downgrade = None
-        if arrays.tas_topo is not None:
-            nom, downgrade = apply_tas_nominate_hook(arrays, nom)
-
-        # Structural eligibility for on-device oracle resolution: the
-        # fungibility scan's choice must be independent of the oracle
-        # outcome. Slot-layout cycles gate per slot: a preempting slot
-        # saw exactly one raw-preempt flavor (its stop is forced), and a
-        # non-preempting slot saw none (its choice never consulted the
-        # oracle); any other shape defers to the host, because a
-        # different oracle verdict would change that slot's flavor and
-        # every later slot's accumulated usage.
-        base_core = (
-            arrays.w_active
-            & (nom.best_pmode == P_PREEMPT_RAW)
-            & ~arrays.w_has_gates
-        )
-        base_elig, slot_nom = structural_elig(arrays, nom, base_core)
-        if arrays.w_tas is not None:
-            # TAS entries may use the kernels' tas_fits-aware searches
-            # (flat and hierarchical) when the tree's admitted TAS usage
-            # is device-representable and the preempt mode came from
-            # nominate (a Fit->Preempt TAS downgrade re-enters the host
-            # fungibility scan instead).
-            tas_allowed = jnp.zeros_like(base_elig)
-            if (arrays.tas_topo is not None
-                    and arrays.preempt_tas_ok is not None):
-                tas_allowed = (
-                    arrays.w_tas
-                    & arrays.preempt_tas_ok[arrays.w_cq]
-                    & ~downgrade
-                )
-                if arrays.w_tas_has_leader is not None:
-                    # Leader-group entries keep the host's TAS-aware
-                    # victim search (the kernels' tas_fits probe has no
-                    # leader planes).
-                    tas_allowed = tas_allowed & ~arrays.w_tas_has_leader
-            base_elig = base_elig & (~arrays.w_tas | tas_allowed)
-        if getattr(arrays, "s_tas", None) is not None:
-            # Generic multi-podset TAS entries needing preemption keep
-            # the host victim search (per-slot tas_fits probes are not
-            # in the kernels); the whole-tree discard keeps the cycle
-            # exact.
-            base_elig = base_elig & ~jnp.any(arrays.s_tas, axis=1)
-        # The hierarchical kernel still reads the legacy single-slot
-        # fields; multi-slot / off-RG0 entries on nested trees defer to
-        # the host preemptor (the flat kernel is slot-aware).
-        base_hier = base_elig
-        if arrays.w_simple_slot is not None:
-            base_hier = base_hier & arrays.w_simple_slot
-        elig = base_elig & arrays.preempt_simple[arrays.w_cq]
-        tgt = preempt_targets(
-            arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
-            nom.considered, slot_nom=slot_nom,
-        )
-        if arrays.preempt_hier is not None:
-            # Nested lend-free trees: hierarchical victim-search kernel
-            # (models/preempt_kernel.hier_targets); the encoder omits the
-            # field entirely when no such tree exists this cycle.
-            from kueue_tpu.models.preempt_kernel import hier_targets
-
-            elig_h = base_hier & arrays.preempt_hier[arrays.w_cq]
-            tgt_h = hier_targets(
-                arrays, adm, nom.chosen_flavor, elig_h, nom.praw_stop,
-                nom.considered,
-            )
-            hm = elig_h
-            tgt = tgt.__class__(
-                victims=jnp.where(hm[:, None], tgt_h.victims, tgt.victims),
-                variant=jnp.where(hm[:, None], tgt_h.variant, tgt.variant),
-                success=jnp.where(hm, tgt_h.success, tgt.success),
-                resolved_nc=jnp.where(
-                    hm, tgt_h.resolved_nc, tgt.resolved_nc
-                ),
-                resolved=jnp.where(hm, tgt_h.resolved, tgt.resolved),
-                borrow_after=jnp.where(
-                    hm, tgt_h.borrow_after, tgt.borrow_after
-                ),
-            )
-        nom = nom._replace(
-            best_pmode=jnp.where(
-                tgt.success, P_PREEMPT_OK,
-                jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
-                          nom.best_pmode),
-            ),
-            best_borrow=jnp.where(
-                tgt.resolved, tgt.borrow_after, nom.best_borrow
-            ),
-            needs_host=nom.needs_host & ~tgt.resolved,
-        )
+        nom, tgt = _resolve_preempt_nominate(arrays, adm, nom)
         partial_count = None
         if arrays.w_partial is not None:
             # The search runs after the full-count preemption resolution
@@ -2107,24 +2137,33 @@ cycle_grouped_preempt = jax.jit(make_grouped_cycle(preempt=True))
 
 
 # ---------------------------------------------------------------------------
-# Fixed-point admission (no-lending-limit fast path)
+# Fixed-point admission
 # ---------------------------------------------------------------------------
 #
-# With no lending limits anywhere (localQuota == 0 for every node —
-# resource_node.go:67), usage bubbles fully to every ancestor and
-#   available(cq) = min over chain nodes b of  T_b - usage_b, where
-#   T_root = subtree_quota[root];  T_b = subtree_quota[b] + borrow_limit[b]
-#   when a borrowing limit is set;  T_b = +inf otherwise.
-# Usage at b before entry i is base + the admission-order prefix sum of
-# admitted deltas inside b's subtree — so greedy admission becomes a
-# monotone-bounds fixed point instead of a sequential scan:
+# The grouped scan's per-tree bookkeeping (node-local quota absorption on
+# the way up, the root-first availability walk on the way down —
+# resource_node.go:67 localQuota / hierarchical available()) is a pure
+# function of the base usage plus the admission-order prefix of earlier
+# entries' contributions at every chain node. Those prefixes are
+# segmented exclusive prefix sums per (node, flavor) — so greedy
+# admission becomes a monotone-bounds fixed point instead of a
+# sequential scan:
 #   * an entry that fits even when ALL undecided earlier entries are
 #     counted (over-estimate) is definitely admitted;
 #   * an entry that cannot fit even when NO undecided earlier entry is
 #     counted (under-estimate) is definitely rejected;
 #   * the first undecided entry of each cohort tree always has an exact
 #     prefix, so every round decides at least one entry per tree.
+# Monotonicity survives lending limits because every walk quantity
+# (node-local absorption, stored+borrow clamp, root slack) is monotone
+# non-increasing in the contribution vector, and the bubbled arrival of
+# a contribution at an ancestor is monotone non-decreasing in it.
 # Expected rounds: a handful; worst case max-entries-per-tree.
+#
+# Chain levels are keyed by ABSOLUTE tree depth (root = depth 0,
+# quota_ops convention), not by per-entry chain position: two CQs of
+# different depths sharing an interior cohort must land that cohort in
+# the same prefix segment or its usage is undercounted.
 
 _INF64 = (jnp.int64(1) << 61)
 
@@ -2201,15 +2240,17 @@ def admit_fixedpoint(
     order: jnp.ndarray,
     max_rounds: int = 64,
     n_levels: int = MAX_DEPTH + 1,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Order-exact admission equivalent to admit_scan_grouped, computed in
-    O(rounds) fully-vectorized passes; also returns the rounds taken.
-    Requires no lending limits (caller checks has_lend_limit is
-    all-False)."""
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Order-exact admission equivalent to admit_scan_grouped (including
+    lending-limit trees), computed in O(rounds) fully-vectorized passes.
+
+    Returns ``(final_usage, admitted, rounds, converged)`` — ``converged``
+    is False when the rounds cap expired with entries still undecided, in
+    which case the planes are NOT exact and the caller must discard the
+    cycle (driver: contained host fallback)."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     f_n, r_n = tree.nominal.shape[1], tree.nominal.shape[2]
-    f_onehot = jnp.arange(f_n)
 
     # Static per-cycle quantities -------------------------------------------
     rank = jnp.zeros(w_n, dtype=jnp.int64).at[order].set(
@@ -2219,25 +2260,20 @@ def admit_fixedpoint(
     chain_cols = [arrays.w_cq.astype(jnp.int32)]
     for _ in range(n_levels - 1):
         chain_cols.append(parent[chain_cols[-1]].astype(jnp.int32))
-    chains = jnp.stack(chain_cols, axis=1)  # [W, L] flat node ids
-    is_root = tree.parent[chains] < 0  # [W, D+1]
+    chains = jnp.stack(chain_cols, axis=1)  # [W, L] CQ-first node ids
 
-    # Constraint term per chain node: T_b - base_usage_b (or +inf).
-    t_node = jnp.where(
-        (tree.parent < 0)[:, None, None],
-        tree.subtree_quota,
-        jnp.where(
-            tree.has_borrow_limit,
-            sat_add(tree.subtree_quota, tree.borrow_limit),
-            _INF64,
-        ),
-    )
-    slack0 = jnp.where(
-        t_node >= _INF64, _INF64, sat_sub(t_node, usage)
-    )  # [N,F,R] capacity left before this cycle's admissions
+    # Depth-aligned chains: column k holds the entry's ancestor at
+    # ABSOLUTE tree depth k (root first), so a shared interior cohort
+    # lands in one prefix segment no matter how deep each CQ under it
+    # sits. Columns past the CQ's own depth are off-chain (masked).
+    depth_w = tree.depth[arrays.w_cq].astype(jnp.int32)  # [W]
+    k_iota = jnp.arange(n_levels, dtype=jnp.int32)
+    al_idx = jnp.clip(depth_w[:, None] - k_iota[None, :], 0, n_levels - 1)
+    aligned = jnp.take_along_axis(chains, al_idx, axis=1)  # [W,L]
+    on_chain = k_iota[None, :] <= depth_w[:, None]  # [W,L]
 
     # Every entry reads and writes a single flavor plane, so all per-entry
-    # tensors are [W,R] plane slices and the per-level segments are keyed
+    # tensors are [W,R] plane slices and the per-depth segments are keyed
     # by (node, flavor) — a factor-F cut in the per-round data volume.
     fcl = jnp.clip(nom.chosen_flavor, 0, f_n - 1)
     cell_mask = (
@@ -2259,15 +2295,23 @@ def admit_fixedpoint(
     nominal_c = tree.nominal[arrays.w_cq, fcl]  # [W,R]
     has_bl_c = tree.has_borrow_limit[arrays.w_cq, fcl]
     bl_c = tree.borrow_limit[arrays.w_cq, fcl]
-    slack0_chain = slack0[chains, fcl[:, None]]  # [W,D+1,R]
 
-    # Per-level sorted orders (static): entries sorted by ((chain node,
+    # Per-depth flavor-plane slices of the scan's node terms, [W,L,R].
+    fcol = fcl[:, None]
+    u0_al = usage[aligned, fcol]
+    lq_al = quota_ops.local_quota(tree)[aligned, fcol]
+    subtree_al = tree.subtree_quota[aligned, fcol]
+    bl_al = tree.borrow_limit[aligned, fcol]
+    has_bl_al = tree.has_borrow_limit[aligned, fcol]
+    stored_al = sat_sub(subtree_al, lq_al)
+
+    # Per-depth sorted orders (static): entries sorted by ((depth-k node,
     # flavor), rank) — contributions within a segment share the plane.
     perms = []
     heads = []
     inv_perms = []
-    for d in range(n_levels):
-        seg_id = chains[:, d].astype(jnp.int64) * f_n + fcl
+    for k in range(n_levels):
+        seg_id = aligned[:, k].astype(jnp.int64) * f_n + fcl
         key = seg_id * (w_n + 1) + rank
         perm = jnp.argsort(key)
         seg_sorted = seg_id[perm]
@@ -2281,22 +2325,62 @@ def admit_fixedpoint(
         heads.append(head)
         inv_perms.append(inv)
 
-    def chain_slack(contrib):
-        """min over chain levels of (slack0[b] - prefix_b(i)) for every
-        entry, given per-entry finalized/assumed plane contributions
-        [W,R]."""
-        avail = jnp.full((w_n, r_n), _INF64, dtype=jnp.int64)
-        for d in range(n_levels):
-            perm, head, inv = perms[d], heads[d], inv_perms[d]
-            pre = _seg_excl_prefix(contrib[perm], head)[inv]
-            term = sat_sub(slack0_chain[:, d], pre)
-            term = jnp.where(slack0_chain[:, d] >= _INF64, _INF64, term)
-            # Repeated root levels recompute the same term: harmless.
-            # The barrier keeps XLA from fusing every level's segmented
-            # prefix into one kernel, whose combined scoped buffers
-            # overflow the TPU's 16M vmem scratch limit.
-            avail = _vmem_barrier(jnp.minimum(avail, term))
-        return avail  # [W,R]
+    def bubble(contrib):
+        """Deepest-first absorption pass mirroring the scan's usage
+        bubbling: each entry's contribution enters at its CQ depth, the
+        node-local quota headroom (computed against base usage plus the
+        admission-rank-exclusive prefix of earlier arrivals) absorbs what
+        it can, and the remainder arrives at the parent depth. Returns
+        (u_cols: per-depth [W,R] step-time usage, pre_cq [W,R] the
+        earlier-arrivals prefix at the entry's own CQ, arrive_cols:
+        per-depth [W,R] amount arriving — the node's usage growth)."""
+        cur = jnp.zeros_like(contrib)
+        pre_cq = jnp.zeros_like(contrib)
+        u_cols = [None] * n_levels
+        arrive_cols = [None] * n_levels
+        for k in range(n_levels - 1, -1, -1):
+            at_cq = (depth_w == k)[:, None]
+            cur = cur + jnp.where(at_cq, contrib, 0)
+            arrive_cols[k] = cur
+            perm, head, inv = perms[k], heads[k], inv_perms[k]
+            pre = _seg_excl_prefix(cur[perm], head)[inv]
+            pre_cq = jnp.where(at_cq, pre, pre_cq)
+            u_k = u0_al[:, k] + pre
+            u_cols[k] = u_k
+            if k > 0:
+                # resource_node.go:67 localQuota absorption; entries
+                # shallower than k carry cur == 0 here, so their lanes
+                # are inert. The barrier keeps XLA from fusing every
+                # depth's segmented prefix into one kernel, whose
+                # combined scoped buffers overflow the TPU's 16M vmem
+                # scratch limit.
+                l_avail = jnp.maximum(0, sat_sub(lq_al[:, k], u_k))
+                cur = _vmem_barrier(jnp.maximum(0, sat_sub(cur, l_avail)))
+        return u_cols, pre_cq, arrive_cols
+
+    def chain_avail(contrib):
+        """Availability at every entry's CQ given assumed per-entry plane
+        contributions [W,R] — the scan's root-first walk (local
+        availability + borrow-clamped parent headroom per node) evaluated
+        against the bubbled step-time usage. Returns (avail [W,R],
+        pre_cq [W,R])."""
+        u_cols, pre_cq, _arrive = bubble(contrib)
+        avail = sat_sub(subtree_al[:, 0], u_cols[0])  # root slack
+        for k in range(1, n_levels):
+            u_k = u_cols[k]
+            l_avail = jnp.maximum(0, sat_sub(lq_al[:, k], u_k))
+            used_in_parent = jnp.maximum(0, sat_sub(u_k, lq_al[:, k]))
+            with_max = sat_add(
+                sat_sub(stored_al[:, k], used_in_parent), bl_al[:, k]
+            )
+            clamped = jnp.where(
+                has_bl_al[:, k], jnp.minimum(with_max, avail), avail
+            )
+            stepped = sat_add(l_avail, clamped)
+            avail = _vmem_barrier(
+                jnp.where(on_chain[:, k][:, None], stepped, avail)
+            )
+        return avail, pre_cq  # [W,R] each
 
     def body(state):
         admitted, rejected, reserved, decided, changed, rounds = state
@@ -2306,8 +2390,8 @@ def admit_fixedpoint(
         maybe = undecided & (is_fit | is_nc)
         contrib_hi = contrib_lo + jnp.where(maybe[:, None], delta, 0)
 
-        avail_lo = chain_slack(contrib_hi)  # worst case (most usage)
-        avail_hi = chain_slack(contrib_lo)  # best case (least usage)
+        avail_lo, pre_cq_hi = chain_avail(contrib_hi)  # worst case
+        avail_hi, pre_cq_lo = chain_avail(contrib_lo)  # best case
         exact = jnp.all(avail_lo == avail_hi, axis=1)
 
         fits_worst = jnp.all((delta <= avail_lo) | ~cell_mask, axis=1)
@@ -2322,14 +2406,10 @@ def admit_fixedpoint(
         # NO_CANDIDATES reserves finalize once the prefix AT THE CQ NODE is
         # exact (the clipped amount needs the true usage there —
         # scheduler.go:738 quotaResourcesToReserve). avail equality is not
-        # enough: the min can coincide while the level-0 prefix differs.
-        pre0 = _seg_excl_prefix(contrib_lo[perms[0]], heads[0])[inv_perms[0]]
-        pre0_hi = _seg_excl_prefix(
-            contrib_hi[perms[0]], heads[0]
-        )[inv_perms[0]]
-        exact0 = jnp.all(pre0 == pre0_hi, axis=1)
+        # enough: the min can coincide while the CQ-level prefix differs.
+        exact0 = jnp.all(pre_cq_lo == pre_cq_hi, axis=1)
         nc_final = undecided & is_nc & exact0
-        u_c = usage[arrays.w_cq, fcl] + pre0
+        u_c = usage[arrays.w_cq, fcl] + pre_cq_lo
         reserve_borrowing = jnp.where(
             has_bl_c,
             jnp.minimum(delta, sat_sub(sat_add(nominal_c, bl_c), u_c)),
@@ -2366,67 +2446,130 @@ def admit_fixedpoint(
     admitted, _rej, reserved, decided, _chg, rounds = jax.lax.while_loop(
         cond, body, init
     )
+    converged = jnp.all(decided)
 
-    # Final usage: base + all finalized contributions bubbled to ancestors.
+    # Final usage: base + finalized contributions bubbled through the
+    # lending-limit absorption — the amount ARRIVING at each depth is what
+    # that node's usage grows by (the scan stores exactly its deltas).
     contrib = jnp.where(admitted[:, None], delta, 0) + reserved
+    _u, _pre, arrive_cols = bubble(contrib)
     final_usage = usage
-    for d in range(n_levels):
-        add_d = jnp.zeros_like(usage)
-        # Scatter each entry's contribution at its chain-d node (on its
-        # flavor plane); repeated roots would double-count, so mask repeats.
-        is_repeat = (chains[:, d] == chains[:, d - 1]) if d > 0 else \
-            jnp.zeros(w_n, bool)
-        vals = jnp.where(is_repeat[:, None], 0, contrib)
-        add_d = add_d.at[chains[:, d], fcl].add(vals, mode="drop")
-        final_usage = quota_ops.sat(final_usage + add_d)
-    return final_usage, admitted, rounds
+    for k in range(n_levels):
+        arrive = jnp.where(on_chain[:, k][:, None], arrive_cols[k], 0)
+        add_k = jnp.zeros_like(usage).at[aligned[:, k], fcl].add(
+            arrive, mode="drop"
+        )
+        final_usage = quota_ops.sat(final_usage + add_k)
+    return final_usage, admitted, rounds, converged
 
 
 def make_fixedpoint_cycle(max_rounds: int = 64,
                           n_levels: int = MAX_DEPTH + 1):
     """Grouped-cycle equivalent using the fixed-point admission pass.
-    Exact iff the tree has no lending limits AND max_rounds suffices (the
-    driver checks the former; rounds cap is a safety net far above any
-    practical depth of contention cascades)."""
+
+    kernel-entry: cycle_fixedpoint
+    gate-requires: not idx.has_partial
+    gate-requires: arrays.s_req is None
+    gate-requires: arrays.tas_topo is None
+
+    Exact for every cycle meeting the preconditions above — including
+    lending-limit trees — provided the loop converges (the CycleOutputs
+    ``converged`` flag is checked by the driver; non-convergence triggers
+    a contained host fallback). Entries whose resolution needs the
+    preemption oracle stay ``needs_host`` and their trees fall back to
+    the host path, exactly as with the grouped scan's deferred entries;
+    the hybrid cycle below settles those on device instead."""
 
     def impl(arrays: CycleArrays, ga: GroupArrays) -> CycleOutputs:
         usage = arrays.usage
         nom = nominate(arrays, usage, n_levels=n_levels)
         order = admission_order(arrays, nom)
-        final_usage, admitted, _rounds = admit_fixedpoint(
+        final_usage, admitted, rounds, converged = admit_fixedpoint(
             arrays, ga, nom, usage, order, max_rounds, n_levels=n_levels
         )
-        outcome = jnp.where(
-            ~arrays.w_active,
-            OUT_NOFIT,
-            jnp.where(
-                nom.needs_host,
-                OUT_NEEDS_HOST,
-                jnp.where(
-                    admitted,
-                    OUT_ADMITTED,
-                    jnp.where(
-                        nom.best_pmode == P_FIT,
-                        OUT_FIT_SKIPPED,
-                        jnp.where(
-                            nom.best_pmode == P_NO_CANDIDATES,
-                            OUT_NO_CANDIDATES,
-                            OUT_NOFIT,
-                        ),
-                    ),
-                ),
-            ),
-        ).astype(jnp.int32)
-        return CycleOutputs(
-            outcome=outcome,
-            chosen_flavor=nom.chosen_flavor,
-            borrow=nom.best_borrow,
-            tried_flavor_idx=nom.tried_flavor_idx,
-            usage=final_usage,
-            order=order,
+        preempting = jnp.zeros_like(admitted)
+        return _finish_outputs(
+            arrays, nom, final_usage, admitted, preempting, order,
+            converged=converged, fp_rounds=rounds,
+        )
+
+    return impl
+
+
+def make_hybrid_preempt_cycle(s_resid: int, max_rounds: int = 64,
+                              unroll: int = 2,
+                              n_levels: int = MAX_DEPTH + 1):
+    """Fixed-point admission with a short residual preemption scan.
+
+    kernel-entry: cycle_fixedpoint_hybrid
+    gate-requires: not idx.has_partial
+    gate-requires: arrays.s_req is None
+    gate-requires: arrays.tas_topo is None
+
+    The preemption front half (oracle + victim search) runs exactly as in
+    the grouped-preempt cycle; then cohort trees are routed by quota
+    independence: a tree holding at least one device-resolved preemptor
+    (P_PREEMPT_OK) needs the scan's sequential designated-victim
+    bookkeeping, every other tree's admissions settle in the fixed-point
+    rounds. The residual scan runs with ``s_resid`` slots per group — the
+    driver computes a host-side bound (max active heads among trees that
+    can possibly preempt) so the residual is exact; victims never cross
+    trees, so the two partitions compose bit-identically to
+    ``cycle_grouped_preempt``."""
+    if s_resid < 1:
+        raise ValueError("s_resid must be >= 1 (use cycle_fixedpoint "
+                         "when no tree can preempt)")
+
+    def impl(arrays: CycleArrays, ga: GroupArrays, adm) -> CycleOutputs:
+        usage = arrays.usage
+        nom = nominate(arrays, usage, n_levels=n_levels)
+        nom, tgt = _resolve_preempt_nominate(arrays, adm, nom)
+        order = admission_order(arrays, nom)
+
+        g_n = ga.node_sel.shape[0]
+        g_w = ga.flat_to_group[arrays.w_cq]
+        pre_w = arrays.w_active & (nom.best_pmode == P_PREEMPT_OK)
+        g_resid = jnp.zeros(g_n, bool).at[g_w].max(pre_w, mode="drop")
+        in_resid = g_resid[g_w] & arrays.w_active
+
+        fp_usage, fp_admit, rounds, converged = admit_fixedpoint(
+            arrays._replace(w_active=arrays.w_active & ~in_resid),
+            ga, nom, usage, order, max_rounds, n_levels=n_levels,
+        )
+        res = admit_scan_grouped(
+            arrays._replace(w_active=in_resid), ga, nom, usage, order,
+            s_resid, adm=adm, targets=tgt, unroll=unroll,
+            n_levels=n_levels,
+        )
+        # Cohort trees share no quota cells, so each partition's usage
+        # delta touches only its own trees' planes: the merge is additive.
+        final_usage = quota_ops.sat(fp_usage + (res.usage - usage))
+        admitted = fp_admit | res.admitted
+        return _finish_outputs(
+            arrays, nom, final_usage, admitted, res.preempting, order,
+            victims=tgt.victims, variant=tgt.variant,
+            converged=converged, fp_rounds=rounds,
         )
 
     return impl
 
 
 cycle_fixedpoint = jax.jit(make_fixedpoint_cycle())
+
+
+@functools.lru_cache(maxsize=None)
+def fixedpoint_cycle_for(max_rounds: int = 64):
+    """Jitted pure fixed-point cycle for a rounds cap (shared across
+    dispatch + prewarm so each cap compiles once per shape)."""
+    if max_rounds == 64:
+        return cycle_fixedpoint
+    return jax.jit(make_fixedpoint_cycle(max_rounds=max_rounds))
+
+
+@functools.lru_cache(maxsize=None)
+def fixedpoint_cycle_preempt_for(s_resid: int, max_rounds: int = 64):
+    """Jitted hybrid cycle for a residual-scan bound (the driver buckets
+    the bound on the pow2 ladder so executables are reused)."""
+    return jax.jit(
+        make_hybrid_preempt_cycle(s_resid, max_rounds=max_rounds)
+    )

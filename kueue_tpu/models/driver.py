@@ -54,6 +54,20 @@ class PlaneValidationError(ValueError):
         super().__init__(f"plane validation failed [{check}]: {detail}")
 
 
+class FixedpointRoundsError(RuntimeError):
+    """The fixed-point kernel hit ``max_rounds`` before every head
+    decided.
+
+    The kernel's bounds are conservative while undecided, so a truncated
+    run could leave heads stuck in their initial "undecided" outcome
+    (OUT_NOFIT plane values that the full run would have admitted).
+    Raised by :meth:`DeviceScheduler._read_planes` BEFORE any admission
+    from the cycle is applied; the containment path reroutes the whole
+    cycle through the host-exact scheduler
+    (``solver_fallback_cycles_total{reason="fixedpoint_rounds"}``).
+    """
+
+
 class DeviceScheduler:
     """Hybrid device/host scheduler."""
 
@@ -73,6 +87,8 @@ class DeviceScheduler:
         breaker_threshold: int = 3,
         breaker_backoff_s: float = 1.0,
         breaker_max_backoff_s: float = 60.0,
+        device_kernel: str = "scan",
+        fixedpoint_max_rounds: int = 64,
     ) -> None:
         self.cache = cache
         self.queues = queues
@@ -84,7 +100,26 @@ class DeviceScheduler:
                               clock=clock)
         self.device_time_s = 0.0
         self.cycles = 0
-        self.use_fixedpoint = False
+        # Admission-kernel selection (see docs/perf.md coverage matrix):
+        #   "scan"       — grouped-preempt scan always (the safe default);
+        #   "fixedpoint" — pure fixed-point rounds whenever exact
+        #                  (preemption-needing trees defer to the host);
+        #   "auto"       — widest exact kernel per cycle: pure fixed-point
+        #                  when no tree can preempt, the fixed-point +
+        #                  residual-scan hybrid otherwise, the scan for
+        #                  shapes the fixed-point kernel does not cover
+        #                  (multislot / TAS / partial). Fair sharing always
+        #                  uses its own tournament kernel.
+        if device_kernel not in ("scan", "fixedpoint", "auto"):
+            raise ValueError(
+                f"device_kernel must be scan|fixedpoint|auto, "
+                f"got {device_kernel!r}"
+            )
+        self.device_kernel = device_kernel
+        self.fixedpoint_max_rounds = int(fixedpoint_max_rounds)
+        # Rounds the most recent fixed-point dispatch took (None when the
+        # last cycle used a scan kernel) — cost-ledger lane + diagnostics.
+        self._last_fp_rounds: Optional[int] = None
         # Incremental cycle encoding: device-resident snapshot arena with
         # row-level delta updates (models/arena.py). verify_arena re-encodes
         # from scratch every incremental cycle and asserts bit-identity.
@@ -123,6 +158,18 @@ class DeviceScheduler:
         # Optional what-if engine refreshed in spare time (attach_whatif).
         self._whatif = None
         self._whatif_interval_s = 30.0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def use_fixedpoint(self) -> bool:
+        """Legacy boolean view of :attr:`device_kernel` (pre-config-layer
+        API): True when a fixed-point mode is selected."""
+        return self.device_kernel in ("fixedpoint", "auto")
+
+    @use_fixedpoint.setter
+    def use_fixedpoint(self, value: bool) -> None:
+        self.device_kernel = "fixedpoint" if value else "scan"
 
     # ------------------------------------------------------------------
 
@@ -207,11 +254,28 @@ class DeviceScheduler:
                         (arrays, idx.group_arrays, idx.admitted_arrays),
                         aot=aot,
                     )
-                    if self.use_fixedpoint:
+                    if self.device_kernel in ("fixedpoint", "auto"):
+                        max_r = self.fixedpoint_max_rounds
                         timings[bucket] += compile_cache.prewarm_entry(
                             "cycle_fixedpoint",
-                            batch_scheduler.cycle_fixedpoint,
-                            (arrays, idx.group_arrays), aot=aot,
+                            batch_scheduler.fixedpoint_cycle_for(max_r),
+                            (arrays, idx.group_arrays),
+                            static=("rounds", max_r), aot=aot,
+                        )
+                    if self.device_kernel == "auto":
+                        # Hybrid: warm the residual ladder's floor rung —
+                        # the common case (few preemptors per tree); deeper
+                        # residuals compile on first use like any bucket
+                        # growth.
+                        s_b = 4
+                        timings[bucket] += compile_cache.prewarm_entry(
+                            "cycle_fixedpoint_hybrid",
+                            batch_scheduler.fixedpoint_cycle_preempt_for(
+                                s_b, max_r
+                            ),
+                            (arrays, idx.group_arrays, idx.admitted_arrays),
+                            static=("s_resid", s_b, "rounds", max_r),
+                            aot=aot,
                         )
             if tracing.ENABLED:
                 tracing.set_gauge("solver_prewarm_state", 2)  # done
@@ -357,10 +421,12 @@ class DeviceScheduler:
                     faults.fire(faults.SOLVER_DISPATCH)
                 # Default kernel: forest-grouped scan with on-device
                 # classical preemption. Fair sharing swaps in the DRS
-                # tournament kernel. The fixed-point kernel (exact for
-                # no-lending-limit trees, no device preemption) is opt-in
-                # until TPU measurements establish the crossover; bench.py
-                # probes both.
+                # tournament kernel. The fixed-point kernel is exact for
+                # every shape except multislot / TAS / partial (lending
+                # limits included); "auto" adds the hybrid for cycles
+                # needing device preemption. The gate conditions below are
+                # pinned against each kernel factory's docstring markers
+                # by tools/check_kernel_gates.py.
                 if self.fair_sharing:
                     from kueue_tpu.models.fair_kernel import (
                         fair_cycle_preempt_for,
@@ -375,19 +441,45 @@ class DeviceScheduler:
                             arrays, idx.admitted_arrays,
                             static=("s_max", idx.fair_s_bound),
                         )
-                elif self.use_fixedpoint and not idx.has_partial \
+                elif self.device_kernel in ("fixedpoint", "auto") \
+                        and not idx.has_partial \
                         and arrays.s_req is None \
-                        and arrays.tas_topo is None and not bool(
-                    np.asarray(arrays.tree.has_lend_limit).any()
-                ):
-                    entry = "cycle_fixedpoint"
-                    with tracing.span("device/cycle_fixedpoint",
-                                      batch=bucket):
-                        out = compile_cache.dispatch(
-                            "cycle_fixedpoint",
-                            batch_scheduler.cycle_fixedpoint,
-                            arrays, idx.group_arrays,
-                        )
+                        and arrays.tas_topo is None:
+                    max_r = self.fixedpoint_max_rounds
+                    # Residual preemption-scan bound: 0 when no tree can
+                    # possibly preempt this cycle (pure fixed-point is
+                    # then exact — preemption-needing entries would defer
+                    # to the host via needs_host, as before). Strict
+                    # "fixedpoint" mode keeps the pure kernel regardless,
+                    # trading those trees to the host path.
+                    s_resid = (
+                        self._residual_scan_bound(arrays, idx)
+                        if self.device_kernel == "auto" else 0
+                    )
+                    if s_resid > 0:
+                        entry = "cycle_fixedpoint_hybrid"
+                        s_b = buckets.pow2_bucket(s_resid, floor=4)
+                        with tracing.span("device/cycle_fixedpoint_hybrid",
+                                          batch=bucket):
+                            out = compile_cache.dispatch(
+                                "cycle_fixedpoint_hybrid",
+                                batch_scheduler.fixedpoint_cycle_preempt_for(
+                                    s_b, max_r
+                                ),
+                                arrays, idx.group_arrays,
+                                idx.admitted_arrays,
+                                static=("s_resid", s_b, "rounds", max_r),
+                            )
+                    else:
+                        entry = "cycle_fixedpoint"
+                        with tracing.span("device/cycle_fixedpoint",
+                                          batch=bucket):
+                            out = compile_cache.dispatch(
+                                "cycle_fixedpoint",
+                                batch_scheduler.fixedpoint_cycle_for(max_r),
+                                arrays, idx.group_arrays,
+                                static=("rounds", max_r),
+                            )
                 else:
                     with tracing.span("device/cycle_grouped_preempt",
                                       batch=bucket):
@@ -444,6 +536,10 @@ class DeviceScheduler:
                     if not self.containment:
                         raise
                     fault = ("plane_validation", exc)
+                except FixedpointRoundsError as exc:
+                    if not self.containment:
+                        raise
+                    fault = ("fixedpoint_rounds", exc)
                 except Exception as exc:
                     if not self._containable(exc):
                         raise
@@ -475,10 +571,15 @@ class DeviceScheduler:
                 # device_time_s, so ledger sums reconcile against the
                 # driver's own totals; W lanes: real heads vs the padded
                 # bucket the executable actually ran.
-                costs.charge(
-                    entry, bucket, dt,
-                    lanes={"W": (len(heads), bucket)},
-                )
+                lanes = {"W": (len(heads), bucket)}
+                if self._last_fp_rounds is not None:
+                    # Rounds lane: real rounds taken vs the compiled
+                    # round budget — the fixed-point analogue of padding
+                    # waste (unused while_loop headroom).
+                    lanes["rounds"] = (
+                        self._last_fp_rounds, self.fixedpoint_max_rounds
+                    )
+                costs.charge(entry, bucket, dt, lanes=lanes)
             if tracing.ENABLED:
                 tracing.observe("solver_device_seconds", dt,
                                 {"kernel": "batch_cycle"})
@@ -612,6 +713,7 @@ class DeviceScheduler:
                 timings=rec_t, result=result,
                 duration_s=result.duration_s,
                 idx=idx, planes=planes,
+                kernel=entry if planes is not None else "",
             )
         return result
 
@@ -644,6 +746,46 @@ class DeviceScheduler:
     def _in_discarded(info, snapshot, discarded_roots) -> bool:
         cqs = snapshot.cluster_queues.get(info.cluster_queue)
         return cqs is not None and id(cqs.node.root()) in discarded_roots
+
+    @staticmethod
+    def _residual_scan_bound(arrays, idx) -> int:
+        """Upper bound on the residual scan length the hybrid kernel
+        needs for THIS cycle, host-side from already-resident encode
+        arrays (no device sync).
+
+        A tree can only produce a P_PREEMPT_OK nomination when it has an
+        active head on a CQ whose policies allow preemption at all
+        (``~never_preempts``) AND at least one admitted workload to
+        victimize. The residual scan processes only such trees' active
+        heads, so the per-tree active-head maximum over those trees
+        bounds the sequential steps exactly like ``s_max`` bounds the
+        full scan. Returns 0 when no tree qualifies — the pure
+        fixed-point kernel is then exact (preemption-needing entries
+        would have deferred to the host anyway).
+        """
+        w_cq = np.asarray(arrays.w_cq)
+        act = np.asarray(arrays.w_active)
+        if not act.any() or not idx.admitted:
+            return 0
+        never = np.asarray(arrays.never_preempts)
+        flat_to_group = np.asarray(idx.group_arrays.flat_to_group)
+        g_w = flat_to_group[w_cq]
+        can_pre = act & ~never[w_cq]
+        if not can_pre.any():
+            return 0
+        adm_active = np.asarray(idx.admitted_arrays.active)
+        if not adm_active.any():
+            return 0
+        adm_groups = np.unique(
+            flat_to_group[np.asarray(idx.admitted_arrays.cq)[adm_active]]
+        )
+        resid = np.zeros(int(flat_to_group.max()) + 1, dtype=bool)
+        resid[adm_groups] = True
+        g_resid = np.unique(g_w[can_pre & resid[g_w]])
+        if g_resid.size == 0:
+            return 0
+        counts = np.bincount(g_w[act], minlength=int(resid.size))
+        return int(counts[g_resid].max())
 
     # -- fault containment ---------------------------------------------------
 
@@ -705,6 +847,22 @@ class DeviceScheduler:
         individual planes)."""
         if faults.ENABLED:
             faults.fire(faults.DEVICE_READBACK)
+        # Convergence gate first: a fixed-point run that exhausted its
+        # round budget has undefined undecided rows, so nothing from the
+        # cycle may apply. Observe the rounds histogram either way —
+        # exhaustion is exactly when the operator needs the data point.
+        if out.converged is not None:
+            rounds = int(np.asarray(out.fp_rounds))
+            self._last_fp_rounds = rounds
+            if tracing.ENABLED:
+                tracing.observe("solver_fixedpoint_rounds", float(rounds))
+            if not bool(np.asarray(out.converged)):
+                raise FixedpointRoundsError(
+                    f"fixed-point kernel undecided after {rounds} rounds "
+                    f"(max_rounds={self.fixedpoint_max_rounds})"
+                )
+        else:
+            self._last_fp_rounds = None
         outcome = np.asarray(out.outcome)  # first blocking read
         chosen = np.asarray(out.chosen_flavor)
         tried = np.asarray(out.tried_flavor_idx)

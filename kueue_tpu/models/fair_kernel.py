@@ -786,6 +786,9 @@ def fair_admit_scan(
 def make_fair_cycle(s_max: int = 0, preempt: bool = False):
     """Jittable fair-sharing cycle: nominate -> DRS tournament scan.
 
+    kernel-entry: cycle_fair_preempt
+    gate-requires: self.fair_sharing
+
     With ``preempt=True`` the cycle takes the AdmittedArrays and resolves
     the fair preemption tournament on device for eligible entries
     (models/fair_preempt_kernel.py) before the admission scan."""

@@ -64,8 +64,9 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
     scan depth (see admit_scan_grouped). ``kernel`` selects the per-round
     admission pass: "grouped" (the sequential per-tree scan),
     "fixedpoint" (monotone-bounds rounds — usually far fewer device steps
-    per cycle; exact only for lending-limit-free trees, which the caller
-    must check), "pallas" (the whole per-tree scan as one Pallas
+    per cycle; exact for every tree shape including lending limits, but
+    resolves no preemptions — preempt-needing entries stay pending),
+    "pallas" (the whole per-tree scan as one Pallas
     kernel with VMEM-resident state — exact only when
     ``pallas_scan.fits_int32`` holds for the cycle arrays, which the
     caller must check; ``interpret`` runs it in interpreter mode
@@ -168,7 +169,7 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
                 admit = fair_admit_scan(a, nom, usage, s_max).admitted
             elif kernel == "fixedpoint":
                 order = bs.admission_order(a, nom)
-                _u, admit, _r = bs.admit_fixedpoint(
+                _u, admit, _r, _conv = bs.admit_fixedpoint(
                     a, ga, nom, usage, order, n_levels=n_levels
                 )
             elif kernel == "pallas":

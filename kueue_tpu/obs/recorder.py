@@ -78,6 +78,10 @@ class CycleRecord:
     arena: bool
     breaker_state: float
     fallback_reason: Optional[str] = None
+    # Device kernel entry that decided the cycle ("cycle_grouped_preempt",
+    # "cycle_fixedpoint", "cycle_fixedpoint_hybrid", "cycle_fair_preempt");
+    # "" when no device readback applied (host / contained / fallback).
+    kernel: str = ""
     encode_s: float = 0.0
     dispatch_s: float = 0.0
     readback_s: float = 0.0
@@ -128,6 +132,7 @@ class FlightRecorder:
                 d = asdict(att)
                 d["cycle"] = rec.cycle
                 d["ts"] = rec.ts
+                d["kernel"] = rec.kernel
                 out.append(d)
         return out[-limit:]
 
@@ -214,6 +219,7 @@ def capture_cycle(
     duration_s: float = 0.0,
     idx=None,
     planes=None,
+    kernel: str = "",
 ) -> None:
     """Build and append one CycleRecord from state the cycle already has
     in hand. ``planes`` is the driver's _read_planes tuple (or None when
@@ -228,6 +234,7 @@ def capture_cycle(
         generation=generations[0], workload_generation=generations[1],
         arena=arena, breaker_state=breaker_state,
         fallback_reason=fallback_reason,
+        kernel=kernel,
         encode_s=t.get("encode_s", 0.0),
         dispatch_s=t.get("dispatch_s", 0.0),
         readback_s=t.get("readback_s", 0.0),

@@ -58,6 +58,10 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "speedup_x": "higher",
         "warm_first_admission_s": "lower",
     },
+    "scanfloor": {
+        "fp_speedup": "higher",
+        "rounds_max": "lower",
+    },
 }
 
 _REQUIRED_KEYS = (

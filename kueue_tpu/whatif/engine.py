@@ -629,9 +629,10 @@ class WhatIfEngine:
             nominal=jnp.asarray(nominal), active=jnp.asarray(active)
         )
 
-        kernel = ("grouped"
-                  if bool(np.asarray(arrays.tree.has_lend_limit).any())
-                  else "fixedpoint")
+        # The fixed-point pass is exact for lending-limit trees too (its
+        # chain walk mirrors the scan's cohort-lending bookkeeping), so
+        # every forecast shares one rollout executable per s_max bucket.
+        kernel = "fixedpoint"
         s_max = _pow2(int(base_active.sum()) + len(hypo_rows), floor=8)
         fn = self._rollout_fn(s_max, kernel)
         arrays_d, ga_d = jax.device_put((arrays, idx.group_arrays))

@@ -71,10 +71,10 @@ def test_s_max_truncation_requeues_tail():
     assert int(np.asarray(out.usage)[cq_node].sum()) == 5 * 1000
 
 
-def test_fixedpoint_gated_off_for_lending_limits():
-    """The driver must not use the fixed-point kernel when any lending
-    limit exists (its closed form assumes full usage bubbling); the
-    lend-limit scenario stays exact via the grouped scan."""
+def test_fixedpoint_exact_for_lending_limits():
+    """Lending-limit trees now route through the fixed-point kernel
+    (its depth-aligned chain walk reproduces the scan's cohort-lending
+    bookkeeping); the lend-limit scenario must stay host-exact."""
     def build():
         return build_env(
             [
@@ -92,7 +92,7 @@ def test_fixedpoint_gated_off_for_lending_limits():
         cache, queues, host = build()
         sched = DeviceScheduler(cache, queues) if device else host
         if device:
-            sched.use_fixedpoint = True  # must be ignored for this tree
+            sched.use_fixedpoint = True  # lending limits stay exact
         # cq-b borrows: cq-a lends at most 2000 of its 4000.
         wls = [
             make_wl("b1", queue="lq-cq-b", cpu_m=1500, creation_time=1.0),

@@ -1,6 +1,7 @@
 """Differential tests: fixed-point admission vs the grouped sequential scan
-on random no-lending-limit problems — outcomes and final usage must be
-identical (both are order-exact greedy admission)."""
+on random problems — outcomes and final usage must be identical (both are
+order-exact greedy admission). Covers flat and nested mixed-depth cohort
+forests, borrow limits, and lending limits."""
 
 import numpy as np
 import pytest
@@ -14,27 +15,68 @@ from kueue_tpu.core.resources import UNLIMITED
 
 
 def synth(seed, W=64, C=10, F=3, R=2, COHORTS=3, with_bl=True,
-          never_preempts=True):
+          never_preempts=True, with_ll=False, nested=False):
     rng = np.random.default_rng(seed)
-    N = C + COHORTS
+    MIDS = COHORTS if nested else 0
+    N = COHORTS + MIDS + C
+    cq0 = COHORTS + MIDS
     parent = np.full(N, -1, np.int32)
-    depth = np.zeros(N, np.int32)
-    height = np.zeros(N, np.int32)
-    for i in range(COHORTS, N):
-        parent[i] = rng.integers(0, COHORTS)
-        depth[i] = 1
-    height[:COHORTS] = 1
     is_cq = np.zeros(N, bool)
-    is_cq[COHORTS:] = True
+    is_cq[cq0:] = True
+    for i in range(COHORTS, cq0):
+        parent[i] = rng.integers(0, COHORTS)
+    for i in range(cq0, N):
+        if nested:
+            # Mixed depths on purpose: CQs at depth 1 (under a root) and
+            # depth 2 (under a mid cohort) share interior cohort
+            # capacity in one tree; a few standalone depth-0 CQs ride
+            # along. This is the shape class the depth-aligned chain
+            # walk exists for.
+            r = rng.random()
+            if r < 0.1:
+                parent[i] = -1
+            elif r < 0.45:
+                parent[i] = rng.integers(0, COHORTS)
+            else:
+                parent[i] = rng.integers(COHORTS, cq0)
+        else:
+            parent[i] = rng.integers(0, COHORTS)
+    depth = np.zeros(N, np.int32)
+    for i in range(N):
+        p, d = parent[i], 0
+        while p >= 0:
+            d += 1
+            p = parent[p]
+        depth[i] = d
+    height = np.zeros(N, np.int32)
+    for i in range(N - 1, -1, -1):
+        if parent[i] >= 0:
+            height[parent[i]] = max(height[parent[i]], height[i] + 1)
     nominal = np.zeros((N, F, R), np.int64)
-    nominal[COHORTS:] = rng.integers(0, 10, (C, F, R)) * 1000
+    nominal[cq0:] = rng.integers(0, 10, (C, F, R)) * 1000
+    if nested:
+        # Interior cohorts hold quota of their own sometimes.
+        mid_mask = rng.random((MIDS, F, R)) < 0.5
+        nominal[COHORTS:cq0][mid_mask] = (
+            rng.integers(0, 6, (MIDS, F, R)) * 1000
+        )[mid_mask]
     has_bl = np.zeros((N, F, R), bool)
     bl = np.full((N, F, R), UNLIMITED, np.int64)
     if with_bl:
         mask = rng.random((C, F, R)) < 0.5
-        has_bl[COHORTS:] = mask
-        bl[COHORTS:][mask] = (
+        has_bl[cq0:] = mask
+        bl[cq0:][mask] = (
             rng.integers(0, 8, (C, F, R)) * 1000
+        )[mask]
+    has_ll = np.zeros((N, F, R), bool)
+    ll = np.full((N, F, R), UNLIMITED, np.int64)
+    if with_ll:
+        # Lending limits on CQ rows and (nested) on interior cohorts —
+        # the walk must honour retained local quota at EVERY chain node.
+        mask = rng.random((N - COHORTS, F, R)) < 0.5
+        has_ll[COHORTS:] = mask
+        ll[COHORTS:][mask] = (
+            rng.integers(0, 8, (N - COHORTS, F, R)) * 1000
         )[mask]
     tree = QuotaTreeArrays(
         parent=jnp.asarray(parent), active=jnp.ones(N, bool),
@@ -42,8 +84,8 @@ def synth(seed, W=64, C=10, F=3, R=2, COHORTS=3, with_bl=True,
         nominal=jnp.asarray(nominal),
         borrow_limit=jnp.asarray(bl),
         has_borrow_limit=jnp.asarray(has_bl),
-        lend_limit=jnp.full((N, F, R), UNLIMITED, jnp.int64),
-        has_lend_limit=jnp.zeros((N, F, R), bool),
+        lend_limit=jnp.asarray(ll),
+        has_lend_limit=jnp.asarray(has_ll),
         subtree_quota=jnp.zeros((N, F, R), jnp.int64),
     )
     usage0 = jnp.asarray(
@@ -70,7 +112,7 @@ def synth(seed, W=64, C=10, F=3, R=2, COHORTS=3, with_bl=True,
         policy_within=jnp.zeros(N, jnp.int32),
         policy_reclaim=jnp.zeros(N, jnp.int32),
         nominal_cq=tree.nominal,
-        w_cq=jnp.asarray(rng.integers(COHORTS, N, W).astype(np.int32)),
+        w_cq=jnp.asarray(rng.integers(cq0, N, W).astype(np.int32)),
         w_req=jnp.asarray(rng.integers(0, 6, (W, R)) * 500),
         w_elig=jnp.asarray(rng.random((W, F)) < 0.85),
         w_active=jnp.asarray(rng.random(W) < 0.95),
@@ -109,3 +151,79 @@ def test_fixedpoint_matches_with_preempt_capable_cqs(seed):
         np.asarray(out_scan.outcome), np.asarray(out_fp.outcome))
     np.testing.assert_array_equal(
         np.asarray(out_scan.usage), np.asarray(out_fp.usage))
+
+
+def _assert_kernels_match(arrays, ga, seed):
+    out_scan = bs.cycle_grouped(arrays, ga)
+    out_fp = bs.cycle_fixedpoint(arrays, ga)
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.outcome), np.asarray(out_fp.outcome),
+        err_msg=f"outcomes differ (seed {seed})",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_scan.usage), np.asarray(out_fp.usage),
+        err_msg=f"final usage differs (seed {seed})",
+    )
+    assert bool(np.asarray(out_fp.converged)), seed
+    assert 0 < int(np.asarray(out_fp.fp_rounds)) <= 64
+
+
+@pytest.mark.parametrize("seed", range(80))
+def test_fixedpoint_matches_scan_with_lending_limits(seed):
+    """The generalized chain walk reproduces the scan's cohort-lending
+    bookkeeping exactly — the shape class the old kernel was gated off."""
+    _assert_kernels_match(*synth(200 + seed, with_ll=True), seed)
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_fixedpoint_matches_scan_nested_mixed_depth(seed):
+    """Nested cohorts with CQs at mixed depths (0/1/2) sharing interior
+    cohort capacity, lending limits on CQs AND interior cohorts."""
+    _assert_kernels_match(
+        *synth(300 + seed, nested=True, with_ll=True), seed)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fixedpoint_matches_scan_nested_no_ll(seed):
+    _assert_kernels_match(*synth(400 + seed, nested=True), seed)
+
+
+def test_fixedpoint_reports_convergence_flag():
+    """A tree where round k's decision unlocks round k+1's rejection:
+    with the round budget cut to 1 the kernel must say so instead of
+    silently shipping undecided planes."""
+    arrays, ga = synth(0, W=8, C=1, F=1, R=1, COHORTS=1, with_bl=False)
+    # One CQ, quota 1000; two entries of 600: round 1 decides the first
+    # (exact prefix), round 2 rejects the second.
+    tree = arrays.tree
+    nominal = np.zeros_like(np.asarray(tree.nominal))
+    nominal[1] = 1000
+    tree = tree._replace(
+        nominal=jnp.asarray(nominal),
+        has_borrow_limit=jnp.zeros_like(tree.has_borrow_limit),
+        borrow_limit=jnp.full_like(tree.borrow_limit, UNLIMITED),
+    )
+    usage0 = jnp.zeros_like(arrays.usage)
+    subtree, usage = compute_subtree(
+        tree, usage0, jnp.asarray(np.arange(2) == 1))
+    arrays = arrays._replace(
+        tree=tree._replace(subtree_quota=subtree), usage=usage,
+        nominal_cq=jnp.asarray(nominal),
+        w_cq=jnp.ones(8, jnp.int32),
+        w_req=jnp.full((8, 1), 600, jnp.int64),
+        w_elig=jnp.ones((8, 1), bool),
+        w_active=jnp.asarray(np.arange(8) < 2),
+        w_priority=jnp.zeros(8, jnp.int64),
+        w_quota_reserved=jnp.zeros(8, bool),
+    )
+    full = bs.cycle_fixedpoint(arrays, ga)
+    assert bool(np.asarray(full.converged))
+    assert int(np.asarray(full.fp_rounds)) == 2
+    outcome = np.asarray(full.outcome)
+    assert outcome[0] == bs.OUT_ADMITTED
+    # Nominate saw free quota (P_FIT) but the admit pass rejected it.
+    assert outcome[1] == bs.OUT_FIT_SKIPPED
+
+    starved = bs.make_fixedpoint_cycle(max_rounds=1)(arrays, ga)
+    assert not bool(np.asarray(starved.converged))
+    assert int(np.asarray(starved.fp_rounds)) == 1

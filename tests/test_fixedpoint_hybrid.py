@@ -1,0 +1,212 @@
+"""Differentials for the fixed-point preemption hybrid and auto kernel
+mode.
+
+Kernel level: on encoded preemption cycles captured from real driver
+runs, ``make_hybrid_preempt_cycle`` must produce planes bit-identical to
+``cycle_grouped_preempt``. Driver level: ``device_kernel="auto"`` must
+match the host-exact scheduler (admissions, flavors, victims) with zero
+host fallback, record which kernel decided in the flight recorder, and
+contain a rounds-cap exhaustion as a ``fixedpoint_rounds`` fallback."""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import ResourceQuota
+from kueue_tpu.models import batch_scheduler as bs
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.obs import recorder as flight
+from kueue_tpu.perf import compile_cache
+
+from .helpers import build_env, make_cq, make_wl, submit
+from .test_device_preemption import random_scenario
+
+pytestmark = pytest.mark.isolated
+
+# One hybrid compile for the whole module: every captured cycle has
+# bucket 16 (the ladder floor on these tiny scenarios), and 16 residual
+# steps dominate any per-tree active-head count at that bucket.
+S_RESID = 16
+
+
+def _capture_preempt_cycles(seed):
+    """Run the scan-kernel driver on a preemption scenario and capture
+    every (arrays, ga, adm) triple actually dispatched — real encoded
+    cycles, admitted arrays included."""
+    flavor_specs, cohorts, cqs, wave1, wave2 = random_scenario(seed)
+    cache, queues, _ = build_env(cqs, cohorts=cohorts, flavors=flavor_specs)
+    sched = DeviceScheduler(cache, queues)
+    captured = []
+    orig = compile_cache.dispatch
+
+    def spy(entry, fn, *a, **kw):
+        if entry == "cycle_grouped_preempt":
+            captured.append(a)
+        return orig(entry, fn, *a, **kw)
+
+    compile_cache.dispatch = spy
+    try:
+        submit(queues, *wave1)
+        sched.schedule_all(max_cycles=40)
+        submit(queues, *wave2)
+        sched.schedule_all(max_cycles=40)
+    finally:
+        compile_cache.dispatch = orig
+    return captured
+
+
+_PLANES = (
+    "outcome", "chosen_flavor", "tried_flavor_idx", "usage",
+    "victims", "victim_variant",
+)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_hybrid_planes_match_grouped_preempt(seed):
+    """Every captured real cycle (~5 per seed) is one differential
+    scenario; 12 seeds comfortably clear 60 distinct cycles."""
+    cycles = _capture_preempt_cycles(seed)
+    assert cycles, f"seed {seed} captured no device cycles"
+    hybrid = bs.fixedpoint_cycle_preempt_for(S_RESID)
+    for n, (arrays, ga, adm) in enumerate(cycles):
+        if int(np.asarray(arrays.w_cq).shape[0]) != 16:
+            continue  # keep the one-compile guarantee
+        out_scan = bs.cycle_grouped_preempt(arrays, ga, adm)
+        out_h = hybrid(arrays, ga, adm)
+        for plane in _PLANES:
+            a, b = getattr(out_scan, plane), getattr(out_h, plane)
+            if a is None or b is None:
+                assert a is None and b is None, (seed, n, plane)
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"plane {plane} differs (seed {seed} cycle {n})",
+            )
+        assert bool(np.asarray(out_h.converged)), (seed, n)
+        assert int(np.asarray(out_h.fp_rounds)) <= 8, (seed, n)
+
+
+def _run_mode(seed, mode):
+    flavor_specs, cohorts, cqs, wave1, wave2 = random_scenario(seed)
+    cache, queues, host = build_env(
+        cqs, cohorts=cohorts, flavors=flavor_specs
+    )
+    evictions = []
+    if mode is None:
+        sched, inner = host, host
+    else:
+        sched = DeviceScheduler(cache, queues, device_kernel=mode)
+        inner = sched.host
+    orig_evict = inner.evict_fn
+
+    def evict(victim, eviction_reason, preemption_reason):
+        evictions.append(f"{victim.obj.name}:{preemption_reason}")
+        orig_evict(victim, eviction_reason, preemption_reason)
+
+    inner.evict_fn = evict
+    submit(queues, *wave1)
+    sched.schedule_all(max_cycles=40)
+    submit(queues, *wave2)
+    sched.schedule_all(max_cycles=40)
+    admissions = {}
+    for key, info in cache.workloads.items():
+        adm = info.obj.status.admission
+        admissions[info.obj.name] = str(
+            sorted(adm.pod_set_assignments[0].flavors.items())
+        )
+    faults = 0 if mode is None else sched.fault_fallback_cycles
+    return admissions, sorted(admissions), sorted(evictions), faults
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_auto_mode_matches_host(seed):
+    host_adm, host_names, host_ev, _ = _run_mode(seed, None)
+    dev_adm, dev_names, dev_ev, faults = _run_mode(seed, "auto")
+    # Individual needs-host entries (probe verdicts the oracle must
+    # decide) route host-side in EVERY device mode; what auto must
+    # never do is trip a contained-fault whole-cycle fallback.
+    assert faults == 0, (seed, faults)
+    assert dev_names == host_names, (seed, host_names, dev_names)
+    assert dev_ev == host_ev, (seed, host_ev, dev_ev)
+    for name in host_names:
+        assert dev_adm[name] == host_adm[name], (seed, name)
+
+
+def _two_round_env():
+    """Two CQs in one cohort, two 600-cell heads over 1000 shared quota:
+    round 1 settles the first head, round 2 rejects the borrower — the
+    minimal cycle needing two fixed-point rounds."""
+    cache, queues, _ = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"f0": {"cpu": ResourceQuota(1000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"f0": {"cpu": ResourceQuota(0)}}),
+        ],
+    )
+    wa = make_wl("wa", queue="lq-cq-a", cpu_m=600, priority=100,
+                 creation_time=1.0)
+    wb = make_wl("wb", queue="lq-cq-b", cpu_m=600, priority=0,
+                 creation_time=2.0)
+    return cache, queues, wa, wb
+
+
+def test_rounds_cap_exhaustion_contained():
+    cache, queues, wa, wb = _two_round_env()
+    sched = DeviceScheduler(cache, queues, device_kernel="fixedpoint",
+                            fixedpoint_max_rounds=1)
+    submit(queues, wa, wb)
+    sched.schedule_all(max_cycles=10)
+    assert sched.last_fault is not None
+    assert sched.last_fault[0] == "fixedpoint_rounds"
+    assert sched.fault_fallback_cycles >= 1
+    # Contained: the host fallback still produced the exact end state.
+    assert "default/wa" in cache.workloads
+    assert cache.workloads["default/wa"].obj.status.admission is not None
+    assert "default/wb" not in cache.workloads
+
+
+def test_rounds_cap_sufficient_stays_on_device():
+    cache, queues, wa, wb = _two_round_env()
+    sched = DeviceScheduler(cache, queues, device_kernel="fixedpoint")
+    submit(queues, wa, wb)
+    sched.schedule_all(max_cycles=10)
+    assert sched.fault_fallback_cycles == 0
+    assert sched.last_fault is None
+    assert "default/wa" in cache.workloads
+    assert "default/wb" not in cache.workloads
+
+
+def test_flight_recorder_names_deciding_kernel():
+    prev = flight.ENABLED
+    rec = flight.enable(capacity=64)
+    rec.clear()
+    try:
+        cache, queues, wa, wb = _two_round_env()
+        sched = DeviceScheduler(cache, queues, device_kernel="auto")
+        submit(queues, wa, wb)
+        sched.schedule_all(max_cycles=10)
+        kernels = {r.kernel for r in rec.records() if r.path == "device"}
+        assert kernels <= {"cycle_fixedpoint", "cycle_fixedpoint_hybrid"}
+        assert kernels, "no device cycle recorded a kernel name"
+        atts = rec.attempts_for("default/wa")
+        assert atts and atts[-1]["kernel"] in kernels
+    finally:
+        if prev:
+            flight.enable()
+        else:
+            flight.disable()
+
+
+def test_use_fixedpoint_property_compat():
+    """The legacy boolean attribute maps onto the mode enum."""
+    cache, queues, _wa, _wb = _two_round_env()
+    sched = DeviceScheduler(cache, queues)
+    assert sched.device_kernel == "scan"
+    assert sched.use_fixedpoint is False
+    sched.use_fixedpoint = True
+    assert sched.device_kernel == "fixedpoint"
+    assert sched.use_fixedpoint is True
+    sched.use_fixedpoint = False
+    assert sched.device_kernel == "scan"
+    with pytest.raises(ValueError):
+        DeviceScheduler(cache, queues, device_kernel="pallas")

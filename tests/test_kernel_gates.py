@@ -1,0 +1,125 @@
+"""tools/check_kernel_gates.py: the dispatch gate <-> docstring marker
+consistency lint — green on the real tree, and actually able to catch
+each staleness direction on synthesized sources."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_kernel_gates", REPO_ROOT / "tools" / "check_kernel_gates.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_gates_consistent():
+    mod = _load()
+    assert mod.run_check() == []
+
+
+def test_all_device_kernels_documented():
+    mod = _load()
+    docs = mod.documented_gates()
+    gates = mod.dispatch_gates()
+    assert set(gates) == set(docs) == {
+        "cycle_grouped_preempt", "cycle_fair_preempt",
+        "cycle_fixedpoint", "cycle_fixedpoint_hybrid",
+    }
+    # The fixed-point kernels document exactly the shapes they cannot
+    # handle — lending limits are NOT among them anymore.
+    for entry in ("cycle_fixedpoint", "cycle_fixedpoint_hybrid"):
+        assert docs[entry] == [
+            "not idx.has_partial",
+            "arrays.s_req is None",
+            "arrays.tas_topo is None",
+        ]
+        assert not any("has_lend_limit" in c for c, _ in gates[entry])
+
+
+KERNEL_SRC = '''
+def make_k():
+    """A kernel.
+
+    kernel-entry: cycle_k
+    gate-requires: arrays.s_req is None
+    """
+'''
+
+DRIVER_OK = '''
+class D:
+    def schedule(self):
+        entry = "cycle_default"
+        if arrays.s_req is None:
+            entry = "cycle_k"
+'''
+
+DRIVER_DROPPED_REQ = '''
+class D:
+    def schedule(self):
+        entry = "cycle_default"
+        if idx.workloads:
+            entry = "cycle_k"
+'''
+
+DRIVER_STALE_GATE = '''
+class D:
+    def schedule(self):
+        entry = "cycle_default"
+        if arrays.s_req is None and not idx.has_partial:
+            entry = "cycle_k"
+'''
+
+DEFAULT_DOC = '''
+def make_default():
+    """kernel-entry: cycle_default"""
+'''
+
+
+def _run_synth(tmp_path, mod, driver_src, kernel_src):
+    driver = tmp_path / "driver.py"
+    kernel = tmp_path / "kernel.py"
+    driver.write_text(driver_src)
+    kernel.write_text(kernel_src + DEFAULT_DOC)
+    mod.DRIVER = driver
+    mod.KERNEL_FILES = (kernel,)
+    return mod.run_check()
+
+
+def test_green_on_matching_synth(tmp_path):
+    assert _run_synth(tmp_path, _load(), DRIVER_OK, KERNEL_SRC) == []
+
+
+def test_catches_dropped_precondition(tmp_path):
+    violations = _run_synth(tmp_path, _load(), DRIVER_DROPPED_REQ, KERNEL_SRC)
+    assert any("gate-requires: arrays.s_req is None" in v
+               for v in violations)
+
+
+def test_catches_stale_gate_condition(tmp_path):
+    # The gate still excludes partial-preemption shapes but the kernel
+    # docstring no longer requires it: the lint must flag the leftover.
+    violations = _run_synth(tmp_path, _load(), DRIVER_STALE_GATE, KERNEL_SRC)
+    assert any("not idx.has_partial" in v and "stale" in v
+               for v in violations)
+
+
+def test_catches_undocumented_entry(tmp_path):
+    violations = _run_synth(
+        tmp_path, _load(), DRIVER_OK.replace("cycle_k", "cycle_new"),
+        KERNEL_SRC,
+    )
+    assert any("cycle_new" in v and "kernel-entry" in v for v in violations)
+
+
+def test_catches_orphaned_marker(tmp_path):
+    violations = _run_synth(
+        tmp_path, _load(),
+        DRIVER_OK.replace('entry = "cycle_k"', "pass"), KERNEL_SRC,
+    )
+    assert any("never assigns" in v for v in violations)

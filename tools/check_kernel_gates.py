@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Static check: the driver's kernel dispatch gate matches each kernel's
+documented preconditions.
+
+Every device cycle factory documents its entry name and the driver-side
+conditions it needs to be exact as docstring markers::
+
+    kernel-entry: cycle_fixedpoint
+    gate-requires: not idx.has_partial
+    gate-requires: arrays.s_req is None
+
+``DeviceScheduler.schedule`` selects a kernel by assigning
+``entry = "<name>"`` inside an if/elif chain. This walker pairs each
+assignment with the conditions that guard it and verifies, in both
+directions, that code and docs agree:
+
+1. every dispatched entry has a ``kernel-entry`` marker (a new kernel
+   cannot ship with undocumented preconditions);
+2. every marker names an entry the driver actually dispatches (a rename
+   cannot orphan the docs);
+3. every ``gate-requires`` condition appears as a conjunct of the gate
+   guarding that entry (the driver cannot silently drop a precondition
+   the kernel still needs);
+4. every gate conjunct testing a known capability attribute is
+   documented by that kernel (a kernel that GAINS a capability — e.g.
+   lending limits — cannot leave a stale exclusion in the gate: the
+   marker is deleted from the docstring, and this check then flags the
+   leftover condition).
+
+Conditions are normalized through ``ast.parse``/``ast.unparse`` so
+whitespace and quoting never matter. Mode-selection conjuncts
+(``self.device_kernel``, bucketing locals like ``s_resid``) are not
+capability tests and are ignored by check 4.
+
+Run standalone (exit 1 on violations) or via tests/test_kernel_gates.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "kueue_tpu"
+
+DRIVER = PACKAGE / "models" / "driver.py"
+
+# Files whose factory docstrings may carry kernel-entry markers.
+KERNEL_FILES = (
+    PACKAGE / "models" / "batch_scheduler.py",
+    PACKAGE / "models" / "fair_kernel.py",
+)
+
+# Attribute substrings that mark a gate conjunct as a CAPABILITY test —
+# something a kernel can or cannot handle — as opposed to mode selection.
+# A conjunct mentioning one of these must be documented by the kernel it
+# guards (check 4).
+CAPABILITY_ATTRS = (
+    "has_partial",
+    "s_req",
+    "tas_topo",
+    "has_lend_limit",
+    "fair_sharing",
+)
+
+_ENTRY_RE = re.compile(r"^\s*kernel-entry:\s*(\S+)\s*$", re.M)
+_REQ_RE = re.compile(r"^\s*gate-requires:\s*(.+?)\s*$", re.M)
+
+
+def _normalize(cond: str) -> str:
+    """Canonical text for a boolean condition (quoting/whitespace-proof)."""
+    try:
+        return ast.unparse(ast.parse(cond, mode="eval").body)
+    except SyntaxError:
+        return " ".join(cond.split())
+
+
+def documented_gates() -> Dict[str, List[str]]:
+    """entry name -> normalized gate-requires conditions, harvested from
+    the kernel factory docstrings."""
+    out: Dict[str, List[str]] = {}
+    for path in KERNEL_FILES:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            doc = ast.get_docstring(node)
+            if not doc:
+                continue
+            entries = _ENTRY_RE.findall(doc)
+            if not entries:
+                continue
+            reqs = [_normalize(c) for c in _REQ_RE.findall(doc)]
+            for entry in entries:
+                out[entry] = reqs
+    return out
+
+
+def _conjuncts(test: ast.expr) -> List[ast.expr]:
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        out: List[ast.expr] = []
+        for v in test.values:
+            out.extend(_conjuncts(v))
+        return out
+    return [test]
+
+
+class _GateCollector(ast.NodeVisitor):
+    """Pair every ``entry = "<name>"`` assignment with the positive
+    conjuncts of the if/elif tests whose BODY (not else-branch) encloses
+    it."""
+
+    def __init__(self) -> None:
+        self.stack: List[ast.expr] = []
+        # entry -> list of (normalized conjunct, lineno)
+        self.gates: Dict[str, List[Tuple[str, int]]] = {}
+
+    def visit_If(self, node: ast.If) -> None:
+        conj = _conjuncts(node.test)
+        self.stack.extend(conj)
+        for child in node.body:
+            self.visit(child)
+        del self.stack[len(self.stack) - len(conj):]
+        for child in node.orelse:
+            self.visit(child)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (
+            len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "entry"
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            self.gates[node.value.value] = [
+                (_normalize(ast.unparse(c)), c.lineno) for c in self.stack
+            ]
+        self.generic_visit(node)
+
+
+def dispatch_gates() -> Dict[str, List[Tuple[str, int]]]:
+    """entry name -> gate conjuncts guarding its assignment in the
+    driver's schedule() method."""
+    tree = ast.parse(DRIVER.read_text(), filename=str(DRIVER))
+    collector = _GateCollector()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "schedule":
+            collector.visit(node)
+    return collector.gates
+
+
+def run_check() -> List[str]:
+    violations: List[str] = []
+    docs = documented_gates()
+    gates = dispatch_gates()
+
+    if not gates:
+        return [f"{DRIVER}: found no entry assignments in schedule()"]
+
+    for entry in sorted(gates):
+        if entry not in docs:
+            violations.append(
+                f"{DRIVER}: dispatches {entry!r} but no kernel factory "
+                f"docstring carries a 'kernel-entry: {entry}' marker"
+            )
+    for entry in sorted(docs):
+        if entry not in gates:
+            violations.append(
+                f"'kernel-entry: {entry}' documented but the driver's "
+                f"schedule() never assigns entry = {entry!r}"
+            )
+
+    for entry, reqs in sorted(docs.items()):
+        if entry not in gates:
+            continue
+        conj = gates[entry]
+        conj_norm = {c for c, _ in conj}
+        for req in reqs:
+            if req not in conj_norm:
+                violations.append(
+                    f"{entry}: documented precondition "
+                    f"'gate-requires: {req}' is not a conjunct of the "
+                    f"driver dispatch gate (gate has: {sorted(conj_norm)})"
+                )
+        for cond, lineno in conj:
+            if not any(attr in cond for attr in CAPABILITY_ATTRS):
+                continue  # mode selection / bucketing, not a capability
+            if cond not in reqs:
+                violations.append(
+                    f"{DRIVER}:{lineno}: gate condition '{cond}' guards "
+                    f"{entry!r} but the kernel docstring does not list it "
+                    f"as 'gate-requires:' — either the kernel gained this "
+                    f"capability (delete the stale gate condition) or the "
+                    f"docstring is missing the marker"
+                )
+    return violations
+
+
+def main() -> int:
+    violations = run_check()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} kernel-gate violation(s)")
+        return 1
+    print("kernel gate check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
