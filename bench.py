@@ -1016,15 +1016,21 @@ def probe_whatif(scale: float):
 
 
 def probe_steady(scale: float):
-    """Open-loop steady-load SLO probe (docs/observability.md): drive
-    the host scheduler with a constant arrival stream — arrivals do NOT
-    wait on completions, so a slow scheduler surfaces as queue growth
-    and burn rate, never as back-pressured arrivals — while a completion
-    churn frees quota at a fixed concurrency. Then read the burn-rate
-    SLO engine exactly the way the ``/slo`` endpoint does. Host-only by
-    design: it measures the admission pipeline + SLO layer, not kernels,
-    so it runs anywhere in seconds."""
-    from kueue_tpu.api.constants import PreemptionPolicy
+    """Steady v2: the open-loop churn driver for the STREAMING service
+    loop (docs/observability.md "Service loop & live health"). A
+    producer paces arrivals into ``ServiceLoop.post`` — arrivals never
+    wait on completions, so a slow loop surfaces as queue growth and
+    burn rate — while an ``on_cycle`` observer posts completions beyond
+    a target concurrency, and the script injects a quota edit, a
+    HOLD_AND_DRAIN drain, and a resume mid-run. Reports loop-health
+    telemetry the way an operator would read it: admissions/s, cycle
+    p50/p99, ingestion lag, watermark peaks, per-SLO burn, and the
+    ``/healthz`` document. Host-only by design: it measures the service
+    pipeline + telemetry plane, not kernels. ``scale=1`` drives >=60s
+    of churn; the CI contract test runs ``scale=0.05`` (~3s)."""
+    import threading
+
+    from kueue_tpu.api.constants import PreemptionPolicy, StopPolicy
     from kueue_tpu.api.types import (
         ClusterQueue,
         ClusterQueuePreemption,
@@ -1039,82 +1045,165 @@ def probe_steady(scale: float):
     )
     from kueue_tpu.manager import Manager
 
-    mgr = Manager()
-    mgr.apply(
-        ResourceFlavor(name="default"),
-        Cohort(name="steady"),
-        ClusterQueue(
+    def steady_cq(nominal: int,
+                  stop_policy=StopPolicy.NONE) -> ClusterQueue:
+        return ClusterQueue(
             name="cq-steady", cohort="steady",
             resource_groups=[ResourceGroup(
                 covered_resources=["cpu"],
                 flavors=[FlavorQuotas(
                     name="default",
-                    resources={"cpu": ResourceQuota(nominal=16000)},
+                    resources={"cpu": ResourceQuota(nominal=nominal)},
                 )],
             )],
             preemption=ClusterQueuePreemption(
                 within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
             ),
-        ),
+            stop_policy=stop_policy,
+        )
+
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        Cohort(name="steady"),
+        steady_cq(16000),
         LocalQueue(name="lq-steady", cluster_queue="cq-steady"),
     )
-    slo = mgr.slo()
+    m = mgr.metrics
+    svc = mgr.service(
+        tick_interval_s=0.25, slo_interval_s=0.5, idle_sleep_s=0.005,
+        stall_after_s=5.0, cycles_per_iter=8,
+    )
 
-    steps = max(10, int(120 * scale))
-    per_step = 4          # arrivals per step (open loop)
-    churn_target = 8      # steady running concurrency after churn
+    # Completion churn rides the telemetry stage: every admitted key
+    # beyond the concurrency target gets a finish posted back through
+    # the ingest path (never a direct manager call — the observer must
+    # not touch state).
+    churn_target = 12
     running: list = []
-    submitted = 0
-    admitted_total = 0
+    admitted_box = [0]
+
+    def churn(result) -> None:
+        admitted_box[0] += len(result.admitted)
+        running.extend(result.admitted)
+        while len(running) > churn_target:
+            svc.finish(running.pop(0))
+
+    svc.on_cycle.append(churn)
+    svc.start()
+
+    duration_s = max(3.0, 60.0 * scale)
+    rate = 16.0  # arrivals/s, open loop
+    interval = 1.0 / rate
     t0 = time.monotonic()
-    for step in range(steps):
-        for j in range(per_step):
-            submitted += 1
-            mgr.create_workload(Workload(
-                name=f"steady-{step}-{j}",
+    t_end = t0 + duration_s
+    events = {"quota_edit": 0.35, "drain": 0.55, "resume": 0.70}
+    fired = set()
+    submitted = 0
+    rejected = 0
+    depth_peak = 0.0
+    oldest_age_peak = 0.0
+    next_arrival = t0
+    while True:
+        now = time.monotonic()
+        if now >= t_end:
+            break
+        while next_arrival <= now and next_arrival < t_end:
+            ok = svc.submit(Workload(
+                name=f"steady-{submitted}",
                 queue_name="lq-steady",
                 pod_sets=[PodSet(name="main", count=1,
                                  requests={"cpu": 1000})],
-                priority=(step + j) % 3,
-                creation_time=float(submitted),
+                priority=submitted % 3,
             ))
-        # One head per CQ per cycle: run a few cycles per step so
-        # admissions keep pace with arrivals (still open loop — the
-        # cycle cap, not completions, bounds the work per step).
-        for _ in range(per_step + 2):
-            result = mgr.schedule()
-            admitted_total += len(result.admitted)
-            running.extend(result.admitted)
-            if not result.admitted and not result.preempted:
-                break
-        # Completion churn: oldest running workloads finish, freeing
-        # quota for the next arrivals — the open loop stays steady
-        # instead of wedging at nominal quota.
-        while len(running) > churn_target:
-            wl = mgr.workloads.get(running.pop(0))
-            if wl is not None:
-                mgr.finish_workload(wl)
-        if step % 10 == 0:
-            slo.evaluate()
+            submitted += 1
+            if not ok:
+                rejected += 1
+            next_arrival += interval
+        frac = (now - t0) / duration_s
+        for name, at in events.items():
+            if frac >= at and name not in fired:
+                fired.add(name)
+                log(f"steady event @{frac:.2f}: {name}")
+                if name == "quota_edit":
+                    svc.apply(steady_cq(24000))
+                elif name == "drain":
+                    svc.apply(steady_cq(24000,
+                                        StopPolicy.HOLD_AND_DRAIN))
+                else:
+                    svc.apply(steady_cq(24000))
+        # Watermark peaks off the exported gauges — the operator's view.
+        depth_peak = max(depth_peak, m.get(
+            "service_queue_depth", {"cluster_queue": "cq-steady"}
+        ))
+        oldest_age_peak = max(oldest_age_peak, m.get(
+            "service_oldest_pending_age_seconds",
+            {"cluster_queue": "cq-steady"},
+        ))
+        time.sleep(min(0.02, max(0.0, next_arrival - time.monotonic())))
+    # Let the loop drain the tail of the ingest queue (late submits and
+    # the observer's last completions) before stopping, so the applied-op
+    # accounting below sees every event.
+    t_drain = time.monotonic() + 10.0
+    while svc.ingest_depth() > 0 and time.monotonic() < t_drain:
+        time.sleep(0.01)
+    svc.flush_telemetry()
+    svc.stop()
     wall = time.monotonic() - t0
+    admitted_total = admitted_box[0]
 
-    statuses = slo.evaluate()
-    children = mgr.metrics.histograms.get(
-        "admission_attempt_duration_seconds", {}
+    def q_ms(series: str, q: float):
+        v = m.histogram_quantile(series, q)
+        if v is None or v != v or v == float("inf"):
+            return None
+        return round(v * 1000, 3)
+
+    statuses = mgr.slo().evaluate()
+    _, _, cycles_n = m.histogram_totals(
+        "admission_attempt_duration_seconds"
     )
-    h = next(iter(children.values()), None)
+    loop_errors = int(m.counter_total("service_loop_errors_total"))
+    applies = int(m.counter_total("service_ingest_ops_total"))
+    health = svc.health()
+    ok = bool(
+        admitted_total > 0
+        and cycles_n > 0
+        and loop_errors == 0
+        and len(fired) == len(events)
+        and applies >= submitted + len(events)
+    )
     return {
         "probe": "steady",
-        "ok": bool(h is not None and h.n > 0),
-        "steps": steps,
-        "submitted": submitted,
-        "admitted": admitted_total,
-        "pending_after": mgr.queues.pending_count("cq-steady"),
+        "ok": ok,
+        # v2 is time-paced against the service loop, not CPU-bound
+        # call-per-cycle: a new ledger fingerprint group, so the gate
+        # baselines fresh instead of comparing across probe designs.
+        "fingerprint_extra": {"version": 2},
+        "duration_s": round(duration_s, 3),
         "wall_s": round(wall, 3),
+        "arrival_rate_per_s": rate,
+        "submitted": submitted,
+        "rejected_posts": rejected,
+        "admitted": admitted_total,
+        "finished": int(m.get("workloads_finished_total")),
+        "pending_after": mgr.queues.pending_count("cq-steady"),
+        "events_fired": sorted(fired),
         "admissions_per_s": round(admitted_total / wall, 2)
         if wall > 0 else 0.0,
-        "cycle_p50_ms": round(h.quantile(0.50) * 1000, 3) if h else None,
-        "cycle_p99_ms": round(h.quantile(0.99) * 1000, 3) if h else None,
+        "cycles": cycles_n,
+        "cycle_p50_ms": q_ms("admission_attempt_duration_seconds", 0.50),
+        "cycle_p99_ms": q_ms("admission_attempt_duration_seconds", 0.99),
+        "ingest_lag_p50_ms": q_ms("service_ingest_lag_seconds", 0.50),
+        "ingest_lag_p99_ms": q_ms("service_ingest_lag_seconds", 0.99),
+        "admit_wait_p99_ms": q_ms("service_submit_to_admit_seconds",
+                                  0.99),
+        "queue_depth_peak": depth_peak,
+        "oldest_pending_age_peak_s": round(oldest_age_peak, 3),
+        "loop_iterations": int(
+            m.counter_total("service_loop_iterations_total")
+        ),
+        "loop_errors": loop_errors,
+        "health": health,
         "healthy": all(st.healthy for st in statuses),
         "slos": [st.to_dict() for st in statuses],
     }
@@ -1519,6 +1608,7 @@ def main():
                 rec = perf_ledger.make_record(
                     args.probe, stats, scale=args.scale,
                     platform=args.platform,
+                    extra_config=stats.get("fingerprint_extra"),
                 )
                 path = args.ledger or perf_ledger.default_ledger_path()
                 if not perf_ledger.append_record(rec, path):

@@ -23,7 +23,6 @@ from typing import Callable, Dict, List, Optional, Union
 from kueue_tpu.api.constants import (
     COND_FINISHED,
     CheckState,
-    RequeueReason,
     StopPolicy,
 )
 from kueue_tpu.utils.validation import (
@@ -131,6 +130,7 @@ class Manager:
         self._whatif = None
         self._explainer = None
         self._slo = None
+        self._service = None
 
     def whatif(self):
         """Lazily built what-if forecasting engine over this manager's
@@ -175,6 +175,23 @@ class Manager:
                 self.metrics, objectives=objectives, clock=self.clock
             )
         return self._slo
+
+    def service(self, **kwargs):
+        """Lazily built streaming service loop over this manager
+        (docs/observability.md, "Service loop & live health"): async
+        ingestion, admission cycles + ticks on a loop thread, watermark
+        gauges + continuous SLO burn on a telemetry thread, and the
+        lock-free ``health()`` document behind ``/healthz``. Constructor
+        kwargs are honored only on first build."""
+        if self._service is None:
+            from kueue_tpu.obs import ServiceLoop
+
+            self._service = ServiceLoop(self, **kwargs)
+        elif kwargs:
+            raise ValueError(
+                "service loop already built; configure it on first call"
+            )
+        return self._service
 
     def prewarm(self, max_heads: int = 16, background: bool = False,
                 aot: bool = True):
@@ -869,28 +886,24 @@ class Manager:
         tick_interval_s: float = 1.0,
         stop_event=None,
     ) -> None:
-        """Daemon mode (reference scheduler.go:221 Start +
-        pkg/util/wait UntilWithBackoff): block on pending work, run cycles,
-        and do clock-driven reconciliation between them."""
-        import threading as _threading
+        """Deprecated daemon mode. The service loop
+        (``mgr.service().run_blocking()`` / ``.start()``) is the one
+        long-running entry point: same cycles + ticks, plus async
+        ingestion, live-health telemetry, and /healthz liveness. This
+        shim delegates so existing callers keep working."""
+        import warnings
 
-        stop = stop_event or _threading.Event()
-        last_tick = self.clock()
-        while not stop.is_set():
-            heads_available = self.queues.heads_blocking(
-                timeout=tick_interval_s
+        warnings.warn(
+            "Manager.run_forever is deprecated; use "
+            "Manager.service(...).run_blocking() (or .start()) instead",
+            DeprecationWarning, stacklevel=2,
+        )
+        if self._service is None:
+            self.service(
+                tick_interval_s=tick_interval_s,
+                idle_sleep_s=min(0.05, tick_interval_s),
             )
-            if heads_available:
-                # Re-inject: heads_blocking popped them; push back and run a
-                # normal cycle so ordering semantics hold.
-                for info in heads_available:
-                    self.queues.requeue_workload(
-                        info, RequeueReason.FAILED_AFTER_NOMINATION
-                    )
-                self.schedule()
-            if self.clock() - last_tick >= tick_interval_s:
-                self.tick()
-                last_tick = self.clock()
+        self._service.run_blocking(stop_event=stop_event)
 
     def run_until_settled(self, max_rounds: int = 1000) -> None:
         """Drive schedule + tick until no more progress."""

@@ -129,8 +129,27 @@ COST_SERIES = frozenset({
     "profile_state",
 })
 
+# Streaming service loop (obs/service.py): ingestion, backpressure,
+# queue-age watermarks, per-workload latency spans, loop liveness.
+SERVICE_SERIES = frozenset({
+    "service_ingest_lag_seconds",
+    "service_ingest_queue_depth",
+    "service_ingest_ops_total",
+    "service_backpressure_total",
+    "service_loop_iterations_total",
+    "service_loop_errors_total",
+    "service_loop_stalled",
+    "service_cycle_staleness_seconds",
+    "service_queue_depth",
+    "service_oldest_pending_age_seconds",
+    "service_admission_wait_p99_seconds",
+    "service_submit_to_nominate_seconds",
+    "service_submit_to_admit_seconds",
+})
+
 METRIC_NAMES = (
     REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES | COST_SERIES
+    | SERVICE_SERIES
 )
 
 # HELP text for the Prometheus exposition (registry.Metrics.expose).
@@ -164,6 +183,28 @@ HELP_TEXT = {
     "whatif_rollout_seconds": "What-if batched rollout wall time",
     "remote_spans_ingested_total":
         "Worker spans merged into the client trace, by worker lane",
+    "service_ingest_lag_seconds":
+        "Time an ingested op waited between post and apply",
+    "service_ingest_queue_depth": "Ops waiting in the ingest queue",
+    "service_ingest_ops_total": "Ops applied by the service loop, by kind",
+    "service_backpressure_total":
+        "Posts rejected because the ingest queue was full",
+    "service_loop_iterations_total": "Service-loop iterations completed",
+    "service_loop_errors_total":
+        "Contained exceptions in the service loop or its telemetry stage",
+    "service_loop_stalled":
+        "1 when cycle staleness exceeds the stall threshold, else 0",
+    "service_cycle_staleness_seconds":
+        "Seconds since the last completed loop iteration",
+    "service_queue_depth": "Pending workloads per ClusterQueue watermark",
+    "service_oldest_pending_age_seconds":
+        "Age of the oldest pending workload per ClusterQueue",
+    "service_admission_wait_p99_seconds":
+        "p99 of submit-to-admit wait across the service's lifetime",
+    "service_submit_to_nominate_seconds":
+        "Submit to first scheduler nomination per workload",
+    "service_submit_to_admit_seconds":
+        "Submit to admission per workload (the admission wait span)",
 }
 
 _HELP_FALLBACK = "kueue_tpu series; see docs/observability.md"

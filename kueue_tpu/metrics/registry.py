@@ -91,6 +91,51 @@ class Metrics:
                 h = self.histograms[name][_lk(labels)] = Histogram()
             h.observe(value)
 
+    # -- locked aggregate readers ---------------------------------------
+    #
+    # Concurrent readers (SLO engine, service-loop watermarks, /metrics
+    # scrapes) must never iterate live histogram/counter cells while a
+    # writer thread mutates them: Histogram.observe updates counts/n/total
+    # non-atomically, so an unlocked read can see n != sum(counts) (a torn
+    # read). These helpers snapshot under the registry lock.
+
+    def counter_total(self, name: str) -> float:
+        """Sum of one counter series across all label children."""
+        with self._lock:
+            return float(sum(self.counters.get(name, {}).values()))
+
+    def histogram_totals(self, name: str):
+        """Aggregate one histogram series across label children into
+        ``(buckets, counts, n)``, read atomically. Children share the
+        default bucket layout per series; a child with a different layout
+        is skipped (mixed layouts fall back to the first child's)."""
+        with self._lock:
+            children = self.histograms.get(name, {})
+            buckets: Optional[Tuple[float, ...]] = None
+            counts: List[int] = []
+            n = 0
+            for h in children.values():
+                if buckets is None:
+                    buckets = tuple(h.buckets)
+                    counts = [0] * (len(h.buckets) + 1)
+                if tuple(h.buckets) != buckets:
+                    continue
+                for i, c in enumerate(h.counts):
+                    counts[i] += c
+                n += h.n
+            return buckets or (), counts, n
+
+    def histogram_quantile(self, name: str, q: float) -> Optional[float]:
+        """Interpolated quantile over one series aggregated across label
+        children; None when the series has no observations."""
+        buckets, counts, n = self.histogram_totals(name)
+        if n == 0 or not buckets:
+            return None
+        h = Histogram(buckets=buckets)
+        h.counts = list(counts)
+        h.n = n
+        return h.quantile(q)
+
     def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
             if name in self.counters:

@@ -270,6 +270,29 @@ def span(name: str, **args: Any):
     return _Span(name, args)
 
 
+def record_complete_span(name: str, duration_s: float,
+                         **args: Any) -> None:
+    """Record a span retroactively: an interval of ``duration_s`` that
+    ends *now*. For latencies measured outside a ``with span()`` block —
+    e.g. the service loop learns a workload's submit→admit wait only at
+    admission time, long after the interval started. No-op unless
+    tracing is on; renders on the Chrome-trace timeline like any other
+    complete event."""
+    if not ENABLED:
+        return
+    tr = _tracer
+    end = time.perf_counter() - tr.epoch
+    tr.record({
+        "name": name,
+        "ts": end - duration_s,
+        "dur": duration_s,
+        "tid": threading.get_ident(),
+        "trace_id": _trace_var.get(),
+        "parent": None,
+        "args": args,
+    })
+
+
 def current_trace_id() -> Optional[str]:
     return _trace_var.get()
 

@@ -162,6 +162,22 @@ class DeviceScheduler:
     # ------------------------------------------------------------------
 
     @property
+    def breaker_state(self) -> float:
+        """The device-path breaker as a gauge (0 closed / 1 half-open /
+        2 open) — surfaced in the service loop's ``health()`` document
+        and ``/healthz`` so liveness probes see host-fallback mode."""
+        return self._breaker.gauge_value
+
+    def health(self) -> dict:
+        """Lock-free device-path health summary for liveness probes."""
+        fault = self.last_fault
+        return {
+            "breakerState": self._breaker.gauge_value,
+            "faultFallbackCycles": self.fault_fallback_cycles,
+            "lastFault": list(fault) if fault is not None else None,
+        }
+
+    @property
     def use_fixedpoint(self) -> bool:
         """Legacy boolean view of :attr:`device_kernel` (pre-config-layer
         API): True when a fixed-point mode is selected."""

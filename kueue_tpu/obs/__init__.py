@@ -8,11 +8,15 @@
 - ``obs.reasons`` — the outcome-code -> kueue condition reason tables.
 - ``obs.costs`` — device cost attribution per solver entry point and
   shape bucket, plus the breaker-guarded on-demand profiler.
+- ``obs.service`` — the streaming admission service loop: async
+  ingestion, pipelined telemetry, queue-age watermarks, /healthz
+  liveness, continuous SLO burn.
 """
 
 from kueue_tpu.obs.costs import CostCell, CostLedger
 from kueue_tpu.obs.explain import Explainer
 from kueue_tpu.obs.recorder import CycleRecord, FlightRecorder, HeadAttempt
+from kueue_tpu.obs.service import ServiceLoop
 from kueue_tpu.obs.slo import (
     DEFAULT_OBJECTIVES,
     SLObjective,
@@ -28,6 +32,7 @@ __all__ = [
     "Explainer",
     "FlightRecorder",
     "HeadAttempt",
+    "ServiceLoop",
     "SLObjective",
     "SLOEngine",
     "SLOStatus",

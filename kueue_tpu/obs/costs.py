@@ -141,8 +141,22 @@ class CostLedger:
     # -- queries ---------------------------------------------------------
 
     def cells(self) -> Dict[Tuple[str, int], CostCell]:
+        """Deep-copied snapshot. The live :class:`CostCell` objects are
+        mutated in place by :meth:`charge` (including ``lanes`` dict
+        growth), so handing out the shared instances would let a reader
+        iterate a dict mid-resize or see dispatches/device_seconds from
+        two different instants. Copies are cheap: cell count is bounded
+        by (entry points × bucket rungs)."""
         with self._lock:
-            return dict(self._cells)
+            return {
+                k: CostCell(
+                    entry=c.entry, bucket=c.bucket,
+                    dispatches=c.dispatches,
+                    device_seconds=c.device_seconds,
+                    lanes=dict(c.lanes),
+                )
+                for k, c in self._cells.items()
+            }
 
     def total_device_seconds(self, entry: Optional[str] = None) -> float:
         with self._lock:

@@ -1,0 +1,519 @@
+"""The streaming admission service loop and its live-health telemetry.
+
+Everything before this module observes one ``Manager.schedule()`` call at
+a time; an operator of a long-running control plane asks a different
+question — *is the loop keeping up?* :class:`ServiceLoop` converts the
+call-driven :class:`~kueue_tpu.manager.Manager` facade into an actual
+service: an async ingestion path (submissions, completions, quota edits,
+drains) that producer threads feed while cycles run, a loop thread that
+drains the ingest queue at cycle boundaries and runs admission cycles +
+clock ticks, and a telemetry stage that overlaps against the *next*
+cycle on its own thread.
+
+Determinism contract (pinned by tests/test_service.py's randomized
+differential): ingested ops are applied FIFO at the top of a loop
+iteration, under the service lock, on the loop thread — so the event
+sequence the scheduler sees is exactly the sequence a call-per-cycle
+driver would produce, and every cycle stays bit-identical to the
+synchronous path. The pipelining is real but observation-only: stage B
+(telemetry export — watermark gauges, continuous SLO burn, observer
+callbacks) runs on the telemetry thread and never touches manager
+state, so overlapping it with stage A cannot change an admission.
+
+Live-health surface (docs/observability.md, "Service loop & live
+health"):
+
+- queue watermarks: per-CQ depth + oldest-pending-age gauges and the
+  p99 admission-wait gauge;
+- per-workload latency spans: submit→nominate and submit→admit
+  histograms, plus retroactive ``service/admission_wait`` spans on the
+  Chrome-trace timeline (:func:`kueue_tpu.metrics.tracing.record_complete_span`);
+- backpressure + lag: bounded ingest queue with a rejected-post
+  counter, per-op ingestion lag histogram;
+- liveness: a lock-free :meth:`health` document (cycle staleness,
+  stall flag, breaker state) served as ``/healthz`` + ``/readyz`` on
+  the visibility server — lock-free because a stalled loop may be
+  holding the service lock, and the health probe must still answer;
+- continuous SLO burn: the PR-6 engine re-evaluated on the loop tick
+  instead of on demand.
+
+Fault drill: the ``service.cycle`` injection point fires at the top of
+every iteration — a ``delay`` rule stalls the loop (``/healthz`` flips
+503 once staleness exceeds ``stall_after_s`` and recovers after), a
+``raise`` rule is contained by the loop and counted in
+``service_loop_errors_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kueue_tpu.metrics import tracing
+from kueue_tpu.utils import faults
+
+
+class ServiceLoop:
+    """Run a Manager as a long-lived admission service.
+
+    Producers call :meth:`submit` / :meth:`finish` / :meth:`apply` /
+    :meth:`delete` / :meth:`call` from any thread; the loop thread
+    drains them FIFO at the next cycle boundary. ``step()`` is also
+    callable synchronously (no threads) — the differential tests and
+    simulations drive it that way.
+
+    Parameters:
+
+    - ``tick_interval_s``: cadence of ``manager.tick()`` inside the
+      loop; ``None`` disables ticking (differential harnesses).
+    - ``slo_interval_s``: cadence of continuous SLO evaluation on the
+      telemetry stage (defaults to ``tick_interval_s`` or 1s).
+    - ``idle_sleep_s``: sleep between iterations when an iteration did
+      no work (no ops, no admissions).
+    - ``max_ingest``: ingest queue bound; a full queue rejects the post
+      (returns False) and counts ``service_backpressure_total``.
+    - ``stall_after_s``: cycle staleness above this flips
+      ``health()["healthy"]`` false and ``/healthz`` to 503.
+    - ``cycles_per_iter``: max admission cycles per iteration (stops
+      early on no progress); 1 = exactly one cycle per step.
+    - ``telemetry_async``: export telemetry on a separate thread,
+      overlapped with the next cycle (False = inline, deterministic).
+    """
+
+    def __init__(
+        self,
+        manager,
+        *,
+        tick_interval_s: Optional[float] = 1.0,
+        slo_interval_s: Optional[float] = None,
+        idle_sleep_s: float = 0.01,
+        max_ingest: int = 4096,
+        stall_after_s: float = 5.0,
+        cycles_per_iter: int = 4,
+        telemetry_async: bool = True,
+    ) -> None:
+        self.manager = manager
+        self.tick_interval_s = tick_interval_s
+        self.slo_interval_s = (
+            slo_interval_s if slo_interval_s is not None
+            else (tick_interval_s or 1.0)
+        )
+        self.idle_sleep_s = idle_sleep_s
+        self.max_ingest = max_ingest
+        self.stall_after_s = stall_after_s
+        self.cycles_per_iter = max(1, cycles_per_iter)
+        self.telemetry_async = telemetry_async
+        self._clock = manager.clock
+
+        #: The service state lock. The loop holds it while applying ops
+        #: and running cycles; visibility handlers that traverse cache /
+        #: queue state (explain, what-if, pendingworkloads) serialize
+        #: against it. RLock: handler code may re-enter manager helpers
+        #: that take it again.
+        self.lock = threading.RLock()
+
+        # Ingestion: producers append under their own mutex so a post
+        # never blocks on a running cycle.
+        self._ingest: deque = deque()
+        self._ingest_lock = threading.Lock()
+
+        # submit→nominate→admit latency bookkeeping (loop thread only):
+        # key -> [submit_ts, nominate_ts or None].
+        self._lat: Dict[str, List[Optional[float]]] = {}
+
+        #: Observer callbacks, invoked with each CycleResult on the
+        #: telemetry stage (never on the loop thread's critical path).
+        #: Callbacks must not mutate manager state directly — post ops.
+        self.on_cycle: List[Callable[[Any], None]] = []
+
+        # Liveness heartbeats — plain float/int writes (atomic under the
+        # GIL) read lock-free by health().
+        self._started = False
+        self._last_cycle_t: Optional[float] = None
+        self._last_tick_t: Optional[float] = None
+        self._last_slo_t: Optional[float] = None
+        self._iterations = 0
+        self._errors = 0
+
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+        # Telemetry hand-off: a coalescing one-slot mailbox + seq/done
+        # counters so flush_telemetry() can wait for quiescence.
+        self._tel_cv = threading.Condition()
+        self._tel_payload: Optional[dict] = None
+        self._tel_seq = 0
+        self._tel_done = 0
+        self._tel_thread: Optional[threading.Thread] = None
+
+    # -- ingestion (any thread) -----------------------------------------
+
+    def post(self, op: Tuple) -> bool:
+        """Enqueue one raw op tuple; False (+ backpressure counter) when
+        the ingest queue is full. Prefer the typed helpers below."""
+        with self._ingest_lock:
+            if len(self._ingest) >= self.max_ingest:
+                full = True
+            else:
+                self._ingest.append(op)
+                full = False
+        if full:
+            m = self.manager.metrics
+            m.inc("service_backpressure_total")
+            return False
+        return True
+
+    def submit(self, wl) -> bool:
+        """Submit one Workload (webhook-validated at apply time)."""
+        return self.post(("submit", wl, self._clock()))
+
+    def finish(self, key: str, success: bool = True) -> bool:
+        """Mark a workload finished (completion churn)."""
+        return self.post(("finish", key, success, self._clock()))
+
+    def apply(self, *objects) -> bool:
+        """Apply config objects (quota edits, drains, new queues)."""
+        return self.post(("apply", objects, self._clock()))
+
+    def delete(self, obj) -> bool:
+        return self.post(("delete", obj, self._clock()))
+
+    def call(self, fn: Callable[[Any], None], kind: str = "call") -> bool:
+        """Run ``fn(manager)`` on the loop thread under the lock — the
+        escape hatch for ops the typed helpers don't cover."""
+        return self.post((kind, fn, self._clock()))
+
+    def ingest_depth(self) -> int:
+        with self._ingest_lock:
+            return len(self._ingest)
+
+    # -- one loop iteration (loop thread) -------------------------------
+
+    def step(self) -> bool:
+        """Apply pending ops FIFO, run admission cycles, tick when due,
+        publish telemetry. Returns True when the iteration did work.
+        Synchronous and deterministic with ``telemetry_async=False``."""
+        if faults.ENABLED:
+            faults.fire(faults.SERVICE_CYCLE)
+        m = self.manager.metrics
+        with self._ingest_lock:
+            batch = list(self._ingest)
+            self._ingest.clear()
+        results: List[Any] = []
+        with self.lock:
+            now = self._clock()
+            for op in batch:
+                m.observe("service_ingest_lag_seconds", max(0.0, now - op[-1]))
+                m.inc("service_ingest_ops_total", {"kind": op[0]})
+                self._apply_op(op)
+            had_pending = bool(batch) or self._any_pending()
+            if had_pending:
+                prev_heads = None
+                for _ in range(self.cycles_per_iter):
+                    result = self.manager.schedule()
+                    results.append(result)
+                    self._track_latency(result)
+                    if result.admitted or result.preempted:
+                        prev_heads = None
+                        continue
+                    if not result.head_keys \
+                            or result.head_keys == prev_heads:
+                        break
+                    prev_heads = result.head_keys
+            now = self._clock()
+            if self.tick_interval_s is not None and (
+                self._last_tick_t is None
+                or now - self._last_tick_t >= self.tick_interval_s
+            ):
+                self.manager.tick()
+                self._last_tick_t = now
+            payload = self._collect_watermarks(results)
+        m.inc("service_loop_iterations_total")
+        self._iterations += 1
+        self._last_cycle_t = self._clock()
+        self._publish_telemetry(payload)
+        return bool(batch) or any(
+            r.admitted or r.preempted for r in results
+        )
+
+    def _apply_op(self, op: Tuple) -> None:
+        kind = op[0]
+        if kind == "submit":
+            wl = op[1]
+            self.manager.create_workload(wl)
+            # Latency clock starts at post time: the operator-visible
+            # wait includes time spent queued in the ingest path.
+            self._lat[wl.key] = [op[2], None]
+        elif kind == "finish":
+            key, success = op[1], op[2]
+            wl = self.manager.workloads.get(key)
+            if wl is not None:
+                self.manager.finish_workload(wl, success=success)
+            self._lat.pop(key, None)
+        elif kind == "apply":
+            self.manager.apply(*op[1])
+        elif kind == "delete":
+            self.manager.delete(op[1])
+        else:
+            op[1](self.manager)
+
+    def _track_latency(self, result) -> None:
+        now = self._clock()
+        for key in result.head_keys:
+            ent = self._lat.get(key)
+            if ent is not None and ent[1] is None:
+                ent[1] = now
+                self.manager.metrics.observe(
+                    "service_submit_to_nominate_seconds",
+                    max(0.0, now - ent[0]),
+                )
+        for key in result.admitted:
+            ent = self._lat.pop(key, None)
+            if ent is None:
+                continue
+            wait = max(0.0, now - ent[0])
+            self.manager.metrics.observe(
+                "service_submit_to_admit_seconds", wait
+            )
+            if tracing.ENABLED:
+                tracing.record_complete_span(
+                    "service/admission_wait", wait, workload=key
+                )
+        # Entries for workloads that left by another door (deleted,
+        # evicted then finished) must not pin memory forever.
+        if len(self._lat) > 64:
+            for key in list(self._lat):
+                if key not in self.manager.workloads:
+                    self._lat.pop(key, None)
+
+    def _any_pending(self) -> bool:
+        q = self.manager.queues
+        return any(
+            q.pending_count(name)
+            for name in list(self.manager.cache.cluster_queues)
+        )
+
+    def _collect_watermarks(self, results: List[Any]) -> dict:
+        """Plain-data snapshot taken under the service lock; exported by
+        the telemetry stage without touching live state."""
+        now = self._clock()
+        per_cq = {}
+        for name in list(self.manager.cache.cluster_queues):
+            depth = self.manager.queues.pending_count(name)
+            oldest = self.manager.queues.oldest_pending_creation(name)
+            per_cq[name] = (
+                depth,
+                0.0 if oldest is None else max(0.0, now - oldest),
+            )
+        return {
+            "per_cq": per_cq,
+            "ingest_depth": self.ingest_depth(),
+            "results": results,
+        }
+
+    # -- telemetry stage (telemetry thread, or inline) ------------------
+
+    def _publish_telemetry(self, payload: dict) -> None:
+        if not self.telemetry_async or self._tel_thread is None:
+            self._export_telemetry(payload)
+            return
+        with self._tel_cv:
+            if self._tel_payload is None:
+                self._tel_payload = payload
+            else:
+                # Coalesce: latest watermarks win, cycle results append
+                # so observers never miss an admission.
+                self._tel_payload["per_cq"] = payload["per_cq"]
+                self._tel_payload["ingest_depth"] = payload["ingest_depth"]
+                self._tel_payload["results"].extend(payload["results"])
+            self._tel_seq += 1
+            self._tel_cv.notify_all()
+
+    def _export_telemetry(self, payload: dict) -> None:
+        m = self.manager.metrics
+        for name, (depth, age) in payload["per_cq"].items():
+            lbl = {"cluster_queue": name}
+            m.set_gauge("service_queue_depth", depth, lbl)
+            m.set_gauge("service_oldest_pending_age_seconds", age, lbl)
+        m.set_gauge("service_ingest_queue_depth", payload["ingest_depth"])
+        p99 = m.histogram_quantile("service_submit_to_admit_seconds", 0.99)
+        if p99 is not None:
+            m.set_gauge("service_admission_wait_p99_seconds", p99)
+        self._export_staleness()
+        now = self._clock()
+        if self._last_slo_t is None \
+                or now - self._last_slo_t >= self.slo_interval_s:
+            self._last_slo_t = now
+            self.manager.slo().evaluate()
+        for result in payload["results"]:
+            for cb in list(self.on_cycle):
+                try:
+                    cb(result)
+                except Exception:
+                    self._errors += 1
+                    m.inc("service_loop_errors_total")
+
+    def _export_staleness(self) -> None:
+        m = self.manager.metrics
+        now = self._clock()
+        last = self._last_cycle_t
+        age = 0.0 if last is None else max(0.0, now - last)
+        m.set_gauge("service_cycle_staleness_seconds", age)
+        m.set_gauge(
+            "service_loop_stalled",
+            1.0 if age > self.stall_after_s else 0.0,
+        )
+
+    def _telemetry_run(self) -> None:
+        stop = self._stop
+        while True:
+            with self._tel_cv:
+                if self._tel_payload is None:
+                    if stop is not None and stop.is_set():
+                        return
+                    # Timed wait so staleness/stalled gauges keep moving
+                    # even while the loop itself is wedged.
+                    self._tel_cv.wait(
+                        timeout=max(0.05, self.stall_after_s / 4.0)
+                    )
+                payload = self._tel_payload
+                self._tel_payload = None
+                seq = self._tel_seq
+            if payload is None:
+                try:
+                    self._export_staleness()
+                except Exception:
+                    self._errors += 1
+                continue
+            try:
+                self._export_telemetry(payload)
+            except Exception:
+                self._errors += 1
+                self.manager.metrics.inc("service_loop_errors_total")
+            with self._tel_cv:
+                self._tel_done = seq
+                self._tel_cv.notify_all()
+
+    def flush_telemetry(self, timeout: float = 5.0) -> None:
+        """Block until every published payload has been exported — the
+        determinism hook for tests and the steady probe."""
+        if not self.telemetry_async or self._tel_thread is None:
+            return
+        deadline = time.monotonic() + timeout
+        with self._tel_cv:
+            while self._tel_done < self._tel_seq \
+                    or self._tel_payload is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._tel_thread.is_alive():
+                    return
+                self._tel_cv.wait(timeout=min(0.1, remaining))
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _prepare_start(self, stop_event) -> None:
+        if self._started:
+            raise RuntimeError("service loop already started")
+        self._started = True
+        self._stop = stop_event or threading.Event()
+        now = self._clock()
+        self._last_cycle_t = now
+        self._last_tick_t = now
+        # Build the SLO engine up front so continuous burn starts on the
+        # first telemetry pass, not the first /slo request.
+        self.manager.slo()
+        if self.telemetry_async:
+            self._tel_thread = threading.Thread(
+                target=self._telemetry_run,
+                name="kueue-service-telemetry", daemon=True,
+            )
+            self._tel_thread.start()
+
+    def start(self, stop_event: Optional[threading.Event] = None
+              ) -> "ServiceLoop":
+        """Spawn the loop (and telemetry) threads; returns self."""
+        self._prepare_start(stop_event)
+        self._thread = threading.Thread(
+            target=self._run, name="kueue-service-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def run_blocking(self, stop_event: Optional[threading.Event] = None
+                     ) -> None:
+        """Run the loop on the calling thread until ``stop_event`` is
+        set (the daemon-mode entry point behind Manager.run_forever)."""
+        self._prepare_start(stop_event)
+        try:
+            self._run()
+        finally:
+            self._shutdown_telemetry()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self._shutdown_telemetry(timeout=timeout)
+
+    def _shutdown_telemetry(self, timeout: float = 10.0) -> None:
+        if self._tel_thread is not None:
+            with self._tel_cv:
+                self._tel_cv.notify_all()
+            self._tel_thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        stop = self._stop
+        m = self.manager.metrics
+        while stop is not None and not stop.is_set():
+            try:
+                progressed = self.step()
+            except Exception:
+                # Contained: one poisoned iteration (including injected
+                # service.cycle raises) must not take the service down.
+                progressed = False
+                self._errors += 1
+                m.inc("service_loop_errors_total")
+            if not progressed:
+                stop.wait(self.idle_sleep_s)
+
+    # -- liveness (any thread, lock-free) -------------------------------
+
+    def health(self) -> dict:
+        """Liveness document for ``/healthz`` + ``/readyz``. Reads only
+        heartbeat attributes — never the service lock — so a stalled (or
+        lock-holding) loop still gets an honest 503."""
+        now = self._clock()
+        last = self._last_cycle_t
+        age = None if last is None else max(0.0, now - last)
+        stopping = self._stop is not None and self._stop.is_set()
+        stalled = bool(
+            self._started and age is not None and age > self.stall_after_s
+        )
+        healthy = bool(self._started and not stalled and not stopping)
+        ready = bool(healthy and self._iterations > 0)
+        breaker = getattr(self.manager.scheduler, "breaker_state", None)
+        return {
+            "healthy": healthy,
+            "ready": ready,
+            "started": self._started,
+            "stopping": stopping,
+            "stalled": stalled,
+            "lastCycleAgeS": age,
+            "stallAfterS": self.stall_after_s,
+            "iterations": self._iterations,
+            "errors": self._errors,
+            "ingestDepth": self.ingest_depth(),
+            "breakerState": breaker,
+        }
+
+    def to_doc(self) -> dict:
+        """The ``/service`` endpoint body: health + loop configuration."""
+        doc = self.health()
+        doc["tickIntervalS"] = self.tick_interval_s
+        doc["sloIntervalS"] = self.slo_interval_s
+        doc["cyclesPerIter"] = self.cycles_per_iter
+        doc["maxIngest"] = self.max_ingest
+        doc["telemetryAsync"] = self.telemetry_async
+        return doc

@@ -22,6 +22,7 @@ and the dashboard see the same numbers as the ``/slo`` endpoint.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -124,33 +125,19 @@ class SLOEngine:
         self._clock = clock
         # (t, {objective name: raw totals}) — cumulative, diffed per call.
         self._snaps: deque = deque()
+        # evaluate() is now called from both the service-loop telemetry
+        # thread (continuous burn) and /slo request handlers; the snapshot
+        # deque diff/append must be atomic per evaluation.
+        self._eval_lock = threading.Lock()
         self.last_statuses: List[SLOStatus] = []
 
     # -- raw totals -----------------------------------------------------
 
     def _hist_totals(self, series: str):
-        """Aggregate one histogram series across label children into
-        (buckets, counts, n). Registry histograms share the default bucket
-        layout per series; mixed layouts fall back to the first child's."""
-        children = self.metrics.histograms.get(series, {})
-        buckets: Optional[Tuple[float, ...]] = None
-        counts: List[int] = []
-        n = 0
-        for h in children.values():
-            if buckets is None:
-                buckets = tuple(h.buckets)
-                counts = [0] * (len(h.buckets) + 1)
-            if tuple(h.buckets) != buckets:
-                continue
-            for i, c in enumerate(h.counts):
-                counts[i] += c
-            n += h.n
-        return buckets or (), counts, n
+        return self.metrics.histogram_totals(series)
 
     def _counter_total(self, series: str) -> float:
-        return float(sum(
-            self.metrics.counters.get(series, {}).values()
-        ))
+        return self.metrics.counter_total(series)
 
     def _raw(self, obj: SLObjective) -> _Raw:
         if obj.kind == "latency":
@@ -163,18 +150,19 @@ class SLOEngine:
     # -- evaluation -----------------------------------------------------
 
     def evaluate(self) -> List[SLOStatus]:
-        now = self._clock()
-        current = {o.name: self._raw(o) for o in self.objectives}
-        baseline = self._baseline(now)
-        statuses = [
-            self._status(o, baseline.get(o.name), current[o.name])
-            for o in self.objectives
-        ]
-        self._snaps.append((now, current))
-        self._trim(now)
-        self._export(statuses)
-        self.last_statuses = statuses
-        return statuses
+        with self._eval_lock:
+            now = self._clock()
+            current = {o.name: self._raw(o) for o in self.objectives}
+            baseline = self._baseline(now)
+            statuses = [
+                self._status(o, baseline.get(o.name), current[o.name])
+                for o in self.objectives
+            ]
+            self._snaps.append((now, current))
+            self._trim(now)
+            self._export(statuses)
+            self.last_statuses = statuses
+            return statuses
 
     def _baseline(self, now: float) -> Dict[str, _Raw]:
         """Oldest snapshot still inside the widest objective window; with

@@ -40,6 +40,7 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "admissions_per_s": "higher",
         "cycle_p50_ms": "lower",
         "cycle_p99_ms": "lower",
+        "ingest_lag_p99_ms": "lower",
     },
     "sim": {"admissions_per_s": "higher"},
     "fair": {

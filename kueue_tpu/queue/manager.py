@@ -332,6 +332,21 @@ class QueueManager:
             cqh = self.cluster_queues.get(cq_name)
             return cqh.pending() if cqh else 0
 
+    def oldest_pending_creation(self, cq_name: str) -> Optional[float]:
+        """Creation timestamp of the oldest pending workload (active or
+        inadmissible) in one CQ, or None when nothing is pending — the
+        source for the service loop's oldest-pending-age watermark."""
+        with self._lock:
+            cqh = self.cluster_queues.get(cq_name)
+            if cqh is None:
+                return None
+            times = [
+                i.obj.creation_time
+                for i in list(cqh._items.values())
+                + list(cqh.inadmissible.values())
+            ]
+            return min(times) if times else None
+
     def pending_workloads_all(self, cq_name: str) -> List[WorkloadInfo]:
         """Active AND inadmissible pending entries in head order. The
         forecasting view: inadmissible workloads requeue on the next

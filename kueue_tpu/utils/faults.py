@@ -31,6 +31,10 @@ anything else so a typo'd point never silently no-ops):
 - ``compile.deserialize`` — AOT executable loads from the on-disk
   compile cache (perf/compile_cache.py; a corrupt or poisoned store
   falls back to the plain jit path behind a breaker)
+- ``service.cycle``     — the top of one service-loop iteration
+  (obs/service.py; a ``delay`` rule stalls the loop so ``/healthz``
+  staleness detection can be drilled, a ``raise`` rule is contained by
+  the loop and counted in ``service_loop_errors_total``)
 
 Rule modes:
 
@@ -83,6 +87,7 @@ REMOTE_DISPATCH = "remote.dispatch"
 CACHE_SNAPSHOT = "cache.snapshot"
 WHATIF_DISPATCH = "whatif.dispatch"
 COMPILE_DESERIALIZE = "compile.deserialize"
+SERVICE_CYCLE = "service.cycle"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -93,6 +98,7 @@ POINTS = frozenset({
     CACHE_SNAPSHOT,
     WHATIF_DISPATCH,
     COMPILE_DESERIALIZE,
+    SERVICE_CYCLE,
 })
 
 _MODES = ("raise", "delay", "corrupt")

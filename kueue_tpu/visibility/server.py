@@ -8,6 +8,7 @@ optional JSON/HTTP server for remote operators.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 from dataclasses import asdict, dataclass, field
@@ -47,10 +48,21 @@ class VisibilityServer:
 
     With an :class:`kueue_tpu.obs.Explainer` / ``SLOEngine`` attached
     (docs/observability.md), also serves ``/explain/<workload>`` and
-    ``/slo``."""
+    ``/slo``.
+
+    With a :class:`kueue_tpu.obs.ServiceLoop` attached, also serves the
+    liveness endpoints ``/healthz`` (503 once the loop is stalled or
+    stopped) and ``/readyz`` (503 until the first iteration completes),
+    plus ``/service`` (health + loop configuration). These read only
+    the loop's lock-free heartbeat — a wedged loop holding the state
+    lock still gets an honest 503. The service lock additionally
+    serializes the state-traversing handlers (``/explain``,
+    ``/whatif/*``) against live cycles, so concurrent scrapes never
+    observe a half-applied admission."""
 
     def __init__(self, queues: QueueManager, whatif=None,
-                 explainer=None, slo=None, metrics=None) -> None:
+                 explainer=None, slo=None, metrics=None,
+                 service=None, lock=None) -> None:
         self.queues = queues
         self.whatif = whatif
         self.explainer = explainer
@@ -58,6 +70,18 @@ class VisibilityServer:
         # Optional Metrics registry: when attached, /metrics serves the
         # Prometheus text exposition and /metrics.json the JSON mirror.
         self.metrics = metrics
+        # Optional ServiceLoop: /healthz, /readyz, /service.
+        self.service = service
+        # State lock shared with the admission loop (defaults to the
+        # attached service's lock): handlers that traverse cache/queue
+        # state take it so they run only at cycle boundaries.
+        self.lock = lock if lock is not None else (
+            service.lock if service is not None else None
+        )
+
+    def _state_lock(self):
+        return self.lock if self.lock is not None \
+            else contextlib.nullcontext()
 
     # -- cost attribution + profiling (docs/observability.md) -----------
 
@@ -101,10 +125,11 @@ class VisibilityServer:
                 include_preview: bool = False) -> Dict:
         if self.explainer is None:
             return {"error": "explainer not attached"}
-        return self.explainer.explain(
-            name, include_forecast=include_forecast,
-            include_preview=include_preview,
-        )
+        with self._state_lock():
+            return self.explainer.explain(
+                name, include_forecast=include_forecast,
+                include_preview=include_preview,
+            )
 
     def slo_doc(self) -> Dict:
         if self.slo is None:
@@ -188,9 +213,10 @@ class VisibilityServer:
         if self.whatif is None:
             return {"error": "whatif engine not attached"}
         scens = [self._parse_scenario(s) for s in (scenarios or [])]
-        report = self.whatif.eta(
-            scenarios=scens, cluster_queue=cluster_queue
-        )
+        with self._state_lock():
+            report = self.whatif.eta(
+                scenarios=scens, cluster_queue=cluster_queue
+            )
         return report.to_dict()
 
     def whatif_preview(self, spec: Dict) -> Dict:
@@ -200,9 +226,10 @@ class VisibilityServer:
         if self.whatif is None:
             return {"error": "whatif engine not attached"}
         wl = self._parse_workload(spec)
-        report = self.whatif.preview(
-            wl, cluster_queue=spec.get("clusterQueue")
-        )
+        with self._state_lock():
+            report = self.whatif.preview(
+                wl, cluster_queue=spec.get("clusterQueue")
+            )
         return report.to_dict()
 
     @staticmethod
@@ -255,6 +282,9 @@ class VisibilityServer:
         GET  /explain/<workload>[?forecast=0&preview=1]
         GET  /slo
         GET  /costs
+        GET  /healthz          (200 healthy / 503 stalled or stopped)
+        GET  /readyz           (200 after the first loop iteration)
+        GET  /service          (loop health + configuration)
         GET  /metrics          (Prometheus text exposition)
         GET  /metrics.json     (same registry, JSON document)
         POST /whatif/eta      {"clusterQueue"?: ..., "scenarios": [...]}
@@ -356,6 +386,35 @@ class VisibilityServer:
                     self._guarded(lambda: self._send_json(
                         server_self.slo_doc()
                     ))
+                elif parts == ["healthz"] or parts == ["readyz"]:
+                    # Deliberately lock-free: a stalled loop may be
+                    # holding the state lock, and the probe must still
+                    # answer with a 503 rather than hang.
+                    svc = server_self.service
+                    if svc is None:
+                        self._send_json({
+                            "error": "service loop not attached",
+                        }, 404)
+                    else:
+                        def _probe():
+                            h = svc.health()
+                            key = (
+                                "healthy" if parts == ["healthz"]
+                                else "ready"
+                            )
+                            self._send_json(h, 200 if h[key] else 503)
+
+                        self._guarded(_probe)
+                elif parts == ["service"]:
+                    svc = server_self.service
+                    if svc is None:
+                        self._send_json({
+                            "error": "service loop not attached",
+                        }, 404)
+                    else:
+                        self._guarded(lambda: self._send_json(
+                            svc.to_doc()
+                        ))
                 elif parts == ["costs"]:
                     self._guarded(lambda: self._send_json(
                         server_self.costs_doc()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""One-shot pre-commit gate: run every static checker plus an import
+smoke test.
+
+Wraps the three repo checkers —
+
+- ``check_metrics_names.py``: every emitted metric name is a literal
+  from ``metrics/names.py`` and documented in docs/observability.md;
+- ``check_kernel_gates.py``: zero-cost module-flag idiom holds at every
+  tracing/faults call site;
+- ``check_perf_ledger.py``: newest PERF_LEDGER.jsonl record per probe
+  fingerprint has not regressed vs its rolling median —
+
+and then imports the public entry points in a fresh CPU-pinned
+subprocess so a syntax error or circular import anywhere in the facade
+fails fast without waiting for the test suite. Exit status is 0 iff
+every step passed. Run it before committing (see README), or via
+``tools/run_isolated.py --checks``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+CHECKERS = (
+    "check_metrics_names.py",
+    "check_kernel_gates.py",
+    "check_perf_ledger.py",
+)
+
+# Facade modules whose import pulls in (nearly) the whole package:
+# manager wires cache/queues/scheduler/solver, obs.service the loop,
+# visibility the HTTP layer, cli the argparse surface, perf.ledger the
+# bench bookkeeping.
+SMOKE_IMPORTS = (
+    "kueue_tpu.manager",
+    "kueue_tpu.obs.service",
+    "kueue_tpu.visibility.server",
+    "kueue_tpu.cli",
+    "kueue_tpu.perf.ledger",
+)
+
+
+def run_step(label: str, cmd: list) -> int:
+    print(f"== [{label}] {' '.join(cmd)}", flush=True)
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    return subprocess.call(cmd, cwd=str(REPO_ROOT), env=env)
+
+
+def main() -> int:
+    failures = []
+    for name in CHECKERS:
+        rc = run_step(name, [sys.executable,
+                             str(REPO_ROOT / "tools" / name)])
+        if rc != 0:
+            failures.append((name, rc))
+    smoke = "import " + ", ".join(SMOKE_IMPORTS)
+    rc = run_step("import-smoke", [sys.executable, "-c", smoke])
+    if rc != 0:
+        failures.append(("import-smoke", rc))
+
+    print("\n== check_all summary")
+    if not failures:
+        print(f"all {len(CHECKERS) + 1} steps passed")
+        return 0
+    for label, rc in failures:
+        print(f"FAILED {label} (rc={rc})")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

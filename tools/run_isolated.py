@@ -31,7 +31,8 @@ the persistent compile cache instead of recompiling from zero
 (perf/compile_cache.py). ``--perf-gate`` additionally runs
 ``tools/check_perf_ledger.py`` after the suite, so a headline-metric
 regression recorded in PERF_LEDGER.jsonl fails the run like a test
-would.
+would. ``--checks`` runs ``tools/check_all.py`` (all static checkers +
+import smoke) before the suite and fails fast if any checker does.
 """
 
 from __future__ import annotations
@@ -124,6 +125,19 @@ def main(argv: list) -> int:
     if "--perf-gate" in argv:
         perf_gate = True
         argv.remove("--perf-gate")
+    if "--checks" in argv:
+        # Static checkers + import smoke up front: a typo'd metric name
+        # or broken facade import fails in seconds, not after the suite.
+        argv.remove("--checks")
+        print("== [checks] tools/check_all.py", flush=True)
+        rc = subprocess.call(
+            [sys.executable, str(REPO_ROOT / "tools" / "check_all.py")],
+            cwd=str(REPO_ROOT),
+        )
+        if rc != 0:
+            print("== [checks] failed; aborting before the suite",
+                  file=sys.stderr)
+            return rc
     if argv:
         print(f"unknown arguments {argv!r}; pass pytest args after --",
               file=sys.stderr)
