@@ -130,6 +130,18 @@ class Configuration:
     # including the fixed-point + residual-scan preemption hybrid). See
     # docs/perf.md "Fixed-point coverage matrix".
     device_kernel: str = "scan"
+    # Which kernel "auto" may pick when the backend is CPU: "scan" (the
+    # grouped scan — fixed-point's vectorized rounds are slower than the
+    # scan under JAX CPU emulation unless the residual-scan bound is
+    # large) or "fixedpoint" (force the accelerator preference anyway).
+    # See docs/perf.md "Pipelined cycle" / the scanfloor ledger note.
+    auto_cpu_kernel: str = "scan"
+    # Pipelined admission cycles: "off" (serialized snapshot -> encode ->
+    # dispatch -> apply), "on" (always speculate the next cycle's encode
+    # inside the device-dispatch window; requires the arena), "auto"
+    # (enabled when driven by the streaming service loop, off for
+    # call-per-cycle use). See docs/perf.md "Pipelined cycle".
+    pipeline_cycles: str = "auto"
     # KEP 7066 custom metric labels: entries of
     # {name, sourceKind: Workload|ClusterQueue|Cohort, sourceLabelKey,
     # sourceAnnotationKey}; values are read from the source object's
@@ -281,6 +293,12 @@ def load(source) -> Configuration:
     cfg.device_kernel = str(
         _pick(raw, "deviceKernel", "device_kernel", default="scan")
     )
+    cfg.auto_cpu_kernel = str(
+        _pick(raw, "autoCpuKernel", "auto_cpu_kernel", default="scan")
+    )
+    cfg.pipeline_cycles = str(
+        _pick(raw, "pipelineCycles", "pipeline_cycles", default="auto")
+    )
 
     validate(cfg)
     return cfg
@@ -309,6 +327,16 @@ def validate(cfg: Configuration) -> None:
         raise ValueError(
             f"unknown deviceKernel {cfg.device_kernel!r} "
             "(expected scan | fixedpoint | auto)"
+        )
+    if cfg.auto_cpu_kernel not in ("scan", "fixedpoint"):
+        raise ValueError(
+            f"unknown autoCpuKernel {cfg.auto_cpu_kernel!r} "
+            "(expected scan | fixedpoint)"
+        )
+    if cfg.pipeline_cycles not in ("on", "off", "auto"):
+        raise ValueError(
+            f"unknown pipelineCycles {cfg.pipeline_cycles!r} "
+            "(expected on | off | auto)"
         )
 
 
@@ -342,6 +370,8 @@ def build_manager(cfg: Configuration, **kw):
         use_device_scheduler=cfg.use_device_scheduler,
         admission_fair_sharing=cfg.admission_fair_sharing,
         device_kernel=cfg.device_kernel,
+        auto_cpu_kernel=cfg.auto_cpu_kernel,
+        pipeline_cycles=cfg.pipeline_cycles,
         **kw,
     )
     mgr.exclude_resource_prefixes = list(

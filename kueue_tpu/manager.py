@@ -89,6 +89,8 @@ class Manager:
         use_device_scheduler: bool = False,
         admission_fair_sharing=None,
         device_kernel: str = "scan",
+        auto_cpu_kernel: str = "scan",
+        pipeline_cycles: str = "auto",
     ) -> None:
         self.clock = clock
         self.cache = Cache()
@@ -100,6 +102,8 @@ class Manager:
             self.scheduler = DeviceScheduler(
                 self.cache, self.queues, fair_sharing=fair_sharing,
                 device_kernel=device_kernel,
+                auto_cpu_kernel=auto_cpu_kernel,
+                pipeline_cycles=pipeline_cycles,
             )
         else:
             self.scheduler = Scheduler(

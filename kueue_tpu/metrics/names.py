@@ -109,6 +109,12 @@ TRACING_SERIES = frozenset({
     "solver_compile_cache_hits_total",
     "solver_compile_cache_misses_total",
     "solver_prewarm_state",
+    # Pipelined admission cycles (models/driver.py + models/arena.py):
+    # speculative next-cycle encode overlapped with device dispatch.
+    "solver_pipeline_cycles_total",
+    "solver_pipeline_abort_total",
+    "solver_pipeline_reused_rows",
+    "solver_pipeline_speculate_seconds",
 })
 
 # Observability layer series (obs/): flight recorder + SLO engine.
@@ -176,6 +182,16 @@ HELP_TEXT = {
         "Padded-minus-real head rows as a percentage of the bucket",
     "obs_recorder_cycles_total":
         "Cycle records captured by the flight recorder, by path",
+    "solver_pipeline_cycles_total":
+        "Pipelined-cycle speculation outcomes, by path "
+        "(staged/consumed)",
+    "solver_pipeline_abort_total":
+        "Speculative encodes abandoned before consumption, by reason",
+    "solver_pipeline_reused_rows":
+        "W rows patched in from the speculation buffer per consumed cycle",
+    "solver_pipeline_speculate_seconds":
+        "Host wall time spent staging the next cycle's speculative encode "
+        "inside the device-dispatch overlap window",
     "trace_span_duration_seconds": "Span durations by span name",
     "remote_calls_total": "Remote worker calls by op/transport/outcome",
     "remote_call_duration_seconds":

@@ -44,6 +44,18 @@ is incremental again. The differential guarantee is strict: arena-built
 arrays are bit-identical to from-scratch encode (``verify=True`` asserts
 it after every incremental cycle; tests/test_arena_differential.py drives
 randomized mutation sequences through it).
+
+Pipelined speculation (PR 10): while cycle N executes on device, the
+pipelined driver stages cycle N+1's W build from the pre-apply state into
+one of two generation-tagged staging buffers (:meth:`begin_speculation`,
+ping-ponged per cycle). The apply boundary reports the keys it mutated
+(:meth:`note_applied`); the next incremental encode consumes the buffer,
+reusing rows whose inputs provably did not change and recomputing the
+dirty rest — or abandons it entirely (``solver_pipeline_abort_total`` by
+reason) on a quota-generation flip, bucket change, oversized delta set,
+arena invalidation, or an injected ``pipeline.patch`` fault. Abandonment
+always means a fresh row compute, never a stale one, so pipelined encodes
+stay bit-identical to the serialized loop by construction.
 """
 
 from __future__ import annotations
@@ -193,6 +205,12 @@ class CycleArena:
         self._committed = False
         # Rolling per-cycle stats (tests pin the perf contract on these).
         self.last_stats: Dict[str, object] = {}
+        # Pipelined-cycle speculation: two generation-tagged staging
+        # buffers ping-ponged per cycle (see begin_speculation).
+        self._spec_bufs: List[Optional[dict]] = [None, None]
+        self._spec_flip = 0
+        self.pipeline_patch_limit = 64
+        self.pipeline_stats: Counter = Counter()
 
     # -- snapshot pairing ---------------------------------------------------
 
@@ -225,7 +243,138 @@ class CycleArena:
         # encode path under generation keys; after a fault those keys can
         # no longer be trusted to imply valid tensors.
         self.component_cache.clear()
+        # Speculation buffers ride on the committed mirrors: a breaker
+        # trip or contained fault invalidates them exactly like the arena.
+        if any(b is not None for b in self._spec_bufs):
+            self._spec_bufs = [None, None]
+            self._pipe_abort("invalidated")
         self.last_stats = {"path": "invalidated", "reason": reason}
+
+    # -- pipelined speculation ----------------------------------------------
+
+    def _pipe_abort(self, reason: str) -> None:
+        self.pipeline_stats["abort:" + reason] += 1
+        tracing.inc(
+            "solver_pipeline_abort_total", labels={"reason": reason}
+        )
+
+    def begin_speculation(self, snapshot, heads: Sequence[WorkloadInfo],
+                          resource_flavors, w_pad: int = 0) -> bool:
+        """Stage cycle N+1's W build from cycle N's *pre-apply* state.
+
+        Called by the pipelined driver inside the device-dispatch overlap
+        window: while the device solves cycle N, the host runs the same
+        per-head W computation the next encode would (warming each head's
+        generation-keyed ``_elig_cache`` — the expensive FlavorAssigner
+        work — and materialising the row values) into one of two
+        generation-tagged staging buffers, ping-ponged per cycle. The
+        buffer is consumed by the next ``_incremental`` encode, which
+        patches in the dirty rows the apply boundary produced
+        (:meth:`note_applied`) and reuses the rest; any validity mismatch
+        abandons the buffer and the encode recomputes from live state, so
+        results are bit-identical to the unpipelined loop by construction.
+
+        Returns True when a buffer was staged.
+        """
+        if not self._committed or self.fair_sharing:
+            self._pipe_abort("not-committed")
+            return False
+        if getattr(snapshot, "quota_generation", None) != self._quota_gen:
+            self._pipe_abort("quota-gen")
+            return False
+        try:
+            device_wls, _fallbacks, mw = self._build_w(
+                snapshot, heads, resource_flavors, w_pad
+            )
+        except _Fallback:
+            self._pipe_abort("shape")
+            return False
+        buf = {
+            "quota_gen": self._quota_gen,
+            "w": int(mw["w_cq"].shape[0]),
+            "rows": {info.key: i for i, info in enumerate(device_wls)},
+            "info_id": {info.key: id(info) for info in device_wls},
+            "cq_gen": {
+                name: cqs.allocatable_generation
+                for name, cqs in snapshot.cluster_queues.items()
+            },
+            "mw": mw,
+            "touched": set(),
+        }
+        slot = self._spec_flip
+        self._spec_flip ^= 1
+        self._spec_bufs[slot] = buf
+        self.pipeline_stats["staged"] += 1
+        tracing.inc(
+            "solver_pipeline_cycles_total", labels={"path": "staged"}
+        )
+        return True
+
+    def note_applied(self, keys) -> None:
+        """Mark workload keys mutated at the apply boundary (processed
+        heads, preemption victims): their staged rows are dirty and will
+        be recomputed — the "patch" half of patch-after-speculate."""
+        for buf in self._spec_bufs:
+            if buf is not None:
+                buf["touched"].update(keys)
+
+    def _take_speculation(self) -> Optional[dict]:
+        """Pop the most recently staged buffer; both slots are cleared
+        (the older buffer describes a cycle that already happened)."""
+        newest = self._spec_flip ^ 1
+        out = None
+        for j in (newest, self._spec_flip):
+            buf, self._spec_bufs[j] = self._spec_bufs[j], None
+            if out is None and buf is not None:
+                out = buf
+        return out
+
+    def _spec_plan(self, spec: dict, device_wls, snapshot,
+                   w: int) -> Optional[Dict[int, int]]:
+        """Map live head positions to reusable staged rows, or None when
+        the speculation must be abandoned (counted by reason). A row is
+        reusable only if its key was staged, untouched since, backed by
+        the same WorkloadInfo object, and its CQ's allocatable generation
+        is unchanged — everything the row value is a function of."""
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.PIPELINE_PATCH)
+        except AssertionError:
+            raise
+        except Exception:
+            self._pipe_abort("fault")
+            return None
+        if spec["quota_gen"] != self._quota_gen:
+            self._pipe_abort("quota-gen")
+            return None
+        if spec["w"] != w:
+            self._pipe_abort("bucket")
+            return None
+        rows = spec["rows"]
+        ids = spec["info_id"]
+        touched = spec["touched"]
+        cq_gen = spec["cq_gen"]
+        plan: Dict[int, int] = {}
+        for i, info in enumerate(device_wls):
+            k = info.key
+            j = rows.get(k)
+            if j is None or k in touched or ids.get(k) != id(info):
+                continue
+            cqs = snapshot.cluster_queues.get(info.cluster_queue)
+            if cqs is None or cq_gen.get(info.cluster_queue) \
+                    != cqs.allocatable_generation:
+                continue
+            plan[i] = j
+        if len(device_wls) - len(plan) > self.pipeline_patch_limit:
+            self._pipe_abort("delta-threshold")
+            return None
+        self.pipeline_stats["consumed"] += 1
+        self.pipeline_stats["reused_rows"] += len(plan)
+        tracing.inc(
+            "solver_pipeline_cycles_total", labels={"path": "consumed"}
+        )
+        tracing.observe("solver_pipeline_reused_rows", float(len(plan)))
+        return plan
 
     # -- public encode ------------------------------------------------------
 
@@ -657,9 +806,12 @@ class CycleArena:
                 self._m_simple = simple
                 self._m_hier = hier
 
-        # 6. W family: per-head rows (inherently O(heads)), diffed.
+        # 6. W family: per-head rows (inherently O(heads)), diffed. A
+        # staged speculation buffer (pipelined driver) patches in clean
+        # rows here; dirty rows are recomputed exactly as without it.
         device_wls, fallbacks, new_mw = self._build_w(
-            snapshot, heads, resource_flavors, w_pad
+            snapshot, heads, resource_flavors, w_pad,
+            spec=self._take_speculation(),
         )
         stats["rows_recomputed"] = len(device_wls)
         w_new = int(new_mw["w_cq"].shape[0])
@@ -805,7 +957,8 @@ class CycleArena:
 
     # -- W family (replicates the encode_cycle head loop, dense case) -------
 
-    def _build_w(self, snapshot, heads, resource_flavors, w_pad):
+    def _build_w(self, snapshot, heads, resource_flavors, w_pad,
+                 spec=None):
         from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
 
         f, r = self._f, self._r
@@ -842,7 +995,18 @@ class CycleArena:
             "w_start_flavor": np.zeros(w, dtype=np.int32),
             "w_has_gates": np.zeros(w, dtype=bool),
         }
+        plan = (
+            self._spec_plan(spec, device_wls, snapshot, w)
+            if spec is not None else None
+        )
         for i, info in enumerate(device_wls):
+            if plan is not None:
+                j = plan.get(i)
+                if j is not None:
+                    for col, v in spec["mw"].items():
+                        if col != "w_order_rank":
+                            mw[col][i] = v[j]
+                    continue
             slots = wl_slots[i]
             cqs = snapshot.cluster_queues[info.cluster_queue]
             ps0 = info.obj.pod_sets[0]

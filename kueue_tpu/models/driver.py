@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 
 from kueue_tpu.api.constants import (
@@ -89,6 +90,9 @@ class DeviceScheduler:
         breaker_max_backoff_s: float = 60.0,
         device_kernel: str = "scan",
         fixedpoint_max_rounds: int = 64,
+        auto_cpu_kernel: str = "scan",
+        pipeline_cycles: str = "off",
+        pipeline_patch_limit: int = 64,
     ) -> None:
         self.cache = cache
         self.queues = queues
@@ -117,6 +121,20 @@ class DeviceScheduler:
             )
         self.device_kernel = device_kernel
         self.fixedpoint_max_rounds = int(fixedpoint_max_rounds)
+        # Per-platform "auto" preference: on CPU the sequential scan is
+        # measured faster than fixed-point rounds (scanfloor ledger:
+        # fp_speedup 0.42x on the single-core box), so auto keeps the
+        # scan there unless the cycle's scan bound is long or this
+        # override forces the fixed point (see _fp_auto_ok).
+        if auto_cpu_kernel not in ("scan", "fixedpoint"):
+            raise ValueError(
+                f"auto_cpu_kernel must be scan|fixedpoint, "
+                f"got {auto_cpu_kernel!r}"
+            )
+        self.auto_cpu_kernel = auto_cpu_kernel
+        # (reason, s_resid) of the most recent auto-kernel decision —
+        # suffixed onto the flight-recorder kernel field.
+        self._auto_choice: Tuple[str, int] = ("", 0)
         # Rounds the most recent fixed-point dispatch took (None when the
         # last cycle used a scan kernel) — cost-ledger lane + diagnostics.
         self._last_fp_rounds: Optional[int] = None
@@ -155,6 +173,30 @@ class DeviceScheduler:
         )
         self.fault_fallback_cycles = 0
         self.last_fault: Optional[Tuple[str, str]] = None
+        # Pipelined admission cycles: while cycle N executes on device,
+        # speculatively stage cycle N+1's host encode from the pre-apply
+        # state (arena.begin_speculation) inside the overlap window; the
+        # next encode patches in the dirty rows the apply produced. Apply
+        # stays FIFO-at-boundary, so results are bit-identical to the
+        # serialized loop. "auto" stays off for call-per-cycle usage and
+        # is switched on by the service loop (set_pipeline).
+        if pipeline_cycles not in ("on", "off", "auto"):
+            raise ValueError(
+                f"pipeline_cycles must be on|off|auto, "
+                f"got {pipeline_cycles!r}"
+            )
+        if pipeline_cycles == "on" and self._arena is None:
+            raise ValueError(
+                "pipeline_cycles='on' requires the arena (use_arena=True)"
+            )
+        self.pipeline_cycles = pipeline_cycles
+        self.pipeline_patch_limit = int(pipeline_patch_limit)
+        self._pipeline_on = pipeline_cycles == "on"
+        self._pipeline_skip_next = False
+        self.pipeline_speculated = 0
+        self.pipeline_overlap_s = 0.0
+        if self._arena is not None:
+            self._arena.pipeline_patch_limit = self.pipeline_patch_limit
         # Optional what-if engine refreshed in spare time (attach_whatif).
         self._whatif = None
         self._whatif_interval_s = 30.0
@@ -171,10 +213,55 @@ class DeviceScheduler:
     def health(self) -> dict:
         """Lock-free device-path health summary for liveness probes."""
         fault = self.last_fault
-        return {
+        doc = {
             "breakerState": self._breaker.gauge_value,
             "faultFallbackCycles": self.fault_fallback_cycles,
             "lastFault": list(fault) if fault is not None else None,
+        }
+        if self.pipeline_cycles != "off":
+            doc["pipeline"] = self.pipeline_health()
+        return doc
+
+    def set_pipeline(self, enabled: bool) -> None:
+        """Resolve ``pipelineCycles: auto``: the service loop enables the
+        pipeline when it starts driving sustained cycles (call-per-cycle
+        usage stays serialized); explicit "on"/"off" are unaffected."""
+        if self.pipeline_cycles == "auto":
+            self._pipeline_on = bool(enabled) and self._arena is not None
+
+    def pipeline_backpressure_hint(self, quota_ops_pending: bool) -> None:
+        """Service-loop backpressure interaction: when the drained ingest
+        batch holds quota-affecting ops, the next speculation would be a
+        guaranteed quota-generation abort — skip staging it instead of
+        burning the overlap window."""
+        if quota_ops_pending:
+            self._pipeline_skip_next = True
+
+    def pipeline_health(self) -> dict:
+        """Lock-free pipeline summary for service health and the bench."""
+        st = (
+            dict(self._arena.pipeline_stats)
+            if self._arena is not None else {}
+        )
+        aborts = {
+            k.split(":", 1)[1]: v for k, v in st.items()
+            if k.startswith("abort:")
+        }
+        dev = self.device_time_s
+        occ = (
+            100.0 * min(self.pipeline_overlap_s, dev) / dev
+            if dev > 0 else 0.0
+        )
+        return {
+            "mode": self.pipeline_cycles,
+            "enabled": self._pipeline_on,
+            "speculated": st.get("staged", 0),
+            "consumed": st.get("consumed", 0),
+            "reusedRows": st.get("reused_rows", 0),
+            "aborts": aborts,
+            "abortTotal": sum(aborts.values()),
+            "overlapS": round(self.pipeline_overlap_s, 6),
+            "overlapOccupancyPct": round(occ, 3),
         }
 
     @property
@@ -228,6 +315,20 @@ class DeviceScheduler:
             return t
         return self._prewarm_sync(max_heads, aot)
 
+    def _prewarm_fp_wanted(self) -> bool:
+        """Whether prewarm should compile the fixed-point entries: skip
+        warms that "auto" would never dispatch on this backend (CPU
+        prefers the scan unless overridden; the long-scan escape hatch
+        compiles on first use like any bucket growth)."""
+        if self.device_kernel == "fixedpoint":
+            return True
+        if self.device_kernel != "auto":
+            return False
+        return (
+            jax.default_backend() != "cpu"
+            or self.auto_cpu_kernel == "fixedpoint"
+        )
+
     def _prewarm_sync(self, max_heads: int, aot: bool):
         if tracing.ENABLED:
             tracing.set_gauge("solver_prewarm_state", 1)  # running
@@ -270,7 +371,7 @@ class DeviceScheduler:
                         (arrays, idx.group_arrays, idx.admitted_arrays),
                         aot=aot,
                     )
-                    if self.device_kernel in ("fixedpoint", "auto"):
+                    if self._prewarm_fp_wanted():
                         max_r = self.fixedpoint_max_rounds
                         timings[bucket] += compile_cache.prewarm_entry(
                             "cycle_fixedpoint",
@@ -278,7 +379,8 @@ class DeviceScheduler:
                             (arrays, idx.group_arrays),
                             static=("rounds", max_r), aot=aot,
                         )
-                    if self.device_kernel == "auto":
+                    if self.device_kernel == "auto" \
+                            and self._prewarm_fp_wanted():
                         # Hybrid: warm the residual ladder's floor rung —
                         # the common case (few preemptors per tree); deeper
                         # residuals compile on first use like any bucket
@@ -429,6 +531,7 @@ class DeviceScheduler:
         fault: Optional[Tuple[str, Exception]] = None
         planes = None
         entry = "cycle_grouped_preempt"
+        self._auto_choice = ("", 0)
         if idx.workloads:
             t0 = self.clock()
             out = None
@@ -460,18 +563,17 @@ class DeviceScheduler:
                 elif self.device_kernel in ("fixedpoint", "auto") \
                         and not idx.has_partial \
                         and arrays.s_req is None \
-                        and arrays.tas_topo is None:
+                        and arrays.tas_topo is None \
+                        and self._fp_auto_ok(arrays, idx):
                     max_r = self.fixedpoint_max_rounds
                     # Residual preemption-scan bound: 0 when no tree can
                     # possibly preempt this cycle (pure fixed-point is
                     # then exact — preemption-needing entries would defer
                     # to the host via needs_host, as before). Strict
                     # "fixedpoint" mode keeps the pure kernel regardless,
-                    # trading those trees to the host path.
-                    s_resid = (
-                        self._residual_scan_bound(arrays, idx)
-                        if self.device_kernel == "auto" else 0
-                    )
+                    # trading those trees to the host path. Computed by
+                    # _fp_auto_ok alongside the platform preference.
+                    s_resid = self._auto_choice[1]
                     if s_resid > 0:
                         entry = "cycle_fixedpoint_hybrid"
                         s_b = buckets.pow2_bucket(s_resid, floor=4)
@@ -530,6 +632,15 @@ class DeviceScheduler:
                 pre_done = True
                 if rec_t is not None:
                     rec_t["overlap_host_s"] = host_dt
+            if self._pipeline_on and fault is None:
+                # Pipeline stage: while the device still solves cycle N,
+                # stage cycle N+1's speculative encode from the pre-apply
+                # state. Contained — a staging failure aborts only the
+                # speculation, never the cycle.
+                spec_dt = self._speculate_next(snapshot, heads, bucket)
+                host_dt += spec_dt
+                if rec_t is not None and spec_dt:
+                    rec_t["speculate_s"] = spec_dt
             planes = None
             if fault is None:
                 try:
@@ -709,6 +820,11 @@ class DeviceScheduler:
             result.skipped.extend(host_result.skipped)
             result.inadmissible.extend(host_result.inadmissible)
 
+        if self._pipeline_on:
+            # Apply boundary passed: report every key this cycle mutated
+            # so staged speculation rows for them are patched, not reused.
+            self._pipeline_note_applied(result)
+
         result.duration_s = self.clock() - start
         if flight.ENABLED:
             flight.capture_cycle(
@@ -729,7 +845,13 @@ class DeviceScheduler:
                 timings=rec_t, result=result,
                 duration_s=result.duration_s,
                 idx=idx, planes=planes,
-                kernel=entry if planes is not None else "",
+                kernel=(
+                    entry + (
+                        f"[{self._auto_choice[0]}]"
+                        if self._auto_choice[0] else ""
+                    )
+                    if planes is not None else ""
+                ),
             )
         return result
 
@@ -802,6 +924,89 @@ class DeviceScheduler:
             return 0
         counts = np.bincount(g_w[act], minlength=int(resid.size))
         return int(counts[g_resid].max())
+
+    # Scan-depth threshold above which CPU "auto" still takes the fixed
+    # point: past this many sequential per-tree steps the parallel rounds
+    # win even on a single core (the scanfloor probe tracks the floor).
+    _CPU_FP_SCAN_BOUND = 64
+
+    def _fp_auto_ok(self, arrays, idx) -> bool:
+        """Per-platform kernel preference for the exact fixed-point shape
+        gate (the conjunct before this one establishes exactness).
+
+        Strict "fixedpoint" keeps the legacy behavior. "auto" prefers the
+        fixed point on accelerator backends (parallel rounds beat the
+        sequential scan), but on CPU the scan is measured faster
+        (scanfloor ledger: fp_speedup 0.42x on the single-core box), so
+        auto keeps the scan there unless the cycle's full scan bound
+        exceeds ``_CPU_FP_SCAN_BOUND`` or ``auto_cpu_kernel`` forces the
+        fixed point. The decision reason and the residual scan bound land
+        in ``self._auto_choice`` (flight-recorder kernel suffix)."""
+        if self.device_kernel != "auto":
+            self._auto_choice = ("", 0)
+            return True
+        s_resid = self._residual_scan_bound(arrays, idx)
+        if jax.default_backend() != "cpu":
+            self._auto_choice = ("auto-accel", s_resid)
+            return True
+        if self.auto_cpu_kernel == "fixedpoint":
+            self._auto_choice = ("auto-cpu-fp", s_resid)
+            return True
+        if self._full_scan_bound(arrays, idx) > self._CPU_FP_SCAN_BOUND:
+            self._auto_choice = ("auto-cpu-long-scan", s_resid)
+            return True
+        self._auto_choice = ("auto-cpu-scan", s_resid)
+        return False
+
+    @staticmethod
+    def _full_scan_bound(arrays, idx) -> int:
+        """Sequential steps the grouped scan needs this cycle: the
+        per-tree active-head maximum over ALL trees (the scan's s_max
+        analogue), host-side from already-resident encode arrays."""
+        act = np.asarray(arrays.w_active)
+        if not act.any():
+            return 0
+        flat_to_group = np.asarray(idx.group_arrays.flat_to_group)
+        g_w = flat_to_group[np.asarray(arrays.w_cq)[act]]
+        return int(np.bincount(g_w).max())
+
+    # -- pipelined cycles ----------------------------------------------------
+
+    def _speculate_next(self, snapshot, heads, bucket: int) -> float:
+        """Stage cycle N+1's speculative encode inside the device overlap
+        window. Returns the host seconds spent (booked as pipeline
+        overlap). Contained: any failure aborts only the speculation."""
+        if self._arena is None:
+            return 0.0
+        if self._pipeline_skip_next:
+            self._pipeline_skip_next = False
+            return 0.0
+        t0 = self.clock()
+        try:
+            staged = self._arena.begin_speculation(
+                snapshot, heads, snapshot.resource_flavors, w_pad=bucket
+            )
+        except AssertionError:
+            raise
+        except Exception:
+            self._arena._pipe_abort("speculate-error")
+            staged = False
+        dt = self.clock() - t0
+        if staged:
+            self.pipeline_speculated += 1
+            self.pipeline_overlap_s += dt
+            if tracing.ENABLED:
+                tracing.observe("solver_pipeline_speculate_seconds", dt)
+        return dt
+
+    def _pipeline_note_applied(self, result: CycleResult) -> None:
+        """Report the apply boundary's mutated keys (every processed head
+        plus preemption victims) to the arena's staged buffers."""
+        if self._arena is None:
+            return
+        keys = set(result.head_keys)
+        keys.update(result.preempted)
+        self._arena.note_applied(keys)
 
     # -- fault containment ---------------------------------------------------
 

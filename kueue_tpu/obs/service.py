@@ -15,10 +15,20 @@ differential): ingested ops are applied FIFO at the top of a loop
 iteration, under the service lock, on the loop thread — so the event
 sequence the scheduler sees is exactly the sequence a call-per-cycle
 driver would produce, and every cycle stays bit-identical to the
-synchronous path. The pipelining is real but observation-only: stage B
+synchronous path. The telemetry pipelining is observation-only: stage B
 (telemetry export — watermark gauges, continuous SLO burn, observer
 callbacks) runs on the telemetry thread and never touches manager
 state, so overlapping it with stage A cannot change an admission.
+
+The service loop is also what switches on *compute* pipelining: a
+device scheduler configured ``pipeline_cycles="auto"`` gets
+``set_pipeline(True)`` at service start, so each admission cycle
+speculatively stages the next cycle's W encode inside its own
+device-dispatch window (models/driver.py + models/arena.py). Apply
+stays FIFO at the cycle boundary and stale speculation rows are
+patched or abandoned, so results remain bit-identical to the
+serialized loop; the loop feeds a backpressure hint (skip staging
+while quota edits / deletes are draining) each iteration.
 
 Live-health surface (docs/observability.md, "Service loop & live
 health"):
@@ -140,6 +150,16 @@ class ServiceLoop:
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
 
+        # Pipelined admission cycles: True when the device scheduler is
+        # speculating next-cycle encodes inside the dispatch window. A
+        # scheduler configured pipeline_cycles="auto" is switched on at
+        # service start (_prepare_start) — the service loop is the
+        # steady-cycle producer the speculation pays off under.
+        self._pipeline = bool(
+            getattr(getattr(manager, "scheduler", None),
+                    "_pipeline_on", False)
+        )
+
         # Telemetry hand-off: a coalescing one-slot mailbox + seq/done
         # counters so flush_telemetry() can wait for quiescence.
         self._tel_cv = threading.Condition()
@@ -208,6 +228,13 @@ class ServiceLoop:
                 m.observe("service_ingest_lag_seconds", max(0.0, now - op[-1]))
                 m.inc("service_ingest_ops_total", {"kind": op[0]})
                 self._apply_op(op)
+            if self._pipeline:
+                # Backpressure hint: config churn (quota edits, queue
+                # deletes) invalidates speculation buffers anyway — skip
+                # staging the next one while such ops are flowing.
+                self.manager.scheduler.pipeline_backpressure_hint(
+                    any(op[0] in ("apply", "delete") for op in batch)
+                )
             had_pending = bool(batch) or self._any_pending()
             if had_pending:
                 prev_heads = None
@@ -423,6 +450,13 @@ class ServiceLoop:
         # Build the SLO engine up front so continuous burn starts on the
         # first telemetry pass, not the first /slo request.
         self.manager.slo()
+        # Resolve pipeline_cycles="auto": under a service loop the next
+        # cycle is (almost) always coming, so speculation pays for
+        # itself; call-per-cycle users keep it off for free.
+        sched = getattr(self.manager, "scheduler", None)
+        if getattr(sched, "pipeline_cycles", None) == "auto":
+            sched.set_pipeline(True)
+        self._pipeline = bool(getattr(sched, "_pipeline_on", False))
         if self.telemetry_async:
             self._tel_thread = threading.Thread(
                 target=self._telemetry_run,
@@ -506,6 +540,7 @@ class ServiceLoop:
             "errors": self._errors,
             "ingestDepth": self.ingest_depth(),
             "breakerState": breaker,
+            "pipelineEnabled": self._pipeline,
         }
 
     def to_doc(self) -> dict:
@@ -516,4 +551,9 @@ class ServiceLoop:
         doc["cyclesPerIter"] = self.cycles_per_iter
         doc["maxIngest"] = self.max_ingest
         doc["telemetryAsync"] = self.telemetry_async
+        pipeline_health = getattr(
+            self.manager.scheduler, "pipeline_health", None
+        )
+        if pipeline_health is not None:
+            doc["pipeline"] = pipeline_health()
         return doc

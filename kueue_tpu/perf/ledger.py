@@ -41,6 +41,13 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "cycle_p50_ms": "lower",
         "cycle_p99_ms": "lower",
         "ingest_lag_p99_ms": "lower",
+        # v3 (pipelined vs serialized in one invocation): occupancy of
+        # the device-dispatch window by speculative host encode, total
+        # abandoned speculations, and pipelined-minus-serialized deltas.
+        "pipeline_overlap_occupancy_pct": "higher",
+        "pipeline_abort_total": "lower",
+        "admissions_per_s_delta_pct": "higher",
+        "cycle_p99_delta_ms": "lower",
     },
     "sim": {"admissions_per_s": "higher"},
     "fair": {

@@ -35,6 +35,11 @@ anything else so a typo'd point never silently no-ops):
   (obs/service.py; a ``delay`` rule stalls the loop so ``/healthz``
   staleness detection can be drilled, a ``raise`` rule is contained by
   the loop and counted in ``service_loop_errors_total``)
+- ``pipeline.patch``    — the CycleArena speculative-encode patch step
+  (models/arena.py; consuming a pipelined speculation buffer into the
+  next cycle's W build. A ``raise`` rule aborts the speculation —
+  counted in ``solver_pipeline_abort_total{reason="fault"}`` — and the
+  cycle falls back to a fresh encode, never a corrupted one)
 
 Rule modes:
 
@@ -88,6 +93,7 @@ CACHE_SNAPSHOT = "cache.snapshot"
 WHATIF_DISPATCH = "whatif.dispatch"
 COMPILE_DESERIALIZE = "compile.deserialize"
 SERVICE_CYCLE = "service.cycle"
+PIPELINE_PATCH = "pipeline.patch"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -99,6 +105,7 @@ POINTS = frozenset({
     WHATIF_DISPATCH,
     COMPILE_DESERIALIZE,
     SERVICE_CYCLE,
+    PIPELINE_PATCH,
 })
 
 _MODES = ("raise", "delay", "corrupt")

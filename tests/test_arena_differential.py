@@ -5,8 +5,13 @@ requeue / CQ quota update / flavor change) drive DeviceScheduler with
 ``verify_arena=True``: every incremental cycle re-encodes from scratch
 and asserts the arena-built arrays are bit-identical (assert_cycle_equal
 inside models/arena.py). The same sequences run arena-on vs arena-off
-and must produce identical per-cycle admission outcomes. Also pins the
-padding-bucket hysteresis and the Cache generation split.
+and must produce identical per-cycle admission outcomes. The same
+randomized schedules also run with ``pipeline_cycles="on"`` — every
+cycle speculatively stages the next encode inside the dispatch window
+and the consume-time patch is verified bit-identical, with and without
+injected ``pipeline.patch`` / ``arena.delta_apply`` / breaker-tripping
+``solver.dispatch`` faults. Also pins the padding-bucket hysteresis and
+the Cache generation split.
 """
 
 import random
@@ -22,6 +27,7 @@ from kueue_tpu.api.types import (
 )
 from kueue_tpu.models.driver import DeviceScheduler
 from kueue_tpu.tas.snapshot import Node
+from kueue_tpu.utils import faults
 
 from .helpers import build_env, make_cq, make_wl, submit
 
@@ -55,14 +61,16 @@ def _build(quota_a: int = 4000):
     return cache, queues
 
 
-def _drive(seed: int, use_arena: bool, verify: bool = False):
+def _drive(seed: int, use_arena: bool, verify: bool = False,
+           pipeline: bool = False):
     """Run one randomized mutation sequence; return per-cycle outcome
     fingerprints (admitted keys, preempted keys, cache contents) plus the
     arena path taken per cycle (empty when arena is off)."""
     rng = random.Random(seed)
     cache, queues = _build()
     sched = DeviceScheduler(
-        cache, queues, use_arena=use_arena, verify_arena=verify
+        cache, queues, use_arena=use_arena, verify_arena=verify,
+        pipeline_cycles="on" if pipeline else "off",
     )
     t = 1000.0
     wl_n = 0
@@ -121,6 +129,42 @@ def test_randomized_mutations_bitwise_and_outcomes(seed):
     with_arena, _ = _drive(seed, use_arena=True, verify=True)
     without, _ = _drive(seed, use_arena=False)
     assert with_arena == without
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_pipeline_bitwise_and_outcomes(seed):
+    """The pipelined tentpole's correctness pin: the same randomized
+    sequences (quota edits and flavor flips included — each one a
+    speculation invalidation or quota-gen abort) with pipeline_cycles=on
+    must stay bit-identical inside every cycle (verify_arena re-encodes
+    from scratch, so a wrongly reused speculation row would assert) AND
+    produce outcomes identical to the plain serialized arena-off run."""
+    piped, _ = _drive(seed, use_arena=True, verify=True, pipeline=True)
+    without, _ = _drive(seed, use_arena=False)
+    assert piped == without
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_randomized_pipeline_under_faults(seed):
+    """Re-convergence under injected faults: pipeline.patch raises abort
+    the speculation consume (reason="fault" — never a corrupted encode),
+    arena.delta_apply raises force contained full/host fallbacks that
+    invalidate the speculation buffers, and solver.dispatch raises can
+    trip the breaker (invalidating them again on trip + recovery). The
+    faulted pipelined run must still match the clean serialized run
+    cycle for cycle."""
+    plan = faults.FaultPlan(seed=seed)
+    plan.add(faults.PIPELINE_PATCH, mode="raise", rate=0.4)
+    plan.add(faults.ARENA_DELTA_APPLY, mode="raise", rate=0.2)
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", rate=0.15)
+    faults.install(plan)
+    try:
+        piped, _ = _drive(seed, use_arena=True, verify=True,
+                          pipeline=True)
+    finally:
+        faults.clear()
+    without, _ = _drive(seed, use_arena=False)
+    assert piped == without
 
 
 def test_incremental_path_taken_and_verified():

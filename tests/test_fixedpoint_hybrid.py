@@ -177,6 +177,11 @@ def test_rounds_cap_sufficient_stays_on_device():
 
 
 def test_flight_recorder_names_deciding_kernel():
+    """deviceKernel=auto records kernel + deciding reason. On a CPU
+    backend the default prefers the grouped scan (the fixed-point
+    rounds are slower under CPU emulation — the scanfloor probe's
+    fp_speedup < 1); autoCpuKernel=fixedpoint forces the accelerator
+    preference and the reason suffix says so."""
     prev = flight.ENABLED
     rec = flight.enable(capacity=64)
     rec.clear()
@@ -186,10 +191,22 @@ def test_flight_recorder_names_deciding_kernel():
         submit(queues, wa, wb)
         sched.schedule_all(max_cycles=10)
         kernels = {r.kernel for r in rec.records() if r.path == "device"}
-        assert kernels <= {"cycle_fixedpoint", "cycle_fixedpoint_hybrid"}
-        assert kernels, "no device cycle recorded a kernel name"
+        assert kernels <= {"cycle_grouped_preempt[auto-cpu-scan]",
+                           "cycle_grouped_preempt"}, kernels
+        assert "cycle_grouped_preempt[auto-cpu-scan]" in kernels
         atts = rec.attempts_for("default/wa")
         assert atts and atts[-1]["kernel"] in kernels
+
+        rec.clear()
+        cache, queues, wa, wb = _two_round_env()
+        sched = DeviceScheduler(cache, queues, device_kernel="auto",
+                                auto_cpu_kernel="fixedpoint")
+        submit(queues, wa, wb)
+        sched.schedule_all(max_cycles=10)
+        kernels = {r.kernel for r in rec.records() if r.path == "device"}
+        assert kernels <= {"cycle_fixedpoint[auto-cpu-fp]",
+                           "cycle_fixedpoint_hybrid[auto-cpu-fp]"}, kernels
+        assert kernels, "no device cycle recorded a kernel name"
     finally:
         if prev:
             flight.enable()

@@ -1,0 +1,401 @@
+"""Pipelined admission cycles: speculation mechanics, abort taxonomy,
+service-loop integration, and config plumbing.
+
+The bit-identity of pipelined runs against the serialized loop is pinned
+by tests/test_arena_differential.py's randomized schedules (with and
+without injected faults); this file covers the machinery those
+differentials exercise only indirectly:
+
+- the actual row-reuse path. Driver-level runs patch every staged row
+  (the apply boundary touches every processed head), so the
+  ``_build_w`` copy-from-speculation branch is only reachable by
+  calling ``begin_speculation`` + ``encode`` directly with no
+  ``note_applied`` in between — done here with ``verify_arena=True``
+  so the reused rows are re-encoded from scratch and asserted
+  bit-identical inside the arena;
+- every abort reason: bucket mismatch, delta threshold, stale
+  quota generation, injected ``pipeline.patch`` fault, breaker-style
+  ``invalidate()``;
+- the service loop resolving ``pipelineCycles: auto`` at start, the
+  backpressure hint skipping speculation while quota ops drain, and
+  ``service.cycle`` raise containment with the pipeline on;
+- the config layer (``pipelineCycles`` / ``autoCpuKernel``) down to
+  the DeviceScheduler attributes, including validation errors.
+
+Every scenario is deliberately tiny: the suite runs on slow
+single-core boxes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kueue_tpu.api.types import LocalQueue, ResourceFlavor, ResourceQuota
+from kueue_tpu.config.configuration import build_manager, load
+from kueue_tpu.manager import Manager
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.utils import faults
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+pytestmark = pytest.mark.isolated
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _env():
+    cqs = [
+        make_cq("cq-a", flavors={
+            "default": {"cpu": ResourceQuota(nominal=4000)}
+        }),
+        make_cq("cq-b", flavors={
+            "default": {"cpu": ResourceQuota(nominal=4000)}
+        }),
+    ]
+    cache, queues, _ = build_env(cqs)
+    return cache, queues
+
+
+def _committed_sched(verify: bool = True):
+    """Two admitted warm-up cycles -> a committed arena with stable
+    priority cuts (the first admission changes them, forcing one more
+    full encode), plus pending heads for the next cycle (never processed
+    by the driver, so their staged rows stay untouched)."""
+    cache, queues = _env()
+    sched = DeviceScheduler(cache, queues, verify_arena=verify)
+    submit(queues, make_wl("seed", queue="lq-cq-a", cpu_m=500,
+                           creation_time=1.0))
+    sched.schedule()
+    submit(queues, make_wl("seed2", queue="lq-cq-a", cpu_m=500,
+                           creation_time=1.5))
+    sched.schedule()
+    assert sched._arena._committed
+    for i in range(2, 5):
+        submit(queues, make_wl(f"p{i}", queue="lq-cq-b", cpu_m=500,
+                               creation_time=float(i)))
+    return cache, queues, sched
+
+
+# ---------------------------------------------------------------------------
+# driver-level: speculation runs, outcomes match the serialized loop
+
+
+def _drive_stream(pipeline: bool):
+    cache, queues = _env()
+    sched = DeviceScheduler(
+        cache, queues, verify_arena=True,
+        pipeline_cycles="on" if pipeline else "off",
+    )
+    outcomes = []
+    for i in range(1, 8):
+        submit(queues, make_wl(
+            f"w{i}", queue="lq-cq-a" if i % 2 else "lq-cq-b",
+            cpu_m=500, creation_time=float(i),
+        ))
+        r = sched.schedule()
+        outcomes.append((
+            sorted(map(str, r.admitted)),
+            sorted(map(str, r.preempted)),
+            sorted(cache.workloads),
+        ))
+    return outcomes, sched
+
+
+def test_pipeline_on_matches_off_and_speculates():
+    """A steady stream with pipeline_cycles=on stages a speculation in
+    (nearly) every dispatch window and consumes it at the next encode —
+    with identical cycle outcomes and verify_arena pinning every
+    incremental encode bit-identical to from-scratch."""
+    on, sched = _drive_stream(True)
+    off, _ = _drive_stream(False)
+    assert on == off
+    assert sched.pipeline_speculated > 0
+    st = sched._arena.pipeline_stats
+    assert st["staged"] > 0
+    # Driver-level consumes patch every row (the apply boundary touches
+    # every processed head) but must still consume, not abort.
+    assert st["consumed"] > 0
+    h = sched.pipeline_health()
+    assert h["mode"] == "on" and h["enabled"]
+    assert h["speculated"] == st["staged"]
+    assert h["consumed"] == st["consumed"]
+    assert h["abortTotal"] == sum(
+        v for k, v in st.items() if k.startswith("abort:")
+    )
+    assert "pipeline" in sched.health()
+
+
+def test_pipeline_off_never_stages():
+    _, sched = _drive_stream(False)
+    assert sched.pipeline_speculated == 0
+    assert sched._arena.pipeline_stats.get("staged", 0) == 0
+    assert "pipeline" not in sched.health()
+
+
+def test_pipeline_on_requires_arena():
+    cache, queues = _env()
+    with pytest.raises(ValueError, match="requires the arena"):
+        DeviceScheduler(cache, queues, use_arena=False,
+                        pipeline_cycles="on")
+    with pytest.raises(ValueError, match="on|off|auto"):
+        DeviceScheduler(cache, queues, pipeline_cycles="sometimes")
+
+
+# ---------------------------------------------------------------------------
+# arena-level: the row-reuse path and the abort taxonomy
+
+
+def test_speculation_row_reuse_bit_identical():
+    """Stage a speculation for pending (untouched) heads, then run the
+    encode it targets: every staged device row must be reused, and the
+    arena's verify mode re-encodes from scratch and asserts the patched
+    arrays bit-identical."""
+    cache, queues, sched = _committed_sched(verify=True)
+    arena = sched._arena
+    heads = sched.queues.heads()
+    assert heads
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=16
+    )
+    out = arena.encode(snap, heads, snap.resource_flavors, w_pad=16)
+    assert out is not None
+    assert arena.last_stats["path"] == "incremental"
+    st = arena.pipeline_stats
+    assert st["staged"] == 1
+    assert st["consumed"] == 1
+    assert st["reused_rows"] >= 1
+    # Consuming clears both staging slots.
+    assert arena._spec_bufs == [None, None]
+
+
+def test_bucket_mismatch_aborts():
+    cache, queues, sched = _committed_sched()
+    arena = sched._arena
+    heads = sched.queues.heads()
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=32
+    )
+    arena.encode(snap, heads, snap.resource_flavors, w_pad=16)
+    st = arena.pipeline_stats
+    assert st["abort:bucket"] == 1
+    assert st.get("consumed", 0) == 0
+
+
+def test_patch_limit_zero_aborts_delta_threshold():
+    cache, queues, sched = _committed_sched()
+    arena = sched._arena
+    arena.pipeline_patch_limit = 0
+    heads = sched.queues.heads()
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=16
+    )
+    # The apply boundary dirties a staged row; with a zero patch budget
+    # any recompute abandons the whole buffer.
+    arena.note_applied({heads[0].key})
+    arena.encode(snap, heads, snap.resource_flavors, w_pad=16)
+    st = arena.pipeline_stats
+    assert st["abort:delta-threshold"] == 1
+    assert st.get("consumed", 0) == 0
+
+
+def test_stale_speculation_aborts_on_quota_generation():
+    """A buffer staged before a quota edit survives the edit's full
+    re-encode (only _incremental consumes buffers) — the next
+    incremental cycle must notice the stale quota generation and
+    abandon it, not reuse rows priced against dead quota."""
+    cache, queues, sched = _committed_sched()
+    arena = sched._arena
+    heads = sched.queues.heads()
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=16
+    )
+    cache.add_or_update_cluster_queue(make_cq("cq-a", flavors={
+        "default": {"cpu": ResourceQuota(nominal=6000)}
+    }))
+    queues.queue_inadmissible_workloads()
+    sched.schedule()  # quota-gen gate -> full encode, re-commit
+    assert arena.last_stats["path"] == "full"
+    submit(queues, make_wl("late", queue="lq-cq-a", cpu_m=500,
+                           creation_time=9.0))
+    sched.schedule()  # incremental: pops the stale buffer, aborts it
+    st = arena.pipeline_stats
+    assert st["abort:quota-gen"] == 1
+    assert st.get("consumed", 0) == 0
+
+
+def test_pipeline_patch_fault_aborts_consume():
+    """An injected pipeline.patch raise aborts the speculation (reason
+    "fault"), and the encode falls back to fresh row computation — the
+    verify-mode re-encode proves it was never corrupted."""
+    cache, queues, sched = _committed_sched(verify=True)
+    arena = sched._arena
+    heads = sched.queues.heads()
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=16
+    )
+    plan = faults.FaultPlan(seed=1)
+    plan.add(faults.PIPELINE_PATCH, mode="raise", rate=1.0)
+    faults.install(plan)
+    try:
+        out = arena.encode(snap, heads, snap.resource_flavors, w_pad=16)
+    finally:
+        faults.clear()
+    assert out is not None
+    assert arena.last_stats["path"] == "incremental"
+    st = arena.pipeline_stats
+    assert st["abort:fault"] == 1
+    assert st.get("consumed", 0) == 0
+
+
+def test_invalidate_clears_speculation_buffers():
+    cache, queues, sched = _committed_sched()
+    arena = sched._arena
+    heads = sched.queues.heads()
+    snap = arena.take_snapshot()
+    assert arena.begin_speculation(
+        snap, heads, snap.resource_flavors, w_pad=16
+    )
+    arena.invalidate("test")
+    assert arena._spec_bufs == [None, None]
+    assert arena.pipeline_stats["abort:invalidated"] == 1
+    # Idempotent: no buffers left, no double count.
+    arena.invalidate("test")
+    assert arena.pipeline_stats["abort:invalidated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# service loop: auto resolution, backpressure hint, fault containment
+
+
+def _service_manager(**kw) -> Manager:
+    mgr = Manager(use_device_scheduler=True, **kw)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={
+            "default": {"cpu": ResourceQuota(nominal=8_000)}
+        }),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    return mgr
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_service_resolves_auto_and_hints_backpressure():
+    """pipelineCycles defaults to "auto": off for call-per-cycle use,
+    switched on when a service loop starts. A drained batch holding
+    quota-affecting ops skips the next speculation (it would be a
+    guaranteed quota-gen abort); clean batches resume staging."""
+    mgr = _service_manager()
+    sched = mgr.scheduler
+    assert sched.pipeline_cycles == "auto"
+    assert not sched._pipeline_on
+    svc = mgr.service(tick_interval_s=None, cycles_per_iter=1,
+                      telemetry_async=False)
+    svc._prepare_start(threading.Event())
+    assert sched._pipeline_on and svc._pipeline
+    assert svc.health()["pipelineEnabled"] is True
+    assert svc.to_doc()["pipeline"]["mode"] == "auto"
+    assert svc.to_doc()["pipeline"]["enabled"] is True
+
+    for i in range(4):
+        assert svc.submit(make_wl(f"s{i}", cpu_m=500))
+    svc.step()
+    staged0 = sched._arena.pipeline_stats["staged"]
+    assert staged0 > 0
+
+    # Quota edit in the batch -> the hint skips this step's speculation.
+    assert svc.apply(make_cq("cq-a", flavors={
+        "default": {"cpu": ResourceQuota(nominal=9_000)}
+    }))
+    assert svc.submit(make_wl("s9", cpu_m=500))
+    svc.step()
+    assert sched._arena.pipeline_stats["staged"] == staged0
+    assert not sched._pipeline_skip_next  # consumed by the cycle
+
+    # Clean submit-only batch -> speculation resumes.
+    assert svc.submit(make_wl("s10", cpu_m=500))
+    svc.step()
+    assert sched._arena.pipeline_stats["staged"] > staged0
+
+
+def test_explicit_off_stays_off_under_service():
+    mgr = _service_manager(pipeline_cycles="off")
+    svc = mgr.service(tick_interval_s=None, telemetry_async=False)
+    svc._prepare_start(threading.Event())
+    assert not mgr.scheduler._pipeline_on
+    assert svc.health()["pipelineEnabled"] is False
+    assert svc.submit(make_wl("w0", cpu_m=500))
+    svc.step()
+    assert mgr.scheduler._arena.pipeline_stats.get("staged", 0) == 0
+
+
+def test_service_cycle_fault_contained_with_pipeline_on():
+    """service.cycle raises are contained by the loop while the pipeline
+    is speculating: every submission is still admitted and the loop
+    stays healthy."""
+    mgr = _service_manager()
+    plan = faults.FaultPlan(seed=3)
+    plan.add(faults.SERVICE_CYCLE, mode="raise", rate=0.3)
+    faults.install(plan)
+    svc = mgr.service(tick_interval_s=None, idle_sleep_s=0.005,
+                      telemetry_async=False)
+    svc.start()
+    try:
+        for i in range(4):
+            assert svc.submit(make_wl(f"f{i}", cpu_m=500))
+        assert _wait_for(lambda: len(mgr.cache.workloads) == 4)
+    finally:
+        faults.clear()
+        svc.stop()
+    assert mgr.scheduler._pipeline_on
+    assert svc.health()["pipelineEnabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+
+
+def test_config_pipeline_and_auto_kernel_plumbing():
+    cfg = load({
+        "useDeviceScheduler": True,
+        "deviceKernel": "auto",
+        "pipelineCycles": "on",
+        "autoCpuKernel": "fixedpoint",
+    })
+    sched = build_manager(cfg).scheduler
+    assert sched.pipeline_cycles == "on"
+    assert sched._pipeline_on
+    assert sched.auto_cpu_kernel == "fixedpoint"
+
+    # Defaults: auto pipeline (serialized until a service loop starts),
+    # scan preference for auto-on-CPU.
+    sched = build_manager(load({"useDeviceScheduler": True})).scheduler
+    assert sched.pipeline_cycles == "auto"
+    assert not sched._pipeline_on
+    assert sched.auto_cpu_kernel == "scan"
+
+    with pytest.raises(ValueError, match="pipelineCycles"):
+        load({"pipelineCycles": "sometimes"})
+    with pytest.raises(ValueError, match="autoCpuKernel"):
+        load({"autoCpuKernel": "maybe"})

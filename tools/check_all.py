@@ -8,6 +8,9 @@ Wraps the three repo checkers —
   from ``metrics/names.py`` and documented in docs/observability.md;
 - ``check_kernel_gates.py``: zero-cost module-flag idiom holds at every
   tracing/faults call site;
+- ``check_pipeline_guards.py``: the pipelined-cycle hooks in the driver
+  and service loop stay behind their ``_pipeline_on`` / ``_pipeline``
+  guards (zero-cost when serialized);
 - ``check_perf_ledger.py``: newest PERF_LEDGER.jsonl record per probe
   fingerprint has not regressed vs its rolling median —
 
@@ -30,6 +33,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 CHECKERS = (
     "check_metrics_names.py",
     "check_kernel_gates.py",
+    "check_pipeline_guards.py",
     "check_perf_ledger.py",
 )
 
