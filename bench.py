@@ -1301,8 +1301,9 @@ def probe_scanfloor(scale: float):
 
     def build(mix):
         """One cohort forest + a wave of pending heads; returns the first
-        encoded (arrays, ga, adm) the scan driver actually dispatches."""
-        mgr = Manager()
+        encoded cycle the scan driver actually dispatches: (arrays, ga,
+        adm) for the grouped mixes, (arrays, adm, s_max) for "fair"."""
+        mgr = Manager(fair_sharing=(mix == "fair"))
         preemption = ClusterQueuePreemption()
         if mix == "preempt":
             preemption = ClusterQueuePreemption(
@@ -1313,21 +1314,35 @@ def probe_scanfloor(scale: float):
                 Cohort(name="co0"), Cohort(name="co1")]
         for i in range(n_cq):
             lend = 2000 if (mix == "lending" and i % 2 == 0) else None
-            objs.append(ClusterQueue(
-                name=f"cq{i}", cohort=f"co{i % 2}",
-                resource_groups=[ResourceGroup(
-                    covered_resources=["cpu"],
+            rgs = [ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="default",
+                    resources={"cpu": ResourceQuota(
+                        4000 + 1000 * (i % 3), 3000, lend)},
+                )],
+            )]
+            if mix == "multislot":
+                # A second resource group forces the slot layout (the
+                # encoded s_req planes) — these heads now ride the
+                # hybrid kernel's residual scan instead of being
+                # scan-only shapes.
+                rgs.append(ResourceGroup(
+                    covered_resources=["gpu"],
                     flavors=[FlavorQuotas(
                         name="default",
-                        resources={"cpu": ResourceQuota(
-                            4000 + 1000 * (i % 3), 3000, lend)},
+                        resources={"gpu": ResourceQuota(4000, 2000)},
                     )],
-                )],
+                ))
+            objs.append(ClusterQueue(
+                name=f"cq{i}", cohort=f"co{i % 2}",
+                resource_groups=rgs,
                 preemption=preemption,
             ))
             objs.append(LocalQueue(name=f"lq{i}", cluster_queue=f"cq{i}"))
         mgr.apply(*objs)
-        sched = DeviceScheduler(mgr.cache, mgr.queues)
+        sched = DeviceScheduler(mgr.cache, mgr.queues,
+                                fair_sharing=(mix == "fair"))
         if mix == "preempt":
             # Fillers first: admitted low-priority victims to preempt.
             for i in range(n_cq):
@@ -1339,19 +1354,23 @@ def probe_scanfloor(scale: float):
                 ))
             sched.schedule_all(max_cycles=20)
         for i in range(2 * n_cq):
+            reqs = {"cpu": 1500 + 500 * (i % 4)}
+            if mix == "multislot":
+                reqs["gpu"] = 1000 + 500 * (i % 3)
             mgr.create_workload(Workload(
                 name=f"w{i}", queue_name=f"lq{i % n_cq}",
-                pod_sets=[PodSet(name="main", count=1,
-                                 requests={"cpu": 1500 + 500 * (i % 4)})],
+                pod_sets=[PodSet(name="main", count=1, requests=reqs)],
                 priority=100 + (i % 3) * 100,
                 creation_time=float(100 + i),
             ))
+        want = ("cycle_fair_preempt" if mix == "fair"
+                else "cycle_grouped_preempt")
         captured = []
         orig = compile_cache.dispatch
 
         def spy(entry, fn, *a, **kw):
-            if entry == "cycle_grouped_preempt" and not captured:
-                captured.append(a)
+            if entry == want and not captured:
+                captured.append((a, kw.get("static", ())))
             return orig(entry, fn, *a, **kw)
 
         compile_cache.dispatch = spy
@@ -1361,7 +1380,10 @@ def probe_scanfloor(scale: float):
             compile_cache.dispatch = orig
         if not captured:
             raise RuntimeError(f"mix {mix}: no device cycle dispatched")
-        return captured[0]
+        a, static = captured[0]
+        if mix == "fair":
+            return a[0], a[1], static[1]
+        return a
 
     def best_of(fn, args, n=7):
         out = fn(*args)
@@ -1378,32 +1400,55 @@ def probe_scanfloor(scale: float):
     mixes = {}
     ok = True
     rounds_max = 0
+    fair_rounds_max = 0
     speedups = []
-    for mix in ("plain", "lending", "preempt"):
-        arrays, ga, adm = build(mix)
-        scan_s, out_scan = best_of(
-            bs.cycle_grouped_preempt, (arrays, ga, adm))
-        if mix == "preempt":
-            fp_fn = bs.fixedpoint_cycle_preempt_for(s_resid)
-            fp_s, out_fp = best_of(fp_fn, (arrays, ga, adm))
-            planes = ("outcome", "usage", "victims")
-        else:
-            fp_s, out_fp = best_of(bs.cycle_fixedpoint, (arrays, ga))
+    fair_speedups = []
+    for mix in ("plain", "lending", "preempt", "multislot", "fair"):
+        built = build(mix)
+        if mix == "fair":
+            from kueue_tpu.models import fair_fixedpoint as ffp
+            from kueue_tpu.models import fair_kernel as fkm
+
+            arrays, adm, s_max = built
+            scan_s, out_scan = best_of(
+                fkm.fair_cycle_preempt_for(s_max), (arrays, adm))
+            fp_s, out_fp = best_of(
+                ffp.fair_fixedpoint_cycle_for(s_max), (arrays, adm))
             planes = ("outcome", "usage")
+        else:
+            arrays, ga, adm = built
+            scan_s, out_scan = best_of(
+                bs.cycle_grouped_preempt, (arrays, ga, adm))
+            if mix in ("preempt", "multislot"):
+                # multislot heads ride the hybrid's residual scan now
+                # (the slot-tree partition), same entry as preemption.
+                fp_fn = bs.fixedpoint_cycle_preempt_for(s_resid)
+                fp_s, out_fp = best_of(fp_fn, (arrays, ga, adm))
+                planes = ("outcome", "usage", "victims")
+            else:
+                fp_s, out_fp = best_of(bs.cycle_fixedpoint, (arrays, ga))
+                planes = ("outcome", "usage")
         match = all(
             np.array_equal(np.asarray(getattr(out_scan, p)),
                            np.asarray(getattr(out_fp, p)))
             for p in planes
+            if getattr(out_scan, p) is not None
+            or getattr(out_fp, p) is not None
         )
         rounds = int(np.asarray(out_fp.fp_rounds))
         converged = bool(np.asarray(out_fp.converged))
         ok = ok and match and converged
-        rounds_max = max(rounds_max, rounds)
-        speedups.append(scan_s / fp_s if fp_s > 0 else 0.0)
+        speedup = scan_s / fp_s if fp_s > 0 else 0.0
+        if mix == "fair":
+            fair_rounds_max = max(fair_rounds_max, rounds)
+            fair_speedups.append(speedup)
+        else:
+            rounds_max = max(rounds_max, rounds)
+            speedups.append(speedup)
         mixes[mix] = {
             "scan_ms": round(scan_s * 1000, 3),
             "fp_ms": round(fp_s * 1000, 3),
-            "speedup": round(scan_s / fp_s, 2) if fp_s > 0 else None,
+            "speedup": round(speedup, 2) if fp_s > 0 else None,
             "rounds": rounds,
             "heads_bucket": int(np.asarray(arrays.w_cq).shape[0]),
             "match": match,
@@ -1412,19 +1457,26 @@ def probe_scanfloor(scale: float):
             f"fp={fp_s * 1e3:.2f}ms rounds={rounds} match={match}")
     return {
         "probe": "scanfloor",
-        "ok": ok and rounds_max <= 8,
+        "ok": ok and rounds_max <= 8 and fair_rounds_max <= 8,
         "n_cq": n_cq,
         # fp_speedup < 1 on CPU is expected (the fixed-point rounds are
         # slower than the grouped scan under JAX CPU emulation) and is
         # exactly why deviceKernel=auto now prefers the scan on a CPU
         # backend (driver._fp_auto_ok / autoCpuKernel) — the default
         # path no longer pays this penalty; the probe keeps measuring
-        # it so a kernel-side fix shows up in the ledger.
+        # it so a kernel-side fix shows up in the ledger. The same
+        # caveat applies to fair_fp_speedup (the fair rounds vs the DRS
+        # tournament scan).
         "fingerprint_extra": {
             "note": "auto-on-cpu prefers scan; fp timed for the record",
+            "v": 2,  # + fair / multislot mixes (fair fixed-point PR)
         },
         "fp_speedup": round(min(speedups), 2) if speedups else 0.0,
         "rounds_max": rounds_max,
+        "fair_fp_speedup": (
+            round(min(fair_speedups), 2) if fair_speedups else 0.0
+        ),
+        "fair_rounds_max": fair_rounds_max,
         "mixes": mixes,
     }
 

@@ -96,6 +96,7 @@ class Manager:
         self.cache = Cache()
         self.queues = QueueManager()
         self.metrics = Metrics()
+        self.fair_sharing = fair_sharing
         if use_device_scheduler:
             from kueue_tpu.models.driver import DeviceScheduler
 
@@ -144,7 +145,11 @@ class Manager:
             from kueue_tpu.whatif import WhatIfEngine
 
             self._whatif = WhatIfEngine(
-                self.cache, self.queues, clock=self.clock
+                self.cache, self.queues, clock=self.clock,
+                kernel=(
+                    "fair_fixedpoint" if self.fair_sharing
+                    else "fixedpoint"
+                ),
             )
         return self._whatif
 

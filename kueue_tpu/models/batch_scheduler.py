@@ -2469,7 +2469,6 @@ def make_fixedpoint_cycle(max_rounds: int = 64,
 
     kernel-entry: cycle_fixedpoint
     gate-requires: not idx.has_partial
-    gate-requires: arrays.s_req is None
     gate-requires: arrays.tas_topo is None
 
     Exact for every cycle meeting the preconditions above — including
@@ -2503,19 +2502,20 @@ def make_hybrid_preempt_cycle(s_resid: int, max_rounds: int = 64,
 
     kernel-entry: cycle_fixedpoint_hybrid
     gate-requires: not idx.has_partial
-    gate-requires: arrays.s_req is None
     gate-requires: arrays.tas_topo is None
 
     The preemption front half (oracle + victim search) runs exactly as in
     the grouped-preempt cycle; then cohort trees are routed by quota
     independence: a tree holding at least one device-resolved preemptor
-    (P_PREEMPT_OK) needs the scan's sequential designated-victim
-    bookkeeping, every other tree's admissions settle in the fixed-point
-    rounds. The residual scan runs with ``s_resid`` slots per group — the
-    driver computes a host-side bound (max active heads among trees that
-    can possibly preempt) so the residual is exact; victims never cross
-    trees, so the two partitions compose bit-identically to
-    ``cycle_grouped_preempt``."""
+    (P_PREEMPT_OK) — or, on slot-layout cycles, any active head that is
+    not a simple single-slot entry (``~w_simple_slot``: the fixed-point
+    pass reads only the legacy single-plane fields) — needs the scan's
+    sequential step semantics, every other tree's admissions settle in
+    the fixed-point rounds. The residual scan runs with ``s_resid`` slots
+    per group — the driver computes a host-side bound (max active heads
+    among trees that can preempt or carry multi-slot heads) so the
+    residual is exact; victims and quota cells never cross trees, so the
+    two partitions compose bit-identically to ``cycle_grouped_preempt``."""
     if s_resid < 1:
         raise ValueError("s_resid must be >= 1 (use cycle_fixedpoint "
                          "when no tree can preempt)")
@@ -2528,8 +2528,20 @@ def make_hybrid_preempt_cycle(s_resid: int, max_rounds: int = 64,
 
         g_n = ga.node_sel.shape[0]
         g_w = ga.flat_to_group[arrays.w_cq]
-        pre_w = arrays.w_active & (nom.best_pmode == P_PREEMPT_OK)
-        g_resid = jnp.zeros(g_n, bool).at[g_w].max(pre_w, mode="drop")
+        resid_w = arrays.w_active & (nom.best_pmode == P_PREEMPT_OK)
+        if arrays.s_req is not None:
+            # Multi-slot (or off-RG0) heads need the scan's per-slot
+            # placement; their whole trees go residual so tournament
+            # interleaving stays exact per tree. Simple single-slot
+            # entries are faithfully described by the legacy planes the
+            # fixed-point pass reads.
+            if arrays.w_simple_slot is not None:
+                resid_w = resid_w | (
+                    arrays.w_active & ~arrays.w_simple_slot
+                )
+            else:
+                resid_w = resid_w | arrays.w_active
+        g_resid = jnp.zeros(g_n, bool).at[g_w].max(resid_w, mode="drop")
         in_resid = g_resid[g_w] & arrays.w_active
 
         fp_usage, fp_admit, rounds, converged = admit_fixedpoint(

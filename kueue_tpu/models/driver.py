@@ -364,6 +364,17 @@ class DeviceScheduler:
                         (arrays, idx.admitted_arrays),
                         static=("s_max", s_bound), aot=aot,
                     )
+                    if self._prewarm_fp_wanted():
+                        from kueue_tpu.models.fair_fixedpoint import (
+                            fair_fixedpoint_cycle_for,
+                        )
+
+                        timings[bucket] += compile_cache.prewarm_entry(
+                            "cycle_fair_fixedpoint",
+                            fair_fixedpoint_cycle_for(s_bound),
+                            (arrays, idx.admitted_arrays),
+                            static=("s_max", s_bound), aot=aot,
+                        )
                 else:
                     timings[bucket] = compile_cache.prewarm_entry(
                         "cycle_grouped_preempt",
@@ -546,7 +557,23 @@ class DeviceScheduler:
                 # needing device preemption. The gate conditions below are
                 # pinned against each kernel factory's docstring markers
                 # by tools/check_kernel_gates.py.
-                if self.fair_sharing:
+                if self.fair_sharing \
+                        and self.device_kernel in ("fixedpoint", "auto") \
+                        and self._fair_fp_auto_ok(arrays, idx):
+                    from kueue_tpu.models.fair_fixedpoint import (
+                        fair_fixedpoint_cycle_for,
+                    )
+
+                    entry = "cycle_fair_fixedpoint"
+                    with tracing.span("device/cycle_fair_fixedpoint",
+                                      batch=bucket):
+                        out = compile_cache.dispatch(
+                            "cycle_fair_fixedpoint",
+                            fair_fixedpoint_cycle_for(idx.fair_s_bound),
+                            arrays, idx.admitted_arrays,
+                            static=("s_max", idx.fair_s_bound),
+                        )
+                elif self.fair_sharing:
                     from kueue_tpu.models.fair_kernel import (
                         fair_cycle_preempt_for,
                     )
@@ -562,17 +589,17 @@ class DeviceScheduler:
                         )
                 elif self.device_kernel in ("fixedpoint", "auto") \
                         and not idx.has_partial \
-                        and arrays.s_req is None \
                         and arrays.tas_topo is None \
                         and self._fp_auto_ok(arrays, idx):
                     max_r = self.fixedpoint_max_rounds
-                    # Residual preemption-scan bound: 0 when no tree can
-                    # possibly preempt this cycle (pure fixed-point is
-                    # then exact — preemption-needing entries would defer
-                    # to the host via needs_host, as before). Strict
-                    # "fixedpoint" mode keeps the pure kernel regardless,
-                    # trading those trees to the host path. Computed by
-                    # _fp_auto_ok alongside the platform preference.
+                    # Residual scan bound: 0 when no tree needs the
+                    # sequential steps this cycle (pure fixed-point is
+                    # then exact). Preempt-capable trees count only in
+                    # "auto" mode — strict "fixedpoint" trades them to
+                    # the host path as before — but slot-layout trees
+                    # count in both (the pure rounds read only legacy
+                    # planes). Computed by _fp_auto_ok alongside the
+                    # platform preference.
                     s_resid = self._auto_choice[1]
                     if s_resid > 0:
                         entry = "cycle_fixedpoint_hybrid"
@@ -886,44 +913,60 @@ class DeviceScheduler:
         return cqs is not None and id(cqs.node.root()) in discarded_roots
 
     @staticmethod
-    def _residual_scan_bound(arrays, idx) -> int:
+    def _residual_scan_bound(arrays, idx, with_preempt: bool = True,
+                             with_slots: bool = True) -> int:
         """Upper bound on the residual scan length the hybrid kernel
         needs for THIS cycle, host-side from already-resident encode
         arrays (no device sync).
 
-        A tree can only produce a P_PREEMPT_OK nomination when it has an
-        active head on a CQ whose policies allow preemption at all
-        (``~never_preempts``) AND at least one admitted workload to
-        victimize. The residual scan processes only such trees' active
-        heads, so the per-tree active-head maximum over those trees
-        bounds the sequential steps exactly like ``s_max`` bounds the
-        full scan. Returns 0 when no tree qualifies — the pure
-        fixed-point kernel is then exact (preemption-needing entries
-        would have deferred to the host anyway).
-        """
+        Two classes of cohort tree need the residual scan's sequential
+        step semantics. (1) Preemption: a tree can only produce a
+        P_PREEMPT_OK nomination when it has an active head on a CQ whose
+        policies allow preemption at all (``~never_preempts``) AND at
+        least one admitted workload to victimize. (2) Slot layout: a
+        tree holding an active multi-slot / off-RG0 head
+        (``~w_simple_slot``) — the fixed-point rounds read only the
+        legacy single-plane fields, so those trees settle in the
+        residual even without admitted workloads. The per-tree
+        active-head maximum over qualifying trees bounds the sequential
+        steps exactly like ``s_max`` bounds the full scan. Returns 0
+        when no tree qualifies — the pure fixed-point kernel is then
+        exact (preemption-needing entries would have deferred to the
+        host anyway)."""
         w_cq = np.asarray(arrays.w_cq)
         act = np.asarray(arrays.w_active)
-        if not act.any() or not idx.admitted:
+        if not act.any():
             return 0
-        never = np.asarray(arrays.never_preempts)
         flat_to_group = np.asarray(idx.group_arrays.flat_to_group)
         g_w = flat_to_group[w_cq]
-        can_pre = act & ~never[w_cq]
-        if not can_pre.any():
+        n_g = int(flat_to_group.max()) + 1
+        resid = np.zeros(n_g, dtype=bool)
+        if with_preempt and idx.admitted:
+            never = np.asarray(arrays.never_preempts)
+            can_pre = act & ~never[w_cq]
+            adm_active = np.asarray(idx.admitted_arrays.active)
+            if can_pre.any() and adm_active.any():
+                adm_groups = np.unique(
+                    flat_to_group[
+                        np.asarray(idx.admitted_arrays.cq)[adm_active]
+                    ]
+                )
+                adm_mask = np.zeros(n_g, dtype=bool)
+                adm_mask[adm_groups] = True
+                resid[np.unique(g_w[can_pre & adm_mask[g_w]])] = True
+        if with_slots and arrays.s_req is not None:
+            simple = (
+                np.asarray(arrays.w_simple_slot)
+                if arrays.w_simple_slot is not None
+                else np.zeros_like(act)
+            )
+            hard = act & ~simple
+            if hard.any():
+                resid[np.unique(g_w[hard])] = True
+        if not resid.any():
             return 0
-        adm_active = np.asarray(idx.admitted_arrays.active)
-        if not adm_active.any():
-            return 0
-        adm_groups = np.unique(
-            flat_to_group[np.asarray(idx.admitted_arrays.cq)[adm_active]]
-        )
-        resid = np.zeros(int(flat_to_group.max()) + 1, dtype=bool)
-        resid[adm_groups] = True
-        g_resid = np.unique(g_w[can_pre & resid[g_w]])
-        if g_resid.size == 0:
-            return 0
-        counts = np.bincount(g_w[act], minlength=int(resid.size))
-        return int(counts[g_resid].max())
+        counts = np.bincount(g_w[act], minlength=n_g)
+        return int(counts[resid].max())
 
     # Scan-depth threshold above which CPU "auto" still takes the fixed
     # point: past this many sequential per-tree steps the parallel rounds
@@ -943,7 +986,11 @@ class DeviceScheduler:
         fixed point. The decision reason and the residual scan bound land
         in ``self._auto_choice`` (flight-recorder kernel suffix)."""
         if self.device_kernel != "auto":
-            self._auto_choice = ("", 0)
+            # Strict "fixedpoint" still needs the hybrid's residual scan
+            # for slot-layout trees (the pure rounds read only the
+            # legacy planes), so carry the slot-only bound.
+            self._auto_choice = ("", self._residual_scan_bound(
+                arrays, idx, with_preempt=False))
             return True
         s_resid = self._residual_scan_bound(arrays, idx)
         if jax.default_backend() != "cpu":
@@ -956,6 +1003,33 @@ class DeviceScheduler:
             self._auto_choice = ("auto-cpu-long-scan", s_resid)
             return True
         self._auto_choice = ("auto-cpu-scan", s_resid)
+        return False
+
+    def _fair_fp_auto_ok(self, arrays, idx) -> bool:
+        """Platform preference for the fair fixed-point rounds, the
+        mirror of :meth:`_fp_auto_ok` for fair-sharing cycles. The fair
+        kernel carries its own residual scan internally (trees the
+        rounds can't settle fall back to scan steps inside the jit), so
+        only the decision reason lands in ``self._auto_choice`` — the
+        bound stays 0.
+
+        Same CPU story as the non-fair shape: "auto" keeps the DRS
+        tournament scan on CPU unless the cycle's scan bound
+        (``idx.fair_s_bound``) exceeds ``_CPU_FP_SCAN_BOUND`` or
+        ``auto_cpu_kernel`` forces the fixed point."""
+        if self.device_kernel != "auto":
+            self._auto_choice = ("", 0)
+            return True
+        if jax.default_backend() != "cpu":
+            self._auto_choice = ("auto-accel", 0)
+            return True
+        if self.auto_cpu_kernel == "fixedpoint":
+            self._auto_choice = ("auto-cpu-fp", 0)
+            return True
+        if idx.fair_s_bound > self._CPU_FP_SCAN_BOUND:
+            self._auto_choice = ("auto-cpu-long-scan", 0)
+            return True
+        self._auto_choice = ("auto-cpu-scan", 0)
         return False
 
     @staticmethod

@@ -1,0 +1,290 @@
+"""Fair-sharing admission as fixed-point rounds + a residual tournament.
+
+The DRS tournament (models/fair_kernel.py) processes one winner per
+cohort tree per sequential scan step. BENCH_r05 showed each step costs
+~0.2 ms of dispatch latency regardless of width, so the win is
+eliminating steps — the same treatment ``admit_fixedpoint`` /
+``cycle_fixedpoint_hybrid`` gave the grouped scan (PR 8).
+
+The key observation making rounds possible WITHOUT simulating DRS order:
+for trees free of device preemptors and TAS placements, every
+participant's *contribution* to usage is order-independent or boundable:
+
+* a FIT participant that does not fit at the cycle's base usage can
+  never fit later (usage only grows, the availability walk is monotone
+  decreasing in usage) — statically rejected, contributes nothing;
+* a NO_CANDIDATES reserve reads only the participant's own-CQ usage
+  (scheduler.go:513), and CQ nodes are tournament-exclusive leaves (one
+  participant per CQ, no other chain passes through) — the reserve
+  amount is static;
+* every other participant either applies its aggregate (if it admits)
+  or nothing — bounded between zero and a raw no-absorption bubble.
+
+Two passes therefore settle a tree: pass 1 scatters the raw
+(absorption-free) bubbles of every potential contributor to get a
+per-node usage upper bound; pass 2 re-runs the availability walk and the
+addUsage bubble under both the base (lower) and worst-case (upper)
+usage. A participant that fits even at the upper bound admits in every
+tournament order; one that fails at base never admits. Trees where the
+two bounds pin every contributor's bubbled arrival exactly
+(``arr_hi == arr_lo``) and leave no participant undecided have an
+order-independent final usage — applied in one scatter. Everything else
+(genuinely order-dependent contention, device preemptors, TAS) runs the
+unmodified sequential tournament, restricted to the unsettled trees and
+early-exited once they drain — bit-identical planes to
+:func:`fair_admit_scan` by construction, pinned by the randomized
+differentials in tests/test_fair_fixedpoint.py.
+
+``converged`` is False when the residual tournament ran out of steps
+before draining; the driver contains that as a
+``solver_fallback_cycles_total{reason="fixedpoint_rounds"}`` host
+fallback before reading any plane.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.models.batch_scheduler import (
+    CycleOutputs,
+    NominateResult,
+    P_FIT,
+    P_NO_CANDIDATES,
+    apply_tas_nominate_hook,
+    nominate,
+)
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.models.fair_kernel import (
+    FairScanResult,
+    _fair_ctx,
+    _fair_finish,
+    _fair_preempt_nominate,
+)
+from kueue_tpu.ops import quota_ops
+from kueue_tpu.ops.quota_ops import sat_add, sat_sub
+
+
+class FairRoundsResult(NamedTuple):
+    """Result of :func:`fair_admit_fixedpoint`."""
+
+    res: FairScanResult
+    fp_rounds: jnp.ndarray  # i32 — 2 bound passes + residual steps run
+    converged: jnp.ndarray  # bool — residual tournament fully drained
+
+
+def fair_admit_fixedpoint(
+    arrays: CycleArrays,
+    nom: NominateResult,
+    usage: jnp.ndarray,
+    s_max: int,
+    adm=None,
+    targets=None,
+) -> FairRoundsResult:
+    """Fair admission via bound rounds + residual tournament.
+
+    Same signature/semantics as :func:`fair_admit_scan` — the planes of
+    ``res`` are bit-identical to the scan's at the same ``s_max`` except
+    ``win_step``/``participated`` ordering diagnostics (settled
+    participants report step 0). ``s_max`` bounds the residual steps; an
+    undrained residual reports ``converged=False``.
+    """
+    ctx = _fair_ctx(arrays, nom, adm=adm, targets=targets)
+
+    # ---- pass 1: classify + raw (no-absorption) usage upper bound --------
+    uS_base = ctx.uS_of(usage)  # [n,S,L,R]
+    is_fit = ctx.p_has & (ctx.pm_c == P_FIT) & ~ctx.deferred_c
+    is_nc = (
+        ctx.p_has & (ctx.pm_c == P_NO_CANDIDATES)
+        & ~ctx.reclaim_c & ~ctx.deferred_c
+    )
+    fits_base = ctx.fits_chain(uS_base)
+    maybe = is_fit & fits_base  # statically-rejected FIT entries drop out
+    hi_set = maybe | is_nc  # every participant that can consume usage
+
+    # NO_CANDIDATES reserve at the CQ — static: no other participant's
+    # chain passes the (leaf) CQ node, so own-CQ usage stays at base
+    # until the participant itself wins. Mirrors the scan body.
+    u_cqS = uS_base[:, :, 0]  # [n,S,R]
+    res_borrow = jnp.where(
+        ctx.hblS[:, :, 0],
+        jnp.minimum(
+            ctx.aggS,
+            sat_sub(sat_add(ctx.nominalS, ctx.blS[:, :, 0]), u_cqS),
+        ),
+        ctx.aggS,
+    )
+    res_plain = jnp.maximum(
+        0, jnp.minimum(ctx.aggS, sat_sub(ctx.nominalS, u_cqS))
+    )
+    reserveS = jnp.where(
+        ctx.borrowing_c[:, None, None], res_borrow, res_plain
+    )
+    reserveS = jnp.where(ctx.cellS, reserveS, 0)
+
+    appliedS = jnp.where(
+        maybe[:, None, None], ctx.aggS,
+        jnp.where(is_nc[:, None, None], reserveS, 0),
+    )  # [n,S,R] worst-case per-participant application
+    appliedS = jnp.where(ctx.dedupS[..., None], appliedS, 0)
+
+    zero_l = jnp.zeros(
+        (ctx.n, appliedS.shape[1], ctx.L, ctx.r_n), jnp.int64
+    )
+    raw_deltas = ctx.bubble_chain(appliedS, zero_l)  # applied at each node
+    grid_raw = jnp.zeros_like(usage).at[ctx.chS, ctx.feS].add(
+        raw_deltas, mode="drop"
+    )
+    # Own raw contribution at every own-chain node is the plane total
+    # (each distinct node receives exactly one scatter of it; same-plane
+    # slots share the dedup'd application).
+    own_fill = jnp.einsum(
+        "nst,ntr->nsr", ctx.samefS.astype(jnp.int64), appliedS
+    )
+    others_raw = jnp.maximum(
+        0, grid_raw[ctx.chS, ctx.feS] - own_fill[:, :, None, :]
+    )  # [n,S,L,R] upper bound on other contributors' arrivals
+
+    # ---- pass 2: bounded bubbles + worst-case fit ------------------------
+    # Higher usage -> smaller local availability -> less absorbed ->
+    # larger arrival upward: l under the hi usage bounds arrivals above,
+    # l at base bounds them below.
+    u_hiS = sat_add(uS_base, others_raw)
+    l_hi = jnp.maximum(0, sat_sub(ctx.lqS, u_hiS))
+    l_lo = jnp.maximum(0, sat_sub(ctx.lqS, uS_base))
+    arr_hi = ctx.bubble_chain(appliedS, l_hi)
+    arr_lo = ctx.bubble_chain(appliedS, l_lo)
+
+    hi_deltas = jnp.where(hi_set[:, None, None, None], arr_hi, 0)
+    grid_hi = jnp.zeros_like(usage).at[ctx.chS, ctx.feS].add(
+        hi_deltas, mode="drop"
+    )
+    # Own arrival at each own-chain node: per-plane arrivals summed over
+    # same-plane slots, forward-filled so repeat (past-root) positions
+    # read the root's own arrival (they alias the root node).
+    plane_arr = jnp.einsum(
+        "nst,ntlr->nslr", ctx.samefS.astype(jnp.int64), hi_deltas
+    )
+    own = plane_arr[:, :, 0]
+    own_rows = []
+    for k in range(ctx.L):
+        own = jnp.where(ctx.first_c[:, None, k, None], plane_arr[:, :, k],
+                        own)
+        own_rows.append(own)
+    own_hi_at = jnp.stack(own_rows, axis=2)  # [n,S,L,R]
+    others_hi = jnp.maximum(0, grid_hi[ctx.chS, ctx.feS] - own_hi_at)
+    fits_worst = ctx.fits_chain(sat_add(uS_base, others_hi))
+
+    admit_b = maybe & fits_worst  # admits in every tournament order
+    undec = maybe & ~fits_worst  # genuinely order-dependent -> residual
+
+    # ---- settle trees ----------------------------------------------------
+    exact_c = jnp.all(
+        (arr_hi == arr_lo) | ~hi_set[:, None, None, None], axis=(1, 2, 3)
+    )
+    bad = (
+        undec
+        | ((admit_b | is_nc) & ~exact_c)
+        | ctx.resid_force
+    )
+    tree_bad = jnp.zeros(ctx.n, bool).at[ctx.root_c].max(bad)
+    settled_c = ctx.p_has & ~tree_bad[ctx.root_c]
+
+    contrib = (admit_b | is_nc) & settled_c
+    settle_deltas = jnp.where(contrib[:, None, None, None], arr_lo, 0)
+    # One sat at the end equals the scan's per-step sat: deltas are
+    # nonnegative, so the running sums are monotone under the clamp.
+    usage1 = quota_ops.sat(
+        usage.at[ctx.chS, ctx.feS].add(settle_deltas, mode="drop")
+    )
+
+    # ---- residual tournament over the unsettled trees --------------------
+    remaining0 = ctx.p_has & ~settled_c
+    admitted0 = admit_b & settled_c
+    win_step0 = jnp.where(settled_c, jnp.int32(0), jnp.int32(-1))
+    carry0 = ctx.init(
+        usage1, remaining0=remaining0, admitted0=admitted0,
+        win_step0=win_step0,
+    )
+
+    def cond_fn(state):
+        step, carry = state
+        return (step < jnp.int32(s_max)) & jnp.any(carry[2])
+
+    def body_fn(state):
+        step, carry = state
+        new_carry, _ = ctx.body(carry, step)
+        return step + jnp.int32(1), new_carry
+
+    step_f, carry_f = jax.lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), carry0)
+    )
+    res = ctx.scatter(carry_f)
+    converged = ~jnp.any(carry_f[2])
+    fp_rounds = jnp.int32(2) + step_f
+    return FairRoundsResult(res=res, fp_rounds=fp_rounds,
+                            converged=converged)
+
+
+def make_fair_fixedpoint_cycle(s_max: int = 0, preempt: bool = True):
+    """Jittable fair cycle: nominate -> fixed-point rounds + residual.
+
+    kernel-entry: cycle_fair_fixedpoint
+    gate-requires: self.fair_sharing
+
+    Drop-in for :func:`make_fair_cycle` — same nomination front half
+    (device fair-preemption resolution included with ``preempt=True``),
+    admission via :func:`fair_admit_fixedpoint`, and the shared
+    ``_fair_finish`` assembly so both kernels report identically, plus
+    the ``converged``/``fp_rounds`` planes the driver's convergence gate
+    reads before any other plane."""
+
+    if not preempt:
+        def impl(arrays: CycleArrays) -> CycleOutputs:
+            usage = arrays.usage
+            nom = nominate(arrays, usage)
+            if arrays.tas_topo is not None:
+                nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
+            s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+            rr = fair_admit_fixedpoint(arrays, nom, usage, s)
+            res = rr.res
+            return _fair_finish(arrays, nom, res.usage, res.admitted,
+                                res.preempting, res.shadowed, res.win_step,
+                                tas_takes=res.tas_takes,
+                                s_tas_takes=res.s_tas_takes,
+                                converged=rr.converged,
+                                fp_rounds=rr.fp_rounds)
+
+        return impl
+
+    def impl_preempt(arrays: CycleArrays, adm) -> CycleOutputs:
+        usage = arrays.usage
+        nom, tgt = _fair_preempt_nominate(arrays, adm)
+        s = s_max if s_max > 0 else arrays.w_cq.shape[0]
+        rr = fair_admit_fixedpoint(arrays, nom, usage, s, adm=adm,
+                                   targets=tgt)
+        res = rr.res
+        return _fair_finish(arrays, nom, res.usage, res.admitted,
+                            res.preempting, res.shadowed, res.win_step,
+                            victims=tgt.victims, variant=tgt.variant,
+                            tas_takes=res.tas_takes,
+                            s_tas_takes=res.s_tas_takes,
+                            converged=rr.converged,
+                            fp_rounds=rr.fp_rounds)
+
+    return impl_preempt
+
+
+@functools.lru_cache(maxsize=None)
+def fair_fixedpoint_cycle_for(s_max: int):
+    """Compiled fixed-point fair cycle for a (bucketed) residual step
+    bound — callers pass CycleIndex.fair_s_bound like the scan's
+    ``fair_cycle_preempt_for``."""
+    return jax.jit(make_fair_fixedpoint_cycle(s_max=s_max, preempt=True))
+
+
+def cycle_fair_fixedpoint(arrays, adm, s_max: int = 0):
+    return fair_fixedpoint_cycle_for(s_max)(arrays, adm)

@@ -64,6 +64,7 @@ from kueue_tpu.models.batch_scheduler import (
     nominate,
 )
 from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.models.fair_preempt_kernel import fair_preempt_targets
 from kueue_tpu.ops import quota_ops
 from kueue_tpu.ops.quota_ops import MAX_DEPTH, sat_add, sat_sub
 
@@ -86,18 +87,19 @@ class FairScanResult(NamedTuple):
     s_tas_takes: jnp.ndarray  # i32[W,S,D] or None
 
 
-def fair_admit_scan(
+def _fair_ctx(
     arrays: CycleArrays,
     nom: NominateResult,
-    usage: jnp.ndarray,
-    s_max: int,
     adm=None,
     targets=None,
-) -> "FairScanResult":
-    """Tournament-ordered admission. With ``adm``/``targets`` (device fair
-    preemption) winners resolved to P_PREEMPT_OK designate their victims
-    with the host's overlap/fit semantics and consume usage like admitted
-    entries. Returns a :class:`FairScanResult`."""
+):
+    """Build the shared tournament context: participant compaction, all
+    per-chain statics, the DRS key/tournament functions and the per-step
+    scan ``body``, plus slot-normalized views (an explicit S axis, S=1
+    for legacy single-plane cycles) of the fit/apply tensors that the
+    fixed-point rounds analysis (models/fair_fixedpoint.py) reuses.
+    Returned as a namespace so :func:`fair_admit_scan` and
+    ``fair_admit_fixedpoint`` run the exact same step semantics."""
     tree = arrays.tree
     w_n = arrays.w_cq.shape[0]
     n = tree.n_nodes
@@ -716,87 +718,206 @@ def fair_admit_scan(
                 preempting_acc | preempt_ok, designated, win_step,
                 w_takes, s_takes), None
 
-    designated0 = (
-        jnp.zeros(adm.cq.shape[0], bool) if with_preempt
-        else jnp.zeros(1, bool)
-    )
-    tas_usage0 = (
-        arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
-    )
-    takes0 = (
-        jnp.zeros((n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
-        if with_tas else jnp.zeros((1,), jnp.int32)
-    )
-    stakes0 = (
-        jnp.zeros(
-            (n, arrays.s_tas.shape[1], arrays.tas_topo.leaf_cap.shape[1]),
-            jnp.int32,
+    def init(usage0, remaining0=None, admitted0=None, win_step0=None):
+        """Scan carry for a tournament starting from ``usage0``.
+        ``remaining0``/``admitted0``/``win_step0`` let the fixed-point
+        rounds pre-settle trees before the residual scan."""
+        designated0 = (
+            jnp.zeros(adm.cq.shape[0], bool) if with_preempt
+            else jnp.zeros(1, bool)
         )
-        if with_stas else jnp.zeros((1,), jnp.int32)
-    )
-    init = (usage, tas_usage0, jnp.ones(n, bool), jnp.zeros(n, bool),
+        tas_usage0 = (
+            arrays.tas_usage0 if with_tas else jnp.zeros((1,), jnp.int64)
+        )
+        takes0 = (
+            jnp.zeros((n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32)
+            if with_tas else jnp.zeros((1,), jnp.int32)
+        )
+        stakes0 = (
+            jnp.zeros(
+                (n, arrays.s_tas.shape[1],
+                 arrays.tas_topo.leaf_cap.shape[1]),
+                jnp.int32,
+            )
+            if with_stas else jnp.zeros((1,), jnp.int32)
+        )
+        return (
+            usage0, tas_usage0,
+            jnp.ones(n, bool) if remaining0 is None else remaining0,
+            jnp.zeros(n, bool) if admitted0 is None else admitted0,
             jnp.zeros(n, bool), designated0,
-            jnp.full(n, -1, jnp.int32), takes0, stakes0)
-    (final_usage, _tas_u, remaining_c, admitted_c, preempting_c, _desig,
-     win_step_c, takes_c, stakes_c), _ = jax.lax.scan(
-        body, init, jnp.arange(s_max, dtype=jnp.int32)
-    )
+            jnp.full(n, -1, jnp.int32) if win_step0 is None else win_step0,
+            takes0, stakes0,
+        )
 
-    # Scatter participant results back onto the entry axis.
-    idx_w = jnp.where(p_has, pe, jnp.int32(w_n))  # OOB rows drop
-    admitted = jnp.zeros(w_n, bool).at[idx_w].set(
-        admitted_c & p_has, mode="drop"
-    )
-    preempting = jnp.zeros(w_n, bool).at[idx_w].set(
-        preempting_c & p_has, mode="drop"
-    )
-    participated = jnp.zeros(w_n, bool).at[idx_w].set(
-        p_has & ~remaining_c, mode="drop"
-    )
-    win_step = jnp.full(w_n, -1, jnp.int32).at[idx_w].set(
-        jnp.where(p_has, win_step_c, -1), mode="drop"
-    )
+    def scatter(carry) -> FairScanResult:
+        """Scatter participant results back onto the entry axis."""
+        (final_usage, _tas_u, remaining_c, admitted_c, preempting_c,
+         _desig, win_step_c, takes_c, stakes_c) = carry
+        idx_w = jnp.where(p_has, pe, jnp.int32(w_n))  # OOB rows drop
+        admitted = jnp.zeros(w_n, bool).at[idx_w].set(
+            admitted_c & p_has, mode="drop"
+        )
+        preempting = jnp.zeros(w_n, bool).at[idx_w].set(
+            preempting_c & p_has, mode="drop"
+        )
+        participated = jnp.zeros(w_n, bool).at[idx_w].set(
+            p_has & ~remaining_c, mode="drop"
+        )
+        win_step = jnp.full(w_n, -1, jnp.int32).at[idx_w].set(
+            jnp.where(p_has, win_step_c, -1), mode="drop"
+        )
+        w_takes_f = None
+        if with_tas:
+            w_takes_f = jnp.zeros(
+                (w_n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32
+            ).at[idx_w].set(
+                jnp.where(p_has[:, None], takes_c, 0), mode="drop"
+            )
+        s_takes_f = None
+        if with_stas:
+            s_takes_f = jnp.zeros(
+                (w_n, arrays.s_tas.shape[1],
+                 arrays.tas_topo.leaf_cap.shape[1]),
+                jnp.int32,
+            ).at[idx_w].set(
+                jnp.where(p_has[:, None, None], stakes_c, 0), mode="drop"
+            )
+        return FairScanResult(
+            usage=final_usage,
+            admitted=admitted,
+            preempting=preempting,
+            shadowed=shadowed,
+            participated=participated,
+            win_step=win_step,
+            tas_takes=w_takes_f,
+            s_tas_takes=s_takes_f,
+        )
+
+    # ---- slot-normalized views (explicit S axis; S=1 legacy) -------------
+    # The fixed-point rounds analysis needs the fit walk, the reserve
+    # formula and the addUsage bubble on arbitrary per-participant chain
+    # usage. These mirror the scan body's two branches exactly — the
+    # randomized kernel differentials (tests/test_fair_fixedpoint.py) pin
+    # them plane-for-plane against the scan.
+    L = MAX_DEPTH + 1
+    if with_slots:
+        chS = ch_sl  # [n,1,L]
+        feS = fe_sl  # [n,S,1]
+        cellS, aggS, dedupS, samefS = cell_s, agg_c, dedup_c, samef
+        lqS, subS, blS, hblS = lq_s, sub_s, bl_s, hbl_s
+        nominalS = nominal_s
+    else:
+        chS = chains_c[:, None, :]
+        feS = fe_c[:, None, None]
+        cellS = cell_c[:, None]
+        aggS = delta_c[:, None]
+        dedupS = jnp.ones((n, 1), bool)
+        samefS = jnp.ones((n, 1, 1), bool)
+        lqS, subS = lq_c[:, None], sub_c[:, None]
+        blS, hblS = bl_c[:, None], hbl_c[:, None]
+        nominalS = nominal_c[:, None]
+    first_c = jnp.concatenate(
+        [jnp.ones((n, 1), bool), ~walk_rep_c[:, :-1]], axis=1
+    )  # [n,L] first occurrence of each distinct chain node
+
+    def uS_of(usage0):
+        """Per-participant chain usage on the assigned plane(s)."""
+        return usage0[chS, feS]  # [n,S,L,R]
+
+    def fits_chain(uS_fit):
+        """The scan body's availability walk on explicit [n,S,L,R] chain
+        usage (victim-free form — rounds never settle preempt trees)."""
+        l_avail_fit = jnp.maximum(0, sat_sub(lqS, uS_fit))
+        stored = sat_sub(subS, lqS)
+        uip = jnp.maximum(0, sat_sub(uS_fit, lqS))
+        with_max = sat_add(sat_sub(stored, uip), blS)
+        avail = sat_sub(subS[:, :, L - 1], uS_fit[:, :, L - 1])
+        for i in range(L - 2, -1, -1):
+            clamped = jnp.where(
+                hblS[:, :, i], jnp.minimum(with_max[:, :, i], avail), avail
+            )
+            stepped = sat_add(l_avail_fit[:, :, i], clamped)
+            avail = jnp.where(
+                walk_rep_c[:, None, i, None], avail, stepped
+            )
+        return jnp.all((aggS <= avail) | ~cellS, axis=(1, 2))
+
+    def bubble_chain(appliedS, l_availS):
+        """addUsage bubbling of [n,S,R] applications along each chain
+        with per-level pre-availability clamping ``l_availS`` [n,S,L,R]
+        (zeros = raw, no absorption). Repeat (at/past-root) positions
+        get zero, like the scan's delta loop."""
+        deltas = jnp.zeros(
+            (n, appliedS.shape[1], L, r_n), dtype=jnp.int64
+        )
+        cur = appliedS
+        for i in range(L):
+            deltas = deltas.at[:, :, i].set(cur)
+            cont = (
+                (~walk_rep_c[:, None, i, None]) if i < L - 1 else False
+            )
+            cur = jnp.where(
+                cont, jnp.maximum(0, sat_sub(cur, l_availS[:, :, i])), 0
+            )
+        return deltas
+
+    # Participants whose step semantics the rounds analysis cannot model
+    # order-independently: device-resolved preemptors (sequential
+    # designated-victim bookkeeping) and TAS placements (sequential
+    # topology-state threading). Their whole trees go residual.
+    resid_force = jnp.zeros(n, bool)
+    if with_preempt:
+        resid_force = resid_force | (p_has & (pm_c == P_PREEMPT_OK))
     if with_tas:
-        w_takes_f = jnp.zeros(
-            (w_n, arrays.tas_topo.leaf_cap.shape[1]), jnp.int32
-        ).at[idx_w].set(
-            jnp.where(p_has[:, None], takes_c, 0), mode="drop"
-        )
-    s_takes_f = None
+        resid_force = resid_force | (p_has & w_tas_c & (t_of_c >= 0))
     if with_stas:
-        s_takes_f = jnp.zeros(
-            (w_n, arrays.s_tas.shape[1],
-             arrays.tas_topo.leaf_cap.shape[1]),
-            jnp.int32,
-        ).at[idx_w].set(
-            jnp.where(p_has[:, None, None], stakes_c, 0), mode="drop"
-        )
-    return FairScanResult(
-        usage=final_usage,
-        admitted=admitted,
-        preempting=preempting,
-        shadowed=shadowed,
-        participated=participated,
-        win_step=win_step,
-        tas_takes=w_takes_f if with_tas else None,
-        s_tas_takes=s_takes_f,
+        resid_force = resid_force | (p_has & jnp.any(stas_c, axis=1))
+
+    import types
+
+    return types.SimpleNamespace(
+        n=n, w_n=w_n, L=L, r_n=r_n,
+        body=body, init=init, scatter=scatter,
+        p_has=p_has, pe=pe, root_c=root_c, chains_c=chains_c,
+        walk_rep_c=walk_rep_c, first_c=first_c, shadowed=shadowed,
+        pm_c=pm_c, deferred_c=deferred_c, reclaim_c=reclaim_c,
+        borrowing_c=borrowing_c, resid_force=resid_force,
+        with_slots=with_slots, with_tas=with_tas,
+        with_preempt=with_preempt, with_stas=with_stas,
+        chS=chS, feS=feS, cellS=cellS, aggS=aggS, dedupS=dedupS,
+        samefS=samefS, lqS=lqS, subS=subS, blS=blS, hblS=hblS,
+        nominalS=nominalS,
+        uS_of=uS_of, fits_chain=fits_chain, bubble_chain=bubble_chain,
     )
 
 
-def make_fair_cycle(s_max: int = 0, preempt: bool = False):
-    """Jittable fair-sharing cycle: nominate -> DRS tournament scan.
+def fair_admit_scan(
+    arrays: CycleArrays,
+    nom: NominateResult,
+    usage: jnp.ndarray,
+    s_max: int,
+    adm=None,
+    targets=None,
+) -> "FairScanResult":
+    """Tournament-ordered admission. With ``adm``/``targets`` (device fair
+    preemption) winners resolved to P_PREEMPT_OK designate their victims
+    with the host's overlap/fit semantics and consume usage like admitted
+    entries. Returns a :class:`FairScanResult`."""
+    ctx = _fair_ctx(arrays, nom, adm=adm, targets=targets)
+    carry, _ = jax.lax.scan(
+        ctx.body, ctx.init(usage), jnp.arange(s_max, dtype=jnp.int32)
+    )
+    return ctx.scatter(carry)
 
-    kernel-entry: cycle_fair_preempt
-    gate-requires: self.fair_sharing
 
-    With ``preempt=True`` the cycle takes the AdmittedArrays and resolves
-    the fair preemption tournament on device for eligible entries
-    (models/fair_preempt_kernel.py) before the admission scan."""
-
-    def finish(arrays, nom, final_usage, admitted, preempting, shadowed,
-               win_step, victims=None, variant=None, tas_takes=None,
-               s_tas_takes=None):
-        outcome = jnp.where(
+def _fair_finish(arrays, nom, final_usage, admitted, preempting, shadowed,
+                 win_step, victims=None, variant=None, tas_takes=None,
+                 s_tas_takes=None, converged=None, fp_rounds=None):
+    """Assemble CycleOutputs from fair-tournament planes — shared by the
+    scan and fixed-point fair cycle factories so both kernels report
+    decisions identically."""
+    outcome = jnp.where(
             ~arrays.w_active,
             OUT_NOFIT,
             jnp.where(
@@ -825,32 +946,90 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
                     ),
                 ),
             ),
-        ).astype(jnp.int32)
-        return CycleOutputs(
-            outcome=outcome,
-            chosen_flavor=nom.chosen_flavor,
-            borrow=nom.best_borrow,
-            tried_flavor_idx=nom.tried_flavor_idx,
-            usage=final_usage,
-            # Diagnostics only: the dynamic tournament order (step each
-            # entry won at; losers sink to the end). Domain decode reads
-            # tas_takes directly and does not depend on this.
-            order=jnp.argsort(
-                jnp.where(
-                    win_step >= 0, win_step.astype(jnp.int64),
-                    jnp.int64(1) << 40,
-                )
-                * arrays.w_cq.shape[0]
-                + jnp.arange(arrays.w_cq.shape[0], dtype=jnp.int64)
-            ).astype(jnp.int32),
-            victims=victims,
-            victim_variant=variant,
-            s_flavor=nom.s_flavor,
-            s_pmode=nom.s_pmode,
-            s_tried=nom.s_tried,
-            tas_takes=tas_takes,
-            s_tas_takes=s_tas_takes,
-        )
+    ).astype(jnp.int32)
+    return CycleOutputs(
+        outcome=outcome,
+        chosen_flavor=nom.chosen_flavor,
+        borrow=nom.best_borrow,
+        tried_flavor_idx=nom.tried_flavor_idx,
+        usage=final_usage,
+        # Diagnostics only: the dynamic tournament order (step each
+        # entry won at; losers sink to the end). Domain decode reads
+        # tas_takes directly and does not depend on this.
+        order=jnp.argsort(
+            jnp.where(
+                win_step >= 0, win_step.astype(jnp.int64),
+                jnp.int64(1) << 40,
+            )
+            * arrays.w_cq.shape[0]
+            + jnp.arange(arrays.w_cq.shape[0], dtype=jnp.int64)
+        ).astype(jnp.int32),
+        victims=victims,
+        victim_variant=variant,
+        s_flavor=nom.s_flavor,
+        s_pmode=nom.s_pmode,
+        s_tried=nom.s_tried,
+        tas_takes=tas_takes,
+        s_tas_takes=s_tas_takes,
+        converged=converged,
+        fp_rounds=fp_rounds,
+    )
+
+
+def _fair_preempt_nominate(arrays: CycleArrays, adm):
+    """The fair cycle's nomination front half: nominate, the TAS hook,
+    device fair-preemption eligibility and target resolution. Shared by
+    the scan and fixed-point fair cycle factories."""
+    usage = arrays.usage
+    nom = nominate(arrays, usage)
+    if arrays.tas_topo is not None:
+        nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
+    elig = (
+        arrays.w_active
+        & (nom.best_pmode == P_PREEMPT_RAW)
+        & (nom.praw_count == 1)
+        & arrays.fair_preempt_ok[arrays.w_cq]
+        & ~arrays.w_has_gates
+    )
+    if arrays.w_tas is not None:
+        elig = elig & ~arrays.w_tas
+    if arrays.s_tas is not None:
+        # Multi-podset TAS entries needing preemption keep the host
+        # victim search (same rule as the grouped cycle).
+        elig = elig & ~jnp.any(arrays.s_tas, axis=1)
+    if arrays.w_simple_slot is not None:
+        # The fair victim tournament reads the legacy single-slot
+        # fields; a multi-slot entry needing preemption stays
+        # needs_host and the driver routes its whole tree through
+        # the host (tournament interleaving stays exact per tree).
+        elig = elig & arrays.w_simple_slot
+    tgt = fair_preempt_targets(
+        arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
+        nom.considered,
+    )
+    nom = nom._replace(
+        best_pmode=jnp.where(
+            tgt.success, P_PREEMPT_OK,
+            jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
+                      nom.best_pmode),
+        ),
+        best_borrow=jnp.where(
+            tgt.resolved, tgt.borrow_after, nom.best_borrow
+        ),
+        needs_host=nom.needs_host & ~tgt.resolved,
+    )
+    return nom, tgt
+
+
+def make_fair_cycle(s_max: int = 0, preempt: bool = False):
+    """Jittable fair-sharing cycle: nominate -> DRS tournament scan.
+
+    kernel-entry: cycle_fair_preempt
+    gate-requires: self.fair_sharing
+
+    With ``preempt=True`` the cycle takes the AdmittedArrays and resolves
+    the fair preemption tournament on device for eligible entries
+    (models/fair_preempt_kernel.py) before the admission scan."""
 
     if not preempt:
         def impl(arrays: CycleArrays) -> CycleOutputs:
@@ -860,60 +1039,23 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
                 nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
             s = s_max if s_max > 0 else arrays.w_cq.shape[0]
             res = fair_admit_scan(arrays, nom, usage, s)
-            return finish(arrays, nom, res.usage, res.admitted,
-                          res.preempting, res.shadowed, res.win_step,
-                          tas_takes=res.tas_takes,
-                          s_tas_takes=res.s_tas_takes)
+            return _fair_finish(arrays, nom, res.usage, res.admitted,
+                                res.preempting, res.shadowed, res.win_step,
+                                tas_takes=res.tas_takes,
+                                s_tas_takes=res.s_tas_takes)
 
         return impl
 
-    from kueue_tpu.models.fair_preempt_kernel import fair_preempt_targets
-
     def impl_preempt(arrays: CycleArrays, adm) -> CycleOutputs:
         usage = arrays.usage
-        nom = nominate(arrays, usage)
-        if arrays.tas_topo is not None:
-            nom, _downgrade = apply_tas_nominate_hook(arrays, nom)
-        elig = (
-            arrays.w_active
-            & (nom.best_pmode == P_PREEMPT_RAW)
-            & (nom.praw_count == 1)
-            & arrays.fair_preempt_ok[arrays.w_cq]
-            & ~arrays.w_has_gates
-        )
-        if arrays.w_tas is not None:
-            elig = elig & ~arrays.w_tas
-        if arrays.s_tas is not None:
-            # Multi-podset TAS entries needing preemption keep the host
-            # victim search (same rule as the grouped cycle).
-            elig = elig & ~jnp.any(arrays.s_tas, axis=1)
-        if arrays.w_simple_slot is not None:
-            # The fair victim tournament reads the legacy single-slot
-            # fields; a multi-slot entry needing preemption stays
-            # needs_host and the driver routes its whole tree through
-            # the host (tournament interleaving stays exact per tree).
-            elig = elig & arrays.w_simple_slot
-        tgt = fair_preempt_targets(
-            arrays, adm, nom.chosen_flavor, elig, nom.praw_stop,
-            nom.considered,
-        )
-        nom = nom._replace(
-            best_pmode=jnp.where(
-                tgt.success, P_PREEMPT_OK,
-                jnp.where(tgt.resolved_nc, P_NO_CANDIDATES,
-                          nom.best_pmode),
-            ),
-            best_borrow=jnp.where(
-                tgt.resolved, tgt.borrow_after, nom.best_borrow
-            ),
-            needs_host=nom.needs_host & ~tgt.resolved,
-        )
+        nom, tgt = _fair_preempt_nominate(arrays, adm)
         s = s_max if s_max > 0 else arrays.w_cq.shape[0]
         res = fair_admit_scan(arrays, nom, usage, s, adm=adm, targets=tgt)
-        return finish(arrays, nom, res.usage, res.admitted,
-                      res.preempting, res.shadowed, res.win_step,
-                      victims=tgt.victims, variant=tgt.variant,
-                      tas_takes=res.tas_takes, s_tas_takes=res.s_tas_takes)
+        return _fair_finish(arrays, nom, res.usage, res.admitted,
+                            res.preempting, res.shadowed, res.win_step,
+                            victims=tgt.victims, variant=tgt.variant,
+                            tas_takes=res.tas_takes,
+                            s_tas_takes=res.s_tas_takes)
 
     return impl_preempt
 

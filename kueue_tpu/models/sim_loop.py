@@ -70,9 +70,12 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
     kernel with VMEM-resident state — exact only when
     ``pallas_scan.fits_int32`` holds for the cycle arrays, which the
     caller must check; ``interpret`` runs it in interpreter mode
-    off-TPU), or "fair" (the DRS tournament admission — requires the
+    off-TPU), "fair" (the DRS tournament admission — requires the
     fair fields on CycleArrays; per round each CQ is represented by its
-    last pending entry, mirroring the per-CQ-heads cycle semantics).
+    last pending entry, mirroring the per-CQ-heads cycle semantics), or
+    "fair_fixedpoint" (the same tournament as parallel monotone-bounds
+    rounds with a residual scan for unsettled trees — bit-identical
+    planes to "fair", usually far fewer device steps).
 
     ``per_cq_heads`` switches each round from the maximal full-batch pass
     (every pending entry competes at once) to the live scheduler's exact
@@ -87,7 +90,9 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
     deeper in a busy CQ's queue. Forecasters that must be bit-identical
     to stepping the real scheduler (whatif/) run with this on; the
     benchmark lifecycle probes keep the cheaper full-batch rounds."""
-    assert kernel in ("grouped", "fixedpoint", "pallas", "fair")
+    assert kernel in (
+        "grouped", "fixedpoint", "pallas", "fair", "fair_fixedpoint"
+    )
     _RANK_INF = jnp.int32(1) << 30
 
     def simulate(
@@ -167,6 +172,14 @@ def make_sim_loop(s_max: int, max_rounds: int = 100000,
 
                 # The tournament orders entries itself (dynamic DRS keys).
                 admit = fair_admit_scan(a, nom, usage, s_max).admitted
+            elif kernel == "fair_fixedpoint":
+                from kueue_tpu.models.fair_fixedpoint import (
+                    fair_admit_fixedpoint,
+                )
+
+                admit = fair_admit_fixedpoint(
+                    a, nom, usage, s_max
+                ).res.admitted
             elif kernel == "fixedpoint":
                 order = bs.admission_order(a, nom)
                 _u, admit, _r, _conv = bs.admit_fixedpoint(

@@ -69,6 +69,9 @@ HEADLINE: Dict[str, Dict[str, str]] = {
     "scanfloor": {
         "fp_speedup": "higher",
         "rounds_max": "lower",
+        # v2: the fair DRS tournament vs its fixed-point rounds.
+        "fair_fp_speedup": "higher",
+        "fair_rounds_max": "lower",
     },
 }
 

@@ -233,11 +233,16 @@ class WhatIfEngine:
         runtime_ms_fn: Optional[Callable[[WorkloadInfo], int]] = None,
         breaker: Optional[CircuitBreaker] = None,
         clock: Callable[[], float] = time.monotonic,
+        kernel: str = "fixedpoint",
     ) -> None:
         self.cache = cache
         self.queues = queues
         self.default_runtime_ms = int(default_runtime_ms)
         self.horizon_rounds = int(horizon_rounds)
+        # Per-round admission pass for rollouts (make_sim_loop kernels).
+        # Fair-sharing managers pass "fair_fixedpoint" so forecasts rank
+        # contenders with the same DRS tournament the live cycles use.
+        self.kernel = str(kernel)
         self._runtime_ms_fn = runtime_ms_fn
         self.breaker = breaker or CircuitBreaker(
             threshold=3, backoff_s=5.0, max_backoff_s=60.0, clock=clock
@@ -500,6 +505,7 @@ class WhatIfEngine:
         arrays, idx = encode_cycle(
             snap, heads, snap.resource_flavors,
             w_pad=_w_bucket(len(heads) + n_admitted), device_put=False,
+            fair_sharing=self.kernel.startswith("fair"),
         )
         tidx = idx.tree_index
         covered = np.asarray(arrays.covered)
@@ -525,6 +531,7 @@ class WhatIfEngine:
             arrays, idx = encode_cycle(
                 snap, heads, snap.resource_flavors,
                 w_pad=_w_bucket(need), device_put=False,
+                fair_sharing=self.kernel.startswith("fair"),
             )
             tidx = idx.tree_index
             covered = np.asarray(arrays.covered)
@@ -632,7 +639,8 @@ class WhatIfEngine:
         # The fixed-point pass is exact for lending-limit trees too (its
         # chain walk mirrors the scan's cohort-lending bookkeeping), so
         # every forecast shares one rollout executable per s_max bucket.
-        kernel = "fixedpoint"
+        # Fair-sharing managers swap in the fair rounds via self.kernel.
+        kernel = self.kernel
         s_max = _pow2(int(base_active.sum()) + len(hypo_rows), floor=8)
         fn = self._rollout_fn(s_max, kernel)
         arrays_d, ga_d = jax.device_put((arrays, idx.group_arrays))

@@ -227,3 +227,54 @@ def test_manager_prewarm_host_scheduler_is_noop():
     from kueue_tpu.manager import Manager
 
     assert Manager().prewarm() == {}
+
+
+def test_fair_fixedpoint_prewarm_covers_live_cycle():
+    """The fair prewarm rung warms BOTH fair entries (tournament scan +
+    fixed-point rounds): with autoCpuKernel=fixedpoint a prewarmed
+    scheduler's live fair cycles dispatch cycle_fair_fixedpoint with
+    zero new backend compiles."""
+    from kueue_tpu.api.types import Cohort
+
+    compile_cache.install_listeners()
+    cache, queues, _ = build_env(
+        [
+            make_cq(
+                name, cohort="co",
+                flavors={"default": {"cpu": ResourceQuota(nominal=6000)}},
+            )
+            for name in ("cq-a", "cq-b")
+        ],
+        cohorts=[Cohort(name="co")], fair_sharing=True,
+    )
+    sched = DeviceScheduler(
+        cache, queues, fair_sharing=True,
+        device_kernel="auto", auto_cpu_kernel="fixedpoint",
+    )
+    timings = sched.prewarm(max_heads=16, aot=False)
+    assert list(timings) == [16]
+    # Warmup cycles compile the non-prewarmed side paths (arena
+    # incremental scatter); the fair cycle executables must already be
+    # resident from the prewarm.
+    submit(queues, *[
+        make_wl(f"w{i}", f"lq-cq-{'ab'[i % 2]}", cpu_m=1000,
+                creation_time=float(i + 1))
+        for i in range(6)
+    ])
+    dispatched = []
+    orig = compile_cache.dispatch
+
+    def spy(entry, fn, *a, **kw):
+        dispatched.append(entry)
+        return orig(entry, fn, *a, **kw)
+
+    compile_cache.dispatch = spy
+    try:
+        assert sched.schedule().admitted
+        assert sched.schedule().admitted
+        compile_cache.reset_stats()
+        assert sched.schedule().admitted
+        assert _compiles() == 0, compile_cache.stats()
+    finally:
+        compile_cache.dispatch = orig
+    assert set(dispatched) == {"cycle_fair_fixedpoint"}, dispatched

@@ -1,0 +1,394 @@
+"""Differentials for the fair fixed-point rounds kernel.
+
+``cycle_fair_fixedpoint`` reformulates the DRS tournament scan as
+monotone-bounds rounds with an internal residual scan for trees the
+bounds cannot settle (kueue_tpu/models/fair_fixedpoint.py). It must be
+plane-for-plane bit-identical to ``cycle_fair_preempt`` on every cycle —
+these tests capture the exact (arrays, admitted) cycles the live driver
+dispatches across randomized fair scenarios and replay both kernels.
+The slot-layout half of the same PR routes multi-podset heads through
+the hybrid's residual scan; those cycles are differentialed against the
+grouped scan the same way. Non-convergence must be contained as
+``solver_fallback_cycles_total{reason="fixedpoint_rounds"}`` before any
+plane read, and the flight recorder must name the deciding kernel.
+"""
+
+import random
+from typing import Dict
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import (
+    ClusterQueuePreemption,
+    Cohort,
+    ResourceQuota,
+)
+from kueue_tpu.models import batch_scheduler as bs
+from kueue_tpu.models import fair_fixedpoint as ffp
+from kueue_tpu.models import fair_kernel as fkm
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.obs import recorder as flight
+from kueue_tpu.perf import compile_cache
+
+from .helpers import build_env, make_cq, make_wl, submit
+from .test_device_multislot import random_scenario as multislot_scenario
+
+pytestmark = pytest.mark.isolated
+
+# Planes that define a cycle's decision set. ``order`` and ``win_step``
+# style diagnostics are deliberately excluded: the rounds settle whole
+# trees at once, so step numbering differs while every decision (and the
+# post-cycle tree state) is identical.
+FAIR_PLANES = (
+    "outcome", "chosen_flavor", "borrow", "tried_flavor_idx", "usage",
+    "victims", "victim_variant",
+)
+SLOT_PLANES = FAIR_PLANES + ("s_flavor", "s_pmode", "s_tried")
+
+
+def _assert_planes(out_ref, out_new, planes, ctx):
+    for p in planes:
+        x, y = getattr(out_ref, p), getattr(out_new, p)
+        if x is None or y is None:
+            assert x is None and y is None, (ctx, p)
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{ctx} plane {p}"
+        )
+
+
+def _capture(entry, run):
+    """Run ``run()`` with a dispatch spy and return the (args, s_max)
+    captured for ``entry``."""
+    captured = []
+    orig = compile_cache.dispatch
+
+    def spy(name, fn, *a, **kw):
+        if name == entry:
+            captured.append((a, kw.get("static", ())))
+        return orig(name, fn, *a, **kw)
+
+    compile_cache.dispatch = spy
+    try:
+        run()
+    finally:
+        compile_cache.dispatch = orig
+    return captured
+
+
+# ---------------------------------------------------------------------------
+# Randomized fair differentials (>=100 captured cycles).
+# ---------------------------------------------------------------------------
+
+
+def _lending_scenario(rng):
+    n_cqs = rng.randint(2, 4)
+    cqs = []
+    for i in range(n_cqs):
+        ll = rng.choice([None, rng.randrange(0, 5) * 1000])
+        cqs.append(make_cq(
+            f"cq{i}", cohort="co",
+            flavors={"default": {"cpu": ResourceQuota(
+                nominal=rng.randrange(0, 8) * 1000,
+                borrowing_limit=rng.choice(
+                    [None, rng.randrange(0, 6) * 1000]
+                ),
+                lending_limit=ll,
+            )}},
+            fair_weight=rng.choice([None, 0.5, 2.0]),
+        ))
+    wls = []
+    for i in range(rng.randint(4, 12)):
+        wls.append(make_wl(
+            f"w{i}", f"lq-cq{rng.randrange(n_cqs)}",
+            cpu_m=rng.randint(1, 8) * 1000,
+            priority=rng.choice([0, 0, 100]),
+            creation_time=float(i + 1),
+        ))
+    return [Cohort(name="co")], cqs, wls
+
+
+def _preempt_scenario(rng):
+    cohorts = [Cohort(name="co")]
+    n_cqs = rng.randint(2, 4)
+    cqs = []
+    for i in range(n_cqs):
+        preemption = None
+        if rng.random() < 0.5:
+            preemption = ClusterQueuePreemption(
+                within_cluster_queue=rng.choice(
+                    [PreemptionPolicy.NEVER, PreemptionPolicy.LOWER_PRIORITY]
+                ),
+                reclaim_within_cohort=rng.choice(
+                    [PreemptionPolicy.NEVER, PreemptionPolicy.ANY]
+                ),
+            )
+        cqs.append(make_cq(
+            f"cq{i}", cohort="co",
+            flavors={"default": {"cpu": ResourceQuota(
+                nominal=rng.randint(0, 10) * 1000,
+                borrowing_limit=rng.choice(
+                    [None, rng.randint(0, 8) * 1000]
+                ),
+            )}},
+            preemption=preemption,
+            fair_weight=rng.choice([None, 0.5, 1.0, 2.0]),
+        ))
+    wls = []
+    for i in range(rng.randint(4, 12)):
+        wls.append(make_wl(
+            f"w{i}", f"lq-cq{rng.randrange(n_cqs)}",
+            cpu_m=rng.randint(1, 9) * 1000,
+            priority=rng.choice([0, 0, 100]),
+            creation_time=float(i + 1),
+        ))
+    return cohorts, cqs, wls
+
+
+def _fair_cycles_for_seed(seed):
+    rng = random.Random(88_000 + seed)
+    maker = _preempt_scenario if seed % 2 else _lending_scenario
+    cohorts, cqs, wls = maker(rng)
+    cache, queues, _host = build_env(
+        cqs, cohorts=cohorts, fair_sharing=True
+    )
+    sched = DeviceScheduler(cache, queues, fair_sharing=True)
+
+    def run():
+        submit(queues, *wls)
+        sched.schedule_all(max_cycles=40)
+
+    return _capture("cycle_fair_preempt", run)
+
+
+def test_fair_rounds_differential_random():
+    """>=100 live-captured fair cycles: the fixed-point rounds kernel
+    must be plane-for-plane identical to the tournament scan, converge,
+    and stay within the probe-scale rounds budget (<= 8)."""
+    total = 0
+    rounds_max = 0
+    for seed in range(24):
+        for (args, static) in _fair_cycles_for_seed(seed):
+            arrays, adm = args
+            s_max = static[1] if static else int(arrays.w_cq.shape[0])
+            out_s = fkm.fair_cycle_preempt_for(s_max)(arrays, adm)
+            out_f = ffp.fair_fixedpoint_cycle_for(s_max)(arrays, adm)
+            _assert_planes(out_s, out_f, FAIR_PLANES, f"seed {seed}")
+            assert bool(np.asarray(out_f.converged)), seed
+            rounds_max = max(rounds_max, int(np.asarray(out_f.fp_rounds)))
+            total += 1
+        if total >= 120:
+            break
+    assert total >= 100, f"only {total} fair cycles captured"
+    assert rounds_max <= 8, rounds_max
+
+
+def test_fair_end_state_matches_host_forced_fp():
+    """End-to-end: autoCpuKernel=fixedpoint (fair rounds live, host
+    fallback forbidden) reproduces the host trace on random scenarios."""
+    for seed in (1, 2, 5, 8):
+        rng = random.Random(88_000 + seed)
+        maker = _preempt_scenario if seed % 2 else _lending_scenario
+        state = rng.getstate()
+
+        def run(device):
+            rng.setstate(state)
+            cohorts, cqs, wls = maker(rng)
+            cache, queues, host = build_env(
+                cqs, cohorts=cohorts, fair_sharing=True
+            )
+            sched = (
+                DeviceScheduler(
+                    cache, queues, fair_sharing=True,
+                    device_kernel="auto", auto_cpu_kernel="fixedpoint",
+                )
+                if device else host
+            )
+            submit(queues, *wls)
+            trace = []
+            for _ in range(40):
+                r = sched.schedule()
+                trace.append((
+                    sorted(r.admitted), sorted(r.preempted),
+                    sorted(r.preempting),
+                ))
+                if not r.admitted and not r.preempted and not r.preempting:
+                    break
+            admitted = sorted(
+                i.obj.name for i in cache.workloads.values()
+            )
+            return admitted, trace
+
+        assert run(False) == run(True), seed
+
+
+# ---------------------------------------------------------------------------
+# Multislot differentials: slot-layout heads through the hybrid residual.
+# ---------------------------------------------------------------------------
+
+
+def test_multislot_hybrid_differential_random():
+    """Slot-layout cycles captured from the live grouped-scan driver are
+    replayed through the hybrid fixed-point kernel (slot trees go to its
+    residual scan): identical planes whenever the rounds converge."""
+    total = 0
+    slot_cycles = 0
+    for seed in range(10):
+        flavor_specs, cohorts, cqs, workloads = multislot_scenario(seed)
+        cache, queues, _host = build_env(
+            cqs, cohorts=cohorts, flavors=flavor_specs
+        )
+        sched = DeviceScheduler(cache, queues)
+
+        def run():
+            submit(queues, *workloads)
+            sched.schedule_all(max_cycles=40)
+
+        for (args, _static) in _capture("cycle_grouped_preempt", run):
+            arrays, ga, adm = args
+            if arrays.tas_topo is not None:
+                continue
+            s_b = max(4, int(arrays.w_cq.shape[0]))
+            out_s = bs.cycle_grouped_preempt(arrays, ga, adm)
+            out_h = bs.fixedpoint_cycle_preempt_for(s_b, 32)(
+                arrays, ga, adm
+            )
+            assert bool(np.asarray(out_h.converged)), seed
+            _assert_planes(out_s, out_h, SLOT_PLANES, f"seed {seed}")
+            total += 1
+            if arrays.s_req is not None:
+                slot_cycles += 1
+        if total >= 40 and slot_cycles >= 10:
+            break
+    assert total >= 25, f"only {total} multislot cycles captured"
+    assert slot_cycles >= 5, f"only {slot_cycles} slot-layout cycles"
+
+
+# ---------------------------------------------------------------------------
+# Containment: rounds-cap exhaustion must never surface bad planes.
+# ---------------------------------------------------------------------------
+
+
+def _contended_env():
+    """Two CQs whose heads are order-dependent: each fits alone by
+    borrowing the whole cohort pool, both together never fit — the
+    monotone bounds cannot settle either, so both trees' decisions ride
+    on the residual scan."""
+    cqs = [
+        make_cq(
+            name, cohort="co",
+            flavors={"default": {"cpu": ResourceQuota(
+                nominal=4_000, borrowing_limit=4_000,
+            )}},
+        )
+        for name in ("cq-a", "cq-b")
+    ]
+    cache, queues, host = build_env(
+        cqs, cohorts=[Cohort(name="co")], fair_sharing=True
+    )
+    wa = make_wl("wa", "lq-cq-a", cpu_m=6_000, creation_time=1.0)
+    wb = make_wl("wb", "lq-cq-b", cpu_m=6_000, creation_time=2.0)
+    return cache, queues, wa, wb
+
+
+def test_rounds_exhaustion_contained(monkeypatch):
+    """A fair fixed-point run whose residual budget is exhausted reports
+    converged=False; the driver contains it as a fixedpoint_rounds fault
+    before reading any plane and the host path finishes the cycle."""
+    starved = ffp.fair_fixedpoint_cycle_for(1)
+    monkeypatch.setattr(
+        ffp, "fair_fixedpoint_cycle_for", lambda s_max: starved
+    )
+    cache, queues, wa, wb = _contended_env()
+    sched = DeviceScheduler(
+        cache, queues, fair_sharing=True,
+        device_kernel="auto", auto_cpu_kernel="fixedpoint",
+    )
+    submit(queues, wa, wb)
+    faults = []
+    for _ in range(6):
+        r = sched.schedule()
+        if sched.last_fault is not None:
+            faults.append(sched.last_fault[0])
+        if not r.admitted and not r.preempted:
+            break
+    assert "fixedpoint_rounds" in faults, faults
+    # Containment, not corruption: the host fallback still admits
+    # exactly one of the two contenders.
+    admitted = sorted(i.obj.name for i in cache.workloads.values())
+    assert len(admitted) == 1, admitted
+
+
+def test_rounds_exhaustion_kernel_level():
+    """Same scenario at the kernel layer: with the residual capped at
+    one step the rounds report converged=False (never an exception)."""
+    cache, queues, wa, wb = _contended_env()
+    sched = DeviceScheduler(cache, queues, fair_sharing=True)
+
+    def run():
+        submit(queues, wa, wb)
+        sched.schedule()
+
+    captured = _capture("cycle_fair_preempt", run)
+    assert captured
+    arrays, adm = captured[0][0]
+    out = ffp.fair_fixedpoint_cycle_for(1)(arrays, adm)
+    assert not bool(np.asarray(out.converged))
+    # With the real budget the same cycle settles exactly.
+    s_max = captured[0][1][1]
+    out_ok = ffp.fair_fixedpoint_cycle_for(s_max)(arrays, adm)
+    assert bool(np.asarray(out_ok.converged))
+    out_s = fkm.fair_cycle_preempt_for(s_max)(arrays, adm)
+    _assert_planes(out_s, out_ok, FAIR_PLANES, "contended")
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: the deciding fair kernel (and auto reason) is named.
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_names_fair_kernel():
+    prev = flight.ENABLED
+    rec = flight.enable(capacity=64)
+    rec.clear()
+    try:
+        cache, queues, wa, wb = _contended_env()
+        sched = DeviceScheduler(
+            cache, queues, fair_sharing=True, device_kernel="auto",
+        )
+        submit(queues, wa, wb)
+        sched.schedule_all(max_cycles=6)
+        kernels = {r.kernel for r in rec.records() if r.path == "device"}
+        assert kernels == {"cycle_fair_preempt[auto-cpu-scan]"}, kernels
+
+        rec.clear()
+        cache, queues, wa, wb = _contended_env()
+        sched = DeviceScheduler(
+            cache, queues, fair_sharing=True, device_kernel="auto",
+            auto_cpu_kernel="fixedpoint",
+        )
+        submit(queues, wa, wb)
+        sched.schedule_all(max_cycles=6)
+        kernels = {r.kernel for r in rec.records() if r.path == "device"}
+        assert kernels == {"cycle_fair_fixedpoint[auto-cpu-fp]"}, kernels
+    finally:
+        if prev:
+            flight.enable()
+        else:
+            flight.disable()
+
+
+# ---------------------------------------------------------------------------
+# What-if forecasts pick the fair rounds kernel on fair managers.
+# ---------------------------------------------------------------------------
+
+
+def test_whatif_uses_fair_kernel():
+    from kueue_tpu.manager import Manager
+
+    mgr = Manager(fair_sharing=True)
+    assert mgr.whatif().kernel == "fair_fixedpoint"
+    mgr = Manager()
+    assert mgr.whatif().kernel == "fixedpoint"

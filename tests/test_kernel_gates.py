@@ -29,17 +29,24 @@ def test_all_device_kernels_documented():
     gates = mod.dispatch_gates()
     assert set(gates) == set(docs) == {
         "cycle_grouped_preempt", "cycle_fair_preempt",
+        "cycle_fair_fixedpoint",
         "cycle_fixedpoint", "cycle_fixedpoint_hybrid",
     }
     # The fixed-point kernels document exactly the shapes they cannot
-    # handle — lending limits are NOT among them anymore.
+    # handle — lending limits are NOT among them anymore, and since the
+    # hybrid's residual partition covers slot-layout trees neither is
+    # the slot layout (s_req).
     for entry in ("cycle_fixedpoint", "cycle_fixedpoint_hybrid"):
         assert docs[entry] == [
             "not idx.has_partial",
-            "arrays.s_req is None",
             "arrays.tas_topo is None",
         ]
         assert not any("has_lend_limit" in c for c, _ in gates[entry])
+        assert not any("s_req" in c for c, _ in gates[entry])
+    # The fair kernels need only the fair-sharing mode switch (the fair
+    # fixed point contains every scan capability via its residual).
+    for entry in ("cycle_fair_preempt", "cycle_fair_fixedpoint"):
+        assert docs[entry] == ["self.fair_sharing"]
 
 
 KERNEL_SRC = '''

@@ -52,6 +52,7 @@ DRIVER = PACKAGE / "models" / "driver.py"
 KERNEL_FILES = (
     PACKAGE / "models" / "batch_scheduler.py",
     PACKAGE / "models" / "fair_kernel.py",
+    PACKAGE / "models" / "fair_fixedpoint.py",
 )
 
 # Attribute substrings that mark a gate conjunct as a CAPABILITY test —
