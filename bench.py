@@ -1481,6 +1481,56 @@ def probe_scanfloor(scale: float):
     }
 
 
+def probe_fleet(scale: float):
+    """Joint fleet placement vs the sequential MultiKueue race
+    (BASELINE.json config #5 shape at tiny CPU scale: 3 worker
+    clusters, ~200*scale workloads). Runs the sequential dispatcher
+    first (per-workload mirror-to-all + first-QuotaReserved-wins), then
+    the FleetDispatcher (one batched ``cycle_fleet_assign`` solve + one
+    apply per cluster lane), on identical fleets. Headlines:
+    ``fleet_joint_speedup`` (sequential wall / joint wall — the
+    subsystem's reason to exist) and ``fleet_dispatch_p99_ms`` (p99 of
+    one joint encode+solve). Correctness is gated elsewhere (the
+    differential suite); here ``ok`` requires both paths to dispatch
+    every workload and the joint path to have actually used the device
+    kernel."""
+    from kueue_tpu.perf import multikueue_bench
+
+    n = max(30, int(200 * scale))
+    workers = 3
+    log(f"fleet probe: sequential dispatch, n={n} workers={workers}")
+    seq = multikueue_bench.run(n_workloads=n, n_workers=workers)
+    log(f"fleet probe: joint dispatch, n={n} workers={workers}")
+    joint = multikueue_bench.run_joint(
+        n_workloads=n, n_workers=workers, device=True, prewarm=True
+    )
+    speedup = (
+        seq["wall_s"] / joint["wall_s"] if joint["wall_s"] else 0.0
+    )
+    ok = (
+        seq["dispatched"] >= n
+        and joint["dispatched"] >= n
+        and joint["device_solves"] >= 1
+        and joint["host_solves"] == 0
+    )
+    return {
+        "probe": "fleet",
+        "ok": bool(ok),
+        "n": n,
+        "workers": workers,
+        "fleet_joint_speedup": round(speedup, 3),
+        "fleet_dispatch_p99_ms": round(joint["dispatch_p99_ms"], 3),
+        "sequential_wall_s": round(seq["wall_s"], 4),
+        "joint_wall_s": round(joint["wall_s"], 4),
+        "sequential_throughput": round(seq["throughput"], 2),
+        "joint_throughput": round(joint["throughput"], 2),
+        "joint_placement": joint["placement"],
+        "device_solves": joint["device_solves"],
+        "host_solves": joint["host_solves"],
+        "fingerprint_extra": {"workers": workers, "version": 1},
+    }
+
+
 def probe_coldstart_child(scale: float):
     """Child half of the cold-start probe: one fresh process, the shared
     persistent compile cache + AOT store (KUEUE_TPU_COMPILE_CACHE), one
@@ -1642,7 +1692,7 @@ def main():
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
-                             "steady", "scanfloor", "coldstart",
+                             "steady", "scanfloor", "fleet", "coldstart",
                              "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
@@ -1703,6 +1753,7 @@ def main():
                 "whatif": lambda: probe_whatif(args.scale),
                 "steady": lambda: probe_steady(args.scale),
                 "scanfloor": lambda: probe_scanfloor(args.scale),
+                "fleet": lambda: probe_fleet(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

@@ -38,7 +38,15 @@ class MultiKueueSettings:
     gc_interval_seconds: float = 60.0
     origin: str = "multikueue"
     worker_lost_timeout_seconds: float = 900.0
-    dispatcher_name: str = "AllAtOnce"  # or "Incremental"
+    dispatcher_name: str = "AllAtOnce"  # or "Incremental" | "Fleet"
+    # Joint fleet placement knobs (kueue_tpu/fleet; used when
+    # dispatcher_name == "Fleet").
+    fleet_device: bool = True
+    fleet_preemption: bool = False
+    fleet_spread_weight: int = 1
+    fleet_preempt_penalty: int = 64
+    fleet_affinity_penalty: int = 8
+    fleet_dispatch_costs: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -234,6 +242,24 @@ def load(source) -> Configuration:
             _pick(mk, "workerLostTimeout", default="15m")
         ),
         dispatcher_name=mk.get("dispatcherName", "AllAtOnce"),
+        fleet_device=bool(_pick(mk, "fleetDevice", "fleet_device",
+                                default=True)),
+        fleet_preemption=bool(_pick(mk, "fleetPreemption",
+                                    "fleet_preemption", default=False)),
+        fleet_spread_weight=int(_pick(mk, "fleetSpreadWeight",
+                                      "fleet_spread_weight", default=1)),
+        fleet_preempt_penalty=int(_pick(
+            mk, "fleetPreemptPenalty", "fleet_preempt_penalty", default=64
+        )),
+        fleet_affinity_penalty=int(_pick(
+            mk, "fleetAffinityPenalty", "fleet_affinity_penalty", default=8
+        )),
+        fleet_dispatch_costs={
+            str(k): int(v)
+            for k, v in (_pick(mk, "fleetDispatchCosts",
+                               "fleet_dispatch_costs", default={})
+                         or {}).items()
+        },
     )
     res = _pick(raw, "resources", default={}) or {}
     cfg.resources = ResourcesConfig(
@@ -316,10 +342,20 @@ def validate(cfg: Configuration) -> None:
             "LessThanOrEqualToFinalShare", "LessThanInitialShare",
         ):
             raise ValueError(f"unknown preemption strategy {strategy}")
-    if cfg.multi_kueue.dispatcher_name not in ("AllAtOnce", "Incremental"):
+    if cfg.multi_kueue.dispatcher_name not in (
+        "AllAtOnce", "Incremental", "Fleet",
+    ):
         raise ValueError(
             f"unknown dispatcher {cfg.multi_kueue.dispatcher_name}"
         )
+    if cfg.multi_kueue.fleet_spread_weight < 0:
+        raise ValueError("multiKueue.fleetSpreadWeight must be >= 0")
+    if cfg.multi_kueue.fleet_preempt_penalty < 0:
+        raise ValueError("multiKueue.fleetPreemptPenalty must be >= 0")
+    if cfg.multi_kueue.fleet_affinity_penalty < 0:
+        raise ValueError("multiKueue.fleetAffinityPenalty must be >= 0")
+    if any(v < 0 for v in cfg.multi_kueue.fleet_dispatch_costs.values()):
+        raise ValueError("multiKueue.fleetDispatchCosts must be >= 0")
     for gate in cfg.feature_gates:
         if gate not in features.all_gates():
             raise ValueError(f"unknown feature gate {gate}")

@@ -45,6 +45,12 @@ class _GroupState:
     round_started_at: float = 0.0
     winner: Optional[str] = None
     winner_lost_since: Optional[float] = None
+    # Remote-status-mirror retry backoff: when the winner's transport is
+    # unreachable (breaker open -> fast-fail WorkerUnreachable), the next
+    # mirror attempt is deferred to next_sync_at instead of hammering the
+    # dead transport every tick.
+    sync_backoff_s: float = 0.0
+    next_sync_at: float = 0.0
 
 
 class MultiKueueController(AdmissionCheckController):
@@ -58,6 +64,9 @@ class MultiKueueController(AdmissionCheckController):
         config: Optional[MultiKueueConfig] = None,
         nomination_round_seconds: float = 300.0,
         worker_lost_timeout_seconds: float = 900.0,
+        remote_sync_backoff_seconds: float = 1.0,
+        remote_sync_backoff_max_seconds: float = 60.0,
+        fleet=None,
     ) -> None:
         self.workers: Dict[str, Manager] = workers or {}
         self.config = config or MultiKueueConfig(name="default")
@@ -65,12 +74,27 @@ class MultiKueueController(AdmissionCheckController):
         # reference config multiKueue.workerLostTimeout: grace before a
         # workload on an unreachable worker is redispatched.
         self.worker_lost_timeout_seconds = worker_lost_timeout_seconds
+        self.remote_sync_backoff_seconds = remote_sync_backoff_seconds
+        self.remote_sync_backoff_max_seconds = remote_sync_backoff_max_seconds
         self.state: Dict[str, _GroupState] = {}
+        # Joint fleet placement (fleet/dispatcher.py): when attached,
+        # sync() hands the whole pending batch to one joint solve and
+        # only falls back to the sequential race below when the fleet
+        # declines (unsupported quota shapes, no reachable workers).
+        self.fleet = None
+        if fleet is not None:
+            self.attach_fleet(fleet)
 
     def add_worker(self, name: str, manager: Manager) -> None:
         self.workers[name] = manager
         if name not in self.config.clusters:
             self.config.clusters.append(name)
+
+    def attach_fleet(self, fleet) -> "MultiKueueController":
+        """Bind a :class:`~kueue_tpu.fleet.FleetDispatcher` to this
+        controller (docs/multikueue.md)."""
+        self.fleet = fleet.bind(self)
+        return self
 
     # ------------------------------------------------------------------
 
@@ -87,6 +111,14 @@ class MultiKueueController(AdmissionCheckController):
 
         clusters = [c for c in self.config.clusters if c in self.workers]
         if not clusters:
+            return
+
+        # Joint fleet placement: one batched solve admits every pending
+        # candidate across all clusters at once; the sequential race
+        # below only runs when the fleet declines the problem.
+        if self.fleet is not None and self.fleet.sync(
+            manager, wl, check_name
+        ):
             return
 
         # Nominate workers (incremental: rounds of 3; reference
@@ -185,15 +217,40 @@ class MultiKueueController(AdmissionCheckController):
             else:
                 return
         now = manager.clock()
+        if st.next_sync_at and now < st.next_sync_at:
+            # Backing off after an unreachable mirror attempt: don't
+            # hammer a transport whose breaker is open. The worker-lost
+            # clock keeps running underneath, so redispatch still fires
+            # after workerLostTimeout even while backing off.
+            if st.winner_lost_since is not None and (
+                now - st.winner_lost_since
+                >= self.worker_lost_timeout_seconds
+            ):
+                self._redispatch(manager, wl)
+            return
         worker = self.workers.get(st.winner)
+        unreachable = False
         try:
             remote = (
                 worker.workloads.get(wl.key) if worker is not None else None
             )
         except ConnectionError:
-            # Transport down: indistinguishable from a lost worker; start
-            # (or continue) the workerLostTimeout clock.
+            # Transport down (incl. fast-failed WorkerUnreachable from an
+            # open breaker): requeue the mirror with exponential backoff
+            # and keep the workerLostTimeout clock running.
             remote = None
+            unreachable = True
+        if unreachable:
+            manager.metrics.inc(
+                "multikueue_remote_sync_retries_total",
+                {"cluster": st.winner},
+            )
+            st.sync_backoff_s = min(
+                max(self.remote_sync_backoff_seconds,
+                    st.sync_backoff_s * 2),
+                self.remote_sync_backoff_max_seconds,
+            )
+            st.next_sync_at = now + st.sync_backoff_s
         if worker is None or remote is None:
             # Worker unreachable/lost the workload: wait out the grace
             # period before redispatching (workerLostTimeout).
@@ -204,6 +261,8 @@ class MultiKueueController(AdmissionCheckController):
                 self._redispatch(manager, wl)
             return
         st.winner_lost_since = None
+        st.sync_backoff_s = 0.0
+        st.next_sync_at = 0.0
         self._mirror_topology(wl, remote)
         if is_finished(remote):
             manager.finish_workload(wl)
@@ -240,6 +299,9 @@ class MultiKueueController(AdmissionCheckController):
         st = self.state.setdefault(wl.key, _GroupState())
         st.winner = None
         st.nominated = []
+        st.winner_lost_since = None
+        st.sync_backoff_s = 0.0
+        st.next_sync_at = 0.0
         wl.status.cluster_name = None
         for acs in wl.status.admission_checks:
             ac = manager.cache.admission_checks.get(acs.name)

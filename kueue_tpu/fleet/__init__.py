@@ -1,0 +1,48 @@
+"""Joint multi-cluster (MultiKueue fleet) placement subsystem.
+
+See docs/multikueue.md. Encode per-cluster capacity into ``[C, ...]``
+lane planes (:mod:`kueue_tpu.fleet.encode`), solve the whole pending
+batch in one device dispatch (:mod:`kueue_tpu.fleet.kernel`) or one
+host oracle walk (:mod:`kueue_tpu.fleet.oracle`), apply per lane
+through the existing remote worker layer
+(:mod:`kueue_tpu.fleet.dispatcher`).
+"""
+
+from kueue_tpu.fleet.dispatcher import FleetDispatcher, plan_from_outputs
+from kueue_tpu.fleet.encode import (
+    AFFINITY_ANNOTATION,
+    FLEET_MAX_S,
+    FleetEncoder,
+    FleetSpec,
+    FleetUnsupported,
+    cluster_capacity,
+    local_capacity,
+    to_device,
+)
+from kueue_tpu.fleet.kernel import FleetOutputs, fleet_cycle, make_fleet_cycle
+from kueue_tpu.fleet.oracle import (
+    FleetPlan,
+    fleet_oracle,
+    plans_equal,
+    validate_plan,
+)
+
+__all__ = [
+    "AFFINITY_ANNOTATION",
+    "FLEET_MAX_S",
+    "FleetDispatcher",
+    "FleetEncoder",
+    "FleetOutputs",
+    "FleetPlan",
+    "FleetSpec",
+    "FleetUnsupported",
+    "cluster_capacity",
+    "fleet_cycle",
+    "fleet_oracle",
+    "local_capacity",
+    "make_fleet_cycle",
+    "plan_from_outputs",
+    "plans_equal",
+    "to_device",
+    "validate_plan",
+]

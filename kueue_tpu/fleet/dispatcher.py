@@ -1,0 +1,430 @@
+"""FleetDispatcher: one joint solve admits for the whole fleet.
+
+The sequential MultiKueue dispatcher mirrors each workload to every
+nominated worker, lets every worker race, then keeps the first
+reservation — O(candidates x clusters) remote round-trips per admission
+wave, and the "winner" is whichever cluster answered first, not the
+cheapest feasible one. The fleet dispatcher replaces that loop: encode
+every reachable worker's capacity into lane planes (``fleet/encode``),
+solve placement for the *entire* pending batch in one device dispatch
+(``cycle_fleet_assign``) or one host oracle walk, then apply each lane's
+placements with one mirror + one ``schedule_all`` per cluster.
+
+Containment ladder (never corrupt local state):
+
+- device solve faults/invalid plan -> host oracle, counted under
+  ``solver_fallback_cycles_total{reason="fleet"}``;
+- a lane shape the flat planes can't model (``FleetUnsupported``) or an
+  encode crash -> return ``False`` so the controller's sequential path
+  handles the workload exactly as before this subsystem existed;
+- a lane that fails during *apply* (transport down, worker crash) ->
+  that lane's placements stay PENDING and retry next tick, counted in
+  ``fleet_apply_failures_total``; other lanes' applies are unaffected.
+
+With a :class:`~kueue_tpu.obs.service.ServiceLoop` attached, per-lane
+apply results are streamed through the loop's ingestion queue
+(``service.call``) so remote confirmations serialize with admission
+cycles instead of racing them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    is_finished,
+)
+from kueue_tpu.fleet.encode import (
+    FLEET_MAX_S,
+    FleetEncoder,
+    FleetSpec,
+    FleetUnsupported,
+    to_device,
+)
+from kueue_tpu.fleet.oracle import FleetPlan, fleet_oracle, validate_plan
+from kueue_tpu.utils import faults
+
+import numpy as np
+
+
+def plan_from_outputs(spec: FleetSpec, out) -> FleetPlan:
+    """Slice padded device outputs back to the spec's real extents."""
+    C = spec.c
+    W = spec.w
+    S = spec.vict_ok.shape[1]
+    return FleetPlan(
+        admitted=np.asarray(out.admitted)[:W].astype(bool),
+        cluster=np.asarray(out.cluster)[:W].astype(np.int32),
+        flavor=np.asarray(out.flavor)[:W].astype(np.int32),
+        victims=np.asarray(out.victims)[:W, :S].astype(bool),
+        placed=np.asarray(out.placed)[:C].astype(np.int32),
+        avail=np.asarray(out.avail)[:C].astype(np.int64),
+    )
+
+
+class FleetDispatcher:
+    """Joint placement front-end for :class:`MultiKueueController`."""
+
+    def __init__(
+        self,
+        device: bool = True,
+        preemption: bool = False,
+        spread_weight: int = 1,
+        preempt_penalty: int = 64,
+        affinity_penalty: int = 8,
+        dispatch_costs: Optional[Dict[str, int]] = None,
+        service=None,
+    ) -> None:
+        self.device = device
+        self.preemption = preemption
+        self.spread_weight = spread_weight
+        self.preempt_penalty = preempt_penalty
+        self.affinity_penalty = affinity_penalty
+        self.dispatch_costs = dict(dispatch_costs or {})
+        self.service = service
+        self.encoder = FleetEncoder()
+        self.controller = None
+        self._last_fp: Optional[Tuple] = None
+
+    @classmethod
+    def from_settings(cls, settings, service=None) -> "FleetDispatcher":
+        """Build from config ``MultiKueueSettings`` (fleet_* fields)."""
+        return cls(
+            device=getattr(settings, "fleet_device", True),
+            preemption=getattr(settings, "fleet_preemption", False),
+            spread_weight=getattr(settings, "fleet_spread_weight", 1),
+            preempt_penalty=getattr(settings, "fleet_preempt_penalty", 64),
+            affinity_penalty=getattr(settings, "fleet_affinity_penalty", 8),
+            dispatch_costs=getattr(settings, "fleet_dispatch_costs", None),
+            service=service,
+        )
+
+    def bind(self, controller) -> "FleetDispatcher":
+        self.controller = controller
+        return self
+
+    # -- candidate collection -------------------------------------------
+
+    def _collect(self, manager, check_name: str) -> List[Workload]:
+        out: List[Workload] = []
+        for wl in manager.workloads.values():
+            if not wl.active or is_finished(wl):
+                continue
+            if not has_quota_reservation(wl):
+                continue
+            if wl.status.cluster_name:
+                continue
+            for acs in wl.status.admission_checks:
+                if acs.name == check_name \
+                        and acs.state == CheckState.PENDING:
+                    out.append(wl)
+                    break
+        return out
+
+    def _capacity_token(self) -> Optional[Tuple]:
+        """Stable token over every worker's cache generations, or None
+        when any worker (remote clients) can't provide one — meaning
+        the no-change fast path must not be taken."""
+        ctrl = self.controller
+        if ctrl is None:
+            return None
+        parts = []
+        for name in sorted(ctrl.workers):
+            cache = getattr(ctrl.workers[name], "cache", None)
+            if cache is None:
+                return None
+            parts.append((name, cache.generation,
+                          cache.workload_generation))
+        return tuple(parts)
+
+    # -- the joint solve -------------------------------------------------
+
+    def sync(self, manager, wl: Workload, check_name: str) -> bool:
+        """Fleet entry point, called per-workload from the controller's
+        ``sync``. The *first* pending workload of a tick triggers the
+        joint solve for every candidate; later candidates' checks are
+        already resolved (or the fingerprint guard makes their call a
+        no-op). Returns ``False`` to hand the workload to the
+        controller's sequential path."""
+        if self.controller is None or not self.controller.workers:
+            return False
+        return self.run(manager, check_name)
+
+    def run(self, manager, check_name: str) -> bool:
+        ctrl = self.controller
+        candidates = self._collect(manager, check_name)
+        if not candidates:
+            return True
+        token = self._capacity_token()
+        fp = (frozenset(w.key for w in candidates), token)
+        if token is not None and fp == self._last_fp:
+            # Same pending set against unchanged capacity: the previous
+            # solve's outcome still stands, nothing to recompute.
+            return True
+
+        t0 = time.perf_counter()
+        try:
+            spec = self.encoder.encode(
+                ctrl.workers, candidates,
+                preemption=self.preemption,
+                spread_weight=self.spread_weight,
+                preempt_penalty=self.preempt_penalty,
+                affinity_penalty=self.affinity_penalty,
+                dispatch_costs=self.dispatch_costs,
+            )
+        except FleetUnsupported:
+            return False
+        except Exception:  # noqa: BLE001 - encode crash: sequential path
+            manager.metrics.inc(
+                "solver_fallback_cycles_total", {"reason": "fleet"}
+            )
+            return False
+
+        for lane in spec.skipped:
+            manager.metrics.inc(
+                "fleet_lane_unavailable_total", {"cluster": lane}
+            )
+        manager.metrics.set_gauge("fleet_lanes", spec.c)
+        manager.metrics.set_gauge("fleet_candidates", spec.w)
+        if spec.c == 0:
+            # Whole fleet unreachable: nothing to place against; retry
+            # next tick (transport breakers own the backoff).
+            self._last_fp = fp
+            return True
+
+        plan, path = self._solve(manager, spec)
+        manager.metrics.inc("fleet_dispatches_total", {"path": path})
+        manager.metrics.observe(
+            "fleet_dispatch_seconds", time.perf_counter() - t0
+        )
+        clean = self._apply(manager, spec, plan, candidates, check_name)
+        # A lane that failed during apply must retry next tick even if
+        # nothing else changed — only a clean apply arms the
+        # unchanged-fingerprint fast path.
+        self._last_fp = (fp[0], self._capacity_token()) if clean else None
+        return True
+
+    def _select_entry(self, spec: FleetSpec) -> Optional[str]:
+        entry = None
+        if self.device and spec.s_bound <= FLEET_MAX_S:
+            entry = "cycle_fleet_assign"
+        return entry
+
+    def _solve(self, manager, spec: FleetSpec) -> Tuple[FleetPlan, str]:
+        entry = self._select_entry(spec)
+        if entry is not None:
+            try:
+                if faults.ENABLED:
+                    faults.fire(faults.FLEET_DISPATCH)
+                from kueue_tpu.fleet.kernel import fleet_cycle
+                from kueue_tpu.perf import compile_cache
+
+                arrays = to_device(spec)
+                out = compile_cache.dispatch(entry, fleet_cycle(), arrays)
+                plan = plan_from_outputs(spec, out)
+                errs = validate_plan(spec, plan)
+                if errs:
+                    raise RuntimeError(
+                        f"fleet plan validation failed: {errs[:3]}"
+                    )
+                return plan, "device"
+            except Exception:  # noqa: BLE001 - contained: host oracle
+                manager.metrics.inc(
+                    "solver_fallback_cycles_total", {"reason": "fleet"}
+                )
+        return fleet_oracle(spec), "host"
+
+    # -- per-lane apply ---------------------------------------------------
+
+    def _apply(self, manager, spec: FleetSpec, plan: FleetPlan,
+               candidates: List[Workload], check_name: str) -> bool:
+        """Apply per lane; returns True only if every lane applied
+        without a contained failure."""
+        by_key = {w.key: w for w in candidates}
+        lanes: Dict[str, List[Tuple[Workload, List[str]]]] = {}
+        for wi, key in enumerate(spec.candidates):
+            if not plan.admitted[wi]:
+                continue
+            wl = by_key.get(key)
+            if wl is None:
+                continue
+            ci = int(plan.cluster[wi])
+            cname = spec.clusters[ci]
+            vkeys = [
+                spec.vict_keys[ci][si]
+                for si in np.nonzero(plan.victims[wi])[0]
+                if si < len(spec.vict_keys[ci])
+            ]
+            lanes.setdefault(cname, []).append((wl, vkeys))
+        clean = True
+        for cname, rows in lanes.items():
+            clean = self._apply_lane(manager, cname, rows, check_name) \
+                and clean
+        return clean
+
+    def _apply_lane(self, manager, cname: str,
+                    rows: List[Tuple[Workload, List[str]]],
+                    check_name: str) -> bool:
+        ctrl = self.controller
+        worker = ctrl.workers[cname]
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.FLEET_APPLY)
+            victim_keys: List[str] = []
+            seen = set()
+            for _wl, vkeys in rows:
+                for vk in vkeys:
+                    if vk not in seen:
+                        seen.add(vk)
+                        victim_keys.append(vk)
+            for vk in victim_keys:
+                remote_v = worker.workloads.get(vk)
+                if remote_v is not None:
+                    worker.delete_workload(remote_v)
+                manager.metrics.inc(
+                    "fleet_preemptions_total", {"cluster": cname}
+                )
+                local_v = manager.workloads.get(vk)
+                if local_v is not None \
+                        and local_v.status.cluster_name == cname:
+                    ctrl._redispatch(manager, local_v)
+            for wl, _vkeys in rows:
+                if wl.key not in worker.workloads:
+                    copy = wl.clone()
+                    copy.status = type(copy.status)()
+                    try:
+                        worker.create_workload(copy)
+                    except ValueError:
+                        pass  # raced into existence: fine
+            schedule_all = getattr(worker, "schedule_all", None)
+            if schedule_all is not None:
+                schedule_all()
+            else:
+                worker.schedule()
+            for wl, _vkeys in rows:
+                remote = worker.workloads.get(wl.key)
+                if remote is None or not has_quota_reservation(remote):
+                    continue  # lane disagreed: stays PENDING, retries
+                self._finalize(manager, wl, cname, check_name)
+            return True
+        except ConnectionError:
+            manager.metrics.inc(
+                "fleet_apply_failures_total", {"cluster": cname}
+            )
+        except Exception:  # noqa: BLE001 - lane contained, others proceed
+            manager.metrics.inc(
+                "fleet_apply_failures_total", {"cluster": cname}
+            )
+        return False
+
+    def _finalize(self, manager, wl: Workload, cname: str,
+                  check_name: str) -> None:
+        """Record the placement on the manager side. Streamed through
+        the service ingest queue when one is attached (and we are not
+        already on the loop thread), so confirmations serialize with
+        admission cycles."""
+        svc = self.service
+
+        def fin(mgr) -> None:
+            self._finalize_inline(mgr, wl, cname, check_name)
+
+        if svc is not None:
+            import threading
+
+            on_loop = (
+                getattr(svc, "_thread", None) is threading.current_thread()
+            )
+            if not on_loop and svc.post(("fleet_apply", fin,
+                                         manager.clock())):
+                return
+        fin(manager)
+
+    def _finalize_inline(self, manager, wl: Workload, cname: str,
+                         check_name: str) -> None:
+        ctrl = self.controller
+        worker = ctrl.workers.get(cname)
+        if worker is None:
+            return
+        try:
+            remote = worker.workloads.get(wl.key)
+        except ConnectionError:
+            remote = None
+        if remote is None or not has_quota_reservation(remote):
+            return
+        st = ctrl.state.get(wl.key)
+        if st is None:
+            st = _group_state()
+            ctrl.state[wl.key] = st
+        st.winner = cname
+        if cname not in st.nominated:
+            st.nominated.append(cname)
+        wl.status.cluster_name = cname
+        ctrl._mirror_topology(wl, remote)
+        acs = next(
+            (a for a in wl.status.admission_checks
+             if a.name == check_name),
+            None,
+        )
+        if acs is not None:
+            acs.state = CheckState.READY
+            acs.message = (
+                f'The workload got reservation on "{cname}" (fleet)'
+            )
+            acs.last_transition_time = manager.clock()
+        manager.metrics.inc(
+            "multikueue_dispatches_total", {"cluster": cname}
+        )
+        manager.metrics.inc(
+            "fleet_placements_total", {"cluster": cname}
+        )
+
+    # -- prewarm -----------------------------------------------------------
+
+    def prewarm(self, max_heads: int = 16, aot: bool = True) -> dict:
+        """Compile the fleet cycle for the current worker shapes so the
+        first joint dispatch hits a warm executable. Zero-candidate
+        planes at the real (C, S, F, R) extents and the W ladder up to
+        ``max_heads`` — the same shapes runtime solves pad to."""
+        ctrl = self.controller
+        if ctrl is None or not ctrl.workers or not self.device:
+            return {"entries": 0}
+        from kueue_tpu.models import buckets
+        from kueue_tpu.fleet.kernel import fleet_cycle
+        from kueue_tpu.perf import compile_cache
+
+        try:
+            spec = self.encoder.encode(
+                ctrl.workers, [],
+                preemption=self.preemption,
+                spread_weight=self.spread_weight,
+                preempt_penalty=self.preempt_penalty,
+                affinity_penalty=self.affinity_penalty,
+                dispatch_costs=self.dispatch_costs,
+            )
+        except Exception:  # noqa: BLE001 - incl. FleetUnsupported
+            return {"entries": 0}
+        if spec.c == 0 or self._select_entry(spec) is None:
+            return {"entries": 0}
+        entries = 0
+        for rung in buckets.ladder(max_heads):
+            try:
+                arrays = to_device(spec, w_bucket=rung)
+                compile_cache.prewarm_entry(
+                    "cycle_fleet_assign", fleet_cycle(), (arrays,),
+                    aot=aot,
+                )
+                entries += 1
+            except Exception:  # noqa: BLE001 - prewarm is best-effort
+                break
+        return {"entries": entries, "clusters": spec.c,
+                "s_bound": spec.s_bound}
+
+
+def _group_state():
+    from kueue_tpu.controllers.multikueue import _GroupState
+
+    return _GroupState()

@@ -1,0 +1,369 @@
+"""Fleet encoding: stack per-cluster capacity planes into ``[C, ...]``.
+
+The joint multi-cluster placement kernel (``fleet/kernel.py``) consumes
+one batched tensor set with a leading cluster axis: per-lane available
+capacity ``[C, F, R]``, per-lane running-workload (victim) planes
+``[C, S, F, R]``, and per-candidate request/eligibility planes
+``[W, ...]`` shared across lanes. This module builds those planes from
+live worker clusters — in-process :class:`kueue_tpu.manager.Manager`
+instances or remote worker clients speaking the ``capacity`` op
+(``remote/worker.py``) — mirroring how ``models/encode.py`` builds the
+single-cluster cycle tensors.
+
+Incremental lane reuse (the CycleArena idea applied per cluster lane):
+:class:`FleetEncoder` caches each lane's capacity doc keyed by the
+worker's cache generations and rebuilds only lanes whose worker state
+changed since the previous solve; unchanged lanes are reused verbatim.
+
+A lane the flat planes cannot represent (multiple ClusterQueues, a
+cohort, or lending limits — shapes where admission depends on the quota
+*tree*, not one per-CQ cell) raises :class:`FleetUnsupported`; the
+dispatcher then leaves the whole fleet to the sequential per-workload
+MultiKueue path rather than solve against a wrong model. An
+*unreachable* lane (transport down) is merely skipped and counted —
+placement proceeds across the reachable lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from kueue_tpu.api.types import Workload
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    is_finished,
+)
+from kueue_tpu.models import buckets
+
+#: Workload annotation naming the preferred worker cluster; other lanes
+#: pay the dispatcher's affinity penalty for this candidate.
+AFFINITY_ANNOTATION = "kueue.x-k8s.io/preferred-cluster"
+
+#: Victim-axis cap for the device kernel: a lane with more running
+#: workloads than this solves on the host oracle instead (the padded
+#: cumulative-free planes grow with S; past this rung the scan's
+#: compile/memory cost outweighs one joint dispatch).
+FLEET_MAX_S = 512
+
+
+class FleetUnsupported(Exception):
+    """A reachable worker's quota shape cannot be modeled by flat
+    per-lane planes (multi-CQ / cohort / lending); use the sequential
+    dispatch path."""
+
+
+class FleetSpec(NamedTuple):
+    """Host-side (numpy, unpadded) joint-placement problem."""
+
+    clusters: Tuple[str, ...]            # lane -> cluster name
+    flavors: Tuple[str, ...]             # flavor universe
+    resources: Tuple[str, ...]           # resource universe
+    candidates: Tuple[str, ...]          # workload keys, admission order
+    vict_keys: Tuple[Tuple[str, ...], ...]  # per lane, victim-axis order
+    avail: np.ndarray                    # [C, F, R] int64
+    flavor_ok: np.ndarray                # [C, F] bool
+    vict_free: np.ndarray                # [C, S, F, R] int64
+    vict_prio: np.ndarray                # [C, S] int64
+    vict_ok: np.ndarray                  # [C, S] bool
+    req: np.ndarray                      # [W, R] int64
+    elig: np.ndarray                     # [W, F] bool
+    prio: np.ndarray                     # [W] int64
+    cost: np.ndarray                     # [C, W] int64
+    preempt: np.ndarray                  # [W] bool
+    spread_weight: int
+    preempt_penalty: int
+    s_bound: int                         # padded victim-axis length
+    skipped: Tuple[str, ...]             # unreachable cluster names
+
+    @property
+    def c(self) -> int:
+        return len(self.clusters)
+
+    @property
+    def w(self) -> int:
+        return len(self.candidates)
+
+
+class FleetArrays(NamedTuple):
+    """Device-side padded planes consumed by ``cycle_fleet_assign``."""
+
+    avail: object        # [Cp, F, R] i32
+    flavor_ok: object    # [Cp, F] bool
+    vict_free: object    # [Cp, Sp, F, R] i32
+    vict_prio: object    # [Cp, Sp] i32
+    vict_ok: object      # [Cp, Sp] bool
+    req: object          # [Wp, R] i32
+    elig: object         # [Wp, F] bool
+    prio: object         # [Wp] i32
+    cost: object         # [Cp, Wp] i32
+    valid: object        # [Wp] bool
+    preempt: object      # [Wp] bool
+    spread_w: object     # scalar i32
+    pre_penalty: object  # scalar i32
+
+
+# --------------------------------------------------------------------------
+# per-cluster capacity docs
+# --------------------------------------------------------------------------
+
+def local_capacity(mgr) -> dict:
+    """Capacity doc for an in-process worker Manager — also the payload
+    of the remote ``capacity`` op (JSON-serializable by construction)."""
+    snap = mgr.cache.snapshot()
+    cqs = list(snap.cluster_queues.values())
+    has_cohort = any(cq.spec.cohort for cq in cqs)
+    has_lend = False
+    flavors: Dict[str, Dict[str, int]] = {}
+    for cq in cqs:
+        for rg in cq.spec.resource_groups:
+            for fq in rg.flavors:
+                row = flavors.setdefault(fq.name, {})
+                for res, q in fq.resources.items():
+                    if q.lending_limit is not None:
+                        has_lend = True
+                    avail = cq.available((fq.name, res))
+                    row[res] = row.get(res, 0) + max(0, int(avail))
+    running: List[dict] = []
+    for wl in mgr.workloads.values():
+        if not has_quota_reservation(wl) or is_finished(wl):
+            continue
+        adm = wl.status.admission
+        if adm is None:
+            continue
+        usage: Dict[str, Dict[str, int]] = {}
+        for psa in adm.pod_set_assignments:
+            for res, amount in psa.resource_usage.items():
+                fl = psa.flavors.get(res)
+                if fl is None:
+                    continue
+                row = usage.setdefault(fl, {})
+                row[res] = row.get(res, 0) + int(amount)
+        running.append({
+            "key": wl.key,
+            "priority": int(wl.priority),
+            "usage": usage,
+        })
+    return {
+        "flavors": flavors,
+        "cq_count": len(cqs),
+        "has_cohort": bool(has_cohort),
+        "has_lend": bool(has_lend),
+        "running": running,
+    }
+
+
+def cluster_capacity(worker) -> Optional[dict]:
+    """Capacity doc for one worker; ``None`` when unreachable."""
+    try:
+        if hasattr(worker, "cache"):
+            return local_capacity(worker)
+        cap = getattr(worker, "capacity", None)
+        if cap is None:
+            raise FleetUnsupported(
+                f"worker {worker!r} exposes neither a cache nor a "
+                "capacity op"
+            )
+        return cap()
+    except ConnectionError:
+        return None
+
+
+# --------------------------------------------------------------------------
+# encoding
+# --------------------------------------------------------------------------
+
+def _candidate_requests(wl: Workload) -> Dict[str, int]:
+    req: Dict[str, int] = {}
+    for ps in wl.pod_sets:
+        for res, v in ps.requests.items():
+            req[res] = req.get(res, 0) + int(v) * int(ps.count)
+    return req
+
+
+class FleetEncoder:
+    """Builds :class:`FleetSpec` instances, reusing unchanged lanes.
+
+    Lane cache key: in-process workers expose
+    ``(cache.generation, cache.workload_generation)``; any worker
+    without those (remote clients) is re-read every solve.
+    """
+
+    def __init__(self) -> None:
+        self._lane_docs: Dict[str, Tuple[object, dict]] = {}
+        self.lane_reuses = 0
+        self.lane_rebuilds = 0
+
+    def _lane_doc(self, name: str, worker) -> Optional[dict]:
+        token = None
+        cache = getattr(worker, "cache", None)
+        if cache is not None:
+            token = (cache.generation, cache.workload_generation)
+        if token is not None:
+            hit = self._lane_docs.get(name)
+            if hit is not None and hit[0] == token:
+                self.lane_reuses += 1
+                return hit[1]
+        doc = cluster_capacity(worker)
+        if doc is not None and token is not None:
+            self._lane_docs[name] = (token, doc)
+            self.lane_rebuilds += 1
+        return doc
+
+    def encode(
+        self,
+        workers: Dict[str, object],
+        candidates: List[Workload],
+        *,
+        preemption: bool = False,
+        spread_weight: int = 1,
+        preempt_penalty: int = 64,
+        affinity_penalty: int = 8,
+        dispatch_costs: Optional[Dict[str, int]] = None,
+    ) -> FleetSpec:
+        docs: Dict[str, dict] = {}
+        skipped: List[str] = []
+        for name in sorted(workers):
+            doc = self._lane_doc(name, workers[name])
+            if doc is None:
+                skipped.append(name)
+                continue
+            if doc["cq_count"] != 1 or doc["has_cohort"] or doc["has_lend"]:
+                raise FleetUnsupported(
+                    f"cluster {name!r}: flat lane planes cannot model "
+                    f"cq_count={doc['cq_count']} "
+                    f"cohort={doc['has_cohort']} lend={doc['has_lend']}"
+                )
+            docs[name] = doc
+
+        clusters = tuple(sorted(docs))
+        flavor_set: set = set()
+        resource_set: set = set()
+        for doc in docs.values():
+            for fname, row in doc["flavors"].items():
+                flavor_set.add(fname)
+                resource_set.update(row)
+            for vic in doc["running"]:
+                for fname, row in vic["usage"].items():
+                    flavor_set.add(fname)
+                    resource_set.update(row)
+        for wl in candidates:
+            resource_set.update(_candidate_requests(wl))
+        flavors = tuple(sorted(flavor_set))
+        resources = tuple(sorted(resource_set))
+        fi = {f: i for i, f in enumerate(flavors)}
+        ri = {r: i for i, r in enumerate(resources)}
+
+        C, F, R = len(clusters), len(flavors), len(resources)
+        # Admission order: priority desc, creation asc, key asc — the
+        # same order the sequential dispatcher sees workloads in.
+        cands = sorted(
+            candidates,
+            key=lambda w: (-w.priority, w.creation_time, w.key),
+        )
+        W = len(cands)
+
+        avail = np.zeros((C, F, R), dtype=np.int64)
+        flavor_ok = np.zeros((C, F), dtype=bool)
+        vict_lists: List[List[dict]] = []
+        s_real = 0
+        for ci, name in enumerate(clusters):
+            doc = docs[name]
+            for fname, row in doc["flavors"].items():
+                flavor_ok[ci, fi[fname]] = True
+                for res, v in row.items():
+                    avail[ci, fi[fname], ri[res]] = v
+            vics = sorted(
+                doc["running"], key=lambda v: (v["priority"], v["key"])
+            ) if preemption else []
+            vict_lists.append(vics)
+            s_real = max(s_real, len(vics))
+        # With preemption off the victim planes are dead weight — pin
+        # S to 1 so the compiled shape never churns as the running set
+        # grows (the zero-compile-after-prewarm pin depends on this).
+        s_bound = buckets.pow2_bucket(s_real, floor=4) if preemption else 1
+
+        vict_free = np.zeros((C, s_bound, F, R), dtype=np.int64)
+        vict_prio = np.zeros((C, s_bound), dtype=np.int64)
+        vict_ok = np.zeros((C, s_bound), dtype=bool)
+        vict_keys: List[Tuple[str, ...]] = []
+        for ci, vics in enumerate(vict_lists):
+            keys = []
+            for si, vic in enumerate(vics[:s_bound]):
+                keys.append(vic["key"])
+                vict_prio[ci, si] = vic["priority"]
+                vict_ok[ci, si] = True
+                for fname, row in vic["usage"].items():
+                    for res, v in row.items():
+                        vict_free[ci, si, fi[fname], ri[res]] = v
+            vict_keys.append(tuple(keys))
+
+        req = np.zeros((W, R), dtype=np.int64)
+        elig = np.ones((W, F), dtype=bool)
+        prio = np.zeros((W,), dtype=np.int64)
+        cost = np.zeros((C, W), dtype=np.int64)
+        preempt_row = np.full((W,), bool(preemption))
+        base_costs = dispatch_costs or {}
+        for wi, wl in enumerate(cands):
+            for res, v in _candidate_requests(wl).items():
+                req[wi, ri[res]] = v
+            prio[wi] = wl.priority
+            preferred = (wl.annotations or {}).get(AFFINITY_ANNOTATION)
+            for ci, name in enumerate(clusters):
+                cost[ci, wi] = int(base_costs.get(name, 0))
+                if preferred is not None and preferred != name:
+                    cost[ci, wi] += int(affinity_penalty)
+
+        return FleetSpec(
+            clusters=clusters, flavors=flavors, resources=resources,
+            candidates=tuple(w.key for w in cands),
+            vict_keys=tuple(vict_keys),
+            avail=avail, flavor_ok=flavor_ok, vict_free=vict_free,
+            vict_prio=vict_prio, vict_ok=vict_ok, req=req, elig=elig,
+            prio=prio, cost=cost, preempt=preempt_row,
+            spread_weight=int(spread_weight),
+            preempt_penalty=int(preempt_penalty),
+            s_bound=s_bound, skipped=tuple(skipped),
+        )
+
+
+def to_device(spec: FleetSpec, w_bucket: Optional[int] = None
+              ) -> FleetArrays:
+    """Pad the host spec onto the device plane shapes: cluster lanes to
+    the next power of two (padded lanes carry no flavors, so they can
+    never win), candidates to the W bucket ladder, victims already at
+    ``s_bound``."""
+    import jax.numpy as jnp
+
+    C, F, R = spec.avail.shape
+    W = spec.req.shape[0]
+    Cp = buckets.pow2_bucket(max(1, C), floor=2)
+    Wp = w_bucket if w_bucket is not None else buckets.bucket_for(W)
+    Sp = spec.s_bound
+
+    def pad(a: np.ndarray, shape: Tuple[int, ...]) -> np.ndarray:
+        out = np.zeros(shape, dtype=a.dtype)
+        out[tuple(slice(0, n) for n in a.shape)] = a
+        return out
+
+    i32 = np.int32
+    return FleetArrays(
+        avail=jnp.asarray(pad(spec.avail, (Cp, F, R)).astype(i32)),
+        flavor_ok=jnp.asarray(pad(spec.flavor_ok, (Cp, F))),
+        vict_free=jnp.asarray(
+            pad(spec.vict_free, (Cp, Sp, F, R)).astype(i32)
+        ),
+        vict_prio=jnp.asarray(pad(spec.vict_prio, (Cp, Sp)).astype(i32)),
+        vict_ok=jnp.asarray(pad(spec.vict_ok, (Cp, Sp))),
+        req=jnp.asarray(pad(spec.req, (Wp, R)).astype(i32)),
+        elig=jnp.asarray(pad(spec.elig, (Wp, F))),
+        prio=jnp.asarray(pad(spec.prio, (Wp,)).astype(i32)),
+        cost=jnp.asarray(pad(spec.cost, (Cp, Wp)).astype(i32)),
+        valid=jnp.asarray(
+            pad(np.ones((W,), dtype=bool), (Wp,))
+        ),
+        preempt=jnp.asarray(pad(spec.preempt, (Wp,))),
+        spread_w=jnp.int32(spec.spread_weight),
+        pre_penalty=jnp.int32(spec.preempt_penalty),
+    )

@@ -1,0 +1,188 @@
+"""``cycle_fleet_assign``: joint multi-cluster placement in one dispatch.
+
+One jitted program places a whole admission batch across every worker
+cluster at once. The scan walks candidates in admission order (priority
+desc — the same order the sequential MultiKueue dispatcher visits them)
+carrying per-lane state ``(avail [C,F,R], taken [C,S], placed [C])``;
+each step evaluates *every* cluster lane in parallel (vectorized
+feasibility over the C axis — the "vmap over clusters" of the fleet
+design, fused into the scan body) and a cross-cluster argmin over
+dispatch cost + spread + preemption penalties picks the lane.
+
+Determinism contract (what the differential suite pins against the
+sequential host oracle in ``fleet/oracle.py``):
+
+- lane tie-break: lowest lane index among equal costs (``argmin`` picks
+  the first minimum; lanes are sorted by cluster name at encode time);
+- flavor tie-break: first feasible flavor index (``argmax`` of the
+  boolean fits row picks the first ``True``);
+- victim selection: the greedy eligible prefix — victims are sorted
+  (priority asc, key asc) at encode time, and a preempting placement
+  takes every eligible victim up to the first prefix whose cumulative
+  freed capacity fits the request, exactly as a sequential preemptor
+  walking that order would.
+
+All integer planes are int32; costs are int32 so the masked argmin is
+exact (no float ties). Infeasible/padded lanes are masked to ``BIG``
+which no real cost can reach (encode bounds dispatch costs well below
+it).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from kueue_tpu.fleet.encode import FleetArrays
+
+#: Cost mask for infeasible lanes: any real cost is far below this, so
+#: the argmin never picks a masked lane and ``min >= BIG`` means "no
+#: lane can take this candidate".
+BIG = 1 << 30
+
+
+class FleetOutputs(NamedTuple):
+    admitted: object   # [W] bool
+    cluster: object    # [W] i32, -1 when not admitted
+    flavor: object     # [W] i32, -1 when not admitted
+    victims: object    # [W, S] bool, chosen lane's victim axis
+    placed: object     # [C] i32 placements per lane
+    avail: object      # [C, F, R] i32 post-placement capacity
+
+
+def make_fleet_cycle():
+    """Build the jitted joint fleet-assignment cycle.
+
+    kernel-entry: cycle_fleet_assign
+    gate-requires: spec.s_bound <= FLEET_MAX_S
+
+    Returns a function ``(arrays: FleetArrays) -> FleetOutputs`` closed
+    over nothing, so one compiled executable serves every fleet at the
+    same padded ``(C, S, F, R, W)`` shapes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, xs):
+        avail, taken, placed = carry
+        req_w, elig_w, prio_w, cost_w, valid_w, pre_w, \
+            flavor_ok, vict_free, vict_prio, vict_ok, \
+            spread_w, pre_penalty = xs
+
+        C, F, R = avail.shape
+        S = taken.shape[1]
+
+        okf = flavor_ok & elig_w[None, :]                       # [C, F]
+        fits_free = jnp.all(
+            avail >= req_w[None, None, :], axis=-1
+        ) & okf                                                  # [C, F]
+        free_any = jnp.any(fits_free, axis=-1)                   # [C]
+        free_flavor = jnp.argmax(fits_free, axis=-1)             # [C]
+
+        elig_v = vict_ok & ~taken & (vict_prio < prio_w)         # [C, S]
+        freed_cum = jnp.cumsum(
+            vict_free * elig_v[:, :, None, None].astype(jnp.int32),
+            axis=1,
+        )                                                        # [C,S,F,R]
+        fits_pre = jnp.all(
+            avail[:, None, :, :] + freed_cum >= req_w[None, None, None, :],
+            axis=-1,
+        ) & okf[:, None, :]                                      # [C, S, F]
+        pre_any_f = jnp.any(fits_pre, axis=1)                    # [C, F]
+        pre_flavor = jnp.argmax(pre_any_f, axis=-1)              # [C]
+        pre_any = jnp.any(pre_any_f, axis=-1) & pre_w            # [C]
+
+        feasible = free_any | pre_any
+        use_pre = ~free_any & pre_any
+        lane_cost = (
+            cost_w
+            + spread_w * placed
+            + jnp.where(use_pre, pre_penalty, 0)
+        )
+        masked = jnp.where(feasible & valid_w, lane_cost, BIG)
+        c_star = jnp.argmin(masked)                              # first min
+        admitted = masked[c_star] < BIG
+
+        pre_here = use_pre[c_star]
+        flavor = jnp.where(pre_here, pre_flavor[c_star],
+                           free_flavor[c_star])
+
+        # Victim prefix on the chosen lane: first s whose cumulative
+        # freed capacity fits at the chosen flavor; take every eligible
+        # victim up to it.
+        fits_row = fits_pre[c_star, :, flavor]                   # [S]
+        s_first = jnp.argmax(fits_row)
+        sel = (
+            elig_v[c_star]
+            & (jnp.arange(S) <= s_first)
+            & pre_here
+            & admitted
+        )                                                        # [S]
+        # dtype pinned: under x64 jnp.sum promotes i32 -> i64, which
+        # would poison the avail scatter-add below.
+        freed_sel = jnp.sum(
+            vict_free[c_star] * sel[:, None, None].astype(jnp.int32),
+            axis=0, dtype=jnp.int32,
+        )                                                        # [F, R]
+        consume = (
+            jnp.zeros((F, R), dtype=jnp.int32)
+            .at[flavor, :].set(req_w)
+        )
+        delta = jnp.where(admitted, freed_sel - consume,
+                          jnp.zeros((F, R), dtype=jnp.int32))
+        avail = avail.at[c_star].add(delta)
+        taken = taken.at[c_star].set(taken[c_star] | sel)
+        placed = placed.at[c_star].add(admitted.astype(jnp.int32))
+
+        out = (
+            admitted,
+            jnp.where(admitted, c_star.astype(jnp.int32),
+                      jnp.int32(-1)),
+            jnp.where(admitted, flavor.astype(jnp.int32),
+                      jnp.int32(-1)),
+            sel,
+        )
+        return (avail, taken, placed), out
+
+    def cycle(arrays: FleetArrays) -> FleetOutputs:
+        C = arrays.avail.shape[0]
+        S = arrays.vict_ok.shape[1]
+        W = arrays.req.shape[0]
+        carry = (
+            arrays.avail,
+            jnp.zeros((C, S), dtype=bool),
+            jnp.zeros((C,), dtype=jnp.int32),
+        )
+        xs = (
+            arrays.req, arrays.elig, arrays.prio,
+            jnp.swapaxes(arrays.cost, 0, 1),     # [W, C]
+            arrays.valid, arrays.preempt,
+        )
+
+        def body(carry, x):
+            req_w, elig_w, prio_w, cost_w, valid_w, pre_w = x
+            return step(carry, (
+                req_w, elig_w, prio_w, cost_w, valid_w, pre_w,
+                arrays.flavor_ok, arrays.vict_free,
+                arrays.vict_prio, arrays.vict_ok,
+                arrays.spread_w, arrays.pre_penalty,
+            ))
+
+        (avail, _taken, placed), (admitted, cluster, flavor, victims) = \
+            jax.lax.scan(body, carry, xs, length=W)
+        return FleetOutputs(
+            admitted=admitted, cluster=cluster, flavor=flavor,
+            victims=victims, placed=placed, avail=avail,
+        )
+
+    return jax.jit(cycle)
+
+
+_CYCLE = None
+
+
+def fleet_cycle():
+    """Memoized jitted cycle (one program per process)."""
+    global _CYCLE
+    if _CYCLE is None:
+        _CYCLE = make_fleet_cycle()
+    return _CYCLE

@@ -1,0 +1,174 @@
+"""Sequential host oracle for joint fleet placement.
+
+This is the *specification* of ``cycle_fleet_assign``: a plain-python
+walk over candidates in admission order, evaluating each cluster lane
+the way the sequential per-cluster MultiKueue dispatcher would (can the
+lane fit the request on free capacity? failing that, can a prefix of
+its lower-priority victims free enough?), then picking the cheapest
+lane under the same dispatch-cost + spread + preemption penalty model
+and the same tie-breaks (lowest lane index, first feasible flavor,
+greedy eligible victim prefix).
+
+The differential suite pins the device kernel bit-identical-in-outcome
+to this function; the dispatcher also uses it directly as the contained
+host fallback when the device path faults — so a fleet under fault
+injection still produces *correct* placements, just slower.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from kueue_tpu.fleet.encode import FleetSpec
+
+
+class FleetPlan(NamedTuple):
+    """Joint placement result on host (unpadded, numpy)."""
+
+    admitted: np.ndarray   # [W] bool
+    cluster: np.ndarray    # [W] int32, -1 when not admitted
+    flavor: np.ndarray     # [W] int32, -1 when not admitted
+    victims: np.ndarray    # [W, S] bool (chosen lane's victim axis)
+    placed: np.ndarray     # [C] int32
+    avail: np.ndarray      # [C, F, R] int64 post-placement
+
+
+def fleet_oracle(spec: FleetSpec) -> FleetPlan:
+    C, F, R = spec.avail.shape
+    W = spec.req.shape[0]
+    S = spec.vict_ok.shape[1]
+
+    avail = spec.avail.astype(np.int64).copy()
+    taken = np.zeros((C, S), dtype=bool)
+    placed = np.zeros((C,), dtype=np.int64)
+
+    admitted = np.zeros((W,), dtype=bool)
+    cluster = np.full((W,), -1, dtype=np.int32)
+    flavor_out = np.full((W,), -1, dtype=np.int32)
+    victims = np.zeros((W, S), dtype=bool)
+
+    for w in range(W):
+        req = spec.req[w]
+        best_cost = None
+        best = None  # (c, flavor, sel_row, use_pre)
+        for c in range(C):
+            okf = spec.flavor_ok[c] & spec.elig[w]
+            # Free-capacity path: first flavor that fits outright.
+            free_flavor = -1
+            for f in range(F):
+                if okf[f] and np.all(avail[c, f] >= req):
+                    free_flavor = f
+                    break
+            use_pre = False
+            sel_row = np.zeros((S,), dtype=bool)
+            flavor = free_flavor
+            if free_flavor < 0:
+                if not spec.preempt[w]:
+                    continue
+                # Preemption path: greedy eligible victim prefix, first
+                # flavor whose cumulative freed capacity ever fits.
+                elig_v = (
+                    spec.vict_ok[c] & ~taken[c]
+                    & (spec.vict_prio[c] < spec.prio[w])
+                )
+                freed = np.zeros((F, R), dtype=np.int64)
+                pre_flavor = -1
+                s_first = -1
+                fits_at = np.full((F,), -1, dtype=np.int64)
+                cum = np.zeros((S, F, R), dtype=np.int64)
+                run = np.zeros((F, R), dtype=np.int64)
+                for s in range(S):
+                    if elig_v[s]:
+                        run = run + spec.vict_free[c, s]
+                    cum[s] = run
+                    for f in range(F):
+                        if fits_at[f] < 0 and okf[f] \
+                                and np.all(avail[c, f] + run[f] >= req):
+                            fits_at[f] = s
+                for f in range(F):
+                    if fits_at[f] >= 0:
+                        pre_flavor = f
+                        break
+                if pre_flavor < 0:
+                    continue
+                flavor = pre_flavor
+                s_first = int(fits_at[pre_flavor])
+                sel_row = elig_v & (np.arange(S) <= s_first)
+                freed = cum[s_first]
+                use_pre = True
+            cost = int(spec.cost[c, w]) + spec.spread_weight * int(placed[c])
+            if use_pre:
+                cost += spec.preempt_penalty
+            if best_cost is None or cost < best_cost:
+                best_cost = cost
+                best = (c, flavor, sel_row, use_pre)
+        if best is None:
+            continue
+        c, flavor, sel_row, use_pre = best
+        admitted[w] = True
+        cluster[w] = c
+        flavor_out[w] = flavor
+        victims[w] = sel_row
+        if use_pre:
+            for s in np.nonzero(sel_row)[0]:
+                avail[c] += spec.vict_free[c, s]
+            taken[c] |= sel_row
+        avail[c, flavor] -= req
+        placed[c] += 1
+
+    return FleetPlan(
+        admitted=admitted, cluster=cluster, flavor=flavor_out,
+        victims=victims, placed=placed.astype(np.int32), avail=avail,
+    )
+
+
+def validate_plan(spec: FleetSpec, plan: FleetPlan) -> List[str]:
+    """Bounds/consistency checks on a (possibly device-produced) plan.
+    Returns problems; empty means the plan is safe to apply."""
+    errs: List[str] = []
+    C, F, _R = spec.avail.shape
+    W = spec.req.shape[0]
+    S = spec.vict_ok.shape[1]
+    if plan.admitted.shape != (W,) or plan.cluster.shape != (W,):
+        return ["plan shape mismatch"]
+    if plan.victims.shape != (W, S):
+        return ["victim plane shape mismatch"]
+    for w in range(W):
+        if not plan.admitted[w]:
+            if plan.cluster[w] != -1 or plan.victims[w].any():
+                errs.append(f"w={w}: placement data on unadmitted row")
+            continue
+        c = int(plan.cluster[w])
+        f = int(plan.flavor[w])
+        if not (0 <= c < C):
+            errs.append(f"w={w}: cluster index {c} out of range")
+            continue
+        if not (0 <= f < F) or not spec.flavor_ok[c, f]:
+            errs.append(f"w={w}: flavor {f} not offered by lane {c}")
+        bad = plan.victims[w] & ~spec.vict_ok[c]
+        if bad.any():
+            errs.append(f"w={w}: selects padded/absent victims on lane {c}")
+        if plan.victims[w].any() and not spec.preempt[w]:
+            errs.append(f"w={w}: victims selected with preemption off")
+    if plan.avail is not None and np.asarray(plan.avail).min() < 0:
+        errs.append("negative post-placement capacity")
+    return errs
+
+
+def plans_equal(a: FleetPlan, b: FleetPlan) -> List[str]:
+    """Differential comparison; returns mismatch descriptions."""
+    errs: List[str] = []
+    if not np.array_equal(a.admitted, b.admitted):
+        errs.append(
+            f"admitted differs: {np.nonzero(a.admitted != b.admitted)[0]}"
+        )
+    mask = a.admitted & b.admitted
+    if not np.array_equal(a.cluster[mask], b.cluster[mask]):
+        errs.append("cluster choice differs on jointly admitted rows")
+    if not np.array_equal(a.flavor[mask], b.flavor[mask]):
+        errs.append("flavor choice differs on jointly admitted rows")
+    if not np.array_equal(a.victims[mask], b.victims[mask]):
+        errs.append("victim sets differ on jointly admitted rows")
+    return errs

@@ -215,11 +215,20 @@ class Manager:
 
         compile_cache.configure()
         prewarm_fn = getattr(self.scheduler, "prewarm", None)
-        if prewarm_fn is None:
-            return {}
-        return prewarm_fn(
-            max_heads=max_heads, background=background, aot=aot
-        )
+        out = {}
+        if prewarm_fn is not None:
+            out = prewarm_fn(
+                max_heads=max_heads, background=background, aot=aot
+            ) or {}
+        # Fleet rung: any check controller carrying a FleetDispatcher
+        # (MultiKueue joint placement) compiles its cycle_fleet_assign
+        # ladder here too, so the first joint dispatch is warm.
+        for ctrl in self.check_controllers.values():
+            fleet = getattr(ctrl, "fleet", None)
+            if fleet is not None and hasattr(fleet, "prewarm"):
+                out = dict(out)
+                out["fleet"] = fleet.prewarm(max_heads=max_heads, aot=aot)
+        return out
 
     # ------------------------------------------------------------------
     # configuration objects

@@ -153,9 +153,24 @@ SERVICE_SERIES = frozenset({
     "service_submit_to_admit_seconds",
 })
 
+# Joint multi-cluster placement (fleet/ + controllers/multikueue.py):
+# the batched fleet dispatch, per-lane applies, and the remote status
+# mirror's breaker-tolerant retry path.
+FLEET_SERIES = frozenset({
+    "fleet_dispatches_total",
+    "fleet_dispatch_seconds",
+    "fleet_candidates",
+    "fleet_lanes",
+    "fleet_placements_total",
+    "fleet_preemptions_total",
+    "fleet_apply_failures_total",
+    "fleet_lane_unavailable_total",
+    "multikueue_remote_sync_retries_total",
+})
+
 METRIC_NAMES = (
     REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES | COST_SERIES
-    | SERVICE_SERIES
+    | SERVICE_SERIES | FLEET_SERIES
 )
 
 # HELP text for the Prometheus exposition (registry.Metrics.expose).
@@ -221,6 +236,23 @@ HELP_TEXT = {
         "Submit to first scheduler nomination per workload",
     "service_submit_to_admit_seconds":
         "Submit to admission per workload (the admission wait span)",
+    "fleet_dispatches_total":
+        "Joint fleet placement solves, by path (device/host)",
+    "fleet_dispatch_seconds":
+        "Wall time of one joint fleet solve (encode+solve, pre-apply)",
+    "fleet_candidates": "Pending candidates in the last joint solve",
+    "fleet_lanes": "Reachable cluster lanes in the last joint solve",
+    "fleet_placements_total":
+        "Workloads placed by the fleet dispatcher, by cluster",
+    "fleet_preemptions_total":
+        "Remote victims preempted by fleet placements, by cluster",
+    "fleet_apply_failures_total":
+        "Cluster-lane applies that failed and left placements PENDING",
+    "fleet_lane_unavailable_total":
+        "Unreachable worker lanes skipped by the fleet encoder",
+    "multikueue_remote_sync_retries_total":
+        "Remote status mirrors deferred behind backoff because the "
+        "worker transport was unreachable",
 }
 
 _HELP_FALLBACK = "kueue_tpu series; see docs/observability.md"

@@ -66,6 +66,10 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "speedup_x": "higher",
         "warm_first_admission_s": "lower",
     },
+    "fleet": {
+        "fleet_joint_speedup": "higher",
+        "fleet_dispatch_p99_ms": "lower",
+    },
     "scanfloor": {
         "fp_speedup": "higher",
         "rounds_max": "lower",

@@ -102,6 +102,75 @@ def run(
     }
 
 
+def run_joint(
+    n_workloads: int = 2000,
+    n_workers: int = 8,
+    device: bool = True,
+    prewarm: bool = True,
+) -> Dict:
+    """Same fleet shape, admitted through the joint FleetDispatcher:
+    one batched solve places the whole pending set, one mirror +
+    ``schedule_all`` per cluster lane applies it."""
+    from kueue_tpu.fleet import FleetDispatcher
+
+    mgr = _cluster(cpu_quota_m=n_workloads * 1000)
+    mgr.cache.cluster_queues["cq"].admission_checks = ["mk"]
+    mgr.apply(AdmissionCheck(
+        name="mk", controller_name="kueue.x-k8s.io/multikueue",
+    ))
+    mk = MultiKueueController(fleet=FleetDispatcher(device=device))
+    per_worker = (n_workloads * 1000) // n_workers + 1000
+    for i in range(n_workers):
+        mk.add_worker(f"worker-{i}", _cluster(per_worker))
+    mgr.register_check_controller(mk)
+    if prewarm and device:
+        mgr.prewarm(max_heads=n_workloads, aot=False)
+
+    jobs: List[BatchJob] = []
+    for i in range(n_workloads):
+        job = BatchJob(f"job-{i}", queue="lq", requests={"cpu": 1000})
+        mgr.submit_job(job)
+        jobs.append(job)
+
+    t0 = time.monotonic()
+    rounds = 0
+    while rounds < 200:
+        mgr.schedule_all()
+        dispatched = sum(
+            1 for wl in mgr.workloads.values()
+            if wl.status.cluster_name is not None
+        )
+        if dispatched >= n_workloads:
+            break
+        rounds += 1
+    wall = time.monotonic() - t0
+
+    placed: Dict[str, int] = {}
+    for wl in mgr.workloads.values():
+        if wl.status.cluster_name:
+            placed[wl.status.cluster_name] = (
+                placed.get(wl.status.cluster_name, 0) + 1
+            )
+    admitted = sum(1 for wl in mgr.workloads.values() if is_admitted(wl))
+    p99 = mgr.metrics.histogram_quantile("fleet_dispatch_seconds", 0.99)
+    return {
+        "n": n_workloads,
+        "workers": n_workers,
+        "dispatched": sum(placed.values()),
+        "admitted": admitted,
+        "wall_s": wall,
+        "throughput": sum(placed.values()) / wall if wall else 0.0,
+        "placement": placed,
+        "dispatch_p99_ms": (p99 or 0.0) * 1000.0,
+        "device_solves": mgr.metrics.get(
+            "fleet_dispatches_total", {"path": "device"}
+        ),
+        "host_solves": mgr.metrics.get(
+            "fleet_dispatches_total", {"path": "host"}
+        ),
+    }
+
+
 if __name__ == "__main__":
     import json
     import sys

@@ -210,5 +210,14 @@ class RemoteWorkerClient:
     def schedule(self) -> None:
         self._call({"op": "schedule"})
 
+    def schedule_all(self) -> None:
+        self._call({"op": "schedule_all"})
+
+    def capacity(self) -> dict:
+        """Flat capacity doc for the fleet encoder (one RPC per lane
+        per joint solve, vs one schedule round-trip per workload on the
+        sequential path)."""
+        return self._call({"op": "capacity"}).get("capacity") or {}
+
     def finish_workload(self, wl: Workload) -> None:
         self._call({"op": "finish_workload", "key": wl.key})

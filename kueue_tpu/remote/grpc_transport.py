@@ -284,6 +284,13 @@ class GrpcWorkerClient:
     def schedule(self) -> None:
         self._call({"op": "schedule"})
 
+    def schedule_all(self) -> None:
+        self._call({"op": "schedule_all"})
+
+    def capacity(self) -> dict:
+        """Flat capacity doc for the fleet encoder's lane planes."""
+        return self._call({"op": "capacity"}).get("capacity") or {}
+
     def finish_workload(self, wl: Workload) -> None:
         self._call({"op": "finish_workload", "key": wl.key})
 

@@ -100,6 +100,16 @@ def _dispatch_impl(mgr: Manager, req: dict) -> dict:
         result = mgr.schedule_all()
         mgr.tick()
         return {"ok": True, "cycles": result}
+    if op == "schedule_all":
+        # One drive of the whole worker queue: the fleet applier's
+        # per-lane batch replaces per-workload schedule round-trips.
+        result = mgr.schedule_all()
+        return {"ok": True, "cycles": result}
+    if op == "capacity":
+        # Flat capacity doc for the fleet encoder's lane planes.
+        from kueue_tpu.fleet.encode import local_capacity
+
+        return {"ok": True, "capacity": local_capacity(mgr)}
     if op == "finish_workload":
         wl = mgr.workloads.get(req["key"])
         if wl is not None:

@@ -35,6 +35,13 @@ anything else so a typo'd point never silently no-ops):
   (obs/service.py; a ``delay`` rule stalls the loop so ``/healthz``
   staleness detection can be drilled, a ``raise`` rule is contained by
   the loop and counted in ``service_loop_errors_total``)
+- ``fleet.dispatch``    — the joint multi-cluster placement dispatch
+  (fleet/dispatcher.py; a ``raise`` rule is contained by the host
+  oracle fallback, counted ``solver_fallback_cycles_total{reason="fleet"}``)
+- ``fleet.apply``       — one cluster lane's placement apply (delete
+  victims / mirror / schedule_all on the worker; a failing lane leaves
+  its placements PENDING — counted ``fleet_apply_failures_total`` — and
+  never corrupts manager state or other lanes)
 - ``pipeline.patch``    — the CycleArena speculative-encode patch step
   (models/arena.py; consuming a pipelined speculation buffer into the
   next cycle's W build. A ``raise`` rule aborts the speculation —
@@ -94,6 +101,8 @@ WHATIF_DISPATCH = "whatif.dispatch"
 COMPILE_DESERIALIZE = "compile.deserialize"
 SERVICE_CYCLE = "service.cycle"
 PIPELINE_PATCH = "pipeline.patch"
+FLEET_DISPATCH = "fleet.dispatch"
+FLEET_APPLY = "fleet.apply"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -106,6 +115,8 @@ POINTS = frozenset({
     COMPILE_DESERIALIZE,
     SERVICE_CYCLE,
     PIPELINE_PATCH,
+    FLEET_DISPATCH,
+    FLEET_APPLY,
 })
 
 _MODES = ("raise", "delay", "corrupt")

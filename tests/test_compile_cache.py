@@ -278,3 +278,69 @@ def test_fair_fixedpoint_prewarm_covers_live_cycle():
     finally:
         compile_cache.dispatch = orig
     assert set(dispatched) == {"cycle_fair_fixedpoint"}, dispatched
+
+
+def test_fleet_prewarm_zero_compiles_after():
+    """Manager.prewarm warms the fleet rung (cycle_fleet_assign at the
+    real cluster/victim extents, W ladder): live joint dispatches after
+    a prewarm add ZERO backend compiles — the shape-stability pin that
+    keeps the fleet path off the compile hot path (encode pins S=1 with
+    preemption off precisely so this holds as workloads place)."""
+    from kueue_tpu.api.types import AdmissionCheck, LocalQueue, ResourceFlavor
+    from kueue_tpu.controllers.jobs import BatchJob
+    from kueue_tpu.controllers.multikueue import MultiKueueController
+    from kueue_tpu.fleet import FleetDispatcher
+    from kueue_tpu.manager import Manager
+
+    compile_cache.install_listeners()
+
+    def cluster(cpu_m):
+        m = Manager()
+        m.apply(
+            ResourceFlavor(name="default"),
+            make_cq("cq", flavors={
+                "default": {"cpu": ResourceQuota(nominal=cpu_m)},
+            }),
+            LocalQueue(name="lq", cluster_queue="cq"),
+        )
+        return m
+
+    mgr = cluster(100_000)
+    mgr.cache.cluster_queues["cq"].admission_checks = ["mk"]
+    mgr.apply(AdmissionCheck(
+        name="mk", controller_name="kueue.x-k8s.io/multikueue",
+    ))
+    mk = MultiKueueController(fleet=FleetDispatcher(device=True))
+    for i in range(3):
+        mk.add_worker(f"cluster-{i}", cluster(8_000))
+    mgr.register_check_controller(mk)
+
+    out = mgr.prewarm(max_heads=16, aot=False)
+    assert out["fleet"]["entries"] == 1
+    assert out["fleet"]["clusters"] == 3
+    assert out["fleet"]["s_bound"] == 1
+    compile_cache.reset_stats()
+
+    # Two waves at different real W (both <= the warmed 16-bucket), with
+    # capacity values shifting between them: zero new executables.
+    wave1 = [
+        mgr.submit_job(BatchJob(f"a{i}", queue="lq",
+                                requests={"cpu": 1000}))
+        for i in range(6)
+    ]
+    mgr.schedule_all()
+    mgr.tick()
+    assert all(w.status.cluster_name for w in wave1)
+    wave2 = [
+        mgr.submit_job(BatchJob(f"b{i}", queue="lq",
+                                requests={"cpu": 1000}))
+        for i in range(3)
+    ]
+    mgr.schedule_all()
+    mgr.tick()
+    assert all(w.status.cluster_name for w in wave2)
+    assert mgr.metrics.get(
+        "fleet_dispatches_total", {"path": "device"}
+    ) >= 2
+    assert mgr.metrics.get("fleet_dispatches_total", {"path": "host"}) == 0
+    assert _compiles() == 0, compile_cache.stats()

@@ -948,3 +948,179 @@ def test_kubeflow_jobs_schedule_end_to_end():
     assert is_admitted(wl)
     assert [psa.name for psa in
             wl.status.admission.pod_set_assignments] == ["master", "worker"]
+
+
+def test_multikueue_remote_sync_unreachable_backoff():
+    """An unreachable winner transport (breaker open -> ConnectionError)
+    requeues the remote-status mirror with exponential backoff instead
+    of hammering the dead transport every tick, counted under
+    multikueue_remote_sync_retries_total."""
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    worker = worker_manager()
+    mk = MultiKueueController(
+        worker_lost_timeout_seconds=1000.0,
+        remote_sync_backoff_seconds=10.0,
+        remote_sync_backoff_max_seconds=30.0,
+    )
+    mk.add_worker("w1", worker)
+    mgr.register_check_controller(mk)
+    wl = mgr.submit_job(BatchJob("j", queue="lq", requests={"cpu": 1000}))
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "w1"
+
+    class DeadWorkloads:
+        def get(self, key):
+            raise ConnectionError("breaker open")
+
+    class DeadWorker:
+        workloads = DeadWorkloads()
+
+    mk.workers["w1"] = DeadWorker()
+
+    def retries():
+        return mgr.metrics.get(
+            "multikueue_remote_sync_retries_total", {"cluster": "w1"}
+        )
+
+    mk.sync_remote_status(mgr, wl)
+    st = mk.state[wl.key]
+    assert retries() == 1
+    assert st.sync_backoff_s == 10.0 and st.next_sync_at == 10.0
+    # Inside the backoff window: gated, no transport attempt.
+    clock.advance(5.0)
+    mk.sync_remote_status(mgr, wl)
+    assert retries() == 1
+    # Past it: one retry, backoff doubles (capped at max).
+    clock.advance(6.0)
+    mk.sync_remote_status(mgr, wl)
+    assert retries() == 2 and st.sync_backoff_s == 20.0
+    clock.advance(21.0)
+    mk.sync_remote_status(mgr, wl)
+    assert retries() == 3 and st.sync_backoff_s == 30.0
+    clock.advance(31.0)
+    mk.sync_remote_status(mgr, wl)
+    assert retries() == 4 and st.sync_backoff_s == 30.0  # capped
+    assert wl.status.cluster_name == "w1"  # still within lost-grace
+
+    # Transport recovers: backoff state resets and mirroring resumes.
+    clock.advance(31.0)
+    mk.workers["w1"] = worker
+    mk.sync_remote_status(mgr, wl)
+    assert st.sync_backoff_s == 0.0 and st.next_sync_at == 0.0
+    assert st.winner_lost_since is None
+    assert retries() == 4
+
+
+def test_multikueue_remote_sync_backoff_still_honors_worker_lost():
+    """The workerLostTimeout clock keeps running underneath the backoff
+    gate: a redispatch fires even while the mirror is backing off."""
+    clock = FakeClock()
+    mgr = Manager(clock=clock)
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq-a", flavors={"default": {"cpu": quota(8_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    worker = worker_manager()
+    mk = MultiKueueController(
+        worker_lost_timeout_seconds=100.0,
+        remote_sync_backoff_seconds=500.0,   # gate far past the timeout
+        remote_sync_backoff_max_seconds=500.0,
+    )
+    mk.add_worker("w1", worker)
+    mgr.register_check_controller(mk)
+    wl = mgr.submit_job(BatchJob("j", queue="lq", requests={"cpu": 1000}))
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "w1"
+
+    class DeadWorkloads:
+        def get(self, key):
+            raise ConnectionError("breaker open")
+
+    class DeadWorker:
+        workloads = DeadWorkloads()
+
+    mk.workers["w1"] = DeadWorker()
+    mk.sync_remote_status(mgr, wl)  # t=0: retry 1, next_sync_at=500
+    st = mk.state[wl.key]
+    assert st.winner_lost_since == 0.0
+    clock.advance(150.0)  # gated (150 < 500) but past the lost timeout
+    mk.sync_remote_status(mgr, wl)
+    assert wl.status.cluster_name is None  # redispatched
+    assert st.sync_backoff_s == 0.0 and st.next_sync_at == 0.0
+    assert mgr.metrics.get(
+        "multikueue_remote_sync_retries_total", {"cluster": "w1"}
+    ) == 1
+
+
+def test_mirror_topology_tas_annotated_remote():
+    """_mirror_topology unit semantics: delayed TAS pod sets receive
+    the remote's topology assignment; resolved or non-delayed pod sets
+    and names absent on the remote are left alone."""
+    from kueue_tpu.api.types import (
+        Admission,
+        PodSet,
+        PodSetAssignment,
+        TopologyAssignment,
+        Workload,
+    )
+
+    def psa(name, delayed=True, ta=None):
+        return PodSetAssignment(
+            name=name, flavors={"tpu": "tpu-v5e"},
+            resource_usage={"tpu": 8}, count=2,
+            delayed_topology_request=delayed, topology_assignment=ta,
+        )
+
+    ta_remote = TopologyAssignment(
+        levels=["block", "rack"],
+        domains=[(("b1", "r1"), 1), (("b1", "r2"), 1)],
+    )
+    ta_local = TopologyAssignment(levels=["rack"], domains=[(("r9",), 2)])
+
+    wl = Workload(name="gang", pod_sets=[PodSet(name="main", count=2)])
+    wl.status.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[
+            psa("delayed"),
+            psa("resolved", ta=ta_local),
+            psa("plain", delayed=False),
+            psa("missing-on-remote"),
+        ],
+    )
+    remote = Workload(name="gang", pod_sets=[PodSet(name="main", count=2)])
+    remote.status.admission = Admission(
+        cluster_queue="cq",
+        pod_set_assignments=[
+            psa("delayed", ta=ta_remote),
+            psa("resolved", ta=ta_remote),
+            psa("plain", delayed=False, ta=ta_remote),
+        ],
+    )
+
+    MultiKueueController._mirror_topology(wl, remote)
+    by_name = {p.name: p for p in wl.status.admission.pod_set_assignments}
+    assert by_name["delayed"].topology_assignment is ta_remote
+    assert by_name["resolved"].topology_assignment is ta_local  # untouched
+    assert by_name["plain"].topology_assignment is None
+    assert by_name["missing-on-remote"].topology_assignment is None
+
+    # Remote without admission (or no remote at all): no-op, no crash.
+    bare = Workload(name="gang", pod_sets=[PodSet(name="main", count=2)])
+    MultiKueueController._mirror_topology(wl, bare)
+    MultiKueueController._mirror_topology(wl, None)
+    assert by_name["missing-on-remote"].topology_assignment is None

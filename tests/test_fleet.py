@@ -1,0 +1,453 @@
+"""Fleet joint placement (kueue_tpu/fleet/): encoder, host oracle,
+dispatcher and controller integration — host path only (device=False),
+so nothing here compiles. The device kernel vs host oracle differential
+and the compile-heavy e2e/fault scenarios live in
+tests/test_fleet_differential.py (isolated).
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceQuota,
+    quota,
+)
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.controllers.multikueue import MultiKueueController
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.fleet import (
+    AFFINITY_ANNOTATION,
+    FleetDispatcher,
+    FleetEncoder,
+    FleetSpec,
+    FleetUnsupported,
+    fleet_oracle,
+    local_capacity,
+    validate_plan,
+)
+from kueue_tpu.manager import Manager
+
+from .helpers import make_cq
+
+
+def worker_manager(cpu_m: int = 4_000) -> Manager:
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq", flavors={"default": {"cpu": quota(cpu_m)}}),
+        LocalQueue(name="lq", cluster_queue="cq"),
+    )
+    return mgr
+
+
+def fleet_env(n_workers: int = 3, fleet: bool = True, device: bool = False,
+              worker_cpu_m: int = 4_000, **fleet_kw):
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq", flavors={"default": {"cpu": quota(100_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    disp = FleetDispatcher(device=device, **fleet_kw) if fleet else None
+    mk = MultiKueueController(fleet=disp)
+    workers = {}
+    for i in range(n_workers):
+        w = worker_manager(worker_cpu_m)
+        workers[f"cluster-{i}"] = w
+        mk.add_worker(f"cluster-{i}", w)
+    mgr.register_check_controller(mk)
+    return mgr, mk, workers
+
+
+def submit_jobs(mgr, n, cpu_m=1000, prefix="job"):
+    return [
+        mgr.submit_job(BatchJob(f"{prefix}-{i}", queue="lq",
+                                requests={"cpu": cpu_m}))
+        for i in range(n)
+    ]
+
+
+# -- capacity docs / encoder ------------------------------------------------
+
+
+def test_local_capacity_doc_shape_and_running():
+    w = worker_manager(4_000)
+    wl = w.submit_job(BatchJob("r", queue="lq", requests={"cpu": 1500}))
+    w.schedule_all()
+    doc = local_capacity(w)
+    assert doc["cq_count"] == 1
+    assert not doc["has_cohort"] and not doc["has_lend"]
+    # Availability reflects the running workload's usage.
+    assert doc["flavors"]["default"]["cpu"] == 2500
+    assert [r["key"] for r in doc["running"]] == [wl.key]
+    assert doc["running"][0]["usage"] == {"default": {"cpu": 1500}}
+    import json
+
+    json.dumps(doc)  # the remote `capacity` op payload must serialize
+
+
+def test_encoder_rejects_unsupported_shapes():
+    enc = FleetEncoder()
+    # Two ClusterQueues in one lane.
+    w = worker_manager()
+    w.apply(make_cq("cq2", flavors={"default": {"cpu": quota(1_000)}}))
+    with pytest.raises(FleetUnsupported, match="cq_count=2"):
+        enc.encode({"a": w}, [])
+    # Cohort + lending limits (a lending limit requires a cohort).
+    from kueue_tpu.api.types import Cohort
+
+    w2 = worker_manager()
+    w3 = Manager()
+    w3.apply(
+        Cohort(name="co"),
+        ResourceFlavor(name="default"),
+        make_cq("cq", cohort="co", flavors={"default": {
+            "cpu": ResourceQuota(nominal=1_000, lending_limit=500),
+        }}),
+        LocalQueue(name="lq", cluster_queue="cq"),
+    )
+    with pytest.raises(FleetUnsupported, match="lend=True"):
+        enc.encode({"a": w2, "b": w3}, [])
+
+
+def test_encoder_lane_reuse_keyed_by_generations():
+    enc = FleetEncoder()
+    w = worker_manager()
+    enc.encode({"a": w}, [])
+    assert (enc.lane_rebuilds, enc.lane_reuses) == (1, 0)
+    enc.encode({"a": w}, [])
+    assert (enc.lane_rebuilds, enc.lane_reuses) == (1, 1)
+    # Any admission-relevant worker-state change invalidates the lane.
+    w.submit_job(BatchJob("x", queue="lq", requests={"cpu": 100}))
+    w.schedule_all()
+    enc.encode({"a": w}, [])
+    assert enc.lane_rebuilds == 2
+
+
+def test_encoder_unreachable_lane_skipped():
+    class Dead:
+        def capacity(self):
+            raise ConnectionError("breaker open")
+
+    enc = FleetEncoder()
+    spec = enc.encode({"up": worker_manager(), "down": Dead()}, [])
+    assert spec.clusters == ("up",)
+    assert spec.skipped == ("down",)
+
+
+def test_encoder_candidate_order_and_affinity_cost():
+    enc = FleetEncoder()
+    workers = {"a": worker_manager(), "b": worker_manager()}
+    mgr, _, _ = fleet_env(n_workers=0, fleet=False)
+    lo = mgr.submit_job(BatchJob("lo", queue="lq", requests={"cpu": 100}))
+    hi = mgr.submit_job(BatchJob("hi", queue="lq", requests={"cpu": 100},
+                                 priority=5))
+    hi.annotations[AFFINITY_ANNOTATION] = "b"
+    spec = enc.encode(workers, [lo, hi], affinity_penalty=8,
+                      dispatch_costs={"a": 3})
+    # priority desc first.
+    assert spec.candidates == (hi.key, lo.key)
+    ai, bi = spec.clusters.index("a"), spec.clusters.index("b")
+    # hi prefers b: every other lane pays the affinity penalty on top of
+    # its base dispatch cost.
+    assert spec.cost[ai, 0] == 3 + 8 and spec.cost[bi, 0] == 0
+    assert spec.cost[ai, 1] == 3 and spec.cost[bi, 1] == 0
+
+
+def test_encoder_pins_victim_axis_without_preemption():
+    w = worker_manager()
+    for i in range(6):
+        w.submit_job(BatchJob(f"r{i}", queue="lq", requests={"cpu": 500}))
+    w.schedule_all()
+    enc = FleetEncoder()
+    spec = enc.encode({"a": w}, [], preemption=False)
+    assert spec.s_bound == 1 and not spec.vict_ok.any()
+    spec_p = enc.encode({"a": w}, [], preemption=True)
+    assert spec_p.s_bound == 8 and int(spec_p.vict_ok.sum()) == 6
+
+
+# -- host oracle ------------------------------------------------------------
+
+
+def _spec(avail, req, *, cost=None, prio=None, spread=1, preempt=False,
+          vict=None):
+    """Tiny single-flavor single-resource spec builder."""
+    C, W = len(avail), len(req)
+    S = len(vict[0]) if vict else 1
+    vict_free = np.zeros((C, S, 1, 1), dtype=np.int64)
+    vict_prio = np.zeros((C, S), dtype=np.int64)
+    vict_ok = np.zeros((C, S), dtype=bool)
+    if vict:
+        for ci, rows in enumerate(vict):
+            for si, (free, vprio) in enumerate(rows):
+                vict_free[ci, si, 0, 0] = free
+                vict_prio[ci, si] = vprio
+                vict_ok[ci, si] = True
+    return FleetSpec(
+        clusters=tuple(f"c{i}" for i in range(C)),
+        flavors=("default",), resources=("cpu",),
+        candidates=tuple(f"ns/w{i}" for i in range(W)),
+        vict_keys=tuple(
+            tuple(f"ns/v{c}-{s}" for s in range(S)) for c in range(C)
+        ),
+        avail=np.asarray(avail, dtype=np.int64).reshape(C, 1, 1),
+        flavor_ok=np.ones((C, 1), dtype=bool),
+        vict_free=vict_free, vict_prio=vict_prio, vict_ok=vict_ok,
+        req=np.asarray(req, dtype=np.int64).reshape(W, 1),
+        elig=np.ones((W, 1), dtype=bool),
+        prio=np.asarray(prio if prio is not None else [0] * W,
+                        dtype=np.int64),
+        cost=np.asarray(cost if cost is not None else
+                        np.zeros((C, W)), dtype=np.int64),
+        preempt=np.full((W,), bool(preempt)),
+        spread_weight=spread, preempt_penalty=64,
+        s_bound=S, skipped=(),
+    )
+
+
+def test_oracle_spreads_across_equal_lanes():
+    spec = _spec(avail=[4, 4], req=[1, 1, 1, 1])
+    plan = fleet_oracle(spec)
+    assert plan.admitted.all()
+    assert sorted(plan.placed.tolist()) == [2, 2]
+    assert validate_plan(spec, plan) == []
+
+
+def test_oracle_prefers_cheap_lane_then_ties_lowest_index():
+    spec = _spec(avail=[4, 4], req=[1], cost=[[5], [1]], spread=0)
+    assert fleet_oracle(spec).cluster[0] == 1
+    tie = _spec(avail=[4, 4], req=[1], spread=0)
+    assert fleet_oracle(tie).cluster[0] == 0
+
+
+def test_oracle_preempts_only_when_free_cannot_fit():
+    # Lane 0 full but holds a low-priority victim freeing 2; lane 1 has
+    # free room. Free placement wins without the penalty.
+    spec = _spec(avail=[0, 2], req=[2], prio=[5], preempt=True,
+                 vict=[[(2, 1)], [(0, 0)]])
+    plan = fleet_oracle(spec)
+    assert plan.admitted[0] and plan.cluster[0] == 1
+    assert not plan.victims.any()
+    # With lane 1 also full, preemption on lane 0 is the only option.
+    spec2 = _spec(avail=[0, 0], req=[2], prio=[5], preempt=True,
+                  vict=[[(2, 1)], [(0, 0)]])
+    plan2 = fleet_oracle(spec2)
+    assert plan2.admitted[0] and plan2.cluster[0] == 0
+    assert plan2.victims[0, 0]
+    # Equal-priority victims are never eligible.
+    spec3 = _spec(avail=[0], req=[2], prio=[1], preempt=True,
+                  vict=[[(2, 1)]])
+    assert not fleet_oracle(spec3).admitted[0]
+
+
+def test_oracle_infeasible_candidate_skipped_not_blocking():
+    spec = _spec(avail=[2], req=[5, 1])
+    plan = fleet_oracle(spec)
+    assert plan.admitted.tolist() == [False, True]
+    assert plan.cluster.tolist() == [-1, 0]
+
+
+def test_validate_plan_catches_corruption():
+    spec = _spec(avail=[2], req=[1])
+    plan = fleet_oracle(spec)
+    bad = plan._replace(cluster=np.asarray([5], dtype=np.int32))
+    assert validate_plan(spec, bad)
+    bad2 = plan._replace(victims=np.ones_like(plan.victims))
+    assert validate_plan(spec, bad2)
+
+
+# -- dispatcher + controller (host solve path) ------------------------------
+
+
+def test_fleet_host_path_places_and_spreads():
+    mgr, mk, workers = fleet_env(n_workers=3, device=False)
+    wls = submit_jobs(mgr, 6)
+    mgr.schedule_all()
+    mgr.tick()
+    placed = [w.status.cluster_name for w in wls]
+    assert all(placed)
+    assert all(is_admitted(w) for w in wls)
+    counts = {c: placed.count(c) for c in set(placed)}
+    assert set(counts.values()) == {2}
+    assert mgr.metrics.get("fleet_dispatches_total", {"path": "host"}) >= 1
+    assert not mgr.metrics.get("fleet_dispatches_total", {"path": "device"})
+    assert sum(
+        mgr.metrics.get("fleet_placements_total", {"cluster": c})
+        for c in workers
+    ) == 6
+    for w in wls:
+        acs = w.status.admission_checks[0]
+        assert acs.state == CheckState.READY
+        assert "(fleet)" in acs.message
+
+
+def test_fleet_affinity_annotation_steers_placement():
+    mgr, mk, _ = fleet_env(n_workers=3, device=False, spread_weight=0)
+    job = BatchJob("pinned", queue="lq", requests={"cpu": 1000})
+    wl = mgr.submit_job(job)
+    wl.annotations[AFFINITY_ANNOTATION] = "cluster-2"
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name == "cluster-2"
+
+
+def test_fleet_unsupported_falls_back_to_sequential():
+    mgr, mk, workers = fleet_env(n_workers=2, device=False)
+    # A cohort on one worker makes the whole fleet unsupported.
+    from kueue_tpu.api.types import Cohort
+
+    workers["cluster-0"].apply(Cohort(name="co"))
+    cq = workers["cluster-0"].cache.cluster_queues["cq"]
+    cq.cohort = "co"
+    workers["cluster-0"].apply(cq)
+    wls = submit_jobs(mgr, 2)
+    mgr.schedule_all()
+    mgr.tick()
+    # Sequential race still places everything; the fleet recorded no
+    # dispatch at all.
+    assert all(w.status.cluster_name for w in wls)
+    assert not mgr.metrics.get("fleet_dispatches_total", {"path": "host"})
+    for w in wls:
+        assert "(fleet)" not in w.status.admission_checks[0].message
+
+
+def test_fleet_unreachable_lane_counted_others_place():
+    mgr, mk, workers = fleet_env(n_workers=2, device=False)
+
+    class Dead:
+        def capacity(self):
+            raise ConnectionError("down")
+
+    mk.workers["cluster-9"] = Dead()
+    mk.config.clusters.append("cluster-9")
+    wls = submit_jobs(mgr, 4)
+    mgr.schedule_all()
+    mgr.tick()
+    assert all(w.status.cluster_name in ("cluster-0", "cluster-1")
+               for w in wls)
+    assert mgr.metrics.get(
+        "fleet_lane_unavailable_total", {"cluster": "cluster-9"}
+    ) >= 1
+    assert mgr.metrics.get("fleet_lanes") == 2
+
+
+def test_fleet_whole_fleet_unreachable_keeps_pending():
+    mgr, mk, _ = fleet_env(n_workers=0, device=False)
+
+    class Dead:
+        def capacity(self):
+            raise ConnectionError("down")
+
+    mk.workers["only"] = Dead()
+    mk.config.clusters.append("only")
+    (wl,) = submit_jobs(mgr, 1)
+    mgr.schedule_all()
+    mgr.tick()
+    assert wl.status.cluster_name is None
+    assert wl.status.admission_checks[0].state == CheckState.PENDING
+    assert not mgr.metrics.get("fleet_dispatches_total", {"path": "host"})
+
+
+def test_fleet_fingerprint_skips_unchanged_resolve():
+    mgr, mk, _ = fleet_env(n_workers=2, device=False)
+    submit_jobs(mgr, 2)
+    mgr.schedule_all()
+    mgr.tick()
+    solves = mgr.metrics.get("fleet_dispatches_total", {"path": "host"})
+    assert solves >= 1
+    # Nothing pending and nothing changed: ticks add no solves.
+    mgr.tick()
+    mgr.tick()
+    assert mgr.metrics.get(
+        "fleet_dispatches_total", {"path": "host"}
+    ) == solves
+
+
+def test_fleet_insufficient_capacity_stays_pending_then_places():
+    mgr, mk, workers = fleet_env(n_workers=1, device=False,
+                                 worker_cpu_m=1_000)
+    a, b = submit_jobs(mgr, 2, cpu_m=1000)
+    mgr.schedule_all()
+    mgr.tick()
+    placed = [w for w in (a, b) if w.status.cluster_name]
+    pending = [w for w in (a, b) if not w.status.cluster_name]
+    assert len(placed) == 1 and len(pending) == 1
+    assert pending[0].status.admission_checks[0].state == CheckState.PENDING
+    # Capacity frees up: the pending one places on a later tick.
+    remote = workers["cluster-0"].workloads[placed[0].key]
+    workers["cluster-0"].finish_workload(remote)
+    mgr.finish_workload(placed[0])
+    mgr.tick()
+    assert pending[0].status.cluster_name == "cluster-0"
+
+
+def test_fleet_finalize_streams_through_service_queue():
+    posted = []
+
+    class FakeService:
+        _thread = object()
+
+        def post(self, op):
+            posted.append(op)
+            return True
+
+    mgr, mk, _ = fleet_env(n_workers=1, device=False)
+    mk.fleet.service = FakeService()
+    (wl,) = submit_jobs(mgr, 1)
+    mgr.schedule_all()
+    mgr.tick()
+    # The placement is deferred to the loop thread's ingest queue.
+    assert wl.status.cluster_name is None
+    assert [op[0] for op in posted] == ["fleet_apply"]
+    posted[0][1](mgr)
+    assert wl.status.cluster_name == "cluster-0"
+    assert wl.status.admission_checks[0].state == CheckState.READY
+    mgr.tick()  # the Admitted condition lands on the next reconcile
+    assert is_admitted(wl)
+
+
+def test_fleet_from_settings():
+    from kueue_tpu.config.configuration import MultiKueueSettings
+
+    s = MultiKueueSettings(
+        fleet_device=False, fleet_preemption=True, fleet_spread_weight=2,
+        fleet_preempt_penalty=9, fleet_affinity_penalty=3,
+        fleet_dispatch_costs={"edge": 7},
+    )
+    d = FleetDispatcher.from_settings(s)
+    assert (d.device, d.preemption, d.spread_weight) == (False, True, 2)
+    assert (d.preempt_penalty, d.affinity_penalty) == (9, 3)
+    assert d.dispatch_costs == {"edge": 7}
+
+
+def test_fleet_preemption_end_to_end_host_path():
+    """A high-priority candidate evicts a low-priority remote workload
+    when no lane has free room; the victim redispatches."""
+    mgr, mk, workers = fleet_env(
+        n_workers=1, device=False, worker_cpu_m=1_000, preemption=True,
+    )
+    low = mgr.submit_job(
+        BatchJob("low", queue="lq", requests={"cpu": 1000})
+    )
+    mgr.schedule_all()
+    mgr.tick()
+    assert low.status.cluster_name == "cluster-0"
+    high = mgr.submit_job(
+        BatchJob("high", queue="lq", requests={"cpu": 1000}, priority=5)
+    )
+    mgr.schedule_all()
+    mgr.tick()
+    assert high.status.cluster_name == "cluster-0"
+    assert mgr.metrics.get(
+        "fleet_preemptions_total", {"cluster": "cluster-0"}
+    ) == 1
+    # The victim lost its placement and its check went back to PENDING.
+    assert low.status.cluster_name is None
+    assert low.status.admission_checks[0].state == CheckState.PENDING

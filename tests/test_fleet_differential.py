@@ -1,0 +1,303 @@
+"""Fleet device kernel differential + compile-heavy e2e scenarios.
+
+The correctness gate for ``cycle_fleet_assign``: randomized joint
+placement problems solved by the device kernel must match the
+sequential host oracle bit-for-bit (same admitted set, same cluster
+choices, same victim sets under the deterministic tie-break). All
+randomized specs share ONE set of padded array shapes — cluster counts
+1–4 are emulated by masking lanes infeasible, smaller candidate sets by
+masking eligibility — so two compiles (preemption off/on) serve every
+case on this box.
+
+Plus the fault-containment scenarios that need the device path: a
+faulted device solve falls back to the host oracle
+(``solver_fallback_cycles_total{reason="fleet"}``) without corrupting
+local state, and a faulted lane apply leaves placements PENDING.
+"""
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.constants import CheckState
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    LocalQueue,
+    ResourceFlavor,
+    quota,
+)
+from kueue_tpu.controllers.jobs import BatchJob
+from kueue_tpu.controllers.multikueue import MultiKueueController
+from kueue_tpu.core.workload_info import is_admitted
+from kueue_tpu.fleet import (
+    FleetDispatcher,
+    FleetSpec,
+    fleet_cycle,
+    fleet_oracle,
+    plan_from_outputs,
+    plans_equal,
+    to_device,
+    validate_plan,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.utils import faults
+
+from .helpers import make_cq
+
+pytestmark = pytest.mark.isolated
+
+# Fixed spec extents: every randomized case is built at these dims so
+# the padded device shapes never change (C=4, F=2, R=2, W=12 -> Wp=16,
+# S=4 with preemption / 1 without). Real cluster counts 1..4 and real
+# candidate counts 1..12 are emulated by masking.
+C, F, R, W, S = 4, 2, 2, 12, 4
+N_CASES = 120
+
+
+def _random_spec(rng: np.random.RandomState, preemption: bool) -> FleetSpec:
+    real_c = rng.randint(1, C + 1)
+    real_w = rng.randint(1, W + 1)
+    sb = S if preemption else 1
+    avail = rng.randint(0, 8, size=(C, F, R)).astype(np.int64)
+    flavor_ok = rng.rand(C, F) < 0.85
+    # Lanes past the real cluster count offer nothing: infeasible in
+    # both implementations, identical to a smaller fleet.
+    flavor_ok[real_c:, :] = False
+    avail[real_c:] = 0
+    vict_free = rng.randint(0, 4, size=(C, sb, F, R)).astype(np.int64)
+    vict_prio = rng.randint(0, 5, size=(C, sb)).astype(np.int64)
+    if preemption:
+        vict_ok = rng.rand(C, sb) < 0.7
+        vict_ok[real_c:, :] = False
+    else:
+        vict_ok = np.zeros((C, sb), dtype=bool)
+        vict_free[:] = 0
+    req = rng.randint(0, 6, size=(W, R)).astype(np.int64)
+    elig = rng.rand(W, F) < 0.9
+    # Candidates past the real count are ineligible everywhere: never
+    # admitted by either implementation.
+    elig[real_w:, :] = False
+    prio = rng.randint(0, 8, size=(W,)).astype(np.int64)
+    cost = rng.randint(0, 10, size=(C, W)).astype(np.int64)
+    return FleetSpec(
+        clusters=tuple(f"c{i}" for i in range(C)),
+        flavors=tuple(f"f{i}" for i in range(F)),
+        resources=tuple(f"r{i}" for i in range(R)),
+        candidates=tuple(f"ns/w{i}" for i in range(W)),
+        vict_keys=tuple(
+            tuple(f"ns/v{c}-{s}" for s in range(sb)) for c in range(C)
+        ),
+        avail=avail, flavor_ok=flavor_ok, vict_free=vict_free,
+        vict_prio=vict_prio, vict_ok=vict_ok, req=req, elig=elig,
+        prio=prio, cost=cost, preempt=np.full((W,), preemption),
+        spread_weight=int(rng.randint(0, 3)),
+        preempt_penalty=int(rng.choice([0, 8, 64])),
+        s_bound=sb, skipped=(),
+    )
+
+
+def test_fleet_kernel_matches_oracle_randomized():
+    rng = np.random.RandomState(1234)
+    cycle = fleet_cycle()
+    failures = []
+    for case in range(N_CASES):
+        preemption = bool(case % 2)
+        spec = _random_spec(rng, preemption)
+        host = fleet_oracle(spec)
+        dev = plan_from_outputs(spec, cycle(to_device(spec)))
+        errs = plans_equal(host, dev) + validate_plan(spec, dev)
+        if errs:
+            failures.append((case, preemption, errs[:3]))
+    assert not failures, failures[:5]
+
+
+def test_fleet_kernel_full_preemption_pressure():
+    """Dense adversarial corner: zero free capacity everywhere, wide
+    priority spread — every admission must go through victim prefixes."""
+    rng = np.random.RandomState(77)
+    cycle = fleet_cycle()
+    for case in range(10):
+        spec = _random_spec(rng, True)
+        spec = spec._replace(
+            avail=np.zeros_like(spec.avail),
+            vict_ok=np.ones_like(spec.vict_ok),
+            prio=np.full_like(spec.prio, 9),
+        )
+        host = fleet_oracle(spec)
+        dev = plan_from_outputs(spec, cycle(to_device(spec)))
+        assert plans_equal(host, dev) == [], case
+        assert validate_plan(spec, dev) == [], case
+
+
+# -- e2e joint vs legacy ----------------------------------------------------
+
+
+def worker_manager(cpu_m: int = 4_000) -> Manager:
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq", flavors={"default": {"cpu": quota(cpu_m)}}),
+        LocalQueue(name="lq", cluster_queue="cq"),
+    )
+    return mgr
+
+
+def fleet_env(n_workers=3, device=True, worker_cpu_m=4_000):
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq", flavors={"default": {"cpu": quota(100_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    mk = MultiKueueController(fleet=FleetDispatcher(device=device))
+    workers = {}
+    for i in range(n_workers):
+        w = worker_manager(worker_cpu_m)
+        workers[f"cluster-{i}"] = w
+        mk.add_worker(f"cluster-{i}", w)
+    mgr.register_check_controller(mk)
+    return mgr, mk, workers
+
+
+def test_fleet_device_e2e_matches_sequential_admitted_set():
+    """Joint device dispatch admits the same set the sequential race
+    does (everything fits), in one device solve, spread evenly."""
+    mgr, mk, workers = fleet_env(n_workers=3, device=True)
+    wls = [
+        mgr.submit_job(BatchJob(f"j{i}", queue="lq",
+                                requests={"cpu": 1000}))
+        for i in range(6)
+    ]
+    mgr.schedule_all()
+    mgr.tick()
+    assert all(is_admitted(w) for w in wls)
+    placed = [w.status.cluster_name for w in wls]
+    assert {placed.count(c) for c in workers} == {2}
+    assert mgr.metrics.get(
+        "fleet_dispatches_total", {"path": "device"}
+    ) >= 1
+    assert mgr.metrics.get("fleet_dispatches_total", {"path": "host"}) == 0
+    assert mgr.metrics.get(
+        "solver_fallback_cycles_total", {"reason": "fleet"}
+    ) == 0
+
+    # Sequential reference fleet: same admitted set.
+    mgr2 = Manager()
+    mgr2.apply(
+        ResourceFlavor(name="default"),
+        make_cq("cq", flavors={"default": {"cpu": quota(100_000)}},
+                admission_checks=["mk"]),
+        LocalQueue(name="lq", cluster_queue="cq"),
+        AdmissionCheck(name="mk",
+                       controller_name="kueue.x-k8s.io/multikueue"),
+    )
+    mk2 = MultiKueueController()
+    for i in range(3):
+        mk2.add_worker(f"cluster-{i}", worker_manager())
+    mgr2.register_check_controller(mk2)
+    wls2 = [
+        mgr2.submit_job(BatchJob(f"j{i}", queue="lq",
+                                 requests={"cpu": 1000}))
+        for i in range(6)
+    ]
+    mgr2.schedule_all()
+    mgr2.tick()
+    assert sorted(w.key for w in wls2 if is_admitted(w)) == \
+        sorted(w.key for w in wls if is_admitted(w))
+
+
+def test_fleet_unreachable_worker_fault_contained_e2e():
+    """One lane's transport dies mid-fleet: the lane is skipped and
+    counted, placements land on the surviving lanes only."""
+    mgr, mk, workers = fleet_env(n_workers=3, device=True)
+
+    real = mk.workers["cluster-2"]
+
+    class Flaky:
+        def capacity(self):
+            raise ConnectionError("transport down")
+
+        def __getattr__(self, name):
+            if name == "cache":  # force the remote capacity-op path
+                raise AttributeError(name)
+            return getattr(real, name)
+
+    mk.workers["cluster-2"] = Flaky()
+    wls = [
+        mgr.submit_job(BatchJob(f"j{i}", queue="lq",
+                                requests={"cpu": 1000}))
+        for i in range(4)
+    ]
+    mgr.schedule_all()
+    mgr.tick()
+    assert all(w.status.cluster_name in ("cluster-0", "cluster-1")
+               for w in wls)
+    assert mgr.metrics.get(
+        "fleet_lane_unavailable_total", {"cluster": "cluster-2"}
+    ) >= 1
+
+
+# -- fault injection --------------------------------------------------------
+
+
+def test_fleet_dispatch_fault_falls_back_to_host_oracle():
+    mgr, mk, workers = fleet_env(n_workers=2, device=True)
+    plan = faults.FaultPlan()
+    plan.add(faults.FLEET_DISPATCH, mode="raise")
+    faults.install(plan)
+    try:
+        wls = [
+            mgr.submit_job(BatchJob(f"j{i}", queue="lq",
+                                    requests={"cpu": 1000}))
+            for i in range(4)
+        ]
+        mgr.schedule_all()
+        mgr.tick()
+        # Contained: the host oracle placed everything, the fallback is
+        # counted, and no local state was corrupted.
+        assert all(w.status.cluster_name for w in wls)
+        assert all(is_admitted(w) for w in wls)
+        assert plan.fired(faults.FLEET_DISPATCH) >= 1
+        assert mgr.metrics.get(
+            "solver_fallback_cycles_total", {"reason": "fleet"}
+        ) >= 1
+        assert mgr.metrics.get(
+            "fleet_dispatches_total", {"path": "host"}
+        ) >= 1
+        assert mgr.metrics.get(
+            "fleet_dispatches_total", {"path": "device"}
+        ) == 0
+    finally:
+        faults.clear()
+
+
+def test_fleet_apply_fault_leaves_placements_pending_then_recovers():
+    mgr, mk, workers = fleet_env(n_workers=2, device=False)
+    plan = faults.FaultPlan()
+    plan.add(faults.FLEET_APPLY, mode="raise")
+    faults.install(plan)
+    try:
+        wls = [
+            mgr.submit_job(BatchJob(f"j{i}", queue="lq",
+                                    requests={"cpu": 1000}))
+            for i in range(4)
+        ]
+        mgr.schedule_all()
+        mgr.tick()
+        # Every lane apply faulted: nothing placed, checks still
+        # PENDING, failures counted per lane.
+        assert all(w.status.cluster_name is None for w in wls)
+        for w in wls:
+            assert w.status.admission_checks[0].state == CheckState.PENDING
+        assert sum(
+            mgr.metrics.get("fleet_apply_failures_total", {"cluster": c})
+            for c in workers
+        ) >= 1
+    finally:
+        faults.clear()
+    # Fault cleared: the next tick re-solves and placements land.
+    mgr.tick()
+    assert all(w.status.cluster_name for w in wls)
+    assert all(is_admitted(w) for w in wls)

@@ -27,11 +27,17 @@ def test_all_device_kernels_documented():
     mod = _load()
     docs = mod.documented_gates()
     gates = mod.dispatch_gates()
-    assert set(gates) == set(docs) == {
+    fleet_path, fleet_func, _ = mod.FLEET_SITE
+    fleet_gates = mod.dispatch_gates(fleet_path, fleet_func)
+    assert set(gates) == {
         "cycle_grouped_preempt", "cycle_fair_preempt",
         "cycle_fair_fixedpoint",
         "cycle_fixedpoint", "cycle_fixedpoint_hybrid",
     }
+    assert set(fleet_gates) == {"cycle_fleet_assign"}
+    assert set(docs) == set(gates) | set(fleet_gates)
+    # The fleet kernel's one capability gate: the victim-plane bound.
+    assert docs["cycle_fleet_assign"] == ["spec.s_bound <= FLEET_MAX_S"]
     # The fixed-point kernels document exactly the shapes they cannot
     # handle — lending limits are NOT among them anymore, and since the
     # hybrid's residual partition covers slot-layout trees neither is

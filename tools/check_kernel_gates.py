@@ -47,13 +47,38 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = REPO_ROOT / "kueue_tpu"
 
 DRIVER = PACKAGE / "models" / "driver.py"
+FLEET_DISPATCHER = PACKAGE / "fleet" / "dispatcher.py"
 
 # Files whose factory docstrings may carry kernel-entry markers.
 KERNEL_FILES = (
     PACKAGE / "models" / "batch_scheduler.py",
     PACKAGE / "models" / "fair_kernel.py",
     PACKAGE / "models" / "fair_fixedpoint.py",
+    PACKAGE / "fleet" / "kernel.py",
 )
+
+# The fleet dispatcher's _select_entry() gates the joint multi-cluster
+# assignment kernel; its kernel file is split out of KERNEL_FILES so the
+# driver site is only checked against the cycle kernels it dispatches.
+FLEET_SITE = (FLEET_DISPATCHER, "_select_entry",
+              (PACKAGE / "fleet" / "kernel.py",))
+
+
+def dispatch_sites():
+    """Every place an ``entry = "<name>"`` dispatch gate lives: (file,
+    method name holding the if/elif chain, kernel files its entries may
+    document themselves in).
+
+    Resolved from module globals at call time so the synth tests can
+    repoint ``DRIVER`` / ``KERNEL_FILES`` at temporary sources.
+    """
+    fleet_kernels = set(FLEET_SITE[2])
+    driver_kernels = tuple(f for f in KERNEL_FILES
+                           if f not in fleet_kernels)
+    return (
+        (DRIVER, "schedule", driver_kernels),
+        FLEET_SITE,
+    )
 
 # Attribute substrings that mark a gate conjunct as a CAPABILITY test —
 # something a kernel can or cannot handle — as opposed to mode selection.
@@ -65,6 +90,7 @@ CAPABILITY_ATTRS = (
     "tas_topo",
     "has_lend_limit",
     "fair_sharing",
+    "s_bound",
 )
 
 _ENTRY_RE = re.compile(r"^\s*kernel-entry:\s*(\S+)\s*$", re.M)
@@ -79,11 +105,11 @@ def _normalize(cond: str) -> str:
         return " ".join(cond.split())
 
 
-def documented_gates() -> Dict[str, List[str]]:
+def documented_gates(files=KERNEL_FILES) -> Dict[str, List[str]]:
     """entry name -> normalized gate-requires conditions, harvested from
     the kernel factory docstrings."""
     out: Dict[str, List[str]] = {}
-    for path in KERNEL_FILES:
+    for path in files:
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -142,36 +168,37 @@ class _GateCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def dispatch_gates() -> Dict[str, List[Tuple[str, int]]]:
-    """entry name -> gate conjuncts guarding its assignment in the
-    driver's schedule() method."""
-    tree = ast.parse(DRIVER.read_text(), filename=str(DRIVER))
+def dispatch_gates(path: Path = DRIVER, func_name: str = "schedule"
+                   ) -> Dict[str, List[Tuple[str, int]]]:
+    """entry name -> gate conjuncts guarding its assignment inside
+    ``func_name`` in ``path``."""
+    tree = ast.parse(path.read_text(), filename=str(path))
     collector = _GateCollector()
     for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "schedule":
+        if isinstance(node, ast.FunctionDef) and node.name == func_name:
             collector.visit(node)
     return collector.gates
 
 
-def run_check() -> List[str]:
+def _check_site(path: Path, func_name: str, kernel_files) -> List[str]:
     violations: List[str] = []
-    docs = documented_gates()
-    gates = dispatch_gates()
+    docs = documented_gates(kernel_files)
+    gates = dispatch_gates(path, func_name)
 
     if not gates:
-        return [f"{DRIVER}: found no entry assignments in schedule()"]
+        return [f"{path}: found no entry assignments in {func_name}()"]
 
     for entry in sorted(gates):
         if entry not in docs:
             violations.append(
-                f"{DRIVER}: dispatches {entry!r} but no kernel factory "
+                f"{path}: dispatches {entry!r} but no kernel factory "
                 f"docstring carries a 'kernel-entry: {entry}' marker"
             )
     for entry in sorted(docs):
         if entry not in gates:
             violations.append(
-                f"'kernel-entry: {entry}' documented but the driver's "
-                f"schedule() never assigns entry = {entry!r}"
+                f"'kernel-entry: {entry}' documented but {path.name}'s "
+                f"{func_name}() never assigns entry = {entry!r}"
             )
 
     for entry, reqs in sorted(docs.items()):
@@ -184,19 +211,27 @@ def run_check() -> List[str]:
                 violations.append(
                     f"{entry}: documented precondition "
                     f"'gate-requires: {req}' is not a conjunct of the "
-                    f"driver dispatch gate (gate has: {sorted(conj_norm)})"
+                    f"{func_name}() dispatch gate "
+                    f"(gate has: {sorted(conj_norm)})"
                 )
         for cond, lineno in conj:
             if not any(attr in cond for attr in CAPABILITY_ATTRS):
                 continue  # mode selection / bucketing, not a capability
             if cond not in reqs:
                 violations.append(
-                    f"{DRIVER}:{lineno}: gate condition '{cond}' guards "
+                    f"{path}:{lineno}: gate condition '{cond}' guards "
                     f"{entry!r} but the kernel docstring does not list it "
                     f"as 'gate-requires:' — either the kernel gained this "
                     f"capability (delete the stale gate condition) or the "
                     f"docstring is missing the marker"
                 )
+    return violations
+
+
+def run_check() -> List[str]:
+    violations: List[str] = []
+    for path, func_name, kernel_files in dispatch_sites():
+        violations.extend(_check_site(path, func_name, kernel_files))
     return violations
 
 
