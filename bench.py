@@ -1481,6 +1481,190 @@ def probe_scanfloor(scale: float):
     }
 
 
+def build_tas_scenario(scale: float = 1.0):
+    """Scaled-down BASELINE config #4: one 3-level TPU topology
+    (block / rack / host), two ClusterQueues over a TAS flavor, and a
+    wave of multi-podset gangs with mixed slot counts — 2- and 3-podset
+    gangs with required levels spread across the hierarchy, every third
+    gang carrying an extra plain (non-TAS) podset. This is the shape
+    whose per-slot placement the batched slot pass
+    (models/slot_tas.py) vectorizes; tests/test_slot_tas.py reuses the
+    builder so the probe and the differential pin the same scenario.
+
+    Returns ``(mgr, sched, workloads)`` with the gangs already created
+    and pending.
+    """
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        Topology,
+        TopologyRequest,
+        Workload,
+        quota,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.models.driver import DeviceScheduler
+    from kueue_tpu.tas.snapshot import Node
+
+    levels = ["tpu.block", "tpu.rack", "kubernetes.io/hostname"]
+    blocks = max(2, int(2 * scale))
+    racks, hosts = 2, 2
+    mgr = Manager()
+    objs = [
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        Topology(name="topo", levels=levels),
+    ]
+    for q in range(2):
+        objs.append(ClusterQueue(
+            name=f"cq{q}",
+            resource_groups=[ResourceGroup(
+                covered_resources=["tpu"],
+                flavors=[FlavorQuotas(
+                    name="tpu-v5e", resources={"tpu": quota(100_000)},
+                )],
+            )],
+        ))
+        objs.append(LocalQueue(name=f"lq{q}", cluster_queue=f"cq{q}"))
+    mgr.apply(*objs)
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                mgr.apply(Node(
+                    name=f"n-{b}-{r}-{h}",
+                    labels={"tpu.block": f"b{b}",
+                            "tpu.rack": f"b{b}-r{r}"},
+                    capacity={"tpu": 16},
+                ))
+    n_gangs = max(6, int(8 * scale))
+    workloads = []
+    for i in range(n_gangs):
+        n_ps = 2 + (i % 2)  # mixed slot counts: 2- and 3-podset gangs
+        pod_sets = []
+        for p in range(n_ps):
+            level = levels[(i + p) % len(levels)]
+            pod_sets.append(PodSet(
+                name=f"ps{p}", count=1 + (p % 2),
+                requests={"tpu": 2 + 2 * (p % 2)},
+                topology_request=TopologyRequest(required_level=level),
+            ))
+        if i % 3 == 2:
+            pod_sets.append(PodSet(
+                name="aux", count=1, requests={"tpu": 1},
+            ))
+        workloads.append(Workload(
+            name=f"gang{i}", queue_name=f"lq{i % 2}",
+            pod_sets=pod_sets,
+            priority=100 * (i % 3), creation_time=float(i + 1),
+        ))
+    sched = DeviceScheduler(mgr.cache, mgr.queues)
+    for wl in workloads:
+        mgr.create_workload(wl)
+    return mgr, sched, workloads
+
+
+def probe_tas(scale: float):
+    """Batched slot pass vs the retired per-slot loop on a REAL encoded
+    multi-podset TAS cycle. Captures the first ``cycle_grouped_preempt``
+    dispatch of a config-#4-shaped gang wave (build_tas_scenario), then
+    times two fresh jits of the same grouped-preempt factory on the
+    identical arrays: once as shipped (models/slot_tas.place_slots, the
+    batched pass + bounded conflict scan) and once with the module
+    attribute swapped to ``place_slots_reference`` — the sequential
+    per-slot oracle that reproduces the five unrolled scans this PR
+    deleted. Headlines: ``tas_slot_speedup`` (reference wall / batched
+    wall per cycle) and ``tas_compile_s_delta`` (batched trace+compile
+    minus reference — the unrolled loop's S-times-larger graph is the
+    compile-time cost the pass removes). ``ok`` additionally requires
+    bit-identical outcome/usage planes between the arms and the
+    conflict-scan bound ``0 <= rounds <= S``."""
+    import jax
+    import numpy as np
+
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.models import slot_tas
+    from kueue_tpu.perf import compile_cache
+
+    mgr, sched, workloads = build_tas_scenario(scale)
+
+    captured = []
+    orig = compile_cache.dispatch
+
+    def spy(entry, fn, *a, **kw):
+        if entry == "cycle_grouped_preempt" and not captured:
+            captured.append(a)
+        return orig(entry, fn, *a, **kw)
+
+    compile_cache.dispatch = spy
+    try:
+        sched.schedule()
+    finally:
+        compile_cache.dispatch = orig
+    if not captured:
+        raise RuntimeError("no grouped TAS device cycle dispatched")
+    arrays, ga, adm = captured[0]
+    if getattr(arrays, "s_tas", None) is None:
+        raise RuntimeError("captured cycle has no slot TAS planes")
+    s_ax2 = int(arrays.s_tas.shape[1])
+
+    def timed(tag):
+        fn = jax.jit(bs.make_grouped_cycle(preempt=True))
+        t0 = time.perf_counter()
+        out = fn(arrays, ga, adm)
+        jax.block_until_ready(out.outcome)
+        compile_s = time.perf_counter() - t0
+        best = None
+        for _ in range(7):
+            t = time.perf_counter()
+            out = fn(arrays, ga, adm)
+            jax.block_until_ready(out.outcome)
+            dt = time.perf_counter() - t
+            best = dt if best is None or dt < best else best
+        log(f"tas[{tag}]: compile={compile_s:.2f}s "
+            f"run={best * 1e3:.3f}ms")
+        return compile_s, best, out
+
+    compile_b, run_b, out_b = timed("batched")
+    orig_pass = slot_tas.place_slots
+    slot_tas.place_slots = slot_tas.place_slots_reference
+    try:
+        compile_r, run_r, out_r = timed("reference")
+    finally:
+        slot_tas.place_slots = orig_pass
+
+    planes = ("outcome", "usage", "victims", "tas_takes", "s_tas_takes")
+    match = all(
+        np.array_equal(np.asarray(getattr(out_b, p)),
+                       np.asarray(getattr(out_r, p)))
+        for p in planes
+        if getattr(out_b, p, None) is not None
+        or getattr(out_r, p, None) is not None
+    )
+    rounds = int(np.asarray(out_b.slot_rounds))
+    speedup = run_r / run_b if run_b > 0 else 0.0
+    admitted = int(np.asarray(out_b.outcome > 0).sum())
+    ok = match and 0 <= rounds <= s_ax2 and admitted >= 1
+    return {
+        "probe": "tas",
+        "ok": bool(ok),
+        "n_gangs": len(workloads),
+        "s_bucket": s_ax2,
+        "tas_slot_speedup": round(speedup, 3),
+        "tas_compile_s_delta": round(compile_b - compile_r, 3),
+        "batched_ms": round(run_b * 1000, 3),
+        "reference_ms": round(run_r * 1000, 3),
+        "batched_compile_s": round(compile_b, 3),
+        "reference_compile_s": round(compile_r, 3),
+        "slot_rounds": rounds,
+        "admitted": admitted,
+        "match": match,
+        "fingerprint_extra": {"levels": 3, "version": 1},
+    }
+
+
 def probe_fleet(scale: float):
     """Joint fleet placement vs the sequential MultiKueue race
     (BASELINE.json config #5 shape at tiny CPU scale: 3 worker
@@ -1692,8 +1876,8 @@ def main():
     ap.add_argument("--probe", default=None,
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
-                             "steady", "scanfloor", "fleet", "coldstart",
-                             "coldstart-child"],
+                             "steady", "scanfloor", "tas", "fleet",
+                             "coldstart", "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -1753,6 +1937,7 @@ def main():
                 "whatif": lambda: probe_whatif(args.scale),
                 "steady": lambda: probe_steady(args.scale),
                 "scanfloor": lambda: probe_scanfloor(args.scale),
+                "tas": lambda: probe_tas(args.scale),
                 "fleet": lambda: probe_fleet(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),

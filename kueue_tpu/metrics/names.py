@@ -97,6 +97,7 @@ TRACING_SERIES = frozenset({
     # Fault containment (models/driver.py, utils/breaker.py, remote/).
     "solver_fallback_cycles_total",
     "solver_fixedpoint_rounds",
+    "solver_slot_conflict_rounds",
     "solver_breaker_state",
     "solver_plane_validation_failures_total",
     "remote_deadline_exceeded_total",
@@ -192,6 +193,9 @@ HELP_TEXT = {
         "Blocking device dispatch+readback wall time per kernel",
     "solver_fixedpoint_rounds":
         "Rounds the fixed-point admission kernel took to decide a cycle",
+    "solver_slot_conflict_rounds":
+        "Conflict-scan rounds the batched TAS slot pass ran in a cycle "
+        "(0 = all slots settled in the first vectorized placement)",
     "solver_batch_size": "W padding bucket used by the admission cycle",
     "solver_padding_waste_pct":
         "Padded-minus-real head rows as a percentage of the bucket",

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.models import slot_tas as _slot_tas
 from kueue_tpu.ops import quota_ops
 from kueue_tpu.ops.quota_ops import (
     CAP,
@@ -119,6 +120,10 @@ class CycleOutputs(NamedTuple):
     # the scan kernels (the driver treats None as trivially converged).
     converged: jnp.ndarray = None  # bool[] scalar
     fp_rounds: jnp.ndarray = None  # i32[] scalar
+    # Max TAS slot-placement conflict rounds across scan steps (None when
+    # the cycle carries no multi-podset TAS planes). 0 = every slot
+    # settled in the batched pass's first placement ([slot-fp] suffix).
+    slot_rounds: jnp.ndarray = None  # i32[] scalar
 
 
 def _pref_score(pmode, borrow, pref_preempt_over_borrow):
@@ -1078,6 +1083,7 @@ class AdmitScanResult(NamedTuple):
     tas_takes: jnp.ndarray  # i32[W,D] or None — pods per leaf domain
     tas_leader_takes: jnp.ndarray  # i32[W,D] or None
     s_tas_takes: jnp.ndarray  # i32[W,S,D] or None
+    slot_rounds: jnp.ndarray = None  # i32[] max conflict rounds, or None
 
 
 def admit_scan_grouped(
@@ -1216,7 +1222,7 @@ def admit_scan_grouped(
 
     def body(carry, s):
         (usage_g, designated, tas_usage, w_takes, w_ltakes,
-         w_stakes) = carry
+         w_stakes, slot_rounds) = carry
         pos = starts + s
         in_range = s < counts
         # Per-step gathers pull from REPLICATED [W]/[N] sources with a
@@ -1432,81 +1438,30 @@ def admit_scan_grouped(
                 tas_ltake = None
             tas_ok = jnp.where(tas_do, tas_feas, True)
             if with_stas:
-                # Generic multi-podset / multi-RG TAS: one placement per
-                # TAS slot, sequential in slot order with assumed-usage
-                # threading (flavorassigner.update_for_tas's ``assumed``
-                # dict). At most one entry per step touches a flavor row
-                # (trees sharing a flavor are merged into one group), so
-                # the threaded copy is step-local.
+                # Generic multi-podset / multi-RG TAS: every slot of
+                # every group lane places in ONE batched pass
+                # (models.slot_tas). The reference's sequential
+                # assumed-usage threading (flavorassigner.update_for_tas's
+                # ``assumed`` dict) is recovered by the pass's bounded
+                # conflict scan — slots on distinct topology rows settle
+                # in the first vectorized placement; only same-row slot
+                # groups iterate, by conflict rank. The accumulator is
+                # shared across lanes (per_lane=False): trees sharing a
+                # flavor are merged into one group, so at most one entry
+                # per step touches a flavor row.
                 s_ax2 = arrays.s_tas.shape[1]
-                fs_all = nom.s_flavor[w]  # [G,S]
-                stas_w = arrays.s_tas[w]
-                t_sim = tas_usage
-                sfeas_all = jnp.ones(g_n, bool)
-                s_do_list, s_tidx_list, s_take_list = [], [], []
-
-                def place_slot(t, u_row, req_v, cnt, ssz, sl_, rl_,
-                               rq_, un_, sz_):
-                    return _tas_place.place(
-                        arrays.tas_topo, t, u_row, req_v, cnt, ssz,
-                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
-                        rq_, un_, sizes=sz_,
-                    )
-
-                for si in range(s_ax2):
-                    f_si = fs_all[:, si]
-                    t_of_si = jnp.where(
-                        f_si >= 0,
-                        arrays.tas_of_flavor[
-                            jnp.clip(f_si, 0, f_all - 1)
-                        ],
-                        -1,
-                    )
-                    do_si = (
-                        valid & stas_w[:, si] & (t_of_si >= 0)
-                        & (pm == P_FIT)
-                    )
-                    t_idx_si = jnp.clip(
-                        t_of_si, 0, tas_usage.shape[0] - 1
-                    )
-                    rl_si = arrays.s_tas_req_level[w][:, si][
-                        g_iota, t_idx_si
-                    ]
-                    sl_si = arrays.s_tas_slice_level[w][:, si][
-                        g_iota, t_idx_si
-                    ]
-                    sz_si = arrays.s_tas_sizes[w][:, si][
-                        g_iota, t_idx_si
-                    ]
-                    feas_si, take_si = jax.vmap(place_slot)(
-                        t_idx_si, t_sim[t_idx_si],
-                        arrays.s_tas_req[w][:, si],
-                        arrays.s_tas_count[w][:, si],
-                        arrays.s_tas_slice_size[w][:, si],
-                        sl_si, rl_si,
-                        arrays.s_tas_required[w][:, si],
-                        arrays.s_tas_unconstrained[w][:, si],
-                        sz_si,
-                    )
-                    feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
-                    delta_si = (
-                        take_si[:, :, None]
-                        * arrays.s_tas_usage_req[w][:, si][:, None, :]
-                    )
-                    t_sim = t_sim.at[t_idx_si].add(jnp.where(
-                        (do_si & feas_si)[:, None, None], delta_si, 0
-                    ))
-                    sfeas_all = sfeas_all & jnp.where(
-                        do_si, feas_si, True
-                    )
-                    s_do_list.append(do_si)
-                    s_tidx_list.append(t_idx_si)
-                    s_take_list.append(
-                        jnp.where(do_si[:, None], take_si, 0)
-                    )
-                has_stas_g = jnp.any(stas_w, axis=1)
+                sctx = _slot_tas.slot_ctx(arrays, nom.s_flavor[w], sel=w)
+                s_do = (
+                    valid[:, None] & sctx.stas & sctx.t_valid
+                    & (pm == P_FIT)[:, None]
+                )
+                sp = _slot_tas.place_slots(
+                    arrays.tas_topo, tas_usage, sctx, s_do
+                )
+                slot_rounds = jnp.maximum(slot_rounds, sp.rounds)
+                has_stas_g = jnp.any(sctx.stas, axis=1)
                 tas_ok = tas_ok & jnp.where(
-                    valid & has_stas_g & (pm == P_FIT), sfeas_all, True
+                    valid & has_stas_g & (pm == P_FIT), sp.ok, True
                 )
         else:
             tas_ok = True
@@ -1645,26 +1600,24 @@ def admit_scan_grouped(
                     mode="drop",
                 )
             if with_stas:
-                for si in range(s_ax2):
-                    do_c = admit & s_do_list[si]
-                    add = (
-                        s_take_list[si][:, :, None]
-                        * arrays.s_tas_usage_req[w][:, si][:, None, :]
-                    )
-                    tas_usage = tas_usage.at[s_tidx_list[si]].add(
-                        jnp.where(do_c[:, None, None], add, 0)
-                    )
-                    w_stakes = w_stakes.at[
-                        jnp.where(do_c, w, w_n), si
-                    ].add(
-                        jnp.where(
-                            do_c[:, None], s_take_list[si], 0
-                        ).astype(jnp.int32),
-                        mode="drop",
-                    )
+                # Batched twin of the per-slot commit: one scatter-add
+                # over every (lane, slot) pair (duplicate topology rows
+                # accumulate, matching the sequential per-slot adds).
+                do_c = admit[:, None] & s_do
+                tas_usage = _slot_tas.commit_usage(
+                    tas_usage, sctx, sp.takes, do_c
+                )
+                w_stakes = w_stakes.at[
+                    jnp.where(do_c, w[:, None], w_n),
+                    jnp.arange(s_ax2)[None, :],
+                ].add(
+                    jnp.where(do_c[:, :, None], sp.takes, 0)
+                    .astype(jnp.int32),
+                    mode="drop",
+                )
         w_out = jnp.where(admit | preempt_ok, w, w_n)  # w_n = dropped
         return (new_usage_g, designated, tas_usage, w_takes, w_ltakes,
-                w_stakes), (w_out, admit, preempt_ok)
+                w_stakes, slot_rounds), (w_out, admit, preempt_ok)
 
     designated0 = (
         jnp.zeros(a_n, bool) if with_preempt else jnp.zeros(1, bool)
@@ -1688,10 +1641,11 @@ def admit_scan_grouped(
         )
         if with_stas else jnp.zeros((1,), jnp.int32)
     )
+    slot_rounds0 = jnp.zeros((), jnp.int32)
     (final_usage_g, _designated, _tas_u, w_takes_f, w_ltakes_f,
-     w_stakes_f), (w_mat, admit_mat, pre_mat) = jax.lax.scan(
+     w_stakes_f, slot_rounds_f), (w_mat, admit_mat, pre_mat) = jax.lax.scan(
         body, (usage_g, designated0, tas_usage0, takes0, ltakes0,
-               stakes0),
+               stakes0, slot_rounds0),
         jnp.arange(s_max), unroll=unroll,
     )
     admitted = rep(jnp.zeros(w_n + 1, dtype=bool).at[w_mat.ravel()].max(
@@ -1717,6 +1671,7 @@ def admit_scan_grouped(
         tas_takes=tas_takes,
         tas_leader_takes=tas_leader_takes,
         s_tas_takes=s_tas_takes,
+        slot_rounds=slot_rounds_f if with_stas else None,
     )
 
 
@@ -1797,69 +1752,22 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
     )
 
     if getattr(arrays, "s_tas", None) is not None:
-        # Generic multi-podset TAS entries: per-slot sequential
-        # feasibility with per-ENTRY assumed-usage threading (the host's
-        # ``assumed`` dict is scoped to one workload's update_for_tas
-        # call — entries must not see each other's simulated takes).
-        s_ax = arrays.s_tas.shape[1]
-        t_rows = arrays.tas_usage0.shape[0]
+        # Generic multi-podset TAS entries: batched per-slot feasibility
+        # (models.slot_tas) with per-ENTRY assumed-usage threading — the
+        # host's ``assumed`` dict is scoped to one workload's
+        # update_for_tas call, so entries must not see each other's
+        # simulated takes (per_lane=True). The [W,T,D,R] accumulator is
+        # affordable because this branch only compiles when a
+        # multi-podset TAS entry exists (small TAS cycles; the flagship
+        # configs have none); a compact multi-TAS row index is the
+        # round-5 refinement if W-wide TAS cycles appear.
+        sctx = _slot_tas.slot_ctx(arrays, nom.s_flavor)
+        s_do = sctx.stas & sctx.t_valid
 
         def slot_feas(usage_all):
-            # Per-(entry, topology-row) assumed takes — the host's
-            # ``assumed`` dict is keyed by flavor within one workload.
-            # [W,T,D,R] is affordable because this branch only compiles
-            # when a multi-podset TAS entry exists (small TAS cycles; the
-            # flagship configs have none); a compact multi-TAS row index
-            # is the round-5 refinement if W-wide TAS cycles appear.
-            extra = jnp.zeros(
-                (w_n,) + arrays.tas_usage0.shape, jnp.int64
-            )
-            ok = jnp.ones(w_n, bool)
-            for si in range(s_ax):
-                f_si = nom.s_flavor[:, si]
-                t_of_si = jnp.where(
-                    f_si >= 0,
-                    arrays.tas_of_flavor[jnp.clip(f_si, 0, f_n - 1)],
-                    -1,
-                )
-                do_si = arrays.s_tas[:, si] & (t_of_si >= 0)
-                t_idx_si = jnp.clip(t_of_si, 0, t_rows - 1)
-                rl_si = arrays.s_tas_req_level[w_iota, si, t_idx_si]
-                sl_si = arrays.s_tas_slice_level[w_iota, si, t_idx_si]
-                sz_si = arrays.s_tas_sizes[w_iota, si, t_idx_si]
-                u_rows = usage_all[t_idx_si] + extra[
-                    w_iota, t_idx_si
-                ]
-
-                def pl(t, u_row, req, cnt, ssz, sl_, rl_, rq_, un_,
-                       sz_):
-                    return tas_place.place(
-                        arrays.tas_topo, t, u_row, req, cnt, ssz,
-                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
-                        rq_, un_, sizes=sz_,
-                    )
-
-                feas_si, take_si = jax.vmap(pl)(
-                    t_idx_si, u_rows,
-                    arrays.s_tas_req[:, si],
-                    arrays.s_tas_count[:, si],
-                    arrays.s_tas_slice_size[:, si],
-                    sl_si, rl_si,
-                    arrays.s_tas_required[:, si],
-                    arrays.s_tas_unconstrained[:, si],
-                    sz_si,
-                )
-                feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
-                add = (
-                    take_si[:, :, None]
-                    * arrays.s_tas_usage_req[:, si][:, None, :]
-                )
-                live = do_si & feas_si
-                extra = extra.at[w_iota, t_idx_si].add(
-                    jnp.where(live[:, None, None], add, 0)
-                )
-                ok = ok & jnp.where(do_si, feas_si, True)
-            return ok
+            return _slot_tas.place_slots(
+                arrays.tas_topo, usage_all, sctx, s_do, per_lane=True
+            ).ok
 
         stas_entry = (
             jnp.any(arrays.s_tas, axis=1) & arrays.w_active
@@ -1889,7 +1797,7 @@ def apply_tas_nominate_hook(arrays: CycleArrays, nom: NominateResult):
 def _finish_outputs(arrays, nom, final_usage, admitted, preempting, order,
                     victims=None, variant=None, partial_count=None,
                     tas_takes=None, tas_leader_takes=None, s_tas_takes=None,
-                    converged=None, fp_rounds=None):
+                    converged=None, fp_rounds=None, slot_rounds=None):
     """Decode the admission planes into the per-workload outcome nest and
     assemble CycleOutputs — shared by the scan, fixed-point and hybrid
     cycle factories so every kernel reports decisions identically."""
@@ -1940,6 +1848,7 @@ def _finish_outputs(arrays, nom, final_usage, admitted, preempting, order,
         s_tas_takes=s_tas_takes,
         converged=converged,
         fp_rounds=fp_rounds,
+        slot_rounds=slot_rounds,
     )
 
 
@@ -2094,7 +2003,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                           partial_count=partial_count,
                           tas_takes=res.tas_takes,
                           tas_leader_takes=res.tas_leader_takes,
-                          s_tas_takes=res.s_tas_takes)
+                          s_tas_takes=res.s_tas_takes,
+                          slot_rounds=res.slot_rounds)
 
         return impl
 
@@ -2127,7 +2037,8 @@ def make_grouped_cycle(s_max: int = 0, preempt: bool = False,
                       partial_count=partial_count,
                       tas_takes=res.tas_takes,
                       tas_leader_takes=res.tas_leader_takes,
-                      s_tas_takes=res.s_tas_takes)
+                      s_tas_takes=res.s_tas_takes,
+                      slot_rounds=res.slot_rounds)
 
     return impl_preempt
 

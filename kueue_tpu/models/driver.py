@@ -138,6 +138,10 @@ class DeviceScheduler:
         # Rounds the most recent fixed-point dispatch took (None when the
         # last cycle used a scan kernel) — cost-ledger lane + diagnostics.
         self._last_fp_rounds: Optional[int] = None
+        # Conflict rounds the last batched TAS slot pass ran (None when
+        # the cycle carried no multi-podset TAS planes) — suffixed onto
+        # the flight-recorder kernel field as [slot-fp]/[slot-scan:r].
+        self._last_slot_rounds: Optional[int] = None
         # Incremental cycle encoding: device-resident snapshot arena with
         # row-level delta updates (models/arena.py). verify_arena re-encodes
         # from scratch every incremental cycle and asserts bit-identity.
@@ -329,6 +333,40 @@ class DeviceScheduler:
             or self.auto_cpu_kernel == "fixedpoint"
         )
 
+    def _synth_slot_heads(self, snapshot):
+        """Synthetic multi-podset TAS heads for the slot-pass prewarm
+        rung. A zero-head encode carries no per-slot TAS planes, so the
+        batched slot pass's compile shapes can never warm from the live
+        snapshot alone; a two-podset gang against the first TAS-covered
+        CQ lights up encode's slot layout at the floor S bucket — the
+        shape every live small-slot-count cycle dispatches."""
+        from kueue_tpu.api.types import PodSet, TopologyRequest, Workload
+        from kueue_tpu.core.workload_info import WorkloadInfo
+
+        for cq in snapshot.cluster_queues.values():
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    tas = snapshot.tas_flavors.get(fq.name)
+                    if tas is None or not rg.covered_resources:
+                        continue
+                    res = rg.covered_resources[0]
+                    level = tas.level_keys[-1]
+                    wl = Workload(
+                        name="__prewarm_slot__",
+                        pod_sets=[
+                            PodSet(
+                                name=f"ps{i}", count=1,
+                                requests={res: 1},
+                                topology_request=TopologyRequest(
+                                    required_level=level
+                                ),
+                            )
+                            for i in range(2)
+                        ],
+                    )
+                    return [WorkloadInfo(wl, cq.name)]
+        return []
+
     def _prewarm_sync(self, max_heads: int, aot: bool):
         if tracing.ENABLED:
             tracing.set_gauge("solver_prewarm_state", 1)  # running
@@ -406,6 +444,38 @@ class DeviceScheduler:
                             static=("s_resid", s_b, "rounds", max_r),
                             aot=aot,
                         )
+            if snapshot.tas_flavors:
+                # Slot-pass rung: warm the batched TAS slot-placement
+                # shapes with synthetic multi-podset heads (the zero-head
+                # encodes above never produce the s_tas planes the pass
+                # compiles against).
+                slot_heads = self._synth_slot_heads(snapshot)
+                if slot_heads:
+                    w_b = buckets.ladder(1)[0]
+                    arrays, idx = encode_cycle(
+                        snapshot, slot_heads, snapshot.resource_flavors,
+                        w_pad=w_b, fair_sharing=self.fair_sharing,
+                        preempt=True,
+                        fair_strategies=(
+                            self.host.preemptor.fair_strategies
+                        ),
+                    )
+                    if getattr(arrays, "s_tas", None) is not None:
+                        if self.fair_sharing:
+                            timings["slot"] = compile_cache.prewarm_entry(
+                                "cycle_fair_preempt",
+                                fair_cycle_preempt_for(s_bound),
+                                (arrays, idx.admitted_arrays),
+                                static=("s_max", s_bound), aot=aot,
+                            )
+                        else:
+                            timings["slot"] = compile_cache.prewarm_entry(
+                                "cycle_grouped_preempt",
+                                batch_scheduler.cycle_grouped_preempt,
+                                (arrays, idx.group_arrays,
+                                 idx.admitted_arrays),
+                                aot=aot,
+                            )
             if tracing.ENABLED:
                 tracing.set_gauge("solver_prewarm_state", 2)  # done
         except Exception as exc:
@@ -876,6 +946,13 @@ class DeviceScheduler:
                     entry + (
                         f"[{self._auto_choice[0]}]"
                         if self._auto_choice[0] else ""
+                    ) + (
+                        # Which slot path decided the cycle: one
+                        # vectorized pass ([slot-fp]) or the bounded
+                        # conflict scan with its round count.
+                        "[slot-fp]" if self._last_slot_rounds == 0
+                        else f"[slot-scan:{self._last_slot_rounds}]"
+                        if self._last_slot_rounds is not None else ""
                     )
                     if planes is not None else ""
                 ),
@@ -1158,6 +1235,19 @@ class DeviceScheduler:
                 )
         else:
             self._last_fp_rounds = None
+        # Slot-pass conflict telemetry: how many bounded conflict-scan
+        # rounds the batched TAS slot placement ran (0 = every slot
+        # settled in the first vectorized pass). No error case — the
+        # bound is structural (< S), never a budget.
+        if getattr(out, "slot_rounds", None) is not None:
+            srounds = int(np.asarray(out.slot_rounds))
+            self._last_slot_rounds = srounds
+            if tracing.ENABLED:
+                tracing.observe(
+                    "solver_slot_conflict_rounds", float(srounds)
+                )
+        else:
+            self._last_slot_rounds = None
         outcome = np.asarray(out.outcome)  # first blocking read
         chosen = np.asarray(out.chosen_flavor)
         tried = np.asarray(out.tried_flavor_idx)

@@ -256,7 +256,8 @@ def make_fair_fixedpoint_cycle(s_max: int = 0, preempt: bool = True):
                                 tas_takes=res.tas_takes,
                                 s_tas_takes=res.s_tas_takes,
                                 converged=rr.converged,
-                                fp_rounds=rr.fp_rounds)
+                                fp_rounds=rr.fp_rounds,
+                                slot_rounds=res.slot_rounds)
 
         return impl
 
@@ -273,7 +274,8 @@ def make_fair_fixedpoint_cycle(s_max: int = 0, preempt: bool = True):
                             tas_takes=res.tas_takes,
                             s_tas_takes=res.s_tas_takes,
                             converged=rr.converged,
-                            fp_rounds=rr.fp_rounds)
+                            fp_rounds=rr.fp_rounds,
+                            slot_rounds=res.slot_rounds)
 
     return impl_preempt
 

@@ -65,6 +65,7 @@ from kueue_tpu.models.batch_scheduler import (
 )
 from kueue_tpu.models.encode import CycleArrays
 from kueue_tpu.models.fair_preempt_kernel import fair_preempt_targets
+from kueue_tpu.models import slot_tas as _slot_tas
 from kueue_tpu.ops import quota_ops
 from kueue_tpu.ops.quota_ops import MAX_DEPTH, sat_add, sat_sub
 
@@ -85,6 +86,7 @@ class FairScanResult(NamedTuple):
     win_step: jnp.ndarray  # i32[W] tournament step won at (-1 = lost)
     tas_takes: jnp.ndarray  # i32[W,D] or None
     s_tas_takes: jnp.ndarray  # i32[W,S,D] or None
+    slot_rounds: jnp.ndarray = None  # i32[] max conflict rounds, or None
 
 
 def _fair_ctx(
@@ -273,22 +275,16 @@ def _fair_ctx(
         )
 
     # Generic multi-podset TAS (slot-layout entries with per-slot
-    # topology requests): one placement per TAS slot, sequential in slot
-    # order with assumed-usage threading, mirroring the grouped admission
-    # scan (batch_scheduler admit_scan_grouped with_stas) and the host's
-    # update_for_tas ``assumed`` dict.
+    # topology requests): one batched slot-placement pass per step
+    # (models.slot_tas), mirroring the grouped admission scan
+    # (batch_scheduler admit_scan_grouped with_stas) and the host's
+    # update_for_tas ``assumed`` dict. The shared context is gathered
+    # once onto the participant axis; the body only supplies the
+    # per-step do-mask and usage base.
     with_stas = with_tas and with_slots and arrays.s_tas is not None
     if with_stas:
-        stas_c = arrays.s_tas[pe]  # [n,S]
-        stas_req_c = arrays.s_tas_req[pe]  # [n,S,R1]
-        stas_ureq_c = arrays.s_tas_usage_req[pe]  # [n,S,R1]
-        stas_cnt_c = arrays.s_tas_count[pe]  # [n,S]
-        stas_ssz_c = arrays.s_tas_slice_size[pe]  # [n,S]
-        stas_rl_c = arrays.s_tas_req_level[pe]  # [n,S,T]
-        stas_sl_c = arrays.s_tas_slice_level[pe]  # [n,S,T]
-        stas_sz_c = arrays.s_tas_sizes[pe]  # [n,S,T,LMAX]
-        stas_rq_c = arrays.s_tas_required[pe]  # [n,S]
-        stas_un_c = arrays.s_tas_unconstrained[pe]  # [n,S]
+        sctx_s = _slot_tas.slot_ctx(arrays, fs_c, sel=pe)
+        stas_c = sctx_s.stas  # [n,S]
 
     lend_par_c = lendable[parent[chains_c]]  # [n,D+1,R]
     wgt_c = weight[chains_c]  # [n,D+1]
@@ -412,7 +408,7 @@ def _fair_ctx(
 
     def body(carry, step):
         (usage_now, tas_usage, remaining, admitted, preempting_acc,
-         designated, win_step, w_takes, s_takes) = carry
+         designated, win_step, w_takes, s_takes, slot_rounds) = carry
         zwb_k, val_k = keys_for(usage_now)
         champ = tournament(zwb_k, val_k, remaining)
         win = p_has & remaining & (champ[root_c] == n_iota)
@@ -518,73 +514,26 @@ def _fair_ctx(
             )  # [n], [n, D]
             tas_ok = jnp.where(tas_do, tas_feas, True)
             if with_stas:
-                # Per-slot sequential placement with assumed-usage
-                # threading, evaluated on a LOCAL copy of the topology
-                # state (commit below re-applies winner deltas on admit,
-                # like the grouped scan). fair_tas_single guarantees at
-                # most one root reaches a flavor, so concurrent per-root
-                # winners never race on a topology row. Twin of
-                # admit_scan_grouped's with_stas block
-                # (batch_scheduler.py) on the participant axis — change
-                # BOTH when the slot-placement semantics change.
-                s_ax2 = arrays.s_tas.shape[1]
-                t_sim = tas_usage
-                sfeas_all = jnp.ones(n, bool)
-                s_do_list, s_tidx_list, s_take_list = [], [], []
-
-                def place_slot(t, u_row, req_v, cnt, ssz, sl_, rl_,
-                               rq_, un_, sz_):
-                    return _tas_place.place(
-                        arrays.tas_topo, t, u_row, req_v, cnt, ssz,
-                        jnp.maximum(sl_, 0), jnp.maximum(rl_, 0),
-                        rq_, un_, sizes=sz_,
-                    )
-
-                for si in range(s_ax2):
-                    f_si = fs_c[:, si]
-                    t_of_si = jnp.where(
-                        f_si >= 0,
-                        arrays.tas_of_flavor[
-                            jnp.clip(f_si, 0, f_n - 1)
-                        ],
-                        -1,
-                    )
-                    do_si = (
-                        win & stas_c[:, si] & (t_of_si >= 0)
-                        & (pm == P_FIT)
-                    )
-                    t_idx_si = jnp.clip(
-                        t_of_si, 0, tas_usage.shape[0] - 1
-                    )
-                    n_io = jnp.arange(n)
-                    rl_si = stas_rl_c[:, si][n_io, t_idx_si]
-                    sl_si = stas_sl_c[:, si][n_io, t_idx_si]
-                    sz_si = stas_sz_c[:, si][n_io, t_idx_si]
-                    feas_si, take_si = jax.vmap(place_slot)(
-                        t_idx_si, t_sim[t_idx_si],
-                        stas_req_c[:, si], stas_cnt_c[:, si],
-                        stas_ssz_c[:, si], sl_si, rl_si,
-                        stas_rq_c[:, si], stas_un_c[:, si], sz_si,
-                    )
-                    feas_si = feas_si & (rl_si >= 0) & (sl_si >= 0)
-                    delta_si = (
-                        take_si[:, :, None]
-                        * stas_ureq_c[:, si][:, None, :]
-                    )
-                    t_sim = t_sim.at[t_idx_si].add(jnp.where(
-                        (do_si & feas_si)[:, None, None], delta_si, 0
-                    ))
-                    sfeas_all = sfeas_all & jnp.where(
-                        do_si, feas_si, True
-                    )
-                    s_do_list.append(do_si)
-                    s_tidx_list.append(t_idx_si)
-                    s_take_list.append(
-                        jnp.where(do_si[:, None], take_si, 0)
-                    )
+                # Batched slot-placement pass on the participant axis,
+                # evaluated against the live topology state (commit
+                # below re-applies winner deltas on admit, like the
+                # grouped scan). fair_tas_single guarantees at most one
+                # root reaches a flavor, so concurrent per-root winners
+                # never race on a topology row — the accumulator is
+                # shared (per_lane=False). Twin of admit_scan_grouped's
+                # with_stas block (batch_scheduler.py) — change BOTH
+                # when the slot-placement semantics change.
+                s_do = (
+                    win[:, None] & sctx_s.stas & sctx_s.t_valid
+                    & (pm == P_FIT)[:, None]
+                )
+                sp = _slot_tas.place_slots(
+                    arrays.tas_topo, tas_usage, sctx_s, s_do
+                )
+                slot_rounds = jnp.maximum(slot_rounds, sp.rounds)
                 has_stas_c = jnp.any(stas_c, axis=1)
                 tas_ok = tas_ok & jnp.where(
-                    win & has_stas_c & (pm == P_FIT), sfeas_all, True
+                    win & has_stas_c & (pm == P_FIT), sp.ok, True
                 )
         else:
             tas_ok = True
@@ -696,18 +645,15 @@ def _fair_ctx(
                 do_take[:, None], tas_take, 0
             ).astype(jnp.int32)
             if with_stas:
-                for si in range(s_ax2):
-                    do_c = admit & s_do_list[si]
-                    add = (
-                        s_take_list[si][:, :, None]
-                        * stas_ureq_c[:, si][:, None, :]
-                    )
-                    tas_usage = tas_usage.at[s_tidx_list[si]].add(
-                        jnp.where(do_c[:, None, None], add, 0)
-                    )
-                    s_takes = s_takes.at[:, si].add(jnp.where(
-                        do_c[:, None], s_take_list[si], 0
-                    ).astype(jnp.int32))
+                # Batched twin of the per-slot commit (shapes align on
+                # the participant axis, so s_takes is a plain add).
+                do_c = admit[:, None] & s_do
+                tas_usage = _slot_tas.commit_usage(
+                    tas_usage, sctx_s, sp.takes, do_c
+                )
+                s_takes = s_takes + jnp.where(
+                    do_c[:, :, None], sp.takes, 0
+                ).astype(jnp.int32)
         if with_preempt:
             designated = designated | jnp.any(
                 jnp.where(preempt_ok[:, None], victims_c, False),
@@ -716,7 +662,7 @@ def _fair_ctx(
         win_step = jnp.where(win, step, win_step)
         return (new_usage, tas_usage, remaining & ~win, admitted | admit,
                 preempting_acc | preempt_ok, designated, win_step,
-                w_takes, s_takes), None
+                w_takes, s_takes, slot_rounds), None
 
     def init(usage0, remaining0=None, admitted0=None, win_step0=None):
         """Scan carry for a tournament starting from ``usage0``.
@@ -741,19 +687,21 @@ def _fair_ctx(
             )
             if with_stas else jnp.zeros((1,), jnp.int32)
         )
+        # slot_rounds rides at the END of the carry so the fixed-point
+        # driver's positional reads (carry[2] = remaining) stay valid.
         return (
             usage0, tas_usage0,
             jnp.ones(n, bool) if remaining0 is None else remaining0,
             jnp.zeros(n, bool) if admitted0 is None else admitted0,
             jnp.zeros(n, bool), designated0,
             jnp.full(n, -1, jnp.int32) if win_step0 is None else win_step0,
-            takes0, stakes0,
+            takes0, stakes0, jnp.zeros((), jnp.int32),
         )
 
     def scatter(carry) -> FairScanResult:
         """Scatter participant results back onto the entry axis."""
         (final_usage, _tas_u, remaining_c, admitted_c, preempting_c,
-         _desig, win_step_c, takes_c, stakes_c) = carry
+         _desig, win_step_c, takes_c, stakes_c, slot_rounds_c) = carry
         idx_w = jnp.where(p_has, pe, jnp.int32(w_n))  # OOB rows drop
         admitted = jnp.zeros(w_n, bool).at[idx_w].set(
             admitted_c & p_has, mode="drop"
@@ -792,6 +740,7 @@ def _fair_ctx(
             win_step=win_step,
             tas_takes=w_takes_f,
             s_tas_takes=s_takes_f,
+            slot_rounds=slot_rounds_c if with_stas else None,
         )
 
     # ---- slot-normalized views (explicit S axis; S=1 legacy) -------------
@@ -864,8 +813,10 @@ def _fair_ctx(
 
     # Participants whose step semantics the rounds analysis cannot model
     # order-independently: device-resolved preemptors (sequential
-    # designated-victim bookkeeping) and TAS placements (sequential
-    # topology-state threading). Their whole trees go residual.
+    # designated-victim bookkeeping) and TAS placements (the topology
+    # state threads across tournament steps — the batched slot pass
+    # removes the per-slot loop WITHIN a step, not the step-to-step
+    # dependency). Their whole trees go residual.
     resid_force = jnp.zeros(n, bool)
     if with_preempt:
         resid_force = resid_force | (p_has & (pm_c == P_PREEMPT_OK))
@@ -913,7 +864,8 @@ def fair_admit_scan(
 
 def _fair_finish(arrays, nom, final_usage, admitted, preempting, shadowed,
                  win_step, victims=None, variant=None, tas_takes=None,
-                 s_tas_takes=None, converged=None, fp_rounds=None):
+                 s_tas_takes=None, converged=None, fp_rounds=None,
+                 slot_rounds=None):
     """Assemble CycleOutputs from fair-tournament planes — shared by the
     scan and fixed-point fair cycle factories so both kernels report
     decisions identically."""
@@ -973,6 +925,7 @@ def _fair_finish(arrays, nom, final_usage, admitted, preempting, shadowed,
         s_tas_takes=s_tas_takes,
         converged=converged,
         fp_rounds=fp_rounds,
+        slot_rounds=slot_rounds,
     )
 
 
@@ -1042,7 +995,8 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
             return _fair_finish(arrays, nom, res.usage, res.admitted,
                                 res.preempting, res.shadowed, res.win_step,
                                 tas_takes=res.tas_takes,
-                                s_tas_takes=res.s_tas_takes)
+                                s_tas_takes=res.s_tas_takes,
+                                slot_rounds=res.slot_rounds)
 
         return impl
 
@@ -1055,7 +1009,8 @@ def make_fair_cycle(s_max: int = 0, preempt: bool = False):
                             res.preempting, res.shadowed, res.win_step,
                             victims=tgt.victims, variant=tgt.variant,
                             tas_takes=res.tas_takes,
-                            s_tas_takes=res.s_tas_takes)
+                            s_tas_takes=res.s_tas_takes,
+                            slot_rounds=res.slot_rounds)
 
     return impl_preempt
 

@@ -1,0 +1,322 @@
+"""Batched TAS slot placement: one pass over every (entry, slot) pair.
+
+Generic multi-podset / multi-RG TAS entries carry up to S per-slot
+topology requests (encode's ``s_tas*`` planes). The reference threads
+them sequentially through ``flavorassigner.update_for_tas``'s
+``assumed`` usage dict — and until this module, every kernel mirrored
+that with a Python-unrolled ``for si in range(S)`` loop, paying S
+placement dispatches (and S traced program copies) per call site.
+
+This module replaces all of those loops with a single batched pass:
+
+* every slot of every lane places at once against the base topology
+  usage (``jax.vmap`` over the ``[L, S]`` block — one placement kernel
+  launch instead of S);
+* the assumed-usage dependency between slots only exists when two
+  ``do``-active slots land on the SAME topology row (same flavor →
+  same topology). A segment count over ``t_idx`` assigns each slot its
+  *conflict rank* — how many earlier active slots share its row;
+* rank-0 slots (the common case: distinct flavors → distinct
+  topologies) are final after the first pass. Only genuinely
+  conflicting slot groups re-place under a bounded
+  ``lax.while_loop`` over conflict rank, committing the previous
+  rank's feasible deltas before each re-place — the fixed-point
+  blueprint of the admission rounds kernels (PR 8/11) applied to the
+  slot axis. The loop runs ``max_rank`` times — the largest same-key
+  active group minus one, which at every kernel call site is < S:
+  per-lane keys cap the group at the lane's S slots, and the shared
+  call sites process one lane per row per step (grouping /
+  fair_tas_single), so a row never collects slots from two lanes.
+
+Bit-identity with the sequential threading is structural: within a
+row group, rank strictly increases with slot order among active slots,
+so a slot of rank r places against exactly the feasible deltas of the
+r earlier same-row slots — the sequential prefix — and equal-rank
+slots of different lanes place concurrently then commit together,
+matching the old same-``si`` place-then-scatter semantics. All the
+math is integer, so "same inputs" means "same bits"; the randomized
+differentials in tests/test_slot_tas.py pin every plane against
+:func:`place_slots_reference` (the retired sequential loop, kept here
+as the oracle).
+
+Threading scopes (mirrors the two historical loop families):
+
+* ``per_lane=False`` — one assumed-usage accumulator shared across
+  lanes, keyed by topology row. Used by the admission-scan bodies
+  (batch_scheduler ``admit_scan_grouped``, fair_kernel ``_fair_ctx``),
+  where grouping / fair_tas_single guarantees at most one lane per
+  step touches a flavor row anyway.
+* ``per_lane=True`` — per-(lane, row) accumulator: lanes are isolated
+  from each other's simulated takes. Used by the nominate-phase
+  feasibility hook (``apply_tas_nominate_hook``), where the host's
+  ``assumed`` dict is scoped to one workload.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kueue_tpu.ops import tas_place as _tas_place
+
+
+class SlotCtx(NamedTuple):
+    """Per-(lane, slot) placement inputs, gathered once per call site.
+
+    ``L`` is the caller's lane axis (scan groups G, fair participants
+    n, or raw entries W), ``S`` the encoded slot axis. All call sites
+    build it through :func:`slot_ctx` so the gather/clip semantics are
+    defined in exactly one place.
+    """
+
+    stas: jnp.ndarray  # bool[L,S] slot carries a topology request
+    t_of: jnp.ndarray  # i32[L,S] topology of the slot's flavor (-1 none)
+    t_valid: jnp.ndarray  # bool[L,S] t_of >= 0
+    t_idx: jnp.ndarray  # i32[L,S] t_of clipped to a valid row
+    levels_ok: jnp.ndarray  # bool[L,S] req/slice levels exist on t
+    req: jnp.ndarray  # i64[L,S,R1] per-pod topology request
+    count: jnp.ndarray  # i64[L,S] pods to place
+    slice_size: jnp.ndarray  # i64[L,S]
+    req_level: jnp.ndarray  # i32[L,S] level on the slot's topology
+    slice_level: jnp.ndarray  # i32[L,S]
+    required: jnp.ndarray  # bool[L,S]
+    unconstrained: jnp.ndarray  # bool[L,S]
+    sizes: jnp.ndarray  # i64[L,S,LMAX] per-level domain sizes
+    usage_req: jnp.ndarray  # i64[L,S,R1] usage added per placed pod
+
+
+class SlotPlacement(NamedTuple):
+    """Result of :func:`place_slots` / :func:`place_slots_reference`."""
+
+    ok: jnp.ndarray  # bool[L] every active slot feasible
+    feas: jnp.ndarray  # bool[L,S] per-slot feasibility (levels included)
+    takes: jnp.ndarray  # i64[L,S,D] leaf takes, zeroed outside ``do``
+    rounds: jnp.ndarray  # i32[] conflict rounds run (reference: -1)
+
+
+def slot_ctx(arrays, s_flavor, sel=None) -> SlotCtx:
+    """Build the shared slot-placement context.
+
+    ``s_flavor`` is the nominated per-slot flavor on the caller's lane
+    axis (``nom.s_flavor`` itself, or a per-step/per-participant gather
+    of it). ``sel`` optionally gathers the encoded ``[W, S, ...]`` slot
+    planes onto that lane axis (the grouped scan's per-step ``w``, the
+    fair kernel's participant ``pe``); ``None`` keeps the raw entry
+    axis (the nominate hook).
+    """
+    g = (lambda x: x[sel]) if sel is not None else (lambda x: x)
+    f_n = arrays.tas_of_flavor.shape[0]
+    t_rows = arrays.tas_usage0.shape[0]
+    t_of = jnp.where(
+        s_flavor >= 0,
+        arrays.tas_of_flavor[jnp.clip(s_flavor, 0, f_n - 1)],
+        -1,
+    )
+    t_idx = jnp.clip(t_of, 0, t_rows - 1)
+    # Per-slot level planes are encoded per topology [.., S, T]; gather
+    # each slot's row at its own topology.
+    t3 = t_idx[:, :, None]
+    req_level = jnp.take_along_axis(
+        g(arrays.s_tas_req_level), t3, axis=2
+    )[:, :, 0]
+    slice_level = jnp.take_along_axis(
+        g(arrays.s_tas_slice_level), t3, axis=2
+    )[:, :, 0]
+    sizes = jnp.take_along_axis(
+        g(arrays.s_tas_sizes), t3[:, :, :, None], axis=2
+    )[:, :, 0]
+    return SlotCtx(
+        stas=g(arrays.s_tas),
+        t_of=t_of,
+        t_valid=t_of >= 0,
+        t_idx=t_idx,
+        levels_ok=(req_level >= 0) & (slice_level >= 0),
+        req=g(arrays.s_tas_req),
+        count=g(arrays.s_tas_count),
+        slice_size=g(arrays.s_tas_slice_size),
+        req_level=req_level,
+        slice_level=slice_level,
+        required=g(arrays.s_tas_required),
+        unconstrained=g(arrays.s_tas_unconstrained),
+        sizes=sizes,
+        usage_req=g(arrays.s_tas_usage_req),
+    )
+
+
+def _conflict_rank(t_idx, do, t_rows: int, per_lane: bool):
+    """Conflict rank per slot: how many ``do``-active slots of strictly
+    earlier slot order share its assumed-usage key (topology row, or
+    (lane, row) under per-lane threading). Rank 0 slots see no earlier
+    simulated takes and are final after one pass."""
+    l_n, s_n = t_idx.shape
+    s_io = jnp.arange(s_n, dtype=jnp.int32)
+    if per_lane:
+        same_row = t_idx[:, :, None] == t_idx[:, None, :]
+        earlier = s_io[None, :, None] > s_io[None, None, :]
+        rank = jnp.sum(
+            (same_row & earlier) & do[:, None, :], axis=2,
+            dtype=jnp.int32,
+        )
+    else:
+        per_row = jnp.zeros((t_rows, s_n), jnp.int32).at[
+            t_idx, s_io[None, :]
+        ].add(do.astype(jnp.int32))
+        excl = jnp.cumsum(per_row, axis=1) - per_row
+        rank = excl[t_idx, s_io[None, :]]
+    return jnp.where(do, rank, 0)
+
+
+def place_slots(topo, base, ctx: SlotCtx, do,
+                per_lane: bool = False) -> SlotPlacement:
+    """One batched placement pass over every (lane, slot) pair.
+
+    ``base`` is the topology usage state all placements start from
+    ([T,D,R1]); ``do`` masks the slots whose feasibility gates the lane
+    and whose takes thread into later same-row slots. Masked-out slots
+    still place (their feas/takes are ignored and their takes zeroed),
+    exactly like the retired unrolled loops.
+
+    Returns feasibility, ``do``-masked takes and the number of conflict
+    rounds run (0 = every active slot settled in the first vectorized
+    pass; always < S). Commit the takes into the running topology usage
+    with :func:`commit_usage`.
+
+    slot-pass-used-by: batch_scheduler.admit_scan_grouped
+    slot-pass-used-by: batch_scheduler.apply_tas_nominate_hook
+    slot-pass-used-by: fair_kernel._fair_ctx
+    """
+    l_n, s_n = do.shape
+    l_io = jnp.arange(l_n)
+    rank = _conflict_rank(ctx.t_idx, do, base.shape[0], per_lane)
+    max_rank = jnp.max(rank).astype(jnp.int32)
+
+    def place_one(t, u_row, req_v, cnt, ssz, sl_, rl_, rq_, un_, sz_):
+        return _tas_place.place(
+            topo, t, u_row, req_v, cnt, ssz,
+            jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+            sizes=sz_,
+        )
+
+    place_block = jax.vmap(jax.vmap(place_one))
+
+    def place_all(acc):
+        if per_lane:
+            u = base[ctx.t_idx] + acc[l_io[:, None], ctx.t_idx]
+        else:
+            u = base[ctx.t_idx] + acc[ctx.t_idx]
+        feas, take = place_block(
+            ctx.t_idx, u, ctx.req, ctx.count, ctx.slice_size,
+            ctx.slice_level, ctx.req_level, ctx.required,
+            ctx.unconstrained, ctx.sizes,
+        )
+        return feas & ctx.levels_ok, take
+
+    if per_lane:
+        acc0 = jnp.zeros((l_n,) + base.shape, base.dtype)
+    else:
+        acc0 = jnp.zeros_like(base)
+    feas0, take0 = place_all(acc0)
+
+    def cond(state):
+        return state[0] <= max_rank
+
+    def body(state):
+        r, acc, feas, take = state
+        # Commit the previous rank's feasible active deltas, then
+        # re-place; only the slots of THIS rank adopt the re-placed
+        # result — they now see exactly the sequential prefix of their
+        # row group.
+        m = do & feas & (rank == r - 1)
+        upd = jnp.where(
+            m[:, :, None, None],
+            take[:, :, :, None] * ctx.usage_req[:, :, None, :],
+            0,
+        )
+        if per_lane:
+            acc = acc.at[l_io[:, None], ctx.t_idx].add(upd)
+        else:
+            acc = acc.at[ctx.t_idx].add(upd)
+        nf, nt = place_all(acc)
+        sel = rank == r
+        feas = jnp.where(sel, nf, feas)
+        take = jnp.where(sel[:, :, None], nt, take)
+        return (r + jnp.int32(1), acc, feas, take)
+
+    _, _, feas_f, take_f = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), acc0, feas0, take0)
+    )
+    return SlotPlacement(
+        ok=jnp.all(jnp.where(do, feas_f, True), axis=1),
+        feas=feas_f,
+        takes=jnp.where(do[:, :, None], take_f, 0),
+        rounds=max_rank,
+    )
+
+
+def commit_usage(tas_usage, ctx: SlotCtx, takes, mask):
+    """Scatter the masked slot takes into the running topology usage —
+    the commit half of the retired per-slot loops, as one batched
+    scatter-add (duplicate rows accumulate, matching the sequential
+    per-slot adds)."""
+    add = takes[:, :, :, None] * ctx.usage_req[:, :, None, :]
+    return tas_usage.at[ctx.t_idx].add(
+        jnp.where(mask[:, :, None, None], add, 0)
+    )
+
+
+def place_slots_reference(topo, base, ctx: SlotCtx, do,
+                          per_lane: bool = False) -> SlotPlacement:
+    """Sequential per-slot placement with assumed-usage threading — the
+    retired unrolled loop, verbatim semantics, kept as the differential
+    oracle for :func:`place_slots` (tests/test_slot_tas.py). Not called
+    by any kernel."""
+    l_n, s_n = do.shape
+    l_io = jnp.arange(l_n)
+    if per_lane:
+        extra = jnp.zeros((l_n,) + base.shape, base.dtype)
+    else:
+        t_sim = base
+    ok = jnp.ones(l_n, bool)
+    feas_cols, take_cols = [], []
+
+    def place_one(t, u_row, req_v, cnt, ssz, sl_, rl_, rq_, un_, sz_):
+        return _tas_place.place(
+            topo, t, u_row, req_v, cnt, ssz,
+            jnp.maximum(sl_, 0), jnp.maximum(rl_, 0), rq_, un_,
+            sizes=sz_,
+        )
+
+    for si in range(s_n):
+        t_i = ctx.t_idx[:, si]
+        if per_lane:
+            u = base[t_i] + extra[l_io, t_i]
+        else:
+            u = t_sim[t_i]
+        feas, take = jax.vmap(place_one)(
+            t_i, u, ctx.req[:, si], ctx.count[:, si],
+            ctx.slice_size[:, si], ctx.slice_level[:, si],
+            ctx.req_level[:, si], ctx.required[:, si],
+            ctx.unconstrained[:, si], ctx.sizes[:, si],
+        )
+        feas = feas & ctx.levels_ok[:, si]
+        live = do[:, si] & feas
+        upd = jnp.where(
+            live[:, None, None],
+            take[:, :, None] * ctx.usage_req[:, si][:, None, :],
+            0,
+        )
+        if per_lane:
+            extra = extra.at[l_io, t_i].add(upd)
+        else:
+            t_sim = t_sim.at[t_i].add(upd)
+        ok = ok & jnp.where(do[:, si], feas, True)
+        feas_cols.append(feas)
+        take_cols.append(jnp.where(do[:, si, None], take, 0))
+    return SlotPlacement(
+        ok=ok,
+        feas=jnp.stack(feas_cols, axis=1),
+        takes=jnp.stack(take_cols, axis=1),
+        rounds=jnp.int32(-1),
+    )

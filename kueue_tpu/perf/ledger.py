@@ -77,6 +77,10 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "fair_fp_speedup": "higher",
         "fair_rounds_max": "lower",
     },
+    "tas": {
+        "tas_slot_speedup": "higher",
+        "tas_compile_s_delta": "lower",
+    },
 }
 
 _REQUIRED_KEYS = (

@@ -344,3 +344,76 @@ def test_fleet_prewarm_zero_compiles_after():
     ) >= 2
     assert mgr.metrics.get("fleet_dispatches_total", {"path": "host"}) == 0
     assert _compiles() == 0, compile_cache.stats()
+
+
+def test_slot_prewarm_zero_compiles_after():
+    """The slot-pass rung (driver._synth_slot_heads) warms the grouped
+    preempt executable WITH the per-slot TAS planes — a zero-head
+    encode never produces them, so without the rung the first live
+    multi-podset TAS gang would compile at admission time. Pin: after a
+    prewarm plus two warmup cycles (arena side paths), a live
+    multi-podset TAS cycle dispatches cycle_grouped_preempt with ZERO
+    new backend compiles."""
+    from kueue_tpu.api.types import (
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        Topology,
+        TopologyRequest,
+        Workload,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.tas.snapshot import Node
+
+    compile_cache.install_listeners()
+    levels = ["tpu.rack", "kubernetes.io/hostname"]
+    mgr = Manager()
+    mgr.apply(
+        ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+        Topology(name="topo", levels=levels),
+        make_cq("cq-a", resources=["tpu"], flavors={
+            "tpu-v5e": {"tpu": ResourceQuota(nominal=100_000)},
+        }),
+        LocalQueue(name="lq", cluster_queue="cq-a"),
+    )
+    for r in range(2):
+        for h in range(2):
+            mgr.apply(Node(
+                name=f"n{r}{h}", labels={"tpu.rack": f"r{r}"},
+                capacity={"tpu": 8},
+            ))
+    sched = DeviceScheduler(mgr.cache, mgr.queues)
+    timings = sched.prewarm(max_heads=16, aot=False)
+    assert "slot" in timings, timings
+    # Two-podset gangs: the exact slot shape the rung warmed (S bucket
+    # of 2, floor W bucket).
+    for i in range(4):
+        mgr.create_workload(Workload(
+            name=f"g{i}", queue_name="lq",
+            pod_sets=[
+                PodSet(
+                    name=f"ps{p}", count=1, requests={"tpu": 1},
+                    topology_request=TopologyRequest(
+                        required_level=levels[p % 2]),
+                )
+                for p in range(2)
+            ],
+            creation_time=float(i + 1),
+        ))
+    dispatched = []
+    orig = compile_cache.dispatch
+
+    def spy(entry, fn, *a, **kw):
+        dispatched.append(entry)
+        return orig(entry, fn, *a, **kw)
+
+    compile_cache.dispatch = spy
+    try:
+        assert sched.schedule().admitted
+        assert sched.schedule().admitted
+        compile_cache.reset_stats()
+        assert sched.schedule().admitted
+        assert _compiles() == 0, compile_cache.stats()
+    finally:
+        compile_cache.dispatch = orig
+    assert set(dispatched) == {"cycle_grouped_preempt"}, dispatched

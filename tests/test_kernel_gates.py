@@ -101,6 +101,8 @@ def _run_synth(tmp_path, mod, driver_src, kernel_src):
     kernel.write_text(kernel_src + DEFAULT_DOC)
     mod.DRIVER = driver
     mod.KERNEL_FILES = (kernel,)
+    # Never written: the slot-pass check has no subject on synth trees.
+    mod.SLOT_PASS = tmp_path / "slot_tas.py"
     return mod.run_check()
 
 
@@ -136,3 +138,46 @@ def test_catches_orphaned_marker(tmp_path):
         DRIVER_OK.replace('entry = "cycle_k"', "pass"), KERNEL_SRC,
     )
     assert any("never assigns" in v for v in violations)
+
+
+SLOT_SRC = '''
+def place_slots(topo):
+    """The batched pass.
+
+    slot-pass-used-by: kernel.admit
+    """
+'''
+
+SLOT_CALLER = '''
+def admit(x):
+    return place_slots(x)
+'''
+
+
+def _slot_synth(tmp_path, mod, slot_src, kernel_extra):
+    slot = tmp_path / "slot_tas.py"
+    kernel = tmp_path / "kernel.py"
+    slot.write_text(slot_src)
+    kernel.write_text(kernel_extra)
+    mod.SLOT_PASS = slot
+    mod.KERNEL_FILES = (kernel,)
+    return mod._check_slot_pass()
+
+
+def test_slot_pass_green_on_matching_synth(tmp_path):
+    assert _slot_synth(tmp_path, _load(), SLOT_SRC, SLOT_CALLER) == []
+
+
+def test_slot_pass_catches_removed_call_site(tmp_path):
+    violations = _slot_synth(
+        tmp_path, _load(), SLOT_SRC, "def admit(x):\n    return None\n"
+    )
+    assert any("slot-pass-used-by: kernel.admit" in v for v in violations)
+
+
+def test_slot_pass_catches_undocumented_consumer(tmp_path):
+    violations = _slot_synth(
+        tmp_path, _load(), SLOT_SRC,
+        SLOT_CALLER + "\ndef sneak(x):\n    return place_slots(x)\n",
+    )
+    assert any("kernel.sneak calls place_slots()" in v for v in violations)

@@ -32,6 +32,17 @@ whitespace and quoting never matter. Mode-selection conjuncts
 (``self.device_kernel``, bucketing locals like ``s_resid``) are not
 capability tests and are ignored by check 4.
 
+A fifth check covers the shared batched TAS slot pass
+(``models/slot_tas.py``), which has no ``entry =`` dispatch of its own:
+``place_slots`` documents its consumers as docstring markers::
+
+    slot-pass-used-by: batch_scheduler.admit_scan_grouped
+
+and the check verifies, in both directions, that every marker names a
+kernel function that really calls ``place_slots`` and that every call
+site in the kernel files is documented — so a new consumer (or a
+removed one) cannot silently drift from the pass's docs.
+
 Run standalone (exit 1 on violations) or via tests/test_kernel_gates.py.
 """
 
@@ -95,6 +106,11 @@ CAPABILITY_ATTRS = (
 
 _ENTRY_RE = re.compile(r"^\s*kernel-entry:\s*(\S+)\s*$", re.M)
 _REQ_RE = re.compile(r"^\s*gate-requires:\s*(.+?)\s*$", re.M)
+
+# The shared batched TAS slot pass and its used-by contract (check 5).
+SLOT_PASS = PACKAGE / "models" / "slot_tas.py"
+SLOT_PASS_FUNC = "place_slots"
+_USED_BY_RE = re.compile(r"^\s*slot-pass-used-by:\s*(\S+)\s*$", re.M)
 
 
 def _normalize(cond: str) -> str:
@@ -228,10 +244,72 @@ def _check_site(path: Path, func_name: str, kernel_files) -> List[str]:
     return violations
 
 
+def _slot_pass_markers(path: Path = None) -> List[str]:
+    """``module.function`` consumers documented in the slot pass's
+    docstring (``slot-pass-used-by:`` markers on ``place_slots``)."""
+    path = SLOT_PASS if path is None else path
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == SLOT_PASS_FUNC):
+            doc = ast.get_docstring(node) or ""
+            return _USED_BY_RE.findall(doc)
+    return []
+
+
+def _slot_pass_call_sites(files=None) -> List[str]:
+    """``module.function`` for every top-level kernel function whose body
+    (including nested closures) calls ``place_slots``."""
+    files = KERNEL_FILES if files is None else files
+    out: List[str] = []
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None
+                )
+                if name == SLOT_PASS_FUNC:
+                    out.append(f"{path.stem}.{node.name}")
+                    break
+    return out
+
+
+def _check_slot_pass() -> List[str]:
+    """Check 5: the slot pass's documented consumers match the kernel
+    call sites of ``place_slots``, in both directions. No subject file
+    (the synth harness repoints ``SLOT_PASS`` at a path it never
+    writes) means nothing to check."""
+    if not SLOT_PASS.exists():
+        return []
+    markers = _slot_pass_markers()
+    sites = _slot_pass_call_sites()
+    violations: List[str] = []
+    for m in sorted(set(markers) - set(sites)):
+        violations.append(
+            f"{SLOT_PASS.name}: 'slot-pass-used-by: {m}' documented but "
+            f"no kernel function of that name calls {SLOT_PASS_FUNC}()"
+        )
+    for s in sorted(set(sites) - set(markers)):
+        violations.append(
+            f"{s} calls {SLOT_PASS_FUNC}() but {SLOT_PASS.name}'s "
+            f"{SLOT_PASS_FUNC} docstring has no "
+            f"'slot-pass-used-by: {s}' marker"
+        )
+    return violations
+
+
 def run_check() -> List[str]:
     violations: List[str] = []
     for path, func_name, kernel_files in dispatch_sites():
         violations.extend(_check_site(path, func_name, kernel_files))
+    violations.extend(_check_slot_pass())
     return violations
 
 
