@@ -280,7 +280,9 @@ def probe_sim(scale: float):
     from kueue_tpu.models import pallas_scan as ps
 
     kernels = [kernel]
-    if platform == "tpu" and ps.fits_int32(arrays):
+    # Pallas is retired to opt-in (docs/perf.md "Pallas scan"): the live
+    # TPU variant only dispatches under KUEUE_TPU_ENABLE_PALLAS=1.
+    if platform == "tpu" and ps.opt_in() and ps.fits_int32(arrays):
         kernels.append("pallas")
     stats = {
         "probe": "sim",
@@ -548,13 +550,20 @@ def probe_mega():
         ("grouped", bs.make_grouped_cycle(
             s_exact, unroll=4, n_levels=n_levels)),
     ]
-    if ps.fits_int32(arrays):
+    if ps.opt_in() and ps.fits_int32(arrays):
         variants.append(
             ("pallas", ps.make_pallas_cycle(s_exact, n_levels=n_levels)))
         # Half-width quota math for the HBM-bound nominate/order phases
         # (bs.cast_arrays_i32) — exact under the same fits_int32 gate.
         variants.append(("pallas_i32", ps.make_pallas_cycle(
             s_exact, n_levels=n_levels, i32=True)))
+    elif not ps.opt_in():
+        # Retired to opt-in after the BENCH_TPU_LIVE RecursionError
+        # re-probe (docs/perf.md "Pallas scan"): the mega probe routes to
+        # the fixed-point/grouped kernels unless explicitly re-enabled.
+        out_stats["pallas"] = (
+            f"retired to opt-in ({ps.PALLAS_OPT_IN_ENV}=1)"
+        )
     walls = {}
     impls = dict(variants)
     for name, impl in variants:
@@ -625,6 +634,187 @@ def probe_mega():
         except Exception as exc:  # noqa: BLE001
             out_stats["percycle_error"] = repr(exc)[:300]
     return out_stats
+
+
+def probe_tiled(scale: float):
+    """Tiled streaming admission vs the monolithic cycle (ROADMAP item 3:
+    500k-1M pending workloads through a bounded device arena).
+
+    The live run is scaled down for this box: a 24-tree x 4-CQ forest
+    driven to completion twice — once monolithic (tileWidth=off), once
+    tiled (tileWidth=16) — with per-cycle result parity asserted (the
+    randomized differential lives in tests/test_tiled.py; this is the
+    measured twin). Both drivers are prewarmed and the measurement is a
+    second fresh-build run, so walls compare dispatch cost, not compiles.
+
+    The 500k-class story is proven without materializing 500k rows:
+    a tiled cycle at any backlog width only ever materializes
+    bucket(tile width) rows, so the probe (a) AOT-lowers the production
+    kernel at the auto tile bucket (8192) — the one shape a tiled 1M
+    cycle dispatches — and (b) projects plane bytes linearly in W from
+    two measured encodes to report what the monolithic plane WOULD cost
+    at the target vs the tiled bound.
+
+    Headline: ``tiled_peak_plane_mb`` (lower; the bound) and
+    ``tiled_vs_mono_delta_pct`` (lower; honest about this CPU box, where
+    tiling the same work adds per-tile dispatch + re-snapshot overhead
+    and no memory pressure is relieved)."""
+    import jax
+    import numpy as np
+
+    from kueue_tpu.models import batch_scheduler as bs
+    from kueue_tpu.models import buckets
+    from kueue_tpu.models.driver import DeviceScheduler
+    from kueue_tpu.models.encode import encode_cycle, plane_nbytes
+
+    TILE_W = 16
+    TARGET_W = 500_000
+    classes = [
+        ("s", max(2, int(6 * scale)), 1000, 50, 0.2),
+        ("l", max(1, int(2 * scale)), 15000, 100, 0.5),
+    ]
+
+    def build():
+        return build_scenario(
+            scale, n_cohorts=24, n_cqs=4, classes=classes
+        )
+
+    def drive(tile_width, submit_then_run=True):
+        cache, queues, workloads = build()
+        for wl, _rt in workloads:
+            queues.add_or_update_workload(wl)
+        sched = DeviceScheduler(cache, queues, tile_width=tile_width)
+        sched.prewarm(max_heads=96, aot=False)
+        cycles = []
+        peak_plane = 0
+        tiles_seen = 0
+        prev_carry = None
+        prev_heads = None
+        t0 = time.monotonic()
+        for _ in range(10_000):
+            res = sched.schedule()
+            carry = sched._last_tile_carry
+            if carry is not None and carry is not prev_carry:
+                peak_plane = max(peak_plane, carry.peak_plane_bytes)
+                tiles_seen = max(tiles_seen, carry.tiles)
+                prev_carry = carry
+            cycles.append(
+                (sorted(res.admitted), sorted(res.preempted),
+                 sorted(res.skipped))
+            )
+            if res.admitted or res.preempted:
+                prev_heads = None
+                continue
+            if not res.head_keys or res.head_keys == prev_heads:
+                break
+            prev_heads = res.head_keys
+        wall = time.monotonic() - t0
+        return cycles, wall, peak_plane, tiles_seen
+
+    stats = {
+        "probe": "tiled",
+        "ok": True,
+        "platform": jax.devices()[0].platform,
+        "tile_width": TILE_W,
+        "target_w": TARGET_W,
+    }
+
+    # Warmup pass (fills the in-process compile cache for both shapes),
+    # then the measured pass on fresh identical builds.
+    log("tiled: warmup drive (monolithic)")
+    drive("off")
+    log("tiled: warmup drive (tiled)")
+    drive(TILE_W)
+    log("tiled: measured drive (monolithic)")
+    mono_cycles, mono_wall, _mono_peak, _ = drive("off")
+    log("tiled: measured drive (tiled)")
+    tiled_cycles, tiled_wall, tiled_peak, tiles_seen = drive(TILE_W)
+
+    identical = mono_cycles == tiled_cycles
+    stats["live_cycles"] = len(mono_cycles)
+    stats["live_admitted"] = sum(len(c[0]) for c in mono_cycles)
+    stats["tiles_per_cycle"] = tiles_seen
+    stats["tiled_vs_mono_identical"] = identical
+    if not identical:
+        stats["ok"] = False
+        log("tiled: DIVERGED from monolithic cycle")
+    stats["mono_wall_s"] = round(mono_wall, 3)
+    stats["tiled_wall_s"] = round(tiled_wall, 3)
+    if mono_wall > 0:
+        stats["tiled_vs_mono_delta_pct"] = round(
+            100.0 * (tiled_wall - mono_wall) / mono_wall, 1
+        )
+
+    # Plane accounting on a fresh build: the monolithic first-cycle
+    # plane vs the tiled peak, measured; then the linear-in-W projection
+    # to the 500k-class target.
+    cache, queues, workloads = build()
+    for wl, _rt in workloads:
+        queues.add_or_update_workload(wl)
+    heads = queues.heads()
+    snapshot = cache.snapshot()
+
+    def plane_at(w_pad, hs=()):
+        arrays, _idx = encode_cycle(
+            snapshot, list(hs), snapshot.resource_flavors, w_pad=w_pad,
+            preempt=True,
+        )
+        return plane_nbytes(arrays)
+
+    mono_bucket = buckets.bucket_for(len(heads))
+    mono_plane = plane_at(mono_bucket, heads)
+    mb = 1024.0 * 1024.0
+    stats["live_heads"] = len(heads)
+    stats["mono_plane_mb"] = round(mono_plane / mb, 3)
+    stats["tiled_peak_plane_mb"] = round(tiled_peak / mb, 3)
+    if tiled_peak >= mono_plane:
+        stats["ok"] = False
+        log("tiled: peak tile plane not below the monolithic plane")
+
+    # Per-row cost from two encode widths; fixed part = tree/policy
+    # tensors that do not scale with W.
+    b1, b2 = 128, 1024
+    p1, p2 = plane_at(b1), plane_at(b2)
+    per_row = (p2 - p1) / float(b2 - b1)
+    fixed = p1 - b1 * per_row
+    auto_tile_bucket = buckets.bucket_for(
+        DeviceScheduler._TILE_AUTO_WIDTH
+    )
+    stats["plane_bytes_per_row"] = round(per_row, 1)
+    stats["projected_mono_plane_mb_at_target"] = round(
+        (fixed + per_row * buckets.bucket_for(TARGET_W)) / mb, 1
+    )
+    stats["projected_tiled_peak_plane_mb_at_target"] = round(
+        (fixed + per_row * auto_tile_bucket) / mb, 1
+    )
+
+    # Full-size shape proof by AOT lowering only: the auto tile bucket
+    # is the one W shape a tiled 500k-1M cycle ever dispatches.
+    log("tiled: AOT-lowering the auto tile bucket shape")
+    try:
+        arrays, idx = encode_cycle(
+            snapshot, [], snapshot.resource_flavors,
+            w_pad=auto_tile_bucket, preempt=True,
+        )
+        t0 = time.monotonic()
+        jax.jit(bs.cycle_grouped_preempt).lower(
+            arrays, idx.group_arrays, idx.admitted_arrays
+        )
+        stats["fullsize_tile_bucket"] = auto_tile_bucket
+        stats["fullsize_lowered"] = True
+        stats["fullsize_lower_s"] = round(time.monotonic() - t0, 1)
+    except Exception as exc:  # noqa: BLE001 - record and fail the gate
+        stats["fullsize_lowered"] = False
+        stats["fullsize_lower_error"] = repr(exc)[:300]
+        stats["ok"] = False
+
+    stats["fingerprint_extra"] = {
+        "target_w": TARGET_W,
+        "tile_width": TILE_W,
+        "n_cohorts": 24,
+        "n_cqs": 4,
+    }
+    return stats
 
 
 def probe_phases():
@@ -1877,7 +2067,7 @@ def main():
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
                              "steady", "scanfloor", "tas", "fleet",
-                             "coldstart", "coldstart-child"],
+                             "tiled", "coldstart", "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -1939,6 +2129,7 @@ def main():
                 "scanfloor": lambda: probe_scanfloor(args.scale),
                 "tas": lambda: probe_tas(args.scale),
                 "fleet": lambda: probe_fleet(args.scale),
+                "tiled": lambda: probe_tiled(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

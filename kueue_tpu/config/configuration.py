@@ -150,6 +150,12 @@ class Configuration:
     # (enabled when driven by the streaming service loop, off for
     # call-per-cycle use). See docs/perf.md "Pipelined cycle".
     pipeline_cycles: str = "auto"
+    # Tiled streaming admission: "auto" (stream past-the-flagship cycles
+    # through the device in bounded W-tiles; smaller cycles keep the
+    # monolithic dispatch), "off" (never tile), or a positive int tile
+    # width (tile whenever the head count exceeds it). See docs/perf.md
+    # "Scaling beyond 50k".
+    tile_width: object = "auto"
     # KEP 7066 custom metric labels: entries of
     # {name, sourceKind: Workload|ClusterQueue|Cohort, sourceLabelKey,
     # sourceAnnotationKey}; values are read from the source object's
@@ -325,6 +331,7 @@ def load(source) -> Configuration:
     cfg.pipeline_cycles = str(
         _pick(raw, "pipelineCycles", "pipeline_cycles", default="auto")
     )
+    cfg.tile_width = _pick(raw, "tileWidth", "tile_width", default="auto")
 
     validate(cfg)
     return cfg
@@ -374,6 +381,18 @@ def validate(cfg: Configuration) -> None:
             f"unknown pipelineCycles {cfg.pipeline_cycles!r} "
             "(expected on | off | auto)"
         )
+    if cfg.tile_width not in ("auto", "off"):
+        try:
+            ok = int(cfg.tile_width) > 0 and not isinstance(
+                cfg.tile_width, bool
+            )
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise ValueError(
+                f"unknown tileWidth {cfg.tile_width!r} "
+                "(expected auto | off | positive integer)"
+            )
 
 
 def apply_feature_gates(cfg: Configuration) -> None:
@@ -408,6 +427,7 @@ def build_manager(cfg: Configuration, **kw):
         device_kernel=cfg.device_kernel,
         auto_cpu_kernel=cfg.auto_cpu_kernel,
         pipeline_cycles=cfg.pipeline_cycles,
+        tile_width=cfg.tile_width,
         **kw,
     )
     mgr.exclude_resource_prefixes = list(

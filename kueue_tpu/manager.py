@@ -91,6 +91,7 @@ class Manager:
         device_kernel: str = "scan",
         auto_cpu_kernel: str = "scan",
         pipeline_cycles: str = "auto",
+        tile_width="auto",
     ) -> None:
         self.clock = clock
         self.cache = Cache()
@@ -105,6 +106,7 @@ class Manager:
                 device_kernel=device_kernel,
                 auto_cpu_kernel=auto_cpu_kernel,
                 pipeline_cycles=pipeline_cycles,
+                tile_width=tile_width,
             )
         else:
             self.scheduler = Scheduler(

@@ -116,6 +116,13 @@ TRACING_SERIES = frozenset({
     "solver_pipeline_abort_total",
     "solver_pipeline_reused_rows",
     "solver_pipeline_speculate_seconds",
+    # Tiled streaming admission (models/driver.py _schedule_tiled):
+    # past-the-flagship cycles streamed through a bounded device arena
+    # in fixed-width W-tiles.
+    "solver_tile_cycles_total",
+    "solver_tiles_per_cycle",
+    "solver_tile_width",
+    "solver_tile_fallback_total",
 })
 
 # Observability layer series (obs/): flight recorder + SLO engine.
@@ -211,6 +218,15 @@ HELP_TEXT = {
     "solver_pipeline_speculate_seconds":
         "Host wall time spent staging the next cycle's speculative encode "
         "inside the device-dispatch overlap window",
+    "solver_tile_cycles_total":
+        "Admission cycles dispatched in tiles, by mode (auto/fixed)",
+    "solver_tiles_per_cycle":
+        "W-tiles the last tiled cycle streamed through the device",
+    "solver_tile_width":
+        "Tile width (rows) the last tiled cycle packed against",
+    "solver_tile_fallback_total":
+        "Tiles rerouted through the host-exact path by containment, "
+        "by reason (settled tiles stay applied)",
     "trace_span_duration_seconds": "Span durations by span name",
     "remote_calls_total": "Remote worker calls by op/transport/outcome",
     "remote_call_duration_seconds":

@@ -1079,6 +1079,51 @@ class CycleArena:
         assert_cycle_equal(arrays, idx, ref_arrays, ref_idx)
 
 
+class TileCarry:
+    """Cross-tile bookkeeping for one tiled admission cycle
+    (models/driver.py ``_schedule_tiled``).
+
+    The quota/admitted carry itself is the arena: tile k's applies land
+    as cache events, and tile k+1's ``take_snapshot`` drains them into
+    row deltas — tile k+1 therefore encodes against tile k's post-apply
+    usage and admitted set without a full re-capture. What this object
+    carries is the *accounting* of that stream: rows solved, tiles
+    faulted into the host path, and the peak plane bytes any single tile
+    materialized (the memory bound tiling exists to enforce — see
+    ``bench.py --probe tiled``'s ``tiled_peak_plane_mb`` headline).
+    """
+
+    def __init__(self, width: int, tiles: int) -> None:
+        self.width = int(width)
+        self.tiles = int(tiles)
+        self.tiles_done = 0
+        self.rows = 0
+        self.faulted_tiles = 0
+        self.peak_plane_bytes = 0
+
+    def note_plane(self, nbytes: int) -> None:
+        """Record one tile's materialized plane size (driver hook,
+        called right after the tile's encode)."""
+        if nbytes > self.peak_plane_bytes:
+            self.peak_plane_bytes = int(nbytes)
+
+    def note_tile(self, rows: int, faulted: bool = False) -> None:
+        self.tiles_done += 1
+        self.rows += int(rows)
+        if faulted:
+            self.faulted_tiles += 1
+
+    def stats(self) -> dict:
+        return {
+            "width": self.width,
+            "tiles": self.tiles,
+            "tiles_done": self.tiles_done,
+            "rows": self.rows,
+            "faulted_tiles": self.faulted_tiles,
+            "peak_plane_bytes": self.peak_plane_bytes,
+        }
+
+
 def _field_equal(name: str, a, b) -> None:
     if a is None or b is None:
         assert a is None and b is None, (

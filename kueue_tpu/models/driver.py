@@ -30,8 +30,8 @@ from kueue_tpu.core.workload_info import (
 )
 from kueue_tpu.metrics import tracing
 from kueue_tpu.models import batch_scheduler, buckets
-from kueue_tpu.models.arena import CycleArena
-from kueue_tpu.models.encode import encode_cycle
+from kueue_tpu.models.arena import CycleArena, TileCarry
+from kueue_tpu.models.encode import encode_cycle, plan_tiles, plane_nbytes
 from kueue_tpu.obs import costs
 from kueue_tpu.obs import recorder as flight
 from kueue_tpu.perf import compile_cache
@@ -76,6 +76,15 @@ class DeviceScheduler:
     # before the W axis actually shrinks (see _pick_bucket).
     _SHRINK_PATIENCE = 4
 
+    # tile_width="auto" thresholds: cycles at or below _TILE_AUTO_MIN
+    # heads keep the monolithic dispatch (the measured regime up to the
+    # 50k flagship); past it the head set streams through the device in
+    # _TILE_AUTO_WIDTH-row tiles, bounding the materialized w_*/s_*
+    # planes regardless of backlog width (see _schedule_tiled and
+    # docs/perf.md "Scaling beyond 50k").
+    _TILE_AUTO_MIN = 65536
+    _TILE_AUTO_WIDTH = 8192
+
     def __init__(
         self,
         cache: Cache,
@@ -93,6 +102,7 @@ class DeviceScheduler:
         auto_cpu_kernel: str = "scan",
         pipeline_cycles: str = "off",
         pipeline_patch_limit: int = 64,
+        tile_width="auto",
     ) -> None:
         self.cache = cache
         self.queues = queues
@@ -201,6 +211,32 @@ class DeviceScheduler:
         self.pipeline_overlap_s = 0.0
         if self._arena is not None:
             self._arena.pipeline_patch_limit = self.pipeline_patch_limit
+        # Tiled streaming admission: past-the-flagship cycles stream the
+        # head set through the device in fixed-width W-tiles instead of
+        # one monolithic plane (see _schedule_tiled). "auto" tiles only
+        # above _TILE_AUTO_MIN heads; "off" never tiles; an explicit int
+        # tiles whenever the head count exceeds it.
+        if tile_width not in ("auto", "off"):
+            try:
+                # Through str() so bools ("True") and non-integral floats
+                # ("2.5") are rejected rather than silently coerced.
+                tile_width = int(str(tile_width))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"tile_width must be auto|off|positive int, "
+                    f"got {tile_width!r}"
+                )
+            if tile_width <= 0:
+                raise ValueError(
+                    f"tile_width must be auto|off|positive int, "
+                    f"got {tile_width!r}"
+                )
+        self.tile_width = tile_width
+        # Live during a tiled cycle (plane accounting hook); the last
+        # completed tiled cycle's carry stays readable for diagnostics
+        # and the bench probe.
+        self._tile_carry: Optional[TileCarry] = None
+        self._last_tile_carry: Optional[TileCarry] = None
         # Optional what-if engine refreshed in spare time (attach_whatif).
         self._whatif = None
         self._whatif_interval_s = 30.0
@@ -333,6 +369,24 @@ class DeviceScheduler:
             or self.auto_cpu_kernel == "fixedpoint"
         )
 
+    def _tile_prewarm_bucket(self, max_heads: int, rungs) -> Optional[int]:
+        """Bucket of the tiled prewarm rung, or None when the ladder
+        already covers it. Tiles dispatch at ``bucket_for(tile rows)``,
+        which the max_heads ladder may not include: an explicit tile
+        width always warms its own bucket; "auto" warms the
+        ``_TILE_AUTO_WIDTH`` bucket only when the caller declares a
+        ``max_heads`` past the auto threshold (warming an 8192-row shape
+        for services that never tile would waste minutes of compile)."""
+        if self.tile_width == "off":
+            return None
+        if self.tile_width == "auto":
+            if max_heads <= self._TILE_AUTO_MIN:
+                return None
+            b = buckets.bucket_for(self._TILE_AUTO_WIDTH)
+        else:
+            b = buckets.bucket_for(int(self.tile_width))
+        return None if b in rungs else b
+
     def _synth_slot_heads(self, snapshot):
         """Synthetic multi-podset TAS heads for the slot-pass prewarm
         rung. A zero-head encode carries no per-slot TAS planes, so the
@@ -388,7 +442,11 @@ class DeviceScheduler:
                 s_bound = buckets.pow2_bucket(
                     max(roots.values(), default=1), floor=4
                 )
-            for bucket in buckets.ladder(max_heads):
+            rungs = list(buckets.ladder(max_heads))
+            tile_b = self._tile_prewarm_bucket(max_heads, rungs)
+            if tile_b is not None:
+                rungs.append(tile_b)
+            for bucket in rungs:
                 arrays, idx = encode_cycle(
                     snapshot, [], snapshot.resource_flavors,
                     w_pad=bucket, fair_sharing=self.fair_sharing,
@@ -444,6 +502,12 @@ class DeviceScheduler:
                             static=("s_resid", s_b, "rounds", max_r),
                             aot=aot,
                         )
+            if tile_b is not None:
+                # Name the tiled rung: the ladder rungs stay keyed by
+                # bucket int, the tile-width rung (a shape the ladder
+                # does not cover) is keyed "tiled" so callers and the
+                # zero-compile pins can assert it warmed.
+                timings["tiled"] = timings.pop(tile_b)
             if snapshot.tas_flavors:
                 # Slot-pass rung: warm the batched TAS slot-placement
                 # shapes with synthetic multi-podset heads (the zero-head
@@ -495,7 +559,107 @@ class DeviceScheduler:
                 self._whatif.maybe_refresh(self._whatif_interval_s)
             result.duration_s = self.clock() - start
             return result
+        width = self._resolve_tile_width(len(heads))
+        if width is not None:
+            return self._schedule_tiled(list(heads), width, start, result)
+        return self._schedule_heads(list(heads), start, result)
 
+    def _resolve_tile_width(self, n_heads: int) -> Optional[int]:
+        """Tile width for this cycle, or None for a monolithic dispatch.
+
+        ``tile_width`` is "off" (never tile), "auto" (tile at
+        ``_TILE_AUTO_WIDTH`` once the head count passes
+        ``_TILE_AUTO_MIN`` — cycles at or below the 50k flagship keep the
+        monolithic path and its measured behavior), or an explicit
+        positive int (tile whenever the head count exceeds it)."""
+        tw = self.tile_width
+        if tw == "off":
+            return None
+        if tw == "auto":
+            if n_heads > self._TILE_AUTO_MIN:
+                return self._TILE_AUTO_WIDTH
+            return None
+        return int(tw) if n_heads > int(tw) else None
+
+    def _schedule_tiled(self, heads: List[WorkloadInfo], width: int,
+                        start: float, result: CycleResult) -> CycleResult:
+        """Stream one cycle's heads through the device in W-tiles.
+
+        Tiles pack whole cohort trees (encode.plan_tiles): trees are
+        quota-independent and the kernels solve them independently, so a
+        tile's device outcomes match the monolithic cycle's row for row.
+        Trees sharing a device-encoded TAS flavor are fused into one tile
+        — topology capacity is physical state shared across trees.
+        The cross-tile carry is the arena itself: tile k's applies land
+        as cache events, and tile k+1's ``take_snapshot`` drains them
+        into row deltas, so tile k+1 encodes against tile k's post-apply
+        usage and admitted set without re-capturing untouched rows.
+        Per-tile containment: a faulted tile reroutes through the
+        host-exact path (same as a faulted monolithic cycle) without
+        invalidating settled tiles — their applies already landed."""
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.CACHE_SNAPSHOT)
+            if self._arena is not None:
+                snapshot = self._arena.take_snapshot()
+            else:
+                snapshot = self.cache.snapshot()
+        except Exception as exc:
+            if not self._containable(exc):
+                raise
+            return self._contain_cycle(
+                result, heads, "snapshot_error", exc, start
+            )
+        tiles = plan_tiles(heads, width, snapshot)
+        carry = TileCarry(width=width, tiles=len(tiles))
+        self._tile_carry = carry
+        self._last_tile_carry = carry
+        if tracing.ENABLED:
+            tracing.inc("solver_tile_cycles_total", {
+                "mode": "auto" if self.tile_width == "auto" else "fixed",
+            })
+            tracing.set_gauge("solver_tile_width", width)
+            tracing.set_gauge("solver_tiles_per_cycle", len(tiles))
+        try:
+            for k, tile_heads in enumerate(tiles):
+                faults_before = self.fault_fallback_cycles
+                self._schedule_heads(
+                    tile_heads, start, result,
+                    bucket=buckets.bucket_for(len(tile_heads)),
+                    tile=(k + 1, len(tiles)),
+                    # Tile 0 solves against the planning snapshot; later
+                    # tiles re-snapshot to drain the prior tile's applies.
+                    snapshot=snapshot if k == 0 else None,
+                )
+                faulted = self.fault_fallback_cycles > faults_before
+                carry.note_tile(len(tile_heads), faulted=faulted)
+                if faulted and tracing.ENABLED:
+                    tracing.inc("solver_tile_fallback_total", {
+                        "reason": (
+                            self.last_fault[0]
+                            if self.last_fault is not None else "unknown"
+                        ),
+                    })
+        finally:
+            self._tile_carry = None
+        result.duration_s = self.clock() - start
+        return result
+
+    def _schedule_heads(
+        self,
+        heads: List[WorkloadInfo],
+        start: float,
+        result: CycleResult,
+        bucket: Optional[int] = None,
+        tile: Optional[Tuple[int, int]] = None,
+        snapshot=None,
+    ) -> CycleResult:
+        """One dispatch of the batched cycle over ``heads``, mutating the
+        shared ``result``: the monolithic cycle calls this once with the
+        full head set; the tiled mode calls it once per tile with an
+        explicit bucket and a ``(k, n)`` tile tag. This is the single
+        kernel dispatch site tools/check_kernel_gates.py pins — both
+        modes funnel through the gate chain below."""
         if tracing.ENABLED:
             tracing.set_gauge(
                 "solver_breaker_state", self._breaker.gauge_value
@@ -523,21 +687,23 @@ class DeviceScheduler:
                 )
             return result
 
-        try:
-            if faults.ENABLED:
-                faults.fire(faults.CACHE_SNAPSHOT)
-            if self._arena is not None:
-                # Snapshot + event drain under one cache lock hold.
-                snapshot = self._arena.take_snapshot()
-            else:
-                snapshot = self.cache.snapshot()
-        except Exception as exc:
-            if not self._containable(exc):
-                raise
-            return self._contain_cycle(
-                result, heads, "snapshot_error", exc, start
-            )
-        bucket = self._pick_bucket(len(heads))
+        if snapshot is None:
+            try:
+                if faults.ENABLED:
+                    faults.fire(faults.CACHE_SNAPSHOT)
+                if self._arena is not None:
+                    # Snapshot + event drain under one cache lock hold.
+                    snapshot = self._arena.take_snapshot()
+                else:
+                    snapshot = self.cache.snapshot()
+            except Exception as exc:
+                if not self._containable(exc):
+                    raise
+                return self._contain_cycle(
+                    result, heads, "snapshot_error", exc, start
+                )
+        if bucket is None:
+            bucket = self._pick_bucket(len(heads))
         # Flight-recorder scratch: generation fingerprint pinned at
         # snapshot time (apply bumps the live counters), stage timings
         # filled in as the cycle progresses. None when recording is off —
@@ -588,6 +754,11 @@ class DeviceScheduler:
             )
         if rec_t is not None:
             rec_t["encode_s"] = self.clock() - rec_t.pop("t0")
+        if self._tile_carry is not None:
+            # The memory story of tiling: what the tile actually
+            # materialized, vs the monolithic plane the full head set
+            # would have needed (bench --probe tiled's headline).
+            self._tile_carry.note_plane(plane_nbytes(arrays))
 
         # Trees with an encode-fallback entry route through the host
         # wholesale (device rows included, see the discard comment below),
@@ -729,7 +900,7 @@ class DeviceScheduler:
                 pre_done = True
                 if rec_t is not None:
                     rec_t["overlap_host_s"] = host_dt
-            if self._pipeline_on and fault is None:
+            if self._pipeline_on and tile is None and fault is None:
                 # Pipeline stage: while the device still solves cycle N,
                 # stage cycle N+1's speculative encode from the pre-apply
                 # state. Contained — a staging failure aborts only the
@@ -943,18 +1114,23 @@ class DeviceScheduler:
                 duration_s=result.duration_s,
                 idx=idx, planes=planes,
                 kernel=(
-                    entry + (
-                        f"[{self._auto_choice[0]}]"
-                        if self._auto_choice[0] else ""
+                    (
+                        entry + (
+                            f"[{self._auto_choice[0]}]"
+                            if self._auto_choice[0] else ""
+                        ) + (
+                            # Which slot path decided the cycle: one
+                            # vectorized pass ([slot-fp]) or the bounded
+                            # conflict scan with its round count.
+                            "[slot-fp]" if self._last_slot_rounds == 0
+                            else f"[slot-scan:{self._last_slot_rounds}]"
+                            if self._last_slot_rounds is not None else ""
+                        )
+                        if planes is not None else ""
                     ) + (
-                        # Which slot path decided the cycle: one
-                        # vectorized pass ([slot-fp]) or the bounded
-                        # conflict scan with its round count.
-                        "[slot-fp]" if self._last_slot_rounds == 0
-                        else f"[slot-scan:{self._last_slot_rounds}]"
-                        if self._last_slot_rounds is not None else ""
+                        f"[tile {tile[0]}/{tile[1]}]"
+                        if tile is not None else ""
                     )
-                    if planes is not None else ""
                 ),
             )
         return result

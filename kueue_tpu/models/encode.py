@@ -547,17 +547,32 @@ def encode_cycle(
         s_valid = np.zeros((w, s_n), dtype=bool)
         w_simple = np.zeros(w, dtype=bool)
 
+    m = len(device_wls)
+    if m:
+        # Batched column fills: the cold/full-encode row builder is pure
+        # host work the arena cannot amortize, and per-row scalar ndarray
+        # stores dominated it. One vectorized assignment per column
+        # replaces m scalar stores each (before/after numbers in
+        # docs/perf.md, "encode" note); the loop below keeps only the
+        # sparse/ragged work (request dicts, partial rows, eligibility
+        # cache, slot layouts).
+        w_cq[:m] = [tidx.node_of[info.cluster_queue] for info in device_wls]
+        w_active[:m] = True
+        w_priority[:m] = [info.priority() for info in device_wls]
+        w_timestamp[:m] = [
+            queue_order_timestamp(info.obj) for info in device_wls
+        ]
+        w_qr[:m] = [has_quota_reservation(info.obj) for info in device_wls]
+        w_gates[:m] = [
+            bool(info.obj.preemption_gates) for info in device_wls
+        ]
+        w_cnt[:m] = [info.obj.pod_sets[0].count for info in device_wls]
+        w_minc[:m] = w_cnt[:m]
+
     for i, info in enumerate(device_wls):
         idx.workloads.append(info)
         slots = wl_slots[i]
         cqs = snapshot.cluster_queues[info.cluster_queue]
-        ni = tidx.node_of[info.cluster_queue]
-        w_cq[i] = ni
-        w_active[i] = True
-        w_priority[i] = info.priority()
-        w_timestamp[i] = queue_order_timestamp(info.obj)
-        w_qr[i] = has_quota_reservation(info.obj)
-        w_gates[i] = bool(info.obj.preemption_gates)
         # Legacy request vector = slot 0 (equals total_requests[0] for
         # single-slot first-RG workloads; the per-entry preemption and
         # partial-admission kernels only apply to those — w_simple_slot).
@@ -565,8 +580,6 @@ def encode_cycle(
             if res in tidx.resource_of:
                 w_req[i, tidx.resource_of[res]] = v
         ps0 = info.obj.pod_sets[0]
-        w_cnt[i] = ps0.count
-        w_minc[i] = ps0.count
         if (partial_on and ps0.min_count is not None
                 and ps0.min_count < ps0.count):
             # Reducible entry (vetted by _device_compatible: single
@@ -1694,3 +1707,109 @@ def _device_compatible(
     # Coverage is guaranteed by the slot computation (None on any
     # uncovered positive request).
     return True
+
+
+# ---------------------------------------------------------------------------
+# Tiled streaming admission (models/driver.py _schedule_tiled): the
+# tile-view encoder is encode_cycle itself called per tile — only the
+# tile's w_*/s_* planes are ever materialized — plus the planner below,
+# which decides which heads may share a tile without changing results.
+
+
+def plane_nbytes(arrays) -> int:
+    """Total bytes of the materialized cycle planes (host or device).
+
+    Sums ``nbytes`` over every array leaf of ``arrays`` — the number the
+    tiled mode bounds: a W-tile's planes instead of the full backlog's.
+    Non-array leaves (e.g. an unregistered topology handle) count zero.
+    """
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(arrays):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def plan_tiles(
+    heads: Sequence[WorkloadInfo],
+    tile_width: int,
+    snapshot: Snapshot,
+) -> List[List[WorkloadInfo]]:
+    """Pack pending heads into W-tiles without splitting a solve-coupled
+    group across tile boundaries.
+
+    Tiling is exact because the batched kernels solve cohort trees
+    independently — quota never crosses a root — so a tile holding whole
+    trees reproduces the monolithic cycle's per-row outcomes. Two
+    couplings survive the root split and are fused here:
+
+    - TAS topology capacity is physical state shared by every tree whose
+      CQs cover the same device-encoded TAS flavor: trees sharing one
+      are unioned into a single group, so their gangs place against one
+      consistent topology plane instead of racing across tiles.
+    - Heads whose CQ is missing from the snapshot ride as singletons
+      (they host-fallback inside their tile either way).
+
+    Groups are ordered by their best head's queue rank
+    ``(-priority, timestamp)`` — the order the monolithic cycle would
+    consider them — and greedily packed up to ``tile_width`` rows. A
+    group wider than the tile gets its own oversized tile: correctness
+    over the bound; the peak plane becomes ``max(tile_width bucket,
+    widest-group bucket)``, which docs/perf.md calls out.
+    """
+    parent: Dict[object, object] = {}
+
+    def find(x):
+        r = x
+        while parent[r] != r:
+            r = parent[r]
+        while parent[x] != r:
+            parent[x], x = r, parent[x]
+        return r
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    keys: List[object] = []
+    for i, info in enumerate(heads):
+        cqs = snapshot.cluster_queues.get(info.cluster_queue)
+        if cqs is None:
+            key = ("solo", i)
+            parent.setdefault(key, key)
+            keys.append(key)
+            continue
+        key = ("root", id(cqs.node.root()))
+        parent.setdefault(key, key)
+        keys.append(key)
+        if snapshot.tas_flavors:
+            for rg in cqs.spec.resource_groups:
+                for fq in rg.flavors:
+                    if fq.name in snapshot.tas_flavors:
+                        fkey = ("tas", fq.name)
+                        parent.setdefault(fkey, fkey)
+                        union(key, fkey)
+
+    groups: Dict[object, List[WorkloadInfo]] = {}
+    for info, key in zip(heads, keys):
+        groups.setdefault(find(key), []).append(info)
+
+    def rank(info: WorkloadInfo):
+        return (-info.priority(), queue_order_timestamp(info.obj), info.key)
+
+    ordered = sorted(groups.values(), key=lambda g: min(rank(h) for h in g))
+    tiles: List[List[WorkloadInfo]] = []
+    cur: List[WorkloadInfo] = []
+    for group in ordered:
+        if cur and len(cur) + len(group) > tile_width:
+            tiles.append(cur)
+            cur = []
+        cur.extend(group)
+        if len(cur) >= tile_width:
+            tiles.append(cur)
+            cur = []
+    if cur:
+        tiles.append(cur)
+    return tiles

@@ -23,11 +23,22 @@ accumulation stays below CAP32, so the int32 math is bit-equivalent to the
 int64 path; callers must fall back to the XLA scan when it returns False
 (real kueue quantities are canonical milli-units/bytes and can exceed
 2**30 — e.g. 1Gi of memory is 2**30 bytes exactly).
+
+Status (PR 17): RETIRED TO OPT-IN. The BENCH_TPU_LIVE ``RecursionError``
+(the Mosaic int64->int32 lowering recursion above) was re-probed against
+the post-PR-8/11/15 kernel set; with the sequential scans eliminated,
+the fixed-point kernels now carry the mega probe and the Pallas variants
+no longer earn their live-hardware risk. The module and its interpret-
+mode differential tests stay, but the bench probes only dispatch Pallas
+when ``KUEUE_TPU_ENABLE_PALLAS=1`` (``opt_in()``); otherwise the mega
+probe routes to the fixed-point/grouped kernels. Decision recorded in
+docs/perf.md ("Pallas scan: retired to opt-in").
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -50,6 +61,17 @@ from kueue_tpu.ops import quota_ops
 # degenerates to avail for unlimited borrow limits exactly like the
 # int64 path.
 CAP32 = int(quota_ops.CAP32)
+
+#: Env flag gating live Pallas dispatch in the bench probes (module
+#: docstring "Status"): the interpret-mode differentials always run, but
+#: live TPU probes skip the Pallas variants unless this is set to "1".
+PALLAS_OPT_IN_ENV = "KUEUE_TPU_ENABLE_PALLAS"
+
+
+def opt_in() -> bool:
+    """Whether live Pallas probe dispatch is explicitly enabled."""
+    return os.environ.get(PALLAS_OPT_IN_ENV) == "1"
+
 
 _META_LOCAL_BITS = 16  # low bits of slot meta = local node id
 _META_ADMIT = 1 << 16  # entry is FIT, active, in range, not host-deferred

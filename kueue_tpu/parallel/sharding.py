@@ -195,9 +195,16 @@ def _sim_out_proto():
 
 
 def _out_proto(preempt: bool, arrays: CycleArrays):
+    """CycleOutputs prototype with the same None/non-None structure the
+    grouped kernel emits for ``arrays`` — out_shardings pytrees must
+    match the output tree exactly, so every conditional output plane
+    (including the post-PR-15 per-slot TAS takes and the trailing
+    ``slot_rounds`` carry) mirrors make_grouped_cycle's with_* gates."""
     has_slots = arrays.s_req is not None
     has_partial = arrays.w_partial is not None
     has_tas = arrays.tas_topo is not None
+    has_leader = has_tas and arrays.w_tas_leader_req is not None
+    has_stas = has_tas and arrays.s_tas is not None
     return batch_scheduler.CycleOutputs(
         outcome=0, chosen_flavor=0, borrow=0, tried_flavor_idx=0,
         usage=0, order=0,
@@ -208,4 +215,7 @@ def _out_proto(preempt: bool, arrays: CycleArrays):
         s_pmode=0 if has_slots else None,
         s_tried=0 if has_slots else None,
         tas_takes=0 if has_tas else None,
+        tas_leader_takes=0 if has_leader else None,
+        s_tas_takes=0 if has_stas else None,
+        slot_rounds=0 if has_stas else None,
     )

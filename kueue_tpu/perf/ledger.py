@@ -81,6 +81,13 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "tas_slot_speedup": "higher",
         "tas_compile_s_delta": "lower",
     },
+    # Tiled streaming admission: the bounded-arena peak plane (the
+    # memory story) and the live tiled-vs-monolithic wall delta (the
+    # honest CPU-box overhead of dispatching per tile).
+    "tiled": {
+        "tiled_peak_plane_mb": "lower",
+        "tiled_vs_mono_delta_pct": "lower",
+    },
 }
 
 _REQUIRED_KEYS = (

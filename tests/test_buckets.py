@@ -169,3 +169,54 @@ def test_driver_pick_bucket_delegates_to_ladder():
     assert sched._pick_bucket(20) == 32
     assert sched._w_ladder.value == 32
     assert sched._w_ladder.patience == DeviceScheduler._SHRINK_PATIENCE
+
+
+# -- beyond the 50k flagship (tiled streaming admission) --------------------
+#
+# The tiled dispatch mode (models/driver.py::_schedule_tiled) resolves
+# every tile's row count through this same ladder, so rungs in the
+# 500k-1M regime must stay exact 1024-multiples and idempotent — a
+# drifting rung there would mint a fresh executable per backlog size.
+
+
+def test_rungs_at_500k_and_1m_are_1024_multiples():
+    assert bucket_for(500_000) == 500_736
+    assert bucket_for(1_000_000) == 1_000_448
+    for n in (65_537, 100_000, 500_000, 999_999, 1_000_000):
+        b = bucket_for(n)
+        assert b >= n
+        assert b % 1024 == 0, (n, b)
+        assert bucket_for(b) == b, (n, b)  # idempotent: rung is a rung
+        assert b - n < 1024, (n, b)  # tight: never a full spare rung
+
+
+def test_tile_widths_are_their_own_rungs():
+    """Every tile width the driver can pick (the auto width and the
+    pow2 explicit widths docs/perf.md recommends) is already a ladder
+    rung, so a tiled cycle compiles exactly one executable shape."""
+    from kueue_tpu.models.driver import DeviceScheduler
+
+    assert bucket_for(DeviceScheduler._TILE_AUTO_WIDTH) == \
+        DeviceScheduler._TILE_AUTO_WIDTH
+    for width in (1024, 2048, 4096, 8192, 16_384):
+        assert bucket_for(width) == width
+
+
+def test_shrink_hysteresis_across_tile_widths():
+    """A ladder that saw a 1M monolithic backlog shrinks one 1024 rung
+    per patience window once observations drop to tile widths — it
+    never jumps straight down, and tile-sized observations behave like
+    any other fit."""
+    lad = BucketLadder()
+    assert lad.observe(1_000_000) == 1_000_448
+    for _ in range(3):
+        assert lad.observe(8192) == 1_000_448  # fits bank up
+    assert lad.observe(8192) == 999_424  # 4th fit: exactly one 1024 rung
+    for _ in range(3):
+        assert lad.observe(8192) == 999_424
+    assert lad.observe(8192) == 998_400  # next window: one more rung
+    # An intervening full-backlog observation resets the streak.
+    assert lad.observe(999_000) == 999_424
+    for _ in range(3):
+        assert lad.observe(2048) == 999_424
+    assert lad.observe(2048) == 998_400

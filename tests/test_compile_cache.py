@@ -417,3 +417,73 @@ def test_slot_prewarm_zero_compiles_after():
     finally:
         compile_cache.dispatch = orig
     assert set(dispatched) == {"cycle_grouped_preempt"}, dispatched
+
+
+def test_tiled_prewarm_adds_tile_rung():
+    """With an explicit tile width the prewarm warms one extra rung at
+    bucket(tile_width) — keyed "tiled" — but ONLY when the W ladder
+    doesn't already cover that bucket (tile_width=20 -> bucket 32, off
+    the max_heads=16 ladder)."""
+    cache, queues = _env()
+    sched = DeviceScheduler(cache, queues, tile_width=20)
+    timings = sched.prewarm(max_heads=16, aot=False)
+    assert list(timings) == [16, "tiled"], timings
+    # A width whose bucket the ladder already covers adds nothing; so
+    # does auto below its threshold (no service pays 8192-row compiles
+    # unless its backlog can actually tile).
+    sched2 = DeviceScheduler(cache, queues, tile_width=16)
+    assert list(sched2.prewarm(max_heads=16, aot=False)) == [16]
+    sched3 = DeviceScheduler(cache, queues)  # auto
+    assert list(sched3.prewarm(max_heads=16, aot=False)) == [16]
+
+
+def test_tiled_cycles_zero_compiles_after_prewarm():
+    """A warmed tiled driver admits through the per-tile dispatch loop
+    with ZERO new backend executables: every tile resolves to the same
+    bucket(tile_width) shape the prewarm compiled, and the cross-tile
+    carry (the arena event stream) adds no device programs. Steady
+    state (admissions completed each cycle) — a monotonically GROWING
+    admitted set crosses pow2 dirty-row buckets and compiles fresh
+    arena scatters in tiled and monolithic mode alike, which is the
+    arena's documented bucketing, not a tiling cost."""
+    from kueue_tpu.api.types import Cohort
+
+    compile_cache.install_listeners()
+    cache, queues, _ = build_env(
+        [
+            make_cq(f"cq-{c}{q}", cohort=f"co-{c}", flavors={
+                "default": {"cpu": ResourceQuota(nominal=4000)},
+            })
+            for c in range(2)
+            for q in range(3)
+        ],
+        cohorts=[Cohort(name=f"co-{c}") for c in range(2)],
+    )
+    sched = DeviceScheduler(cache, queues, tile_width=4)
+    sched.prewarm(max_heads=16, aot=False)
+    wls = [
+        make_wl(f"w{i}", f"lq-cq-{c}{q}", cpu_m=500,
+                creation_time=float(i * 6 + c * 3 + q + 1))
+        for i in range(4)
+        for c in range(2)
+        for q in range(3)
+    ]
+    submit(queues, *wls)
+
+    def cycle():
+        res = sched.schedule()
+        assert res.admitted
+        for key in res.admitted:
+            cache.delete_workload(key)  # steady state: complete at once
+        return res
+
+    cycle()
+    cycle()
+    compile_cache.reset_stats()
+    cycle()
+    cycle()
+    assert _compiles() == 0, compile_cache.stats()
+    carry = sched._last_tile_carry
+    assert carry is not None and carry.tiles == 2, vars(carry)
+    assert carry.rows == 6
+    assert carry.peak_plane_bytes > 0

@@ -66,7 +66,7 @@ def make_k():
 
 DRIVER_OK = '''
 class D:
-    def schedule(self):
+    def _schedule_heads(self):
         entry = "cycle_default"
         if arrays.s_req is None:
             entry = "cycle_k"
@@ -74,7 +74,7 @@ class D:
 
 DRIVER_DROPPED_REQ = '''
 class D:
-    def schedule(self):
+    def _schedule_heads(self):
         entry = "cycle_default"
         if idx.workloads:
             entry = "cycle_k"
@@ -82,7 +82,7 @@ class D:
 
 DRIVER_STALE_GATE = '''
 class D:
-    def schedule(self):
+    def _schedule_heads(self):
         entry = "cycle_default"
         if arrays.s_req is None and not idx.has_partial:
             entry = "cycle_k"

@@ -1,0 +1,183 @@
+"""Multi-chip spec derivation (parallel/sharding.py).
+
+The sharding spec is derived from CycleArrays field NAMES: per-workload
+tensors (``w_*``, and since the slot-layout work the ``s_*`` planes)
+shard their leading axis over the 1-D ``('w',)`` mesh; the quota tree,
+per-CQ policy, TAS topology and fair fields replicate. These tests pin
+that derivation for EVERY field — including everything added since the
+multi-chip PR: the slot layout (``s_req``..``w_simple_slot``), device
+preemption policy planes, partial admission, the device-TAS family, the
+LWS leader rows, the per-slot TAS planes and the fair-sharing fields —
+so a new encoder field cannot silently land on the wrong placement.
+
+``_out_proto`` is pinned too: out_shardings pytrees must match the
+kernel's output tree None-structure exactly, so each conditional output
+plane (victim planes, partial counts, slot choices, TAS takes, the
+post-PR-15 per-slot takes and trailing ``slot_rounds`` carry) must
+mirror make_grouped_cycle's ``with_*`` gates.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from kueue_tpu.models.encode import CycleArrays
+from kueue_tpu.parallel import sharding
+
+
+def full_proto(**overrides):
+    """A CycleArrays with EVERY field non-None (dummy leaves): the spec
+    derivation only looks at names and None-ness."""
+    fields = {name: 0 for name in CycleArrays._fields}
+    fields.update(overrides)
+    return CycleArrays(**fields)
+
+
+def spec_of(sh):
+    return sh.spec
+
+
+# -- arrays_shardings: every field, by name ---------------------------------
+
+
+def test_every_field_has_the_expected_placement():
+    mesh = sharding.make_mesh()
+    specs = sharding.arrays_shardings(mesh, full_proto())
+    for name in CycleArrays._fields:
+        want = P("w") if name.startswith(("w_", "s_")) else P()
+        got = spec_of(getattr(specs, name))
+        assert got == want, (name, got, want)
+
+
+def test_sharded_field_inventory_is_explicit():
+    """The exact set of workload-axis fields, written out. Adding an
+    encoder field means updating this list deliberately — deciding its
+    placement — not inheriting one by accident."""
+    expected = {
+        # legacy per-workload vectors
+        "w_cq", "w_req", "w_elig", "w_active", "w_priority",
+        "w_timestamp", "w_quota_reserved", "w_start_flavor",
+        "w_order_rank",
+        # slot layout
+        "s_req", "s_elig", "s_flavor_at", "s_n_flavors", "s_start",
+        "s_valid", "w_simple_slot",
+        # partial admission
+        "w_req_pp", "w_count", "w_min_count", "w_partial", "w_has_gates",
+        # device TAS per-entry rows
+        "w_tas", "w_tas_req", "w_tas_usage_req", "w_tas_count",
+        "w_tas_slice_size", "w_tas_req_level", "w_tas_slice_level",
+        "w_tas_sizes", "w_tas_required", "w_tas_unconstrained",
+        "w_tas_invalid", "w_tas_balanced", "w_tas_cap", "w_tas_has_cap",
+        # LWS leader group
+        "w_tas_leader_req", "w_tas_leader_usage_req", "w_tas_has_leader",
+        # per-slot TAS planes (PR 15 slot layouts)
+        "s_tas", "s_tas_req", "s_tas_usage_req", "s_tas_count",
+        "s_tas_slice_size", "s_tas_req_level", "s_tas_slice_level",
+        "s_tas_sizes", "s_tas_required", "s_tas_unconstrained",
+    }
+    derived = {
+        n for n in CycleArrays._fields if n.startswith(("w_", "s_"))
+    }
+    assert derived == expected
+
+
+def test_replicated_families_stay_replicated():
+    """Spot-pin the families that must NOT shard: tree/usage, per-CQ
+    policy, the preemption prefilter, TAS topology state and fair
+    weights are indexed by CQ/flavor/topology — scattering them over the
+    workload mesh axis would be wrong, not just slow."""
+    mesh = sharding.make_mesh()
+    specs = sharding.arrays_shardings(mesh, full_proto())
+    for name in (
+        "tree", "usage", "flavor_at", "covered", "usage_by_prio",
+        "prio_cuts", "policy_within", "nominal_cq", "bwc_policy",
+        "preempt_simple", "preempt_hier", "tas_topo", "tas_usage0",
+        "tas_of_flavor", "node_weight", "fair_preempt_ok",
+    ):
+        assert spec_of(getattr(specs, name)) == P(), name
+
+
+def test_none_fields_stay_none():
+    """A None field must map to None in the spec pytree (in_shardings
+    structure has to match the argument structure)."""
+    mesh = sharding.make_mesh()
+    proto = full_proto(s_req=None, s_tas=None, tas_topo=None,
+                       node_weight=None)
+    specs = sharding.arrays_shardings(mesh, proto)
+    assert specs.s_req is None
+    assert specs.s_tas is None
+    assert specs.tas_topo is None
+    assert specs.node_weight is None
+    # and non-None neighbours are unaffected
+    assert spec_of(specs.w_cq) == P("w")
+
+
+# -- _out_proto: conditional output planes mirror the kernel gates ----------
+
+
+def none_structure(outputs):
+    return {
+        name: getattr(outputs, name) is not None
+        for name in type(outputs)._fields
+    }
+
+
+def test_out_proto_bare_cycle():
+    proto = full_proto(s_req=None, w_partial=None, tas_topo=None,
+                       w_tas_leader_req=None, s_tas=None)
+    got = none_structure(sharding._out_proto(preempt=False, arrays=proto))
+    assert got["victims"] is False
+    assert got["victim_variant"] is False
+    assert got["partial_count"] is False
+    assert got["s_flavor"] is False
+    assert got["tas_takes"] is False
+    assert got["tas_leader_takes"] is False
+    assert got["s_tas_takes"] is False
+    assert got["slot_rounds"] is False
+    # unconditional outputs always present
+    for name in ("outcome", "chosen_flavor", "borrow", "usage", "order"):
+        assert got[name] is True, name
+
+
+def test_out_proto_slots_and_partial():
+    proto = full_proto(tas_topo=None, w_tas_leader_req=None, s_tas=None)
+    got = none_structure(sharding._out_proto(preempt=True, arrays=proto))
+    assert got["victims"] is True
+    assert got["partial_count"] is True
+    assert got["s_flavor"] is True and got["s_pmode"] is True
+    assert got["s_tried"] is True
+    assert got["tas_takes"] is False
+    assert got["slot_rounds"] is False
+
+
+def test_out_proto_tas_without_leader_or_slot_planes():
+    proto = full_proto(w_tas_leader_req=None, s_tas=None)
+    got = none_structure(sharding._out_proto(preempt=True, arrays=proto))
+    assert got["tas_takes"] is True
+    assert got["tas_leader_takes"] is False
+    assert got["s_tas_takes"] is False
+    assert got["slot_rounds"] is False
+
+
+def test_out_proto_slot_tas_emits_takes_and_rounds_together():
+    """The per-slot TAS pass emits its takes plane AND the trailing
+    slot_rounds carry as a pair — both keyed on s_tas AND tas_topo."""
+    proto = full_proto(w_tas_leader_req=None)
+    got = none_structure(sharding._out_proto(preempt=True, arrays=proto))
+    assert got["s_tas_takes"] is True
+    assert got["slot_rounds"] is True
+    # s_tas planes without a device topology never reach the kernel's
+    # slot pass: the gate is has_tas AND s_tas.
+    proto2 = full_proto(tas_topo=None, w_tas_leader_req=None)
+    got2 = none_structure(sharding._out_proto(preempt=True, arrays=proto2))
+    assert got2["s_tas_takes"] is False
+    assert got2["slot_rounds"] is False
+
+
+def test_out_proto_full():
+    got = none_structure(
+        sharding._out_proto(preempt=True, arrays=full_proto())
+    )
+    # converged/fp_rounds belong to the fixed-point kernels only; the
+    # scan kernels _out_proto models never emit them.
+    assert got.pop("converged") is False
+    assert got.pop("fp_rounds") is False
+    assert all(got.values()), [k for k, v in got.items() if not v]

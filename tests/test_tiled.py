@@ -1,0 +1,285 @@
+"""Tiled streaming admission differential (models/driver.py tentpole).
+
+The tiled dispatch mode streams pending heads through the bounded device
+arena in fixed-width tiles, carrying quota usage and admitted deltas
+across tiles through the arena's event stream: tile N+1 solves against
+tile N's post-apply usage. These tests pin the tentpole claim — a tiled
+cycle is BIT-IDENTICAL to the monolithic cycle — on randomized
+scenarios where cohort trees straddle tile boundaries, preemption
+victims land mid-stream, multi-podset TAS gangs share a fused topology
+group, and injected per-tile faults reroute single tiles host-exact
+without disturbing settled neighbours.
+
+Tile widths here are tiny (3-5) so even small forests split into
+several tiles; production widths (auto: 8192) change the packing, not
+the math.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.constants import PreemptionPolicy
+from kueue_tpu.api.types import ClusterQueuePreemption, ResourceQuota
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.utils import faults
+
+from .helpers import admitted_names, build_env, make_cq, make_wl, submit
+
+# Compile-heavy: run in its own subprocess via tools/run_isolated.py.
+pytestmark = pytest.mark.isolated
+
+PREEMPT = ClusterQueuePreemption(
+    reclaim_within_cohort=PreemptionPolicy.ANY,
+    within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+)
+
+
+def build(seed):
+    """Seeded forest: 3-5 cohorts x 2-3 CQs, borrowable quota, a first
+    wave of mixed-priority workloads and a second wave of high-priority
+    preemptors. Twin builds from the same seed are identical (explicit
+    creation_time everywhere)."""
+    rng = random.Random(61_000 + seed)
+    cqs = []
+    for c in range(rng.randint(3, 5)):
+        for q in range(rng.randint(2, 3)):
+            cqs.append(make_cq(
+                f"cq{c}q{q}", cohort=f"co{c}",
+                flavors={"default": {"cpu": ResourceQuota(
+                    nominal=4000, borrowing_limit=6000)}},
+                preemption=PREEMPT,
+            ))
+    cache, queues, _ = build_env(cqs)
+    t = 0.0
+    first, second = [], []
+    for cq in cqs:
+        for i in range(rng.randint(2, 4)):
+            t += 1.0
+            first.append(make_wl(
+                f"{cq.name}-w{i}", queue=f"lq-{cq.name}",
+                cpu_m=rng.choice([500, 1000, 2000, 3000]),
+                priority=rng.choice([0, 0, 100]),
+                creation_time=t,
+            ))
+        if rng.random() < 0.7:
+            t += 1.0
+            second.append(make_wl(
+                f"{cq.name}-hi", queue=f"lq-{cq.name}",
+                cpu_m=rng.choice([2000, 4000]), priority=200,
+                creation_time=t,
+            ))
+    return cache, queues, first, second
+
+
+def drive(sched, max_cycles=25):
+    """Per-cycle (admitted, preempted, skipped) for up to max_cycles.
+    Early exit only on true quiescence (two consecutive empty cycles) —
+    some reclaim-vs-borrow seeds oscillate forever, and the differential
+    claim is over the capped stream either way."""
+    out = []
+    idle = 0
+    for _ in range(max_cycles):
+        res = sched.schedule()
+        out.append((
+            tuple(sorted(res.admitted)),
+            tuple(sorted(res.preempted)),
+            tuple(sorted(res.skipped)),
+        ))
+        if res.admitted or res.preempted or res.head_keys:
+            idle = 0
+        else:
+            idle += 1
+            if idle >= 2:
+                break
+    return out
+
+
+def run(seed, tile_width, fault_plan=None):
+    cache, queues, first, second = build(seed)
+    sched = DeviceScheduler(cache, queues, tile_width=tile_width)
+    if fault_plan is not None:
+        faults.install(fault_plan)
+    try:
+        submit(queues, *first)
+        cycles = drive(sched)
+        submit(queues, *second)  # preemptors arrive mid-stream
+        cycles += drive(sched)
+    finally:
+        if fault_plan is not None:
+            faults.clear()
+    return cycles, admitted_names(cache), sched
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_tiled_matches_monolithic(seed):
+    """Randomized forests with preemption: tiled (width 4 — trees of
+    2-3 heads straddle every boundary) is bit-identical to monolithic,
+    per cycle and in the final admitted set."""
+    mono_cycles, mono_final, _ = run(seed, "off")
+    tiled_cycles, tiled_final, sched = run(seed, 4)
+    assert tiled_cycles == mono_cycles
+    assert tiled_final == mono_final
+    carry = sched._last_tile_carry
+    assert carry is not None and carry.tiles >= 2
+    assert carry.faulted_tiles == 0
+    assert carry.peak_plane_bytes > 0
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_tiled_fault_containment_is_invisible(seed):
+    """Per-tile faults (solver dispatch raising on a seeded schedule)
+    reroute only the faulted tile through the host-exact path; the
+    cycle stream still matches an UNFAULTED monolithic run exactly."""
+    mono_cycles, mono_final, _ = run(seed, "off")
+    plan = faults.FaultPlan(seed=seed)
+    plan.add(faults.SOLVER_DISPATCH, mode="raise", rate=0.4, times=3)
+    tiled_cycles, tiled_final, sched = run(seed, 4, fault_plan=plan)
+    assert plan.counts[(faults.SOLVER_DISPATCH, "raise")] > 0
+    assert sched.fault_fallback_cycles > 0
+    assert tiled_cycles == mono_cycles
+    assert tiled_final == mono_final
+
+
+def test_tiled_snapshot_fault_falls_back_whole_cycle():
+    """A fault in the shared pre-tile snapshot (before any tile runs)
+    contains at cycle granularity and still matches monolithic."""
+    mono_cycles, mono_final, _ = run(0, "off")
+    plan = faults.FaultPlan(seed=0)
+    plan.add(faults.CACHE_SNAPSHOT, mode="raise", rate=1.0, times=1)
+    tiled_cycles, tiled_final, _ = run(0, 4, fault_plan=plan)
+    assert plan.counts[(faults.CACHE_SNAPSHOT, "raise")] == 1
+    assert tiled_cycles == mono_cycles
+    assert tiled_final == mono_final
+
+
+def test_tile_width_validation():
+    cache, queues, *_ = build(0)
+    for bad in (0, -3, True, "sometimes", 2.5):
+        with pytest.raises(ValueError):
+            DeviceScheduler(cache, queues, tile_width=bad)
+    for ok in ("auto", "off", 1, 4096, "16"):
+        DeviceScheduler(cache, queues, tile_width=ok)
+
+
+def test_auto_mode_never_tiles_small_cycles():
+    """tile_width='auto' leaves every existing deployment untouched:
+    cycles at or below the auto threshold dispatch monolithically."""
+    cache, queues, first, _second = build(1)
+    sched = DeviceScheduler(cache, queues)  # default: auto
+    submit(queues, *first)
+    drive(sched)
+    assert sched._last_tile_carry is None
+    assert sched._resolve_tile_width(DeviceScheduler._TILE_AUTO_MIN) is None
+    assert (sched._resolve_tile_width(DeviceScheduler._TILE_AUTO_MIN + 1)
+            == DeviceScheduler._TILE_AUTO_WIDTH)
+
+
+def test_tas_gangs_straddling_tile_boundaries():
+    """Cohorts whose CQs share one device-encoded TAS flavor are FUSED
+    into a single tile group (topology capacity is physical state the
+    monolithic kernel arbitrates in one conflict pass); the remaining
+    quota-only cohorts pack around them. Multi-podset TAS gangs ride
+    the per-slot planes. Tiled == monolithic, including topology domain
+    assignments."""
+    from kueue_tpu.api.types import (
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        Topology,
+        TopologyRequest,
+        Workload,
+        quota,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.tas.snapshot import Node
+
+    LVL = ["tpu.rack", "kubernetes.io/hostname"]
+
+    def build_tas():
+        mgr = Manager()
+        objs = [
+            ResourceFlavor(name="tpu-v5e", topology_name="topo"),
+            Topology(name="topo", levels=LVL),
+        ]
+        # Two TAS cohorts sharing the flavor (fused group) + two plain
+        # cohorts (packable around the fused group).
+        for c in range(2):
+            cq = make_cq(f"tas{c}", cohort=f"tco{c}",
+                         flavors={"tpu-v5e": {"tpu": quota(64)}},
+                         resources=["tpu"], preemption=PREEMPT)
+            objs += [cq, LocalQueue(name=f"lq-tas{c}",
+                                    cluster_queue=f"tas{c}")]
+        for c in range(2):
+            cq = make_cq(f"plain{c}", cohort=f"pco{c}",
+                         flavors={"default": {"cpu": ResourceQuota(
+                             nominal=6000)}},
+                         preemption=PREEMPT)
+            objs += [cq, LocalQueue(name=f"lq-plain{c}",
+                                    cluster_queue=f"plain{c}")]
+        mgr.apply(*objs)
+        for r in range(2):
+            for h in range(2):
+                mgr.apply(Node(
+                    name=f"n{r}{h}", labels={"tpu.rack": f"r{r}"},
+                    capacity={"tpu": 8},
+                ))
+        wls = []
+        t = 0.0
+        for c in range(2):
+            for i in range(3):
+                t += 1.0
+                tr = TopologyRequest(required_level="tpu.rack")
+                wls.append(Workload(
+                    name=f"gang{c}-{i}", queue_name=f"lq-tas{c}",
+                    pod_sets=[
+                        PodSet(name="lead", count=1,
+                               requests={"tpu": 1},
+                               topology_request=tr),
+                        PodSet(name="work", count=2 + i,
+                               requests={"tpu": 2},
+                               topology_request=TopologyRequest(
+                                   required_level="tpu.rack")),
+                    ],
+                    priority=(i % 2) * 100,
+                    creation_time=t,
+                ))
+        for c in range(2):
+            for i in range(3):
+                t += 1.0
+                wls.append(make_wl(
+                    f"job{c}-{i}", queue=f"lq-plain{c}",
+                    cpu_m=2500, priority=(i % 3) * 100,
+                    creation_time=t,
+                ))
+        return mgr, wls
+
+    def state_of(mgr, wls):
+        out = {}
+        for wl in wls:
+            adm = wl.status.admission
+            if adm is None:
+                out[wl.name] = None
+            else:
+                out[wl.name] = [
+                    (sorted(psa.flavors.items()),
+                     sorted(psa.topology_assignment.domains)
+                     if psa.topology_assignment else None)
+                    for psa in adm.pod_set_assignments
+                ]
+        return out
+
+    def run_tas(tile_width):
+        mgr, wls = build_tas()
+        sched = DeviceScheduler(mgr.cache, mgr.queues,
+                                tile_width=tile_width)
+        for wl in wls:
+            mgr.create_workload(wl)
+        drive(sched)
+        return state_of(mgr, wls), sched
+
+    mono, _ = run_tas("off")
+    tiled, sched = run_tas(3)
+    assert tiled == mono
+    carry = sched._last_tile_carry
+    assert carry is not None and carry.tiles >= 2

@@ -9,7 +9,9 @@ conditions it needs to be exact as docstring markers::
     gate-requires: not idx.has_partial
     gate-requires: arrays.s_req is None
 
-``DeviceScheduler.schedule`` selects a kernel by assigning
+``DeviceScheduler._schedule_heads`` (the single dispatch site both the
+monolithic ``schedule`` path and the tiled ``_schedule_tiled`` loop
+funnel through) selects a kernel by assigning
 ``entry = "<name>"`` inside an if/elif chain. This walker pairs each
 assignment with the conditions that guard it and verifies, in both
 directions, that code and docs agree:
@@ -87,7 +89,10 @@ def dispatch_sites():
     driver_kernels = tuple(f for f in KERNEL_FILES
                            if f not in fleet_kernels)
     return (
-        (DRIVER, "schedule", driver_kernels),
+        # _schedule_heads is the one kernel-dispatch site in the driver:
+        # monolithic cycles call it once, the tiled mode once per tile —
+        # covering the tile dispatch path with the same gate pins.
+        (DRIVER, "_schedule_heads", driver_kernels),
         FLEET_SITE,
     )
 
@@ -184,7 +189,7 @@ class _GateCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def dispatch_gates(path: Path = DRIVER, func_name: str = "schedule"
+def dispatch_gates(path: Path = DRIVER, func_name: str = "_schedule_heads"
                    ) -> Dict[str, List[Tuple[str, int]]]:
     """entry name -> gate conjuncts guarding its assignment inside
     ``func_name`` in ``path``."""
