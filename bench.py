@@ -1455,6 +1455,280 @@ def probe_steady(scale: float):
     return out
 
 
+def probe_failover(scale: float, seed: int = 1808):
+    """Warm-failover drill (docs/failover.md): a primary ServiceLoop
+    with a ``Replicator`` streams crash-consistent records to a warm
+    standby through a durable ``LeaseStore`` while a steady-style churn
+    runs against it (paced submits, completion churn past a concurrency
+    target). At a seeded mid-churn step the primary "crashes": the
+    step's record is already durable (write-ahead of the ack) but its
+    acks die with the process, and a torn half-record is left on the
+    stream tail. The virtual clock runs the lease out, the standby
+    promotes (strict final replay, torn-tail truncation, lease CAS) and
+    the driver finishes the schedule against it, re-issuing every op
+    that was never acked (idempotent replay). Correctness gates by
+    differential against an unkilled twin run of the identical
+    schedule: zero lost and zero duplicated admission acks, zero
+    standby fingerprint mismatches, and the takeover window (promote +
+    first post-takeover admission cycle) pays zero backend compiles —
+    the standby's bucket ladder is AOT-warm from the shared store."""
+    import random
+    import shutil
+    import tempfile
+    from collections import Counter
+
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.controllers.ha import (
+        LeaseStore,
+        Replicator,
+        WarmStandby,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.perf import compile_cache as cc
+
+    n = max(24, int(round(240 * scale)))
+    batch = 4
+    churn_target = 8
+    lease_s = 0.5
+    dt = 0.05  # virtual seconds per step
+    heads = 16
+    submit_steps = (n + batch - 1) // batch
+    rng = random.Random(seed)
+    kill_step = rng.randint(max(1, submit_steps // 3),
+                            max(2, (2 * submit_steps) // 3))
+
+    workdir = tempfile.mkdtemp(prefix="kueue_tpu_failover_")
+    # Shared persistent compile cache + AOT executable store: the
+    # primary's prewarm populates it, the standby's (re-)prewarm loads
+    # from it — the takeover window must not compile.
+    cc.configure(cache_dir=os.path.join(workdir, "xla"))
+    cc.install_listeners()
+
+    def wl_for(i: int) -> Workload:
+        return Workload(
+            name=f"ha-{i}", queue_name="lq-ha",
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 1})],
+        )
+
+    def specs():
+        # Fresh objects per manager (apply takes ownership). Quota is
+        # ample — every key admits exactly once, so the differential is
+        # exact set equality, never an eviction race.
+        return [
+            ResourceFlavor(name="default"),
+            ClusterQueue(
+                name="cq-ha",
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(
+                        name="default",
+                        resources={"cpu": ResourceQuota(nominal=2 * n)},
+                    )],
+                )],
+            ),
+            LocalQueue(name="lq-ha", cluster_queue="cq-ha"),
+        ]
+
+    def one_run(kill: bool) -> dict:
+        clk = [0.0]
+        mkw = dict(use_device_scheduler=True, device_kernel="scan",
+                   clock=lambda: clk[0])
+        store = LeaseStore(
+            lease_duration_s=lease_s,
+            dir=os.path.join(workdir, "kill" if kill else "twin"),
+        )
+        mgr = Manager(**mkw)
+        mgr.apply(*specs())
+        mgr.prewarm(max_heads=heads, aot=True)
+        svc = mgr.service(tick_interval_s=None, idle_sleep_s=0.0,
+                          cycles_per_iter=4, telemetry_async=False)
+        rep = Replicator(store).attach(svc)
+        store.try_acquire("primary", clk[0])
+
+        standby = None
+        if kill:
+            standby = WarmStandby("standby", store, manager_kw=mkw)
+            standby.prewarm(max_heads=heads, aot=True)
+
+        acks: list = []      # every admission ack a client received
+        running: list = []   # acked keys not yet finished (churn pool)
+        cycle_box: list = []
+        svc.on_cycle.append(lambda r: cycle_box.extend(r.admitted))
+
+        submitted = 0
+        step = 0
+        crashed = False
+        while True:
+            clk[0] += dt
+            store.try_acquire("primary", clk[0])
+            while submitted < n and submitted < (step + 1) * batch:
+                svc.submit(wl_for(submitted))
+                submitted += 1
+            cycle_box.clear()
+            svc.step()
+            step += 1
+            step_acks = list(cycle_box)
+            if kill and step == kill_step:
+                # CRASH. The step's stream record is fsync'd
+                # (write-ahead) but its acks were never delivered, and
+                # the next append died mid-write: torn garbage on the
+                # tail (a length the file can't satisfy).
+                with open(store.stream.path, "ab") as f:
+                    f.write(b"\x00\x01\x00\x00torn-half-record")
+                crashed = True
+                break
+            acks.extend(step_acks)
+            running.extend(step_acks)
+            while len(running) > churn_target:
+                svc.finish(running.pop(0))
+            if standby is not None:
+                standby.poll(clk[0])
+            if submitted >= n and len(set(acks)) >= n:
+                svc.step()  # drain the last finishes
+                break
+            if step > submit_steps + 400:
+                break
+        out = {
+            "steps": step, "submitted": submitted,
+            "records_written": rep.records_written,
+            "stream_bytes": store.stream.size(),
+            "acks": acks, "crashed": crashed,
+        }
+        if not kill:
+            store.stream.close()
+            return out
+
+        # Run the lease out on the virtual clock, then let the standby
+        # take over and serve the rest of the schedule.
+        clk[0] += lease_s + dt
+        c0 = int(cc.stats().get("backend_compiles", 0))
+        t0 = time.perf_counter()
+        role = standby.poll(clk[0])
+        svc2 = standby.manager.service(
+            tick_interval_s=None, idle_sleep_s=0.0,
+            cycles_per_iter=4, telemetry_async=False,
+        )
+        rep2 = Replicator(store).attach(svc2)
+        cycle_box2: list = []
+        svc2.on_cycle.append(lambda r: cycle_box2.extend(r.admitted))
+
+        # Client recovery: re-issue everything never acked. Keys the
+        # stream already made durable are answered idempotently from
+        # the standby's state (admitted -> the single ack arrives now);
+        # only truly-lost ops are re-submitted for a fresh decision.
+        acked = set(acks)
+        for i in range(submitted):
+            key = wl_for(i).key
+            if key in acked:
+                continue
+            if key in standby.manager.workloads:
+                if key in standby.manager.cache.workloads:
+                    acks.append(key)
+                # else: still pending — admitted by a cycle below.
+            else:
+                svc2.submit(wl_for(i))
+        # Unconfirmed finishes (posted into the dead primary's ingest
+        # queue, never applied): re-issue; finish_workload is a no-op
+        # on an already-finished workload.
+        for key in list(running):
+            if key in standby.manager.workloads:
+                svc2.finish(key)
+
+        first_cycle = {}
+        while True:
+            clk[0] += dt
+            store.try_acquire("standby", clk[0])
+            while submitted < n and submitted < (step + 1) * batch:
+                svc2.submit(wl_for(submitted))
+                submitted += 1
+            cycle_box2.clear()
+            svc2.step()
+            step += 1
+            if not first_cycle:
+                first_cycle = {
+                    "takeover_ms": round(
+                        (time.perf_counter() - t0) * 1000.0, 3),
+                    "takeover_compiles": int(
+                        cc.stats().get("backend_compiles", 0)) - c0,
+                }
+            acks.extend(cycle_box2)
+            running.extend(cycle_box2)
+            while len(running) > churn_target:
+                svc2.finish(running.pop(0))
+            if submitted >= n and len(set(acks)) >= n:
+                svc2.step()
+                break
+            if step > submit_steps + 400:
+                break
+        store.stream.close()
+        out.update({
+            "acks": acks, "submitted": submitted, "steps": step,
+            "role": role, "promoted": standby.promoted,
+            "records_applied": standby.records_applied,
+            "replayed_at_takeover": standby.manager.metrics.get(
+                "failover_replayed_records"),
+            "truncated_bytes": standby.truncated_bytes,
+            "fingerprint_mismatches": standby.fingerprint_mismatches,
+            "promote_ms": round(
+                (standby.takeover_seconds or 0.0) * 1000.0, 3),
+            "records_written_2": rep2.records_written,
+            **first_cycle,
+        })
+        return out
+
+    log(f"failover: twin run (n={n}, {submit_steps} submit steps)")
+    twin = one_run(kill=False)
+    log(f"failover: kill run (kill step {kill_step})")
+    rec = one_run(kill=True)
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    twin_set = set(twin["acks"])
+    counts = Counter(rec["acks"])
+    lost = sorted(twin_set - set(counts))
+    dups = sorted(k for k, c in counts.items() if c > 1)
+    ok = bool(
+        rec["crashed"]
+        and rec.get("promoted")
+        and len(twin_set) == n
+        and not lost
+        and not dups
+        and set(counts) == twin_set
+        and rec.get("takeover_compiles") == 0
+        and rec.get("truncated_bytes", 0) > 0
+        and rec.get("fingerprint_mismatches") == 0
+    )
+    return {
+        "probe": "failover", "ok": ok,
+        "n_workloads": n, "seed": seed, "kill_step": kill_step,
+        "failover_takeover_ms": rec.get("takeover_ms"),
+        "failover_promote_ms": rec.get("promote_ms"),
+        "failover_lost_admissions": len(lost),
+        "failover_dup_admissions": len(dups),
+        "failover_takeover_compiles": rec.get("takeover_compiles"),
+        "failover_truncated_bytes": rec.get("truncated_bytes"),
+        "failover_replayed_records": rec.get("replayed_at_takeover"),
+        "fingerprint_mismatches": rec.get("fingerprint_mismatches"),
+        "twin_admitted": len(twin_set),
+        "recovered_admitted": len(set(counts)),
+        "records_written": rec.get("records_written"),
+        "records_applied": rec.get("records_applied"),
+        "stream_bytes": rec.get("stream_bytes"),
+        "twin_steps": twin["steps"], "kill_steps": rec["steps"],
+        "lost_keys": lost[:8], "dup_keys": dups[:8],
+        "fingerprint_extra": {"version": 1, "seed": seed},
+    }
+
+
 def probe_scanfloor(scale: float):
     """Scan-vs-fixed-point cycle latency + rounds-taken on tiny CPU-scale
     encoded cycles across three quota mixes (plain borrow-limits,
@@ -2067,7 +2341,8 @@ def main():
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
                              "steady", "scanfloor", "tas", "fleet",
-                             "tiled", "coldstart", "coldstart-child"],
+                             "tiled", "failover", "coldstart",
+                             "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -2130,6 +2405,7 @@ def main():
                 "tas": lambda: probe_tas(args.scale),
                 "fleet": lambda: probe_fleet(args.scale),
                 "tiled": lambda: probe_tiled(args.scale),
+                "failover": lambda: probe_failover(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

@@ -32,6 +32,22 @@ from kueue_tpu.core.workload_info import WorkloadInfo
 from kueue_tpu.tas.snapshot import Node, TASFlavorSnapshot
 
 
+class CursorLost(Exception):
+    """A workload-event cursor points into a trimmed (dropped) range of
+    the event log. Tailers must fall back to a full snapshot instead of
+    applying a gapped stream (replaying past a gap would silently lose
+    the trimmed mutations)."""
+
+    def __init__(self, cursor: int, base: int, end: int) -> None:
+        super().__init__(
+            f"event cursor {cursor} outside live log window "
+            f"[{base}, {end}]"
+        )
+        self.cursor = cursor
+        self.base = base
+        self.end = end
+
+
 class Cache:
     """reference cache.go:144."""
 
@@ -82,6 +98,9 @@ class Cache:
         # info). kind is +1 (added to the live tree) / -1 (removed).
         self._workload_events: list = []
         self._workload_event_base = 0
+        # Count of cap-trims applied to the event log; tailers holding a
+        # cursor into a trimmed range get CursorLost and must resync.
+        self.workload_event_trims = 0
         # Structure cache for TAS snapshots: keyed by the generations the
         # template actually depends on (quota + node inputs).
         self._tas_templates: Dict[str, tuple] = {}
@@ -194,6 +213,7 @@ class Cache:
             drop = len(self._workload_events) // 2
             del self._workload_events[:drop]
             self._workload_event_base += drop
+            self.workload_event_trims += 1
         self.admitted_generation += 1
 
     def _live_add(self, info: WorkloadInfo) -> None:
@@ -457,3 +477,20 @@ class Cache:
             else:
                 events = list(self._workload_events[cursor - base:])
             return self.snapshot(), events, end
+
+    def workload_events_since(self, cursor: int):
+        """Events recorded since ``cursor`` without a snapshot (the tail
+        path for replication streams). Returns ``(events, new_cursor)``.
+
+        Raises :class:`CursorLost` when the cap-trim dropped entries the
+        cursor still points at — the stream has a gap, so the tailer must
+        resync from a full snapshot rather than apply what remains.
+        (``snapshot_with_workload_events`` keeps its legacy ``events is
+        None`` convention for the arena encoder, which always has the
+        snapshot in hand to re-encode from.)"""
+        with self._lock:
+            base = self._workload_event_base
+            end = base + len(self._workload_events)
+            if cursor < base or cursor > end:
+                raise CursorLost(cursor, base, end)
+            return list(self._workload_events[cursor - base:]), end

@@ -1,4 +1,5 @@
-"""High-availability manager replication: leader lease + warm standby.
+"""High-availability admission serving: leader lease, crash-consistent
+replication stream, and a warm standby that takes over mid-churn.
 
 Behavioral analog of the reference's HA story: the scheduler only runs on
 the elected leader (reference pkg/scheduler/scheduler.go:230
@@ -11,7 +12,8 @@ The reference delegates durability to etcd (CRD status is the journal) and
 leases to the kube leader-election API. Standalone, the same contract is:
 
   * ``LeaseStore`` — the lease + journal backend (in-process here; the
-    same interface maps onto any CAS-capable store).
+    same interface maps onto any CAS-capable store). With ``dir=`` it
+    also carries a durable :class:`RecordLog` replication stream.
   * the leader publishes ``Manager.export_state()`` checkpoints and
     appends every accepted client object to an event journal; the
     checkpoint truncates the journal (etcd-compaction analog);
@@ -20,15 +22,51 @@ leases to the kube leader-election API. Standalone, the same contract is:
     leader's exclusive write;
   * on lease expiry a follower promotes: it re-applies the journal tail
     and starts scheduling from the recovered state.
+
+Two serving layers share that store:
+
+``HAReplica``
+    The original coarse replica: full ``export_state`` checkpoints plus
+    a client-object journal, recovered wholesale at promotion. Simple,
+    correct, O(state) per checkpoint.
+
+``Replicator`` + ``WarmStandby`` (docs/failover.md)
+    The streaming path the service loop uses. The primary's
+    :class:`Replicator` hooks ``ServiceLoop.step()`` (obs/service.py)
+    under the service lock and appends ONE record per step to the
+    store's :class:`RecordLog`: the step's ingested ops, the cache
+    workload events drained through the ``workload_events_since``
+    cursor, and a compact admitted-set fingerprint. Records are
+    length-prefixed, CRC-checked and fsync'd — a torn write at crash is
+    detected by framing and truncated at promotion, never replayed. The
+    :class:`WarmStandby` prewarms its bucket ladder from the shared AOT
+    store (perf/compile_cache.py), tails the stream applying records
+    idempotently, and on lease expiry promotes with its arenas already
+    generation-consistent — zero backend compiles at takeover.
+
+Every HA state mutation runs inside a ``_contained(...)`` scope: the
+named fault points (``ha.checkpoint_write`` / ``ha.event_tail`` /
+``ha.takeover``, utils/faults.py) fire at the top of the scope and any
+failure lands in the scope's circuit breaker instead of the caller
+(docs/fault_containment.md). tools/check_ha_containment.py enforces the
+invariant statically.
 """
 
 from __future__ import annotations
 
+import contextlib
 import itertools
+import json
+import os
+import struct
+import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from kueue_tpu.manager import Manager
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
 
 
 @dataclass
@@ -40,12 +78,156 @@ class Lease:
     expires_at: float = 0.0
 
 
-class LeaseStore:
-    """Shared lease + checkpoint + journal. In-process reference backend;
-    every mutation is synchronous and linearizable (the CAS the kube
-    leader-election client gets from the apiserver)."""
+# ----------------------------------------------------------------------
+# replication stream: length-prefixed, checksummed, fsync'd records
+# ----------------------------------------------------------------------
 
-    def __init__(self, lease_duration_s: float = 15.0) -> None:
+#: Record framing: big-endian (payload length, CRC32 of payload).
+_HEADER = struct.Struct(">II")
+
+
+class RecordLog:
+    """Append-only log of JSON records with torn-write detection.
+
+    Each record is ``_HEADER(len, crc32)`` + the JSON payload, written as
+    one buffer and fsync'd, so a crash mid-append leaves a tail that
+    fails either the length or the checksum — :meth:`scan` stops there
+    and the promoting standby calls :meth:`truncate_to` to drop it. A
+    *live* tailer must NOT truncate: the primary may legitimately be
+    mid-write; torn bytes are final only once the lease has expired.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fd: Optional[int] = None
+        self.bytes_written = (
+            os.path.getsize(path) if os.path.exists(path) else 0
+        )
+
+    def _ensure_fd(self) -> int:
+        if self._fd is None:
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+        return self._fd
+
+    def append(self, doc: dict) -> int:
+        """Append one record (single write + fsync); returns the end
+        byte offset. On a failed write the file is rolled back to the
+        pre-append length so a *live* stream never grows torn bytes —
+        only a crash can leave them."""
+        payload = json.dumps(doc, separators=(",", ":")).encode()
+        buf = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        fd = self._ensure_fd()
+        pos = self.bytes_written
+        try:
+            os.write(fd, buf)
+            os.fsync(fd)
+        except Exception:
+            with contextlib.suppress(OSError):
+                os.ftruncate(fd, pos)
+            raise
+        self.bytes_written = pos + len(buf)
+        return self.bytes_written
+
+    def scan(self, offset: int) -> Tuple[List[Tuple[dict, int]], bool]:
+        """Decode complete records from byte ``offset``; returns
+        ``([(doc, end_offset), ...], torn)`` where ``torn`` reports
+        undecodable trailing bytes (incomplete header/payload or CRC
+        mismatch). Never mutates the file."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                data = f.read()
+        except FileNotFoundError:
+            return [], False
+        out: List[Tuple[dict, int]] = []
+        pos = 0
+        while True:
+            if pos + _HEADER.size > len(data):
+                return out, pos < len(data)
+            ln, crc = _HEADER.unpack_from(data, pos)
+            end = pos + _HEADER.size + ln
+            if end > len(data):
+                return out, True
+            payload = data[pos + _HEADER.size:end]
+            if zlib.crc32(payload) != crc:
+                return out, True
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                return out, True
+            out.append((doc, offset + end))
+            pos = end
+
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def truncate_to(self, offset: int) -> int:
+        """Drop everything past ``offset`` (the promote-time torn-tail
+        cut); returns the number of bytes removed."""
+        size = self.size()
+        if size <= offset:
+            return 0
+        with open(self.path, "rb+") as f:
+            f.truncate(offset)
+            f.flush()
+            os.fsync(f.fileno())
+        self.bytes_written = offset
+        return size - offset
+
+    def close(self) -> None:
+        if self._fd is not None:
+            with contextlib.suppress(OSError):
+                os.close(self._fd)
+            self._fd = None
+
+
+class MemoryLog:
+    """In-process :class:`RecordLog` twin for stores without a ``dir``
+    (offsets are record indices; writes cannot tear)."""
+
+    def __init__(self) -> None:
+        self.records: List[dict] = []
+        self.bytes_written = 0
+
+    def append(self, doc: dict) -> int:
+        self.records.append(doc)
+        self.bytes_written += len(json.dumps(doc, separators=(",", ":")))
+        return len(self.records)
+
+    def scan(self, offset: int) -> Tuple[List[Tuple[dict, int]], bool]:
+        return (
+            [(doc, offset + i + 1)
+             for i, doc in enumerate(self.records[offset:])],
+            False,
+        )
+
+    def size(self) -> int:
+        return len(self.records)
+
+    def truncate_to(self, offset: int) -> int:
+        removed = max(0, len(self.records) - offset)
+        del self.records[offset:]
+        return removed
+
+    def close(self) -> None:
+        pass
+
+
+class LeaseStore:
+    """Shared lease + checkpoint + journal + replication stream.
+    In-process reference backend; every mutation is synchronous and
+    linearizable (the CAS the kube leader-election client gets from the
+    apiserver). With ``dir=`` the replication stream is a durable
+    :class:`RecordLog` a fresh process can recover from; without it the
+    stream is in-memory (same interface, same tests)."""
+
+    def __init__(self, lease_duration_s: float = 15.0,
+                 dir: Optional[str] = None) -> None:
         self.lease = Lease()
         self.lease_duration_s = lease_duration_s
         self.checkpoint: Optional[str] = None
@@ -53,6 +235,12 @@ class LeaseStore:
         # Journal of (seq, yaml-doc) accepted since the last checkpoint.
         self.journal: List[Tuple[int, str]] = []
         self._seq = itertools.count(1)
+        self.dir = dir
+        if dir:
+            os.makedirs(dir, exist_ok=True)
+            self.stream = RecordLog(os.path.join(dir, "replication.log"))
+        else:
+            self.stream = MemoryLog()
 
     # -- lease ---------------------------------------------------------
 
@@ -99,7 +287,501 @@ class RoleTracker:
             self.transitions.append(role)
 
 
-class HAReplica:
+# ----------------------------------------------------------------------
+# containment
+# ----------------------------------------------------------------------
+
+
+class _Containment:
+    """Breaker-guarded fault containment shared by every HA actor. Each
+    state-mutation scope fires its named fault point up front and books
+    any failure (breaker trip + ``ha_replication_errors_total``) before
+    letting it propagate; the call site decides whether to absorb it —
+    a generator context manager cannot skip its body, so a fault fired
+    on entry must raise, and callers that survive the failure wrap the
+    scope in try/except and leave their state un-advanced."""
+
+    breaker: CircuitBreaker
+
+    def _init_containment(self) -> None:
+        self.breaker = CircuitBreaker(
+            threshold=3, backoff_s=0.05, max_backoff_s=5.0
+        )
+
+    def _containment_metrics(self):
+        mgr = getattr(self, "manager", None)
+        return getattr(mgr, "metrics", None)
+
+    @contextlib.contextmanager
+    def _contained(self, point: str):
+        try:
+            if faults.ENABLED:
+                faults.fire(point)
+            yield
+        except Exception:
+            self.breaker.record_failure()
+            m = self._containment_metrics()
+            if m is not None:
+                m.inc("ha_replication_errors_total", {"point": point})
+            raise
+        else:
+            self.breaker.record_success()
+
+
+# ----------------------------------------------------------------------
+# fingerprints / digests
+# ----------------------------------------------------------------------
+
+
+def admitted_fingerprint(manager) -> dict:
+    """Compact admitted-set fingerprint streamed with every step record:
+    the CRC32 of the sorted admitted keys plus the count. Cheap enough
+    for every step; a mismatch on the standby means the replicas have
+    diverged and the next full checkpoint must resync."""
+    keys = sorted(manager.cache.workloads)
+    return {
+        "crc": zlib.crc32("\n".join(keys).encode()) & 0xFFFFFFFF,
+        "n": len(keys),
+    }
+
+
+def state_digest(manager) -> dict:
+    """Canonical, order-independent digest of the control-plane state
+    the failover differential gates on: the admitted set with per-key
+    admission assignments and usage, aggregate usage per CQ, and the
+    pending/finished sets. Condition timestamps are excluded — a standby
+    re-deciding an unacked admission does so at a later clock."""
+    from kueue_tpu.api.serialization import encode
+    from kueue_tpu.core.workload_info import is_finished
+
+    admitted: Dict[str, dict] = {}
+    usage: Dict[str, Dict[str, float]] = {}
+    for key in sorted(manager.cache.workloads):
+        info = manager.cache.workloads[key]
+        doc = encode(info.obj)
+        admitted[key] = {
+            "cq": info.cluster_queue,
+            "admission": (doc.get("status") or {}).get("admission"),
+            "usage": sorted(
+                (str(fr), float(v)) for fr, v in info.usage().items()
+            ),
+        }
+        cq_usage = usage.setdefault(info.cluster_queue, {})
+        for fr, v in info.usage().items():
+            cq_usage[str(fr)] = cq_usage.get(str(fr), 0.0) + float(v)
+    pending = sorted(
+        key for key, wl in manager.workloads.items()
+        if key not in manager.cache.workloads and not is_finished(wl)
+    )
+    finished = sorted(
+        key for key, wl in manager.workloads.items() if is_finished(wl)
+    )
+    return {
+        "admitted": admitted,
+        "usage": {cq: sorted(m.items()) for cq, m in usage.items()},
+        "pending": pending,
+        "finished": finished,
+    }
+
+
+# ----------------------------------------------------------------------
+# primary side: the service-loop replicator
+# ----------------------------------------------------------------------
+
+
+def _encode_ops(batch) -> Tuple[List[dict], int]:
+    """Serialize one step's ingested op tuples (obs/service.py post
+    format) into stream docs. Returns (ops, opaque_count): ``call`` ops
+    carry arbitrary closures and cannot be replayed — the caller must
+    follow with a full checkpoint."""
+    import yaml as _yaml
+
+    from kueue_tpu.api.serialization import encode
+
+    ops: List[dict] = []
+    opaque = 0
+    for op in batch:
+        kind = op[0]
+        if kind == "submit":
+            ops.append({
+                "op": "submit",
+                "doc": _yaml.safe_dump(encode(op[1]), sort_keys=False),
+            })
+        elif kind == "finish":
+            ops.append({
+                "op": "finish", "key": op[1], "success": bool(op[2]),
+            })
+        elif kind == "apply":
+            ops.append({
+                "op": "apply",
+                "docs": [
+                    _yaml.safe_dump(encode(o), sort_keys=False)
+                    for o in op[1]
+                ],
+            })
+        elif kind == "delete":
+            ops.append({
+                "op": "delete",
+                "doc": _yaml.safe_dump(encode(op[1]), sort_keys=False),
+            })
+        else:
+            ops.append({"op": "opaque", "kind": str(kind)})
+            opaque += 1
+    return ops, opaque
+
+
+class Replicator(_Containment):
+    """Primary-side stream producer, attached to a ``ServiceLoop`` via
+    :meth:`attach`. ``on_step`` runs INSIDE ``step()`` under the service
+    lock, after cycles and before telemetry — so every record is durable
+    (fsync'd) before any observer sees the step's results: an acked
+    admission is always recoverable (write-ahead of the ack).
+
+    Per step it appends one ``step`` record: the batch's ops, the cache
+    workload events drained through ``workload_events_since`` (a
+    ``CursorLost`` — the cap trimmed past our cursor — forces a full
+    checkpoint instead of a gapped stream), and the admitted-set
+    fingerprint. Failures trip the breaker; while it is open steps are
+    skipped (counted) and the stream is marked dirty so the first
+    successful write re-publishes a full checkpoint."""
+
+    def __init__(self, store: LeaseStore, full_every: int = 0) -> None:
+        self.store = store
+        #: 0 = full checkpoints only on demand (first step, opaque ops,
+        #: cursor loss, breaker recovery); N > 0 also every N steps.
+        self.full_every = full_every
+        self.manager = None
+        self._init_containment()
+        self._cursor = 0
+        self._steps = 0
+        self._dirty_full = True
+        self.records_written = 0
+
+    def attach(self, service) -> "Replicator":
+        service.replicator = self
+        self.manager = service.manager
+        return self
+
+    def on_step(self, manager, batch) -> None:
+        self.manager = manager
+        m = manager.metrics
+        self._steps += 1
+        if not self.breaker.allow():
+            self._dirty_full = True
+            m.inc("ha_replication_skipped_total")
+            return
+        try:
+            self._write_step(manager, batch, m)
+        except Exception:
+            # Contained: the step completes regardless; the failed
+            # append was rolled back to the previous record boundary,
+            # and the first successful write re-publishes a full
+            # checkpoint covering the gap.
+            self._dirty_full = True
+
+    def _write_step(self, manager, batch, m) -> None:
+        from kueue_tpu.cache.cache import CursorLost
+
+        with self._contained(faults.HA_CHECKPOINT_WRITE):
+            ops, opaque = _encode_ops(batch)
+            if opaque:
+                self._dirty_full = True
+            try:
+                events, cursor = manager.cache.workload_events_since(
+                    self._cursor
+                )
+            except CursorLost as exc:
+                # The event-log cap dropped entries we never streamed:
+                # resync from the end and ship a full checkpoint rather
+                # than a gapped stream.
+                self._dirty_full = True
+                events, cursor = [], exc.end
+            evs: List[dict] = []
+            wl_docs: Dict[str, str] = {}
+            if events:
+                import yaml as _yaml
+
+                from kueue_tpu.api.serialization import encode
+
+                for kind, key, cq, _items, _prio, _uid, info in events:
+                    evs.append({"e": int(kind), "key": key, "cq": cq})
+                    # Event-time usage was captured in the tuple, but the
+                    # workload object is shared and mutable — serialized
+                    # here, it carries the step-final status, which is
+                    # what the standby must converge to.
+                    wl_docs[key] = _yaml.safe_dump(
+                        encode(info.obj), sort_keys=False
+                    )
+            term = self.store.lease.term
+            need_full = self._dirty_full or (
+                self.full_every > 0
+                and self._steps % self.full_every == 0
+            )
+            if not (ops or evs or need_full):
+                return
+            b0 = self.store.stream.bytes_written
+            if ops or evs:
+                self.store.stream.append({
+                    "k": "step", "t": term, "ops": ops, "evs": evs,
+                    "wl": wl_docs, "cur": cursor,
+                    "fp": admitted_fingerprint(manager),
+                })
+                self.records_written += 1
+            if need_full:
+                state = manager.export_state()
+                self.store.stream.append({
+                    "k": "full", "t": term, "state": state,
+                    "cur": cursor,
+                })
+                self.records_written += 1
+                self.store.publish_checkpoint(state, term)
+                self._dirty_full = False
+            m.inc("ha_checkpoint_writes_total")
+            m.inc(
+                "ha_checkpoint_bytes_total",
+                value=float(self.store.stream.bytes_written - b0),
+            )
+            self._cursor = cursor
+
+
+# ----------------------------------------------------------------------
+# standby side: tail, apply, promote
+# ----------------------------------------------------------------------
+
+
+class WarmStandby(_Containment):
+    """A follower that tails the replication stream into its own Manager
+    and promotes on lease expiry.
+
+    Record application is idempotent (at-least-once delivery: a failed
+    apply never advances the stream offset, so the record is retried),
+    and the standby prewarms its device bucket ladder from the shared
+    AOT executable store up front — takeover schedules on warm
+    executables, zero backend compiles."""
+
+    def __init__(self, identity: str, store: LeaseStore,
+                 manager_kw: Optional[dict] = None) -> None:
+        self.identity = identity
+        self.store = store
+        self.manager_kw = dict(manager_kw or {})
+        self.manager = Manager(**self.manager_kw)
+        self.roletracker = RoleTracker()
+        self._init_containment()
+        self._offset = 0
+        self._cursor = 0
+        self._restored_term = 0
+        self._prewarm_kw: Optional[dict] = None
+        self._opaque_ops = 0
+        self.promoted = False
+        self.records_applied = 0
+        self.fingerprint_mismatches = 0
+        self.truncated_bytes = 0
+        self.takeover_seconds: Optional[float] = None
+
+    # -- warm-up -------------------------------------------------------
+
+    def prewarm(self, max_heads: int = 16, aot: bool = True) -> dict:
+        """Compile/load the standby's bucket ladder now (from the shared
+        AOT store when ``aot``), and remember the shape so a full-state
+        restore — which rebuilds the Manager — re-warms automatically."""
+        self._prewarm_kw = {"max_heads": max_heads, "aot": aot}
+        return self.manager.prewarm(max_heads=max_heads, aot=aot)
+
+    # -- stream application --------------------------------------------
+
+    def tail(self, strict: bool = False) -> Tuple[int, bool]:
+        """Apply every complete record past our offset; returns
+        ``(applied, torn)``. A record that fails to apply stops the scan
+        WITHOUT advancing past it (retried next poll); with ``strict``
+        the failure propagates (the promote path must not silently skip
+        tail state). Torn trailing bytes are reported, never truncated
+        here — only :meth:`promote` may cut them, once the primary's
+        lease is dead."""
+        m = self.manager.metrics
+        if not self.breaker.allow():
+            m.inc("ha_replication_skipped_total")
+            return 0, False
+        applied = 0
+        entries, torn = self.store.stream.scan(self._offset)
+        try:
+            with self._contained(faults.HA_EVENT_TAIL):
+                for doc, end_offset in entries:
+                    self._apply_record(doc)
+                    self._offset = end_offset
+                    applied += 1
+                    self.records_applied += 1
+        except Exception:
+            # Contained: the offset never advanced past the failed
+            # record — at-least-once delivery, retried next poll.
+            if strict:
+                raise
+        m = self.manager.metrics  # a full record replaces the manager
+        m.set_gauge(
+            "ha_replication_lag_records", float(len(entries) - applied)
+        )
+        return applied, torn
+
+    def _apply_record(self, doc: dict) -> None:
+        if doc.get("k") == "full":
+            self._apply_full(doc)
+        else:
+            self._apply_step(doc)
+
+    def _apply_full(self, doc: dict) -> None:
+        with self._contained(faults.HA_EVENT_TAIL):
+            self.manager = Manager.restore_state(
+                doc["state"], **self.manager_kw
+            )
+            self._restored_term = int(doc.get("t", 0))
+            self._cursor = int(doc.get("cur", 0))
+        if self._prewarm_kw is not None:
+            # restore_state built a fresh Manager (cold scheduler); the
+            # shared AOT store makes this a load, not a compile.
+            self.manager.prewarm(**self._prewarm_kw)
+
+    def _apply_step(self, doc: dict) -> None:
+        from kueue_tpu.api.serialization import load_manifests
+        from kueue_tpu.api.types import Workload
+        from kueue_tpu.core.workload_info import (
+            WorkloadInfo,
+            has_quota_reservation,
+            is_admitted,
+            is_finished,
+        )
+
+        mgr = self.manager
+        applied_events = 0
+        with self._contained(faults.HA_EVENT_TAIL):
+            for op in doc.get("ops", ()):
+                kind = op.get("op")
+                if kind == "submit":
+                    for obj in load_manifests(op["doc"]):
+                        if not isinstance(obj, Workload) \
+                                or obj.key in mgr.workloads:
+                            continue
+                        if is_admitted(obj) or has_quota_reservation(obj):
+                            # Admitted by the time the primary streamed
+                            # it; the cache add arrives as an ev below.
+                            mgr.workloads[obj.key] = obj
+                        else:
+                            mgr.create_workload(obj)
+                elif kind == "finish":
+                    wl = mgr.workloads.get(op.get("key"))
+                    if wl is not None and not is_finished(wl):
+                        mgr.finish_workload(
+                            wl, success=bool(op.get("success", True))
+                        )
+                elif kind == "apply":
+                    for text in op.get("docs", ()):
+                        for obj in load_manifests(text):
+                            mgr.apply(obj)
+                elif kind == "delete":
+                    for obj in load_manifests(op["doc"]):
+                        mgr.delete(obj)
+                else:
+                    # A ``call`` escape-hatch op: not replayable; the
+                    # primary marked the stream dirty and a full
+                    # checkpoint follows.
+                    self._opaque_ops += 1
+            decoded: Dict[str, Workload] = {}
+            for key, text in (doc.get("wl") or {}).items():
+                objs = load_manifests(text)
+                if objs:
+                    decoded[key] = objs[0]
+            for ev in doc.get("evs", ()):
+                key = ev.get("key")
+                wl = decoded.get(key)
+                if int(ev.get("e", 0)) > 0:
+                    if wl is None:
+                        continue
+                    mgr.workloads[key] = wl
+                    info = WorkloadInfo(wl, ev.get("cq") or "")
+                    info.sync_assignment_from_admission()
+                    mgr.cache.add_or_update_workload(info)
+                    mgr.queues.delete_workload(wl)
+                else:
+                    mgr.cache.delete_workload(key)
+                    if wl is not None and key in mgr.workloads:
+                        mgr.workloads[key] = wl
+                        mgr.queues.delete_workload(wl)
+                        if not is_finished(wl) and not (
+                            is_admitted(wl) or has_quota_reservation(wl)
+                        ):
+                            # Evicted/requeued on the primary — back to
+                            # pending here too.
+                            mgr.queues.add_or_update_workload(wl)
+                applied_events += 1
+        if applied_events:
+            mgr.metrics.inc(
+                "ha_events_applied_total", value=float(applied_events)
+            )
+        self._cursor = int(doc.get("cur", self._cursor))
+        fp = doc.get("fp")
+        if fp:
+            mine = admitted_fingerprint(mgr)
+            if (fp.get("crc"), fp.get("n")) != (mine["crc"], mine["n"]):
+                self.fingerprint_mismatches += 1
+                mgr.metrics.inc("ha_fingerprint_mismatch_total")
+
+    # -- control loop --------------------------------------------------
+
+    def poll(self, now: float) -> str:
+        """One standby beat: tail the stream; when the lease is
+        winnable, promote. Returns the current role."""
+        m = self.manager.metrics
+        if self.promoted:
+            self.store.try_acquire(self.identity, now)
+            m.set_gauge("ha_role", 1.0)
+            return "lead"
+        self.tail()
+        lease = self.store.lease
+        if lease.holder in (None, self.identity) \
+                or now >= lease.expires_at:
+            self.promote(now)
+        m = self.manager.metrics
+        m.set_gauge("ha_role", 1.0 if self.promoted else 0.0)
+        self.roletracker.observe(self.promoted)
+        return "lead" if self.promoted else "follow"
+
+    def promote(self, now: float) -> bool:
+        """Take over: the primary's lease is dead, so the torn tail (if
+        any) is final — apply the last complete records, cut the torn
+        bytes, acquire the lease. A failure anywhere aborts the
+        promotion (retried on the next poll) — the lease is never left
+        half-claimed."""
+        t0 = time.perf_counter()
+        try:
+            with self._contained(faults.HA_TAKEOVER):
+                replayed, torn = self.tail(strict=True)
+                if torn:
+                    cut = self.store.stream.truncate_to(self._offset)
+                    self.truncated_bytes += cut
+                    self.manager.metrics.inc(
+                        "failover_truncated_bytes", value=float(cut)
+                    )
+                if not self.store.try_acquire(self.identity, now):
+                    return False
+                self.promoted = True
+                self.roletracker.observe(True)
+                self.takeover_seconds = time.perf_counter() - t0
+                m = self.manager.metrics
+                m.inc("failover_takeovers_total")
+                m.observe("failover_takeover_seconds",
+                          self.takeover_seconds)
+                m.set_gauge("failover_replayed_records", float(replayed))
+        except Exception:
+            # Contained: promotion aborts whole — the lease was never
+            # claimed; retried on the next poll.
+            return False
+        return self.promoted
+
+
+# ----------------------------------------------------------------------
+# coarse replica (checkpoint + client-object journal)
+# ----------------------------------------------------------------------
+
+
+class HAReplica(_Containment):
     """One manager replica participating in leader election.
 
     Drive it with ``tick(now)``; submit client objects with ``submit``
@@ -114,6 +796,7 @@ class HAReplica:
         self.manager_kw = dict(manager_kw or {})
         self.manager = Manager(**self.manager_kw)
         self.roletracker = RoleTracker()
+        self._init_containment()
         self.checkpoint_every = checkpoint_every
         self._cycles_since_checkpoint = 0
         self._applied_seq = 0
@@ -127,17 +810,18 @@ class HAReplica:
         the current leader)."""
         if not self.store.is_leader(self.identity, now):
             return False
-        from kueue_tpu.api.serialization import encode
         import yaml as _yaml
 
+        from kueue_tpu.api.serialization import encode
         from kueue_tpu.api.types import Workload
 
-        if isinstance(obj, Workload):
-            self.manager.create_workload(obj)
-        else:
-            self.manager.apply(obj)
-        self.store.append_event(_yaml.safe_dump(encode(obj),
-                                                sort_keys=False))
+        with self._contained(faults.HA_CHECKPOINT_WRITE):
+            if isinstance(obj, Workload):
+                self.manager.create_workload(obj)
+            else:
+                self.manager.apply(obj)
+            self.store.append_event(_yaml.safe_dump(encode(obj),
+                                                    sort_keys=False))
         return True
 
     # -- replication ---------------------------------------------------
@@ -147,28 +831,30 @@ class HAReplica:
         standby manager (read-only — never schedules, never writes
         admissions; leader_aware_reconciler.go:60 semantics)."""
         store = self.store
-        if store.checkpoint is not None and \
-                store.checkpoint_term > self._restored_term:
-            self.manager = Manager.restore_state(
-                store.checkpoint, **self.manager_kw
-            )
-            self._restored_term = store.checkpoint_term
-            self._applied_seq = 0
         from kueue_tpu.api.serialization import load_manifests
         from kueue_tpu.api.types import Workload
 
-        for seq, doc in store.journal:
-            if seq <= self._applied_seq:
-                continue
-            for obj in load_manifests(doc):
-                if isinstance(obj, Workload):
-                    # Pending client submissions re-enter the queues; the
-                    # leader's admission outcomes arrive via checkpoints.
-                    if obj.key not in self.manager.workloads:
-                        self.manager.create_workload(obj)
-                else:
-                    self.manager.apply(obj)
-            self._applied_seq = seq
+        with self._contained(faults.HA_EVENT_TAIL):
+            if store.checkpoint is not None and \
+                    store.checkpoint_term > self._restored_term:
+                self.manager = Manager.restore_state(
+                    store.checkpoint, **self.manager_kw
+                )
+                self._restored_term = store.checkpoint_term
+                self._applied_seq = 0
+            for seq, doc in store.journal:
+                if seq <= self._applied_seq:
+                    continue
+                for obj in load_manifests(doc):
+                    if isinstance(obj, Workload):
+                        # Pending client submissions re-enter the queues;
+                        # the leader's admission outcomes arrive via
+                        # checkpoints.
+                        if obj.key not in self.manager.workloads:
+                            self.manager.create_workload(obj)
+                    else:
+                        self.manager.apply(obj)
+                self._applied_seq = seq
 
     def tick(self, now: float, max_cycles: int = 10) -> dict:
         """One control-loop beat: renew/contend the lease, then act the
@@ -176,9 +862,20 @@ class HAReplica:
         leading = self.store.try_acquire(self.identity, now)
         admitted: List[str] = []
         if leading and self.roletracker.role != "lead":
-            # Fresh promotion: recover the latest durable state first.
-            self._read_reconcile()
+            # Fresh promotion: recover the latest durable state first. A
+            # failed recovery aborts the promotion for this tick (never
+            # lead on unrecovered state); holding the lease, the replica
+            # retries on its next beat.
+            try:
+                with self._contained(faults.HA_TAKEOVER):
+                    self._read_reconcile()
+            except Exception:
+                return {"role": self.roletracker.role,
+                        "admitted": admitted}
         self.roletracker.observe(leading)
+        self.manager.metrics.set_gauge(
+            "ha_role", 1.0 if leading else 0.0
+        )
         if leading:
             for _ in range(max_cycles):
                 result = self.manager.schedule()
@@ -187,10 +884,17 @@ class HAReplica:
                     break
             self._cycles_since_checkpoint += 1
             if self._cycles_since_checkpoint >= self.checkpoint_every:
-                self.store.publish_checkpoint(
-                    self.manager.export_state(), self.store.lease.term
-                )
-                self._cycles_since_checkpoint = 0
+                try:
+                    with self._contained(faults.HA_CHECKPOINT_WRITE):
+                        self.store.publish_checkpoint(
+                            self.manager.export_state(),
+                            self.store.lease.term,
+                        )
+                        self._cycles_since_checkpoint = 0
+                except Exception:
+                    # Contained: the leader keeps serving; the next tick
+                    # retries the checkpoint publish.
+                    pass
         else:
             self._read_reconcile()
         return {"role": self.roletracker.role, "admitted": admitted}

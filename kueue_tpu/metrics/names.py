@@ -176,9 +176,27 @@ FLEET_SERIES = frozenset({
     "multikueue_remote_sync_retries_total",
 })
 
+# Warm failover / HA replication (controllers/ha.py + docs/failover.md):
+# the primary's crash-consistent replication stream, the warm standby's
+# tail/apply loop, and takeover outcomes.
+HA_SERIES = frozenset({
+    "ha_role",
+    "ha_checkpoint_writes_total",
+    "ha_checkpoint_bytes_total",
+    "ha_replication_errors_total",
+    "ha_replication_skipped_total",
+    "ha_replication_lag_records",
+    "ha_events_applied_total",
+    "ha_fingerprint_mismatch_total",
+    "failover_takeovers_total",
+    "failover_takeover_seconds",
+    "failover_replayed_records",
+    "failover_truncated_bytes",
+})
+
 METRIC_NAMES = (
     REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES | COST_SERIES
-    | SERVICE_SERIES | FLEET_SERIES
+    | SERVICE_SERIES | FLEET_SERIES | HA_SERIES
 )
 
 # HELP text for the Prometheus exposition (registry.Metrics.expose).
@@ -273,6 +291,28 @@ HELP_TEXT = {
     "multikueue_remote_sync_retries_total":
         "Remote status mirrors deferred behind backoff because the "
         "worker transport was unreachable",
+    "ha_role": "Replica role: 1 leading, 0 following",
+    "ha_checkpoint_writes_total":
+        "Replication-stream writes completed by the primary",
+    "ha_checkpoint_bytes_total":
+        "Bytes appended to the replication stream by the primary",
+    "ha_replication_errors_total":
+        "Contained HA replication failures, by fault point",
+    "ha_replication_skipped_total":
+        "Replication steps skipped while the HA breaker was open",
+    "ha_replication_lag_records":
+        "Scanned stream records the standby has not applied yet",
+    "ha_events_applied_total":
+        "Cache workload events the standby applied from the stream",
+    "ha_fingerprint_mismatch_total":
+        "Step fingerprints that disagreed with the standby's state",
+    "failover_takeovers_total": "Standby promotions completed",
+    "failover_takeover_seconds":
+        "Promotion wall time: final replay + torn-tail cut + lease grab",
+    "failover_replayed_records":
+        "Stream records replayed during the last promotion",
+    "failover_truncated_bytes":
+        "Torn trailing bytes cut from the stream at promotion",
 }
 
 _HELP_FALLBACK = "kueue_tpu series; see docs/observability.md"

@@ -16,6 +16,7 @@ path executes no recorder code and allocates nothing
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
 from dataclasses import asdict, dataclass, field
@@ -189,12 +190,21 @@ class FlightRecorder:
         )
 
     def export_jsonl(self, path: str) -> int:
-        """Write one JSON object per cycle record; returns record count."""
+        """Write one JSON object per cycle record; returns record count.
+
+        Crash-consistent: the export lands in a temp file first and is
+        fsync'd before an atomic rename, so a kill mid-export leaves
+        either the previous file or the complete new one — never a
+        half-written line that poisons later readers."""
         recs = self.records()
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             for rec in recs:
                 f.write(json.dumps(rec.to_dict()))
                 f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return len(recs)
 
 

@@ -160,6 +160,13 @@ class ServiceLoop:
                     "_pipeline_on", False)
         )
 
+        #: HA replication hook (controllers/ha.py Replicator.attach):
+        #: when set, ``on_step(manager, batch)`` runs inside ``step()``
+        #: under the service lock AFTER cycles/tick and BEFORE telemetry
+        #: — the stream is durable before any observer sees the step's
+        #: results (write-ahead of the ack).
+        self.replicator = None
+
         # Telemetry hand-off: a coalescing one-slot mailbox + seq/done
         # counters so flush_telemetry() can wait for quiescence.
         self._tel_cv = threading.Condition()
@@ -256,6 +263,8 @@ class ServiceLoop:
             ):
                 self.manager.tick()
                 self._last_tick_t = now
+            if self.replicator is not None:
+                self.replicator.on_step(self.manager, batch)
             payload = self._collect_watermarks(results)
         m.inc("service_loop_iterations_total")
         self._iterations += 1

@@ -88,6 +88,16 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "tiled_peak_plane_mb": "lower",
         "tiled_vs_mono_delta_pct": "lower",
     },
+    # Warm failover (docs/failover.md): takeover latency plus the
+    # correctness headliners — the differential vs the unkilled twin run
+    # must find nothing lost or duplicated, and the AOT-warm takeover
+    # window must pay zero backend compiles (all hard-gated by ``ok``).
+    "failover": {
+        "failover_takeover_ms": "lower",
+        "failover_lost_admissions": "lower",
+        "failover_dup_admissions": "lower",
+        "failover_takeover_compiles": "lower",
+    },
 }
 
 _REQUIRED_KEYS = (
@@ -205,12 +215,23 @@ def validate_record(rec: dict) -> List[str]:
 
 
 def append_record(rec: dict, path: Optional[Path] = None) -> bool:
-    """Append one JSON line; best-effort (False on any I/O failure)."""
+    """Append one JSON line; best-effort (False on any I/O failure).
+
+    Crash-consistent: the whole line goes down as a single O_APPEND
+    ``os.write`` followed by fsync, so a kill mid-append can at worst
+    leave one torn final line — which ``load_records`` (and the
+    check_perf_ledger.py gate) already skip — never interleave with a
+    concurrent writer or poison earlier records."""
     p = Path(path) if path is not None else default_ledger_path()
     try:
         line = json.dumps(rec, sort_keys=True, separators=(",", ":"))
-        with open(p, "a") as f:
-            f.write(line + "\n")
+        data = (line + "\n").encode()
+        fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         return True
     except Exception:  # noqa: BLE001 - ledger must never fail the probe
         return False

@@ -47,6 +47,18 @@ anything else so a typo'd point never silently no-ops):
   next cycle's W build. A ``raise`` rule aborts the speculation —
   counted in ``solver_pipeline_abort_total{reason="fault"}`` — and the
   cycle falls back to a fresh encode, never a corrupted one)
+- ``ha.checkpoint_write`` — the primary's replication-stream write
+  (controllers/ha.py; a ``raise`` rule is contained by the replicator
+  breaker — the step completes, the stream marks itself dirty and
+  re-publishes a full checkpoint once the breaker closes; counted in
+  ``ha_replication_errors_total``)
+- ``ha.event_tail``     — the standby's stream tail/apply step
+  (controllers/ha.py; a failing tail never advances the cursor — the
+  standby retries, or falls back to the latest full checkpoint)
+- ``ha.takeover``       — the standby's promotion sequence (torn-tail
+  truncation + final replay + lease acquisition; a ``raise`` rule
+  aborts the promotion, which is retried on the next poll — the lease
+  stays unclaimed rather than half-claimed)
 
 Rule modes:
 
@@ -103,6 +115,9 @@ SERVICE_CYCLE = "service.cycle"
 PIPELINE_PATCH = "pipeline.patch"
 FLEET_DISPATCH = "fleet.dispatch"
 FLEET_APPLY = "fleet.apply"
+HA_CHECKPOINT_WRITE = "ha.checkpoint_write"
+HA_EVENT_TAIL = "ha.event_tail"
+HA_TAKEOVER = "ha.takeover"
 
 POINTS = frozenset({
     SOLVER_DISPATCH,
@@ -117,6 +132,9 @@ POINTS = frozenset({
     PIPELINE_PATCH,
     FLEET_DISPATCH,
     FLEET_APPLY,
+    HA_CHECKPOINT_WRITE,
+    HA_EVENT_TAIL,
+    HA_TAKEOVER,
 })
 
 _MODES = ("raise", "delay", "corrupt")

@@ -1,0 +1,303 @@
+"""Warm failover (docs/failover.md): crash-consistent replication over
+the LeaseStore stream, randomized kill-point recovery differentials
+against an unkilled twin, fault drills for the three ``ha.*`` points,
+and the AOT-warm zero-compile takeover.
+
+Module-isolated: the zero-compile drill prewarms a device bucket ladder
+in-process.
+"""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import (
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    Workload,
+    quota,
+)
+from kueue_tpu.controllers.ha import (
+    LeaseStore,
+    Replicator,
+    WarmStandby,
+    state_digest,
+)
+from kueue_tpu.manager import Manager
+from kueue_tpu.utils import faults
+
+from .helpers import make_cq
+
+pytestmark = pytest.mark.isolated
+
+LEASE_S = 1.0
+DT = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+def _specs():
+    return [
+        ResourceFlavor(name="default"),
+        make_cq("cq-ha", flavors={"default": {"cpu": quota(64)}},
+                resources=["cpu"]),
+        LocalQueue(name="lq-ha", cluster_queue="cq-ha"),
+    ]
+
+
+def _wl(i):
+    return Workload(
+        name=f"wl-{i}", queue_name="lq-ha",
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 1})],
+    )
+
+
+class _Cluster:
+    """One primary (service loop + replicator) and one warm standby over
+    a durable LeaseStore, driven synchronously on a virtual clock."""
+
+    def __init__(self, dirpath, manager_kw=None):
+        self.clk = [0.0]
+        self.mkw = dict(manager_kw or {}, clock=lambda: self.clk[0])
+        self.store = LeaseStore(lease_duration_s=LEASE_S,
+                                dir=str(dirpath))
+        self.mgr = Manager(**self.mkw)
+        self.mgr.apply(*_specs())
+        self.svc = self.mgr.service(
+            tick_interval_s=None, idle_sleep_s=0.0,
+            cycles_per_iter=4, telemetry_async=False,
+        )
+        self.rep = Replicator(self.store).attach(self.svc)
+        self.store.try_acquire("primary", self.clk[0])
+        self.standby = WarmStandby("standby", self.store,
+                                   manager_kw=self.mkw)
+        self.acks = []
+        self._box = []
+        self.svc.on_cycle.append(lambda r: self._box.extend(r.admitted))
+
+    def step(self, submits=(), finishes=(), poll=True, keep_acks=True):
+        self.clk[0] += DT
+        self.store.try_acquire("primary", self.clk[0])
+        for wl in submits:
+            assert self.svc.submit(wl)
+        for key in finishes:
+            assert self.svc.finish(key)
+        self._box.clear()
+        self.svc.step()
+        acks = list(self._box)
+        if keep_acks:
+            self.acks.extend(acks)
+        if poll:
+            assert self.standby.poll(self.clk[0]) == "follow"
+        return acks
+
+    def tear_tail(self, garbage: bytes) -> None:
+        with open(self.store.stream.path, "ab") as f:
+            f.write(garbage)
+
+    def expire_lease(self) -> None:
+        self.clk[0] += LEASE_S + DT
+
+
+def _digest_core(manager):
+    d = state_digest(manager)
+    return {k: d[k] for k in ("admitted", "usage", "pending")}
+
+
+def test_randomized_kill_point_differential(tmp_path):
+    """Kill the primary at a random step (its last acks lost, a fuzzed
+    torn tail left on the stream), promote the standby, finish the
+    schedule against it: the recovered admitted set must equal the
+    unkilled twin's exactly — nothing lost, nothing duplicated."""
+    n, batch = 12, 2
+    for seed in (3, 11, 29):
+        rng = random.Random(seed)
+        kill_step = rng.randint(1, n // batch - 1)
+        garbage = bytes(rng.getrandbits(8)
+                        for _ in range(rng.randint(1, 40)))
+
+        twin = _Cluster(tmp_path / f"twin-{seed}")
+        i = 0
+        while len(set(twin.acks)) < n:
+            subs = [_wl(j) for j in range(i, min(i + batch, n))]
+            i += len(subs)
+            twin.step(submits=subs, poll=False)
+        twin.store.stream.close()
+
+        c = _Cluster(tmp_path / f"kill-{seed}")
+        i = 0
+        for s in range(kill_step):
+            subs = [_wl(j) for j in range(i, min(i + batch, n))]
+            i += len(subs)
+            c.step(submits=subs)
+        # The kill step: record durable, acks lost with the process.
+        subs = [_wl(j) for j in range(i, min(i + batch, n))]
+        i += len(subs)
+        lost_acks = c.step(submits=subs, poll=False, keep_acks=False)
+        assert lost_acks  # the drill must actually lose something
+        c.tear_tail(garbage)
+
+        c.expire_lease()
+        assert c.standby.poll(c.clk[0]) == "lead"
+        assert c.standby.truncated_bytes == len(garbage)
+        svc2 = c.standby.manager.service(
+            tick_interval_s=None, idle_sleep_s=0.0,
+            cycles_per_iter=4, telemetry_async=False,
+        )
+        Replicator(c.store).attach(svc2)
+        box2 = []
+        svc2.on_cycle.append(lambda r: box2.extend(r.admitted))
+        # Client recovery: re-issue everything never acked; durable keys
+        # answer idempotently from standby state.
+        acked = set(c.acks)
+        for j in range(i):
+            key = _wl(j).key
+            if key in acked:
+                continue
+            if key in c.standby.manager.workloads:
+                if key in c.standby.manager.cache.workloads:
+                    c.acks.append(key)
+            else:
+                svc2.submit(_wl(j))
+        for _ in range(200):
+            if len(set(c.acks)) >= n and i >= n:
+                break
+            c.clk[0] += DT
+            c.store.try_acquire("standby", c.clk[0])
+            subs = [_wl(j) for j in range(i, min(i + batch, n))]
+            i += len(subs)
+            for wl in subs:
+                svc2.submit(wl)
+            box2.clear()
+            svc2.step()
+            c.acks.extend(box2)
+        c.store.stream.close()
+
+        assert sorted(set(c.acks)) == sorted(set(twin.acks))
+        dup = [k for k in set(c.acks) if c.acks.count(k) > 1]
+        assert dup == []
+        assert c.standby.fingerprint_mismatches == 0
+        assert _digest_core(c.standby.manager) == _digest_core(twin.mgr)
+
+
+def test_live_tail_reports_torn_but_never_truncates(tmp_path):
+    c = _Cluster(tmp_path / "c")
+    c.step(submits=[_wl(0), _wl(1)], poll=False)
+    c.tear_tail(b"\x00\x01\x00\x00half-written")
+    size_before = c.store.stream.size()
+    applied, torn = c.standby.tail()
+    assert torn and applied >= 1
+    assert c.store.stream.size() == size_before  # live tailer: hands off
+    assert c.standby.truncated_bytes == 0
+    # Only the promote path — lease dead, tail final — cuts it.
+    c.expire_lease()
+    assert c.standby.poll(c.clk[0]) == "lead"
+    assert c.standby.truncated_bytes > 0
+    _, torn = c.store.stream.scan(0)
+    assert not torn
+
+
+def test_fault_checkpoint_write_contained(tmp_path):
+    """A replication-stream write failure must not fail the admission
+    step; the first write after recovery re-publishes a full checkpoint
+    that resyncs the standby over the gap."""
+    c = _Cluster(tmp_path / "c")
+    plan = faults.FaultPlan()
+    plan.add(faults.HA_CHECKPOINT_WRITE, mode="raise", times=1)
+    faults.install(plan)
+    acks = c.step(submits=[_wl(0), _wl(1)], poll=False)
+    assert len(acks) == 2  # admissions acked despite the dead stream
+    m = c.mgr.metrics
+    assert m.get("ha_replication_errors_total",
+                 {"point": faults.HA_CHECKPOINT_WRITE}) == 1
+    assert c.rep.records_written == 0
+    faults.clear()
+    c.step(submits=[_wl(2)])
+    assert c.rep.records_written >= 2  # step record + covering full
+    assert _digest_core(c.standby.manager) == _digest_core(c.mgr)
+    c.store.stream.close()
+
+
+def test_fault_event_tail_never_advances_offset(tmp_path):
+    c = _Cluster(tmp_path / "c")
+    c.step(submits=[_wl(0), _wl(1)], poll=False)
+    plan = faults.FaultPlan()
+    plan.add(faults.HA_EVENT_TAIL, mode="raise", times=1)
+    faults.install(plan)
+    applied, _ = c.standby.tail()
+    assert applied == 0
+    assert c.standby._offset == 0  # at-least-once: nothing skipped
+    assert c.standby.manager.metrics.get(
+        "ha_replication_errors_total",
+        {"point": faults.HA_EVENT_TAIL}) >= 1
+    faults.clear()
+    applied, _ = c.standby.tail()
+    assert applied >= 1
+    assert _digest_core(c.standby.manager) == _digest_core(c.mgr)
+    c.store.stream.close()
+
+
+def test_fault_takeover_aborts_whole_promotion(tmp_path):
+    c = _Cluster(tmp_path / "c")
+    c.step(submits=[_wl(0)], poll=False)
+    c.expire_lease()
+    plan = faults.FaultPlan()
+    plan.add(faults.HA_TAKEOVER, mode="raise", times=1)
+    faults.install(plan)
+    assert c.standby.poll(c.clk[0]) == "follow"
+    assert not c.standby.promoted
+    assert c.store.lease.holder == "primary"  # never left half-claimed
+    faults.clear()
+    assert c.standby.poll(c.clk[0]) == "lead"
+    assert c.store.lease.term == 2
+    c.store.stream.close()
+
+
+def test_cursor_lost_forces_full_checkpoint(tmp_path):
+    """An event-log cursor outside the live window (the cap trimmed
+    entries that never streamed) must resync via a full checkpoint, not
+    ship a gapped stream."""
+    c = _Cluster(tmp_path / "c")
+    c.step(submits=[_wl(0), _wl(1)])
+    c.rep._cursor = -5  # simulate: the cap trimmed past our cursor
+    c.step(submits=[_wl(2)])
+    docs = [d for d, _ in c.store.stream.scan(0)[0]]
+    assert docs[-1]["k"] == "full"
+    assert _digest_core(c.standby.manager) == _digest_core(c.mgr)
+    c.store.stream.close()
+
+
+def test_zero_compile_takeover_from_shared_aot_store(tmp_path):
+    """The takeover window (promote + first post-takeover admission
+    cycle) pays zero backend compiles: the standby's bucket ladder is
+    warm from the shared AOT executable store, pinned the same way as
+    the test_compile_cache.py rungs."""
+    from kueue_tpu.perf import compile_cache as cc
+
+    cc.configure(cache_dir=str(tmp_path / "xla"))
+    cc.install_listeners()
+    dev = dict(use_device_scheduler=True, device_kernel="scan")
+    c = _Cluster(tmp_path / "c", manager_kw=dev)
+    c.mgr.prewarm(max_heads=4, aot=True)
+    c.standby.prewarm(max_heads=4, aot=True)
+    c.step(submits=[_wl(0), _wl(1)])
+    c.step(submits=[_wl(2)])
+    c.expire_lease()
+    before = int(cc.stats()["backend_compiles"])
+    assert c.standby.poll(c.clk[0]) == "lead"
+    svc2 = c.standby.manager.service(
+        tick_interval_s=None, idle_sleep_s=0.0,
+        cycles_per_iter=4, telemetry_async=False,
+    )
+    Replicator(c.store).attach(svc2)
+    svc2.submit(_wl(3))
+    c.clk[0] += DT
+    svc2.step()
+    assert int(cc.stats()["backend_compiles"]) == before
+    assert "default/wl-3" in c.standby.manager.cache.workloads
+    c.store.stream.close()

@@ -4,7 +4,7 @@ pkg/controller/core/leader_aware_reconciler.go:60 non-leader read
 reconciliation)."""
 
 from kueue_tpu.api.types import LocalQueue, PodSet, ResourceFlavor, Workload, quota
-from kueue_tpu.controllers.ha import HAReplica, LeaseStore
+from kueue_tpu.controllers.ha import HAReplica, LeaseStore, RecordLog
 from kueue_tpu.core.workload_info import is_admitted
 
 from .helpers import make_cq
@@ -132,3 +132,116 @@ def test_roletracker_records_transitions():
     a.tick(31.0)  # a observes it lost
     assert a.roletracker.transitions == ["lead", "follow"]
     assert b.roletracker.transitions == ["lead"]
+
+
+# ---------------------------------------------------------------------------
+# lease semantics under clock skew
+# ---------------------------------------------------------------------------
+
+
+def test_lease_lagging_challenger_never_self_leads():
+    """A challenger whose clock lags the holder's renewals can never win:
+    the store is linearizable, so the challenger's (earlier) `now` is
+    compared against the holder's latest expiry, not a stale read."""
+    store = LeaseStore(lease_duration_s=10.0)
+    assert store.try_acquire("a", 0.0)       # expires 10
+    assert store.try_acquire("a", 8.0)       # renewed -> expires 18
+    # b's clock is 6s behind a's: every challenge lands before expiry.
+    for b_now in (2.0, 6.0, 12.0, 17.9):
+        assert not store.try_acquire("b", b_now)
+        assert store.lease.holder == "a"
+    assert store.lease.term == 1
+
+
+def test_lease_skewed_ahead_challenger_fences_old_holder():
+    """A challenger running fast takes over once ITS clock passes the
+    expiry; the deposed holder's later renewal attempts bounce (fencing
+    by holder identity + term bump)."""
+    store = LeaseStore(lease_duration_s=10.0)
+    assert store.try_acquire("a", 0.0)
+    assert not store.try_acquire("b", 5.0)
+    assert store.try_acquire("b", 10.0)      # boundary: now >= expires_at
+    assert store.lease.term == 2
+    # a (clock behind) still believes it leads; its renewal must fail.
+    assert not store.try_acquire("a", 6.0)
+    assert not store.is_leader("a", 6.0)
+    assert store.lease.holder == "b"
+
+
+def test_lease_term_monotonic_renewals_free():
+    store = LeaseStore(lease_duration_s=5.0)
+    store.try_acquire("a", 0.0)
+    store.try_acquire("a", 1.0)
+    store.try_acquire("a", 2.0)
+    assert store.lease.term == 1             # renewals never bump the term
+    store.try_acquire("b", 10.0)
+    assert store.lease.term == 2
+    store.try_acquire("a", 30.0)
+    assert store.lease.term == 3
+
+
+# ---------------------------------------------------------------------------
+# RecordLog framing: torn writes detected, never replayed
+# ---------------------------------------------------------------------------
+
+
+def test_record_log_roundtrip_and_offsets(tmp_path):
+    log = RecordLog(str(tmp_path / "stream.log"))
+    offsets = [log.append({"i": i}) for i in range(3)]
+    entries, torn = log.scan(0)
+    assert not torn
+    assert [doc["i"] for doc, _ in entries] == [0, 1, 2]
+    assert [end for _, end in entries] == offsets
+    # Tailing from a mid-stream offset yields exactly the suffix.
+    tail, torn = log.scan(offsets[0])
+    assert not torn and [doc["i"] for doc, _ in tail] == [1, 2]
+
+
+def test_record_log_torn_tail_detected_and_truncated(tmp_path):
+    log = RecordLog(str(tmp_path / "stream.log"))
+    end = 0
+    for i in range(2):
+        end = log.append({"i": i})
+    # Crash mid-append: a header promising more bytes than exist.
+    with open(log.path, "ab") as f:
+        f.write(b"\x00\x01\x00\x00half-a-record")
+    entries, torn = log.scan(0)
+    assert torn and len(entries) == 2        # complete records intact
+    # scan() never mutates; only the promote path truncates.
+    assert log.size() > end
+    removed = log.truncate_to(end)
+    assert removed > 0 and log.size() == end
+    entries, torn = log.scan(0)
+    assert not torn and len(entries) == 2
+    log.close()
+
+
+def test_record_log_crc_corruption_stops_scan(tmp_path):
+    log = RecordLog(str(tmp_path / "stream.log"))
+    first_end = log.append({"i": 0})
+    log.append({"i": 1})
+    # Flip one payload byte of the second record: length still valid,
+    # CRC must catch it.
+    with open(log.path, "rb+") as f:
+        f.seek(first_end + 12)
+        byte = f.read(1)
+        f.seek(first_end + 12)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    entries, torn = log.scan(0)
+    assert torn and [doc["i"] for doc, _ in entries] == [0]
+
+
+def test_durable_store_recovers_stream_across_processes(tmp_path):
+    store = LeaseStore(lease_duration_s=5.0, dir=str(tmp_path / "ha"))
+    store.stream.append({"k": "step", "i": 0})
+    store.stream.append({"k": "step", "i": 1})
+    store.stream.close()
+    # A fresh process (new LeaseStore over the same dir) sees the
+    # stream where it left off and keeps appending after it.
+    store2 = LeaseStore(lease_duration_s=5.0, dir=str(tmp_path / "ha"))
+    entries, torn = store2.stream.scan(0)
+    assert not torn and [d["i"] for d, _ in entries] == [0, 1]
+    store2.stream.append({"k": "step", "i": 2})
+    entries, _ = store2.stream.scan(0)
+    assert [d["i"] for d, _ in entries] == [0, 1, 2]
+    store2.stream.close()

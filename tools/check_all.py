@@ -2,7 +2,7 @@
 """One-shot pre-commit gate: run every static checker plus an import
 smoke test.
 
-Wraps the three repo checkers —
+Wraps the repo checkers —
 
 - ``check_metrics_names.py``: every emitted metric name is a literal
   from ``metrics/names.py`` and documented in docs/observability.md;
@@ -11,6 +11,9 @@ Wraps the three repo checkers —
 - ``check_pipeline_guards.py``: the pipelined-cycle hooks in the driver
   and service loop stay behind their ``_pipeline_on`` / ``_pipeline``
   guards (zero-cost when serialized);
+- ``check_ha_containment.py``: every HA state-mutation site in
+  ``controllers/ha.py`` sits inside a ``_contained(...)`` scope
+  (docs/failover.md recovery invariants);
 - ``check_perf_ledger.py``: newest PERF_LEDGER.jsonl record per probe
   fingerprint has not regressed vs its rolling median —
 
@@ -34,6 +37,7 @@ CHECKERS = (
     "check_metrics_names.py",
     "check_kernel_gates.py",
     "check_pipeline_guards.py",
+    "check_ha_containment.py",
     "check_perf_ledger.py",
 )
 

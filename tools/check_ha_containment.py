@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Static check: every HA state-mutation site sits inside a containment
+scope.
+
+The warm-failover subsystem (``kueue_tpu/controllers/ha.py``,
+docs/failover.md) promises that a replication, tail, or takeover failure
+can never corrupt replica state — every mutation of a ``Manager`` / its
+cache / its queues happens inside a ``with self._contained(<point>):``
+scope whose breaker absorbs the failure (docs/fault_containment.md).
+That promise is structural, so this checker enforces it structurally:
+it parses ``ha.py`` and requires every ``Call`` whose attribute is one
+of the known mutators to be *lexically* nested inside an ``ast.With``
+whose context expression calls ``_contained``. A new execution scope
+(nested ``def`` / ``lambda``) resets the containment — code defined
+inside a with-block does not run under it.
+
+Run standalone (exit 1 on violations) or via tools/check_all.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+HA_PATH = REPO_ROOT / "kueue_tpu" / "controllers" / "ha.py"
+
+#: Method names that mutate Manager / cache / queue state when called on
+#: any receiver inside ha.py. ``schedule`` is deliberately absent: the
+#: leader's admission cycles are contained by the driver's own scopes
+#: (models/driver.py), not by the replication layer.
+MUTATORS = frozenset({
+    "create_workload",
+    "update_workload",
+    "finish_workload",
+    "delete_workload",
+    "forget_workload",
+    "assume_workload",
+    "add_or_update_workload",
+    "requeue_workload",
+    "restore_state",
+    "apply",
+    "delete",
+})
+
+
+def _is_contained_ctx(expr: ast.expr) -> bool:
+    """True for ``self._contained(...)`` (or any ``*._contained(...)``)
+    used as a with-item context expression."""
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "_contained"
+    )
+
+
+def _walk(node: ast.AST, contained: bool, violations: List[str]) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        # New execution scope: a def/lambda *defined* under a with-block
+        # does not *run* under it.
+        contained = False
+    elif isinstance(node, ast.With):
+        if any(_is_contained_ctx(item.context_expr)
+               for item in node.items):
+            contained = True
+    elif isinstance(node, ast.Call) and not contained:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            violations.append(
+                f"{HA_PATH}:{node.lineno}: mutation call "
+                f"'.{func.attr}(...)' is not inside a "
+                f"'with ..._contained(<point>):' scope"
+            )
+    for child in ast.iter_child_nodes(node):
+        _walk(child, contained, violations)
+
+
+def run_check() -> List[str]:
+    violations: List[str] = []
+    try:
+        tree = ast.parse(HA_PATH.read_text(), filename=str(HA_PATH))
+    except (OSError, SyntaxError) as exc:
+        return [f"{HA_PATH}: unparseable ({exc})"]
+    _walk(tree, False, violations)
+    # Self-test: deleting every mutator from ha.py (or renaming them)
+    # must fail loudly instead of silently un-checking.
+    found = sum(
+        1 for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in MUTATORS
+    )
+    if found == 0:
+        violations.append(
+            f"{HA_PATH}: no mutation call sites found — MUTATORS in "
+            f"{Path(__file__).name} is stale"
+        )
+    return violations
+
+
+def main() -> int:
+    violations = run_check()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} HA containment violation(s)")
+        return 1
+    print("HA containment check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,10 +28,12 @@ standalone retry is green does not fail the run. Extra pytest args after
 -q``). ``--compile-cache DIR`` exports KUEUE_TPU_COMPILE_CACHE=DIR to
 every segment so the fresh subprocesses share warm executables through
 the persistent compile cache instead of recompiling from zero
-(perf/compile_cache.py). ``--perf-gate`` additionally runs
-``tools/check_perf_ledger.py`` after the suite, so a headline-metric
-regression recorded in PERF_LEDGER.jsonl fails the run like a test
-would. ``--checks`` runs ``tools/check_all.py`` (all static checkers +
+(perf/compile_cache.py). ``--perf-gate`` additionally runs the warm-
+failover drill (``bench.py --probe failover`` — the kill/recover
+differential of docs/failover.md, which appends ``failover_takeover_ms``
+to the ledger) and then ``tools/check_perf_ledger.py``, so a failed
+drill or a headline-metric regression recorded in PERF_LEDGER.jsonl
+fails the run like a test would. ``--checks`` runs ``tools/check_all.py`` (all static checkers +
 import smoke) before the suite and fails fast if any checker does.
 """
 
@@ -180,6 +182,18 @@ def main(argv: list) -> int:
             failures.append((rel, rc))
 
     if perf_gate:
+        # Failover drill first: the kill/recover differential probe
+        # (docs/failover.md) appends its takeover headline to the
+        # ledger, so the gate below sees this run, not just history.
+        print("== [perf-gate] bench.py --probe failover", flush=True)
+        rc = subprocess.call(
+            [sys.executable, str(REPO_ROOT / "bench.py"),
+             "--probe", "failover", "--scale", "0.05",
+             "--platform", "cpu"],
+            cwd=str(REPO_ROOT),
+        )
+        if rc != 0:
+            failures.append(("perf-gate:failover", rc))
         # Perf-ledger gate: headline metrics in PERF_LEDGER.jsonl must
         # not regress vs their rolling median (check_perf_ledger.py).
         print("== [perf-gate] tools/check_perf_ledger.py", flush=True)
