@@ -1205,6 +1205,492 @@ def probe_whatif(scale: float):
     }
 
 
+def probe_readplane(scale: float):
+    """Multi-tenant read plane (docs/whatif.md, "Multi-tenant read
+    plane"): K>=64 equivalent what-if load — seven tenants' quota
+    sweeps, a drain matrix, a starvation bisection, ETAs and previews —
+    coalesced into shared tiled rollout dispatches against one pinned
+    double-buffered snapshot generation, vs the same queries issued
+    solo. Three phases: (1) coalesced-vs-sequential wall on a pinned
+    generation plus the concurrent differential (three seeds; coalesced
+    answers must equal solo-issued answers with plain ``==``), (2) a
+    read-idle service-loop churn window, (3) the same churn window under
+    concurrent read traffic — the admission-cycle p99 delta between the
+    two is the "reads never block admission" headline, gated generously
+    here (single-core box) and median-tracked by the perf ledger.
+    ``lane_budget=15`` tiles every batch through K=16 dispatches, so the
+    scenario-plane working set stays bounded no matter how many queries
+    coalesce (the memory story: ``plane_reduction_x``)."""
+    import random
+    import threading
+
+    import jax
+
+    from kueue_tpu.api.constants import PreemptionPolicy
+    from kueue_tpu.api.types import (
+        ClusterQueue,
+        ClusterQueuePreemption,
+        Cohort,
+        FlavorQuotas,
+        LocalQueue,
+        PodSet,
+        ResourceFlavor,
+        ResourceGroup,
+        ResourceQuota,
+        Workload,
+    )
+    from kueue_tpu.manager import Manager
+    from kueue_tpu.metrics.registry import Histogram
+    from kueue_tpu.models.buckets import bucket_for, pow2_bucket
+    from kueue_tpu.readplane.queries import (
+        drain_matrix_query,
+        eta_query,
+        expand,
+        preview_query,
+        starve_search_query,
+        sweep_query,
+    )
+    from kueue_tpu.tas.snapshot import Node
+    from kueue_tpu.whatif.engine import Scenario
+
+    mgr = Manager()
+    m = mgr.metrics
+
+    def rp_cq(name: str, nominal: int = 8000) -> ClusterQueue:
+        return ClusterQueue(
+            name=name, cohort="rp",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="default",
+                    resources={"cpu": ResourceQuota(nominal=nominal)},
+                )],
+            )],
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+        )
+
+    # The "default" flavor selects the probe nodes via node_labels (no
+    # topology_name, so the what-if rollout path stays supported), which
+    # makes the drain-matrix lanes real proportional quota cuts instead
+    # of ForecastUnsupported fallbacks.
+    mgr.apply(
+        ResourceFlavor(name="default", node_labels={"pool": "rp"}),
+        Cohort(name="rp"),
+        Cohort(name="churn"),
+        # cq-rp-0 gets 9000m so the standing admitted count lands at 25
+        # (9+8+8) — one past the preview path's multiple-of-8 admitted
+        # axis (encode's `a`) — and cq-churn's 7000m caps churn at 7
+        # concurrent admissions, so total admitted holds in (24, 32]
+        # and the A axis stays 32 through every phase. At 24 standing
+        # (a rung boundary) the first churn admission mid-window forced
+        # a fresh preview-kernel compile into the query-p99 headline.
+        rp_cq("cq-rp-0", nominal=9000),
+        *[rp_cq(f"cq-rp-{i}") for i in (1, 2)],
+        # Churn rides its own cohort/CQ so the open-loop arrivals below
+        # admit and finish without draining the rp CQs' standing backlog
+        # (which pins the rollout's W bucket for the probe's lifetime).
+        ClusterQueue(
+            name="cq-churn", cohort="churn",
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(
+                    name="default",
+                    resources={"cpu": ResourceQuota(nominal=7000)},
+                )],
+            )],
+            preemption=ClusterQueuePreemption(
+                within_cluster_queue=PreemptionPolicy.LOWER_PRIORITY,
+            ),
+        ),
+        *[LocalQueue(name=f"lq-cq-rp-{i}", cluster_queue=f"cq-rp-{i}")
+          for i in range(3)],
+        LocalQueue(name="lq-churn", cluster_queue="cq-churn"),
+    )
+    for i in range(4):
+        mgr.cache.add_or_update_node(Node(
+            name=f"node-{i}", labels={"pool": "rp"},
+            capacity={"cpu": 2000},
+        ))
+    # Standing backlog, built to pin the rollout shape statics for the
+    # probe's whole lifetime: 14 x 1000m per rp CQ. Once the service
+    # loop settles, 25 admit fleet-wide (9+8+8, quota-full, and nothing
+    # ever finishes them — the churn observer only tracks churn-CQ
+    # admissions) and 17 stay pending forever. Three budgets ride on
+    # this:
+    #  - w_pad (bucket_for of pending+admitted): 42 standing + the
+    #    churn CQ's 0..16 in-flight stays inside the (32, 64] rung;
+    #  - s_max (_pow2 of *active pending* + hypo heads, engine.py): 17
+    #    standing pending keeps every dispatch in the (16, 32] band —
+    #    15 would sit at the band edge and the first churn arrival
+    #    during the loaded window would flip s_max 16 -> 32, a fresh
+    #    ~60s XLA compile landing squarely in the query-p99 headline;
+    #  - the preview A axis pinned at 32 by the quota split above.
+    # Every serving-phase query therefore reuses the executables
+    # phase 0 compiled instead of paying a mid-window recompile, and
+    # sweeps / cuts / drains still move real admitted-within-horizon
+    # numbers (the rollout's virtual time completes admitted
+    # workloads, so the blocked tail admits late-horizon).
+    for ci in range(3):
+        for i in range(14):
+            mgr.create_workload(Workload(
+                name=f"rp-{ci}-{i}", queue_name=f"lq-cq-rp-{ci}",
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 1000})],
+                priority=i % 3, creation_time=float(ci * 14 + i + 1),
+            ))
+
+    # Small horizon on the shared template: the read plane inherits it
+    # (and the jit-cache dict) so probe compiles stay CPU-box friendly.
+    tpl = mgr.whatif()
+    tpl.default_runtime_ms = 1000
+    tpl.horizon_rounds = 64
+    rp = mgr.readplane(window=32, coalesce_delay_s=0.01, lane_budget=15)
+
+    # Settle BEFORE the compile warmup, not after: warmup must run in
+    # the same admitted/pending regime the serving windows measure, or
+    # it warms the wrong s_max band (42 active pending pre-settle vs 18
+    # post-settle) and the loaded window pays the recompile instead.
+    svc = mgr.service(
+        tick_interval_s=0.25, slo_interval_s=0.5, idle_sleep_s=0.005,
+        stall_after_s=5.0, cycles_per_iter=8,
+    )
+    svc.start()
+    t_settle = time.monotonic() + 30.0
+    while time.monotonic() < t_settle:
+        live_pending = sum(
+            len(mgr.queues.pending_workloads_all(name))
+            for name in mgr.queues.cluster_queues)
+        if live_pending <= 17 and svc.ingest_depth() == 0:
+            break
+        time.sleep(0.05)
+
+    rp.publish(force=True)
+    rp.start()
+
+    def hypo(name: str, ci: int) -> Workload:
+        return Workload(
+            name=name, queue_name=f"lq-cq-rp-{ci}",
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 1000})],
+            priority=5,
+        )
+
+    def make_queries():
+        """Fresh Query objects per repetition (starve_search mutates its
+        bisection bracket as it folds). ~65 first-round scenario lanes —
+        the K>=64 equivalent load — spread over nine tenants."""
+        qs = []
+        for ti in range(7):
+            qs.append(sweep_query(
+                f"cq-rp-{ti % 3}", "default", "cpu",
+                deltas=tuple(1000 * (d + 1) for d in range(8)),
+                tenant=f"tenant-{ti}",
+            ))
+        qs.append(drain_matrix_query(
+            tuple(f"node-{i}" for i in range(4)), tenant="ops"))
+        qs.append(starve_search_query(
+            "cq-rp-0", "default", "cpu", max_cut=6000, points=4,
+            rounds=2, tenant="ops"))
+        qs.append(eta_query(cluster_queue="cq-rp-1", tenant="tenant-0"))
+        qs.append(eta_query(
+            scenarios=(Scenario(
+                kind="submit", label="hypo-submit",
+                workload=hypo("rp-hypo-eta", 2),
+                cluster_queue="cq-rp-2",
+            ),),
+            tenant="tenant-1",
+        ))
+        qs.append(preview_query(hypo("rp-hypo-prev-a", 0),
+                                cluster_queue="cq-rp-0",
+                                tenant="tenant-2"))
+        qs.append(preview_query(hypo("rp-hypo-prev-b", 1),
+                                cluster_queue="cq-rp-1",
+                                tenant="tenant-3"))
+        return qs
+
+    mix_lanes = sum(len(expand(q)) for q in make_queries())
+    n_queries = len(make_queries())
+
+    # Phase 0: compile warmup — solo issuance touches every dispatch
+    # shape (K=1/2/8/16 rollouts + the preview path); the coalesced pass
+    # then reuses the same executables via the shared jit-cache dict.
+    log("readplane: compile warmup (solo shapes + one coalesced pass)")
+    t0 = time.monotonic()
+    warm = [rp.query_solo(q) for q in make_queries()]
+    bad = [a for a in warm if not isinstance(a, dict) or not a.get("ok")]
+    if bad:
+        return {"probe": "readplane", "ok": False,
+                "error": f"warmup failed: {str(bad[0])[:200]}"}
+    basis = next((a["basis"] for a in warm if "basis" in a), None)
+    if basis != "rollout":
+        return {"probe": "readplane", "ok": False,
+                "error": f"fell back: basis={basis}"}
+    for t in [rp.submit(q) for q in make_queries()]:
+        t.result(120.0)
+    compile_s = time.monotonic() - t0
+
+    # Phase 1a: coalesced vs sequential wall on the pinned generation.
+    # Best-of-N both ways: single-core boxes jitter by tens of percent.
+    coalesced_s = float("inf")
+    answers: list = []
+    for _ in range(3):
+        qs = make_queries()
+        t0 = time.monotonic()
+        tickets = [rp.submit(q) for q in qs]
+        answers = [t.result(120.0) for t in tickets]
+        coalesced_s = min(coalesced_s, time.monotonic() - t0)
+    if not all(a.get("ok") for a in answers):
+        return {"probe": "readplane", "ok": False,
+                "error": "coalesced pass returned a failed answer"}
+    sequential_s = float("inf")
+    for _ in range(2):
+        qs = make_queries()
+        t0 = time.monotonic()
+        for q in qs:
+            rp.query_solo(q)
+        sequential_s = min(sequential_s, time.monotonic() - t0)
+    speedup = sequential_s / coalesced_s if coalesced_s > 0 else 0.0
+    log(f"readplane: coalesced {coalesced_s:.3f}s vs sequential "
+        f"{sequential_s:.3f}s (speedup {speedup:.2f}x)")
+
+    # Phase 1b: concurrent differential — shuffled multi-thread issuance
+    # must produce answers == solo issuance against the same pinned
+    # generation (the bit-identity contract of readplane/queries.py).
+    diff_ok = True
+    diff_detail = []
+    for seed in (1, 2, 3):
+        rng = random.Random(seed)
+        qs = make_queries()
+        solo = [rp.query_solo(q) for q in make_queries()]
+        order = list(range(len(qs)))
+        rng.shuffle(order)
+        results: list = [None] * len(qs)
+
+        def issue(idxs, qs=qs, results=results):
+            for i in idxs:
+                results[i] = rp.query(qs[i], timeout=120.0)
+
+        threads = [threading.Thread(target=issue, args=(order[t::4],))
+                   for t in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        mismatches = [i for i in range(len(qs)) if results[i] != solo[i]]
+        diff_detail.append({"seed": seed, "queries": len(qs),
+                            "mismatches": len(mismatches)})
+        if mismatches:
+            diff_ok = False
+            log(f"readplane differential seed {seed}: "
+                f"{len(mismatches)} mismatched (first: query "
+                f"{mismatches[0]} kind={qs[mismatches[0]].kind})")
+
+    # Phase 2/3: service-loop churn windows, read-idle then read-loaded.
+    duration_s = max(2.0, 40.0 * scale)
+    window_seq = [0]
+
+    def window_q_ms(series: str, before_counts, q: float):
+        buckets, counts, _n = m.histogram_totals(series)
+        if not buckets:
+            return None
+        prev = before_counts if before_counts else [0] * (len(buckets) + 1)
+        dc = [c - p for c, p in zip(counts, prev)]
+        dn = sum(dc)
+        if dn <= 0:
+            return None
+        h = Histogram(buckets=buckets)
+        h.counts = dc
+        h.n = dn
+        v = h.quantile(q)
+        if v is None or v != v or v == float("inf"):
+            return None
+        return round(v * 1000, 3)
+
+    def churn_window(readers_n: int) -> dict:
+        window_seq[0] += 1
+        tag = window_seq[0]
+        before = {}
+        for series in ("admission_attempt_duration_seconds",
+                       "readplane_query_seconds",
+                       "readplane_snapshot_staleness_seconds"):
+            _b, counts, _n = m.histogram_totals(series)
+            before[series] = list(counts)
+        stop_readers = threading.Event()
+        reader_stats = [[0, 0] for _ in range(readers_n)]  # [queries, errs]
+
+        def reader_loop(rix: int) -> None:
+            st = reader_stats[rix]
+            while not stop_readers.is_set():
+                for q in make_queries():
+                    if stop_readers.is_set():
+                        break
+                    try:
+                        a = rp.query(q, timeout=120.0)
+                        st[0] += 1
+                        if not a.get("ok"):
+                            st[1] += 1
+                    except Exception:  # noqa: BLE001 - counted, not fatal
+                        st[1] += 1
+
+        readers = [threading.Thread(target=reader_loop, args=(rix,),
+                                    daemon=True)
+                   for rix in range(readers_n)]
+        for th in readers:
+            th.start()
+        running: list = []
+        admitted_box = [0]
+
+        def churn(result) -> None:
+            admitted_box[0] += len(result.admitted)
+            # Only churn-CQ workloads cycle through completion: the rp
+            # CQs' standing backlog stays put (25 admitted + 17 pending
+            # fleet-wide), pinning the rollout's shape statics for the
+            # whole serving phase. Finish down to 4 so churn keeps
+            # turning over inside cq-churn's 7-admission cap.
+            running.extend(k for k in result.admitted if "/churn-" in k)
+            while len(running) > 4:
+                svc.finish(running.pop(0))
+
+        svc.on_cycle.append(churn)
+        t0 = time.monotonic()
+        t_end = t0 + duration_s
+        submitted = 0
+        next_arrival = t0
+        interval = 1.0 / 8.0  # arrivals/s, open loop
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            while next_arrival <= now and next_arrival < t_end:
+                svc.submit(Workload(
+                    name=f"churn-{tag}-{submitted}",
+                    queue_name="lq-churn",
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": 1000})],
+                    priority=submitted % 3,
+                ))
+                submitted += 1
+                next_arrival += interval
+            time.sleep(0.01)
+        stop_readers.set()
+        for th in readers:
+            th.join(timeout=30.0)
+        # Drain this window's churn out of the system entirely (admit
+        # stragglers, then finish everything tracked) so the next
+        # window — and the final stats — start from the standing-
+        # backlog steady state, not on top of leftover churn quota.
+        t_drain = time.monotonic() + 15.0
+        while time.monotonic() < t_drain:
+            churn_pending = len(
+                mgr.queues.pending_workloads_all("cq-churn"))
+            if churn_pending == 0 and svc.ingest_depth() == 0:
+                break
+            time.sleep(0.02)
+        while running:
+            svc.finish(running.pop(0))
+        t_drain = time.monotonic() + 5.0
+        while svc.ingest_depth() > 0 and time.monotonic() < t_drain:
+            time.sleep(0.01)
+        svc.on_cycle.remove(churn)
+        _b, counts, _n = m.histogram_totals(
+            "admission_attempt_duration_seconds")
+        cycles = sum(c - p for c, p in zip(
+            counts, before["admission_attempt_duration_seconds"]))
+        return {
+            "duration_s": round(duration_s, 3),
+            "readers": readers_n,
+            "submitted": submitted,
+            "admitted": admitted_box[0],
+            "cycles": cycles,
+            "cycle_p99_ms": window_q_ms(
+                "admission_attempt_duration_seconds",
+                before["admission_attempt_duration_seconds"], 0.99),
+            "queries": sum(st[0] for st in reader_stats),
+            "query_errors": sum(st[1] for st in reader_stats),
+            "query_p99_ms": window_q_ms(
+                "readplane_query_seconds",
+                before["readplane_query_seconds"], 0.99),
+            "staleness_p99_ms": window_q_ms(
+                "readplane_snapshot_staleness_seconds",
+                before["readplane_snapshot_staleness_seconds"], 0.99),
+        }
+
+    log("readplane: read-idle churn window")
+    idle = churn_window(readers_n=0)
+    log("readplane: read-loaded churn window")
+    loaded = churn_window(readers_n=3)
+    svc.flush_telemetry()
+    svc.stop()
+    rp.stop()
+    loop_errors = int(m.counter_total("service_loop_errors_total"))
+
+    # Bounded-memory story: the tiled scenario plane (peak padded K any
+    # single dispatch used) vs the padded K one monolithic dispatch of
+    # the whole mix would allocate. Per-lane estimate: the (N,F,R)
+    # nominal plane in int64 plus the W-padded active/result rows.
+    peak_lanes = rp.coalescer.peak_tile_lanes
+    rs = rp.publisher.current()
+    w_pad = bucket_for((rs.pending_total if rs is not None else 48) + 2)
+    per_lane_bytes = 3 * 1 * 1 * 8 + w_pad * 9
+    untiled_lanes = pow2_bucket(mix_lanes + 1, floor=1)
+    peak_plane_mb = peak_lanes * per_lane_bytes / 1e6
+    untiled_plane_mb = untiled_lanes * per_lane_bytes / 1e6
+
+    idle_p99 = idle.get("cycle_p99_ms")
+    loaded_p99 = loaded.get("cycle_p99_ms")
+    cycle_delta = (round(loaded_p99 - idle_p99, 3)
+                   if isinstance(idle_p99, float)
+                   and isinstance(loaded_p99, float) else None)
+    # Generous absolute/relative bound: one slow box cycle is tens of
+    # ms; the ledger's rolling median gates drift across runs.
+    cycle_ok = (idle_p99 is None or loaded_p99 is None
+                or loaded_p99 <= max(3.0 * idle_p99, idle_p99 + 15.0))
+    ok = bool(
+        speedup > 1.0
+        and diff_ok
+        and basis == "rollout"
+        and peak_lanes <= 16
+        and idle["admitted"] > 0
+        and loaded["admitted"] > 0
+        and loaded["queries"] > 0
+        and loaded["query_errors"] == 0
+        and loop_errors == 0
+        and cycle_ok
+    )
+    stats = {
+        "probe": "readplane",
+        "ok": ok,
+        "platform": jax.devices()[0].platform,
+        "queries_per_mix": n_queries,
+        "mix_lanes": mix_lanes,
+        "tenants": 9,
+        "compile_s": round(compile_s, 1),
+        "coalesced_wall_s": round(coalesced_s, 3),
+        "sequential_wall_s": round(sequential_s, 3),
+        "readplane_coalesced_speedup": round(speedup, 2),
+        "differential": {"ok": diff_ok, "seeds": diff_detail},
+        "batches": rp.coalescer.batches,
+        "total_lanes": rp.coalescer.total_lanes,
+        "lane_budget": rp.coalescer.lane_budget,
+        "peak_tile_lanes": peak_lanes,
+        "untiled_lanes": untiled_lanes,
+        "readplane_peak_plane_mb": round(peak_plane_mb, 6),
+        "untiled_plane_mb": round(untiled_plane_mb, 6),
+        "plane_reduction_x": round(untiled_lanes / peak_lanes, 2)
+        if peak_lanes else 0.0,
+        "idle": idle,
+        "loaded": loaded,
+        "readplane_cycle_p99_delta_ms": cycle_delta,
+        "readplane_query_p99_ms": loaded.get("query_p99_ms"),
+        "readplane_staleness_p99_ms": loaded.get("staleness_p99_ms"),
+        "publish": rp.publisher.to_doc(),
+        "loop_errors": loop_errors,
+        "fingerprint_extra": {"version": 2, "mix_lanes": mix_lanes,
+                              "lane_budget": 15},
+    }
+    return stats
+
+
 def _steady_once(scale: float, pipeline: str):
     """One open-loop churn window against the STREAMING service loop
     (docs/observability.md "Service loop & live health") driving the
@@ -2341,8 +2827,8 @@ def main():
                     choices=["ping", "mega", "sim", "fair", "phases",
                              "multichip", "incremental", "whatif",
                              "steady", "scanfloor", "tas", "fleet",
-                             "tiled", "failover", "coldstart",
-                             "coldstart-child"],
+                             "tiled", "failover", "readplane",
+                             "coldstart", "coldstart-child"],
                     help="internal: run one device probe and exit")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform inside the probe (the "
@@ -2406,6 +2892,7 @@ def main():
                 "fleet": lambda: probe_fleet(args.scale),
                 "tiled": lambda: probe_tiled(args.scale),
                 "failover": lambda: probe_failover(args.scale),
+                "readplane": lambda: probe_readplane(args.scale),
                 "coldstart": lambda: probe_coldstart(
                     args.scale, args.platform),
                 "coldstart-child": lambda: probe_coldstart_child(

@@ -138,6 +138,7 @@ class Manager:
         self._explainer = None
         self._slo = None
         self._service = None
+        self._readplane = None
 
     def whatif(self):
         """Lazily built what-if forecasting engine over this manager's
@@ -198,11 +199,37 @@ class Manager:
             from kueue_tpu.obs import ServiceLoop
 
             self._service = ServiceLoop(self, **kwargs)
+            if self._readplane is not None:
+                self._service.attach_readplane(self._readplane)
         elif kwargs:
             raise ValueError(
                 "service loop already built; configure it on first call"
             )
         return self._service
+
+    def readplane(self, **kwargs):
+        """Lazily built multi-tenant read plane (docs/whatif.md,
+        "Multi-tenant read plane"): coalesced what-if serving off
+        double-buffered cycle-boundary snapshots. Shares the live
+        what-if engine's jit caches, registers the read-plane SLO
+        objectives, and — when the service loop exists (before or
+        after) — wires its cycle-boundary publish hook. Constructor
+        kwargs are honored only on first build."""
+        if self._readplane is None:
+            from kueue_tpu.readplane import ReadPlane
+
+            self._readplane = ReadPlane(
+                self.cache, self.queues, metrics=self.metrics,
+                clock=self.clock, template=self.whatif(), **kwargs,
+            )
+            self.slo().add_objectives(self._readplane.slo_objectives())
+            if self._service is not None:
+                self._service.attach_readplane(self._readplane)
+        elif kwargs:
+            raise ValueError(
+                "read plane already built; configure it on first call"
+            )
+        return self._readplane
 
     def prewarm(self, max_heads: int = 16, background: bool = False,
                 aot: bool = True):

@@ -194,9 +194,29 @@ HA_SERIES = frozenset({
     "failover_truncated_bytes",
 })
 
+# Multi-tenant read plane (readplane/): snapshot publishing, query
+# coalescing, tenant fairness/cost accounting, batch containment.
+READPLANE_SERIES = frozenset({
+    "readplane_queries_total",
+    "readplane_batches_total",
+    "readplane_dispatch_tiles_total",
+    "readplane_lanes_per_batch",
+    "readplane_query_seconds",
+    "readplane_queue_depth",
+    "readplane_rejected_total",
+    "readplane_deferred_total",
+    "readplane_batch_failures_total",
+    "readplane_breaker_state",
+    "readplane_tenant_lanes_total",
+    "readplane_snapshot_generation",
+    "readplane_snapshot_staleness_seconds",
+    "readplane_publish_seconds",
+    "readplane_publish_errors_total",
+})
+
 METRIC_NAMES = (
     REFERENCE_SERIES | TRACING_SERIES | OBS_SERIES | COST_SERIES
-    | SERVICE_SERIES | FLEET_SERIES | HA_SERIES
+    | SERVICE_SERIES | FLEET_SERIES | HA_SERIES | READPLANE_SERIES
 )
 
 # HELP text for the Prometheus exposition (registry.Metrics.expose).
@@ -313,6 +333,36 @@ HELP_TEXT = {
         "Stream records replayed during the last promotion",
     "failover_truncated_bytes":
         "Torn trailing bytes cut from the stream at promotion",
+    "readplane_queries_total":
+        "Read-plane queries submitted, by kind "
+        "(eta/preview/sweep/drain_matrix/starve_search)",
+    "readplane_batches_total":
+        "Coalescing windows dispatched by the read plane",
+    "readplane_dispatch_tiles_total":
+        "K-tiles dispatched across all coalesced batches",
+    "readplane_lanes_per_batch":
+        "Scenario lanes packed into the last coalesced batch",
+    "readplane_query_seconds":
+        "Read-plane query latency, submit to resolved answer",
+    "readplane_queue_depth": "Queries waiting in the coalescer queue",
+    "readplane_rejected_total":
+        "Queries rejected because the coalescer queue was full",
+    "readplane_deferred_total":
+        "Queries deferred to a later window by the per-tenant lane cap",
+    "readplane_batch_failures_total":
+        "Coalesced batches that failed; only that window's queries err",
+    "readplane_breaker_state":
+        "Read-plane breaker: 0 closed, 1 open, 2 half-open",
+    "readplane_tenant_lanes_total":
+        "Scenario lanes dispatched per tenant (cost attribution)",
+    "readplane_snapshot_generation":
+        "Generation of the newest published read snapshot",
+    "readplane_snapshot_staleness_seconds":
+        "Age of the pinned snapshot at batch dispatch time",
+    "readplane_publish_seconds":
+        "Wall time to capture one read snapshot at a cycle boundary",
+    "readplane_publish_errors_total":
+        "Contained snapshot-capture failures in the publish hook",
 }
 
 _HELP_FALLBACK = "kueue_tpu series; see docs/observability.md"

@@ -69,6 +69,16 @@ def charge(entry: str, bucket: int, device_s: float,
         led.charge(entry, bucket, device_s, lanes=lanes)
 
 
+def charge_tenant(tenant: str, bucket: int, device_s: float,
+                  lanes: Optional[Dict[str, Tuple[int, int]]] = None,
+                  entry: str = "readplane") -> None:
+    """Per-tenant attribution shim (read plane): books the tenant's
+    share of a coalesced dispatch against an ``entry[tenant]`` cell, so
+    ``/costs`` breaks read traffic down by who asked. Call sites guard
+    with ``if costs.ENABLED:`` like every other charge site."""
+    charge(f"{entry}[{tenant}]", bucket, device_s, lanes=lanes)
+
+
 @dataclass
 class CostCell:
     """Accumulated cost for one (entry point, bucket rung)."""

@@ -167,6 +167,11 @@ class ServiceLoop:
         #: results (write-ahead of the ack).
         self.replicator = None
 
+        #: when attached, ``publish_cycle(cache, queues, dirty)`` runs at
+        #: the end of each step under the service lock, so the read plane
+        #: serves crash-consistent cycle-boundary snapshots.
+        self._readplane = None
+
         # Telemetry hand-off: a coalescing one-slot mailbox + seq/done
         # counters so flush_telemetry() can wait for quiescence.
         self._tel_cv = threading.Condition()
@@ -215,6 +220,12 @@ class ServiceLoop:
     def ingest_depth(self) -> int:
         with self._ingest_lock:
             return len(self._ingest)
+
+    def attach_readplane(self, readplane) -> None:
+        """Wire a ReadPlane so ``step()`` publishes cycle-boundary read
+        snapshots (readplane/publisher.py). Idempotent; pass None to
+        detach."""
+        self._readplane = readplane
 
     # -- one loop iteration (loop thread) -------------------------------
 
@@ -265,6 +276,14 @@ class ServiceLoop:
                 self._last_tick_t = now
             if self.replicator is not None:
                 self.replicator.on_step(self.manager, batch)
+            if self._readplane is not None:
+                # Cycle-boundary snapshot for the read plane: demand- and
+                # fingerprint-gated inside, contained, never raises.
+                self._readplane.publish_cycle(
+                    self.manager.cache, self.manager.queues,
+                    dirty=bool(batch) or any(
+                        r.admitted or r.preempted for r in results),
+                )
             payload = self._collect_watermarks(results)
         m.inc("service_loop_iterations_total")
         self._iterations += 1

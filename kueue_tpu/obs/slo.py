@@ -71,6 +71,30 @@ DEFAULT_OBJECTIVES: Tuple[SLObjective, ...] = (
 )
 
 
+# Read-plane serving objectives (readplane/): opted in by
+# Manager.readplane() via :meth:`SLOEngine.add_objectives` so
+# deployments without a read plane don't evaluate dead series.
+READPLANE_OBJECTIVES: Tuple[SLObjective, ...] = (
+    SLObjective(
+        name="readplane_query_latency",
+        kind="latency",
+        series="readplane_query_seconds",
+        threshold_s=2.0,
+        budget=0.05,
+        description="read-plane query latency: <5% of queries over 2s",
+    ),
+    SLObjective(
+        name="readplane_staleness",
+        kind="latency",
+        series="readplane_snapshot_staleness_seconds",
+        threshold_s=5.0,
+        budget=0.05,
+        description="snapshot staleness at dispatch: <5% of batches "
+                    "read a snapshot older than 5s",
+    ),
+)
+
+
 @dataclass
 class SLOStatus:
     name: str
@@ -130,6 +154,16 @@ class SLOEngine:
         # deque diff/append must be atomic per evaluation.
         self._eval_lock = threading.Lock()
         self.last_statuses: List[SLOStatus] = []
+
+    def add_objectives(self, objectives: Sequence[SLObjective]) -> None:
+        """Register extra objectives (e.g. the read plane's) after
+        construction. Dedupes by name so repeated wiring is idempotent."""
+        with self._eval_lock:
+            have = {o.name for o in self.objectives}
+            for o in objectives:
+                if o.name not in have:
+                    self.objectives.append(o)
+                    have.add(o.name)
 
     # -- raw totals -----------------------------------------------------
 

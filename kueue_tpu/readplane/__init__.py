@@ -1,0 +1,49 @@
+"""Multi-tenant read plane: coalesced what-if serving off snapshots.
+
+The admission loop answers one what-if caller at a time, serialized on
+the service lock, against live state. This package absorbs heavy read
+traffic instead (docs/whatif.md, "Multi-tenant read plane"):
+
+- :class:`SnapshotPublisher` — a generation-fingerprinted, double-
+  buffered read snapshot the ServiceLoop publishes at cycle boundaries
+  (demand-gated: a read-idle deployment pays one attribute read per
+  cycle and never captures);
+- :class:`QueryCoalescer` / :class:`ReadPlane` — a bounded coalescing
+  window that packs concurrent heterogeneous queries (eta, preview,
+  quota sweeps, drain matrices, starvation bisection) into shared
+  K-padded rollout dispatches against the pinned snapshot, tiling the
+  K axis through a bounded lane budget so scenario-plane memory stays
+  fixed at any query load;
+- :mod:`queries` — the sweep/search compiler: expands high-level
+  queries into scenario lanes and folds lane results back into
+  per-query answers.
+
+Read traffic overlaps with — and never blocks — admission: dispatches
+run off the service lock against frozen views, and answers are
+bit-identical to issuing each query alone against the same snapshot
+generation (tests/test_readplane.py differential).
+"""
+
+from kueue_tpu.readplane.coalescer import QueryCoalescer, ReadPlane
+from kueue_tpu.readplane.publisher import ReadSnapshot, SnapshotPublisher
+from kueue_tpu.readplane.queries import (
+    Query,
+    drain_matrix_query,
+    eta_query,
+    preview_query,
+    starve_search_query,
+    sweep_query,
+)
+
+__all__ = [
+    "Query",
+    "QueryCoalescer",
+    "ReadPlane",
+    "ReadSnapshot",
+    "SnapshotPublisher",
+    "drain_matrix_query",
+    "eta_query",
+    "preview_query",
+    "starve_search_query",
+    "sweep_query",
+]

@@ -1,0 +1,461 @@
+"""QueryCoalescer: bounded-window batching of read-plane queries.
+
+Concurrent what-if traffic from many tenants lands in a bounded queue;
+a worker thread drains it in *coalescing windows*, expands every query
+into scenario lanes (readplane/queries.py) and packs the lanes into
+shared K-padded rollout dispatches against the publisher's pinned
+snapshot generation. Because vmap lanes are independent and every
+lane's hypotheticals are inactive in every other lane, coalesced
+answers are bit-identical to issuing each query alone against the same
+generation — the contract :meth:`QueryCoalescer.query_solo` exists to
+check (tests/test_readplane.py differential).
+
+Memory stays bounded at any K: lanes tile through ``lane_budget``-sized
+dispatches, so the scenario-plane working set is the tile's pow2 bucket
+— never the full batch — and the tile shapes reuse the live engine's
+compiled executables via the shared jit-cache dict.
+
+Containment: a poisoned batch (``faults.READPLANE_DISPATCH``, or any
+dispatch-path bug) fails only the queries in that window with a
+structured error; later windows re-coalesce cleanly, and repeated
+failures open the per-coalescer breaker so callers shed fast instead
+of queueing behind a broken plane (docs/fault_containment.md).
+
+Fairness: each tenant's lanes per window are capped
+(``max_lanes_per_tenant``); surplus queries defer to the next window
+(never dropped), and a tenant's first query in a window always admits
+so a big sweep cannot be starved out entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.models.buckets import pow2_bucket as _pow2
+from kueue_tpu.obs import costs
+from kueue_tpu.readplane.publisher import ReadSnapshot, SnapshotPublisher
+from kueue_tpu.readplane.queries import Query, expand, fold, fold_preview
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CircuitBreaker
+from kueue_tpu.whatif.engine import WhatIfEngine
+
+
+class _Ticket:
+    """One in-flight query: a waitable slot the coalescer resolves."""
+
+    __slots__ = ("query", "event", "answer", "t0")
+
+    def __init__(self, query: Query, t0: float) -> None:
+        self.query = query
+        self.event = threading.Event()
+        self.answer: Optional[dict] = None
+        self.t0 = t0
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self.event.wait(timeout):
+            raise TimeoutError(
+                f"read-plane query {self.query.kind} not resolved in time")
+        return self.answer
+
+
+class QueryCoalescer:
+    """Batches queries into shared dispatches against pinned snapshots."""
+
+    def __init__(
+        self,
+        publisher: SnapshotPublisher,
+        template: Optional[WhatIfEngine] = None,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        window: int = 32,
+        coalesce_delay_s: float = 0.005,
+        queue_limit: int = 256,
+        max_lanes_per_tenant: int = 64,
+        lane_budget: int = 127,
+        breaker: Optional[CircuitBreaker] = None,
+    ) -> None:
+        self.publisher = publisher
+        self.template = template
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._clock = clock
+        self.window = int(window)
+        self.coalesce_delay_s = float(coalesce_delay_s)
+        self.queue_limit = int(queue_limit)
+        self.max_lanes_per_tenant = int(max_lanes_per_tenant)
+        self.lane_budget = int(lane_budget)
+        self.breaker = breaker or CircuitBreaker(
+            threshold=3, backoff_s=2.0, max_backoff_s=30.0, clock=clock
+        )
+        self._queue: Deque[_Ticket] = deque()
+        self._cv = threading.Condition()
+        # Serializes batch execution: the worker thread vs query_solo
+        # callers (both swap the shared engine's frozen views).
+        self._exec_lock = threading.Lock()
+        self._engine: Optional[WhatIfEngine] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        # Bounded-memory evidence for the perf probe: the largest padded
+        # K any single dispatch used, and total lanes ever dispatched.
+        self.peak_tile_lanes = 0
+        self.total_lanes = 0
+        self.batches = 0
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "QueryCoalescer":
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._stopping = False
+                self._thread = threading.Thread(
+                    target=self._run, name="readplane-coalescer",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # Drain: late submitters get a structured shutdown error.
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for t in pending:
+            self._resolve(t, {"ok": False, "error": "stopped"})
+
+    # -- submission (any thread) ----------------------------------------
+
+    def submit(self, query: Query) -> _Ticket:
+        t = _Ticket(query, self._clock())
+        m = self.metrics
+        m.inc("readplane_queries_total", {"kind": query.kind})
+        self.publisher.note_demand()
+        with self._cv:
+            if len(self._queue) >= self.queue_limit:
+                m.inc("readplane_rejected_total")
+                self._resolve(t, {"ok": False, "error": "queue_full"})
+                return t
+            self._queue.append(t)
+            m.set_gauge("readplane_queue_depth", float(len(self._queue)))
+            self._cv.notify()
+        return t
+
+    def query(self, query: Query, timeout: Optional[float] = 30.0) -> dict:
+        """Coalesced blocking query: submit, wait for the window that
+        carries it (and any continuation windows) to resolve."""
+        self.start()
+        return self.submit(query).result(timeout)
+
+    def query_solo(self, query: Query, max_windows: int = 64) -> dict:
+        """The differential reference: run ``query`` alone, one single-
+        query window per continuation round, against the same pinned
+        snapshot the coalesced path would use."""
+        t = _Ticket(query, self._clock())
+        self.metrics.inc("readplane_queries_total", {"kind": query.kind})
+        self.publisher.note_demand()
+        for _ in range(max_windows):
+            with self._exec_lock:
+                continued = self._execute([t])
+            if not continued:
+                break
+        if not t.event.is_set():  # round budget exhausted mid-bisection
+            self._resolve(t, {"ok": False, "error": "unresolved"})
+        return t.answer
+
+    # -- worker ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._next_window()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            with self._exec_lock:
+                continued = self._execute(batch)
+            if continued:
+                with self._cv:
+                    # Continuations ride the next window, ahead of new
+                    # arrivals, so bisections converge under load.
+                    for t in reversed(continued):
+                        self._queue.appendleft(t)
+                    self._cv.notify()
+
+    def _next_window(self) -> Optional[List[_Ticket]]:
+        """Block for traffic, linger ``coalesce_delay_s`` to let a burst
+        accumulate, then take up to ``window`` queries subject to the
+        per-tenant lane cap. Returns None on shutdown."""
+        with self._cv:
+            while not self._queue:
+                if self._stopping:
+                    return None
+                self._cv.wait(0.1)
+            if self._stopping:
+                return None
+            if len(self._queue) < self.window:
+                self._cv.wait(self.coalesce_delay_s)
+            batch: List[_Ticket] = []
+            deferred: List[_Ticket] = []
+            tenant_lanes: Dict[str, int] = {}
+            while self._queue and len(batch) < self.window:
+                t = self._queue.popleft()
+                cost = max(1, len(expand(t.query)))
+                tenant = t.query.tenant
+                used = tenant_lanes.get(tenant, 0)
+                # A tenant's first query always admits; beyond that its
+                # lanes are capped per window and the surplus defers.
+                if used and used + cost > self.max_lanes_per_tenant:
+                    deferred.append(t)
+                    continue
+                tenant_lanes[tenant] = used + cost
+                batch.append(t)
+            if deferred:
+                self.metrics.inc("readplane_deferred_total",
+                                 value=float(len(deferred)))
+                for t in reversed(deferred):
+                    self._queue.appendleft(t)
+            self.metrics.set_gauge("readplane_queue_depth",
+                                   float(len(self._queue)))
+            return batch
+
+    # -- execution (under _exec_lock) -----------------------------------
+
+    def _engine_for(self, rs: ReadSnapshot) -> WhatIfEngine:
+        """One long-lived engine over swapped frozen views: sharing the
+        template's jit-cache dict means the read plane reuses the live
+        engine's compiled executables instead of recompiling per
+        generation (or per coalescer)."""
+        eng = self._engine
+        if eng is None:
+            t = self.template
+            if t is not None:
+                eng = WhatIfEngine(
+                    rs.cache_view, rs.queues_view,
+                    default_runtime_ms=t.default_runtime_ms,
+                    horizon_rounds=t.horizon_rounds,
+                    runtime_ms_fn=t._runtime_ms_fn,
+                    clock=self._clock,
+                    kernel=t.kernel,
+                )
+                eng._rollout_fns = t._rollout_fns
+            else:
+                eng = WhatIfEngine(rs.cache_view, rs.queues_view,
+                                   clock=self._clock)
+            self._engine = eng
+        eng.cache = rs.cache_view
+        eng.queues = rs.queues_view
+        return eng
+
+    def _resolve(self, t: _Ticket, answer: dict) -> None:
+        t.answer = answer
+        self.metrics.observe("readplane_query_seconds",
+                             max(0.0, self._clock() - t.t0))
+        t.event.set()
+
+    def _fail_all(self, batch: List[_Ticket], error: str,
+                  reason: str = "") -> None:
+        doc = {"ok": False, "error": error}
+        if reason:
+            doc["reason"] = reason
+        for t in batch:
+            if not t.event.is_set():
+                self._resolve(t, dict(doc))
+
+    def _execute(self, batch: List[_Ticket]) -> List[_Ticket]:
+        """Dispatch one window. Returns the continuation tickets (still
+        unresolved, to re-enter the queue). Exceptions never escape: a
+        poisoned window fails only its own tickets."""
+        m = self.metrics
+        rs = self.publisher.current()
+        if rs is None:
+            self._fail_all(batch, "no_snapshot")
+            return []
+        m.observe("readplane_snapshot_staleness_seconds",
+                  max(0.0, self._clock() - rs.published_at))
+        if not self.breaker.allow():
+            m.set_gauge("readplane_breaker_state",
+                        float(self.breaker.gauge_value))
+            self._fail_all(batch, "breaker_open")
+            return []
+        t0 = self._clock()
+        continued: List[_Ticket] = []
+        try:
+            if faults.ENABLED:
+                faults.fire(faults.READPLANE_DISPATCH)
+            eng = self._engine_for(rs)
+            # Expand every query's lanes into one flat plan.
+            plans: List[Tuple[_Ticket, int, int]] = []  # (ticket, off, n)
+            all_lanes: List = []
+            need_rollout = False
+            for t in batch:
+                if t.query.kind == "preview":
+                    plans.append((t, 0, 0))
+                    continue
+                need_rollout = True
+                lanes = expand(t.query)
+                plans.append((t, len(all_lanes), len(lanes)))
+                all_lanes.extend(lanes)
+            # Tiled dispatch: every tile is one K-padded rollout sharing
+            # the base lane; the scenario-plane working set is bounded
+            # by lane_budget regardless of batch size. A single query's
+            # lanes may split across tiles — lanes are independent, so
+            # per-lane results are unchanged.
+            base_sf = None
+            basis = "rollout"
+            lane_sfs: List = []
+            tiles = 0
+            if need_rollout:
+                step = max(1, self.lane_budget)
+                tile_list = [all_lanes[i:i + step]
+                             for i in range(0, len(all_lanes), step)]
+                if not tile_list:
+                    tile_list = [[]]  # plain eta queries: base lane only
+                for tile in tile_list:
+                    rep = eng.eta(scenarios=tile, cluster_queue=None)
+                    tiles += 1
+                    self.peak_tile_lanes = max(
+                        self.peak_tile_lanes,
+                        _pow2(len(tile) + 1, floor=1))
+                    if base_sf is None:
+                        base_sf = rep.base
+                        basis = rep.basis
+                    lane_sfs.extend(rep.scenarios[1:])
+            # Fold lane results back into per-query answers.
+            tenant_lanes: Dict[str, int] = {}
+            for t, off, n in plans:
+                q = t.query
+                tenant_lanes[q.tenant] = (
+                    tenant_lanes.get(q.tenant, 0) + max(1, n))
+                if q.kind == "preview":
+                    rep = eng.preview(q.workload, q.cluster_queue)
+                    ans = fold_preview(q, rep)
+                    self._resolve(t, dict(
+                        ans, ok=True, generation=rs.generation))
+                    continue
+                answer, cont = fold(q, base_sf, lane_sfs[off:off + n],
+                                    basis)
+                if cont is not None:
+                    continued.append(t)
+                else:
+                    self._resolve(t, dict(
+                        answer, ok=True, generation=rs.generation))
+            wall = max(0.0, self._clock() - t0)
+            self.batches += 1
+            self.total_lanes += len(all_lanes)
+            m.inc("readplane_batches_total")
+            if tiles:
+                m.inc("readplane_dispatch_tiles_total", value=float(tiles))
+            m.set_gauge("readplane_lanes_per_batch", float(len(all_lanes)))
+            total = sum(tenant_lanes.values()) or 1
+            for tenant, tl in sorted(tenant_lanes.items()):
+                m.inc("readplane_tenant_lanes_total", {"tenant": tenant},
+                      value=float(tl))
+                if costs.ENABLED:
+                    costs.charge_tenant(
+                        tenant, self.peak_tile_lanes or 1,
+                        wall * tl / total, lanes={"K": (tl, tl)})
+            self.breaker.record_success()
+        except Exception as exc:  # noqa: BLE001 - window containment
+            self.breaker.record_failure()
+            m.inc("readplane_batch_failures_total")
+            self._fail_all(batch, "dispatch_failed",
+                           reason=f"{type(exc).__name__}: {exc}")
+            continued = []
+        m.set_gauge("readplane_breaker_state",
+                    float(self.breaker.gauge_value))
+        return continued
+
+    def to_doc(self) -> dict:
+        with self._cv:
+            depth = len(self._queue)
+        return {
+            "window": self.window,
+            "laneBudget": self.lane_budget,
+            "maxLanesPerTenant": self.max_lanes_per_tenant,
+            "queueDepth": depth,
+            "queueLimit": self.queue_limit,
+            "batches": self.batches,
+            "totalLanes": self.total_lanes,
+            "peakTileLanes": self.peak_tile_lanes,
+            "breaker": self.breaker.state,
+        }
+
+
+class ReadPlane:
+    """Facade wiring a publisher + coalescer over one (cache, queues).
+
+    The ServiceLoop calls :meth:`publish_cycle` at cycle boundaries
+    (guarded ``if self._readplane is not None``); clients call
+    :meth:`query` / :meth:`submit` from any thread."""
+
+    def __init__(
+        self,
+        cache,
+        queues,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        template: Optional[WhatIfEngine] = None,
+        min_interval_s: float = 0.05,
+        demand_window_s: float = 5.0,
+        **coalescer_kwargs,
+    ) -> None:
+        self.cache = cache
+        self.queues = queues
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.publisher = SnapshotPublisher(
+            metrics=self.metrics, clock=clock,
+            min_interval_s=min_interval_s,
+            demand_window_s=demand_window_s,
+        )
+        self.coalescer = QueryCoalescer(
+            self.publisher, template=template, metrics=self.metrics,
+            clock=clock, **coalescer_kwargs,
+        )
+
+    # Publishing (service-loop thread / manual).
+    def publish_cycle(self, cache=None, queues=None,
+                      dirty: bool = False) -> bool:
+        return self.publisher.publish_cycle(
+            cache if cache is not None else self.cache,
+            queues if queues is not None else self.queues,
+            dirty=dirty,
+        )
+
+    def publish(self, force: bool = False) -> bool:
+        return self.publisher.publish(self.cache, self.queues,
+                                      force=force)
+
+    # Serving (any thread).
+    def query(self, query: Query, timeout: Optional[float] = 30.0) -> dict:
+        return self.coalescer.query(query, timeout)
+
+    def query_solo(self, query: Query) -> dict:
+        return self.coalescer.query_solo(query)
+
+    def submit(self, query: Query) -> _Ticket:
+        self.coalescer.start()
+        return self.coalescer.submit(query)
+
+    def start(self) -> "ReadPlane":
+        self.coalescer.start()
+        return self
+
+    def stop(self) -> None:
+        self.coalescer.stop()
+
+    def slo_objectives(self):
+        from kueue_tpu.obs.slo import READPLANE_OBJECTIVES
+        return READPLANE_OBJECTIVES
+
+    def to_doc(self) -> dict:
+        return {
+            "publisher": self.publisher.to_doc(),
+            "coalescer": self.coalescer.to_doc(),
+        }

@@ -1,0 +1,229 @@
+"""SnapshotPublisher: double-buffered read snapshots at cycle boundaries.
+
+The ServiceLoop calls :meth:`SnapshotPublisher.publish_cycle` inside
+``step()`` under the service lock (guarded by ``if self._readplane is
+not None`` — tools/check_readplane_guards.py pins the idiom), so every
+published generation is a crash-consistent cycle-boundary view. Reads
+then run against the frozen views with NO lock shared with admission.
+
+Cost discipline (the "never blocks admission" half of the contract):
+
+- **demand gating** — no coalescer traffic inside ``demand_window_s``
+  means ``publish_cycle`` returns after two float compares; a read-idle
+  deployment pays nothing per cycle;
+- **fingerprint gating** — captures only when the cache generation
+  counters / pending totals moved (or the step applied ops), so a busy
+  read plane over a quiet cluster reuses one generation;
+- **min-interval throttling** — bounds capture rate under churn.
+
+Double buffering: the publisher retains at most the two newest
+generations (front + back slot); ``current()`` is an atomic reference
+read. In-flight batches keep older generations alive only for the
+duration of their dispatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class _FrozenQueues:
+    """The slice of the QueueManager surface the WhatIfEngine reads,
+    pinned at publish time. Entries were cloned at capture; the engine
+    clones again per rollout, so the stored copies are never mutated."""
+
+    def __init__(self, pending: Dict[str, List], pending_all: Dict[str, List],
+                 lq_to_cq: Dict[str, str]) -> None:
+        self.cluster_queues = {name: None for name in pending_all}
+        self._pending = pending
+        self._pending_all = pending_all
+        self._lq_to_cq = lq_to_cq
+
+    def pending_workloads(self, cq_name: str) -> List:
+        return self._pending.get(cq_name, [])
+
+    def pending_workloads_all(self, cq_name: str) -> List:
+        return self._pending_all.get(cq_name, [])
+
+    def pending_count(self, cq_name: str) -> int:
+        return len(self._pending.get(cq_name, []))
+
+    def cluster_queue_for(self, wl) -> Optional[str]:
+        return self._lq_to_cq.get(f"{wl.namespace}/{wl.queue_name}")
+
+
+class _FrozenCache:
+    """The slice of the Cache surface the WhatIfEngine reads."""
+
+    def __init__(self, snap, nodes: Dict) -> None:
+        self._snap = snap
+        self.nodes = nodes
+
+    def snapshot(self):
+        return self._snap
+
+
+class ReadSnapshot:
+    """One published generation: frozen cache/queue views + identity."""
+
+    __slots__ = ("generation", "fingerprint", "published_at",
+                 "cache_view", "queues_view", "pending_total")
+
+    def __init__(self, generation: int, fingerprint: Tuple,
+                 published_at: float, cache_view: _FrozenCache,
+                 queues_view: _FrozenQueues, pending_total: int) -> None:
+        self.generation = generation
+        self.fingerprint = fingerprint
+        self.published_at = published_at
+        self.cache_view = cache_view
+        self.queues_view = queues_view
+        self.pending_total = pending_total
+
+    def to_doc(self) -> dict:
+        return {
+            "generation": self.generation,
+            "publishedAt": self.published_at,
+            "pendingTotal": self.pending_total,
+        }
+
+
+class SnapshotPublisher:
+    """Publishes double-buffered :class:`ReadSnapshot` generations."""
+
+    def __init__(
+        self,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+        min_interval_s: float = 0.05,
+        demand_window_s: float = 5.0,
+    ) -> None:
+        self.metrics = metrics
+        self._clock = clock
+        self.min_interval_s = float(min_interval_s)
+        self.demand_window_s = float(demand_window_s)
+        # Double buffer: only the two newest generations stay referenced
+        # here; _front_idx flips after the back slot is fully built.
+        self._buffers: List[Optional[ReadSnapshot]] = [None, None]
+        self._front_idx = 0
+        self._generation = 0
+        self._last_fingerprint: Optional[Tuple] = None
+        self._last_capture_t = -float("inf")
+        # Plain float, written by any submitting thread / read by the
+        # loop thread — atomic under the GIL, no lock on the hot path.
+        self._last_demand_t = -float("inf")
+        self.publish_errors = 0
+
+    # -- demand signal (coalescer threads) ------------------------------
+
+    def note_demand(self) -> None:
+        """Called by the coalescer on every submit: read traffic within
+        ``demand_window_s`` is what arms ``publish_cycle``."""
+        self._last_demand_t = self._clock()
+
+    # -- publishing (service-loop thread, under the service lock) -------
+
+    def publish_cycle(self, cache, queues, dirty: bool = False) -> bool:
+        """The ServiceLoop cycle-boundary hook. Contained: a capture
+        failure is counted, never raised into the admission loop."""
+        try:
+            if self._should_capture(cache, queues, dirty):
+                self._capture(cache, queues)
+                return True
+        except Exception:  # noqa: BLE001 - must never poison a cycle
+            self.publish_errors += 1
+            if self.metrics is not None:
+                self.metrics.inc("readplane_publish_errors_total")
+        return False
+
+    def publish(self, cache, queues, force: bool = False) -> bool:
+        """Manual/test publish path (e.g. before a service loop exists).
+        ``force=True`` skips the demand + interval gates but still
+        dedupes on an unchanged fingerprint via ``dirty=True`` capture
+        semantics."""
+        if force or self._should_capture(cache, queues, dirty=True):
+            self._capture(cache, queues)
+            return True
+        return False
+
+    def _should_capture(self, cache, queues, dirty: bool) -> bool:
+        now = self._clock()
+        if now - self._last_demand_t > self.demand_window_s:
+            return False  # read-idle: zero publish cost
+        if self._buffers[self._front_idx] is None:
+            return True
+        if now - self._last_capture_t < self.min_interval_s:
+            return False
+        if dirty:
+            return True
+        return self._fingerprint(cache, queues) != self._last_fingerprint
+
+    @staticmethod
+    def _fingerprint(cache, queues) -> Tuple:
+        """Cheap change detector: the cache's fine-grained generation
+        counters plus the pending-backlog total (pending entries live in
+        the queues and bump no cache counter)."""
+        pending_total = sum(
+            queues.pending_count(name) for name in queues.cluster_queues
+        )
+        return (
+            cache.generation, cache.quota_generation,
+            cache.node_generation, cache.admitted_generation,
+            cache.workload_generation, pending_total,
+        )
+
+    def _capture(self, cache, queues) -> None:
+        t0 = self._clock()
+        fp = self._fingerprint(cache, queues)
+        snap = cache.snapshot()
+        pending: Dict[str, List] = {}
+        pending_all: Dict[str, List] = {}
+        for name in sorted(queues.cluster_queues):
+            pending[name] = [
+                i.clone() for i in queues.pending_workloads(name)
+            ]
+            pending_all[name] = [
+                i.clone() for i in queues.pending_workloads_all(name)
+            ]
+        lq_to_cq = {
+            key: lq.cluster_queue
+            for key, lq in queues.local_queues.items()
+        }
+        self._generation += 1
+        rs = ReadSnapshot(
+            generation=self._generation,
+            fingerprint=fp,
+            published_at=self._clock(),
+            cache_view=_FrozenCache(snap, dict(cache.nodes)),
+            queues_view=_FrozenQueues(pending, pending_all, lq_to_cq),
+            pending_total=fp[-1],
+        )
+        # Build into the back slot, then flip: readers either see the
+        # old front or the fully-built new one, never a partial.
+        back = 1 - self._front_idx
+        self._buffers[back] = rs
+        self._front_idx = back
+        self._last_fingerprint = fp
+        self._last_capture_t = self._clock()
+        if self.metrics is not None:
+            m = self.metrics
+            m.set_gauge("readplane_snapshot_generation",
+                        float(self._generation))
+            m.observe("readplane_publish_seconds",
+                      max(0.0, self._clock() - t0))
+
+    # -- readers (any thread) -------------------------------------------
+
+    def current(self) -> Optional[ReadSnapshot]:
+        """The newest fully-published generation (atomic ref read)."""
+        return self._buffers[self._front_idx]
+
+    def to_doc(self) -> dict:
+        rs = self.current()
+        return {
+            "generation": self._generation,
+            "minIntervalS": self.min_interval_s,
+            "demandWindowS": self.demand_window_s,
+            "publishErrors": self.publish_errors,
+            "current": rs.to_doc() if rs is not None else None,
+        }

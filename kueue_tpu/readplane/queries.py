@@ -1,0 +1,256 @@
+"""The read plane's sweep/search compiler.
+
+High-level queries (ETA forecasts, admission previews, quota sweeps,
+drain matrices, starvation bisection) compile down to *scenario lanes*
+— rows of the what-if engine's batched K-padded rollout — so the
+coalescer can pack many tenants' questions into one device dispatch.
+:func:`expand` produces the lanes; :func:`fold` turns the lane
+forecasts back into one deterministic answer document per query.
+
+Answers are deterministic on a pinned snapshot generation: no wall-
+clock fields survive folding, so the concurrent-coalescer differential
+(tests/test_readplane.py) can compare coalesced answers against
+solo-issued ones with plain ``==``.
+
+Iterative queries (``starve_search``) fold into a *continuation*: the
+bisection bracket narrows by one grid per coalescing window, riding
+whatever batch dispatches next, until the bracket closes or the round
+budget runs out.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from kueue_tpu.whatif.engine import QuotaDelta, Scenario
+
+_KINDS = ("eta", "preview", "sweep", "drain_matrix", "starve_search")
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Query:
+    """One read-plane query. Build via the constructor helpers below —
+    they validate the per-kind field contract."""
+
+    kind: str
+    tenant: str = "default"
+    cluster_queue: Optional[str] = None
+    # eta: extra engine scenarios to evaluate alongside the base lane.
+    scenarios: Tuple[Scenario, ...] = ()
+    # preview: the hypothetical workload.
+    workload: Optional[object] = None
+    # sweep / starve_search: the nominal-quota cell under study.
+    node: Optional[str] = None
+    flavor: Optional[str] = None
+    resource: Optional[str] = None
+    # sweep: additive deltas to evaluate, one lane each.
+    deltas: Tuple[int, ...] = ()
+    # drain_matrix: nodes to drain, one lane each.
+    drain_nodes: Tuple[str, ...] = ()
+    # starve_search: bisection budget.
+    max_cut: int = 0
+    points: int = 4
+    rounds: int = 4
+    # starve_search bracket state (mutated by fold): largest cut known
+    # safe / smallest known (or assumed) starving. _hi starts one past
+    # max_cut as a *virtual* bound; hi_confirmed records whether a probe
+    # actually starved there.
+    _lo: int = 0
+    _hi: int = 0
+    _hi_confirmed: bool = False
+    _probed: List[int] = field(default_factory=list)
+    _round: int = 0
+    qid: int = field(default_factory=lambda: next(_ids))
+
+    def cell_doc(self) -> dict:
+        return {"node": self.node, "flavor": self.flavor,
+                "resource": self.resource}
+
+
+# -- constructor helpers -----------------------------------------------
+
+
+def eta_query(cluster_queue: Optional[str] = None,
+              scenarios: Tuple[Scenario, ...] = (),
+              tenant: str = "default") -> Query:
+    return Query(kind="eta", tenant=tenant, cluster_queue=cluster_queue,
+                 scenarios=tuple(scenarios))
+
+
+def preview_query(workload, cluster_queue: Optional[str] = None,
+                  tenant: str = "default") -> Query:
+    if workload is None:
+        raise ValueError("preview_query requires a workload")
+    return Query(kind="preview", tenant=tenant,
+                 cluster_queue=cluster_queue, workload=workload)
+
+
+def sweep_query(node: str, flavor: str, resource: str,
+                deltas: Tuple[int, ...],
+                tenant: str = "default") -> Query:
+    if not deltas:
+        raise ValueError("sweep_query requires at least one delta")
+    return Query(kind="sweep", tenant=tenant, node=node, flavor=flavor,
+                 resource=resource,
+                 deltas=tuple(int(d) for d in deltas))
+
+
+def drain_matrix_query(drain_nodes: Tuple[str, ...],
+                       tenant: str = "default") -> Query:
+    if not drain_nodes:
+        raise ValueError("drain_matrix_query requires at least one node")
+    return Query(kind="drain_matrix", tenant=tenant,
+                 drain_nodes=tuple(drain_nodes))
+
+
+def starve_search_query(node: str, flavor: str, resource: str,
+                        max_cut: int, points: int = 4, rounds: int = 4,
+                        tenant: str = "default") -> Query:
+    """Binary-search "when does cutting this quota cell starve the
+    cohort": finds the largest cut that keeps admitted-within-horizon
+    at the base level, probing ``points`` cuts per coalescing window
+    for at most ``rounds`` windows."""
+    if max_cut < 1:
+        raise ValueError("starve_search_query requires max_cut >= 1")
+    return Query(kind="starve_search", tenant=tenant, node=node,
+                 flavor=flavor, resource=resource, max_cut=int(max_cut),
+                 points=max(1, int(points)), rounds=max(1, int(rounds)),
+                 _lo=0, _hi=int(max_cut) + 1)
+
+
+# -- lane expansion ----------------------------------------------------
+
+
+def _search_grid(q: Query) -> List[int]:
+    """Up to ``q.points`` integer cuts strictly inside the (_lo, _hi)
+    bracket, evenly spaced, deduplicated, ascending."""
+    lo, hi = q._lo, q._hi
+    span = hi - lo
+    if span <= 1:
+        return []
+    n = min(q.points, span - 1)
+    cuts = sorted({lo + max(1, round(i * span / (n + 1)))
+                   for i in range(1, n + 1)})
+    return [c for c in cuts if lo < c < hi]
+
+
+def expand(q: Query) -> List[Scenario]:
+    """The scenario lanes this query contributes to the next batch.
+    Previews contribute none — they ride the batch as per-workload
+    ``preview()`` calls against the same pinned snapshot."""
+    if q.kind == "eta":
+        return list(q.scenarios)
+    if q.kind == "preview":
+        return []
+    if q.kind == "sweep":
+        return [
+            Scenario(kind="quota", label=f"sweep:{d}", quota_deltas=(
+                QuotaDelta(q.node, q.flavor, q.resource, d),))
+            for d in q.deltas
+        ]
+    if q.kind == "drain_matrix":
+        return [Scenario(kind="drain", label=f"drain:{n}", drain_node=n)
+                for n in q.drain_nodes]
+    if q.kind == "starve_search":
+        return [
+            Scenario(kind="quota", label=f"starve:{c}", quota_deltas=(
+                QuotaDelta(q.node, q.flavor, q.resource, -c),))
+            for c in _search_grid(q)
+        ]
+    raise ValueError(f"unknown query kind {q.kind!r}")
+
+
+# -- result folding ----------------------------------------------------
+
+
+def _lane_doc(sf) -> dict:
+    """A ScenarioForecast document with the per-workload rows dropped —
+    sweep/drain/search answers are aggregate questions."""
+    d = sf.to_dict()
+    d.pop("workloads", None)
+    return d
+
+
+def _starved(sf, base_sf) -> bool:
+    return (not sf.ok) or (
+        sf.admitted_within_horizon < base_sf.admitted_within_horizon)
+
+
+def fold(q: Query, base_sf, lane_sfs: List, basis: str
+         ) -> Tuple[Optional[dict], Optional[Query]]:
+    """Fold the lane forecasts for ``q`` (ordered as :func:`expand`
+    produced them) into ``(answer, continuation)``. Exactly one of the
+    two is non-None; a continuation re-enters the coalescer queue."""
+    if q.kind == "eta":
+        base_doc = base_sf.to_dict()
+        if q.cluster_queue is not None:
+            base_doc["workloads"] = [
+                w for w in base_doc["workloads"]
+                if w["clusterQueue"] == q.cluster_queue
+            ]
+        return ({
+            "kind": "eta",
+            "basis": basis,
+            "base": base_doc,
+            "scenarios": [sf.to_dict() for sf in lane_sfs],
+        }, None)
+
+    if q.kind == "sweep":
+        return ({
+            "kind": "sweep",
+            "basis": basis,
+            "cell": q.cell_doc(),
+            "points": [
+                dict(_lane_doc(sf), delta=d)
+                for d, sf in zip(q.deltas, lane_sfs)
+            ],
+        }, None)
+
+    if q.kind == "drain_matrix":
+        return ({
+            "kind": "drain_matrix",
+            "basis": basis,
+            "rows": [
+                dict(_lane_doc(sf), node=n)
+                for n, sf in zip(q.drain_nodes, lane_sfs)
+            ],
+        }, None)
+
+    if q.kind == "starve_search":
+        cuts = _search_grid(q)
+        q._round += 1
+        for c, sf in zip(cuts, lane_sfs):
+            q._probed.append(c)
+            if _starved(sf, base_sf):
+                if c < q._hi:
+                    q._hi = c
+                    q._hi_confirmed = True
+            elif c > q._lo and c < q._hi:
+                q._lo = c
+        # Safe probes above a starving one are stale bracket-wise; the
+        # invariant _lo < _hi is restored by the (c < _hi) filter above.
+        if q._hi - q._lo > 1 and q._round < q.rounds and _search_grid(q):
+            return (None, q)
+        return ({
+            "kind": "starve_search",
+            "basis": basis,
+            "cell": q.cell_doc(),
+            "maxSafeCut": q._lo,
+            "minStarvingCut": q._hi if q._hi_confirmed else None,
+            "probedCuts": sorted(q._probed),
+            "rounds": q._round,
+        }, None)
+
+    raise ValueError(f"fold() does not handle kind {q.kind!r}")
+
+
+def fold_preview(q: Query, report) -> dict:
+    """Deterministic preview answer: the PreviewReport document minus
+    its wall-clock field."""
+    d = report.to_dict()
+    d.pop("wallS", None)
+    return {"kind": "preview", "preview": d}

@@ -28,6 +28,11 @@ anything else so a typo'd point never silently no-ops):
 - ``cache.snapshot``    — the device path's snapshot acquisition
 - ``whatif.dispatch``   — the what-if engine's batched forecast dispatch
   (whatif/engine.py; degrades to the queue-position heuristic)
+- ``readplane.dispatch`` — the read plane's coalesced batch dispatch
+  (readplane/coalescer.py; a ``raise`` rule poisons exactly one
+  coalescing window — every query in that window resolves with a
+  structured error, later windows re-coalesce cleanly — and repeated
+  failures open the per-coalescer breaker)
 - ``compile.deserialize`` — AOT executable loads from the on-disk
   compile cache (perf/compile_cache.py; a corrupt or poisoned store
   falls back to the plain jit path behind a breaker)
@@ -110,6 +115,7 @@ REMOTE_TRANSPORT = "remote.transport"
 REMOTE_DISPATCH = "remote.dispatch"
 CACHE_SNAPSHOT = "cache.snapshot"
 WHATIF_DISPATCH = "whatif.dispatch"
+READPLANE_DISPATCH = "readplane.dispatch"
 COMPILE_DESERIALIZE = "compile.deserialize"
 SERVICE_CYCLE = "service.cycle"
 PIPELINE_PATCH = "pipeline.patch"
@@ -127,6 +133,7 @@ POINTS = frozenset({
     REMOTE_DISPATCH,
     CACHE_SNAPSHOT,
     WHATIF_DISPATCH,
+    READPLANE_DISPATCH,
     COMPILE_DESERIALIZE,
     SERVICE_CYCLE,
     PIPELINE_PATCH,

@@ -17,6 +17,16 @@ from typing import Dict, List, Optional
 from kueue_tpu.queue.manager import QueueManager
 
 
+class ServiceUnavailable(RuntimeError):
+    """A route's backing subsystem is not attached / not serving.
+    ``_guarded`` maps this to a structured 503 with a machine-readable
+    ``reason`` — never a 200-shaped error dict."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
 @dataclass
 class PendingWorkload:
     """reference apis/visibility/v1beta2/types.go:66."""
@@ -62,9 +72,13 @@ class VisibilityServer:
 
     def __init__(self, queues: QueueManager, whatif=None,
                  explainer=None, slo=None, metrics=None,
-                 service=None, lock=None) -> None:
+                 service=None, lock=None, readplane=None) -> None:
         self.queues = queues
         self.whatif = whatif
+        # Optional ReadPlane (docs/whatif.md, "Multi-tenant read
+        # plane"): serves /readplane/query, and the /whatif/* routes
+        # run coalesced off the admission lock when attached.
+        self.readplane = readplane
         self.explainer = explainer
         self.slo = slo
         # Optional Metrics registry: when attached, /metrics serves the
@@ -210,9 +224,17 @@ class VisibilityServer:
                    scenarios: Optional[List[Dict]] = None) -> Dict:
         """Per-pending-workload admission ETA + flavor forecast, plus any
         capacity-probe scenarios (JSON dicts, see _parse_scenario)."""
-        if self.whatif is None:
-            return {"error": "whatif engine not attached"}
         scens = [self._parse_scenario(s) for s in (scenarios or [])]
+        if self.readplane is not None:
+            # Coalesced read path: no admission lock, answers come off
+            # the pinned cycle-boundary snapshot.
+            from kueue_tpu.readplane import eta_query
+
+            return self.readplane.query(eta_query(
+                cluster_queue=cluster_queue, scenarios=tuple(scens),
+            ))
+        if self.whatif is None:
+            raise ServiceUnavailable("whatif_engine_not_attached")
         with self._state_lock():
             report = self.whatif.eta(
                 scenarios=scens, cluster_queue=cluster_queue
@@ -223,9 +245,15 @@ class VisibilityServer:
         """Preemption preview for one hypothetical workload. ``spec``:
         {"name", "namespace"?, "queue"?, "clusterQueue"?, "priority"?,
         "count"?, "requests": {resource: canonical int}}."""
-        if self.whatif is None:
-            return {"error": "whatif engine not attached"}
         wl = self._parse_workload(spec)
+        if self.readplane is not None:
+            from kueue_tpu.readplane import preview_query
+
+            return self.readplane.query(preview_query(
+                wl, cluster_queue=spec.get("clusterQueue"),
+            ))
+        if self.whatif is None:
+            raise ServiceUnavailable("whatif_engine_not_attached")
         with self._state_lock():
             report = self.whatif.preview(
                 wl, cluster_queue=spec.get("clusterQueue")
@@ -250,6 +278,65 @@ class VisibilityServer:
                 },
             )],
         )
+
+    # -- read plane (docs/whatif.md, "Multi-tenant read plane") ---------
+
+    def readplane_doc(self) -> Dict:
+        if self.readplane is None:
+            raise ServiceUnavailable("readplane_not_attached")
+        return self.readplane.to_doc()
+
+    def readplane_query(self, payload: Dict) -> Dict:
+        """Dispatch one read-plane query. ``payload``: {"kind": "eta" |
+        "preview" | "sweep" | "drain_matrix" | "starve_search",
+        "tenant"?, "timeoutS"?, plus per-kind fields — see
+        readplane/queries.py constructor helpers}."""
+        if self.readplane is None:
+            raise ServiceUnavailable("readplane_not_attached")
+        from kueue_tpu.readplane import (
+            drain_matrix_query, eta_query, preview_query,
+            starve_search_query, sweep_query,
+        )
+
+        kind = payload.get("kind")
+        tenant = str(payload.get("tenant", "default"))
+        if kind == "eta":
+            q = eta_query(
+                cluster_queue=payload.get("clusterQueue"),
+                scenarios=tuple(
+                    self._parse_scenario(s)
+                    for s in payload.get("scenarios") or []
+                ),
+                tenant=tenant,
+            )
+        elif kind == "preview":
+            q = preview_query(
+                self._parse_workload(payload["workload"]),
+                cluster_queue=payload.get("clusterQueue"),
+                tenant=tenant,
+            )
+        elif kind == "sweep":
+            q = sweep_query(
+                payload["node"], payload["flavor"], payload["resource"],
+                tuple(int(d) for d in payload["deltas"]),
+                tenant=tenant,
+            )
+        elif kind == "drain_matrix":
+            q = drain_matrix_query(
+                tuple(payload["drainNodes"]), tenant=tenant,
+            )
+        elif kind == "starve_search":
+            q = starve_search_query(
+                payload["node"], payload["flavor"], payload["resource"],
+                max_cut=int(payload["maxCut"]),
+                points=int(payload.get("points", 4)),
+                rounds=int(payload.get("rounds", 4)),
+                tenant=tenant,
+            )
+        else:
+            raise ValueError(f"unknown read-plane query kind {kind!r}")
+        return self.readplane.query(
+            q, timeout=float(payload.get("timeoutS", 30.0)))
 
     def _parse_scenario(self, s: Dict):
         from kueue_tpu.whatif.engine import QuotaDelta, Scenario
@@ -287,8 +374,14 @@ class VisibilityServer:
         GET  /service          (loop health + configuration)
         GET  /metrics          (Prometheus text exposition)
         GET  /metrics.json     (same registry, JSON document)
+        GET  /readplane        (publisher + coalescer status)
         POST /whatif/eta      {"clusterQueue"?: ..., "scenarios": [...]}
         POST /whatif/preview  {workload spec, see whatif_preview}
+        POST /readplane/query {"kind": ..., see readplane_query}
+
+        Routes whose backing subsystem is not attached return a
+        structured 503 ``{"error": "service unavailable", "reason":
+        ...}`` (machine-readable), never a 200-shaped error dict.
         POST /profile/start   {"logDir"?: ...}   (also GET, operator cURL)
         POST /profile/stop                        (also GET).
 
@@ -329,6 +422,11 @@ class VisibilityServer:
                 structured 400, anything else a structured 500."""
                 try:
                     fn()
+                except ServiceUnavailable as exc:
+                    self._send_json({
+                        "error": "service unavailable",
+                        "reason": exc.reason,
+                    }, 503)
                 except (KeyError, ValueError, TypeError,
                         AttributeError) as exc:
                     self._send_json({
@@ -415,6 +513,10 @@ class VisibilityServer:
                         self._guarded(lambda: self._send_json(
                             svc.to_doc()
                         ))
+                elif parts == ["readplane"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.readplane_doc()
+                    ))
                 elif parts == ["costs"]:
                     self._guarded(lambda: self._send_json(
                         server_self.costs_doc()
@@ -479,6 +581,10 @@ class VisibilityServer:
                 elif parts == ["whatif", "preview"]:
                     self._guarded(lambda: self._send_json(
                         server_self.whatif_preview(payload)
+                    ))
+                elif parts == ["readplane", "query"]:
+                    self._guarded(lambda: self._send_json(
+                        server_self.readplane_query(payload)
                     ))
                 elif parts == ["profile", "start"]:
                     self._guarded(lambda: self._send_json(

@@ -248,7 +248,11 @@ class WhatIfEngine:
             threshold=3, backoff_s=5.0, max_backoff_s=60.0, clock=clock
         )
         self._clock = clock
-        self._lock = threading.Lock()
+        # RLock: maybe_refresh() holds it across its refresh decision AND
+        # the eta() call it triggers (which re-acquires), so a concurrent
+        # preview() can never interleave with the refresh's jit-cache
+        # bucket swap between the decision and the compile.
+        self._lock = threading.RLock()
         self._rollout_fns: Dict[tuple, Callable] = {}
         # Spare-time refresh state (driver hook).
         self.last_report: Optional[WhatIfReport] = None
@@ -368,16 +372,23 @@ class WhatIfEngine:
 
     def maybe_refresh(self, interval_s: float = 30.0) -> Optional[WhatIfReport]:
         """Driver spare-time hook: refresh the cached base ETA forecast
-        at most every ``interval_s``. Never raises."""
-        now = self._clock()
-        if now - self._last_refresh < interval_s:
-            return None
-        self._last_refresh = now
-        try:
-            self.last_report = self.eta()
-        except Exception:  # pragma: no cover - eta() already contains
-            return None
-        return self.last_report
+        at most every ``interval_s``. Never raises.
+
+        Runs entirely under the engine lock (reentrant, so the inner
+        ``eta()`` re-acquires safely): the unlocked version raced a
+        concurrent ``preview()`` on ``_last_refresh`` / ``last_report``
+        and on the jit-cache bucket swap between the refresh decision
+        and the compile (tests/test_whatif.py hammer test)."""
+        with self._lock:
+            now = self._clock()
+            if now - self._last_refresh < interval_s:
+                return None
+            self._last_refresh = now
+            try:
+                self.last_report = self.eta()
+            except Exception:  # pragma: no cover - eta() already contains
+                return None
+            return self.last_report
 
     # ------------------------------------------------------------------
     # rollout path

@@ -1,0 +1,500 @@
+"""Multi-tenant read plane tests (readplane/, docs/whatif.md).
+
+Four claims:
+
+1. **Bit-identity**: coalesced answers equal solo answers against the
+   same pinned snapshot generation — randomized heterogeneous query
+   mixes issued from concurrent threads fold to exactly what each query
+   returns alone (plain ``==``), across seeds, and a tiled plane
+   (small ``lane_budget``) answers identically to a wider one.
+2. **Publishing discipline**: the SnapshotPublisher is demand-gated,
+   fingerprint-deduped and min-interval-throttled; published
+   generations are frozen (later cluster changes don't leak in); a
+   capture failure is counted, never raised into the admission loop.
+3. **Containment & fairness**: a poisoned dispatch window
+   (``faults.READPLANE_DISPATCH``) fails only its own tickets with a
+   structured error, repeated failures open the per-coalescer breaker
+   (which recovers through half-open), and a tenant flooding the window
+   defers — never starves — other tenants (``max_lanes_per_tenant``).
+4. **Wiring**: Manager.readplane() is idempotent, registers the
+   read-plane SLO objectives and attaches to the service loop in either
+   build order; the HTTP layer serves /readplane + /readplane/query and
+   answers detached-subsystem requests with machine-readable 503s
+   (never a 200-shaped error) — the visibility/server.py contract.
+
+Compile budget: every env here uses 2 CQs + one cohort, one flavor,
+one resource, <= 8 pending -> W bucket 16, horizon 64, and every engine
+(templates and the coalescers' internal engines) shares one jit cache;
+lane budgets are chosen so tiles pad to K in {1, 2, 4} — the same
+shapes tests/test_whatif.py pays for.
+"""
+
+import importlib.util
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kueue_tpu.api.types import ResourceFlavor, ResourceQuota
+from kueue_tpu.api.types import Cohort
+from kueue_tpu.manager import Manager
+from kueue_tpu.metrics.registry import Metrics
+from kueue_tpu.obs import costs
+from kueue_tpu.readplane import (
+    ReadPlane,
+    SnapshotPublisher,
+    drain_matrix_query,
+    eta_query,
+    preview_query,
+    starve_search_query,
+    sweep_query,
+)
+from kueue_tpu.tas.snapshot import Node
+from kueue_tpu.utils import faults
+from kueue_tpu.utils.breaker import CLOSED, OPEN, CircuitBreaker
+from kueue_tpu.visibility.server import ServiceUnavailable, VisibilityServer
+from kueue_tpu.whatif.engine import WhatIfEngine
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+pytestmark = pytest.mark.isolated
+
+HORIZON = 64
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# One jit cache for every engine in the file (the test_whatif.py idiom):
+# templates hand their _rollout_fns to the coalescers' internal engines
+# (coalescer._engine_for), so the whole file compiles each (K, W) shape
+# once.
+_SHARED_FNS = {}
+
+
+def make_template(cache, queues, **kw):
+    kw.setdefault("default_runtime_ms", 500)
+    kw.setdefault("horizon_rounds", HORIZON)
+    eng = WhatIfEngine(cache, queues, **kw)
+    eng._rollout_fns = _SHARED_FNS
+    return eng
+
+
+def rp_env(n_pending=6, cpu_m=2000):
+    """The file's one tensor shape: cq-a + cq-b (4000m nominal each)
+    sharing cohort co, a node_labels flavor over four 1000m-cpu nodes
+    (so drain lanes are real proportional quota cuts, not
+    ForecastUnsupported fallbacks), and ``n_pending`` contended
+    workloads."""
+    cache, queues, _sched = build_env(
+        [
+            make_cq("cq-a", cohort="co",
+                    flavors={"default": {"cpu": ResourceQuota(nominal=4000)}}),
+            make_cq("cq-b", cohort="co",
+                    flavors={"default": {"cpu": ResourceQuota(nominal=4000)}}),
+        ],
+        cohorts=[Cohort(name="co")],
+        flavors=[ResourceFlavor(name="default",
+                                node_labels={"pool": "rp"})],
+    )
+    for i in range(4):
+        cache.add_or_update_node(Node(
+            name=f"node-{i}", labels={"pool": "rp"},
+            capacity={"cpu": 1000},
+        ))
+    submit(queues, *[
+        make_wl(f"wl-{i}",
+                queue="lq-cq-a" if i % 2 == 0 else "lq-cq-b",
+                cpu_m=cpu_m, priority=i % 3, creation_time=float(i + 1))
+        for i in range(n_pending)
+    ])
+    return cache, queues
+
+
+def make_plane(cache, queues, clock=time.monotonic, **kw):
+    """A ReadPlane over its own Metrics registry. lane_budget=3 tiles
+    pad to K=4 — the same rollout shape a 3-lane solo query compiles."""
+    m = Metrics()
+    kw.setdefault("lane_budget", 3)
+    kw.setdefault("coalesce_delay_s", 0.005)
+    rp = ReadPlane(cache, queues, metrics=m, clock=clock,
+                   template=make_template(cache, queues), **kw)
+    return rp, m
+
+
+# -- publishing discipline ----------------------------------------------
+
+
+def test_publisher_demand_fingerprint_and_interval_gating():
+    cache, queues = rp_env()
+    t = [100.0]
+    pub = SnapshotPublisher(clock=lambda: t[0], min_interval_s=0.05,
+                            demand_window_s=5.0)
+    # Read-idle: no demand inside the window means no capture at all.
+    assert pub.publish_cycle(cache, queues) is False
+    assert pub.current() is None
+    pub.note_demand()
+    assert pub.publish_cycle(cache, queues) is True
+    rs1 = pub.current()
+    assert rs1.generation == 1 and rs1.pending_total == 6
+    # Unchanged fingerprint: a busy read plane over a quiet cluster
+    # reuses the generation.
+    t[0] += 1.0
+    pub.note_demand()
+    assert pub.publish_cycle(cache, queues) is False
+    assert pub.current() is rs1
+    # State moved -> new generation (double buffer: rs1 stays frozen).
+    submit(queues, make_wl("wl-late", queue="lq-cq-a", cpu_m=1000,
+                           creation_time=50.0))
+    t[0] += 1.0
+    assert pub.publish_cycle(cache, queues) is True
+    rs2 = pub.current()
+    assert rs2.generation == 2 and rs2.pending_total == 7
+    assert rs1.pending_total == 6  # the old buffer didn't mutate
+    # Min-interval throttle: churn within the window defers capture.
+    submit(queues, make_wl("wl-later", queue="lq-cq-b", cpu_m=1000,
+                           creation_time=51.0))
+    t[0] += 0.01
+    assert pub.publish_cycle(cache, queues) is False
+    t[0] += 1.0
+    assert pub.publish_cycle(cache, queues) is True
+    assert pub.current().generation == 3
+
+
+def test_publish_cycle_failure_is_contained():
+    class _Boom:
+        def __getattr__(self, name):
+            raise RuntimeError("boom")
+
+    cache, queues = rp_env()
+    m = Metrics()
+    pub = SnapshotPublisher(metrics=m, clock=time.monotonic)
+    pub.note_demand()
+    # A capture failure must never raise into the admission loop.
+    assert pub.publish_cycle(_Boom(), queues) is False
+    assert pub.publish_errors == 1
+    assert m.counter_total("readplane_publish_errors_total") == 1.0
+    # And the plane still publishes fine afterwards.
+    assert pub.publish_cycle(cache, queues) is True
+    assert pub.current().generation == 1
+
+
+def test_publish_force_skips_demand_gate():
+    cache, queues = rp_env()
+    rp, _m = make_plane(cache, queues)
+    assert rp.publish(force=True) is True
+    assert rp.publisher.current().generation == 1
+
+
+# -- bit-identity (the differential) ------------------------------------
+
+
+def _mix(rng):
+    """One randomized heterogeneous query mix. Fresh Query objects per
+    call (starve_search mutates its bisection bracket as it folds), but
+    the same rng seed rebuilds the identical mix."""
+    nodes = [f"node-{i}" for i in range(4)]
+    qs = [
+        sweep_query("cq-a", "default", "cpu",
+                    deltas=tuple(rng.sample([500, 1000, 1500, 2000], 3)),
+                    tenant="t-sweep"),
+        drain_matrix_query(tuple(rng.sample(nodes, 2)), tenant="t-drain"),
+        starve_search_query("cq-b", "default", "cpu", max_cut=3000,
+                            points=3, rounds=2, tenant="t-starve"),
+        eta_query(cluster_queue=rng.choice(["cq-a", "cq-b"]),
+                  tenant="t-eta"),
+        preview_query(
+            make_wl("hypo-prev", queue="lq-cq-b", cpu_m=1000, priority=5,
+                    creation_time=50.0),
+            cluster_queue="cq-b", tenant="t-prev"),
+    ]
+    rng.shuffle(qs)
+    return qs
+
+
+def test_concurrent_coalesced_equals_solo_across_seeds():
+    cache, queues = rp_env()
+    rp, _m = make_plane(cache, queues)
+    rp.publish(force=True)
+    rp.start()
+    try:
+        for seed in (1, 2, 3):
+            solo = [rp.query_solo(q) for q in _mix(random.Random(seed))]
+            assert all(a.get("ok") for a in solo)
+            assert all(a.get("generation") == 1 for a in solo)
+            qs = _mix(random.Random(seed))
+            order = list(range(len(qs)))
+            random.Random(seed + 99).shuffle(order)
+            results = [None] * len(qs)
+
+            def issue(idxs, qs=qs, results=results):
+                for i in idxs:
+                    results[i] = rp.query(qs[i], timeout=120.0)
+
+            threads = [threading.Thread(target=issue,
+                                        args=(order[w::3],))
+                       for w in range(3)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=180.0)
+            assert results == solo, f"seed {seed} diverged"
+    finally:
+        rp.stop()
+
+
+def test_tiled_plane_answers_match_wider_plane():
+    cache, queues = rp_env()
+    # Same 5-delta sweep through a 1-lane-per-tile plane and a 3-lane
+    # one: tiling splits lanes across dispatches but lanes are
+    # independent, so the folded answers must be identical — only the
+    # peak scenario-plane bucket (the memory bound) differs.
+    deltas = (500, 1000, 1500, 2000, 2500)
+    rp_narrow, m_narrow = make_plane(cache, queues, lane_budget=1)
+    rp_wide, m_wide = make_plane(cache, queues, lane_budget=3)
+    rp_narrow.publish(force=True)
+    rp_wide.publish(force=True)
+    a_narrow = rp_narrow.query_solo(
+        sweep_query("cq-a", "default", "cpu", deltas=deltas))
+    a_wide = rp_wide.query_solo(
+        sweep_query("cq-a", "default", "cpu", deltas=deltas))
+    assert a_narrow.get("ok") and a_wide.get("ok")
+    assert a_narrow == a_wide
+    assert rp_narrow.coalescer.peak_tile_lanes == 2  # pow2(1 lane + base)
+    assert rp_wide.coalescer.peak_tile_lanes == 4  # pow2(3 lanes + base)
+    assert m_narrow.counter_total("readplane_dispatch_tiles_total") == 5.0
+    assert m_wide.counter_total("readplane_dispatch_tiles_total") == 2.0
+
+
+# -- fairness ------------------------------------------------------------
+
+
+def test_tenant_lane_cap_defers_but_never_starves():
+    cache, queues = rp_env()
+    rp, m = make_plane(cache, queues, max_lanes_per_tenant=4,
+                       coalesce_delay_s=0.0)
+    rp.publish(force=True)
+    co = rp.coalescer
+    # Worker NOT started: drive windows white-box so the partition is
+    # deterministic. Tenant "big" floods 3 sweeps x 3 lanes; "small"
+    # rides one eta lane behind them.
+    big = [co.submit(sweep_query(
+        "cq-a", "default", "cpu",
+        deltas=(500 * (i + 1), 1000 * (i + 1), 1500), tenant="big"))
+        for i in range(3)]
+    small = co.submit(eta_query(cluster_queue="cq-b", tenant="small"))
+    w1 = co._next_window()
+    # First query of a tenant always admits (3 lanes); the second would
+    # exceed the 4-lane cap -> deferred, small's first query admits.
+    assert [t.query.tenant for t in w1] == ["big", "small"]
+    assert m.counter_total("readplane_deferred_total") == 2.0
+    with co._exec_lock:
+        assert co._execute(w1) == []
+    w2 = co._next_window()
+    assert [t.query.tenant for t in w2] == ["big"]
+    assert m.counter_total("readplane_deferred_total") == 3.0
+    with co._exec_lock:
+        assert co._execute(w2) == []
+    w3 = co._next_window()
+    assert [t.query.tenant for t in w3] == ["big"]
+    with co._exec_lock:
+        assert co._execute(w3) == []
+    # Deferred is not dropped: every ticket resolved, in order, ok.
+    for t in big + [small]:
+        assert t.answer is not None and t.answer["ok"]
+    assert big[1].answer["kind"] == "sweep"
+
+
+# -- containment ---------------------------------------------------------
+
+
+def test_poisoned_window_fails_only_its_own_tickets():
+    cache, queues = rp_env()
+    rp, m = make_plane(cache, queues)
+    rp.publish(force=True)
+    plan = faults.FaultPlan(seed=7)
+    plan.add(faults.READPLANE_DISPATCH, mode="raise", times=1)
+    faults.install(plan)
+    try:
+        bad = rp.query_solo(sweep_query("cq-a", "default", "cpu",
+                                        deltas=(500, 1000)))
+        assert bad["ok"] is False
+        assert bad["error"] == "dispatch_failed"
+        assert "InjectedFault" in bad["reason"]
+        assert m.counter_total("readplane_batch_failures_total") == 1.0
+        # The next window re-coalesces cleanly (breaker threshold is 3).
+        good = rp.query_solo(sweep_query("cq-a", "default", "cpu",
+                                         deltas=(500, 1000)))
+        assert good["ok"] is True and good["basis"] == "rollout"
+    finally:
+        faults.clear()
+
+
+def test_breaker_opens_and_recovers_half_open():
+    cache, queues = rp_env()
+    t = [500.0]
+    rp, m = make_plane(
+        cache, queues, clock=lambda: t[0],
+        breaker=CircuitBreaker(threshold=2, backoff_s=5.0,
+                               max_backoff_s=5.0, clock=lambda: t[0]))
+    rp.publish(force=True)
+    q = lambda: sweep_query("cq-a", "default", "cpu", deltas=(500,))  # noqa: E731
+    plan = faults.FaultPlan(seed=7)
+    plan.add(faults.READPLANE_DISPATCH, mode="raise", times=2)
+    faults.install(plan)
+    try:
+        assert rp.query_solo(q())["error"] == "dispatch_failed"
+        assert rp.query_solo(q())["error"] == "dispatch_failed"
+        assert rp.coalescer.breaker.state == OPEN
+        # Open breaker sheds fast: no dispatch, structured error.
+        shed = rp.query_solo(q())
+        assert shed["error"] == "breaker_open"
+        assert m.get("readplane_breaker_state") == 1.0
+        # Past the backoff, the half-open probe dispatch closes it.
+        t[0] += 6.0
+        ok = rp.query_solo(q())
+        assert ok["ok"] is True
+        assert rp.coalescer.breaker.state == CLOSED
+        assert m.get("readplane_breaker_state") == 0.0
+    finally:
+        faults.clear()
+
+
+# -- wiring --------------------------------------------------------------
+
+
+def test_manager_wiring_slo_and_service_attach():
+    mgr = Manager()
+    rp = mgr.readplane(lane_budget=3)
+    assert mgr.readplane() is rp
+    with pytest.raises(ValueError):
+        mgr.readplane(lane_budget=5)
+    names = {o.name for o in mgr.slo().objectives}
+    assert {"readplane_query_latency", "readplane_staleness"} <= names
+    # readplane-then-service ...
+    svc = mgr.service()
+    assert svc._readplane is rp
+    # ... and service-then-readplane both wire the publish hook.
+    mgr2 = Manager()
+    svc2 = mgr2.service()
+    assert svc2._readplane is None
+    rp2 = mgr2.readplane()
+    assert svc2._readplane is rp2
+
+
+def test_tenant_cost_cells():
+    cache, queues = rp_env()
+    rp, _m = make_plane(cache, queues)
+    rp.publish(force=True)
+    led = costs.enable()
+    led.clear()
+    try:
+        assert rp.query(sweep_query("cq-a", "default", "cpu",
+                                    deltas=(500, 1000), tenant="acme"),
+                        timeout=120.0)["ok"]
+        assert rp.query_solo(eta_query(cluster_queue="cq-b",
+                                       tenant="globex"))["ok"]
+        doc = led.snapshot()
+        assert "readplane[acme]" in doc["entries"]
+        assert "readplane[globex]" in doc["entries"]
+        assert doc["entries"]["readplane[acme]"]["dispatches"] >= 1
+    finally:
+        costs.disable()
+        rp.stop()
+
+
+def test_readplane_guard_checker_is_clean():
+    spec = importlib.util.spec_from_file_location(
+        "check_readplane_guards",
+        REPO_ROOT / "tools" / "check_readplane_guards.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run_check() == []
+
+
+# -- HTTP ----------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}") as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_readplane_endpoints():
+    cache, queues = rp_env()
+    rp, m = make_plane(cache, queues)
+    rp.publish(force=True)
+    srv = VisibilityServer(queues, metrics=m, readplane=rp)
+    httpd = srv.serve(port=0)
+    port = httpd.server_address[1]
+    try:
+        status, doc = _get(port, "/readplane")
+        assert status == 200
+        assert doc["coalescer"]["laneBudget"] == 3
+        assert doc["publisher"]["current"]["generation"] == 1
+        status, body = _post(port, "/readplane/query", {
+            "kind": "sweep", "node": "cq-a", "flavor": "default",
+            "resource": "cpu", "deltas": [500, 1000], "tenant": "acme",
+            "timeoutS": 120.0,
+        })
+        assert status == 200
+        assert body["ok"] is True and body["kind"] == "sweep"
+        assert body["generation"] == 1
+        assert [p["delta"] for p in body["points"]] == [500, 1000]
+        # /whatif/eta routes through the coalesced read path when a
+        # read plane is attached — same pinned generation.
+        status, body = _get(port, "/whatif/eta?cluster_queue=cq-a")
+        assert status == 200
+        assert body["ok"] is True and body["kind"] == "eta"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(port, "/readplane/query", {"kind": "nope"})
+        assert err.value.code == 400
+        detail = json.loads(err.value.read())
+        assert detail["error"] == "bad request"
+    finally:
+        httpd.shutdown()
+        rp.stop()
+
+
+def test_http_detached_subsystems_return_machine_readable_503():
+    _cache, queues = rp_env()
+    srv = VisibilityServer(queues)  # no whatif, no readplane
+    httpd = srv.serve(port=0)
+    port = httpd.server_address[1]
+    try:
+        for path, post_payload in (
+            ("/whatif/eta", None),
+            ("/whatif/preview",
+             {"name": "x", "requests": {"cpu": 1000}}),
+            ("/readplane", None),
+            ("/readplane/query", {"kind": "eta"}),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                if post_payload is None:
+                    _get(port, path)
+                else:
+                    _post(port, path, post_payload)
+            assert err.value.code == 503, path
+            body = json.loads(err.value.read())
+            assert body["error"] == "service unavailable", path
+            assert body["reason"] in (
+                "whatif_engine_not_attached", "readplane_not_attached"
+            ), path
+    finally:
+        httpd.shutdown()
+    # The same contract, straight off the API surface.
+    with pytest.raises(ServiceUnavailable) as exc:
+        srv.whatif_eta()
+    assert exc.value.reason == "whatif_engine_not_attached"

@@ -544,6 +544,52 @@ def test_maybe_refresh_honors_interval():
     assert again is not None and again is eng.last_report
 
 
+def test_maybe_refresh_two_thread_hammer():
+    """The race maybe_refresh's docstring pins: an unlocked refresh
+    raced a concurrent preview() on ``_last_refresh`` / ``last_report``
+    and on the jit-cache bucket swap between the refresh decision and
+    the compile. Two refresher threads and two preview threads hammer
+    the engine; no exception may escape and every published report must
+    be a complete rollout report."""
+    import threading
+
+    cache, queues, _ = _contended_env()
+    t = [0.0]
+    eng = make_engine(cache, queues, clock=lambda: t[0])
+    first = eng.maybe_refresh(interval_s=0.0)  # compile pre-hammer
+    assert first is not None and first.basis == "rollout"
+    hypo = make_wl("hammer-hypo", queue="lq", cpu_m=1_000, priority=5)
+    errors = []
+    published = []
+
+    def hammer(refresher: bool) -> None:
+        try:
+            for _ in range(25):
+                if refresher:
+                    r = eng.maybe_refresh(interval_s=0.5)
+                    if r is not None:
+                        published.append(r)
+                else:
+                    eng.preview(hypo, cluster_queue="cq-a")
+                # Racy += is fine: the clock only needs to move forward.
+                t[0] += 0.1
+        except Exception as exc:  # noqa: BLE001 - the assertion target
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer, args=(w,))
+               for w in (True, False, True, False)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=300.0)
+    assert not any(th.is_alive() for th in threads)
+    assert errors == []
+    assert published and all(r.basis == "rollout" for r in published)
+    # Appends happen outside the engine lock, so `published` order can
+    # lag the last assignment — membership is the invariant.
+    assert eng.last_report in published
+
+
 # ---------------------------------------------------------------------------
 # K-lane padding waste + cost attribution
 # ---------------------------------------------------------------------------

@@ -14,6 +14,9 @@ Wraps the repo checkers —
 - ``check_ha_containment.py``: every HA state-mutation site in
   ``controllers/ha.py`` sits inside a ``_contained(...)`` scope
   (docs/failover.md recovery invariants);
+- ``check_readplane_guards.py``: the read-plane publish/coalesce hooks
+  stay behind their ``self._readplane`` / ``_should_capture`` /
+  ``ENABLED`` guards (zero-cost when no read plane is attached);
 - ``check_perf_ledger.py``: newest PERF_LEDGER.jsonl record per probe
   fingerprint has not regressed vs its rolling median —
 
@@ -38,6 +41,7 @@ CHECKERS = (
     "check_kernel_gates.py",
     "check_pipeline_guards.py",
     "check_ha_containment.py",
+    "check_readplane_guards.py",
     "check_perf_ledger.py",
 )
 

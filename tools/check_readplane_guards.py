@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Static check: the read-plane hot-path hooks stay zero-cost when the
+read plane is off (and its optional accounting stays flag-gated).
+
+The read plane must cost a deployment that never attaches one exactly
+one attribute read per service-loop step — the same contract
+``tracing.ENABLED`` / ``faults.ENABLED`` carry (tools/
+check_kernel_gates.py) and the pipeline hooks carry
+(tools/check_pipeline_guards.py). The guarded seams:
+
+- ``obs/service.py`` guards its per-step snapshot publish
+  (``....publish_cycle(...)``) with ``if self._readplane``;
+- ``readplane/publisher.py`` only captures behind its gate
+  (``self._capture(...)`` under ``self._should_capture``), so demand-
+  idle cycles never pay a clone;
+- ``readplane/coalescer.py`` guards fault injection with
+  ``faults.ENABLED`` and tenant cost attribution with
+  ``costs.ENABLED``.
+
+For every call site matching one of those patterns, this checker walks
+back from the call line (at most ``MAX_WALKBACK`` lines) to the first
+non-blank line at strictly lower indentation — the statement that owns
+the enclosing block — and requires the guard substring on that line. It
+also requires at least one site per (file, pattern): deleting a hook
+without deleting its rule fails loudly instead of silently un-checking.
+
+Run standalone (exit 1 on violations) or via tools/check_all.py.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "kueue_tpu"
+
+MAX_WALKBACK = 40
+
+# (file, call-site substring, required guard substring). Call patterns
+# include the leading receiver dot so ``def`` lines never match.
+RULES: Tuple[Tuple[Path, str, str], ...] = (
+    (PACKAGE / "obs" / "service.py",
+     ".publish_cycle(", "self._readplane"),
+    (PACKAGE / "readplane" / "publisher.py",
+     "self._capture(", "self._should_capture"),
+    (PACKAGE / "readplane" / "coalescer.py",
+     "faults.fire(", "faults.ENABLED"),
+    (PACKAGE / "readplane" / "coalescer.py",
+     "costs.charge", "costs.ENABLED"),
+)
+
+
+def _indent(line: str) -> int:
+    return len(line) - len(line.lstrip())
+
+
+def _enclosing_stmt(lines: List[str], i: int) -> Tuple[int, str]:
+    """Index + text of the first non-blank line above ``lines[i]`` with
+    strictly lower indentation (the owner of the enclosing block)."""
+    base = _indent(lines[i])
+    for j in range(i - 1, max(-1, i - 1 - MAX_WALKBACK), -1):
+        line = lines[j]
+        if not line.strip():
+            continue
+        if _indent(line) < base:
+            return j, line
+    return -1, ""
+
+
+def run_check() -> List[str]:
+    violations: List[str] = []
+    for path, call, guard in RULES:
+        try:
+            lines = path.read_text().splitlines()
+        except OSError as exc:
+            violations.append(f"{path}: unreadable ({exc})")
+            continue
+        sites = [
+            i for i, line in enumerate(lines)
+            if call in line and not line.lstrip().startswith("#")
+        ]
+        if not sites:
+            violations.append(
+                f"{path}: no call site matching {call!r} — the hook was "
+                f"removed; update RULES in {Path(__file__).name}"
+            )
+            continue
+        for i in sites:
+            j, stmt = _enclosing_stmt(lines, i)
+            if guard not in stmt:
+                where = f"{path}:{i + 1}"
+                owner = (
+                    f"line {j + 1}: {stmt.strip()!r}" if j >= 0
+                    else "no enclosing statement found in walk-back range"
+                )
+                violations.append(
+                    f"{where}: {call!r} is not directly guarded by "
+                    f"'{guard}' (enclosing {owner}) — the read-plane hook "
+                    f"must be zero-cost when the read plane is off"
+                )
+    return violations
+
+
+def main() -> int:
+    violations = run_check()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} readplane-guard violation(s)")
+        return 1
+    print("readplane guard check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,9 @@ the persistent compile cache instead of recompiling from zero
 (perf/compile_cache.py). ``--perf-gate`` additionally runs the warm-
 failover drill (``bench.py --probe failover`` — the kill/recover
 differential of docs/failover.md, which appends ``failover_takeover_ms``
-to the ledger) and then ``tools/check_perf_ledger.py``, so a failed
+to the ledger), the read-plane probe (``bench.py --probe readplane`` —
+coalesced-vs-sequential serving speedup + bounded tiled-K memory,
+docs/whatif.md) and then ``tools/check_perf_ledger.py``, so a failed
 drill or a headline-metric regression recorded in PERF_LEDGER.jsonl
 fails the run like a test would. ``--checks`` runs ``tools/check_all.py`` (all static checkers +
 import smoke) before the suite and fails fast if any checker does.
@@ -194,6 +196,18 @@ def main(argv: list) -> int:
         )
         if rc != 0:
             failures.append(("perf-gate:failover", rc))
+        # Read-plane probe: coalesced-vs-sequential serving speedup,
+        # query p99 under concurrent load, snapshot staleness, and the
+        # bounded tiled-K scenario plane (docs/whatif.md).
+        print("== [perf-gate] bench.py --probe readplane", flush=True)
+        rc = subprocess.call(
+            [sys.executable, str(REPO_ROOT / "bench.py"),
+             "--probe", "readplane", "--scale", "0.05",
+             "--platform", "cpu"],
+            cwd=str(REPO_ROOT),
+        )
+        if rc != 0:
+            failures.append(("perf-gate:readplane", rc))
         # Perf-ledger gate: headline metrics in PERF_LEDGER.jsonl must
         # not regress vs their rolling median (check_perf_ledger.py).
         print("== [perf-gate] tools/check_perf_ledger.py", flush=True)
