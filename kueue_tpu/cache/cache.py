@@ -101,6 +101,13 @@ class Cache:
         # Count of cap-trims applied to the event log; tailers holding a
         # cursor into a trimmed range get CursorLost and must resync.
         self.workload_event_trims = 0
+        # Columnar workload plane (cache/columns.py): struct-of-arrays
+        # per-workload encode inputs, invalidated through the workload
+        # event log and shared with every snapshot. The encoders gather
+        # from it instead of re-walking rows in Python.
+        from kueue_tpu.cache.columns import WorkloadColumns
+
+        self.workload_columns = WorkloadColumns()
         # Structure cache for TAS snapshots: keyed by the generations the
         # template actually depends on (quota + node inputs).
         self._tas_templates: Dict[str, tuple] = {}
@@ -209,6 +216,11 @@ class Cache:
         self._workload_events.append(
             (kind, key, cq, items, info.priority(), info.obj.uid, info)
         )
+        # The same event stream drives columnar invalidation: these are
+        # exactly the in-place workload mutations (quota-reservation
+        # flips, evictions, elastic reaccounts) that object identity
+        # cannot detect.
+        self.workload_columns.note_event(kind, key)
         if len(self._workload_events) > self._EVENT_LOG_CAP:
             drop = len(self._workload_events) // 2
             del self._workload_events[:drop]
@@ -275,6 +287,10 @@ class Cache:
             self._live_remove(key)
             self.workloads.pop(key, None)
             self.assumed.discard(key)
+            # Release the columnar row (and its strong WorkloadInfo ref)
+            # even when the workload never reached the live tree — the
+            # event hook only sees live-set mutations.
+            self.workload_columns.drop(key)
             self.workload_generation += 1
 
     def reaccount_workload(self, key: str, mutate) -> None:
@@ -380,6 +396,7 @@ class Cache:
             self._ensure_live()
             snap = Snapshot()
             snap.generation = self.generation
+            snap.workload_columns = self.workload_columns
             snap.quota_generation = self.quota_generation
             snap.node_generation = self.node_generation
             snap.admitted_generation = self.admitted_generation

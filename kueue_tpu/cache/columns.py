@@ -1,0 +1,505 @@
+"""Struct-of-arrays workload column store: the columnar truth source for
+the cycle encoders.
+
+``WorkloadColumns`` keeps every encode-relevant per-workload fact in
+NumPy slabs (one row per workload, grow-by-doubling like the arena's
+admitted store) so a cold or full encode becomes column gathers
+(``np.take`` / fancy indexing) instead of an O(W) Python row walk:
+
+- scalar slabs: CQ vocab id, priority, timestamp, quota-reservation and
+  preemption-gate flags, pod count, flavor-resume index;
+- a fixed-width request table (``REQ_WIDTH`` resource-vocab/value pairs
+  per row — the dense analog of slot 0's request dict; rows needing
+  more stay row-wise, the ragged-overflow contract);
+- an eligibility slab over a store-level flavor vocabulary (the
+  per-(workload, flavor) taints/affinity verdict, allowed-flavor label
+  already folded in).
+
+Rows are filled lazily by ``gather`` (and in bulk by ``warm``) with the
+exact per-row logic of the row-wise oracle, then reused across cycles,
+tiles, arena deltas, speculation and failover restores. A row is valid
+for a snapshot iff
+
+- the head is the *same* ``WorkloadInfo`` object the row was filled
+  from (the queue manager builds a fresh ``WorkloadInfo`` on every spec
+  update, so object identity subsumes spec generations; the store holds
+  a strong reference, so ``id`` reuse cannot alias),
+- the snapshot's ``quota_generation`` matches (flavor vocab, CQ
+  membership, eligibility and resume validity are all quota-keyed),
+- ``id(info.last_assignment)`` matches (every writer installs a fresh
+  assignment object), and
+- no cache workload event dirtied the key since the fill
+  (``note_event`` — quota-reservation flips and evictions mutate the
+  workload object in place, which identity alone cannot see).
+
+The *dense class* a row can represent columnar-ly is deliberately the
+same class the arena's ``_build_w`` handles: single assignment slot on
+resource group 0, no topology request, no partial-admission reduction,
+at most ``REQ_WIDTH`` request entries. For such rows the stored
+``compat`` verdict is context-free: ``_device_compatible`` only reads
+``preempt``/``fair_sharing``/``delayed``/TAS state on topology or
+partial rows, which are excluded from the class. Any head outside the
+class makes ``gather`` return ``None`` and the cycle takes the
+row-wise oracle unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from kueue_tpu.core.workload_info import (
+    has_quota_reservation,
+    queue_order_timestamp,
+)
+
+# Fixed request-table width: rows whose slot-0 request dict has more
+# entries are ragged and stay on the row-wise oracle. Real workloads
+# request a handful of resources (cpu/memory/accelerator + extended).
+REQ_WIDTH = 8
+
+
+class GatherView(NamedTuple):
+    """One cycle's resolved head set, as store coordinates.
+
+    ``device_idx``/``fallback_idx`` partition ``range(len(heads))`` in
+    head order (the oracle's classification order); ``rows`` are the
+    store rows of the device heads, aligned with ``device_idx``.
+    """
+
+    rows: np.ndarray          # i64[M] store rows, device heads in order
+    device_idx: np.ndarray    # i64[M] positions into heads
+    fallback_idx: np.ndarray  # i64[H-M] positions into heads
+    filled: int               # rows (re)filled by this gather
+
+
+class WorkloadColumns:
+    """Incrementally maintained struct-of-arrays workload store."""
+
+    def __init__(self, cap: int = 1024) -> None:
+        cap = max(16, int(cap))
+        self._cap = cap
+        self._index: Dict[str, int] = {}
+        self._free: List[int] = []
+        self._next = 0
+        # Bumped on every fill/invalidate: callers key component caches
+        # and fingerprints off it (docs/observability.md,
+        # solver_encode_columns_generation).
+        self.generation = 0
+        self.filled_total = 0
+        self._axis_cache = None
+        # Vocabularies (store-level; per-encode maps translate to the
+        # cycle's node/flavor/resource axes).
+        self._cq_vid: Dict[str, int] = {}
+        self._cq_names: List[str] = []
+        self._res_vid: Dict[str, int] = {}
+        self._res_names: List[str] = []
+        self._flavor_vid: Dict[str, int] = {}
+        self._flavor_names: List[str] = []
+        # Row slabs.
+        self.info = np.empty(cap, dtype=object)     # strong refs
+        self.qgen = np.full(cap, -1, dtype=np.int64)
+        self.la_id = np.zeros(cap, dtype=np.int64)
+        self.dirty = np.zeros(cap, dtype=bool)
+        self.dense = np.zeros(cap, dtype=bool)
+        self.compat = np.zeros(cap, dtype=bool)
+        self.cq = np.zeros(cap, dtype=np.int32)
+        self.priority = np.zeros(cap, dtype=np.int64)
+        self.timestamp = np.zeros(cap, dtype=np.float64)
+        self.quota_reserved = np.zeros(cap, dtype=bool)
+        self.gates = np.zeros(cap, dtype=bool)
+        self.count = np.ones(cap, dtype=np.int64)
+        self.start_flavor = np.zeros(cap, dtype=np.int32)
+        self.req_vid = np.full((cap, REQ_WIDTH), -1, dtype=np.int32)
+        self.req_val = np.zeros((cap, REQ_WIDTH), dtype=np.int64)
+        self.elig = np.zeros((cap, 0), dtype=bool)
+
+    # -- slab plumbing -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _grow(self) -> None:
+        old = self._cap
+        new = old * 2
+        self.info = np.concatenate(
+            [self.info, np.empty(old, dtype=object)]
+        )
+        self.qgen = np.concatenate(
+            [self.qgen, np.full(old, -1, dtype=np.int64)]
+        )
+        for name in ("la_id", "cq", "priority", "count"):
+            col = getattr(self, name)
+            setattr(self, name, np.concatenate(
+                [col, np.zeros(old, dtype=col.dtype)]
+            ))
+        for name in ("dirty", "dense", "compat", "quota_reserved", "gates"):
+            col = getattr(self, name)
+            setattr(self, name, np.concatenate(
+                [col, np.zeros(old, dtype=bool)]
+            ))
+        self.timestamp = np.concatenate(
+            [self.timestamp, np.zeros(old, dtype=np.float64)]
+        )
+        self.start_flavor = np.concatenate(
+            [self.start_flavor, np.zeros(old, dtype=np.int32)]
+        )
+        self.req_vid = np.concatenate(
+            [self.req_vid, np.full((old, REQ_WIDTH), -1, dtype=np.int32)]
+        )
+        self.req_val = np.concatenate(
+            [self.req_val, np.zeros((old, REQ_WIDTH), dtype=np.int64)]
+        )
+        self.elig = np.concatenate(
+            [self.elig, np.zeros((old, self.elig.shape[1]), dtype=bool)]
+        )
+        self._cap = new
+
+    def _alloc(self, key: str) -> int:
+        row = self._index.get(key)
+        if row is not None:
+            return row
+        if self._free:
+            row = self._free.pop()
+        else:
+            if self._next >= self._cap:
+                self._grow()
+            row = self._next
+            self._next += 1
+        self._index[key] = row
+        return row
+
+    def _intern(self, vid: Dict[str, int], names: List[str],
+                name: str) -> int:
+        v = vid.get(name)
+        if v is None:
+            v = len(names)
+            vid[name] = v
+            names.append(name)
+        return v
+
+    def _intern_flavor(self, name: str) -> int:
+        v = self._flavor_vid.get(name)
+        if v is None:
+            v = len(self._flavor_names)
+            self._flavor_vid[name] = v
+            self._flavor_names.append(name)
+            self.elig = np.concatenate(
+                [self.elig, np.zeros((self._cap, 1), dtype=bool)], axis=1
+            )
+        return v
+
+    # -- event-log application --------------------------------------------
+
+    def note_event(self, kind: int, key: str) -> None:
+        """One cache workload event (``Cache._record_workload_event``):
+        the workload object mutated in place (quota-reservation flip,
+        eviction, elastic reaccount), which the identity check cannot
+        see — mark the key's row for refill."""
+        row = self._index.get(key)
+        if row is not None:
+            self.dirty[row] = True
+            self.generation += 1
+
+    def drop(self, key: str) -> None:
+        row = self._index.pop(key, None)
+        if row is not None:
+            self.info[row] = None
+            self.qgen[row] = -1
+            self._free.append(row)
+            self.generation += 1
+
+    # -- row fill (the per-row oracle; shared with the row-wise encoder) ---
+
+    def _quota_flavor_axis(self, snapshot) -> Dict[str, int]:
+        """The cycle flavor axis (flavor name -> column), rebuilt the
+        exact way ``ops.tree_encode.encode_tree`` builds
+        ``tidx.flavor_of``: pre-order quota-tree traversal, first
+        occurrence wins. Memoized per quota generation — the axis is a
+        pure function of the quota tree."""
+        qgen = getattr(snapshot, "quota_generation", None)
+        cached = self._axis_cache
+        if cached is not None and cached[0] == qgen:
+            return cached[1]
+        flavor_of: Dict[str, int] = {}
+
+        def collect(node) -> None:
+            for fr in node.quotas:
+                if fr.flavor not in flavor_of:
+                    flavor_of[fr.flavor] = len(flavor_of)
+            for child in node.children:
+                collect(child)
+
+        for root in snapshot.roots:
+            collect(root)
+        self._axis_cache = (qgen, flavor_of)
+        return flavor_of
+
+    def fill_row(self, info, snapshot, resource_flavors) -> int:
+        """(Re)fill ``info``'s row from the snapshot with the exact
+        per-row logic of the row-wise oracle; returns the row index.
+        This is per-workload Python by design — the ragged fallback the
+        column plane is built from, run once per (workload, quota
+        generation) instead of once per cycle."""
+        from kueue_tpu.models.encode import (
+            _device_compatible,
+            _workload_slots,
+        )
+        from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
+
+        row = self._alloc(info.key)
+        self.info[row] = info
+        self.la_id[row] = id(info.last_assignment)
+        self.qgen[row] = int(getattr(snapshot, "quota_generation", 0))
+        self.dirty[row] = False
+        self.generation += 1
+        self.filled_total += 1
+
+        self.priority[row] = info.priority()
+        self.timestamp[row] = queue_order_timestamp(info.obj)
+        self.quota_reserved[row] = has_quota_reservation(info.obj)
+        self.gates[row] = bool(info.obj.preemption_gates)
+        self.count[row] = info.obj.pod_sets[0].count
+        self.cq[row] = self._intern(
+            self._cq_vid, self._cq_names, info.cluster_queue
+        )
+
+        # Dense-class membership: topology or partial rows have
+        # context-dependent compatibility (preempt/fair/delayed/TAS) and
+        # stay row-wise; everything below is context-free.
+        if any(
+            ps.topology_request is not None
+            or (ps.min_count is not None and ps.min_count < ps.count)
+            for ps in info.obj.pod_sets
+        ):
+            self.dense[row] = False
+            self.compat[row] = False
+            return row
+
+        cqs = snapshot.cluster_queues.get(info.cluster_queue)
+        slots = _workload_slots(info, cqs) if cqs is not None else None
+        compat = _device_compatible(
+            info, snapshot, slots, frozenset(), False, True, False
+        )
+        if not compat:
+            # Host-fallback row: dense (the verdict is all the encoder
+            # needs), no field payload.
+            self.dense[row] = True
+            self.compat[row] = False
+            return row
+        if len(slots) > 1 or slots[0].rg_idx != 0 \
+                or len(slots[0].requests) > REQ_WIDTH:
+            # Device-compatible but outside the columnar class (slot
+            # layout / ragged-wide request dict): the whole cycle must
+            # take the row-wise path to build slot planes.
+            self.dense[row] = False
+            self.compat[row] = True
+            return row
+        self.dense[row] = True
+        self.compat[row] = True
+
+        self.req_vid[row] = -1
+        self.req_val[row] = 0
+        for k, (res, v) in enumerate(slots[0].requests.items()):
+            self.req_vid[row, k] = self._intern(
+                self._res_vid, self._res_names, res
+            )
+            self.req_val[row, k] = v
+
+        # Taints/affinity eligibility, identical to the oracle incl. its
+        # per-WorkloadInfo cache (shared, so verify mode never computes
+        # the matcher twice) and the allowed-resource-flavor mask. The
+        # cached erows row is shaped on the cycle flavor axis
+        # (tidx.flavor_of — quota-tree pre-order), reproduced here so
+        # the shared cache stays coherent between both fill paths.
+        gen = cqs.allocatable_generation
+        flavor_of = self._quota_flavor_axis(snapshot)
+        f = max(len(flavor_of), 1)
+        cached = getattr(info, "_elig_cache", None)
+        if cached is not None and cached[0] == gen \
+                and cached[1].shape == (len(slots), f):
+            erows = cached[1]
+        else:
+            assigner = FlavorAssigner(info, cqs, resource_flavors)
+            erows = np.zeros((len(slots), f), dtype=bool)
+            for si, sl in enumerate(slots):
+                pod_sets = [info.obj.pod_sets[j] for j in sl.ps_ids]
+                for fname, fi in flavor_of.items():
+                    ok, _ = assigner._check_flavor_for_podsets(
+                        fname, pod_sets
+                    )
+                    erows[si, fi] = ok
+            info._elig_cache = (gen, erows)
+        allowed = info.obj.labels.get(
+            "kueue.x-k8s.io/allowed-resource-flavor"
+        )
+        er = erows[0]
+        if allowed is not None:
+            amask = np.zeros(f, dtype=bool)
+            ai = flavor_of.get(allowed)
+            if ai is not None:
+                amask[ai] = True
+            er = er & amask
+        for fname, fi in flavor_of.items():
+            # Intern first: it may widen ``self.elig``, so it must run
+            # before the subscript binds the slab.
+            col = self._intern_flavor(fname)
+            self.elig[row, col] = er[fi]
+
+        resume = info.last_assignment is not None and (
+            gen <= info.last_assignment.cluster_queue_generation
+        )
+        self.start_flavor[row] = (
+            info.last_assignment.next_flavor_to_try(
+                slots[0].ps_ids[0], slots[0].trigger_res
+            ) if resume else 0
+        )
+        return row
+
+    # -- cycle resolution --------------------------------------------------
+
+    def gather(self, heads: Sequence, snapshot,
+               resource_flavors) -> Optional[GatherView]:
+        """Resolve one cycle's heads against the store: reuse valid rows,
+        refill invalid ones, and return the columnar view — or ``None``
+        when any head is outside the dense class (the cycle then takes
+        the row-wise oracle). The loop here is the thin per-head residue
+        (a dict lookup and three comparisons); all field construction is
+        amortized into ``fill_row``."""
+        qgen = getattr(snapshot, "quota_generation", None)
+        if qgen is None:
+            return None
+        n = len(heads)
+        rows = np.empty(n, dtype=np.int64)
+        compat = np.empty(n, dtype=bool)
+        filled = 0
+        index = self._index
+        for i, info in enumerate(heads):
+            row = index.get(info.key)
+            if (row is None or self.info[row] is not info
+                    or self.qgen[row] != qgen or self.dirty[row]
+                    or self.la_id[row] != id(info.last_assignment)):
+                row = self.fill_row(info, snapshot, resource_flavors)
+                filled += 1
+            if not self.dense[row]:
+                return None
+            rows[i] = row
+            compat[i] = self.compat[row]
+        device_idx = np.flatnonzero(compat)
+        return GatherView(
+            rows=rows[device_idx],
+            device_idx=device_idx,
+            fallback_idx=np.flatnonzero(~compat),
+            filled=filled,
+        )
+
+    def warm(self, heads: Sequence, snapshot, resource_flavors) -> int:
+        """Bulk (re)fill — one vectorized-downstream pass used by the
+        failover restore and by speculation staging; returns the number
+        of rows filled."""
+        view = self.gather(heads, snapshot, resource_flavors)
+        if view is not None:
+            return view.filled
+        # Mixed backlog: fill what is fillable without demanding the
+        # dense class cycle-wide.
+        qgen = getattr(snapshot, "quota_generation", None)
+        if qgen is None:
+            return 0
+        filled = 0
+        for info in heads:
+            row = self._index.get(info.key)
+            if (row is None or self.info[row] is not info
+                    or self.qgen[row] != qgen or self.dirty[row]
+                    or self.la_id[row] != id(info.last_assignment)):
+                self.fill_row(info, snapshot, resource_flavors)
+                filled += 1
+        return filled
+
+    # -- columnar assembly -------------------------------------------------
+
+    def assemble(self, rows: np.ndarray, node_of: Dict[str, int],
+                 flavor_of: Dict[str, int], resource_of: Dict[str, int],
+                 out: Dict[str, np.ndarray]) -> None:
+        """Scatter the gathered rows into the cycle's W-arrays: per-axis
+        vocabulary translation tables (O(vocab)), then one gather or
+        scatter per column. ``out`` maps canonical field names to the
+        preallocated padded arrays; optional fields (``w_count`` /
+        ``w_min_count``) are filled when present."""
+        m = len(rows)
+        if m == 0:
+            return
+        node_of_vid = np.full(
+            len(self._cq_names), -1, dtype=np.int32
+        )
+        for name, vid in self._cq_vid.items():
+            ni = node_of.get(name)
+            if ni is not None:
+                node_of_vid[vid] = ni
+        out["w_cq"][:m] = node_of_vid[self.cq[rows]]
+        out["w_active"][:m] = True
+        out["w_priority"][:m] = self.priority[rows]
+        out["w_timestamp"][:m] = self.timestamp[rows]
+        out["w_quota_reserved"][:m] = self.quota_reserved[rows]
+        out["w_gates"][:m] = self.gates[rows]
+        out["w_start_flavor"][:m] = self.start_flavor[rows]
+        if "w_count" in out:
+            out["w_count"][:m] = self.count[rows]
+        if "w_min_count" in out:
+            out["w_min_count"][:m] = self.count[rows]
+
+        # Requests: store resource vocab -> cycle resource axis; the
+        # sentinel -1 vid lands on the extra -1 slot so unmapped and
+        # empty entries drop out together.
+        res_axis = np.full(len(self._res_names) + 1, -1, dtype=np.int64)
+        for name, vid in self._res_vid.items():
+            ri = resource_of.get(name)
+            if ri is not None:
+                res_axis[vid] = ri
+        cyc = res_axis[self.req_vid[rows]]
+        rr, cc = np.nonzero(cyc >= 0)
+        out["w_req"][rr, cyc[rr, cc]] = self.req_val[rows][rr, cc]
+
+        # Eligibility: cycle flavor axis -> store vocab column, with a
+        # sentinel all-False column for flavors the store never saw.
+        fv = len(self._flavor_names)
+        cols = np.full(out["w_elig"].shape[1], fv, dtype=np.int64)
+        for name, fi in flavor_of.items():
+            vid = self._flavor_vid.get(name)
+            if vid is not None:
+                cols[fi] = vid
+        eg = np.concatenate(
+            [self.elig[rows], np.zeros((m, 1), dtype=bool)], axis=1
+        )
+        out["w_elig"][:m] = eg[:, cols]
+
+    def rank_arrays(self, heads: Sequence):
+        """(priority, timestamp) per head for tile planning: column
+        reads for rows whose identity still matches, per-head attribute
+        access only for the misses (no fill — planning must not pay the
+        eligibility matcher)."""
+        n = len(heads)
+        prio = np.empty(n, dtype=np.int64)
+        ts = np.empty(n, dtype=np.float64)
+        index = self._index
+        for i, info in enumerate(heads):
+            row = index.get(info.key)
+            if row is not None and self.info[row] is info \
+                    and not self.dirty[row]:
+                prio[i] = self.priority[row]
+                ts[i] = self.timestamp[row]
+            else:
+                prio[i] = info.priority()
+                ts[i] = queue_order_timestamp(info.obj)
+        return prio, ts
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "rows": len(self._index),
+            "capacity": self._cap,
+            "generation": self.generation,
+            "filled_total": self.filled_total,
+            "flavors": len(self._flavor_names),
+            "resources": len(self._res_names),
+            "cluster_queues": len(self._cq_names),
+        }

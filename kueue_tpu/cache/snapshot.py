@@ -117,6 +117,10 @@ class Snapshot:
         self.inactive_cluster_queues: Set[str] = set()
         # flavor name -> TASFlavorSnapshot (reference tas_flavor_snapshot.go)
         self.tas_flavors: Dict[str, object] = {}
+        # Columnar workload plane (cache/columns.py) shared by reference
+        # from the owning Cache; None for synthetically built snapshots,
+        # which then take the row-wise encode path.
+        self.workload_columns: Optional[object] = None
 
     def cluster_queue(self, name: str) -> ClusterQueueSnapshot:
         return self.cluster_queues[name]

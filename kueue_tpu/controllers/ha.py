@@ -638,6 +638,9 @@ class WarmStandby(_Containment):
             # restore_state built a fresh Manager (cold scheduler); the
             # shared AOT store makes this a load, not a compile.
             self.manager.prewarm(**self._prewarm_kw)
+        # Rebuild the columnar workload plane in one pass so the first
+        # post-takeover cycle gathers instead of cold row-walking.
+        self.manager.warm_workload_columns()
 
     def _apply_step(self, doc: dict) -> None:
         from kueue_tpu.api.serialization import load_manifests

@@ -259,6 +259,28 @@ class Manager:
                 out["fleet"] = fleet.prewarm(max_heads=max_heads, aot=aot)
         return out
 
+    def warm_workload_columns(self) -> int:
+        """Bulk-fill the columnar workload plane (cache/columns.py) for
+        every pending workload against one fresh snapshot. Called after a
+        failover restore so the first post-takeover cycle encodes off warm
+        columns instead of paying the O(W) cold row walk; no-op when the
+        columnar plane is disabled or nothing is pending. Returns the
+        number of rows filled."""
+        from kueue_tpu.models.encode import columns_mode
+
+        if columns_mode() == "off":
+            return 0
+        snapshot = self.cache.snapshot()
+        store = snapshot.workload_columns
+        if store is None:
+            return 0
+        infos: list = []
+        for name in self.queues.cluster_queues:
+            infos.extend(self.queues.pending_workloads(name))
+        if not infos:
+            return 0
+        return store.warm(infos, snapshot, snapshot.resource_flavors)
+
     # ------------------------------------------------------------------
     # configuration objects
     # ------------------------------------------------------------------

@@ -123,6 +123,14 @@ TRACING_SERIES = frozenset({
     "solver_tiles_per_cycle",
     "solver_tile_width",
     "solver_tile_fallback_total",
+    # Columnar workload plane (cache/columns.py + models/encode.py):
+    # struct-of-arrays cold encode. Gauges describe the store the last
+    # columnar cycle gathered from; the counter counts cycles that fell
+    # back to the row-wise oracle because the backlog was ragged.
+    "solver_encode_columns_rows",
+    "solver_encode_columns_filled",
+    "solver_encode_columns_generation",
+    "solver_encode_columns_fallback_total",
 })
 
 # Observability layer series (obs/): flight recorder + SLO engine.

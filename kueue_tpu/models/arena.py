@@ -959,6 +959,93 @@ class CycleArena:
 
     def _build_w(self, snapshot, heads, resource_flavors, w_pad,
                  spec=None):
+        """Dispatch: columnar W build off the cache's struct-of-arrays
+        store when attached and the backlog is dense (cache/columns.py);
+        the row-wise oracle (``_build_w_rows``) otherwise. Both are
+        bit-identical by construction (and compared in verify mode);
+        speculation staging rides whichever path runs, warming store
+        rows during the device overlap."""
+        from kueue_tpu.models.encode import columns_mode
+
+        store = getattr(snapshot, "workload_columns", None)
+        view = None
+        if store is not None and columns_mode() != "off":
+            view = store.gather(heads, snapshot, resource_flavors)
+        if view is None:
+            return self._build_w_rows(
+                snapshot, heads, resource_flavors, w_pad, spec
+            )
+        device_wls = [heads[j] for j in view.device_idx]
+        fallbacks = [heads[j] for j in view.fallback_idx]
+        if w_pad == 0:
+            w = max(16, 1 << max(len(device_wls) - 1, 0).bit_length())
+        else:
+            w = w_pad
+        f, r = self._f, self._r
+        mw = {
+            "w_cq": np.zeros(w, dtype=np.int32),
+            "w_req": np.zeros((w, r), dtype=np.int64),
+            "w_elig": np.zeros((w, f), dtype=bool),
+            "w_active": np.zeros(w, dtype=bool),
+            "w_priority": np.zeros(w, dtype=np.int64),
+            "w_timestamp": np.zeros(w, dtype=np.float64),
+            "w_quota_reserved": np.zeros(w, dtype=bool),
+            "w_start_flavor": np.zeros(w, dtype=np.int32),
+            "w_has_gates": np.zeros(w, dtype=bool),
+        }
+        if spec is not None:
+            # Keep the speculation-consumption contract (fault point,
+            # abort taxonomy, consumed/reused_rows accounting) exactly as
+            # the row-wise path: the plan's values are not needed — a
+            # columnar recompute of a validated staged row is the same
+            # bits — but its bookkeeping is part of the pipeline's
+            # observable behavior.
+            self._spec_plan(spec, device_wls, snapshot, w)
+        store.assemble(
+            view.rows, self._node_of, self._flavor_of, self._resource_of,
+            {
+                "w_cq": mw["w_cq"], "w_active": mw["w_active"],
+                "w_priority": mw["w_priority"],
+                "w_timestamp": mw["w_timestamp"],
+                "w_quota_reserved": mw["w_quota_reserved"],
+                "w_gates": mw["w_has_gates"],
+                "w_start_flavor": mw["w_start_flavor"],
+                "w_req": mw["w_req"], "w_elig": mw["w_elig"],
+            },
+        )
+        mw["w_order_rank"] = _order_rank(
+            mw["w_priority"], mw["w_timestamp"]
+        )
+        if columns_mode() == "verify":
+            self._verify_build_w(
+                snapshot, heads, resource_flavors, w_pad,
+                device_wls, fallbacks, mw
+            )
+        return device_wls, fallbacks, mw
+
+    def _verify_build_w(self, snapshot, heads, resource_flavors, w_pad,
+                        device_wls, fallbacks, mw):
+        """Verify-mode oracle comparison for the columnar W build."""
+        ref_wls, ref_fallbacks, ref_mw = self._build_w_rows(
+            snapshot, heads, resource_flavors, w_pad, None
+        )
+        if [id(x) for x in ref_wls] != [id(x) for x in device_wls] \
+                or [id(x) for x in ref_fallbacks] \
+                != [id(x) for x in fallbacks]:
+            raise AssertionError(
+                "columns/oracle divergence: arena partition mismatch"
+            )
+        for col, v in ref_mw.items():
+            if not np.array_equal(mw[col], v):
+                raise AssertionError(
+                    f"columns/oracle divergence on arena {col}"
+                )
+
+    def _build_w_rows(self, snapshot, heads, resource_flavors, w_pad,
+                      spec=None):
+        """Row-wise W build — the oracle the columnar path is compared
+        against, and the fallback for ragged backlogs. Per-workload
+        Python by design (allowlisted in check_encode_columns)."""
         from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
 
         f, r = self._f, self._r

@@ -171,6 +171,15 @@ class DeviceScheduler:
         self._w_ladder = buckets.BucketLadder(
             patience=self._SHRINK_PATIENCE
         )
+        # Separate ladder for tiled cycles: tile widths are near-constant
+        # (the planner packs to ``tile_width``) but the last tile of a
+        # cycle is ragged — without hysteresis every cycle's tail tile
+        # oscillated executables across a shrink/grow churn window
+        # (tiles previously bypassed the ladder entirely and bucketed
+        # exactly).
+        self._tile_ladder = buckets.BucketLadder(
+            patience=self._SHRINK_PATIENCE
+        )
         # Fault containment: device-path exceptions and invalid readback
         # planes route the cycle through the host-exact path instead of
         # crashing the loop or applying a wrong admission; K consecutive
@@ -625,7 +634,10 @@ class DeviceScheduler:
                 faults_before = self.fault_fallback_cycles
                 self._schedule_heads(
                     tile_heads, start, result,
-                    bucket=buckets.bucket_for(len(tile_heads)),
+                    # Ladder-observed (shrink hysteresis), not an exact
+                    # bucket: ragged tail tiles must not oscillate
+                    # executables across a churn window.
+                    bucket=self._tile_ladder.observe(len(tile_heads)),
                     tile=(k + 1, len(tiles)),
                     # Tile 0 solves against the planning snapshot; later
                     # tiles re-snapshot to drain the prior tile's applies.

@@ -13,6 +13,7 @@ the encoder reports them in ``host_fallback``.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -27,10 +28,29 @@ from kueue_tpu.api.constants import (
 from kueue_tpu.cache.snapshot import Snapshot
 from kueue_tpu.core.resources import FlavorResource
 from kueue_tpu.core.workload_info import WorkloadInfo, has_quota_reservation
+from kueue_tpu.metrics import tracing
 from kueue_tpu.models import buckets
 from kueue_tpu.ops.quota_ops import QuotaTreeArrays
 from kueue_tpu.ops.tree_encode import GroupLayout, TreeIndex, encode_tree
 from kueue_tpu.core.workload_info import queue_order_timestamp
+
+# Columnar encode mode (cache/columns.py): "on" gathers the W plane
+# from the struct-of-arrays store with the row-wise path as fallback;
+# "off" forces the row-wise oracle everywhere; "verify" runs both and
+# compares field-for-field every columnar cycle. Env override for
+# probes/tests; set_columns_mode for in-process switching.
+_COLUMNS_MODE = os.environ.get("KUEUE_TPU_ENCODE_COLUMNS", "on")
+
+
+def columns_mode() -> str:
+    return _COLUMNS_MODE
+
+
+def set_columns_mode(mode: str) -> None:
+    global _COLUMNS_MODE
+    if mode not in ("on", "off", "verify"):
+        raise ValueError(f"unknown encode columns mode: {mode!r}")
+    _COLUMNS_MODE = mode
 
 
 class CycleArrays(NamedTuple):
@@ -422,90 +442,48 @@ def encode_cycle(
             for name, roots in roots_of_flavor.items()
         }
 
-    # Workload arrays.
-    device_wls: List[WorkloadInfo] = []
-    wl_slots: List[List[AssignSlot]] = []
-    for info in heads:
-        slots = (
-            _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
-            if info.cluster_queue in snapshot.cluster_queues else None
-        )
-        fair_host = False
-        if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
-            if any(
-                ps2.topology_request is not None
-                for ps2 in info.obj.pod_sets
-            ):
-                # The tournament's placement threading is only race-free
-                # when every TAS flavor the entry might land on is
-                # reachable from a single cohort root (fair_tas_single).
-                # The check spans exactly the resource groups the entry's
-                # slots assign from (an off-RG0 single podset places on
-                # ITS group's flavors, not RG0's); uncovered entries
-                # (slots=None) never reach the device path, but check all
-                # groups anyway so fair_host never under-approximates.
-                rgs0 = snapshot.cluster_queues[
-                    info.cluster_queue
-                ].spec.resource_groups
-                if slots is not None:
-                    rg_ids = sorted({sl.rg_idx for sl in slots})
-                    rgs_chk = [rgs0[i] for i in rg_ids if i < len(rgs0)]
-                else:
-                    rgs_chk = rgs0
-                tas_names = [
-                    fq.name
-                    for rg0 in rgs_chk
-                    for fq in rg0.flavors
-                    if fq.name in snapshot.tas_flavors
-                ]
-                fair_host = not tas_names or not all(
-                    fair_tas_single.get(nm, False) for nm in tas_names
+    # Workload arrays: columnar fast path first (cache/columns.py) —
+    # classification and every W column resolved from the struct-of-
+    # arrays store when the cycle carries no fair/TAS context and the
+    # backlog is in the dense class; any ragged head (slot layout,
+    # topology, partial reduction, over-wide request dict) drops the
+    # whole cycle to the row-wise oracle (_classify_heads/_fill_w_rows),
+    # which stays the reference path and the verify-mode differential.
+    store = getattr(snapshot, "workload_columns", None)
+    col_view = None
+    if (_COLUMNS_MODE != "off" and store is not None and heads
+            and not fair_sharing and not snapshot.tas_flavors):
+        col_view = store.gather(heads, snapshot, resource_flavors)
+        if tracing.ENABLED:
+            if col_view is None:
+                tracing.inc("solver_encode_columns_fallback_total",
+                            {"reason": "ragged"})
+            else:
+                tracing.set_gauge(
+                    "solver_encode_columns_rows",
+                    float(len(col_view.rows)),
                 )
-        delayed = bool(
-            delay_tas_fn is not None
-            and info.cluster_queue in snapshot.cluster_queues
-            and any(
-                ps.topology_request is not None
-                for ps in info.obj.pod_sets
-            )
-            and delay_tas_fn(
-                snapshot.cluster_queues[info.cluster_queue], info
-            )
+                tracing.set_gauge(
+                    "solver_encode_columns_filled",
+                    float(col_view.filled),
+                )
+                tracing.set_gauge(
+                    "solver_encode_columns_generation",
+                    float(store.generation),
+                )
+    if col_view is not None:
+        device_wls = [heads[j] for j in col_view.device_idx]
+        wl_slots = None
+        idx.workloads = device_wls
+        idx.host_fallback = [heads[j] for j in col_view.fallback_idx]
+        idx.delayed_tas = [False] * len(device_wls)
+        need_slots = False
+        s_n = 1
+    else:
+        device_wls, wl_slots, need_slots, s_n = _classify_heads(
+            snapshot, heads, idx, fair_sharing, preempt, delay_tas_fn,
+            tas_device_flavors, fair_tas_single, root_of_cq,
         )
-        if not fair_host and _device_compatible(
-                info, snapshot, slots,
-                set(tas_device_flavors), delayed,
-                preempt, fair_sharing):
-            device_wls.append(info)
-            wl_slots.append(slots)
-            idx.delayed_tas.append(delayed)
-        else:
-            idx.host_fallback.append(info)
-
-    if fair_sharing:
-        # Steps the tournament scan actually needs (see CycleIndex):
-        # max over cohort roots of the number of device CQs with >=1
-        # entry under that root.
-        cqs_of_root: Dict[int, set] = {}
-        for info in device_wls:
-            # root_of_cq covers every snapshot CQ, and _device_compatible
-            # guarantees device entries' CQs are in the snapshot.
-            cqs_of_root.setdefault(
-                root_of_cq[info.cluster_queue], set()
-            ).add(info.cluster_queue)
-        bound = max((len(s) for s in cqs_of_root.values()), default=1)
-        idx.fair_s_bound = buckets.pow2_bucket(bound, floor=4)
-
-    # Layout: the dense legacy (single-slot, first-RG) layout compiles the
-    # existing kernels unchanged; any multi-podset or off-RG0 entry
-    # switches the cycle to the slot layout (padded S axis, slot fields).
-    need_slots = any(
-        len(sl) > 1 or sl[0].rg_idx != 0 for sl in wl_slots
-    )
-    s_n = 1
-    if need_slots:
-        # Power-of-two compile bucket for the slot axis.
-        s_n = buckets.pow2_bucket(max(len(sl) for sl in wl_slots))
 
     # Unified compile bucket (models/buckets.py, min 16): the W axis
     # shrinks cycle over cycle as entries admit, and an exact-size pad
@@ -532,7 +510,6 @@ def encode_cycle(
     w_minc = np.ones(w, dtype=np.int64)
     w_part = np.zeros(w, dtype=bool)
 
-    from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
     from kueue_tpu.utils import features as _feat
 
     partial_on = _feat.enabled("PartialAdmission") and not fair_sharing
@@ -547,119 +524,43 @@ def encode_cycle(
         s_valid = np.zeros((w, s_n), dtype=bool)
         w_simple = np.zeros(w, dtype=bool)
 
-    m = len(device_wls)
-    if m:
-        # Batched column fills: the cold/full-encode row builder is pure
-        # host work the arena cannot amortize, and per-row scalar ndarray
-        # stores dominated it. One vectorized assignment per column
-        # replaces m scalar stores each (before/after numbers in
-        # docs/perf.md, "encode" note); the loop below keeps only the
-        # sparse/ragged work (request dicts, partial rows, eligibility
-        # cache, slot layouts).
-        w_cq[:m] = [tidx.node_of[info.cluster_queue] for info in device_wls]
-        w_active[:m] = True
-        w_priority[:m] = [info.priority() for info in device_wls]
-        w_timestamp[:m] = [
-            queue_order_timestamp(info.obj) for info in device_wls
-        ]
-        w_qr[:m] = [has_quota_reservation(info.obj) for info in device_wls]
-        w_gates[:m] = [
-            bool(info.obj.preemption_gates) for info in device_wls
-        ]
-        w_cnt[:m] = [info.obj.pod_sets[0].count for info in device_wls]
-        w_minc[:m] = w_cnt[:m]
-
-    for i, info in enumerate(device_wls):
-        idx.workloads.append(info)
-        slots = wl_slots[i]
-        cqs = snapshot.cluster_queues[info.cluster_queue]
-        # Legacy request vector = slot 0 (equals total_requests[0] for
-        # single-slot first-RG workloads; the per-entry preemption and
-        # partial-admission kernels only apply to those — w_simple_slot).
-        for res, v in slots[0].requests.items():
-            if res in tidx.resource_of:
-                w_req[i, tidx.resource_of[res]] = v
-        ps0 = info.obj.pod_sets[0]
-        if (partial_on and ps0.min_count is not None
-                and ps0.min_count < ps0.count):
-            # Reducible entry (vetted by _device_compatible: single
-            # podset, non-TAS, exact per-pod totals; preempting CQs
-            # allowed in preempt cycles — the search probes the
-            # victim-search kernel).
-            w_part[i] = True
-            w_minc[i] = ps0.min_count
-            for res, v in ps0.requests.items():
-                if res in tidx.resource_of:
-                    w_pp[i, tidx.resource_of[res]] = v
-        # Taints/affinity eligibility per flavor and slot (host-side;
-        # reuses the exact assigner's check). The verdict depends only on
-        # flavor specs and the slot's podsets, so it is cached on the
-        # WorkloadInfo keyed by the cache spec generation — a requeued
-        # workload re-encodes in O(S*F) array copy instead of re-running
-        # the matcher every cycle.
-        gen = cqs.allocatable_generation
-        cached = getattr(info, "_elig_cache", None)
-        if cached is not None and cached[0] == gen \
-                and cached[1].shape == (len(slots), f):
-            erows = cached[1]
-        else:
-            assigner = FlavorAssigner(info, cqs, resource_flavors)
-            erows = np.zeros((len(slots), f), dtype=bool)
-            for si, sl in enumerate(slots):
-                pod_sets = [info.obj.pod_sets[j] for j in sl.ps_ids]
-                for fname, fi in tidx.flavor_of.items():
-                    ok, _ = assigner._check_flavor_for_podsets(
-                        fname, pod_sets
-                    )
-                    erows[si, fi] = ok
-            info._elig_cache = (gen, erows)
-        allowed = info.obj.labels.get(
-            "kueue.x-k8s.io/allowed-resource-flavor"
+    cols = dict(
+        w_cq=w_cq, w_req=w_req, w_elig=w_elig, w_active=w_active,
+        w_priority=w_priority, w_timestamp=w_timestamp, w_qr=w_qr,
+        w_start=w_start, w_gates=w_gates, w_pp=w_pp, w_cnt=w_cnt,
+        w_minc=w_minc, w_part=w_part,
+    )
+    if col_view is not None:
+        # Columnar W plane: vocabulary translation tables plus one
+        # gather/scatter per column (cache/columns.py assemble) — the
+        # per-row Python walk the store amortizes away.
+        store.assemble(
+            col_view.rows, tidx.node_of, tidx.flavor_of, tidx.resource_of,
+            {
+                "w_cq": w_cq, "w_active": w_active,
+                "w_priority": w_priority, "w_timestamp": w_timestamp,
+                "w_quota_reserved": w_qr, "w_gates": w_gates,
+                "w_start_flavor": w_start, "w_req": w_req,
+                "w_elig": w_elig, "w_count": w_cnt, "w_min_count": w_minc,
+            },
         )
-        if allowed is not None:
-            # ConcurrentAdmission variants race one flavor each: the host
-            # scan skips every other flavor (flavorassigner.go:981
-            # semantics); masking eligibility is the identical device
-            # behavior (skipped and NoFit flavors both advance the scan).
-            amask = np.zeros(f, dtype=bool)
-            ai = tidx.flavor_of.get(allowed)
-            if ai is not None:
-                amask[ai] = True
-            erows = erows & amask[None, :]
-        w_elig[i] = erows[0]
-        resume = info.last_assignment is not None and (
-            cqs.allocatable_generation
-            <= info.last_assignment.cluster_queue_generation
-        )
-        if resume:
-            # Per-slot resume key: the resource that opens the slot's RG
-            # search (first in sorted group-request order), exactly the
-            # host's res_name at flavorassigner.go:425.
-            w_start[i] = info.last_assignment.next_flavor_to_try(
-                slots[0].ps_ids[0], slots[0].trigger_res
+        if _COLUMNS_MODE == "verify":
+            _verify_columns(
+                snapshot, heads, tidx, resource_flavors, partial_on,
+                fair_sharing, preempt, delay_tas_fn, tas_device_flavors,
+                fair_tas_single, root_of_cq, device_wls,
+                idx.host_fallback, cols,
             )
-        if need_slots:
-            w_simple[i] = len(slots) == 1 and slots[0].rg_idx == 0
-            for si, sl in enumerate(slots):
-                s_valid[i, si] = True
-                rg_s = cqs.spec.resource_groups[sl.rg_idx]
-                flist = [
-                    fq.name for fq in rg_s.flavors
-                    if fq.name in tidx.flavor_of
-                ]
-                s_nf[i, si] = len(flist)
-                for k2, fname in enumerate(flist):
-                    s_flavor_at[i, si, k2] = tidx.flavor_of[fname]
-                for res, v in sl.requests.items():
-                    if res in tidx.resource_of:
-                        s_req[i, si, tidx.resource_of[res]] = v
-                s_elig[i, si] = erows[si]
-                if resume:
-                    s_start_arr[i, si] = (
-                        info.last_assignment.next_flavor_to_try(
-                            sl.ps_ids[0], sl.trigger_res
-                        )
-                    )
+    else:
+        _fill_w_rows(
+            device_wls, wl_slots, snapshot, tidx, resource_flavors,
+            partial_on, need_slots, idx, cols,
+            dict(
+                s_req=s_req, s_elig=s_elig, s_flavor_at=s_flavor_at,
+                s_nf=s_nf, s_start_arr=s_start_arr, s_valid=s_valid,
+                w_simple=w_simple,
+            ) if need_slots else None,
+        )
 
     partial_fields: Dict[str, object] = {}
     if w_part.any():
@@ -802,6 +703,284 @@ def encode_cycle(
                  preempt_hier, fair_node_ok, preempt_tas_ok),
             )
     return arrays, idx
+
+
+def _classify_heads(
+    snapshot, heads, idx, fair_sharing, preempt, delay_tas_fn,
+    tas_device_flavors, fair_tas_single, root_of_cq,
+):
+    """Row-wise head classification — the oracle the columnar gather is
+    verified against, and the only classifier for fair/TAS/ragged
+    cycles. Per-workload Python by design (the allowlisted fallback in
+    tools/check_encode_columns.py). Returns ``(device_wls, wl_slots,
+    need_slots, s_n)``; mutates ``idx`` (fallbacks, delayed flags, fair
+    scan bound) exactly as the pre-columnar encoder did."""
+    device_wls: List[WorkloadInfo] = []
+    wl_slots: List[List[AssignSlot]] = []
+    for info in heads:
+        slots = (
+            _workload_slots(info, snapshot.cluster_queues[info.cluster_queue])
+            if info.cluster_queue in snapshot.cluster_queues else None
+        )
+        fair_host = False
+        if fair_sharing and info.cluster_queue in snapshot.cluster_queues:
+            if any(
+                ps2.topology_request is not None
+                for ps2 in info.obj.pod_sets
+            ):
+                # The tournament's placement threading is only race-free
+                # when every TAS flavor the entry might land on is
+                # reachable from a single cohort root (fair_tas_single).
+                # The check spans exactly the resource groups the entry's
+                # slots assign from (an off-RG0 single podset places on
+                # ITS group's flavors, not RG0's); uncovered entries
+                # (slots=None) never reach the device path, but check all
+                # groups anyway so fair_host never under-approximates.
+                rgs0 = snapshot.cluster_queues[
+                    info.cluster_queue
+                ].spec.resource_groups
+                if slots is not None:
+                    rg_ids = sorted({sl.rg_idx for sl in slots})
+                    rgs_chk = [rgs0[i] for i in rg_ids if i < len(rgs0)]
+                else:
+                    rgs_chk = rgs0
+                tas_names = [
+                    fq.name
+                    for rg0 in rgs_chk
+                    for fq in rg0.flavors
+                    if fq.name in snapshot.tas_flavors
+                ]
+                fair_host = not tas_names or not all(
+                    fair_tas_single.get(nm, False) for nm in tas_names
+                )
+        delayed = bool(
+            delay_tas_fn is not None
+            and info.cluster_queue in snapshot.cluster_queues
+            and any(
+                ps.topology_request is not None
+                for ps in info.obj.pod_sets
+            )
+            and delay_tas_fn(
+                snapshot.cluster_queues[info.cluster_queue], info
+            )
+        )
+        if not fair_host and _device_compatible(
+                info, snapshot, slots,
+                set(tas_device_flavors), delayed,
+                preempt, fair_sharing):
+            device_wls.append(info)
+            wl_slots.append(slots)
+            idx.delayed_tas.append(delayed)
+        else:
+            idx.host_fallback.append(info)
+
+    if fair_sharing:
+        # Steps the tournament scan actually needs (see CycleIndex):
+        # max over cohort roots of the number of device CQs with >=1
+        # entry under that root.
+        cqs_of_root: Dict[int, set] = {}
+        for info in device_wls:
+            # root_of_cq covers every snapshot CQ, and _device_compatible
+            # guarantees device entries' CQs are in the snapshot.
+            cqs_of_root.setdefault(
+                root_of_cq[info.cluster_queue], set()
+            ).add(info.cluster_queue)
+        bound = max((len(s) for s in cqs_of_root.values()), default=1)
+        idx.fair_s_bound = buckets.pow2_bucket(bound, floor=4)
+
+    # Layout: the dense legacy (single-slot, first-RG) layout compiles the
+    # existing kernels unchanged; any multi-podset or off-RG0 entry
+    # switches the cycle to the slot layout (padded S axis, slot fields).
+    need_slots = any(
+        len(sl) > 1 or sl[0].rg_idx != 0 for sl in wl_slots
+    )
+    s_n = 1
+    if need_slots:
+        # Power-of-two compile bucket for the slot axis.
+        s_n = buckets.pow2_bucket(max(len(sl) for sl in wl_slots))
+    return device_wls, wl_slots, need_slots, s_n
+
+
+def _fill_w_rows(
+    device_wls, wl_slots, snapshot, tidx, resource_flavors, partial_on,
+    need_slots, idx, cols, slot_cols,
+):
+    """Row-wise W fill — the oracle the columnar plane is bit-compared
+    against (verify mode and the randomized differentials), and the only
+    fill for ragged cycles (slot layouts, partial admission, fair/TAS
+    context). Per-workload Python by design; appends each device row to
+    ``idx.workloads`` exactly as the pre-columnar encoder did."""
+    from kueue_tpu.scheduler.flavorassigner import FlavorAssigner
+
+    w_cq = cols["w_cq"]
+    w_req = cols["w_req"]
+    w_elig = cols["w_elig"]
+    w_active = cols["w_active"]
+    w_priority = cols["w_priority"]
+    w_timestamp = cols["w_timestamp"]
+    w_qr = cols["w_qr"]
+    w_start = cols["w_start"]
+    w_gates = cols["w_gates"]
+    w_pp = cols["w_pp"]
+    w_cnt = cols["w_cnt"]
+    w_minc = cols["w_minc"]
+    w_part = cols["w_part"]
+    f = w_elig.shape[1]
+    if need_slots:
+        s_req = slot_cols["s_req"]
+        s_elig = slot_cols["s_elig"]
+        s_flavor_at = slot_cols["s_flavor_at"]
+        s_nf = slot_cols["s_nf"]
+        s_start_arr = slot_cols["s_start_arr"]
+        s_valid = slot_cols["s_valid"]
+        w_simple = slot_cols["w_simple"]
+
+    for i, info in enumerate(device_wls):
+        idx.workloads.append(info)
+        slots = wl_slots[i]
+        cqs = snapshot.cluster_queues[info.cluster_queue]
+        w_cq[i] = tidx.node_of[info.cluster_queue]
+        w_active[i] = True
+        w_priority[i] = info.priority()
+        w_timestamp[i] = queue_order_timestamp(info.obj)
+        w_qr[i] = has_quota_reservation(info.obj)
+        w_gates[i] = bool(info.obj.preemption_gates)
+        ps0 = info.obj.pod_sets[0]
+        w_cnt[i] = ps0.count
+        w_minc[i] = ps0.count
+        # Legacy request vector = slot 0 (equals total_requests[0] for
+        # single-slot first-RG workloads; the per-entry preemption and
+        # partial-admission kernels only apply to those — w_simple_slot).
+        for res, v in slots[0].requests.items():
+            if res in tidx.resource_of:
+                w_req[i, tidx.resource_of[res]] = v
+        if (partial_on and ps0.min_count is not None
+                and ps0.min_count < ps0.count):
+            # Reducible entry (vetted by _device_compatible: single
+            # podset, non-TAS, exact per-pod totals; preempting CQs
+            # allowed in preempt cycles — the search probes the
+            # victim-search kernel).
+            w_part[i] = True
+            w_minc[i] = ps0.min_count
+            for res, v in ps0.requests.items():
+                if res in tidx.resource_of:
+                    w_pp[i, tidx.resource_of[res]] = v
+        # Taints/affinity eligibility per flavor and slot (host-side;
+        # reuses the exact assigner's check). The verdict depends only on
+        # flavor specs and the slot's podsets, so it is cached on the
+        # WorkloadInfo keyed by the cache spec generation — a requeued
+        # workload re-encodes in O(S*F) array copy instead of re-running
+        # the matcher every cycle.
+        gen = cqs.allocatable_generation
+        cached = getattr(info, "_elig_cache", None)
+        if cached is not None and cached[0] == gen \
+                and cached[1].shape == (len(slots), f):
+            erows = cached[1]
+        else:
+            assigner = FlavorAssigner(info, cqs, resource_flavors)
+            erows = np.zeros((len(slots), f), dtype=bool)
+            for si, sl in enumerate(slots):
+                pod_sets = [info.obj.pod_sets[j] for j in sl.ps_ids]
+                for fname, fi in tidx.flavor_of.items():
+                    ok, _ = assigner._check_flavor_for_podsets(
+                        fname, pod_sets
+                    )
+                    erows[si, fi] = ok
+            info._elig_cache = (gen, erows)
+        allowed = info.obj.labels.get(
+            "kueue.x-k8s.io/allowed-resource-flavor"
+        )
+        if allowed is not None:
+            # ConcurrentAdmission variants race one flavor each: the host
+            # scan skips every other flavor (flavorassigner.go:981
+            # semantics); masking eligibility is the identical device
+            # behavior (skipped and NoFit flavors both advance the scan).
+            amask = np.zeros(f, dtype=bool)
+            ai = tidx.flavor_of.get(allowed)
+            if ai is not None:
+                amask[ai] = True
+            erows = erows & amask[None, :]
+        w_elig[i] = erows[0]
+        resume = info.last_assignment is not None and (
+            cqs.allocatable_generation
+            <= info.last_assignment.cluster_queue_generation
+        )
+        if resume:
+            # Per-slot resume key: the resource that opens the slot's RG
+            # search (first in sorted group-request order), exactly the
+            # host's res_name at flavorassigner.go:425.
+            w_start[i] = info.last_assignment.next_flavor_to_try(
+                slots[0].ps_ids[0], slots[0].trigger_res
+            )
+        if need_slots:
+            w_simple[i] = len(slots) == 1 and slots[0].rg_idx == 0
+            for si, sl in enumerate(slots):
+                s_valid[i, si] = True
+                rg_s = cqs.spec.resource_groups[sl.rg_idx]
+                flist = [
+                    fq.name for fq in rg_s.flavors
+                    if fq.name in tidx.flavor_of
+                ]
+                s_nf[i, si] = len(flist)
+                for k2, fname in enumerate(flist):
+                    s_flavor_at[i, si, k2] = tidx.flavor_of[fname]
+                for res, v in sl.requests.items():
+                    if res in tidx.resource_of:
+                        s_req[i, si, tidx.resource_of[res]] = v
+                s_elig[i, si] = erows[si]
+                if resume:
+                    s_start_arr[i, si] = (
+                        info.last_assignment.next_flavor_to_try(
+                            sl.ps_ids[0], sl.trigger_res
+                        )
+                    )
+
+
+def _verify_columns(
+    snapshot, heads, tidx, resource_flavors, partial_on, fair_sharing,
+    preempt, delay_tas_fn, tas_device_flavors, fair_tas_single,
+    root_of_cq, device_wls, host_fallback, cols,
+):
+    """Verify-mode differential: re-run the row-wise oracle on the same
+    cycle and require the columnar partition and every W column to be
+    bit-identical. Raises AssertionError on any divergence."""
+    ref_idx = CycleIndex(
+        tree_index=tidx,
+        resources=list(tidx.resources),
+        flavors=list(tidx.flavors),
+    )
+    ref_wls, ref_slots, ref_need_slots, _ = _classify_heads(
+        snapshot, heads, ref_idx, fair_sharing, preempt, delay_tas_fn,
+        tas_device_flavors, fair_tas_single, root_of_cq,
+    )
+    if ref_need_slots:
+        raise AssertionError(
+            "columns/oracle divergence: oracle classified a slot-layout "
+            "cycle the gather accepted as dense"
+        )
+    if [id(x) for x in ref_wls] != [id(x) for x in device_wls]:
+        raise AssertionError(
+            "columns/oracle divergence: device partition mismatch"
+        )
+    if [id(x) for x in ref_idx.host_fallback] \
+            != [id(x) for x in host_fallback]:
+        raise AssertionError(
+            "columns/oracle divergence: host-fallback partition mismatch"
+        )
+    ref_cols = {
+        k: (np.ones_like(v) if k in ("w_cnt", "w_minc")
+            else np.zeros_like(v))
+        for k, v in cols.items()
+    }
+    _fill_w_rows(
+        ref_wls, ref_slots, snapshot, tidx, resource_flavors, partial_on,
+        False, ref_idx, ref_cols, None,
+    )
+    for k in cols:
+        if not np.array_equal(cols[k], ref_cols[k]):
+            raise AssertionError(
+                f"columns/oracle divergence on {k}"
+            )
 
 
 def _order_rank(priority: np.ndarray, timestamp: np.ndarray) -> np.ndarray:
@@ -1758,6 +1937,46 @@ def plan_tiles(
     over the bound; the peak plane becomes ``max(tile_width bucket,
     widest-group bucket)``, which docs/perf.md calls out.
     """
+    if not heads:
+        return []
+    groups, roots, prio, ts, wkeys = _tile_head_views(heads, snapshot)
+
+    # Group order = the order the monolithic cycle would first consider
+    # any member: rank heads once, vectorized ((-priority, timestamp,
+    # key) via one lexsort over column views), then take each group at
+    # its best member's position. Members keep submission order within
+    # the group (the dict preserved head order).
+    order = np.lexsort((wkeys, ts, -prio))
+    seen = set()
+    ordered: List[List[WorkloadInfo]] = []
+    for j in order:
+        root = roots[j]
+        if root not in seen:
+            seen.add(root)
+            ordered.append(groups[root])
+
+    tiles: List[List[WorkloadInfo]] = []
+    cur: List[WorkloadInfo] = []
+    for group in ordered:
+        if cur and len(cur) + len(group) > tile_width:
+            tiles.append(cur)
+            cur = []
+        cur.extend(group)
+        if len(cur) >= tile_width:
+            tiles.append(cur)
+            cur = []
+    if cur:
+        tiles.append(cur)
+    return tiles
+
+
+def _tile_head_views(heads: Sequence[WorkloadInfo], snapshot: Snapshot):
+    """Per-head tile-planning views: fused-group membership and rank
+    columns. The per-head residue is one dict lookup each — cohort-root
+    and TAS fusion are resolved once per distinct CQ (O(#CQs) union-find,
+    not O(heads) tree walks), and rank columns come from the workload
+    column store when attached (``rank_arrays``), falling back to
+    per-head attribute reads (the allowlisted row-wise path)."""
     parent: Dict[object, object] = {}
 
     def find(x):
@@ -1773,43 +1992,51 @@ def plan_tiles(
         if ra != rb:
             parent[rb] = ra
 
+    cq_key: Dict[str, object] = {}
+
+    def key_of_cq(cq_name: str):
+        key = cq_key.get(cq_name)
+        if key is None:
+            cqs = snapshot.cluster_queues[cq_name]
+            key = ("root", id(cqs.node.root()))
+            parent.setdefault(key, key)
+            if snapshot.tas_flavors:
+                for rg in cqs.spec.resource_groups:
+                    for fq in rg.flavors:
+                        if fq.name in snapshot.tas_flavors:
+                            fkey = ("tas", fq.name)
+                            parent.setdefault(fkey, fkey)
+                            union(key, fkey)
+            cq_key[cq_name] = key
+        return key
+
     keys: List[object] = []
     for i, info in enumerate(heads):
-        cqs = snapshot.cluster_queues.get(info.cluster_queue)
-        if cqs is None:
+        if info.cluster_queue in snapshot.cluster_queues:
+            keys.append(key_of_cq(info.cluster_queue))
+        else:
             key = ("solo", i)
             parent.setdefault(key, key)
             keys.append(key)
-            continue
-        key = ("root", id(cqs.node.root()))
-        parent.setdefault(key, key)
-        keys.append(key)
-        if snapshot.tas_flavors:
-            for rg in cqs.spec.resource_groups:
-                for fq in rg.flavors:
-                    if fq.name in snapshot.tas_flavors:
-                        fkey = ("tas", fq.name)
-                        parent.setdefault(fkey, fkey)
-                        union(key, fkey)
 
     groups: Dict[object, List[WorkloadInfo]] = {}
+    roots: List[object] = []
     for info, key in zip(heads, keys):
-        groups.setdefault(find(key), []).append(info)
+        root = find(key)
+        roots.append(root)
+        groups.setdefault(root, []).append(info)
 
-    def rank(info: WorkloadInfo):
-        return (-info.priority(), queue_order_timestamp(info.obj), info.key)
-
-    ordered = sorted(groups.values(), key=lambda g: min(rank(h) for h in g))
-    tiles: List[List[WorkloadInfo]] = []
-    cur: List[WorkloadInfo] = []
-    for group in ordered:
-        if cur and len(cur) + len(group) > tile_width:
-            tiles.append(cur)
-            cur = []
-        cur.extend(group)
-        if len(cur) >= tile_width:
-            tiles.append(cur)
-            cur = []
-    if cur:
-        tiles.append(cur)
-    return tiles
+    store = getattr(snapshot, "workload_columns", None)
+    if store is not None and _COLUMNS_MODE != "off":
+        prio, ts = store.rank_arrays(heads)
+    else:
+        n = len(heads)
+        prio = np.fromiter(
+            (h.priority() for h in heads), dtype=np.int64, count=n
+        )
+        ts = np.fromiter(
+            (queue_order_timestamp(h.obj) for h in heads),
+            dtype=np.float64, count=n,
+        )
+    wkeys = np.array([h.key for h in heads])
+    return groups, roots, prio, ts, wkeys

@@ -111,6 +111,16 @@ HEADLINE: Dict[str, Dict[str, str]] = {
         "readplane_cycle_p99_delta_ms": "lower",
         "readplane_peak_plane_mb": "lower",
     },
+    # Columnar workload plane (docs/perf.md "Columnar workload plane"):
+    # warm-columns full encode vs the row-wise oracle at W=50k, the
+    # absolute columnar encode wall, and the per-tile gather slice cost.
+    # The probe hard-gates (``ok``) on the 3-seed columns-vs-oracle
+    # bit-identity differential before timing anything.
+    "encode": {
+        "encode_cold_speedup": "higher",
+        "encode_50k_ms": "lower",
+        "encode_tile_slice_ms": "lower",
+    },
 }
 
 _REQUIRED_KEYS = (

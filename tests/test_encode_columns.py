@@ -1,0 +1,369 @@
+"""Columnar workload plane (cache/columns.py + models/encode.py).
+
+The struct-of-arrays store turns the cold full encode into column
+slicing + gathers; the old per-row builder survives as the verify-mode
+oracle. These tests pin the tentpole claims host-side (``device_put=
+False`` — no kernels, no compiles):
+
+- randomized columns-vs-oracle bit-identity, including churn (quota
+  generation bumps, cache workload events, deletions) and verify mode;
+- store invalidation hooks: a cache workload event dirties the row, a
+  delete frees it, a quota-gen bump refills on the next gather;
+- ragged backlogs (partial rows) reject the columnar gather and the
+  fallback stays bit-identical;
+- ``plan_tiles`` union-find edge cases: an oversized fused TAS group
+  rides alone, missing-CQ heads are singletons, fused groups never
+  straddle a greedy pack boundary (property-style, seeded);
+- tiled cycles resolve per-tile buckets through the tile ladder's
+  shrink hysteresis — an oscillating ragged tail never flips buckets
+  cycle-to-cycle (the PR 20 bugfix: exact ``bucket_for`` per tile used
+  to bypass the ladder entirely).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import ResourceQuota
+from kueue_tpu.core.workload_info import WorkloadInfo
+from kueue_tpu.models.arena import assert_cycle_equal
+from kueue_tpu.models.driver import DeviceScheduler
+from kueue_tpu.models.encode import (
+    columns_mode,
+    encode_cycle,
+    plan_tiles,
+    set_columns_mode,
+)
+from kueue_tpu.scheduler.scheduler import CycleResult
+
+from .helpers import build_env, make_cq, make_wl, submit
+
+
+@pytest.fixture(autouse=True)
+def _restore_columns_mode():
+    prev = columns_mode()
+    yield
+    set_columns_mode(prev)
+
+
+def _pending(queues, cq_names):
+    out = []
+    for name in cq_names:
+        out.extend(queues.pending_workloads(name))
+    return out
+
+
+def _encode_both(snap, heads):
+    set_columns_mode("off")
+    ref = encode_cycle(snap, heads, snap.resource_flavors,
+                       preempt=True, device_put=False)
+    set_columns_mode("on")
+    got = encode_cycle(snap, heads, snap.resource_flavors,
+                       preempt=True, device_put=False)
+    assert_cycle_equal(got[0], got[1], ref[0], ref[1])
+    return got
+
+
+# ---------------------------------------------------------------------------
+# columns-vs-oracle differential
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_columns_match_oracle_under_churn(seed):
+    rng = random.Random(77_000 + seed)
+    cq_names = []
+    cqs = []
+    for c in range(rng.randint(2, 4)):
+        for q in range(rng.randint(1, 3)):
+            name = f"cq{c}q{q}"
+            cq_names.append(name)
+            cqs.append(make_cq(
+                name, cohort=f"co{c}",
+                flavors={"default": {"cpu": ResourceQuota(
+                    nominal=rng.choice([3000, 6000]))}},
+            ))
+    cache, queues, _ = build_env(cqs)
+    t = 0.0
+    for name in cq_names:
+        for i in range(rng.randint(2, 5)):
+            t += 1.0
+            submit(queues, make_wl(
+                f"{name}-w{i}", queue=f"lq-{name}",
+                cpu_m=rng.choice([500, 1000, 2000]),
+                priority=rng.choice([0, 50, 100]),
+                creation_time=t,
+            ))
+    heads = _pending(queues, cq_names)
+    assert heads
+
+    snap = cache.snapshot()
+    _encode_both(snap, heads)
+
+    # Warm repeat: pure gather, zero refills, still identical.
+    store = cache.workload_columns
+    before = store.filled_total
+    _encode_both(snap, heads)
+    assert store.filled_total == before
+
+    # Quota churn invalidates by generation: rows refill, still equal.
+    cache.add_or_update_cluster_queue(cqs[0])
+    snap = cache.snapshot()
+    _encode_both(snap, heads)
+    assert store.filled_total > before
+
+    # Workload churn through the cache event hook + deletion.
+    victim = heads[rng.randrange(len(heads))]
+    cache.add_or_update_workload(victim)
+    cache.delete_workload(victim.key)
+    heads = [h for h in heads if h.key != victim.key]
+    snap = cache.snapshot()
+    _encode_both(snap, heads)
+
+    # Verify mode runs both paths per cycle and asserts internally.
+    set_columns_mode("verify")
+    encode_cycle(snap, heads, snap.resource_flavors,
+                 preempt=True, device_put=False)
+
+
+def test_columns_invalidation_hooks():
+    cache, queues, _ = build_env([make_cq("cq0")])
+    submit(queues, make_wl("a", queue="lq-cq0", creation_time=1.0))
+    info = queues.pending_workloads("cq0")[0]
+    store = cache.workload_columns
+    snap = cache.snapshot()
+
+    set_columns_mode("on")
+    view = store.gather([info], snap, snap.resource_flavors)
+    assert view is not None and view.filled == 1
+    view = store.gather([info], snap, snap.resource_flavors)
+    assert view.filled == 0
+
+    # A cache workload event (in-place mutation the identity check can't
+    # see) dirties the row; the next gather refills it.
+    cache.add_or_update_workload(info)
+    view = store.gather([info], snap, snap.resource_flavors)
+    assert view.filled == 1
+
+    # A quota-generation bump invalidates by stamp.
+    cache.add_or_update_cluster_queue(cache.cluster_queues["cq0"])
+    snap2 = cache.snapshot()
+    view = store.gather([info], snap2, snap2.resource_flavors)
+    assert view.filled == 1
+
+    # Deletion frees the row and releases the strong info ref.
+    cache.delete_workload(info.key)
+    assert info.key not in store._index
+
+
+def test_ragged_backlog_falls_back_bit_identical():
+    cache, queues, _ = build_env([make_cq("cq0"), make_cq("cq1")])
+    submit(
+        queues,
+        make_wl("dense", queue="lq-cq0", cpu_m=1000, creation_time=1.0),
+        make_wl("partial", queue="lq-cq1", cpu_m=500, count=4,
+                min_count=2, creation_time=2.0),
+    )
+    heads = _pending(queues, ["cq0", "cq1"])
+    snap = cache.snapshot()
+    set_columns_mode("on")
+    assert cache.workload_columns.gather(
+        heads, snap, snap.resource_flavors) is None
+    _encode_both(snap, heads)
+
+
+# ---------------------------------------------------------------------------
+# plan_tiles union-find edge cases
+# ---------------------------------------------------------------------------
+
+def _tile_env(n_plain=2, n_tas=0, tas_flavor="tasf"):
+    cqs = []
+    for i in range(n_plain):
+        cqs.append(make_cq(f"plain{i}", cohort=f"pco{i}"))
+    for i in range(n_tas):
+        cqs.append(make_cq(
+            f"tas{i}", cohort=f"tco{i}",
+            flavors={tas_flavor: {"cpu": ResourceQuota(nominal=8000)}},
+        ))
+    cache, queues, _ = build_env(cqs)
+    return cache, queues, cqs
+
+
+def test_plan_tiles_oversized_fused_group_rides_alone():
+    cache, queues, _ = _tile_env(n_plain=2, n_tas=4)
+    t = 0.0
+    for i in range(4):
+        for j in range(2):
+            t += 1.0
+            submit(queues, make_wl(f"tas{i}-w{j}", queue=f"lq-tas{i}",
+                                   creation_time=t))
+    for i in range(2):
+        t += 1.0
+        submit(queues, make_wl(f"plain{i}-w", queue=f"lq-plain{i}",
+                               creation_time=t))
+    heads = _pending(queues, [f"tas{i}" for i in range(4)]
+                     + [f"plain{i}" for i in range(2)])
+    snap = cache.snapshot()
+    # Device-encoded TAS flavor shared by all four tas CQs: their four
+    # cohort trees fuse into ONE 8-head group, wider than the tile.
+    snap.tas_flavors = {"tasf": object()}
+    tiles = plan_tiles(heads, 4, snap)
+    sizes = sorted(len(t) for t in tiles)
+    assert 8 in sizes, f"fused group was split: {sizes}"
+    fused = next(t for t in tiles if len(t) == 8)
+    assert {h.cluster_queue for h in fused} == {f"tas{i}" for i in range(4)}
+    # Every head exactly once.
+    flat = [h.key for t in tiles for h in t]
+    assert sorted(flat) == sorted(h.key for h in heads)
+    assert len(set(flat)) == len(heads)
+
+
+def test_plan_tiles_missing_cq_singletons():
+    cache, queues, _ = _tile_env(n_plain=2)
+    submit(queues, make_wl("p0", queue="lq-plain0", creation_time=1.0),
+           make_wl("p1", queue="lq-plain1", creation_time=2.0))
+    heads = _pending(queues, ["plain0", "plain1"])
+    ghosts = [
+        WorkloadInfo(make_wl(f"ghost{i}", queue="lq-plain0",
+                             creation_time=10.0 + i), "no-such-cq")
+        for i in range(3)
+    ]
+    snap = cache.snapshot()
+    tiles = plan_tiles(heads + ghosts, 2, snap)
+    flat = [h.key for t in tiles for h in t]
+    assert sorted(flat) == sorted(h.key for h in heads + ghosts)
+    # Ghost heads are singleton groups: no tile holds two ghosts plus a
+    # real group that together exceed the width (greedy pack respects
+    # the bound when every group is width-1).
+    assert all(len(t) <= 2 for t in tiles)
+
+
+def test_plan_tiles_fused_group_never_straddles_pack_boundary():
+    # Group sizes 3 (fused tas) then 2 (one cohort): tile_width 4 forces
+    # the greedy packer to flush rather than split the second group.
+    cache, queues, _ = _tile_env(n_plain=1, n_tas=3)
+    t = 0.0
+    for i in range(3):
+        t += 1.0
+        submit(queues, make_wl(f"tas{i}-w", queue=f"lq-tas{i}",
+                               creation_time=t))
+    for j in range(2):
+        t += 1.0
+        submit(queues, make_wl(f"plain0-w{j}", queue="lq-plain0",
+                               creation_time=t))
+    heads = _pending(queues, ["tas0", "tas1", "tas2", "plain0"])
+    snap = cache.snapshot()
+    snap.tas_flavors = {"tasf": object()}
+    tiles = plan_tiles(heads, 4, snap)
+    assert [len(t) for t in tiles] == [3, 2]
+    assert {h.cluster_queue for h in tiles[0]} == {"tas0", "tas1", "tas2"}
+    assert all(h.cluster_queue == "plain0" for h in tiles[1])
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_plan_tiles_properties(seed):
+    """Seeded property test: tiles partition the heads, fused groups are
+    atomic (never split across tiles), and only a tile holding a single
+    oversized group may exceed the width."""
+    rng = random.Random(88_000 + seed)
+    n_tas = rng.randint(0, 3)
+    n_plain = rng.randint(1, 4)
+    cache, queues, _ = _tile_env(n_plain=n_plain, n_tas=n_tas)
+    cq_names = [f"plain{i}" for i in range(n_plain)] \
+        + [f"tas{i}" for i in range(n_tas)]
+    t = 0.0
+    for name in cq_names:
+        for i in range(rng.randint(1, 4)):
+            t += 1.0
+            submit(queues, make_wl(
+                f"{name}-w{i}", queue=f"lq-{name}",
+                priority=rng.choice([0, 50, 100]), creation_time=t,
+            ))
+    heads = _pending(queues, cq_names)
+    for i in range(rng.randint(0, 2)):
+        heads.append(WorkloadInfo(
+            make_wl(f"ghost{i}", queue=f"lq-{cq_names[0]}",
+                    creation_time=100.0 + i), "ghost-cq"))
+    snap = cache.snapshot()
+    if n_tas:
+        snap.tas_flavors = {"tasf": object()}
+    width = rng.choice([2, 3, 5])
+    tiles = plan_tiles(heads, width, snap)
+
+    flat = [h.key for tile in tiles for h in tile]
+    assert sorted(flat) == sorted(h.key for h in heads)
+    assert len(set(flat)) == len(heads)
+
+    # Expected fused-group key per head: cohort for plain CQs, one
+    # shared key for every TAS CQ (they all cover "tasf"), the head
+    # itself for missing CQs.
+    def group_key(i, h):
+        if h.cluster_queue not in snap.cluster_queues:
+            return ("solo", i)
+        if n_tas and h.cluster_queue.startswith("tas"):
+            return ("tas",)
+        return ("co", h.cluster_queue)
+
+    key_of = {h.key: group_key(i, h) for i, h in enumerate(heads)}
+    tile_of = {}
+    for k, tile in enumerate(tiles):
+        for h in tile:
+            tile_of.setdefault(key_of[h.key], set()).add(k)
+    for gk, tset in tile_of.items():
+        assert len(tset) == 1, f"group {gk} split across tiles {tset}"
+    for tile in tiles:
+        if len(tile) > width:
+            assert len({key_of[h.key] for h in tile}) == 1, \
+                "only a single oversized group may exceed the width"
+
+
+# ---------------------------------------------------------------------------
+# tiled bucket hysteresis (PR 20 bugfix)
+# ---------------------------------------------------------------------------
+
+def test_tiled_bucket_hysteresis(monkeypatch):
+    """Tiled cycles must resolve per-tile buckets through the tile
+    ladder: a tail tile oscillating across a rung boundary holds the
+    grown bucket (no executable flip), and only a sustained run of
+    smaller tiles shrinks one rung after the patience window."""
+    cqs = [make_cq(f"cq{i}", cohort=f"co{i}") for i in range(40)]
+    cache, queues, _ = build_env(cqs)
+    sched = DeviceScheduler(cache, queues, tile_width=32)
+    seen = []
+
+    def fake_schedule_heads(heads, start, result, bucket=None,
+                            tile=None, snapshot=None):
+        seen.append(bucket)
+        return result
+
+    monkeypatch.setattr(sched, "_schedule_heads", fake_schedule_heads)
+
+    mk = [0]
+
+    def heads_n(n):
+        out = []
+        for i in range(n):
+            mk[0] += 1
+            out.append(WorkloadInfo(
+                make_wl(f"w{mk[0]}", queue=f"lq-cq{i}",
+                        creation_time=float(mk[0])), f"cq{i}"))
+        return out
+
+    # 33 singleton groups at width 32 -> tiles [32, 1]: ladder grows to
+    # the 32 rung; the width-1 tail observes smaller but must not shrink.
+    sched._schedule_tiled(heads_n(33), 32, 0.0, CycleResult())
+    assert seen[0] == 32
+
+    # Oscillating backlog (10-head cycles interleaved with 33-head
+    # cycles): the old exact-bucket path flips 32 <-> 16 every cycle;
+    # the ladder must hold 32 throughout (patience never reached).
+    for _ in range(3):
+        sched._schedule_tiled(heads_n(10), 32, 0.0, CycleResult())
+        sched._schedule_tiled(heads_n(33), 32, 0.0, CycleResult())
+    assert all(b == 32 for b in seen), f"bucket oscillated: {seen}"
+
+    # A sustained run of small cycles shrinks one rung after patience.
+    for _ in range(8):
+        sched._schedule_tiled(heads_n(10), 32, 0.0, CycleResult())
+    assert seen[-1] == 16
+    assert seen.count(16) >= 1

@@ -42,6 +42,7 @@ CHECKERS = (
     "check_pipeline_guards.py",
     "check_ha_containment.py",
     "check_readplane_guards.py",
+    "check_encode_columns.py",
     "check_perf_ledger.py",
 )
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Static check: the encode hot paths stay columnar.
+
+PR 20 moved the per-workload encode work into the struct-of-arrays
+store (kueue_tpu/cache/columns.py): ``encode_cycle`` / ``plan_tiles``
+(models/encode.py) and ``CycleArena._build_w`` (models/arena.py) now do
+column slicing and ``np.take`` gathers, with the old per-row Python
+walks quarantined in named oracle helpers (``_classify_heads``,
+``_fill_w_rows``, ``_tile_head_views``, ``_build_w_rows``) that run only
+on the ragged fallback or in verify mode. This checker keeps it that
+way:
+
+- inside the hot functions, no ``for`` loop / comprehension / generator
+  may iterate a per-workload sequence (``heads``, ``device_wls``,
+  ``wl_slots``, ``infos``) — that is the host-side floor coming back;
+- the oracle helpers must still exist (deleting one silently un-checks
+  the allowlist and orphans the differential tests);
+- the hot path must still call into the columnar store (at least one
+  ``.gather(`` and one ``.assemble(`` site across the two files).
+
+Run standalone (exit 1 on violations) or via tools/check_all.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Set
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "kueue_tpu"
+
+# file -> functions whose bodies must not loop per workload.
+HOT_FUNCS: Dict[Path, Set[str]] = {
+    PACKAGE / "models" / "encode.py": {"encode_cycle", "plan_tiles"},
+    PACKAGE / "models" / "arena.py": {"_build_w"},
+}
+
+# Allowlisted row-wise oracles: they must exist (anti-rot — the verify
+# mode and the differential tests depend on them), and per-workload
+# loops inside them are fine.
+ORACLE_FUNCS: Dict[Path, Set[str]] = {
+    PACKAGE / "models" / "encode.py": {
+        "_classify_heads", "_fill_w_rows", "_tile_head_views",
+    },
+    PACKAGE / "models" / "arena.py": {"_build_w_rows"},
+}
+
+# Iterating any of these names inside a hot function is a violation.
+PER_WORKLOAD_NAMES = {"heads", "device_wls", "wl_slots", "infos"}
+
+LOOP_NODES = (ast.For, ast.ListComp, ast.SetComp, ast.DictComp,
+              ast.GeneratorExp)
+
+
+def _iter_exprs(node: ast.AST) -> List[ast.expr]:
+    if isinstance(node, ast.For):
+        return [node.iter]
+    if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return [g.iter for g in node.generators]
+    if isinstance(node, ast.DictComp):
+        return [g.iter for g in node.generators]
+    return []
+
+
+def _per_workload_name(expr: ast.expr) -> str:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in PER_WORKLOAD_NAMES:
+            return sub.id
+    return ""
+
+
+def _functions(tree: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def run_check() -> List[str]:
+    violations: List[str] = []
+    gather_sites = 0
+    assemble_sites = 0
+    for path in sorted(set(HOT_FUNCS) | set(ORACLE_FUNCS)):
+        try:
+            src = path.read_text()
+        except OSError as exc:
+            violations.append(f"{path}: unreadable ({exc})")
+            continue
+        tree = ast.parse(src, filename=str(path))
+        funcs = _functions(tree)
+
+        for name in sorted(ORACLE_FUNCS.get(path, ())):
+            if name not in funcs:
+                violations.append(
+                    f"{path}: oracle helper {name}() is gone — the "
+                    f"row-wise verify path must stay; update "
+                    f"{Path(__file__).name} if it was renamed"
+                )
+
+        for name in sorted(HOT_FUNCS.get(path, ())):
+            fn = funcs.get(name)
+            if fn is None:
+                violations.append(
+                    f"{path}: hot function {name}() not found — update "
+                    f"HOT_FUNCS in {Path(__file__).name}"
+                )
+                continue
+            nested = {
+                n for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            }
+            nested_bodies: Set[int] = set()
+            for nf in nested:
+                for sub in ast.walk(nf):
+                    nested_bodies.add(id(sub))
+            for node in ast.walk(fn):
+                if id(node) in nested_bodies:
+                    continue
+                if not isinstance(node, LOOP_NODES):
+                    continue
+                for expr in _iter_exprs(node):
+                    hit = _per_workload_name(expr)
+                    if hit:
+                        violations.append(
+                            f"{path}:{node.lineno}: {name}() iterates "
+                            f"per-workload sequence '{hit}' — the hot "
+                            f"path must stay columnar; move the loop "
+                            f"into an oracle helper or use the store"
+                        )
+
+        gather_sites += src.count(".gather(")
+        assemble_sites += src.count(".assemble(")
+
+    if not violations:
+        if gather_sites == 0:
+            violations.append(
+                "no '.gather(' call site in the encode hot paths — the "
+                "columnar store is no longer consulted"
+            )
+        if assemble_sites == 0:
+            violations.append(
+                "no '.assemble(' call site in the encode hot paths — "
+                "the columnar store no longer fills the cycle arrays"
+            )
+    return violations
+
+
+def main() -> int:
+    violations = run_check()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} encode-columns violation(s)")
+        return 1
+    print("encode columns check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,6 +208,18 @@ def main(argv: list) -> int:
         )
         if rc != 0:
             failures.append(("perf-gate:readplane", rc))
+        # Columnar encode probe: warm-columns full-encode speedup vs the
+        # row-wise oracle, plus the 3-seed bit-identity differential
+        # (docs/perf.md, "Columnar workload plane").
+        print("== [perf-gate] bench.py --probe encode", flush=True)
+        rc = subprocess.call(
+            [sys.executable, str(REPO_ROOT / "bench.py"),
+             "--probe", "encode", "--scale", "0.1",
+             "--platform", "cpu"],
+            cwd=str(REPO_ROOT),
+        )
+        if rc != 0:
+            failures.append(("perf-gate:encode", rc))
         # Perf-ledger gate: headline metrics in PERF_LEDGER.jsonl must
         # not regress vs their rolling median (check_perf_ledger.py).
         print("== [perf-gate] tools/check_perf_ledger.py", flush=True)
